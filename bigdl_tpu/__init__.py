@@ -1,0 +1,14 @@
+"""bigdl-tpu: a TPU-native distributed deep learning framework.
+
+A ground-up re-design of the capabilities of BigDL (Torch-style module
+zoo, Optimizer façade with triggers/validation/checkpointing, DataSet
+pipelines, Keras-style API, distributed data/tensor/pipeline/sequence
+parallel training) on JAX/XLA/Pallas over TPU device meshes.
+"""
+
+__version__ = "0.1.0"
+
+from bigdl_tpu.core import (
+    Module, ModuleList, Parameter, partition, combine,
+    forward_context,
+)

@@ -1,0 +1,5 @@
+from bigdl_tpu.core.module import (
+    Module, ModuleList, Parameter, partition, combine, tree_map_params,
+    forward_context, next_rng_key, has_rng,
+)
+from bigdl_tpu.core import init

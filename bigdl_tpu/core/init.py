@@ -1,0 +1,141 @@
+"""Weight initialization methods.
+
+Reference: spark/dl/.../nn/InitializationMethod.scala (Zeros, Ones, Const,
+RandomUniform, RandomNormal, Xavier, MsraFiller, BilinearFiller) and the
+Initializable protocol (nn/abstractnn/Initializable.scala:48).
+
+Each method is a callable ``(key, shape, dtype, fan_in=None, fan_out=None)
+-> jax.Array``.  Fans default to the Torch/BigDL convention: for a 2-D
+weight (out, in) fan_in = shape[1]; for conv kernels (out_c, in_c, kh, kw)
+fan_in = in_c*kh*kw.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "Zeros", "Ones", "ConstInitMethod", "RandomUniform", "RandomNormal",
+    "Xavier", "MsraFiller", "BilinearFiller", "Bilinear", "calc_fans",
+]
+
+
+def calc_fans(shape: Tuple[int, ...]) -> Tuple[int, int]:
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[1], shape[0]
+    receptive = 1
+    for s in shape[2:]:
+        receptive *= s
+    return shape[1] * receptive, shape[0] * receptive
+
+
+class InitMethod:
+    def __call__(self, key, shape, dtype=jnp.float32,
+                 fan_in: Optional[int] = None, fan_out: Optional[int] = None):
+        raise NotImplementedError
+
+
+class _Zeros(InitMethod):
+    def __call__(self, key, shape, dtype=jnp.float32, fan_in=None, fan_out=None):
+        return jnp.zeros(shape, dtype)
+
+
+class _Ones(InitMethod):
+    def __call__(self, key, shape, dtype=jnp.float32, fan_in=None, fan_out=None):
+        return jnp.ones(shape, dtype)
+
+
+class ConstInitMethod(InitMethod):
+    def __init__(self, value: float):
+        self.value = value
+
+    def __call__(self, key, shape, dtype=jnp.float32, fan_in=None, fan_out=None):
+        return jnp.full(shape, self.value, dtype)
+
+
+class RandomUniform(InitMethod):
+    """U(lower, upper); with no bounds, U(-1/sqrt(fan_in), 1/sqrt(fan_in))
+    (the Torch default used throughout the reference layer zoo)."""
+
+    def __init__(self, lower: Optional[float] = None,
+                 upper: Optional[float] = None):
+        if (lower is None) != (upper is None):
+            raise ValueError(
+                "RandomUniform needs both bounds or neither "
+                f"(got lower={lower}, upper={upper})")
+        self.lower, self.upper = lower, upper
+
+    def __call__(self, key, shape, dtype=jnp.float32, fan_in=None, fan_out=None):
+        if self.lower is None:
+            fi, _ = calc_fans(shape) if fan_in is None else (fan_in, None)
+            bound = 1.0 / math.sqrt(max(fi, 1))
+            lo, hi = -bound, bound
+        else:
+            lo, hi = self.lower, self.upper
+        return jax.random.uniform(key, shape, dtype, minval=lo, maxval=hi)
+
+
+class RandomNormal(InitMethod):
+    def __init__(self, mean: float = 0.0, stdv: float = 1.0):
+        self.mean, self.stdv = mean, stdv
+
+    def __call__(self, key, shape, dtype=jnp.float32, fan_in=None, fan_out=None):
+        return self.mean + self.stdv * jax.random.normal(key, shape, dtype)
+
+
+class _Xavier(InitMethod):
+    """Glorot uniform: U(-sqrt(6/(fan_in+fan_out)), +...)."""
+
+    def __call__(self, key, shape, dtype=jnp.float32, fan_in=None, fan_out=None):
+        fi, fo = calc_fans(shape)
+        fi = fan_in if fan_in is not None else fi
+        fo = fan_out if fan_out is not None else fo
+        bound = math.sqrt(6.0 / (fi + fo))
+        return jax.random.uniform(key, shape, dtype, minval=-bound, maxval=bound)
+
+
+class MsraFiller(InitMethod):
+    """Kaiming/He normal: N(0, sqrt(2/fan)) (reference MsraFiller)."""
+
+    def __init__(self, variance_norm_average: bool = True):
+        self.average = variance_norm_average
+
+    def __call__(self, key, shape, dtype=jnp.float32, fan_in=None, fan_out=None):
+        fi, fo = calc_fans(shape)
+        fi = fan_in if fan_in is not None else fi
+        fo = fan_out if fan_out is not None else fo
+        # non-average mode uses fan_out, matching the reference MsraFiller
+        # (InitializationMethod.scala:322-326)
+        n = (fi + fo) / 2.0 if self.average else fo
+        std = math.sqrt(2.0 / max(n, 1))
+        return std * jax.random.normal(key, shape, dtype)
+
+
+class BilinearFiller(InitMethod):
+    """Bilinear upsampling kernel init for transposed conv
+    (reference BilinearFiller; used by segmentation decoders)."""
+
+    def __call__(self, key, shape, dtype=jnp.float32, fan_in=None, fan_out=None):
+        assert len(shape) == 4, "BilinearFiller expects (out, in, kh, kw)"
+        kh, kw = shape[2], shape[3]
+        f_h, f_w = math.ceil(kh / 2.0), math.ceil(kw / 2.0)
+        c_h = (2 * f_h - 1 - f_h % 2) / (2.0 * f_h)
+        c_w = (2 * f_w - 1 - f_w % 2) / (2.0 * f_w)
+        ys = jnp.arange(kh)
+        xs = jnp.arange(kw)
+        wy = 1.0 - jnp.abs(ys / f_h - c_h)
+        wx = 1.0 - jnp.abs(xs / f_w - c_w)
+        kernel = jnp.outer(wy, wx).astype(dtype)
+        return jnp.broadcast_to(kernel, shape).astype(dtype)
+
+
+Zeros = _Zeros()
+Ones = _Ones()
+Xavier = _Xavier()
+Bilinear = BilinearFiller()

@@ -1,0 +1,535 @@
+"""Core module protocol for the bigdl-tpu framework.
+
+This replaces the reference's Torch-style ``AbstractModule`` hierarchy
+(reference: spark/dl/src/main/scala/com/intel/analytics/bigdl/nn/abstractnn/AbstractModule.scala:59)
+with a TPU/JAX-native design:
+
+* A :class:`Module` is a *mutable* Python object for ergonomic, Torch-style
+  model construction (``self.weight = Parameter(...)``, ``m.forward(x)``),
+  but every Module class is registered as a JAX **pytree**.  A jitted step
+  function receives the model as an argument, freely mutates the traced
+  copy (e.g. BatchNorm running stats), and returns the updated model —
+  imperative inside the trace, purely functional at jit boundaries.
+
+* ``forward``/``__call__`` compute the output (reference ``updateOutput``,
+  AbstractModule.scala:329).  There is no hand-written backward: gradients
+  come from ``jax.grad`` over the params partition.  A convenience
+  :meth:`Module.backward` mirroring AbstractModule.scala:305 is provided
+  via ``jax.vjp`` for API parity and testing.
+
+* Leaves are classified as *parameters* (trainable, created with
+  :class:`Parameter`) or *buffers* (non-trainable state, e.g. BN running
+  mean; any bare array assignment).  ``partition()/combine()`` split a
+  module into a params-only tree and a remainder so optimizers can
+  differentiate w.r.t. parameters only (reference ``parameters()``,
+  AbstractModule.scala:370).
+
+* ``get_parameters()`` returns the flattened compact (weights, unravel)
+  view mirroring ``getParameters()`` (AbstractModule.scala:390).
+"""
+
+from __future__ import annotations
+
+import copy as _copy
+import threading
+from contextlib import contextmanager
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "Parameter",
+    "Module",
+    "ModuleList",
+    "partition",
+    "combine",
+    "tree_map_params",
+    "forward_context",
+    "next_rng_key",
+    "has_rng",
+    "current_context",
+]
+
+
+class Parameter:
+    """Marker wrapper: ``self.weight = Parameter(array)`` registers a
+    trainable leaf.  The wrapper is unwrapped on assignment; modules store
+    raw ``jax.Array``s."""
+
+    __slots__ = ("value",)
+
+    def __init__(self, value):
+        self.value = jnp.asarray(value)
+
+
+def _is_array(x) -> bool:
+    return isinstance(x, (jax.Array, np.ndarray, jnp.ndarray))
+
+
+# --------------------------------------------------------------------------
+# Forward context: carries RNG + mode through Torch-style forward() calls
+# without changing their signatures.  Runs at trace time, so the key is a
+# (possibly traced) JAX PRNG key split functionally with a Python counter.
+# --------------------------------------------------------------------------
+
+class _ForwardContext(threading.local):
+    def __init__(self):
+        self.key = None
+        self._count = 0
+
+
+_ctx = _ForwardContext()
+
+
+@contextmanager
+def forward_context(rng=None):
+    """Provide an RNG key for stochastic layers (Dropout, RReLU, sampling)
+    during the enclosed ``forward`` calls."""
+    prev_key, prev_count = _ctx.key, _ctx._count
+    _ctx.key = rng
+    _ctx._count = 0
+    try:
+        yield
+    finally:
+        _ctx.key, _ctx._count = prev_key, prev_count
+
+
+def has_rng() -> bool:
+    return _ctx.key is not None
+
+
+def _in_active_trace() -> bool:
+    try:
+        from jax._src import core as _core
+        return not _core.trace_state_clean()
+    except Exception:
+        return False
+
+
+def next_rng_key():
+    """Split a fresh key off the ambient forward context.
+
+    The forward_context MUST be opened *inside* the jitted function (with
+    the key passed as a traced argument); a context opened outside jit
+    would bake the key into the compiled program as a constant.
+    """
+    if _ctx.key is None:
+        raise RuntimeError(
+            "No RNG in scope: wrap the forward call in "
+            "`with forward_context(rng=key):` (training mode stochastic "
+            "layers need randomness)."
+        )
+    if _in_active_trace() and not isinstance(_ctx.key, jax.core.Tracer):
+        raise RuntimeError(
+            "forward_context was opened OUTSIDE the jitted function: the "
+            "RNG key would be baked into the compiled trace as a constant "
+            "and every call would reuse the same randomness. Pass the key "
+            "into the jitted function and open forward_context inside it."
+        )
+    _ctx._count += 1
+    return jax.random.fold_in(_ctx.key, _ctx._count)
+
+
+def current_context():
+    return _ctx
+
+
+# --------------------------------------------------------------------------
+# Module
+# --------------------------------------------------------------------------
+
+class _Sentinel:
+    """Placeholder stored in __dict__ for attrs living in the classified
+    dicts.  Deepcopy/pickle-stable singleton so `is _SENTINEL` survives
+    Module.clone() and serialization."""
+
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+        return cls._instance
+
+    def __copy__(self):
+        return self
+
+    def __deepcopy__(self, memo):
+        return self
+
+    def __reduce__(self):
+        return (_Sentinel, ())
+
+
+_SENTINEL = _Sentinel()
+
+
+class Module:
+    """Base class of every layer/container (reference AbstractModule.scala:59).
+
+    Subclasses are automatically registered as pytrees.  Dynamic leaves are
+    (in order): parameters, buffers, submodules.  Everything else set on the
+    instance is static aux data and must be hashable-equatable (ints,
+    floats, strings, tuples, callables).
+    """
+
+    # -- construction ------------------------------------------------------
+
+    def __init__(self):
+        # use object.__setattr__ to avoid classification of bookkeeping
+        object.__setattr__(self, "_params", {})     # name -> array
+        object.__setattr__(self, "_buffers", {})    # name -> array
+        object.__setattr__(self, "_modules", {})    # name -> Module|ModuleList
+        object.__setattr__(self, "_static", {})     # name -> hashable
+        object.__setattr__(self, "training", True)
+        object.__setattr__(self, "name", self.__class__.__name__)
+
+    def __init_subclass__(cls, **kw):
+        super().__init_subclass__(**kw)
+        jax.tree_util.register_pytree_with_keys(
+            cls, cls._tree_flatten_with_keys, cls._tree_unflatten,
+            flatten_func=cls._tree_flatten)
+
+    # -- attribute classification -----------------------------------------
+
+    def __setattr__(self, name, value):
+        if name in ("training", "name"):
+            object.__setattr__(self, name, value)
+            return
+        # remove from previous slot if re-assigned with different kind
+        for d in (self._params, self._buffers, self._modules, self._static):
+            d.pop(name, None)
+        if isinstance(value, Parameter):
+            self._params[name] = value.value
+        elif _is_array(value):
+            self._buffers[name] = jnp.asarray(value)
+        elif isinstance(value, (Module, ModuleList)):
+            self._modules[name] = value
+        else:
+            self._static[name] = value
+        object.__setattr__(self, name, _SENTINEL)
+
+    def __getattribute__(self, name):
+        v = object.__getattribute__(self, name)
+        if v is _SENTINEL:
+            for dn in ("_params", "_buffers", "_modules", "_static"):
+                d = object.__getattribute__(self, dn)
+                if name in d:
+                    return d[name]
+            raise AttributeError(name)
+        return v
+
+    # -- pytree protocol ---------------------------------------------------
+
+    def _tree_flatten(self):
+        children, _ = self._tree_flatten_with_keys()
+        return [c for _, c in children], self._aux()
+
+    def _tree_flatten_with_keys(self):
+        children = []
+        for n in self._params:
+            children.append((jax.tree_util.GetAttrKey(n), self._params[n]))
+        for n in self._buffers:
+            children.append((jax.tree_util.GetAttrKey(n), self._buffers[n]))
+        for n in self._modules:
+            children.append((jax.tree_util.GetAttrKey(n), self._modules[n]))
+        return children, self._aux()
+
+    def _aux(self):
+        return (
+            tuple(self._params.keys()),
+            tuple(self._buffers.keys()),
+            tuple(self._modules.keys()),
+            tuple(sorted(self._static.items(), key=lambda kv: kv[0])),
+            self.training,
+            self.name,
+        )
+
+    @classmethod
+    def _tree_unflatten(cls, aux, children):
+        pnames, bnames, mnames, static_items, training, name = aux
+        obj = object.__new__(cls)
+        object.__setattr__(obj, "_params", {})
+        object.__setattr__(obj, "_buffers", {})
+        object.__setattr__(obj, "_modules", {})
+        object.__setattr__(obj, "_static", dict(static_items))
+        object.__setattr__(obj, "training", training)
+        object.__setattr__(obj, "name", name)
+        it = iter(children)
+        for n in pnames:
+            obj._params[n] = next(it)
+        for n in bnames:
+            obj._buffers[n] = next(it)
+        for n in mnames:
+            obj._modules[n] = next(it)
+        for n in list(obj._params) + list(obj._buffers) + list(obj._modules):
+            object.__setattr__(obj, n, _SENTINEL)
+        return obj
+
+    # -- forward / backward ------------------------------------------------
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def __call__(self, *inputs):
+        return self.forward(*inputs)
+
+    def backward(self, input, grad_output):
+        """API-parity helper (reference AbstractModule.scala:305): returns
+        grad_input via jax.vjp.  Training uses jax.grad over params instead.
+
+        Runs the vjp on a functional copy of the module so buffer mutations
+        inside forward can't leak tracers into this live instance."""
+        leaves, treedef = jax.tree_util.tree_flatten(self)
+
+        def pure_forward(x, leaves):
+            m = jax.tree_util.tree_unflatten(treedef, leaves)
+            return m.forward(x)
+
+        y, vjp = jax.vjp(pure_forward, input, leaves)
+        gi, _ = vjp(grad_output)
+        return gi
+
+    # -- mode --------------------------------------------------------------
+
+    def train_mode(self, flag: bool = True) -> "Module":
+        """Set training mode recursively (reference ``training()``)."""
+        self.training = flag
+        for m in self.modules():
+            m.train_mode(flag)
+        return self
+
+    def eval_mode(self) -> "Module":
+        """Set evaluation mode recursively (reference ``evaluate()``)."""
+        return self.train_mode(False)
+
+    def is_training(self) -> bool:
+        return self.training
+
+    # -- traversal ---------------------------------------------------------
+
+    def modules(self) -> List["Module"]:
+        out = []
+        for v in self._modules.values():
+            if isinstance(v, ModuleList):
+                out.extend(v._items)
+            else:
+                out.append(v)
+        return out
+
+    def named_modules(self, prefix: str = "") -> List[Tuple[str, "Module"]]:
+        res = [(prefix or self.name, self)]
+        for n, v in self._modules.items():
+            if isinstance(v, ModuleList):
+                for i, m in enumerate(v._items):
+                    res.extend(m.named_modules(f"{prefix}.{n}[{i}]" if prefix
+                                               else f"{n}[{i}]"))
+            else:
+                res.extend(v.named_modules(f"{prefix}.{n}" if prefix else n))
+        return res
+
+    def apply_to_modules(self, fn: Callable[["Module"], None]) -> "Module":
+        fn(self)
+        for m in self.modules():
+            m.apply_to_modules(fn)
+        return self
+
+    def set_name(self, name: str) -> "Module":
+        self.name = name
+        return self
+
+    def get_name(self) -> str:
+        return self.name
+
+    # -- parameters --------------------------------------------------------
+
+    def parameters(self) -> Dict[str, Any]:
+        """Nested dict of trainable parameters (reference parameters():370)."""
+        out = dict(self._params)
+        for n, v in self._modules.items():
+            if isinstance(v, ModuleList):
+                for i, m in enumerate(v._items):
+                    sub = m.parameters()
+                    if sub:
+                        out[f"{n}[{i}]"] = sub
+            else:
+                sub = v.parameters()
+                if sub:
+                    out[n] = sub
+        return out
+
+    def buffers(self) -> Dict[str, Any]:
+        out = dict(self._buffers)
+        for n, v in self._modules.items():
+            if isinstance(v, ModuleList):
+                for i, m in enumerate(v._items):
+                    sub = m.buffers()
+                    if sub:
+                        out[f"{n}[{i}]"] = sub
+            else:
+                sub = v.buffers()
+                if sub:
+                    out[n] = sub
+        return out
+
+    def get_parameters(self):
+        """Compact flat view: (flat_weights, unravel_fn).  Mirrors
+        ``getParameters()`` (AbstractModule.scala:390) which flattens all
+        trainable weights into one contiguous tensor."""
+        from jax.flatten_util import ravel_pytree
+        flat, unravel = ravel_pytree(self.parameters())
+        return flat, unravel
+
+    def n_parameters(self) -> int:
+        return sum(int(np.prod(x.shape))
+                   for x in jax.tree_util.tree_leaves(self.parameters()))
+
+    def load_parameters(self, params) -> "Module":
+        """Set trainable parameters from a nested dict of the same
+        structure as :meth:`parameters` (in place)."""
+        for n in self._params:
+            if n in params:
+                self._params[n] = jnp.asarray(params[n])
+        for n, v in self._modules.items():
+            if isinstance(v, ModuleList):
+                for i, m in enumerate(v._items):
+                    key = f"{n}[{i}]"
+                    if key in params:
+                        m.load_parameters(params[key])
+            elif n in params:
+                v.load_parameters(params[n])
+        return self
+
+    # -- freezing / lr scale (reference freeze/unfreeze, scaleW/scaleB) ----
+
+    def freeze(self, *names: str) -> "Module":
+        """Mark this module (or named descendants) as non-trainable:
+        their params are excluded from the grad partition
+        (reference AbstractModule.freeze)."""
+        if names:
+            wanted = set(names)
+            for nm, m in self.named_modules():
+                if m.name in wanted or nm in wanted:
+                    m.apply_to_modules(
+                        lambda mm: mm._static.__setitem__("_frozen", True))
+        else:
+            self.apply_to_modules(lambda m: m._static.__setitem__("_frozen", True))
+        return self
+
+    def unfreeze(self) -> "Module":
+        self.apply_to_modules(lambda m: m._static.__setitem__("_frozen", False))
+        return self
+
+    def is_frozen(self) -> bool:
+        return bool(self._static.get("_frozen", False))
+
+    # -- misc --------------------------------------------------------------
+
+    def clone(self) -> "Module":
+        return _copy.deepcopy(self)
+
+    def __repr__(self):
+        parts = []
+        for n, p in self._params.items():
+            parts.append(f"{n}:{tuple(p.shape)}")
+        inner = ", ".join(parts)
+        subs = "".join(
+            "\n  " + repr(m).replace("\n", "\n  ") for m in self.modules())
+        return f"{self.__class__.__name__}({inner}){subs}"
+
+
+class ModuleList:
+    """Container for a homogeneous list of submodules (registered pytree)."""
+
+    def __init__(self, items: Sequence[Module] = ()):
+        self._items: List[Module] = list(items)
+
+    def append(self, m: Module) -> "ModuleList":
+        self._items.append(m)
+        return self
+
+    def __iter__(self):
+        return iter(self._items)
+
+    def __len__(self):
+        return len(self._items)
+
+    def __getitem__(self, i):
+        return self._items[i]
+
+
+jax.tree_util.register_pytree_with_keys(
+    ModuleList,
+    lambda ml: ([(jax.tree_util.SequenceKey(i), m)
+                 for i, m in enumerate(ml._items)], len(ml._items)),
+    lambda n, children: ModuleList(list(children)),
+    flatten_func=lambda ml: (list(ml._items), len(ml._items)),
+)
+
+
+# --------------------------------------------------------------------------
+# partition / combine — equinox-style filtering so optimizers can grad
+# w.r.t. trainable parameters only.
+# --------------------------------------------------------------------------
+
+def partition(mod: Module):
+    """Split a module into ``(params, remainder)`` — two same-structure
+    pytrees with ``None`` at complementary leaves; frozen modules' params
+    stay in the remainder.  ``combine(params, remainder)`` restores."""
+    leaves_p, leaves_r = [], []
+    paths_leaves, treedef = jax.tree_util.tree_flatten_with_path(mod)
+    # Determine param-ness per leaf by re-walking the module structure.
+    flags = _param_flags(mod)
+    assert len(flags) == len(paths_leaves)
+    for (path, leaf), is_p in zip(paths_leaves, flags):
+        if is_p:
+            leaves_p.append(leaf)
+            leaves_r.append(None)
+        else:
+            leaves_p.append(None)
+            leaves_r.append(leaf)
+    return (jax.tree_util.tree_unflatten(treedef, leaves_p),
+            jax.tree_util.tree_unflatten(treedef, leaves_r))
+
+
+def _param_flags(obj) -> List[bool]:
+    """Per-flattened-leaf flags: True if the leaf is a trainable param
+    of a non-frozen module."""
+    flags: List[bool] = []
+    if isinstance(obj, Module):
+        frozen = obj.is_frozen()
+        for n in obj._params:
+            flags.append(not frozen)
+        for n in obj._buffers:
+            flags.append(False)
+        for n in obj._modules:
+            flags.extend(_param_flags(obj._modules[n]))
+    elif isinstance(obj, ModuleList):
+        for m in obj._items:
+            flags.extend(_param_flags(m))
+    else:
+        # generic pytree (tuple/list/dict of the above or raw leaves)
+        children = jax.tree_util.tree_leaves(
+            obj, is_leaf=lambda x: isinstance(x, (Module, ModuleList))
+            and x is not obj)
+        for c in children:
+            if isinstance(c, (Module, ModuleList)):
+                flags.extend(_param_flags(c))
+            else:
+                flags.append(False)
+    return flags
+
+
+def combine(a, b):
+    """Merge two same-structure trees, taking the non-None leaf."""
+    return jax.tree_util.tree_map(
+        lambda x, y: x if x is not None else y, a, b,
+        is_leaf=lambda x: x is None)
+
+
+def tree_map_params(fn: Callable, mod: Module) -> Module:
+    """Apply fn to every trainable param leaf, returning a new module."""
+    params, rest = partition(mod)
+    params = jax.tree_util.tree_map(fn, params)
+    return combine(params, rest)

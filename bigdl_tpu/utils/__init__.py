@@ -1,0 +1,1 @@
+from bigdl_tpu.utils.rng import set_seed, get_seed, next_key

@@ -1,0 +1,23 @@
+"""Test configuration: run on XLA CPU with 8 virtual devices so the
+multi-chip sharding paths are exercised without a pod — the equivalent of
+the reference's `new SparkContext("local[1]", ...)` trick
+(reference: optim/DistriOptimizerSpec.scala:139)."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    from bigdl_tpu.utils import set_seed
+    set_seed(4357)  # the reference's default RandomGenerator seed semantics
+    yield

@@ -1,0 +1,121 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu import Module, Parameter, partition, combine, forward_context
+from bigdl_tpu.core import init
+from bigdl_tpu.utils import set_seed, next_key
+
+
+class Affine(Module):
+    def __init__(self, fin, fout):
+        super().__init__()
+        self.weight = Parameter(init.Xavier(next_key(), (fout, fin)))
+        self.bias = Parameter(jnp.zeros(fout))
+        self.calls = jnp.zeros(())
+
+    def forward(self, x):
+        self.calls = self.calls + 1
+        return x @ self.weight.T + self.bias
+
+
+class MLP(Module):
+    def __init__(self):
+        super().__init__()
+        self.a = Affine(4, 8)
+        self.b = Affine(8, 2)
+
+    def forward(self, x):
+        return self.b(jax.nn.relu(self.a(x)))
+
+
+def test_pytree_roundtrip():
+    m = MLP()
+    leaves, treedef = jax.tree_util.tree_flatten(m)
+    m2 = jax.tree_util.tree_unflatten(treedef, leaves)
+    x = jnp.ones((3, 4))
+    np.testing.assert_allclose(m.forward(x), m2.forward(x))
+
+
+def test_partition_grad_and_buffer_update():
+    m = MLP()
+    x = jnp.ones((3, 4))
+    params, rest = partition(m)
+
+    def loss_fn(p):
+        mm = combine(p, rest)
+        return jnp.sum(mm.forward(x) ** 2), mm
+
+    (loss, m2), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+    assert float(m2.a.calls) == 1.0
+    n_param_grads = len(jax.tree_util.tree_leaves(grads))
+    assert n_param_grads == 4
+
+
+def test_jit_model_as_arg_is_functional():
+    m = MLP()
+    x = jnp.ones((3, 4))
+
+    @jax.jit
+    def step(model, x):
+        y = model.forward(x)
+        return y, model
+
+    _, m1 = step(m, x)
+    _, m2 = step(m1, x)
+    assert float(m.a.calls) == 0.0  # original untouched
+    assert float(m2.a.calls) == 2.0
+
+
+def test_freeze_excludes_params():
+    m = MLP()
+    m.a.freeze()
+    params, _ = partition(m)
+    assert len(jax.tree_util.tree_leaves(params)) == 2
+    m.unfreeze()
+    params, _ = partition(m)
+    assert len(jax.tree_util.tree_leaves(params)) == 4
+
+
+def test_get_parameters_flat_view():
+    m = MLP()
+    flat, unravel = m.get_parameters()
+    assert flat.shape == (4 * 8 + 8 + 8 * 2 + 2,)
+    tree = unravel(flat)
+    assert "a" in tree and "weight" in tree["a"]
+
+
+def test_train_eval_mode_recursive():
+    m = MLP()
+    m.eval_mode()
+    assert not m.a.training and not m.b.training
+    m.train_mode()
+    assert m.a.training
+
+
+def test_init_methods_reproducible():
+    set_seed(7)
+    k = next_key()
+    a = init.Xavier(k, (16, 16))
+    b = init.Xavier(k, (16, 16))
+    np.testing.assert_allclose(a, b)
+    z = init.Zeros(k, (3,))
+    assert float(jnp.sum(jnp.abs(z))) == 0.0
+    # non-average MSRA uses fan_out (reference InitializationMethod.scala:322)
+    msra = init.MsraFiller(False)(k, (64, 32, 3, 3))
+    assert abs(float(jnp.std(msra)) - (2.0 / (64 * 9)) ** 0.5) < 0.01
+
+
+def test_forward_context_rng():
+    from bigdl_tpu.core.module import next_rng_key, has_rng
+    assert not has_rng()
+    with forward_context(rng=jax.random.key(0)):
+        assert has_rng()
+        k1 = next_rng_key()
+        k2 = next_rng_key()
+        assert not np.array_equal(jax.random.key_data(k1),
+                                  jax.random.key_data(k2))
+    assert not has_rng()
+    with pytest.raises(RuntimeError):
+        next_rng_key()
