@@ -206,7 +206,13 @@ class Module:
             self._buffers[name] = jnp.asarray(value)
         elif isinstance(value, (Module, ModuleList)):
             self._modules[name] = value
+        elif isinstance(value, (list, tuple)) and value and \
+                all(isinstance(v, Module) for v in value):
+            self._modules[name] = ModuleList(list(value))
         else:
+            if isinstance(value, list):
+                # static aux must be hashable for jit caching
+                value = tuple(value)
             self._static[name] = value
         object.__setattr__(self, name, _SENTINEL)
 
@@ -263,7 +269,8 @@ class Module:
             obj._buffers[n] = next(it)
         for n in mnames:
             obj._modules[n] = next(it)
-        for n in list(obj._params) + list(obj._buffers) + list(obj._modules):
+        for n in (list(obj._params) + list(obj._buffers)
+                  + list(obj._modules) + list(obj._static)):
             object.__setattr__(obj, n, _SENTINEL)
         return obj
 
@@ -273,6 +280,13 @@ class Module:
         raise NotImplementedError
 
     def __call__(self, *inputs):
+        # Graph-building DSL (reference nn/Graph.scala `inputs()`):
+        # calling a module on Node objects creates a new graph Node
+        # instead of executing forward.
+        if inputs:
+            from bigdl_tpu.nn.containers import Node, node_of
+            if all(isinstance(i, Node) for i in inputs):
+                return node_of(self, *inputs)
         return self.forward(*inputs)
 
     def backward(self, input, grad_output):

@@ -1,0 +1,357 @@
+"""Pointwise activation layers.
+
+Reference: the Torch-style activation zoo under spark/dl/.../nn/
+(ReLU.scala, Tanh.scala, HardTanh.scala, ELU.scala, …).  On TPU these
+are pure ``jnp`` elementwise ops that XLA fuses into neighbouring
+matmuls/convs — the reference's MKL-VML dispatch (TensorNumeric.scala:542)
+has no equivalent cost here.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.core.module import Module, Parameter, next_rng_key, has_rng
+from bigdl_tpu.core import init as init_methods
+
+__all__ = [
+    "ReLU", "ReLU6", "Tanh", "Sigmoid", "HardSigmoid", "HardTanh",
+    "LeakyReLU", "PReLU", "RReLU", "SReLU", "ELU", "SoftPlus", "SoftSign",
+    "SoftShrink", "HardShrink", "TanhShrink", "SoftMax", "SoftMin",
+    "LogSoftMax", "LogSigmoid", "Threshold", "BinaryThreshold", "Clamp",
+    "Power", "Square", "Sqrt", "Log", "Exp", "Abs", "Negative",
+    "GradientReversal", "AddConstant", "MulConstant", "GELU", "Swish",
+]
+
+
+class ReLU(Module):
+    """max(0, x) (reference nn/ReLU.scala)."""
+
+    def __init__(self, ip: bool = False):
+        super().__init__()
+
+    def forward(self, x):
+        return jnp.maximum(x, 0)
+
+
+class ReLU6(Module):
+    """min(max(0, x), 6) (reference nn/ReLU6.scala)."""
+
+    def forward(self, x):
+        return jnp.clip(x, 0, 6)
+
+
+class Tanh(Module):
+    def forward(self, x):
+        return jnp.tanh(x)
+
+
+class Sigmoid(Module):
+    def forward(self, x):
+        return jax.nn.sigmoid(x)
+
+
+class HardSigmoid(Module):
+    """clip(0.2*x + 0.5, 0, 1) (reference nn/HardSigmoid.scala)."""
+
+    def forward(self, x):
+        return jnp.clip(0.2 * x + 0.5, 0.0, 1.0)
+
+
+class HardTanh(Module):
+    """clip(x, min_value, max_value) (reference nn/HardTanh.scala)."""
+
+    def __init__(self, min_value: float = -1.0, max_value: float = 1.0,
+                 ip: bool = False):
+        super().__init__()
+        self.min_value = float(min_value)
+        self.max_value = float(max_value)
+
+    def forward(self, x):
+        return jnp.clip(x, self.min_value, self.max_value)
+
+
+class LeakyReLU(Module):
+    """x if x>0 else negval*x (reference nn/LeakyReLU.scala)."""
+
+    def __init__(self, negval: float = 0.01, ip: bool = False):
+        super().__init__()
+        self.negval = float(negval)
+
+    def forward(self, x):
+        return jnp.where(x > 0, x, self.negval * x)
+
+
+class PReLU(Module):
+    """Learnable leaky slope, one weight (shared) or per channel
+    (reference nn/PReLU.scala; channel dim is the last axis in NHWC)."""
+
+    def __init__(self, n_output_plane: int = 0):
+        super().__init__()
+        self.n_output_plane = n_output_plane
+        n = max(n_output_plane, 1)
+        self.weight = Parameter(jnp.full((n,), 0.25))
+
+    def forward(self, x):
+        w = self.weight if self.n_output_plane > 0 else self.weight[0]
+        return jnp.where(x > 0, x, w * x)
+
+
+class RReLU(Module):
+    """Randomized leaky ReLU: slope ~ U(lower, upper) in training,
+    fixed mean slope in eval (reference nn/RReLU.scala)."""
+
+    def __init__(self, lower: float = 1.0 / 8, upper: float = 1.0 / 3,
+                 ip: bool = False):
+        super().__init__()
+        self.lower, self.upper = float(lower), float(upper)
+
+    def forward(self, x):
+        if self.training and has_rng():
+            a = jax.random.uniform(next_rng_key(), x.shape, x.dtype,
+                                   self.lower, self.upper)
+        else:
+            a = (self.lower + self.upper) / 2.0
+        return jnp.where(x >= 0, x, a * x)
+
+
+class SReLU(Module):
+    """S-shaped ReLU with 4 learnable per-channel params t_r, a_r, t_l, a_l
+    (reference nn/SReLU.scala)."""
+
+    def __init__(self, shape):
+        super().__init__()
+        shape = tuple(shape)
+        self.t_left = Parameter(jnp.zeros(shape))
+        self.a_left = Parameter(jnp.ones(shape))
+        self.t_right = Parameter(jnp.ones(shape))
+        self.a_right = Parameter(jnp.ones(shape))
+
+    def forward(self, x):
+        y = jnp.where(x >= self.t_right,
+                      self.t_right + self.a_right * (x - self.t_right), x)
+        return jnp.where(y <= self.t_left,
+                         self.t_left + self.a_left * (y - self.t_left), y)
+
+
+class ELU(Module):
+    """alpha*(exp(x)-1) for x<0 else x (reference nn/ELU.scala)."""
+
+    def __init__(self, alpha: float = 1.0, ip: bool = False):
+        super().__init__()
+        self.alpha = float(alpha)
+
+    def forward(self, x):
+        return jnp.where(x > 0, x, self.alpha * jnp.expm1(x))
+
+
+class SoftPlus(Module):
+    """log(1+exp(beta*x))/beta with linear tail for large x
+    (reference nn/SoftPlus.scala)."""
+
+    def __init__(self, beta: float = 1.0):
+        super().__init__()
+        self.beta = float(beta)
+
+    def forward(self, x):
+        return jax.nn.softplus(self.beta * x) / self.beta
+
+
+class SoftSign(Module):
+    def forward(self, x):
+        return x / (1.0 + jnp.abs(x))
+
+
+class SoftShrink(Module):
+    def __init__(self, lambd: float = 0.5):
+        super().__init__()
+        self.lambd = float(lambd)
+
+    def forward(self, x):
+        return jnp.sign(x) * jnp.maximum(jnp.abs(x) - self.lambd, 0.0)
+
+
+class HardShrink(Module):
+    def __init__(self, lambd: float = 0.5):
+        super().__init__()
+        self.lambd = float(lambd)
+
+    def forward(self, x):
+        return jnp.where(jnp.abs(x) > self.lambd, x, 0.0)
+
+
+class TanhShrink(Module):
+    def forward(self, x):
+        return x - jnp.tanh(x)
+
+
+class SoftMax(Module):
+    """Softmax over the feature axis (last axis; reference nn/SoftMax.scala
+    normalizes dim 1 of NCHW — NHWC-native here)."""
+
+    def __init__(self, axis: int = -1):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        return jax.nn.softmax(x, axis=self.axis)
+
+
+class SoftMin(Module):
+    def __init__(self, axis: int = -1):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        return jax.nn.softmax(-x, axis=self.axis)
+
+
+class LogSoftMax(Module):
+    def __init__(self, axis: int = -1):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        return jax.nn.log_softmax(x, axis=self.axis)
+
+
+class LogSigmoid(Module):
+    def forward(self, x):
+        return jax.nn.log_sigmoid(x)
+
+
+class Threshold(Module):
+    """x if x > th else value (reference nn/Threshold.scala)."""
+
+    def __init__(self, th: float = 1e-6, v: float = 0.0, ip: bool = False):
+        super().__init__()
+        self.th, self.v = float(th), float(v)
+
+    def forward(self, x):
+        return jnp.where(x > self.th, x, self.v)
+
+
+class BinaryThreshold(Module):
+    """1 if x > th else 0 (reference nn/BinaryThreshold.scala)."""
+
+    def __init__(self, th: float = 1e-6):
+        super().__init__()
+        self.th = float(th)
+
+    def forward(self, x):
+        return (x > self.th).astype(x.dtype)
+
+
+class Clamp(HardTanh):
+    """Alias of HardTanh with int bounds (reference nn/Clamp.scala)."""
+
+    def __init__(self, min_value: int, max_value: int):
+        super().__init__(float(min_value), float(max_value))
+
+
+class Power(Module):
+    """(shift + scale*x)^power (reference nn/Power.scala)."""
+
+    def __init__(self, power: float, scale: float = 1.0, shift: float = 0.0):
+        super().__init__()
+        self.power, self.scale, self.shift = float(power), float(scale), float(shift)
+
+    def forward(self, x):
+        return jnp.power(self.shift + self.scale * x, self.power)
+
+
+class Square(Module):
+    def forward(self, x):
+        return x * x
+
+
+class Sqrt(Module):
+    def forward(self, x):
+        return jnp.sqrt(x)
+
+
+class Log(Module):
+    def forward(self, x):
+        return jnp.log(x)
+
+
+class Exp(Module):
+    def forward(self, x):
+        return jnp.exp(x)
+
+
+class Abs(Module):
+    def forward(self, x):
+        return jnp.abs(x)
+
+
+class Negative(Module):
+    def __init__(self, inplace: bool = False):
+        super().__init__()
+
+    def forward(self, x):
+        return -x
+
+
+@jax.custom_vjp
+def _grad_reverse(x, lambd):
+    return x
+
+
+def _grad_reverse_fwd(x, lambd):
+    return x, lambd
+
+
+def _grad_reverse_bwd(lambd, g):
+    return (-lambd * g, None)
+
+
+_grad_reverse.defvjp(_grad_reverse_fwd, _grad_reverse_bwd)
+
+
+class GradientReversal(Module):
+    """Identity forward, -lambda * grad backward (reference
+    nn/GradientReversal.scala; domain-adversarial training)."""
+
+    def __init__(self, lambd: float = 1.0):
+        super().__init__()
+        self.lambd = float(lambd)
+
+    def forward(self, x):
+        return _grad_reverse(x, self.lambd)
+
+
+class AddConstant(Module):
+    def __init__(self, constant_scalar: float, ip: bool = False):
+        super().__init__()
+        self.constant_scalar = float(constant_scalar)
+
+    def forward(self, x):
+        return x + self.constant_scalar
+
+
+class MulConstant(Module):
+    def __init__(self, scalar: float, ip: bool = False):
+        super().__init__()
+        self.scalar = float(scalar)
+
+    def forward(self, x):
+        return x * self.scalar
+
+
+class GELU(Module):
+    """Gaussian error linear unit (used by the reference Transformer,
+    nn/Transformer.scala gelu)."""
+
+    def __init__(self, approximate: bool = True):
+        super().__init__()
+        self.approximate = approximate
+
+    def forward(self, x):
+        return jax.nn.gelu(x, approximate=self.approximate)
+
+
+class Swish(Module):
+    def forward(self, x):
+        return x * jax.nn.sigmoid(x)

@@ -1,0 +1,214 @@
+"""Structural containers.
+
+Reference: nn/Container.scala, nn/Sequential.scala, nn/Concat.scala,
+nn/ConcatTable.scala, nn/ParallelTable.scala, nn/MapTable.scala,
+nn/Bottle.scala, nn/Graph.scala (+ StaticGraph topo-sorted execution,
+nn/StaticGraph.scala:44) and utils/DirectedGraph.scala.
+
+A "Table" activity in the reference maps to a Python tuple/list (any JAX
+pytree is a valid activity here).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.core.module import Module, ModuleList
+
+__all__ = [
+    "Container", "Sequential", "Concat", "ConcatTable", "ParallelTable",
+    "MapTable", "Bottle", "Node", "Input", "Graph",
+]
+
+
+class Container(Module):
+    """Base composite module (reference nn/Container.scala)."""
+
+    def __init__(self, *modules: Module):
+        super().__init__()
+        self.layers = ModuleList(list(modules))
+
+    def add(self, module: Module) -> "Container":
+        self.layers.append(module)
+        return self
+
+    def __len__(self):
+        return len(self.layers)
+
+    def __getitem__(self, i) -> Module:
+        return self.layers[i]
+
+
+class Sequential(Container):
+    """Chain modules (reference nn/Sequential.scala)."""
+
+    def forward(self, x):
+        for m in self.layers:
+            x = m(x)
+        return x
+
+
+class Concat(Container):
+    """Apply each branch to the same input and concatenate the outputs
+    along `dimension` (reference nn/Concat.scala; dimension is 1-based
+    counting the batch dim, Torch convention)."""
+
+    def __init__(self, dimension: int, *modules: Module):
+        super().__init__(*modules)
+        self.dimension = dimension
+
+    def forward(self, x):
+        outs = [m(x) for m in self.layers]
+        return jnp.concatenate(outs, axis=self.dimension - 1)
+
+
+class ConcatTable(Container):
+    """Apply each branch to the same input, return the tuple of outputs
+    (reference nn/ConcatTable.scala)."""
+
+    def forward(self, x):
+        return tuple(m(x) for m in self.layers)
+
+
+class ParallelTable(Container):
+    """Apply i-th module to i-th element of the input table
+    (reference nn/ParallelTable.scala)."""
+
+    def forward(self, xs):
+        return tuple(m(x) for m, x in zip(self.layers, xs))
+
+
+class MapTable(Container):
+    """Apply one shared module to every element of the input table
+    (reference nn/MapTable.scala)."""
+
+    def __init__(self, module: Module):
+        super().__init__(module)
+
+    def forward(self, xs):
+        m = self.layers[0]
+        return tuple(m(x) for x in xs)
+
+
+class Bottle(Container):
+    """Collapse leading dims, apply module, restore
+    (reference nn/Bottle.scala)."""
+
+    def __init__(self, module: Module, n_input_dim: int = 2,
+                 n_output_dim: int = 2):
+        super().__init__(module)
+        self.n_input_dim = n_input_dim
+        self.n_output_dim = n_output_dim
+
+    def forward(self, x):
+        lead = x.shape[:x.ndim - self.n_input_dim + 1]
+        flat = x.reshape((-1,) + x.shape[x.ndim - self.n_input_dim + 1:])
+        y = self.layers[0](flat)
+        return y.reshape(lead + y.shape[1:])
+
+
+# --------------------------------------------------------------------------
+# Graph (functional DAG, reference nn/Graph.scala + StaticGraph)
+# --------------------------------------------------------------------------
+
+class Node:
+    """Graph node wrapping a module; calling a module on nodes builds
+    edges (reference utils/Node + the `inputs` DSL of nn/Graph.scala)."""
+
+    _counter = [0]
+
+    def __init__(self, module: Optional[Module]):
+        self.module = module
+        self.prev: List["Node"] = []
+        Node._counter[0] += 1
+        self.id = Node._counter[0]
+
+    def __repr__(self):
+        m = self.module.name if self.module else "Input"
+        return f"Node[{self.id}]({m})"
+
+
+def Input() -> Node:
+    """Placeholder input node (reference nn/Input.scala)."""
+    return Node(None)
+
+
+def node_of(module: Module, *inputs: Node) -> Node:
+    n = Node(module)
+    n.prev = list(inputs)
+    return n
+
+
+class Graph(Module):
+    """DAG container executed in topological order (reference
+    nn/Graph.scala:403 topologySort; StaticGraph.scala:44 pre-computed
+    execution order).  Under jit, execution order is baked into the
+    trace, so this is exactly the reference StaticGraph semantics."""
+
+    def __init__(self, inputs: Union[Node, Sequence[Node]],
+                 outputs: Union[Node, Sequence[Node]]):
+        super().__init__()
+        self.input_nodes = [inputs] if isinstance(inputs, Node) else list(inputs)
+        self.output_nodes = ([outputs] if isinstance(outputs, Node)
+                             else list(outputs))
+        order = self._topo_sort()
+        self.exec_order = tuple(n.id for n in order)
+        self.node_prevs = tuple(tuple(p.id for p in n.prev) for n in order)
+        self.input_ids = tuple(n.id for n in self.input_nodes)
+        self.output_ids = tuple(n.id for n in self.output_nodes)
+        self.graph_modules = ModuleList(
+            [n.module for n in order if n.module is not None])
+        self.module_node_ids = tuple(
+            n.id for n in order if n.module is not None)
+
+    def _topo_sort(self) -> List[Node]:
+        visited: Dict[int, Node] = {}
+        order: List[Node] = []
+        temp = set()
+
+        def visit(n: Node):
+            if n.id in visited:
+                return
+            if n.id in temp:
+                raise ValueError("Graph has a cycle")
+            temp.add(n.id)
+            for p in n.prev:
+                visit(p)
+            temp.discard(n.id)
+            visited[n.id] = n
+            order.append(n)
+
+        for out in self.output_nodes:
+            visit(out)
+        for inp in self.input_nodes:
+            if inp.id not in visited:
+                raise ValueError(
+                    f"Input node {inp} is not connected to any output")
+        return order
+
+    def forward(self, *xs):
+        if len(xs) == 1 and isinstance(xs[0], (tuple, list)) \
+                and len(self.input_ids) > 1:
+            xs = tuple(xs[0])
+        if len(xs) != len(self.input_ids):
+            raise ValueError(
+                f"Graph expects {len(self.input_ids)} input(s), "
+                f"got {len(xs)}")
+        values: Dict[int, object] = {}
+        for nid, x in zip(self.input_ids, xs):
+            values[nid] = x
+        mod_for_node = dict(zip(self.module_node_ids, self.graph_modules))
+        for nid, prevs in zip(self.exec_order, self.node_prevs):
+            if nid in values and not prevs:
+                continue  # input node
+            args = [values[p] for p in prevs]
+            m = mod_for_node[nid]
+            # multi-input nodes receive a Table (tuple), reference
+            # nn/Graph.scala input gathering
+            values[nid] = m.forward(args[0]) if len(args) == 1 \
+                else m.forward(tuple(args))
+        outs = tuple(values[o] for o in self.output_ids)
+        return outs[0] if len(outs) == 1 else outs

@@ -1,0 +1,591 @@
+"""Loss criterions.
+
+Reference: nn/abstractnn/AbstractCriterion.scala plus the criterion zoo
+(ClassNLLCriterion.scala, CrossEntropyCriterion.scala, MSECriterion.scala,
+BCECriterion.scala, …).  ``forward(input, target)`` returns a scalar;
+gradients come from jax.grad (no hand-written updateGradInput needed).
+
+Class targets follow the reference's Torch convention: 1-based class
+indices.  Criterions accept ``size_average`` where the reference does.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.core.module import Module, ModuleList
+
+__all__ = [
+    "Criterion", "ClassNLLCriterion", "CrossEntropyCriterion",
+    "CategoricalCrossEntropy", "BCECriterion", "MSECriterion",
+    "AbsCriterion", "SmoothL1Criterion", "DistKLDivCriterion",
+    "KLDCriterion", "GaussianCriterion", "CosineEmbeddingCriterion",
+    "HingeEmbeddingCriterion", "MarginCriterion", "MarginRankingCriterion",
+    "MultiCriterion", "ParallelCriterion", "MultiLabelMarginCriterion",
+    "MultiLabelSoftMarginCriterion", "MultiMarginCriterion",
+    "SoftMarginCriterion", "L1HingeEmbeddingCriterion",
+    "CosineDistanceCriterion", "CosineProximityCriterion",
+    "DotProductCriterion", "PoissonCriterion", "MeanAbsolutePercentageCriterion",
+    "MeanSquaredLogarithmicCriterion", "KullbackLeiblerDivergenceCriterion",
+    "ClassSimplexCriterion", "L1Cost", "DiceCoefficientCriterion",
+    "PGCriterion", "TimeDistributedCriterion", "TransformerCriterion",
+    "TimeDistributedMaskCriterion",
+]
+
+
+class Criterion(Module):
+    """Base criterion (reference nn/abstractnn/AbstractCriterion.scala).
+    forward(input, target) -> scalar loss."""
+
+    def forward(self, input, target):
+        raise NotImplementedError
+
+    def __call__(self, input, target=None):
+        return self.forward(input, target)
+
+    def backward(self, input, target):
+        """grad of loss w.r.t. input (reference updateGradInput)."""
+        return jax.grad(lambda x: self.forward(x, target))(input)
+
+
+def _reduce(x, size_average: bool):
+    return jnp.mean(x) if size_average else jnp.sum(x)
+
+
+def _one_based(target):
+    return jnp.asarray(target).astype(jnp.int32) - 1
+
+
+class ClassNLLCriterion(Criterion):
+    """NLL over log-probabilities with 1-based class targets and
+    optional class weights; paddingValue rows contribute zero
+    (reference nn/ClassNLLCriterion.scala)."""
+
+    def __init__(self, weights=None, size_average: bool = True,
+                 logProbAsInput: bool = True, paddingValue: int = -1):
+        super().__init__()
+        self.size_average = size_average
+        self.log_prob_as_input = logProbAsInput
+        self.padding_value = paddingValue
+        if weights is not None:
+            self.class_weights = jnp.asarray(weights)
+
+    def forward(self, input, target):
+        logp = input if self.log_prob_as_input else jnp.log(input + 1e-8)
+        t = jnp.asarray(target).astype(jnp.int32)
+        idx = jnp.clip(t - 1, 0, logp.shape[-1] - 1)
+        picked = jnp.take_along_axis(logp, idx[..., None], axis=-1)[..., 0]
+        valid = (t != self.padding_value).astype(logp.dtype)
+        if "class_weights" in self._buffers:
+            w = self.class_weights[idx] * valid
+        else:
+            w = valid
+        total = -jnp.sum(picked * w)
+        if self.size_average:
+            return total / jnp.maximum(jnp.sum(w), 1e-8)
+        return total
+
+
+class CrossEntropyCriterion(Criterion):
+    """LogSoftMax + ClassNLL fused (reference nn/CrossEntropyCriterion.scala)."""
+
+    def __init__(self, weights=None, size_average: bool = True):
+        super().__init__()
+        self.inner = ClassNLLCriterion(weights, size_average)
+
+    def forward(self, input, target):
+        return self.inner(jax.nn.log_softmax(input, axis=-1), target)
+
+
+class CategoricalCrossEntropy(Criterion):
+    """Cross entropy with one-hot targets over probabilities
+    (reference nn/CategoricalCrossEntropy.scala)."""
+
+    def forward(self, input, target):
+        logp = jnp.log(jnp.clip(input, 1e-8, 1.0))
+        return -jnp.mean(jnp.sum(target * logp, axis=-1))
+
+
+class BCECriterion(Criterion):
+    """Binary cross entropy on probabilities, optional per-element weights
+    (reference nn/BCECriterion.scala)."""
+
+    def __init__(self, weights=None, size_average: bool = True):
+        super().__init__()
+        self.size_average = size_average
+        if weights is not None:
+            self.elem_weights = jnp.asarray(weights)
+
+    def forward(self, input, target):
+        eps = 1e-12
+        p = jnp.clip(input, eps, 1 - eps)
+        ll = target * jnp.log(p) + (1 - target) * jnp.log1p(-p)
+        if "elem_weights" in self._buffers:
+            ll = ll * self.elem_weights
+        return _reduce(-ll, self.size_average)
+
+
+class MSECriterion(Criterion):
+    """(reference nn/MSECriterion.scala)"""
+
+    def __init__(self, size_average: bool = True):
+        super().__init__()
+        self.size_average = size_average
+
+    def forward(self, input, target):
+        return _reduce((input - target) ** 2, self.size_average)
+
+
+class AbsCriterion(Criterion):
+    """(reference nn/AbsCriterion.scala)"""
+
+    def __init__(self, size_average: bool = True):
+        super().__init__()
+        self.size_average = size_average
+
+    def forward(self, input, target):
+        return _reduce(jnp.abs(input - target), self.size_average)
+
+
+class SmoothL1Criterion(Criterion):
+    """Huber with delta=1 (reference nn/SmoothL1Criterion.scala)."""
+
+    def __init__(self, size_average: bool = True):
+        super().__init__()
+        self.size_average = size_average
+
+    def forward(self, input, target):
+        d = jnp.abs(input - target)
+        loss = jnp.where(d < 1.0, 0.5 * d * d, d - 0.5)
+        return _reduce(loss, self.size_average)
+
+
+class DistKLDivCriterion(Criterion):
+    """KL(target || input) with input = log-probs
+    (reference nn/DistKLDivCriterion.scala)."""
+
+    def __init__(self, size_average: bool = True):
+        super().__init__()
+        self.size_average = size_average
+
+    def forward(self, input, target):
+        pointwise = target * (jnp.log(jnp.clip(target, 1e-12, None)) - input)
+        pointwise = jnp.where(target > 0, pointwise, 0.0)
+        # reference divides by nElement() (DistKLDivCriterion.scala:51),
+        # not by batch size
+        return jnp.mean(pointwise) if self.size_average \
+            else jnp.sum(pointwise)
+
+
+class KLDCriterion(Criterion):
+    """KL(N(mu, sigma) || N(0,1)) from (mean, log_var) table — VAE loss
+    (reference nn/KLDCriterion.scala)."""
+
+    def forward(self, input, target=None):
+        mean, log_var = input
+        return 0.5 * jnp.sum(mean ** 2 + jnp.exp(log_var) - log_var - 1.0)
+
+
+class GaussianCriterion(Criterion):
+    """Negative log-likelihood of target under N(mean, exp(log_var))
+    (reference nn/GaussianCriterion.scala)."""
+
+    def forward(self, input, target):
+        mean, log_var = input
+        return 0.5 * jnp.sum(
+            log_var + (target - mean) ** 2 / jnp.exp(log_var)
+            + jnp.log(2 * jnp.pi))
+
+
+class CosineEmbeddingCriterion(Criterion):
+    """(reference nn/CosineEmbeddingCriterion.scala): y=1 → 1-cos,
+    y=-1 → max(0, cos - margin)."""
+
+    def __init__(self, margin: float = 0.0, size_average: bool = True):
+        super().__init__()
+        self.margin = float(margin)
+        self.size_average = size_average
+
+    def forward(self, input, target):
+        x1, x2 = input
+        y = target.reshape(-1) if hasattr(target, "reshape") else target
+        cos = jnp.sum(x1 * x2, -1) / (
+            jnp.linalg.norm(x1, axis=-1) * jnp.linalg.norm(x2, axis=-1)
+            + 1e-12)
+        loss = jnp.where(y > 0, 1.0 - cos,
+                         jnp.maximum(0.0, cos - self.margin))
+        return _reduce(loss, self.size_average)
+
+
+class HingeEmbeddingCriterion(Criterion):
+    """(reference nn/HingeEmbeddingCriterion.scala)"""
+
+    def __init__(self, margin: float = 1.0, size_average: bool = True):
+        super().__init__()
+        self.margin = float(margin)
+        self.size_average = size_average
+
+    def forward(self, input, target):
+        loss = jnp.where(target > 0, input,
+                         jnp.maximum(0.0, self.margin - input))
+        return _reduce(loss, self.size_average)
+
+
+class L1HingeEmbeddingCriterion(Criterion):
+    """Hinge on L1 distance of a pair (reference
+    nn/L1HingeEmbeddingCriterion.scala)."""
+
+    def __init__(self, margin: float = 1.0):
+        super().__init__()
+        self.margin = float(margin)
+
+    def forward(self, input, target):
+        x1, x2 = input
+        d = jnp.sum(jnp.abs(x1 - x2), axis=-1)
+        loss = jnp.where(target > 0, d, jnp.maximum(0.0, self.margin - d))
+        return jnp.sum(loss)
+
+
+class MarginCriterion(Criterion):
+    """Hinge loss max(0, margin - y*x); squared variant for L2-SVM
+    (reference nn/MarginCriterion.scala)."""
+
+    def __init__(self, margin: float = 1.0, size_average: bool = True,
+                 squared: bool = False):
+        super().__init__()
+        self.margin = float(margin)
+        self.size_average = size_average
+        self.squared = squared
+
+    def forward(self, input, target):
+        h = jnp.maximum(0.0, self.margin - input * target)
+        if self.squared:
+            h = h * h
+        return _reduce(h, self.size_average)
+
+
+class MarginRankingCriterion(Criterion):
+    """max(0, -y*(x1-x2) + margin) (reference nn/MarginRankingCriterion.scala)."""
+
+    def __init__(self, margin: float = 1.0, size_average: bool = True):
+        super().__init__()
+        self.margin = float(margin)
+        self.size_average = size_average
+
+    def forward(self, input, target):
+        x1, x2 = input
+        loss = jnp.maximum(0.0, -target * (x1 - x2) + self.margin)
+        return _reduce(loss, self.size_average)
+
+
+class MultiCriterion(Criterion):
+    """Weighted sum of criterions on the same (input, target)
+    (reference nn/MultiCriterion.scala)."""
+
+    def __init__(self):
+        super().__init__()
+        self.crits = ModuleList([])
+        self.crit_weights = ()
+
+    def add(self, criterion: Criterion, weight: float = 1.0):
+        self.crits.append(criterion)
+        self.crit_weights = self.crit_weights + (float(weight),)
+        return self
+
+    def forward(self, input, target):
+        total = 0.0
+        for c, w in zip(self.crits, self.crit_weights):
+            total = total + w * c(input, target)
+        return total
+
+
+class ParallelCriterion(Criterion):
+    """i-th criterion applied to i-th (input, target) pair
+    (reference nn/ParallelCriterion.scala)."""
+
+    def __init__(self, repeat_target: bool = False):
+        super().__init__()
+        self.crits = ModuleList([])
+        self.crit_weights = ()
+        self.repeat_target = repeat_target
+
+    def add(self, criterion: Criterion, weight: float = 1.0):
+        self.crits.append(criterion)
+        self.crit_weights = self.crit_weights + (float(weight),)
+        return self
+
+    def forward(self, input, target):
+        total = 0.0
+        for i, (c, w) in enumerate(zip(self.crits, self.crit_weights)):
+            t = target if self.repeat_target else target[i]
+            total = total + w * c(input[i], t)
+        return total
+
+
+class MultiLabelMarginCriterion(Criterion):
+    """Multi-class multi-label hinge (reference
+    nn/MultiLabelMarginCriterion.scala).  Targets: 1-based label indices
+    padded with 0."""
+
+    def __init__(self, size_average: bool = True):
+        super().__init__()
+        self.size_average = size_average
+
+    def forward(self, input, target):
+        t = jnp.asarray(target).astype(jnp.int32)
+        # labels stop at the first 0 pad (torch/reference semantics):
+        # everything at or after the first zero is invalid
+        valid = jnp.cumprod((t > 0).astype(input.dtype), axis=-1)  # [..., J]
+        idx = jnp.clip(t - 1, 0, input.shape[-1] - 1)
+        target_scores = jnp.take_along_axis(input, idx, axis=-1)  # [..., J]
+        # per-class membership mask: 1 where class is one of the targets
+        is_target = jnp.clip(
+            jnp.sum(jax.nn.one_hot(idx, input.shape[-1])
+                    * valid[..., None], axis=-2), 0, 1)           # [..., C]
+        margins = jnp.maximum(
+            0.0, 1.0 - (target_scores[..., :, None] - input[..., None, :]))
+        loss = jnp.sum(
+            margins * valid[..., :, None] * (1.0 - is_target)[..., None, :],
+            axis=(-1, -2)) / input.shape[-1]
+        return _reduce(loss, self.size_average)
+
+
+class MultiLabelSoftMarginCriterion(Criterion):
+    """Sigmoid BCE per label (reference nn/MultiLabelSoftMarginCriterion.scala)."""
+
+    def __init__(self, weights=None, size_average: bool = True):
+        super().__init__()
+        self.size_average = size_average
+        if weights is not None:
+            self.label_weights = jnp.asarray(weights)
+
+    def forward(self, input, target):
+        ll = target * jax.nn.log_sigmoid(input) \
+            + (1 - target) * jax.nn.log_sigmoid(-input)
+        if "label_weights" in self._buffers:
+            ll = ll * self.label_weights
+        loss = -jnp.mean(ll, axis=-1)
+        return _reduce(loss, self.size_average)
+
+
+class MultiMarginCriterion(Criterion):
+    """Multi-class hinge (reference nn/MultiMarginCriterion.scala)."""
+
+    def __init__(self, p: int = 1, weights=None, margin: float = 1.0,
+                 size_average: bool = True):
+        super().__init__()
+        self.p = p
+        self.margin = float(margin)
+        self.size_average = size_average
+        if weights is not None:
+            self.class_weights = jnp.asarray(weights)
+
+    def forward(self, input, target):
+        idx = _one_based(target)
+        correct = jnp.take_along_axis(input, idx[..., None], axis=-1)
+        m = jnp.maximum(0.0, self.margin - (correct - input))
+        if self.p == 2:
+            m = m * m
+        mask = 1.0 - jax.nn.one_hot(idx, input.shape[-1])
+        loss = jnp.sum(m * mask, axis=-1) / input.shape[-1]
+        if "class_weights" in self._buffers:
+            loss = loss * self.class_weights[idx]
+        return _reduce(loss, self.size_average)
+
+
+class SoftMarginCriterion(Criterion):
+    """log(1 + exp(-y*x)) (reference nn/SoftMarginCriterion.scala)."""
+
+    def __init__(self, size_average: bool = True):
+        super().__init__()
+        self.size_average = size_average
+
+    def forward(self, input, target):
+        return _reduce(jax.nn.softplus(-input * target), self.size_average)
+
+
+class CosineDistanceCriterion(Criterion):
+    """1 - cos(input, target) (reference nn/CosineDistanceCriterion.scala)."""
+
+    def __init__(self, size_average: bool = True):
+        super().__init__()
+        self.size_average = size_average
+
+    def forward(self, input, target):
+        cos = jnp.sum(input * target, -1) / (
+            jnp.linalg.norm(input, axis=-1)
+            * jnp.linalg.norm(target, axis=-1) + 1e-12)
+        return _reduce(1.0 - cos, self.size_average)
+
+
+class CosineProximityCriterion(Criterion):
+    """-mean(cos) keras-style (reference nn/CosineProximityCriterion.scala)."""
+
+    def forward(self, input, target):
+        xn = input / (jnp.linalg.norm(input, axis=-1, keepdims=True) + 1e-12)
+        tn = target / (jnp.linalg.norm(target, axis=-1, keepdims=True) + 1e-12)
+        return -jnp.mean(jnp.sum(xn * tn, axis=-1))
+
+
+class DotProductCriterion(Criterion):
+    """-sum(x*y) (reference nn/DotProductCriterion.scala; policy gradient)."""
+
+    def __init__(self, size_average: bool = False):
+        super().__init__()
+        self.size_average = size_average
+
+    def forward(self, input, target):
+        return -_reduce(input * target, self.size_average)
+
+
+class PoissonCriterion(Criterion):
+    """Poisson NLL: mean(pred - target*log(pred))
+    (reference nn/PoissonCriterion.scala)."""
+
+    def forward(self, input, target):
+        return jnp.mean(input - target * jnp.log(input + 1e-8))
+
+
+class MeanAbsolutePercentageCriterion(Criterion):
+    """100 * mean(|t-p| / clip(|t|)) (reference
+    nn/MeanAbsolutePercentageCriterion.scala)."""
+
+    def forward(self, input, target):
+        diff = jnp.abs(target - input) / jnp.clip(jnp.abs(target), 1e-7, None)
+        return 100.0 * jnp.mean(diff)
+
+
+class MeanSquaredLogarithmicCriterion(Criterion):
+    """mean((log(t+1)-log(p+1))^2) (reference
+    nn/MeanSquaredLogarithmicCriterion.scala)."""
+
+    def forward(self, input, target):
+        a = jnp.log(jnp.clip(input, 1e-7, None) + 1.0)
+        b = jnp.log(jnp.clip(target, 1e-7, None) + 1.0)
+        return jnp.mean((a - b) ** 2)
+
+
+class KullbackLeiblerDivergenceCriterion(Criterion):
+    """sum(t * log(t/p)) over clipped probs (reference
+    nn/KullbackLeiblerDivergenceCriterion.scala)."""
+
+    def forward(self, input, target):
+        p = jnp.clip(input, 1e-7, 1.0)
+        t = jnp.clip(target, 1e-7, 1.0)
+        return jnp.mean(jnp.sum(t * jnp.log(t / p), axis=-1))
+
+
+class ClassSimplexCriterion(Criterion):
+    """MSE against simplex-embedded class targets
+    (reference nn/ClassSimplexCriterion.scala)."""
+
+    def __init__(self, n_classes: int):
+        super().__init__()
+        self.n_classes = n_classes
+        # build simplex embedding (Huffman-like construction)
+        import numpy as np
+        n = n_classes
+        mat = np.zeros((n, n), dtype=np.float32)
+        mat[0, 0] = 1.0
+        for k in range(1, n):
+            s = 0.0
+            for j in range(k):
+                mat[k, j] = (-1.0 / n - np.dot(mat[k], mat[j])) / mat[j, j]
+                s += mat[k, j] ** 2
+            mat[k, k] = np.sqrt(max(1.0 - s, 0.0))
+        self.simplex = jnp.asarray(mat)
+
+    def forward(self, input, target):
+        t = self.simplex[_one_based(target)]
+        return jnp.mean(jnp.sum((input - t) ** 2, axis=-1))
+
+
+class L1Cost(Criterion):
+    """sum(|x|) ignoring target (reference nn/L1Cost.scala)."""
+
+    def forward(self, input, target=None):
+        return jnp.sum(jnp.abs(input))
+
+
+class DiceCoefficientCriterion(Criterion):
+    """1 - dice overlap (reference nn/DiceCoefficientCriterion.scala)."""
+
+    def __init__(self, size_average: bool = True, epsilon: float = 1.0):
+        super().__init__()
+        self.epsilon = float(epsilon)
+
+    def forward(self, input, target):
+        axes = tuple(range(1, input.ndim))
+        inter = jnp.sum(input * target, axis=axes)
+        union = jnp.sum(input, axis=axes) + jnp.sum(target, axis=axes)
+        dice = (2.0 * inter + self.epsilon) / (union + self.epsilon)
+        return jnp.mean(1.0 - dice)
+
+
+class PGCriterion(Criterion):
+    """Policy-gradient criterion: -sum(log(p) * reward)
+    (reference nn/PGCriterion.scala)."""
+
+    def __init__(self, sizeAverage: bool = False):
+        super().__init__()
+        self.size_average = sizeAverage
+
+    def forward(self, input, target):
+        logp = jnp.log(jnp.clip(input, 1e-8, 1.0))
+        return -_reduce(logp * target, self.size_average)
+
+
+class TimeDistributedCriterion(Criterion):
+    """Apply a criterion at every timestep of [batch, time, ...]
+    (reference nn/TimeDistributedCriterion.scala)."""
+
+    def __init__(self, critrn: Criterion, size_average: bool = False,
+                 dimension: int = 2):
+        super().__init__()
+        self.critrn = critrn
+        self.size_average = size_average
+        self.dimension = dimension
+
+    def forward(self, input, target):
+        t_axis = self.dimension - 1
+        n = input.shape[t_axis]
+        # apply the inner criterion per timestep (vmap over the time axis)
+        # and sum, exactly the reference's updateOutput loop; sizeAverage
+        # divides the summed loss by nstep.
+        x = jnp.moveaxis(input, t_axis, 0)
+        t = jnp.asarray(target)
+        t = jnp.moveaxis(t, t_axis, 0) if t.ndim > 1 else \
+            jnp.broadcast_to(t, (n,) + t.shape)
+        losses = jax.vmap(lambda xi, ti: self.critrn(xi, ti))(x, t)
+        total = jnp.sum(losses)
+        return total / n if self.size_average else total
+
+
+class TimeDistributedMaskCriterion(TimeDistributedCriterion):
+    """Masked variant (reference nn/TimeDistributedMaskCriterion.scala);
+    padding handled by the inner criterion's paddingValue."""
+
+
+class TransformerCriterion(Criterion):
+    """Apply transforms to input/target before an inner criterion
+    (reference nn/TransformerCriterion.scala)."""
+
+    def __init__(self, criterion: Criterion,
+                 input_transformer: Optional[Module] = None,
+                 target_transformer: Optional[Module] = None):
+        super().__init__()
+        self.criterion = criterion
+        if input_transformer is not None:
+            self.input_transformer = input_transformer
+        if target_transformer is not None:
+            self.target_transformer = target_transformer
+
+    def forward(self, input, target):
+        if "input_transformer" in self._modules:
+            input = self.input_transformer.forward(input)
+        if "target_transformer" in self._modules:
+            target = self.target_transformer.forward(target)
+        return self.criterion(input, target)

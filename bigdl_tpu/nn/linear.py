@@ -1,0 +1,277 @@
+"""Dense / parameterized elementwise layers.
+
+Reference: nn/Linear.scala, nn/Bilinear.scala, nn/Add.scala, nn/Mul.scala,
+nn/CMul.scala, nn/CAdd.scala, nn/Cosine.scala, nn/Euclidean.scala,
+nn/LookupTable.scala, nn/Maxout.scala, nn/Highway.scala.
+
+Weight layout is Torch-style (out, in) so gemm maps x @ W.T onto the MXU;
+init defaults mirror the reference (uniform 1/sqrt(fan_in) unless an
+InitializationMethod is set, Linear.scala setInitMethod).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.core.module import Module, Parameter
+from bigdl_tpu.core import init as init_methods
+from bigdl_tpu.utils.rng import next_key
+
+__all__ = [
+    "Linear", "Bilinear", "Add", "Mul", "CMul", "CAdd", "Cosine",
+    "Euclidean", "LookupTable", "Maxout", "Highway", "Identity", "Echo",
+]
+
+
+class Linear(Module):
+    """y = x W^T + b (reference nn/Linear.scala)."""
+
+    def __init__(self, input_size: int, output_size: int,
+                 with_bias: bool = True,
+                 w_regularizer=None, b_regularizer=None,
+                 init_weight=None, init_bias=None,
+                 init_method=None):
+        super().__init__()
+        self.input_size = input_size
+        self.output_size = output_size
+        self.with_bias = with_bias
+        self.w_regularizer = w_regularizer
+        self.b_regularizer = b_regularizer
+        im = init_method or init_methods.RandomUniform()
+        if init_weight is not None:
+            self.weight = Parameter(init_weight)
+        else:
+            self.weight = Parameter(
+                im(next_key(), (output_size, input_size),
+                   fan_in=input_size, fan_out=output_size))
+        if with_bias:
+            if init_bias is not None:
+                self.bias = Parameter(init_bias)
+            else:
+                bound = 1.0 / math.sqrt(input_size)
+                self.bias = Parameter(jax.random.uniform(
+                    next_key(), (output_size,), minval=-bound, maxval=bound))
+
+    def forward(self, x):
+        squeeze = x.ndim == 1
+        if squeeze:
+            x = x[None]
+        y = x @ self.weight.T
+        if self.with_bias:
+            y = y + self.bias
+        return y[0] if squeeze else y
+
+
+class Identity(Module):
+    """Pass-through (reference nn/Identity.scala)."""
+
+    def forward(self, *xs):
+        return xs[0] if len(xs) == 1 else xs
+
+
+class Echo(Module):
+    """Identity that prints activation shape when tracing — debugging aid
+    (reference nn/Echo.scala)."""
+
+    def forward(self, x):
+        print(f"[Echo {self.name}] shape={getattr(x, 'shape', None)} "
+              f"dtype={getattr(x, 'dtype', None)}")
+        return x
+
+
+class Bilinear(Module):
+    """y_k = x1^T W_k x2 + b_k over two table inputs
+    (reference nn/Bilinear.scala)."""
+
+    def __init__(self, input_size1: int, input_size2: int, output_size: int,
+                 bias_res: bool = True,
+                 w_regularizer=None, b_regularizer=None):
+        super().__init__()
+        self.bias_res = bias_res
+        stdv = 1.0 / math.sqrt(input_size1)
+        self.weight = Parameter(jax.random.uniform(
+            next_key(), (output_size, input_size1, input_size2),
+            minval=-stdv, maxval=stdv))
+        if bias_res:
+            self.bias = Parameter(jax.random.uniform(
+                next_key(), (output_size,), minval=-stdv, maxval=stdv))
+
+    def forward(self, inputs):
+        x1, x2 = inputs[0], inputs[1]
+        y = jnp.einsum("bi,oij,bj->bo", x1, self.weight, x2)
+        if self.bias_res:
+            y = y + self.bias
+        return y
+
+
+class Add(Module):
+    """Learnable per-element additive bias (reference nn/Add.scala)."""
+
+    def __init__(self, input_size: int):
+        super().__init__()
+        stdv = 1.0 / math.sqrt(input_size)
+        self.bias = Parameter(jax.random.uniform(
+            next_key(), (input_size,), minval=-stdv, maxval=stdv))
+
+    def forward(self, x):
+        return x + self.bias
+
+
+class Mul(Module):
+    """Single learnable scalar gain (reference nn/Mul.scala)."""
+
+    def __init__(self):
+        super().__init__()
+        self.weight = Parameter(jax.random.uniform(
+            next_key(), (1,), minval=-1.0, maxval=1.0))
+
+    def forward(self, x):
+        return x * self.weight[0]
+
+
+class CMul(Module):
+    """Learnable componentwise gain, broadcast over batch
+    (reference nn/CMul.scala)."""
+
+    def __init__(self, size):
+        super().__init__()
+        size = tuple(size)
+        n = 1
+        for s in size:
+            n *= s
+        stdv = 1.0 / math.sqrt(n)
+        self.weight = Parameter(jax.random.uniform(
+            next_key(), size, minval=-stdv, maxval=stdv))
+
+    def forward(self, x):
+        return x * self.weight
+
+
+class CAdd(Module):
+    """Learnable componentwise bias (reference nn/CAdd.scala)."""
+
+    def __init__(self, size, b_regularizer=None):
+        super().__init__()
+        size = tuple(size)
+        n = 1
+        for s in size:
+            n *= s
+        stdv = 1.0 / math.sqrt(n)
+        self.bias = Parameter(jax.random.uniform(
+            next_key(), size, minval=-stdv, maxval=stdv))
+
+    def forward(self, x):
+        return x + self.bias
+
+
+class Cosine(Module):
+    """Cosine similarity of input to each weight row
+    (reference nn/Cosine.scala)."""
+
+    def __init__(self, input_size: int, output_size: int):
+        super().__init__()
+        stdv = 1.0 / math.sqrt(input_size)
+        self.weight = Parameter(jax.random.uniform(
+            next_key(), (output_size, input_size), minval=-stdv, maxval=stdv))
+
+    def forward(self, x):
+        xn = x / (jnp.linalg.norm(x, axis=-1, keepdims=True) + 1e-12)
+        wn = self.weight / (
+            jnp.linalg.norm(self.weight, axis=-1, keepdims=True) + 1e-12)
+        return xn @ wn.T
+
+
+class Euclidean(Module):
+    """L2 distance of input to each weight column
+    (reference nn/Euclidean.scala)."""
+
+    def __init__(self, input_size: int, output_size: int,
+                 fast_backward: bool = True):
+        super().__init__()
+        stdv = 1.0 / math.sqrt(input_size)
+        self.weight = Parameter(jax.random.uniform(
+            next_key(), (output_size, input_size), minval=-stdv, maxval=stdv))
+
+    def forward(self, x):
+        diff = x[:, None, :] - self.weight[None, :, :]
+        return jnp.linalg.norm(diff, axis=-1)
+
+
+class LookupTable(Module):
+    """Embedding lookup with optional max-norm renorm and padding index
+    (reference nn/LookupTable.scala).  Indices are 1-based as in the
+    reference/Torch convention."""
+
+    def __init__(self, n_index: int, n_output: int,
+                 padding_value: float = 0.0,
+                 max_norm: float = float("inf"),
+                 norm_type: float = 2.0,
+                 should_scale_grad_by_freq: bool = False,
+                 w_regularizer=None,
+                 mask_zero: bool = False):
+        super().__init__()
+        self.n_index, self.n_output = n_index, n_output
+        self.padding_value = padding_value
+        self.max_norm = max_norm
+        self.norm_type = norm_type
+        self.mask_zero = mask_zero
+        self.weight = Parameter(jax.random.normal(
+            next_key(), (n_index, n_output)))
+
+    def forward(self, indices):
+        idx = jnp.asarray(indices).astype(jnp.int32) - 1  # 1-based → 0-based
+        idx = jnp.clip(idx, 0, self.n_index - 1)
+        w = self.weight
+        if self.max_norm != float("inf"):
+            norms = jnp.linalg.norm(w, ord=self.norm_type, axis=1,
+                                    keepdims=True)
+            w = w * jnp.minimum(1.0, self.max_norm / (norms + 1e-7))
+        out = w[idx]
+        if self.mask_zero and self.padding_value != 0:
+            mask = (jnp.asarray(indices) != self.padding_value)
+            out = out * mask[..., None].astype(out.dtype)
+        return out
+
+
+class Maxout(Module):
+    """Linear to maxout_number pieces, max over pieces
+    (reference nn/Maxout.scala)."""
+
+    def __init__(self, input_size: int, output_size: int, maxout_number: int,
+                 with_bias: bool = True, w_regularizer=None,
+                 b_regularizer=None, init_weight=None, init_bias=None):
+        super().__init__()
+        self.output_size = output_size
+        self.maxout_number = maxout_number
+        self.layer = Linear(input_size, output_size * maxout_number,
+                            with_bias=with_bias,
+                            init_weight=init_weight, init_bias=init_bias)
+
+    def forward(self, x):
+        y = self.layer(x)
+        y = y.reshape(y.shape[:-1] + (self.maxout_number, self.output_size))
+        return jnp.max(y, axis=-2)
+
+
+class Highway(Module):
+    """Highway network layer: t*g(Wx) + (1-t)*x
+    (reference nn/Highway.scala)."""
+
+    def __init__(self, size: int, with_bias: bool = True,
+                 activation: Optional[Module] = None,
+                 w_regularizer=None, b_regularizer=None):
+        super().__init__()
+        self.gate = Linear(size, size, with_bias=with_bias)
+        self.transform = Linear(size, size, with_bias=with_bias)
+        self.activation = activation
+
+    def forward(self, x):
+        t = jax.nn.sigmoid(self.gate(x))
+        h = self.transform(x)
+        if self.activation is not None:
+            h = self.activation(h)
+        return t * h + (1.0 - t) * x

@@ -1,0 +1,205 @@
+"""Normalization layers.
+
+Reference: nn/BatchNormalization.scala, nn/SpatialBatchNormalization.scala,
+nn/LayerNormalization.scala, nn/Normalize.scala, nn/NormalizeScale.scala,
+nn/SpatialCrossMapLRN.scala, nn/SpatialWithinChannelLRN.scala,
+nn/SpatialContrastiveNormalization.scala,
+nn/SpatialDivisiveNormalization.scala,
+nn/SpatialSubtractiveNormalization.scala.
+
+BatchNorm running stats are module *buffers*: forward in training mode
+mutates them on the traced copy, and the updated module comes back out of
+the jitted step (see core/module.py design note).  In a data-parallel
+mesh the batch axis is global because XLA computes the mean/var over the
+full sharded batch — matching the reference's per-replica BN only if you
+ask for it via sync=False (local shard stats via shard_map is a later
+extension; XLA's default here is *sync* BN, strictly better).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.core.module import Module, Parameter
+from bigdl_tpu.utils.rng import next_key
+
+__all__ = [
+    "BatchNormalization", "SpatialBatchNormalization", "LayerNormalization",
+    "Normalize", "NormalizeScale", "SpatialCrossMapLRN",
+    "SpatialWithinChannelLRN",
+]
+
+
+class BatchNormalization(Module):
+    """BatchNorm over the feature (last) axis of [batch, feat]
+    (reference nn/BatchNormalization.scala; eps/momentum defaults match)."""
+
+    reduce_axes = (0,)
+
+    def __init__(self, n_output: int, eps: float = 1e-5,
+                 momentum: float = 0.1, affine: bool = True,
+                 init_weight=None, init_bias=None,
+                 init_grad_weight=None, init_grad_bias=None):
+        super().__init__()
+        self.n_output = n_output
+        self.eps = float(eps)
+        self.momentum = float(momentum)
+        self.affine = affine
+        if affine:
+            self.weight = Parameter(
+                init_weight if init_weight is not None
+                else jax.random.uniform(next_key(), (n_output,)))
+            self.bias = Parameter(
+                init_bias if init_bias is not None else jnp.zeros(n_output))
+        self.running_mean = jnp.zeros(n_output)
+        self.running_var = jnp.ones(n_output)
+
+    def forward(self, x):
+        if self.training:
+            mean = jnp.mean(x, axis=self.reduce_axes)
+            var = jnp.var(x, axis=self.reduce_axes)
+            m = self.momentum
+            self.running_mean = (1 - m) * self.running_mean + m * mean
+            n = 1
+            for a in self.reduce_axes:
+                n *= x.shape[a]
+            unbiased = var * n / max(n - 1, 1)
+            self.running_var = (1 - m) * self.running_var + m * unbiased
+        else:
+            mean, var = self.running_mean, self.running_var
+        inv = jax.lax.rsqrt(var + self.eps)
+        y = (x - mean) * inv
+        if self.affine:
+            y = y * self.weight + self.bias
+        return y
+
+
+class SpatialBatchNormalization(BatchNormalization):
+    """BatchNorm over NHWC images, per channel
+    (reference nn/SpatialBatchNormalization.scala)."""
+
+    reduce_axes = (0, 1, 2)
+
+    def __init__(self, n_output: int, eps: float = 1e-5,
+                 momentum: float = 0.1, affine: bool = True,
+                 init_weight=None, init_bias=None,
+                 init_grad_weight=None, init_grad_bias=None,
+                 data_format: str = "NHWC"):
+        super().__init__(n_output, eps, momentum, affine,
+                         init_weight, init_bias)
+        self.data_format = data_format
+
+    def forward(self, x):
+        if self.data_format == "NCHW":
+            x = jnp.transpose(x, (0, 2, 3, 1))
+            y = super().forward(x)
+            return jnp.transpose(y, (0, 3, 1, 2))
+        return super().forward(x)
+
+
+class LayerNormalization(Module):
+    """LayerNorm over the last axis (reference nn/LayerNormalization.scala,
+    used by the Transformer stack)."""
+
+    def __init__(self, hidden_size: int, eps: float = 1e-6):
+        super().__init__()
+        self.eps = eps
+        self.weight = Parameter(jnp.ones(hidden_size))
+        self.bias = Parameter(jnp.zeros(hidden_size))
+
+    def forward(self, x):
+        mean = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.var(x, axis=-1, keepdims=True)
+        return (x - mean) * jax.lax.rsqrt(var + self.eps) * self.weight \
+            + self.bias
+
+
+class Normalize(Module):
+    """Lp-normalize over the feature axis (reference nn/Normalize.scala)."""
+
+    def __init__(self, p: float = 2.0, eps: float = 1e-10):
+        super().__init__()
+        self.p, self.eps = float(p), float(eps)
+
+    def forward(self, x):
+        if self.p == float("inf"):
+            norm = jnp.max(jnp.abs(x), axis=-1, keepdims=True)
+        else:
+            norm = jnp.sum(jnp.abs(x) ** self.p, axis=-1,
+                           keepdims=True) ** (1.0 / self.p)
+        return x / (norm + self.eps)
+
+
+class NormalizeScale(Module):
+    """L2 normalize across channels then learnable per-channel scale
+    (reference nn/NormalizeScale.scala; SSD conv4_3 trick)."""
+
+    def __init__(self, p: float = 2.0, eps: float = 1e-10,
+                 scale: float = 1.0, size=None,
+                 w_regularizer=None):
+        super().__init__()
+        self.p, self.eps = float(p), float(eps)
+        size = tuple(size) if size is not None else (1,)
+        self.weight = Parameter(jnp.full(size, float(scale)))
+
+    def forward(self, x):
+        norm = jnp.sum(jnp.abs(x) ** self.p, axis=-1,
+                       keepdims=True) ** (1.0 / self.p)
+        return (x / (norm + self.eps)) * self.weight.reshape(
+            (1,) * (x.ndim - 1) + (-1,)) if self.weight.size == x.shape[-1] \
+            else (x / (norm + self.eps)) * self.weight
+
+
+class SpatialCrossMapLRN(Module):
+    """AlexNet-style local response normalization across channels
+    (reference nn/SpatialCrossMapLRN.scala; NHWC channel-last here)."""
+
+    def __init__(self, size: int = 5, alpha: float = 1.0,
+                 beta: float = 0.75, k: float = 1.0,
+                 data_format: str = "NHWC"):
+        super().__init__()
+        self.size, self.alpha, self.beta, self.k = size, alpha, beta, k
+        self.data_format = data_format
+
+    def forward(self, x):
+        if self.data_format == "NCHW":
+            x = jnp.transpose(x, (0, 2, 3, 1))
+        sq = x * x
+        half = (self.size - 1) // 2
+        # sum over a channel window via reduce_window on last axis
+        acc = jax.lax.reduce_window(
+            sq, 0.0, jax.lax.add,
+            window_dimensions=(1, 1, 1, self.size),
+            window_strides=(1, 1, 1, 1),
+            padding=((0, 0), (0, 0), (0, 0),
+                     (half, self.size - 1 - half)))
+        y = x * jnp.power(self.k + self.alpha / self.size * acc, -self.beta)
+        if self.data_format == "NCHW":
+            y = jnp.transpose(y, (0, 3, 1, 2))
+        return y
+
+
+class SpatialWithinChannelLRN(Module):
+    """LRN within each channel over a spatial window
+    (reference nn/SpatialWithinChannelLRN.scala)."""
+
+    def __init__(self, size: int = 5, alpha: float = 1.0,
+                 beta: float = 0.75):
+        super().__init__()
+        self.size, self.alpha, self.beta = size, alpha, beta
+
+    def forward(self, x):
+        sq = x * x
+        half = (self.size - 1) // 2
+        acc = jax.lax.reduce_window(
+            sq, 0.0, jax.lax.add,
+            window_dimensions=(1, self.size, self.size, 1),
+            window_strides=(1, 1, 1, 1),
+            padding=((0, 0), (half, self.size - 1 - half),
+                     (half, self.size - 1 - half), (0, 0)))
+        return x * jnp.power(
+            1.0 + self.alpha / (self.size * self.size) * acc, -self.beta)

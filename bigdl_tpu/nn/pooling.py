@@ -1,0 +1,314 @@
+"""Pooling / resize layers.
+
+Reference: nn/SpatialMaxPooling.scala, nn/SpatialAveragePooling.scala,
+nn/TemporalMaxPooling.scala, nn/VolumetricMaxPooling.scala,
+nn/VolumetricAveragePooling.scala, nn/UpSampling1D.scala,
+nn/UpSampling2D.scala, nn/UpSampling3D.scala, nn/ResizeBilinear.scala.
+
+Built on ``lax.reduce_window`` (XLA's native pooling primitive).
+Layout NHWC by default, NCHW accepted.  ``ceil_mode`` mirrors the
+reference's setCeilMode (SpatialMaxPooling.scala ceil/floor output size).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.core.module import Module
+
+__all__ = [
+    "SpatialMaxPooling", "SpatialAveragePooling", "TemporalMaxPooling",
+    "VolumetricMaxPooling", "VolumetricAveragePooling",
+    "UpSampling1D", "UpSampling2D", "UpSampling3D", "ResizeBilinear",
+    "GlobalAveragePooling2D",
+]
+
+
+def _pool_pads(in_size, k, s, pad, ceil_mode):
+    """Explicit (lo, hi) padding per spatial dim implementing the
+    reference's floor/ceil output-size formula."""
+    if pad == -1:  # SAME
+        out = -(-in_size // s)
+        total = max((out - 1) * s + k - in_size, 0)
+        return (total // 2, total - total // 2)
+    if ceil_mode:
+        out = int(math.ceil((in_size + 2 * pad - k) / s)) + 1
+        # Torch: ensure last window starts inside the (padded) input
+        if (out - 1) * s >= in_size + pad:
+            out -= 1
+    else:
+        out = int(math.floor((in_size + 2 * pad - k) / s)) + 1
+    hi = max((out - 1) * s + k - in_size - pad, pad)
+    return (pad, hi)
+
+
+class SpatialMaxPooling(Module):
+    """2-D max pool (reference nn/SpatialMaxPooling.scala)."""
+
+    def __init__(self, kw: int, kh: int, dw: Optional[int] = None,
+                 dh: Optional[int] = None, pad_w: int = 0, pad_h: int = 0,
+                 data_format: str = "NHWC"):
+        super().__init__()
+        self.kernel = (kh, kw)
+        self.stride = (dh or kh, dw or kw)
+        self.pad = (pad_h, pad_w)
+        self.ceil_mode = False
+        self.data_format = data_format
+
+    def ceil(self):
+        self.ceil_mode = True
+        return self
+
+    def floor(self):
+        self.ceil_mode = False
+        return self
+
+    def forward(self, x):
+        nchw = self.data_format == "NCHW"
+        if nchw:
+            x = jnp.transpose(x, (0, 2, 3, 1))
+        kh, kw = self.kernel
+        sh, sw = self.stride
+        ph, pw = self.pad
+        pads = ((0, 0),
+                _pool_pads(x.shape[1], kh, sh, ph, self.ceil_mode),
+                _pool_pads(x.shape[2], kw, sw, pw, self.ceil_mode),
+                (0, 0))
+        y = jax.lax.reduce_window(
+            x, -jnp.inf, jax.lax.max,
+            window_dimensions=(1, kh, kw, 1),
+            window_strides=(1, sh, sw, 1),
+            padding=pads)
+        return jnp.transpose(y, (0, 3, 1, 2)) if nchw else y
+
+
+class SpatialAveragePooling(Module):
+    """2-D average pool (reference nn/SpatialAveragePooling.scala;
+    count_include_pad + divide toggles)."""
+
+    def __init__(self, kw: int, kh: int, dw: int = 1, dh: int = 1,
+                 pad_w: int = 0, pad_h: int = 0,
+                 global_pooling: bool = False,
+                 ceil_mode: bool = False,
+                 count_include_pad: bool = True,
+                 divide: bool = True,
+                 data_format: str = "NHWC"):
+        super().__init__()
+        self.kernel = (kh, kw)
+        self.stride = (dh, dw)
+        self.pad = (pad_h, pad_w)
+        self.global_pooling = global_pooling
+        self.ceil_mode = ceil_mode
+        self.count_include_pad = count_include_pad
+        self.divide = divide
+        self.data_format = data_format
+
+    def ceil(self):
+        self.ceil_mode = True
+        return self
+
+    def forward(self, x):
+        nchw = self.data_format == "NCHW"
+        if nchw:
+            x = jnp.transpose(x, (0, 2, 3, 1))
+        if self.global_pooling:
+            kh, kw = x.shape[1], x.shape[2]
+            sh, sw = 1, 1
+            ph = pw = 0
+        else:
+            kh, kw = self.kernel
+            sh, sw = self.stride
+            ph, pw = self.pad
+        pads = ((0, 0),
+                _pool_pads(x.shape[1], kh, sh, ph, self.ceil_mode),
+                _pool_pads(x.shape[2], kw, sw, pw, self.ceil_mode),
+                (0, 0))
+        summed = jax.lax.reduce_window(
+            x, 0.0, jax.lax.add,
+            window_dimensions=(1, kh, kw, 1),
+            window_strides=(1, sh, sw, 1),
+            padding=pads)
+        if self.divide:
+            if self.count_include_pad:
+                y = summed / (kh * kw)
+            else:
+                ones = jnp.ones_like(x)
+                counts = jax.lax.reduce_window(
+                    ones, 0.0, jax.lax.add,
+                    window_dimensions=(1, kh, kw, 1),
+                    window_strides=(1, sh, sw, 1),
+                    padding=pads)
+                y = summed / counts
+        else:
+            y = summed
+        return jnp.transpose(y, (0, 3, 1, 2)) if nchw else y
+
+
+class GlobalAveragePooling2D(SpatialAveragePooling):
+    """Keras-style global average pool, squeezing spatial dims."""
+
+    def __init__(self, data_format: str = "NHWC"):
+        super().__init__(1, 1, global_pooling=True, data_format=data_format)
+
+    def forward(self, x):
+        y = super().forward(x)
+        if self.data_format == "NHWC":
+            return y[:, 0, 0, :]
+        return y[:, :, 0, 0]
+
+
+class TemporalMaxPooling(Module):
+    """1-D max pool over [batch, time, feat]
+    (reference nn/TemporalMaxPooling.scala)."""
+
+    def __init__(self, k_w: int, d_w: Optional[int] = None):
+        super().__init__()
+        self.k_w = k_w
+        self.d_w = d_w or k_w
+
+    def forward(self, x):
+        return jax.lax.reduce_window(
+            x, -jnp.inf, jax.lax.max,
+            window_dimensions=(1, self.k_w, 1),
+            window_strides=(1, self.d_w, 1),
+            padding="VALID")
+
+
+class VolumetricMaxPooling(Module):
+    """3-D max pool over NDHWC (reference nn/VolumetricMaxPooling.scala)."""
+
+    def __init__(self, k_t: int, k_w: int, k_h: int,
+                 d_t: Optional[int] = None, d_w: Optional[int] = None,
+                 d_h: Optional[int] = None,
+                 pad_t: int = 0, pad_w: int = 0, pad_h: int = 0):
+        super().__init__()
+        self.kernel = (k_t, k_h, k_w)
+        self.stride = (d_t or k_t, d_h or k_h, d_w or k_w)
+        self.pad = (pad_t, pad_h, pad_w)
+
+    def forward(self, x):
+        kt, kh, kw = self.kernel
+        st, sh, sw = self.stride
+        pt, ph, pw = self.pad
+        return jax.lax.reduce_window(
+            x, -jnp.inf, jax.lax.max,
+            window_dimensions=(1, kt, kh, kw, 1),
+            window_strides=(1, st, sh, sw, 1),
+            padding=((0, 0), (pt, pt), (ph, ph), (pw, pw), (0, 0)))
+
+
+class VolumetricAveragePooling(Module):
+    """3-D average pool (reference nn/VolumetricAveragePooling.scala)."""
+
+    def __init__(self, k_t: int, k_w: int, k_h: int,
+                 d_t: Optional[int] = None, d_w: Optional[int] = None,
+                 d_h: Optional[int] = None,
+                 pad_t: int = 0, pad_w: int = 0, pad_h: int = 0,
+                 count_include_pad: bool = True, ceil_mode: bool = False):
+        super().__init__()
+        self.kernel = (k_t, k_h, k_w)
+        self.stride = (d_t or k_t, d_h or k_h, d_w or k_w)
+        self.pad = (pad_t, pad_h, pad_w)
+        self.count_include_pad = count_include_pad
+        self.ceil_mode = ceil_mode
+
+    def forward(self, x):
+        kt, kh, kw = self.kernel
+        st, sh, sw = self.stride
+        pt, ph, pw = self.pad
+        pads = ((0, 0),
+                _pool_pads(x.shape[1], kt, st, pt, self.ceil_mode),
+                _pool_pads(x.shape[2], kh, sh, ph, self.ceil_mode),
+                _pool_pads(x.shape[3], kw, sw, pw, self.ceil_mode),
+                (0, 0))
+        dims = (1, kt, kh, kw, 1)
+        strides = (1, st, sh, sw, 1)
+        summed = jax.lax.reduce_window(
+            x, 0.0, jax.lax.add, window_dimensions=dims,
+            window_strides=strides, padding=pads)
+        if self.count_include_pad:
+            return summed / (kt * kh * kw)
+        counts = jax.lax.reduce_window(
+            jnp.ones_like(x), 0.0, jax.lax.add, window_dimensions=dims,
+            window_strides=strides, padding=pads)
+        return summed / counts
+
+
+class UpSampling1D(Module):
+    """Repeat timesteps length times (reference nn/UpSampling1D.scala)."""
+
+    def __init__(self, length: int):
+        super().__init__()
+        self.length = length
+
+    def forward(self, x):
+        return jnp.repeat(x, self.length, axis=1)
+
+
+class UpSampling2D(Module):
+    """Nearest-neighbour upsample (reference nn/UpSampling2D.scala)."""
+
+    def __init__(self, size: Tuple[int, int], data_format: str = "NHWC"):
+        super().__init__()
+        self.size = tuple(size)
+        self.data_format = data_format
+
+    def forward(self, x):
+        h, w = self.size
+        if self.data_format == "NHWC":
+            return jnp.repeat(jnp.repeat(x, h, axis=1), w, axis=2)
+        return jnp.repeat(jnp.repeat(x, h, axis=2), w, axis=3)
+
+
+class UpSampling3D(Module):
+    """Nearest-neighbour 3-D upsample (reference nn/UpSampling3D.scala)."""
+
+    def __init__(self, size: Tuple[int, int, int]):
+        super().__init__()
+        self.size = tuple(size)
+
+    def forward(self, x):
+        t, h, w = self.size
+        x = jnp.repeat(x, t, axis=1)
+        x = jnp.repeat(x, h, axis=2)
+        return jnp.repeat(x, w, axis=3)
+
+
+class ResizeBilinear(Module):
+    """Bilinear resize to (out_height, out_width)
+    (reference nn/ResizeBilinear.scala; align_corners semantics)."""
+
+    def __init__(self, output_height: int, output_width: int,
+                 align_corners: bool = False, data_format: str = "NHWC"):
+        super().__init__()
+        self.out_size = (output_height, output_width)
+        self.align_corners = align_corners
+        self.data_format = data_format
+
+    def forward(self, x):
+        nchw = self.data_format == "NCHW"
+        if nchw:
+            x = jnp.transpose(x, (0, 2, 3, 1))
+        oh, ow = self.out_size
+        if self.align_corners:
+            # jax.image has no align_corners; do explicit gather math
+            h, w = x.shape[1], x.shape[2]
+            ys = jnp.linspace(0, h - 1, oh)
+            xs = jnp.linspace(0, w - 1, ow)
+            y0 = jnp.floor(ys).astype(jnp.int32)
+            x0 = jnp.floor(xs).astype(jnp.int32)
+            y1 = jnp.minimum(y0 + 1, h - 1)
+            x1 = jnp.minimum(x0 + 1, w - 1)
+            wy = (ys - y0)[None, :, None, None]
+            wx = (xs - x0)[None, None, :, None]
+            g = lambda yi, xi: x[:, yi][:, :, xi]
+            y = (g(y0, x0) * (1 - wy) * (1 - wx) + g(y1, x0) * wy * (1 - wx)
+                 + g(y0, x1) * (1 - wy) * wx + g(y1, x1) * wy * wx)
+        else:
+            y = jax.image.resize(
+                x, (x.shape[0], oh, ow, x.shape[3]), method="bilinear")
+        return jnp.transpose(y, (0, 3, 1, 2)) if nchw else y

@@ -1,0 +1,173 @@
+"""Stochastic regularization layers.
+
+Reference: nn/Dropout.scala, nn/SpatialDropout1D.scala,
+nn/SpatialDropout2D.scala, nn/SpatialDropout3D.scala,
+nn/GaussianDropout.scala, nn/GaussianNoise.scala,
+nn/GaussianSampler.scala, nn/ActivityRegularization.scala,
+nn/L1Penalty.scala, nn/NegativeEntropyPenalty.scala.
+
+All stochastic layers draw from the ambient ``forward_context`` RNG
+(see core/module.py); in eval mode they are deterministic pass-throughs.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.core.module import Module, next_rng_key
+
+__all__ = [
+    "Dropout", "SpatialDropout1D", "SpatialDropout2D", "SpatialDropout3D",
+    "GaussianDropout", "GaussianNoise", "GaussianSampler",
+    "ActivityRegularization", "L1Penalty", "NegativeEntropyPenalty",
+]
+
+
+class Dropout(Module):
+    """Zero with prob init_p, scale kept values by 1/(1-p) when
+    scale=True (reference nn/Dropout.scala)."""
+
+    def __init__(self, init_p: float = 0.5, inplace: bool = False,
+                 scale: bool = True):
+        super().__init__()
+        self.p = float(init_p)
+        self.scale = scale
+
+    def forward(self, x):
+        if not self.training or self.p <= 0.0:
+            return x
+        keep = jax.random.bernoulli(next_rng_key(), 1.0 - self.p, x.shape)
+        y = jnp.where(keep, x, 0.0)
+        return y / (1.0 - self.p) if self.scale else y
+
+
+class _SpatialDropoutND(Module):
+    """Drop whole feature maps (channel-last convention)."""
+
+    def __init__(self, init_p: float = 0.5):
+        super().__init__()
+        self.p = float(init_p)
+
+    def forward(self, x):
+        if not self.training or self.p <= 0.0:
+            return x
+        # mask shape: broadcast over all spatial dims, per (batch, channel)
+        mask_shape = (x.shape[0],) + (1,) * (x.ndim - 2) + (x.shape[-1],)
+        keep = jax.random.bernoulli(next_rng_key(), 1.0 - self.p, mask_shape)
+        return jnp.where(keep, x, 0.0)
+
+
+class SpatialDropout1D(_SpatialDropoutND):
+    """(reference nn/SpatialDropout1D.scala)"""
+
+
+class SpatialDropout2D(_SpatialDropoutND):
+    """(reference nn/SpatialDropout2D.scala)"""
+
+    def __init__(self, init_p: float = 0.5, data_format: str = "NHWC"):
+        super().__init__(init_p)
+        self.data_format = data_format
+
+    def forward(self, x):
+        if self.data_format == "NCHW":
+            if not self.training or self.p <= 0.0:
+                return x
+            mask_shape = (x.shape[0], x.shape[1]) + (1,) * (x.ndim - 2)
+            keep = jax.random.bernoulli(next_rng_key(), 1.0 - self.p,
+                                        mask_shape)
+            return jnp.where(keep, x, 0.0)
+        return super().forward(x)
+
+
+class SpatialDropout3D(SpatialDropout2D):
+    """(reference nn/SpatialDropout3D.scala)"""
+
+
+class GaussianDropout(Module):
+    """Multiply by N(1, p/(1-p)) in training
+    (reference nn/GaussianDropout.scala)."""
+
+    def __init__(self, rate: float):
+        super().__init__()
+        self.rate = float(rate)
+
+    def forward(self, x):
+        if not self.training:
+            return x
+        stddev = (self.rate / (1.0 - self.rate)) ** 0.5
+        noise = 1.0 + stddev * jax.random.normal(next_rng_key(), x.shape)
+        return x * noise
+
+
+class GaussianNoise(Module):
+    """Additive N(0, stddev) noise in training
+    (reference nn/GaussianNoise.scala)."""
+
+    def __init__(self, stddev: float):
+        super().__init__()
+        self.stddev = float(stddev)
+
+    def forward(self, x):
+        if not self.training:
+            return x
+        return x + self.stddev * jax.random.normal(next_rng_key(), x.shape)
+
+
+class GaussianSampler(Module):
+    """Reparameterized sampling from (mean, log_var) table — VAE latent
+    (reference nn/GaussianSampler.scala)."""
+
+    def forward(self, inputs):
+        mean, log_var = inputs
+        eps = jax.random.normal(next_rng_key(), mean.shape)
+        return mean + jnp.exp(0.5 * log_var) * eps
+
+
+class ActivityRegularization(Module):
+    """Identity that records an activity penalty, exposed via .loss
+    (reference nn/ActivityRegularization.scala).  The stored penalty is
+    a buffer so it rides out of jit with the updated module."""
+
+    def __init__(self, l1: float = 0.0, l2: float = 0.0):
+        super().__init__()
+        self.l1, self.l2 = float(l1), float(l2)
+        self.loss = jnp.zeros(())
+
+    def forward(self, x):
+        self.loss = self.l1 * jnp.sum(jnp.abs(x)) \
+            + self.l2 * jnp.sum(x * x)
+        return x
+
+
+class L1Penalty(Module):
+    """Identity adding L1 sparsity penalty on activations
+    (reference nn/L1Penalty.scala)."""
+
+    def __init__(self, l1weight: float, size_average: bool = False,
+                 provide_output: bool = True):
+        super().__init__()
+        self.l1weight = float(l1weight)
+        self.size_average = size_average
+        self.loss = jnp.zeros(())
+
+    def forward(self, x):
+        penalty = self.l1weight * jnp.sum(jnp.abs(x))
+        if self.size_average:
+            penalty = penalty / x.size
+        self.loss = penalty
+        return x
+
+
+class NegativeEntropyPenalty(Module):
+    """Identity adding -beta*H(p) penalty to encourage exploration
+    (reference nn/NegativeEntropyPenalty.scala)."""
+
+    def __init__(self, beta: float = 0.01):
+        super().__init__()
+        self.beta = float(beta)
+        self.loss = jnp.zeros(())
+
+    def forward(self, x):
+        self.loss = self.beta * jnp.sum(x * jnp.log(x + 1e-8))
+        return x

@@ -1,0 +1,424 @@
+"""Shape / indexing / reduction layers.
+
+Reference: nn/Reshape.scala, nn/View.scala, nn/Squeeze.scala,
+nn/Unsqueeze.scala, nn/Transpose.scala, nn/Select.scala, nn/Narrow.scala,
+nn/Replicate.scala, nn/Padding.scala, nn/SpatialZeroPadding.scala,
+nn/Cropping2D.scala, nn/Cropping3D.scala, nn/Tile.scala,
+nn/ExpandSize.scala, nn/InferReshape.scala, nn/Contiguous.scala,
+nn/Index.scala, nn/MaskedSelect.scala, nn/Max.scala, nn/Min.scala,
+nn/Mean.scala, nn/Sum.scala, nn/Masking.scala, nn/Pack.scala,
+nn/Reverse.scala.
+
+Dim arguments follow the reference's Torch convention: 1-based and, for
+layers documented as batch-excluding, offset by the batch axis.
+Negative-size (-1) inference is supported where the reference supports it.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.core.module import Module
+
+__all__ = [
+    "Reshape", "View", "Squeeze", "Unsqueeze", "Transpose", "Select",
+    "Narrow", "Replicate", "Padding", "SpatialZeroPadding", "Cropping2D",
+    "Cropping3D", "Tile", "ExpandSize", "InferReshape", "Contiguous",
+    "Index", "MaskedSelect", "Max", "Min", "Mean", "Sum", "Masking",
+    "Pack", "Reverse", "Flatten",
+]
+
+
+class Reshape(Module):
+    """Reshape non-batch dims to `size`; batch dim preserved when the
+    input has one more dim than `size` implies (reference nn/Reshape.scala
+    batchMode semantics)."""
+
+    def __init__(self, size: Sequence[int], batch_mode: Optional[bool] = None):
+        super().__init__()
+        self.size = tuple(size)
+        self.batch_mode = batch_mode
+
+    def forward(self, x):
+        n_elem = 1
+        for s in self.size:
+            n_elem *= s
+        total = 1
+        for s in x.shape:
+            total *= s
+        if self.batch_mode is True or (
+                self.batch_mode is None and total != n_elem):
+            return x.reshape((x.shape[0],) + self.size)
+        return x.reshape(self.size)
+
+
+class Flatten(Module):
+    """Collapse all non-batch dims (keras-style convenience)."""
+
+    def forward(self, x):
+        return x.reshape((x.shape[0], -1))
+
+
+class View(Module):
+    """Reshape with -1 inference, batch preserved (reference nn/View.scala)."""
+
+    def __init__(self, *sizes: int):
+        super().__init__()
+        if len(sizes) == 1 and isinstance(sizes[0], (tuple, list)):
+            sizes = tuple(sizes[0])
+        self.sizes = tuple(sizes)
+
+    def forward(self, x):
+        return x.reshape((x.shape[0],) + self.sizes)
+
+
+class Squeeze(Module):
+    """Drop singleton dim(s) (reference nn/Squeeze.scala; 1-based dim,
+    counting from the first non-batch axis when batch_mode)."""
+
+    def __init__(self, dim: Optional[int] = None, num_input_dims: int = -1):
+        super().__init__()
+        self.dim = dim
+        self.num_input_dims = num_input_dims
+
+    def forward(self, x):
+        if self.dim is None:
+            return jnp.squeeze(x)
+        d = self.dim - 1
+        if self.num_input_dims > 0 and x.ndim > self.num_input_dims:
+            d += x.ndim - self.num_input_dims  # batch offset
+        return jnp.squeeze(x, axis=d)
+
+
+class Unsqueeze(Module):
+    """Insert singleton dim at pos (1-based, batch excluded per reference
+    nn/Unsqueeze.scala when used inside batched models)."""
+
+    def __init__(self, pos: int, num_input_dims: int = -1):
+        super().__init__()
+        self.pos = pos
+        self.num_input_dims = num_input_dims
+
+    def forward(self, x):
+        d = self.pos - 1
+        if self.num_input_dims > 0 and x.ndim > self.num_input_dims:
+            d += x.ndim - self.num_input_dims  # batch offset
+        return jnp.expand_dims(x, axis=d)
+
+
+class Transpose(Module):
+    """Swap listed dim pairs (1-based, reference nn/Transpose.scala)."""
+
+    def __init__(self, permutations: Sequence[Tuple[int, int]]):
+        super().__init__()
+        self.permutations = tuple(tuple(p) for p in permutations)
+
+    def forward(self, x):
+        for d1, d2 in self.permutations:
+            x = jnp.swapaxes(x, d1 - 1, d2 - 1)
+        return x
+
+
+class Select(Module):
+    """Select index along dim, dropping it (reference nn/Select.scala;
+    1-based dim and index; negative values count from the end)."""
+
+    def __init__(self, dim: int, index: int):
+        super().__init__()
+        self.dim, self.index = dim, index
+
+    def forward(self, x):
+        dim = self.dim - 1 if self.dim > 0 else x.ndim + self.dim
+        idx = self.index - 1 if self.index > 0 else x.shape[dim] + self.index
+        return jax.lax.index_in_dim(x, idx, axis=dim, keepdims=False)
+
+
+class Narrow(Module):
+    """Slice `length` elements from `offset` along dim
+    (reference nn/Narrow.scala; 1-based; negative length = until end+1+length)."""
+
+    def __init__(self, dimension: int, offset: int, length: int = 1):
+        super().__init__()
+        self.dimension, self.offset, self.length = dimension, offset, length
+
+    def forward(self, x):
+        dim = self.dimension - 1 if self.dimension > 0 else x.ndim + self.dimension
+        start = self.offset - 1
+        length = self.length if self.length >= 0 \
+            else x.shape[dim] - start + self.length + 1
+        return jax.lax.slice_in_dim(x, start, start + length, axis=dim)
+
+
+class Replicate(Module):
+    """Insert new dim of size n_features at dim (reference nn/Replicate.scala)."""
+
+    def __init__(self, n_features: int, dim: int = 1, n_dim: int = 2147483647):
+        super().__init__()
+        self.n_features, self.dim = n_features, dim
+
+    def forward(self, x):
+        y = jnp.expand_dims(x, axis=self.dim - 1)
+        reps = [1] * y.ndim
+        reps[self.dim - 1] = self.n_features
+        return jnp.tile(y, reps)
+
+
+class Padding(Module):
+    """Pad `pad` entries (before if negative, after if positive) along dim
+    with value (reference nn/Padding.scala)."""
+
+    def __init__(self, dim: int, pad: int, n_input_dim: int,
+                 value: float = 0.0, n_index: int = 1):
+        super().__init__()
+        self.dim, self.pad, self.value = dim, pad, value
+        self.n_input_dim = n_input_dim
+
+    def forward(self, x):
+        dim = self.dim - 1
+        if x.ndim > self.n_input_dim:
+            dim += x.ndim - self.n_input_dim  # batch present
+        widths = [(0, 0)] * x.ndim
+        widths[dim] = (abs(self.pad), 0) if self.pad < 0 else (0, self.pad)
+        return jnp.pad(x, widths, constant_values=self.value)
+
+
+class SpatialZeroPadding(Module):
+    """Zero-pad H/W of NHWC (or NCHW) images
+    (reference nn/SpatialZeroPadding.scala)."""
+
+    def __init__(self, pad_left: int, pad_right: int, pad_top: int,
+                 pad_bottom: int, data_format: str = "NHWC"):
+        super().__init__()
+        self.pads = (pad_left, pad_right, pad_top, pad_bottom)
+        self.data_format = data_format
+
+    def forward(self, x):
+        l, r, t, b = self.pads
+        if self.data_format == "NHWC":
+            widths = [(0, 0), (t, b), (l, r), (0, 0)]
+        else:
+            widths = [(0, 0), (0, 0), (t, b), (l, r)]
+        if x.ndim == 3:  # unbatched
+            widths = widths[1:]
+        return jnp.pad(x, widths)
+
+
+class Cropping2D(Module):
+    """Crop H/W (reference nn/Cropping2D.scala)."""
+
+    def __init__(self, height_crop: Tuple[int, int] = (0, 0),
+                 width_crop: Tuple[int, int] = (0, 0),
+                 data_format: str = "NHWC"):
+        super().__init__()
+        self.height_crop = tuple(height_crop)
+        self.width_crop = tuple(width_crop)
+        self.data_format = data_format
+
+    def forward(self, x):
+        (t, b), (l, r) = self.height_crop, self.width_crop
+        if self.data_format == "NHWC":
+            return x[:, t:x.shape[1] - b, l:x.shape[2] - r, :]
+        return x[:, :, t:x.shape[2] - b, l:x.shape[3] - r]
+
+
+class Cropping3D(Module):
+    """Crop D/H/W of NDHWC volumes (reference nn/Cropping3D.scala)."""
+
+    def __init__(self, dim1_crop=(0, 0), dim2_crop=(0, 0), dim3_crop=(0, 0),
+                 data_format: str = "NDHWC"):
+        super().__init__()
+        self.crops = (tuple(dim1_crop), tuple(dim2_crop), tuple(dim3_crop))
+        self.data_format = data_format
+
+    def forward(self, x):
+        (d1a, d1b), (d2a, d2b), (d3a, d3b) = self.crops
+        if self.data_format == "NDHWC":
+            return x[:, d1a:x.shape[1] - d1b, d2a:x.shape[2] - d2b,
+                     d3a:x.shape[3] - d3b, :]
+        return x[:, :, d1a:x.shape[2] - d1b, d2a:x.shape[3] - d2b,
+                 d3a:x.shape[4] - d3b]
+
+
+class Tile(Module):
+    """Repeat along dim `copies` times (reference nn/Tile.scala)."""
+
+    def __init__(self, dim: int = 1, copies: int = 2):
+        super().__init__()
+        self.dim, self.copies = dim, copies
+
+    def forward(self, x):
+        reps = [1] * x.ndim
+        reps[self.dim - 1] = self.copies
+        return jnp.tile(x, reps)
+
+
+class ExpandSize(Module):
+    """Broadcast singleton dims to target sizes (-1 keeps size;
+    reference nn/ExpandSize.scala)."""
+
+    def __init__(self, sizes: Sequence[int]):
+        super().__init__()
+        self.sizes = tuple(sizes)
+
+    def forward(self, x):
+        target = tuple(x.shape[i] if s == -1 else s
+                       for i, s in enumerate(self.sizes))
+        return jnp.broadcast_to(x, target)
+
+
+class InferReshape(Module):
+    """Reshape where -1 infers a dim and 0 copies the input dim
+    (reference nn/InferReshape.scala)."""
+
+    def __init__(self, size: Sequence[int], batch_mode: bool = False):
+        super().__init__()
+        self.size = tuple(size)
+        self.batch_mode = batch_mode
+
+    def forward(self, x):
+        in_shape = x.shape[1:] if self.batch_mode else x.shape
+        out = []
+        for i, s in enumerate(self.size):
+            if s == 0:
+                out.append(in_shape[i])
+            else:
+                out.append(s)
+        if self.batch_mode:
+            return x.reshape((x.shape[0],) + tuple(out))
+        return x.reshape(tuple(out))
+
+
+class Contiguous(Module):
+    """No-op on TPU: XLA arrays have no stride aliasing
+    (reference nn/Contiguous.scala)."""
+
+    def forward(self, x):
+        return x
+
+
+class Index(Module):
+    """Table input (tensor, indices): index along dim
+    (reference nn/Index.scala; 1-based)."""
+
+    def __init__(self, dimension: int):
+        super().__init__()
+        self.dimension = dimension
+
+    def forward(self, inputs):
+        x, idx = inputs
+        return jnp.take(x, jnp.asarray(idx).astype(jnp.int32) - 1,
+                        axis=self.dimension - 1)
+
+
+class MaskedSelect(Module):
+    """Table input (tensor, mask): select masked entries.  The reference
+    (nn/MaskedSelect.scala) returns a dynamic-length vector; for XLA
+    static shapes we return (values_where_mask_else_0, mask) when jitted
+    callers need fixed shapes, or the compacted vector in eager mode."""
+
+    def forward(self, inputs):
+        x, mask = inputs
+        mask = mask.astype(bool)
+        try:
+            return x[mask]  # eager path: dynamic shape ok
+        except jax.errors.ConcretizationTypeError:
+            return jnp.where(mask, x, 0)
+
+
+class Max(Module):
+    """Max along dim, optionally returning values only
+    (reference nn/Max.scala; 1-based, num_input_dims for batch offset)."""
+
+    def __init__(self, dim: int = 1, num_input_dims: int = -1):
+        super().__init__()
+        self.dim = dim
+        self.num_input_dims = num_input_dims
+
+    def _axis(self, x):
+        d = self.dim - 1
+        if self.num_input_dims > 0 and x.ndim > self.num_input_dims:
+            d += x.ndim - self.num_input_dims
+        return d
+
+    def forward(self, x):
+        return jnp.max(x, axis=self._axis(x))
+
+
+class Min(Max):
+    def forward(self, x):
+        return jnp.min(x, axis=self._axis(x))
+
+
+class Mean(Module):
+    """Mean along dim (reference nn/Mean.scala; 1-based, squeeze option)."""
+
+    def __init__(self, dimension: int = 1, n_input_dims: int = -1,
+                 squeeze: bool = True):
+        super().__init__()
+        self.dimension = dimension
+        self.n_input_dims = n_input_dims
+        self.squeeze = squeeze
+
+    def forward(self, x):
+        d = self.dimension - 1
+        if self.n_input_dims > 0 and x.ndim > self.n_input_dims:
+            d += x.ndim - self.n_input_dims
+        return jnp.mean(x, axis=d, keepdims=not self.squeeze)
+
+
+class Sum(Module):
+    """Sum along dim (reference nn/Sum.scala)."""
+
+    def __init__(self, dimension: int = 1, n_input_dims: int = -1,
+                 size_average: bool = False, squeeze: bool = True):
+        super().__init__()
+        self.dimension = dimension
+        self.n_input_dims = n_input_dims
+        self.size_average = size_average
+        self.squeeze = squeeze
+
+    def forward(self, x):
+        d = self.dimension - 1
+        if self.n_input_dims > 0 and x.ndim > self.n_input_dims:
+            d += x.ndim - self.n_input_dims
+        if self.size_average:
+            return jnp.mean(x, axis=d, keepdims=not self.squeeze)
+        return jnp.sum(x, axis=d, keepdims=not self.squeeze)
+
+
+class Masking(Module):
+    """Zero out timesteps equal to mask_value in all features
+    (reference nn/Masking.scala)."""
+
+    def __init__(self, mask_value: float = 0.0):
+        super().__init__()
+        self.mask_value = float(mask_value)
+
+    def forward(self, x):
+        keep = jnp.any(x != self.mask_value, axis=-1, keepdims=True)
+        return x * keep.astype(x.dtype)
+
+
+class Pack(Module):
+    """Stack a table of tensors along a new dim (reference nn/Pack.scala)."""
+
+    def __init__(self, dimension: int = 1):
+        super().__init__()
+        self.dimension = dimension
+
+    def forward(self, xs):
+        return jnp.stack(list(xs), axis=self.dimension - 1)
+
+
+class Reverse(Module):
+    """Reverse along dim (reference nn/Reverse.scala)."""
+
+    def __init__(self, dimension: int = 1, is_inplace: bool = False):
+        super().__init__()
+        self.dimension = dimension
+
+    def forward(self, x):
+        return jnp.flip(x, axis=self.dimension - 1)

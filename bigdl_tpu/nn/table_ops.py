@@ -1,0 +1,261 @@
+"""Table (tuple-activity) arithmetic and combination layers.
+
+Reference: nn/CAddTable.scala, nn/CSubTable.scala, nn/CMulTable.scala,
+nn/CDivTable.scala, nn/CMaxTable.scala, nn/CMinTable.scala,
+nn/CAveTable.scala, nn/JoinTable.scala, nn/SplitTable.scala,
+nn/SelectTable.scala, nn/NarrowTable.scala, nn/FlattenTable.scala,
+nn/MixtureTable.scala, nn/DotProduct.scala, nn/CosineDistance.scala,
+nn/PairwiseDistance.scala, nn/MM.scala, nn/MV.scala,
+nn/BifurcateSplitTable.scala, nn/CrossProduct.scala,
+nn/TableOperation.scala.
+
+A reference "Table" is a Python tuple/list here (any pytree works).
+"""
+
+from __future__ import annotations
+
+from functools import reduce
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.core.module import Module
+
+__all__ = [
+    "CAddTable", "CSubTable", "CMulTable", "CDivTable", "CMaxTable",
+    "CMinTable", "CAveTable", "JoinTable", "SplitTable", "SelectTable",
+    "NarrowTable", "FlattenTable", "MixtureTable", "DotProduct",
+    "CosineDistance", "PairwiseDistance", "MM", "MV",
+    "BifurcateSplitTable", "CrossProduct",
+]
+
+
+class CAddTable(Module):
+    """Elementwise sum of the input table (reference nn/CAddTable.scala)."""
+
+    def __init__(self, inplace: bool = False):
+        super().__init__()
+
+    def forward(self, xs):
+        return reduce(jnp.add, xs)
+
+
+class CSubTable(Module):
+    def forward(self, xs):
+        return xs[0] - xs[1]
+
+
+class CMulTable(Module):
+    def forward(self, xs):
+        return reduce(jnp.multiply, xs)
+
+
+class CDivTable(Module):
+    def forward(self, xs):
+        return xs[0] / xs[1]
+
+
+class CMaxTable(Module):
+    def forward(self, xs):
+        return reduce(jnp.maximum, xs)
+
+
+class CMinTable(Module):
+    def forward(self, xs):
+        return reduce(jnp.minimum, xs)
+
+
+class CAveTable(Module):
+    def __init__(self, inplace: bool = False):
+        super().__init__()
+
+    def forward(self, xs):
+        return reduce(jnp.add, xs) / len(xs)
+
+
+class JoinTable(Module):
+    """Concatenate table elements along dim (reference nn/JoinTable.scala;
+    1-based; n_input_dims offsets for batched input)."""
+
+    def __init__(self, dimension: int, n_input_dims: int = -1):
+        super().__init__()
+        self.dimension = dimension
+        self.n_input_dims = n_input_dims
+
+    def forward(self, xs):
+        d = self.dimension - 1
+        if self.n_input_dims > 0 and xs[0].ndim > self.n_input_dims:
+            d += xs[0].ndim - self.n_input_dims
+        return jnp.concatenate(list(xs), axis=d)
+
+
+class SplitTable(Module):
+    """Split a tensor along dim into a table of slices
+    (reference nn/SplitTable.scala)."""
+
+    def __init__(self, dimension: int, n_input_dims: int = -1):
+        super().__init__()
+        self.dimension = dimension
+        self.n_input_dims = n_input_dims
+
+    def forward(self, x):
+        d = self.dimension - 1
+        if self.n_input_dims > 0 and x.ndim > self.n_input_dims:
+            d += x.ndim - self.n_input_dims
+        if d < 0:
+            d += x.ndim
+        return tuple(jax.lax.index_in_dim(x, i, axis=d, keepdims=False)
+                     for i in range(x.shape[d]))
+
+
+class SelectTable(Module):
+    """Pick the index-th element of the table (reference
+    nn/SelectTable.scala; 1-based, negative from end)."""
+
+    def __init__(self, index: int):
+        super().__init__()
+        self.index = index
+
+    def forward(self, xs):
+        i = self.index - 1 if self.index > 0 else len(xs) + self.index
+        return xs[i]
+
+
+class NarrowTable(Module):
+    """Sub-table [offset, offset+length) (reference nn/NarrowTable.scala)."""
+
+    def __init__(self, offset: int, length: int = 1):
+        super().__init__()
+        self.offset, self.length = offset, length
+
+    def forward(self, xs):
+        length = self.length if self.length >= 0 \
+            else len(xs) - self.offset + 2 + self.length
+        return tuple(xs[self.offset - 1:self.offset - 1 + length])
+
+
+class FlattenTable(Module):
+    """Flatten nested tables into a flat table (reference
+    nn/FlattenTable.scala)."""
+
+    def forward(self, xs):
+        out = []
+
+        def rec(t):
+            if isinstance(t, (tuple, list)):
+                for e in t:
+                    rec(e)
+            else:
+                out.append(t)
+
+        rec(xs)
+        return tuple(out)
+
+
+class MixtureTable(Module):
+    """Mixture-of-experts blend: (gater [b,n], experts table/tensor) →
+    sum_i gater_i * expert_i (reference nn/MixtureTable.scala)."""
+
+    def __init__(self, dim: int = 2147483647):
+        super().__init__()
+
+    def forward(self, inputs):
+        gater, experts = inputs
+        if isinstance(experts, (tuple, list)):
+            stacked = jnp.stack(list(experts), axis=1)  # [b, n, ...]
+        else:
+            stacked = experts
+        g = gater.reshape(gater.shape + (1,) * (stacked.ndim - gater.ndim))
+        return jnp.sum(g * stacked, axis=1)
+
+
+class DotProduct(Module):
+    """Row-wise dot product of two inputs (reference nn/DotProduct.scala)."""
+
+    def forward(self, inputs):
+        a, b = inputs
+        return jnp.sum(a * b, axis=-1)
+
+
+class CosineDistance(Module):
+    """Row-wise cosine similarity (reference nn/CosineDistance.scala)."""
+
+    def forward(self, inputs):
+        a, b = inputs
+        an = a / (jnp.linalg.norm(a, axis=-1, keepdims=True) + 1e-12)
+        bn = b / (jnp.linalg.norm(b, axis=-1, keepdims=True) + 1e-12)
+        return jnp.sum(an * bn, axis=-1)
+
+
+class PairwiseDistance(Module):
+    """Row-wise Lp distance (reference nn/PairwiseDistance.scala)."""
+
+    def __init__(self, norm: int = 2):
+        super().__init__()
+        self.norm = norm
+
+    def forward(self, inputs):
+        a, b = inputs
+        return jnp.linalg.norm(a - b, ord=self.norm, axis=-1)
+
+
+class MM(Module):
+    """Batch (or plain) matrix-matrix product with optional transposes
+    (reference nn/MM.scala)."""
+
+    def __init__(self, trans_a: bool = False, trans_b: bool = False):
+        super().__init__()
+        self.trans_a, self.trans_b = trans_a, trans_b
+
+    def forward(self, inputs):
+        a, b = inputs
+        if self.trans_a:
+            a = jnp.swapaxes(a, -1, -2)
+        if self.trans_b:
+            b = jnp.swapaxes(b, -1, -2)
+        return a @ b
+
+
+class MV(Module):
+    """Batch matrix-vector product (reference nn/MV.scala)."""
+
+    def __init__(self, trans: bool = False):
+        super().__init__()
+        self.trans = trans
+
+    def forward(self, inputs):
+        m, v = inputs
+        if self.trans:
+            m = jnp.swapaxes(m, -1, -2)
+        return jnp.einsum("...ij,...j->...i", m, v)
+
+
+class BifurcateSplitTable(Module):
+    """Split a tensor into two halves along dim
+    (reference nn/BifurcateSplitTable.scala)."""
+
+    def __init__(self, dimension: int):
+        super().__init__()
+        self.dimension = dimension
+
+    def forward(self, x):
+        d = self.dimension - 1
+        half = x.shape[d] // 2
+        return (jax.lax.slice_in_dim(x, 0, half, axis=d),
+                jax.lax.slice_in_dim(x, half, x.shape[d], axis=d))
+
+
+class CrossProduct(Module):
+    """Pairwise dot products between all table entries
+    (reference nn/CrossProduct.scala)."""
+
+    def __init__(self, num_tensor: int = 0, embedding_size: int = 0):
+        super().__init__()
+
+    def forward(self, xs):
+        outs = []
+        for i in range(len(xs)):
+            for j in range(i + 1, len(xs)):
+                outs.append(jnp.sum(xs[i] * xs[j], axis=-1, keepdims=True))
+        return jnp.concatenate(outs, axis=-1)

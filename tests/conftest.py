@@ -1,11 +1,16 @@
 """Test configuration: run on XLA CPU with 8 virtual devices so the
 multi-chip sharding paths are exercised without a pod — the equivalent of
 the reference's `new SparkContext("local[1]", ...)` trick
-(reference: optim/DistriOptimizerSpec.scala:139)."""
+(reference: optim/DistriOptimizerSpec.scala:139).
+
+NOTE: the axon sitecustomize forces jax_platforms="axon,cpu" via
+jax.config.update at interpreter start, overriding the JAX_PLATFORMS env
+var — so we must win the override war with our own config.update AFTER
+importing jax, BEFORE any backend is initialized.
+"""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -13,11 +18,23 @@ if "xla_force_host_platform_device_count" not in flags:
 
 import jax  # noqa: E402
 
+jax.config.update("jax_platforms", "cpu")
+
 import pytest  # noqa: E402
 
 
 @pytest.fixture(autouse=True)
 def _seed():
     from bigdl_tpu.utils import set_seed
-    set_seed(4357)  # the reference's default RandomGenerator seed semantics
+    set_seed(4357)
     yield
+
+
+@pytest.fixture()
+def mesh8():
+    """An 8-device CPU mesh shaped (data=2, model=2, pipe=2)."""
+    import numpy as np
+    from jax.sharding import Mesh
+    devs = np.array(jax.devices()[:8]).reshape(2, 2, 2)
+    with Mesh(devs, ("data", "model", "pipe")) as m:
+        yield m

@@ -1,0 +1,226 @@
+"""Criterion oracle tests vs torch CPU + structural tests.
+
+Targets use the reference's 1-based class convention; torch's are
+0-based, adjusted at the boundary.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import torch
+import torch.nn.functional as F
+
+import bigdl_tpu.nn as nn
+
+RTOL, ATOL = 1e-4, 1e-5
+
+
+def rnd(*shape, seed=0):
+    return np.random.RandomState(seed).randn(*shape).astype(np.float32)
+
+
+def test_classnll_matches_torch():
+    logits = rnd(5, 7)
+    logp = np.asarray(jax.nn.log_softmax(jnp.asarray(logits)))
+    target = np.array([1, 3, 7, 2, 5])
+    ours = nn.ClassNLLCriterion()(jnp.asarray(logp), jnp.asarray(target))
+    ref = F.nll_loss(torch.tensor(logp), torch.tensor(target - 1))
+    np.testing.assert_allclose(float(ours), float(ref), rtol=RTOL)
+
+
+def test_classnll_with_weights_matches_torch():
+    logp = np.asarray(jax.nn.log_softmax(jnp.asarray(rnd(5, 4))))
+    target = np.array([1, 2, 3, 4, 2])
+    w = np.array([0.2, 0.5, 1.0, 2.0], dtype=np.float32)
+    ours = nn.ClassNLLCriterion(weights=w)(jnp.asarray(logp),
+                                           jnp.asarray(target))
+    ref = F.nll_loss(torch.tensor(logp), torch.tensor(target - 1),
+                     weight=torch.tensor(w))
+    np.testing.assert_allclose(float(ours), float(ref), rtol=RTOL)
+
+
+def test_crossentropy_matches_torch():
+    logits = rnd(6, 9)
+    target = np.array([1, 2, 3, 4, 5, 9])
+    ours = nn.CrossEntropyCriterion()(jnp.asarray(logits),
+                                      jnp.asarray(target))
+    ref = F.cross_entropy(torch.tensor(logits), torch.tensor(target - 1))
+    np.testing.assert_allclose(float(ours), float(ref), rtol=RTOL)
+
+
+def test_mse_and_abs_match_torch():
+    a, b = rnd(4, 5), rnd(4, 5, seed=1)
+    np.testing.assert_allclose(
+        float(nn.MSECriterion()(jnp.asarray(a), jnp.asarray(b))),
+        float(F.mse_loss(torch.tensor(a), torch.tensor(b))), rtol=RTOL)
+    np.testing.assert_allclose(
+        float(nn.AbsCriterion()(jnp.asarray(a), jnp.asarray(b))),
+        float(F.l1_loss(torch.tensor(a), torch.tensor(b))), rtol=RTOL)
+
+
+def test_bce_matches_torch():
+    p = 1 / (1 + np.exp(-rnd(6, 3)))
+    t = (rnd(6, 3, seed=2) > 0).astype(np.float32)
+    np.testing.assert_allclose(
+        float(nn.BCECriterion()(jnp.asarray(p), jnp.asarray(t))),
+        float(F.binary_cross_entropy(torch.tensor(p), torch.tensor(t))),
+        rtol=1e-3)
+
+
+def test_smoothl1_matches_torch():
+    a, b = rnd(4, 5), rnd(4, 5, seed=1) * 3
+    np.testing.assert_allclose(
+        float(nn.SmoothL1Criterion()(jnp.asarray(a), jnp.asarray(b))),
+        float(F.smooth_l1_loss(torch.tensor(a), torch.tensor(b))), rtol=RTOL)
+
+
+def test_distkldiv_matches_torch():
+    logp = np.asarray(jax.nn.log_softmax(jnp.asarray(rnd(4, 6))))
+    t = np.asarray(jax.nn.softmax(jnp.asarray(rnd(4, 6, seed=1))))
+    np.testing.assert_allclose(
+        float(nn.DistKLDivCriterion()(jnp.asarray(logp), jnp.asarray(t))),
+        float(F.kl_div(torch.tensor(logp), torch.tensor(t),
+                       reduction="mean")), rtol=1e-3)
+
+
+def test_margin_ranking_matches_torch():
+    x1, x2 = rnd(8), rnd(8, seed=1)
+    y = np.sign(rnd(8, seed=2)).astype(np.float32)
+    ours = nn.MarginRankingCriterion(margin=0.5)(
+        (jnp.asarray(x1), jnp.asarray(x2)), jnp.asarray(y))
+    ref = F.margin_ranking_loss(torch.tensor(x1), torch.tensor(x2),
+                                torch.tensor(y), margin=0.5)
+    np.testing.assert_allclose(float(ours), float(ref), rtol=RTOL)
+
+
+def test_multimargin_matches_torch():
+    x = rnd(5, 6)
+    t = np.array([1, 4, 2, 6, 3])
+    ours = nn.MultiMarginCriterion()(jnp.asarray(x), jnp.asarray(t))
+    ref = F.multi_margin_loss(torch.tensor(x), torch.tensor(t - 1))
+    np.testing.assert_allclose(float(ours), float(ref), rtol=1e-3)
+
+
+def test_soft_margin_matches_torch():
+    x = rnd(6, 4)
+    y = np.sign(rnd(6, 4, seed=1)).astype(np.float32)
+    ours = nn.SoftMarginCriterion()(jnp.asarray(x), jnp.asarray(y))
+    ref = F.soft_margin_loss(torch.tensor(x), torch.tensor(y))
+    np.testing.assert_allclose(float(ours), float(ref), rtol=RTOL)
+
+
+def test_cosine_embedding_matches_torch():
+    x1, x2 = rnd(4, 8), rnd(4, 8, seed=1)
+    y = np.array([1, -1, 1, -1], dtype=np.float32)
+    ours = nn.CosineEmbeddingCriterion(margin=0.3)(
+        (jnp.asarray(x1), jnp.asarray(x2)), jnp.asarray(y))
+    ref = F.cosine_embedding_loss(torch.tensor(x1), torch.tensor(x2),
+                                  torch.tensor(y), margin=0.3)
+    np.testing.assert_allclose(float(ours), float(ref), rtol=1e-3)
+
+
+def test_hinge_embedding_matches_torch():
+    x = rnd(10)
+    y = np.sign(rnd(10, seed=1)).astype(np.float32)
+    ours = nn.HingeEmbeddingCriterion(margin=1.0)(
+        jnp.asarray(x), jnp.asarray(y))
+    ref = F.hinge_embedding_loss(torch.tensor(x), torch.tensor(y))
+    np.testing.assert_allclose(float(ours), float(ref), rtol=RTOL)
+
+
+def test_multilabel_soft_margin_matches_torch():
+    x = rnd(4, 5)
+    t = (rnd(4, 5, seed=3) > 0).astype(np.float32)
+    ours = nn.MultiLabelSoftMarginCriterion()(jnp.asarray(x), jnp.asarray(t))
+    ref = F.multilabel_soft_margin_loss(torch.tensor(x), torch.tensor(t))
+    np.testing.assert_allclose(float(ours), float(ref), rtol=1e-3)
+
+
+def test_multilabel_margin_matches_torch():
+    x = rnd(3, 6)
+    t = np.array([[2, 4, 0, 0, 0, 0], [1, 0, 0, 0, 0, 0],
+                  [3, 5, 6, 0, 0, 0]])
+    ours = nn.MultiLabelMarginCriterion()(jnp.asarray(x), jnp.asarray(t))
+    tt = torch.tensor(t - 1)
+    tt[t == 0] = -1
+    ref = F.multilabel_margin_loss(torch.tensor(x), tt)
+    np.testing.assert_allclose(float(ours), float(ref), rtol=1e-3)
+
+
+def test_criterion_backward_matches_torch():
+    logits = rnd(4, 5)
+    target = np.array([1, 2, 3, 4])
+    crit = nn.CrossEntropyCriterion()
+    gi = crit.backward(jnp.asarray(logits), jnp.asarray(target))
+    xt = torch.tensor(logits, requires_grad=True)
+    F.cross_entropy(xt, torch.tensor(target - 1)).backward()
+    np.testing.assert_allclose(np.asarray(gi), xt.grad.numpy(),
+                               rtol=RTOL, atol=ATOL)
+
+
+def test_parallel_and_multi_criterion():
+    a, b = jnp.asarray(rnd(3, 4)), jnp.asarray(rnd(3, 4, seed=1))
+    pc = nn.ParallelCriterion().add(nn.MSECriterion(), 0.5) \
+                               .add(nn.AbsCriterion(), 2.0)
+    loss = pc((a, a * 0), (b, b))
+    expect = 0.5 * float(nn.MSECriterion()(a, b)) \
+        + 2.0 * float(nn.AbsCriterion()(a * 0, b))
+    np.testing.assert_allclose(float(loss), expect, rtol=RTOL)
+    mc = nn.MultiCriterion().add(nn.MSECriterion()).add(nn.AbsCriterion())
+    loss2 = mc(a, b)
+    expect2 = float(nn.MSECriterion()(a, b)) + float(nn.AbsCriterion()(a, b))
+    np.testing.assert_allclose(float(loss2), expect2, rtol=RTOL)
+
+
+def test_kld_vae_criterion():
+    mean = jnp.zeros((2, 4))
+    log_var = jnp.zeros((2, 4))
+    assert float(nn.KLDCriterion()((mean, log_var))) == pytest.approx(0.0)
+
+
+def test_timedistributed_criterion():
+    x = rnd(2, 3, 5)  # batch, time, classes
+    logp = np.asarray(jax.nn.log_softmax(jnp.asarray(x)))
+    t = np.array([[1, 2, 3], [4, 5, 1]])
+    # reference semantics: sum of per-timestep criterion losses, divided
+    # by nstep when size_average (TimeDistributedCriterion.scala)
+    ours_sa = nn.TimeDistributedCriterion(nn.ClassNLLCriterion(), True, 2)(
+        jnp.asarray(logp), jnp.asarray(t))
+    ours_sum = nn.TimeDistributedCriterion(nn.ClassNLLCriterion(), False, 2)(
+        jnp.asarray(logp), jnp.asarray(t))
+    per_step = [F.nll_loss(torch.tensor(logp[:, i]),
+                           torch.tensor(t[:, i] - 1)) for i in range(3)]
+    expect_sum = float(sum(per_step))
+    np.testing.assert_allclose(float(ours_sum), expect_sum, rtol=1e-3)
+    np.testing.assert_allclose(float(ours_sa), expect_sum / 3, rtol=1e-3)
+
+
+def test_multimargin_weights_applied():
+    x = rnd(5, 6)
+    t = np.array([1, 4, 2, 6, 3])
+    w = np.array([0.1, 0.5, 1.0, 2.0, 0.3, 1.5], dtype=np.float32)
+    ours = nn.MultiMarginCriterion(weights=w)(jnp.asarray(x), jnp.asarray(t))
+    ref = F.multi_margin_loss(torch.tensor(x), torch.tensor(t - 1),
+                              weight=torch.tensor(w))
+    np.testing.assert_allclose(float(ours), float(ref), rtol=1e-3)
+
+
+def test_multilabel_margin_stops_at_first_zero():
+    x = rnd(1, 6)
+    t = np.array([[2, 0, 4, 0, 0, 0]])  # only class 2 is a target
+    ours = nn.MultiLabelMarginCriterion()(jnp.asarray(x), jnp.asarray(t))
+    tt = torch.tensor(t - 1)
+    tt[t == 0] = -1
+    ref = F.multilabel_margin_loss(torch.tensor(x), tt)
+    np.testing.assert_allclose(float(ours), float(ref), rtol=1e-3)
+
+
+def test_distkldiv_divides_by_nelement():
+    logp = np.asarray(jax.nn.log_softmax(jnp.asarray(rnd(4, 6))))
+    t = np.asarray(jax.nn.softmax(jnp.asarray(rnd(4, 6, seed=1))))
+    ours = nn.DistKLDivCriterion(size_average=True)(
+        jnp.asarray(logp), jnp.asarray(t))
+    ref = F.kl_div(torch.tensor(logp), torch.tensor(t), reduction="mean")
+    np.testing.assert_allclose(float(ours), float(ref), rtol=1e-3)

@@ -1,0 +1,256 @@
+"""Numerical oracle tests: layer outputs and input-grads vs torch CPU.
+
+Mirrors the reference's cross-framework oracle strategy
+(integration/torch/TH.scala runs Torch7 and compares; here torch-cpu is
+in-process).  NHWC inputs are transposed to NCHW for the torch side.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import torch
+import torch.nn.functional as F
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.core.module import Parameter
+
+
+RTOL, ATOL = 1e-4, 1e-5
+
+
+def to_nchw(x):
+    return np.transpose(x, (0, 3, 1, 2))
+
+
+def rnd(*shape, seed=0):
+    return np.random.RandomState(seed).randn(*shape).astype(np.float32)
+
+
+def test_linear_matches_torch():
+    x = rnd(4, 10)
+    layer = nn.Linear(10, 6)
+    tl = torch.nn.Linear(10, 6)
+    with torch.no_grad():
+        tl.weight.copy_(torch.tensor(np.asarray(layer.weight)))
+        tl.bias.copy_(torch.tensor(np.asarray(layer.bias)))
+    np.testing.assert_allclose(
+        np.asarray(layer(jnp.asarray(x))),
+        tl(torch.tensor(x)).detach().numpy(), rtol=RTOL, atol=ATOL)
+
+
+def test_conv2d_matches_torch():
+    x = rnd(2, 9, 9, 3)
+    layer = nn.SpatialConvolution(3, 8, 3, 3, 2, 2, 1, 1)
+    w = np.asarray(layer.weight)  # HWIO
+    w_t = np.transpose(w, (3, 2, 0, 1))  # OIHW
+    out = layer(jnp.asarray(x))
+    ref = F.conv2d(torch.tensor(to_nchw(x)), torch.tensor(w_t),
+                   torch.tensor(np.asarray(layer.bias)),
+                   stride=2, padding=1)
+    np.testing.assert_allclose(
+        np.asarray(jnp.transpose(out, (0, 3, 1, 2))),
+        ref.numpy(), rtol=RTOL, atol=ATOL)
+
+
+def test_grouped_conv_matches_torch():
+    x = rnd(2, 8, 8, 4)
+    layer = nn.SpatialConvolution(4, 6, 3, 3, n_group=2)
+    w = np.transpose(np.asarray(layer.weight), (3, 2, 0, 1))
+    ref = F.conv2d(torch.tensor(to_nchw(x)), torch.tensor(w),
+                   torch.tensor(np.asarray(layer.bias)), groups=2)
+    np.testing.assert_allclose(
+        np.asarray(jnp.transpose(layer(jnp.asarray(x)), (0, 3, 1, 2))),
+        ref.numpy(), rtol=RTOL, atol=ATOL)
+
+
+def test_conv_transpose_matches_torch():
+    x = rnd(1, 8, 8, 3)
+    layer = nn.SpatialFullConvolution(3, 5, 4, 4, 2, 2, 1, 1)
+    w = np.asarray(layer.weight)  # HWIO: (kh, kw, in, out)
+    w_t = np.transpose(w, (2, 3, 0, 1))  # IOHW for torch transposed
+    ref = F.conv_transpose2d(
+        torch.tensor(to_nchw(x)), torch.tensor(w_t),
+        torch.tensor(np.asarray(layer.bias)), stride=2, padding=1)
+    np.testing.assert_allclose(
+        np.asarray(jnp.transpose(layer(jnp.asarray(x)), (0, 3, 1, 2))),
+        ref.numpy(), rtol=RTOL, atol=ATOL)
+
+
+def test_dilated_conv_matches_torch():
+    x = rnd(1, 9, 9, 3)
+    layer = nn.SpatialDilatedConvolution(3, 4, 3, 3, 1, 1, 1, 1, 2, 2)
+    w = np.transpose(np.asarray(layer.weight), (3, 2, 0, 1))
+    ref = F.conv2d(torch.tensor(to_nchw(x)), torch.tensor(w),
+                   torch.tensor(np.asarray(layer.bias)),
+                   padding=1, dilation=2)
+    np.testing.assert_allclose(
+        np.asarray(jnp.transpose(layer(jnp.asarray(x)), (0, 3, 1, 2))),
+        ref.numpy(), rtol=RTOL, atol=ATOL)
+
+
+def test_maxpool_matches_torch():
+    x = rnd(2, 8, 8, 3)
+    layer = nn.SpatialMaxPooling(3, 3, 2, 2, 1, 1)
+    ref = F.max_pool2d(torch.tensor(to_nchw(x)), 3, 2, padding=1)
+    np.testing.assert_allclose(
+        np.asarray(jnp.transpose(layer(jnp.asarray(x)), (0, 3, 1, 2))),
+        ref.numpy(), rtol=RTOL, atol=ATOL)
+
+
+def test_maxpool_ceil_mode_matches_torch():
+    x = rnd(1, 7, 7, 2)
+    layer = nn.SpatialMaxPooling(3, 3, 2, 2).ceil()
+    ref = F.max_pool2d(torch.tensor(to_nchw(x)), 3, 2, ceil_mode=True)
+    np.testing.assert_allclose(
+        np.asarray(jnp.transpose(layer(jnp.asarray(x)), (0, 3, 1, 2))),
+        ref.numpy(), rtol=RTOL, atol=ATOL)
+
+
+def test_avgpool_matches_torch():
+    x = rnd(2, 8, 8, 3)
+    layer = nn.SpatialAveragePooling(2, 2, 2, 2)
+    ref = F.avg_pool2d(torch.tensor(to_nchw(x)), 2, 2)
+    np.testing.assert_allclose(
+        np.asarray(jnp.transpose(layer(jnp.asarray(x)), (0, 3, 1, 2))),
+        ref.numpy(), rtol=RTOL, atol=ATOL)
+
+
+def test_batchnorm_train_and_eval_match_torch():
+    x = rnd(4, 6, 6, 5)
+    layer = nn.SpatialBatchNormalization(5)
+    tb = torch.nn.BatchNorm2d(5)
+    with torch.no_grad():
+        tb.weight.copy_(torch.tensor(np.asarray(layer.weight)))
+        tb.bias.copy_(torch.tensor(np.asarray(layer.bias)))
+    out = layer(jnp.asarray(x))
+    ref = tb(torch.tensor(to_nchw(x)))
+    np.testing.assert_allclose(
+        np.asarray(jnp.transpose(out, (0, 3, 1, 2))),
+        ref.detach().numpy(), rtol=1e-3, atol=1e-4)
+    # running stats agree
+    np.testing.assert_allclose(np.asarray(layer.running_mean),
+                               tb.running_mean.numpy(), rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(layer.running_var),
+                               tb.running_var.numpy(), rtol=1e-3, atol=1e-4)
+    # eval mode
+    layer.eval_mode()
+    tb.eval()
+    out_e = layer(jnp.asarray(x))
+    ref_e = tb(torch.tensor(to_nchw(x)))
+    np.testing.assert_allclose(
+        np.asarray(jnp.transpose(out_e, (0, 3, 1, 2))),
+        ref_e.detach().numpy(), rtol=1e-3, atol=1e-4)
+
+
+def test_layernorm_matches_torch():
+    x = rnd(4, 12)
+    layer = nn.LayerNormalization(12, eps=1e-5)
+    t = torch.nn.LayerNorm(12, eps=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(layer(jnp.asarray(x))),
+        t(torch.tensor(x)).detach().numpy(), rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("ours,theirs", [
+    (nn.ReLU(), F.relu),
+    (nn.Tanh(), torch.tanh),
+    (nn.Sigmoid(), torch.sigmoid),
+    (nn.ELU(), F.elu),
+    (nn.SoftPlus(), F.softplus),
+    (nn.SoftSign(), F.softsign),
+    (nn.LeakyReLU(0.1), lambda t: F.leaky_relu(t, 0.1)),
+    (nn.ReLU6(), F.relu6),
+    (nn.HardShrink(0.5), lambda t: F.hardshrink(t, 0.5)),
+    (nn.SoftShrink(0.5), lambda t: F.softshrink(t, 0.5)),
+    (nn.TanhShrink(), F.tanhshrink),
+    (nn.LogSigmoid(), F.logsigmoid),
+    (nn.GELU(approximate=False), F.gelu),
+])
+def test_activations_match_torch(ours, theirs):
+    x = rnd(3, 7, seed=3)
+    np.testing.assert_allclose(
+        np.asarray(ours(jnp.asarray(x))),
+        theirs(torch.tensor(x)).numpy(), rtol=RTOL, atol=ATOL)
+
+
+def test_logsoftmax_and_softmax_match_torch():
+    x = rnd(3, 7)
+    np.testing.assert_allclose(
+        np.asarray(nn.LogSoftMax()(jnp.asarray(x))),
+        F.log_softmax(torch.tensor(x), dim=-1).numpy(), rtol=RTOL, atol=ATOL)
+    np.testing.assert_allclose(
+        np.asarray(nn.SoftMax()(jnp.asarray(x))),
+        F.softmax(torch.tensor(x), dim=-1).numpy(), rtol=RTOL, atol=ATOL)
+
+
+def test_lookup_table_matches_torch_embedding():
+    layer = nn.LookupTable(20, 8)
+    emb = torch.nn.Embedding(20, 8)
+    with torch.no_grad():
+        emb.weight.copy_(torch.tensor(np.asarray(layer.weight)))
+    idx = np.array([[1, 5, 20], [3, 3, 7]])
+    np.testing.assert_allclose(
+        np.asarray(layer(jnp.asarray(idx))),
+        emb(torch.tensor(idx) - 1).detach().numpy(), rtol=RTOL, atol=ATOL)
+
+
+def test_input_gradient_matches_torch():
+    """backward() (vjp) vs torch autograd through a small conv net."""
+    x = rnd(2, 8, 8, 3)
+    conv = nn.SpatialConvolution(3, 4, 3, 3)
+    w = np.transpose(np.asarray(conv.weight), (3, 2, 0, 1))
+    xt = torch.tensor(to_nchw(x), requires_grad=True)
+    ref = F.conv2d(xt, torch.tensor(w), torch.tensor(np.asarray(conv.bias)))
+    ref.sum().backward()
+    gi = conv.backward(jnp.asarray(x), jnp.ones(conv(jnp.asarray(x)).shape))
+    np.testing.assert_allclose(
+        np.asarray(jnp.transpose(gi, (0, 3, 1, 2))),
+        xt.grad.numpy(), rtol=RTOL, atol=ATOL)
+
+
+def test_lrn_matches_torch():
+    x = rnd(2, 5, 5, 8)
+    layer = nn.SpatialCrossMapLRN(size=5, alpha=1.0, beta=0.75, k=1.0)
+    ref = torch.nn.LocalResponseNorm(5, alpha=1.0, beta=0.75, k=1.0)(
+        torch.tensor(to_nchw(x)))
+    np.testing.assert_allclose(
+        np.asarray(jnp.transpose(layer(jnp.asarray(x)), (0, 3, 1, 2))),
+        ref.numpy(), rtol=1e-3, atol=1e-4)
+
+
+def test_prelu_matches_torch():
+    x = rnd(3, 4)
+    layer = nn.PReLU(4)
+    t = torch.nn.PReLU(4)
+    with torch.no_grad():
+        t.weight.copy_(torch.tensor(np.asarray(layer.weight)))
+    np.testing.assert_allclose(
+        np.asarray(layer(jnp.asarray(x))),
+        t(torch.tensor(x)).detach().numpy(), rtol=RTOL, atol=ATOL)
+
+
+def test_unsqueeze_batch_offset():
+    x = jnp.ones((4, 7))
+    assert nn.Unsqueeze(1, num_input_dims=1)(x).shape == (4, 1, 7)
+    assert nn.Squeeze(1, num_input_dims=2)(
+        jnp.ones((4, 1, 7))).shape == (4, 7)
+
+
+def test_volumetric_avgpool_excl_pad():
+    x = rnd(1, 4, 4, 4, 2)
+    layer = nn.VolumetricAveragePooling(2, 2, 2, 2, 2, 2, 1, 1, 1,
+                                        count_include_pad=False)
+    ref = F.avg_pool3d(torch.tensor(np.transpose(x, (0, 4, 1, 2, 3))),
+                       2, 2, padding=1, count_include_pad=False)
+    np.testing.assert_allclose(
+        np.asarray(jnp.transpose(layer(jnp.asarray(x)), (0, 4, 1, 2, 3))),
+        ref.numpy(), rtol=RTOL, atol=ATOL)
+
+
+def test_graph_arity_error():
+    i1, i2 = nn.Input(), nn.Input()
+    g = nn.Graph([i1, i2], nn.CAddTable()(i1, i2))
+    with pytest.raises(ValueError, match="expects 2"):
+        g(jnp.ones((2, 3)))
