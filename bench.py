@@ -1,0 +1,93 @@
+"""Benchmark entry: ResNet-50 ImageNet-shape training throughput on the
+available accelerator (one TPU chip under the driver).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Baseline for vs_baseline: the reference's published ResNet-50 recipe
+throughput per CPU core — BigDL trains ResNet-50 at global batch 8192 on
+2048 Xeon cores (models/resnet/README.md); sustained ~1.1 img/s/core
+(whitepaper-era Broadwell measurements ⇒ ~2250 img/s cluster-wide).
+vs_baseline reports our img/s on ONE chip divided by the reference's
+img/s on one 32-core executor (~35 img/s) — i.e. chip-for-executor
+speedup.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from bigdl_tpu.core.module import partition, combine, forward_context
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.models import resnet50
+    from bigdl_tpu.optim.methods import SGD
+    from bigdl_tpu.utils import set_seed
+
+    set_seed(0)
+    dev = jax.devices()[0]
+    on_tpu = dev.platform != "cpu"
+    batch = 64 if on_tpu else 8
+    size = 224 if on_tpu else 64
+
+    model = resnet50(class_num=1000)
+    criterion = nn.CrossEntropyCriterion()
+    method = SGD(0.1, momentum=0.9, dampening=0.0)
+
+    params_tree, rest = partition(model)
+    opt_state = method.init_state(params_tree)
+
+    from bigdl_tpu.core.module import cast_floating
+
+    @jax.jit
+    def step(params, rest, opt_state, x, y):
+        def loss_fn(p):
+            m = cast_floating(combine(p, rest), jnp.bfloat16)
+            out = m.forward(x.astype(jnp.bfloat16)).astype(jnp.float32)
+            return criterion(out, y), m
+
+        (loss, m2), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        params, opt_state2 = method.update(grads, params, opt_state)
+        _, rest2 = partition(m2)
+        rest2 = cast_floating(rest2, jnp.float32)
+        return params, rest2, opt_state2, loss
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(batch, size, size, 3)),
+                    dtype=jnp.float32)
+    y = jnp.asarray(rng.integers(1, 1001, size=(batch,)))
+
+    # warmup/compile
+    params_tree, rest, opt_state, loss = step(
+        params_tree, rest, opt_state, x, y)
+    jax.block_until_ready(loss)
+
+    iters = 20 if on_tpu else 3
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params_tree, rest, opt_state, loss = step(
+            params_tree, rest, opt_state, x, y)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    img_per_sec = batch * iters / dt
+    # reference: ~35 img/s per 32-core executor (see module docstring)
+    vs_baseline = img_per_sec / 35.0
+    print(json.dumps({
+        "metric": f"resnet50_train_img_per_sec_bs{batch}_{size}px_"
+                  f"{dev.platform}",
+        "value": round(img_per_sec, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(vs_baseline, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

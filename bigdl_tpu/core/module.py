@@ -415,6 +415,22 @@ class Module:
                 v.load_parameters(params[n])
         return self
 
+    def load_buffers(self, buffers) -> "Module":
+        """Set buffers (e.g. BN running stats) from a nested dict of the
+        same structure as :meth:`buffers` (in place)."""
+        for n in self._buffers:
+            if n in buffers:
+                self._buffers[n] = jnp.asarray(buffers[n])
+        for n, v in self._modules.items():
+            if isinstance(v, ModuleList):
+                for i, m in enumerate(v._items):
+                    key = f"{n}[{i}]"
+                    if key in buffers:
+                        m.load_buffers(buffers[key])
+            elif n in buffers:
+                v.load_buffers(buffers[n])
+        return self
+
     # -- freezing / lr scale (reference freeze/unfreeze, scaleW/scaleB) ----
 
     def freeze(self, *names: str) -> "Module":
@@ -535,11 +551,42 @@ def _param_flags(obj) -> List[bool]:
     return flags
 
 
+def param_paths(mod: Module) -> List[str]:
+    """Dotted paths of trainable params, aligned with the flattened leaf
+    order of ``partition(mod)[0]`` (frozen modules excluded)."""
+    paths: List[str] = []
+
+    def rec(obj, prefix):
+        if isinstance(obj, Module):
+            if not obj.is_frozen():
+                for n in obj._params:
+                    paths.append(f"{prefix}.{n}" if prefix else n)
+            for n in obj._modules:
+                rec(obj._modules[n], f"{prefix}.{n}" if prefix else n)
+        elif isinstance(obj, ModuleList):
+            for i, m in enumerate(obj._items):
+                rec(m, f"{prefix}[{i}]")
+
+    rec(mod, "")
+    return paths
+
+
 def combine(a, b):
     """Merge two same-structure trees, taking the non-None leaf."""
     return jax.tree_util.tree_map(
         lambda x, y: x if x is not None else y, a, b,
         is_leaf=lambda x: x is None)
+
+
+def cast_floating(tree, dtype):
+    """Cast every floating-point array leaf to dtype (mixed-precision
+    helper: bf16 compute ≙ the reference's FP16 wire compression,
+    parameters/FP16CompressedTensor.scala — but end-to-end)."""
+    def cast(x):
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
+            return x.astype(dtype)
+        return x
+    return jax.tree_util.tree_map(cast, tree)
 
 
 def tree_map_params(fn: Callable, mod: Module) -> Module:

@@ -1,0 +1,8 @@
+from bigdl_tpu.dataset.dataset import (
+    DataSet, LocalDataSet, DistributedDataSet, MiniBatch, Sample,
+)
+from bigdl_tpu.dataset.transformer import (
+    Transformer, SampleToMiniBatch, Identity as IdentityTransformer,
+)
+from bigdl_tpu.dataset import image
+from bigdl_tpu.dataset import text

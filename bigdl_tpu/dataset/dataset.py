@@ -1,0 +1,155 @@
+"""DataSet / Sample / MiniBatch abstractions.
+
+Reference: dataset/DataSet.scala:53-374 (AbstractDataSet, LocalDataSet,
+DistributedDataSet, CachedDistriDataSet, factories), dataset/Sample.scala,
+dataset/MiniBatch.scala.
+
+TPU-native design: data lives host-side as numpy; each *host* owns a
+shard of the global dataset (jax.process_index-keyed slice, replacing the
+reference's Spark-partition caching, DataSet.scala:247).  A MiniBatch is
+the per-step global batch; the Optimizer shards it over the mesh's data
+axis with jax.device_put so each chip reads only its slice.  Shuffling is
+a host-side permutation re-drawn each epoch (≙ CachedDistriDataSet
+shuffle, DataSet.scala:260).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["Sample", "MiniBatch", "DataSet", "LocalDataSet",
+           "DistributedDataSet"]
+
+
+class Sample:
+    """One training example: feature tensor(s) + label tensor(s)
+    (reference dataset/Sample.scala ArraySample)."""
+
+    __slots__ = ("feature", "label")
+
+    def __init__(self, feature, label=None):
+        self.feature = feature
+        self.label = label
+
+    def __repr__(self):
+        f = getattr(self.feature, "shape", None)
+        l = getattr(self.label, "shape", None)
+        return f"Sample(feature={f}, label={l})"
+
+
+class MiniBatch:
+    """A batch of stacked features/labels (reference
+    dataset/MiniBatch.scala:34; ``slice`` supported via indexing)."""
+
+    def __init__(self, input, target=None):
+        self.input = input
+        self.target = target
+
+    def get_input(self):
+        return self.input
+
+    def get_target(self):
+        return self.target
+
+    def size(self) -> int:
+        x = self.input[0] if isinstance(self.input, (tuple, list)) \
+            else self.input
+        return x.shape[0]
+
+    def slice(self, offset: int, length: int) -> "MiniBatch":
+        """1-based offset slice (reference MiniBatch.slice)."""
+        def sl(t):
+            if isinstance(t, (tuple, list)):
+                return type(t)(sl(e) for e in t)
+            return t[offset - 1: offset - 1 + length]
+        return MiniBatch(sl(self.input),
+                         None if self.target is None else sl(self.target))
+
+
+class DataSet:
+    """Factory namespace (reference DataSet object, DataSet.scala:326)."""
+
+    @staticmethod
+    def array(data: Sequence, shuffle: bool = True) -> "LocalDataSet":
+        return LocalDataSet(list(data), shuffle=shuffle)
+
+    @staticmethod
+    def sharded(data: Sequence, shuffle: bool = True,
+                process_index: Optional[int] = None,
+                process_count: Optional[int] = None) -> "DistributedDataSet":
+        """Per-host shard of a global dataset (≙ DataSet.rdd)."""
+        return DistributedDataSet(list(data), shuffle=shuffle,
+                                  process_index=process_index,
+                                  process_count=process_count)
+
+    @staticmethod
+    def image_folder(path: str, shuffle: bool = True) -> "LocalDataSet":
+        """Load a class-per-subdirectory image tree
+        (≙ DataSet.ImageFolder, DataSet.scala:425)."""
+        from bigdl_tpu.dataset.image import load_image_folder
+        return LocalDataSet(load_image_folder(path), shuffle=shuffle)
+
+
+class LocalDataSet:
+    """Single-host dataset over an in-memory list
+    (reference DataSet.scala:117 LocalDataSet + LocalArrayDataSet)."""
+
+    def __init__(self, data: List, shuffle: bool = True):
+        self._data = data
+        self._shuffle = shuffle
+        self._transformers = []
+        self._rng = np.random.default_rng(0)
+
+    def transform(self, transformer) -> "LocalDataSet":
+        """Append a Transformer stage (reference ``dataset -> transformer``)."""
+        out = self.__class__(self._data, self._shuffle)
+        out._transformers = self._transformers + [transformer]
+        out._rng = self._rng
+        return out
+
+    def __rshift__(self, transformer):
+        return self.transform(transformer)
+
+    def size(self) -> int:
+        return len(self._data)
+
+    def shuffle(self):
+        self._rng.shuffle(self._data)
+
+    def data(self, train: bool = True) -> Iterator:
+        """One pass (epoch) iterator; shuffled when train."""
+        order = np.arange(len(self._data))
+        if train and self._shuffle:
+            order = self._rng.permutation(len(self._data))
+        it = (self._data[i] for i in order)
+        for t in self._transformers:
+            it = t(it)
+        return it
+
+
+class DistributedDataSet(LocalDataSet):
+    """Each host holds its process's shard (reference
+    DistributedDataSet/CachedDistriDataSet, DataSet.scala:171,247).
+    Shard assignment: round-robin by global index so per-host sizes are
+    balanced; with one process this degrades to LocalDataSet."""
+
+    def __init__(self, data: List, shuffle: bool = True,
+                 process_index: Optional[int] = None,
+                 process_count: Optional[int] = None):
+        if process_index is None:
+            try:
+                import jax
+                process_index = jax.process_index()
+                process_count = jax.process_count()
+            except Exception:
+                process_index, process_count = 0, 1
+        self.process_index = process_index
+        self.process_count = process_count or 1
+        shard = data[process_index::self.process_count]
+        super().__init__(shard, shuffle)
+        self._global_size = len(data)
+
+    def size(self) -> int:
+        return self._global_size

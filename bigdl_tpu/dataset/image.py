@@ -1,0 +1,168 @@
+"""Host-side image pipeline stages (numpy; run before device transfer).
+
+Reference: dataset/image/*.scala (BytesToGreyImg, GreyImgNormalizer,
+GreyImgToBatch, BGRImgNormalizer, BGRImgCropper, HFlip, ColorJitter,
+Lighting) and the MNIST/CIFAR loaders under models/lenet/Utils.scala,
+models/resnet/Utils.scala.
+
+These are CPU input-side transforms — on TPU the goal is zero host
+compute *inside the step*, so everything here happens in the input
+pipeline thread, producing ready NHWC float arrays.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+from typing import Iterator, Optional, Tuple
+
+import numpy as np
+
+from bigdl_tpu.dataset.dataset import Sample
+from bigdl_tpu.dataset.transformer import Transformer
+
+__all__ = [
+    "GreyImgNormalizer", "BGRImgNormalizer", "HFlip", "RandomCrop",
+    "CenterCrop", "ChannelNormalize", "load_mnist", "load_image_folder",
+]
+
+
+class GreyImgNormalizer(Transformer):
+    """(x - mean) / std on grey images (reference
+    dataset/image/GreyImgNormalizer.scala)."""
+
+    def __init__(self, mean: float, std: float):
+        self.mean, self.std = mean, std
+
+    def apply(self, it):
+        for s in it:
+            yield Sample((np.asarray(s.feature, np.float32) - self.mean)
+                         / self.std, s.label)
+
+
+class BGRImgNormalizer(Transformer):
+    """Per-channel normalize (reference dataset/image/BGRImgNormalizer.scala);
+    channel-last."""
+
+    def __init__(self, means: Tuple[float, ...], stds: Tuple[float, ...]):
+        self.means = np.asarray(means, np.float32)
+        self.stds = np.asarray(stds, np.float32)
+
+    def apply(self, it):
+        for s in it:
+            yield Sample((np.asarray(s.feature, np.float32) - self.means)
+                         / self.stds, s.label)
+
+
+ChannelNormalize = BGRImgNormalizer
+
+
+class HFlip(Transformer):
+    """Random horizontal flip (reference dataset/image/HFlip.scala)."""
+
+    def __init__(self, p: float = 0.5, seed: int = 0):
+        self.p = p
+        self._rng = np.random.default_rng(seed)
+
+    def apply(self, it):
+        for s in it:
+            f = np.asarray(s.feature)
+            if self._rng.random() < self.p:
+                f = f[:, ::-1].copy()
+            yield Sample(f, s.label)
+
+
+class RandomCrop(Transformer):
+    """Random crop with optional zero padding (reference
+    dataset/image/BGRImgRdmCropper.scala)."""
+
+    def __init__(self, crop_h: int, crop_w: int, padding: int = 0,
+                 seed: int = 0):
+        self.crop_h, self.crop_w, self.padding = crop_h, crop_w, padding
+        self._rng = np.random.default_rng(seed)
+
+    def apply(self, it):
+        for s in it:
+            f = np.asarray(s.feature)
+            if self.padding:
+                f = np.pad(f, ((self.padding, self.padding),
+                               (self.padding, self.padding), (0, 0)))
+            y = self._rng.integers(0, f.shape[0] - self.crop_h + 1)
+            x = self._rng.integers(0, f.shape[1] - self.crop_w + 1)
+            yield Sample(f[y:y + self.crop_h, x:x + self.crop_w], s.label)
+
+
+class CenterCrop(Transformer):
+    def __init__(self, crop_h: int, crop_w: int):
+        self.crop_h, self.crop_w = crop_h, crop_w
+
+    def apply(self, it):
+        for s in it:
+            f = np.asarray(s.feature)
+            y = (f.shape[0] - self.crop_h) // 2
+            x = (f.shape[1] - self.crop_w) // 2
+            yield Sample(f[y:y + self.crop_h, x:x + self.crop_w], s.label)
+
+
+def load_mnist(folder: str, kind: str = "train"):
+    """Read IDX-format MNIST files (reference models/lenet/Utils.scala
+    load + dataset/image/BytesToGreyImg.scala).  Returns Samples with
+    [28,28,1] float features and 1-based labels.  Falls back to a
+    deterministic synthetic set when files are absent (CI / no-network)."""
+    prefix = "train" if kind == "train" else "t10k"
+    img_path = os.path.join(folder, f"{prefix}-images-idx3-ubyte")
+    lbl_path = os.path.join(folder, f"{prefix}-labels-idx1-ubyte")
+
+    def _open(p):
+        if os.path.exists(p):
+            return open(p, "rb")
+        if os.path.exists(p + ".gz"):
+            return gzip.open(p + ".gz", "rb")
+        return None
+
+    fi, fl = _open(img_path), _open(lbl_path)
+    if fi is None or fl is None:
+        return synthetic_mnist(2048 if kind == "train" else 512)
+    with fi, fl:
+        _, n, rows, cols = struct.unpack(">IIII", fi.read(16))
+        images = np.frombuffer(fi.read(), np.uint8).reshape(n, rows, cols, 1)
+        struct.unpack(">II", fl.read(8))
+        labels = np.frombuffer(fl.read(), np.uint8)
+    return [Sample(images[i].astype(np.float32), int(labels[i]) + 1)
+            for i in range(n)]
+
+
+def synthetic_mnist(n: int = 2048, seed: int = 0):
+    """Deterministic MNIST-shaped synthetic digits: class-dependent
+    blob patterns learnable by LeNet, for envs without the dataset."""
+    rng = np.random.default_rng(seed)
+    samples = []
+    for i in range(n):
+        label = i % 10
+        img = rng.normal(16.0, 8.0, size=(28, 28, 1)).astype(np.float32)
+        # class-dependent bright square
+        r, c = divmod(label, 4)
+        img[4 + r * 8:10 + r * 8, 4 + c * 6:10 + c * 6] += 200.0
+        samples.append(Sample(np.clip(img, 0, 255), label + 1))
+    rng.shuffle(samples)
+    return samples
+
+
+def load_image_folder(path: str):
+    """Class-per-subdirectory image tree → Samples (reference
+    DataSet.ImageFolder, DataSet.scala:425).  Uses PIL if available."""
+    samples = []
+    classes = sorted(d for d in os.listdir(path)
+                     if os.path.isdir(os.path.join(path, d)))
+    try:
+        from PIL import Image
+    except ImportError as e:
+        raise RuntimeError("image folder loading needs PIL") from e
+    for ci, cls in enumerate(classes):
+        cdir = os.path.join(path, cls)
+        for fn in sorted(os.listdir(cdir)):
+            img = np.asarray(Image.open(os.path.join(cdir, fn)).convert(
+                "RGB"), np.float32)
+            samples.append(Sample(img, ci + 1))
+    return samples

@@ -1,0 +1,104 @@
+"""Text dataset utilities: tokenization, dictionary, LM sample building.
+
+Reference: dataset/text/*.scala (Tokenizer, Dictionary,
+TextToLabeledSentence, LabeledSentenceToSample, SentenceSplitter) and
+the PTB pipeline in example/languagemodel/PTBWordLM.scala.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from collections import Counter
+from typing import Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from bigdl_tpu.dataset.dataset import Sample
+from bigdl_tpu.dataset.transformer import Transformer
+
+__all__ = ["Tokenizer", "Dictionary", "TextToLabeledSentence",
+           "ptb_batches", "synthetic_ptb"]
+
+
+class Tokenizer(Transformer):
+    """Whitespace/regex word tokenizer (reference dataset/text/Tokenizer
+    uses OpenNLP; a regex tokenizer serves the same pipeline slot)."""
+
+    def __init__(self, pattern: str = r"\w+|[^\w\s]"):
+        self.pattern = re.compile(pattern)
+
+    def apply(self, it):
+        for line in it:
+            yield self.pattern.findall(line.lower())
+
+
+class Dictionary:
+    """Word-frequency vocabulary with index mapping (reference
+    dataset/text/Dictionary.scala).  Indices are 1-based; index
+    ``vocab_size`` is the unknown token."""
+
+    def __init__(self, tokens_iter=None, vocab_size: Optional[int] = None):
+        self.word2idx = {}
+        self.idx2word = []
+        if tokens_iter is not None:
+            counts = Counter()
+            for toks in tokens_iter:
+                counts.update(toks)
+            most = counts.most_common(
+                None if vocab_size is None else vocab_size - 1)
+            for i, (w, _) in enumerate(most):
+                self.word2idx[w] = i + 1
+                self.idx2word.append(w)
+        self.unk_index = len(self.idx2word) + 1
+
+    def vocab_size(self) -> int:
+        return self.unk_index
+
+    def index(self, word: str) -> int:
+        return self.word2idx.get(word, self.unk_index)
+
+    def indices(self, words: Sequence[str]) -> List[int]:
+        return [self.index(w) for w in words]
+
+
+class TextToLabeledSentence(Transformer):
+    """token list → (input ids, shifted target ids)
+    (reference dataset/text/TextToLabeledSentence.scala)."""
+
+    def __init__(self, dictionary: Dictionary):
+        self.dictionary = dictionary
+
+    def apply(self, it):
+        for toks in it:
+            ids = np.asarray(self.dictionary.indices(toks), np.int32)
+            if len(ids) < 2:
+                continue
+            yield Sample(ids[:-1], ids[1:])
+
+
+def ptb_batches(word_ids: np.ndarray, batch_size: int, num_steps: int):
+    """Contiguous LM batching à la PTB (reference
+    example/languagemodel/PTBWordLM.scala readWordsToBatches): reshape the
+    word stream to [batch_size, -1], slide windows of num_steps."""
+    n = len(word_ids) // batch_size
+    data = np.asarray(word_ids[:n * batch_size]).reshape(batch_size, n)
+    batches = []
+    for i in range(0, n - num_steps, num_steps):
+        x = data[:, i:i + num_steps]
+        y = data[:, i + 1:i + num_steps + 1]
+        batches.append((x, y))
+    return batches
+
+
+def synthetic_ptb(n_words: int = 40000, vocab: int = 1000, seed: int = 0):
+    """Markov-chain word stream for LM training without the PTB files."""
+    rng = np.random.default_rng(seed)
+    # sparse transition structure so there is signal to learn
+    trans = rng.integers(1, vocab + 1, size=(vocab + 1, 4))
+    ids = np.empty(n_words, np.int32)
+    ids[0] = 1
+    choices = rng.integers(0, 4, size=n_words)
+    for i in range(1, n_words):
+        ids[i] = trans[ids[i - 1], choices[i]]
+    return ids

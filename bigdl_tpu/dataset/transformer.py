@@ -1,0 +1,112 @@
+"""Transformer pipeline stages.
+
+Reference: dataset/Transformer.scala:44 (``Transformer[A,B]:
+Iterator[A] => Iterator[B]`` composed with ``->``), SampleToMiniBatch
+(:309 with padding params).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterator, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["Transformer", "Identity", "SampleToMiniBatch",
+           "FeatureLabelTransformer"]
+
+
+class Transformer:
+    """Iterator→iterator stage; compose with ``a >> b`` (≙ reference
+    ``a -> b``)."""
+
+    def apply(self, it: Iterator) -> Iterator:
+        raise NotImplementedError
+
+    def __call__(self, it: Iterator) -> Iterator:
+        return self.apply(it)
+
+    def __rshift__(self, other: "Transformer") -> "Transformer":
+        return _Chained(self, other)
+
+
+class _Chained(Transformer):
+    def __init__(self, first: Transformer, second: Transformer):
+        self.first, self.second = first, second
+
+    def apply(self, it):
+        return self.second(self.first(it))
+
+
+class Identity(Transformer):
+    def apply(self, it):
+        return it
+
+
+class FeatureLabelTransformer(Transformer):
+    """Map a function over each Sample's feature (and optionally label)."""
+
+    def __init__(self, feature_fn: Optional[Callable] = None,
+                 label_fn: Optional[Callable] = None):
+        self.feature_fn = feature_fn
+        self.label_fn = label_fn
+
+    def apply(self, it):
+        from bigdl_tpu.dataset.dataset import Sample
+        for s in it:
+            f = self.feature_fn(s.feature) if self.feature_fn else s.feature
+            l = self.label_fn(s.label) if self.label_fn else s.label
+            yield Sample(f, l)
+
+
+def _pad_to(arr: np.ndarray, shape, value):
+    pads = [(0, t - s) for s, t in zip(arr.shape, shape)]
+    return np.pad(arr, pads, constant_values=value)
+
+
+class SampleToMiniBatch(Transformer):
+    """Group Samples into MiniBatches (reference
+    dataset/SampleToMiniBatch, Transformer.scala:309).
+
+    With ``padding_value`` set, variable-length features in a batch are
+    right-padded to the batch max (≙ PaddingParam).  ``drop_last`` keeps
+    every batch the same size — required for static XLA shapes; the
+    default True differs from the reference (which emits a ragged tail)
+    because a changing batch shape would retrace the step function.
+    """
+
+    def __init__(self, batch_size: int, padding_value: Optional[float] = None,
+                 drop_last: bool = True):
+        self.batch_size = batch_size
+        self.padding_value = padding_value
+        self.drop_last = drop_last
+
+    def apply(self, it):
+        from bigdl_tpu.dataset.dataset import MiniBatch
+        buf = []
+        for s in it:
+            buf.append(s)
+            if len(buf) == self.batch_size:
+                yield self._collate(buf, MiniBatch)
+                buf = []
+        if buf and not self.drop_last:
+            yield self._collate(buf, MiniBatch)
+
+    def _collate(self, samples, MiniBatch):
+        feats = [np.asarray(s.feature) for s in samples]
+        if self.padding_value is not None:
+            target_shape = tuple(
+                max(f.shape[i] for f in feats)
+                for i in range(feats[0].ndim))
+            feats = [_pad_to(f, target_shape, self.padding_value)
+                     for f in feats]
+        x = np.stack(feats)
+        y = None
+        if samples[0].label is not None:
+            labels = [np.asarray(s.label) for s in samples]
+            if self.padding_value is not None and labels[0].ndim > 0:
+                tshape = tuple(max(l.shape[i] for l in labels)
+                               for i in range(labels[0].ndim))
+                labels = [_pad_to(l, tshape, self.padding_value)
+                          for l in labels]
+            y = np.stack(labels)
+        return MiniBatch(x, y)

@@ -1,0 +1,95 @@
+"""Inception v1 (GoogLeNet) — reference models/inception/Inception_v1.scala.
+
+NHWC; each inception module is four parallel towers concatenated on the
+channel axis (reference's Concat(2) over NCHW ⇒ channel-last concat here).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.core import init as init_methods
+from bigdl_tpu.core.module import Module
+
+__all__ = ["Inception_v1", "inception_module"]
+
+
+def _conv(nin, nout, kw, kh, sw=1, sh=1, pw=0, ph=0, name=""):
+    c = nn.SpatialConvolution(
+        nin, nout, kw, kh, sw, sh, pw, ph,
+        init_method=init_methods.Xavier)
+    if name:
+        c.set_name(name)
+    return c
+
+
+class InceptionModule(Module):
+    """One inception block (reference Inception_v1.scala inception())."""
+
+    def __init__(self, input_size, c1x1, c3x3r, c3x3, c5x5r, c5x5, pool_proj,
+                 name="inception"):
+        super().__init__()
+        self.b1 = nn.Sequential(_conv(input_size, c1x1, 1, 1), nn.ReLU())
+        self.b2 = nn.Sequential(
+            _conv(input_size, c3x3r, 1, 1), nn.ReLU(),
+            _conv(c3x3r, c3x3, 3, 3, 1, 1, 1, 1), nn.ReLU())
+        self.b3 = nn.Sequential(
+            _conv(input_size, c5x5r, 1, 1), nn.ReLU(),
+            _conv(c5x5r, c5x5, 5, 5, 1, 1, 2, 2), nn.ReLU())
+        self.b4 = nn.Sequential(
+            nn.SpatialMaxPooling(3, 3, 1, 1, 1, 1),
+            _conv(input_size, pool_proj, 1, 1), nn.ReLU())
+        self.set_name(name)
+
+    def forward(self, x):
+        return jnp.concatenate(
+            [self.b1(x), self.b2(x), self.b3(x), self.b4(x)], axis=-1)
+
+
+def inception_module(*args, **kw):
+    return InceptionModule(*args, **kw)
+
+
+class Inception_v1(Module):
+    """GoogLeNet main tower (reference Inception_v1.scala apply; the two
+    aux classifiers are train-time extras the reference enables via
+    hasAuxOutputs — main path here, aux heads optional)."""
+
+    def __init__(self, class_num: int = 1000, has_dropout: bool = True):
+        super().__init__()
+        self.stem = nn.Sequential(
+            _conv(3, 64, 7, 7, 2, 2, 3, 3, "conv1/7x7_s2"), nn.ReLU(),
+            nn.SpatialMaxPooling(3, 3, 2, 2).ceil(),
+            nn.SpatialCrossMapLRN(5, 0.0001, 0.75),
+            _conv(64, 64, 1, 1, name="conv2/3x3_reduce"), nn.ReLU(),
+            _conv(64, 192, 3, 3, 1, 1, 1, 1, "conv2/3x3"), nn.ReLU(),
+            nn.SpatialCrossMapLRN(5, 0.0001, 0.75),
+            nn.SpatialMaxPooling(3, 3, 2, 2).ceil())
+        self.i3a = InceptionModule(192, 64, 96, 128, 16, 32, 32, "3a")
+        self.i3b = InceptionModule(256, 128, 128, 192, 32, 96, 64, "3b")
+        self.pool3 = nn.SpatialMaxPooling(3, 3, 2, 2).ceil()
+        self.i4a = InceptionModule(480, 192, 96, 208, 16, 48, 64, "4a")
+        self.i4b = InceptionModule(512, 160, 112, 224, 24, 64, 64, "4b")
+        self.i4c = InceptionModule(512, 128, 128, 256, 24, 64, 64, "4c")
+        self.i4d = InceptionModule(512, 112, 144, 288, 32, 64, 64, "4d")
+        self.i4e = InceptionModule(528, 256, 160, 320, 32, 128, 128, "4e")
+        self.pool4 = nn.SpatialMaxPooling(3, 3, 2, 2).ceil()
+        self.i5a = InceptionModule(832, 256, 160, 320, 32, 128, 128, "5a")
+        self.i5b = InceptionModule(832, 384, 192, 384, 48, 128, 128, "5b")
+        self.has_dropout = has_dropout
+        if has_dropout:
+            self.dropout = nn.Dropout(0.4)
+        self.head = nn.Linear(1024, class_num)
+
+    def forward(self, x):
+        y = self.stem(x)
+        y = self.pool3(self.i3b(self.i3a(y)))
+        y = self.i4e(self.i4d(self.i4c(self.i4b(self.i4a(y)))))
+        y = self.pool4(y)
+        y = self.i5b(self.i5a(y))
+        y = jnp.mean(y, axis=(1, 2))
+        if self.has_dropout and self.training:
+            y = self.dropout(y)
+        return jax.nn.log_softmax(self.head(y))

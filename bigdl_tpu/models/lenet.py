@@ -1,0 +1,45 @@
+"""LeNet-5 (reference models/lenet/LeNet5.scala:26 apply, :42 graph)."""
+
+from __future__ import annotations
+
+import bigdl_tpu.nn as nn
+
+__all__ = ["LeNet5", "lenet5_graph"]
+
+
+def LeNet5(class_num: int = 10) -> nn.Sequential:
+    """Sequential LeNet-5 (LeNet5.scala:26): conv5x5x6 → tanh → pool →
+    conv5x5x12 → tanh → pool → fc100 → tanh → fc{classes} → logsoftmax.
+    NHWC [batch, 28, 28, 1] input."""
+    return nn.Sequential(
+        nn.Reshape((28, 28, 1), batch_mode=True),
+        nn.SpatialConvolution(1, 6, 5, 5).set_name("conv1_5x5"),
+        nn.Tanh(),
+        nn.SpatialMaxPooling(2, 2, 2, 2),
+        nn.SpatialConvolution(6, 12, 5, 5).set_name("conv2_5x5"),
+        nn.Tanh(),
+        nn.SpatialMaxPooling(2, 2, 2, 2),
+        nn.Flatten(),
+        nn.Linear(12 * 4 * 4, 100).set_name("fc1"),
+        nn.Tanh(),
+        nn.Linear(100, class_num).set_name("fc2"),
+        nn.LogSoftMax(),
+    )
+
+
+def lenet5_graph(class_num: int = 10) -> nn.Graph:
+    """Graph-container variant (LeNet5.scala:42 graph())."""
+    inp = nn.Input()
+    x = nn.Reshape((28, 28, 1), batch_mode=True)(inp)
+    x = nn.SpatialConvolution(1, 6, 5, 5)(x)
+    x = nn.Tanh()(x)
+    x = nn.SpatialMaxPooling(2, 2, 2, 2)(x)
+    x = nn.SpatialConvolution(6, 12, 5, 5)(x)
+    x = nn.Tanh()(x)
+    x = nn.SpatialMaxPooling(2, 2, 2, 2)(x)
+    x = nn.Flatten()(x)
+    x = nn.Linear(12 * 4 * 4, 100)(x)
+    x = nn.Tanh()(x)
+    x = nn.Linear(100, class_num)(x)
+    out = nn.LogSoftMax()(x)
+    return nn.Graph(inp, out)

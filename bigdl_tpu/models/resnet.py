@@ -1,0 +1,136 @@
+"""ResNet for CIFAR-10 (basic blocks) and ImageNet (bottleneck, ResNet-50).
+
+Reference: models/resnet/ResNet.scala (shortcutType A/B, basicBlock,
+bottleneck, iChannels plumbing) and TrainImageNet.scala.  NHWC layout,
+MSRA init for convs, BN gamma-last-zero trick (optimnet in the reference
+README recipe) supported via ``zero_init_residual``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.core import init as init_methods
+from bigdl_tpu.core.module import Module
+
+__all__ = ["ResNet", "resnet_cifar", "resnet50", "BasicBlock", "Bottleneck"]
+
+
+def _conv(nin, nout, k, stride=1, pad=0):
+    return nn.SpatialConvolution(
+        nin, nout, k, k, stride, stride, pad, pad, with_bias=False,
+        init_method=init_methods.MsraFiller(False))
+
+
+class BasicBlock(Module):
+    """3x3+3x3 residual block (reference ResNet.scala basicBlock)."""
+
+    expansion = 1
+
+    def __init__(self, nin, nout, stride=1, zero_init_residual=True):
+        super().__init__()
+        self.conv1 = _conv(nin, nout, 3, stride, 1)
+        self.bn1 = nn.SpatialBatchNormalization(nout)
+        self.conv2 = _conv(nout, nout, 3, 1, 1)
+        self.bn2 = nn.SpatialBatchNormalization(
+            nout, init_weight=(jnp.zeros(nout) if zero_init_residual
+                               else None))
+        if stride != 1 or nin != nout:
+            self.down_conv = _conv(nin, nout, 1, stride, 0)
+            self.down_bn = nn.SpatialBatchNormalization(nout)
+        self.has_down = stride != 1 or nin != nout
+
+    def forward(self, x):
+        import jax
+        y = jax.nn.relu(self.bn1(self.conv1(x)))
+        y = self.bn2(self.conv2(y))
+        sc = self.down_bn(self.down_conv(x)) if self.has_down else x
+        return jax.nn.relu(y + sc)
+
+
+class Bottleneck(Module):
+    """1x1/3x3/1x1 bottleneck (reference ResNet.scala bottleneck)."""
+
+    expansion = 4
+
+    def __init__(self, nin, planes, stride=1, zero_init_residual=True):
+        super().__init__()
+        nout = planes * self.expansion
+        self.conv1 = _conv(nin, planes, 1)
+        self.bn1 = nn.SpatialBatchNormalization(planes)
+        self.conv2 = _conv(planes, planes, 3, stride, 1)
+        self.bn2 = nn.SpatialBatchNormalization(planes)
+        self.conv3 = _conv(planes, nout, 1)
+        self.bn3 = nn.SpatialBatchNormalization(
+            nout, init_weight=(jnp.zeros(nout) if zero_init_residual
+                               else None))
+        if stride != 1 or nin != nout:
+            self.down_conv = _conv(nin, nout, 1, stride, 0)
+            self.down_bn = nn.SpatialBatchNormalization(nout)
+        self.has_down = stride != 1 or nin != nout
+
+    def forward(self, x):
+        import jax
+        y = jax.nn.relu(self.bn1(self.conv1(x)))
+        y = jax.nn.relu(self.bn2(self.conv2(y)))
+        y = self.bn3(self.conv3(y))
+        sc = self.down_bn(self.down_conv(x)) if self.has_down else x
+        return jax.nn.relu(y + sc)
+
+
+class ResNet(Module):
+    """Reference ResNet.scala apply(): ImageNet stem + 4 stages."""
+
+    def __init__(self, block, layers, class_num=1000, cifar=False,
+                 zero_init_residual=True):
+        super().__init__()
+        self.cifar = cifar
+        if cifar:
+            self.stem_conv = _conv(3, 16, 3, 1, 1)
+            self.stem_bn = nn.SpatialBatchNormalization(16)
+            nin = 16
+            widths = [16, 32, 64]
+            strides = [1, 2, 2]
+        else:
+            self.stem_conv = nn.SpatialConvolution(
+                3, 64, 7, 7, 2, 2, 3, 3, with_bias=False,
+                init_method=init_methods.MsraFiller(False))
+            self.stem_bn = nn.SpatialBatchNormalization(64)
+            self.stem_pool = nn.SpatialMaxPooling(3, 3, 2, 2, 1, 1)
+            nin = 64
+            widths = [64, 128, 256, 512]
+            strides = [1, 2, 2, 2]
+        blocks = []
+        for w, s, n in zip(widths, strides, layers):
+            for i in range(n):
+                blocks.append(block(nin, w, s if i == 0 else 1,
+                                    zero_init_residual))
+                nin = w * block.expansion
+        self.blocks = nn.ModuleList(blocks)
+        self.head = nn.Linear(nin, class_num,
+                              init_method=init_methods.RandomNormal(0, 0.01))
+
+    def forward(self, x):
+        import jax
+        y = jax.nn.relu(self.stem_bn(self.stem_conv(x)))
+        if not self.cifar:
+            y = self.stem_pool(y)
+        for b in self.blocks:
+            y = b(y)
+        y = jnp.mean(y, axis=(1, 2))  # global average pool
+        return self.head(y)
+
+
+def resnet_cifar(depth: int = 20, class_num: int = 10) -> ResNet:
+    """CIFAR ResNet (reference ResNet.scala CIFAR-10 path): depth=6n+2."""
+    assert (depth - 2) % 6 == 0
+    n = (depth - 2) // 6
+    return ResNet(BasicBlock, [n, n, n], class_num, cifar=True)
+
+
+def resnet50(class_num: int = 1000) -> ResNet:
+    """ImageNet ResNet-50 (reference TrainImageNet recipe)."""
+    return ResNet(Bottleneck, [3, 4, 6, 3], class_num)

@@ -1,0 +1,59 @@
+"""Language models: PTB word-level LSTM LM + char-level SimpleRNN.
+
+Reference: example/languagemodel/PTBModel.scala (embedding → stacked
+LSTM → TimeDistributed Linear → logsoftmax) and models/rnn/SimpleRNN.scala
+(char-LM with RnnCell).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.core.module import Module
+
+__all__ = ["PTBModel", "SimpleRNN"]
+
+
+class PTBModel(Module):
+    """Word LM (reference PTBModel.scala): LookupTable → num_layers LSTM
+    → TimeDistributed(Linear) → logsoftmax over vocab.
+
+    Input: [batch, time] 1-based word ids; output [batch, time, vocab]
+    log-probs.
+    """
+
+    def __init__(self, input_size: int, hidden_size: int = 200,
+                 output_size: int = None, num_layers: int = 2,
+                 key_dim: int = 0, dropout: float = 0.0):
+        super().__init__()
+        output_size = output_size or input_size
+        self.embedding = nn.LookupTable(input_size, hidden_size)
+        cells = [nn.LSTM(hidden_size, hidden_size)
+                 for _ in range(num_layers)]
+        self.recurrent = nn.Recurrent(
+            nn.MultiRNNCell(cells) if num_layers > 1 else cells[0])
+        self.dropout_p = dropout
+        if dropout > 0:
+            self.dropout = nn.Dropout(dropout)
+        self.decoder = nn.TimeDistributed(
+            nn.Linear(hidden_size, output_size))
+
+    def forward(self, ids):
+        x = self.embedding(ids)
+        h = self.recurrent(x)
+        if self.dropout_p > 0 and self.training:
+            h = self.dropout(h)
+        return jax.nn.log_softmax(self.decoder(h), axis=-1)
+
+
+def SimpleRNN(input_size: int = 128, hidden_size: int = 128,
+              output_size: int = 128):
+    """Char-level RNN LM (reference models/rnn/SimpleRNN.scala):
+    one-hot input → RnnCell(tanh) → TimeDistributed Linear → logsoftmax."""
+    return nn.Sequential(
+        nn.Recurrent(nn.RnnCell(input_size, hidden_size, nn.Tanh())),
+        nn.TimeDistributed(nn.Linear(hidden_size, output_size)),
+        nn.LogSoftMax(),
+    )

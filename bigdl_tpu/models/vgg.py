@@ -1,0 +1,76 @@
+"""VGG-16/19 + the CIFAR-10 variant.
+
+Reference: models/vgg/VggForCifar10.scala and the Vgg_16/Vgg_19 builders
+used by the perf tool (models/utils/DistriOptimizerPerf.scala).
+"""
+
+from __future__ import annotations
+
+import bigdl_tpu.nn as nn
+
+__all__ = ["VggForCifar10", "Vgg_16", "Vgg_19"]
+
+
+def _block(seq, nin, nout, with_bn=True):
+    seq.add(nn.SpatialConvolution(nin, nout, 3, 3, 1, 1, 1, 1))
+    if with_bn:
+        seq.add(nn.SpatialBatchNormalization(nout, 1e-3))
+    seq.add(nn.ReLU())
+    return nout
+
+
+def VggForCifar10(class_num: int = 10, has_dropout: bool = True):
+    """Conv-BN VGG for 32x32 inputs (reference VggForCifar10.scala)."""
+    m = nn.Sequential()
+    cfg = [(3, 64), (64, 64), "M", (64, 128), (128, 128), "M",
+           (128, 256), (256, 256), (256, 256), "M",
+           (256, 512), (512, 512), (512, 512), "M",
+           (512, 512), (512, 512), (512, 512), "M"]
+    for c in cfg:
+        if c == "M":
+            m.add(nn.SpatialMaxPooling(2, 2, 2, 2).ceil())
+        else:
+            _block(m, c[0], c[1])
+    m.add(nn.Flatten())
+    m.add(nn.Linear(512, 512))
+    m.add(nn.BatchNormalization(512))
+    m.add(nn.ReLU())
+    if has_dropout:
+        m.add(nn.Dropout(0.5))
+    m.add(nn.Linear(512, class_num))
+    m.add(nn.LogSoftMax())
+    return m
+
+
+def _vgg(cfg, class_num, has_dropout=True):
+    m = nn.Sequential()
+    nin = 3
+    for c in cfg:
+        if c == "M":
+            m.add(nn.SpatialMaxPooling(2, 2, 2, 2))
+        else:
+            nin = _block(m, nin, c, with_bn=False)
+    m.add(nn.Flatten())
+    m.add(nn.Linear(512 * 7 * 7, 4096))
+    m.add(nn.ReLU())
+    if has_dropout:
+        m.add(nn.Dropout(0.5))
+    m.add(nn.Linear(4096, 4096))
+    m.add(nn.ReLU())
+    if has_dropout:
+        m.add(nn.Dropout(0.5))
+    m.add(nn.Linear(4096, class_num))
+    m.add(nn.LogSoftMax())
+    return m
+
+
+def Vgg_16(class_num: int = 1000, has_dropout: bool = True):
+    return _vgg([64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
+                 512, 512, 512, "M", 512, 512, 512, "M"],
+                class_num, has_dropout)
+
+
+def Vgg_19(class_num: int = 1000, has_dropout: bool = True):
+    return _vgg([64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M",
+                 512, 512, 512, 512, "M", 512, 512, 512, 512, "M"],
+                class_num, has_dropout)

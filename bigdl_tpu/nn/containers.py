@@ -20,7 +20,7 @@ from bigdl_tpu.core.module import Module, ModuleList
 
 __all__ = [
     "Container", "Sequential", "Concat", "ConcatTable", "ParallelTable",
-    "MapTable", "Bottle", "Node", "Input", "Graph",
+    "MapTable", "Bottle", "Node", "Input", "Graph", "Module", "ModuleList",
 ]
 
 

@@ -1,0 +1,402 @@
+"""Recurrent layers: cells + time-iteration containers.
+
+Reference: nn/Cell.scala (the recurrent-cell contract), nn/RnnCell
+(RNN.scala), nn/LSTM.scala, nn/LSTMPeephole.scala, nn/GRU.scala,
+nn/ConvLSTMPeephole.scala, nn/Recurrent.scala:47 (unrolls a Cell over
+time), nn/BiRecurrent.scala, nn/RecurrentDecoder.scala,
+nn/MultiRNNCell.scala, nn/TimeDistributed.scala.
+
+TPU-first: the reference unrolls time steps in a sequential JVM loop
+(Recurrent.scala:243); here iteration is ``lax.scan``, which XLA compiles
+into a single fused loop with the cell's matmuls on the MXU.  The input
+gate matmul for all timesteps is hoisted out of the scan (one big
+[B*T, 4H] gemm) — the standard TPU trick the reference cannot do.
+
+Layout: [batch, time, feature] (reference batchNormParams default).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.core.module import Module, ModuleList, Parameter, next_rng_key
+from bigdl_tpu.utils.rng import next_key
+
+__all__ = [
+    "Cell", "RnnCell", "LSTM", "LSTMPeephole", "GRU", "ConvLSTMPeephole",
+    "Recurrent", "BiRecurrent", "RecurrentDecoder", "MultiRNNCell",
+    "TimeDistributed",
+]
+
+
+class Cell(Module):
+    """Recurrent cell protocol (reference nn/Cell.scala): ``step(x_t,
+    state) -> (output_t, new_state)`` + ``init_state(batch)``."""
+
+    def init_state(self, batch_size: int, dtype=jnp.float32):
+        raise NotImplementedError
+
+    def step(self, x_t, state):
+        raise NotImplementedError
+
+    def precompute_inputs(self, x):
+        """Optional whole-sequence input projection hoisted out of the
+        scan ([B,T,F] → [B,T,proj]); default identity."""
+        return x
+
+    def step_single(self, x_t, state):
+        """step() on a raw (un-projected) single timestep."""
+        proj = self.precompute_inputs(x_t[:, None])[:, 0]
+        return self.step(proj, state)
+
+    def _input_dropout(self, x, p: float):
+        """Input-connection dropout (the reference cells' ``p`` param,
+        nn/LSTM.scala); applied on the whole sequence before the hoisted
+        projection."""
+        if p <= 0.0 or not self.training:
+            return x
+        keep = jax.random.bernoulli(next_rng_key(), 1.0 - p, x.shape)
+        return jnp.where(keep, x / (1.0 - p), 0.0)
+
+    def forward(self, x, state=None):
+        if state is None:
+            state = self.init_state(x.shape[0], x.dtype)
+        return self.step_single(x, state)
+
+
+class RnnCell(Cell):
+    """Vanilla RNN: h' = act(W x + U h + b) (reference nn/RNN.scala
+    RnnCell)."""
+
+    def __init__(self, input_size: int, hidden_size: int,
+                 activation: Optional[Module] = None,
+                 isInputWithBias: bool = True,
+                 w_regularizer=None, u_regularizer=None, b_regularizer=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        stdv = 1.0 / math.sqrt(hidden_size)
+        self.w_input = Parameter(jax.random.uniform(
+            next_key(), (input_size, hidden_size), minval=-stdv, maxval=stdv))
+        self.w_hidden = Parameter(jax.random.uniform(
+            next_key(), (hidden_size, hidden_size), minval=-stdv, maxval=stdv))
+        self.bias = Parameter(jnp.zeros(hidden_size))
+        self.activation = activation
+
+    def init_state(self, batch_size, dtype=jnp.float32):
+        return jnp.zeros((batch_size, self.hidden_size), dtype)
+
+    def precompute_inputs(self, x):
+        return x @ self.w_input + self.bias
+
+    def step(self, xproj_t, h):
+        pre = xproj_t + h @ self.w_hidden
+        h_new = self.activation(pre) if self.activation is not None \
+            else jnp.tanh(pre)
+        return h_new, h_new
+
+
+class LSTM(Cell):
+    """Standard LSTM (reference nn/LSTM.scala). Gate order i,f,g,o."""
+
+    def __init__(self, input_size: int, hidden_size: int,
+                 p: float = 0.0,
+                 activation: Optional[Module] = None,
+                 inner_activation: Optional[Module] = None,
+                 w_regularizer=None, u_regularizer=None, b_regularizer=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self.p = float(p)
+        stdv = 1.0 / math.sqrt(hidden_size)
+        self.w_input = Parameter(jax.random.uniform(
+            next_key(), (input_size, 4 * hidden_size),
+            minval=-stdv, maxval=stdv))
+        self.w_hidden = Parameter(jax.random.uniform(
+            next_key(), (hidden_size, 4 * hidden_size),
+            minval=-stdv, maxval=stdv))
+        self.bias = Parameter(jnp.zeros(4 * hidden_size))
+        self.activation = activation
+        self.inner_activation = inner_activation
+
+    def init_state(self, batch_size, dtype=jnp.float32):
+        return (jnp.zeros((batch_size, self.hidden_size), dtype),
+                jnp.zeros((batch_size, self.hidden_size), dtype))
+
+    def precompute_inputs(self, x):
+        x = self._input_dropout(x, self.p)
+        return x @ self.w_input + self.bias
+
+    def step(self, xproj_t, state):
+        h, c = state
+        gates = xproj_t + h @ self.w_hidden
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        act = (lambda v: self.activation(v)) if self.activation \
+            else jnp.tanh
+        inner = (lambda v: self.inner_activation(v)) \
+            if self.inner_activation else jax.nn.sigmoid
+        c_new = inner(f) * c + inner(i) * act(g)
+        h_new = inner(o) * act(c_new)
+        return h_new, (h_new, c_new)
+
+
+class LSTMPeephole(Cell):
+    """LSTM with peephole connections from the cell state to the gates
+    (reference nn/LSTMPeephole.scala)."""
+
+    def __init__(self, input_size: int, hidden_size: int, p: float = 0.0,
+                 w_regularizer=None, u_regularizer=None, b_regularizer=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self.p = float(p)
+        stdv = 1.0 / math.sqrt(hidden_size)
+        self.w_input = Parameter(jax.random.uniform(
+            next_key(), (input_size, 4 * hidden_size),
+            minval=-stdv, maxval=stdv))
+        self.w_hidden = Parameter(jax.random.uniform(
+            next_key(), (hidden_size, 4 * hidden_size),
+            minval=-stdv, maxval=stdv))
+        self.bias = Parameter(jnp.zeros(4 * hidden_size))
+        self.peep_i = Parameter(jnp.zeros(hidden_size))
+        self.peep_f = Parameter(jnp.zeros(hidden_size))
+        self.peep_o = Parameter(jnp.zeros(hidden_size))
+
+    def init_state(self, batch_size, dtype=jnp.float32):
+        return (jnp.zeros((batch_size, self.hidden_size), dtype),
+                jnp.zeros((batch_size, self.hidden_size), dtype))
+
+    def precompute_inputs(self, x):
+        x = self._input_dropout(x, self.p)
+        return x @ self.w_input + self.bias
+
+    def step(self, xproj_t, state):
+        h, c = state
+        gates = xproj_t + h @ self.w_hidden
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i = jax.nn.sigmoid(i + self.peep_i * c)
+        f = jax.nn.sigmoid(f + self.peep_f * c)
+        c_new = f * c + i * jnp.tanh(g)
+        o = jax.nn.sigmoid(o + self.peep_o * c_new)
+        h_new = o * jnp.tanh(c_new)
+        return h_new, (h_new, c_new)
+
+
+class GRU(Cell):
+    """GRU (reference nn/GRU.scala). Gate order r,z then candidate."""
+
+    def __init__(self, input_size: int, output_size: int, p: float = 0.0,
+                 w_regularizer=None, u_regularizer=None, b_regularizer=None):
+        super().__init__()
+        self.hidden_size = output_size
+        self.p = float(p)
+        stdv = 1.0 / math.sqrt(output_size)
+        self.w_input = Parameter(jax.random.uniform(
+            next_key(), (input_size, 3 * output_size),
+            minval=-stdv, maxval=stdv))
+        self.w_hidden = Parameter(jax.random.uniform(
+            next_key(), (output_size, 2 * output_size),
+            minval=-stdv, maxval=stdv))
+        self.w_candidate = Parameter(jax.random.uniform(
+            next_key(), (output_size, output_size),
+            minval=-stdv, maxval=stdv))
+        self.bias = Parameter(jnp.zeros(3 * output_size))
+
+    def init_state(self, batch_size, dtype=jnp.float32):
+        return jnp.zeros((batch_size, self.hidden_size), dtype)
+
+    def precompute_inputs(self, x):
+        x = self._input_dropout(x, self.p)
+        return x @ self.w_input + self.bias
+
+    def step(self, xproj_t, h):
+        H = self.hidden_size
+        x_rz, x_g = xproj_t[..., :2 * H], xproj_t[..., 2 * H:]
+        rz = jax.nn.sigmoid(x_rz + h @ self.w_hidden)
+        r, z = jnp.split(rz, 2, axis=-1)
+        g = jnp.tanh(x_g + (r * h) @ self.w_candidate)
+        h_new = (1 - z) * g + z * h
+        return h_new, h_new
+
+
+class ConvLSTMPeephole(Cell):
+    """Convolutional LSTM over NHWC feature maps
+    (reference nn/ConvLSTMPeephole.scala)."""
+
+    def __init__(self, input_size: int, output_size: int,
+                 kernel_i: int = 3, kernel_c: int = 3, stride: int = 1,
+                 padding: int = -1, with_peephole: bool = True,
+                 w_regularizer=None, u_regularizer=None, b_regularizer=None):
+        super().__init__()
+        from bigdl_tpu.nn.conv import SpatialConvolution
+        self.output_size = output_size
+        self.with_peephole = with_peephole
+        # padding=-1 means SAME (the reference's default); an explicit
+        # padding is honored on the input conv.  The hidden conv must be
+        # shape-preserving, so it is always SAME.
+        self.conv_input = SpatialConvolution(
+            input_size, 4 * output_size, kernel_i, kernel_i,
+            stride, stride, padding, padding)
+        self.conv_hidden = SpatialConvolution(
+            output_size, 4 * output_size, kernel_c, kernel_c,
+            1, 1, -1, -1, with_bias=False)
+        if with_peephole:
+            self.peep_i = Parameter(jnp.zeros(output_size))
+            self.peep_f = Parameter(jnp.zeros(output_size))
+            self.peep_o = Parameter(jnp.zeros(output_size))
+
+    def init_state(self, batch_size, dtype=jnp.float32,
+                   spatial: Optional[Tuple[int, int]] = None):
+        if spatial is None:
+            raise ValueError("ConvLSTMPeephole needs spatial dims; pass "
+                             "state explicitly or use Recurrent")
+        h, w = spatial
+        z = jnp.zeros((batch_size, h, w, self.output_size), dtype)
+        return (z, z)
+
+    def precompute_inputs(self, x):
+        b, t = x.shape[0], x.shape[1]
+        flat = x.reshape((b * t,) + x.shape[2:])
+        proj = self.conv_input(flat)
+        return proj.reshape((b, t) + proj.shape[1:])
+
+    def step(self, xproj_t, state):
+        h, c = state
+        gates = xproj_t + self.conv_hidden(h)
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        if self.with_peephole:
+            i = jax.nn.sigmoid(i + self.peep_i * c)
+            f = jax.nn.sigmoid(f + self.peep_f * c)
+        else:
+            i, f = jax.nn.sigmoid(i), jax.nn.sigmoid(f)
+        c_new = f * c + i * jnp.tanh(g)
+        if self.with_peephole:
+            o = jax.nn.sigmoid(o + self.peep_o * c_new)
+        else:
+            o = jax.nn.sigmoid(o)
+        h_new = o * jnp.tanh(c_new)
+        return h_new, (h_new, c_new)
+
+
+class MultiRNNCell(Cell):
+    """Stack of cells applied per timestep (reference nn/MultiRNNCell.scala)."""
+
+    def __init__(self, cells):
+        super().__init__()
+        self.cells = ModuleList(list(cells))
+
+    def init_state(self, batch_size, dtype=jnp.float32):
+        return tuple(c.init_state(batch_size, dtype) for c in self.cells)
+
+    def step(self, x_t, states):
+        new_states = []
+        out = x_t
+        for cell, state in zip(self.cells, states):
+            out, s = cell.step_single(out, state)
+            new_states.append(s)
+        return out, tuple(new_states)
+
+
+class Recurrent(Module):
+    """Iterate a Cell over the time axis of [batch, time, ...] via
+    lax.scan (reference nn/Recurrent.scala:47).  Returns the full output
+    sequence [batch, time, hidden]."""
+
+    def __init__(self, cell: Cell):
+        super().__init__()
+        self.cell = cell
+
+    def forward(self, x, init_state=None):
+        cell = self.cell
+        xproj = cell.precompute_inputs(x)
+        if init_state is None:
+            if isinstance(cell, ConvLSTMPeephole):
+                # hidden state spatial dims follow the (possibly strided)
+                # input projection, not the raw input
+                init_state = cell.init_state(
+                    x.shape[0], x.dtype,
+                    spatial=(xproj.shape[2], xproj.shape[3]))
+            else:
+                init_state = cell.init_state(x.shape[0], x.dtype)
+        xs = jnp.swapaxes(xproj, 0, 1)  # [T, B, ...]
+
+        def body(state, x_t):
+            # single cells consume the hoisted projection; MultiRNNCell's
+            # precompute is identity and it projects per layer inside step
+            out, new_state = cell.step(x_t, state)
+            return new_state, out
+
+        _, outs = jax.lax.scan(body, init_state, xs)
+        return jnp.swapaxes(outs, 0, 1)
+
+
+class BiRecurrent(Module):
+    """Bidirectional wrapper merging forward and time-reversed passes
+    (reference nn/BiRecurrent.scala; default merge = concat)."""
+
+    def __init__(self, merge: Optional[Module] = None, cell: Cell = None,
+                 cell_reverse: Cell = None):
+        super().__init__()
+        # convenience: BiRecurrent(cell) / BiRecurrent(cellA, cellB)
+        if isinstance(merge, Cell):
+            if cell is not None and cell_reverse is None:
+                cell_reverse = cell
+            merge, cell = None, merge
+        if cell is None:
+            raise ValueError("BiRecurrent needs a cell: "
+                             "BiRecurrent(merge, cell=...) or "
+                             "BiRecurrent(cell)")
+        self.fwd = Recurrent(cell)
+        self.bwd = Recurrent(cell_reverse if cell_reverse is not None
+                             else cell.clone())
+        if merge is not None:
+            self.merge = merge
+        self.use_concat = merge is None
+
+    def forward(self, x):
+        f = self.fwd(x)
+        b = jnp.flip(self.bwd(jnp.flip(x, axis=1)), axis=1)
+        if self.use_concat:
+            return jnp.concatenate([f, b], axis=-1)
+        return self.merge((f, b))
+
+
+class RecurrentDecoder(Module):
+    """Autoregressive unroll feeding the output back as the next input
+    for ``output_length`` steps (reference nn/RecurrentDecoder.scala).
+    Input: the first-step input [batch, ...]."""
+
+    def __init__(self, output_length: int, cell: Cell = None):
+        super().__init__()
+        self.output_length = output_length
+        self.cell = cell
+
+    def forward(self, x, init_state=None):
+        cell = self.cell
+        if init_state is None:
+            init_state = cell.init_state(x.shape[0], x.dtype)
+
+        def body(carry, _):
+            inp, state = carry
+            out, new_state = cell.step_single(inp, state)
+            return (out, new_state), out
+
+        (_, _), outs = jax.lax.scan(
+            body, (x, init_state), None, length=self.output_length)
+        return jnp.swapaxes(outs, 0, 1)
+
+
+class TimeDistributed(Module):
+    """Apply a module independently at every timestep by folding time
+    into batch (reference nn/TimeDistributed.scala)."""
+
+    def __init__(self, layer: Module):
+        super().__init__()
+        self.layer = layer
+
+    def forward(self, x):
+        b, t = x.shape[0], x.shape[1]
+        flat = x.reshape((b * t,) + x.shape[2:])
+        y = self.layer(flat)
+        return y.reshape((b, t) + y.shape[1:])

@@ -1,0 +1,12 @@
+from bigdl_tpu.optim.methods import (
+    OptimMethod, SGD, Adam, ParallelAdam, Adagrad, Adadelta, Adamax,
+    RMSprop, Ftrl, LarsSGD, LBFGS,
+    Default, Step, MultiStep, EpochStep, EpochDecay, Poly, Exponential,
+    NaturalExp, Warmup, SequentialSchedule, Plateau, EpochSchedule,
+)
+from bigdl_tpu.optim.trigger import Trigger
+from bigdl_tpu.optim.validation import (
+    ValidationMethod, ValidationResult, Top1Accuracy, Top5Accuracy,
+    TopKAccuracy, Loss, MAE, HitRatio, NDCG,
+)
+from bigdl_tpu.optim.optimizer import Optimizer

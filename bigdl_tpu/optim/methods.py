@@ -1,0 +1,604 @@
+"""Optimization methods (parameter update rules).
+
+Reference: optim/OptimMethod.scala (state-table contract), optim/SGD.scala
+(+ the LearningRateSchedule zoo, SGD.scala:233-690), Adam.scala,
+Adagrad.scala, Adadelta.scala, Adamax.scala, RMSprop.scala, Ftrl.scala,
+LBFGS.scala, LarsSGD.scala, ParallelAdam.scala.
+
+TPU-native design: each method is a pure pytree-to-pytree transform —
+``init_state(params)`` then ``update(grads, params, state) -> (params,
+state)`` — fully jit-compatible so the whole update fuses into the train
+step (the reference's ParallelAdam multi-thread chunking is XLA's job).
+Scalar hyper-state (evalCounter, epoch) lives in ``state['t']`` etc. as
+traced scalars.  LR schedules are pure functions of the step/epoch
+carried in the state dict.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "OptimMethod", "SGD", "Adam", "ParallelAdam", "Adagrad", "Adadelta",
+    "Adamax", "RMSprop", "Ftrl", "LarsSGD", "LBFGS",
+    "Default", "Step", "MultiStep", "EpochStep", "EpochDecay", "Poly",
+    "Exponential", "NaturalExp", "Warmup", "SequentialSchedule", "Plateau",
+    "EpochSchedule", "Regime",
+]
+
+
+def _tmap(f, *trees):
+    return jax.tree_util.tree_map(f, *trees)
+
+
+# --------------------------------------------------------------------------
+# Learning rate schedules (reference SGD.scala:233-690)
+# --------------------------------------------------------------------------
+
+class LearningRateSchedule:
+    """lr(base_lr, step, epoch) -> scalar; pure function of progress."""
+
+    def __call__(self, base_lr, step, epoch):
+        raise NotImplementedError
+
+
+class Default(LearningRateSchedule):
+    """lr / (1 + step*decay) (reference SGD.Default)."""
+
+    def __init__(self, learning_rate_decay: float = 0.0):
+        self.decay = learning_rate_decay
+
+    def __call__(self, base_lr, step, epoch):
+        return base_lr / (1.0 + step * self.decay)
+
+
+class Step(LearningRateSchedule):
+    """lr * gamma^(floor(step/step_size)) (reference SGD.Step)."""
+
+    def __init__(self, step_size: int, gamma: float):
+        self.step_size, self.gamma = step_size, gamma
+
+    def __call__(self, base_lr, step, epoch):
+        return base_lr * jnp.power(self.gamma, jnp.floor(step / self.step_size))
+
+
+class MultiStep(LearningRateSchedule):
+    """lr * gamma^(#milestones passed) (reference SGD.MultiStep)."""
+
+    def __init__(self, step_sizes, gamma: float):
+        self.step_sizes = tuple(step_sizes)
+        self.gamma = gamma
+
+    def __call__(self, base_lr, step, epoch):
+        passed = sum(jnp.where(step >= s, 1.0, 0.0) for s in self.step_sizes)
+        return base_lr * jnp.power(self.gamma, passed)
+
+
+class EpochStep(LearningRateSchedule):
+    """lr * gamma^(floor(epoch/step_size)) (reference SGD.EpochStep)."""
+
+    def __init__(self, step_size: int, gamma: float):
+        self.step_size, self.gamma = step_size, gamma
+
+    def __call__(self, base_lr, step, epoch):
+        return base_lr * jnp.power(self.gamma,
+                                   jnp.floor(epoch / self.step_size))
+
+
+class EpochDecay(LearningRateSchedule):
+    """lr * 0.1^decay_fn(epoch); decay_fn is a host-side python fn
+    (reference SGD.EpochDecay)."""
+
+    def __init__(self, decay_fn: Callable[[int], float]):
+        self.decay_fn = decay_fn
+
+    def __call__(self, base_lr, step, epoch):
+        # epoch may be traced; decay_fn must be jnp-friendly
+        return base_lr * jnp.power(0.1, self.decay_fn(epoch))
+
+
+class Poly(LearningRateSchedule):
+    """lr * (1 - step/max_iteration)^power, 0 past max
+    (reference SGD.Poly)."""
+
+    def __init__(self, power: float, max_iteration: int):
+        self.power, self.max_iteration = power, max_iteration
+
+    def __call__(self, base_lr, step, epoch):
+        frac = jnp.clip(step / self.max_iteration, 0.0, 1.0)
+        return base_lr * jnp.power(1.0 - frac, self.power)
+
+
+class Exponential(LearningRateSchedule):
+    """lr * decay_rate^(step/decay_step), optionally staircased
+    (reference SGD.Exponential)."""
+
+    def __init__(self, decay_step: int, decay_rate: float,
+                 stair_case: bool = False):
+        self.decay_step, self.decay_rate = decay_step, decay_rate
+        self.stair_case = stair_case
+
+    def __call__(self, base_lr, step, epoch):
+        p = step / self.decay_step
+        if self.stair_case:
+            p = jnp.floor(p)
+        return base_lr * jnp.power(self.decay_rate, p)
+
+
+class NaturalExp(LearningRateSchedule):
+    """lr * exp(-gamma * floor(step/decay_step))
+    (reference SGD.NaturalExp)."""
+
+    def __init__(self, decay_step: int, gamma: float):
+        self.decay_step, self.gamma = decay_step, gamma
+
+    def __call__(self, base_lr, step, epoch):
+        return base_lr * jnp.exp(-self.gamma * jnp.floor(
+            step / self.decay_step))
+
+
+class Warmup(LearningRateSchedule):
+    """Linear ramp by delta per step (composed inside SequentialSchedule;
+    reference SGD.Warmup)."""
+
+    def __init__(self, delta: float):
+        self.delta = delta
+
+    def __call__(self, base_lr, step, epoch):
+        return base_lr + self.delta * step
+
+
+class SequentialSchedule(LearningRateSchedule):
+    """Chain schedules, each active for its iteration budget
+    (reference SGD.SequentialSchedule)."""
+
+    def __init__(self, iteration_per_epoch: int = 1):
+        self.entries = []  # (schedule, max_iter)
+        self.iteration_per_epoch = iteration_per_epoch
+
+    def add(self, schedule: LearningRateSchedule, max_iteration: int):
+        self.entries.append((schedule, max_iteration))
+        return self
+
+    def __call__(self, base_lr, step, epoch):
+        lr = base_lr
+        offset = 0
+        out = None
+        remaining = step
+        for sched, budget in self.entries:
+            local = jnp.clip(step - offset, 0, budget)
+            val = sched(base_lr, local, epoch)
+            active = (step >= offset) & (step < offset + budget)
+            out = val if out is None else jnp.where(active, val, out)
+            # after this stage completes, hand the final lr to later logic
+            base_lr_after = sched(base_lr, budget, epoch)
+            base_lr = jnp.where(step >= offset + budget,
+                                base_lr_after, base_lr)
+            offset += budget
+        # past the last stage: keep the last stage's final value
+        return jnp.where(step >= offset, base_lr, out)
+
+
+class Plateau(LearningRateSchedule):
+    """Reduce LR when a monitored metric stops improving (reference
+    SGD.Plateau).  Host-side stateful: the Optimizer calls
+    ``on_epoch_end(metric)``; __call__ returns the current factor-adjusted
+    lr."""
+
+    def __init__(self, monitor: str = "score", factor: float = 0.1,
+                 patience: int = 10, mode: str = "min", epsilon: float = 1e-4,
+                 cooldown: int = 0, min_lr: float = 0.0):
+        self.monitor, self.factor, self.patience = monitor, factor, patience
+        self.mode, self.epsilon = mode, epsilon
+        self.cooldown, self.min_lr = cooldown, min_lr
+        self.current_factor = 1.0
+        self._best = None
+        self._wait = 0
+        self._cooldown_left = 0
+
+    def on_metric(self, value: float):
+        improved = (self._best is None
+                    or (self.mode == "min" and value < self._best - self.epsilon)
+                    or (self.mode == "max" and value > self._best + self.epsilon))
+        if self._cooldown_left > 0:
+            self._cooldown_left -= 1
+        if improved:
+            self._best = value
+            self._wait = 0
+        elif self._cooldown_left == 0:
+            self._wait += 1
+            if self._wait >= self.patience:
+                self.current_factor *= self.factor
+                self._wait = 0
+                self._cooldown_left = self.cooldown
+
+    def __call__(self, base_lr, step, epoch):
+        return jnp.maximum(base_lr * self.current_factor, self.min_lr)
+
+
+class EpochSchedule(LearningRateSchedule):
+    """Per-epoch regimes (reference SGD.EpochSchedule / Regime)."""
+
+    def __init__(self, regimes):
+        self.regimes = list(regimes)  # [(start_epoch, end_epoch, lr)]
+
+    def __call__(self, base_lr, step, epoch):
+        lr = base_lr
+        for start, end, r_lr in self.regimes:
+            lr = jnp.where((epoch >= start) & (epoch <= end), r_lr, lr)
+        return lr
+
+
+class Regime:
+    def __init__(self, start_epoch, end_epoch, config):
+        self.start_epoch, self.end_epoch, self.config = \
+            start_epoch, end_epoch, config
+
+
+# --------------------------------------------------------------------------
+# OptimMethods
+# --------------------------------------------------------------------------
+
+class OptimMethod:
+    """Base update rule (reference optim/OptimMethod.scala).
+
+    State is a flat dict of pytrees + scalars, itself a pytree, so the
+    whole (params, state) update jit-compiles into the train step.
+    """
+
+    def init_state(self, params) -> Dict[str, Any]:
+        return {"t": jnp.zeros((), jnp.int32)}
+
+    def update(self, grads, params, state, epoch=0):
+        raise NotImplementedError
+
+    def get_learning_rate(self, state):
+        return getattr(self, "learning_rate", None)
+
+    # persistence parity (reference OptimMethod.save/load)
+    def state_dict(self, state):
+        return jax.tree_util.tree_map(lambda x: x, state)
+
+
+class SGD(OptimMethod):
+    """SGD with momentum/nesterov/dampening/weight decay and pluggable
+    LR schedule (reference optim/SGD.scala:39)."""
+
+    def __init__(self, learning_rate: float = 1e-3,
+                 learning_rate_decay: float = 0.0,
+                 weight_decay: float = 0.0,
+                 momentum: float = 0.0,
+                 dampening: Optional[float] = None,
+                 nesterov: bool = False,
+                 learning_rate_schedule: Optional[LearningRateSchedule] = None):
+        self.learning_rate = learning_rate
+        self.weight_decay = weight_decay
+        self.momentum = momentum
+        self.dampening = momentum if dampening is None else dampening
+        self.nesterov = nesterov
+        self.schedule = learning_rate_schedule or Default(learning_rate_decay)
+        if nesterov and (momentum <= 0 or self.dampening != 0):
+            raise ValueError(
+                "Nesterov momentum requires momentum > 0 and dampening = 0")
+
+    def init_state(self, params):
+        s = {"t": jnp.zeros((), jnp.int32)}
+        if self.momentum > 0:
+            s["velocity"] = _tmap(jnp.zeros_like, params)
+        return s
+
+    def update(self, grads, params, state, epoch=0):
+        lr = self.schedule(self.learning_rate, state["t"], epoch)
+        if self.weight_decay > 0:
+            grads = _tmap(lambda g, p: g + self.weight_decay * p,
+                          grads, params)
+        if self.momentum > 0:
+            vel = _tmap(
+                lambda v, g: self.momentum * v + (1 - self.dampening) * g,
+                state["velocity"], grads)
+            state = dict(state, velocity=vel)
+            if self.nesterov:
+                grads = _tmap(lambda g, v: g + self.momentum * v, grads, vel)
+            else:
+                grads = vel
+        params = _tmap(lambda p, g: p - lr * g, params, grads)
+        state = dict(state, t=state["t"] + 1)
+        return params, state
+
+
+class Adam(OptimMethod):
+    """(reference optim/Adam.scala)"""
+
+    def __init__(self, learning_rate: float = 1e-3,
+                 learning_rate_decay: float = 0.0,
+                 beta1: float = 0.9, beta2: float = 0.999,
+                 epsilon: float = 1e-8, weight_decay: float = 0.0,
+                 learning_rate_schedule: Optional[LearningRateSchedule] = None):
+        self.learning_rate = learning_rate
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.weight_decay = weight_decay
+        self.schedule = learning_rate_schedule or Default(learning_rate_decay)
+
+    def init_state(self, params):
+        return {"t": jnp.zeros((), jnp.int32),
+                "m": _tmap(jnp.zeros_like, params),
+                "v": _tmap(jnp.zeros_like, params)}
+
+    def update(self, grads, params, state, epoch=0):
+        t = state["t"] + 1
+        lr = self.schedule(self.learning_rate, state["t"], epoch)
+        if self.weight_decay > 0:
+            grads = _tmap(lambda g, p: g + self.weight_decay * p,
+                          grads, params)
+        m = _tmap(lambda m, g: self.beta1 * m + (1 - self.beta1) * g,
+                  state["m"], grads)
+        v = _tmap(lambda v, g: self.beta2 * v + (1 - self.beta2) * g * g,
+                  state["v"], grads)
+        bc1 = 1 - jnp.power(self.beta1, t.astype(jnp.float32))
+        bc2 = 1 - jnp.power(self.beta2, t.astype(jnp.float32))
+        params = _tmap(
+            lambda p, mm, vv: p - lr * (mm / bc1)
+            / (jnp.sqrt(vv / bc2) + self.epsilon),
+            params, m, v)
+        return params, {"t": t, "m": m, "v": v}
+
+
+class ParallelAdam(Adam):
+    """The reference's multi-threaded Adam (ParallelAdam.scala) exists to
+    parallelize the elementwise update across cores; under XLA the fused
+    update is already data-parallel, so this is Adam."""
+
+
+class Adagrad(OptimMethod):
+    """(reference optim/Adagrad.scala)"""
+
+    def __init__(self, learning_rate: float = 1e-3,
+                 learning_rate_decay: float = 0.0,
+                 weight_decay: float = 0.0):
+        self.learning_rate = learning_rate
+        self.learning_rate_decay = learning_rate_decay
+        self.weight_decay = weight_decay
+
+    def init_state(self, params):
+        return {"t": jnp.zeros((), jnp.int32),
+                "accum": _tmap(jnp.zeros_like, params)}
+
+    def update(self, grads, params, state, epoch=0):
+        lr = self.learning_rate / (1 + state["t"] * self.learning_rate_decay)
+        if self.weight_decay > 0:
+            grads = _tmap(lambda g, p: g + self.weight_decay * p,
+                          grads, params)
+        accum = _tmap(lambda a, g: a + g * g, state["accum"], grads)
+        params = _tmap(lambda p, g, a: p - lr * g / (jnp.sqrt(a) + 1e-10),
+                       params, grads, accum)
+        return params, {"t": state["t"] + 1, "accum": accum}
+
+
+class Adadelta(OptimMethod):
+    """(reference optim/Adadelta.scala)"""
+
+    def __init__(self, decay_rate: float = 0.9, epsilon: float = 1e-10):
+        self.rho, self.epsilon = decay_rate, epsilon
+        self.learning_rate = 1.0
+
+    def init_state(self, params):
+        return {"t": jnp.zeros((), jnp.int32),
+                "accum": _tmap(jnp.zeros_like, params),
+                "delta_accum": _tmap(jnp.zeros_like, params)}
+
+    def update(self, grads, params, state, epoch=0):
+        rho, eps = self.rho, self.epsilon
+        accum = _tmap(lambda a, g: rho * a + (1 - rho) * g * g,
+                      state["accum"], grads)
+        delta = _tmap(
+            lambda g, a, d: g * jnp.sqrt(d + eps) / jnp.sqrt(a + eps),
+            grads, accum, state["delta_accum"])
+        d_accum = _tmap(lambda d, dl: rho * d + (1 - rho) * dl * dl,
+                        state["delta_accum"], delta)
+        params = _tmap(lambda p, d: p - d, params, delta)
+        return params, {"t": state["t"] + 1, "accum": accum,
+                        "delta_accum": d_accum}
+
+
+class Adamax(OptimMethod):
+    """(reference optim/Adamax.scala)"""
+
+    def __init__(self, learning_rate: float = 0.002,
+                 beta1: float = 0.9, beta2: float = 0.999,
+                 epsilon: float = 1e-38):
+        self.learning_rate = learning_rate
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def init_state(self, params):
+        return {"t": jnp.zeros((), jnp.int32),
+                "m": _tmap(jnp.zeros_like, params),
+                "u": _tmap(jnp.zeros_like, params)}
+
+    def update(self, grads, params, state, epoch=0):
+        t = state["t"] + 1
+        m = _tmap(lambda m, g: self.beta1 * m + (1 - self.beta1) * g,
+                  state["m"], grads)
+        u = _tmap(lambda u, g: jnp.maximum(self.beta2 * u, jnp.abs(g)
+                                           + self.epsilon),
+                  state["u"], grads)
+        bc = 1 - jnp.power(self.beta1, t.astype(jnp.float32))
+        params = _tmap(lambda p, mm, uu: p - self.learning_rate / bc * mm / uu,
+                       params, m, u)
+        return params, {"t": t, "m": m, "u": u}
+
+
+class RMSprop(OptimMethod):
+    """(reference optim/RMSprop.scala)"""
+
+    def __init__(self, learning_rate: float = 1e-2,
+                 learning_rate_decay: float = 0.0,
+                 decay_rate: float = 0.99, epsilon: float = 1e-8):
+        self.learning_rate = learning_rate
+        self.learning_rate_decay = learning_rate_decay
+        self.rho, self.epsilon = decay_rate, epsilon
+
+    def init_state(self, params):
+        return {"t": jnp.zeros((), jnp.int32),
+                "accum": _tmap(jnp.zeros_like, params)}
+
+    def update(self, grads, params, state, epoch=0):
+        lr = self.learning_rate / (1 + state["t"] * self.learning_rate_decay)
+        accum = _tmap(lambda a, g: self.rho * a + (1 - self.rho) * g * g,
+                      state["accum"], grads)
+        params = _tmap(
+            lambda p, g, a: p - lr * g / (jnp.sqrt(a) + self.epsilon),
+            params, grads, accum)
+        return params, {"t": state["t"] + 1, "accum": accum}
+
+
+class Ftrl(OptimMethod):
+    """Follow-the-regularized-leader (reference optim/Ftrl.scala)."""
+
+    def __init__(self, learning_rate: float = 1e-3,
+                 learning_rate_power: float = -0.5,
+                 initial_accumulator_value: float = 0.1,
+                 l1_regularization_strength: float = 0.0,
+                 l2_regularization_strength: float = 0.0,
+                 l2_shrinkage_regularization_strength: float = 0.0):
+        self.learning_rate = learning_rate
+        self.lr_power = learning_rate_power
+        self.init_accum = initial_accumulator_value
+        self.l1 = l1_regularization_strength
+        self.l2 = l2_regularization_strength
+        self.l2_shrinkage = l2_shrinkage_regularization_strength
+
+    def init_state(self, params):
+        return {"t": jnp.zeros((), jnp.int32),
+                "accum": _tmap(
+                    lambda p: jnp.full_like(p, self.init_accum), params),
+                "linear": _tmap(jnp.zeros_like, params)}
+
+    def update(self, grads, params, state, epoch=0):
+        lr, lp = self.learning_rate, self.lr_power
+
+        def upd(p, g, a, l):
+            g_shrink = g + 2 * self.l2_shrinkage * p
+            new_a = a + g * g
+            sigma = (jnp.power(new_a, -lp) - jnp.power(a, -lp)) / lr
+            new_l = l + g_shrink - sigma * p
+            quad = jnp.power(new_a, -lp) / lr + 2 * self.l2
+            l_reg = jnp.clip(new_l, -self.l1, self.l1)
+            new_p = (l_reg - new_l) / quad
+            return new_p, new_a, new_l
+
+        flat_p, tdef = jax.tree_util.tree_flatten(params)
+        flat_g = jax.tree_util.tree_leaves(grads)
+        flat_a = jax.tree_util.tree_leaves(state["accum"])
+        flat_l = jax.tree_util.tree_leaves(state["linear"])
+        out = [upd(p, g, a, l)
+               for p, g, a, l in zip(flat_p, flat_g, flat_a, flat_l)]
+        params = jax.tree_util.tree_unflatten(tdef, [o[0] for o in out])
+        accum = jax.tree_util.tree_unflatten(tdef, [o[1] for o in out])
+        linear = jax.tree_util.tree_unflatten(tdef, [o[2] for o in out])
+        return params, {"t": state["t"] + 1, "accum": accum,
+                        "linear": linear}
+
+
+class LarsSGD(SGD):
+    """Layer-wise adaptive rate scaling (reference optim/LarsSGD.scala):
+    per-leaf trust ratio ||w||/(||g|| + wd*||w||) scales the LR."""
+
+    def __init__(self, learning_rate: float = 1e-3,
+                 trust_coefficient: float = 0.001,
+                 momentum: float = 0.5,
+                 weight_decay: float = 5e-4,
+                 learning_rate_schedule: Optional[LearningRateSchedule] = None):
+        super().__init__(learning_rate, momentum=momentum,
+                         weight_decay=0.0, dampening=0.0,
+                         learning_rate_schedule=learning_rate_schedule)
+        self.trust = trust_coefficient
+        self.lars_weight_decay = weight_decay
+
+    def init_state(self, params):
+        # LARS always carries a velocity buffer, even at momentum=0
+        return {"t": jnp.zeros((), jnp.int32),
+                "velocity": _tmap(jnp.zeros_like, params)}
+
+    def update(self, grads, params, state, epoch=0):
+        lr = self.schedule(self.learning_rate, state["t"], epoch)
+        wd = self.lars_weight_decay
+
+        def scaled(g, p):
+            g = g + wd * p
+            w_norm = jnp.linalg.norm(p.reshape(-1))
+            g_norm = jnp.linalg.norm(g.reshape(-1))
+            trust_ratio = jnp.where(
+                (w_norm > 0) & (g_norm > 0),
+                self.trust * w_norm / (g_norm + 1e-12), 1.0)
+            return g * trust_ratio
+
+        grads = _tmap(scaled, grads, params)
+        vel = _tmap(lambda v, g: self.momentum * v + lr * g,
+                    state["velocity"], grads)
+        params = _tmap(lambda p, v: p - v, params, vel)
+        return params, {"t": state["t"] + 1, "velocity": vel}
+
+
+class LBFGS(OptimMethod):
+    """L-BFGS with fixed history (reference optim/LBFGS.scala).  Uses a
+    flattened parameter vector and a jit-friendly two-loop recursion with
+    static history size; no line search (learningRate step, matching the
+    reference's default fallback when lineSearch is not set)."""
+
+    def __init__(self, max_iter: int = 20, max_eval: Optional[float] = None,
+                 tolerance_fun: float = 1e-5, tolerance_x: float = 1e-9,
+                 n_correction: int = 10, learning_rate: float = 1.0,
+                 line_search=None):
+        self.history = n_correction
+        self.learning_rate = learning_rate
+
+    def init_state(self, params):
+        from jax.flatten_util import ravel_pytree
+        flat, _ = ravel_pytree(params)
+        n = flat.shape[0]
+        m = self.history
+        return {"t": jnp.zeros((), jnp.int32),
+                "s": jnp.zeros((m, n)), "y": jnp.zeros((m, n)),
+                "rho": jnp.zeros((m,)),
+                "prev_flat": jnp.zeros((n,)), "prev_grad": jnp.zeros((n,))}
+
+    def update(self, grads, params, state, epoch=0):
+        from jax.flatten_util import ravel_pytree
+        flat, unravel = ravel_pytree(params)
+        gflat, _ = ravel_pytree(grads)
+        m = self.history
+        t = state["t"]
+
+        s_new = flat - state["prev_flat"]
+        y_new = gflat - state["prev_grad"]
+        ys = jnp.dot(y_new, s_new)
+        valid = (t > 0) & (ys > 1e-10)
+        s_hist = jnp.where(valid, jnp.roll(state["s"], -1, axis=0)
+                           .at[-1].set(s_new), state["s"])
+        y_hist = jnp.where(valid, jnp.roll(state["y"], -1, axis=0)
+                           .at[-1].set(y_new), state["y"])
+        rho = jnp.where(valid, jnp.roll(state["rho"], -1)
+                        .at[-1].set(jnp.where(ys > 1e-10, 1.0 / ys, 0.0)),
+                        state["rho"])
+
+        # two-loop recursion (static unroll over history m)
+        q = gflat
+        alphas = []
+        for i in range(m - 1, -1, -1):
+            a = rho[i] * jnp.dot(s_hist[i], q)
+            q = q - a * y_hist[i]
+            alphas.append((i, a))
+        gamma = jnp.where(valid, ys / (jnp.dot(y_new, y_new) + 1e-12), 1.0)
+        r = gamma * q
+        for i, a in reversed(alphas):
+            b = rho[i] * jnp.dot(y_hist[i], r)
+            r = r + s_hist[i] * (a - b)
+
+        new_flat = flat - self.learning_rate * r
+        new_state = {"t": t + 1, "s": s_hist, "y": y_hist, "rho": rho,
+                     "prev_flat": flat, "prev_grad": gflat}
+        return unravel(new_flat), new_state

@@ -1,0 +1,60 @@
+"""Composable triggers for stop/validation/checkpoint conditions.
+
+Reference: optim/Trigger.scala (maxEpoch, maxIteration, everyEpoch,
+severalIteration, maxScore, minLoss, and/or combinators).
+
+A trigger is called with the driver state dict (host-side python scalars:
+``epoch``, ``neval`` (iteration), ``loss``, ``score``, ``is_epoch_end``).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+__all__ = ["Trigger"]
+
+
+class Trigger:
+    def __init__(self, fn, name="trigger"):
+        self._fn = fn
+        self.name = name
+
+    def __call__(self, state: Dict) -> bool:
+        return bool(self._fn(state))
+
+    # ---- factories (reference Trigger.scala object methods) ----
+
+    @staticmethod
+    def max_epoch(n: int) -> "Trigger":
+        return Trigger(lambda s: s.get("epoch", 0) > n, f"maxEpoch({n})")
+
+    @staticmethod
+    def max_iteration(n: int) -> "Trigger":
+        return Trigger(lambda s: s.get("neval", 0) > n, f"maxIteration({n})")
+
+    @staticmethod
+    def every_epoch() -> "Trigger":
+        return Trigger(lambda s: s.get("is_epoch_end", False), "everyEpoch")
+
+    @staticmethod
+    def several_iteration(n: int) -> "Trigger":
+        return Trigger(lambda s: s.get("neval", 0) % n == 0,
+                       f"severalIteration({n})")
+
+    @staticmethod
+    def max_score(threshold: float) -> "Trigger":
+        return Trigger(lambda s: s.get("score", float("-inf")) > threshold,
+                       f"maxScore({threshold})")
+
+    @staticmethod
+    def min_loss(threshold: float) -> "Trigger":
+        return Trigger(lambda s: s.get("loss", float("inf")) < threshold,
+                       f"minLoss({threshold})")
+
+    @staticmethod
+    def and_(*triggers: "Trigger") -> "Trigger":
+        return Trigger(lambda s: all(t(s) for t in triggers), "and")
+
+    @staticmethod
+    def or_(*triggers: "Trigger") -> "Trigger":
+        return Trigger(lambda s: any(t(s) for t in triggers), "or")

@@ -1,0 +1,168 @@
+"""Validation methods (metrics).
+
+Reference: optim/ValidationMethod.scala — Top1Accuracy (:174),
+Top5Accuracy (:828), Loss, MAE, TreeNNAccuracy (:122), HitRatio (:883),
+NDCG; plus optim/ValidationResult contract (`+` merge, `result()`).
+
+Each method computes a mergeable ``ValidationResult`` from (output,
+target) so distributed evaluation just sums results across batches and
+hosts — the TPU equivalent of the reference's RDD aggregate.  The
+device-side part (``batch_stats``) is jit-friendly: it returns
+(numerator, denominator) arrays.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "ValidationResult", "AccuracyResult", "LossResult",
+    "ValidationMethod", "Top1Accuracy", "Top5Accuracy", "TopKAccuracy",
+    "Loss", "MAE", "HitRatio", "NDCG",
+]
+
+
+class ValidationResult:
+    """Mergeable metric accumulator (reference ValidationResult)."""
+
+    def __init__(self, numerator: float, denominator: float, fmt: str):
+        self.numerator = float(numerator)
+        self.denominator = float(denominator)
+        self.fmt = fmt
+
+    def result(self) -> Tuple[float, int]:
+        value = self.numerator / max(self.denominator, 1e-12)
+        return value, int(self.denominator)
+
+    def __add__(self, other: "ValidationResult") -> "ValidationResult":
+        return ValidationResult(self.numerator + other.numerator,
+                                self.denominator + other.denominator,
+                                self.fmt)
+
+    def __repr__(self):
+        v, n = self.result()
+        return f"{self.fmt}: {v:.6f} (count {n})"
+
+
+class AccuracyResult(ValidationResult):
+    def __init__(self, correct, count):
+        super().__init__(correct, count, "Accuracy")
+
+
+class LossResult(ValidationResult):
+    def __init__(self, loss, count):
+        super().__init__(loss, count, "Loss")
+
+
+class ValidationMethod:
+    """Metric protocol: ``batch_stats(output, target)`` runs on device
+    inside jit returning (num, den) scalars; ``to_result`` wraps them."""
+
+    fmt = "Metric"
+
+    def batch_stats(self, output, target):
+        raise NotImplementedError
+
+    def to_result(self, num, den) -> ValidationResult:
+        return ValidationResult(float(num), float(den), self.fmt)
+
+    def __call__(self, output, target) -> ValidationResult:
+        num, den = self.batch_stats(output, target)
+        return self.to_result(num, den)
+
+    def __repr__(self):
+        return self.fmt
+
+
+class TopKAccuracy(ValidationMethod):
+    """Top-k classification accuracy; 1-based integer targets
+    (reference Top1Accuracy/Top5Accuracy, ValidationMethod.scala:174,828)."""
+
+    def __init__(self, k: int = 1):
+        self.k = k
+        self.fmt = f"Top{k}Accuracy"
+
+    def batch_stats(self, output, target):
+        t = jnp.asarray(target).astype(jnp.int32).reshape(-1) - 1
+        out = output.reshape((-1, output.shape[-1]))
+        if self.k == 1:
+            pred = jnp.argmax(out, axis=-1)
+            correct = jnp.sum((pred == t).astype(jnp.float32))
+        else:
+            _, topk = jax.lax.top_k(out, self.k)
+            correct = jnp.sum(
+                jnp.any(topk == t[:, None], axis=-1).astype(jnp.float32))
+        return correct, jnp.asarray(float(t.shape[0]))
+
+
+class Top1Accuracy(TopKAccuracy):
+    def __init__(self):
+        super().__init__(1)
+
+
+class Top5Accuracy(TopKAccuracy):
+    def __init__(self):
+        super().__init__(5)
+
+
+class Loss(ValidationMethod):
+    """Mean criterion loss over samples (reference ValidationMethod.Loss)."""
+
+    fmt = "Loss"
+
+    def __init__(self, criterion=None):
+        if criterion is None:
+            from bigdl_tpu.nn.criterion import CrossEntropyCriterion
+            criterion = CrossEntropyCriterion()
+        self.criterion = criterion
+
+    def batch_stats(self, output, target):
+        loss = self.criterion(output, target)
+        n = output.shape[0]
+        return loss * n, jnp.asarray(float(n))
+
+
+class MAE(ValidationMethod):
+    """Mean absolute error (reference ValidationMethod.MAE)."""
+
+    fmt = "MAE"
+
+    def batch_stats(self, output, target):
+        err = jnp.mean(jnp.abs(output - target),
+                       axis=tuple(range(1, output.ndim)))
+        return jnp.sum(err), jnp.asarray(float(output.shape[0]))
+
+
+class HitRatio(ValidationMethod):
+    """HR@k for recommendation: positive item is output[...,0] vs
+    negatives (reference ValidationMethod.scala:883; NCF evaluation).
+    Input: output [batch, 1+neg] scores, first column the positive."""
+
+    def __init__(self, k: int = 10, neg_num: int = 100):
+        self.k = k
+        self.fmt = f"HitRatio@{k}"
+
+    def batch_stats(self, output, target=None):
+        rank = jnp.sum((output > output[..., :1]).astype(jnp.int32),
+                       axis=-1) + 1
+        hits = jnp.sum((rank <= self.k).astype(jnp.float32))
+        return hits, jnp.asarray(float(output.shape[0]))
+
+
+class NDCG(ValidationMethod):
+    """NDCG@k, positive-at-column-0 protocol like HitRatio
+    (reference ValidationMethod.scala NDCG)."""
+
+    def __init__(self, k: int = 10, neg_num: int = 100):
+        self.k = k
+        self.fmt = f"NDCG@{k}"
+
+    def batch_stats(self, output, target=None):
+        rank = jnp.sum((output > output[..., :1]).astype(jnp.int32),
+                       axis=-1) + 1
+        gain = jnp.where(rank <= self.k,
+                         jnp.log(2.0) / jnp.log(rank + 1.0), 0.0)
+        return jnp.sum(gain), jnp.asarray(float(output.shape[0]))

@@ -1,0 +1,8 @@
+from bigdl_tpu.parallel.mesh import (
+    Mesh, MeshConfig, P, NamedSharding, make_mesh, data_parallel_mesh,
+    batch_sharding, local_device_count,
+)
+from bigdl_tpu.parallel.sharding import (
+    ShardingRules, replicated, shard_model_params, model_shardings,
+    fsdp_spec,
+)

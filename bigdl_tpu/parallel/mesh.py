@@ -1,0 +1,127 @@
+"""Device mesh construction and topology discovery.
+
+Reference equivalence: utils/Engine.scala:499-600 parses the Spark master
+URL into (nodeNumber, coreNumber); here topology comes from
+``jax.devices()`` and the mesh axes replace the reference's
+executor×thread grid.  The reference's single parallelism axis (data)
+generalizes to the full axis set {data, fsdp, model(tensor), pipe,
+seq, expert} — absent in the reference (SURVEY §2.6) but first-class
+here.
+
+The canonical axis names used across the framework:
+
+* ``data``  — batch sharding (≙ AllReduceParameter data parallelism)
+* ``fsdp``  — parameter/optimizer-state sharding combined with data
+* ``model`` — tensor parallelism (megatron-style)
+* ``pipe``  — pipeline stages
+* ``seq``   — sequence/context parallelism (ring attention)
+* ``expert``— MoE expert parallelism
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["make_mesh", "data_parallel_mesh", "MeshConfig", "P",
+           "NamedSharding", "Mesh", "local_device_count", "batch_sharding"]
+
+AXES = ("data", "fsdp", "model", "pipe", "seq", "expert")
+
+
+def local_device_count() -> int:
+    return len(jax.devices())
+
+
+def _infer(shape: Dict[str, int], n: int) -> Dict[str, int]:
+    """Resolve a single -1 entry so the product equals n."""
+    known = 1
+    unknown = None
+    for k, v in shape.items():
+        if v == -1:
+            if unknown is not None:
+                raise ValueError("only one mesh axis may be -1")
+            unknown = k
+        else:
+            known *= v
+    if unknown is not None:
+        if n % known:
+            raise ValueError(
+                f"mesh axes {shape} don't divide device count {n}")
+        shape = dict(shape)
+        shape[unknown] = n // known
+    else:
+        prod = known
+        if prod > n:
+            raise ValueError(
+                f"mesh axes {shape} (={prod}) exceed device count {n}")
+        # prod < n: use the first prod devices (≙ running on a subset
+        # of executors)
+    return shape
+
+
+def make_mesh(axes: Optional[Dict[str, int]] = None,
+              devices=None) -> Mesh:
+    """Build a Mesh over the given axes (dict axis→size; one may be -1).
+
+    Axis order follows AXES so that the innermost (fastest-varying,
+    best-ICI-locality) axis is the model/tensor axis — collectives for
+    TP ride nearest-neighbour ICI links while DP gradients ride the
+    outer dimensions, matching create_device_mesh's locality heuristics.
+    """
+    devices = list(jax.devices()) if devices is None else list(devices)
+    n = len(devices)
+    if axes is None:
+        axes = {"data": n}
+    axes = _infer(dict(axes), n)
+    names = [a for a in AXES if a in axes]
+    extra = [a for a in axes if a not in AXES]
+    names += extra
+    sizes = tuple(axes[a] for a in names)
+    prod = int(np.prod(sizes))
+    if prod < n:
+        devices = devices[:prod]
+    try:
+        from jax.experimental import mesh_utils
+        mesh_devices = mesh_utils.create_device_mesh(
+            sizes, devices=devices)
+    except Exception:
+        mesh_devices = np.array(devices).reshape(sizes)
+    return Mesh(mesh_devices, tuple(names))
+
+
+def data_parallel_mesh(devices=None) -> Mesh:
+    """All devices on one ``data`` axis — the reference's only strategy
+    (AllReduceParameter over nodes; SURVEY §2.6)."""
+    return make_mesh({"data": -1}, devices)
+
+
+def batch_sharding(mesh: Mesh, *, extra_axes: Sequence[str] = ()) \
+        -> NamedSharding:
+    """Sharding for a batch-leading array: batch dim over every
+    data-like axis present in the mesh."""
+    batch_axes = tuple(a for a in ("data", "fsdp") if a in mesh.axis_names)
+    spec = P(batch_axes if batch_axes else None, *extra_axes)
+    return NamedSharding(mesh, spec)
+
+
+class MeshConfig:
+    """Declarative parallelism config used by the Optimizer (the
+    TPU-native replacement for the reference's Engine node/core conf).
+
+    Example::
+
+        MeshConfig(data=-1)                      # pure DP (default)
+        MeshConfig(data=2, model=4)              # DP×TP
+        MeshConfig(data=2, pipe=2, model=2)      # 3D
+    """
+
+    def __init__(self, **axes: int):
+        self.axes = axes or {"data": -1}
+
+    def build(self, devices=None) -> Mesh:
+        return make_mesh(self.axes, devices)

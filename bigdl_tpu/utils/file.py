@@ -1,0 +1,58 @@
+"""Checkpoint persistence.
+
+Reference: utils/File.scala (save/load to local/HDFS/S3) and
+optim/AbstractOptimizer.scala:205 checkpoint (model + OptimMethod state,
+timestamp-suffixed).  TPU-native: params/buffers/optim-state are pulled
+to host as numpy and written as an .npz + pickled treedef — a
+self-contained single-file format.  Cloud-storage URIs can be layered on
+by fsspec-style adapters later; local paths are the baseline.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import pickle
+from typing import Any, Dict, Tuple
+
+import numpy as np
+
+import jax
+
+__all__ = ["save_pytree", "load_pytree", "save_checkpoint",
+           "load_checkpoint"]
+
+
+def _to_host(tree):
+    return jax.tree_util.tree_map(lambda x: np.asarray(x), tree)
+
+
+def save_pytree(tree: Any, path: str) -> None:
+    leaves, treedef = jax.tree_util.tree_flatten(_to_host(tree))
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, "wb") as f:
+        np.savez(f, *leaves, __treedef__=np.frombuffer(
+            pickle.dumps(treedef), dtype=np.uint8))
+
+
+def load_pytree(path: str) -> Any:
+    with np.load(path, allow_pickle=False) as z:
+        treedef = pickle.loads(z["__treedef__"].tobytes())
+        leaves = [z[f"arr_{i}"] for i in range(len(z.files) - 1)]
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def save_checkpoint(path: str, model_state: Dict, optim_state: Any,
+                    driver_state: Dict) -> None:
+    """Write a full training checkpoint (≙ checkpoint() writing model +
+    optimMethod, AbstractOptimizer.scala:205-226)."""
+    save_pytree({"model": model_state, "optim": optim_state,
+                 "driver": {k: np.asarray(v)
+                            for k, v in driver_state.items()}}, path)
+
+
+def load_checkpoint(path: str) -> Tuple[Dict, Any, Dict]:
+    tree = load_pytree(path)
+    driver = {k: v.item() if np.ndim(v) == 0 else v
+              for k, v in tree["driver"].items()}
+    return tree["model"], tree["optim"], driver

@@ -254,3 +254,32 @@ def test_graph_arity_error():
     g = nn.Graph([i1, i2], nn.CAddTable()(i1, i2))
     with pytest.raises(ValueError, match="expects 2"):
         g(jnp.ones((2, 3)))
+
+
+def test_birecurrent_positional_cell():
+    cell = nn.LSTM(4, 6)
+    bi = nn.BiRecurrent(cell)  # convenience positional form
+    assert bi(jnp.ones((2, 3, 4))).shape == (2, 3, 12)
+    with pytest.raises(ValueError, match="needs a cell"):
+        nn.BiRecurrent()
+
+
+def test_convlstm_strided():
+    cl = nn.Recurrent(nn.ConvLSTMPeephole(3, 8, stride=2))
+    out = cl(jnp.ones((2, 4, 8, 8, 3)))
+    assert out.shape == (2, 4, 4, 4, 8)
+
+
+def test_lstm_input_dropout_active():
+    from bigdl_tpu import forward_context
+    cell = nn.LSTM(4, 6, p=0.5)
+    rec = nn.Recurrent(cell)
+    x = jnp.ones((2, 3, 4))
+    with forward_context(rng=jax.random.key(0)):
+        a = rec(x)
+    with forward_context(rng=jax.random.key(1)):
+        b = rec(x)
+    assert not np.allclose(np.asarray(a), np.asarray(b))
+    rec.eval_mode()
+    c, d = rec(x), rec(x)
+    np.testing.assert_allclose(np.asarray(c), np.asarray(d))
