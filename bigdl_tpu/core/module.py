@@ -279,15 +279,15 @@ class Module:
     def forward(self, *inputs):
         raise NotImplementedError
 
-    def __call__(self, *inputs):
+    def __call__(self, *inputs, **kwargs):
         # Graph-building DSL (reference nn/Graph.scala `inputs()`):
         # calling a module on Node objects creates a new graph Node
         # instead of executing forward.
-        if inputs:
+        if inputs and not kwargs:
             from bigdl_tpu.nn.containers import Node, node_of
             if all(isinstance(i, Node) for i in inputs):
                 return node_of(self, *inputs)
-        return self.forward(*inputs)
+        return self.forward(*inputs, **kwargs)
 
     def backward(self, input, grad_output):
         """API-parity helper (reference AbstractModule.scala:305): returns

@@ -15,6 +15,7 @@ shuffle, DataSet.scala:260).
 
 from __future__ import annotations
 
+import copy as _copy
 from typing import Callable, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -103,10 +104,13 @@ class LocalDataSet:
         self._rng = np.random.default_rng(0)
 
     def transform(self, transformer) -> "LocalDataSet":
-        """Append a Transformer stage (reference ``dataset -> transformer``)."""
-        out = self.__class__(self._data, self._shuffle)
+        """Append a Transformer stage (reference ``dataset -> transformer``).
+
+        Shallow-copies the dataset object (sharing data/rng) so subclass
+        state — e.g. DistributedDataSet's already-computed shard — is
+        preserved rather than re-derived."""
+        out = _copy.copy(self)
         out._transformers = self._transformers + [transformer]
-        out._rng = self._rng
         return out
 
     def __rshift__(self, transformer):

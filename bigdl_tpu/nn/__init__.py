@@ -16,3 +16,4 @@ from bigdl_tpu.nn.normalization import *   # noqa: F401,F403
 from bigdl_tpu.nn.regularization import *  # noqa: F401,F403
 from bigdl_tpu.nn.criterion import *       # noqa: F401,F403
 from bigdl_tpu.nn.rnn import *             # noqa: F401,F403
+from bigdl_tpu.nn.attention import *       # noqa: F401,F403

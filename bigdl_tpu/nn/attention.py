@@ -1,0 +1,542 @@
+"""Multi-head attention + Transformer stack.
+
+Reference: nn/Attention.scala (multi-head attention as a graph of
+MM/SoftMax/Dropout layers), nn/FeedForwardNetwork.scala,
+nn/TransformerOperation.scala (position encoding, padding/causal bias,
+shiftRight3D), nn/Transformer.scala (LanguageModel + Translation
+topologies, pre-norm blocks, shared embedding/softmax weights),
+nn/SequenceBeamSearch.scala.
+
+TPU-first redesign: attention scores never materialize at [B,H,T,T] on
+the hot path — :func:`bigdl_tpu.ops.dot_product_attention` dispatches to
+a Pallas flash kernel (blockwise online softmax) on TPU.  Decode uses a
+fixed-size KV cache updated with ``lax.dynamic_update_slice`` so the
+beam-search loop stays jittable (static shapes, no concat-growing
+tensors like the reference's JoinTable cache, Attention.scala joinK/V).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.core.module import Module, ModuleList, Parameter, \
+    next_rng_key
+from bigdl_tpu.nn.linear import Linear
+from bigdl_tpu.nn.normalization import LayerNormalization
+from bigdl_tpu.ops import dot_product_attention
+from bigdl_tpu.ops.attention_kernels import xla_attention, _NEG_INF
+
+__all__ = [
+    "Attention", "FeedForwardNetwork", "TransformerEncoderLayer",
+    "TransformerDecoderLayer", "Transformer", "SequenceBeamSearch",
+    "position_encoding", "padding_bias", "causal_bias", "shift_right_3d",
+]
+
+
+# ---------------------------------------------------------------------------
+# TransformerOperation equivalents (reference nn/TransformerOperation.scala)
+# ---------------------------------------------------------------------------
+
+def position_encoding(length: int, hidden_size: int,
+                      min_timescale: float = 1.0,
+                      max_timescale: float = 1.0e4,
+                      dtype=jnp.float32):
+    """Sinusoidal position encoding [length, hidden_size]
+    (reference TransformerOperation.getPositionEncode:118)."""
+    position = jnp.arange(length, dtype=jnp.float32)
+    num_timescales = hidden_size // 2
+    log_inc = math.log(max_timescale / min_timescale) / max(
+        num_timescales - 1, 1)
+    inv_timescales = min_timescale * jnp.exp(
+        jnp.arange(num_timescales, dtype=jnp.float32) * -log_inc)
+    scaled = position[:, None] * inv_timescales[None, :]
+    signal = jnp.concatenate([jnp.sin(scaled), jnp.cos(scaled)], axis=1)
+    if signal.shape[1] < hidden_size:  # odd hidden size
+        signal = jnp.pad(signal, ((0, 0), (0, hidden_size - signal.shape[1])))
+    return signal.astype(dtype)
+
+
+def padding_bias(tokens, padding_value: float = 0.0):
+    """[B, 1, 1, T] additive bias: -1e9 at padding positions
+    (reference TransformerOperation.getPaddingBias:74)."""
+    pad = (tokens == padding_value).astype(jnp.float32) * _NEG_INF
+    return pad[:, None, None, :]
+
+
+def causal_bias(length: int, dtype=jnp.float32):
+    """[1, 1, T, T] lower-triangle attention bias (reference
+    TransformerOperation.attentionBiasLowerTriangle:156)."""
+    mask = jnp.tril(jnp.ones((length, length), bool))
+    return jnp.where(mask, 0.0, _NEG_INF).astype(dtype)[None, None]
+
+
+def shift_right_3d(x):
+    """Shift the time axis right by one, zero-filling position 0
+    (reference TransformerOperation.shiftRight3D:94 — decoder input
+    shifting)."""
+    return jnp.pad(x, ((0, 0), (1, 0), (0, 0)))[:, :-1, :]
+
+
+# ---------------------------------------------------------------------------
+# Attention
+# ---------------------------------------------------------------------------
+
+class Attention(Module):
+    """Multi-head (self/cross) attention (reference nn/Attention.scala).
+
+    ``forward(x, y=None, bias=None, cache=None, cache_index=None)``:
+
+    * x: queries [B, Tq, H]; y: keys/values source (defaults to x —
+      self-attention, like the reference feeding inputX=inputY).
+    * bias: additive attention bias broadcastable to [B, h, Tq, Tk]
+      (padding mask and/or causal mask).
+    * cache: optional dict {"k": [B, h, Tmax, d], "v": ...} for
+      incremental decoding; cache_index is the current step.  Returns
+      (output, new_cache) when a cache is passed, else output.
+    """
+
+    def __init__(self, hidden_size: int, num_heads: int,
+                 attention_dropout: float = 0.0):
+        super().__init__()
+        if hidden_size % num_heads:
+            raise ValueError("hidden_size must be divisible by num_heads")
+        self.hidden_size = hidden_size
+        self.num_heads = num_heads
+        self.attention_dropout = attention_dropout
+        self.q_layer = Linear(hidden_size, hidden_size, with_bias=False)
+        self.k_layer = Linear(hidden_size, hidden_size, with_bias=False)
+        self.v_layer = Linear(hidden_size, hidden_size, with_bias=False)
+        self.output_layer = Linear(hidden_size, hidden_size, with_bias=False)
+
+    def _split_heads(self, x):
+        b, t, _ = x.shape
+        d = self.hidden_size // self.num_heads
+        return x.reshape(b, t, self.num_heads, d).transpose(0, 2, 1, 3)
+
+    def _combine_heads(self, x):
+        b, h, t, d = x.shape
+        return x.transpose(0, 2, 1, 3).reshape(b, t, h * d)
+
+    def forward(self, x, y=None, bias=None, cache=None, cache_index=None):
+        self_attention = y is None
+        y = x if self_attention else y
+        q = self._split_heads(self.q_layer(x))
+        d = self.hidden_size // self.num_heads
+        # reference scales q by 1/sqrt(depth) before the MM
+        # (Attention.scala createModule); we fold it into the kernel scale.
+
+        new_cache = None
+        if cache is not None:
+            if self_attention:
+                k_step = self._split_heads(self.k_layer(y))
+                v_step = self._split_heads(self.v_layer(y))
+                k = jax.lax.dynamic_update_slice(
+                    cache["k"], k_step.astype(cache["k"].dtype),
+                    (0, 0, cache_index, 0))
+                v = jax.lax.dynamic_update_slice(
+                    cache["v"], v_step.astype(cache["v"].dtype),
+                    (0, 0, cache_index, 0))
+                new_cache = {"k": k, "v": v}
+            else:
+                # cross-attention: cache holds the projected encoder K/V
+                k, v = cache["k"], cache["v"]
+                new_cache = cache
+        else:
+            k = self._split_heads(self.k_layer(y))
+            v = self._split_heads(self.v_layer(y))
+
+        if self.training and self.attention_dropout > 0.0:
+            # dropout on the softmax weights forces the materialized path
+            # (reference dropLayer after softMaxLayer)
+            logits = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                                preferred_element_type=jnp.float32)
+            logits = logits / math.sqrt(d)
+            if bias is not None:
+                logits = logits + bias.astype(jnp.float32)
+            w = jax.nn.softmax(logits, axis=-1)
+            keep = jax.random.bernoulli(
+                next_rng_key(), 1.0 - self.attention_dropout, w.shape)
+            w = jnp.where(keep, w / (1.0 - self.attention_dropout), 0.0)
+            ctxt = jnp.einsum("bhqk,bhkd->bhqd", w.astype(v.dtype), v)
+        else:
+            ctxt = dot_product_attention(q, k, v, bias)
+        out = self.output_layer(self._combine_heads(ctxt))
+        if cache is not None:
+            return out, new_cache
+        return out
+
+    def init_cache(self, batch: int, max_length: int, dtype=jnp.float32):
+        d = self.hidden_size // self.num_heads
+        shape = (batch, self.num_heads, max_length, d)
+        return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+class FeedForwardNetwork(Module):
+    """Position-wise FFN: Linear→ReLU→Dropout→Linear
+    (reference nn/FeedForwardNetwork.scala)."""
+
+    def __init__(self, hidden_size: int, filter_size: int,
+                 relu_dropout: float = 0.0):
+        super().__init__()
+        self.relu_dropout = relu_dropout
+        self.filter_layer = Linear(hidden_size, filter_size, with_bias=True)
+        self.output_layer = Linear(filter_size, hidden_size, with_bias=True)
+
+    def forward(self, x):
+        h = jax.nn.relu(self.filter_layer(x))
+        if self.training and self.relu_dropout > 0.0:
+            keep = jax.random.bernoulli(
+                next_rng_key(), 1.0 - self.relu_dropout, h.shape)
+            h = jnp.where(keep, h / (1.0 - self.relu_dropout), 0.0)
+        return self.output_layer(h)
+
+
+def _residual_dropout(x, p, training):
+    if training and p > 0.0:
+        keep = jax.random.bernoulli(next_rng_key(), 1.0 - p, x.shape)
+        return jnp.where(keep, x / (1.0 - p), 0.0)
+    return x
+
+
+class TransformerEncoderLayer(Module):
+    """Pre-norm encoder block: LN→self-attn→dropout→residual;
+    LN→FFN→dropout→residual (reference Transformer.scala block(),
+    encode branch)."""
+
+    def __init__(self, hidden_size, num_heads, filter_size,
+                 attention_dropout=0.0, ffn_dropout=0.0):
+        super().__init__()
+        self.ffn_dropout = ffn_dropout
+        self.attn_norm = LayerNormalization(hidden_size)
+        self.attn = Attention(hidden_size, num_heads, attention_dropout)
+        self.ffn_norm = LayerNormalization(hidden_size)
+        self.ffn = FeedForwardNetwork(hidden_size, filter_size, ffn_dropout)
+
+    def forward(self, x, bias=None):
+        y = self.attn(self.attn_norm(x), None, bias)
+        x = x + _residual_dropout(y, self.ffn_dropout, self.training)
+        y = self.ffn(self.ffn_norm(x))
+        return x + _residual_dropout(y, self.ffn_dropout, self.training)
+
+
+class TransformerDecoderLayer(Module):
+    """Pre-norm decoder block: self-attn (causal) [+ cross-attn] + FFN
+    (reference Transformer.scala block(), decode branch)."""
+
+    def __init__(self, hidden_size, num_heads, filter_size,
+                 attention_dropout=0.0, ffn_dropout=0.0,
+                 with_cross_attention=True):
+        super().__init__()
+        self.ffn_dropout = ffn_dropout
+        self.with_cross_attention = with_cross_attention
+        self.self_norm = LayerNormalization(hidden_size)
+        self.self_attn = Attention(hidden_size, num_heads, attention_dropout)
+        if with_cross_attention:
+            self.cross_norm = LayerNormalization(hidden_size)
+            self.cross_attn = Attention(hidden_size, num_heads,
+                                        attention_dropout)
+        self.ffn_norm = LayerNormalization(hidden_size)
+        self.ffn = FeedForwardNetwork(hidden_size, filter_size, ffn_dropout)
+
+    def forward(self, x, self_bias=None, enc_out=None, enc_bias=None,
+                cache=None, cache_index=None):
+        new_cache = None
+        if cache is not None:
+            y, self_cache = self.self_attn(
+                self.self_norm(x), None, self_bias,
+                cache=cache["self"], cache_index=cache_index)
+            new_cache = dict(cache)
+            new_cache["self"] = self_cache
+        else:
+            y = self.self_attn(self.self_norm(x), None, self_bias)
+        x = x + _residual_dropout(y, self.ffn_dropout, self.training)
+        if self.with_cross_attention and enc_out is not None:
+            if cache is not None and "cross" in cache:
+                y, _ = self.cross_attn(self.cross_norm(x), enc_out, enc_bias,
+                                       cache=cache["cross"])
+            else:
+                y = self.cross_attn(self.cross_norm(x), enc_out, enc_bias)
+            x = x + _residual_dropout(y, self.ffn_dropout, self.training)
+        y = self.ffn(self.ffn_norm(x))
+        x = x + _residual_dropout(y, self.ffn_dropout, self.training)
+        if cache is not None:
+            return x, new_cache
+        return x
+
+
+class Transformer(Module):
+    """Full transformer (reference nn/Transformer.scala:53).
+
+    transformer_type:
+      * "lm" — decoder-only language model: ``forward(tokens[B,T])`` →
+        logits [B,T,vocab] when with_share_weights_linear (shared
+        embedding/softmax matrix, reference shareWeights) else hidden
+        [B,T,H].
+      * "translation" — encoder-decoder: ``forward(src[B,Ts],
+        tgt[B,Tt])`` → decoder hidden/logits.
+
+    Token ids are 1-based with ``padding_value`` (default 0) as padding,
+    matching the reference's LookupTable(paddingValue, maskZero=true).
+    """
+
+    def __init__(self, vocab_size: int, hidden_size: int, num_heads: int,
+                 filter_size: int, num_hidden_layers: int,
+                 embedding_dropout: float = 0.0,
+                 attention_dropout: float = 0.0,
+                 ffn_dropout: float = 0.0,
+                 padding_value: float = 0.0,
+                 with_share_weights_linear: bool = False,
+                 transformer_type: str = "lm"):
+        super().__init__()
+        if transformer_type not in ("lm", "translation"):
+            raise ValueError(transformer_type)
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.embedding_dropout = embedding_dropout
+        self.padding_value = padding_value
+        self.with_share_weights_linear = with_share_weights_linear
+        self.transformer_type = transformer_type
+        from bigdl_tpu.utils.rng import next_key
+        self.embedding = Parameter(
+            jax.random.normal(next_key(), (vocab_size, hidden_size))
+            * (hidden_size ** -0.5))
+        if transformer_type == "translation":
+            self.encoder_layers = ModuleList([
+                TransformerEncoderLayer(hidden_size, num_heads, filter_size,
+                                        attention_dropout, ffn_dropout)
+                for _ in range(num_hidden_layers)])
+            self.encoder_norm = LayerNormalization(hidden_size)
+        self.decoder_layers = ModuleList([
+            TransformerDecoderLayer(
+                hidden_size, num_heads, filter_size, attention_dropout,
+                ffn_dropout,
+                with_cross_attention=(transformer_type == "translation"))
+            for _ in range(num_hidden_layers)])
+        self.decoder_norm = LayerNormalization(hidden_size)
+
+    # -- embedding ---------------------------------------------------------
+
+    def embed(self, tokens):
+        """LookupTable(padding→0) * sqrt(H) (reference buildLM embedding)."""
+        idx = jnp.clip(tokens.astype(jnp.int32) - 1, 0, self.vocab_size - 1)
+        emb = self.embedding[idx] * math.sqrt(self.hidden_size)
+        mask = (tokens != self.padding_value)
+        return emb * mask[..., None].astype(emb.dtype)
+
+    def logits(self, hidden):
+        """Project to vocab with the shared embedding matrix
+        (reference linearSharedWeigths/shareWeights)."""
+        return jnp.einsum("bth,vh->btv", hidden, self.embedding)
+
+    # -- topologies --------------------------------------------------------
+
+    def _decoder_input(self, emb):
+        t = emb.shape[1]
+        x = shift_right_3d(emb) + position_encoding(
+            t, self.hidden_size, dtype=emb.dtype)
+        return _residual_dropout(x, self.embedding_dropout, self.training)
+
+    def encode(self, src):
+        emb = self.embed(src)
+        bias = padding_bias(src, self.padding_value)
+        x = emb + position_encoding(emb.shape[1], self.hidden_size,
+                                    dtype=emb.dtype)
+        x = _residual_dropout(x, self.embedding_dropout, self.training)
+        for layer in self.encoder_layers:
+            x = layer(x, bias)
+        return self.encoder_norm(x), bias
+
+    def decode(self, tgt, enc_out=None, enc_bias=None):
+        emb = self.embed(tgt)
+        x = self._decoder_input(emb)
+        self_bias = causal_bias(x.shape[1], x.dtype)
+        for layer in self.decoder_layers:
+            x = layer(x, self_bias, enc_out, enc_bias)
+        x = self.decoder_norm(x)
+        if self.with_share_weights_linear:
+            return self.logits(x)
+        return x
+
+    def forward(self, *inputs):
+        if self.transformer_type == "lm":
+            (tokens,) = inputs
+            return self.decode(tokens)
+        src, tgt = inputs
+        enc_out, enc_bias = self.encode(src)
+        return self.decode(tgt, enc_out, enc_bias)
+
+    # -- incremental decoding (used by SequenceBeamSearch) -----------------
+
+    def init_decode_cache(self, batch: int, max_length: int,
+                          dtype=jnp.float32, enc_out=None):
+        """Fixed-size decode cache; when ``enc_out`` (encoder output) is
+        given, each layer's cross-attention K/V is projected ONCE and
+        cached (the reference re-projects per step via joinK/joinV)."""
+        cache = []
+        for layer in self.decoder_layers:
+            entry = {"self": layer.self_attn.init_cache(
+                batch, max_length, dtype)}
+            if enc_out is not None and layer.with_cross_attention:
+                ca = layer.cross_attn
+                entry["cross"] = {
+                    "k": ca._split_heads(ca.k_layer(enc_out)).astype(dtype),
+                    "v": ca._split_heads(ca.v_layer(enc_out)).astype(dtype),
+                }
+            cache.append(entry)
+        return cache
+
+    def decode_step(self, token, step, cache, enc_out=None, enc_bias=None):
+        """One decode step: token [B, 1] at position ``step`` (0-based
+        traced int), fixed-size cache.  Returns (logits [B, vocab],
+        new_cache).  ≙ reference Transformer.symbols (Transformer.scala)
+        but with static shapes."""
+        emb = self.embed(token)  # [B, 1, H]
+        max_len = cache[0]["self"]["k"].shape[2]
+        pos = position_encoding(max_len, self.hidden_size, dtype=emb.dtype)
+        x = emb + jax.lax.dynamic_slice_in_dim(pos, step, 1, axis=0)[None]
+        # bias over the cache: positions > step are invalid
+        valid = jnp.arange(max_len) <= step
+        self_bias = jnp.where(valid, 0.0, _NEG_INF).astype(
+            jnp.float32)[None, None, None, :]
+        new_cache = []
+        for layer, layer_cache in zip(self.decoder_layers, cache):
+            x, lc = layer(x, self_bias, enc_out, enc_bias,
+                          cache=layer_cache, cache_index=step)
+            new_cache.append(lc)
+        x = self.decoder_norm(x)
+        return self.logits(x)[:, 0, :], new_cache
+
+
+# ---------------------------------------------------------------------------
+# Beam search (reference nn/SequenceBeamSearch.scala)
+# ---------------------------------------------------------------------------
+
+class SequenceBeamSearch(Module):
+    """Length-normalized beam search over a ``symbols_to_logits`` step
+    function (reference nn/SequenceBeamSearch.scala:37).
+
+    The search state is a fixed-shape pytree advanced by a jitted step;
+    the loop runs ``lax.while_loop`` with the reference's early-stop
+    condition (best alive score can no longer beat worst finished score
+    under length normalization ``((5+len)/6)^alpha``,
+    SequenceBeamSearch.scala lengthNormalization:89).
+    """
+
+    def __init__(self, vocab_size: int, beam_size: int, alpha: float,
+                 max_decode_length: int, eos_id: int,
+                 padding_value: float = 0.0):
+        super().__init__()
+        self.vocab_size = vocab_size
+        self.beam_size = beam_size
+        self.alpha = alpha
+        self.max_decode_length = max_decode_length
+        self.eos_id = eos_id
+        self.padding_value = padding_value
+        self._logits_fn = None
+
+    def set_logit_fn(self, fn):
+        """fn(flat_ids[B*beam, 1], step, cache) -> (logits[B*beam, V],
+        cache)  (reference setLogitFn:309)."""
+        self._logits_fn = fn
+        return self
+
+    def _length_norm(self, length):
+        return ((5.0 + length) / 6.0) ** self.alpha
+
+    def search(self, batch_size: int, initial_cache):
+        """Run the search; returns (seq [B, beam, T+1], scores [B, beam])."""
+        assert self._logits_fn is not None, "call set_logit_fn first"
+        beam, vocab = self.beam_size, self.vocab_size
+        tmax = self.max_decode_length
+
+        def flatten(x):  # [B, beam, ...] -> [B*beam, ...]
+            return x.reshape((batch_size * beam,) + x.shape[2:])
+
+        def unflatten(x):
+            return x.reshape((batch_size, beam) + x.shape[1:])
+
+        neg = jnp.float32(_NEG_INF)
+        alive_seq = jnp.zeros((batch_size, beam, tmax + 1), jnp.int32)
+        alive_log_probs = jnp.tile(
+            jnp.array([[0.0] + [float(_NEG_INF)] * (beam - 1)], jnp.float32),
+            (batch_size, 1))
+        finished_seq = jnp.zeros_like(alive_seq)
+        finished_scores = jnp.full((batch_size, beam), neg)
+        finished_flags = jnp.zeros((batch_size, beam), bool)
+        # replicate the cache across beams
+        cache = jax.tree_util.tree_map(
+            lambda x: flatten(jnp.broadcast_to(
+                x[:, None], (batch_size, beam) + x.shape[1:])),
+            initial_cache)
+
+        state = (jnp.int32(0), alive_seq, alive_log_probs, finished_seq,
+                 finished_scores, finished_flags, cache)
+
+        def cond(state):
+            i, _, alive_lp, _, fin_scores, fin_flags, _ = state
+            max_alive = alive_lp[:, 0] / self._length_norm(tmax)
+            worst_fin = jnp.min(
+                jnp.where(fin_flags, fin_scores, neg), axis=1)
+            worst_fin = jnp.where(jnp.any(fin_flags, 1), worst_fin, neg)
+            bound_met = jnp.all(worst_fin >= max_alive)
+            return jnp.logical_and(i < tmax, jnp.logical_not(bound_met))
+
+        def body(state):
+            i, alive_seq, alive_lp, fin_seq, fin_scores, fin_flags, cache \
+                = state
+            ids = jax.lax.dynamic_slice_in_dim(alive_seq, i, 1, axis=2)
+            logits, cache = self._logits_fn(flatten(ids), i, cache)
+            log_probs = jax.nn.log_softmax(logits.astype(jnp.float32))
+            log_probs = unflatten(log_probs) + alive_lp[:, :, None]
+            flat_lp = log_probs.reshape(batch_size, beam * vocab)
+            # 2*beam candidates so EOS-heavy rows keep enough alive beams
+            top_lp, top_idx = jax.lax.top_k(flat_lp, 2 * beam)
+            beam_idx = top_idx // vocab
+            token_id = top_idx % vocab
+            cand_seq = jnp.take_along_axis(
+                alive_seq, beam_idx[:, :, None], axis=1)
+            cand_seq = jax.lax.dynamic_update_slice_in_dim(
+                cand_seq, token_id[:, :, None].astype(jnp.int32), i + 1,
+                axis=2)
+            is_eos = token_id == self.eos_id
+            # new alive = best beam non-EOS candidates
+            alive_cand_lp = jnp.where(is_eos, neg, top_lp)
+            new_alive_lp, alive_sel = jax.lax.top_k(alive_cand_lp, beam)
+            new_alive_seq = jnp.take_along_axis(
+                cand_seq, alive_sel[:, :, None], axis=1)
+            sel_beam = jnp.take_along_axis(beam_idx, alive_sel, axis=1)
+            cache = jax.tree_util.tree_map(
+                lambda x: flatten(jnp.take_along_axis(
+                    unflatten(x),
+                    sel_beam.reshape(sel_beam.shape + (1,) * (x.ndim - 1)),
+                    axis=1)),
+                cache)
+            # finished pool = old finished + EOS candidates, keep top beam
+            cand_scores = jnp.where(
+                is_eos, top_lp / self._length_norm(i + 1), neg)
+            pool_scores = jnp.concatenate([fin_scores, cand_scores], 1)
+            pool_flags = jnp.concatenate(
+                [fin_flags, is_eos], 1)
+            pool_seq = jnp.concatenate([fin_seq, cand_seq], 1)
+            new_fin_scores, fin_sel = jax.lax.top_k(pool_scores, beam)
+            new_fin_seq = jnp.take_along_axis(
+                pool_seq, fin_sel[:, :, None], axis=1)
+            new_fin_flags = jnp.take_along_axis(pool_flags, fin_sel, axis=1)
+            return (i + 1, new_alive_seq, new_alive_lp, new_fin_seq,
+                    new_fin_scores, new_fin_flags, cache)
+
+        (i, alive_seq, alive_lp, fin_seq, fin_scores, fin_flags, _) = \
+            jax.lax.while_loop(cond, body, state)
+        # rows with no finished hypothesis fall back to alive beams
+        any_fin = jnp.any(fin_flags, axis=1, keepdims=True)
+        seq = jnp.where(any_fin[:, :, None], fin_seq, alive_seq)
+        scores = jnp.where(any_fin, fin_scores,
+                           alive_lp / self._length_norm(tmax))
+        return seq[:, :, 1:], scores
+
+    def forward(self, batch_size, initial_cache):
+        return self.search(int(batch_size), initial_cache)

@@ -1,0 +1,14 @@
+"""Hot-op kernels (Pallas TPU + XLA fallbacks).
+
+This package is the TPU-native analogue of the reference's native kernel
+layer (BigDL-core: MKL/MKL-DNN/BigQuant JNI — see SURVEY §2.9): the ops
+where hand-scheduling beats the compiler live here, everything else is
+left to XLA fusion.
+"""
+
+from bigdl_tpu.ops.attention_kernels import (
+    dot_product_attention,
+    flash_attention,
+)
+
+__all__ = ["dot_product_attention", "flash_attention"]

@@ -168,7 +168,6 @@ class SequentialSchedule(LearningRateSchedule):
         lr = base_lr
         offset = 0
         out = None
-        remaining = step
         for sched, budget in self.entries:
             local = jnp.clip(step - offset, 0, budget)
             val = sched(base_lr, local, epoch)

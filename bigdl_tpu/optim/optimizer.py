@@ -234,16 +234,7 @@ class Optimizer:
                 grads = jax.tree_util.tree_map(lambda g: g * scale, grads)
             return grads
 
-        n_leaves = self._n_param_leaves
-        group_idx = self._group_idx
-        ptreedef = self._ptreedef
-
-        def merge_groups(groups):
-            full = [None] * n_leaves
-            for idxs, glist in zip(group_idx, groups):
-                for i, v in zip(idxs, glist):
-                    full[i] = v
-            return jax.tree_util.tree_unflatten(ptreedef, full)
+        merge_groups = self._merge_groups_host  # jit-traceable as-is
 
         def step(params_groups, rest, opt_states, x, y, rng, epoch):
             from bigdl_tpu.core.module import cast_floating

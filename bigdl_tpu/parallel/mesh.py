@@ -34,7 +34,9 @@ AXES = ("data", "fsdp", "model", "pipe", "seq", "expert")
 
 
 def local_device_count() -> int:
-    return len(jax.devices())
+    """Devices attached to THIS host (jax.local_device_count);
+    use len(jax.devices()) for the global count."""
+    return jax.local_device_count()
 
 
 def _infer(shape: Dict[str, int], n: int) -> Dict[str, int]:

@@ -1,0 +1,158 @@
+"""Attention/Transformer stack tests.
+
+Oracles: torch F.scaled_dot_product_attention for the kernel;
+self-consistency between the Pallas flash kernel and the XLA path;
+incremental decode vs full causal forward; beam search on a toy scorer.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import torch
+import torch.nn.functional as F
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.ops.attention_kernels import flash_attention, xla_attention
+
+
+def rnd(*shape, seed=0):
+    return np.random.RandomState(seed).randn(*shape).astype(np.float32)
+
+
+def test_xla_attention_matches_torch_sdpa():
+    q, k, v = rnd(2, 4, 10, 16, seed=1), rnd(2, 4, 12, 16, seed=2), \
+        rnd(2, 4, 12, 16, seed=3)
+    out = xla_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+    ref = F.scaled_dot_product_attention(
+        torch.tensor(q), torch.tensor(k), torch.tensor(v)).numpy()
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_xla_attention_causal_matches_torch():
+    q, k, v = rnd(2, 2, 8, 16, seed=4), rnd(2, 2, 8, 16, seed=5), \
+        rnd(2, 2, 8, 16, seed=6)
+    out = xla_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                        causal=True)
+    ref = F.scaled_dot_product_attention(
+        torch.tensor(q), torch.tensor(k), torch.tensor(v),
+        is_causal=True).numpy()
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_kernel_matches_xla(causal):
+    q = jnp.asarray(rnd(2, 3, 256, 64, seed=7))
+    k = jnp.asarray(rnd(2, 3, 256, 64, seed=8))
+    v = jnp.asarray(rnd(2, 3, 256, 64, seed=9))
+    bias = None if causal else jnp.asarray(rnd(2, 1, 256, 256, seed=10))
+    out = flash_attention(q, k, v, bias, causal=causal, interpret=True)
+    ref = xla_attention(q, k, v, bias, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_multihead_attention_matches_torch():
+    h, heads, b, t = 32, 4, 2, 6
+    x = rnd(b, t, h, seed=11)
+    layer = nn.Attention(h, heads).eval_mode()
+    tl = torch.nn.MultiheadAttention(h, heads, bias=False, batch_first=True)
+    with torch.no_grad():
+        wq = torch.tensor(np.asarray(layer.q_layer.weight))
+        wk = torch.tensor(np.asarray(layer.k_layer.weight))
+        wv = torch.tensor(np.asarray(layer.v_layer.weight))
+        tl.in_proj_weight.copy_(torch.cat([wq, wk, wv], 0))
+        tl.out_proj.weight.copy_(
+            torch.tensor(np.asarray(layer.output_layer.weight)))
+    out = layer(jnp.asarray(x))
+    ref, _ = tl(torch.tensor(x), torch.tensor(x), torch.tensor(x))
+    np.testing.assert_allclose(np.asarray(out), ref.detach().numpy(),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_transformer_lm_forward_and_grad():
+    model = nn.Transformer(vocab_size=17, hidden_size=16, num_heads=2,
+                           filter_size=32, num_hidden_layers=2,
+                           with_share_weights_linear=True).eval_mode()
+    tokens = jnp.asarray(
+        np.random.RandomState(0).randint(1, 17, size=(2, 5)))
+    logits = model(tokens)
+    assert logits.shape == (2, 5, 17)
+
+    from bigdl_tpu.core.module import partition, combine
+    params, rest = partition(model)
+
+    def loss_fn(p):
+        return jnp.sum(combine(p, rest).forward(tokens) ** 2)
+
+    grads = jax.grad(loss_fn)(params)
+    leaves = jax.tree_util.tree_leaves(grads)
+    assert leaves and all(np.isfinite(np.asarray(g)).all() for g in leaves)
+
+
+def test_transformer_causality():
+    """Changing a future token must not change past logits."""
+    model = nn.Transformer(vocab_size=11, hidden_size=16, num_heads=2,
+                           filter_size=32, num_hidden_layers=2,
+                           with_share_weights_linear=True).eval_mode()
+    t1 = jnp.asarray([[1, 2, 3, 4, 5]])
+    t2 = jnp.asarray([[1, 2, 3, 9, 5]])
+    l1, l2 = model(t1), model(t2)
+    # positions 0..3 see tokens shifted-right 0..2 / 0..3 → first 3 match
+    np.testing.assert_allclose(np.asarray(l1[:, :3]), np.asarray(l2[:, :3]),
+                               rtol=1e-5, atol=1e-6)
+    assert not np.allclose(np.asarray(l1[:, 4]), np.asarray(l2[:, 4]))
+
+
+def test_incremental_decode_matches_full_forward():
+    model = nn.Transformer(vocab_size=13, hidden_size=16, num_heads=2,
+                           filter_size=32, num_hidden_layers=2,
+                           with_share_weights_linear=True).eval_mode()
+    tokens = jnp.asarray(np.random.RandomState(1).randint(1, 13, size=(2, 6)))
+    full = model(tokens)  # logits at position i use tokens < i (shifted)
+    cache = model.init_decode_cache(2, 8)
+    # Incremental convention (reference SequenceBeamSearch: ids start at
+    # 0 = pad/start): feeding shifted token s_i = [0, t_0, t_1, ...][i]
+    # at step i reproduces full[:, i].
+    shifted = jnp.concatenate(
+        [jnp.zeros((2, 1), tokens.dtype), tokens[:, :-1]], axis=1)
+    for i in range(6):
+        logits, cache = model.decode_step(shifted[:, i:i + 1], i, cache)
+        np.testing.assert_allclose(
+            np.asarray(logits), np.asarray(full[:, i]),
+            rtol=1e-4, atol=1e-4)
+
+
+def test_beam_search_toy():
+    """Scorer that deterministically prefers token (step+2) then EOS."""
+    vocab, beam, tmax, eos = 8, 3, 5, 1
+
+    def logits_fn(ids, step, cache):
+        b = ids.shape[0]
+        # strongly prefer token 2 at step 0, 3 at step 1, then EOS
+        prefs = jnp.where(step == 0, 2, jnp.where(step == 1, 3, eos))
+        logits = jnp.full((b, vocab), -5.0)
+        logits = logits.at[:, prefs].set(5.0)
+        return logits, cache
+
+    bs = nn.SequenceBeamSearch(vocab, beam, alpha=0.6,
+                               max_decode_length=tmax, eos_id=eos)
+    bs.set_logit_fn(logits_fn)
+    seq, scores = bs.search(2, {"dummy": jnp.zeros((2, 1))})
+    assert seq.shape == (2, beam, tmax)
+    # best hypothesis: [2, 3, eos, ...]
+    np.testing.assert_array_equal(np.asarray(seq[0, 0, :3]), [2, 3, eos])
+    assert float(scores[0, 0]) > float(scores[0, 1]) - 1e-6
+
+
+def test_transformer_translation_mode():
+    model = nn.Transformer(vocab_size=15, hidden_size=16, num_heads=2,
+                           filter_size=32, num_hidden_layers=1,
+                           transformer_type="translation",
+                           with_share_weights_linear=True).eval_mode()
+    src = jnp.asarray([[1, 2, 3, 0], [4, 5, 0, 0]])
+    tgt = jnp.asarray([[6, 7], [8, 9]])
+    out = model(src, tgt)
+    assert out.shape == (2, 2, 15)
+    assert np.isfinite(np.asarray(out)).all()

@@ -1,0 +1,40 @@
+"""Data pipeline tests (reference dataset/ specs, SURVEY §2.7)."""
+
+import numpy as np
+
+from bigdl_tpu.dataset import DataSet, Sample, SampleToMiniBatch
+from bigdl_tpu.dataset.dataset import DistributedDataSet
+
+
+def _samples(n):
+    return [Sample(np.full((2,), i, np.float32), i) for i in range(n)]
+
+
+def test_distributed_transform_preserves_shard():
+    """Regression: .transform() must not re-shard an already-sharded
+    DistributedDataSet (it used to re-run __init__ on the shard)."""
+    ds = DistributedDataSet(_samples(16), shuffle=False,
+                            process_index=1, process_count=4)
+    shard_before = [s.feature[0] for s in ds.data(train=False)]
+    out = ds.transform(SampleToMiniBatch(2))
+    assert out.size() == 16  # global size preserved
+    assert out.process_index == 1 and out.process_count == 4
+    batches = list(out.data(train=False))
+    got = np.concatenate([np.asarray(b.input)[:, 0] for b in batches])
+    np.testing.assert_array_equal(sorted(got), sorted(shard_before))
+
+
+def test_round_robin_sharding_partitions_data():
+    all_feats = []
+    for p in range(3):
+        ds = DistributedDataSet(_samples(10), shuffle=False,
+                                process_index=p, process_count=3)
+        all_feats.extend(s.feature[0] for s in ds.data(train=False))
+    np.testing.assert_array_equal(sorted(all_feats), np.arange(10))
+
+
+def test_local_dataset_chained_transforms():
+    ds = DataSet.array(_samples(8), shuffle=False) \
+        .transform(SampleToMiniBatch(4))
+    batches = list(ds.data(train=False))
+    assert len(batches) == 2 and batches[0].input.shape == (4, 2)
