@@ -391,9 +391,12 @@ class Transformer(Module):
 
     def decode_step(self, token, step, cache, enc_out=None, enc_bias=None):
         """One decode step: token [B, 1] at position ``step`` (0-based
-        traced int), fixed-size cache.  Returns (logits [B, vocab],
-        new_cache).  ≙ reference Transformer.symbols (Transformer.scala)
-        but with static shapes."""
+        traced int), fixed-size cache.  Returns (out [B, vocab] when
+        with_share_weights_linear else hidden [B, H], new_cache) —
+        consistent with decode()/forward(); wire an external head in
+        your logits_fn when weights aren't shared.  ≙ reference
+        Transformer.symbols (Transformer.scala) but with static
+        shapes."""
         emb = self.embed(token)  # [B, 1, H]
         max_len = cache[0]["self"]["k"].shape[2]
         pos = position_encoding(max_len, self.hidden_size, dtype=emb.dtype)
@@ -408,7 +411,9 @@ class Transformer(Module):
                           cache=layer_cache, cache_index=step)
             new_cache.append(lc)
         x = self.decoder_norm(x)
-        return self.logits(x)[:, 0, :], new_cache
+        if self.with_share_weights_linear:
+            return self.logits(x)[:, 0, :], new_cache
+        return x[:, 0, :], new_cache
 
 
 # ---------------------------------------------------------------------------

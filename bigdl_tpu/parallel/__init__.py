@@ -6,3 +6,7 @@ from bigdl_tpu.parallel.sharding import (
     ShardingRules, replicated, shard_model_params, model_shardings,
     fsdp_spec,
 )
+from bigdl_tpu.parallel.ring_attention import (
+    ring_attention, ring_self_attention,
+)
+from bigdl_tpu.parallel.pipeline import gpipe, Pipeline

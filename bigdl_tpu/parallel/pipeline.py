@@ -1,0 +1,154 @@
+"""Pipeline parallelism over a mesh axis.
+
+The reference has NO pipeline parallelism (SURVEY §2.6) — this is new,
+TPU-first capability.  The design is the collective-permute pipeline
+from the scaling playbook: the stages of a deep network are sharded over
+the ``pipe`` mesh axis (each device holds ONE stage's parameters — a
+stack of identical blocks, e.g. transformer layers, stacked on a leading
+axis and sharded dim-0).  Microbatches stream through: at every tick
+each device applies its stage to the activation it holds, then passes
+the result to the next device with ``lax.ppermute`` (ICI
+neighbor-to-neighbor).  A full batch of M microbatches over S stages
+drains in M + S - 1 ticks (GPipe schedule; bubble fraction
+(S-1)/(M+S-1)).
+
+``gpipe`` is the functional entry; :class:`Pipeline` wraps a list of
+identical Modules into the stacked representation.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, List
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from bigdl_tpu.core.module import Module, ModuleList
+
+__all__ = ["gpipe", "Pipeline"]
+
+
+def _pipe_loop(stage_params, x_mb, stage_apply, axis_name: str):
+    """Per-device pipeline loop (runs under shard_map).
+
+    stage_params: this device's stage parameters (leading stage axis
+    already sharded away → local block params).
+    x_mb: [M, mb, ...] all microbatches (replicated on every device).
+    Returns [M, mb, ...] outputs (replicated; only the last stage's
+    contribution is nonzero before the psum).
+    """
+    s_total = jax.lax.psum(1, axis_name)
+    me = jax.lax.axis_index(axis_name)
+    # shard_map delivers the stage-sharded leaves with a size-1 leading
+    # dim — strip it so stage_apply sees one stage's params as documented
+    stage_params = jax.tree_util.tree_map(lambda l: l[0], stage_params)
+    m_total = x_mb.shape[0]
+    ticks = m_total + s_total - 1
+
+    ys0 = jnp.zeros_like(x_mb)
+    carry0 = jnp.zeros_like(x_mb[0])
+    perm = [(i, i + 1) for i in range(s_total - 1)]
+
+    def tick(t, state):
+        carry, ys = state
+        # stage 0 ingests microbatch t (while t < M); later stages use
+        # the activation ppermuted from the previous stage
+        feed_idx = jnp.clip(t, 0, m_total - 1)
+        inp = jnp.where(me == 0, x_mb[feed_idx], carry)
+        out = stage_apply(stage_params, inp)
+        # last stage emits microbatch t - (S-1) when it's valid
+        emit_idx = jnp.clip(t - (s_total - 1), 0, m_total - 1)
+        valid = (t >= s_total - 1) & (me == s_total - 1)
+        upd = jnp.where(valid, out, ys[emit_idx])
+        ys = jax.lax.dynamic_update_index_in_dim(ys, upd, emit_idx, 0)
+        carry = jax.lax.ppermute(out, axis_name, perm)
+        return carry, ys
+
+    _, ys = jax.lax.fori_loop(0, ticks, tick, (carry0, ys0))
+    # replicate the last stage's outputs to every device
+    keep = (me == s_total - 1).astype(ys.dtype)
+    return jax.lax.psum(ys * keep, axis_name)
+
+
+def gpipe(stage_apply: Callable, stacked_params, x, mesh: Mesh,
+          axis: str = "pipe", num_microbatches: int = 1):
+    """Run ``x`` through S pipeline stages sharded over ``axis``.
+
+    stage_apply(stage_params, x_mb) -> y_mb applies ONE stage;
+    stacked_params is a pytree whose leaves have a leading stage axis of
+    size S = mesh.shape[axis]; x is the full batch [B, ...] with B
+    divisible by num_microbatches.
+    """
+    s = mesh.shape[axis]
+    b = x.shape[0]
+    assert b % num_microbatches == 0, (b, num_microbatches)
+    x_mb = x.reshape((num_microbatches, b // num_microbatches)
+                     + x.shape[1:])
+
+    fn = jax.shard_map(
+        functools.partial(_pipe_loop, stage_apply=stage_apply,
+                          axis_name=axis),
+        mesh=mesh,
+        in_specs=(_stage_specs(stacked_params, axis), P()),
+        out_specs=P(),
+        check_vma=False,
+    )
+    y_mb = fn(stacked_params, x_mb)
+    return y_mb.reshape((b,) + y_mb.shape[2:])
+
+
+def _stage_specs(stacked_params, axis: str):
+    return jax.tree_util.tree_map(lambda _: P(axis), stacked_params)
+
+
+class Pipeline(Module):
+    """Pipeline container over identical blocks (reference analogue:
+    none — Sequential executes stages on one node, nn/Sequential.scala).
+
+    ``Pipeline([block]*N, num_microbatches)`` stacks the blocks'
+    parameters on a leading axis; ``forward(x)`` runs sequentially (for
+    single-device correctness/testing), while :meth:`forward_on_mesh`
+    runs the GPipe schedule over a mesh axis.  N must equal the mesh
+    axis size × blocks-per-stage.
+    """
+
+    def __init__(self, blocks: List[Module], num_microbatches: int = 1):
+        super().__init__()
+        self.blocks = ModuleList(blocks)
+        self.num_microbatches = num_microbatches
+
+    def forward(self, x):
+        for blk in self.blocks:
+            x = blk(x)
+        return x
+
+    def _stacked(self):
+        """Stack per-block pytrees leaf-wise onto a leading stage axis."""
+        trees = list(self.blocks)
+        return jax.tree_util.tree_map(
+            lambda *leaves: jnp.stack(leaves), *trees)
+
+    def forward_on_mesh(self, x, mesh: Mesh, axis: str = "pipe"):
+        s = mesh.shape[axis]
+        n = len(self.blocks)
+        assert n % s == 0, (n, s)
+        per_stage = n // s
+
+        def stage_apply(stage_tree, x_mb):
+            # stage_tree leaves: [per_stage, ...] — apply blocks in order
+            def one(i, acc):
+                blk = jax.tree_util.tree_map(
+                    lambda l: jax.lax.dynamic_index_in_dim(
+                        l, i, 0, keepdims=False), stage_tree)
+                return blk(acc)
+            return jax.lax.fori_loop(0, per_stage, one, x_mb)
+
+        # regroup the N stacked blocks as [S, per_stage, ...]
+        stacked = jax.tree_util.tree_map(
+            lambda l: l.reshape((s, per_stage) + l.shape[1:]),
+            self._stacked())
+
+        return gpipe(stage_apply, stacked, x, mesh, axis,
+                     self.num_microbatches)

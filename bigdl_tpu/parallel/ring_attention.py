@@ -1,0 +1,131 @@
+"""Ring attention: sequence/context parallelism over a mesh axis.
+
+The reference has NO sequence parallelism of any kind (SURVEY §5.7 —
+sequences are processed whole per replica, nn/Recurrent.scala:243,
+nn/Attention.scala).  This module is new, TPU-first capability: contexts
+longer than one chip's HBM are sharded over a mesh axis and attention is
+computed with a ring schedule (Liu et al., "Ring Attention with
+Blockwise Transformers").
+
+Mechanics: under ``shard_map`` each device holds the local Q/K/V chunk
+[B, H, T/n, D].  The ring runs n steps; at step s every device computes
+blockwise attention between its Q chunk and the K/V chunk that
+originated on device (me - s) mod n, merging partial results with the
+online-softmax (m, l, acc) recurrence, then passes its current K/V
+chunk to the next neighbor with ``lax.ppermute`` — the collective rides
+a physical ICI ring, overlapping compute with transfer.  Causality is
+handled per (my_chunk, src_chunk) pair: full block when src < mine,
+diagonal mask when equal, skipped (fully masked) when src > mine.
+
+``ring_attention`` is the per-shard function (call inside your own
+shard_map); :func:`ring_self_attention` wraps a global [B, H, T, D]
+array with the shard_map + NamedSharding plumbing.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["ring_attention", "ring_self_attention"]
+
+_NEG_INF = -1e9
+
+
+def _block_attend(q, k, v, bias_blk, scale, acc, m_prev, l_prev):
+    """One blockwise-attention accumulation step (online softmax).
+
+    q [B,H,Tq,D]; k,v [B,H,Tc,D]; bias_blk broadcastable [B,H,Tq,Tc] or
+    None; carries acc [B,H,Tq,D], m/l [B,H,Tq] in fp32.
+    """
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if bias_blk is not None:
+        s = s + bias_blk.astype(jnp.float32)
+    m_cur = jnp.max(s, axis=-1)
+    m_new = jnp.maximum(m_prev, m_cur)
+    p = jnp.exp(s - m_new[..., None])
+    alpha = jnp.exp(m_prev - m_new)
+    l_new = l_prev * alpha + jnp.sum(p, axis=-1)
+    acc = acc * alpha[..., None] + jnp.einsum(
+        "bhqk,bhkd->bhqd", p.astype(v.dtype), v,
+        preferred_element_type=jnp.float32)
+    return acc, m_new, l_new
+
+
+def ring_attention(q, k, v, axis_name: str, *, causal: bool = False,
+                   scale: Optional[float] = None, bias=None):
+    """Per-shard ring attention (call under shard_map).
+
+    q/k/v: the LOCAL sequence chunk [B, H, Tc, D]; axis_name: the mesh
+    axis the sequence is sharded over.  bias, if given, is the LOCAL
+    [B, H, Tc, T_global] slice of the additive attention bias (rows =
+    my queries, columns = the full key axis in GLOBAL order).
+    Returns the local output chunk [B, H, Tc, D].
+    """
+    n = jax.lax.psum(1, axis_name)
+    me = jax.lax.axis_index(axis_name)
+    b, h, tc, d = q.shape
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    acc0 = jnp.zeros((b, h, tc, d), jnp.float32)
+    m0 = jnp.full((b, h, tc), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, tc), jnp.float32)
+
+    def body(s, carry):
+        acc, m, l, k_cur, v_cur = carry
+        src = (me - s) % n  # chunk index the current K/V originated from
+        blk_bias = None
+        if bias is not None:
+            blk_bias = jax.lax.dynamic_slice_in_dim(
+                bias, src * tc, tc, axis=3)
+        if causal:
+            q_pos = me * tc + jax.lax.broadcasted_iota(
+                jnp.int32, (tc, tc), 0)
+            k_pos = src * tc + jax.lax.broadcasted_iota(
+                jnp.int32, (tc, tc), 1)
+            cb = jnp.where(q_pos >= k_pos, 0.0, _NEG_INF).astype(jnp.float32)
+            blk_bias = cb if blk_bias is None else blk_bias + cb
+        acc, m, l = _block_attend(q, k_cur, v_cur, blk_bias, scale,
+                                  acc, m, l)
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return acc, m, l, k_nxt, v_nxt
+
+    acc, m, l, _, _ = jax.lax.fori_loop(
+        0, n, body, (acc0, m0, l0, k, v))
+    # rows that saw no unmasked key (can't happen for causal self-attn
+    # since the diagonal block always contributes) — guard anyway
+    safe_l = jnp.where(l == 0.0, 1.0, l)
+    return (acc / safe_l[..., None]).astype(q.dtype)
+
+
+def ring_self_attention(q, k, v, mesh: Mesh, axis: str = "seq", *,
+                        causal: bool = False,
+                        scale: Optional[float] = None, bias=None):
+    """Global entry: q/k/v [B, H, T, D] (T divisible by mesh axis size)
+    are sequence-sharded over ``axis`` and attended with the ring
+    schedule.  Equivalent to full attention, O(T/n) memory per chip."""
+    spec = P(None, None, axis, None)
+    if bias is None:
+        fn = jax.shard_map(
+            functools.partial(ring_attention, axis_name=axis,
+                              causal=causal, scale=scale),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False)
+        return fn(q, k, v)
+    bias = jnp.broadcast_to(
+        bias, (q.shape[0], q.shape[1], q.shape[2], k.shape[2]))
+    fn = jax.shard_map(
+        lambda q_, k_, v_, b_: ring_attention(
+            q_, k_, v_, axis_name=axis, causal=causal, scale=scale,
+            bias=b_),
+        mesh=mesh, in_specs=(spec, spec, spec, spec), out_specs=spec,
+        check_vma=False)
+    return fn(q, k, v, bias)
