@@ -388,6 +388,20 @@ class Optimizer:
                         self.train_summary.add_scalar(
                             "Throughput", n / max(dt, 1e-9),
                             self.state["neval"])
+                        lr = _scheduled_lr(methods[0], opt_states[0],
+                                           epoch)
+                        if lr is not None:
+                            self.train_summary.add_scalar(
+                                "LearningRate", lr, self.state["neval"])
+                        trig = (self.train_summary.get_summary_trigger(
+                            "Parameters")
+                            if hasattr(self.train_summary,
+                                       "get_summary_trigger") else None)
+                        if trig is not None and trig(self.state):
+                            self.train_summary.save_parameters(
+                                combine(self._merge_groups_host(
+                                    params_groups), rest),
+                                self.state["neval"], self.state)
                     self.state["neval"] += 1
                     self.state["is_epoch_end"] = False
                     self._maybe_validate_checkpoint(
@@ -485,3 +499,18 @@ class Optimizer:
 
 def _to_plain(tree):
     return jax.tree_util.tree_map(lambda x: np.asarray(x), tree)
+
+
+def _scheduled_lr(method, opt_state, epoch):
+    """The learning rate actually applied this iteration: base lr run
+    through the method's schedule at the current step count."""
+    lr = getattr(method, "learning_rate", None)
+    if lr is None:
+        return None
+    sched = getattr(method, "schedule", None)
+    if sched is None:
+        return float(lr)
+    t = opt_state.get("t")
+    if t is None:
+        return float(lr)
+    return float(sched(lr, jnp.asarray(t), epoch))

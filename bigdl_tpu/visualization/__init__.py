@@ -1,0 +1,9 @@
+"""TensorBoard-compatible visualization (≙ reference visualization/)."""
+
+from bigdl_tpu.visualization.writer import (   # noqa: F401
+    RecordWriter, FileWriter, Summary, TrainSummary, ValidationSummary,
+)
+from bigdl_tpu.visualization.reader import FileReader  # noqa: F401
+from bigdl_tpu.visualization.proto import (    # noqa: F401
+    Event, ScalarValue, make_histogram,
+)

@@ -1,0 +1,48 @@
+"""CRC32C (Castagnoli) checksum + TFRecord masking.
+
+Reference: spark/dl/src/main/java/netty/Crc32c.java and utils/Crc32.scala
+(masked CRC framing for TF event / TFRecord files).  Pure-software
+table-driven implementation; the native IO extension (bigdl_tpu.native)
+provides an accelerated path when built.
+"""
+
+from __future__ import annotations
+
+__all__ = ["crc32c", "masked_crc32c", "unmask_crc32c"]
+
+_POLY = 0x82F63B78  # reflected 0x1EDC6F41
+
+
+def _make_table():
+    table = []
+    for i in range(256):
+        crc = i
+        for _ in range(8):
+            crc = (crc >> 1) ^ (_POLY if crc & 1 else 0)
+        table.append(crc)
+    return table
+
+
+_TABLE = _make_table()
+
+
+def crc32c(data: bytes, crc: int = 0) -> int:
+    crc ^= 0xFFFFFFFF
+    for b in data:
+        crc = _TABLE[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+_MASK_DELTA = 0xA282EAD8
+
+
+def masked_crc32c(data: bytes) -> int:
+    """The masked CRC used by the TFRecord/event-file framing
+    (Crc32c.java / RecordWriter.scala)."""
+    crc = crc32c(data)
+    return (((crc >> 15) | (crc << 17)) + _MASK_DELTA) & 0xFFFFFFFF
+
+
+def unmask_crc32c(masked: int) -> int:
+    rot = (masked - _MASK_DELTA) & 0xFFFFFFFF
+    return ((rot >> 17) | (rot << 15)) & 0xFFFFFFFF

@@ -1,0 +1,256 @@
+"""Minimal protobuf wire-format codec for TensorBoard event files.
+
+Reference: visualization/Summary.scala:87-108 builds
+``tensorflow.framework.Summary`` protos via generated Java classes; here
+the handful of messages we need (Event, Summary, Summary.Value,
+HistogramProto) are encoded/decoded directly on the wire format, so no
+protobuf runtime or generated code is required.
+
+Wire schema (field numbers match tensorflow/core/util/event.proto and
+tensorflow/core/framework/summary.proto):
+
+    Event:          double wall_time = 1; int64 step = 2;
+                    string file_version = 3; Summary summary = 5;
+    Summary:        repeated Value value = 1;
+    Summary.Value:  string tag = 1; float simple_value = 2;
+                    HistogramProto histo = 7;
+    HistogramProto: double min = 1; double max = 2; double num = 3;
+                    double sum = 4; double sum_squares = 5;
+                    repeated double bucket_limit = 6 [packed];
+                    repeated double bucket = 7 [packed];
+"""
+
+from __future__ import annotations
+
+import math
+import struct
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["encode_event", "decode_event", "make_histogram",
+           "ScalarValue", "HistogramValue", "Event"]
+
+
+# ---- primitive writers ----------------------------------------------------
+
+def _varint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _key(field: int, wire: int) -> bytes:
+    return _varint((field << 3) | wire)
+
+
+def _double(field: int, v: float) -> bytes:
+    return _key(field, 1) + struct.pack("<d", v)
+
+
+def _float(field: int, v: float) -> bytes:
+    return _key(field, 5) + struct.pack("<f", v)
+
+
+def _int64(field: int, v: int) -> bytes:
+    return _key(field, 0) + _varint(v & 0xFFFFFFFFFFFFFFFF)
+
+
+def _bytes(field: int, v: bytes) -> bytes:
+    return _key(field, 2) + _varint(len(v)) + v
+
+
+def _packed_doubles(field: int, vs) -> bytes:
+    payload = b"".join(struct.pack("<d", float(v)) for v in vs)
+    return _bytes(field, payload)
+
+
+# ---- histogram ------------------------------------------------------------
+
+class HistogramValue:
+    def __init__(self, minimum, maximum, num, total, sum_squares,
+                 bucket_limit, bucket):
+        self.min = minimum
+        self.max = maximum
+        self.num = num
+        self.sum = total
+        self.sum_squares = sum_squares
+        self.bucket_limit = list(bucket_limit)
+        self.bucket = list(bucket)
+
+
+def _default_bucket_limits() -> List[float]:
+    """TensorBoard's exponential bucket edges (±1e-12 … ±1e20, ×1.1)."""
+    pos = []
+    v = 1e-12
+    while v < 1e20:
+        pos.append(v)
+        v *= 1.1
+    return [-x for x in reversed(pos)] + pos + [float("inf")]
+
+
+_BUCKET_LIMITS = _default_bucket_limits()
+
+
+def make_histogram(values: np.ndarray) -> HistogramValue:
+    """Build a TensorBoard histogram from raw values
+    (≙ Summary.histogram, visualization/Summary.scala:97).
+
+    Non-finite values (NaN/±inf — diverging training) are dropped rather
+    than crashing the writer; overflow values land in the final +inf
+    bucket."""
+    values = np.asarray(values, dtype=np.float64).ravel()
+    values = values[np.isfinite(values)]
+    limits = np.asarray(_BUCKET_LIMITS[:-1])
+    idx = np.minimum(np.searchsorted(limits, values, side="left"),
+                     len(_BUCKET_LIMITS) - 1)
+    counts = np.bincount(idx, minlength=len(_BUCKET_LIMITS))
+    # trim trailing empty buckets (TensorBoard convention keeps one extra)
+    nz = np.nonzero(counts)[0]
+    end = min((nz[-1] + 2) if len(nz) else 1, len(_BUCKET_LIMITS))
+    return HistogramValue(
+        minimum=float(values.min()) if values.size else 0.0,
+        maximum=float(values.max()) if values.size else 0.0,
+        num=float(values.size),
+        total=float(values.sum()),
+        sum_squares=float(np.square(values).sum()),
+        bucket_limit=_BUCKET_LIMITS[:end],
+        bucket=list(counts[:end].astype(float)),
+    )
+
+
+def _encode_histo(h: HistogramValue) -> bytes:
+    return (_double(1, h.min) + _double(2, h.max) + _double(3, h.num)
+            + _double(4, h.sum) + _double(5, h.sum_squares)
+            + _packed_doubles(6, h.bucket_limit)
+            + _packed_doubles(7, h.bucket))
+
+
+# ---- event ----------------------------------------------------------------
+
+class ScalarValue:
+    def __init__(self, tag: str, value: float):
+        self.tag = tag
+        self.value = value
+
+
+class Event:
+    def __init__(self, wall_time: float = 0.0, step: int = 0,
+                 file_version: Optional[str] = None,
+                 scalars: Optional[List[ScalarValue]] = None,
+                 histograms: Optional[List[Tuple[str, HistogramValue]]]
+                 = None):
+        self.wall_time = wall_time
+        self.step = step
+        self.file_version = file_version
+        self.scalars = scalars or []
+        self.histograms = histograms or []
+
+
+def encode_event(ev: Event) -> bytes:
+    out = _double(1, ev.wall_time) + _int64(2, ev.step)
+    if ev.file_version is not None:
+        out += _bytes(3, ev.file_version.encode())
+    values = b""
+    for s in ev.scalars:
+        values += _bytes(1, _bytes(1, s.tag.encode())
+                         + _float(2, float(s.value)))
+    for tag, h in ev.histograms:
+        values += _bytes(1, _bytes(1, tag.encode())
+                         + _bytes(7, _encode_histo(h)))
+    if values:
+        out += _bytes(5, values)
+    return out
+
+
+# ---- decoding (FileReader support) ---------------------------------------
+
+def _read_varint(buf: bytes, pos: int) -> Tuple[int, int]:
+    result = shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def _iter_fields(buf: bytes):
+    pos = 0
+    while pos < len(buf):
+        key, pos = _read_varint(buf, pos)
+        field, wire = key >> 3, key & 7
+        if wire == 0:
+            val, pos = _read_varint(buf, pos)
+        elif wire == 1:
+            val = buf[pos:pos + 8]
+            pos += 8
+        elif wire == 2:
+            ln, pos = _read_varint(buf, pos)
+            val = buf[pos:pos + ln]
+            pos += ln
+        elif wire == 5:
+            val = buf[pos:pos + 4]
+            pos += 4
+        else:  # pragma: no cover - groups unused
+            raise ValueError(f"unsupported wire type {wire}")
+        yield field, wire, val
+
+
+def _decode_histo(buf: bytes) -> HistogramValue:
+    h = HistogramValue(0, 0, 0, 0, 0, [], [])
+    for field, wire, val in _iter_fields(buf):
+        if wire == 1:
+            d = struct.unpack("<d", val)[0]
+            if field == 1:
+                h.min = d
+            elif field == 2:
+                h.max = d
+            elif field == 3:
+                h.num = d
+            elif field == 4:
+                h.sum = d
+            elif field == 5:
+                h.sum_squares = d
+        elif wire == 2 and field in (6, 7):
+            arr = [struct.unpack("<d", val[i:i + 8])[0]
+                   for i in range(0, len(val), 8)]
+            if field == 6:
+                h.bucket_limit = arr
+            else:
+                h.bucket = arr
+    return h
+
+
+def decode_event(buf: bytes) -> Event:
+    ev = Event()
+    for field, wire, val in _iter_fields(buf):
+        if field == 1 and wire == 1:
+            ev.wall_time = struct.unpack("<d", val)[0]
+        elif field == 2 and wire == 0:
+            ev.step = val
+        elif field == 3 and wire == 2:
+            ev.file_version = val.decode()
+        elif field == 5 and wire == 2:
+            for f2, w2, v2 in _iter_fields(val):
+                if f2 == 1 and w2 == 2:
+                    tag, simple, histo = None, None, None
+                    for f3, w3, v3 in _iter_fields(v2):
+                        if f3 == 1 and w3 == 2:
+                            tag = v3.decode()
+                        elif f3 == 2 and w3 == 5:
+                            simple = struct.unpack("<f", v3)[0]
+                        elif f3 == 7 and w3 == 2:
+                            histo = _decode_histo(v3)
+                    if simple is not None:
+                        ev.scalars.append(ScalarValue(tag, simple))
+                    if histo is not None:
+                        ev.histograms.append((tag, histo))
+    return ev

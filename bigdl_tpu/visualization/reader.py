@@ -1,0 +1,48 @@
+"""Read back TensorBoard event files (≙ visualization/tensorboard/
+FileReader.scala)."""
+
+from __future__ import annotations
+
+import struct
+from typing import List, Tuple
+
+from bigdl_tpu.visualization.crc32c import masked_crc32c
+from bigdl_tpu.visualization.proto import Event, decode_event
+
+__all__ = ["FileReader"]
+
+
+class FileReader:
+    def __init__(self, path: str):
+        self.path = path
+
+    def events(self) -> List[Event]:
+        out = []
+        with open(self.path, "rb") as f:
+            data = f.read()
+        pos = 0
+        while pos + 12 <= len(data):
+            header = data[pos:pos + 8]
+            (length,) = struct.unpack("<Q", header)
+            (hcrc,) = struct.unpack("<I", data[pos + 8:pos + 12])
+            if hcrc != masked_crc32c(header):
+                raise ValueError(f"corrupt record header at {pos}")
+            start = pos + 12
+            if start + length + 4 > len(data):
+                break  # truncated tail: writer mid-record — treat as EOF
+            payload = data[start:start + length]
+            (pcrc,) = struct.unpack(
+                "<I", data[start + length:start + length + 4])
+            if pcrc != masked_crc32c(payload):
+                raise ValueError(f"corrupt record payload at {pos}")
+            out.append(decode_event(payload))
+            pos = start + length + 4
+        return out
+
+    def scalars(self, tag: str) -> List[Tuple[int, float]]:
+        return [(ev.step, s.value) for ev in self.events()
+                for s in ev.scalars if s.tag == tag]
+
+    def histograms(self, tag: str):
+        return [(ev.step, h) for ev in self.events()
+                for t, h in ev.histograms if t == tag]

@@ -1,0 +1,193 @@
+"""TensorBoard event-file writers.
+
+Reference: visualization/tensorboard/{RecordWriter,EventWriter,
+FileWriter}.scala and visualization/{TrainSummary,ValidationSummary}.scala.
+Event files written here are readable by stock TensorBoard: TFRecord
+framing (length + masked CRC32C) around hand-encoded Event protos.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import struct
+import threading
+import time
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from bigdl_tpu.visualization.crc32c import masked_crc32c
+from bigdl_tpu.visualization.proto import (
+    Event, ScalarValue, encode_event, make_histogram,
+)
+
+__all__ = ["RecordWriter", "FileWriter", "Summary", "TrainSummary",
+           "ValidationSummary"]
+
+
+class RecordWriter:
+    """TFRecord framing: u64 length, u32 masked-crc(length), payload,
+    u32 masked-crc(payload) (≙ tensorboard/RecordWriter.scala)."""
+
+    def __init__(self, fileobj):
+        self._f = fileobj
+
+    def write(self, payload: bytes) -> None:
+        header = struct.pack("<Q", len(payload))
+        self._f.write(header)
+        self._f.write(struct.pack("<I", masked_crc32c(header)))
+        self._f.write(payload)
+        self._f.write(struct.pack("<I", masked_crc32c(payload)))
+
+    def flush(self) -> None:
+        self._f.flush()
+
+
+class FileWriter:
+    """Async event writer: events are queued and drained by a daemon
+    thread (≙ tensorboard/FileWriter.scala:31 / EventWriter.scala)."""
+
+    def __init__(self, log_dir: str, flush_secs: float = 2.0):
+        os.makedirs(log_dir, exist_ok=True)
+        fname = (f"events.out.tfevents.{int(time.time())}."
+                 f"{os.uname().nodename}")
+        self._path = os.path.join(log_dir, fname)
+        self._file = open(self._path, "wb")
+        self._record = RecordWriter(self._file)
+        self._queue: "queue.Queue" = queue.Queue()
+        self._flush_secs = flush_secs
+        self._closed = False
+        self._record.write(encode_event(
+            Event(wall_time=time.time(), file_version="brain.Event:2")))
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    @property
+    def path(self) -> str:
+        return self._path
+
+    def add_event(self, event: Event) -> "FileWriter":
+        if self._closed:
+            raise RuntimeError("FileWriter is closed")
+        self._queue.put(event)
+        return self
+
+    def _run(self):
+        last_flush = time.time()
+        while True:
+            try:
+                ev = self._queue.get(timeout=self._flush_secs)
+            except queue.Empty:
+                if time.time() - last_flush >= self._flush_secs:
+                    self._record.flush()
+                    last_flush = time.time()
+                continue
+            try:
+                if ev is StopIteration:
+                    self._record.flush()
+                    return
+                self._record.write(encode_event(ev))
+            finally:
+                self._queue.task_done()
+
+    def flush(self) -> "FileWriter":
+        self._queue.join()  # drainer task_done()s after the write completes
+        self._record.flush()
+        return self
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._queue.put(StopIteration)
+        self._thread.join(timeout=10)
+        self._file.flush()
+        self._file.close()
+
+
+class Summary:
+    """Base summary bound to ``<log_dir>/<app_name>/<tag>`` — the layout
+    TrainSummary/ValidationSummary use (TrainSummary.scala:32)."""
+
+    tag = "summary"
+
+    def __init__(self, log_dir: str, app_name: str):
+        self.log_dir = log_dir
+        self.app_name = app_name
+        self._writer = FileWriter(os.path.join(log_dir, app_name, self.tag))
+
+    @property
+    def writer_path(self) -> str:
+        return self._writer.path
+
+    def add_scalar(self, tag: str, value: float, step: int) -> "Summary":
+        self._writer.add_event(Event(
+            wall_time=time.time(), step=int(step),
+            scalars=[ScalarValue(tag, float(value))]))
+        return self
+
+    def add_histogram(self, tag: str, values, step: int) -> "Summary":
+        self._writer.add_event(Event(
+            wall_time=time.time(), step=int(step),
+            histograms=[(tag, make_histogram(np.asarray(values)))]))
+        return self
+
+    def read_scalar(self, tag: str) -> List[Tuple[int, float]]:
+        """Read back (step, value) pairs for a tag
+        (≙ TrainSummary.readScalar via tensorboard/FileReader)."""
+        from bigdl_tpu.visualization.reader import FileReader
+        self.flush()
+        out: List[Tuple[int, float]] = []
+        d = os.path.join(self.log_dir, self.app_name, self.tag)
+        for fname in sorted(os.listdir(d)):
+            out.extend(FileReader(os.path.join(d, fname)).scalars(tag))
+        return out
+
+    def flush(self) -> "Summary":
+        self._writer.flush()
+        return self
+
+    def close(self) -> None:
+        self._writer.close()
+
+
+class TrainSummary(Summary):
+    """Training summaries: Loss/Throughput/LearningRate scalars always;
+    per-parameter histograms behind a trigger because they are expensive
+    (≙ visualization/TrainSummary.scala:32, setSummaryTrigger)."""
+
+    tag = "train"
+
+    def __init__(self, log_dir: str, app_name: str):
+        super().__init__(log_dir, app_name)
+        self._triggers = {}
+
+    def set_summary_trigger(self, name: str, trigger) -> "TrainSummary":
+        if name not in ("Loss", "Throughput", "LearningRate", "Parameters"):
+            raise ValueError(f"unsupported summary name {name!r}")
+        self._triggers[name] = trigger
+        return self
+
+    def get_summary_trigger(self, name: str):
+        return self._triggers.get(name)
+
+    def save_parameters(self, model, step: int, state: dict) -> None:
+        """Write per-parameter histograms if the 'Parameters' trigger
+        fires.  Uses the flat dotted-path view so nested containers
+        (Sequential, Graph, …) produce one histogram per leaf array."""
+        trig = self._triggers.get("Parameters")
+        if trig is None or not trig(state):
+            return
+        import jax
+        from bigdl_tpu.core.module import param_paths, partition
+        params, _ = partition(model)
+        leaves = jax.tree_util.tree_leaves(params)
+        for path, arr in zip(param_paths(model), leaves):
+            self.add_histogram(path, np.asarray(arr), step)
+
+
+class ValidationSummary(Summary):
+    """Per-validation-method scalars (≙ ValidationSummary.scala)."""
+
+    tag = "validation"

@@ -1,0 +1,135 @@
+"""Tests for TensorBoard-compatible event files (reference
+visualization/ + tensorboard/ writers/readers)."""
+
+import os
+import struct
+
+import numpy as np
+import pytest
+
+from bigdl_tpu.visualization import (
+    FileReader, FileWriter, TrainSummary, ValidationSummary,
+    Event, ScalarValue, make_histogram,
+)
+from bigdl_tpu.visualization.crc32c import crc32c, masked_crc32c, \
+    unmask_crc32c
+from bigdl_tpu.visualization.proto import encode_event, decode_event
+
+
+def test_crc32c_known_vectors():
+    # RFC 3720 test vectors
+    assert crc32c(b"123456789") == 0xE3069283
+    assert crc32c(b"") == 0
+    assert crc32c(b"\x00" * 32) == 0x8A9136AA
+    assert crc32c(b"\xff" * 32) == 0x62A8AB43
+
+
+def test_masked_crc_roundtrip():
+    for payload in (b"", b"abc", b"x" * 1000):
+        assert unmask_crc32c(masked_crc32c(payload)) == crc32c(payload)
+
+
+def test_event_proto_roundtrip_scalar():
+    ev = Event(wall_time=123.5, step=7,
+               scalars=[ScalarValue("Loss", 0.25),
+                        ScalarValue("Throughput", 1000.0)])
+    dec = decode_event(encode_event(ev))
+    assert dec.wall_time == 123.5
+    assert dec.step == 7
+    assert [(s.tag, s.value) for s in dec.scalars] == [
+        ("Loss", 0.25), ("Throughput", 1000.0)]
+
+
+def test_event_proto_roundtrip_histogram():
+    vals = np.concatenate([np.linspace(-2, 2, 101), [0.0]])
+    h = make_histogram(vals)
+    ev = Event(step=3, histograms=[("weights", h)])
+    dec = decode_event(encode_event(ev))
+    tag, h2 = dec.histograms[0]
+    assert tag == "weights"
+    assert h2.num == vals.size
+    assert h2.min == pytest.approx(-2.0)
+    assert h2.max == pytest.approx(2.0)
+    assert h2.sum == pytest.approx(vals.sum())
+    assert sum(h2.bucket) == vals.size
+    assert len(h2.bucket_limit) == len(h2.bucket)
+
+
+def test_file_writer_reader_roundtrip(tmp_path):
+    w = FileWriter(str(tmp_path))
+    for i in range(5):
+        w.add_event(Event(step=i, scalars=[ScalarValue("Loss", i * 0.5)]))
+    w.close()
+    r = FileReader(w.path)
+    events = r.events()
+    assert events[0].file_version == "brain.Event:2"
+    assert r.scalars("Loss") == [(i, i * 0.5) for i in range(5)]
+
+
+def test_record_framing_is_tfrecord(tmp_path):
+    w = FileWriter(str(tmp_path))
+    w.close()
+    with open(w.path, "rb") as f:
+        data = f.read()
+    (length,) = struct.unpack("<Q", data[:8])
+    (hcrc,) = struct.unpack("<I", data[8:12])
+    assert hcrc == masked_crc32c(data[:8])
+    payload = data[12:12 + length]
+    (pcrc,) = struct.unpack("<I", data[12 + length:16 + length])
+    assert pcrc == masked_crc32c(payload)
+
+
+def test_train_summary_scalars_and_read_back(tmp_path):
+    s = TrainSummary(str(tmp_path), "app1")
+    s.add_scalar("Loss", 1.0, 1).add_scalar("Loss", 0.5, 2)
+    s.add_scalar("Throughput", 100.0, 1)
+    got = s.read_scalar("Loss")
+    s.close()
+    assert got == [(1, 1.0), (2, 0.5)]
+
+
+def test_train_summary_parameter_trigger(tmp_path):
+    from bigdl_tpu.optim import Trigger
+    import bigdl_tpu.nn as nn
+    s = TrainSummary(str(tmp_path), "app2")
+    s.set_summary_trigger("Parameters", Trigger.several_iteration(1))
+    model = nn.Linear(4, 2)
+    s.save_parameters(model, 1, {"neval": 1, "is_epoch_end": False})
+    s.flush()
+    d = os.path.join(str(tmp_path), "app2", "train")
+    fname = os.path.join(d, sorted(os.listdir(d))[0])
+    hists = {t for ev in FileReader(fname).events()
+             for t, _ in ev.histograms}
+    s.close()
+    assert any("weight" in t for t in hists)
+    assert any("bias" in t for t in hists)
+
+
+def test_optimizer_writes_summaries(tmp_path):
+    import jax.numpy as jnp
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.dataset.dataset import Sample
+    from bigdl_tpu.optim import Optimizer, SGD, Trigger
+
+    rng = np.random.default_rng(0)
+    samples = [Sample(rng.normal(size=(4,)).astype(np.float32),
+                      rng.normal(size=(2,)).astype(np.float32))
+               for _ in range(16)]
+    model = nn.Linear(4, 2)
+    train_sum = TrainSummary(str(tmp_path), "opt")
+    val_sum = ValidationSummary(str(tmp_path), "opt")
+    from bigdl_tpu.optim.validation import Loss
+    opt = (Optimizer(model, samples, nn.MSECriterion(), batch_size=8)
+           .set_optim_method(SGD(0.1))
+           .set_end_when(Trigger.max_epoch(2))
+           .set_train_summary(train_sum)
+           .set_val_summary(val_sum)
+           .set_validation(Trigger.every_epoch(), samples,
+                           [Loss(nn.MSECriterion())], batch_size=8))
+    opt.optimize()
+    losses = train_sum.read_scalar("Loss")
+    assert len(losses) == 4  # 2 epochs × 2 iterations
+    val = val_sum.read_scalar("Loss")
+    assert len(val) == 2
+    train_sum.close()
+    val_sum.close()
