@@ -401,7 +401,7 @@ class Optimizer:
                             self.train_summary.save_parameters(
                                 combine(self._merge_groups_host(
                                     params_groups), rest),
-                                self.state["neval"], self.state)
+                                self.state["neval"])
                     self.state["neval"] += 1
                     self.state["is_epoch_end"] = False
                     self._maybe_validate_checkpoint(
@@ -513,4 +513,7 @@ def _scheduled_lr(method, opt_state, epoch):
     t = opt_state.get("t")
     if t is None:
         return float(lr)
-    return float(sched(lr, jnp.asarray(t), epoch))
+    # opt_state is post-update: the step just taken evaluated the
+    # schedule at t-1
+    t_applied = jnp.maximum(jnp.asarray(t) - 1, 0)
+    return float(sched(lr, t_applied, epoch))

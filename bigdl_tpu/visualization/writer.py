@@ -8,6 +8,7 @@ framing (length + masked CRC32C) around hand-encoded Event protos.
 
 from __future__ import annotations
 
+import itertools
 import os
 import queue
 import struct
@@ -24,6 +25,8 @@ from bigdl_tpu.visualization.proto import (
 
 __all__ = ["RecordWriter", "FileWriter", "Summary", "TrainSummary",
            "ValidationSummary"]
+
+_file_seq = itertools.count()
 
 
 class RecordWriter:
@@ -50,8 +53,9 @@ class FileWriter:
 
     def __init__(self, log_dir: str, flush_secs: float = 2.0):
         os.makedirs(log_dir, exist_ok=True)
-        fname = (f"events.out.tfevents.{int(time.time())}."
-                 f"{os.uname().nodename}")
+        fname = (f"events.out.tfevents.{time.time():.6f}."
+                 f"{os.uname().nodename}.{os.getpid()}."
+                 f"{next(_file_seq)}")
         self._path = os.path.join(log_dir, fname)
         self._file = open(self._path, "wb")
         self._record = RecordWriter(self._file)
@@ -172,13 +176,10 @@ class TrainSummary(Summary):
     def get_summary_trigger(self, name: str):
         return self._triggers.get(name)
 
-    def save_parameters(self, model, step: int, state: dict) -> None:
-        """Write per-parameter histograms if the 'Parameters' trigger
-        fires.  Uses the flat dotted-path view so nested containers
-        (Sequential, Graph, …) produce one histogram per leaf array."""
-        trig = self._triggers.get("Parameters")
-        if trig is None or not trig(state):
-            return
+    def save_parameters(self, model, step: int) -> None:
+        """Write one histogram per parameter leaf (flat dotted paths, so
+        nested containers work).  Trigger gating is the caller's job —
+        the Optimizer consults ``get_summary_trigger('Parameters')``."""
         import jax
         from bigdl_tpu.core.module import param_paths, partition
         params, _ = partition(model)

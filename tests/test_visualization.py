@@ -93,8 +93,8 @@ def test_train_summary_parameter_trigger(tmp_path):
     import bigdl_tpu.nn as nn
     s = TrainSummary(str(tmp_path), "app2")
     s.set_summary_trigger("Parameters", Trigger.several_iteration(1))
-    model = nn.Linear(4, 2)
-    s.save_parameters(model, 1, {"neval": 1, "is_epoch_end": False})
+    model = nn.Sequential(nn.Linear(4, 2))  # nested: flat paths required
+    s.save_parameters(model, 1)
     s.flush()
     d = os.path.join(str(tmp_path), "app2", "train")
     fname = os.path.join(d, sorted(os.listdir(d))[0])
