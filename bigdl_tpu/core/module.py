@@ -459,6 +459,47 @@ class Module:
     def clone(self) -> "Module":
         return _copy.deepcopy(self)
 
+    # -- inference entry points (≙ AbstractModule.predict:660 /
+    #    evaluate:890; delegate to the optim runtime) ---------------------
+
+    def predict(self, data, batch_size: int = 32):
+        from bigdl_tpu.optim.predictor import Predictor
+        return Predictor(self, batch_size).predict(data)
+
+    def predict_class(self, data, batch_size: int = 32):
+        from bigdl_tpu.optim.predictor import Predictor
+        return Predictor(self, batch_size).predict_class(data)
+
+    def evaluate(self, data, methods, batch_size: int = 32):
+        from bigdl_tpu.optim.predictor import Evaluator
+        return Evaluator(self, batch_size).evaluate(data, methods)
+
+    # -- persistence (≙ AbstractModule.saveModule / Module.loadModule) ----
+
+    def save(self, path: str) -> "Module":
+        from bigdl_tpu.utils.serializer import save_module
+        save_module(self, path)
+        return self
+
+    @staticmethod
+    def load(path: str) -> "Module":
+        from bigdl_tpu.utils.serializer import load_module
+        return load_module(path)
+
+    def save_weights(self, path: str) -> "Module":
+        from bigdl_tpu.utils.serializer import save_weights
+        save_weights(self, path)
+        return self
+
+    def load_weights(self, path: str, strict: bool = True) -> "Module":
+        from bigdl_tpu.utils.serializer import load_weights
+        return load_weights(self, path, strict=strict)
+
+    def quantize(self) -> "Module":
+        """Int8 inference copy (≙ AbstractModule.quantize:954)."""
+        from bigdl_tpu.nn.quantized import Quantizer
+        return Quantizer.quantize(self)
+
     def __repr__(self):
         parts = []
         for n, p in self._params.items():

@@ -10,3 +10,6 @@ from bigdl_tpu.optim.validation import (
     TopKAccuracy, Loss, MAE, HitRatio, NDCG,
 )
 from bigdl_tpu.optim.optimizer import Optimizer
+from bigdl_tpu.optim.predictor import (
+    Predictor, Evaluator, PredictionService,
+)

@@ -1,0 +1,114 @@
+"""Tests for the inference runtime (reference optim/Predictor.scala,
+Evaluator.scala, PredictionService.scala)."""
+
+import io
+import threading
+
+import numpy as np
+import pytest
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.dataset.dataset import Sample, LocalDataSet
+from bigdl_tpu.dataset.transformer import SampleToMiniBatch
+from bigdl_tpu.optim import (
+    Predictor, Evaluator, PredictionService, Top1Accuracy, Loss,
+)
+from bigdl_tpu.utils import set_seed
+
+
+def _model():
+    set_seed(3)
+    return nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 3),
+                         nn.LogSoftMax())
+
+
+def test_predict_matches_forward():
+    m = _model()
+    rng = np.random.default_rng(0)
+    feats = [rng.normal(size=(4,)).astype(np.float32) for _ in range(10)]
+    preds = Predictor(m, batch_size=4).predict([Sample(f) for f in feats])
+    assert len(preds) == 10  # ragged tail (10 = 2*4 + 2) included
+    import jax.numpy as jnp
+    want = np.asarray(m.eval_mode().forward(jnp.stack(
+        [jnp.asarray(f) for f in feats])))
+    np.testing.assert_allclose(np.stack(preds), want, rtol=1e-5)
+
+
+def test_predict_class_is_one_based():
+    m = _model()
+    rng = np.random.default_rng(1)
+    feats = [rng.normal(size=(4,)).astype(np.float32) for _ in range(6)]
+    classes = Predictor(m, batch_size=4).predict_class(
+        [Sample(f) for f in feats])
+    assert classes.shape == (6,)
+    assert set(classes) <= {1, 2, 3}
+
+
+def test_module_predict_convenience():
+    m = _model()
+    rng = np.random.default_rng(2)
+    feats = [rng.normal(size=(4,)).astype(np.float32) for _ in range(4)]
+    out = m.predict([Sample(f) for f in feats], batch_size=4)
+    assert len(out) == 4
+
+
+def test_evaluator_counts_every_sample():
+    m = _model()
+    rng = np.random.default_rng(3)
+    samples = [Sample(rng.normal(size=(4,)).astype(np.float32),
+                      int(rng.integers(1, 4)))
+               for _ in range(11)]
+    results = Evaluator(m, batch_size=4).evaluate(
+        samples, [Top1Accuracy(), Loss(nn.ClassNLLCriterion())])
+    (acc, acc_m), (loss, loss_m) = results
+    assert acc.result()[1] == 11  # denominator counts all samples
+    assert 0.0 <= acc.result()[0] <= 1.0
+    assert np.isfinite(loss.result()[0])
+
+
+def test_evaluate_on_transformed_dataset():
+    m = _model()
+    rng = np.random.default_rng(4)
+    samples = [Sample(rng.normal(size=(4,)).astype(np.float32),
+                      int(rng.integers(1, 4)))
+               for _ in range(8)]
+    ds = LocalDataSet(samples, shuffle=False).transform(
+        SampleToMiniBatch(4))
+    results = m.evaluate(ds, [Top1Accuracy()])
+    assert results[0][0].result()[1] == 8
+
+
+def test_prediction_service_concurrent():
+    m = _model()
+    svc = PredictionService(m, concurrency=3)
+    rng = np.random.default_rng(5)
+    xs = [rng.normal(size=(2, 4)).astype(np.float32) for _ in range(12)]
+    outs = [None] * len(xs)
+    errs = []
+
+    def work(i):
+        try:
+            outs[i] = svc.predict(xs[i])
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    threads = [threading.Thread(target=work, args=(i,))
+               for i in range(len(xs))]
+    [t.start() for t in threads]
+    [t.join() for t in threads]
+    assert not errs
+    import jax.numpy as jnp
+    for x, y in zip(xs, outs):
+        want = np.asarray(m.eval_mode().forward(jnp.asarray(x)))
+        np.testing.assert_allclose(y, want, rtol=1e-5)
+
+
+def test_prediction_service_bytes_roundtrip():
+    m = _model()
+    svc = PredictionService(m)
+    x = np.random.default_rng(6).normal(size=(2, 4)).astype(np.float32)
+    buf = io.BytesIO()
+    np.save(buf, x, allow_pickle=False)
+    resp = svc.predict_bytes(buf.getvalue())
+    y = np.load(io.BytesIO(resp), allow_pickle=False)
+    assert y.shape == (2, 3)
