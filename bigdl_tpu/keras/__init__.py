@@ -1,0 +1,4 @@
+"""Keras-1.2.2-compatible API (≙ reference nn/keras/ + pyspark keras)."""
+
+from bigdl_tpu.keras.layers import *     # noqa: F401,F403
+from bigdl_tpu.keras.topology import Sequential  # noqa: F401

@@ -1,0 +1,331 @@
+"""Keras-1.2.2-compatible layers with shape inference.
+
+Reference: nn/keras/ (71 wrapper files) + nn/abstractnn/InferShape.scala.
+Each Keras layer holds its config and lazily builds the underlying
+``bigdl_tpu.nn`` module once the input shape is known — at ``add()``
+time when an ``input_shape`` was given upstream, else on first forward.
+Shapes exclude the batch dimension, Keras-style.  Image layers are NHWC
+(TPU-native layout; the reference's keras layers default to NCHW
+``dim_ordering="th"``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.core.module import Module
+
+__all__ = [
+    "KerasLayer", "InputLayer", "Dense", "Activation", "Dropout",
+    "Flatten", "Reshape", "Convolution2D", "MaxPooling2D",
+    "AveragePooling2D", "GlobalAveragePooling2D", "BatchNormalization",
+    "Embedding", "LSTM", "GRU", "SimpleRNN", "Highway", "Merge",
+]
+
+
+def _activation_module(name: Optional[str]) -> Optional[Module]:
+    if name is None or name == "linear":
+        return None
+    table = {
+        "relu": nn.ReLU, "tanh": nn.Tanh, "sigmoid": nn.Sigmoid,
+        "softmax": nn.SoftMax, "softplus": nn.SoftPlus,
+        "softsign": nn.SoftSign, "hard_sigmoid": nn.HardSigmoid,
+        "elu": nn.ELU, "log_softmax": nn.LogSoftMax,
+    }
+    if name not in table:
+        raise ValueError(f"unknown activation {name!r}")
+    return table[name]()
+
+
+class KerasLayer(Module):
+    """Base: config + lazy build (≙ nn/keras/KerasLayer.scala wrapping
+    InferShape)."""
+
+    def __init__(self, input_shape: Optional[Sequence[int]] = None):
+        super().__init__()
+        self.input_shape = tuple(input_shape) if input_shape else None
+        self.output_shape: Optional[Tuple[int, ...]] = None
+        self.built = False
+
+    # subclass contract -----------------------------------------------------
+    def build_layer(self, input_shape: Tuple[int, ...]) \
+            -> Tuple[Module, Tuple[int, ...]]:
+        raise NotImplementedError
+
+    # ----------------------------------------------------------------------
+    def build(self, input_shape: Tuple[int, ...]) -> Tuple[int, ...]:
+        if self.built:
+            return self.output_shape
+        self.input_shape = tuple(input_shape)
+        self.inner, self.output_shape = self.build_layer(self.input_shape)
+        self.built = True
+        return self.output_shape
+
+    def forward(self, x):
+        if not self.built:
+            self.build(tuple(x.shape[1:]))
+        return self.inner.forward(x)
+
+
+class InputLayer(KerasLayer):
+    def __init__(self, input_shape: Sequence[int]):
+        super().__init__(input_shape)
+
+    def build_layer(self, input_shape):
+        return nn.Identity(), input_shape
+
+
+class Dense(KerasLayer):
+    """(≙ nn/keras/Dense.scala)"""
+
+    def __init__(self, output_dim: int, activation: Optional[str] = None,
+                 b_regularizer=None, w_regularizer=None, bias: bool = True,
+                 input_shape: Optional[Sequence[int]] = None):
+        super().__init__(input_shape)
+        self.output_dim = output_dim
+        self.activation = activation
+        self.bias = bias
+        self.w_regularizer = w_regularizer
+        self.b_regularizer = b_regularizer
+
+    def build_layer(self, input_shape):
+        lin = nn.Linear(input_shape[-1], self.output_dim,
+                        with_bias=self.bias,
+                        w_regularizer=self.w_regularizer,
+                        b_regularizer=self.b_regularizer)
+        act = _activation_module(self.activation)
+        mod = lin if act is None else nn.Sequential(lin, act)
+        return mod, tuple(input_shape[:-1]) + (self.output_dim,)
+
+
+class Activation(KerasLayer):
+    def __init__(self, activation: str,
+                 input_shape: Optional[Sequence[int]] = None):
+        super().__init__(input_shape)
+        self.activation = activation
+
+    def build_layer(self, input_shape):
+        return _activation_module(self.activation) or nn.Identity(), \
+            input_shape
+
+
+class Dropout(KerasLayer):
+    def __init__(self, p: float,
+                 input_shape: Optional[Sequence[int]] = None):
+        super().__init__(input_shape)
+        self.p = p
+
+    def build_layer(self, input_shape):
+        return nn.Dropout(self.p), input_shape
+
+
+class Flatten(KerasLayer):
+    def build_layer(self, input_shape):
+        n = int(np.prod(input_shape))
+        return nn.Flatten(), (n,)
+
+
+class Reshape(KerasLayer):
+    def __init__(self, target_shape: Sequence[int],
+                 input_shape: Optional[Sequence[int]] = None):
+        super().__init__(input_shape)
+        self.target_shape = tuple(target_shape)
+
+    def build_layer(self, input_shape):
+        return nn.Reshape(self.target_shape), self.target_shape
+
+
+class Convolution2D(KerasLayer):
+    """NHWC conv (≙ nn/keras/Convolution2D.scala; input_shape =
+    (rows, cols, channels))."""
+
+    def __init__(self, nb_filter: int, nb_row: int, nb_col: int,
+                 activation: Optional[str] = None,
+                 border_mode: str = "valid",
+                 subsample: Tuple[int, int] = (1, 1),
+                 bias: bool = True,
+                 input_shape: Optional[Sequence[int]] = None):
+        super().__init__(input_shape)
+        if border_mode not in ("valid", "same"):
+            raise ValueError(f"unsupported border_mode {border_mode!r}")
+        self.nb_filter = nb_filter
+        self.nb_row = nb_row
+        self.nb_col = nb_col
+        self.activation = activation
+        self.border_mode = border_mode
+        self.subsample = subsample
+        self.bias = bias
+
+    def build_layer(self, input_shape):
+        h, w, c = input_shape
+        if self.border_mode == "same":
+            # true SAME padding (pad=-1) keeps inference and execution in
+            # agreement for even kernels / odd dims
+            pad_h = pad_w = -1
+            out_h = -(-h // self.subsample[0])
+            out_w = -(-w // self.subsample[1])
+        else:
+            pad_h = pad_w = 0
+            out_h = (h - self.nb_row) // self.subsample[0] + 1
+            out_w = (w - self.nb_col) // self.subsample[1] + 1
+        conv = nn.SpatialConvolution(
+            c, self.nb_filter, self.nb_col, self.nb_row,
+            self.subsample[1], self.subsample[0], pad_w, pad_h,
+            with_bias=self.bias, data_format="NHWC")
+        act = _activation_module(self.activation)
+        mod = conv if act is None else nn.Sequential(conv, act)
+        return mod, (out_h, out_w, self.nb_filter)
+
+
+class _Pooling2D(KerasLayer):
+    pool_cls = None
+
+    def __init__(self, pool_size: Tuple[int, int] = (2, 2),
+                 strides: Optional[Tuple[int, int]] = None,
+                 border_mode: str = "valid",
+                 input_shape: Optional[Sequence[int]] = None):
+        super().__init__(input_shape)
+        self.pool_size = pool_size
+        self.strides = strides or pool_size
+        self.border_mode = border_mode
+
+    def build_layer(self, input_shape):
+        h, w, c = input_shape
+        pad_h = pad_w = 0
+        if self.border_mode == "same":
+            out_h = -(-h // self.strides[0])
+            out_w = -(-w // self.strides[1])
+            pad_h = pad_w = -1  # true SAME padding in the nn layer
+        else:
+            out_h = (h - self.pool_size[0]) // self.strides[0] + 1
+            out_w = (w - self.pool_size[1]) // self.strides[1] + 1
+        pool = self.pool_cls(
+            self.pool_size[1], self.pool_size[0],
+            self.strides[1], self.strides[0], pad_w, pad_h,
+            data_format="NHWC")
+        return pool, (out_h, out_w, c)
+
+
+class MaxPooling2D(_Pooling2D):
+    pool_cls = nn.SpatialMaxPooling
+
+
+class AveragePooling2D(_Pooling2D):
+    pool_cls = nn.SpatialAveragePooling
+
+
+class GlobalAveragePooling2D(KerasLayer):
+    def build_layer(self, input_shape):
+        h, w, c = input_shape
+        return nn.GlobalAveragePooling2D(), (c,)
+
+
+class BatchNormalization(KerasLayer):
+    def __init__(self, epsilon: float = 1e-3, momentum: float = 0.99,
+                 input_shape: Optional[Sequence[int]] = None):
+        super().__init__(input_shape)
+        self.epsilon = epsilon
+        self.momentum = momentum
+
+    def build_layer(self, input_shape):
+        c = input_shape[-1]
+        if len(input_shape) == 3:
+            bn = nn.SpatialBatchNormalization(
+                c, eps=self.epsilon, momentum=1 - self.momentum,
+                data_format="NHWC")
+        else:
+            bn = nn.BatchNormalization(
+                c, eps=self.epsilon, momentum=1 - self.momentum)
+        return bn, input_shape
+
+
+class Embedding(KerasLayer):
+    """(≙ nn/keras/Embedding.scala).  Input: [seq_len] of 1-based ids."""
+
+    def __init__(self, input_dim: int, output_dim: int,
+                 input_shape: Optional[Sequence[int]] = None):
+        super().__init__(input_shape)
+        self.input_dim = input_dim
+        self.output_dim = output_dim
+
+    def build_layer(self, input_shape):
+        emb = nn.LookupTable(self.input_dim, self.output_dim)
+        return emb, tuple(input_shape) + (self.output_dim,)
+
+
+class _RecurrentLayer(KerasLayer):
+    def __init__(self, output_dim: int, return_sequences: bool = False,
+                 input_shape: Optional[Sequence[int]] = None):
+        super().__init__(input_shape)
+        self.output_dim = output_dim
+        self.return_sequences = return_sequences
+
+    def make_cell(self, input_size):
+        raise NotImplementedError
+
+    def build_layer(self, input_shape):
+        seq_len, feat = input_shape
+        rec = nn.Recurrent(self.make_cell(feat))
+        if self.return_sequences:
+            return rec, (seq_len, self.output_dim)
+        return nn.Sequential(rec, nn.Select(2, -1)), (self.output_dim,)
+
+
+class LSTM(_RecurrentLayer):
+    def make_cell(self, input_size):
+        return nn.LSTM(input_size, self.output_dim)
+
+
+class GRU(_RecurrentLayer):
+    def make_cell(self, input_size):
+        return nn.GRU(input_size, self.output_dim)
+
+
+class SimpleRNN(_RecurrentLayer):
+    def make_cell(self, input_size):
+        return nn.RnnCell(input_size, self.output_dim, nn.Tanh())
+
+
+class Highway(KerasLayer):
+    def __init__(self, activation: Optional[str] = "tanh",
+                 input_shape: Optional[Sequence[int]] = None):
+        super().__init__(input_shape)
+        self.activation = activation
+
+    def build_layer(self, input_shape):
+        act = _activation_module(self.activation)
+        return nn.Highway(input_shape[-1], activation=act), input_shape
+
+
+class Merge(KerasLayer):
+    """Merge a list of inputs (≙ nn/keras/Merge.scala): mode in
+    {sum, mul, max, ave, concat}."""
+
+    def __init__(self, mode: str = "sum", concat_axis: int = -1,
+                 input_shape: Optional[Sequence[int]] = None):
+        super().__init__(input_shape)
+        if mode not in ("sum", "mul", "max", "ave", "concat"):
+            raise ValueError(f"unsupported merge mode {mode!r}")
+        self.mode = mode
+        self.concat_axis = concat_axis
+
+    def build_layer(self, input_shape):
+        table = {"sum": nn.CAddTable, "mul": nn.CMulTable,
+                 "max": nn.CMaxTable, "ave": nn.CAveTable}
+        if self.mode == "concat":
+            ndim = len(input_shape) + 1  # batched rank
+            dim = (self.concat_axis + 1 if self.concat_axis >= 0
+                   else ndim + self.concat_axis + 1)  # 1-based
+            # output shape along the concat axis depends on sibling
+            # inputs unknown here; leave it as the first input's shape
+            return nn.JoinTable(dim), input_shape
+        return table[self.mode](), input_shape
+
+    def forward(self, x):
+        if not self.built:
+            first = x[0] if isinstance(x, (tuple, list)) else x
+            self.build(tuple(first.shape[1:]))
+        return self.inner.forward(x)

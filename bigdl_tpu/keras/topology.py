@@ -1,0 +1,185 @@
+"""Keras-style Sequential model: compile / fit / evaluate / predict.
+
+Reference: nn/keras/Topology.scala:55 (compile), :89,116 (fit), :269
+(Sequential) — the Keras façade that builds the Optimizer internally.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.core.module import Module
+from bigdl_tpu.keras.layers import KerasLayer
+from bigdl_tpu.optim.methods import OptimMethod, SGD, Adam, Adagrad, \
+    Adadelta, Adamax, RMSprop
+from bigdl_tpu.optim.trigger import Trigger
+from bigdl_tpu.optim.validation import Top1Accuracy, Loss, MAE, \
+    ValidationMethod
+
+__all__ = ["Sequential"]
+
+
+_OPTIMIZERS = {
+    "sgd": lambda: SGD(0.01),
+    "adam": Adam,
+    "adagrad": Adagrad,
+    "adadelta": Adadelta,
+    "adamax": Adamax,
+    "rmsprop": RMSprop,
+}
+
+_LOSSES = {
+    "categorical_crossentropy": nn.CategoricalCrossEntropy,
+    "sparse_categorical_crossentropy": nn.CrossEntropyCriterion,
+    "mse": nn.MSECriterion,
+    "mean_squared_error": nn.MSECriterion,
+    "mae": nn.AbsCriterion,
+    "mean_absolute_error": nn.AbsCriterion,
+    "binary_crossentropy": nn.BCECriterion,
+    "hinge": nn.MarginCriterion,
+    "kld": nn.DistKLDivCriterion,
+    "poisson": nn.PoissonCriterion,
+    "cosine_proximity": nn.CosineProximityCriterion,
+}
+
+
+def _resolve_metric(m, criterion) -> ValidationMethod:
+    if isinstance(m, ValidationMethod):
+        return m
+    table = {"accuracy": Top1Accuracy, "acc": Top1Accuracy, "mae": MAE}
+    if m == "loss":
+        return Loss(criterion)
+    if m not in table:
+        raise ValueError(f"unknown metric {m!r}")
+    return table[m]()
+
+
+class Sequential(Module):
+    """``Sequential().add(...).compile(...).fit(x, y)``
+    (≙ nn/keras/Topology.scala Sequential:269 + KerasModel:55-158)."""
+
+    def __init__(self):
+        super().__init__()
+        self.layers = nn.Sequential()
+        self._compiled = False
+        self.criterion = None
+        self.optim_method: Optional[OptimMethod] = None
+        self.metrics: List[ValidationMethod] = []
+
+    def add(self, layer: Module) -> "Sequential":
+        self.layers.add(layer)
+        # propagate shapes eagerly when possible (≙ reference add-time
+        # shape inference)
+        self._propagate_shapes()
+        return self
+
+    def _propagate_shapes(self):
+        shape = None
+        for lay in self.layers.modules():
+            if isinstance(lay, KerasLayer):
+                if lay.built:
+                    shape = lay.output_shape
+                elif shape is not None or lay.input_shape is not None:
+                    shape = lay.build(shape or lay.input_shape)
+                else:
+                    return
+            else:
+                return  # raw nn layer: no static shape inference
+
+    def build(self, input_shape: Sequence[int]):
+        """Force-build all layers from a known (batchless) input shape."""
+        shape = tuple(input_shape)
+        for lay in self.layers.modules():
+            if isinstance(lay, KerasLayer):
+                shape = lay.build(shape)
+            # raw nn modules keep shape unknown; stop inferring but they
+            # are already concrete so nothing to build
+        return self
+
+    def forward(self, x):
+        return self.layers.forward(x)
+
+    def get_output_shape(self) -> Optional[Tuple[int, ...]]:
+        mods = self.layers.modules()
+        for lay in reversed(mods):
+            if isinstance(lay, KerasLayer):
+                return lay.output_shape
+        return None
+
+    # ---- the Keras training façade -------------------------------------
+
+    def compile(self, optimizer: Union[str, OptimMethod],
+                loss, metrics: Optional[Sequence] = None) -> "Sequential":
+        """(≙ Topology.scala:55)"""
+        if isinstance(optimizer, str):
+            key = optimizer.lower()
+            if key not in _OPTIMIZERS:
+                raise ValueError(f"unknown optimizer {optimizer!r}")
+            optimizer = _OPTIMIZERS[key]()
+        if isinstance(loss, str):
+            key = loss.lower()
+            if key not in _LOSSES:
+                raise ValueError(f"unknown loss {loss!r}")
+            loss = _LOSSES[key]()
+        self.optim_method = optimizer
+        self.criterion = loss
+        self.metrics = [_resolve_metric(m, loss) for m in (metrics or [])]
+        self._compiled = True
+        return self
+
+    def _to_samples(self, x, y=None):
+        from bigdl_tpu.dataset.dataset import Sample
+        if y is None:
+            return [Sample(np.asarray(f)) for f in x]
+        return [Sample(np.asarray(f), np.asarray(t))
+                for f, t in zip(x, y)]
+
+    def fit(self, x, y=None, batch_size: int = 32, nb_epoch: int = 10,
+            validation_data=None) -> "Sequential":
+        """(≙ Topology.scala:89,116).  ``x`` may be a numpy array (with
+        ``y``), a list of Samples, or a DataSet of MiniBatches."""
+        if not self._compiled:
+            raise RuntimeError("call compile(optimizer, loss) before fit")
+        from bigdl_tpu.optim.optimizer import Optimizer
+
+        if isinstance(x, np.ndarray):
+            self.build(x.shape[1:])
+            data = self._to_samples(x, y)
+        else:
+            data = x
+        kwargs = {"batch_size": batch_size} \
+            if not hasattr(data, "data") else {}
+        opt = (Optimizer(self, data, self.criterion, **kwargs)
+               .set_optim_method(self.optim_method)
+               .set_end_when(Trigger.max_epoch(nb_epoch)))
+        if validation_data is not None:
+            vx, vy = validation_data
+            vdata = self._to_samples(vx, vy) \
+                if isinstance(vx, np.ndarray) else vx
+            methods = self.metrics or [Loss(self.criterion)]
+            opt.set_validation(Trigger.every_epoch(), vdata, methods,
+                               batch_size=batch_size)
+        opt.optimize()
+        return self
+
+    def evaluate(self, x, y=None, batch_size: int = 32):
+        """(≙ Topology.scala evaluate)"""
+        if not self._compiled:
+            raise RuntimeError("call compile before evaluate")
+        data = self._to_samples(x, y) if isinstance(x, np.ndarray) else x
+        methods = self.metrics or [Loss(self.criterion)]
+        from bigdl_tpu.optim.predictor import Evaluator
+        return Evaluator(self, batch_size).evaluate(data, methods)
+
+    def predict(self, x, batch_size: int = 32) -> np.ndarray:
+        data = self._to_samples(x) if isinstance(x, np.ndarray) else x
+        from bigdl_tpu.optim.predictor import Predictor
+        return np.stack(Predictor(self, batch_size).predict(data))
+
+    def predict_classes(self, x, batch_size: int = 32) -> np.ndarray:
+        data = self._to_samples(x) if isinstance(x, np.ndarray) else x
+        from bigdl_tpu.optim.predictor import Predictor
+        return Predictor(self, batch_size).predict_class(data)

@@ -1,0 +1,122 @@
+"""Tests for the Keras-compatible API (reference nn/keras/Topology.scala
++ keras layer wrappers with shape inference)."""
+
+import numpy as np
+import pytest
+
+from bigdl_tpu import keras
+from bigdl_tpu.utils import set_seed
+
+
+def test_shape_inference_at_add_time():
+    set_seed(0)
+    m = (keras.Sequential()
+         .add(keras.Dense(16, activation="relu", input_shape=(8,)))
+         .add(keras.Dense(4, activation="softmax")))
+    layers = m.layers.modules()
+    assert layers[0].built and layers[0].output_shape == (16,)
+    assert layers[1].built and layers[1].output_shape == (4,)
+    assert m.get_output_shape() == (4,)
+
+
+def test_conv_pool_flatten_shapes():
+    set_seed(0)
+    m = (keras.Sequential()
+         .add(keras.Convolution2D(6, 5, 5, activation="relu",
+                                  input_shape=(28, 28, 1)))
+         .add(keras.MaxPooling2D((2, 2)))
+         .add(keras.Convolution2D(12, 5, 5, border_mode="same"))
+         .add(keras.Flatten())
+         .add(keras.Dense(10, activation="log_softmax")))
+    mods = m.layers.modules()
+    assert mods[0].output_shape == (24, 24, 6)
+    assert mods[1].output_shape == (12, 12, 6)
+    assert mods[2].output_shape == (12, 12, 12)
+    assert mods[3].output_shape == (12 * 12 * 12,)
+    x = np.random.default_rng(0).normal(size=(2, 28, 28, 1)) \
+        .astype(np.float32)
+    import jax.numpy as jnp
+    y = m.eval_mode().forward(jnp.asarray(x))
+    assert y.shape == (2, 10)
+
+
+def test_same_padding_inference_matches_execution():
+    import jax.numpy as jnp
+    set_seed(7)
+    # odd input + even kernel/pool: the hard case for SAME padding
+    m = (keras.Sequential()
+         .add(keras.Convolution2D(4, 2, 2, border_mode="same",
+                                  subsample=(2, 2),
+                                  input_shape=(5, 5, 3)))
+         .add(keras.MaxPooling2D((2, 2), border_mode="same"))
+         .add(keras.Flatten())
+         .add(keras.Dense(2)))
+    mods = m.layers.modules()
+    x = jnp.ones((1, 5, 5, 3))
+    y = m.eval_mode().forward(x)
+    assert mods[0].output_shape == (3, 3, 4)
+    assert mods[1].output_shape == (2, 2, 4)
+    assert y.shape == (1, 2)
+
+
+def test_lazy_build_on_first_forward():
+    set_seed(0)
+    m = keras.Sequential().add(keras.Dense(3))  # no input_shape anywhere
+    import jax.numpy as jnp
+    y = m.forward(jnp.ones((2, 7)))
+    assert y.shape == (2, 3)
+    assert m.layers[0].built and m.layers[0].input_shape == (7,)
+
+
+def test_compile_fit_evaluate_predict():
+    set_seed(1)
+    rng = np.random.default_rng(0)
+    # linearly separable 2-class problem
+    x = rng.normal(size=(64, 6)).astype(np.float32)
+    w = rng.normal(size=(6,))
+    labels = (x @ w > 0).astype(np.int64) + 1  # 1-based classes
+    m = (keras.Sequential()
+         .add(keras.Dense(16, activation="relu", input_shape=(6,)))
+         .add(keras.Dense(2, activation="log_softmax")))
+    m.compile(optimizer="adam", loss="sparse_categorical_crossentropy",
+              metrics=["accuracy"])
+    m.fit(x, labels, batch_size=16, nb_epoch=15,
+          validation_data=(x, labels))
+    results = m.evaluate(x, labels, batch_size=16)
+    acc = results[0][0].result()[0]
+    assert acc > 0.85, f"keras fit failed to learn: acc={acc}"
+    preds = m.predict(x, batch_size=16)
+    assert preds.shape == (64, 2)
+    classes = m.predict_classes(x, batch_size=16)
+    assert set(classes) <= {1, 2}
+    assert (classes == labels).mean() > 0.85
+
+
+def test_lstm_and_embedding_shapes():
+    set_seed(2)
+    m = (keras.Sequential()
+         .add(keras.Embedding(50, 8, input_shape=(12,)))
+         .add(keras.LSTM(16, return_sequences=True))
+         .add(keras.LSTM(6)))
+    mods = m.layers.modules()
+    assert mods[0].output_shape == (12, 8)
+    assert mods[1].output_shape == (12, 16)
+    assert mods[2].output_shape == (6,)
+    import jax.numpy as jnp
+    ids = jnp.asarray(np.random.default_rng(0).integers(
+        1, 51, size=(3, 12)))
+    y = m.eval_mode().forward(ids)
+    assert y.shape == (3, 6)
+
+
+def test_unknown_names_raise():
+    with pytest.raises(ValueError):
+        keras.Dense(4, activation="nope", input_shape=(3,)).build((3,))
+    m = keras.Sequential().add(keras.Dense(4, input_shape=(3,)))
+    with pytest.raises(ValueError):
+        m.compile("sgd", "not_a_loss")
+    with pytest.raises(ValueError):
+        m.compile("not_an_opt", "mse")
+    with pytest.raises(RuntimeError):
+        keras.Sequential().add(keras.Dense(2, input_shape=(3,))).fit(
+            np.ones((8, 3), np.float32), np.ones((8, 2), np.float32))
