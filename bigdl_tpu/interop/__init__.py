@@ -1,0 +1,6 @@
+"""Model import from other frameworks (≙ reference utils/{caffe,tf},
+TorchFile.scala — re-targeted at the formats that matter today)."""
+
+from bigdl_tpu.interop.torch_import import (  # noqa: F401
+    load_torch_state_dict, register_torch_converter,
+)

@@ -1,0 +1,148 @@
+"""Import PyTorch weights into bigdl_tpu modules.
+
+Reference parity: utils/TorchFile.scala (Torch7 model import) — the
+modern equivalent surface is a PyTorch ``state_dict``.  Layout
+conversions are per-layer-class converters in a registry
+(≙ utils/caffe/Converter.scala's per-layer converter pattern):
+
+* torch Linear weight [out, in]  → ours [out, in] (identity)
+* torch Conv2d weight OIHW       → ours HWIO (transpose 2,3,1,0)
+* torch BatchNorm{1,2}d          → weight/bias + running stats
+* torch Embedding [n, dim]       → LookupTable weight (identity)
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Tuple
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from bigdl_tpu.core.module import Module
+
+__all__ = ["load_torch_state_dict", "register_torch_converter"]
+
+
+# our-class-name → (our_leaf_names, converter(module, group_arrays))
+_CONVERTERS: Dict[str, Callable[[Module, Dict[str, np.ndarray]], None]] = {}
+
+
+def register_torch_converter(class_name: str):
+    def deco(fn):
+        _CONVERTERS[class_name] = fn
+        return fn
+    return deco
+
+
+@register_torch_converter("Linear")
+def _linear(mod, group):
+    mod._params["weight"] = jnp.asarray(group["weight"])
+    if "bias" in group and "bias" in mod._params:
+        mod._params["bias"] = jnp.asarray(group["bias"])
+
+
+@register_torch_converter("SpatialConvolution")
+def _conv2d(mod, group):
+    w = np.asarray(group["weight"])          # OIHW
+    mod._params["weight"] = jnp.asarray(w.transpose(2, 3, 1, 0))  # HWIO
+    if "bias" in group and "bias" in mod._params:
+        mod._params["bias"] = jnp.asarray(group["bias"])
+
+
+@register_torch_converter("BatchNormalization")
+def _bn(mod, group):
+    if "weight" in group and "weight" in mod._params:
+        mod._params["weight"] = jnp.asarray(group["weight"])
+    if "bias" in group and "bias" in mod._params:
+        mod._params["bias"] = jnp.asarray(group["bias"])
+    mod._buffers["running_mean"] = jnp.asarray(group["running_mean"])
+    mod._buffers["running_var"] = jnp.asarray(group["running_var"])
+
+
+_CONVERTERS["SpatialBatchNormalization"] = _CONVERTERS["BatchNormalization"]
+
+
+@register_torch_converter("LookupTable")
+def _embedding(mod, group):
+    mod._params["weight"] = jnp.asarray(group["weight"])
+
+
+@register_torch_converter("LayerNormalization")
+def _layernorm(mod, group):
+    mod._params["weight"] = jnp.asarray(group["weight"])
+    mod._params["bias"] = jnp.asarray(group["bias"])
+
+
+def _stateful_leaves(module: Module, prefix: str = "") \
+        -> List[Tuple[str, Module]]:
+    """Depth-first leaf modules that own parameters or buffers."""
+    from bigdl_tpu.core.module import ModuleList
+    out = []
+    own = bool(module._params) or bool(module._buffers)
+    children = []
+    for n, v in module._modules.items():
+        if isinstance(v, ModuleList):
+            for i, m in enumerate(v._items):
+                children.append((f"{prefix}{n}[{i}].", m))
+        else:
+            children.append((f"{prefix}{n}.", m))
+    if own:
+        out.append((prefix.rstrip("."), module))
+    for p, c in children:
+        out.extend(_stateful_leaves(c, p))
+    return out
+
+
+def _group_state_dict(state_dict) -> List[Tuple[str, Dict[str, np.ndarray]]]:
+    """Group torch entries by module prefix, preserving insertion order
+    (state_dict order is the torch module tree order)."""
+    groups: Dict[str, Dict[str, np.ndarray]] = {}
+    for key, tensor in state_dict.items():
+        if key.endswith("num_batches_tracked"):
+            continue
+        prefix, _, leaf = key.rpartition(".")
+        arr = tensor.detach().cpu().numpy() \
+            if hasattr(tensor, "detach") else np.asarray(tensor)
+        groups.setdefault(prefix, {})[leaf] = arr
+    return list(groups.items())
+
+
+def load_torch_state_dict(module: Module, state_dict,
+                          path_map: Dict[str, str] = None) -> Module:
+    """Load a PyTorch ``state_dict`` into ``module`` in place.
+
+    Without ``path_map``, torch parameter groups are zipped against this
+    model's stateful leaf modules in tree order (both frameworks emit
+    depth-first order, so architecturally-matching models align).  With
+    ``path_map`` ({our_path: torch_prefix}), only the listed pairs load.
+    """
+    groups = _group_state_dict(state_dict)
+    leaves = _stateful_leaves(module)
+    if path_map is not None:
+        by_path = dict(leaves)
+        by_prefix = dict(groups)
+        pairs = []
+        for ours, theirs in path_map.items():
+            if ours not in by_path:
+                raise KeyError(f"no module at path {ours!r}")
+            if theirs not in by_prefix:
+                raise KeyError(f"no torch group {theirs!r}")
+            pairs.append((by_path[ours], by_prefix[theirs], ours))
+    else:
+        if len(groups) != len(leaves):
+            raise ValueError(
+                f"structure mismatch: model has {len(leaves)} stateful "
+                f"modules, state_dict has {len(groups)} groups; pass "
+                f"path_map to align manually")
+        pairs = [(m, g, p) for (p, m), (_, g) in zip(leaves, groups)]
+
+    for mod, group, path in pairs:
+        cls = type(mod).__name__
+        conv = _CONVERTERS.get(cls)
+        if conv is None:
+            raise NotImplementedError(
+                f"no torch converter for {cls} (at {path!r}); "
+                f"register one with register_torch_converter")
+        conv(mod, group)
+    return module

@@ -1,0 +1,125 @@
+"""Model persistence.
+
+Reference: utils/serializer/ (ModuleSerializer with reflection-based
+default + registered custom serializers, weight-file separation,
+version tag) and nn/Module.scala:load/save factories.
+
+TPU-native format: a Module IS a registered pytree, so the full model —
+architecture (treedef aux: classes + static config) and state (leaves:
+params/buffers) — serializes as one ``tree_flatten``.  Files are a zip
+(numpy ``.npz``) holding the weight arrays plus a pickled treedef and a
+format-version tag: the same weight/structure separation as the
+reference's protobuf+weights layout, without a schema compiler.
+
+Two granularities:
+
+* ``save_module``/``load_module`` — whole model, architecture included
+  (≙ Module.saveModule/loadModule).
+* ``save_weights``/``load_weights`` — dotted-path → array dict only, for
+  loading into an architecture rebuilt in code (≙ saveWeights).
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+from typing import Any, Dict
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.core.module import Module
+from bigdl_tpu.utils.file import save_pytree, load_pytree
+
+__all__ = ["save_module", "load_module", "save_weights", "load_weights",
+           "FORMAT_VERSION"]
+
+FORMAT_VERSION = 1
+
+
+def save_module(module: Module, path: str) -> None:
+    """Persist architecture + weights (≙ AbstractModule.saveModule)."""
+    save_pytree({"__bigdl_tpu_version__": np.int64(FORMAT_VERSION),
+                 "module": module}, path)
+
+
+def load_module(path: str) -> Module:
+    """Rebuild a model saved by :func:`save_module`
+    (≙ Module.loadModule, nn/Module.scala)."""
+    tree = load_pytree(path)
+    version = int(tree.get("__bigdl_tpu_version__", -1))
+    if version != FORMAT_VERSION:
+        raise ValueError(
+            f"unsupported bigdl_tpu model format version {version} "
+            f"(supported: {FORMAT_VERSION})")
+    module = tree["module"]
+    # npz round-trips leaves as numpy; restore device arrays
+    return jax.tree_util.tree_map(jnp.asarray, module)
+
+
+def _flatten_state(module: Module) -> Dict[str, np.ndarray]:
+    out: Dict[str, np.ndarray] = {}
+
+    def walk(prefix: str, tree: Any):
+        if isinstance(tree, dict):
+            for k, v in tree.items():
+                walk(f"{prefix}.{k}" if prefix else k, v)
+        else:
+            out[prefix] = np.asarray(tree)
+
+    walk("", module.parameters())
+    walk("", module.buffers())
+    return out
+
+
+def save_weights(module: Module, path: str) -> None:
+    """Weights-only save, keyed by dotted path (≙ saveWeights)."""
+    state = _flatten_state(module)
+    with open(path, "wb") as f:
+        np.savez(f, **state)
+
+
+def load_weights(module: Module, path: str, strict: bool = True) -> Module:
+    """Load a weights-only file into an already-built architecture."""
+    with np.load(path, allow_pickle=False) as z:
+        saved = {k: z[k] for k in z.files}
+    have = _flatten_state(module)
+    missing = set(have) - set(saved)
+    unexpected = set(saved) - set(have)
+    if strict and (missing or unexpected):
+        raise KeyError(
+            f"weight mismatch: missing={sorted(missing)[:5]} "
+            f"unexpected={sorted(unexpected)[:5]}")
+
+    def assign(mod: Module, dotted: str, value):
+        parts = dotted.split(".")
+        obj = mod
+        for p in parts[:-1]:
+            if "[" in p:
+                name, idx = p[:-1].split("[")
+                obj = obj._modules[name]._items[int(idx)]
+            else:
+                obj = obj._modules[p]
+        leaf = parts[-1]
+        arr = jnp.asarray(value)
+        store = (obj._params if leaf in obj._params
+                 else obj._buffers if leaf in obj._buffers else None)
+        if store is None:
+            if strict:
+                raise KeyError(f"no leaf {dotted}")
+            return
+        if tuple(store[leaf].shape) != tuple(arr.shape):
+            if strict:
+                raise ValueError(
+                    f"shape mismatch at {dotted}: model has "
+                    f"{tuple(store[leaf].shape)}, file has "
+                    f"{tuple(arr.shape)}")
+            return
+        store[leaf] = arr
+
+    for k, v in saved.items():
+        if k in have:
+            assign(module, k, v)
+    return module
