@@ -10,5 +10,8 @@ from bigdl_tpu.ops.attention_kernels import (
     dot_product_attention,
     flash_attention,
 )
+from bigdl_tpu.ops import operations  # noqa: F401
+from bigdl_tpu.ops.operations import *  # noqa: F401,F403
 
-__all__ = ["dot_product_attention", "flash_attention"]
+__all__ = ["dot_product_attention", "flash_attention"] \
+    + list(operations.__all__)

@@ -1,0 +1,429 @@
+"""TensorFlow-style stateless operations.
+
+Reference: nn/ops/ (71 files — Operation = AbstractModule with no
+backward, used for TF graph execution) and nn/ops/Operation.scala.
+Each op is a thin Module over the matching jax/jnp primitive; under jit
+they fuse into the surrounding computation, so there is no per-op
+dispatch cost as in the reference's per-layer JNI calls.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.core.module import Module, next_rng_key
+
+__all__ = [
+    "Operation", "All", "Any", "ArgMax", "BatchMatMul", "Cast", "Ceil",
+    "Equal", "NotEqual", "Greater", "GreaterEqual", "Less", "LessEqual",
+    "Erf", "Erfc", "Expm1", "Floor", "FloorDiv", "FloorMod", "Inv",
+    "IsFinite", "IsInf", "IsNan", "L2Loss", "Lgamma", "Digamma", "Log1p",
+    "LogicalAnd", "LogicalOr", "LogicalNot", "MaximumOp", "MinimumOp",
+    "Mod", "OneHot", "Pad", "Pow", "Prod", "RandomUniform", "RangeOps",
+    "Rank", "Rint", "Round", "Rsqrt", "SelectOp", "Sign", "Slice",
+    "SquaredDifference", "SumOp", "TileOp", "TopK", "TruncateDiv",
+    "TruncatedNormal", "BucketizedCol", "CrossEntropy", "DepthwiseConv2D",
+]
+
+
+class Operation(Module):
+    """Stateless forward-only op (≙ nn/ops/Operation.scala: backward is
+    an error; here gradients simply flow through jax where defined)."""
+
+
+class _Unary(Operation):
+    fn = None
+
+    def forward(self, x):
+        return type(self).fn(x)
+
+
+class _Binary(Operation):
+    """Takes a table (pair) input like the reference ops."""
+    fn = None
+
+    def forward(self, xs):
+        a, b = xs
+        return type(self).fn(a, b)
+
+
+class _AxisReduce(Operation):
+    """Reduce over an `axis` table input (shared by All/Any)."""
+
+    fn = None
+
+    def __init__(self, keep_dims: bool = False):
+        super().__init__()
+        self.keep_dims = keep_dims
+
+    def forward(self, xs):
+        x, axis = (xs if isinstance(xs, (tuple, list)) else (xs, None))
+        axis = tuple(np.asarray(axis).ravel().tolist()) \
+            if axis is not None else None
+        return type(self).fn(x, axis=axis, keepdims=self.keep_dims)
+
+
+class All(_AxisReduce):
+    """(≙ nn/ops/All.scala)"""
+    fn = staticmethod(jnp.all)
+
+
+class Any(_AxisReduce):
+    """(≙ nn/ops/Any.scala)"""
+    fn = staticmethod(jnp.any)
+
+
+class ArgMax(Operation):
+    def __init__(self, axis: int = 0):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        return jnp.argmax(x, axis=self.axis)
+
+
+class BatchMatMul(Operation):
+    """(≙ nn/ops/BatchMatMul.scala) with adj_x/adj_y transposes."""
+
+    def __init__(self, adj_x: bool = False, adj_y: bool = False):
+        super().__init__()
+        self.adj_x, self.adj_y = adj_x, adj_y
+
+    def forward(self, xs):
+        a, b = xs
+        if self.adj_x:
+            a = jnp.swapaxes(a, -1, -2)
+        if self.adj_y:
+            b = jnp.swapaxes(b, -1, -2)
+        return jnp.matmul(a, b)
+
+
+class Cast(Operation):
+    def __init__(self, dtype):
+        super().__init__()
+        self.dtype = jnp.dtype(dtype)
+
+    def forward(self, x):
+        return x.astype(self.dtype)
+
+
+class Ceil(_Unary):
+    fn = staticmethod(jnp.ceil)
+
+
+class Equal(_Binary):
+    fn = staticmethod(jnp.equal)
+
+
+class NotEqual(_Binary):
+    fn = staticmethod(jnp.not_equal)
+
+
+class Greater(_Binary):
+    fn = staticmethod(jnp.greater)
+
+
+class GreaterEqual(_Binary):
+    fn = staticmethod(jnp.greater_equal)
+
+
+class Less(_Binary):
+    fn = staticmethod(jnp.less)
+
+
+class LessEqual(_Binary):
+    fn = staticmethod(jnp.less_equal)
+
+
+class Erf(_Unary):
+    fn = staticmethod(jax.scipy.special.erf)
+
+
+class Erfc(_Unary):
+    fn = staticmethod(jax.scipy.special.erfc)
+
+
+class Expm1(_Unary):
+    fn = staticmethod(jnp.expm1)
+
+
+class Floor(_Unary):
+    fn = staticmethod(jnp.floor)
+
+
+class FloorDiv(_Binary):
+    fn = staticmethod(jnp.floor_divide)
+
+
+class FloorMod(_Binary):
+    fn = staticmethod(jnp.mod)
+
+
+class Inv(_Unary):
+    """Reciprocal (≙ nn/ops/Inv.scala)."""
+    fn = staticmethod(lambda x: 1.0 / x)
+
+
+class IsFinite(_Unary):
+    fn = staticmethod(jnp.isfinite)
+
+
+class IsInf(_Unary):
+    fn = staticmethod(jnp.isinf)
+
+
+class IsNan(_Unary):
+    fn = staticmethod(jnp.isnan)
+
+
+class L2Loss(Operation):
+    """sum(x**2)/2 (≙ nn/ops/L2Loss.scala)."""
+
+    def forward(self, x):
+        return jnp.sum(jnp.square(x)) / 2
+
+
+class Lgamma(_Unary):
+    fn = staticmethod(jax.scipy.special.gammaln)
+
+
+class Digamma(_Unary):
+    fn = staticmethod(jax.scipy.special.digamma)
+
+
+class Log1p(_Unary):
+    fn = staticmethod(jnp.log1p)
+
+
+class LogicalAnd(_Binary):
+    fn = staticmethod(jnp.logical_and)
+
+
+class LogicalOr(_Binary):
+    fn = staticmethod(jnp.logical_or)
+
+
+class LogicalNot(_Unary):
+    fn = staticmethod(jnp.logical_not)
+
+
+class MaximumOp(_Binary):
+    fn = staticmethod(jnp.maximum)
+
+
+class MinimumOp(_Binary):
+    fn = staticmethod(jnp.minimum)
+
+
+class Mod(_Binary):
+    fn = staticmethod(jnp.mod)
+
+
+class OneHot(Operation):
+    """(≙ nn/ops/OneHot.scala): table input (indices, depth, on, off)."""
+
+    def __init__(self, axis: int = -1):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, xs):
+        indices, depth = xs[0], int(xs[1])
+        on = xs[2] if len(xs) > 2 else 1.0
+        off = xs[3] if len(xs) > 3 else 0.0
+        oh = jax.nn.one_hot(indices, depth, axis=self.axis)
+        return oh * on + (1 - oh) * off
+
+
+class Pad(Operation):
+    """(≙ nn/ops/Pad.scala): table input (tensor, paddings [n,2])."""
+
+    def __init__(self, mode: str = "CONSTANT", constant_value: float = 0.0):
+        super().__init__()
+        if mode not in ("CONSTANT", "REFLECT", "SYMMETRIC"):
+            raise ValueError(f"unsupported pad mode {mode!r}")
+        self.mode = mode
+        self.constant_value = constant_value
+
+    def forward(self, xs):
+        x, paddings = xs
+        pads = [tuple(int(v) for v in row) for row in np.asarray(paddings)]
+        if self.mode == "CONSTANT":
+            return jnp.pad(x, pads, constant_values=self.constant_value)
+        return jnp.pad(x, pads, mode=self.mode.lower())
+
+
+class Pow(_Binary):
+    fn = staticmethod(jnp.power)
+
+
+class Prod(Operation):
+    def __init__(self, axis: int = 0, keep_dims: bool = False):
+        super().__init__()
+        self.axis, self.keep_dims = axis, keep_dims
+
+    def forward(self, x):
+        return jnp.prod(x, axis=self.axis, keepdims=self.keep_dims)
+
+
+class RandomUniform(Operation):
+    """(≙ nn/ops/RandomUniform.scala). Needs forward_context rng."""
+
+    def __init__(self, minval: float = 0.0, maxval: float = 1.0):
+        super().__init__()
+        self.minval, self.maxval = minval, maxval
+
+    def forward(self, shape):
+        shape = tuple(int(s) for s in np.asarray(shape).ravel())
+        return jax.random.uniform(next_rng_key(), shape,
+                                  minval=self.minval, maxval=self.maxval)
+
+
+class RangeOps(Operation):
+    """(≙ nn/ops/RangeOps.scala): (start, limit, delta) table; float
+    ranges supported like tf.range."""
+
+    def forward(self, xs):
+        start, limit, delta = (np.asarray(v).item() for v in xs)
+        return jnp.arange(start, limit, delta)
+
+
+class Rank(Operation):
+    def forward(self, x):
+        return jnp.asarray(x.ndim, jnp.int32)
+
+
+class Rint(_Unary):
+    fn = staticmethod(jnp.rint)
+
+
+class Round(_Unary):
+    fn = staticmethod(jnp.round)
+
+
+class Rsqrt(_Unary):
+    fn = staticmethod(jax.lax.rsqrt)
+
+
+class SelectOp(Operation):
+    """tf.where(cond, x, y) (≙ nn/ops/Select.scala)."""
+
+    def forward(self, xs):
+        cond, x, y = xs
+        return jnp.where(cond, x, y)
+
+
+class Sign(_Unary):
+    fn = staticmethod(jnp.sign)
+
+
+class Slice(Operation):
+    """(≙ nn/ops/Slice.scala): static begin/size config."""
+
+    def __init__(self, begin: Sequence[int], size: Sequence[int]):
+        super().__init__()
+        self.begin = tuple(begin)
+        self.size = tuple(size)
+
+    def forward(self, x):
+        limits = tuple(b + (s if s != -1 else dim - b)
+                       for b, s, dim in zip(self.begin, self.size, x.shape))
+        return jax.lax.slice(x, self.begin, limits)
+
+
+class SquaredDifference(_Binary):
+    fn = staticmethod(lambda a, b: jnp.square(a - b))
+
+
+class SumOp(Operation):
+    """reduce_sum with axis table input (≙ nn/ops/Sum.scala)."""
+
+    def __init__(self, keep_dims: bool = False):
+        super().__init__()
+        self.keep_dims = keep_dims
+
+    def forward(self, xs):
+        x, axis = (xs if isinstance(xs, (tuple, list)) else (xs, None))
+        axis = tuple(np.asarray(axis).ravel().tolist()) \
+            if axis is not None else None
+        return jnp.sum(x, axis=axis, keepdims=self.keep_dims)
+
+
+class TileOp(Operation):
+    """(≙ nn/ops/Tile.scala): (tensor, multiples) table."""
+
+    def forward(self, xs):
+        x, multiples = xs
+        return jnp.tile(x, tuple(int(m) for m in np.asarray(multiples)))
+
+
+class TopK(Operation):
+    def __init__(self, k: int, sorted: bool = True):
+        super().__init__()
+        self.k = k
+
+    def forward(self, x):
+        values, indices = jax.lax.top_k(x, self.k)
+        return values, indices
+
+
+class TruncateDiv(_Binary):
+    fn = staticmethod(lambda a, b: jnp.trunc(a / b).astype(a.dtype))
+
+
+class TruncatedNormal(Operation):
+    """(≙ nn/ops/TruncatedNormal.scala). Needs forward_context rng."""
+
+    def __init__(self, mean: float = 0.0, stddev: float = 1.0):
+        super().__init__()
+        self.mean, self.stddev = mean, stddev
+
+    def forward(self, shape):
+        shape = tuple(int(s) for s in np.asarray(shape).ravel())
+        z = jax.random.truncated_normal(next_rng_key(), -2.0, 2.0, shape)
+        return z * self.stddev + self.mean
+
+
+class BucketizedCol(Operation):
+    """Bucketize by boundaries (≙ nn/ops/BucketizedCol.scala)."""
+
+    def __init__(self, boundaries: Sequence[float]):
+        super().__init__()
+        self.boundaries = jnp.asarray(sorted(boundaries))
+
+    def forward(self, x):
+        return jnp.searchsorted(self.boundaries, x, side="right") \
+            .astype(jnp.int32)
+
+
+class CrossEntropy(Operation):
+    """Per-sample softmax cross entropy from logits
+    (≙ nn/ops/CrossEntropy.scala): input (logits, one-hot labels)."""
+
+    def forward(self, xs):
+        logits, labels = xs
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.sum(labels * logp, axis=-1)
+
+
+class DepthwiseConv2D(Operation):
+    """(≙ nn/ops/DepthwiseConv2D.scala): input (x NHWC, filter HWCM)."""
+
+    def __init__(self, stride_w: int = 1, stride_h: int = 1,
+                 padding: str = "SAME"):
+        super().__init__()
+        self.strides = (stride_h, stride_w)
+        self.padding = padding
+
+    def forward(self, xs):
+        x, w = xs
+        kh, kw, c, m = w.shape
+        w = w.reshape(kh, kw, 1, c * m)
+        return jax.lax.conv_general_dilated(
+            x, w, window_strides=self.strides, padding=self.padding,
+            feature_group_count=c,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))

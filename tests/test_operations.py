@@ -1,0 +1,142 @@
+"""Tests for TF-style stateless ops (reference nn/ops/)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu import ops
+from bigdl_tpu.core.module import forward_context
+
+
+def test_elementwise_unary_ops():
+    x = jnp.asarray([[1.3, -2.7], [0.0, 4.5]])
+    np.testing.assert_allclose(ops.Ceil()(x), np.ceil(np.asarray(x)))
+    np.testing.assert_allclose(ops.Floor()(x), np.floor(np.asarray(x)))
+    np.testing.assert_allclose(ops.Round()(x), np.round(np.asarray(x)))
+    np.testing.assert_allclose(ops.Sign()(x), np.sign(np.asarray(x)))
+    np.testing.assert_allclose(ops.Log1p()(jnp.abs(x)),
+                               np.log1p(np.abs(np.asarray(x))), rtol=1e-6)
+    np.testing.assert_allclose(
+        ops.Rsqrt()(jnp.asarray([4.0, 16.0])), [0.5, 0.25], rtol=1e-6)
+    np.testing.assert_allclose(
+        ops.Inv()(jnp.asarray([2.0, 4.0])), [0.5, 0.25], rtol=1e-6)
+
+
+def test_special_functions_match_scipy():
+    sps = pytest.importorskip("scipy.special")
+    x = jnp.asarray([0.5, 1.5, 2.5])
+    np.testing.assert_allclose(ops.Erf()(x), sps.erf(np.asarray(x)),
+                               rtol=1e-5)
+    np.testing.assert_allclose(ops.Lgamma()(x),
+                               sps.gammaln(np.asarray(x)), rtol=1e-5)
+    np.testing.assert_allclose(ops.Digamma()(x),
+                               sps.digamma(np.asarray(x)), rtol=1e-4)
+
+
+def test_comparisons_and_logical():
+    a = jnp.asarray([1, 2, 3])
+    b = jnp.asarray([2, 2, 2])
+    assert list(ops.Greater()((a, b))) == [False, False, True]
+    assert list(ops.LessEqual()((a, b))) == [True, True, False]
+    assert list(ops.Equal()((a, b))) == [False, True, False]
+    t = jnp.asarray([True, False])
+    f = jnp.asarray([True, True])
+    assert list(ops.LogicalAnd()((t, f))) == [True, False]
+    assert list(ops.LogicalNot()(t)) == [False, True]
+
+
+def test_reductions_with_axis_table():
+    x = jnp.asarray([[1.0, 2.0], [3.0, 4.0]])
+    np.testing.assert_allclose(ops.SumOp()((x, 0)), [4.0, 6.0])
+    np.testing.assert_allclose(ops.Prod(axis=1)(x), [2.0, 12.0])
+    assert bool(ops.All()((x > 0, 0)).all())
+    assert bool(ops.Any()((x > 3, None)))
+
+
+def test_batch_matmul_adjoints():
+    rng = np.random.default_rng(0)
+    a = rng.normal(size=(2, 3, 4)).astype(np.float32)
+    b = rng.normal(size=(2, 4, 5)).astype(np.float32)
+    got = ops.BatchMatMul()((jnp.asarray(a), jnp.asarray(b)))
+    np.testing.assert_allclose(got, a @ b, rtol=1e-5)
+    got_t = ops.BatchMatMul(adj_x=True)(
+        (jnp.asarray(a.transpose(0, 2, 1)), jnp.asarray(b)))
+    np.testing.assert_allclose(got_t, a @ b, rtol=1e-5)
+
+
+def test_one_hot_and_pad_and_slice():
+    oh = ops.OneHot()((jnp.asarray([0, 2]), 3, 5.0, -1.0))
+    np.testing.assert_allclose(
+        oh, [[5, -1, -1], [-1, -1, 5]])
+    padded = ops.Pad()((jnp.ones((2, 2)), [[1, 1], [0, 0]]))
+    assert padded.shape == (4, 2)
+    assert float(padded[0, 0]) == 0.0
+    x = jnp.arange(24).reshape(2, 3, 4)
+    s = ops.Slice(begin=(0, 1, 0), size=(2, 2, -1))(x)
+    assert s.shape == (2, 2, 4)
+    np.testing.assert_array_equal(s, np.asarray(x)[:, 1:3, :])
+
+
+def test_topk_select_squared_difference():
+    v, i = ops.TopK(2)(jnp.asarray([1.0, 5.0, 3.0, 4.0]))
+    assert list(np.asarray(v)) == [5.0, 4.0]
+    assert list(np.asarray(i)) == [1, 3]
+    sel = ops.SelectOp()((jnp.asarray([True, False]),
+                          jnp.asarray([1.0, 2.0]),
+                          jnp.asarray([9.0, 9.0])))
+    assert list(np.asarray(sel)) == [1.0, 9.0]
+    np.testing.assert_allclose(
+        ops.SquaredDifference()((jnp.asarray([3.0]), jnp.asarray([1.0]))),
+        [4.0])
+
+
+def test_random_ops_need_rng_and_are_deterministic_per_key():
+    with pytest.raises(RuntimeError):
+        ops.RandomUniform()(jnp.asarray([2, 2]))
+    key = jax.random.key(0)
+    with forward_context(rng=key):
+        a = ops.RandomUniform(0.0, 1.0)(jnp.asarray([4]))
+    with forward_context(rng=key):
+        b = ops.RandomUniform(0.0, 1.0)(jnp.asarray([4]))
+    np.testing.assert_array_equal(a, b)
+    assert a.shape == (4,)
+    assert (np.asarray(a) >= 0).all() and (np.asarray(a) < 1).all()
+    with forward_context(rng=key):
+        t = ops.TruncatedNormal(stddev=2.0)(jnp.asarray([1000]))
+    assert np.abs(np.asarray(t)).max() <= 4.0 + 1e-5  # truncated at 2σ
+
+
+def test_bucketized_col_and_cross_entropy():
+    b = ops.BucketizedCol(boundaries=[0.0, 10.0, 100.0])
+    np.testing.assert_array_equal(
+        b(jnp.asarray([-5.0, 5.0, 50.0, 500.0])), [0, 1, 2, 3])
+    logits = jnp.asarray([[2.0, 1.0, 0.1]])
+    labels = jnp.asarray([[1.0, 0.0, 0.0]])
+    ce = ops.CrossEntropy()((logits, labels))
+    want = -np.log(np.exp(2.0) / np.exp([2.0, 1.0, 0.1]).sum())
+    np.testing.assert_allclose(ce, [want], rtol=1e-5)
+
+
+def test_depthwise_conv2d():
+    torch = pytest.importorskip("torch")
+    import torch.nn.functional as F
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(1, 6, 6, 3)).astype(np.float32)
+    w = rng.normal(size=(3, 3, 3, 2)).astype(np.float32)  # HWCM
+    got = ops.DepthwiseConv2D(padding="VALID")(
+        (jnp.asarray(x), jnp.asarray(w)))
+    # torch: depthwise = groups=C, weight [C*M, 1, kh, kw]
+    tw = torch.tensor(w.transpose(2, 3, 0, 1).reshape(6, 1, 3, 3))
+    tx = torch.tensor(x.transpose(0, 3, 1, 2))
+    want = F.conv2d(tx, tw, groups=3).numpy().transpose(0, 2, 3, 1)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_cast_rank_range():
+    x = jnp.asarray([1.7, 2.3])
+    assert ops.Cast(jnp.int32)(x).dtype == jnp.int32
+    assert int(ops.Rank()(jnp.ones((2, 3, 4)))) == 3
+    np.testing.assert_array_equal(ops.RangeOps()((1, 7, 2)), [1, 3, 5])
