@@ -19,3 +19,4 @@ from bigdl_tpu.nn.rnn import *             # noqa: F401,F403
 from bigdl_tpu.nn.attention import *       # noqa: F401,F403
 from bigdl_tpu.nn.moe import *             # noqa: F401,F403
 from bigdl_tpu.nn.quantized import *       # noqa: F401,F403
+from bigdl_tpu.nn.detection import *       # noqa: F401,F403

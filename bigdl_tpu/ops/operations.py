@@ -5,6 +5,11 @@ backward, used for TF graph execution) and nn/ops/Operation.scala.
 Each op is a thin Module over the matching jax/jnp primitive; under jit
 they fuse into the surrounding computation, so there is no per-op
 dispatch cost as in the reference's per-layer JNI calls.
+
+Shape-like operands (axis, paddings, multiples, depth, shape, range
+bounds) are *static*: they are concretized at trace time, mirroring
+XLA's static-shape model, so they must not be produced by traced
+computation.  Data operands are fully traceable.
 """
 
 from __future__ import annotations
@@ -221,7 +226,9 @@ class MinimumOp(_Binary):
 
 
 class Mod(_Binary):
-    fn = staticmethod(jnp.mod)
+    # C truncated-remainder semantics (pairs with TruncateDiv so that
+    # truncatediv(x, y) * y + mod(x, y) == x, matching the TF op)
+    fn = staticmethod(jax.lax.rem)
 
 
 class OneHot(Operation):
@@ -365,14 +372,23 @@ class TopK(Operation):
     def __init__(self, k: int, sorted: bool = True):
         super().__init__()
         self.k = k
+        # lax.top_k always returns sorted results; sorted=False (order
+        # unspecified in the TF contract) is satisfied by that too.
+        self.sorted = sorted
 
     def forward(self, x):
         values, indices = jax.lax.top_k(x, self.k)
         return values, indices
 
 
+def _truncate_div(a, b):
+    if jnp.issubdtype(jnp.result_type(a), jnp.integer):
+        return jax.lax.div(a, b)  # exact C-style truncating int division
+    return jnp.trunc(a / b)
+
+
 class TruncateDiv(_Binary):
-    fn = staticmethod(lambda a, b: jnp.trunc(a / b).astype(a.dtype))
+    fn = staticmethod(_truncate_div)
 
 
 class TruncatedNormal(Operation):

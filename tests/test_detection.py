@@ -1,0 +1,327 @@
+"""Detection-stack tests: NMS/IoU vs numpy reference loops, RoiAlign vs a
+naive bilinear implementation, anchors, box transforms, FPN/Pooler/heads
+shape + semantics, SSD PriorBox/DetectionOutput.
+
+Mirrors the reference spec strategy for nn/NmsSpec, RoiAlignSpec,
+AnchorSpec, FPNSpec, PoolerSpec, BoxHeadSpec, MaskHeadSpec,
+PriorBoxSpec, DetectionOutputSSDSpec (spark/dl/src/test/.../nn/).
+"""
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.nn.detection import (
+    Anchor, BoxHead, DetectionOutputSSD, FPN, MaskHead, Pooler, PriorBox,
+    Proposal, RegionProposal, RoiAlign, RoiPooling, bbox_encode,
+    bbox_transform_inv, box_iou, clip_boxes, nms,
+)
+from bigdl_tpu.utils import set_seed
+
+
+def np_iou(a, b):
+    x1 = max(a[0], b[0]); y1 = max(a[1], b[1])
+    x2 = min(a[2], b[2]); y2 = min(a[3], b[3])
+    inter = max(0.0, x2 - x1) * max(0.0, y2 - y1)
+    aa = (a[2] - a[0]) * (a[3] - a[1])
+    ab = (b[2] - b[0]) * (b[3] - b[1])
+    return inter / (aa + ab - inter) if aa + ab - inter > 0 else 0.0
+
+
+def np_nms(boxes, scores, thresh):
+    order = np.argsort(-scores)
+    keep = []
+    while order.size:
+        i = order[0]
+        keep.append(i)
+        rest = [j for j in order[1:]
+                if np_iou(boxes[i], boxes[j]) <= thresh]
+        order = np.array(rest, int)
+    return keep
+
+
+def test_box_iou_matches_scalar():
+    rng = np.random.RandomState(0)
+    a = rng.rand(6, 4) * 50
+    a[:, 2:] = a[:, :2] + rng.rand(6, 2) * 50 + 1
+    b = rng.rand(4, 4) * 50
+    b[:, 2:] = b[:, :2] + rng.rand(4, 2) * 50 + 1
+    got = np.asarray(box_iou(a, b))
+    for i in range(6):
+        for j in range(4):
+            assert abs(got[i, j] - np_iou(a[i], b[j])) < 1e-5
+
+
+def test_nms_matches_numpy_reference():
+    rng = np.random.RandomState(1)
+    n = 40
+    boxes = rng.rand(n, 4) * 80
+    boxes[:, 2:] = boxes[:, :2] + rng.rand(n, 2) * 40 + 5
+    scores = rng.rand(n).astype(np.float32)
+    ref = np_nms(boxes, scores, 0.5)
+    idx, valid = jax.jit(
+        lambda b, s: nms(b, s, 0.5, n))(jnp.asarray(boxes),
+                                        jnp.asarray(scores))
+    got = [int(i) for i, v in zip(idx, valid) if v]
+    assert got == ref
+
+
+def test_nms_fixed_output_and_neg_inf_exclusion():
+    boxes = jnp.asarray([[0, 0, 10, 10], [100, 100, 110, 110],
+                         [0, 0, 10, 10]], jnp.float32)
+    scores = jnp.asarray([0.9, -jnp.inf, 0.8])
+    idx, valid = nms(boxes, scores, 0.5, 5)
+    assert idx.shape == (5,)
+    got = [int(i) for i, v in zip(idx, valid) if v]
+    assert got == [0]  # box2 is -inf-masked, box3 suppressed by box1
+
+
+def naive_roi_align(feat, roi, scale, sr, ph, pw, aligned=True):
+    """Straight-from-the-paper per-sample loop (numpy)."""
+    H, W, C = feat.shape
+    off = 0.5 if aligned else 0.0
+    x1, y1, x2, y2 = [r * scale - off for r in roi]
+    rw, rh = x2 - x1, y2 - y1
+    if not aligned:
+        rw, rh = max(rw, 1.0), max(rh, 1.0)
+    out = np.zeros((ph, pw, C), np.float32)
+    for py in range(ph):
+        for px in range(pw):
+            acc = np.zeros(C, np.float32)
+            for iy in range(sr):
+                for ix in range(sr):
+                    y = y1 + (py + (iy + .5) / sr) * rh / ph
+                    x = x1 + (px + (ix + .5) / sr) * rw / pw
+                    if y < -1 or y > H or x < -1 or x > W:
+                        continue
+                    y = min(max(y, 0), H - 1)
+                    x = min(max(x, 0), W - 1)
+                    y0, x0 = int(y), int(x)
+                    y1c, x1c = min(y0 + 1, H - 1), min(x0 + 1, W - 1)
+                    ly, lx = y - y0, x - x0
+                    acc += ((1 - ly) * (1 - lx) * feat[y0, x0]
+                            + (1 - ly) * lx * feat[y0, x1c]
+                            + ly * (1 - lx) * feat[y1c, x0]
+                            + ly * lx * feat[y1c, x1c])
+            out[py, px] = acc / (sr * sr)
+    return out
+
+
+def test_roi_align_matches_naive():
+    rng = np.random.RandomState(2)
+    feat = rng.randn(16, 20, 3).astype(np.float32)
+    rois = np.array([[4.0, 4.0, 60.0, 50.0],
+                     [0.0, 0.0, 16.0, 16.0]], np.float32)
+    layer = RoiAlign(0.25, 2, 7, 7, aligned=True)
+    got = np.asarray(layer((jnp.asarray(feat)[None], jnp.asarray(rois))))
+    for i, roi in enumerate(rois):
+        want = naive_roi_align(feat, roi, 0.25, 2, 7, 7)
+        np.testing.assert_allclose(got[i], want, rtol=1e-4, atol=1e-5)
+
+
+def test_roi_pooling_basic():
+    feat = np.zeros((1, 8, 8, 1), np.float32)
+    feat[0, 2, 3, 0] = 5.0
+    rois = jnp.asarray([[0, 0, 0, 7, 7]], jnp.float32)
+    layer = RoiPooling(2, 2, 1.0)
+    out = np.asarray(layer((jnp.asarray(feat), rois)))
+    assert out.shape == (1, 2, 2, 1)
+    assert out.max() == pytest.approx(5.0)
+    # the max lives in the top-left 4x4 bin
+    assert out[0, 0, 0, 0] == pytest.approx(5.0)
+
+
+def test_anchor_generation():
+    a = Anchor(ratios=[0.5, 1.0, 2.0], scales=[8.0])
+    assert a.anchor_num == 3
+    base = a.base_anchors(16.0)
+    # ratio=1 scale=8 on base 16 → 128x128 box centred at 7.5
+    r1 = base[1]
+    assert r1[2] - r1[0] + 1 == pytest.approx(128)
+    assert (r1[0] + r1[2]) / 2 == pytest.approx(7.5)
+    grid = np.asarray(a.generate(2, 3, 16.0))
+    assert grid.shape == (2 * 3 * 3, 4)
+    # shifting by one stride moves anchors by 16 in x
+    np.testing.assert_allclose(grid[3] - grid[0], [16, 0, 16, 0])
+
+
+def test_bbox_transform_roundtrip():
+    rng = np.random.RandomState(3)
+    ex = rng.rand(10, 4) * 50
+    ex[:, 2:] = ex[:, :2] + rng.rand(10, 2) * 60 + 4
+    gt = rng.rand(10, 4) * 50
+    gt[:, 2:] = gt[:, :2] + rng.rand(10, 2) * 60 + 4
+    deltas = bbox_encode(jnp.asarray(ex), jnp.asarray(gt))
+    back = bbox_transform_inv(jnp.asarray(ex), deltas)
+    np.testing.assert_allclose(np.asarray(back), gt, rtol=1e-4, atol=1e-3)
+
+
+def test_clip_boxes():
+    b = jnp.asarray([[-5.0, -5.0, 200.0, 90.0]])
+    out = np.asarray(clip_boxes(b, 100, 150))
+    np.testing.assert_allclose(out[0], [0, 0, 149, 90])
+
+
+def test_fpn_shapes_and_topdown():
+    set_seed(0)
+    fpn = FPN([8, 16, 32], 4, top_blocks=1)
+    feats = [jnp.ones((1, 16, 16, 8)), jnp.ones((1, 8, 8, 16)),
+             jnp.ones((1, 4, 4, 32))]
+    outs = fpn(feats)
+    assert [tuple(o.shape) for o in outs] == [
+        (1, 16, 16, 4), (1, 8, 8, 4), (1, 4, 4, 4), (1, 2, 2, 4)]
+
+
+def test_pooler_level_assignment():
+    set_seed(0)
+    p = Pooler(3, [0.25, 0.125], 2)
+    assert p.lvl_min == 2 and p.lvl_max == 3
+    rois = jnp.asarray([[0, 0, 40, 40],        # tiny → lvl 2
+                        [0, 0, 120, 120]],     # large → lvl 3
+                       jnp.float32)
+    lv = np.asarray(p.level_of(rois))
+    assert lv[0] == 2 and lv[1] == 3
+    feats = [jnp.ones((1, 32, 32, 4)), jnp.ones((1, 16, 16, 4))]
+    out = p((feats, rois))
+    assert out.shape == (2, 3, 3, 4)
+    np.testing.assert_allclose(np.asarray(out), 1.0, atol=1e-5)
+
+
+def test_region_proposal_shapes():
+    set_seed(1)
+    rpn = RegionProposal(8, anchor_sizes=[32, 64], aspect_ratios=[1.0],
+                         anchor_stride=[4, 8], pre_nms_topn_test=50,
+                         post_nms_topn_test=20)
+    rpn.eval_mode()
+    feats = [jnp.asarray(np.random.RandomState(0).randn(1, 16, 16, 8),
+                         jnp.float32),
+             jnp.asarray(np.random.RandomState(1).randn(1, 8, 8, 8),
+                         jnp.float32)]
+    boxes, scores = rpn((feats, jnp.asarray([64.0, 64.0])))
+    assert boxes.shape == (20, 4)
+    assert scores.shape == (20,)
+    b = np.asarray(boxes)
+    assert (b[:, 2] >= b[:, 0] - 1).all() and (b[:, 3] >= b[:, 1] - 1).all()
+    assert b.min() >= -1e-5 and b.max() <= 63.0 + 1e-4
+
+
+def test_proposal_shapes():
+    set_seed(2)
+    prop = Proposal(pre_nms_topn=60, post_nms_topn=10,
+                    ratios=[0.5, 1.0, 2.0], scales=[8.0])
+    prop.eval_mode()
+    a = prop.anchor.anchor_num
+    rng = np.random.RandomState(0)
+    cls = jax.nn.softmax(
+        jnp.asarray(rng.randn(1, 6, 6, 2 * a), jnp.float32), -1)
+    bbox = jnp.asarray(rng.randn(1, 6, 6, 4 * a) * 0.1, jnp.float32)
+    rois, scores = prop((cls, bbox, jnp.asarray([96.0, 96.0, 1.0, 1.0])))
+    assert rois.shape == (10, 5)
+    np.testing.assert_allclose(np.asarray(rois[:, 0]), 0.0)
+
+
+def test_box_head_end_to_end_shapes():
+    set_seed(3)
+    head = BoxHead(in_channels=4, resolution=3, scales=[0.25, 0.125],
+                   sampling_ratio=2, score_thresh=0.0, nms_thresh=0.5,
+                   max_per_image=8, output_size=16, num_classes=5)
+    head.eval_mode()
+    feats = [jnp.asarray(np.random.RandomState(1).randn(1, 32, 32, 4),
+                         jnp.float32),
+             jnp.asarray(np.random.RandomState(2).randn(1, 16, 16, 4),
+                         jnp.float32)]
+    props = jnp.asarray([[0, 0, 30, 30], [10, 10, 100, 100],
+                         [5, 5, 64, 40]], jnp.float32)
+    boxes, labels, scores, valid = head((feats, props,
+                                         jnp.asarray([128.0, 128.0])))
+    assert boxes.shape == (8, 4) and labels.shape == (8,)
+    assert scores.shape == (8,) and valid.shape == (8,)
+    lb = np.asarray(labels)[np.asarray(valid)]
+    assert ((lb >= 1) & (lb < 5)).all()
+
+
+def test_mask_head_shapes():
+    set_seed(4)
+    mh = MaskHead(in_channels=4, resolution=4, scales=[0.25],
+                  sampling_ratio=2, layers=[8, 8], dilation=1,
+                  num_classes=3)
+    feats = [jnp.asarray(np.random.RandomState(3).randn(1, 16, 16, 4),
+                         jnp.float32)]
+    boxes = jnp.asarray([[0, 0, 20, 20], [8, 8, 40, 40]], jnp.float32)
+    labels = jnp.asarray([1, 2], jnp.int32)
+    masks, logits = mh((feats, boxes, labels))
+    assert masks.shape == (2, 8, 8)
+    assert logits.shape == (2, 3, 8, 8)
+    m = np.asarray(masks)
+    assert (m >= 0).all() and (m <= 1).all()
+
+
+def test_prior_box_values():
+    pb = PriorBox(min_sizes=[30.0], max_sizes=[60.0],
+                  aspect_ratios=[2.0], is_flip=True, is_clip=False,
+                  variances=[0.1, 0.1, 0.2, 0.2], img_size=300,
+                  step=100.0)
+    # priors per location: 1 (min) + 1 (sqrt(min*max)) + 2 (ar 2, 1/2)
+    assert pb.num_priors == 4
+    feat = jnp.zeros((1, 3, 3, 2))
+    out = np.asarray(pb(feat))
+    assert out.shape == (2, 3 * 3 * 4 * 4)
+    boxes = out[0].reshape(-1, 4)
+    # first prior at cell (0,0): centred at 50,50, 30x30, normalized /300
+    np.testing.assert_allclose(
+        boxes[0], [(50 - 15) / 300, (50 - 15) / 300,
+                   (50 + 15) / 300, (50 + 15) / 300], rtol=1e-5)
+    var = out[1].reshape(-1, 4)
+    np.testing.assert_allclose(var[0], [0.1, 0.1, 0.2, 0.2], rtol=1e-6)
+
+
+def test_detection_output_ssd():
+    # 2 priors, 3 classes; zero loc deltas → boxes = priors
+    priors = np.array([[0.1, 0.1, 0.3, 0.3], [0.6, 0.6, 0.9, 0.9]],
+                      np.float32)
+    var = np.full((2, 4), 0.1, np.float32)
+    prior_t = jnp.asarray(np.stack([priors.ravel(), var.ravel()]))
+    loc = jnp.zeros((1, 8))
+    conf = jnp.asarray([[0.05, 0.9, 0.05,    # prior 1 → class 1
+                         0.1, 0.1, 0.8]])    # prior 2 → class 2
+    det = DetectionOutputSSD(n_classes=3, nms_thresh=0.45, keep_top_k=4,
+                             conf_thresh=0.1)
+    out = np.asarray(det((loc, conf, prior_t)))
+    assert out.shape == (1, 4, 6)
+    rows = out[0]
+    # best two detections: class1@0.9 on prior1, class2@0.8 on prior2
+    assert rows[0][0] == 1 and rows[0][1] == pytest.approx(0.9, abs=1e-5)
+    np.testing.assert_allclose(rows[0][2:], priors[0], atol=1e-5)
+    assert rows[1][0] == 2 and rows[1][1] == pytest.approx(0.8, abs=1e-5)
+    np.testing.assert_allclose(rows[1][2:], priors[1], atol=1e-5)
+
+
+def test_smooth_l1_with_weights():
+    crit = nn.SmoothL1CriterionWithWeights(sigma=1.0, num=2)
+    x = jnp.asarray([0.0, 2.0])
+    t = jnp.asarray([0.25, 0.0])
+    # d = [-0.25, 2]; loss = [0.5*0.0625, 1.5] = 0.03125 + 1.5
+    got = float(crit(x, t))
+    assert got == pytest.approx((0.03125 + 1.5) / 2)
+
+
+def test_softmax_with_criterion():
+    logits = jnp.asarray([[2.0, 1.0, 0.0], [0.0, 3.0, 0.0]])
+    target = jnp.asarray([1.0, 2.0])
+    crit = nn.SoftmaxWithCriterion()
+    want = -float(jnp.mean(
+        jax.nn.log_softmax(logits, -1)[jnp.arange(2),
+                                       jnp.asarray([0, 1])]))
+    assert float(crit(logits, target)) == pytest.approx(want, rel=1e-5)
+
+
+def test_nms_jit_and_roi_align_jit():
+    """The whole stack must be jittable (static shapes)."""
+    layer = RoiAlign(0.5, 2, 2, 2)
+    f = jax.jit(lambda feat, rois: layer((feat, rois)))
+    out = f(jnp.ones((1, 8, 8, 2)), jnp.asarray([[0.0, 0.0, 8.0, 8.0]]))
+    assert out.shape == (1, 2, 2, 2)
