@@ -323,24 +323,29 @@ class Module:
 
     # -- traversal ---------------------------------------------------------
 
-    def modules(self) -> List["Module"]:
+    def _named_children(self) -> List[Tuple[str, "Module"]]:
+        """(key, submodule) pairs with nested ModuleLists flattened to
+        ``name[i]``/``name[i][j]`` keys."""
         out = []
-        for v in self._modules.values():
+
+        def expand(key, v):
             if isinstance(v, ModuleList):
-                out.extend(v._items)
+                for i, item in enumerate(v._items):
+                    expand(f"{key}[{i}]", item)
             else:
-                out.append(v)
+                out.append((key, v))
+
+        for n, v in self._modules.items():
+            expand(n, v)
         return out
+
+    def modules(self) -> List["Module"]:
+        return [m for _, m in self._named_children()]
 
     def named_modules(self, prefix: str = "") -> List[Tuple[str, "Module"]]:
         res = [(prefix or self.name, self)]
-        for n, v in self._modules.items():
-            if isinstance(v, ModuleList):
-                for i, m in enumerate(v._items):
-                    res.extend(m.named_modules(f"{prefix}.{n}[{i}]" if prefix
-                                               else f"{n}[{i}]"))
-            else:
-                res.extend(v.named_modules(f"{prefix}.{n}" if prefix else n))
+        for n, v in self._named_children():
+            res.extend(v.named_modules(f"{prefix}.{n}" if prefix else n))
         return res
 
     def apply_to_modules(self, fn: Callable[["Module"], None]) -> "Module":
@@ -361,30 +366,18 @@ class Module:
     def parameters(self) -> Dict[str, Any]:
         """Nested dict of trainable parameters (reference parameters():370)."""
         out = dict(self._params)
-        for n, v in self._modules.items():
-            if isinstance(v, ModuleList):
-                for i, m in enumerate(v._items):
-                    sub = m.parameters()
-                    if sub:
-                        out[f"{n}[{i}]"] = sub
-            else:
-                sub = v.parameters()
-                if sub:
-                    out[n] = sub
+        for n, v in self._named_children():
+            sub = v.parameters()
+            if sub:
+                out[n] = sub
         return out
 
     def buffers(self) -> Dict[str, Any]:
         out = dict(self._buffers)
-        for n, v in self._modules.items():
-            if isinstance(v, ModuleList):
-                for i, m in enumerate(v._items):
-                    sub = m.buffers()
-                    if sub:
-                        out[f"{n}[{i}]"] = sub
-            else:
-                sub = v.buffers()
-                if sub:
-                    out[n] = sub
+        for n, v in self._named_children():
+            sub = v.buffers()
+            if sub:
+                out[n] = sub
         return out
 
     def get_parameters(self):
@@ -405,13 +398,8 @@ class Module:
         for n in self._params:
             if n in params:
                 self._params[n] = jnp.asarray(params[n])
-        for n, v in self._modules.items():
-            if isinstance(v, ModuleList):
-                for i, m in enumerate(v._items):
-                    key = f"{n}[{i}]"
-                    if key in params:
-                        m.load_parameters(params[key])
-            elif n in params:
+        for n, v in self._named_children():
+            if n in params:
                 v.load_parameters(params[n])
         return self
 
@@ -421,13 +409,8 @@ class Module:
         for n in self._buffers:
             if n in buffers:
                 self._buffers[n] = jnp.asarray(buffers[n])
-        for n, v in self._modules.items():
-            if isinstance(v, ModuleList):
-                for i, m in enumerate(v._items):
-                    key = f"{n}[{i}]"
-                    if key in buffers:
-                        m.load_buffers(buffers[key])
-            elif n in buffers:
+        for n, v in self._named_children():
+            if n in buffers:
                 v.load_buffers(buffers[n])
         return self
 

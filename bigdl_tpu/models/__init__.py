@@ -5,3 +5,7 @@ from bigdl_tpu.models.resnet import (
 from bigdl_tpu.models.inception import Inception_v1
 from bigdl_tpu.models.vgg import VggForCifar10, Vgg_16, Vgg_19
 from bigdl_tpu.models.rnn_lm import PTBModel, SimpleRNN
+from bigdl_tpu.models.autoencoder import Autoencoder, autoencoder
+from bigdl_tpu.models.maskrcnn import (
+    MaskRCNN, MaskRCNNParams, ResNetFPNBackbone,
+)

@@ -1,0 +1,32 @@
+"""MNIST autoencoder (reference models/autoencoder/Autoencoder.scala:
+Reshape(784) → Linear(784, classNum) → ReLU → Linear(classNum, 784) →
+Sigmoid)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.core.module import Module
+
+__all__ = ["Autoencoder", "autoencoder"]
+
+ROW_N = COL_N = 28
+FEATURE_SIZE = ROW_N * COL_N
+
+
+class Autoencoder(Module):
+    def __init__(self, class_num: int = 32):
+        super().__init__()
+        self.encoder = nn.Linear(FEATURE_SIZE, class_num)
+        self.decoder = nn.Linear(class_num, FEATURE_SIZE)
+
+    def forward(self, x):
+        y = x.reshape(x.shape[0], -1)
+        y = jax.nn.relu(self.encoder(y))
+        return jax.nn.sigmoid(self.decoder(y))
+
+
+def autoencoder(class_num: int = 32) -> Autoencoder:
+    return Autoencoder(class_num)

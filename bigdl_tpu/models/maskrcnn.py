@@ -1,0 +1,129 @@
+"""Mask R-CNN (reference models/maskrcnn/MaskRCNN.scala:57, params case
+class at :35).
+
+ResNet-50-FPN backbone → RegionProposal → BoxHead → MaskHead, assembled
+from the TPU-native detection stack (bigdl_tpu/nn/detection.py): every
+stage keeps static shapes (fixed proposal/detection slots + validity
+masks), so the entire detector jits into one XLA program — unlike the
+reference whose post-processing runs in data-dependent Scala loops.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.core.module import Module, ModuleList
+from bigdl_tpu.core import init as init_methods
+from bigdl_tpu.models.resnet import Bottleneck
+from bigdl_tpu.nn.detection import FPN, BoxHead, MaskHead, RegionProposal
+
+__all__ = ["MaskRCNN", "MaskRCNNParams", "ResNetFPNBackbone"]
+
+
+@dataclass
+class MaskRCNNParams:
+    """Mirrors reference MaskRCNNParams (models/maskrcnn/MaskRCNN.scala:35)."""
+    anchor_sizes: Tuple[float, ...] = (32, 64, 128, 256, 512)
+    aspect_ratios: Tuple[float, ...] = (0.5, 1.0, 2.0)
+    anchor_stride: Tuple[float, ...] = (4, 8, 16, 32, 64)
+    pre_nms_topn_test: int = 1000
+    post_nms_topn_test: int = 1000
+    pre_nms_topn_train: int = 2000
+    post_nms_topn_train: int = 2000
+    rpn_nms_thresh: float = 0.7
+    min_size: int = 0
+    box_resolution: int = 7
+    mask_resolution: int = 14
+    scales: Tuple[float, ...] = (0.25, 0.125, 0.0625, 0.03125)
+    sampling_ratio: int = 2
+    box_score_thresh: float = 0.05
+    box_nms_thresh: float = 0.5
+    max_per_image: int = 100
+    output_size: int = 1024
+    layers: Tuple[int, ...] = (256, 256, 256, 256)
+    dilation: int = 1
+    use_gn: bool = False
+
+
+class ResNetFPNBackbone(Module):
+    """ResNet-50 C2–C5 + FPN (reference MaskRCNN.buildBackbone).  The
+    stem/stage-1 freeze of the reference recipe corresponds to excluding
+    those params from the optimizer mask."""
+
+    def __init__(self, out_channels: int = 256):
+        super().__init__()
+        self.stem_conv = nn.SpatialConvolution(
+            3, 64, 7, 7, 2, 2, 3, 3, with_bias=False,
+            init_method=init_methods.MsraFiller(False))
+        self.stem_bn = nn.SpatialBatchNormalization(64, eps=1e-3)
+        self.stem_pool = nn.SpatialMaxPooling(3, 3, 2, 2, 1, 1)
+        stages = []
+        nin = 64
+        for width, blocks, stride in ((64, 3, 1), (128, 4, 2),
+                                      (256, 6, 2), (512, 3, 2)):
+            stage = []
+            for i in range(blocks):
+                stage.append(Bottleneck(nin, width, stride if i == 0 else 1))
+                nin = width * Bottleneck.expansion
+            stages.append(ModuleList(stage))
+        self.stages = ModuleList(stages)
+        self.fpn = FPN([256, 512, 1024, 2048], out_channels, top_blocks=1)
+
+    def forward(self, x) -> List[jnp.ndarray]:
+        y = jax.nn.relu(self.stem_bn(self.stem_conv(x)))
+        y = self.stem_pool(y)
+        cs = []
+        for stage in self.stages:
+            for block in stage:
+                y = block(y)
+            cs.append(y)
+        return self.fpn(cs)
+
+
+class MaskRCNN(Module):
+    """``forward((images (1, H, W, 3), image_info (4,)))`` →
+    ``(boxes (maxPerImage, 4), labels, scores, valid,
+    masks (maxPerImage, 2*maskRes, 2*maskRes))``.
+
+    ``image_info`` carries (height, width, orig_height, orig_width) as in
+    the reference (MaskRCNN.scala:168 updateOutput); the first two drive
+    box clipping.  Resizing masks back to the original image size is a
+    host-side visualization step (reference postProcessorForMaskRCNN) —
+    kept out of the jitted graph.
+    """
+
+    def __init__(self, in_channels: int = 256, out_channels: int = 256,
+                 num_classes: int = 81,
+                 config: MaskRCNNParams = None):
+        super().__init__()
+        cfg = config or MaskRCNNParams()
+        self.config = cfg
+        self.backbone = ResNetFPNBackbone(out_channels)
+        self.rpn = RegionProposal(
+            in_channels, cfg.anchor_sizes, cfg.aspect_ratios,
+            cfg.anchor_stride, cfg.pre_nms_topn_test,
+            cfg.post_nms_topn_test, cfg.pre_nms_topn_train,
+            cfg.post_nms_topn_train, cfg.rpn_nms_thresh, cfg.min_size)
+        self.box_head = BoxHead(
+            in_channels, cfg.box_resolution, cfg.scales,
+            cfg.sampling_ratio, cfg.box_score_thresh, cfg.box_nms_thresh,
+            cfg.max_per_image, cfg.output_size, num_classes)
+        self.mask_head = MaskHead(
+            in_channels, cfg.mask_resolution, cfg.scales,
+            cfg.sampling_ratio, cfg.layers, cfg.dilation, num_classes)
+
+    def forward(self, inputs):
+        images, image_info = inputs
+        im_hw = image_info[:2]
+        features = self.backbone(images)
+        proposals, _ = self.rpn((features, im_hw))
+        boxes, labels, scores, valid = self.box_head(
+            (features, proposals, im_hw))
+        masks, _ = self.mask_head((features, boxes, labels))
+        masks = jnp.where(valid[:, None, None], masks, 0.0)
+        return boxes, labels, scores, valid, masks

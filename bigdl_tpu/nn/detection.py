@@ -354,11 +354,12 @@ class RoiPooling(Module):
         batch_idx = rois[:, 0].astype(jnp.int32)
         per_roi = feat[batch_idx]  # (N, H, W, C)
         neg = jnp.finfo(feat.dtype).min
-        masked = jnp.where(
-            (ymask[:, :, None, :, None, None]
-             & xmask[:, None, :, None, :, None]),
-            per_roi[:, None, None], neg)  # (N, ph, pw, H, W, C)
-        out = masked.max(axis=(3, 4))
+        # separable max: reduce H under ymask, then W under xmask —
+        # peak intermediate is (N, ph, W, C), not (N, ph, pw, H, W, C)
+        rows = jnp.where(ymask[:, :, :, None, None],
+                         per_roi[:, None], neg).max(axis=2)  # (N, ph, W, C)
+        out = jnp.where(xmask[:, None, :, :, None],
+                        rows[:, :, None], neg).max(axis=3)   # (N, ph, pw, C)
         empty = ((hend <= hstart)[:, :, None, None]
                  | (wend <= wstart)[:, None, :, None])
         return jnp.where(empty, 0.0, out)
@@ -525,8 +526,12 @@ class RegionProposal(Module):
         keep_idx, valid = nms(boxes, top_scores, self.nms_thresh,
                               min(post_nms, k))
         sel_boxes = jnp.where(valid[:, None], boxes[keep_idx], 0.0)
-        sel_scores = jnp.where(valid, top_scores[keep_idx], -jnp.inf)
-        return sel_boxes, jax.nn.sigmoid(sel_scores)
+        # sigmoid the logits of valid slots; padding stays -inf so the
+        # documented "padded slots carry -inf score" contract holds
+        sel_scores = jnp.where(valid,
+                               jax.nn.sigmoid(top_scores[keep_idx]),
+                               -jnp.inf)
+        return sel_boxes, sel_scores
 
     def forward(self, inputs):
         features, im_info = inputs

@@ -7,7 +7,9 @@ from bigdl_tpu.optim.methods import (
 from bigdl_tpu.optim.trigger import Trigger
 from bigdl_tpu.optim.validation import (
     ValidationMethod, ValidationResult, Top1Accuracy, Top5Accuracy,
-    TopKAccuracy, Loss, MAE, HitRatio, NDCG,
+    TopKAccuracy, Loss, MAE, HitRatio, NDCG, MeanAveragePrecision,
+    MeanAveragePrecisionObjectDetection, PrecisionRecallAUC,
+    TreeNNAccuracy,
 )
 from bigdl_tpu.optim.optimizer import Optimizer
 from bigdl_tpu.optim.predictor import (

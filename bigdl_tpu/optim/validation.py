@@ -17,11 +17,14 @@ from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 __all__ = [
     "ValidationResult", "AccuracyResult", "LossResult",
     "ValidationMethod", "Top1Accuracy", "Top5Accuracy", "TopKAccuracy",
-    "Loss", "MAE", "HitRatio", "NDCG",
+    "Loss", "MAE", "HitRatio", "NDCG", "MeanAveragePrecision",
+    "MeanAveragePrecisionObjectDetection", "PrecisionRecallAUC",
+    "TreeNNAccuracy",
 ]
 
 
@@ -166,3 +169,236 @@ class NDCG(ValidationMethod):
         gain = jnp.where(rank <= self.k,
                          jnp.log(2.0) / jnp.log(rank + 1.0), 0.0)
         return jnp.sum(gain), jnp.asarray(float(output.shape[0]))
+
+
+# --------------------------------------------------------------------------
+# Ranking-based metrics: these accumulate raw score arrays per batch and
+# compute the metric at result() time (reference MAPValidationResult,
+# ValidationMethod.scala:231-753, accumulates per-class score lists the
+# same way).  batch_stats stays jit-compatible: it returns fixed-shape
+# arrays; concatenation happens host-side in ``+``.
+# --------------------------------------------------------------------------
+
+class _ArrayResult(ValidationResult):
+    """Mergeable result holding host arrays; subclass computes the
+    metric in ``result()``."""
+
+    def __init__(self, fmt: str, *arrays):
+        self.fmt = fmt
+        self.arrays = [np.asarray(a) for a in arrays]
+
+    def __add__(self, other):
+        merged = [np.concatenate([a, b], axis=0)
+                  for a, b in zip(self.arrays, other.arrays)]
+        return type(self)(self.fmt, *merged)
+
+    def result(self):
+        raise NotImplementedError
+
+    def __repr__(self):
+        v, n = self.result()
+        return f"{self.fmt}: {v:.6f} (on {n} samples)"
+
+
+def _average_precision(scores, is_pos, n_pos, k=None):
+    """AP for one class: ranked ``scores`` with positive mask."""
+    if n_pos == 0:
+        return 0.0
+    order = np.argsort(-scores)
+    pos = is_pos[order]
+    if k is not None:
+        pos = pos[:k]
+    tp = np.cumsum(pos)
+    precision = tp / (np.arange(len(pos)) + 1)
+    return float(np.sum(precision * pos) / n_pos)
+
+
+class MAPResult(_ArrayResult):
+    def __init__(self, fmt, scores, targets, k=None):
+        super().__init__(fmt, scores, targets)
+        self.k = k
+
+    def __add__(self, other):
+        merged = [np.concatenate([a, b], axis=0)
+                  for a, b in zip(self.arrays, other.arrays)]
+        return MAPResult(self.fmt, *merged, k=self.k)
+
+    def result(self):
+        scores, targets = self.arrays
+        n, n_classes = scores.shape
+        aps = []
+        for c in range(n_classes):
+            is_pos = (targets == c + 1)  # 1-based labels
+            aps.append(_average_precision(scores[:, c], is_pos,
+                                          int(is_pos.sum()), self.k))
+        return float(np.mean(aps)), n
+
+
+class MeanAveragePrecision(ValidationMethod):
+    """Classification mean-average-precision over classes (reference
+    ValidationMethod.scala MeanAveragePrecision; MAPValidationResult)."""
+
+    def __init__(self, k: Optional[int] = None, classes: int = 0):
+        self.k = k
+        self.classes = classes
+        self.fmt = "MAP@" + (str(k) if k else "all")
+
+    def batch_stats(self, output, target):
+        if output.ndim == 1:
+            output = output[None]
+        return output, target.reshape(-1)
+
+    def to_result(self, scores, targets):
+        return MAPResult(self.fmt, scores, targets, k=self.k)
+
+
+class AUCResult(_ArrayResult):
+    def result(self):
+        scores, labels = self.arrays
+        order = np.argsort(-scores)
+        lab = labels[order] > 0.5
+        n_pos = int(lab.sum())
+        n_neg = len(lab) - n_pos
+        if n_pos == 0 or n_neg == 0:
+            return 0.0, len(lab)
+        tp = np.cumsum(lab)
+        fp = np.cumsum(~lab)
+        precision = tp / np.maximum(tp + fp, 1)
+        recall = tp / n_pos
+        # area under the PR curve (trapezoid over recall, anchored at
+        # recall=0 with the first observed precision)
+        recall = np.concatenate([[0.0], recall])
+        precision = np.concatenate([[precision[0]], precision])
+        auc = float(np.trapezoid(precision, recall))
+        return auc, len(lab)
+
+
+class PrecisionRecallAUC(ValidationMethod):
+    """Area under the precision-recall curve for binary scores
+    (reference optim/PrecisionRecallAUC.scala)."""
+
+    fmt = "PrecisionRecallAUC"
+
+    def batch_stats(self, output, target):
+        return output.reshape(-1), target.reshape(-1)
+
+    def to_result(self, scores, labels):
+        return AUCResult(self.fmt, scores, labels)
+
+
+class TreeNNAccuracy(ValidationMethod):
+    """Accuracy on the first (root) node of TreeLSTM-style outputs
+    (reference ValidationMethod.scala:122)."""
+
+    fmt = "TreeNNAccuracy()"
+
+    def batch_stats(self, output, target):
+        if isinstance(output, (tuple, list)):
+            output = output[0]
+        output = output[:, 0] if output.ndim == 3 else output
+        pred = jnp.argmax(output, axis=-1) + 1
+        tgt = target[:, 0] if target.ndim == 2 else target
+        correct = jnp.sum((pred == tgt.astype(pred.dtype))
+                          .astype(jnp.float32))
+        return correct, jnp.asarray(float(output.shape[0]))
+
+    def to_result(self, num, den):
+        return AccuracyResult(float(num), float(den))
+
+
+# --------------------------------------------------------------------------
+# Object-detection mAP (reference ValidationMethod.scala:231-753 —
+# MeanAveragePrecisionObjectDetection, VOC07/VOC10/COCO styles).
+# Host-side: operates on decoded detection rows, not jitted outputs.
+# --------------------------------------------------------------------------
+
+def _det_iou(box, boxes):
+    x1 = np.maximum(box[0], boxes[:, 0])
+    y1 = np.maximum(box[1], boxes[:, 1])
+    x2 = np.minimum(box[2], boxes[:, 2])
+    y2 = np.minimum(box[3], boxes[:, 3])
+    inter = np.clip(x2 - x1, 0, None) * np.clip(y2 - y1, 0, None)
+    a = (box[2] - box[0]) * (box[3] - box[1])
+    b = (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+    union = a + b - inter
+    return np.where(union > 0, inter / union, 0.0)
+
+
+def _voc_ap(recall, precision, use_07_metric=False):
+    if use_07_metric:
+        ap = 0.0
+        for t in np.arange(0.0, 1.1, 0.1):
+            p = precision[recall >= t].max() if (recall >= t).any() else 0.0
+            ap += p / 11.0
+        return float(ap)
+    mrec = np.concatenate([[0.0], recall, [1.0]])
+    mpre = np.concatenate([[0.0], precision, [0.0]])
+    for i in range(len(mpre) - 2, -1, -1):
+        mpre[i] = max(mpre[i], mpre[i + 1])
+    idx = np.where(mrec[1:] != mrec[:-1])[0]
+    return float(np.sum((mrec[idx + 1] - mrec[idx]) * mpre[idx + 1]))
+
+
+class MeanAveragePrecisionObjectDetection(ValidationMethod):
+    """Detection mAP.  ``styles``: "VOC07" (11-point), "VOC" (area),
+    "COCO" (mean over IoU 0.5:0.05:0.95).
+
+    ``evaluate(detections, ground_truths)`` where, per image,
+    ``detections[i] = (labels (N,), scores (N,), boxes (N, 4))`` and
+    ``ground_truths[i] = (labels (M,), boxes (M, 4))``; invalid/padded
+    rows must already be stripped (host side).
+    """
+
+    def __init__(self, classes: int, iou_thresh: float = 0.5,
+                 style: str = "VOC"):
+        self.classes = classes
+        self.iou_thresh = iou_thresh
+        self.style = style
+        self.fmt = f"mAP[{style}]"
+
+    def _ap_at(self, dets, gts, iou_thresh):
+        aps = []
+        for c in range(1, self.classes + 1):
+            records = []  # (score, image_idx, box)
+            n_gt = 0
+            gt_per_img = []
+            for (glab, gbox) in gts:
+                sel = np.asarray(glab) == c
+                gt_per_img.append(np.asarray(gbox)[sel])
+                n_gt += int(sel.sum())
+            for i, (dlab, dsc, dbox) in enumerate(dets):
+                sel = np.asarray(dlab) == c
+                for s, b in zip(np.asarray(dsc)[sel],
+                                np.asarray(dbox)[sel]):
+                    records.append((float(s), i, b))
+            if n_gt == 0:
+                continue
+            records.sort(key=lambda r: -r[0])
+            matched = [np.zeros(len(g), bool) for g in gt_per_img]
+            tp = np.zeros(len(records))
+            fp = np.zeros(len(records))
+            for k, (s, i, b) in enumerate(records):
+                g = gt_per_img[i]
+                if len(g) == 0:
+                    fp[k] = 1
+                    continue
+                ious = _det_iou(b, g)
+                j = int(np.argmax(ious))
+                if ious[j] >= iou_thresh and not matched[i][j]:
+                    tp[k] = 1
+                    matched[i][j] = True
+                else:
+                    fp[k] = 1
+            ctp, cfp = np.cumsum(tp), np.cumsum(fp)
+            recall = ctp / n_gt
+            precision = ctp / np.maximum(ctp + cfp, 1e-9)
+            aps.append(_voc_ap(recall, precision, self.style == "VOC07"))
+        return float(np.mean(aps)) if aps else 0.0
+
+    def evaluate(self, detections, ground_truths) -> float:
+        if self.style == "COCO":
+            threshes = np.arange(0.5, 1.0, 0.05)
+            return float(np.mean([
+                self._ap_at(detections, ground_truths, t)
+                for t in threshes]))
+        return self._ap_at(detections, ground_truths, self.iou_thresh)

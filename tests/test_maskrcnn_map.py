@@ -1,0 +1,121 @@
+"""MaskRCNN model forward, autoencoder, and ranking/detection metrics.
+
+Mirrors reference specs: models/maskrcnn/MaskRCNNSpec, autoencoder
+specs, optim/ValidationSpec (MAP + object-detection mAP cases).
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from bigdl_tpu.models import Autoencoder, MaskRCNN, MaskRCNNParams
+from bigdl_tpu.optim import (MeanAveragePrecision,
+                             MeanAveragePrecisionObjectDetection,
+                             PrecisionRecallAUC, TreeNNAccuracy)
+from bigdl_tpu.utils import set_seed
+
+
+def test_autoencoder_shapes_and_range():
+    set_seed(0)
+    model = Autoencoder(32)
+    x = jnp.asarray(np.random.RandomState(0).rand(4, 28, 28),
+                    jnp.float32)
+    out = model(x)
+    assert out.shape == (4, 784)
+    o = np.asarray(out)
+    assert (o >= 0).all() and (o <= 1).all()
+
+
+def test_maskrcnn_forward_shapes():
+    set_seed(1)
+    cfg = MaskRCNNParams(
+        anchor_sizes=(16, 32, 64, 128, 256),
+        pre_nms_topn_test=50, post_nms_topn_test=16,
+        max_per_image=8, output_size=32, layers=(8, 8),
+        box_score_thresh=0.0)
+    model = MaskRCNN(num_classes=5, config=cfg).eval_mode()
+    img = jnp.asarray(np.random.RandomState(0).randn(1, 64, 64, 3),
+                      jnp.float32)
+    info = jnp.asarray([64.0, 64.0, 64.0, 64.0])
+    boxes, labels, scores, valid, masks = model((img, info))
+    assert boxes.shape == (8, 4)
+    assert labels.shape == (8,) and scores.shape == (8,)
+    assert masks.shape == (8, 28, 28)
+    m = np.asarray(masks)
+    assert (m >= 0).all() and (m <= 1).all()
+
+
+def test_map_classification_perfect_and_half():
+    m = MeanAveragePrecision(classes=2)
+    # two classes, predictions perfectly ranked
+    scores = jnp.asarray([[0.9, 0.1], [0.8, 0.2], [0.1, 0.9], [0.2, 0.8]])
+    target = jnp.asarray([1.0, 1.0, 2.0, 2.0])
+    res = m(scores, target)
+    val, _ = res.result()
+    assert val == pytest.approx(1.0)
+    # merge two batches: still perfect
+    res2 = res + m(scores, target)
+    assert res2.result()[0] == pytest.approx(1.0)
+
+
+def test_map_classification_known_value():
+    m = MeanAveragePrecision(classes=1)
+    # ranked: pos, neg, pos → AP = (1/1 + 2/3)/2 = 0.8333
+    scores = jnp.asarray([[0.9], [0.8], [0.7]])
+    target = jnp.asarray([1.0, 2.0, 1.0])
+    val, _ = m(scores, target).result()
+    assert val == pytest.approx((1.0 + 2.0 / 3.0) / 2.0, rel=1e-6)
+
+
+def test_precision_recall_auc_perfect():
+    m = PrecisionRecallAUC()
+    scores = jnp.asarray([0.9, 0.8, 0.2, 0.1])
+    labels = jnp.asarray([1.0, 1.0, 0.0, 0.0])
+    val, n = m(scores, labels).result()
+    assert n == 4
+    assert val == pytest.approx(1.0, abs=1e-6)
+
+
+def test_tree_nn_accuracy():
+    m = TreeNNAccuracy()
+    out = jnp.asarray([[[0.1, 0.9], [0.5, 0.5]],
+                       [[0.8, 0.2], [0.5, 0.5]]])  # (B, nodes, C)
+    tgt = jnp.asarray([[2.0, 1.0], [1.0, 1.0]])
+    res = m(out, tgt)
+    val, _ = res.result()
+    assert val == pytest.approx(1.0)
+
+
+def test_detection_map_voc():
+    m = MeanAveragePrecisionObjectDetection(classes=2, iou_thresh=0.5)
+    gts = [
+        (np.array([1, 2]), np.array([[0, 0, 10, 10], [20, 20, 30, 30]],
+                                    np.float32)),
+    ]
+    # perfect detections
+    dets = [
+        (np.array([1, 2]), np.array([0.9, 0.8]),
+         np.array([[0, 0, 10, 10], [20, 20, 30, 30]], np.float32)),
+    ]
+    assert m.evaluate(dets, gts) == pytest.approx(1.0)
+    # class-2 detection misses (IoU < .5) → its AP = 0, mAP = 0.5
+    dets_half = [
+        (np.array([1, 2]), np.array([0.9, 0.8]),
+         np.array([[0, 0, 10, 10], [25, 25, 40, 40]], np.float32)),
+    ]
+    assert m.evaluate(dets_half, gts) == pytest.approx(0.5)
+
+
+def test_detection_map_voc07_and_coco_styles():
+    gts = [(np.array([1]), np.array([[0, 0, 10, 10]], np.float32))]
+    dets = [(np.array([1]), np.array([0.9]),
+             np.array([[0, 0, 10, 10]], np.float32))]
+    for style in ("VOC07", "COCO"):
+        m = MeanAveragePrecisionObjectDetection(classes=1, style=style)
+        assert m.evaluate(dets, gts) == pytest.approx(1.0)
+    # duplicate detection of the same gt counts as FP under VOC
+    dets_dup = [(np.array([1, 1]), np.array([0.9, 0.8]),
+                 np.array([[0, 0, 10, 10], [0, 0, 10, 10]], np.float32))]
+    m = MeanAveragePrecisionObjectDetection(classes=1)
+    assert m.evaluate(dets_dup, gts) == pytest.approx(1.0)  # recall hit at rank 1
