@@ -115,7 +115,8 @@ class MaskRCNN(Module):
             cfg.max_per_image, cfg.output_size, num_classes)
         self.mask_head = MaskHead(
             in_channels, cfg.mask_resolution, cfg.scales,
-            cfg.sampling_ratio, cfg.layers, cfg.dilation, num_classes)
+            cfg.sampling_ratio, cfg.layers, cfg.dilation, num_classes,
+            use_gn=cfg.use_gn)
 
     def forward(self, inputs):
         images, image_info = inputs

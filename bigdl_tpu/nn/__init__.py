@@ -20,3 +20,5 @@ from bigdl_tpu.nn.attention import *       # noqa: F401,F403
 from bigdl_tpu.nn.moe import *             # noqa: F401,F403
 from bigdl_tpu.nn.quantized import *       # noqa: F401,F403
 from bigdl_tpu.nn.detection import *       # noqa: F401,F403
+from bigdl_tpu.nn.sparse import *          # noqa: F401,F403
+from bigdl_tpu.nn.tree import *            # noqa: F401,F403

@@ -25,6 +25,7 @@ from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from bigdl_tpu.core.module import Module, Parameter
 from bigdl_tpu.core import init as init_methods
@@ -35,7 +36,7 @@ __all__ = [
     "SpatialFullConvolution", "SpatialSeparableConvolution",
     "SpatialShareConvolution", "TemporalConvolution",
     "VolumetricConvolution", "VolumetricFullConvolution",
-    "LocallyConnected2D",
+    "LocallyConnected2D", "LocallyConnected1D", "SpatialConvolutionMap",
 ]
 
 
@@ -418,3 +419,100 @@ class LocallyConnected2D(Module):
         if self.with_bias:
             y = y + self.bias
         return _from_nhwc(y, self.data_format)
+
+
+class LocallyConnected1D(Module):
+    """Temporal conv with unshared weights per output frame
+    (reference nn/LocallyConnected1D.scala).  Lowered to one batched
+    einsum over unfolded windows so the MXU sees a single contraction."""
+
+    def __init__(self, n_input_frame: int, input_frame_size: int,
+                 output_frame_size: int, kernel_w: int, stride_w: int = 1,
+                 propagate_back: bool = True,
+                 w_regularizer=None, b_regularizer=None,
+                 init_weight=None, init_bias=None):
+        super().__init__()
+        self.kernel_w = kernel_w
+        self.stride_w = stride_w
+        n_out_frame = (n_input_frame - kernel_w) // stride_w + 1
+        self.n_output_frame = n_out_frame
+        fan_in = kernel_w * input_frame_size
+        bound = 1.0 / math.sqrt(fan_in)
+        if init_weight is not None:
+            self.weight = Parameter(init_weight)
+        else:
+            self.weight = Parameter(jax.random.uniform(
+                next_key(),
+                (n_out_frame, output_frame_size, kernel_w,
+                 input_frame_size), minval=-bound, maxval=bound))
+        self.bias = Parameter(
+            init_bias if init_bias is not None
+            else jax.random.uniform(next_key(),
+                                    (n_out_frame, output_frame_size),
+                                    minval=-bound, maxval=bound))
+
+    def forward(self, x):
+        # x: (B, T, in) → windows (B, n_out, kw, in)
+        idx = (jnp.arange(self.n_output_frame)[:, None] * self.stride_w
+               + jnp.arange(self.kernel_w)[None, :])
+        win = x[:, idx]                      # (B, n_out, kw, in)
+        y = jnp.einsum("bokc,olkc->bol", win, self.weight)
+        return y + self.bias
+
+
+class SpatialConvolutionMap(Module):
+    """Convolution with an explicit input→output connection table
+    (reference nn/SpatialConvolutionMap.scala).  Implemented as a dense
+    conv with a constant connectivity mask on the kernel — MXU-friendly,
+    gradients flow only through connected pairs.
+
+    ``conn_table``: (n_links, 2) 1-based [in_plane, out_plane] pairs
+    (Torch convention; build with :meth:`full`, :meth:`one_to_one`,
+    or :meth:`random`).
+    """
+
+    def __init__(self, conn_table, kw: int, kh: int,
+                 dw: int = 1, dh: int = 1, pad_w: int = 0, pad_h: int = 0,
+                 w_regularizer=None, b_regularizer=None):
+        super().__init__()
+        conn = np.asarray(conn_table, np.int32)
+        n_in = int(conn[:, 0].max())
+        n_out = int(conn[:, 1].max())
+        mask = np.zeros((kh, kw, n_in, n_out), np.float32)
+        for i, o in conn:
+            mask[:, :, i - 1, o - 1] = 1.0
+        self.mask = jnp.asarray(mask)
+        self.stride = (dh, dw)
+        self.pad = (pad_h, pad_w)
+        fan_in = int(conn.shape[0] / n_out * kh * kw)
+        bound = 1.0 / math.sqrt(fan_in)
+        self.weight = Parameter(jax.random.uniform(
+            next_key(), (kh, kw, n_in, n_out), minval=-bound, maxval=bound))
+        self.bias = Parameter(jax.random.uniform(
+            next_key(), (n_out,), minval=-bound, maxval=bound))
+
+    @staticmethod
+    def full(n_in: int, n_out: int):
+        return [[i + 1, o + 1] for o in range(n_out) for i in range(n_in)]
+
+    @staticmethod
+    def one_to_one(n_features: int):
+        return [[i + 1, i + 1] for i in range(n_features)]
+
+    @staticmethod
+    def random(n_in: int, n_out: int, n_from: int, seed: int = 0):
+        rng = np.random.RandomState(seed)
+        table = []
+        for o in range(n_out):
+            for i in rng.choice(n_in, size=n_from, replace=False):
+                table.append([int(i) + 1, o + 1])
+        return table
+
+    def forward(self, x):
+        w = self.weight * self.mask
+        ph, pw = self.pad
+        y = jax.lax.conv_general_dilated(
+            x, w, self.stride,
+            ((ph, ph), (pw, pw)) if (ph, pw) != (-1, -1) else "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        return y + self.bias

@@ -688,7 +688,7 @@ class MaskHead(Module):
                  use_gn: bool = False):
         super().__init__()
         self.pooler = Pooler(resolution, scales, sampling_ratio)
-        convs = []
+        convs, norms = [], []
         nin = in_channels
         for nout in layers:
             if dilation == 1:
@@ -699,8 +699,13 @@ class MaskHead(Module):
                 convs.append(SpatialDilatedConvolution(
                     nin, nout, 3, 3, 1, 1, dilation, dilation,
                     dilation, dilation))
+            if use_gn:
+                from bigdl_tpu.nn.normalization import GroupNorm
+                norms.append(GroupNorm(nout))
             nin = nout
         self.convs = ModuleList(convs)
+        self.norms = ModuleList(norms)
+        self.use_gn = bool(use_gn)
         self.dilation = int(dilation)
         self.deconv = SpatialFullConvolution(nin, nin, 2, 2, 2, 2)
         self.predictor = SpatialConvolution(
@@ -711,9 +716,11 @@ class MaskHead(Module):
     def forward(self, inputs):
         features, boxes, labels = inputs
         x = self.pooler((features, boxes))
-        for conv in self.convs:
-            # dilated 3x3 needs SAME-style pad = dilation
-            x = jax.nn.relu(conv(x))
+        for i, conv in enumerate(self.convs):
+            x = conv(x)
+            if self.use_gn:
+                x = self.norms[i](x)
+            x = jax.nn.relu(x)
         x = jax.nn.relu(self.deconv(x))
         logits = self.predictor(x)             # (N, 2r, 2r, C)
         n = boxes.shape[0]

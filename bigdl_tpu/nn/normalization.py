@@ -30,7 +30,9 @@ from bigdl_tpu.utils.rng import next_key
 __all__ = [
     "BatchNormalization", "SpatialBatchNormalization", "LayerNormalization",
     "Normalize", "NormalizeScale", "SpatialCrossMapLRN",
-    "SpatialWithinChannelLRN",
+    "SpatialWithinChannelLRN", "Scale", "SpatialSubtractiveNormalization",
+    "SpatialDivisiveNormalization", "SpatialContrastiveNormalization",
+    "GroupNorm",
 ]
 
 
@@ -203,3 +205,134 @@ class SpatialWithinChannelLRN(Module):
                      (half, self.size - 1 - half), (0, 0)))
         return x * jnp.power(
             1.0 + self.alpha / (self.size * self.size) * acc, -self.beta)
+
+
+class Scale(Module):
+    """Learnable per-feature affine: broadcastable mul weight + add bias
+    (reference nn/Scale.scala = CMul followed by CAdd)."""
+
+    def __init__(self, size):
+        super().__init__()
+        from bigdl_tpu.nn.linear import CMul, CAdd
+        self.cmul = CMul(size)
+        self.cadd = CAdd(size)
+
+    def forward(self, x):
+        return self.cadd(self.cmul(x))
+
+
+def _local_kernel_sum(x, kernel):
+    """Weighted local sum over (H, W) and *all channels* of NHWC ``x``
+    with a 2-D kernel, SAME padding — the building block of the classic
+    Torch spatial normalization layers."""
+    kh, kw = kernel.shape
+    summed = jnp.sum(x, axis=-1, keepdims=True)  # (B, H, W, 1)
+    k = kernel.reshape(kh, kw, 1, 1).astype(x.dtype)
+    return jax.lax.conv_general_dilated(
+        summed, k, (1, 1), "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+class SpatialSubtractiveNormalization(Module):
+    """Subtract the kernel-weighted local mean across channels
+    (reference nn/SpatialSubtractiveNormalization.scala).  Border pixels
+    divide by the actual kernel mass inside the image (coef map)."""
+
+    def __init__(self, n_input_plane: int = 1, kernel=None):
+        super().__init__()
+        if kernel is None:
+            kernel = jnp.ones((9, 9))
+        kernel = jnp.asarray(kernel, jnp.float32)
+        if kernel.ndim == 1:
+            kernel = kernel[:, None] * kernel[None, :]
+        self.n_input_plane = n_input_plane
+        # pre-normalize: local mean over kernel mass × channels
+        self.kernel = kernel / (kernel.sum() * n_input_plane)
+
+    def forward(self, x):
+        # normalized kernel ⇒ interior coef == 1; border coef < 1
+        # corrects for the kernel mass falling outside the image
+        mean = _local_kernel_sum(x, self.kernel)
+        coef = _local_kernel_sum(jnp.ones_like(x), self.kernel)
+        return x - mean / jnp.maximum(coef, 1e-12)
+
+
+class SpatialDivisiveNormalization(Module):
+    """Divide by the thresholded local standard deviation
+    (reference nn/SpatialDivisiveNormalization.scala)."""
+
+    def __init__(self, n_input_plane: int = 1, kernel=None,
+                 threshold: Optional[float] = None,
+                 thresval: Optional[float] = None):
+        super().__init__()
+        if kernel is None:
+            kernel = jnp.ones((9, 9))
+        kernel = jnp.asarray(kernel, jnp.float32)
+        if kernel.ndim == 1:
+            kernel = kernel[:, None] * kernel[None, :]
+        self.n_input_plane = n_input_plane
+        self.kernel = kernel / (kernel.sum() * n_input_plane)
+        self.threshold = threshold
+        self.thresval = thresval
+
+    def forward(self, x):
+        sq = _local_kernel_sum(x * x, self.kernel)
+        coef = _local_kernel_sum(jnp.ones_like(x), self.kernel)
+        # border-corrected weighted mean of x² → local std
+        localstd = jnp.sqrt(jnp.maximum(sq / jnp.maximum(coef, 1e-12), 0.0))
+        meanstd = jnp.mean(localstd)
+        if self.threshold is None:
+            thr = meanstd
+            val = meanstd
+        else:
+            thr = self.threshold
+            val = self.thresval if self.thresval is not None else thr
+        denom = jnp.where(localstd < thr, val, localstd)
+        return x / jnp.maximum(denom, 1e-12)
+
+
+class SpatialContrastiveNormalization(Module):
+    """Subtractive then divisive local normalization
+    (reference nn/SpatialContrastiveNormalization.scala)."""
+
+    def __init__(self, n_input_plane: int = 1, kernel=None,
+                 threshold: Optional[float] = None,
+                 thresval: Optional[float] = None):
+        super().__init__()
+        self.sub = SpatialSubtractiveNormalization(n_input_plane, kernel)
+        self.div = SpatialDivisiveNormalization(n_input_plane, kernel,
+                                                threshold, thresval)
+
+    def forward(self, x):
+        return self.div(self.sub(x))
+
+
+class GroupNorm(Module):
+    """Group normalization over the channel (last) axis of NHWC maps —
+    backs the reference's useGn option (nn/MaskHead.scala, FPN variants
+    built on MaskRCNN's GN recipe)."""
+
+    def __init__(self, n_output: int, n_groups: int = 32,
+                 eps: float = 1e-5, affine: bool = True):
+        super().__init__()
+        while n_output % n_groups != 0:
+            n_groups //= 2
+        self.n_groups = max(n_groups, 1)
+        self.eps = float(eps)
+        self.affine = affine
+        if affine:
+            self.weight = Parameter(jnp.ones(n_output))
+            self.bias = Parameter(jnp.zeros(n_output))
+
+    def forward(self, x):
+        shape = x.shape
+        c = shape[-1]
+        g = self.n_groups
+        xg = x.reshape(shape[:-1] + (g, c // g))
+        axes = tuple(range(1, xg.ndim - 2)) + (xg.ndim - 1,)
+        mean = jnp.mean(xg, axis=axes, keepdims=True)
+        var = jnp.var(xg, axis=axes, keepdims=True)
+        y = ((xg - mean) * jax.lax.rsqrt(var + self.eps)).reshape(shape)
+        if self.affine:
+            y = y * self.weight + self.bias
+        return y

@@ -27,7 +27,8 @@ from bigdl_tpu.core.module import Module, ModuleList, Parameter, next_rng_key
 from bigdl_tpu.utils.rng import next_key
 
 __all__ = [
-    "Cell", "RnnCell", "LSTM", "LSTMPeephole", "GRU", "ConvLSTMPeephole",
+    "Cell", "RnnCell", "RNN", "LSTM", "LSTMPeephole", "GRU",
+    "ConvLSTMPeephole", "ConvLSTMPeephole3D",
     "Recurrent", "BiRecurrent", "RecurrentDecoder", "MultiRNNCell",
     "TimeDistributed",
 ]
@@ -39,6 +40,11 @@ class Cell(Module):
 
     def init_state(self, batch_size: int, dtype=jnp.float32):
         raise NotImplementedError
+
+    def init_state_for(self, xproj, dtype=jnp.float32):
+        """State for a hoisted projection ``xproj [B, T, ...]`` — cells
+        whose state has spatial dims derive them from the projection."""
+        return self.init_state(xproj.shape[0], dtype)
 
     def step(self, x_t, state):
         raise NotImplementedError
@@ -255,6 +261,11 @@ class ConvLSTMPeephole(Cell):
         z = jnp.zeros((batch_size, h, w, self.output_size), dtype)
         return (z, z)
 
+    def init_state_for(self, xproj, dtype=jnp.float32):
+        # hidden spatial dims follow the (possibly strided) projection
+        return self.init_state(xproj.shape[0], dtype,
+                               spatial=tuple(xproj.shape[2:-1]))
+
     def precompute_inputs(self, x):
         b, t = x.shape[0], x.shape[1]
         flat = x.reshape((b * t,) + x.shape[2:])
@@ -311,14 +322,7 @@ class Recurrent(Module):
         cell = self.cell
         xproj = cell.precompute_inputs(x)
         if init_state is None:
-            if isinstance(cell, ConvLSTMPeephole):
-                # hidden state spatial dims follow the (possibly strided)
-                # input projection, not the raw input
-                init_state = cell.init_state(
-                    x.shape[0], x.dtype,
-                    spatial=(xproj.shape[2], xproj.shape[3]))
-            else:
-                init_state = cell.init_state(x.shape[0], x.dtype)
+            init_state = cell.init_state_for(xproj, x.dtype)
         xs = jnp.swapaxes(xproj, 0, 1)  # [T, B, ...]
 
         def body(state, x_t):
@@ -400,3 +404,68 @@ class TimeDistributed(Module):
         flat = x.reshape((b * t,) + x.shape[2:])
         y = self.layer(flat)
         return y.reshape((b, t) + y.shape[1:])
+
+
+class ConvLSTMPeephole3D(Cell):
+    """Volumetric convolutional LSTM over NDHWC feature maps
+    (reference nn/ConvLSTMPeephole3D.scala); same gate structure as the
+    2-D variant with 3-D convs."""
+
+    def __init__(self, input_size: int, output_size: int,
+                 kernel_i: int = 3, kernel_c: int = 3, stride: int = 1,
+                 padding: int = -1, with_peephole: bool = True,
+                 w_regularizer=None, u_regularizer=None, b_regularizer=None):
+        super().__init__()
+        from bigdl_tpu.nn.conv import VolumetricConvolution
+        self.output_size = output_size
+        self.with_peephole = with_peephole
+        self.conv_input = VolumetricConvolution(
+            input_size, 4 * output_size, kernel_i, kernel_i, kernel_i,
+            stride, stride, stride, padding, padding, padding)
+        self.conv_hidden = VolumetricConvolution(
+            output_size, 4 * output_size, kernel_c, kernel_c, kernel_c,
+            1, 1, 1, -1, -1, -1, with_bias=False)
+        if with_peephole:
+            self.peep_i = Parameter(jnp.zeros(output_size))
+            self.peep_f = Parameter(jnp.zeros(output_size))
+            self.peep_o = Parameter(jnp.zeros(output_size))
+
+    def init_state(self, batch_size, dtype=jnp.float32,
+                   spatial=None):
+        if spatial is None:
+            raise ValueError("ConvLSTMPeephole3D needs (D, H, W) dims")
+        d, h, w = spatial
+        z = jnp.zeros((batch_size, d, h, w, self.output_size), dtype)
+        return (z, z)
+
+    def init_state_for(self, xproj, dtype=jnp.float32):
+        return self.init_state(xproj.shape[0], dtype,
+                               spatial=tuple(xproj.shape[2:-1]))
+
+    def precompute_inputs(self, x):
+        b, t = x.shape[0], x.shape[1]
+        flat = x.reshape((b * t,) + x.shape[2:])
+        proj = self.conv_input(flat)
+        return proj.reshape((b, t) + proj.shape[1:])
+
+    def step(self, xproj_t, state):
+        h, c = state
+        gates = xproj_t + self.conv_hidden(h)
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        if self.with_peephole:
+            i = jax.nn.sigmoid(i + self.peep_i * c)
+            f = jax.nn.sigmoid(f + self.peep_f * c)
+        else:
+            i, f = jax.nn.sigmoid(i), jax.nn.sigmoid(f)
+        c_new = f * c + i * jnp.tanh(g)
+        if self.with_peephole:
+            o = jax.nn.sigmoid(o + self.peep_o * c_new)
+        else:
+            o = jax.nn.sigmoid(o)
+        h_new = o * jnp.tanh(c_new)
+        return h_new, (h_new, c_new)
+
+
+# Inventory alias: the reference's vanilla recurrent cell file is
+# nn/RNN.scala (RnnCell class); both names resolve here.
+RNN = RnnCell

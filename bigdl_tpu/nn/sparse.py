@@ -1,0 +1,203 @@
+"""Sparse-tensor layers.
+
+Reference: tensor/SparseTensor.scala (COO sparse tensor),
+nn/DenseToSparse.scala, nn/SparseJoinTable.scala, nn/SparseLinear.scala,
+nn/LookupTableSparse.scala — the stack used by wide-and-deep style
+recommendation models.
+
+TPU-first design: XLA has no dynamic-nnz sparse formats, so
+:class:`SparseTensor` is a *fixed-capacity* COO pytree
+``(indices (nnz, ndim) int32, values (nnz,), shape)``.  Padding entries
+simply carry ``value == 0`` — exact for every linear consumer here
+(SpMM, embedding sums), so no validity mask is needed.  Sparse matmul
+and embedding lookups lower to gather + ``segment_sum``, which XLA
+turns into efficient one-hot/scatter programs on TPU.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.core.module import Module, Parameter
+from bigdl_tpu.nn.linear import Linear
+from bigdl_tpu.utils.rng import next_key
+
+__all__ = [
+    "SparseTensor", "DenseToSparse", "SparseJoinTable", "SparseLinear",
+    "LookupTableSparse",
+]
+
+
+class SparseTensor:
+    """Fixed-capacity 2-D-or-n-D COO tensor (≙ tensor/SparseTensor.scala).
+
+    ``indices``: (nnz, ndim) int32; ``values``: (nnz,); ``shape``: the
+    dense shape — registered as *static* pytree aux data so it stays a
+    Python tuple under jit.  Zero-valued entries are padding.
+    """
+
+    __slots__ = ("indices", "values", "shape")
+
+    def __init__(self, indices, values, shape: Tuple[int, ...]):
+        self.indices = indices
+        self.values = values
+        self.shape = tuple(int(s) for s in shape)
+
+    def __repr__(self):
+        return (f"SparseTensor(shape={self.shape}, "
+                f"capacity={self.values.shape[0]})")
+
+    def to_dense(self) -> jnp.ndarray:
+        flat_idx = jnp.ravel_multi_index(
+            tuple(self.indices[:, d] for d in range(len(self.shape))),
+            self.shape, mode="clip")
+        out = jnp.zeros(int(np.prod(self.shape)), self.values.dtype)
+        out = out.at[flat_idx].add(self.values)
+        return out.reshape(self.shape)
+
+    @staticmethod
+    def from_dense(x) -> "SparseTensor":
+        """Capacity = full size; zero entries become padding."""
+        shape = tuple(int(s) for s in x.shape)
+        grid = jnp.stack(jnp.meshgrid(
+            *[jnp.arange(s) for s in shape], indexing="ij"),
+            axis=-1).reshape(-1, len(shape)).astype(jnp.int32)
+        return SparseTensor(grid, x.reshape(-1), shape)
+
+
+jax.tree_util.register_pytree_node(
+    SparseTensor,
+    lambda t: ((t.indices, t.values), t.shape),
+    lambda shape, children: SparseTensor(children[0], children[1], shape),
+)
+
+
+class DenseToSparse(Module):
+    """Dense → COO (reference nn/DenseToSparse.scala).  Keeps full
+    capacity so the op stays shape-static under jit."""
+
+    def forward(self, x):
+        return SparseTensor.from_dense(x)
+
+
+class SparseJoinTable(Module):
+    """Concatenate sparse tensors along ``dimension`` (1-based, like the
+    reference nn/SparseJoinTable.scala)."""
+
+    def __init__(self, dimension: int = 2):
+        super().__init__()
+        self.dimension = dimension  # 1-based
+
+    def forward(self, tensors: Sequence[SparseTensor]) -> SparseTensor:
+        d = self.dimension - 1
+        ndim = len(tensors[0].shape)
+        offset = 0
+        all_idx, all_val = [], []
+        for t in tensors:
+            idx = t.indices.at[:, d].add(offset)
+            all_idx.append(idx)
+            all_val.append(t.values)
+            offset += t.shape[d]
+        shape = list(tensors[0].shape)
+        shape[d] = offset
+        return SparseTensor(jnp.concatenate(all_idx, 0),
+                            jnp.concatenate(all_val, 0), tuple(shape))
+
+
+class SparseLinear(Module):
+    """Linear layer over a sparse (batch, in) input
+    (reference nn/SparseLinear.scala).  Lowered to gather + segment_sum:
+    each nnz contributes ``value * W[:, col]`` to its row."""
+
+    def __init__(self, input_size: int, output_size: int,
+                 with_bias: bool = True, backward_start: int = -1,
+                 backward_length: int = -1,
+                 w_regularizer=None, b_regularizer=None,
+                 init_weight=None, init_bias=None):
+        super().__init__()
+        self.inner = Linear(input_size, output_size, with_bias,
+                            w_regularizer, b_regularizer,
+                            init_weight, init_bias)
+        self.output_size = output_size
+
+    @property
+    def weight(self):
+        return self.inner.weight
+
+    @property
+    def bias(self):
+        return self.inner.bias
+
+    def forward(self, x):
+        if isinstance(x, (tuple, list)) and not isinstance(x, SparseTensor):
+            # table input: (sparse, dense) — wide & deep pattern where
+            # the dense part goes through the same weights' tail is NOT
+            # reference semantics; reference concatenates results, so we
+            # just sum contributions of each sparse part laid side by side
+            raise ValueError("SparseLinear expects a single SparseTensor; "
+                             "use SparseJoinTable to merge inputs first")
+        rows = x.indices[:, 0]
+        cols = x.indices[:, 1]
+        w = self.inner.weight  # (out, in)
+        contrib = x.values[:, None] * w.T[cols]          # (nnz, out)
+        y = jax.ops.segment_sum(contrib, rows, num_segments=x.shape[0])
+        if self.inner.with_bias:
+            y = y + self.inner.bias
+        return y
+
+
+class LookupTableSparse(Module):
+    """Embedding lookup over sparse id tensors with sum/mean/sqrtn
+    combiners (reference nn/LookupTableSparse.scala; the TF
+    embedding_lookup_sparse semantics).
+
+    ``forward(ids)`` or ``forward((ids, weights))`` where ``ids`` is a
+    SparseTensor of shape (batch, maxlen) whose *values* are 1-based
+    embedding ids (0 ids are padding), and ``weights`` (optional) is a
+    SparseTensor with the same layout carrying per-id weights.
+    Output: (batch, embedding_dim).
+    """
+
+    def __init__(self, n_index: int, n_output: int,
+                 combiner: str = "sum", max_norm: float = -1.0,
+                 w_regularizer=None):
+        super().__init__()
+        assert combiner in ("sum", "mean", "sqrtn")
+        self.n_index = n_index
+        self.n_output = n_output
+        self.combiner = combiner
+        self.max_norm = max_norm
+        self.weight = Parameter(jax.random.normal(
+            next_key(), (n_index, n_output)) * 0.05)
+
+    def forward(self, x):
+        if isinstance(x, SparseTensor):
+            ids, weights = x, None
+        else:
+            ids, weights = x
+        rows = ids.indices[:, 0]
+        id_vals = ids.values.astype(jnp.int32)
+        present = (id_vals > 0).astype(self.weight.dtype)
+        emb_w = self.weight
+        if self.max_norm > 0:
+            norms = jnp.linalg.norm(emb_w, axis=1, keepdims=True)
+            emb_w = emb_w * jnp.minimum(1.0, self.max_norm
+                                        / jnp.maximum(norms, 1e-7))
+        emb = emb_w[jnp.clip(id_vals - 1, 0, self.n_index - 1)]
+        w = weights.values if weights is not None else present
+        w = w * present
+        batch = ids.shape[0]
+        summed = jax.ops.segment_sum(emb * w[:, None], rows,
+                                     num_segments=batch)
+        if self.combiner == "sum":
+            return summed
+        wsum = jax.ops.segment_sum(w, rows, num_segments=batch)
+        if self.combiner == "mean":
+            return summed / jnp.maximum(wsum, 1e-7)[:, None]
+        wsq = jax.ops.segment_sum(w * w, rows, num_segments=batch)
+        return summed / jnp.maximum(jnp.sqrt(wsq), 1e-7)[:, None]

@@ -1,0 +1,123 @@
+"""Tree-structured LSTMs.
+
+Reference: nn/TreeLSTM.scala (abstract protocol over parse trees),
+nn/BinaryTreeLSTM.scala (constituency binary-tree composer used by
+treeLSTMSentiment example).
+
+TPU-first design: the reference walks the tree with recursive Scala
+calls per node.  Here a batch of trees is encoded as *node arrays in
+topological (children-first) order* and processed with one
+``lax.fori_loop`` over node slots — gathers fetch child states, a
+``dynamic_update_index`` writes the composed state, and the whole thing
+jits with static shapes.  Batching is a vmap over trees.
+
+Tree encoding (per tree, ``n_nodes`` slots, padded with -1):
+  * ``children (n_nodes, 2)`` int32: indices of left/right children in
+    the node array, or -1 for none (leaf).
+  * ``leaf_ids (n_nodes,)`` int32: index into the input sequence for
+    leaves, -1 for internal nodes.
+Nodes must be ordered so every child index < its parent index (standard
+post-order satisfies this).  The root is the last non-padding node.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.core.module import Module, Parameter
+from bigdl_tpu.utils.rng import next_key
+
+__all__ = ["TreeLSTM", "BinaryTreeLSTM"]
+
+
+class TreeLSTM(Module):
+    """Abstract tree-LSTM protocol (reference nn/TreeLSTM.scala):
+    subclasses implement ``compose(child_h, child_c, leaf_x, is_leaf)``."""
+
+    def __init__(self, input_size: int, hidden_size: int):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+
+    def compose(self, child_h, child_c, leaf_x, is_leaf):
+        raise NotImplementedError
+
+    def forward(self, inputs):
+        """``inputs = (x (B, T, in), children (B, N, 2),
+        leaf_ids (B, N))`` → hidden states (B, N, hidden)."""
+        x, children, leaf_ids = inputs
+        return jax.vmap(self._one_tree)(x, children, leaf_ids)
+
+    def _one_tree(self, x, children, leaf_ids):
+        n_nodes = children.shape[0]
+        H = self.hidden_size
+        h0 = jnp.zeros((n_nodes + 1, H), x.dtype)  # slot n_nodes = "none"
+        c0 = jnp.zeros((n_nodes + 1, H), x.dtype)
+
+        def body(i, hc):
+            h, c = hc
+            kid = children[i]
+            # -1 (none) → the zero slot at index n_nodes
+            kid_idx = jnp.where(kid < 0, n_nodes, kid)
+            child_h = h[kid_idx]          # (2, H)
+            child_c = c[kid_idx]
+            lid = leaf_ids[i]
+            leaf_x = x[jnp.clip(lid, 0, x.shape[0] - 1)]
+            is_leaf = (lid >= 0)
+            nh, nc = self.compose(child_h, child_c, leaf_x, is_leaf)
+            return (h.at[i].set(nh), c.at[i].set(nc))
+
+        h, c = jax.lax.fori_loop(0, n_nodes, body, (h0, c0))
+        return h[:n_nodes]
+
+
+class BinaryTreeLSTM(TreeLSTM):
+    """Constituency binary tree-LSTM (reference nn/BinaryTreeLSTM.scala):
+    leaves run an input transform; internal nodes compose (hl, hr)
+    with separate left/right gate weights."""
+
+    def __init__(self, input_size: int, hidden_size: int,
+                 gate_output: bool = True, with_graph: bool = True):
+        super().__init__(input_size, hidden_size)
+        self.gate_output = gate_output
+        H, D = hidden_size, input_size
+        s = 1.0 / math.sqrt(H)
+
+        def rnd(*shape):
+            return Parameter(jax.random.uniform(
+                next_key(), shape, minval=-s, maxval=s))
+
+        # leaf transform: x → (c, o)
+        self.leaf_w = rnd(2 * H, D)
+        self.leaf_b = rnd(2 * H)
+        # composer: [hl, hr] → gates i, lf, rf, update, o
+        self.comp_w = rnd(5 * H, 2 * H)
+        self.comp_b = rnd(5 * H)
+
+    def compose(self, child_h, child_c, leaf_x, is_leaf):
+        H = self.hidden_size
+        # leaf path
+        proj = self.leaf_w @ leaf_x + self.leaf_b
+        c_leaf = proj[:H]
+        o_leaf = jax.nn.sigmoid(proj[H:])
+        h_leaf = o_leaf * jnp.tanh(c_leaf) if self.gate_output \
+            else jnp.tanh(c_leaf)
+        # internal path
+        hl, hr = child_h[0], child_h[1]
+        cl, cr = child_c[0], child_c[1]
+        g = self.comp_w @ jnp.concatenate([hl, hr]) + self.comp_b
+        i = jax.nn.sigmoid(g[:H])
+        lf = jax.nn.sigmoid(g[H:2 * H])
+        rf = jax.nn.sigmoid(g[2 * H:3 * H])
+        u = jnp.tanh(g[3 * H:4 * H])
+        o = jax.nn.sigmoid(g[4 * H:])
+        c_int = i * u + lf * cl + rf * cr
+        h_int = o * jnp.tanh(c_int) if self.gate_output \
+            else jnp.tanh(c_int)
+        h = jnp.where(is_leaf, h_leaf, h_int)
+        c = jnp.where(is_leaf, c_leaf, c_int)
+        return h, c

@@ -38,7 +38,13 @@ def _as_dataset(data, batch_size: int, shuffle: bool = False):
     if hasattr(data, "data") and callable(data.data):
         return data  # already a (possibly transformed) DataSet
     if isinstance(data, (list, tuple)):
-        if data and isinstance(data[0], np.ndarray):
+        if (isinstance(data, tuple) and len(data) == 2
+                and isinstance(data[0], np.ndarray)
+                and isinstance(data[1], np.ndarray)
+                and data[0].shape[0] == data[1].shape[0]):
+            # (features, labels) array pair → one Sample per row
+            data = [Sample(f, l) for f, l in zip(data[0], data[1])]
+        elif data and isinstance(data[0], np.ndarray):
             data = [Sample(f) for f in data]
         return LocalDataSet(list(data), shuffle=shuffle).transform(
             SampleToMiniBatch(batch_size, drop_last=False))
@@ -117,7 +123,9 @@ class Evaluator:
                     for v in methods]
             else:
                 stats = fn(self.model, jnp.asarray(x), jnp.asarray(y))
-            results = [v.to_result(float(a), float(b))
+            # to_result handles scalar coercion; array-accumulating
+            # metrics (MAP, PR-AUC) receive the raw batch arrays
+            results = [v.to_result(a, b)
                        for v, (a, b) in zip(methods, stats)]
             totals = results if totals is None else [
                 t + r for t, r in zip(totals, results)]

@@ -214,18 +214,22 @@ def _average_precision(scores, is_pos, n_pos, k=None):
 
 
 class MAPResult(_ArrayResult):
-    def __init__(self, fmt, scores, targets, k=None):
+    def __init__(self, fmt, scores, targets, k=None, classes=0):
         super().__init__(fmt, scores, targets)
         self.k = k
+        self.classes = classes
 
     def __add__(self, other):
         merged = [np.concatenate([a, b], axis=0)
                   for a, b in zip(self.arrays, other.arrays)]
-        return MAPResult(self.fmt, *merged, k=self.k)
+        return MAPResult(self.fmt, *merged, k=self.k, classes=self.classes)
 
     def result(self):
         scores, targets = self.arrays
-        n, n_classes = scores.shape
+        n = scores.shape[0]
+        # classes bounds the averaged columns (e.g. to skip trailing
+        # background/aux columns); 0 means all
+        n_classes = min(self.classes or scores.shape[1], scores.shape[1])
         aps = []
         for c in range(n_classes):
             is_pos = (targets == c + 1)  # 1-based labels
@@ -249,7 +253,8 @@ class MeanAveragePrecision(ValidationMethod):
         return output, target.reshape(-1)
 
     def to_result(self, scores, targets):
-        return MAPResult(self.fmt, scores, targets, k=self.k)
+        return MAPResult(self.fmt, scores, targets, k=self.k,
+                         classes=self.classes)
 
 
 class AUCResult(_ArrayResult):
@@ -287,17 +292,24 @@ class PrecisionRecallAUC(ValidationMethod):
 
 
 class TreeNNAccuracy(ValidationMethod):
-    """Accuracy on the first (root) node of TreeLSTM-style outputs
-    (reference ValidationMethod.scala:122)."""
+    """Accuracy on the root node of TreeLSTM-style (B, nodes, C) outputs
+    (reference ValidationMethod.scala:122).
 
-    fmt = "TreeNNAccuracy()"
+    The tree encoding in bigdl_tpu.nn.tree is children-first, so the
+    root is the *last* node — ``root_index`` defaults to -1.  Pass the
+    actual root slot for trees padded at the tail.
+    """
+
+    def __init__(self, root_index: int = -1):
+        self.root_index = root_index
+        self.fmt = "TreeNNAccuracy()"
 
     def batch_stats(self, output, target):
         if isinstance(output, (tuple, list)):
             output = output[0]
-        output = output[:, 0] if output.ndim == 3 else output
+        output = output[:, self.root_index] if output.ndim == 3 else output
         pred = jnp.argmax(output, axis=-1) + 1
-        tgt = target[:, 0] if target.ndim == 2 else target
+        tgt = target[:, self.root_index] if target.ndim == 2 else target
         correct = jnp.sum((pred == tgt.astype(pred.dtype))
                           .astype(jnp.float32))
         return correct, jnp.asarray(float(output.shape[0]))
@@ -355,6 +367,12 @@ class MeanAveragePrecisionObjectDetection(ValidationMethod):
         self.iou_thresh = iou_thresh
         self.style = style
         self.fmt = f"mAP[{style}]"
+
+    def batch_stats(self, output, target):
+        raise TypeError(
+            "MeanAveragePrecisionObjectDetection is a host-side metric "
+            "over decoded detections — call .evaluate(detections, "
+            "ground_truths) instead of running it through Evaluator")
 
     def _ap_at(self, dets, gts, iou_thresh):
         aps = []

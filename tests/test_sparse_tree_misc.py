@@ -1,0 +1,273 @@
+"""Tests for the sparse stack, TreeLSTM family, and misc layers
+(Scale, spatial local normalization, SpatialConvolutionMap,
+LocallyConnected1D, ConvLSTMPeephole3D).
+
+Mirrors reference specs: nn/SparseLinearSpec, LookupTableSparseSpec,
+SparseJoinTableSpec, DenseToSparseSpec, BinaryTreeLSTMSpec, ScaleSpec,
+SpatialConvolutionMapSpec, LocallyConnected1DSpec,
+SpatialDivisiveNormalizationSpec, SpatialSubtractiveNormalizationSpec.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.core.module import Parameter, partition, combine
+from bigdl_tpu.nn.sparse import SparseTensor
+from bigdl_tpu.utils import set_seed
+
+
+# ---------------- sparse ----------------
+
+def test_sparse_roundtrip_and_jit():
+    x = jnp.asarray([[0.0, 2.0, 0.0], [1.0, 0.0, 3.0]])
+    sp = nn.DenseToSparse()(x)
+    np.testing.assert_allclose(np.asarray(sp.to_dense()), np.asarray(x))
+    # jit through the pytree: shape must stay static
+    f = jax.jit(lambda s: s.to_dense() * 2)
+    np.testing.assert_allclose(np.asarray(f(sp)), np.asarray(x) * 2)
+
+
+def test_sparse_linear_matches_dense():
+    set_seed(0)
+    layer = nn.SparseLinear(6, 4)
+    x = np.zeros((3, 6), np.float32)
+    x[0, 1] = 2.0
+    x[1, 0] = -1.0
+    x[2, 5] = 0.5
+    sp = SparseTensor.from_dense(jnp.asarray(x))
+    dense_out = nn.Linear(6, 4)
+    # share weights
+    dense_out.weight = Parameter(layer.weight)
+    dense_out.bias = Parameter(layer.bias)
+    np.testing.assert_allclose(
+        np.asarray(layer(sp)), np.asarray(dense_out(jnp.asarray(x))),
+        rtol=1e-5, atol=1e-6)
+
+
+def test_sparse_join_table():
+    a = SparseTensor.from_dense(jnp.asarray([[1.0, 0.0]]))
+    b = SparseTensor.from_dense(jnp.asarray([[0.0, 3.0, 4.0]]))
+    joined = nn.SparseJoinTable(2)([a, b])
+    assert joined.shape == (1, 5)
+    np.testing.assert_allclose(np.asarray(joined.to_dense()),
+                               [[1.0, 0.0, 0.0, 3.0, 4.0]])
+
+
+def test_lookup_table_sparse_combiners():
+    set_seed(1)
+    for combiner in ("sum", "mean", "sqrtn"):
+        lt = nn.LookupTableSparse(10, 4, combiner=combiner)
+        # batch of 2: row0 has ids [1, 3], row1 has id [2]
+        ids = SparseTensor(
+            jnp.asarray([[0, 0], [0, 1], [1, 0]], jnp.int32),
+            jnp.asarray([1.0, 3.0, 2.0]), (2, 2))
+        out = lt(ids)
+        assert out.shape == (2, 4)
+        w = np.asarray(lt.weight)
+        if combiner == "sum":
+            want0 = w[0] + w[2]
+        elif combiner == "mean":
+            want0 = (w[0] + w[2]) / 2
+        else:
+            want0 = (w[0] + w[2]) / np.sqrt(2)
+        np.testing.assert_allclose(np.asarray(out[0]), want0, rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(out[1]), w[1], rtol=1e-5)
+
+
+def test_lookup_table_sparse_with_weights():
+    set_seed(2)
+    lt = nn.LookupTableSparse(5, 3, combiner="mean")
+    ids = SparseTensor(jnp.asarray([[0, 0], [0, 1]], jnp.int32),
+                       jnp.asarray([1.0, 2.0]), (1, 2))
+    wts = SparseTensor(jnp.asarray([[0, 0], [0, 1]], jnp.int32),
+                       jnp.asarray([3.0, 1.0]), (1, 2))
+    out = lt((ids, wts))
+    w = np.asarray(lt.weight)
+    want = (3.0 * w[0] + 1.0 * w[1]) / 4.0
+    np.testing.assert_allclose(np.asarray(out[0]), want, rtol=1e-5)
+
+
+# ---------------- tree LSTM ----------------
+
+def _chain_tree():
+    """3 leaves, 2 internal:  ((l0 l1) l2) — post-order slots."""
+    children = np.full((5, 2), -1, np.int32)
+    leaf_ids = np.full((5,), -1, np.int32)
+    leaf_ids[0], leaf_ids[1] = 0, 1
+    children[2] = [0, 1]
+    leaf_ids[3] = 2
+    children[4] = [2, 3]
+    return children, leaf_ids
+
+
+def test_binary_tree_lstm_shapes_and_grad():
+    set_seed(3)
+    model = nn.BinaryTreeLSTM(input_size=4, hidden_size=6)
+    children, leaf_ids = _chain_tree()
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 3, 4), jnp.float32)
+    ch = jnp.asarray(np.stack([children, children]))
+    lf = jnp.asarray(np.stack([leaf_ids, leaf_ids]))
+    out = model((x, ch, lf))
+    assert out.shape == (2, 5, 6)
+    # root state differs from leaf state
+    assert not np.allclose(np.asarray(out[0, 4]), np.asarray(out[0, 0]))
+
+    # gradient flows to composer weights through the tree
+    params, rest = partition(model)
+
+    def loss(p):
+        m = combine(p, rest)
+        return jnp.sum(m((x, ch, lf))[:, 4] ** 2)
+
+    grads = jax.grad(loss)(params)
+    g = jax.tree_util.tree_leaves(grads)
+    assert any(float(jnp.abs(x).sum()) > 0 for x in g)
+
+
+def test_tree_lstm_jit():
+    set_seed(4)
+    model = nn.BinaryTreeLSTM(3, 4)
+    children, leaf_ids = _chain_tree()
+    x = jnp.ones((1, 3, 3))
+    f = jax.jit(lambda m, a, b, c: m((a, b, c)))
+    out = f(model, x, jnp.asarray(children[None]),
+            jnp.asarray(leaf_ids[None]))
+    assert out.shape == (1, 5, 4)
+
+
+# ---------------- misc layers ----------------
+
+def test_scale():
+    set_seed(5)
+    s = nn.Scale((4,))
+    x = jnp.ones((2, 4))
+    out = s(x)
+    want = np.asarray(s.cmul.weight) * 1.0 + np.asarray(s.cadd.bias)
+    np.testing.assert_allclose(np.asarray(out[0]), want, rtol=1e-6)
+
+
+def test_spatial_subtractive_normalization_constant_input():
+    # constant image → local mean == value → output ~ 0 (also at borders)
+    layer = nn.SpatialSubtractiveNormalization(2, jnp.ones((5, 5)))
+    x = jnp.full((1, 8, 8, 2), 3.0)
+    out = np.asarray(layer(x))
+    np.testing.assert_allclose(out, 0.0, atol=1e-5)
+
+
+def test_spatial_divisive_normalization_constant_input():
+    layer = nn.SpatialDivisiveNormalization(1, jnp.ones((3, 3)))
+    x = jnp.full((1, 6, 6, 1), 4.0)
+    out = np.asarray(layer(x))
+    # std of constant 4 is 4 (no mean subtraction) → output = 1
+    np.testing.assert_allclose(out, 1.0, atol=1e-4)
+
+
+def test_spatial_contrastive_normalization_runs():
+    layer = nn.SpatialContrastiveNormalization(1, jnp.ones((3, 3)))
+    x = jnp.asarray(np.random.RandomState(1).rand(1, 7, 7, 1),
+                    jnp.float32)
+    out = layer(x)
+    assert out.shape == x.shape
+
+
+def test_locally_connected_1d_matches_manual():
+    set_seed(6)
+    layer = nn.LocallyConnected1D(5, 3, 2, kernel_w=2, stride_w=1)
+    x = jnp.asarray(np.random.RandomState(2).randn(1, 5, 3), jnp.float32)
+    out = np.asarray(layer(x))
+    assert out.shape == (1, 4, 2)
+    w = np.asarray(layer.weight)  # (n_out_frame, out, kw, in)
+    b = np.asarray(layer.bias)
+    xx = np.asarray(x)
+    for t in range(4):
+        win = xx[0, t:t + 2]  # (kw, in)
+        want = np.einsum("okc,kc->o", w[t], win) + b[t]
+        np.testing.assert_allclose(out[0, t], want, rtol=1e-4, atol=1e-5)
+
+
+def test_spatial_convolution_map_one_to_one():
+    set_seed(7)
+    table = nn.SpatialConvolutionMap.one_to_one(3)
+    layer = nn.SpatialConvolutionMap(table, 3, 3, pad_w=1, pad_h=1)
+    x = jnp.asarray(np.random.RandomState(3).randn(1, 6, 6, 3),
+                    jnp.float32)
+    out = layer(x)
+    assert out.shape == (1, 6, 6, 3)
+    # channel o depends only on input channel o: zero out channel 0 and
+    # check only output channel 0 changes
+    x2 = x.at[..., 0].set(0.0)
+    out2 = layer(x2)
+    d = np.abs(np.asarray(out - out2)).sum(axis=(0, 1, 2))
+    assert d[0] > 1e-3 and d[1] < 1e-6 and d[2] < 1e-6
+
+
+def test_conv_lstm_3d_step():
+    set_seed(8)
+    cell = nn.ConvLSTMPeephole3D(2, 3, kernel_i=3, kernel_c=3)
+    x = jnp.asarray(np.random.RandomState(4).randn(1, 4, 4, 4, 2),
+                    jnp.float32)
+    state = cell.init_state(1, spatial=(4, 4, 4))
+    xproj = cell.conv_input(x)
+    out, (h, c) = cell.step(xproj, state)
+    assert out.shape == (1, 4, 4, 4, 3)
+    assert h.shape == c.shape == (1, 4, 4, 4, 3)
+
+
+def test_rnn_alias():
+    assert nn.RNN is nn.RnnCell
+
+
+def test_recurrent_drives_conv_lstm_2d_and_3d():
+    set_seed(9)
+    rec2 = nn.Recurrent(nn.ConvLSTMPeephole(2, 3))
+    x2 = jnp.ones((1, 2, 4, 4, 2))
+    assert rec2(x2).shape == (1, 2, 4, 4, 3)
+    rec3 = nn.Recurrent(nn.ConvLSTMPeephole3D(2, 3))
+    x3 = jnp.ones((1, 2, 4, 4, 4, 2))
+    assert rec3(x3).shape == (1, 2, 4, 4, 4, 3)
+
+
+def test_group_norm_zero_mean_unit_var():
+    set_seed(10)
+    gn = nn.GroupNorm(8, n_groups=4)
+    x = jnp.asarray(np.random.RandomState(5).randn(2, 5, 5, 8),
+                    jnp.float32)
+    y = np.asarray(gn(x)).reshape(2, 5, 5, 4, 2)
+    # per (sample, group): mean≈0, var≈1
+    m = y.mean(axis=(1, 2, 4))
+    v = y.var(axis=(1, 2, 4))
+    np.testing.assert_allclose(m, 0.0, atol=1e-5)
+    np.testing.assert_allclose(v, 1.0, atol=1e-4)
+
+
+def test_mask_head_use_gn():
+    set_seed(11)
+    from bigdl_tpu.nn.detection import MaskHead
+    mh = MaskHead(in_channels=4, resolution=4, scales=[0.25],
+                  sampling_ratio=2, layers=[8], dilation=1,
+                  num_classes=3, use_gn=True)
+    feats = [jnp.ones((1, 16, 16, 4))]
+    boxes = jnp.asarray([[0, 0, 20, 20]], jnp.float32)
+    masks, _ = mh((feats, boxes, jnp.asarray([1], jnp.int32)))
+    assert masks.shape == (1, 8, 8)
+    assert len(mh.norms) == 1
+
+
+def test_evaluator_with_array_metrics():
+    """MAP / PR-AUC must run through the Evaluator pipeline (they
+    accumulate arrays, not scalars)."""
+    from bigdl_tpu.optim import Evaluator, MeanAveragePrecision, Top1Accuracy
+    set_seed(12)
+    model = nn.Linear(4, 3)
+    x = np.random.RandomState(6).randn(10, 4).astype(np.float32)
+    y = np.random.RandomState(7).randint(1, 4, size=(10,)).astype(np.float32)
+    ev = Evaluator(model, batch_size=4)
+    results = ev.evaluate((x, y), [MeanAveragePrecision(classes=3),
+                                   Top1Accuracy()])
+    (map_res, _), (acc_res, _) = results
+    val, n = map_res.result()
+    assert n == 10 and 0.0 <= val <= 1.0
+    assert 0.0 <= acc_res.result()[0] <= 1.0
