@@ -1,0 +1,251 @@
+"""COCO dataset parsing + RLE/polygon mask utilities.
+
+Reference: dataset/segmentation/COCODataset.scala (annotation JSON
+parsing into typed records) and dataset/segmentation/MaskUtils.scala
+(compressed/uncompressed RLE, polygon rasterization).
+
+Host-side numpy; masks feed the detection pipeline as dense arrays.
+The compressed RLE string codec is the standard COCO LEB128-style
+format, byte-compatible with pycocotools.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+__all__ = [
+    "PolyMasks", "RLEMasks", "COCODataset", "COCOImage", "COCOAnnotation",
+    "rle_encode", "rle_decode", "rle_from_string", "rle_to_string",
+    "poly_to_mask", "mask_area", "rle_area", "merge_rles",
+]
+
+
+# --------------------------------------------------------------------------
+# RLE codec (reference MaskUtils.scala — COCO column-major RLE)
+# --------------------------------------------------------------------------
+
+def rle_encode(mask: np.ndarray) -> List[int]:
+    """Binary mask (H, W) → COCO RLE counts (column-major runs,
+    starting with the count of zeros)."""
+    flat = np.asarray(mask, np.uint8).flatten(order="F")
+    # run-length: positions where value changes
+    diffs = np.nonzero(flat[1:] != flat[:-1])[0] + 1
+    bounds = np.concatenate([[0], diffs, [flat.size]])
+    counts = np.diff(bounds).tolist()
+    if flat.size and flat[0] == 1:
+        counts = [0] + counts
+    return counts
+
+
+def rle_decode(counts: Sequence[int], height: int, width: int) \
+        -> np.ndarray:
+    """COCO RLE counts → binary mask (H, W)."""
+    flat = np.zeros(height * width, np.uint8)
+    pos = 0
+    val = 0
+    for c in counts:
+        if val:
+            flat[pos:pos + c] = 1
+        pos += c
+        val ^= 1
+    return flat.reshape((height, width), order="F")
+
+
+def rle_to_string(counts: Sequence[int]) -> str:
+    """COCO compressed RLE: delta + LEB128-ish base-32 chars
+    (byte-compatible with the pycocotools codec)."""
+    out = []
+    prev = 0
+    for i, c in enumerate(counts):
+        x = int(c)
+        if i > 2:
+            x -= int(counts[i - 2])
+        prev = x
+        more = True
+        while more:
+            ch = x & 0x1F
+            x >>= 5
+            more = not ((x == 0 and not (ch & 0x10))
+                        or (x == -1 and (ch & 0x10)))
+            if more:
+                ch |= 0x20
+            out.append(chr(ch + 48))
+    return "".join(out)
+
+
+def rle_from_string(s: str) -> List[int]:
+    counts: List[int] = []
+    i = 0
+    while i < len(s):
+        x = 0
+        k = 0
+        more = True
+        while more:
+            ch = ord(s[i]) - 48
+            x |= (ch & 0x1F) << (5 * k)
+            more = bool(ch & 0x20)
+            i += 1
+            k += 1
+            if not more and (ch & 0x10):
+                x |= -1 << (5 * k)
+        if len(counts) > 2:
+            x += counts[-2]
+        counts.append(x)
+    return counts
+
+
+def rle_area(counts: Sequence[int]) -> int:
+    return int(sum(counts[1::2]))
+
+
+def mask_area(mask: np.ndarray) -> int:
+    return int(np.asarray(mask, bool).sum())
+
+
+def merge_rles(rles: Sequence[Sequence[int]], height: int,
+               width: int) -> List[int]:
+    """Union of several RLE masks."""
+    acc = np.zeros((height, width), np.uint8)
+    for c in rles:
+        acc |= rle_decode(c, height, width)
+    return rle_encode(acc)
+
+
+def poly_to_mask(polys: Sequence[Sequence[float]], height: int,
+                 width: int) -> np.ndarray:
+    """COCO polygons ([x1,y1,x2,y2,...] lists) → binary mask, via PIL
+    rasterization (replacing the reference's OpenCV fillPoly)."""
+    from PIL import Image as PILImage, ImageDraw
+    img = PILImage.new("1", (width, height), 0)
+    draw = ImageDraw.Draw(img)
+    for poly in polys:
+        pts = [(float(poly[i]), float(poly[i + 1]))
+               for i in range(0, len(poly) - 1, 2)]
+        if len(pts) >= 3:
+            draw.polygon(pts, outline=1, fill=1)
+    return np.asarray(img, np.uint8)
+
+
+# --------------------------------------------------------------------------
+# mask containers (reference SegmentationMasks hierarchy)
+# --------------------------------------------------------------------------
+
+@dataclass
+class PolyMasks:
+    """Polygon segmentation (possibly multi-part)."""
+    polys: List[List[float]]
+    height: int
+    width: int
+
+    def to_mask(self) -> np.ndarray:
+        return poly_to_mask(self.polys, self.height, self.width)
+
+    def to_rle(self) -> "RLEMasks":
+        return RLEMasks(rle_encode(self.to_mask()), self.height, self.width)
+
+
+@dataclass
+class RLEMasks:
+    counts: List[int]
+    height: int
+    width: int
+
+    def to_mask(self) -> np.ndarray:
+        return rle_decode(self.counts, self.height, self.width)
+
+    @property
+    def area(self) -> int:
+        return rle_area(self.counts)
+
+
+# --------------------------------------------------------------------------
+# COCO dataset (reference COCODataset.scala)
+# --------------------------------------------------------------------------
+
+@dataclass
+class COCOAnnotation:
+    id: int
+    image_id: int
+    category_id: int
+    bbox: Tuple[float, float, float, float]  # x, y, w, h
+    area: float
+    iscrowd: bool
+    segmentation: Optional[Union[PolyMasks, RLEMasks]] = None
+
+    def bbox_xyxy(self) -> Tuple[float, float, float, float]:
+        x, y, w, h = self.bbox
+        return (x, y, x + w, y + h)
+
+
+@dataclass
+class COCOImage:
+    id: int
+    file_name: str
+    height: int
+    width: int
+    annotations: List[COCOAnnotation] = field(default_factory=list)
+
+
+class COCODataset:
+    """Parsed COCO annotation file (reference COCODataset.scala:
+    images/annotations/categories cross-linked)."""
+
+    def __init__(self, images: List[COCOImage],
+                 categories: Dict[int, str]):
+        self.images = images
+        self.categories = categories
+        # contiguous 1-based label ids like the reference's cateIdx
+        self.cat_to_label = {cid: i + 1
+                             for i, cid in enumerate(sorted(categories))}
+
+    @staticmethod
+    def load(annotation_file: str, image_root: Optional[str] = None) \
+            -> "COCODataset":
+        with open(annotation_file) as f:
+            data = json.load(f)
+        categories = {c["id"]: c["name"]
+                      for c in data.get("categories", [])}
+        images = {}
+        for im in data.get("images", []):
+            fn = im["file_name"]
+            if image_root:
+                fn = os.path.join(image_root, fn)
+            images[im["id"]] = COCOImage(im["id"], fn, im["height"],
+                                         im["width"])
+        for ann in data.get("annotations", []):
+            img = images.get(ann["image_id"])
+            if img is None:
+                continue
+            seg = ann.get("segmentation")
+            parsed_seg = None
+            if isinstance(seg, list) and seg:
+                parsed_seg = PolyMasks(seg, img.height, img.width)
+            elif isinstance(seg, dict):
+                counts = seg.get("counts")
+                if isinstance(counts, str):
+                    counts = rle_from_string(counts)
+                h, w = seg.get("size", (img.height, img.width))
+                parsed_seg = RLEMasks(list(counts), h, w)
+            img.annotations.append(COCOAnnotation(
+                ann["id"], ann["image_id"], ann["category_id"],
+                tuple(ann["bbox"]), ann.get("area", 0.0),
+                bool(ann.get("iscrowd", 0)), parsed_seg))
+        return COCODataset(list(images.values()), categories)
+
+    def to_detection_samples(self):
+        """Per image: (file_name, boxes (N,4) xyxy, labels (N,),
+        iscrowd (N,)) — the detection-training record layout."""
+        out = []
+        for img in self.images:
+            boxes = np.asarray([a.bbox_xyxy() for a in img.annotations],
+                               np.float32).reshape(-1, 4)
+            labels = np.asarray([self.cat_to_label[a.category_id]
+                                 for a in img.annotations], np.int32)
+            crowd = np.asarray([a.iscrowd for a in img.annotations], bool)
+            out.append((img.file_name, boxes, labels, crowd))
+        return out

@@ -473,11 +473,16 @@ class SpatialConvolutionMap(Module):
 
     def __init__(self, conn_table, kw: int, kh: int,
                  dw: int = 1, dh: int = 1, pad_w: int = 0, pad_h: int = 0,
+                 n_input_plane: int = 0, n_output_plane: int = 0,
                  w_regularizer=None, b_regularizer=None):
         super().__init__()
         conn = np.asarray(conn_table, np.int32)
-        n_in = int(conn[:, 0].max())
-        n_out = int(conn[:, 1].max())
+        # table max only sees *connected* planes — pass the counts
+        # explicitly when the table may omit the last plane (random())
+        n_in = n_input_plane or int(conn[:, 0].max())
+        n_out = n_output_plane or int(conn[:, 1].max())
+        assert conn[:, 0].max() <= n_in and conn[:, 1].max() <= n_out, \
+            "connection table references planes beyond the declared counts"
         mask = np.zeros((kh, kw, n_in, n_out), np.float32)
         for i, o in conn:
             mask[:, :, i - 1, o - 1] = 1.0
@@ -501,6 +506,8 @@ class SpatialConvolutionMap(Module):
 
     @staticmethod
     def random(n_in: int, n_out: int, n_from: int, seed: int = 0):
+        """Random table à la Torch; pass n_input_plane/n_output_plane to
+        the constructor since the sample may omit the highest planes."""
         rng = np.random.RandomState(seed)
         table = []
         for o in range(n_out):

@@ -44,6 +44,12 @@ from bigdl_tpu.nn.conv import (SpatialConvolution,
                                SpatialFullConvolution)
 from bigdl_tpu.nn.linear import Linear
 
+def _group_norm(n_out):
+    # deferred import: normalization.py sits later in nn/__init__
+    from bigdl_tpu.nn.normalization import GroupNorm
+    return GroupNorm(n_out)
+
+
 __all__ = [
     "Anchor", "Nms", "nms", "box_iou", "bbox_transform_inv", "bbox_encode",
     "clip_boxes", "RoiAlign", "RoiPooling", "FPN", "Pooler",
@@ -700,8 +706,7 @@ class MaskHead(Module):
                     nin, nout, 3, 3, 1, 1, dilation, dilation,
                     dilation, dilation))
             if use_gn:
-                from bigdl_tpu.nn.normalization import GroupNorm
-                norms.append(GroupNorm(nout))
+                norms.append(_group_norm(nout))
             nin = nout
         self.convs = ModuleList(convs)
         self.norms = ModuleList(norms)

@@ -95,7 +95,6 @@ class SparseJoinTable(Module):
 
     def forward(self, tensors: Sequence[SparseTensor]) -> SparseTensor:
         d = self.dimension - 1
-        ndim = len(tensors[0].shape)
         offset = 0
         all_idx, all_val = [], []
         for t in tensors:
@@ -134,11 +133,7 @@ class SparseLinear(Module):
         return self.inner.bias
 
     def forward(self, x):
-        if isinstance(x, (tuple, list)) and not isinstance(x, SparseTensor):
-            # table input: (sparse, dense) — wide & deep pattern where
-            # the dense part goes through the same weights' tail is NOT
-            # reference semantics; reference concatenates results, so we
-            # just sum contributions of each sparse part laid side by side
+        if isinstance(x, (tuple, list)):
             raise ValueError("SparseLinear expects a single SparseTensor; "
                              "use SparseJoinTable to merge inputs first")
         rows = x.indices[:, 0]
@@ -183,12 +178,12 @@ class LookupTableSparse(Module):
         rows = ids.indices[:, 0]
         id_vals = ids.values.astype(jnp.int32)
         present = (id_vals > 0).astype(self.weight.dtype)
-        emb_w = self.weight
+        emb = self.weight[jnp.clip(id_vals - 1, 0, self.n_index - 1)]
         if self.max_norm > 0:
-            norms = jnp.linalg.norm(emb_w, axis=1, keepdims=True)
-            emb_w = emb_w * jnp.minimum(1.0, self.max_norm
-                                        / jnp.maximum(norms, 1e-7))
-        emb = emb_w[jnp.clip(id_vals - 1, 0, self.n_index - 1)]
+            # clip only the gathered (nnz, dim) rows, not the whole table
+            norms = jnp.linalg.norm(emb, axis=1, keepdims=True)
+            emb = emb * jnp.minimum(1.0, self.max_norm
+                                    / jnp.maximum(norms, 1e-7))
         w = weights.values if weights is not None else present
         w = w * present
         batch = ids.shape[0]

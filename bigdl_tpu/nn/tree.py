@@ -17,7 +17,9 @@ Tree encoding (per tree, ``n_nodes`` slots, padded with -1):
   * ``leaf_ids (n_nodes,)`` int32: index into the input sequence for
     leaves, -1 for internal nodes.
 Nodes must be ordered so every child index < its parent index (standard
-post-order satisfies this).  The root is the last non-padding node.
+post-order satisfies this).  Padding slots (children AND leaf_id all -1,
+placed after the real nodes) copy the previous slot's state forward, so
+``output[:, -1]`` is the root state for every tree in a ragged batch.
 """
 
 from __future__ import annotations
@@ -69,6 +71,13 @@ class TreeLSTM(Module):
             leaf_x = x[jnp.clip(lid, 0, x.shape[0] - 1)]
             is_leaf = (lid >= 0)
             nh, nc = self.compose(child_h, child_c, leaf_x, is_leaf)
+            # padding slots (no children, no leaf) carry the previous
+            # slot's state forward, so slot -1 always holds the root of
+            # every tree in a ragged batch
+            is_pad = (lid < 0) & (kid[0] < 0) & (kid[1] < 0)
+            prev = jnp.maximum(i - 1, 0)
+            nh = jnp.where(is_pad, h[prev], nh)
+            nc = jnp.where(is_pad, c[prev], nc)
             return (h.at[i].set(nh), c.at[i].set(nc))
 
         h, c = jax.lax.fori_loop(0, n_nodes, body, (h0, c0))

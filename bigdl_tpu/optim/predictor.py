@@ -41,8 +41,11 @@ def _as_dataset(data, batch_size: int, shuffle: bool = False):
         if (isinstance(data, tuple) and len(data) == 2
                 and isinstance(data[0], np.ndarray)
                 and isinstance(data[1], np.ndarray)
-                and data[0].shape[0] == data[1].shape[0]):
-            # (features, labels) array pair → one Sample per row
+                and data[0].shape[0] == data[1].shape[0]
+                and data[1].ndim < data[0].ndim):
+            # (features, labels) array pair → one Sample per row.  The
+            # ndim test keeps a 2-tuple of equally-shaped per-sample
+            # feature arrays on the unlabeled-samples path below.
             data = [Sample(f, l) for f, l in zip(data[0], data[1])]
         elif data and isinstance(data[0], np.ndarray):
             data = [Sample(f) for f in data]

@@ -295,9 +295,10 @@ class TreeNNAccuracy(ValidationMethod):
     """Accuracy on the root node of TreeLSTM-style (B, nodes, C) outputs
     (reference ValidationMethod.scala:122).
 
-    The tree encoding in bigdl_tpu.nn.tree is children-first, so the
-    root is the *last* node — ``root_index`` defaults to -1.  Pass the
-    actual root slot for trees padded at the tail.
+    The tree encoding in bigdl_tpu.nn.tree is children-first with
+    padding slots propagating the previous state, so slot -1 is the root
+    for every tree in a (possibly ragged) batch — ``root_index``
+    defaults to -1.  Pass 0 for root-first encodings.
     """
 
     def __init__(self, root_index: int = -1):

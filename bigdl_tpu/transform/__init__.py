@@ -1,0 +1,1 @@
+from bigdl_tpu.transform.vision import *  # noqa: F401,F403

@@ -1,0 +1,651 @@
+"""Vision pipeline: ImageFeature/ImageFrame + augmentation transformers.
+
+Reference: transform/vision/image/ImageFrame.scala:80-214,
+ImageFeature.scala:36, FeatureTransformer.scala, and augmentation/
+(Brightness, ChannelNormalize, ChannelOrder, ChannelScaledNormalizer,
+ColorJitter, Contrast, Crop, Expand, Filler, HFlip, Hue,
+PixelNormalizer, RandomAlterAspect, RandomCropper, RandomResize,
+RandomTransformer, Resize, Saturation, ScaleResize), plus the ROI label
+transformers (label/roi/*) and MatToTensor/ImageFrameToSample.
+
+TPU-first design: these are *host-side input transforms* (numpy + PIL
+replacing the reference's OpenCV JNI) — on TPU the goal is zero host
+compute inside the jitted step, so all augmentation happens in the
+input pipeline before device transfer, producing ready NHWC float
+arrays.  Images are HWC float32 (BGR by default, matching the
+reference's OpenCV heritage; ChannelOrder converts).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from bigdl_tpu.dataset.dataset import Sample
+from bigdl_tpu.dataset.transformer import Transformer
+
+__all__ = [
+    "ImageFeature", "ImageFrame", "LocalImageFrame", "FeatureTransformer",
+    "Brightness", "ChannelNormalize", "ChannelOrder",
+    "ChannelScaledNormalizer", "ColorJitter", "Contrast", "CenterCrop",
+    "RandomCrop", "FixedCrop", "Expand", "Filler", "HFlip", "Hue",
+    "PixelNormalizer", "RandomAlterAspect", "RandomCropper",
+    "RandomResize", "RandomTransformer", "Resize", "Saturation",
+    "ScaleResize", "AspectScale", "MatToTensor", "ImageFrameToSample",
+    "RoiNormalize", "RoiHFlip", "RoiResize",
+]
+
+
+class ImageFeature(dict):
+    """Mutable map describing one image through the pipeline
+    (reference ImageFeature.scala:36): standard keys below, arbitrary
+    extras allowed.  The working image lives under ``floats`` as an
+    HWC float32 numpy array."""
+
+    # standard keys (reference ImageFeature companion object)
+    bytes_key = "bytes"
+    floats = "floats"
+    label = "label"
+    uri = "uri"
+    original_size = "originalSize"
+    bounding_box = "boundingBox"
+    size = "size"
+
+    def __init__(self, image: Optional[np.ndarray] = None, label=None,
+                 uri: Optional[str] = None, **kw):
+        super().__init__(**kw)
+        if image is not None:
+            img = np.asarray(image, np.float32)
+            self[self.floats] = img
+            self[self.original_size] = img.shape
+        if label is not None:
+            self[self.label] = label
+        if uri is not None:
+            self[self.uri] = uri
+
+    @property
+    def image(self) -> np.ndarray:
+        return self[self.floats]
+
+    @image.setter
+    def image(self, v):
+        self[self.floats] = np.asarray(v, np.float32)
+
+    def get_label(self):
+        return self.get(self.label)
+
+    def width(self) -> int:
+        return self.image.shape[1]
+
+    def height(self) -> int:
+        return self.image.shape[0]
+
+
+class ImageFrame:
+    """Collection of ImageFeatures (reference ImageFrame.scala:80).
+    ``ImageFrame.read`` loads a directory/file via PIL (replacing the
+    OpenCV imread path); distributed-frame semantics are covered by
+    per-host sharding in the data pipeline (DataSet.shard)."""
+
+    @staticmethod
+    def read(path: str, with_label_from_dirs: bool = False) \
+            -> "LocalImageFrame":
+        from PIL import Image as PILImage
+        feats = []
+        if os.path.isdir(path):
+            if with_label_from_dirs:
+                classes = sorted(d for d in os.listdir(path)
+                                 if os.path.isdir(os.path.join(path, d)))
+                for ci, cls in enumerate(classes):
+                    cdir = os.path.join(path, cls)
+                    for f in sorted(os.listdir(cdir)):
+                        fp = os.path.join(cdir, f)
+                        img = np.asarray(PILImage.open(fp).convert("RGB"),
+                                         np.float32)[:, :, ::-1]  # BGR
+                        feats.append(ImageFeature(img, label=float(ci + 1),
+                                                  uri=fp))
+            else:
+                for f in sorted(os.listdir(path)):
+                    fp = os.path.join(path, f)
+                    if not os.path.isfile(fp):
+                        continue
+                    img = np.asarray(PILImage.open(fp).convert("RGB"),
+                                     np.float32)[:, :, ::-1]
+                    feats.append(ImageFeature(img, uri=fp))
+        else:
+            img = np.asarray(PILImage.open(path).convert("RGB"),
+                             np.float32)[:, :, ::-1]
+            feats.append(ImageFeature(img, uri=path))
+        return LocalImageFrame(feats)
+
+    @staticmethod
+    def from_arrays(images: Sequence[np.ndarray], labels=None) \
+            -> "LocalImageFrame":
+        labels = labels if labels is not None else [None] * len(images)
+        return LocalImageFrame([ImageFeature(im, label=l)
+                                for im, l in zip(images, labels)])
+
+
+class LocalImageFrame(ImageFrame):
+    """Array-backed frame (reference LocalImageFrame)."""
+
+    def __init__(self, features: List[ImageFeature]):
+        self.features = list(features)
+
+    def __len__(self):
+        return len(self.features)
+
+    def __iter__(self):
+        return iter(self.features)
+
+    def transform(self, transformer: "FeatureTransformer") \
+            -> "LocalImageFrame":
+        return LocalImageFrame(list(transformer(iter(self.features))))
+
+    def to_samples(self) -> List[Sample]:
+        return [Sample(f.image, f.get_label()) for f in self.features]
+
+
+class FeatureTransformer(Transformer):
+    """Per-image transformer (reference FeatureTransformer.scala):
+    subclasses implement ``transform(feature)`` mutating/returning the
+    ImageFeature; composition via ``>>`` is inherited."""
+
+    def transform(self, feature: ImageFeature) -> ImageFeature:
+        raise NotImplementedError
+
+    def apply(self, it):
+        for f in it:
+            yield self.transform(f)
+
+    def __call__(self, arg):
+        if isinstance(arg, ImageFeature):
+            return self.transform(arg)
+        if isinstance(arg, ImageFrame):
+            return arg.transform(self)
+        return self.apply(arg)
+
+
+# --------------------------------------------------------------------------
+# pixel-level transforms
+# --------------------------------------------------------------------------
+
+class Brightness(FeatureTransformer):
+    """Add a uniform delta in [delta_low, delta_high]
+    (reference augmentation/Brightness.scala)."""
+
+    def __init__(self, delta_low: float = -32.0, delta_high: float = 32.0,
+                 rng: Optional[np.random.RandomState] = None):
+        self.low, self.high = delta_low, delta_high
+        self.rng = rng or np.random.RandomState()
+
+    def transform(self, f):
+        f.image = f.image + self.rng.uniform(self.low, self.high)
+        return f
+
+
+class Contrast(FeatureTransformer):
+    """Scale pixel values by a random factor
+    (reference augmentation/Contrast.scala)."""
+
+    def __init__(self, delta_low: float = 0.5, delta_high: float = 1.5,
+                 rng: Optional[np.random.RandomState] = None):
+        self.low, self.high = delta_low, delta_high
+        self.rng = rng or np.random.RandomState()
+
+    def transform(self, f):
+        f.image = f.image * self.rng.uniform(self.low, self.high)
+        return f
+
+
+def _bgr_to_hsv(img):
+    import colorsys  # noqa: F401  (documentation: vectorized below)
+    b, g, r = img[..., 0] / 255.0, img[..., 1] / 255.0, img[..., 2] / 255.0
+    mx = np.maximum(np.maximum(r, g), b)
+    mn = np.minimum(np.minimum(r, g), b)
+    diff = mx - mn
+    h = np.zeros_like(mx)
+    mask = diff > 1e-12
+    rc = np.where(mask, (mx - r) / np.where(mask, diff, 1), 0)
+    gc = np.where(mask, (mx - g) / np.where(mask, diff, 1), 0)
+    bc = np.where(mask, (mx - b) / np.where(mask, diff, 1), 0)
+    h = np.where(mx == r, bc - gc, h)
+    h = np.where((mx == g) & mask, 2.0 + rc - bc, h)
+    h = np.where((mx == b) & mask, 4.0 + gc - rc, h)
+    h = (h / 6.0) % 1.0
+    s = np.where(mx > 1e-12, diff / np.where(mx > 1e-12, mx, 1), 0)
+    return h, s, mx
+
+
+def _hsv_to_bgr(h, s, v):
+    i = np.floor(h * 6.0)
+    fr = h * 6.0 - i
+    p = v * (1 - s)
+    q = v * (1 - s * fr)
+    t = v * (1 - s * (1 - fr))
+    i = i.astype(np.int32) % 6
+    r = np.choose(i, [v, q, p, p, t, v])
+    g = np.choose(i, [t, v, v, q, p, p])
+    b = np.choose(i, [p, p, t, v, v, q])
+    return np.stack([b, g, r], axis=-1) * 255.0
+
+
+class Saturation(FeatureTransformer):
+    """Scale HSV saturation (reference augmentation/Saturation.scala)."""
+
+    def __init__(self, delta_low: float = 0.5, delta_high: float = 1.5,
+                 rng: Optional[np.random.RandomState] = None):
+        self.low, self.high = delta_low, delta_high
+        self.rng = rng or np.random.RandomState()
+
+    def transform(self, f):
+        h, s, v = _bgr_to_hsv(np.clip(f.image, 0, 255))
+        s = np.clip(s * self.rng.uniform(self.low, self.high), 0, 1)
+        f.image = _hsv_to_bgr(h, s, v)
+        return f
+
+
+class Hue(FeatureTransformer):
+    """Rotate HSV hue by a delta in degrees
+    (reference augmentation/Hue.scala)."""
+
+    def __init__(self, delta_low: float = -18.0, delta_high: float = 18.0,
+                 rng: Optional[np.random.RandomState] = None):
+        self.low, self.high = delta_low, delta_high
+        self.rng = rng or np.random.RandomState()
+
+    def transform(self, f):
+        h, s, v = _bgr_to_hsv(np.clip(f.image, 0, 255))
+        h = (h + self.rng.uniform(self.low, self.high) / 360.0) % 1.0
+        f.image = _hsv_to_bgr(h, s, v)
+        return f
+
+
+class ChannelOrder(FeatureTransformer):
+    """Reverse channel order BGR↔RGB
+    (reference augmentation/ChannelOrder.scala)."""
+
+    def transform(self, f):
+        f.image = f.image[:, :, ::-1].copy()
+        return f
+
+
+class ChannelNormalize(FeatureTransformer):
+    """(x - mean) / std per channel
+    (reference augmentation/ChannelNormalize.scala)."""
+
+    def __init__(self, mean_b: float, mean_g: float, mean_r: float,
+                 std_b: float = 1.0, std_g: float = 1.0,
+                 std_r: float = 1.0):
+        self.mean = np.asarray([mean_b, mean_g, mean_r], np.float32)
+        self.std = np.asarray([std_b, std_g, std_r], np.float32)
+
+    def transform(self, f):
+        f.image = (f.image - self.mean) / self.std
+        return f
+
+
+class ChannelScaledNormalizer(FeatureTransformer):
+    """Per-channel mean subtraction + global scale
+    (reference augmentation/ChannelScaledNormalizer.scala)."""
+
+    def __init__(self, mean_b: float, mean_g: float, mean_r: float,
+                 scale: float = 1.0):
+        self.mean = np.asarray([mean_b, mean_g, mean_r], np.float32)
+        self.scale = scale
+
+    def transform(self, f):
+        f.image = (f.image - self.mean) * self.scale
+        return f
+
+
+class PixelNormalizer(FeatureTransformer):
+    """Subtract a full per-pixel mean image
+    (reference augmentation/PixelNormalizer.scala)."""
+
+    def __init__(self, means: np.ndarray):
+        self.means = np.asarray(means, np.float32)
+
+    def transform(self, f):
+        f.image = f.image - self.means.reshape(f.image.shape)
+        return f
+
+
+class ColorJitter(FeatureTransformer):
+    """Random brightness/contrast/saturation in random order
+    (reference augmentation/ColorJitter.scala)."""
+
+    def __init__(self, brightness: float = 32.0, contrast: float = 0.5,
+                 saturation: float = 0.5, shuffle: bool = True,
+                 rng: Optional[np.random.RandomState] = None):
+        self.rng = rng or np.random.RandomState()
+        self.stages = [
+            Brightness(-brightness, brightness, rng=self.rng),
+            Contrast(1 - contrast, 1 + contrast, rng=self.rng),
+            Saturation(1 - saturation, 1 + saturation, rng=self.rng),
+        ]
+        self.shuffle = shuffle
+
+    def transform(self, f):
+        order = (self.rng.permutation(len(self.stages)) if self.shuffle
+                 else range(len(self.stages)))
+        for i in order:
+            f = self.stages[i].transform(f)
+        f.image = np.clip(f.image, 0, 255)
+        return f
+
+
+# --------------------------------------------------------------------------
+# geometric transforms
+# --------------------------------------------------------------------------
+
+def _pil_resize(img: np.ndarray, w: int, h: int,
+                method: str = "bilinear") -> np.ndarray:
+    from PIL import Image as PILImage
+    m = {"bilinear": PILImage.BILINEAR, "nearest": PILImage.NEAREST,
+         "bicubic": PILImage.BICUBIC, "area": PILImage.BOX}[method]
+    chans = [PILImage.fromarray(img[:, :, c]).resize((w, h), m)
+             for c in range(img.shape[2])]
+    return np.stack([np.asarray(c, np.float32) for c in chans], axis=-1)
+
+
+class Resize(FeatureTransformer):
+    """Resize to (resize_w, resize_h)
+    (reference augmentation/Resize.scala)."""
+
+    def __init__(self, resize_h: int, resize_w: int,
+                 method: str = "bilinear"):
+        self.h, self.w = resize_h, resize_w
+        self.method = method
+
+    def transform(self, f):
+        f.image = _pil_resize(f.image, self.w, self.h, self.method)
+        return f
+
+
+class AspectScale(FeatureTransformer):
+    """Resize so the short side is ``min_size`` with the long side capped
+    at ``max_size`` (reference ScaleResize/AspectScale semantics used by
+    detection pipelines)."""
+
+    def __init__(self, min_size: int, max_size: int = 1000,
+                 scale_multiple: int = 1):
+        self.min_size, self.max_size = min_size, max_size
+        self.mult = scale_multiple
+
+    def transform(self, f):
+        h, w = f.image.shape[:2]
+        scale = self.min_size / min(h, w)
+        if max(h, w) * scale > self.max_size:
+            scale = self.max_size / max(h, w)
+        nh, nw = int(round(h * scale)), int(round(w * scale))
+        if self.mult > 1:
+            nh = (nh // self.mult) * self.mult
+            nw = (nw // self.mult) * self.mult
+        f["scale"] = (nh / h, nw / w)
+        f.image = _pil_resize(f.image, nw, nh)
+        return f
+
+
+class ScaleResize(AspectScale):
+    """Alias of AspectScale (reference augmentation/ScaleResize.scala)."""
+
+
+class RandomResize(FeatureTransformer):
+    """Resize to a random size in [min, max] keeping square target
+    (reference augmentation/RandomResize.scala)."""
+
+    def __init__(self, min_size: int, max_size: int,
+                 rng: Optional[np.random.RandomState] = None):
+        self.min_size, self.max_size = min_size, max_size
+        self.rng = rng or np.random.RandomState()
+
+    def transform(self, f):
+        s = int(self.rng.randint(self.min_size, self.max_size + 1))
+        f.image = _pil_resize(f.image, s, s)
+        return f
+
+
+class CenterCrop(FeatureTransformer):
+    """(reference augmentation/Crop.scala CenterCrop)."""
+
+    def __init__(self, crop_w: int, crop_h: int):
+        self.w, self.h = crop_w, crop_h
+
+    def transform(self, f):
+        H, W = f.image.shape[:2]
+        y0 = max((H - self.h) // 2, 0)
+        x0 = max((W - self.w) // 2, 0)
+        f.image = f.image[y0:y0 + self.h, x0:x0 + self.w]
+        return f
+
+
+class RandomCrop(FeatureTransformer):
+    """(reference augmentation/Crop.scala RandomCrop)."""
+
+    def __init__(self, crop_w: int, crop_h: int,
+                 rng: Optional[np.random.RandomState] = None):
+        self.w, self.h = crop_w, crop_h
+        self.rng = rng or np.random.RandomState()
+
+    def transform(self, f):
+        H, W = f.image.shape[:2]
+        y0 = self.rng.randint(0, max(H - self.h, 0) + 1)
+        x0 = self.rng.randint(0, max(W - self.w, 0) + 1)
+        f.image = f.image[y0:y0 + self.h, x0:x0 + self.w]
+        return f
+
+
+class FixedCrop(FeatureTransformer):
+    """Crop a fixed box, absolute pixels or normalized [0,1] coords
+    (reference augmentation/Crop.scala FixedCrop)."""
+
+    def __init__(self, x1: float, y1: float, x2: float, y2: float,
+                 normalized: bool = False):
+        self.box = (x1, y1, x2, y2)
+        self.normalized = normalized
+
+    def transform(self, f):
+        H, W = f.image.shape[:2]
+        x1, y1, x2, y2 = self.box
+        if self.normalized:
+            x1, x2 = x1 * W, x2 * W
+            y1, y2 = y1 * H, y2 * H
+        f.image = f.image[int(y1):int(y2), int(x1):int(x2)]
+        return f
+
+
+class RandomCropper(FeatureTransformer):
+    """Random crop with HFlip for classification training
+    (reference augmentation/RandomCropper.scala)."""
+
+    def __init__(self, crop_w: int, crop_h: int, mirror: bool = True,
+                 rng: Optional[np.random.RandomState] = None):
+        self.rng = rng or np.random.RandomState()
+        self.crop = RandomCrop(crop_w, crop_h, rng=self.rng)
+        self.mirror = mirror
+
+    def transform(self, f):
+        f = self.crop.transform(f)
+        if self.mirror and self.rng.rand() < 0.5:
+            f.image = f.image[:, ::-1].copy()
+        return f
+
+
+class RandomAlterAspect(FeatureTransformer):
+    """Random area+aspect-ratio crop then resize (GoogLeNet-style;
+    reference augmentation/RandomAlterAspect.scala)."""
+
+    def __init__(self, min_area_ratio: float = 0.08,
+                 max_area_ratio: float = 1.0,
+                 min_aspect_ratio_change: float = 0.75,
+                 interp_mode: str = "bilinear", crop_length: int = 224,
+                 rng: Optional[np.random.RandomState] = None):
+        self.min_area, self.max_area = min_area_ratio, max_area_ratio
+        self.min_ar = min_aspect_ratio_change
+        self.method = interp_mode
+        self.out = crop_length
+        self.rng = rng or np.random.RandomState()
+
+    def transform(self, f):
+        H, W = f.image.shape[:2]
+        area = H * W
+        for _ in range(10):
+            target = self.rng.uniform(self.min_area, self.max_area) * area
+            ar = self.rng.uniform(self.min_ar, 1.0 / self.min_ar)
+            w = int(round(np.sqrt(target * ar)))
+            h = int(round(np.sqrt(target / ar)))
+            if w <= W and h <= H:
+                y0 = self.rng.randint(0, H - h + 1)
+                x0 = self.rng.randint(0, W - w + 1)
+                crop = f.image[y0:y0 + h, x0:x0 + w]
+                f.image = _pil_resize(crop, self.out, self.out, self.method)
+                return f
+        f.image = _pil_resize(f.image, self.out, self.out, self.method)
+        return f
+
+
+class Expand(FeatureTransformer):
+    """Place the image on a larger mean-filled canvas (SSD zoom-out;
+    reference augmentation/Expand.scala)."""
+
+    def __init__(self, means_b: float = 123.0, means_g: float = 117.0,
+                 means_r: float = 104.0, min_expand_ratio: float = 1.0,
+                 max_expand_ratio: float = 4.0,
+                 rng: Optional[np.random.RandomState] = None):
+        self.means = np.asarray([means_b, means_g, means_r], np.float32)
+        self.min_ratio, self.max_ratio = min_expand_ratio, max_expand_ratio
+        self.rng = rng or np.random.RandomState()
+
+    def transform(self, f):
+        H, W, C = f.image.shape
+        ratio = self.rng.uniform(self.min_ratio, self.max_ratio)
+        nh, nw = int(H * ratio), int(W * ratio)
+        y0 = int(self.rng.uniform(0, nh - H))
+        x0 = int(self.rng.uniform(0, nw - W))
+        canvas = np.tile(self.means, (nh, nw, 1)).astype(np.float32)
+        canvas[y0:y0 + H, x0:x0 + W] = f.image
+        f["expand_offset"] = (y0, x0)
+        f.image = canvas
+        return f
+
+
+class Filler(FeatureTransformer):
+    """Fill a normalized sub-rectangle with a constant value
+    (reference augmentation/Filler.scala)."""
+
+    def __init__(self, start_x: float, start_y: float, end_x: float,
+                 end_y: float, value: float = 255.0):
+        self.box = (start_x, start_y, end_x, end_y)
+        self.value = value
+
+    def transform(self, f):
+        H, W = f.image.shape[:2]
+        x1, y1, x2, y2 = self.box
+        f.image[int(y1 * H):int(y2 * H), int(x1 * W):int(x2 * W)] = \
+            self.value
+        return f
+
+
+class HFlip(FeatureTransformer):
+    """Unconditional horizontal flip (reference augmentation/HFlip.scala;
+    use RandomTransformer(HFlip(), 0.5) for the random variant)."""
+
+    def transform(self, f):
+        f.image = f.image[:, ::-1].copy()
+        f["flipped"] = True
+        return f
+
+
+class RandomTransformer(FeatureTransformer):
+    """Apply inner transformer with probability p
+    (reference augmentation/RandomTransformer.scala)."""
+
+    def __init__(self, inner: FeatureTransformer, prob: float,
+                 rng: Optional[np.random.RandomState] = None):
+        self.inner = inner
+        self.prob = prob
+        self.rng = rng or np.random.RandomState()
+
+    def transform(self, f):
+        if self.rng.rand() < self.prob:
+            f = self.inner.transform(f)
+        return f
+
+
+# --------------------------------------------------------------------------
+# ROI label transforms (reference transform/vision/image/label/roi/*)
+# --------------------------------------------------------------------------
+
+class RoiNormalize(FeatureTransformer):
+    """Normalize bbox coords to [0,1] by image size."""
+
+    def transform(self, f):
+        boxes = f.get(ImageFeature.bounding_box)
+        if boxes is not None:
+            H, W = f.image.shape[:2]
+            boxes = np.asarray(boxes, np.float32)
+            boxes[:, [0, 2]] /= W
+            boxes[:, [1, 3]] /= H
+            f[ImageFeature.bounding_box] = boxes
+        return f
+
+
+class RoiHFlip(FeatureTransformer):
+    """Mirror bbox x coords; pair with HFlip on the pixels."""
+
+    def __init__(self, normalized: bool = True):
+        self.normalized = normalized
+
+    def transform(self, f):
+        boxes = f.get(ImageFeature.bounding_box)
+        if boxes is not None:
+            boxes = np.asarray(boxes, np.float32)
+            w = 1.0 if self.normalized else f.image.shape[1]
+            x1 = boxes[:, 0].copy()
+            boxes[:, 0] = w - boxes[:, 2]
+            boxes[:, 2] = w - x1
+            f[ImageFeature.bounding_box] = boxes
+        return f
+
+
+class RoiResize(FeatureTransformer):
+    """Scale absolute bbox coords by the recorded resize scale."""
+
+    def transform(self, f):
+        boxes = f.get(ImageFeature.bounding_box)
+        scale = f.get("scale")
+        if boxes is not None and scale is not None:
+            boxes = np.asarray(boxes, np.float32)
+            sy, sx = scale
+            boxes[:, [0, 2]] *= sx
+            boxes[:, [1, 3]] *= sy
+            f[ImageFeature.bounding_box] = boxes
+        return f
+
+
+# --------------------------------------------------------------------------
+# bridge to the training pipeline
+# --------------------------------------------------------------------------
+
+class MatToTensor(FeatureTransformer):
+    """Finalize the float image (÷ optional scale, HWC float32) —
+    reference MatToTensor/MatToFloats."""
+
+    def __init__(self, scale: float = 1.0):
+        self.scale = scale
+
+    def transform(self, f):
+        f.image = np.ascontiguousarray(f.image, np.float32) * self.scale
+        return f
+
+
+class ImageFrameToSample(Transformer):
+    """ImageFeature iterator → Sample iterator
+    (reference ImageFrameToSample.scala)."""
+
+    def apply(self, it):
+        for f in it:
+            yield Sample(f.image, f.get_label())

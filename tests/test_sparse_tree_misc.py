@@ -271,3 +271,49 @@ def test_evaluator_with_array_metrics():
     val, n = map_res.result()
     assert n == 10 and 0.0 <= val <= 1.0
     assert 0.0 <= acc_res.result()[0] <= 1.0
+
+
+def test_tree_lstm_ragged_padding_propagates_root():
+    """Padded batches: slot -1 must hold each tree's root state."""
+    set_seed(13)
+    model = nn.BinaryTreeLSTM(3, 4)
+    # tree A: full 5 slots; tree B: 3 real nodes + 2 padding slots
+    ch_a, lf_a = _chain_tree()
+    ch_b = np.full((5, 2), -1, np.int32)
+    lf_b = np.full((5,), -1, np.int32)
+    lf_b[0], lf_b[1] = 0, 1
+    ch_b[2] = [0, 1]          # root of B at slot 2; slots 3, 4 padding
+    x = jnp.asarray(np.random.RandomState(8).randn(2, 3, 3), jnp.float32)
+    out = model((x, jnp.asarray(np.stack([ch_a, ch_b])),
+                 jnp.asarray(np.stack([lf_a, lf_b]))))
+    # B's padding slots replicate its root (slot 2)
+    np.testing.assert_allclose(np.asarray(out[1, 3]),
+                               np.asarray(out[1, 2]), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(out[1, 4]),
+                               np.asarray(out[1, 2]), rtol=1e-6)
+    # A's last slot is its real root (differs from its slot-2 subtree)
+    assert not np.allclose(np.asarray(out[0, 4]), np.asarray(out[0, 2]))
+
+
+def test_spatial_convolution_map_random_with_explicit_planes():
+    set_seed(14)
+    table = nn.SpatialConvolutionMap.random(4, 2, 2, seed=1)
+    layer = nn.SpatialConvolutionMap(table, 3, 3, pad_w=1, pad_h=1,
+                                     n_input_plane=4, n_output_plane=2)
+    x = jnp.ones((1, 5, 5, 4))
+    assert layer(x).shape == (1, 5, 5, 2)
+
+
+def test_predictor_tuple_of_features_not_misread_as_pair():
+    """A 2-tuple of same-shaped per-sample feature arrays must stay on
+    the unlabeled-samples path."""
+    from bigdl_tpu.optim import Predictor
+    set_seed(15)
+    model = nn.Linear(4, 2)
+    a = np.random.RandomState(9).randn(4).astype(np.float32)
+    b = np.random.RandomState(10).randn(4).astype(np.float32)
+    preds = Predictor(model, batch_size=2).predict((a, b))
+    assert np.asarray(preds).shape == (2, 2)
+    np.testing.assert_allclose(
+        np.asarray(preds[0]), np.asarray(model(jnp.asarray(a))),
+        rtol=1e-5)

@@ -1,0 +1,239 @@
+"""Vision transform pipeline + COCO/RLE segmentation tests.
+
+Mirrors reference specs under transform/vision (BrightnessSpec,
+ChannelNormalizeSpec, CropSpec, ExpandSpec, HFlipSpec, ResizeSpec, …)
+and dataset/segmentation (COCODatasetSpec, MaskUtilsSpec).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from bigdl_tpu.dataset.segmentation import (
+    COCODataset, PolyMasks, RLEMasks, mask_area, merge_rles, poly_to_mask,
+    rle_decode, rle_encode, rle_from_string, rle_to_string,
+)
+from bigdl_tpu.transform.vision import (
+    AspectScale, Brightness, CenterCrop, ChannelNormalize, ChannelOrder,
+    ColorJitter, Contrast, Expand, Filler, FixedCrop, HFlip, Hue,
+    ImageFeature, ImageFrame, ImageFrameToSample, LocalImageFrame,
+    MatToTensor, PixelNormalizer, RandomAlterAspect, RandomCrop,
+    RandomCropper, RandomResize, RandomTransformer, Resize, RoiHFlip,
+    RoiNormalize, RoiResize, Saturation,
+)
+
+
+def img(h=6, w=8, c=3, seed=0):
+    return np.random.RandomState(seed).rand(h, w, c).astype(
+        np.float32) * 255
+
+
+def test_brightness_contrast_deterministic():
+    rng = np.random.RandomState(0)
+    f = ImageFeature(img())
+    base = f.image.copy()
+    out = Brightness(10, 10, rng=rng)(ImageFeature(base)).image
+    np.testing.assert_allclose(out, base + 10, rtol=1e-6)
+    out = Contrast(2, 2, rng=rng)(ImageFeature(base)).image
+    np.testing.assert_allclose(out, base * 2, rtol=1e-6)
+
+
+def test_channel_normalize_and_order():
+    f = ImageFeature(img())
+    base = f.image.copy()
+    out = ChannelNormalize(1, 2, 3, 2, 2, 2)(ImageFeature(base)).image
+    want = (base - np.array([1, 2, 3], np.float32)) / 2
+    np.testing.assert_allclose(out, want, rtol=1e-6)
+    out = ChannelOrder()(ImageFeature(base)).image
+    np.testing.assert_allclose(out, base[:, :, ::-1])
+
+
+def test_pixel_normalizer():
+    base = img()
+    means = np.ones_like(base) * 5
+    out = PixelNormalizer(means)(ImageFeature(base)).image
+    np.testing.assert_allclose(out, base - 5, rtol=1e-6)
+
+
+def test_hue_saturation_roundtrip_range():
+    base = img()
+    out = Saturation(1.0, 1.0)(ImageFeature(base)).image
+    # unit saturation change ≈ identity
+    np.testing.assert_allclose(out, base, atol=1.0)
+    out = Hue(0.0, 0.0)(ImageFeature(base)).image
+    np.testing.assert_allclose(out, base, atol=1.0)
+
+
+def test_crops_and_resize():
+    base = img(10, 12)
+    out = CenterCrop(6, 4)(ImageFeature(base)).image
+    assert out.shape == (4, 6, 3)
+    np.testing.assert_allclose(out, base[3:7, 3:9])
+    out = RandomCrop(6, 4, rng=np.random.RandomState(0))(
+        ImageFeature(base)).image
+    assert out.shape == (4, 6, 3)
+    out = FixedCrop(0.25, 0.0, 0.75, 1.0, normalized=True)(
+        ImageFeature(base)).image
+    assert out.shape == (10, 6, 3)
+    out = Resize(5, 7)(ImageFeature(base)).image
+    assert out.shape == (5, 7, 3)
+
+
+def test_aspect_scale_records_scale():
+    f = ImageFeature(img(10, 20))
+    out = AspectScale(5, max_size=8)(f)
+    # long side capped at 8: scale = 8/20
+    assert out.image.shape[1] == 8
+    sy, sx = out["scale"]
+    assert sx == pytest.approx(8 / 20)
+
+
+def test_expand_and_filler():
+    base = img(4, 4)
+    f = Expand(1, 2, 3, 2.0, 2.0, rng=np.random.RandomState(0))(
+        ImageFeature(base))
+    assert f.image.shape == (8, 8, 3)
+    y0, x0 = f["expand_offset"]
+    np.testing.assert_allclose(f.image[y0:y0 + 4, x0:x0 + 4], base)
+    base2 = img(4, 4).copy()
+    out = Filler(0.0, 0.0, 0.5, 0.5, value=9.0)(ImageFeature(base2)).image
+    np.testing.assert_allclose(out[:2, :2], 9.0)
+
+
+def test_hflip_and_random_transformer():
+    base = img()
+    out = HFlip()(ImageFeature(base)).image
+    np.testing.assert_allclose(out, base[:, ::-1])
+    rt = RandomTransformer(HFlip(), 0.0, rng=np.random.RandomState(0))
+    np.testing.assert_allclose(rt(ImageFeature(base)).image, base)
+
+
+def test_color_jitter_and_random_shapes():
+    base = img()
+    out = ColorJitter(rng=np.random.RandomState(1))(
+        ImageFeature(base)).image
+    assert out.shape == base.shape
+    assert out.min() >= 0 and out.max() <= 255
+    out = RandomResize(4, 6, rng=np.random.RandomState(2))(
+        ImageFeature(base)).image
+    assert 4 <= out.shape[0] <= 6 and out.shape[0] == out.shape[1]
+    out = RandomAlterAspect(crop_length=5, rng=np.random.RandomState(3))(
+        ImageFeature(base)).image
+    assert out.shape == (5, 5, 3)
+    out = RandomCropper(4, 4, rng=np.random.RandomState(4))(
+        ImageFeature(base)).image
+    assert out.shape == (4, 4, 3)
+
+
+def test_roi_transforms():
+    f = ImageFeature(img(10, 20))
+    f[ImageFeature.bounding_box] = np.asarray(
+        [[2.0, 1.0, 10.0, 9.0]], np.float32)
+    f = RoiNormalize()(f)
+    np.testing.assert_allclose(f[ImageFeature.bounding_box],
+                               [[0.1, 0.1, 0.5, 0.9]], rtol=1e-6)
+    f = RoiHFlip(normalized=True)(f)
+    np.testing.assert_allclose(f[ImageFeature.bounding_box],
+                               [[0.5, 0.1, 0.9, 0.9]], rtol=1e-6)
+    f2 = ImageFeature(img(10, 20))
+    f2[ImageFeature.bounding_box] = np.asarray(
+        [[2.0, 1.0, 10.0, 9.0]], np.float32)
+    f2["scale"] = (0.5, 2.0)
+    f2 = RoiResize()(f2)
+    np.testing.assert_allclose(f2[ImageFeature.bounding_box],
+                               [[4.0, 0.5, 20.0, 4.5]], rtol=1e-6)
+
+
+def test_image_frame_pipeline_to_samples():
+    frame = ImageFrame.from_arrays([img(8, 8, seed=i) for i in range(3)],
+                                   labels=[1.0, 2.0, 3.0])
+    pipeline = Resize(4, 4) >> MatToTensor(scale=1 / 255.0)
+    out = frame.transform(pipeline)
+    samples = list(ImageFrameToSample()(iter(out.features)))
+    assert len(samples) == 3
+    assert samples[0].feature.shape == (4, 4, 3)
+    assert samples[0].feature.max() <= 1.0
+    assert samples[2].label == 3.0
+
+
+# ---------------- RLE / COCO ----------------
+
+def test_rle_roundtrip():
+    rng = np.random.RandomState(0)
+    mask = (rng.rand(13, 7) > 0.6).astype(np.uint8)
+    counts = rle_encode(mask)
+    back = rle_decode(counts, 13, 7)
+    np.testing.assert_array_equal(back, mask)
+    assert sum(counts) == mask.size
+
+
+def test_rle_string_codec_pycoco_compat():
+    # hand-checked vector: 3x3 mask with first column set
+    mask = np.zeros((3, 3), np.uint8)
+    mask[:, 0] = 1
+    counts = rle_encode(mask)
+    assert counts == [0, 3, 6]
+    s = rle_to_string(counts)
+    assert rle_from_string(s) == counts
+    # negative-delta path
+    counts2 = [10, 2, 3, 50, 1]
+    assert rle_from_string(rle_to_string(counts2)) == counts2
+
+
+def test_poly_to_mask_square():
+    mask = poly_to_mask([[1, 1, 5, 1, 5, 5, 1, 5]], 8, 8)
+    assert mask.shape == (8, 8)
+    assert mask[3, 3] == 1 and mask[0, 0] == 0
+    assert mask_area(mask) >= 16
+
+
+def test_merge_rles():
+    a = np.zeros((4, 4), np.uint8)
+    a[0, :] = 1
+    b = np.zeros((4, 4), np.uint8)
+    b[3, :] = 1
+    merged = merge_rles([rle_encode(a), rle_encode(b)], 4, 4)
+    np.testing.assert_array_equal(rle_decode(merged, 4, 4), a | b)
+
+
+def test_coco_dataset_load(tmp_path):
+    ann = {
+        "images": [
+            {"id": 1, "file_name": "a.jpg", "height": 10, "width": 20},
+            {"id": 2, "file_name": "b.jpg", "height": 8, "width": 8},
+        ],
+        "categories": [{"id": 7, "name": "cat"},
+                       {"id": 3, "name": "dog"}],
+        "annotations": [
+            {"id": 100, "image_id": 1, "category_id": 7,
+             "bbox": [2, 3, 4, 5], "area": 20, "iscrowd": 0,
+             "segmentation": [[2, 3, 6, 3, 6, 8, 2, 8]]},
+            {"id": 101, "image_id": 2, "category_id": 3,
+             "bbox": [0, 0, 4, 4], "area": 16, "iscrowd": 1,
+             "segmentation": {"size": [8, 8],
+                              "counts": rle_to_string([0, 8, 56])}},
+        ],
+    }
+    p = tmp_path / "ann.json"
+    p.write_text(json.dumps(ann))
+    ds = COCODataset.load(str(p), image_root="/imgs")
+    assert len(ds.images) == 2
+    assert ds.categories == {7: "cat", 3: "dog"}
+    assert ds.cat_to_label == {3: 1, 7: 2}
+    img1 = [i for i in ds.images if i.id == 1][0]
+    assert img1.file_name == "/imgs/a.jpg"
+    a = img1.annotations[0]
+    assert a.bbox_xyxy() == (2, 3, 6, 8)
+    assert isinstance(a.segmentation, PolyMasks)
+    assert a.segmentation.to_mask().shape == (10, 20)
+    img2 = [i for i in ds.images if i.id == 2][0]
+    seg2 = img2.annotations[0].segmentation
+    assert isinstance(seg2, RLEMasks)
+    assert seg2.to_mask()[:, 0].sum() == 8
+    recs = ds.to_detection_samples()
+    assert len(recs) == 2
+    fn, boxes, labels, crowd = recs[0]
+    np.testing.assert_allclose(boxes, [[2, 3, 6, 8]])
+    assert labels[0] == 2  # category 7 → contiguous label 2
