@@ -1,0 +1,71 @@
+"""TFRecord file IO.
+
+Reference: utils/tf/TFRecordWriter.scala + utils/tf/TFRecordIterator
+(the reference's TFRecord input/output used by the TensorFlow interop
+and SeqFile-style dataset paths).  Framing + CRC run in the native C++
+extension (bigdl_tpu.native) when built; pure-Python otherwise.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterable, Iterator, List, Optional
+
+import numpy as np
+
+from bigdl_tpu import native
+from bigdl_tpu.dataset.dataset import LocalDataSet, Sample
+
+__all__ = ["TFRecordWriter", "read_tfrecords", "tfrecord_dataset",
+           "write_tfrecords"]
+
+
+class TFRecordWriter:
+    """Append framed records to a file (reference TFRecordWriter.scala)."""
+
+    def __init__(self, path: str):
+        self._f = open(path, "wb")
+
+    def write(self, payload: bytes) -> None:
+        self._f.write(native.tfrecord_frame(payload))
+
+    def flush(self) -> None:
+        self._f.flush()
+
+    def close(self) -> None:
+        self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def write_tfrecords(path: str, payloads: Iterable[bytes]) -> int:
+    n = 0
+    with TFRecordWriter(path) as w:
+        for p in payloads:
+            w.write(p)
+            n += 1
+    return n
+
+
+def read_tfrecords(path: str, verify_crc: bool = True) -> List[bytes]:
+    """All record payloads of one file (reference TFRecordIterator)."""
+    with open(path, "rb") as f:
+        buf = f.read()
+    return [buf[o:o + l] for o, l in native.tfrecord_scan(buf, verify_crc)]
+
+
+def tfrecord_dataset(paths, decode=None, shuffle: bool = True,
+                     verify_crc: bool = True) -> LocalDataSet:
+    """DataSet over TFRecord files; ``decode(payload) -> Sample``
+    defaults to raw-bytes features."""
+    if isinstance(paths, (str, os.PathLike)):
+        paths = [paths]
+    samples = []
+    for p in paths:
+        for payload in read_tfrecords(str(p), verify_crc):
+            samples.append(decode(payload) if decode else Sample(payload))
+    return LocalDataSet(samples, shuffle=shuffle)

@@ -1,0 +1,259 @@
+"""Native (C++) runtime kernels: CRC32C, int8 quantization, TFRecord
+framing.
+
+Reference parity: the BigDL-core native submodule — netty/Crc32c.java,
+the BigQuant int8 library (nn/quantized/Desc.scala call sites), and the
+TFRecord framing hot loops (utils/tf/TFRecordWriter.scala,
+visualization/tensorboard/RecordWriter.scala).
+
+Build model: sources under ``src/`` compile to one shared library with
+g++ on first import (cached next to the sources, keyed by source mtime);
+every entry point has a pure-numpy fallback so the package works without
+a toolchain.  Compute-path kernels stay in XLA/Pallas — this library is
+the *host runtime* tranche only.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import sys
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+__all__ = [
+    "available", "lib", "crc32c", "masked_crc32c",
+    "quantize_rows", "dequantize_rows", "mix_precision_gemm",
+    "tfrecord_frame", "tfrecord_scan",
+]
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "src")
+_LIB_PATH = os.path.join(_HERE, "libbigdl_native.so")
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _sources():
+    return sorted(os.path.join(_SRC, f) for f in os.listdir(_SRC)
+                  if f.endswith(".cc"))
+
+
+def _needs_build() -> bool:
+    if not os.path.exists(_LIB_PATH):
+        return True
+    lib_m = os.path.getmtime(_LIB_PATH)
+    return any(os.path.getmtime(s) > lib_m for s in _sources())
+
+
+def _build() -> bool:
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+           "-o", _LIB_PATH] + _sources()
+    try:
+        res = subprocess.run(cmd, capture_output=True, timeout=120)
+        if res.returncode != 0:
+            sys.stderr.write("bigdl_tpu.native build failed:\n"
+                             + res.stderr.decode()[:2000] + "\n")
+            return False
+        return True
+    except (OSError, subprocess.TimeoutExpired) as e:
+        sys.stderr.write(f"bigdl_tpu.native build unavailable: {e}\n")
+        return False
+
+
+def lib() -> Optional[ctypes.CDLL]:
+    """The loaded native library, building it on first use; None if the
+    toolchain is unavailable (callers fall back to numpy)."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if _needs_build() and not _build():
+            return None
+        try:
+            l = ctypes.CDLL(_LIB_PATH)
+        except OSError as e:
+            sys.stderr.write(f"bigdl_tpu.native load failed: {e}\n")
+            return None
+        l.bigdl_crc32c.restype = ctypes.c_uint32
+        l.bigdl_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_size_t,
+                                   ctypes.c_uint32]
+        l.bigdl_quantize_rows.argtypes = [
+            ctypes.POINTER(ctypes.c_float), ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int8), ctypes.POINTER(ctypes.c_float)]
+        l.bigdl_dequantize_rows.argtypes = [
+            ctypes.POINTER(ctypes.c_int8), ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float)]
+        l.bigdl_mix_precision_gemm.argtypes = [
+            ctypes.POINTER(ctypes.c_float), ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int8), ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int, ctypes.POINTER(ctypes.c_float)]
+        l.bigdl_tfrecord_frame.restype = ctypes.c_size_t
+        l.bigdl_tfrecord_frame.argtypes = [ctypes.c_char_p,
+                                           ctypes.c_uint64,
+                                           ctypes.c_char_p]
+        l.bigdl_tfrecord_scan.restype = ctypes.c_longlong
+        l.bigdl_tfrecord_scan.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.c_longlong, ctypes.c_int]
+        _lib = l
+        return _lib
+
+
+def available() -> bool:
+    return lib() is not None
+
+
+# --------------------------------------------------------------------------
+# crc32c
+# --------------------------------------------------------------------------
+
+def crc32c(data: bytes, crc: int = 0) -> int:
+    l = lib()
+    if l is None:
+        from bigdl_tpu.visualization.crc32c import crc32c as py_crc
+        return py_crc(data, crc)
+    return int(l.bigdl_crc32c(data, len(data), crc))
+
+
+_MASK_DELTA = 0xA282EAD8
+
+
+def masked_crc32c(data: bytes) -> int:
+    crc = crc32c(data)
+    return (((crc >> 15) | (crc << 17)) + _MASK_DELTA) & 0xFFFFFFFF
+
+
+# --------------------------------------------------------------------------
+# int8 quantization (BigQuant analog)
+# --------------------------------------------------------------------------
+
+def _round_half_away(x: np.ndarray) -> np.ndarray:
+    """Round half away from zero — matches the C++ kernels' std::lround
+    so quantized bytes are identical with or without the toolchain
+    (np.rint would round ties to even)."""
+    return np.trunc(x + np.copysign(0.5, x))
+
+def quantize_rows(w: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Per-row symmetric int8 quantization of a (rows, cols) float32
+    matrix → (int8 matrix, per-row float scales)."""
+    w = np.ascontiguousarray(w, np.float32)
+    rows, cols = w.shape
+    q = np.empty((rows, cols), np.int8)
+    scales = np.empty((rows,), np.float32)
+    l = lib()
+    if l is None:
+        mx = np.abs(w).max(axis=1)
+        scales[:] = np.where(mx > 0, mx / 127.0, 1.0)
+        q[:] = np.clip(_round_half_away(w / scales[:, None]), -127, 127)
+        return q, scales
+    l.bigdl_quantize_rows(
+        w.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), rows, cols,
+        q.ctypes.data_as(ctypes.POINTER(ctypes.c_int8)),
+        scales.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+    return q, scales
+
+
+def dequantize_rows(q: np.ndarray, scales: np.ndarray) -> np.ndarray:
+    q = np.ascontiguousarray(q, np.int8)
+    scales = np.ascontiguousarray(scales, np.float32)
+    rows, cols = q.shape
+    l = lib()
+    if l is None:
+        return q.astype(np.float32) * scales[:, None]
+    out = np.empty((rows, cols), np.float32)
+    l.bigdl_dequantize_rows(
+        q.ctypes.data_as(ctypes.POINTER(ctypes.c_int8)), rows, cols,
+        scales.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+    return out
+
+
+def mix_precision_gemm(x: np.ndarray, wq: np.ndarray,
+                       wscales: np.ndarray) -> np.ndarray:
+    """(m, k) float × (n, k) int8ᵀ → (m, n) float with on-the-fly
+    per-row activation quantization (≙ BigQuant.MixPrecisionGEMM)."""
+    x = np.ascontiguousarray(x, np.float32)
+    wq = np.ascontiguousarray(wq, np.int8)
+    wscales = np.ascontiguousarray(wscales, np.float32)
+    m, k = x.shape
+    n = wq.shape[0]
+    l = lib()
+    if l is None:
+        mx = np.abs(x).max(axis=1)
+        xs = np.where(mx > 0, mx / 127.0, 1.0)
+        xq = np.clip(_round_half_away(x / xs[:, None]),
+                     -127, 127).astype(np.int32)
+        acc = xq @ wq.astype(np.int32).T
+        return acc.astype(np.float32) * xs[:, None] * wscales[None, :]
+    out = np.empty((m, n), np.float32)
+    l.bigdl_mix_precision_gemm(
+        x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), m, k,
+        wq.ctypes.data_as(ctypes.POINTER(ctypes.c_int8)),
+        wscales.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), n,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+    return out
+
+
+# --------------------------------------------------------------------------
+# TFRecord framing
+# --------------------------------------------------------------------------
+
+def tfrecord_frame(payload: bytes) -> bytes:
+    """One framed TFRecord: [len u64][masked crc][payload][masked crc]."""
+    l = lib()
+    if l is None:
+        import struct
+        header = struct.pack("<Q", len(payload))
+        return (header
+                + struct.pack("<I", masked_crc32c(header))
+                + payload
+                + struct.pack("<I", masked_crc32c(payload)))
+    out = ctypes.create_string_buffer(16 + len(payload))
+    n = l.bigdl_tfrecord_frame(payload, len(payload), out)
+    return out.raw[:n]
+
+
+def tfrecord_scan(buf: bytes, verify_crc: bool = True):
+    """All payload (offset, length) spans in a framed buffer.
+    Raises ValueError on CRC mismatch."""
+    l = lib()
+    if l is None:
+        return _py_scan(buf, verify_crc)
+    cap = max(len(buf) // 16 + 1, 16)
+    offsets = (ctypes.c_uint64 * cap)()
+    lengths = (ctypes.c_uint64 * cap)()
+    n = l.bigdl_tfrecord_scan(buf, len(buf), offsets, lengths, cap,
+                              1 if verify_crc else 0)
+    if n < 0:
+        raise ValueError(f"TFRecord CRC/framing error at byte {-n - 1}")
+    return [(int(offsets[i]), int(lengths[i])) for i in range(n)]
+
+
+def _py_scan(buf: bytes, verify_crc: bool):
+    import struct
+    spans = []
+    pos = 0
+    while pos + 12 <= len(buf):
+        (length,) = struct.unpack_from("<Q", buf, pos)
+        if pos + 16 + length > len(buf):
+            break
+        if verify_crc:
+            (lcrc,) = struct.unpack_from("<I", buf, pos + 8)
+            if masked_crc32c(buf[pos:pos + 8]) != lcrc:
+                raise ValueError(f"TFRecord CRC error at byte {pos}")
+            (dcrc,) = struct.unpack_from("<I", buf, pos + 12 + length)
+            if masked_crc32c(buf[pos + 12:pos + 12 + length]) != dcrc:
+                raise ValueError(f"TFRecord CRC error at byte {pos}")
+        spans.append((pos + 12, length))
+        pos += 16 + length
+    return spans

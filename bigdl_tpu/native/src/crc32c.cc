@@ -1,0 +1,61 @@
+// CRC32C (Castagnoli) — native kernel behind bigdl_tpu.visualization
+// and the TFRecord framing.
+//
+// Reference parity: spark/dl/src/main/java/netty/Crc32c.java (the
+// reference ships this as JVM code consumed by RecordWriter /
+// TFRecordWriter); here it is the slice-by-8 table algorithm in C++,
+// ~20x the pure-Python fallback.
+
+#include <cstddef>
+#include <cstdint>
+
+namespace {
+
+struct Tables {
+  uint32_t t[8][256];
+  Tables() {
+    const uint32_t poly = 0x82F63B78u;  // reflected 0x1EDC6F41
+    for (uint32_t i = 0; i < 256; ++i) {
+      uint32_t crc = i;
+      for (int j = 0; j < 8; ++j)
+        crc = (crc >> 1) ^ ((crc & 1) ? poly : 0);
+      t[0][i] = crc;
+    }
+    for (uint32_t i = 0; i < 256; ++i)
+      for (int k = 1; k < 8; ++k)
+        t[k][i] = (t[k - 1][i] >> 8) ^ t[0][t[k - 1][i] & 0xFF];
+  }
+};
+
+// function-local static: C++11 guarantees thread-safe one-time init
+// (ctypes calls release the GIL, so first use may be concurrent)
+const Tables& tables() {
+  static const Tables kTables;
+  return kTables;
+}
+
+}  // namespace
+
+extern "C" {
+
+uint32_t bigdl_crc32c(const uint8_t* data, size_t n, uint32_t crc) {
+  const auto& T = tables().t;
+  crc ^= 0xFFFFFFFFu;
+  // slice-by-8
+  while (n >= 8) {
+    uint32_t lo = crc ^ (static_cast<uint32_t>(data[0]) |
+                         (static_cast<uint32_t>(data[1]) << 8) |
+                         (static_cast<uint32_t>(data[2]) << 16) |
+                         (static_cast<uint32_t>(data[3]) << 24));
+    crc = T[7][lo & 0xFF] ^ T[6][(lo >> 8) & 0xFF] ^
+          T[5][(lo >> 16) & 0xFF] ^ T[4][lo >> 24] ^
+          T[3][data[4]] ^ T[2][data[5]] ^
+          T[1][data[6]] ^ T[0][data[7]];
+    data += 8;
+    n -= 8;
+  }
+  while (n--) crc = T[0][(crc ^ *data++) & 0xFF] ^ (crc >> 8);
+  return crc ^ 0xFFFFFFFFu;
+}
+
+}  // extern "C"

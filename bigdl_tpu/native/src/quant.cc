@@ -1,0 +1,91 @@
+// Int8 quantization kernels — the BigQuant analog.
+//
+// Reference parity: the BigQuant native library
+// (com.intel.analytics.bigdl.bigquant.BigQuant: ConvKernelLoadFromModel,
+// FCKernelLoadFromModel, MixPrecisionGEMM — call sites in
+// nn/quantized/Desc.scala:125-170).  On TPU the int8 matmul itself runs
+// through XLA (bigdl_tpu/nn/quantized.py); these host kernels cover the
+// model-load path (per-output-channel weight quantization) and a CPU
+// reference GEMM used by host-side serving and as a numeric oracle.
+
+#include <cmath>
+#include <cstddef>
+#include <cstdint>
+
+extern "C" {
+
+// Per-row symmetric int8 quantization (row-major weight (rows, cols)):
+// scale[r] = max(|w[r,:]|) / 127; q = round(w / scale).
+void bigdl_quantize_rows(const float* w, int rows, int cols,
+                         int8_t* q, float* scales) {
+  for (int r = 0; r < rows; ++r) {
+    const float* src = w + static_cast<size_t>(r) * cols;
+    float mx = 0.f;
+    for (int c = 0; c < cols; ++c) {
+      float a = std::fabs(src[c]);
+      if (a > mx) mx = a;
+    }
+    float scale = mx > 0.f ? mx / 127.f : 1.f;
+    scales[r] = scale;
+    float inv = 1.f / scale;
+    int8_t* dst = q + static_cast<size_t>(r) * cols;
+    for (int c = 0; c < cols; ++c) {
+      float v = src[c] * inv;
+      int iv = static_cast<int>(std::lround(v));
+      if (iv > 127) iv = 127;
+      if (iv < -127) iv = -127;
+      dst[c] = static_cast<int8_t>(iv);
+    }
+  }
+}
+
+void bigdl_dequantize_rows(const int8_t* q, int rows, int cols,
+                           const float* scales, float* out) {
+  for (int r = 0; r < rows; ++r) {
+    const int8_t* src = q + static_cast<size_t>(r) * cols;
+    float* dst = out + static_cast<size_t>(r) * cols;
+    float s = scales[r];
+    for (int c = 0; c < cols; ++c) dst[c] = src[c] * s;
+  }
+}
+
+// Mixed-precision GEMM (≙ BigQuant.MixPrecisionGEMM): float activations
+// quantized per-row on the fly, int8xint8 -> int32 accumulate, rescaled
+// to float.  out(m, n) = x(m, k) * w(n, k)^T ; w pre-quantized per row.
+void bigdl_mix_precision_gemm(const float* x, int m, int k,
+                              const int8_t* wq, const float* wscales,
+                              int n, float* out) {
+  for (int i = 0; i < m; ++i) {
+    const float* xi = x + static_cast<size_t>(i) * k;
+    float mx = 0.f;
+    for (int c = 0; c < k; ++c) {
+      float a = std::fabs(xi[c]);
+      if (a > mx) mx = a;
+    }
+    float xscale = mx > 0.f ? mx / 127.f : 1.f;
+    float inv = 1.f / xscale;
+    // quantize the activation row into a stack buffer (k small enough
+    // for serving-time layers; heap for big k)
+    int8_t stackbuf[4096];
+    int8_t* xq = stackbuf;
+    bool heap = k > 4096;
+    if (heap) xq = new int8_t[k];
+    for (int c = 0; c < k; ++c) {
+      int iv = static_cast<int>(std::lround(xi[c] * inv));
+      if (iv > 127) iv = 127;
+      if (iv < -127) iv = -127;
+      xq[c] = static_cast<int8_t>(iv);
+    }
+    float* oi = out + static_cast<size_t>(i) * n;
+    for (int j = 0; j < n; ++j) {
+      const int8_t* wj = wq + static_cast<size_t>(j) * k;
+      int32_t acc = 0;
+      for (int c = 0; c < k; ++c)
+        acc += static_cast<int32_t>(xq[c]) * static_cast<int32_t>(wj[c]);
+      oi[j] = static_cast<float>(acc) * xscale * wscales[j];
+    }
+    if (heap) delete[] xq;
+  }
+}
+
+}  // extern "C"

@@ -1,0 +1,70 @@
+// TFRecord-framing reader/writer core.
+//
+// Reference parity: utils/tf/TFRecordWriter.scala +
+// visualization/tensorboard/RecordWriter.scala (length-prefixed records
+// with masked CRC32C over length and payload), whose hot CRC loop the
+// reference delegates to netty's JVM Crc32c.  Here the framing and CRC
+// run natively; file IO stays on the Python side (mmap'd byte buffers
+// in, assembled byte buffers out) so the Python layer owns file
+// lifecycle and error handling.
+
+#include <cstddef>
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+uint32_t bigdl_crc32c(const uint8_t* data, size_t n, uint32_t crc);
+
+static inline uint32_t mask_crc(uint32_t crc) {
+  const uint32_t kMaskDelta = 0xA282EAD8u;
+  return ((crc >> 15) | (crc << 17)) + kMaskDelta;
+}
+
+// Frame one record into out (must hold 8 + 4 + len + 4 bytes):
+// [len u64le][masked crc32c(len) u32le][payload][masked crc32c(payload)]
+// Returns bytes written.
+size_t bigdl_tfrecord_frame(const uint8_t* payload, uint64_t len,
+                            uint8_t* out) {
+  std::memcpy(out, &len, 8);
+  uint32_t lcrc = mask_crc(bigdl_crc32c(out, 8, 0));
+  std::memcpy(out + 8, &lcrc, 4);
+  std::memcpy(out + 12, payload, len);
+  uint32_t dcrc = mask_crc(bigdl_crc32c(payload, len, 0));
+  std::memcpy(out + 12 + len, &dcrc, 4);
+  return 16 + len;
+}
+
+// Scan framed records in buf: fills offsets/lengths (payload spans)
+// up to max_records.  Returns the number of records found, or
+// -(byte position + 1) on a CRC/framing error.
+long long bigdl_tfrecord_scan(const uint8_t* buf, size_t n,
+                              uint64_t* offsets, uint64_t* lengths,
+                              long long max_records, int verify_crc) {
+  size_t pos = 0;
+  long long count = 0;
+  while (pos + 16 <= n && count < max_records) {
+    uint64_t len;
+    std::memcpy(&len, buf + pos, 8);
+    // overflow-safe truncation check: n - pos - 16 cannot underflow
+    // after the loop condition above
+    if (len > n - pos - 16) break;  // truncated tail
+    if (verify_crc) {
+      uint32_t lcrc;
+      std::memcpy(&lcrc, buf + pos + 8, 4);
+      if (mask_crc(bigdl_crc32c(buf + pos, 8, 0)) != lcrc)
+        return -static_cast<long long>(pos) - 1;
+      uint32_t dcrc;
+      std::memcpy(&dcrc, buf + pos + 12 + len, 4);
+      if (mask_crc(bigdl_crc32c(buf + pos + 12, len, 0)) != dcrc)
+        return -static_cast<long long>(pos) - 1;
+    }
+    offsets[count] = pos + 12;
+    lengths[count] = len;
+    ++count;
+    pos += 16 + len;
+  }
+  return count;
+}
+
+}  // extern "C"

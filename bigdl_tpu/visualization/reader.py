@@ -6,7 +6,8 @@ from __future__ import annotations
 import struct
 from typing import List, Tuple
 
-from bigdl_tpu.visualization.crc32c import masked_crc32c
+# native C++ CRC when built, pure-Python fallback otherwise
+from bigdl_tpu.native import masked_crc32c
 from bigdl_tpu.visualization.proto import Event, decode_event
 
 __all__ = ["FileReader"]

@@ -18,7 +18,8 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
-from bigdl_tpu.visualization.crc32c import masked_crc32c
+# native C++ CRC when built, pure-Python fallback otherwise
+from bigdl_tpu.native import masked_crc32c
 from bigdl_tpu.visualization.proto import (
     Event, ScalarValue, encode_event, make_histogram,
 )
