@@ -1,6 +1,22 @@
-"""Model import from other frameworks (≙ reference utils/{caffe,tf},
-TorchFile.scala — re-targeted at the formats that matter today)."""
+"""Model interop (≙ reference utils/{caffe,tf,serializer}, nn/onnx,
+TorchFile.scala).
 
+* Caffe: prototxt+caffemodel import, caffemodel export.
+* TensorFlow: GraphDef import (op loaders + fusions) and export.
+* ONNX: the reference's three op shims (Gemm, Reshape, Shape).
+* PyTorch: state-dict import (torch_import).
+All binary protobuf handling goes through the generic wire codec in
+protowire.py — no generated proto classes.
+"""
+
+from bigdl_tpu.interop.caffe import (  # noqa: F401
+    load_caffe, load_caffe_weights, parse_prototxt, read_caffemodel,
+    register_caffe_converter, save_caffemodel,
+)
+from bigdl_tpu.interop.onnx import Gemm, OnnxReshape, OnnxShape  # noqa: F401
+from bigdl_tpu.interop.tensorflow import (  # noqa: F401
+    load_tf_graph, parse_graphdef, register_tf_converter, save_tf_graph,
+)
 from bigdl_tpu.interop.torch_import import (  # noqa: F401
     load_torch_state_dict, register_torch_converter,
 )

@@ -1,0 +1,75 @@
+"""Minimal ONNX op shims.
+
+Reference: nn/onnx/{Gemm,Reshape,Shape}.scala (235 LoC — the reference
+exposes exactly these three ops to its Python ONNX bridge).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.core.module import Module, Parameter
+
+__all__ = ["Gemm", "OnnxReshape", "OnnxShape"]
+
+
+class Gemm(Module):
+    """Y = alpha * A' * B' + beta * C (reference nn/onnx/Gemm.scala)."""
+
+    def __init__(self, alpha: float = 1.0, beta: float = 1.0,
+                 trans_a: bool = False, trans_b: bool = False,
+                 matrix_b=None, matrix_c=None):
+        super().__init__()
+        self.alpha, self.beta = float(alpha), float(beta)
+        self.trans_a, self.trans_b = trans_a, trans_b
+        if matrix_b is not None:
+            self.matrix_b = Parameter(matrix_b)
+        else:
+            self.matrix_b = None
+        if matrix_c is not None:
+            self.matrix_c = Parameter(matrix_c)
+        else:
+            self.matrix_c = None
+
+    def forward(self, inputs):
+        if isinstance(inputs, (tuple, list)):
+            a = inputs[0]
+            b = inputs[1] if len(inputs) > 1 else self.matrix_b
+            c = inputs[2] if len(inputs) > 2 else self.matrix_c
+        else:
+            a, b, c = inputs, self.matrix_b, self.matrix_c
+        if self.trans_a:
+            a = a.T
+        if self.trans_b:
+            b = b.T
+        y = self.alpha * (a @ b)
+        if c is not None:
+            y = y + self.beta * c
+        return y
+
+
+class OnnxReshape(Module):
+    """ONNX Reshape with 0 = copy-input-dim semantics
+    (reference nn/onnx/Reshape.scala)."""
+
+    def __init__(self, shape=None):
+        super().__init__()
+        self.shape = tuple(int(s) for s in shape) if shape is not None \
+            else None
+
+    def forward(self, inputs):
+        if isinstance(inputs, (tuple, list)):
+            x, shape = inputs[0], [int(s) for s in np.asarray(inputs[1])]
+        else:
+            x, shape = inputs, list(self.shape)
+        shape = [x.shape[i] if s == 0 else s for i, s in enumerate(shape)]
+        return x.reshape(shape)
+
+
+class OnnxShape(Module):
+    """Returns the input's shape as an int64 tensor
+    (reference nn/onnx/Shape.scala)."""
+
+    def forward(self, x):
+        return jnp.asarray(x.shape, jnp.int64)

@@ -1,0 +1,145 @@
+"""Generic protobuf wire-format codec (no generated classes).
+
+Reference: the reference ships ~120k LoC of protoc-generated Java
+(caffe/Caffe.java, serialization/Bigdl.java, tensorflow framework
+protos) to read/write Caffe, TensorFlow and BigDL model files.  Here the
+same formats are handled with a ~200-line generic wire codec: messages
+decode to ``{field_number: [values]}`` dicts and encode from
+``[(field_number, wire_type, value)]`` lists; the schema knowledge
+(which field number means what) lives in the importers.
+
+Wire types: 0 varint, 1 fixed64, 2 length-delimited, 5 fixed32.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Dict, Iterable, List, Tuple, Union
+
+import numpy as np
+
+__all__ = [
+    "decode_message", "encode_message", "varint", "zigzag",
+    "as_string", "as_floats", "as_ints", "Field",
+    "VARINT", "FIXED64", "BYTES", "FIXED32",
+]
+
+VARINT, FIXED64, BYTES, FIXED32 = 0, 1, 2, 5
+
+
+def _read_varint(buf: bytes, pos: int) -> Tuple[int, int]:
+    result = 0
+    shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+        if shift > 70:
+            raise ValueError("malformed varint")
+
+
+def decode_message(buf: bytes) -> Dict[int, list]:
+    """Decode one message into {field_number: [raw values]}.
+    Varint fields → int; fixed32/64 → raw 4/8 bytes; length-delimited →
+    bytes (caller interprets as sub-message/string/packed array)."""
+    out: Dict[int, list] = {}
+    pos = 0
+    n = len(buf)
+    while pos < n:
+        key, pos = _read_varint(buf, pos)
+        field, wire = key >> 3, key & 7
+        if wire == VARINT:
+            val, pos = _read_varint(buf, pos)
+        elif wire == FIXED64:
+            val = buf[pos:pos + 8]
+            pos += 8
+        elif wire == BYTES:
+            ln, pos = _read_varint(buf, pos)
+            val = buf[pos:pos + ln]
+            pos += ln
+        elif wire == FIXED32:
+            val = buf[pos:pos + 4]
+            pos += 4
+        elif wire in (3, 4):  # group start/end (deprecated) — skip
+            continue
+        else:
+            raise ValueError(f"unknown wire type {wire} at {pos}")
+        out.setdefault(field, []).append(val)
+    return out
+
+
+def varint(x: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = x & 0x7F
+        x >>= 7
+        if x:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def zigzag(x: int) -> int:
+    return (x << 1) ^ (x >> 63)
+
+
+Field = Tuple[int, int, Union[int, bytes]]
+
+
+def encode_message(fields: Iterable[Field]) -> bytes:
+    """[(field_number, wire_type, value)] → bytes.  wire_type BYTES
+    values must already be encoded (sub-message bytes / utf-8 / packed)."""
+    out = bytearray()
+    for num, wire, val in fields:
+        out += varint((num << 3) | wire)
+        if wire == VARINT:
+            out += varint(int(val))
+        elif wire == BYTES:
+            out += varint(len(val))
+            out += val
+        elif wire == FIXED32:
+            out += (val if isinstance(val, bytes)
+                    else struct.pack("<f", val))
+        elif wire == FIXED64:
+            out += (val if isinstance(val, bytes)
+                    else struct.pack("<d", val))
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+    return bytes(out)
+
+
+# ---- interpretation helpers ----------------------------------------------
+
+def as_string(v: bytes) -> str:
+    return v.decode("utf-8")
+
+
+def as_floats(values: list) -> np.ndarray:
+    """Repeated float field: either packed (one bytes blob) or a list of
+    fixed32 values."""
+    if not values:
+        return np.zeros(0, np.float32)
+    if len(values) == 1 and isinstance(values[0], bytes) \
+            and len(values[0]) % 4 == 0:
+        # packed (N floats in one blob) — also covers a single fixed32
+        return np.frombuffer(values[0], "<f4").copy()
+    return np.asarray([struct.unpack("<f", v)[0] for v in values],
+                      np.float32)
+
+
+def as_ints(values: list) -> List[int]:
+    """Repeated varint field: packed blob or list of ints."""
+    out: List[int] = []
+    for v in values:
+        if isinstance(v, bytes):
+            pos = 0
+            while pos < len(v):
+                x, pos = _read_varint(v, pos)
+                out.append(x)
+        else:
+            out.append(int(v))
+    return out

@@ -1,0 +1,655 @@
+"""TensorFlow GraphDef import/export.
+
+Reference: utils/tf/TensorflowLoader.scala:43-358 (parse GraphDef,
+pattern-match node sub-graphs to modules via ~160 op loaders in
+utils/tf/loaders/), utils/tf/TensorflowSaver.scala (BigDL → GraphDef
+export).  Protos are read/written with the generic wire codec
+(bigdl_tpu/interop/protowire.py) instead of generated classes.
+
+Import supports the inference-graph op set (Const/Placeholder/Conv2D/
+DepthwiseConv2dNative/BiasAdd/MatMul/Relu(6)/Elu/Sigmoid/Tanh/Softmax/
+MaxPool/AvgPool/FusedBatchNorm(V2,V3)/LRN/Reshape/Squeeze/Pad/ConcatV2/
+Mean/Add(V2)/Sub/Mul/RealDiv/Maximum/Minimum/Identity/NoOp) with the
+reference's key fusions: Conv2D+BiasAdd → one conv, MatMul+BiasAdd →
+one Linear.  TF graphs are NHWC by default — already the TPU-native
+layout, no transposition needed.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.core.module import Module, Parameter
+from bigdl_tpu.interop.protowire import (BYTES, FIXED32, VARINT, as_floats,
+                                         as_ints, as_string,
+                                         decode_message, encode_message,
+                                         varint)
+
+__all__ = ["load_tf_graph", "parse_graphdef", "save_tf_graph",
+           "register_tf_converter"]
+
+# NodeDef fields
+_N_NAME, _N_OP, _N_INPUT, _N_DEVICE, _N_ATTR = 1, 2, 3, 4, 5
+# attr map entry
+_MAP_KEY, _MAP_VALUE = 1, 2
+# AttrValue
+_A_LIST, _A_S, _A_I, _A_F, _A_B, _A_TYPE, _A_SHAPE, _A_TENSOR = \
+    1, 2, 3, 4, 5, 6, 7, 8
+# TensorProto
+_T_DTYPE, _T_SHAPE, _T_CONTENT, _T_HALF, _T_FLOAT, _T_DOUBLE, _T_INT = \
+    1, 2, 4, 13, 5, 6, 7
+# DataType enum values
+_DT_FLOAT, _DT_DOUBLE, _DT_INT32, _DT_INT64 = 1, 2, 3, 9
+
+_DTYPES = {_DT_FLOAT: np.float32, _DT_DOUBLE: np.float64,
+           _DT_INT32: np.int32, _DT_INT64: np.int64}
+
+
+class TFNode:
+    __slots__ = ("name", "op", "inputs", "attrs")
+
+    def __init__(self, name, op, inputs, attrs):
+        self.name = name
+        self.op = op
+        self.inputs = inputs
+        self.attrs = attrs
+
+    def __repr__(self):
+        return f"TFNode({self.op}:{self.name})"
+
+
+def _decode_attr(raw: bytes):
+    msg = decode_message(raw)
+    if _A_S in msg:
+        return msg[_A_S][0].decode("utf-8", "replace")
+    if _A_I in msg:
+        v = msg[_A_I][0]
+        return v - (1 << 64) if v >= (1 << 63) else v
+    if _A_F in msg:
+        return struct.unpack("<f", msg[_A_F][0])[0]
+    if _A_B in msg:
+        return bool(msg[_A_B][0])
+    if _A_TYPE in msg:
+        return int(msg[_A_TYPE][0])
+    if _A_TENSOR in msg:
+        return _decode_tensor(msg[_A_TENSOR][0])
+    if _A_LIST in msg:
+        lst = decode_message(msg[_A_LIST][0])
+        if 3 in lst:   # ints
+            return [x - (1 << 64) if x >= (1 << 63) else x
+                    for x in as_ints(lst[3])]
+        if 4 in lst:   # floats
+            return list(as_floats(lst[4]))
+        if 2 in lst:   # strings
+            return [s.decode() for s in lst[2]]
+        return []
+    if _A_SHAPE in msg:
+        return _decode_shape(msg[_A_SHAPE][0])
+    return None
+
+
+def _decode_shape(raw: bytes) -> List[int]:
+    msg = decode_message(raw)
+    dims = []
+    for d in msg.get(2, []):
+        dm = decode_message(d)
+        v = int(dm.get(1, [0])[0]) if 1 in dm else 0
+        dims.append(v - (1 << 64) if v >= (1 << 63) else v)
+    return dims
+
+
+def _decode_tensor(raw: bytes) -> np.ndarray:
+    msg = decode_message(raw)
+    dt = int(msg.get(_T_DTYPE, [_DT_FLOAT])[0])
+    np_dt = _DTYPES.get(dt, np.float32)
+    shape = _decode_shape(msg[_T_SHAPE][0]) if _T_SHAPE in msg else []
+    if _T_CONTENT in msg and msg[_T_CONTENT][0]:
+        arr = np.frombuffer(msg[_T_CONTENT][0], np_dt).copy()
+    elif _T_FLOAT in msg:
+        arr = as_floats(msg[_T_FLOAT])
+    elif _T_INT in msg:
+        arr = np.asarray(as_ints(msg[_T_INT]), np_dt)
+    else:
+        arr = np.zeros(0, np_dt)
+    n = int(np.prod(shape)) if shape else arr.size
+    if arr.size == 1 and n > 1:
+        arr = np.full(n, arr[0], np_dt)  # splat scalar
+    return arr.reshape(shape) if shape else arr
+
+
+def parse_graphdef(data: bytes) -> List[TFNode]:
+    """GraphDef bytes → list of TFNodes."""
+    g = decode_message(data)
+    nodes = []
+    for raw in g.get(1, []):
+        msg = decode_message(raw)
+        attrs = {}
+        for entry in msg.get(_N_ATTR, []):
+            e = decode_message(entry)
+            key = as_string(e[_MAP_KEY][0])
+            attrs[key] = _decode_attr(e[_MAP_VALUE][0])
+        nodes.append(TFNode(
+            as_string(msg[_N_NAME][0]), as_string(msg[_N_OP][0]),
+            [as_string(i) for i in msg.get(_N_INPUT, [])], attrs))
+    return nodes
+
+
+# --------------------------------------------------------------------------
+# conversion to modules
+# --------------------------------------------------------------------------
+
+_TF_CONVERTERS = {}
+
+
+def register_tf_converter(*ops):
+    """Custom op loader hook (≙ utils/tf/loaders registry)."""
+    def deco(fn):
+        for op in ops:
+            _TF_CONVERTERS[op] = fn
+        return fn
+    return deco
+
+
+class _Lambda(Module):
+    def __init__(self, fn, name=""):
+        super().__init__()
+        self._fn = fn
+        if name:
+            self.set_name(name)
+
+    def forward(self, *xs):
+        if len(xs) == 1 and isinstance(xs[0], (tuple, list)):
+            return self._fn(*xs[0])
+        return self._fn(*xs)
+
+
+def _clean(name: str) -> str:
+    name = name.split(":")[0]
+    return name[1:] if name.startswith("^") else name
+
+
+def const_of_nodes(nodes, consts, name: str) -> Optional[np.ndarray]:
+    """Resolve a node reference to a constant, walking Identity chains."""
+    name = _clean(name)
+    n = nodes.get(name)
+    while n is not None and n.op == "Identity":
+        name = _clean(n.inputs[0])
+        n = nodes.get(name)
+    return consts.get(name)
+
+
+def load_tf_graph(path_or_bytes, inputs: Sequence[str],
+                  outputs: Sequence[str]):
+    """GraphDef (file path or bytes) → (Graph model, {name: module}).
+
+    ``inputs``: placeholder node names (become Graph inputs, in order);
+    ``outputs``: node names whose values the Graph returns.
+    (≙ TensorflowLoader.load, TensorflowLoader.scala:43)
+    """
+    if isinstance(path_or_bytes, (bytes, bytearray)):
+        data = bytes(path_or_bytes)
+    else:
+        with open(path_or_bytes, "rb") as f:
+            data = f.read()
+    nodes = {n.name: n for n in parse_graphdef(data)}
+
+    consts: Dict[str, np.ndarray] = {}
+    for n in nodes.values():
+        if n.op == "Const":
+            consts[n.name] = n.attrs.get("value")
+
+    import jax.numpy as jnp
+    from bigdl_tpu.nn import Graph, Input
+    from bigdl_tpu.nn.containers import node_of
+
+    graph_nodes: Dict[str, object] = {}
+    layer_map: Dict[str, Module] = {}
+    input_nodes = []
+    for name in inputs:
+        gn = Input()
+        graph_nodes[name] = gn
+        input_nodes.append(gn)
+
+    def resolve(name: str):
+        name = _clean(name)
+        if name in graph_nodes:
+            return graph_nodes[name]
+        n = nodes.get(name)
+        if n is None:
+            raise ValueError(f"unknown node {name!r}")
+        gn = build(n)
+        graph_nodes[name] = gn
+        return gn
+
+    def data_inputs(n: TFNode):
+        return [i for i in n.inputs if not i.startswith("^")]
+
+    def const_of(name: str) -> Optional[np.ndarray]:
+        return const_of_nodes(nodes, consts, name)
+
+    def build(n: TFNode):
+        conv = _TF_CONVERTERS.get(n.op)
+        if conv is None:
+            raise ValueError(f"no TF converter for op {n.op!r} "
+                             f"(node {n.name!r}); register one with "
+                             f"register_tf_converter")
+        return conv(n, nodes, const_of, resolve, node_of, layer_map)
+
+    # pre-pass: mark BiasAdd whose input is Conv2D/MatMul for fusion —
+    # only when the BiasAdd is the producer's SOLE consumer (another
+    # consumer would otherwise observe post-bias values) and the bias
+    # is a resolvable constant
+    consumers: Dict[str, int] = {}
+    for n in nodes.values():
+        for i in n.inputs:
+            if not i.startswith("^"):
+                consumers[_clean(i)] = consumers.get(_clean(i), 0) + 1
+    fused_into: Dict[str, TFNode] = {}
+    for n in nodes.values():
+        if n.op == "BiasAdd":
+            src = nodes.get(_clean(n.inputs[0]))
+            if (src is not None
+                    and src.op in ("Conv2D", "MatMul",
+                                   "DepthwiseConv2dNative")
+                    and consumers.get(src.name, 0) == 1
+                    and const_of_nodes(nodes, consts, n.inputs[1])
+                    is not None):
+                fused_into[src.name] = n
+
+    # expose fusion info to converters via attribute
+    for src_name, badd in fused_into.items():
+        nodes[src_name].attrs["_fused_bias"] = const_of(badd.inputs[1])
+
+    out_nodes = []
+    for name in outputs:
+        n = nodes.get(_clean(name))
+        if n is not None and n.op == "BiasAdd":
+            src = nodes.get(_clean(n.inputs[0]))
+            if src is not None and src.name in fused_into:
+                out_nodes.append(resolve(src.name))
+                continue
+        out_nodes.append(resolve(name))
+
+    # BiasAdd nodes that were fused: make their name resolve to the conv
+    model = Graph(input_nodes, out_nodes)
+    return model, layer_map
+
+
+def _register_defaults():
+    import jax
+    import jax.numpy as jnp
+    from bigdl_tpu.nn.containers import node_of
+
+    def simple(fn):
+        def cv(n, nodes, const_of, resolve, node_of, layer_map):
+            ins = [resolve(i) for i in n.inputs if not i.startswith("^")]
+            m = _Lambda(fn, n.name)
+            layer_map[n.name] = m
+            return node_of(m, *ins)
+        return cv
+
+    _TF_CONVERTERS.update({
+        "Relu": simple(jax.nn.relu),
+        "Relu6": simple(lambda x: jnp.clip(x, 0, 6)),
+        "Elu": simple(jax.nn.elu),
+        "Sigmoid": simple(jax.nn.sigmoid),
+        "Tanh": simple(jnp.tanh),
+        "Softmax": simple(lambda x: jax.nn.softmax(x, axis=-1)),
+        "Identity": simple(lambda x: x),
+        "NoOp": simple(lambda *x: x[0] if x else None),
+        "Add": simple(jnp.add), "AddV2": simple(jnp.add),
+        "Sub": simple(jnp.subtract), "Mul": simple(jnp.multiply),
+        "RealDiv": simple(jnp.divide),
+        "Maximum": simple(jnp.maximum), "Minimum": simple(jnp.minimum),
+        "Rsqrt": simple(jax.lax.rsqrt), "Sqrt": simple(jnp.sqrt),
+        "Square": simple(jnp.square), "Exp": simple(jnp.exp),
+        "Log": simple(jnp.log), "Neg": simple(jnp.negative),
+        "Abs": simple(jnp.abs),
+    })
+
+    def conv2d(n, nodes, const_of, resolve, node_of, layer_map):
+        w = const_of(n.inputs[1])
+        assert w is not None, f"Conv2D {n.name}: non-const filter"
+        strides = n.attrs.get("strides", [1, 1, 1, 1])
+        padding = n.attrs.get("padding", "SAME")
+        dil = list(n.attrs.get("dilations", [1, 1, 1, 1]))
+        bias = n.attrs.get("_fused_bias")
+        kh, kw, cin, cout = w.shape
+        pad = -1 if padding == "SAME" else 0
+        depthwise = n.op == "DepthwiseConv2dNative"
+        if depthwise:
+            if dil != [1, 1, 1, 1]:
+                raise ValueError(f"{n.name}: dilated depthwise conv "
+                                 f"import not supported")
+            cout = cin * w.shape[3]
+            mod = nn.SpatialConvolution(
+                cin, cout, kw, kh, strides[2], strides[1], pad, pad,
+                n_group=cin, with_bias=bias is not None)
+            # depthwise HWIM → grouped HWIO: (kh, kw, 1, cout)
+            mod.weight = Parameter(w.reshape(kh, kw, 1, cout))
+        elif dil != [1, 1, 1, 1]:
+            if padding == "SAME":
+                # SAME pad for dilated conv: effective kernel size
+                pad_h = ((kh - 1) * dil[1]) // 2
+                pad_w = ((kw - 1) * dil[2]) // 2
+            else:
+                pad_h = pad_w = 0
+            mod = nn.SpatialDilatedConvolution(
+                cin, cout, kw, kh, strides[2], strides[1], pad_w, pad_h,
+                dil[2], dil[1])
+            mod.weight = Parameter(w)
+            # this layer always carries a bias param — zero it when the
+            # graph has no (fused) bias so numerics match exactly
+            mod.bias = Parameter(bias.reshape(-1) if bias is not None
+                                 else np.zeros(cout, np.float32))
+            mod.set_name(n.name)
+            layer_map[n.name] = mod
+            return node_of(mod, resolve(n.inputs[0]))
+        else:
+            mod = nn.SpatialConvolution(
+                cin, cout, kw, kh, strides[2], strides[1], pad, pad,
+                with_bias=bias is not None)
+            mod.weight = Parameter(w)
+        if bias is not None:
+            mod.bias = Parameter(bias.reshape(-1))
+        mod.set_name(n.name)
+        layer_map[n.name] = mod
+        return node_of(mod, resolve(n.inputs[0]))
+
+    _TF_CONVERTERS["Conv2D"] = conv2d
+    _TF_CONVERTERS["DepthwiseConv2dNative"] = conv2d
+
+    def matmul(n, nodes, const_of, resolve, node_of, layer_map):
+        w = const_of(n.inputs[1])
+        assert w is not None, f"MatMul {n.name}: non-const weights"
+        if n.attrs.get("transpose_a", False):
+            raise ValueError(f"MatMul {n.name}: transpose_a=True import "
+                             f"not supported")
+        if n.attrs.get("transpose_b", False):
+            w = w.T
+        bias = n.attrs.get("_fused_bias")
+        mod = nn.Linear(w.shape[0], w.shape[1],
+                        with_bias=bias is not None)
+        mod.weight = Parameter(w.T)  # ours is (out, in)
+        if bias is not None:
+            mod.bias = Parameter(bias.reshape(-1))
+        mod.set_name(n.name)
+        layer_map[n.name] = mod
+        return node_of(mod, resolve(n.inputs[0]))
+
+    _TF_CONVERTERS["MatMul"] = matmul
+
+    def bias_add(n, nodes, const_of, resolve, node_of, layer_map):
+        src = nodes.get(_clean(n.inputs[0]))
+        if src is not None and src.attrs.get("_fused_bias") is not None:
+            return resolve(src.name)  # fused into producer
+        b = const_of(n.inputs[1])
+        if b is None:
+            # non-const bias: plain elementwise add of two graph values
+            m = _Lambda(_jnp.add, n.name)
+            layer_map[n.name] = m
+            return node_of(m, resolve(n.inputs[0]),
+                           resolve(n.inputs[1]))
+        m = _Lambda(lambda x, b=jnp_asarray(b): x + b, n.name)
+        layer_map[n.name] = m
+        return node_of(m, resolve(n.inputs[0]))
+
+    import jax.numpy as _jnp
+
+    def jnp_asarray(x):
+        return _jnp.asarray(x)
+
+    _TF_CONVERTERS["BiasAdd"] = bias_add
+
+    def pool(n, nodes, const_of, resolve, node_of, layer_map):
+        ks = n.attrs.get("ksize", [1, 2, 2, 1])
+        st = n.attrs.get("strides", [1, 2, 2, 1])
+        pad = n.attrs.get("padding", "VALID")
+        cls = (nn.SpatialMaxPooling if n.op == "MaxPool"
+               else nn.SpatialAveragePooling)
+        mod = cls(ks[2], ks[1], st[2], st[1],
+                  -1 if pad == "SAME" else 0, -1 if pad == "SAME" else 0)
+        mod.set_name(n.name)
+        layer_map[n.name] = mod
+        return node_of(mod, resolve(n.inputs[0]))
+
+    _TF_CONVERTERS["MaxPool"] = pool
+    _TF_CONVERTERS["AvgPool"] = pool
+
+    def fused_bn(n, nodes, const_of, resolve, node_of, layer_map):
+        gamma = const_of(n.inputs[1])
+        beta = const_of(n.inputs[2])
+        mean = const_of(n.inputs[3])
+        var = const_of(n.inputs[4])
+        eps = n.attrs.get("epsilon", 1e-3)
+        mod = nn.SpatialBatchNormalization(
+            mean.size, eps=float(eps),
+            init_weight=gamma, init_bias=beta)
+        mod.running_mean = np.asarray(mean, np.float32)
+        mod.running_var = np.asarray(var, np.float32)
+        mod.set_name(n.name)
+        layer_map[n.name] = mod
+        return node_of(mod, resolve(n.inputs[0]))
+
+    _TF_CONVERTERS["FusedBatchNorm"] = fused_bn
+    _TF_CONVERTERS["FusedBatchNormV2"] = fused_bn
+    _TF_CONVERTERS["FusedBatchNormV3"] = fused_bn
+
+    def reshape(n, nodes, const_of, resolve, node_of, layer_map):
+        shape = const_of(n.inputs[1])
+        assert shape is not None, f"Reshape {n.name}: dynamic shape"
+        shape = [int(s) for s in shape.reshape(-1)]
+        # jnp.reshape resolves a single -1 like TF does
+        m = _Lambda(lambda x: x.reshape(shape), n.name)
+        layer_map[n.name] = m
+        return node_of(m, resolve(n.inputs[0]))
+
+    _TF_CONVERTERS["Reshape"] = reshape
+
+    def squeeze(n, nodes, const_of, resolve, node_of, layer_map):
+        dims = n.attrs.get("squeeze_dims", n.attrs.get("axis", []))
+        m = _Lambda(lambda x: _jnp.squeeze(
+            x, axis=tuple(dims) if dims else None), n.name)
+        layer_map[n.name] = m
+        return node_of(m, resolve(n.inputs[0]))
+
+    _TF_CONVERTERS["Squeeze"] = squeeze
+
+    def mean(n, nodes, const_of, resolve, node_of, layer_map):
+        axes = const_of(n.inputs[1])
+        keep = n.attrs.get("keep_dims", n.attrs.get("keepdims", False))
+        ax = tuple(int(a) for a in np.asarray(axes).reshape(-1))
+        m = _Lambda(lambda x: _jnp.mean(x, axis=ax, keepdims=bool(keep)),
+                    n.name)
+        layer_map[n.name] = m
+        return node_of(m, resolve(n.inputs[0]))
+
+    _TF_CONVERTERS["Mean"] = mean
+
+    def pad(n, nodes, const_of, resolve, node_of, layer_map):
+        p = const_of(n.inputs[1])
+        pads = [(int(a), int(b)) for a, b in np.asarray(p)]
+        m = _Lambda(lambda x: _jnp.pad(x, pads), n.name)
+        layer_map[n.name] = m
+        return node_of(m, resolve(n.inputs[0]))
+
+    _TF_CONVERTERS["Pad"] = pad
+
+    def concat(n, nodes, const_of, resolve, node_of, layer_map):
+        data = [i for i in n.inputs if not i.startswith("^")]
+        axis = const_of(data[-1])  # last DATA input is the axis
+        ax = int(np.asarray(axis).reshape(-1)[0])
+        ins = [resolve(i) for i in data[:-1]]
+        m = _Lambda(lambda *xs: _jnp.concatenate(xs, axis=ax), n.name)
+        layer_map[n.name] = m
+        return node_of(m, *ins)
+
+    _TF_CONVERTERS["ConcatV2"] = concat
+
+    def lrn(n, nodes, const_of, resolve, node_of, layer_map):
+        r = int(n.attrs.get("depth_radius", 5))
+        mod = nn.SpatialCrossMapLRN(
+            2 * r + 1, float(n.attrs.get("alpha", 1.0)) * (2 * r + 1),
+            float(n.attrs.get("beta", 0.5)),
+            float(n.attrs.get("bias", 1.0)))
+        mod.set_name(n.name)
+        layer_map[n.name] = mod
+        return node_of(mod, resolve(n.inputs[0]))
+
+    _TF_CONVERTERS["LRN"] = lrn
+
+    def const(n, nodes, const_of, resolve, node_of, layer_map):
+        v = n.attrs.get("value")
+        m = _Lambda(lambda *a, v=_jnp.asarray(v): v, n.name)
+        layer_map[n.name] = m
+        return node_of(m)
+
+    _TF_CONVERTERS["Const"] = const
+
+    def placeholder(n, nodes, const_of, resolve, node_of, layer_map):
+        raise ValueError(f"Placeholder {n.name!r} must be listed in "
+                         f"`inputs`")
+
+    _TF_CONVERTERS["Placeholder"] = placeholder
+
+
+_register_defaults()
+
+
+# --------------------------------------------------------------------------
+# export (≙ TensorflowSaver)
+# --------------------------------------------------------------------------
+
+def _attr_entry(key: str, value_fields) -> bytes:
+    return encode_message([
+        (_MAP_KEY, BYTES, key.encode()),
+        (_MAP_VALUE, BYTES, encode_message(value_fields)),
+    ])
+
+
+def _tensor_proto(arr: np.ndarray) -> bytes:
+    arr = np.asarray(arr)
+    dt = {np.dtype(np.float32): _DT_FLOAT,
+          np.dtype(np.int32): _DT_INT32,
+          np.dtype(np.int64): _DT_INT64}.get(arr.dtype, _DT_FLOAT)
+    if dt == _DT_FLOAT:
+        arr = arr.astype("<f4")
+    shape = encode_message([
+        (2, BYTES, encode_message([(1, VARINT, int(d))]))
+        for d in arr.shape])
+    return encode_message([
+        (_T_DTYPE, VARINT, dt),
+        (_T_SHAPE, BYTES, shape),
+        (_T_CONTENT, BYTES, arr.tobytes()),
+    ])
+
+
+def _node_def(name: str, op: str, inputs: Sequence[str],
+              attrs: Dict[str, bytes] = ()) -> bytes:
+    fields = [(_N_NAME, BYTES, name.encode()), (_N_OP, BYTES, op.encode())]
+    for i in inputs:
+        fields.append((_N_INPUT, BYTES, i.encode()))
+    for entry in (attrs or []):
+        fields.append((_N_ATTR, BYTES, entry))
+    return encode_message(fields)
+
+
+def save_tf_graph(model: Module, path: str, input_name: str = "input",
+                  input_shape: Optional[Sequence[int]] = None) -> List[str]:
+    """Export a Sequential of supported layers to a TF GraphDef
+    (≙ TensorflowSaver.saveGraph).  Returns the node names in order."""
+    node_defs: List[bytes] = []
+    names: List[str] = []
+
+    def add(name, op, inputs, attrs=()):
+        node_defs.append(_node_def(name, op, inputs, attrs))
+        names.append(name)
+        return name
+
+    dtype_attr = _attr_entry("dtype", [(_A_TYPE, VARINT, _DT_FLOAT)])
+    t_attr = _attr_entry("T", [(_A_TYPE, VARINT, _DT_FLOAT)])
+    add(input_name, "Placeholder", [], [dtype_attr])
+    cur = input_name
+
+    mods = (list(model.modules()) if isinstance(model, nn.Sequential)
+            else [model])
+    for pos, m in enumerate(mods):
+        base = m.get_name() or f"layer{pos + 1}"
+        if isinstance(m, nn.Linear):
+            w = np.asarray(m.weight).T  # TF: (in, out)
+            wn = add(f"{base}/weights", "Const", [],
+                     [dtype_attr,
+                      _attr_entry("value", [(_A_TENSOR, BYTES,
+                                             _tensor_proto(w))])])
+            cur = add(f"{base}/MatMul", "MatMul", [cur, wn], [t_attr])
+            if getattr(m, "with_bias", False):
+                b = np.asarray(m.bias)
+                bn = add(f"{base}/bias", "Const", [],
+                         [dtype_attr,
+                          _attr_entry("value", [(_A_TENSOR, BYTES,
+                                                 _tensor_proto(b))])])
+                cur = add(f"{base}/BiasAdd", "BiasAdd", [cur, bn],
+                          [t_attr])
+        elif isinstance(m, nn.ReLU):
+            cur = add(f"{base}/Relu", "Relu", [cur], [t_attr])
+        elif isinstance(m, nn.Tanh):
+            cur = add(f"{base}/Tanh", "Tanh", [cur], [t_attr])
+        elif isinstance(m, nn.Sigmoid):
+            cur = add(f"{base}/Sigmoid", "Sigmoid", [cur], [t_attr])
+        elif isinstance(m, (nn.SoftMax, nn.LogSoftMax)):
+            cur = add(f"{base}/Softmax", "Softmax", [cur], [t_attr])
+            if isinstance(m, nn.LogSoftMax):
+                cur = add(f"{base}/Log", "Log", [cur], [t_attr])
+        elif isinstance(m, (nn.Reshape, nn.Flatten, nn.View)):
+            if isinstance(m, nn.Reshape):
+                dims = list(m.size)
+            elif isinstance(m, nn.View):
+                dims = list(m.sizes)
+            else:  # Flatten: infer the feature size from the next Linear
+                nxt = next((x for x in mods[pos + 1:]
+                            if isinstance(x, nn.Linear)), None)
+                if nxt is None:
+                    raise ValueError(
+                        "save_tf_graph: Flatten needs a following Linear "
+                        "to infer its target size — use Reshape instead")
+                dims = [nxt.input_size]
+            shape = np.asarray([-1] + dims, np.int32)
+            sn = add(f"{base}/shape", "Const", [],
+                     [_attr_entry("dtype", [(_A_TYPE, VARINT, _DT_INT32)]),
+                      _attr_entry("value", [(_A_TENSOR, BYTES,
+                                             _tensor_proto(shape))])])
+            cur = add(f"{base}/Reshape", "Reshape", [cur, sn], [t_attr])
+        elif isinstance(m, nn.SpatialConvolution):
+            w = np.asarray(m.weight)  # HWIO already
+            wn = add(f"{base}/weights", "Const", [],
+                     [dtype_attr,
+                      _attr_entry("value", [(_A_TENSOR, BYTES,
+                                             _tensor_proto(w))])])
+            sh, sw = m.stride
+            ph, pw = m.pad
+            pad = b"SAME" if ph == -1 else b"VALID"
+            strides = _attr_entry("strides", [(_A_LIST, BYTES,
+                encode_message([(3, VARINT, 1), (3, VARINT, sh),
+                                (3, VARINT, sw), (3, VARINT, 1)]))])
+            padding = _attr_entry("padding", [(_A_S, BYTES, pad)])
+            cur = add(f"{base}/Conv2D", "Conv2D", [cur, wn],
+                      [t_attr, strides, padding])
+            if getattr(m, "with_bias", False):
+                b = np.asarray(m.bias)
+                bn = add(f"{base}/bias", "Const", [],
+                         [dtype_attr,
+                          _attr_entry("value", [(_A_TENSOR, BYTES,
+                                                 _tensor_proto(b))])])
+                cur = add(f"{base}/BiasAdd", "BiasAdd", [cur, bn],
+                          [t_attr])
+        else:
+            raise ValueError(f"save_tf_graph: unsupported layer "
+                             f"{type(m).__name__}")
+    graph = encode_message([(1, BYTES, nd) for nd in node_defs])
+    with open(path, "wb") as f:
+        f.write(graph)
+    return names

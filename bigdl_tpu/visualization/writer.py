@@ -38,11 +38,8 @@ class RecordWriter:
         self._f = fileobj
 
     def write(self, payload: bytes) -> None:
-        header = struct.pack("<Q", len(payload))
-        self._f.write(header)
-        self._f.write(struct.pack("<I", masked_crc32c(header)))
-        self._f.write(payload)
-        self._f.write(struct.pack("<I", masked_crc32c(payload)))
+        from bigdl_tpu import native
+        self._f.write(native.tfrecord_frame(payload))
 
     def flush(self) -> None:
         self._f.flush()

@@ -1,0 +1,279 @@
+"""TF GraphDef interop tests: build GraphDef bytes with the wire
+encoder, import, and check numerics against a torch oracle; export a
+Sequential and re-import it (roundtrip).
+
+Mirrors reference TensorflowLoaderSpec / TensorflowSaverSpec
+(spark/dl/src/test/.../utils/tf/).
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+import torch
+import torch.nn.functional as F
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.interop.protowire import (BYTES, FIXED32, VARINT,
+                                         encode_message, varint)
+from bigdl_tpu.interop.tensorflow import (load_tf_graph, parse_graphdef,
+                                          save_tf_graph)
+from bigdl_tpu.utils import set_seed
+
+
+# ---- GraphDef construction helpers (test-side encoder) -------------------
+
+def attr(key, fields):
+    return encode_message([(1, BYTES, key.encode()),
+                           (2, BYTES, encode_message(fields))])
+
+
+def tensor_proto(arr):
+    arr = np.asarray(arr)
+    dt = {np.dtype(np.float32): 1, np.dtype(np.int32): 3,
+          np.dtype(np.int64): 9}[arr.dtype]
+    shape = encode_message([(2, BYTES, encode_message([(1, VARINT, d)]))
+                            for d in arr.shape])
+    return encode_message([(1, VARINT, dt), (2, BYTES, shape),
+                           (4, BYTES, arr.tobytes())])
+
+
+def node(name, op, inputs=(), attrs=()):
+    fields = [(1, BYTES, name.encode()), (2, BYTES, op.encode())]
+    for i in inputs:
+        fields.append((3, BYTES, i.encode()))
+    for a in attrs:
+        fields.append((5, BYTES, a))
+    return encode_message(fields)
+
+
+def graphdef(*nodes):
+    return encode_message([(1, BYTES, n) for n in nodes])
+
+
+def const_node(name, arr):
+    return node(name, "Const", (), [
+        attr("dtype", [(6, VARINT, 1 if arr.dtype == np.float32 else 3)]),
+        attr("value", [(8, BYTES, tensor_proto(arr))]),
+    ])
+
+
+def ints_list_attr(key, vals):
+    packed = b"".join(varint(v) for v in vals)
+    return attr(key, [(1, BYTES, encode_message([(3, BYTES, packed)]))])
+
+
+def test_parse_graphdef():
+    g = graphdef(
+        node("x", "Placeholder"),
+        node("y", "Relu", ["x"]),
+        const_node("c", np.asarray([1.0, 2.0], np.float32)),
+    )
+    nodes = parse_graphdef(g)
+    assert [n.op for n in nodes] == ["Placeholder", "Relu", "Const"]
+    np.testing.assert_allclose(nodes[2].attrs["value"], [1.0, 2.0])
+
+
+def test_import_mlp_matches_torch():
+    set_seed(0)
+    rng = np.random.RandomState(0)
+    w1 = rng.randn(6, 8).astype(np.float32)   # TF layout (in, out)
+    b1 = rng.randn(8).astype(np.float32)
+    w2 = rng.randn(8, 3).astype(np.float32)
+    b2 = rng.randn(3).astype(np.float32)
+    g = graphdef(
+        node("input", "Placeholder"),
+        const_node("w1", w1), const_node("b1", b1),
+        const_node("w2", w2), const_node("b2", b2),
+        node("mm1", "MatMul", ["input", "w1"]),
+        node("ba1", "BiasAdd", ["mm1", "b1"]),
+        node("relu", "Relu", ["ba1"]),
+        node("mm2", "MatMul", ["relu", "w2"]),
+        node("ba2", "BiasAdd", ["mm2", "b2"]),
+        node("prob", "Softmax", ["ba2"]),
+    )
+    model, layer_map = load_tf_graph(g, ["input"], ["prob"])
+    # bias fused into the Linear layers
+    assert isinstance(layer_map["mm1"], nn.Linear)
+    x = rng.randn(4, 6).astype(np.float32)
+    out = np.asarray(model(jnp.asarray(x)))
+    tx = torch.tensor(x)
+    want = F.softmax(
+        F.relu(tx @ torch.tensor(w1) + torch.tensor(b1))
+        @ torch.tensor(w2) + torch.tensor(b2), dim=-1).numpy()
+    np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-5)
+
+
+def test_import_conv_net_matches_torch():
+    set_seed(1)
+    rng = np.random.RandomState(1)
+    w = rng.randn(3, 3, 2, 4).astype(np.float32)  # HWIO
+    b = rng.randn(4).astype(np.float32)
+    g = graphdef(
+        node("input", "Placeholder"),
+        const_node("w", w), const_node("b", b),
+        node("conv", "Conv2D", ["input", "w"], [
+            ints_list_attr("strides", [1, 1, 1, 1]),
+            attr("padding", [(2, BYTES, b"SAME")]),
+        ]),
+        node("bias", "BiasAdd", ["conv", "b"]),
+        node("relu", "Relu", ["bias"]),
+        node("pool", "MaxPool", ["relu"], [
+            ints_list_attr("ksize", [1, 2, 2, 1]),
+            ints_list_attr("strides", [1, 2, 2, 1]),
+            attr("padding", [(2, BYTES, b"VALID")]),
+        ]),
+    )
+    model, _ = load_tf_graph(g, ["input"], ["pool"])
+    x = rng.randn(1, 6, 6, 2).astype(np.float32)  # NHWC
+    out = np.asarray(model(jnp.asarray(x)))
+    # torch oracle (NCHW)
+    tx = torch.tensor(np.transpose(x, (0, 3, 1, 2)))
+    tw = torch.tensor(np.transpose(w, (3, 2, 0, 1)))
+    y = F.conv2d(tx, tw, torch.tensor(b), padding=1)
+    y = F.relu(y)
+    y = F.max_pool2d(y, 2, 2)
+    want = np.transpose(y.numpy(), (0, 2, 3, 1))
+    np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-5)
+
+
+def test_import_bn_and_eltwise():
+    set_seed(2)
+    rng = np.random.RandomState(2)
+    gamma = rng.rand(3).astype(np.float32) + 0.5
+    beta = rng.randn(3).astype(np.float32)
+    mean = rng.randn(3).astype(np.float32)
+    var = rng.rand(3).astype(np.float32) + 0.5
+    g = graphdef(
+        node("input", "Placeholder"),
+        const_node("gamma", gamma), const_node("beta", beta),
+        const_node("mean", mean), const_node("var", var),
+        node("bn", "FusedBatchNormV3",
+             ["input", "gamma", "beta", "mean", "var"]),
+        node("out", "AddV2", ["bn", "bn"]),
+    )
+    model, _ = load_tf_graph(g, ["input"], ["out"])
+    model.eval_mode()
+    x = rng.randn(2, 4, 4, 3).astype(np.float32)
+    out = np.asarray(model(jnp.asarray(x)))
+    want = 2 * (gamma * (x - mean) / np.sqrt(var + 1e-3) + beta)
+    np.testing.assert_allclose(out, want, rtol=1e-3, atol=1e-4)
+
+
+def test_import_concat_mean_reshape():
+    g = graphdef(
+        node("input", "Placeholder"),
+        const_node("axis", np.asarray(1, np.int32).reshape(())),
+        node("cat", "ConcatV2", ["input", "input", "axis"]),
+        const_node("mean_ax", np.asarray([1], np.int32)),
+        node("mean", "Mean", ["cat", "mean_ax"]),
+        const_node("shape", np.asarray([-1, 2], np.int32)),
+        node("resh", "Reshape", ["mean", "shape"]),
+    )
+    model, _ = load_tf_graph(g, ["input"], ["resh"])
+    x = jnp.asarray(np.arange(8, dtype=np.float32).reshape(2, 4))
+    out = np.asarray(model(x))
+    cat = np.concatenate([np.asarray(x)] * 2, axis=1)
+    want = cat.mean(axis=1).reshape(-1, 2)
+    np.testing.assert_allclose(out, want, rtol=1e-6)
+
+
+def test_export_import_roundtrip(tmp_path):
+    set_seed(3)
+    model = nn.Sequential(
+        nn.Linear(5, 7).set_name("fc1"), nn.ReLU(),
+        nn.Linear(7, 3).set_name("fc2"))
+    p = str(tmp_path / "model.pb")
+    names = save_tf_graph(model, p, input_name="input")
+    assert names[0] == "input"
+    back, _ = load_tf_graph(p, ["input"], [names[-1]])
+    x = jnp.asarray(np.random.RandomState(4).randn(3, 5), jnp.float32)
+    np.testing.assert_allclose(np.asarray(back(x)),
+                               np.asarray(model(x)), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_unknown_op_errors():
+    g = graphdef(node("input", "Placeholder"),
+                 node("w", "WeirdCustomOp", ["input"]))
+    with pytest.raises(ValueError, match="WeirdCustomOp"):
+        load_tf_graph(g, ["input"], ["w"])
+
+
+def test_onnx_shims():
+    from bigdl_tpu.interop import Gemm, OnnxReshape, OnnxShape
+    rng = np.random.RandomState(5)
+    a = rng.randn(3, 4).astype(np.float32)
+    b = rng.randn(4, 2).astype(np.float32)
+    c = rng.randn(2).astype(np.float32)
+    g = Gemm(alpha=2.0, beta=0.5)
+    out = np.asarray(g((jnp.asarray(a), jnp.asarray(b), jnp.asarray(c))))
+    np.testing.assert_allclose(out, 2.0 * a @ b + 0.5 * c, rtol=1e-5)
+    r = OnnxReshape((0, 2, 2))
+    assert r(jnp.ones((3, 4))).shape == (3, 2, 2)
+    s = OnnxShape()
+    np.testing.assert_array_equal(np.asarray(s(jnp.ones((2, 5)))), [2, 5])
+
+
+def test_biasadd_not_fused_with_second_consumer():
+    """A second consumer of the MatMul output must see PRE-bias values."""
+    w = np.eye(2, dtype=np.float32)
+    b = np.asarray([10.0, 10.0], np.float32)
+    g = graphdef(
+        node("input", "Placeholder"),
+        const_node("w", w), const_node("b", b),
+        node("mm", "MatMul", ["input", "w"]),
+        node("ba", "BiasAdd", ["mm", "b"]),
+        node("tap", "Identity", ["mm"]),   # pre-bias branch
+        node("sum", "AddV2", ["ba", "tap"]),
+    )
+    model, _ = load_tf_graph(g, ["input"], ["sum"])
+    x = jnp.asarray([[1.0, 2.0]])
+    out = np.asarray(model(x))
+    # sum = (x + 10) + x — bias applied exactly once
+    np.testing.assert_allclose(out, [[12.0, 14.0]], rtol=1e-6)
+
+
+def test_dilated_conv_import():
+    rng = np.random.RandomState(6)
+    w = rng.randn(3, 3, 1, 2).astype(np.float32)
+    g = graphdef(
+        node("input", "Placeholder"),
+        const_node("w", w),
+        node("conv", "Conv2D", ["input", "w"], [
+            ints_list_attr("strides", [1, 1, 1, 1]),
+            ints_list_attr("dilations", [1, 2, 2, 1]),
+            attr("padding", [(2, BYTES, b"SAME")]),
+        ]),
+    )
+    model, _ = load_tf_graph(g, ["input"], ["conv"])
+    x = rng.randn(1, 8, 8, 1).astype(np.float32)
+    out = np.asarray(model(jnp.asarray(x)))
+    tx = torch.tensor(np.transpose(x, (0, 3, 1, 2)))
+    tw = torch.tensor(np.transpose(w, (3, 2, 0, 1)))
+    want = F.conv2d(tx, tw, padding=2, dilation=2)
+    np.testing.assert_allclose(
+        out, np.transpose(want.numpy(), (0, 2, 3, 1)),
+        rtol=1e-4, atol=1e-5)
+
+
+def test_export_flatten_roundtrip(tmp_path):
+    set_seed(7)
+    model = nn.Sequential(nn.Flatten(), nn.Linear(12, 3).set_name("fc"))
+    p = str(tmp_path / "f.pb")
+    names = save_tf_graph(model, p)
+    back, _ = load_tf_graph(p, ["input"], [names[-1]])
+    x = jnp.asarray(np.random.RandomState(8).randn(2, 3, 4), jnp.float32)
+    np.testing.assert_allclose(np.asarray(back(x)),
+                               np.asarray(model(x)), rtol=1e-5, atol=1e-6)
+
+
+def test_caffe_missing_weights_clear_error(tmp_path):
+    from bigdl_tpu.interop import load_caffe
+    p = str(tmp_path / "only.prototxt")
+    with open(p, "w") as f:
+        f.write('input: "data"\n'
+                'layer { name: "fc" type: "InnerProduct" bottom: "data" '
+                'top: "fc" inner_product_param { num_output: 3 } }\n')
+    with pytest.raises(ValueError, match="caffemodel"):
+        load_caffe(p)
