@@ -1,0 +1,216 @@
+"""DataFrame ML-pipeline integration (the dlframes analog).
+
+Reference: dlframes/DLEstimator.scala:166 (Spark ML Estimator wrapping
+an Optimizer; DLModel:368 Transformer wrapping a Predictor),
+DLClassifier.scala:40, DLImageReader.scala, DLImageTransformer.scala.
+
+The reference integrates with Spark ML pipelines; the TPU-native stack
+integrates with the pandas/scikit-learn ecosystem instead: DLEstimator
+follows the sklearn estimator protocol (``fit``/``transform``/
+``get_params``) over pandas DataFrames whose cells hold features, so it
+composes with sklearn ``Pipeline`` the way DLEstimator composed with
+Spark ML pipelines.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from bigdl_tpu.core.module import Module
+
+__all__ = ["DLEstimator", "DLClassifier", "DLModel", "DLClassifierModel",
+           "DLImageReader", "DLImageTransformer"]
+
+
+def _column_to_array(col, feature_size):
+    arr = np.asarray([np.asarray(v, np.float32).reshape(feature_size)
+                      for v in col])
+    return arr
+
+
+class DLEstimator:
+    """Train a Module on DataFrame columns (reference
+    dlframes/DLEstimator.scala:166).
+
+    ``fit(df)`` trains on ``features_col``/``label_col`` and returns a
+    :class:`DLModel`.  Cells may hold scalars, lists, or ndarrays;
+    ``feature_size``/``label_size`` give the per-row shapes (reference
+    featureSize/labelSize params).
+    """
+
+    def __init__(self, model: Module, criterion,
+                 feature_size: Sequence[int],
+                 label_size: Sequence[int],
+                 features_col: str = "features",
+                 label_col: str = "label",
+                 prediction_col: str = "prediction",
+                 batch_size: int = 32, max_epoch: int = 10,
+                 learning_rate: float = 1e-3, optim_method=None):
+        self.model = model
+        self.criterion = criterion
+        self.feature_size = tuple(feature_size)
+        self.label_size = tuple(label_size)
+        self.features_col = features_col
+        self.label_col = label_col
+        self.prediction_col = prediction_col
+        self.batch_size = batch_size
+        self.max_epoch = max_epoch
+        self.learning_rate = learning_rate
+        self.optim_method = optim_method
+
+    # sklearn protocol -----------------------------------------------------
+    def get_params(self, deep=True):
+        return {k: getattr(self, k) for k in
+                ("model", "criterion", "feature_size", "label_size",
+                 "features_col", "label_col", "prediction_col",
+                 "batch_size", "max_epoch", "learning_rate",
+                 "optim_method")}
+
+    def set_params(self, **params):
+        for k, v in params.items():
+            setattr(self, k, v)
+        return self
+
+    # builder-style setters mirroring the reference ------------------------
+    def set_batch_size(self, v: int) -> "DLEstimator":
+        self.batch_size = v
+        return self
+
+    def set_max_epoch(self, v: int) -> "DLEstimator":
+        self.max_epoch = v
+        return self
+
+    def set_learning_rate(self, v: float) -> "DLEstimator":
+        self.learning_rate = v
+        return self
+
+    def _label_array(self, df):
+        return _column_to_array(df[self.label_col], self.label_size)
+
+    def fit(self, df, y=None) -> "DLModel":
+        from bigdl_tpu.dataset.dataset import LocalDataSet, Sample
+        from bigdl_tpu.dataset.transformer import SampleToMiniBatch
+        from bigdl_tpu.optim import Optimizer, SGD, Trigger
+
+        x = _column_to_array(df[self.features_col], self.feature_size)
+        labels = self._label_array(df)
+        samples = [Sample(f, l) for f, l in zip(x, labels)]
+        ds = LocalDataSet(samples, shuffle=True).transform(
+            SampleToMiniBatch(min(self.batch_size, len(samples))))
+        method = self.optim_method or SGD(self.learning_rate)
+        trained = (Optimizer(self.model, ds, self.criterion)
+                   .set_optim_method(method)
+                   .set_end_when(Trigger.max_epoch(self.max_epoch))
+                   .optimize())
+        return self._make_model(trained)
+
+    def _make_model(self, trained) -> "DLModel":
+        return DLModel(trained, self.feature_size,
+                       features_col=self.features_col,
+                       prediction_col=self.prediction_col,
+                       batch_size=self.batch_size)
+
+
+class DLModel:
+    """Fitted transformer: appends ``prediction_col`` to a DataFrame
+    (reference dlframes/DLEstimator.scala:368 DLModel.transform →
+    internal Predictor)."""
+
+    def __init__(self, model: Module, feature_size: Sequence[int],
+                 features_col: str = "features",
+                 prediction_col: str = "prediction",
+                 batch_size: int = 32):
+        self.model = model
+        self.feature_size = tuple(feature_size)
+        self.features_col = features_col
+        self.prediction_col = prediction_col
+        self.batch_size = batch_size
+
+    def _predict_array(self, x: np.ndarray) -> np.ndarray:
+        from bigdl_tpu.optim import Predictor
+        preds = Predictor(self.model, batch_size=self.batch_size) \
+            .predict(list(x))
+        return np.asarray(preds)
+
+    def _format(self, preds: np.ndarray) -> List:
+        return [np.asarray(p) for p in preds]
+
+    def transform(self, df):
+        x = _column_to_array(df[self.features_col], self.feature_size)
+        out = df.copy()
+        out[self.prediction_col] = self._format(self._predict_array(x))
+        return out
+
+    predict = transform
+
+
+class DLClassifier(DLEstimator):
+    """Classification sugar: ClassNLL over log-probs, argmax prediction
+    (reference DLClassifier.scala:40 — label column holds 1-based class
+    ids, prediction column gets the predicted id)."""
+
+    def __init__(self, model: Module, criterion=None,
+                 feature_size: Sequence[int] = (),
+                 features_col: str = "features",
+                 label_col: str = "label", **kw):
+        import bigdl_tpu.nn as nn
+        super().__init__(model, criterion or nn.ClassNLLCriterion(),
+                         feature_size, (1,), features_col=features_col,
+                         label_col=label_col, **kw)
+
+    def _label_array(self, df):
+        # class ids are per-row scalars: (B,) for ClassNLL
+        return np.asarray(df[self.label_col], np.float32).reshape(-1)
+
+    def _make_model(self, trained) -> "DLClassifierModel":
+        return DLClassifierModel(trained, self.feature_size,
+                                 features_col=self.features_col,
+                                 prediction_col=self.prediction_col,
+                                 batch_size=self.batch_size)
+
+
+class DLClassifierModel(DLModel):
+    def _format(self, preds: np.ndarray) -> List:
+        return list(np.argmax(preds, axis=-1).astype(np.float64) + 1)
+
+
+class DLImageReader:
+    """Read an image directory into a DataFrame with an ``image`` column
+    of HWC float arrays (reference DLImageReader.scala: reads to a
+    DataFrame of image schema rows)."""
+
+    @staticmethod
+    def read_images(path: str, with_label_from_dirs: bool = False):
+        import pandas as pd
+        from bigdl_tpu.transform.vision import ImageFrame
+        frame = ImageFrame.read(path, with_label_from_dirs)
+        rows = {
+            "image": [f.image for f in frame],
+            "uri": [f.get(f.uri) for f in frame],
+        }
+        if with_label_from_dirs:
+            rows["label"] = [f.get_label() for f in frame]
+        return pd.DataFrame(rows)
+
+
+class DLImageTransformer:
+    """Apply a vision FeatureTransformer pipeline to an image column
+    (reference DLImageTransformer.scala)."""
+
+    def __init__(self, transformer, input_col: str = "image",
+                 output_col: str = "features"):
+        self.transformer = transformer
+        self.input_col = input_col
+        self.output_col = output_col
+
+    def transform(self, df):
+        from bigdl_tpu.transform.vision import ImageFeature
+        out = df.copy()
+        feats = [ImageFeature(np.asarray(img))
+                 for img in df[self.input_col]]
+        # iterator form works for single transformers AND >>-chains
+        results = [f.image for f in self.transformer(iter(feats))]
+        out[self.output_col] = results
+        return out

@@ -259,13 +259,15 @@ def _convert_conv(spec, params, blobs):
     group = _one(p, "group", 1)
     bias = _one(p, "bias_term", True)
     _need_blobs(spec, blobs, 1)
+    if bias:
+        _need_blobs(spec, blobs, 2)  # bias_term=true requires the blob
     w = blobs[0]  # caffe: (out, in/group, kh, kw)
     n_in = w.shape[1] * group
     m = nn.SpatialConvolution(n_in, n_out, kw, kh, sw, sh, pw, ph,
                               n_group=group, with_bias=bias,
                               data_format="NCHW")
     m.weight = Parameter(np.transpose(w, (2, 3, 1, 0)))  # → HWIO
-    if bias and len(blobs) > 1:
+    if bias:
         m.bias = Parameter(blobs[1].reshape(-1))
     return m
 
@@ -276,10 +278,12 @@ def _convert_linear(spec, params, blobs):
     n_out = _one(p, "num_output")
     bias = _one(p, "bias_term", True)
     _need_blobs(spec, blobs, 1)
+    if bias:
+        _need_blobs(spec, blobs, 2)
     w = blobs[0].reshape(n_out, -1)
     m = nn.Linear(w.shape[1], n_out, with_bias=bias)
     m.weight = Parameter(w)
-    if bias and len(blobs) > 1:
+    if bias:
         m.bias = Parameter(blobs[1].reshape(-1))
     # caffe flattens (B, C, H, W) → (B, C*H*W) implicitly
     return nn.Sequential(nn.Flatten(), m)
@@ -472,11 +476,25 @@ def _find_outputs(blob_nodes, layer_defs):
     return uniq
 
 
-def load_caffe_weights(model: Module, prototxt_path: str,
+def load_caffe_weights(model: Module, prototxt_path: Optional[str],
                        caffemodel_path: str, match_all: bool = True):
     """Copy caffemodel weights into an existing model by layer name
-    (≙ Module.loadCaffe / CaffeLoader.load, CaffeLoader.scala:57-73)."""
+    (≙ Module.loadCaffe / CaffeLoader.load, CaffeLoader.scala:57-73).
+
+    ``prototxt_path`` is optional: when given, it is parsed and its
+    layer names cross-checked against the caffemodel (catching
+    mismatched prototxt/caffemodel pairs early)."""
     weights = read_caffemodel(caffemodel_path)
+    if prototxt_path:
+        with open(prototxt_path) as f:
+            net = parse_prototxt(f.read())
+        proto_names = {_one(s, "name") for s in
+                       net.get("layer", net.get("layers", []))}
+        stray = [n for n in weights if n not in proto_names]
+        if stray:
+            raise ValueError(
+                f"caffemodel layers absent from the prototxt: "
+                f"{stray[:5]} — mismatched model pair?")
     named = {m.get_name(): m for _, m in model.named_modules()}
     copied = []
     for lname, spec in weights.items():

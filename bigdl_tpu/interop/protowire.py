@@ -72,6 +72,10 @@ def decode_message(buf: bytes) -> Dict[int, list]:
 
 
 def varint(x: int) -> bytes:
+    if x < 0:
+        # proto2/3 semantics: negative ints go out as 10-byte two's
+        # complement (Python's arithmetic shift would loop forever)
+        x &= (1 << 64) - 1
     out = bytearray()
     while True:
         b = x & 0x7F

@@ -172,6 +172,13 @@ def _clean(name: str) -> str:
     return name[1:] if name.startswith("^") else name
 
 
+def _check_nhwc(n: "TFNode") -> None:
+    fmt = n.attrs.get("data_format", "NHWC")
+    if fmt not in ("NHWC", None, ""):
+        raise ValueError(f"{n.op} {n.name!r}: data_format={fmt!r} import "
+                         f"not supported (NHWC only)")
+
+
 def const_of_nodes(nodes, consts, name: str) -> Optional[np.ndarray]:
     """Resolve a node reference to a constant, walking Identity chains."""
     name = _clean(name)
@@ -248,6 +255,10 @@ def load_tf_graph(path_or_bytes, inputs: Sequence[str],
         for i in n.inputs:
             if not i.startswith("^"):
                 consumers[_clean(i)] = consumers.get(_clean(i), 0) + 1
+    # requested outputs are external consumers: a producer whose
+    # pre-bias value is observed must not absorb the bias
+    for name in outputs:
+        consumers[_clean(name)] = consumers.get(_clean(name), 0) + 1
     fused_into: Dict[str, TFNode] = {}
     for n in nodes.values():
         if n.op == "BiasAdd":
@@ -312,6 +323,7 @@ def _register_defaults():
     })
 
     def conv2d(n, nodes, const_of, resolve, node_of, layer_map):
+        _check_nhwc(n)
         w = const_of(n.inputs[1])
         assert w is not None, f"Conv2D {n.name}: non-const filter"
         strides = n.attrs.get("strides", [1, 1, 1, 1])
@@ -384,6 +396,7 @@ def _register_defaults():
     _TF_CONVERTERS["MatMul"] = matmul
 
     def bias_add(n, nodes, const_of, resolve, node_of, layer_map):
+        _check_nhwc(n)
         src = nodes.get(_clean(n.inputs[0]))
         if src is not None and src.attrs.get("_fused_bias") is not None:
             return resolve(src.name)  # fused into producer
@@ -406,13 +419,18 @@ def _register_defaults():
     _TF_CONVERTERS["BiasAdd"] = bias_add
 
     def pool(n, nodes, const_of, resolve, node_of, layer_map):
+        _check_nhwc(n)
         ks = n.attrs.get("ksize", [1, 2, 2, 1])
         st = n.attrs.get("strides", [1, 2, 2, 1])
         pad = n.attrs.get("padding", "VALID")
-        cls = (nn.SpatialMaxPooling if n.op == "MaxPool"
-               else nn.SpatialAveragePooling)
-        mod = cls(ks[2], ks[1], st[2], st[1],
-                  -1 if pad == "SAME" else 0, -1 if pad == "SAME" else 0)
+        p = -1 if pad == "SAME" else 0
+        if n.op == "MaxPool":
+            mod = nn.SpatialMaxPooling(ks[2], ks[1], st[2], st[1], p, p)
+        else:
+            # TF AvgPool excludes padded cells from the divisor
+            mod = nn.SpatialAveragePooling(ks[2], ks[1], st[2], st[1],
+                                           p, p,
+                                           count_include_pad=False)
         mod.set_name(n.name)
         layer_map[n.name] = mod
         return node_of(mod, resolve(n.inputs[0]))
@@ -578,7 +596,9 @@ def save_tf_graph(model: Module, path: str, input_name: str = "input",
     mods = (list(model.modules()) if isinstance(model, nn.Sequential)
             else [model])
     for pos, m in enumerate(mods):
-        base = m.get_name() or f"layer{pos + 1}"
+        # position suffix keeps node names unique even for repeated
+        # unnamed layers (duplicate names corrupt a GraphDef)
+        base = f"{m.get_name() or type(m).__name__}_{pos + 1}"
         if isinstance(m, nn.Linear):
             w = np.asarray(m.weight).T  # TF: (in, out)
             wn = add(f"{base}/weights", "Const", [],

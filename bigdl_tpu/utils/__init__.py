@@ -1,1 +1,2 @@
 from bigdl_tpu.utils.rng import set_seed, get_seed, next_key
+from bigdl_tpu.utils.engine import Engine, ThreadPool, get_property

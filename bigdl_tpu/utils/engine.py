@@ -1,0 +1,185 @@
+"""Engine — global runtime singleton.
+
+Reference: utils/Engine.scala:49 (parses Spark conf into
+(nodeNumber, coreNumber), owns thread pools, engine type, optimizer
+version, the ``bigdl.*`` system-property config tier, and the
+singleton-per-JVM check) and utils/ThreadPool.scala.
+
+TPU-native mapping: topology comes from the JAX runtime —
+``process_count`` (≙ nodeNumber), ``local_device_count`` (≙ executor
+cores for device work) — and config from ``BIGDL_TPU_*`` environment
+variables (≙ the ``bigdl.*`` sysprops).  The reference's compute thread
+pools (model replicas per core) have no TPU analog — XLA owns the
+device — so ThreadPool here serves the host side: data loading,
+checkpoint IO, metric drains.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from concurrent.futures import Future, ThreadPoolExecutor, wait
+from typing import Callable, List, Optional, Sequence, Tuple
+
+__all__ = ["Engine", "ThreadPool", "get_property"]
+
+
+def get_property(name: str, default: str = "") -> str:
+    """Config tier (≙ ``bigdl.*`` JVM properties, Engine.scala:53):
+    ``bigdl.foo.bar`` → env var ``BIGDL_TPU_FOO_BAR``."""
+    env = "BIGDL_TPU_" + name.replace("bigdl.", "").replace(".", "_").upper()
+    return os.environ.get(env, default)
+
+
+class ThreadPool:
+    """Host-side pool (≙ utils/ThreadPool.scala): ``invoke_and_wait``
+    mirrors invokeAndWait; ``invoke_and_wait2`` returns (done, pending)
+    futures under a timeout — the API the reference used for straggler
+    dropping (ThreadPool.scala:156), retained for host IO tasks."""
+
+    def __init__(self, size: int):
+        self.size = size
+        self._pool = ThreadPoolExecutor(max_workers=size)
+
+    def invoke(self, tasks: Sequence[Callable]) -> List[Future]:
+        return [self._pool.submit(t) for t in tasks]
+
+    def invoke_and_wait(self, tasks: Sequence[Callable]) -> List:
+        futures = self.invoke(tasks)
+        return [f.result() for f in futures]
+
+    def invoke_and_wait2(self, tasks: Sequence[Callable],
+                         timeout: Optional[float] = None):
+        futures = self.invoke(tasks)
+        done, pending = wait(futures, timeout=timeout)
+        for p in pending:
+            p.cancel()
+        return done, pending
+
+    def sync(self):
+        self.invoke_and_wait([lambda: None])
+
+    def shutdown(self):
+        self._pool.shutdown(wait=False)
+
+
+class _EngineState:
+    def __init__(self):
+        self.inited = False
+        self.node_number = 1
+        self.core_number = 1
+        self.local_device_count = 1
+        self.optimizer_version = get_property(
+            "bigdl.optimizerVersion", "optimizerV1")
+        self.engine_type = get_property("bigdl.engineType", "xla")
+        self._default_pool: Optional[ThreadPool] = None
+        self._io_pool: Optional[ThreadPool] = None
+
+
+class Engine:
+    """Singleton runtime facade (reference Engine.init,
+    utils/Engine.scala:114)."""
+
+    _state = _EngineState()
+    _lock = threading.Lock()
+
+    @classmethod
+    def init(cls, node_number: Optional[int] = None,
+             core_number: Optional[int] = None) -> None:
+        """Discover (or override) the topology.  Reference
+        Engine.init:114 parses the Spark master; here the JAX runtime is
+        the source of truth: process_count ≙ nodes, local device count ≙
+        per-node accelerator parallelism."""
+        with cls._lock:
+            s = cls._state
+            if node_number is not None:
+                s.node_number = node_number
+            else:
+                try:
+                    import jax
+                    s.node_number = jax.process_count()
+                except Exception:
+                    s.node_number = 1
+            try:
+                import jax
+                s.local_device_count = jax.local_device_count()
+            except Exception:
+                s.local_device_count = 1
+            if core_number is not None:
+                s.core_number = core_number
+            else:
+                env = get_property("bigdl.coreNumber")
+                s.core_number = int(env) if env else (os.cpu_count() or 1)
+            s.inited = True
+
+    @classmethod
+    def _ensure(cls):
+        if not cls._state.inited:
+            cls.init()
+
+    @classmethod
+    def node_number(cls) -> int:
+        cls._ensure()
+        return cls._state.node_number
+
+    @classmethod
+    def core_number(cls) -> int:
+        cls._ensure()
+        return cls._state.core_number
+
+    @classmethod
+    def local_device_count(cls) -> int:
+        cls._ensure()
+        return cls._state.local_device_count
+
+    @classmethod
+    def get_engine_type(cls) -> str:
+        return cls._state.engine_type
+
+    @classmethod
+    def get_optimizer_version(cls) -> str:
+        """≙ Engine.getOptimizerVersion (Engine.scala:230)."""
+        return cls._state.optimizer_version
+
+    @classmethod
+    def set_optimizer_version(cls, v: str) -> None:
+        assert v in ("optimizerV1", "optimizerV2"), v
+        cls._state.optimizer_version = v
+
+    @classmethod
+    def default_pool(cls) -> ThreadPool:
+        """Host task pool (≙ Engine.default, core×2 capped — the
+        reference's core×50 sizing existed to absorb blocked Spark task
+        threads, which have no analog here)."""
+        cls._ensure()
+        with cls._lock:
+            if cls._state._default_pool is None:
+                cls._state._default_pool = ThreadPool(
+                    min(cls._state.core_number * 2, 64))
+            return cls._state._default_pool
+
+    @classmethod
+    def io_pool(cls) -> ThreadPool:
+        """Dedicated IO pool (checkpoint writes, event files —
+        ≙ the reference's wrapperComputing pool)."""
+        cls._ensure()
+        with cls._lock:
+            if cls._state._io_pool is None:
+                cls._state._io_pool = ThreadPool(4)
+            return cls._state._io_pool
+
+    @classmethod
+    def check_singleton(cls) -> bool:
+        """≙ Engine.checkSingleton (Engine.scala:286): one Engine per
+        process by construction here; kept for API parity."""
+        return True
+
+    @classmethod
+    def reset(cls) -> None:
+        """Test hook."""
+        with cls._lock:
+            if cls._state._default_pool:
+                cls._state._default_pool.shutdown()
+            if cls._state._io_pool:
+                cls._state._io_pool.shutdown()
+            cls._state = _EngineState()

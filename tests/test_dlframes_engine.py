@@ -1,0 +1,121 @@
+"""dlframes (pandas/sklearn pipeline integration) + Engine runtime tests.
+
+Mirrors reference DLEstimatorSpec/DLClassifierSpec
+(spark/dl/src/test/.../dlframes/) and utils/EngineSpec.
+"""
+
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.dlframes import (DLClassifier, DLEstimator, DLImageReader,
+                                DLImageTransformer, DLModel)
+from bigdl_tpu.utils import Engine, ThreadPool, get_property, set_seed
+
+
+def _toy_df(n=64, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, 4).astype(np.float32)
+    y = (x[:, 0] + x[:, 1] > 0).astype(np.float32) + 1  # classes 1/2
+    return pd.DataFrame({"features": list(x), "label": list(y)}), x, y
+
+
+def test_dl_classifier_fit_transform():
+    set_seed(0)
+    df, x, y = _toy_df()
+    model = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2),
+                          nn.LogSoftMax())
+    clf = DLClassifier(model, feature_size=(4,),
+                       batch_size=16, max_epoch=30, learning_rate=0.5)
+    fitted = clf.fit(df)
+    out = fitted.transform(df)
+    assert "prediction" in out.columns
+    acc = (out["prediction"].to_numpy() == y).mean()
+    assert acc >= 0.9, acc
+
+
+def test_dl_estimator_regression():
+    set_seed(1)
+    rng = np.random.RandomState(2)
+    x = rng.randn(48, 3).astype(np.float32)
+    w = np.asarray([[1.0], [-2.0], [0.5]], np.float32)
+    y = x @ w
+    df = pd.DataFrame({"features": list(x), "label": list(y)})
+    est = DLEstimator(nn.Linear(3, 1), nn.MSECriterion(),
+                      feature_size=(3,), label_size=(1,),
+                      batch_size=16, max_epoch=40, learning_rate=0.1)
+    fitted = est.fit(df)
+    out = fitted.transform(df)
+    preds = np.stack(out["prediction"].to_numpy())
+    assert np.abs(preds - y).mean() < 0.1
+
+
+def test_sklearn_pipeline_compat():
+    """DLEstimator must compose in sklearn Pipelines (the analog of the
+    reference's Spark ML pipeline integration)."""
+    from sklearn.pipeline import Pipeline
+    set_seed(2)
+    df, x, y = _toy_df(seed=3)
+    model = nn.Sequential(nn.Linear(4, 2), nn.LogSoftMax())
+    clf = DLClassifier(model, feature_size=(4,), batch_size=16,
+                       max_epoch=20, learning_rate=0.5)
+    pipe = Pipeline([("clf", clf)])
+    fitted = pipe.fit(df)
+    out = fitted.named_steps["clf"].fit(df).transform(df)
+    assert "prediction" in out.columns
+
+
+def test_dl_image_reader_and_transformer(tmp_path):
+    from PIL import Image
+    from bigdl_tpu.transform.vision import ChannelNormalize, Resize
+    d = tmp_path / "cls" / "a"
+    d.mkdir(parents=True)
+    for i in range(3):
+        Image.fromarray(
+            np.full((8, 8, 3), i * 40, np.uint8)).save(d / f"{i}.png")
+    df = DLImageReader.read_images(str(tmp_path / "cls"),
+                                   with_label_from_dirs=True)
+    assert len(df) == 3 and "image" in df.columns
+    tr = DLImageTransformer(Resize(4, 4) >> ChannelNormalize(0, 0, 0,
+                                                             255, 255, 255))
+    out = tr.transform(df)
+    assert out["features"][0].shape == (4, 4, 3)
+    assert out["features"][2].max() <= 1.0
+
+
+def test_engine_topology_and_pools():
+    Engine.reset()
+    Engine.init()
+    assert Engine.node_number() >= 1
+    assert Engine.core_number() >= 1
+    assert Engine.check_singleton()
+    pool = Engine.default_pool()
+    results = pool.invoke_and_wait([lambda i=i: i * i for i in range(5)])
+    assert sorted(results) == [0, 1, 4, 9, 16]
+    done, pending = pool.invoke_and_wait2(
+        [lambda: 1, lambda: 2], timeout=10)
+    assert len(done) == 2 and not pending
+
+
+def test_engine_properties(monkeypatch):
+    monkeypatch.setenv("BIGDL_TPU_CORENUMBER", "3")
+    assert get_property("bigdl.coreNumber") == "3"
+    Engine.reset()
+    Engine.init()
+    assert Engine.core_number() == 3
+    Engine.reset()
+    Engine.init(node_number=2, core_number=8)
+    assert Engine.node_number() == 2
+    assert Engine.core_number() == 8
+    Engine.reset()
+
+
+def test_optimizer_version_switch():
+    Engine.set_optimizer_version("optimizerV2")
+    assert Engine.get_optimizer_version() == "optimizerV2"
+    Engine.set_optimizer_version("optimizerV1")
+    with pytest.raises(AssertionError):
+        Engine.set_optimizer_version("bogus")

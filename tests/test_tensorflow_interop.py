@@ -277,3 +277,70 @@ def test_caffe_missing_weights_clear_error(tmp_path):
                 'top: "fc" inner_product_param { num_output: 3 } }\n')
     with pytest.raises(ValueError, match="caffemodel"):
         load_caffe(p)
+
+
+def test_avgpool_same_excludes_padding():
+    g = graphdef(
+        node("input", "Placeholder"),
+        node("pool", "AvgPool", ["input"], [
+            ints_list_attr("ksize", [1, 3, 3, 1]),
+            ints_list_attr("strides", [1, 1, 1, 1]),
+            attr("padding", [(2, BYTES, b"SAME")]),
+        ]),
+    )
+    model, _ = load_tf_graph(g, ["input"], ["pool"])
+    x = np.arange(16, dtype=np.float32).reshape(1, 4, 4, 1)
+    out = np.asarray(model(jnp.asarray(x)))
+    tx = torch.tensor(np.transpose(x, (0, 3, 1, 2)))
+    want = F.avg_pool2d(tx, 3, 1, padding=1, count_include_pad=False)
+    np.testing.assert_allclose(
+        out, np.transpose(want.numpy(), (0, 2, 3, 1)), rtol=1e-5)
+
+
+def test_export_repeated_unnamed_layers(tmp_path):
+    set_seed(9)
+    model = nn.Sequential(nn.Linear(4, 4), nn.ReLU(),
+                          nn.Linear(4, 4), nn.ReLU())
+    p = str(tmp_path / "dup.pb")
+    names = save_tf_graph(model, p)
+    assert len(set(names)) == len(names)  # no duplicate node names
+    back, _ = load_tf_graph(p, ["input"], [names[-1]])
+    x = jnp.asarray(np.random.RandomState(10).randn(2, 4), jnp.float32)
+    np.testing.assert_allclose(np.asarray(back(x)),
+                               np.asarray(model(x)), rtol=1e-5, atol=1e-6)
+
+
+def test_pre_bias_output_not_fused():
+    w = np.eye(2, dtype=np.float32)
+    b = np.asarray([10.0, 10.0], np.float32)
+    g = graphdef(
+        node("input", "Placeholder"),
+        const_node("w", w), const_node("b", b),
+        node("mm", "MatMul", ["input", "w"]),
+        node("ba", "BiasAdd", ["mm", "b"]),
+    )
+    model, _ = load_tf_graph(g, ["input"], ["mm", "ba"])
+    x = jnp.asarray([[1.0, 2.0]])
+    mm_out, ba_out = model(x)
+    np.testing.assert_allclose(np.asarray(mm_out), [[1.0, 2.0]])
+    np.testing.assert_allclose(np.asarray(ba_out), [[11.0, 12.0]])
+
+
+def test_nchw_graph_rejected():
+    w = np.zeros((3, 3, 1, 1), np.float32)
+    g = graphdef(
+        node("input", "Placeholder"),
+        const_node("w", w),
+        node("conv", "Conv2D", ["input", "w"], [
+            ints_list_attr("strides", [1, 1, 1, 1]),
+            attr("padding", [(2, BYTES, b"SAME")]),
+            attr("data_format", [(2, BYTES, b"NCHW")]),
+        ]),
+    )
+    with pytest.raises(ValueError, match="NCHW"):
+        load_tf_graph(g, ["input"], ["conv"])
+
+
+def test_varint_negative_terminates():
+    from bigdl_tpu.interop.protowire import varint
+    assert len(varint(-1)) == 10  # two's-complement 64-bit
