@@ -20,3 +20,6 @@ from bigdl_tpu.interop.tensorflow import (  # noqa: F401
 from bigdl_tpu.interop.torch_import import (  # noqa: F401
     load_torch_state_dict, register_torch_converter,
 )
+from bigdl_tpu.interop.torch_file import (  # noqa: F401
+    load_t7, load_torch_module, save_t7, TorchObject,
+)

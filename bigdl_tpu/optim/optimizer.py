@@ -51,6 +51,7 @@ from bigdl_tpu.parallel.sharding import (
     ShardingRules, shard_model_params, replicated,
 )
 from bigdl_tpu.utils.file import save_checkpoint, load_checkpoint
+from bigdl_tpu.optim.metrics import Metrics
 from bigdl_tpu.utils.rng import get_seed
 
 logger = logging.getLogger("bigdl_tpu.optim")
@@ -91,6 +92,7 @@ class Optimizer:
         self.sharding_rules = ShardingRules()
         self.compute_dtype = None  # e.g. jnp.bfloat16 for mixed precision
         self.train_summary = None
+        self.metrics = Metrics()
         self.val_summary = None
         self.state: Dict[str, Any] = {"epoch": 1, "neval": 1,
                                       "records": 0, "loss": float("nan"),
@@ -368,9 +370,13 @@ class Optimizer:
                                        x_sharding) \
                         if batch.get_target() is not None else None
                     rng = jax.random.fold_in(seed_key, self.state["neval"])
+                    t_data = time.time() - it_start
                     params_groups, rest, opt_states, loss = step(
                         params_groups, rest, opt_states, x, y, rng, epoch)
-                    loss_f = float(loss)
+                    loss_f = float(loss)  # blocks on the device step
+                    self.metrics.add("data load and transfer", t_data)
+                    self.metrics.add("device step time",
+                                     time.time() - it_start - t_data)
                     n = batch.size()
                     self.state["records"] += n
                     self.state["loss"] = loss_f
@@ -422,6 +428,7 @@ class Optimizer:
         # write trained params back into the user's module (in place)
         trained = combine(self._merge_groups_host(params_groups), rest)
         self._sync_into(self.model, trained)
+        logger.info("%s", self.metrics.summary())
         return self.model
 
     def _merge_groups_host(self, params_groups):
@@ -450,7 +457,8 @@ class Optimizer:
         if do_val:
             self._last_val_neval = self.state["neval"]
             current = combine(merged, rest).eval_mode()
-            results = self._validate(current, eval_step)
+            with self.metrics.time("validation time"):
+                results = self._validate(current, eval_step)
             current.train_mode()
             if results:
                 first = next(iter(results.values()))
@@ -471,13 +479,14 @@ class Optimizer:
                 else f".{self.state['neval']}"
             path = os.path.join(self.checkpoint_path, f"checkpoint{tag}.npz")
             temp = combine(merged, rest)
-            save_checkpoint(
-                path,
-                {"params": _to_plain(temp.parameters()),
-                 "buffers": _to_plain(temp.buffers())},
-                [s for s in opt_states],
-                {k: v for k, v in self.state.items()
-                 if isinstance(v, (int, float))})
+            with self.metrics.time("checkpoint time"):
+                save_checkpoint(
+                    path,
+                    {"params": _to_plain(temp.parameters()),
+                     "buffers": _to_plain(temp.buffers())},
+                    [s for s in opt_states],
+                    {k: v for k, v in self.state.items()
+                     if isinstance(v, (int, float))})
             logger.info("checkpoint written to %s", path)
 
     def _sync_into(self, target: Module, source: Module):

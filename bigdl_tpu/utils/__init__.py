@@ -1,2 +1,4 @@
 from bigdl_tpu.utils.rng import set_seed, get_seed, next_key
 from bigdl_tpu.utils.engine import Engine, ThreadPool, get_property
+from bigdl_tpu.utils.table import T, Table
+from bigdl_tpu.utils import logger as logger_filter

@@ -1,0 +1,54 @@
+"""Logging control (the LoggerFilter analog).
+
+Reference: utils/LoggerFilter.scala (134 LoC — redirects Spark's noisy
+INFO logs to a file, keeps the framework's console logging).  Here the
+noise sources are jax/XLA instead of Spark.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+__all__ = ["redirect_noise_logs", "disable", "log_file"]
+
+_NOISY = ("jax._src.xla_bridge", "jax._src.dispatch",
+          "jax._src.compiler", "jax._src.cache_key",
+          "jax.experimental", "absl")
+
+
+def redirect_noise_logs(path: Optional[str] = None,
+                        console_level: int = logging.WARNING) -> None:
+    """Send jax/XLA chatter to ``path`` (default ``bigdl.log`` in cwd,
+    ≙ LoggerFilter.redirectSparkInfoLogs) and raise their console level.
+    """
+    path = path or os.path.join(os.getcwd(), "bigdl.log")
+    handler = logging.FileHandler(path)
+    handler.setFormatter(logging.Formatter(
+        "%(asctime)s %(levelname)s %(name)s - %(message)s"))
+    for name in _NOISY:
+        lg = logging.getLogger(name)
+        lg.addHandler(handler)
+        lg.setLevel(logging.INFO)
+        for h in list(lg.handlers):
+            if isinstance(h, logging.StreamHandler) \
+                    and not isinstance(h, logging.FileHandler):
+                h.setLevel(console_level)
+        lg.propagate = False
+
+
+def disable() -> None:
+    """Silence the noisy loggers entirely
+    (≙ ``bigdl.utils.LoggerFilter.disable``)."""
+    for name in _NOISY:
+        logging.getLogger(name).setLevel(logging.ERROR)
+
+
+def log_file(path: str) -> None:
+    """Also write the framework's own logs to ``path``
+    (≙ ``bigdl.utils.LoggerFilter.logFile``)."""
+    handler = logging.FileHandler(path)
+    handler.setFormatter(logging.Formatter(
+        "%(asctime)s %(levelname)s %(name)s - %(message)s"))
+    logging.getLogger("bigdl_tpu").addHandler(handler)

@@ -1,0 +1,112 @@
+"""Torch-style Table (the ``T()`` DSL).
+
+Reference: utils/Table.scala (378 LoC) — the heterogeneous, 1-based
+int-keyed container used both as an Activity (multi-tensor layer IO)
+and as a state/config dict.  In the TPU-native stack multi-tensor IO is
+plain tuples/pytrees, but Table is kept for API parity: it IS a
+registered pytree, so a Table can flow through jitted forwards.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator
+
+import jax
+
+__all__ = ["Table", "T"]
+
+
+class Table:
+    """1-based int-keyed (plus named-key) container
+    (reference utils/Table.scala)."""
+
+    def __init__(self, *items, **named):
+        self._state: Dict[Any, Any] = {}
+        for i, v in enumerate(items):
+            self._state[i + 1] = v
+        self._state.update(named)
+
+    # torch-style API ------------------------------------------------------
+    def __getitem__(self, key):
+        return self._state[key]
+
+    def __setitem__(self, key, value):
+        self._state[key] = value
+
+    def __contains__(self, key):
+        return key in self._state
+
+    def get(self, key, default=None):
+        return self._state.get(key, default)
+
+    def length(self) -> int:
+        """Count of consecutive int keys from 1 (reference
+        Table.length)."""
+        n = 0
+        while (n + 1) in self._state:
+            n += 1
+        return n
+
+    def insert(self, value) -> "Table":
+        self._state[self.length() + 1] = value
+        return self
+
+    def remove(self, key=None):
+        if key is None:
+            key = self.length()
+        return self._state.pop(key, None)
+
+    def keys(self):
+        return self._state.keys()
+
+    def values(self):
+        return self._state.values()
+
+    def items(self):
+        return self._state.items()
+
+    def __iter__(self) -> Iterator:
+        """Iterate the 1..n array part."""
+        for i in range(1, self.length() + 1):
+            yield self._state[i]
+
+    def __len__(self):
+        return self.length()
+
+    def __eq__(self, other):
+        return isinstance(other, Table) and self._state == other._state
+
+    def __repr__(self):
+        inner = ", ".join(f"{k}: {v!r}" for k, v in self._state.items())
+        return f"T({{{inner}}})"
+
+    def to_tuple(self):
+        return tuple(self)
+
+
+def T(*items, **named) -> Table:
+    """The reference's ``T()`` constructor sugar."""
+    return Table(*items, **named)
+
+
+jax.tree_util.register_pytree_with_keys(
+    Table,
+    lambda t: ([(jax.tree_util.DictKey(k), v)
+                for k, v in sorted(t._state.items(), key=lambda kv:
+                                   (isinstance(kv[0], str), str(kv[0])))],
+               tuple(sorted(t._state.keys(), key=lambda k:
+                            (isinstance(k, str), str(k))))),
+    lambda keys, children: _table_from(keys, children),
+    flatten_func=lambda t: (
+        [v for _, v in sorted(t._state.items(), key=lambda kv:
+                              (isinstance(kv[0], str), str(kv[0])))],
+        tuple(sorted(t._state.keys(), key=lambda k:
+                     (isinstance(k, str), str(k))))),
+)
+
+
+def _table_from(keys, children) -> Table:
+    t = Table()
+    for k, v in zip(keys, children):
+        t[k] = v
+    return t

@@ -1,0 +1,180 @@
+"""Torch7 .t7 codec, Table DSL, Metrics, and logger tests.
+
+Mirrors reference TorchFileSpec (utils/), TableSpec, MetricsSpec.
+"""
+
+import logging
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.interop.torch_file import (TorchObject, load_t7,
+                                          load_torch_module, save_t7)
+from bigdl_tpu.optim.metrics import Metrics
+from bigdl_tpu.utils.table import T, Table
+from bigdl_tpu.utils import set_seed
+
+
+# ---------------- t7 ----------------
+
+def test_t7_scalar_string_table_roundtrip(tmp_path):
+    p = str(tmp_path / "x.t7")
+    save_t7(p, 42)
+    assert load_t7(p) == 42
+    save_t7(p, 3.5)
+    assert load_t7(p) == 3.5
+    save_t7(p, "hello")
+    assert load_t7(p) == "hello"
+    save_t7(p, True)
+    assert load_t7(p) is True
+    save_t7(p, {1: "a", 2: {1: 7}, "key": 9})
+    back = load_t7(p)
+    assert back[1] == "a" and back[2][1] == 7 and back["key"] == 9
+
+
+def test_t7_tensor_roundtrip(tmp_path):
+    p = str(tmp_path / "t.t7")
+    for dt in (np.float32, np.float64, np.int64, np.int32):
+        arr = (np.arange(24).reshape(2, 3, 4) * 1.5).astype(dt)
+        save_t7(p, arr)
+        back = load_t7(p)
+        assert back.dtype == dt
+        np.testing.assert_allclose(back, arr)
+
+
+def test_t7_tensor_in_table(tmp_path):
+    p = str(tmp_path / "tt.t7")
+    w = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+    save_t7(p, {"weight": w, "n": 5})
+    back = load_t7(p)
+    np.testing.assert_allclose(back["weight"], w)
+    assert back["n"] == 5
+
+
+def _write_torch_module(path, cls, payload, writer_cls=None):
+    """Emit a TORCH record for an nn class wrapping a table payload."""
+    from bigdl_tpu.interop.torch_file import _Writer
+    with open(path, "wb") as f:
+        w = _Writer(f)
+        import struct
+        f.write(struct.pack("<i", 4))          # TYPE_TORCH
+        f.write(struct.pack("<i", w._idx()))   # index
+        w._string("V 1")
+        w._string(cls)
+        w.write(payload)
+
+
+def test_load_torch_module_linear(tmp_path):
+    p = str(tmp_path / "lin.t7")
+    wt = np.random.RandomState(1).randn(2, 5).astype(np.float32)
+    b = np.random.RandomState(2).randn(2).astype(np.float32)
+    _write_torch_module(p, "nn.Linear", {"weight": wt, "bias": b})
+    m = load_torch_module(p)
+    assert isinstance(m, nn.Linear)
+    np.testing.assert_allclose(np.asarray(m.weight), wt)
+    x = jnp.asarray(np.random.RandomState(3).randn(3, 5), jnp.float32)
+    want = np.asarray(x) @ wt.T + b
+    np.testing.assert_allclose(np.asarray(m(x)), want, rtol=1e-5)
+
+
+def test_load_torch_module_unknown_class(tmp_path):
+    p = str(tmp_path / "u.t7")
+    _write_torch_module(p, "nn.ExoticLayer", {})
+    obj = load_t7(p)
+    assert isinstance(obj, TorchObject)
+    with pytest.raises(ValueError, match="ExoticLayer"):
+        load_torch_module(p)
+
+
+# ---------------- Table ----------------
+
+def test_table_basics():
+    t = T(10, 20, name="x")
+    assert t[1] == 10 and t[2] == 20 and t["name"] == "x"
+    assert t.length() == 2 and len(t) == 2
+    t.insert(30)
+    assert t[3] == 30
+    assert list(t) == [10, 20, 30]
+    assert t.remove() == 30
+    assert t.length() == 2
+    assert T(1, 2) == T(1, 2)
+
+
+def test_table_is_pytree():
+    t = T(jnp.ones(3), jnp.zeros(2), tag=jnp.asarray(5.0))
+    doubled = jax.tree_util.tree_map(lambda x: x * 2, t)
+    assert isinstance(doubled, Table)
+    np.testing.assert_allclose(np.asarray(doubled[1]), 2.0)
+    np.testing.assert_allclose(np.asarray(doubled["tag"]), 10.0)
+
+    @jax.jit
+    def f(tbl):
+        return tbl[1].sum() + tbl[2].sum() + tbl["tag"]
+
+    assert float(f(t)) == pytest.approx(8.0)
+
+
+def test_table_as_layer_input():
+    """Table flows through table-op layers like a tuple."""
+    add = nn.CAddTable()
+    out = add(T(jnp.ones(4), jnp.full(4, 2.0)))
+    np.testing.assert_allclose(np.asarray(out), 3.0)
+
+
+# ---------------- Metrics ----------------
+
+def test_metrics_accumulate_and_summary():
+    m = Metrics()
+    m.add("phase", 1.0)
+    m.add("phase", 3.0)
+    assert m.mean("phase") == pytest.approx(2.0)
+    m.set("other", 10.0, parallelism=5)
+    assert m.get("other") == (10.0, 5)
+    s = m.summary()
+    assert "phase" in s and "other" in s
+    m.reset()
+    assert m.get("phase") == (0.0, 0)
+
+
+def test_metrics_time_context():
+    import time
+    m = Metrics()
+    with m.time("sleep"):
+        time.sleep(0.01)
+    total, count = m.get("sleep")
+    assert count == 1 and total >= 0.005
+
+
+def test_optimizer_populates_metrics():
+    from bigdl_tpu.dataset.dataset import LocalDataSet, Sample
+    from bigdl_tpu.dataset.transformer import SampleToMiniBatch
+    from bigdl_tpu.optim import Optimizer, SGD, Trigger
+    set_seed(0)
+    rng = np.random.RandomState(0)
+    samples = [Sample(rng.randn(4).astype(np.float32),
+                      rng.randn(1).astype(np.float32)) for _ in range(16)]
+    ds = LocalDataSet(samples).transform(SampleToMiniBatch(8))
+    opt = (Optimizer(nn.Linear(4, 1), ds, nn.MSECriterion())
+           .set_optim_method(SGD(0.01))
+           .set_end_when(Trigger.max_epoch(2)))
+    opt.optimize()
+    assert opt.metrics.get("device step time")[1] >= 2
+    assert opt.metrics.get("data load and transfer")[1] >= 2
+
+
+# ---------------- logger ----------------
+
+def test_logger_filter(tmp_path):
+    from bigdl_tpu.utils.logger import disable, log_file, \
+        redirect_noise_logs
+    redirect_noise_logs(str(tmp_path / "noise.log"))
+    logging.getLogger("jax._src.dispatch").info("to file only")
+    assert (tmp_path / "noise.log").exists()
+    disable()
+    assert logging.getLogger("absl").level == logging.ERROR
+    log_file(str(tmp_path / "app.log"))
+    logging.getLogger("bigdl_tpu").warning("hello")
+    assert "hello" in (tmp_path / "app.log").read_text()
