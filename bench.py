@@ -1,40 +1,138 @@
-"""Benchmark entry: ResNet-50 ImageNet-shape training throughput on the
-available accelerator (one TPU chip under the driver).
+"""Benchmark entry: ResNet-50 ImageNet-shape training throughput + MFU on
+the available accelerator (one TPU chip under the driver).
 
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "mfu": ...}
 
-Baseline for vs_baseline: the reference's published ResNet-50 recipe
-throughput per CPU core — BigDL trains ResNet-50 at global batch 8192 on
-2048 Xeon cores (models/resnet/README.md); sustained ~1.1 img/s/core
-(whitepaper-era Broadwell measurements ⇒ ~2250 img/s cluster-wide).
-vs_baseline reports our img/s on ONE chip divided by the reference's
-img/s on one 32-core executor (~35 img/s) — i.e. chip-for-executor
-speedup.
+Never exits with a raw traceback: backend init is retried with backoff
+(the chip may be transiently held), and any failure still emits a
+machine-readable diagnostic JSON line.
+
+Baseline for vs_baseline: the reference's published ResNet-50 recipe —
+BigDL trains ResNet-50 at global batch 8192 on 2048 Xeon cores
+(models/resnet/README.md:85-150); whitepaper-era Broadwell measurements
+imply ~35 img/s per 32-core executor.  vs_baseline = our img/s on ONE
+chip / 35 (chip-for-executor speedup).
+
+MFU: model FLOPs per optimizer step (XLA cost analysis of the compiled
+step when available, else the analytic ResNet-50 count 3x2x4.09 GFLOP
+per image) / step time / chip peak bf16 FLOPs (device_kind lookup).
+North star: >=45% MFU (BASELINE.md).
 """
 
 from __future__ import annotations
 
 import json
+import sys
 import time
 
 import numpy as np
 
 
-def main():
+def _emit(obj):
+    print(json.dumps(obj), flush=True)
+
+
+def _emit_failure(reason: str):
+    _emit({"metric": "resnet50_train_img_per_sec", "value": 0.0,
+           "unit": "images/sec/chip", "vs_baseline": 0.0, "error": reason})
+
+
+# Dense bf16 peak FLOP/s per chip by device_kind substring (public specs).
+_PEAK_BF16 = [
+    ("v6", 918e12), ("v5p", 459e12), ("v5e", 197e12), ("v5 lite", 197e12),
+    ("v5litepod", 197e12), ("v4", 275e12), ("v3", 123e12), ("v2", 46e12),
+]
+
+
+def _peak_flops(device_kind: str):
+    kind = (device_kind or "").lower()
+    for key, peak in _PEAK_BF16:
+        if key in kind:
+            return peak
+    return None
+
+
+def _init_backend(attempts: int = 3, deadline_s: float = 150.0):
+    """jax.devices() with retry/backoff under an overall deadline — one
+    transient backend hiccup must not erase the round's perf evidence
+    (round-1 failure mode), but a slow-failing init must not eat the
+    whole driver budget either."""
     import jax
+    t0 = time.time()
+    delay = 5.0
+    last = None
+    for i in range(attempts):
+        try:
+            devs = jax.devices()
+            return jax, devs[0]
+        except Exception as e:  # backend UNAVAILABLE, chip held, ...
+            last = e
+            sys.stderr.write(
+                f"[bench] backend init attempt {i + 1}/{attempts} failed: "
+                f"{type(e).__name__}: {e}\n")
+            if i + 1 == attempts or time.time() - t0 + delay > deadline_s:
+                break
+            try:
+                import jax.extend.backend
+                jax.extend.backend.clear_backends()
+            except Exception:
+                pass
+            time.sleep(delay)
+            delay *= 2
+    raise RuntimeError(
+        f"backend init failed after {time.time() - t0:.0f}s "
+        f"(is another process holding the chip?): {last}") from last
+
+
+def _start_watchdog(budget_s: float = 540.0):
+    """If the bench hasn't finished within budget (e.g. backend init or
+    compile blocked indefinitely), emit the diagnostic JSON line and
+    hard-exit — the driver must always receive parseable output."""
+    import threading
+
+    def fire():
+        _emit_failure(f"watchdog: bench exceeded {budget_s:.0f}s "
+                      f"(blocked backend init or compile)")
+        import os
+        os._exit(2)
+
+    t = threading.Timer(budget_s, fire)
+    t.daemon = True
+    t.start()
+    return t
+
+
+def main():
+    watchdog = _start_watchdog()
+    try:
+        jax, dev = _init_backend()
+    except Exception as e:
+        _emit_failure(f"backend_init: {e}")
+        watchdog.cancel()
+        return
+    try:
+        _bench(jax, dev)
+    except Exception as e:
+        import traceback
+        sys.stderr.write(traceback.format_exc())
+        _emit_failure(f"{type(e).__name__}: {e}")
+    finally:
+        watchdog.cancel()
+
+
+def _bench(jax, dev):
     import jax.numpy as jnp
 
-    from bigdl_tpu.core.module import partition, combine, forward_context
+    from bigdl_tpu.core.module import partition, combine, cast_floating
     import bigdl_tpu.nn as nn
     from bigdl_tpu.models import resnet50
     from bigdl_tpu.optim.methods import SGD
     from bigdl_tpu.utils import set_seed
 
     set_seed(0)
-    dev = jax.devices()[0]
     on_tpu = dev.platform != "cpu"
-    batch = 64 if on_tpu else 8
+    batch = 128 if on_tpu else 8
     size = 224 if on_tpu else 64
 
     model = resnet50(class_num=1000)
@@ -44,9 +142,6 @@ def main():
     params_tree, rest = partition(model)
     opt_state = method.init_state(params_tree)
 
-    from bigdl_tpu.core.module import cast_floating
-
-    @jax.jit
     def step(params, rest, opt_state, x, y):
         def loss_fn(p):
             m = cast_floating(combine(p, rest), jnp.bfloat16)
@@ -59,34 +154,67 @@ def main():
         rest2 = cast_floating(rest2, jnp.float32)
         return params, rest2, opt_state2, loss
 
+    jitted = jax.jit(step)
+
     rng = np.random.default_rng(0)
     x = jnp.asarray(rng.normal(size=(batch, size, size, 3)),
                     dtype=jnp.float32)
     y = jnp.asarray(rng.integers(1, 1001, size=(batch,)))
 
-    # warmup/compile
-    params_tree, rest, opt_state, loss = step(
+    # AOT compile ONCE; the same executable serves cost analysis and the
+    # timed loop (a second trace/compile would double the startup cost).
+    t_c = time.perf_counter()
+    compiled = jitted.lower(params_tree, rest, opt_state, x, y).compile()
+    sys.stderr.write(
+        f"[bench] compiled in {time.perf_counter() - t_c:.1f}s\n")
+
+    # FLOPs per step, preferring XLA's own cost analysis of the program
+    # we actually execute (fwd+bwd+update); analytic ResNet-50 fallback.
+    flops_per_step = None
+    try:
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        f = float(cost.get("flops", -1.0)) if cost else -1.0
+        if f > 0:
+            flops_per_step = f
+    except Exception:
+        pass
+    if flops_per_step is None:
+        # 4.089e9 MACs fwd per 224px image; x2 FLOP/MAC; train ~ 3x fwd
+        flops_per_step = 3 * 2 * 4.089e9 * batch * (size / 224.0) ** 2
+
+    # warmup
+    params_tree, rest, opt_state, loss = compiled(
         params_tree, rest, opt_state, x, y)
     jax.block_until_ready(loss)
 
     iters = 20 if on_tpu else 3
     t0 = time.perf_counter()
     for _ in range(iters):
-        params_tree, rest, opt_state, loss = step(
+        params_tree, rest, opt_state, loss = compiled(
             params_tree, rest, opt_state, x, y)
     jax.block_until_ready(loss)
     dt = time.perf_counter() - t0
 
-    img_per_sec = batch * iters / dt
-    # reference: ~35 img/s per 32-core executor (see module docstring)
-    vs_baseline = img_per_sec / 35.0
-    print(json.dumps({
+    step_time = dt / iters
+    img_per_sec = batch / step_time
+    peak = _peak_flops(getattr(dev, "device_kind", ""))
+    mfu = (flops_per_step / step_time / peak) if (peak and on_tpu) else None
+    out = {
         "metric": f"resnet50_train_img_per_sec_bs{batch}_{size}px_"
                   f"{dev.platform}",
         "value": round(img_per_sec, 2),
         "unit": "images/sec/chip",
-        "vs_baseline": round(vs_baseline, 2),
-    }))
+        # reference: ~35 img/s per 32-core executor (module docstring)
+        "vs_baseline": round(img_per_sec / 35.0, 2),
+        "step_time_ms": round(step_time * 1e3, 2),
+        "flops_per_step": flops_per_step,
+        "device_kind": getattr(dev, "device_kind", dev.platform),
+    }
+    if mfu is not None:
+        out["mfu"] = round(mfu, 4)
+    _emit(out)
 
 
 if __name__ == "__main__":

@@ -4,7 +4,7 @@ from bigdl_tpu.parallel.mesh import (
 )
 from bigdl_tpu.parallel.sharding import (
     ShardingRules, replicated, shard_model_params, model_shardings,
-    fsdp_spec,
+    fsdp_spec, tensor_parallel_rules,
 )
 from bigdl_tpu.parallel.ring_attention import (
     ring_attention, ring_self_attention,

@@ -30,7 +30,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = [
     "ShardingRules", "replicated", "shard_model_params",
-    "model_shardings", "fsdp_spec",
+    "model_shardings", "fsdp_spec", "tensor_parallel_rules",
 ]
 
 
@@ -51,6 +51,42 @@ def fsdp_spec(shape: Tuple[int, ...], mesh: Mesh,
             spec[d] = axis
             return P(*spec)
     return P()
+
+
+def tensor_parallel_rules(column: Sequence[str] = (),
+                          row: Sequence[str] = (),
+                          axis: str = "model",
+                          fsdp: bool = False) -> "ShardingRules":
+    """Megatron-style tensor parallelism as sharding rules.
+
+    ``column`` / ``row`` are regex patterns over parameter paths (e.g.
+    ``r"layers\\[0\\]"``).  Column-parallel splits the OUTPUT feature dim
+    (weight dim 0 in this framework's Torch-style ``(out, in)`` layout,
+    bias dim 0); row-parallel splits the INPUT dim (weight dim 1, bias
+    replicated).  Under GSPMD the classic Megatron choreography — g/f
+    identity-forward/all-reduce-backward conjugate operators around a
+    column→row pair — is recovered automatically: annotating the weight
+    shardings is enough and XLA's sharding propagation inserts the
+    all-reduce after the row-parallel matmul.  (The reference has no TP
+    at all — SURVEY §2.6 build-target row.)
+    """
+    def col_spec(shape, mesh):
+        if axis not in mesh.axis_names:
+            return P()
+        if len(shape) >= 1 and shape[0] % mesh.shape[axis] == 0:
+            return P(axis, *([None] * (len(shape) - 1)))
+        return P()
+
+    def row_spec(shape, mesh):
+        if axis not in mesh.axis_names:
+            return P()
+        if len(shape) >= 2 and shape[1] % mesh.shape[axis] == 0:
+            return P(None, axis, *([None] * (len(shape) - 2)))
+        return P()  # 1-D leaves (row-layer bias) stay replicated
+
+    rules = ([(pat, col_spec) for pat in column]
+             + [(pat, row_spec) for pat in row])
+    return ShardingRules(rules, fsdp=fsdp)
 
 
 class ShardingRules:
