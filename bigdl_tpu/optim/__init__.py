@@ -15,3 +15,6 @@ from bigdl_tpu.optim.optimizer import Optimizer
 from bigdl_tpu.optim.predictor import (
     Predictor, Evaluator, PredictionService,
 )
+from bigdl_tpu.optim.profiling import (
+    module_forward_times, times_by_module_type, profile_trace,
+)

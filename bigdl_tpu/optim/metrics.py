@@ -44,12 +44,14 @@ class Metrics:
             acc.total = float(value)
             acc.count = max(parallelism, 1)
 
-    def add(self, name: str, value: float) -> None:
-        """Accumulate an observation (reference Metrics.add)."""
+    def add(self, name: str, value: float, count: int = 1) -> None:
+        """Accumulate an observation (reference Metrics.add).  ``count``
+        lets one amortized measurement stand for several iterations
+        (async loss-readback windows)."""
         with self._lock:
             acc = self._accs.setdefault(name, _Acc())
-            acc.total += float(value)
-            acc.count += 1
+            acc.total += float(value) * count
+            acc.count += count
 
     @contextmanager
     def time(self, name: str):

@@ -91,6 +91,9 @@ class Optimizer:
         self.mesh_config = MeshConfig(data=-1)
         self.sharding_rules = ShardingRules()
         self.compute_dtype = None  # e.g. jnp.bfloat16 for mixed precision
+        self.log_interval: Optional[int] = None  # None = auto
+        self.profile_dir: Optional[str] = None
+        self.profile_steps: Tuple[int, int] = (2, 5)
         self.train_summary = None
         self.metrics = Metrics()
         self.val_summary = None
@@ -172,6 +175,25 @@ class Optimizer:
     def set_compute_dtype(self, dtype) -> "Optimizer":
         """bf16 compute (≙ FP16 gradient compression — but end-to-end)."""
         self.compute_dtype = dtype
+        return self
+
+    def set_log_interval(self, n: int) -> "Optimizer":
+        """Fetch/log the loss every n iterations instead of every
+        iteration.  The device step itself never blocks on the host —
+        readback of up to n losses is batched, so the device queue stays
+        full (the reference paid one Spark-job barrier per iteration;
+        SPMD need not pay an analogous host sync)."""
+        self.log_interval = int(n)
+        return self
+
+    def set_profiler(self, logdir: str,
+                     start_iteration: int = 2,
+                     num_iterations: int = 5) -> "Optimizer":
+        """Capture a jax.profiler trace of iterations
+        [start_iteration, start_iteration + num_iterations) into logdir
+        (view in TensorBoard's profile tab)."""
+        self.profile_dir = logdir
+        self.profile_steps = (int(start_iteration), int(num_iterations))
         return self
 
     def set_train_summary(self, summary) -> "Optimizer":
@@ -349,6 +371,83 @@ class Optimizer:
             if a in mesh.axis_names:
                 n_data *= mesh.shape[a]
 
+        # Loss readback cadence: the device step is dispatched without
+        # blocking the host; up to `interval` iterations' losses are
+        # fetched together (the reference paid one Spark-job barrier per
+        # iteration — DistriOptimizer.scala:425; SPMD need not pay an
+        # analogous per-step host sync).  Loss-reading triggers force
+        # per-iteration freshness.
+        needs_loss = any(
+            t is not None and getattr(t, "needs_loss", False)
+            for t in (self.end_when, self.val_trigger,
+                      self.checkpoint_trigger))
+        interval = self.log_interval
+        if interval is None:
+            interval = 1 if needs_loss else 8
+        elif needs_loss and interval > 1:
+            logger.warning(
+                "log_interval=%d ignored: a loss-reading trigger "
+                "(minLoss) requires per-iteration loss readback",
+                interval)
+            interval = 1
+        # pending: (neval, epoch, n_records, records_cum, loss_device)
+        pending: List[Tuple] = []
+        window = {"start": time.time(), "data_t": 0.0}
+        prof_start, prof_num = self.profile_steps
+        prof_active = False
+        prof_done = False
+
+        def flush_pending(params_groups, rest, opt_states):
+            if not pending:
+                return
+            losses = [float(l) for *_, l in pending]  # blocks on the last
+            window_dt = time.time() - window["start"]
+            per_iter = window_dt / len(pending)
+            self.metrics.add("device step time",
+                             max(window_dt - window["data_t"], 0.0)
+                             / len(pending), count=len(pending))
+            n_pend = len(pending)
+            for idx, ((neval_i, epoch_i, n_i, cum_i, _), lf) in enumerate(
+                    zip(pending, losses)):
+                logger.info(
+                    "Epoch %d %d/%d][Iteration %d][Wall Clock %.3fs] "
+                    "Trained %d records in %.4f seconds. Throughput is "
+                    "%.1f records/second. Loss is %.4f.",
+                    epoch_i, cum_i, total_records, neval_i,
+                    time.time() - wall_start, n_i, per_iter,
+                    n_i / max(per_iter, 1e-9), lf)
+                if self.train_summary is not None:
+                    self.train_summary.add_scalar("Loss", lf, neval_i)
+                    self.train_summary.add_scalar(
+                        "Throughput", n_i / max(per_iter, 1e-9), neval_i)
+                    # steps_back rewinds the schedule's step counter to
+                    # the value it had when iteration neval_i ran
+                    lr = _scheduled_lr(methods[0], opt_states[0], epoch_i,
+                                       steps_back=n_pend - 1 - idx)
+                    if lr is not None:
+                        self.train_summary.add_scalar(
+                            "LearningRate", lr, neval_i)
+            if self.train_summary is not None:
+                # Parameter histograms: only the latest iteration's
+                # params exist host-side, so snapshots fire at flush
+                # granularity (one per window, labeled with the real
+                # neval) instead of fabricating a per-step trajectory.
+                trig = (self.train_summary.get_summary_trigger(
+                    "Parameters")
+                    if hasattr(self.train_summary,
+                               "get_summary_trigger") else None)
+                last_neval = pending[-1][0]
+                if trig is not None and any(
+                        trig({**self.state, "neval": ne, "epoch": ep})
+                        for (ne, ep, *_r) in pending):
+                    self.train_summary.save_parameters(
+                        combine(self._merge_groups_host(params_groups),
+                                rest), last_neval)
+            self.state["loss"] = losses[-1]
+            pending.clear()
+            window["start"] = time.time()
+            window["data_t"] = 0.0
+
         saw_batches = False
         with mesh:
             while not self.end_when(self.state):
@@ -363,6 +462,11 @@ class Optimizer:
                             f"divisible by the mesh's data-parallel extent "
                             f"{n_data}; choose a batch size that is a "
                             f"multiple of it")
+                    if (self.profile_dir and not prof_active
+                            and not prof_done
+                            and self.state["neval"] >= prof_start):
+                        jax.profiler.start_trace(self.profile_dir)
+                        prof_active = True
                     it_start = time.time()
                     x = jax.device_put(jnp.asarray(batch.get_input()),
                                        x_sharding)
@@ -373,49 +477,34 @@ class Optimizer:
                     t_data = time.time() - it_start
                     params_groups, rest, opt_states, loss = step(
                         params_groups, rest, opt_states, x, y, rng, epoch)
-                    loss_f = float(loss)  # blocks on the device step
                     self.metrics.add("data load and transfer", t_data)
-                    self.metrics.add("device step time",
-                                     time.time() - it_start - t_data)
+                    window["data_t"] += t_data
                     n = batch.size()
                     self.state["records"] += n
-                    self.state["loss"] = loss_f
-                    dt = time.time() - it_start
-                    logger.info(
-                        "Epoch %d %d/%d][Iteration %d][Wall Clock %.3fs] "
-                        "Trained %d records in %.4f seconds. Throughput is "
-                        "%.1f records/second. Loss is %.4f.",
-                        epoch, self.state["records"], total_records,
-                        self.state["neval"], time.time() - wall_start,
-                        n, dt, n / max(dt, 1e-9), loss_f)
-                    if self.train_summary is not None:
-                        self.train_summary.add_scalar(
-                            "Loss", loss_f, self.state["neval"])
-                        self.train_summary.add_scalar(
-                            "Throughput", n / max(dt, 1e-9),
-                            self.state["neval"])
-                        lr = _scheduled_lr(methods[0], opt_states[0],
-                                           epoch)
-                        if lr is not None:
-                            self.train_summary.add_scalar(
-                                "LearningRate", lr, self.state["neval"])
-                        trig = (self.train_summary.get_summary_trigger(
-                            "Parameters")
-                            if hasattr(self.train_summary,
-                                       "get_summary_trigger") else None)
-                        if trig is not None and trig(self.state):
-                            self.train_summary.save_parameters(
-                                combine(self._merge_groups_host(
-                                    params_groups), rest),
-                                self.state["neval"])
+                    pending.append((self.state["neval"], epoch, n,
+                                    self.state["records"], loss))
+                    if prof_active and (self.state["neval"]
+                                        >= prof_start + prof_num - 1):
+                        jax.block_until_ready(loss)
+                        jax.profiler.stop_trace()
+                        prof_active = False
+                        prof_done = True
+                    if len(pending) >= interval:
+                        flush_pending(params_groups, rest, opt_states)
                     self.state["neval"] += 1
                     self.state["is_epoch_end"] = False
-                    self._maybe_validate_checkpoint(
-                        params_groups, rest, opt_states, eval_step)
+                    if self._want_validate_checkpoint():
+                        flush_pending(params_groups, rest, opt_states)
+                        self._maybe_validate_checkpoint(
+                            params_groups, rest, opt_states, eval_step)
+                        # don't bill validation/checkpoint wall time to
+                        # the next window's "device step time"
+                        window["start"] = time.time()
                     if self.end_when(self.state):
                         break
                 self.state["epoch"] += 1
                 self.state["is_epoch_end"] = True
+                flush_pending(params_groups, rest, opt_states)
                 logger.info("Epoch %d finished in %.2f s", epoch,
                             time.time() - epoch_start)
                 if not saw_batches:
@@ -424,6 +513,10 @@ class Optimizer:
                         "fewer samples than one batch with drop_last)")
                 self._maybe_validate_checkpoint(
                     params_groups, rest, opt_states, eval_step)
+                window["start"] = time.time()
+            flush_pending(params_groups, rest, opt_states)
+            if prof_active:
+                jax.profiler.stop_trace()
 
         # write trained params back into the user's module (in place)
         trained = combine(self._merge_groups_host(params_groups), rest)
@@ -439,6 +532,16 @@ class Optimizer:
         return jax.tree_util.tree_unflatten(self._ptreedef, full)
 
     # ---- helpers ---------------------------------------------------------
+
+    def _want_validate_checkpoint(self) -> bool:
+        """Cheap host-side pre-check so the hot loop only flushes pending
+        loss readback when validation/checkpoint will actually fire."""
+        return ((self.val_trigger is not None
+                 and self.val_trigger(self.state)
+                 and self._last_val_neval != self.state["neval"])
+                or (self.checkpoint_trigger is not None
+                    and self.checkpoint_trigger(self.state)
+                    and self._last_ckpt_neval != self.state["neval"]))
 
     def _maybe_validate_checkpoint(self, params_groups, rest,
                                    opt_states, eval_step):
@@ -510,9 +613,10 @@ def _to_plain(tree):
     return jax.tree_util.tree_map(lambda x: np.asarray(x), tree)
 
 
-def _scheduled_lr(method, opt_state, epoch):
-    """The learning rate actually applied this iteration: base lr run
-    through the method's schedule at the current step count."""
+def _scheduled_lr(method, opt_state, epoch, steps_back: int = 0):
+    """The learning rate applied ``steps_back`` iterations before the
+    given (post-update) opt_state: base lr run through the method's
+    schedule at the step count that iteration saw."""
     lr = getattr(method, "learning_rate", None)
     if lr is None:
         return None
@@ -523,6 +627,6 @@ def _scheduled_lr(method, opt_state, epoch):
     if t is None:
         return float(lr)
     # opt_state is post-update: the step just taken evaluated the
-    # schedule at t-1
-    t_applied = jnp.maximum(jnp.asarray(t) - 1, 0)
+    # schedule at t-1; earlier window iterations at t-1-steps_back
+    t_applied = jnp.maximum(jnp.asarray(t) - 1 - steps_back, 0)
     return float(sched(lr, t_applied, epoch))

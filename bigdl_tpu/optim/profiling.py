@@ -1,0 +1,87 @@
+"""Per-module timing + profiler hooks.
+
+Reference: nn/abstractnn/AbstractModule.scala:191-213 — every module
+accumulates forwardTime/backwardTime, exposed via ``getTimes`` /
+``getTimesGroupByModuleType``; DistriOptimizer dumps phase timings.
+
+TPU-native stance: inside jit there are no per-module boundaries (XLA
+fuses across them), so per-module wall times are measured EAGERLY — the
+right tool for "which layer is the hotspot" triage — while whole-step
+truth comes from ``jax.profiler`` traces (the TensorBoard profile shows
+the fused XLA ops).  Both are provided here.
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+from typing import Dict, List, Tuple
+
+import jax
+
+__all__ = ["module_forward_times", "times_by_module_type", "profile_trace"]
+
+
+@contextmanager
+def _timed(model, records: List):
+    """Temporarily wrap every submodule's forward with a blocking timer.
+
+    Timings nest (a container's time includes its children), matching the
+    reference's getTimes semantics."""
+    patched = []
+    for path, mod in model.named_modules():
+        orig = mod.forward
+
+        def make(orig=orig, path=path, mod=mod):
+            def timed_forward(*a, **k):
+                t0 = time.perf_counter()
+                out = orig(*a, **k)
+                jax.block_until_ready(out)
+                records.append((path, type(mod).__name__,
+                                time.perf_counter() - t0))
+                return out
+            return timed_forward
+
+        # object.__setattr__: Module.__setattr__ would classify a plain
+        # function into _static and pollute the pytree aux data.
+        object.__setattr__(mod, "forward", make())
+        patched.append(mod)
+    try:
+        yield
+    finally:
+        for mod in patched:
+            try:
+                object.__delattr__(mod, "forward")
+            except AttributeError:
+                pass
+
+
+def module_forward_times(model, *inputs) -> List[Tuple[str, str, float]]:
+    """Run one eager forward and return [(path, type, seconds)] per
+    submodule, outermost last (≙ AbstractModule.getTimes)."""
+    records: List[Tuple[str, str, float]] = []
+    with _timed(model, records):
+        model.forward(*inputs)
+    return records
+
+
+def times_by_module_type(records) -> Dict[str, Tuple[int, float]]:
+    """Aggregate getTimes records as type -> (count, total_seconds)
+    (≙ getTimesGroupByModuleType)."""
+    out: Dict[str, Tuple[int, float]] = {}
+    for _path, tname, sec in records:
+        cnt, tot = out.get(tname, (0, 0.0))
+        out[tname] = (cnt + 1, tot + sec)
+    return out
+
+
+@contextmanager
+def profile_trace(logdir: str):
+    """jax.profiler trace context — view in TensorBoard's profile tab.
+    The whole-step source of truth on real hardware (fused XLA ops,
+    per-op HLO timings, HBM traffic)."""
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

@@ -15,9 +15,13 @@ __all__ = ["Trigger"]
 
 
 class Trigger:
-    def __init__(self, fn, name="trigger"):
+    def __init__(self, fn, name="trigger", needs_loss=False):
         self._fn = fn
         self.name = name
+        # True when the trigger reads state["loss"]: tells the Optimizer
+        # it must fetch the loss every iteration (otherwise readback is
+        # batched asynchronously to keep the device queue full)
+        self.needs_loss = needs_loss
 
     def __call__(self, state: Dict) -> bool:
         return bool(self._fn(state))
@@ -49,12 +53,17 @@ class Trigger:
     @staticmethod
     def min_loss(threshold: float) -> "Trigger":
         return Trigger(lambda s: s.get("loss", float("inf")) < threshold,
-                       f"minLoss({threshold})")
+                       f"minLoss({threshold})", needs_loss=True)
 
     @staticmethod
     def and_(*triggers: "Trigger") -> "Trigger":
-        return Trigger(lambda s: all(t(s) for t in triggers), "and")
+        # getattr: plain callables are accepted wherever Triggers are
+        return Trigger(lambda s: all(t(s) for t in triggers), "and",
+                       needs_loss=any(getattr(t, "needs_loss", False)
+                                      for t in triggers))
 
     @staticmethod
     def or_(*triggers: "Trigger") -> "Trigger":
-        return Trigger(lambda s: any(t(s) for t in triggers), "or")
+        return Trigger(lambda s: any(t(s) for t in triggers), "or",
+                       needs_loss=any(getattr(t, "needs_loss", False)
+                                      for t in triggers))
