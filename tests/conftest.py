@@ -10,15 +10,13 @@ importing jax, BEFORE any backend is initialized.
 """
 
 import os
+import sys
 
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import jax  # noqa: E402
+from __graft_entry__ import _ensure_devices  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+jax = _ensure_devices(8, force_cpu=True)
 
 import pytest  # noqa: E402
 
