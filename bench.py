@@ -2,11 +2,20 @@
 the available accelerator (one TPU chip under the driver).
 
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "mfu": ...}
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
 
-Never exits with a raw traceback: backend init is retried with backoff
-(the chip may be transiently held), and any failure still emits a
-machine-readable diagnostic JSON line.
+The headline number drives the FRAMEWORK loop (``Optimizer.optimize()``
+with mesh + bf16 compute + async loss readback), not a hand-rolled
+bypass; the raw jitted-step number is reported alongside so a gap
+between the two reads as framework overhead to fix.
+
+MFU is reported against two rooflines:
+  * ``mfu_vs_spec``     — public peak bf16 FLOP/s for the device kind;
+    flagged ``mfu_vs_spec_suspect`` when > 1 (a virtualized chip can
+    out-run its nominal spec, which makes the spec denominator wrong).
+  * ``mfu_vs_measured`` — an empirically calibrated roofline: a chained
+    big-matmul microbench run on the same chip right before the model
+    bench.  This is the honest utilization number.
 
 Baseline for vs_baseline: the reference's published ResNet-50 recipe —
 BigDL trains ResNet-50 at global batch 8192 on 2048 Xeon cores
@@ -14,15 +23,14 @@ BigDL trains ResNet-50 at global batch 8192 on 2048 Xeon cores
 imply ~35 img/s per 32-core executor.  vs_baseline = our img/s on ONE
 chip / 35 (chip-for-executor speedup).
 
-MFU: model FLOPs per optimizer step (XLA cost analysis of the compiled
-step when available, else the analytic ResNet-50 count 3x2x4.09 GFLOP
-per image) / step time / chip peak bf16 FLOPs (device_kind lookup).
-North star: >=45% MFU (BASELINE.md).
+Never exits with a raw traceback: backend init is retried with backoff,
+and any failure still emits a machine-readable diagnostic JSON line.
 """
 
 from __future__ import annotations
 
 import json
+import logging
 import sys
 import time
 
@@ -55,9 +63,8 @@ def _peak_flops(device_kind: str):
 
 def _init_backend(attempts: int = 3, deadline_s: float = 150.0):
     """jax.devices() with retry/backoff under an overall deadline — one
-    transient backend hiccup must not erase the round's perf evidence
-    (round-1 failure mode), but a slow-failing init must not eat the
-    whole driver budget either."""
+    transient backend hiccup must not erase the round's perf evidence,
+    but a slow-failing init must not eat the whole driver budget either."""
     import jax
     t0 = time.time()
     delay = 5.0
@@ -121,6 +128,63 @@ def main():
         watchdog.cancel()
 
 
+def _measure_peak(jax, on_tpu: bool) -> float:
+    """Empirical bf16 matmul roofline of this chip: chained square
+    matmuls (each output feeds the next, so XLA cannot elide any) timed
+    after warmup.  Returns achieved FLOP/s.
+
+    Timing forces completion with a scalar readback — on the tunneled
+    bench backend ``block_until_ready`` returns before the work is done,
+    which is how round 2 shipped a 204%-of-spec MFU."""
+    import jax.numpy as jnp
+
+    n = 8192 if on_tpu else 512
+    chain_len = 8
+
+    @jax.jit
+    def chain(a, b):
+        for _ in range(chain_len):
+            a = jnp.matmul(a, b, preferred_element_type=jnp.bfloat16)
+        return a
+
+    a = jnp.full((n, n), 0.5, jnp.bfloat16)
+    b = jnp.full((n, n), 1e-4, jnp.bfloat16)
+
+    def run(reps):
+        out = a
+        for _ in range(reps):
+            out = chain(out, b)
+        return float(jnp.sum(out, dtype=jnp.float32))
+
+    run(1)  # compile chain + the readback reduction
+    reps = 16 if on_tpu else 2
+    t0 = time.perf_counter()
+    run(reps)
+    dt = time.perf_counter() - t0
+    flops = 2.0 * n * n * n * chain_len * reps
+    peak = flops / dt
+    sys.stderr.write(f"[bench] measured matmul roofline: "
+                     f"{peak / 1e12:.1f} TFLOP/s bf16 ({n}^3 x{chain_len}, "
+                     f"{dt:.2f}s)\n")
+    return peak
+
+
+class _TimedData:
+    """Wraps a dataset with per-epoch iterator timestamps, so the bench
+    can time steady-state epochs of the real Optimizer loop."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.epoch_starts = []
+
+    def data(self, train=True):
+        self.epoch_starts.append(time.perf_counter())
+        return self.inner.data(train)
+
+    def size(self) -> int:
+        return self.inner.size()
+
+
 def _bench(jax, dev):
     import jax.numpy as jnp
 
@@ -130,10 +194,14 @@ def _bench(jax, dev):
     from bigdl_tpu.optim.methods import SGD
     from bigdl_tpu.utils import set_seed
 
+    logging.getLogger("bigdl_tpu.optim").setLevel(logging.WARNING)
     set_seed(0)
     on_tpu = dev.platform != "cpu"
     batch = 128 if on_tpu else 8
     size = 224 if on_tpu else 64
+
+    peak_measured = _measure_peak(jax, on_tpu)
+    peak_spec = _peak_flops(getattr(dev, "device_kind", ""))
 
     model = resnet50(class_num=1000)
     criterion = nn.CrossEntropyCriterion()
@@ -154,19 +222,20 @@ def _bench(jax, dev):
         rest2 = cast_floating(rest2, jnp.float32)
         return params, rest2, opt_state2, loss
 
-    jitted = jax.jit(step)
+    jitted = jax.jit(step, donate_argnums=(0, 1, 2))
 
     rng = np.random.default_rng(0)
-    x = jnp.asarray(rng.normal(size=(batch, size, size, 3)),
-                    dtype=jnp.float32)
-    y = jnp.asarray(rng.integers(1, 1001, size=(batch,)))
+    x_np = rng.normal(size=(batch, size, size, 3)).astype(np.float32)
+    y_np = rng.integers(1, 1001, size=(batch,))
+    x = jnp.asarray(x_np)
+    y = jnp.asarray(y_np)
 
     # AOT compile ONCE; the same executable serves cost analysis and the
     # timed loop (a second trace/compile would double the startup cost).
     t_c = time.perf_counter()
     compiled = jitted.lower(params_tree, rest, opt_state, x, y).compile()
     sys.stderr.write(
-        f"[bench] compiled in {time.perf_counter() - t_c:.1f}s\n")
+        f"[bench] raw step compiled in {time.perf_counter() - t_c:.1f}s\n")
 
     # FLOPs per step, preferring XLA's own cost analysis of the program
     # we actually execute (fwd+bwd+update); analytic ResNet-50 fallback.
@@ -184,36 +253,94 @@ def _bench(jax, dev):
         # 4.089e9 MACs fwd per 224px image; x2 FLOP/MAC; train ~ 3x fwd
         flops_per_step = 3 * 2 * 4.089e9 * batch * (size / 224.0) ** 2
 
-    # warmup
+    # warmup (float() forces real completion; see _measure_peak)
     params_tree, rest, opt_state, loss = compiled(
         params_tree, rest, opt_state, x, y)
-    jax.block_until_ready(loss)
+    float(loss)
 
     iters = 20 if on_tpu else 3
     t0 = time.perf_counter()
     for _ in range(iters):
         params_tree, rest, opt_state, loss = compiled(
             params_tree, rest, opt_state, x, y)
-    jax.block_until_ready(loss)
+    float(loss)
     dt = time.perf_counter() - t0
+    raw_step_time = dt / iters
+    raw_img_per_sec = batch / raw_step_time
 
-    step_time = dt / iters
-    img_per_sec = batch / step_time
-    peak = _peak_flops(getattr(dev, "device_kind", ""))
-    mfu = (flops_per_step / step_time / peak) if (peak and on_tpu) else None
+    # ---- the framework loop: Optimizer.optimize() on a 1-chip mesh ------
+    opt_step_time = opt_img_per_sec = None
+    opt_error = None
+    try:
+        from bigdl_tpu.dataset.dataset import DataSet, MiniBatch
+        from bigdl_tpu.optim import Optimizer, Trigger
+
+        iters_per_epoch = 20 if on_tpu else 3
+        epochs = 4
+        # The batches share one host buffer, so the HBM cache holds it
+        # once; epochs after the first pay zero host->device transfer
+        # (cache_on_device ≙ the reference's CachedDistriDataSet).
+        data = _TimedData(
+            DataSet.array([MiniBatch(x_np, y_np)
+                           for _ in range(iters_per_epoch)], shuffle=False)
+            .cache_on_device())
+        model2 = resnet50(class_num=1000)
+        opt = (Optimizer(model2, data, nn.CrossEntropyCriterion())
+               .set_optim_method(SGD(0.1, momentum=0.9, dampening=0.0))
+               .set_end_when(Trigger.max_epoch(epochs))
+               .set_compute_dtype(jnp.bfloat16)
+               .set_log_interval(iters_per_epoch))
+        t_c = time.perf_counter()
+        opt.optimize()
+        sys.stderr.write(f"[bench] optimizer loop ({epochs} epochs) in "
+                         f"{time.perf_counter() - t_c:.1f}s\n")
+        # epoch 1 pays trace+compile; steady state = best later epoch
+        starts = data.epoch_starts
+        epoch_times = [b - a for a, b in zip(starts[1:], starts[2:])]
+        opt_step_time = min(epoch_times) / iters_per_epoch
+        opt_img_per_sec = batch / opt_step_time
+    except Exception as e:
+        import traceback
+        sys.stderr.write(traceback.format_exc())
+        opt_error = f"{type(e).__name__}: {e}"
+
+    def mfu(per_step_flops, step_time, peak):
+        if not (peak and on_tpu and step_time):
+            return None
+        return round(per_step_flops / step_time / peak, 4)
+
+    headline = opt_img_per_sec if opt_img_per_sec else raw_img_per_sec
     out = {
         "metric": f"resnet50_train_img_per_sec_bs{batch}_{size}px_"
                   f"{dev.platform}",
-        "value": round(img_per_sec, 2),
+        "value": round(headline, 2),
         "unit": "images/sec/chip",
         # reference: ~35 img/s per 32-core executor (module docstring)
-        "vs_baseline": round(img_per_sec / 35.0, 2),
-        "step_time_ms": round(step_time * 1e3, 2),
+        "vs_baseline": round(headline / 35.0, 2),
+        "raw_step_img_per_sec": round(raw_img_per_sec, 2),
+        "raw_step_time_ms": round(raw_step_time * 1e3, 2),
         "flops_per_step": flops_per_step,
+        "peak_measured_flops": peak_measured,
         "device_kind": getattr(dev, "device_kind", dev.platform),
     }
-    if mfu is not None:
-        out["mfu"] = round(mfu, 4)
+    if opt_img_per_sec:
+        out["optimizer_img_per_sec"] = round(opt_img_per_sec, 2)
+        out["optimizer_step_time_ms"] = round(opt_step_time * 1e3, 2)
+        overhead = 1.0 - opt_img_per_sec / raw_img_per_sec
+        out["optimizer_overhead_pct"] = round(100.0 * overhead, 1)
+    if opt_error:
+        out["optimizer_error"] = opt_error
+    m_spec = mfu(flops_per_step, opt_step_time or raw_step_time, peak_spec)
+    m_meas = mfu(flops_per_step, opt_step_time or raw_step_time,
+                 peak_measured)
+    if m_spec is not None:
+        out["mfu_vs_spec"] = m_spec
+        if m_spec > 1.0:
+            # >100% of nominal spec: the spec denominator is wrong for
+            # this (virtualized) part — trust mfu_vs_measured instead
+            out["mfu_vs_spec_suspect"] = True
+    if m_meas is not None:
+        out["mfu_vs_measured"] = m_meas
     _emit(out)
 
 

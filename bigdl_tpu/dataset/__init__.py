@@ -1,5 +1,6 @@
 from bigdl_tpu.dataset.dataset import (
-    DataSet, LocalDataSet, DistributedDataSet, MiniBatch, Sample,
+    DataSet, LocalDataSet, DistributedDataSet, DeviceCachedDataSet,
+    MiniBatch, Sample,
 )
 from bigdl_tpu.dataset.transformer import (
     Transformer, SampleToMiniBatch, Identity as IdentityTransformer,

@@ -400,7 +400,11 @@ class Optimizer:
         def flush_pending(params_groups, rest, opt_states):
             if not pending:
                 return
-            losses = [float(l) for *_, l in pending]  # blocks on the last
+            # ONE device->host transfer for the whole window: per-scalar
+            # float() readbacks pay a full round trip each, which on a
+            # high-latency host<->device link dwarfs the payload
+            losses = np.asarray(jnp.stack([l for *_, l in pending])
+                                ).astype(float).tolist()
             window_dt = time.time() - window["start"]
             per_iter = window_dt / len(pending)
             self.metrics.add("device step time",
