@@ -1,0 +1,60 @@
+"""CIFAR-10 binary-format reader (reference models/resnet/Utils.scala
+loadTrain/loadTest over data_batch_*.bin; no downloader — zero-egress
+environments must provide the files).
+
+Each record: 1 label byte + 3072 bytes (3x32x32, channel-major RGB).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List
+
+import numpy as np
+
+from bigdl_tpu.dataset.dataset import Sample
+
+__all__ = ["load_cifar10", "cifar10_samples", "synthetic_cifar10",
+           "TRAIN_MEAN", "TRAIN_STD"]
+
+# reference models/resnet/Utils.scala trainMean/trainStd (RGB, [0,1])
+TRAIN_MEAN = np.array([0.4913996, 0.4821584, 0.44653094], np.float32)
+TRAIN_STD = np.array([0.24703223, 0.24348513, 0.26158784], np.float32)
+
+
+def _read_bin(path: str):
+    raw = np.fromfile(path, np.uint8).reshape(-1, 3073)
+    labels = raw[:, 0]
+    # channel-major [n, 3, 32, 32] → NHWC
+    images = raw[:, 1:].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+    return images, labels
+
+
+def load_cifar10(folder: str, train: bool = True):
+    """Returns (images [n, 32, 32, 3] uint8, labels [n] uint8).  Accepts
+    the folder itself or its ``cifar-10-batches-bin`` subdirectory."""
+    sub = os.path.join(folder, "cifar-10-batches-bin")
+    if os.path.isdir(sub):
+        folder = sub
+    files = ([f"data_batch_{i}.bin" for i in range(1, 6)] if train
+             else ["test_batch.bin"])
+    images, labels = zip(*(_read_bin(os.path.join(folder, f))
+                           for f in files))
+    return np.concatenate(images), np.concatenate(labels)
+
+
+def cifar10_samples(folder: str, train: bool = True) -> List[Sample]:
+    """Normalized NHWC Samples with 1-based labels."""
+    images, labels = load_cifar10(folder, train)
+    feats = (images.astype(np.float32) / 255.0 - TRAIN_MEAN) / TRAIN_STD
+    return [Sample(f, int(l) + 1) for f, l in zip(feats, labels)]
+
+
+def synthetic_cifar10(n: int = 512, seed: int = 0) -> List[Sample]:
+    """Class-separable fake images for file-less e2e runs."""
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, 10, size=n)
+    protos = rng.normal(size=(10, 32, 32, 3)).astype(np.float32)
+    feats = protos[labels] + 0.3 * rng.normal(size=(n, 32, 32, 3))
+    return [Sample(f.astype(np.float32), int(l) + 1)
+            for f, l in zip(feats, labels)]

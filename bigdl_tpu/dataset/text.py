@@ -18,7 +18,8 @@ from bigdl_tpu.dataset.dataset import Sample
 from bigdl_tpu.dataset.transformer import Transformer
 
 __all__ = ["Tokenizer", "Dictionary", "TextToLabeledSentence",
-           "ptb_batches", "synthetic_ptb"]
+           "ptb_batches", "synthetic_ptb", "read_ptb_words",
+           "load_ptb_corpus"]
 
 
 class Tokenizer(Transformer):
@@ -89,6 +90,40 @@ def ptb_batches(word_ids: np.ndarray, batch_size: int, num_steps: int):
         y = data[:, i + 1:i + num_steps + 1]
         batches.append((x, y))
     return batches
+
+
+def read_ptb_words(path: str) -> List[str]:
+    """One PTB file → flat word list with ``<eos>`` appended per line
+    (reference example/languagemodel/PTBWordLM.scala readWords)."""
+    words: List[str] = []
+    with open(path, "r", encoding="utf-8") as f:
+        for line in f:
+            words.extend(line.split())
+            words.append("<eos>")
+    return words
+
+
+def load_ptb_corpus(folder: str, vocab_size: Optional[int] = 10000):
+    """Real-corpus PTB pipeline (reference PTBWordLM.scala:60-90):
+    reads ``ptb.train.txt`` / ``ptb.valid.txt`` / ``ptb.test.txt``,
+    builds the Dictionary on the training split, and returns
+    ``(train_ids, valid_ids, test_ids, dictionary)`` as 1-based int32
+    id streams ready for :func:`ptb_batches`."""
+    paths = {split: os.path.join(folder, f"ptb.{split}.txt")
+             for split in ("train", "valid", "test")}
+    missing = [p for p in paths.values() if not os.path.exists(p)]
+    if missing:
+        raise FileNotFoundError(
+            f"PTB files not found: {missing} (expected the Penn Treebank "
+            f"ptb.train/valid/test.txt layout under {folder!r})")
+    train_words = read_ptb_words(paths["train"])
+    dictionary = Dictionary([train_words], vocab_size=vocab_size)
+    words = {"train": train_words,
+             "valid": read_ptb_words(paths["valid"]),
+             "test": read_ptb_words(paths["test"])}
+    ids = {split: np.asarray(dictionary.indices(w), np.int32)
+           for split, w in words.items()}
+    return ids["train"], ids["valid"], ids["test"], dictionary
 
 
 def synthetic_ptb(n_words: int = 40000, vocab: int = 1000, seed: int = 0):

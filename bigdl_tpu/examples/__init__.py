@@ -1,0 +1,11 @@
+"""Runnable end-user entry points (reference models/*/Train.scala CLI
+mains + example/ suite).  Installed as console scripts:
+
+    bigdl-tpu-lenet         LeNet-5 on MNIST
+    bigdl-tpu-resnet-cifar  ResNet-20/32/... on CIFAR-10
+    bigdl-tpu-ptb           PTB word-level LSTM LM
+
+Each mirrors its reference scopt CLI (folder/batch/epochs/lr/checkpoint/
+summaries) and falls back to synthetic data with ``--synthetic`` so the
+end-to-end path runs in zero-egress environments.
+"""

@@ -1,0 +1,70 @@
+"""Shared CLI plumbing for the example mains (reference
+models/*/Utils.scala scopt parsers)."""
+
+from __future__ import annotations
+
+import argparse
+import logging
+
+
+def _positive_int(s: str) -> int:
+    n = int(s)
+    if n <= 0:
+        raise argparse.ArgumentTypeError(f"must be positive, got {n}")
+    return n
+
+
+def base_parser(description: str) -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=description)
+    p.add_argument("-f", "--folder", default=None,
+                   help="dataset directory (omit with --synthetic)")
+    p.add_argument("-b", "--batch-size", type=int, default=128)
+    p.add_argument("-e", "--max-epoch", type=int, default=5)
+    p.add_argument("-r", "--learning-rate", type=float, default=0.05)
+    p.add_argument("--checkpoint", default=None,
+                   help="directory for per-epoch checkpoints")
+    p.add_argument("--state", default=None,
+                   help="checkpoint file to resume from")
+    p.add_argument("--summary-dir", default=None,
+                   help="TensorBoard event-file directory")
+    p.add_argument("--synthetic", type=_positive_int, default=None,
+                   metavar="N",
+                   help="train on N synthetic samples instead of files")
+    p.add_argument("--bf16", action="store_true",
+                   help="bfloat16 compute with fp32 master weights")
+    p.add_argument("--cache-device", action="store_true",
+                   help="cache the dataset in device memory (HBM)")
+    p.add_argument("-q", "--quiet", action="store_true")
+    return p
+
+
+def setup(args, app_name: str):
+    """Logging + summaries; returns (train_summary, val_summary)."""
+    logging.basicConfig(
+        level=logging.WARNING if args.quiet else logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s - %(message)s")
+    if not args.folder and args.synthetic is None:
+        raise SystemExit(
+            f"{app_name}: provide --folder DATA_DIR or --synthetic N")
+    if args.summary_dir:
+        from bigdl_tpu.visualization import TrainSummary, ValidationSummary
+        return (TrainSummary(args.summary_dir, app_name),
+                ValidationSummary(args.summary_dir, app_name))
+    return None, None
+
+
+def apply_common(opt, args, train_summary=None, val_summary=None):
+    """Wire the flags every example shares into the Optimizer."""
+    from bigdl_tpu.optim import Trigger
+    if args.bf16:
+        import jax.numpy as jnp
+        opt.set_compute_dtype(jnp.bfloat16)
+    if args.checkpoint:
+        opt.set_checkpoint(args.checkpoint, Trigger.every_epoch())
+    if args.state:
+        opt.resume(args.state)
+    if train_summary is not None:
+        opt.set_train_summary(train_summary)
+    if val_summary is not None:
+        opt.set_val_summary(val_summary)
+    return opt

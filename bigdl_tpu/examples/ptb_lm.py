@@ -1,0 +1,74 @@
+"""PTB word-level LSTM language model main (reference
+example/languagemodel/PTBWordLM.scala).
+
+    bigdl-tpu-ptb -f /data/ptb -b 20 -e 13          # real Penn Treebank
+    bigdl-tpu-ptb --synthetic 40000 -e 2            # Markov-chain corpus
+"""
+
+from __future__ import annotations
+
+import math
+
+from bigdl_tpu.examples.common import apply_common, base_parser, setup
+
+
+def main(argv=None):
+    p = base_parser("Train the PTB word-level LSTM LM")
+    p.add_argument("--vocab-size", type=int, default=10000)
+    p.add_argument("--hidden-size", type=int, default=200)
+    p.add_argument("--num-layers", type=int, default=2)
+    p.add_argument("--num-steps", type=int, default=20)
+    p.set_defaults(batch_size=20, learning_rate=1.0, max_epoch=13)
+    args = p.parse_args(argv)
+    train_summary, val_summary = setup(args, "ptb")
+
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.dataset import DataSet, MiniBatch
+    from bigdl_tpu.dataset.text import (
+        load_ptb_corpus, ptb_batches, synthetic_ptb,
+    )
+    from bigdl_tpu.models import PTBModel
+    from bigdl_tpu.optim import Loss, Optimizer, SGD, Trigger
+
+    if args.synthetic:
+        vocab = min(args.vocab_size, 1000)
+        train_ids = synthetic_ptb(args.synthetic, vocab=vocab, seed=0)
+        valid_ids = synthetic_ptb(args.synthetic // 4, vocab=vocab, seed=1)
+    else:
+        train_ids, valid_ids, _test_ids, dictionary = load_ptb_corpus(
+            args.folder, vocab_size=args.vocab_size)
+        vocab = dictionary.vocab_size()
+
+    def to_dataset(ids, shuffle):
+        batches = [MiniBatch(x, y) for x, y in
+                   ptb_batches(ids, args.batch_size, args.num_steps)]
+        return DataSet.array(batches, shuffle=shuffle)
+
+    data = to_dataset(train_ids, shuffle=True)
+    if args.cache_device:
+        data = data.cache_on_device()
+    val_data = to_dataset(valid_ids, shuffle=False)
+
+    model = PTBModel(input_size=vocab + 1,
+                     hidden_size=args.hidden_size,
+                     output_size=vocab + 1,
+                     num_layers=args.num_layers)
+    criterion = nn.TimeDistributedCriterion(
+        nn.ClassNLLCriterion(), size_average=False, dimension=2)
+    opt = (Optimizer(model, data, criterion)
+           .set_optim_method(SGD(args.learning_rate))
+           .set_end_when(Trigger.max_epoch(args.max_epoch))
+           .set_gradient_clipping_by_l2_norm(5.0)
+           .set_validation(Trigger.every_epoch(), val_data,
+                           [Loss(criterion)]))
+    apply_common(opt, args, train_summary, val_summary)
+    opt.optimize()
+    val_loss = opt.state["score"]
+    per_word = val_loss / args.num_steps  # criterion sums over timesteps
+    print(f"Final validation loss {val_loss:.4f} "
+          f"(perplexity {math.exp(min(per_word, 20.0)):.2f})")
+    return model
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,147 @@
+"""End-user entry points: dataset file readers + runnable mains
+(reference models/lenet/Train.scala, models/resnet/Train.scala,
+example/languagemodel/PTBWordLM.scala)."""
+
+import gzip
+import struct
+
+import numpy as np
+import pytest
+
+
+def _write_idx(path, arr):
+    with open(path, "wb") as f:
+        f.write(struct.pack(">i", 0x800 + arr.ndim))
+        for d in arr.shape:
+            f.write(struct.pack(">i", d))
+        f.write(arr.astype(np.uint8).tobytes())
+
+
+@pytest.fixture()
+def mnist_dir(tmp_path):
+    rng = np.random.default_rng(0)
+    for prefix, n in (("train", 32), ("t10k", 16)):
+        _write_idx(tmp_path / f"{prefix}-images-idx3-ubyte",
+                   rng.integers(0, 256, size=(n, 28, 28)))
+        _write_idx(tmp_path / f"{prefix}-labels-idx1-ubyte",
+                   rng.integers(0, 10, size=(n,)))
+    return str(tmp_path)
+
+
+def test_mnist_reader(mnist_dir):
+    from bigdl_tpu.dataset.mnist import load_mnist, mnist_samples
+    images, labels = load_mnist(mnist_dir, train=True)
+    assert images.shape == (32, 28, 28) and labels.shape == (32,)
+    samples = mnist_samples(mnist_dir, train=False)
+    assert len(samples) == 16
+    assert all(1 <= s.label <= 10 for s in samples)
+    assert abs(float(np.mean([s.feature.mean() for s in samples]))) < 3.0
+
+
+def test_mnist_reader_gz(tmp_path):
+    from bigdl_tpu.dataset.mnist import load_mnist
+    rng = np.random.default_rng(1)
+    imgs = rng.integers(0, 256, size=(4, 28, 28)).astype(np.uint8)
+    lbls = rng.integers(0, 10, size=(4,)).astype(np.uint8)
+    for name, arr in (("train-images-idx3-ubyte", imgs),
+                      ("train-labels-idx1-ubyte", lbls)):
+        raw = struct.pack(">i", 0x800 + arr.ndim)
+        for d in arr.shape:
+            raw += struct.pack(">i", d)
+        raw += arr.tobytes()
+        with gzip.open(tmp_path / (name + ".gz"), "wb") as f:
+            f.write(raw)
+    images, labels = load_mnist(str(tmp_path), train=True)
+    np.testing.assert_array_equal(images, imgs)
+    np.testing.assert_array_equal(labels, lbls)
+
+
+def test_cifar_reader(tmp_path):
+    from bigdl_tpu.dataset.cifar import cifar10_samples, load_cifar10
+    rng = np.random.default_rng(0)
+    for i in range(1, 6):
+        rec = rng.integers(0, 256, size=(8, 3073)).astype(np.uint8)
+        rec[:, 0] = rng.integers(0, 10, size=8)
+        rec.tofile(tmp_path / f"data_batch_{i}.bin")
+    rec.tofile(tmp_path / "test_batch.bin")
+    images, labels = load_cifar10(str(tmp_path), train=True)
+    assert images.shape == (40, 32, 32, 3) and labels.shape == (40,)
+    samples = cifar10_samples(str(tmp_path), train=False)
+    assert len(samples) == 8 and samples[0].feature.shape == (32, 32, 3)
+
+
+def test_ptb_corpus(tmp_path):
+    from bigdl_tpu.dataset.text import load_ptb_corpus, ptb_batches
+    text = "the cat sat on the mat\nthe dog ran\n"
+    for split in ("train", "valid", "test"):
+        (tmp_path / f"ptb.{split}.txt").write_text(text * 20)
+    train, valid, test, d = load_ptb_corpus(str(tmp_path), vocab_size=50)
+    assert d.index("the") >= 1 and d.index("<eos>") >= 1
+    assert train.dtype == np.int32 and len(train) == 11 * 20
+    batches = ptb_batches(train, batch_size=4, num_steps=5)
+    x, y = batches[0]
+    assert x.shape == (4, 5)
+    np.testing.assert_array_equal(x[:, 1:], y[:, :-1])
+
+
+def test_ptb_corpus_missing(tmp_path):
+    from bigdl_tpu.dataset.text import load_ptb_corpus
+    with pytest.raises(FileNotFoundError):
+        load_ptb_corpus(str(tmp_path))
+
+
+def test_lenet_main_synthetic(tmp_path):
+    from bigdl_tpu.examples.lenet import main
+    model = main(["--synthetic", "64", "-e", "1", "-b", "32", "-q",
+                  "--checkpoint", str(tmp_path / "ckpt")])
+    assert (tmp_path / "ckpt" / "checkpoint.npz").exists()
+    assert model is not None
+
+
+def test_lenet_main_real_files(mnist_dir):
+    from bigdl_tpu.examples.lenet import main
+    model = main(["-f", mnist_dir, "-e", "1", "-b", "16", "-q"])
+    assert model is not None
+
+
+def test_ptb_main_synthetic():
+    from bigdl_tpu.examples.ptb_lm import main
+    model = main(["--synthetic", "2000", "-e", "1", "-q", "-b", "8",
+                  "--hidden-size", "16", "--num-steps", "8",
+                  "--vocab-size", "50"])
+    assert model is not None
+
+
+def test_cache_on_device_distinct_batches():
+    """Regression: id()-recycling of freed batch arrays must not alias
+    distinct batches to one cached transfer."""
+    from bigdl_tpu.dataset import DataSet, Sample, SampleToMiniBatch
+    rng = np.random.default_rng(0)
+    samples = [Sample(rng.normal(size=(4,)).astype(np.float32), i + 1)
+               for i in range(32)]
+    data = (DataSet.array(samples, shuffle=False)
+            .transform(SampleToMiniBatch(8)).cache_on_device())
+    first = [np.asarray(b.get_input()) for b in data.data(train=False)]
+    assert len(first) == 4
+    for i in range(len(first)):
+        for j in range(i + 1, len(first)):
+            assert not np.array_equal(first[i], first[j])
+    again = [np.asarray(b.get_input()) for b in data.data(train=False)]
+    for a, b in zip(first, again):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_cache_on_device_respects_shuffle_flag():
+    from bigdl_tpu.dataset import DataSet, MiniBatch
+    batches = [MiniBatch(np.full((2, 3), i, np.float32),
+                         np.ones(2, np.int32)) for i in range(6)]
+    data = DataSet.array(batches, shuffle=False).cache_on_device()
+    vals = [float(np.asarray(b.get_input())[0, 0])
+            for b in data.data(train=True)]
+    assert vals == sorted(vals)
+
+
+def test_main_requires_data_source():
+    from bigdl_tpu.examples.lenet import main
+    with pytest.raises(SystemExit):
+        main(["-e", "1"])
