@@ -1,7 +1,7 @@
 """PTB word-level LSTM language model main (reference
 example/languagemodel/PTBWordLM.scala).
 
-    bigdl-tpu-ptb -f /data/ptb -b 20 -e 13          # real Penn Treebank
+    bigdl-tpu-ptb -f /data/ptb -b 32 -e 13          # real Penn Treebank
     bigdl-tpu-ptb --synthetic 40000 -e 2            # Markov-chain corpus
 """
 
@@ -18,7 +18,7 @@ def main(argv=None):
     p.add_argument("--hidden-size", type=int, default=200)
     p.add_argument("--num-layers", type=int, default=2)
     p.add_argument("--num-steps", type=int, default=20)
-    p.set_defaults(batch_size=20, learning_rate=1.0, max_epoch=13)
+    p.set_defaults(batch_size=32, learning_rate=1.0, max_epoch=13)
     args = p.parse_args(argv)
     train_summary, val_summary = setup(args, "ptb")
 
@@ -33,7 +33,10 @@ def main(argv=None):
     if args.synthetic:
         vocab = min(args.vocab_size, 1000)
         train_ids = synthetic_ptb(args.synthetic, vocab=vocab, seed=0)
-        valid_ids = synthetic_ptb(args.synthetic // 4, vocab=vocab, seed=1)
+        # enough words for at least one [batch, num_steps] window
+        valid_n = max(args.synthetic // 4,
+                      args.batch_size * (args.num_steps + 1) + 1)
+        valid_ids = synthetic_ptb(valid_n, vocab=vocab, seed=1)
     else:
         train_ids, valid_ids, _test_ids, dictionary = load_ptb_corpus(
             args.folder, vocab_size=args.vocab_size)

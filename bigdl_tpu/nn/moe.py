@@ -57,6 +57,16 @@ class MoE(Module):
         self.experts = ModuleList(experts)
         self.gate = Linear(hidden_size, self.num_experts, with_bias=False)
         self.aux_loss = jnp.zeros(())
+        self.expert_mesh = None
+        self.expert_axis = "expert"
+
+    def set_mesh(self, mesh: Mesh, axis: str = "expert") -> "MoE":
+        """Route ``forward`` through the expert-parallel path on this
+        mesh, so the layer composes with the Optimizer (whose jitted
+        step just calls ``model.forward``)."""
+        self.expert_mesh = mesh
+        self.expert_axis = axis
+        return self
 
     # -- routing -----------------------------------------------------------
 
@@ -91,6 +101,9 @@ class MoE(Module):
     # -- dense path --------------------------------------------------------
 
     def forward(self, x):
+        if self.expert_mesh is not None:
+            return self.forward_on_mesh(x, self.expert_mesh,
+                                        self.expert_axis)
         weights = self._route(x)  # [B, T, E]
         outs = self._apply_stacked(self._stacked_experts(), x)  # [E,B,T,H]
         return jnp.einsum("ebth,bte->bth", outs, weights)

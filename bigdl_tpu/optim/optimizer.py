@@ -316,8 +316,12 @@ class Optimizer:
                              for m, (n, d) in zip(self.val_methods, stats)]
             results = batch_results if results is None else [
                 a + b for a, b in zip(results, batch_results)]
+        if results is None:
+            raise ValueError(
+                "validation dataset produced no batches (empty split, or "
+                "fewer samples than one batch)")
         out = {}
-        for m, r in zip(self.val_methods, results or []):
+        for m, r in zip(self.val_methods, results):
             out[m.fmt] = r
             logger.info("%s is %s", m.fmt, r)
         return out

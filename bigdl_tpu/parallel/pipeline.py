@@ -118,8 +118,20 @@ class Pipeline(Module):
         super().__init__()
         self.blocks = ModuleList(blocks)
         self.num_microbatches = num_microbatches
+        self.pipe_mesh = None
+        self.pipe_axis = "pipe"
+
+    def set_mesh(self, mesh: Mesh, axis: str = "pipe") -> "Pipeline":
+        """Route ``forward`` through the GPipe schedule on this mesh, so
+        the container composes with the Optimizer (whose jitted step
+        just calls ``model.forward``)."""
+        self.pipe_mesh = mesh
+        self.pipe_axis = axis
+        return self
 
     def forward(self, x):
+        if self.pipe_mesh is not None:
+            return self.forward_on_mesh(x, self.pipe_mesh, self.pipe_axis)
         for blk in self.blocks:
             x = blk(x)
         return x
