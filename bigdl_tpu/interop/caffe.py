@@ -430,6 +430,7 @@ def load_caffe(prototxt_path: str, caffemodel_path: Optional[str] = None):
         inputs.append(node)
     layer_map: Dict[str, Module] = {}
 
+    consumed_ids = set()
     for spec in layer_defs:
         lname = _one(spec, "name", "")
         ltype = _one(spec, "type", "")
@@ -453,20 +454,20 @@ def load_caffe(prototxt_path: str, caffemodel_path: Optional[str] = None):
         module.set_name(lname)
         layer_map[lname] = module
         prev = [blob_nodes[b] for b in bottoms if b in blob_nodes]
+        # consumption is per NODE, not per blob name: an in-place layer
+        # (top == bottom, e.g. ReLU) consumes the old producer but its
+        # own output under the same name must stay an output candidate
+        consumed_ids.update(id(p) for p in prev)
         node = node_of(module, *prev)
         for t in tops:
             blob_nodes[t] = node
-    outputs = _find_outputs(blob_nodes, layer_defs)
+    outputs = _find_outputs(blob_nodes, consumed_ids)
     model = Graph(inputs, outputs)
     return model, layer_map
 
 
-def _find_outputs(blob_nodes, layer_defs):
-    consumed = set()
-    for spec in layer_defs:
-        for b in spec.get("bottom", []):
-            consumed.add(str(b))
-    outs = [n for name, n in blob_nodes.items() if name not in consumed]
+def _find_outputs(blob_nodes, consumed_ids):
+    outs = [n for n in blob_nodes.values() if id(n) not in consumed_ids]
     # dedup preserving order
     seen, uniq = set(), []
     for n in outs:

@@ -128,9 +128,12 @@ class _Reader:
                                 dt).astype(_STORAGE_DTYPES[cls])
             self.refs[idx] = arr
             return arr
-        payload = self.read()
-        obj = TorchObject(cls, payload)
+        # register BEFORE reading the payload: a cyclic reference back to
+        # this object (e.g. container.modules[i].parent) must resolve to
+        # the same instance instead of re-parsing the byte stream
+        obj = TorchObject(cls, None)
         self.refs[idx] = obj
+        obj.payload = self.read()
         return obj
 
 
@@ -145,6 +148,10 @@ class _Writer:
     def __init__(self, f: BinaryIO):
         self.f = f
         self.next_idx = 1
+        # id(obj) -> (obj, idx): written tables are recorded so shared or
+        # cyclic references serialize as an index reuse, matching the
+        # reader (and Torch7 itself); retaining obj keeps ids stable
+        self.memo: Dict[int, Any] = {}
 
     def _int(self, v: int):
         self.f.write(struct.pack("<i", v))
@@ -174,7 +181,12 @@ class _Writer:
             self._string(v)
         elif isinstance(v, dict):
             self._int(TYPE_TABLE)
-            self._int(self._idx())
+            if id(v) in self.memo:
+                self._int(self.memo[id(v)][1])
+                return
+            idx = self._idx()
+            self.memo[id(v)] = (v, idx)
+            self._int(idx)
             self._int(len(v))
             for k, val in v.items():
                 self.write(k)

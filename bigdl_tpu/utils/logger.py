@@ -18,17 +18,33 @@ _NOISY = ("jax._src.xla_bridge", "jax._src.dispatch",
           "jax.experimental", "absl")
 
 
+def _file_handler(path: str) -> logging.FileHandler:
+    handler = logging.FileHandler(path)
+    handler.setFormatter(logging.Formatter(
+        "%(asctime)s %(levelname)s %(name)s - %(message)s"))
+    handler._bigdl_tpu_handler = True
+    return handler
+
+
+def _drop_ours(lg: logging.Logger) -> None:
+    """Remove handlers a previous call installed — repeated setup calls
+    (notebooks re-running cells) must not duplicate every log line."""
+    for h in list(lg.handlers):
+        if getattr(h, "_bigdl_tpu_handler", False):
+            lg.removeHandler(h)
+            h.close()
+
+
 def redirect_noise_logs(path: Optional[str] = None,
                         console_level: int = logging.WARNING) -> None:
     """Send jax/XLA chatter to ``path`` (default ``bigdl.log`` in cwd,
     ≙ LoggerFilter.redirectSparkInfoLogs) and raise their console level.
     """
     path = path or os.path.join(os.getcwd(), "bigdl.log")
-    handler = logging.FileHandler(path)
-    handler.setFormatter(logging.Formatter(
-        "%(asctime)s %(levelname)s %(name)s - %(message)s"))
+    handler = _file_handler(path)
     for name in _NOISY:
         lg = logging.getLogger(name)
+        _drop_ours(lg)
         lg.addHandler(handler)
         lg.setLevel(logging.INFO)
         for h in list(lg.handlers):
@@ -48,7 +64,6 @@ def disable() -> None:
 def log_file(path: str) -> None:
     """Also write the framework's own logs to ``path``
     (≙ ``bigdl.utils.LoggerFilter.logFile``)."""
-    handler = logging.FileHandler(path)
-    handler.setFormatter(logging.Formatter(
-        "%(asctime)s %(levelname)s %(name)s - %(message)s"))
-    logging.getLogger("bigdl_tpu").addHandler(handler)
+    lg = logging.getLogger("bigdl_tpu")
+    _drop_ours(lg)
+    lg.addHandler(_file_handler(path))

@@ -184,3 +184,35 @@ def test_unknown_layer_type_errors(tmp_path):
                 'top: "x" }\n')
     with pytest.raises(ValueError, match="FancyOp"):
         load_caffe(proto_p)
+
+
+def test_inplace_final_layer_is_output(tmp_path):
+    """Regression (round-1 advisor #4): an in-place layer (top == bottom)
+    as the LAST layer must stay the graph output — consumption tracking
+    by blob NAME dropped it."""
+    proto = '''
+    name: "InPlaceNet"
+    input: "data"
+    layer {
+      name: "conv1" type: "Convolution" bottom: "data" top: "conv1"
+      convolution_param { num_output: 3 kernel_size: 1 stride: 1 }
+    }
+    layer { name: "relu1" type: "ReLU" bottom: "conv1" top: "conv1" }
+    '''
+    rng = np.random.RandomState(7)
+    weights = {"conv1": {
+        "type": "Convolution", "bottom": ["data"], "top": ["conv1"],
+        "blobs": [rng.randn(3, 2, 1, 1).astype(np.float32),
+                  rng.randn(3).astype(np.float32)]}}
+    proto_p = str(tmp_path / "deploy.prototxt")
+    model_p = str(tmp_path / "net.caffemodel")
+    with open(proto_p, "w") as f:
+        f.write(proto)
+    save_caffemodel(model_p, weights)
+    model, layer_map = load_caffe(proto_p, model_p)
+    model.eval_mode()
+    x = rng.randn(2, 2, 4, 4).astype(np.float32)
+    out = np.asarray(model(jnp.asarray(x)))
+    assert out.shape == (2, 3, 4, 4)
+    assert (out >= 0).all(), "ReLU (the in-place final layer) missing"
+    assert (out == 0).any(), "output is pre-ReLU conv values"

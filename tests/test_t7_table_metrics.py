@@ -178,3 +178,57 @@ def test_logger_filter(tmp_path):
     log_file(str(tmp_path / "app.log"))
     logging.getLogger("bigdl_tpu").warning("hello")
     assert "hello" in (tmp_path / "app.log").read_text()
+
+
+def test_t7_cyclic_object_reference(tmp_path):
+    """Regression (round-1 advisor #3): a torch object whose payload
+    refers back to itself must resolve to the same instance."""
+    import struct
+    p = tmp_path / "cyclic.t7"
+    with open(p, "wb") as f:
+        def w_int(v): f.write(struct.pack("<i", v))
+        def w_str(s):
+            w_int(len(s)); f.write(s.encode())
+        w_int(4); w_int(1)              # TYPE_TORCH, idx 1
+        w_str("V 1"); w_str("nn.Weird")
+        w_int(3); w_int(2)              # payload: TYPE_TABLE, idx 2
+        w_int(1)                        # one entry
+        w_int(2); w_str("self")         # key "self"
+        w_int(4); w_int(1)              # value: TYPE_TORCH ref to idx 1
+    obj = load_t7(str(p))
+    assert isinstance(obj, TorchObject)
+    assert obj.payload["self"] is obj
+
+
+def test_t7_shared_table_roundtrip(tmp_path):
+    """Writer memoizes shared tables so reader identity is preserved."""
+    shared = {"v": 1.0}
+    top = {"a": shared, "b": shared}
+    p = str(tmp_path / "shared.t7")
+    save_t7(p, top)
+    back = load_t7(p)
+    assert back["a"] is back["b"]
+    d = {}
+    d["self"] = d
+    p2 = str(tmp_path / "cyclic_w.t7")
+    save_t7(p2, d)
+    back2 = load_t7(p2)
+    assert back2["self"] is back2
+
+
+def test_logger_no_duplicate_handlers(tmp_path):
+    """Regression (round-1 advisor #5): repeated setup calls must not
+    stack FileHandlers (every log line would duplicate)."""
+    import logging
+    from bigdl_tpu.utils.logger import log_file, redirect_noise_logs
+    redirect_noise_logs(str(tmp_path / "noise.log"))
+    redirect_noise_logs(str(tmp_path / "noise.log"))
+    for name in ("jax._src.dispatch", "absl"):
+        ours = [h for h in logging.getLogger(name).handlers
+                if getattr(h, "_bigdl_tpu_handler", False)]
+        assert len(ours) == 1, f"{name}: {len(ours)} handlers"
+    log_file(str(tmp_path / "own.log"))
+    log_file(str(tmp_path / "own.log"))
+    ours = [h for h in logging.getLogger("bigdl_tpu").handlers
+            if getattr(h, "_bigdl_tpu_handler", False)]
+    assert len(ours) == 1
