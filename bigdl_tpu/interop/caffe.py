@@ -454,10 +454,12 @@ def load_caffe(prototxt_path: str, caffemodel_path: Optional[str] = None):
         module.set_name(lname)
         layer_map[lname] = module
         prev = [blob_nodes[b] for b in bottoms if b in blob_nodes]
-        # consumption is per NODE, not per blob name: an in-place layer
-        # (top == bottom, e.g. ReLU) consumes the old producer but its
-        # own output under the same name must stay an output candidate
-        consumed_ids.update(id(p) for p in prev)
+        # consumption is per (node, blob-name) pair: an in-place layer
+        # (top == bottom, e.g. ReLU) consumes the OLD producer under that
+        # name while its own same-named output stays an output candidate,
+        # and a multi-top layer with one top consumed keeps the others
+        consumed_ids.update((id(blob_nodes[b]), b) for b in bottoms
+                            if b in blob_nodes)
         node = node_of(module, *prev)
         for t in tops:
             blob_nodes[t] = node
@@ -467,7 +469,8 @@ def load_caffe(prototxt_path: str, caffemodel_path: Optional[str] = None):
 
 
 def _find_outputs(blob_nodes, consumed_ids):
-    outs = [n for n in blob_nodes.values() if id(n) not in consumed_ids]
+    outs = [n for name, n in blob_nodes.items()
+            if (id(n), name) not in consumed_ids]
     # dedup preserving order
     seen, uniq = set(), []
     for n in outs:

@@ -202,6 +202,11 @@ class _Writer:
         return i
 
     def _write_tensor(self, arr: np.ndarray):
+        self._int(TYPE_TORCH)
+        orig = arr
+        if id(orig) in self.memo:
+            self._int(self.memo[id(orig)][1])
+            return
         cls = {np.dtype(np.float64): "torch.DoubleTensor",
                np.dtype(np.float32): "torch.FloatTensor",
                np.dtype(np.int64): "torch.LongTensor",
@@ -210,8 +215,9 @@ class _Writer:
         if cls is None:
             arr = arr.astype(np.float32)
             cls = "torch.FloatTensor"
-        self._int(TYPE_TORCH)
-        self._int(self._idx())
+        idx = self._idx()
+        self.memo[id(orig)] = (orig, idx)
+        self._int(idx)
         self._string("V 1")
         self._string(cls)
         arr_c = np.ascontiguousarray(arr)

@@ -9,3 +9,4 @@ from bigdl_tpu.models.autoencoder import Autoencoder, autoencoder
 from bigdl_tpu.models.maskrcnn import (
     MaskRCNN, MaskRCNNParams, ResNetFPNBackbone,
 )
+from bigdl_tpu.models.ssd import SSDVGG16, ssd_vgg16_300

@@ -122,9 +122,9 @@ class MaskRCNN(Module):
         images, image_info = inputs
         im_hw = image_info[:2]
         features = self.backbone(images)
-        proposals, _ = self.rpn((features, im_hw))
+        proposals, prop_scores = self.rpn((features, im_hw))
         boxes, labels, scores, valid = self.box_head(
-            (features, proposals, im_hw))
+            (features, proposals, im_hw, prop_scores > -jnp.inf))
         masks, _ = self.mask_head((features, boxes, labels))
         masks = jnp.where(valid[:, None, None], masks, 0.0)
         return boxes, labels, scores, valid, masks

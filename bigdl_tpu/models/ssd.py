@@ -1,0 +1,162 @@
+"""SSD-300 VGG16 single-shot detector (BASELINE config #5).
+
+Reference composition: the reference builds SSD by Caffe import
+(utils/caffe/CaffeLoader.scala:57) over its PriorBox
+(nn/PriorBox.scala:1), NormalizeScale (nn/NormalizeScale.scala) and
+DetectionOutputSSD (nn/DetectionOutputSSD.scala:1) layers; the int8
+SSD/VGG16 benchmark is whitepaper fig10 (docs/docs/whitepaper.md:192).
+Here the same architecture is assembled natively (NHWC, XLA-fused) with
+Caffe-SSD layer names throughout so ``load_caffe_weights`` drops a
+published VGG_coco/VOC caffemodel straight in.
+
+Input: [B, 300, 300, 3] mean-subtracted BGR (Caffe convention).
+Output: [B, keep_top_k, 6] rows [label, score, x1, y1, x2, y2] in
+normalized [0, 1] coordinates.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax
+import jax.numpy as jnp
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.core.module import Module, ModuleList
+
+__all__ = ["SSDVGG16", "ssd_vgg16_300"]
+
+# (min_size, max_size, aspect_ratios, step, n_priors) per source map
+_SSD300_PRIORS = [
+    (30.0, 60.0, (2.0,), 8.0, 4),          # conv4_3_norm, 38x38
+    (60.0, 111.0, (2.0, 3.0), 16.0, 6),    # fc7, 19x19
+    (111.0, 162.0, (2.0, 3.0), 32.0, 6),   # conv6_2, 10x10
+    (162.0, 213.0, (2.0, 3.0), 64.0, 6),   # conv7_2, 5x5
+    (213.0, 264.0, (2.0,), 100.0, 4),      # conv8_2, 3x3
+    (264.0, 315.0, (2.0,), 300.0, 4),      # conv9_2, 1x1
+]
+
+
+def _conv(nin, nout, k, stride=1, pad=0, dilation=1, name=""):
+    if dilation != 1:
+        m = nn.SpatialDilatedConvolution(nin, nout, k, k, stride, stride,
+                                         pad, pad, dilation, dilation)
+    else:
+        m = nn.SpatialConvolution(nin, nout, k, k, stride, stride, pad, pad)
+    return m.set_name(name)
+
+
+class SSDVGG16(Module):
+    """SSD-300 over the modified VGG16 base (fc6/fc7 as atrous convs,
+    pool5 3x3/s1, L2-normalized conv4_3 source)."""
+
+    def __init__(self, class_num: int = 21, nms_thresh: float = 0.45,
+                 nms_topk: int = 400, keep_top_k: int = 200,
+                 conf_thresh: float = 0.01):
+        super().__init__()
+        self.class_num = class_num
+
+        # VGG16 base, Caffe-SSD layer names
+        cfg = [(3, 64, "conv1_1"), (64, 64, "conv1_2"),
+               (64, 128, "conv2_1"), (128, 128, "conv2_2"),
+               (128, 256, "conv3_1"), (256, 256, "conv3_2"),
+               (256, 256, "conv3_3"),
+               (256, 512, "conv4_1"), (512, 512, "conv4_2"),
+               (512, 512, "conv4_3"),
+               (512, 512, "conv5_1"), (512, 512, "conv5_2"),
+               (512, 512, "conv5_3")]
+        self.base = ModuleList(
+            [_conv(i, o, 3, pad=1, name=nm) for i, o, nm in cfg])
+        self.pool = nn.SpatialMaxPooling(2, 2, 2, 2)
+        self.pool_ceil = nn.SpatialMaxPooling(2, 2, 2, 2).ceil()
+        self.pool5 = nn.SpatialMaxPooling(3, 3, 1, 1, 1, 1)
+        self.fc6 = _conv(512, 1024, 3, pad=6, dilation=6, name="fc6")
+        self.fc7 = _conv(1024, 1024, 1, name="fc7")
+
+        # extra feature layers
+        self.conv6_1 = _conv(1024, 256, 1, name="conv6_1")
+        self.conv6_2 = _conv(256, 512, 3, stride=2, pad=1, name="conv6_2")
+        self.conv7_1 = _conv(512, 128, 1, name="conv7_1")
+        self.conv7_2 = _conv(128, 256, 3, stride=2, pad=1, name="conv7_2")
+        self.conv8_1 = _conv(256, 128, 1, name="conv8_1")
+        self.conv8_2 = _conv(128, 256, 3, name="conv8_2")
+        self.conv9_1 = _conv(256, 128, 1, name="conv9_1")
+        self.conv9_2 = _conv(128, 256, 3, name="conv9_2")
+
+        self.conv4_3_norm = nn.NormalizeScale(
+            p=2.0, scale=20.0, size=(512,)).set_name("conv4_3_norm")
+
+        src_channels = [512, 1024, 512, 256, 256, 256]
+        src_names = ["conv4_3_norm", "fc7", "conv6_2", "conv7_2",
+                     "conv8_2", "conv9_2"]
+        locs, confs, priors = [], [], []
+        for ch, name, (mn, mx, ars, step, np_) in zip(
+                src_channels, src_names, _SSD300_PRIORS):
+            locs.append(_conv(ch, np_ * 4, 3, pad=1,
+                              name=f"{name}_mbox_loc"))
+            confs.append(_conv(ch, np_ * class_num, 3, pad=1,
+                               name=f"{name}_mbox_conf"))
+            priors.append(nn.PriorBox(
+                min_sizes=[mn], max_sizes=[mx], aspect_ratios=list(ars),
+                is_flip=True, is_clip=False,
+                variances=[0.1, 0.1, 0.2, 0.2], offset=0.5,
+                img_size=300, step=step))
+        self.loc_layers = ModuleList(locs)
+        self.conf_layers = ModuleList(confs)
+        self.prior_layers = ModuleList(priors)
+        self.detection = nn.DetectionOutputSSD(
+            n_classes=class_num, nms_thresh=nms_thresh, nms_topk=nms_topk,
+            keep_top_k=keep_top_k, conf_thresh=conf_thresh)
+
+    def feature_maps(self, x) -> List:
+        """The six SSD source maps (conv4_3_norm … conv9_2)."""
+        r = jax.nn.relu
+        i = 0
+        for upto, pool in ((2, self.pool), (4, self.pool),
+                           (7, self.pool_ceil)):
+            while i < upto:
+                x = r(self.base[i](x))
+                i += 1
+            x = pool(x)
+        while i < 10:
+            x = r(self.base[i](x))
+            i += 1
+        s1 = self.conv4_3_norm(x)
+        x = self.pool(x)
+        while i < 13:
+            x = r(self.base[i](x))
+            i += 1
+        x = self.pool5(x)
+        x = r(self.fc6(x))
+        s2 = r(self.fc7(x))
+        x = r(self.conv6_1(s2))
+        s3 = r(self.conv6_2(x))
+        x = r(self.conv7_1(s3))
+        s4 = r(self.conv7_2(x))
+        x = r(self.conv8_1(s4))
+        s5 = r(self.conv8_2(x))
+        x = r(self.conv9_1(s5))
+        s6 = r(self.conv9_2(x))
+        return [s1, s2, s3, s4, s5, s6]
+
+    def forward(self, x):
+        sources = self.feature_maps(x)
+        b = x.shape[0]
+        locs, confs, priors = [], [], []
+        for src, loc_l, conf_l, prior_l in zip(
+                sources, self.loc_layers, self.conf_layers,
+                self.prior_layers):
+            locs.append(loc_l(src).reshape(b, -1))
+            confs.append(conf_l(src).reshape(b, -1))
+            priors.append(prior_l(src))
+        loc = jnp.concatenate(locs, axis=1)
+        conf = jnp.concatenate(confs, axis=1)
+        prior = jnp.concatenate(priors, axis=1)
+        conf = jax.nn.softmax(
+            conf.reshape(b, -1, self.class_num), axis=-1).reshape(b, -1)
+        return self.detection((loc, conf, prior))
+
+
+def ssd_vgg16_300(class_num: int = 21, **kw) -> SSDVGG16:
+    """SSD-300 VGG16 (the whitepaper fig10 int8 benchmark model)."""
+    return SSDVGG16(class_num=class_num, **kw)

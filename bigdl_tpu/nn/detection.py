@@ -135,14 +135,25 @@ def clip_boxes(boxes, height: float, width: float):
 # NMS (reference nn/Nms.scala — serial greedy loop → masked fori_loop)
 # --------------------------------------------------------------------------
 
-def nms(boxes, scores, iou_threshold: float, max_output: int):
+def nms(boxes, scores, iou_threshold: float, max_output: int,
+        pre_topk: Optional[int] = None):
     """Greedy NMS with static output size.
 
     Returns ``(indices, valid)`` where ``indices: (max_output,) int32``
     point into the input arrays (score-descending) and ``valid`` is a
     boolean mask.  Invalid slots repeat index 0 with ``valid=False``.
+
+    ``pre_topk`` caps the suppression to the top-k-scoring boxes so the
+    IoU matrix is k x k instead of n x n (with SSD's 8,732 priors the
+    full matrix is ~300MB per class under vmap; the reference applies
+    NMS to the top nmsTopk boxes only, DetectionOutputSSD.scala:49).
     """
     n = boxes.shape[0]
+    if pre_topk is not None and pre_topk < n:
+        top_s, top_i = jax.lax.top_k(scores, pre_topk)
+        sub_idx, sub_valid = nms(boxes[top_i], top_s, iou_threshold,
+                                 max_output)
+        return top_i[sub_idx], sub_valid
     order = jnp.argsort(-scores)
     sboxes = boxes[order]
     sscores = scores[order]
@@ -648,7 +659,15 @@ class BoxHead(Module):
         return jax.nn.relu(self.fc2(x))
 
     def forward(self, inputs):
-        features, proposals, im_info = inputs
+        # optional 4th element: proposal validity (True = real proposal).
+        # RegionProposal pads its fixed-shape output with -inf-score
+        # slots; without the mask those padded (zero) boxes would be
+        # classified and could enter the top-k as spurious detections.
+        if len(inputs) == 4:
+            features, proposals, im_info, prop_valid = inputs
+        else:
+            features, proposals, im_info = inputs
+            prop_valid = None
         feats = self.features_of(features, proposals)
         logits = self.cls_score(feats)
         deltas = self.bbox_pred(feats)
@@ -664,6 +683,8 @@ class BoxHead(Module):
             dec = clip_boxes(dec, im_info[0], im_info[1])
             sc = jnp.where(probs[:, c] > self.score_thresh,
                            probs[:, c], -jnp.inf)
+            if prop_valid is not None:
+                sc = jnp.where(prop_valid, sc, -jnp.inf)
             keep, valid = nms(dec, sc, self.nms_thresh,
                               min(per_class_keep, n))
             cand_boxes.append(jnp.where(valid[:, None], dec[keep], 0.0))
@@ -867,7 +888,8 @@ class DetectionOutputSSD(Module):
                 continue
             sc = jnp.where(conf[:, c] > self.conf_thresh, conf[:, c],
                            -jnp.inf)
-            keep, valid = nms(boxes, sc, self.nms_thresh, per_cls)
+            keep, valid = nms(boxes, sc, self.nms_thresh, per_cls,
+                              pre_topk=self.nms_topk)
             all_boxes.append(jnp.where(valid[:, None], boxes[keep], 0.0))
             all_scores.append(jnp.where(valid, conf[keep, c], -jnp.inf))
             all_labels.append(jnp.full((per_cls,), c, jnp.int32))

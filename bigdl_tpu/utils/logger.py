@@ -26,11 +26,14 @@ def _file_handler(path: str) -> logging.FileHandler:
     return handler
 
 
-def _drop_ours(lg: logging.Logger) -> None:
-    """Remove handlers a previous call installed — repeated setup calls
-    (notebooks re-running cells) must not duplicate every log line."""
+def _drop_ours(lg: logging.Logger, path: str) -> None:
+    """Remove handlers a previous call installed FOR THE SAME FILE —
+    repeated setup calls (notebooks re-running cells) must not duplicate
+    every log line, but logging to a second file stays additive."""
+    target = os.path.abspath(path)
     for h in list(lg.handlers):
-        if getattr(h, "_bigdl_tpu_handler", False):
+        if getattr(h, "_bigdl_tpu_handler", False) \
+                and getattr(h, "baseFilename", None) == target:
             lg.removeHandler(h)
             h.close()
 
@@ -44,7 +47,7 @@ def redirect_noise_logs(path: Optional[str] = None,
     handler = _file_handler(path)
     for name in _NOISY:
         lg = logging.getLogger(name)
-        _drop_ours(lg)
+        _drop_ours(lg, path)
         lg.addHandler(handler)
         lg.setLevel(logging.INFO)
         for h in list(lg.handlers):
@@ -65,5 +68,5 @@ def log_file(path: str) -> None:
     """Also write the framework's own logs to ``path``
     (≙ ``bigdl.utils.LoggerFilter.logFile``)."""
     lg = logging.getLogger("bigdl_tpu")
-    _drop_ours(lg)
+    _drop_ours(lg, path)
     lg.addHandler(_file_handler(path))

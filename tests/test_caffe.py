@@ -216,3 +216,33 @@ def test_inplace_final_layer_is_output(tmp_path):
     assert out.shape == (2, 3, 4, 4)
     assert (out >= 0).all(), "ReLU (the in-place final layer) missing"
     assert (out == 0).any(), "output is pre-ReLU conv values"
+
+
+def test_multi_top_partial_consumption(tmp_path):
+    """A multi-top layer with only one top consumed must keep the other
+    top as a graph output (consumption is per (node, blob-name) pair)."""
+    proto = '''
+    name: "MultiTop"
+    input: "data"
+    layer {
+      name: "conv1" type: "Convolution" bottom: "data" top: "conv1"
+      convolution_param { num_output: 2 kernel_size: 1 stride: 1 }
+    }
+    layer { name: "split1" type: "ReLU" bottom: "conv1" top: "a" top: "b" }
+    layer { name: "relu2" type: "ReLU" bottom: "a" top: "c" }
+    '''
+    rng = np.random.RandomState(0)
+    weights = {"conv1": {
+        "type": "Convolution", "bottom": ["data"], "top": ["conv1"],
+        "blobs": [rng.randn(2, 2, 1, 1).astype(np.float32),
+                  rng.randn(2).astype(np.float32)]}}
+    proto_p = str(tmp_path / "deploy.prototxt")
+    model_p = str(tmp_path / "net.caffemodel")
+    with open(proto_p, "w") as f:
+        f.write(proto)
+    save_caffemodel(model_p, weights)
+    model, _ = load_caffe(proto_p, model_p)
+    model.eval_mode()
+    out = model(jnp.asarray(rng.randn(1, 2, 3, 3).astype(np.float32)))
+    assert isinstance(out, (tuple, list)) and len(out) == 2, \
+        "partially-consumed multi-top output was dropped"

@@ -325,3 +325,145 @@ def test_nms_jit_and_roi_align_jit():
     f = jax.jit(lambda feat, rois: layer((feat, rois)))
     out = f(jnp.ones((1, 8, 8, 2)), jnp.asarray([[0.0, 0.0, 8.0, 8.0]]))
     assert out.shape == (1, 2, 2, 2)
+
+
+# ---------------- SSD-VGG16 (BASELINE config #5) ----------------
+
+def test_ssd_vgg16_300_architecture():
+    """Canonical SSD-300: source maps 38/19/10/5/3/1 and 8,732 priors."""
+    from bigdl_tpu.models import ssd_vgg16_300
+    set_seed(0)
+    m = ssd_vgg16_300(class_num=21).eval_mode()
+    srcs = m.feature_maps(jnp.zeros((1, 300, 300, 3)))
+    assert [tuple(s.shape[1:3]) for s in srcs] == [
+        (38, 38), (19, 19), (10, 10), (5, 5), (3, 3), (1, 1)]
+    total = sum(int(np.prod(p.forward(s).shape[1:])) // 4
+                for p, s in zip(m.prior_layers, srcs))
+    assert total == 8732
+    out = m.forward(jnp.asarray(
+        np.random.default_rng(0).normal(size=(1, 300, 300, 3)),
+        jnp.float32))
+    assert out.shape == (1, 200, 6)
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_ssd_caffe_weight_import(tmp_path):
+    """A caffemodel's blobs land in the same-named SSD layers (the
+    reference's import-and-infer path, CaffeLoader.scala:57)."""
+    from bigdl_tpu.interop.caffe import load_caffe_weights, save_caffemodel
+    from bigdl_tpu.models import ssd_vgg16_300
+    set_seed(0)
+    m = ssd_vgg16_300(class_num=21)
+    rng = np.random.RandomState(3)
+    weights = {
+        "conv1_1": {"type": "Convolution", "bottom": [], "top": [],
+                    "blobs": [rng.randn(64, 3, 3, 3).astype(np.float32),
+                              rng.randn(64).astype(np.float32)]},
+        "conv6_1": {"type": "Convolution", "bottom": [], "top": [],
+                    "blobs": [rng.randn(256, 1024, 1, 1).astype(np.float32),
+                              rng.randn(256).astype(np.float32)]},
+        "conv4_3_norm": {"type": "Normalize", "bottom": [], "top": [],
+                         "blobs": [rng.randn(512).astype(np.float32)]},
+    }
+    p = str(tmp_path / "ssd.caffemodel")
+    save_caffemodel(p, weights)
+    _, copied = load_caffe_weights(m, None, p)
+    assert set(copied) == {"conv1_1", "conv6_1", "conv4_3_norm"}
+    named = {mod.get_name(): mod for _, mod in m.named_modules()}
+    np.testing.assert_allclose(
+        np.asarray(named["conv1_1"].weight),
+        np.transpose(weights["conv1_1"]["blobs"][0], (2, 3, 1, 0)))
+    np.testing.assert_allclose(np.asarray(named["conv4_3_norm"].weight),
+                               weights["conv4_3_norm"]["blobs"][0])
+
+
+def test_ssd_detection_output_map():
+    """DetectionOutputSSD recovers planted boxes; VOC mAP == 1.0."""
+    from bigdl_tpu.optim.validation import (
+        MeanAveragePrecisionObjectDetection,
+    )
+    set_seed(0)
+    # 4 priors spread out; loc = 0 so decoded boxes == priors
+    priors = np.array([[0.05, 0.05, 0.2, 0.2], [0.3, 0.3, 0.5, 0.5],
+                       [0.6, 0.6, 0.8, 0.8], [0.1, 0.6, 0.3, 0.9]],
+                      np.float32)
+    var = np.full_like(priors, 0.1)
+    prior = jnp.asarray(np.stack([priors.reshape(-1), var.reshape(-1)]))
+    loc = jnp.zeros((1, 16))
+    conf = np.full((4, 3), 0.01, np.float32)
+    conf[0, 1] = 0.95   # prior 0 → class 1
+    conf[2, 2] = 0.9    # prior 2 → class 2
+    det = DetectionOutputSSD(n_classes=3, keep_top_k=8, nms_topk=4,
+                             conf_thresh=0.5)
+    out = np.asarray(det((loc, jnp.asarray(conf.reshape(1, -1)), prior)))[0]
+    kept = out[out[:, 1] > 0]
+    assert kept.shape[0] == 2
+    m = MeanAveragePrecisionObjectDetection(classes=2, iou_thresh=0.5)
+    dets = [(kept[:, 0].astype(int), kept[:, 1], kept[:, 2:6])]
+    gts = [(np.array([1, 2]), priors[[0, 2]])]
+    assert m.evaluate(dets, gts) == 1.0
+
+
+def test_nms_pre_topk_matches_full():
+    """Regression (round-1 advisor #2): pre-top-k capping must not
+    change the result when the winners are inside the cap."""
+    rng = np.random.RandomState(0)
+    # 10 well-separated high-score boxes + 30 low-score jitters of them
+    base = np.stack([np.linspace(0, 9, 10) * 30,
+                     np.zeros(10),
+                     np.linspace(0, 9, 10) * 30 + 20,
+                     np.full(10, 20.0)], 1).astype(np.float32)
+    jitter = np.repeat(base, 3, axis=0) + rng.rand(30, 4).astype(np.float32)
+    boxes = jnp.asarray(np.concatenate([base, jitter]))
+    scores = jnp.asarray(np.concatenate([
+        0.9 + 0.01 * rng.rand(10), 0.1 * rng.rand(30)]).astype(np.float32))
+    from bigdl_tpu.nn.detection import nms
+    idx_full, val_full = nms(boxes, scores, 0.5, 10)
+    idx_cap, val_cap = nms(boxes, scores, 0.5, 10, pre_topk=15)
+    np.testing.assert_array_equal(np.asarray(val_full), np.asarray(val_cap))
+    np.testing.assert_array_equal(np.asarray(idx_full)[np.asarray(val_full)],
+                                  np.asarray(idx_cap)[np.asarray(val_cap)])
+
+
+def test_boxhead_masks_padded_proposals():
+    """Regression (round-1 advisor #1): padded proposal slots must not
+    produce detections when the validity mask is supplied."""
+    set_seed(5)
+    head = BoxHead(in_channels=4, resolution=3, scales=[0.25],
+                   sampling_ratio=2, score_thresh=0.01, nms_thresh=0.99,
+                   max_per_image=16, output_size=8, num_classes=2)
+    feats = [jnp.asarray(np.random.RandomState(1).rand(1, 16, 16, 4),
+                         jnp.float32)]
+    # 4 real well-separated proposals + 4 padded zero slots
+    real = np.array([[0, 0, 15, 15], [20, 0, 35, 15],
+                     [40, 0, 55, 15], [0, 20, 15, 35]], np.float32)
+    proposals = jnp.asarray(np.concatenate([real, np.zeros((4, 4))]),
+                            jnp.float32)
+    im_info = jnp.asarray([64.0, 64.0])
+    pvalid = jnp.asarray([True] * 4 + [False] * 4)
+    _, _, _, valid_masked = head((feats, proposals, im_info, pvalid))
+    _, _, _, valid_unmasked = head((feats, proposals, im_info))
+    assert int(valid_masked.sum()) <= 4
+    assert int(valid_unmasked.sum()) > int(valid_masked.sum())
+
+
+def test_ssd_int8_quantized_inference():
+    """BASELINE config #5: int8-quantized SSD inference runs and stays
+    close to the fp32 detections (whitepaper fig10 recipe: <0.1%
+    accuracy drop at up to 2x speedup)."""
+    from bigdl_tpu.models import ssd_vgg16_300
+    from bigdl_tpu.nn.quantized import Quantizer
+    set_seed(0)
+    m = ssd_vgg16_300(class_num=4, conf_thresh=0.05).eval_mode()
+    q = Quantizer.quantize(m)
+    x = jnp.asarray(np.random.default_rng(1).normal(
+        size=(1, 300, 300, 3)), jnp.float32)
+    out_f = np.asarray(m.forward(x))[0]
+    out_q = np.asarray(q.forward(x))[0]
+    assert out_q.shape == out_f.shape
+    assert np.isfinite(out_q).all()
+    # top detections must agree: same labels, boxes/scores within int8
+    # quantization error
+    np.testing.assert_array_equal(out_f[:10, 0], out_q[:10, 0])
+    np.testing.assert_allclose(out_f[:10, 1], out_q[:10, 1], atol=0.05)
+    np.testing.assert_allclose(out_f[:10, 2:], out_q[:10, 2:], atol=0.02)

@@ -216,11 +216,21 @@ def test_t7_shared_table_roundtrip(tmp_path):
     assert back2["self"] is back2
 
 
+def _strip_ours(*names):
+    import logging
+    for name in names:
+        lg = logging.getLogger(name)
+        for h in list(lg.handlers):
+            if getattr(h, "_bigdl_tpu_handler", False):
+                lg.removeHandler(h)
+
+
 def test_logger_no_duplicate_handlers(tmp_path):
     """Regression (round-1 advisor #5): repeated setup calls must not
     stack FileHandlers (every log line would duplicate)."""
     import logging
     from bigdl_tpu.utils.logger import log_file, redirect_noise_logs
+    _strip_ours("jax._src.dispatch", "absl", "bigdl_tpu")
     redirect_noise_logs(str(tmp_path / "noise.log"))
     redirect_noise_logs(str(tmp_path / "noise.log"))
     for name in ("jax._src.dispatch", "absl"):
@@ -232,3 +242,28 @@ def test_logger_no_duplicate_handlers(tmp_path):
     ours = [h for h in logging.getLogger("bigdl_tpu").handlers
             if getattr(h, "_bigdl_tpu_handler", False)]
     assert len(ours) == 1
+
+
+def test_t7_shared_tensor_memoized(tmp_path):
+    """Shared numpy arrays serialize once and load as one object."""
+    arr = np.arange(6, dtype=np.float32).reshape(2, 3)
+    p = str(tmp_path / "tied.t7")
+    save_t7(p, {"w": arr, "tied": arr})
+    back = load_t7(p)
+    assert back["w"] is back["tied"]
+    np.testing.assert_array_equal(back["w"], arr)
+
+
+def test_logger_second_file_is_additive(tmp_path):
+    """Dedup is keyed per target file: logging to a second file must not
+    silently drop the first."""
+    import logging
+    from bigdl_tpu.utils.logger import log_file
+    _strip_ours("bigdl_tpu")
+    log_file(str(tmp_path / "one.log"))
+    log_file(str(tmp_path / "two.log"))
+    ours = [h for h in logging.getLogger("bigdl_tpu").handlers
+            if getattr(h, "_bigdl_tpu_handler", False)]
+    assert len(ours) == 2
+    for h in ours:
+        logging.getLogger("bigdl_tpu").removeHandler(h)
