@@ -148,6 +148,10 @@ class Graph(Module):
     execution order).  Under jit, execution order is baked into the
     trace, so this is exactly the reference StaticGraph semantics."""
 
+    # Node objects are build-time scaffolding; execution state lives in
+    # the id tuples + graph_modules, so persistence skips them
+    serialize_skip_static = ("input_nodes", "output_nodes")
+
     def __init__(self, inputs: Union[Node, Sequence[Node]],
                  outputs: Union[Node, Sequence[Node]]):
         super().__init__()

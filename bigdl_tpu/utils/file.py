@@ -3,43 +3,101 @@
 Reference: utils/File.scala (save/load to local/HDFS/S3) and
 optim/AbstractOptimizer.scala:205 checkpoint (model + OptimMethod state,
 timestamp-suffixed).  TPU-native: params/buffers/optim-state are pulled
-to host as numpy and written as an .npz + pickled treedef — a
-self-contained single-file format.  Cloud-storage URIs can be layered on
-by fsspec-style adapters later; local paths are the baseline.
+to host as numpy and written as a single ``.npz`` holding the arrays
+plus a JSON structure descriptor — NO pickle anywhere, so loading an
+untrusted checkpoint cannot execute code and the format is stable
+across refactors (the round-2 format pickled the jax treedef, which was
+neither).
 """
 
 from __future__ import annotations
 
-import io
+import json
 import os
-import pickle
-from typing import Any, Dict, Tuple
+from typing import Any, Dict, List, Tuple
 
 import numpy as np
-
-import jax
 
 __all__ = ["save_pytree", "load_pytree", "save_checkpoint",
            "load_checkpoint"]
 
+PYTREE_FORMAT_VERSION = 2
 
-def _to_host(tree):
-    return jax.tree_util.tree_map(lambda x: np.asarray(x), tree)
+
+def _encode(node: Any, arrays: List[np.ndarray], path: str):
+    """Plain-pytree → JSON-able structure with array refs."""
+    if node is None:
+        return {"t": "none"}
+    if isinstance(node, (bool, int, float, str)) \
+            and not isinstance(node, np.generic):
+        return {"t": "py", "v": node}
+    if isinstance(node, dict):
+        return {"t": "dict", "items": [
+            [_encode(k, arrays, path), _encode(v, arrays, f"{path}.{k}")]
+            for k, v in node.items()]}
+    if isinstance(node, (list, tuple)):
+        return {"t": "list" if isinstance(node, list) else "tuple",
+                "v": [_encode(v, arrays, f"{path}[{i}]")
+                      for i, v in enumerate(node)]}
+    arr = np.asarray(node)
+    if arr.dtype == object:
+        raise TypeError(
+            f"save_pytree: unserializable value of type "
+            f"{type(node).__name__} at {path} (plain pytrees only — "
+            f"use Module.save for models)")
+    arrays.append(arr)
+    return {"t": "arr", "i": len(arrays) - 1}
+
+
+def _decode(entry, z):
+    t = entry["t"]
+    if t == "none":
+        return None
+    if t == "py":
+        return entry["v"]
+    if t == "dict":
+        return {_decode(k, z): _decode(v, z) for k, v in entry["items"]}
+    if t == "list":
+        return [_decode(v, z) for v in entry["v"]]
+    if t == "tuple":
+        return tuple(_decode(v, z) for v in entry["v"])
+    if t == "arr":
+        return z[f"a{entry['i']}"]
+    raise ValueError(f"load_pytree: unknown node tag {t!r}")
+
+
+def _json_bytes(obj) -> np.ndarray:
+    return np.frombuffer(json.dumps(obj).encode("utf-8"), np.uint8)
+
+
+def _check_legacy(files) -> None:
+    if "__treedef__" in files:
+        raise ValueError(
+            "this file uses the legacy pickle-based layout (round-2 "
+            "format); it cannot be loaded safely — re-save it with the "
+            "current version")
 
 
 def save_pytree(tree: Any, path: str) -> None:
-    leaves, treedef = jax.tree_util.tree_flatten(_to_host(tree))
+    arrays: List[np.ndarray] = []
+    structure = _encode(tree, arrays, "root")
     os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    payload = {f"a{i}": a for i, a in enumerate(arrays)}
     with open(path, "wb") as f:
-        np.savez(f, *leaves, __treedef__=np.frombuffer(
-            pickle.dumps(treedef), dtype=np.uint8))
+        np.savez(f, __structure__=_json_bytes(
+            {"format": PYTREE_FORMAT_VERSION, "root": structure}),
+            **payload)
 
 
 def load_pytree(path: str) -> Any:
     with np.load(path, allow_pickle=False) as z:
-        treedef = pickle.loads(z["__treedef__"].tobytes())
-        leaves = [z[f"arr_{i}"] for i in range(len(z.files) - 1)]
-    return jax.tree_util.tree_unflatten(treedef, leaves)
+        _check_legacy(z.files)
+        meta = json.loads(z["__structure__"].tobytes().decode("utf-8"))
+        if meta.get("format") != PYTREE_FORMAT_VERSION:
+            raise ValueError(
+                f"unsupported pytree format {meta.get('format')} "
+                f"(supported: {PYTREE_FORMAT_VERSION})")
+        return _decode(meta["root"], z)
 
 
 def save_checkpoint(path: str, model_state: Dict, optim_state: Any,

@@ -1,15 +1,18 @@
-"""Model persistence.
+"""Model persistence with a stable, pickle-free schema.
 
-Reference: utils/serializer/ (ModuleSerializer with reflection-based
-default + registered custom serializers, weight-file separation,
-version tag) and nn/Module.scala:load/save factories.
+Reference: utils/serializer/ModuleSerializer.scala:36-223 — versioned
+protobuf with per-layer converters and back-compat migration.  The
+TPU-native equivalent: a JSON **manifest** describing the module tree
+(class import path, static config, param/buffer array refs) plus the
+weight arrays in the same ``.npz`` — the reference's schema+weights
+separation without a schema compiler, and with the same guarantees:
 
-TPU-native format: a Module IS a registered pytree, so the full model —
-architecture (treedef aux: classes + static config) and state (leaves:
-params/buffers) — serializes as one ``tree_flatten``.  Files are a zip
-(numpy ``.npz``) holding the weight arrays plus a pickled treedef and a
-format-version tag: the same weight/structure separation as the
-reference's protobuf+weights layout, without a schema compiler.
+* loading runs NO untrusted code: classes resolve only inside the
+  ``bigdl_tpu`` package or the explicit :func:`register_serializable`
+  registry, and reconstruction bypasses ``__init__`` (no constructor
+  side effects from file-controlled values);
+* the format is versioned; :func:`register_migration` hooks upgrade
+  old manifests on load (≙ the reference's version tag + converters).
 
 Two granularities:
 
@@ -21,43 +24,230 @@ Two granularities:
 
 from __future__ import annotations
 
-import io
-import pickle
-from typing import Any, Dict
+import importlib
+import json
+import logging
+import os
+from typing import Any, Callable, Dict, List
 
 import numpy as np
 
-import jax
 import jax.numpy as jnp
 
-from bigdl_tpu.core.module import Module
-from bigdl_tpu.utils.file import save_pytree, load_pytree
+from bigdl_tpu.core.module import Module, ModuleList
 
 __all__ = ["save_module", "load_module", "save_weights", "load_weights",
-           "FORMAT_VERSION"]
+           "register_serializable", "register_migration",
+           "MANIFEST_VERSION"]
 
-FORMAT_VERSION = 1
+logger = logging.getLogger("bigdl_tpu.serializer")
+
+MANIFEST_VERSION = 1
+
+_CLASS_REGISTRY: Dict[str, type] = {}
+_MIGRATIONS: Dict[int, Callable[[dict], dict]] = {}
+
+
+def register_serializable(cls: type) -> type:
+    """Allow ``load_module`` to reconstruct a class defined outside the
+    ``bigdl_tpu`` package (class decorator)."""
+    _CLASS_REGISTRY[_class_key(cls)] = cls
+    return cls
+
+
+def register_migration(from_version: int,
+                       fn: Callable[[dict], dict]) -> None:
+    """Register a manifest upgrade ``from_version`` → ``from_version+1``
+    (≙ the reference serializer's version converters)."""
+    _MIGRATIONS[int(from_version)] = fn
+
+
+def _class_key(cls: type) -> str:
+    return f"{cls.__module__}:{cls.__qualname__}"
+
+
+def _resolve_class(key: str) -> type:
+    if key in _CLASS_REGISTRY:
+        return _CLASS_REGISTRY[key]
+    mod_name, _, qual = key.partition(":")
+    if not (mod_name == "bigdl_tpu" or mod_name.startswith("bigdl_tpu.")):
+        raise ValueError(
+            f"refusing to import class {key!r} from outside bigdl_tpu — "
+            f"register it with register_serializable to allow loading")
+    obj: Any = importlib.import_module(mod_name)
+    for part in qual.split("."):
+        obj = getattr(obj, part)
+    if not (isinstance(obj, type) and issubclass(obj, Module)):
+        raise ValueError(f"{key!r} is not a Module class")
+    return obj
+
+
+# ---- static-config codec --------------------------------------------------
+
+def _enc_static(v: Any, path: str):
+    if v is None:
+        return {"t": "none"}
+    if isinstance(v, (bool, int, float, str)) \
+            and not isinstance(v, np.generic):
+        return {"t": "py", "v": v}
+    if isinstance(v, tuple):
+        return {"t": "tuple", "v": [_enc_static(x, path) for x in v]}
+    if isinstance(v, list):
+        return {"t": "list", "v": [_enc_static(x, path) for x in v]}
+    if isinstance(v, dict):
+        return {"t": "dict", "items": [
+            [_enc_static(k, path), _enc_static(x, f"{path}.{k}")]
+            for k, x in v.items()]}
+    if isinstance(v, np.dtype):
+        return {"t": "dtype", "v": v.name}
+    if isinstance(v, type) and issubclass(v, np.generic):
+        return {"t": "nptype", "v": np.dtype(v).name}
+    if isinstance(v, np.generic):
+        return {"t": "npscalar", "v": v.item(), "dtype": v.dtype.name}
+    from jax.sharding import Mesh
+    if isinstance(v, Mesh):
+        # machine topology is not model state: drop it on save (the
+        # loader gets a mesh-less model; call set_mesh again)
+        logger.warning("dropping device Mesh at %s during save", path)
+        return {"t": "none"}
+    raise TypeError(
+        f"save_module: static attribute at {path} of type "
+        f"{type(v).__name__} has no stable encoding — hold it outside "
+        f"the module or register a converter")
+
+
+def _dec_static(entry):
+    t = entry["t"]
+    if t == "none":
+        return None
+    if t == "py":
+        return entry["v"]
+    if t == "tuple":
+        return tuple(_dec_static(x) for x in entry["v"])
+    if t == "list":
+        return [_dec_static(x) for x in entry["v"]]
+    if t == "dict":
+        return {_dec_static(k): _dec_static(x)
+                for k, x in entry["items"]}
+    if t == "dtype":
+        return np.dtype(entry["v"])
+    if t == "nptype":
+        return np.dtype(entry["v"]).type
+    if t == "npscalar":
+        return np.dtype(entry["dtype"]).type(entry["v"])
+    raise ValueError(f"load_module: unknown static tag {t!r}")
+
+
+# ---- module tree codec ----------------------------------------------------
+
+def _add_array(arrays: List[np.ndarray], v) -> int:
+    arrays.append(np.asarray(v))
+    return len(arrays) - 1
+
+
+def _encode_module(m: Module, arrays: List[np.ndarray],
+                   path: str) -> dict:
+    def enc_child(name, child):
+        cpath = f"{path}.{name}" if path else name
+        if isinstance(child, ModuleList):
+            return {"t": "mlist", "v": [
+                _encode_module(x, arrays, f"{cpath}[{i}]")
+                for i, x in enumerate(child._items)]}
+        return _encode_module(child, arrays, cpath)
+
+    skip = getattr(type(m), "serialize_skip_static", ())
+    return {
+        "class": _class_key(type(m)),
+        "name": m.name,
+        "training": bool(m.training),
+        "static": {k: _enc_static(v, f"{path}.{k}" if path else k)
+                   for k, v in m._static.items() if k not in skip},
+        "params": {k: _add_array(arrays, v) for k, v in m._params.items()},
+        "buffers": {k: _add_array(arrays, v)
+                    for k, v in m._buffers.items()},
+        "modules": {k: enc_child(k, v) for k, v in m._modules.items()},
+    }
+
+
+def _decode_module(entry: dict, z) -> Module:
+    cls = _resolve_class(entry["class"])
+    obj = cls.__new__(cls)
+
+    def dec_child(e):
+        if isinstance(e, dict) and e.get("t") == "mlist":
+            return ModuleList([_decode_module(x, z) for x in e["v"]])
+        return _decode_module(e, z)
+
+    object.__setattr__(obj, "_params",
+                       {k: jnp.asarray(z[f"a{i}"])
+                        for k, i in entry["params"].items()})
+    object.__setattr__(obj, "_buffers",
+                       {k: jnp.asarray(z[f"a{i}"])
+                        for k, i in entry["buffers"].items()})
+    object.__setattr__(obj, "_modules",
+                       {k: dec_child(e)
+                        for k, e in entry["modules"].items()})
+    object.__setattr__(obj, "_static",
+                       {k: _dec_static(v)
+                        for k, v in entry["static"].items()})
+    object.__setattr__(obj, "training", bool(entry["training"]))
+    object.__setattr__(obj, "name", entry["name"])
+    # Module.__getattribute__ resolves slot names via a sentinel instance
+    # attribute that __setattr__ normally plants — recreate them
+    from bigdl_tpu.core.module import _SENTINEL
+    for slot in ("_params", "_buffers", "_modules", "_static"):
+        for k in getattr(obj, slot):
+            object.__setattr__(obj, k, _SENTINEL)
+    return obj
 
 
 def save_module(module: Module, path: str) -> None:
     """Persist architecture + weights (≙ AbstractModule.saveModule)."""
-    save_pytree({"__bigdl_tpu_version__": np.int64(FORMAT_VERSION),
-                 "module": module}, path)
+    arrays: List[np.ndarray] = []
+    manifest = {"manifest_version": MANIFEST_VERSION,
+                "module": _encode_module(module, arrays, "")}
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    payload = {f"a{i}": a for i, a in enumerate(arrays)}
+    with open(path, "wb") as f:
+        np.savez(f, __manifest__=np.frombuffer(
+            json.dumps(manifest).encode("utf-8"), np.uint8), **payload)
 
 
 def load_module(path: str) -> Module:
     """Rebuild a model saved by :func:`save_module`
-    (≙ Module.loadModule, nn/Module.scala)."""
-    tree = load_pytree(path)
-    version = int(tree.get("__bigdl_tpu_version__", -1))
-    if version != FORMAT_VERSION:
-        raise ValueError(
-            f"unsupported bigdl_tpu model format version {version} "
-            f"(supported: {FORMAT_VERSION})")
-    module = tree["module"]
-    # npz round-trips leaves as numpy; restore device arrays
-    return jax.tree_util.tree_map(jnp.asarray, module)
+    (≙ Module.loadModule, nn/Module.scala).  Never unpickles."""
+    with np.load(path, allow_pickle=False) as z:
+        if "__treedef__" in z.files:
+            raise ValueError(
+                "this model file uses the legacy pickle-based layout; "
+                "it cannot be loaded safely — re-save it with the "
+                "current version")
+        if "__manifest__" not in z.files:
+            raise ValueError(f"{path!r} is not a bigdl_tpu model file")
+        manifest = json.loads(
+            z["__manifest__"].tobytes().decode("utf-8"))
+        version = int(manifest.get("manifest_version", -1))
+        while version < MANIFEST_VERSION:
+            if version not in _MIGRATIONS:
+                raise ValueError(
+                    f"unsupported model manifest version {version} "
+                    f"(current: {MANIFEST_VERSION}, no migration "
+                    f"registered)")
+            manifest = _MIGRATIONS[version](manifest)
+            new_version = int(manifest["manifest_version"])
+            if new_version <= version:
+                raise ValueError(
+                    f"migration from manifest version {version} did not "
+                    f"advance the version (got {new_version})")
+            version = new_version
+        if version != MANIFEST_VERSION:
+            raise ValueError(
+                f"unsupported model manifest version {version} "
+                f"(current: {MANIFEST_VERSION})")
+        return _decode_module(manifest["module"], z)
 
+
+# ---- weights-only (unchanged format: plain npz of dotted paths) -----------
 
 def _flatten_state(module: Module) -> Dict[str, np.ndarray]:
     out: Dict[str, np.ndarray] = {}

@@ -41,13 +41,130 @@ def test_save_load_module_roundtrip(tmp_path):
     np.testing.assert_allclose(got, want, rtol=1e-6)
 
 
+def _rewrite_manifest(path, mutate):
+    import json
+    with np.load(path, allow_pickle=False) as z:
+        manifest = json.loads(z["__manifest__"].tobytes().decode())
+        arrays = {k: z[k] for k in z.files if k != "__manifest__"}
+    mutate(manifest)
+    with open(path, "wb") as f:
+        np.savez(f, __manifest__=np.frombuffer(
+            json.dumps(manifest).encode(), np.uint8), **arrays)
+
+
 def test_load_module_rejects_bad_version(tmp_path):
-    from bigdl_tpu.utils.file import save_pytree
     p = str(tmp_path / "bad.bigdl")
-    save_pytree({"__bigdl_tpu_version__": np.int64(99),
-                 "module": nn.Linear(2, 2)}, p)
-    with pytest.raises(ValueError, match="version"):
+    nn.Linear(2, 2).save(p)
+    _rewrite_manifest(p, lambda m: m.update(manifest_version=99))
+    with pytest.raises(ValueError, match="manifest version"):
         Module.load(p)
+
+
+def test_load_module_migration_hook(tmp_path):
+    """Old-version manifests upgrade through registered migrations
+    (≙ the reference serializer's version converters,
+    ModuleSerializer.scala:36-223)."""
+    from bigdl_tpu.utils import serializer as S
+    p = str(tmp_path / "old.bigdl")
+    m = nn.Linear(3, 2)
+    m.save(p)
+
+    def downgrade(man):
+        man["manifest_version"] = 0
+        man["module"]["cls"] = man["module"].pop("class")
+
+    _rewrite_manifest(p, downgrade)
+    with pytest.raises(ValueError, match="no migration"):
+        Module.load(p)
+
+    def migrate_0_to_1(man):
+        man = dict(man)
+        man["module"] = dict(man["module"])
+        man["module"]["class"] = man["module"].pop("cls")
+        man["manifest_version"] = 1
+        return man
+
+    S.register_migration(0, migrate_0_to_1)
+    try:
+        m2 = Module.load(p)
+        x = jnp.asarray(np.random.default_rng(0).normal(size=(2, 3)),
+                        jnp.float32)
+        np.testing.assert_allclose(np.asarray(m2.forward(x)),
+                                   np.asarray(m.forward(x)))
+    finally:
+        S._MIGRATIONS.pop(0, None)
+
+
+def test_load_module_refuses_foreign_class(tmp_path):
+    """Class resolution must not import arbitrary modules (code exec)."""
+    p = str(tmp_path / "evil.bigdl")
+    nn.Linear(2, 2).save(p)
+    _rewrite_manifest(
+        p, lambda m: m["module"].update({"class": "os:system"}))
+    with pytest.raises(ValueError, match="refusing"):
+        Module.load(p)
+
+
+def test_load_module_refuses_legacy_pickle(tmp_path):
+    p = str(tmp_path / "legacy.bigdl")
+    with open(p, "wb") as f:
+        np.savez(f, __treedef__=np.zeros(4, np.uint8))
+    with pytest.raises(ValueError, match="pickle"):
+        Module.load(p)
+
+
+def test_no_pickle_in_persistence_code():
+    import inspect
+    from bigdl_tpu.utils import file as file_mod
+    from bigdl_tpu.utils import serializer as ser_mod
+    for mod in (file_mod, ser_mod):
+        src = inspect.getsource(mod)
+        assert "import pickle" not in src, mod.__name__
+        assert "pickle.load" not in src and "pickle.dump" not in src
+        assert "allow_pickle=True" not in src
+
+
+@pytest.mark.parametrize("family", ["graph", "rnn", "transformer", "moe"])
+def test_roundtrip_layer_families(tmp_path, family):
+    from bigdl_tpu.models import PTBModel, lenet5_graph
+    from bigdl_tpu.nn.moe import MoE
+    set_seed(3)
+    rng = np.random.default_rng(0)
+    if family == "graph":
+        m = lenet5_graph()
+        x = jnp.asarray(rng.normal(size=(2, 28, 28)), jnp.float32)
+    elif family == "rnn":
+        m = PTBModel(50, 16)
+        x = jnp.asarray(rng.integers(1, 50, size=(2, 7)))
+    elif family == "transformer":
+        m = nn.Sequential(nn.TransformerEncoderLayer(16, 2, 32))
+        x = jnp.asarray(rng.normal(size=(2, 5, 16)), jnp.float32)
+    else:
+        m = MoE(8, [nn.FeedForwardNetwork(8, 16) for _ in range(4)],
+                top_k=2)
+        x = jnp.asarray(rng.normal(size=(2, 3, 8)), jnp.float32)
+    p = str(tmp_path / "m.bigdl")
+    m.save(p)
+    m2 = Module.load(p)
+    np.testing.assert_array_equal(
+        np.asarray(m.eval_mode().forward(x)),
+        np.asarray(m2.eval_mode().forward(x)))
+
+
+def test_checkpoint_pytree_roundtrip(tmp_path):
+    """The checkpoint codec preserves nested structure without pickle,
+    including tuples, int dict keys, and scalar dtypes."""
+    from bigdl_tpu.utils.file import load_pytree, save_pytree
+    tree = {"a": [np.arange(3), (np.float32(2.5), None)],
+            1: {"nested": np.ones((2, 2), np.float32)},
+            "s": "text", "flag": True}
+    p = str(tmp_path / "t.npz")
+    save_pytree(tree, p)
+    back = load_pytree(p)
+    assert back["s"] == "text" and back["flag"] is True
+    assert isinstance(back["a"][1], tuple)
+    np.testing.assert_array_equal(back["a"][0], np.arange(3))
+    np.testing.assert_array_equal(back[1]["nested"], tree[1]["nested"])
 
 
 def test_save_load_weights_roundtrip(tmp_path):
