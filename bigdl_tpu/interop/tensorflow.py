@@ -30,7 +30,7 @@ from bigdl_tpu.interop.protowire import (BYTES, FIXED32, VARINT, as_floats,
                                          varint)
 
 __all__ = ["load_tf_graph", "parse_graphdef", "save_tf_graph",
-           "register_tf_converter"]
+           "register_tf_converter", "TFSession"]
 
 # NodeDef fields
 _N_NAME, _N_OP, _N_INPUT, _N_DEVICE, _N_ATTR = 1, 2, 3, 4, 5
@@ -534,8 +534,322 @@ def _register_defaults():
 
     _TF_CONVERTERS["Placeholder"] = placeholder
 
+    # ---- extended op set (toward the reference's ~160 loaders,
+    # utils/tf/loaders/) -------------------------------------------------
+
+    _TF_CONVERTERS.update({
+        "Pow": simple(_jnp.power), "Floor": simple(_jnp.floor),
+        "Ceil": simple(_jnp.ceil), "Round": simple(_jnp.round),
+        "Sign": simple(_jnp.sign), "Softplus": simple(jax.nn.softplus),
+        "Softsign": simple(jax.nn.soft_sign),
+        "LogSoftmax": simple(lambda x: jax.nn.log_softmax(x, axis=-1)),
+        "Erf": simple(jax.lax.erf), "Sin": simple(_jnp.sin),
+        "Cos": simple(_jnp.cos), "Tan": simple(_jnp.tan),
+        "Atan": simple(_jnp.arctan), "Asin": simple(_jnp.arcsin),
+        "Acos": simple(_jnp.arccos), "Sinh": simple(_jnp.sinh),
+        "Cosh": simple(_jnp.cosh), "Log1p": simple(_jnp.log1p),
+        "Expm1": simple(_jnp.expm1),
+        "Reciprocal": simple(lambda x: 1.0 / x), "Inv": simple(
+            lambda x: 1.0 / x),
+        "FloorDiv": simple(_jnp.floor_divide),
+        "FloorMod": simple(_jnp.mod), "Mod": simple(_jnp.mod),
+        "SquaredDifference": simple(lambda a, b: (a - b) ** 2),
+        "AddN": simple(lambda *xs: sum(xs)),
+        "Equal": simple(_jnp.equal), "NotEqual": simple(_jnp.not_equal),
+        "Greater": simple(_jnp.greater),
+        "GreaterEqual": simple(_jnp.greater_equal),
+        "Less": simple(_jnp.less), "LessEqual": simple(_jnp.less_equal),
+        "LogicalAnd": simple(_jnp.logical_and),
+        "LogicalOr": simple(_jnp.logical_or),
+        "LogicalNot": simple(_jnp.logical_not),
+        "Select": simple(_jnp.where), "SelectV2": simple(_jnp.where),
+        "ZerosLike": simple(_jnp.zeros_like),
+        "OnesLike": simple(_jnp.ones_like),
+        "Shape": simple(lambda x: _jnp.asarray(x.shape, _jnp.int32)),
+        "Rank": simple(lambda x: _jnp.asarray(x.ndim, _jnp.int32)),
+        "Size": simple(lambda x: _jnp.asarray(x.size, _jnp.int32)),
+        "BatchMatMul": simple(_jnp.matmul),
+        "BatchMatMulV2": simple(_jnp.matmul),
+    })
+
+    def leaky_relu(n, nodes, const_of, resolve, node_of, layer_map):
+        alpha = float(n.attrs.get("alpha", 0.2))
+        m = _Lambda(lambda x: jax.nn.leaky_relu(x, alpha), n.name)
+        layer_map[n.name] = m
+        return node_of(m, resolve(n.inputs[0]))
+
+    _TF_CONVERTERS["LeakyRelu"] = leaky_relu
+
+    def reduction(jfn):
+        def cv(n, nodes, const_of, resolve, node_of, layer_map):
+            axes = const_of(n.inputs[1])
+            assert axes is not None, \
+                f"{n.op} {n.name}: dynamic reduction axes"
+            keep = bool(n.attrs.get("keep_dims",
+                                    n.attrs.get("keepdims", False)))
+            ax = tuple(int(a) for a in np.asarray(axes).reshape(-1))
+            m = _Lambda(lambda x: jfn(x, axis=ax, keepdims=keep), n.name)
+            layer_map[n.name] = m
+            return node_of(m, resolve(n.inputs[0]))
+        return cv
+
+    for _op, _f in (("Sum", _jnp.sum), ("Max", _jnp.max),
+                    ("Min", _jnp.min), ("Prod", _jnp.prod),
+                    ("All", _jnp.all), ("Any", _jnp.any)):
+        _TF_CONVERTERS[_op] = reduction(_f)
+
+    def argminmax(jfn):
+        def cv(n, nodes, const_of, resolve, node_of, layer_map):
+            axis = const_of(n.inputs[1])
+            assert axis is not None, f"{n.op} {n.name}: dynamic axis"
+            ax = int(np.asarray(axis).reshape(-1)[0])
+            m = _Lambda(lambda x: jfn(x, axis=ax).astype(_jnp.int64),
+                        n.name)
+            layer_map[n.name] = m
+            return node_of(m, resolve(n.inputs[0]))
+        return cv
+
+    _TF_CONVERTERS["ArgMax"] = argminmax(_jnp.argmax)
+    _TF_CONVERTERS["ArgMin"] = argminmax(_jnp.argmin)
+
+    def expand_dims(n, nodes, const_of, resolve, node_of, layer_map):
+        axis = const_of(n.inputs[1])
+        ax = int(np.asarray(axis).reshape(-1)[0])
+        m = _Lambda(lambda x: _jnp.expand_dims(x, ax), n.name)
+        layer_map[n.name] = m
+        return node_of(m, resolve(n.inputs[0]))
+
+    _TF_CONVERTERS["ExpandDims"] = expand_dims
+
+    def transpose(n, nodes, const_of, resolve, node_of, layer_map):
+        perm = const_of(n.inputs[1])
+        p = tuple(int(a) for a in np.asarray(perm).reshape(-1))
+        m = _Lambda(lambda x: _jnp.transpose(x, p), n.name)
+        layer_map[n.name] = m
+        return node_of(m, resolve(n.inputs[0]))
+
+    _TF_CONVERTERS["Transpose"] = transpose
+
+    def tf_slice(n, nodes, const_of, resolve, node_of, layer_map):
+        begin = const_of(n.inputs[1])
+        size = const_of(n.inputs[2])
+        assert begin is not None and size is not None, \
+            f"Slice {n.name}: dynamic begin/size"
+        b = [int(x) for x in np.asarray(begin).reshape(-1)]
+        s = [int(x) for x in np.asarray(size).reshape(-1)]
+
+        def fn(x):
+            idx = tuple(slice(bi, x.shape[i] if si == -1 else bi + si)
+                        for i, (bi, si) in enumerate(zip(b, s)))
+            return x[idx]
+        m = _Lambda(fn, n.name)
+        layer_map[n.name] = m
+        return node_of(m, resolve(n.inputs[0]))
+
+    _TF_CONVERTERS["Slice"] = tf_slice
+
+    def strided_slice(n, nodes, const_of, resolve, node_of, layer_map):
+        begin = const_of(n.inputs[1])
+        end = const_of(n.inputs[2])
+        strides = const_of(n.inputs[3]) if len(n.inputs) > 3 else None
+        assert begin is not None and end is not None, \
+            f"StridedSlice {n.name}: dynamic bounds"
+        for unsupported in ("ellipsis_mask", "new_axis_mask"):
+            if int(n.attrs.get(unsupported, 0) or 0):
+                raise ValueError(f"StridedSlice {n.name}: "
+                                 f"{unsupported} import not supported")
+        bm = int(n.attrs.get("begin_mask", 0))
+        em = int(n.attrs.get("end_mask", 0))
+        sa = int(n.attrs.get("shrink_axis_mask", 0))
+        b = [int(x) for x in np.asarray(begin).reshape(-1)]
+        e = [int(x) for x in np.asarray(end).reshape(-1)]
+        s = ([int(x) for x in np.asarray(strides).reshape(-1)]
+             if strides is not None else [1] * len(b))
+
+        def fn(x):
+            idx = []
+            for i in range(len(b)):
+                if sa & (1 << i):
+                    idx.append(b[i])
+                    continue
+                lo = None if bm & (1 << i) else b[i]
+                hi = None if em & (1 << i) else e[i]
+                idx.append(slice(lo, hi, s[i]))
+            return x[tuple(idx)]
+        m = _Lambda(fn, n.name)
+        layer_map[n.name] = m
+        return node_of(m, resolve(n.inputs[0]))
+
+    _TF_CONVERTERS["StridedSlice"] = strided_slice
+
+    def split(n, nodes, const_of, resolve, node_of, layer_map):
+        # Split: inputs = (axis, value); SplitV: (value, sizes, axis)
+        if n.op == "Split":
+            axis = const_of(n.inputs[0])
+            val = n.inputs[1]
+            parts = int(n.attrs.get("num_split", 1))
+            sizes = None
+        else:
+            val = n.inputs[0]
+            sizes = [int(x) for x in
+                     np.asarray(const_of(n.inputs[1])).reshape(-1)]
+            axis = const_of(n.inputs[2])
+            parts = len(sizes)
+        ax = int(np.asarray(axis).reshape(-1)[0])
+
+        def fn(x):
+            if sizes is None:
+                return tuple(_jnp.split(x, parts, axis=ax))
+            cuts = np.cumsum(sizes)[:-1].tolist()
+            return tuple(_jnp.split(x, cuts, axis=ax))
+        m = _Lambda(fn, n.name)
+        layer_map[n.name] = m
+        return node_of(m, resolve(val))
+
+    _TF_CONVERTERS["Split"] = split
+    _TF_CONVERTERS["SplitV"] = split
+
+    def pack(n, nodes, const_of, resolve, node_of, layer_map):
+        ax = int(n.attrs.get("axis", 0))
+        ins = [resolve(i) for i in n.inputs if not i.startswith("^")]
+        m = _Lambda(lambda *xs: _jnp.stack(xs, axis=ax), n.name)
+        layer_map[n.name] = m
+        return node_of(m, *ins)
+
+    _TF_CONVERTERS["Pack"] = pack
+
+    def unpack(n, nodes, const_of, resolve, node_of, layer_map):
+        ax = int(n.attrs.get("axis", 0))
+        num = int(n.attrs.get("num", 0))
+        m = _Lambda(lambda x: tuple(
+            _jnp.squeeze(p, axis=ax)
+            for p in _jnp.split(x, num or x.shape[ax], axis=ax)), n.name)
+        layer_map[n.name] = m
+        return node_of(m, resolve(n.inputs[0]))
+
+    _TF_CONVERTERS["Unpack"] = unpack
+
+    def tile(n, nodes, const_of, resolve, node_of, layer_map):
+        reps = const_of(n.inputs[1])
+        r = tuple(int(x) for x in np.asarray(reps).reshape(-1))
+        m = _Lambda(lambda x: _jnp.tile(x, r), n.name)
+        layer_map[n.name] = m
+        return node_of(m, resolve(n.inputs[0]))
+
+    _TF_CONVERTERS["Tile"] = tile
+
+    def gather(n, nodes, const_of, resolve, node_of, layer_map):
+        ax = 0
+        if n.op == "GatherV2" and len(n.inputs) > 2:
+            a = const_of(n.inputs[2])
+            if a is not None:
+                ax = int(np.asarray(a).reshape(-1)[0])
+        m = _Lambda(lambda x, i: _jnp.take(x, i.astype(_jnp.int32),
+                                           axis=ax), n.name)
+        layer_map[n.name] = m
+        return node_of(m, resolve(n.inputs[0]), resolve(n.inputs[1]))
+
+    _TF_CONVERTERS["Gather"] = gather
+    _TF_CONVERTERS["GatherV2"] = gather
+
+    def one_hot(n, nodes, const_of, resolve, node_of, layer_map):
+        depth = int(np.asarray(const_of(n.inputs[1])).reshape(-1)[0])
+        on = float(np.asarray(const_of(n.inputs[2])).reshape(-1)[0]) \
+            if const_of(n.inputs[2]) is not None else 1.0
+        off = float(np.asarray(const_of(n.inputs[3])).reshape(-1)[0]) \
+            if const_of(n.inputs[3]) is not None else 0.0
+        ax = int(n.attrs.get("axis", -1) or -1)
+
+        def fn(x):
+            y = jax.nn.one_hot(x.astype(_jnp.int32), depth) \
+                * (on - off) + off
+            return y if ax == -1 else _jnp.moveaxis(y, -1, ax)
+        m = _Lambda(fn, n.name)
+        layer_map[n.name] = m
+        return node_of(m, resolve(n.inputs[0]))
+
+    _TF_CONVERTERS["OneHot"] = one_hot
+
+    def cast(n, nodes, const_of, resolve, node_of, layer_map):
+        dst = int(n.attrs.get("DstT", _DT_FLOAT))
+        # TF DataType enum values
+        np_t = {_DT_FLOAT: _jnp.float32, 2: _jnp.float64, 3: _jnp.int32,
+                4: _jnp.uint8, 5: _jnp.int16, 6: _jnp.int8,
+                9: _jnp.int64, 10: _jnp.bool_, 14: _jnp.bfloat16,
+                17: _jnp.uint16, 19: _jnp.float16,
+                22: _jnp.uint32, 23: _jnp.uint64}.get(dst)
+        if np_t is None:
+            raise ValueError(f"Cast {n.name}: unsupported DstT={dst}")
+        m = _Lambda(lambda x: x.astype(np_t), n.name)
+        layer_map[n.name] = m
+        return node_of(m, resolve(n.inputs[0]))
+
+    _TF_CONVERTERS["Cast"] = cast
+
+    def fill(n, nodes, const_of, resolve, node_of, layer_map):
+        dims = const_of(n.inputs[0])
+        assert dims is not None, f"Fill {n.name}: dynamic shape"
+        shape = tuple(int(x) for x in np.asarray(dims).reshape(-1))
+        m = _Lambda(lambda v: _jnp.full(shape, v), n.name)
+        layer_map[n.name] = m
+        return node_of(m, resolve(n.inputs[1]))
+
+    _TF_CONVERTERS["Fill"] = fill
+
+    def resize(n, nodes, const_of, resolve, node_of, layer_map):
+        size = const_of(n.inputs[1])
+        h, w = (int(x) for x in np.asarray(size).reshape(-1))
+        method = ("bilinear" if n.op == "ResizeBilinear"
+                  else "nearest")
+        m = _Lambda(lambda x: jax.image.resize(
+            x, (x.shape[0], h, w, x.shape[3]), method), n.name)
+        layer_map[n.name] = m
+        return node_of(m, resolve(n.inputs[0]))
+
+    _TF_CONVERTERS["ResizeBilinear"] = resize
+    _TF_CONVERTERS["ResizeNearestNeighbor"] = resize
+
+    def mirror_pad(n, nodes, const_of, resolve, node_of, layer_map):
+        p = const_of(n.inputs[1])
+        pads = [(int(a), int(b)) for a, b in np.asarray(p)]
+        mode = n.attrs.get("mode", "REFLECT")
+        jmode = "reflect" if mode == "REFLECT" else "symmetric"
+        m = _Lambda(lambda x: _jnp.pad(x, pads, mode=jmode), n.name)
+        layer_map[n.name] = m
+        return node_of(m, resolve(n.inputs[0]))
+
+    _TF_CONVERTERS["MirrorPad"] = mirror_pad
+
 
 _register_defaults()
+
+
+class TFSession:
+    """Train an imported TF graph with the framework Optimizer
+    (≙ BigDLSessionImpl.train, utils/tf/Session.scala:43-132 — the
+    reference assembles a DistriOptimizer over the imported graph; here
+    the imported Graph IS a Module whose Conv/MatMul/BN nodes carry real
+    Parameters, so the Optimizer trains it directly)."""
+
+    def __init__(self, graph, inputs: Sequence[str],
+                 outputs: Sequence[str]):
+        self.model, self.layer_map = load_tf_graph(graph, inputs, outputs)
+
+    def train(self, dataset, criterion, optim_method=None,
+              end_when=None, batch_size: Optional[int] = None,
+              mesh_config=None) -> Module:
+        from bigdl_tpu.optim import Optimizer, SGD, Trigger
+        opt = Optimizer(self.model, dataset, criterion,
+                        batch_size=batch_size)
+        opt.set_optim_method(optim_method or SGD(0.01))
+        opt.set_end_when(end_when or Trigger.max_epoch(1))
+        if mesh_config is not None:
+            opt.set_mesh(mesh_config)
+        opt.optimize()
+        return self.model
+
+    def predict(self, x):
+        return self.model.eval_mode().forward(x)
 
 
 # --------------------------------------------------------------------------

@@ -103,6 +103,10 @@ class Optimizer:
         self._resume_from: Optional[str] = None
         self._last_val_neval = -1
         self._last_ckpt_neval = -1
+        self.retry_times = int(os.environ.get(
+            "BIGDL_TPU_FAILURE_RETRY_TIMES", "5"))
+        self.retry_interval_s = float(os.environ.get(
+            "BIGDL_TPU_FAILURE_RETRY_INTERVAL_S", "120"))
 
     # ---- configuration (reference Optimizer.scala setters) -------------
 
@@ -326,9 +330,75 @@ class Optimizer:
             logger.info("%s is %s", m.fmt, r)
         return out
 
+    def set_failure_retry(self, times: int,
+                          interval_s: float = 120.0) -> "Optimizer":
+        """Retry training from the latest checkpoint after a failure, up
+        to ``times`` retries; the counter resets when more than
+        ``interval_s`` passed since the previous failure (reference
+        bigdl.failure.retryTimes / retryTimeInterval,
+        DistriOptimizer.scala:901-983).  On TPU pods this covers
+        preemption and transient runtime errors."""
+        self.retry_times = int(times)
+        self.retry_interval_s = float(interval_s)
+        return self
+
+    def _latest_checkpoint(self) -> Optional[str]:
+        if not self.checkpoint_path:
+            return None
+        from bigdl_tpu.utils.file import is_remote_path
+        if is_remote_path(self.checkpoint_path):
+            try:
+                import fsspec
+                fs, root = fsspec.core.url_to_fs(self.checkpoint_path)
+                entries = [e for e in fs.ls(root, detail=True)
+                           if os.path.basename(
+                               e["name"]).startswith("checkpoint")
+                           and e["name"].endswith(".npz")]
+                if not entries:
+                    return None
+                best = max(entries,
+                           key=lambda e: e.get("mtime") or e["name"])
+                scheme = self.checkpoint_path.split("://", 1)[0]
+                return f"{scheme}://{best['name']}"
+            except Exception:
+                return None
+        if not os.path.isdir(self.checkpoint_path):
+            return None
+        files = [os.path.join(self.checkpoint_path, f)
+                 for f in os.listdir(self.checkpoint_path)
+                 if f.startswith("checkpoint") and f.endswith(".npz")]
+        return max(files, key=os.path.getmtime) if files else None
+
     # ---- main loop (≙ DistriOptimizer.optimize, :823) --------------------
 
     def optimize(self) -> Module:
+        """Run training, retrying from the latest checkpoint on failure
+        (≙ the reference's retry loop around optimize,
+        DistriOptimizer.scala:901-983)."""
+        retries_left = self.retry_times
+        last_failure = None
+        while True:
+            try:
+                return self._optimize_once()
+            except KeyboardInterrupt:
+                raise
+            except Exception as e:
+                now = time.time()
+                if last_failure is not None and \
+                        now - last_failure > self.retry_interval_s:
+                    retries_left = self.retry_times
+                last_failure = now
+                ckpt = self._latest_checkpoint()
+                if retries_left <= 0 or ckpt is None:
+                    raise
+                retries_left -= 1
+                logger.warning(
+                    "training failed (%s: %s); resuming from %s "
+                    "(%d retr%s left)", type(e).__name__, e, ckpt,
+                    retries_left, "y" if retries_left == 1 else "ies")
+                self._resume_from = ckpt
+
+    def _optimize_once(self) -> Module:
         from bigdl_tpu.core.module import param_paths
         mesh = self.mesh_config.build()
         model = self.model.train_mode()

@@ -19,9 +19,39 @@ from typing import Any, Dict, List, Tuple
 import numpy as np
 
 __all__ = ["save_pytree", "load_pytree", "save_checkpoint",
-           "load_checkpoint"]
+           "load_checkpoint", "open_file", "is_remote_path",
+           "np_load_any", "strip_file_scheme"]
 
 PYTREE_FORMAT_VERSION = 2
+
+
+def is_remote_path(path: str) -> bool:
+    return "://" in path and not path.startswith("file://")
+
+
+def strip_file_scheme(path: str) -> str:
+    return path[len("file://"):] if path.startswith("file://") else path
+
+
+def open_file(path: str, mode: str = "rb"):
+    """Open a local or remote (``gs://``/``s3://``/``hdfs://``/…) path
+    (≙ utils/File.scala:27-120 local/HDFS/S3 dispatch).  Remote schemes
+    route through fsspec; the scheme's backend (e.g. gcsfs for gs://)
+    must be installed."""
+    path = strip_file_scheme(path)
+    if is_remote_path(path):
+        try:
+            import fsspec
+        except ImportError as e:
+            raise RuntimeError(
+                f"remote path {path!r} requires fsspec (plus the "
+                f"scheme's backend, e.g. gcsfs for gs://)") from e
+        return fsspec.open(path, mode).open()
+    if "w" in mode or "a" in mode:
+        d = os.path.dirname(os.path.abspath(path))
+        if d:
+            os.makedirs(d, exist_ok=True)
+    return open(path, mode)
 
 
 def _encode(node: Any, arrays: List[np.ndarray], path: str):
@@ -81,16 +111,26 @@ def _check_legacy(files) -> None:
 def save_pytree(tree: Any, path: str) -> None:
     arrays: List[np.ndarray] = []
     structure = _encode(tree, arrays, "root")
-    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
     payload = {f"a{i}": a for i, a in enumerate(arrays)}
-    with open(path, "wb") as f:
+    with open_file(path, "wb") as f:
         np.savez(f, __structure__=_json_bytes(
             {"format": PYTREE_FORMAT_VERSION, "root": structure}),
             **payload)
 
 
+def np_load_any(path: str):
+    """np.load-ready handle for a local or remote path (remote content
+    is buffered host-side first — np.load needs a seekable file)."""
+    path = strip_file_scheme(path)
+    if is_remote_path(path):
+        import io
+        with open_file(path, "rb") as f:
+            return np.load(io.BytesIO(f.read()), allow_pickle=False)
+    return np.load(path, allow_pickle=False)
+
+
 def load_pytree(path: str) -> Any:
-    with np.load(path, allow_pickle=False) as z:
+    with np_load_any(path) as z:
         _check_legacy(z.files)
         meta = json.loads(z["__structure__"].tobytes().decode("utf-8"))
         if meta.get("format") != PYTREE_FORMAT_VERSION:

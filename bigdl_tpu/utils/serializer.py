@@ -202,13 +202,14 @@ def _decode_module(entry: dict, z) -> Module:
 
 
 def save_module(module: Module, path: str) -> None:
-    """Persist architecture + weights (≙ AbstractModule.saveModule)."""
+    """Persist architecture + weights (≙ AbstractModule.saveModule);
+    local or remote (gs://…) paths alike."""
+    from bigdl_tpu.utils.file import open_file
     arrays: List[np.ndarray] = []
     manifest = {"manifest_version": MANIFEST_VERSION,
                 "module": _encode_module(module, arrays, "")}
-    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
     payload = {f"a{i}": a for i, a in enumerate(arrays)}
-    with open(path, "wb") as f:
+    with open_file(path, "wb") as f:
         np.savez(f, __manifest__=np.frombuffer(
             json.dumps(manifest).encode("utf-8"), np.uint8), **payload)
 
@@ -216,7 +217,8 @@ def save_module(module: Module, path: str) -> None:
 def load_module(path: str) -> Module:
     """Rebuild a model saved by :func:`save_module`
     (≙ Module.loadModule, nn/Module.scala).  Never unpickles."""
-    with np.load(path, allow_pickle=False) as z:
+    from bigdl_tpu.utils.file import np_load_any
+    with np_load_any(path) as z:
         if "__treedef__" in z.files:
             raise ValueError(
                 "this model file uses the legacy pickle-based layout; "
@@ -266,14 +268,16 @@ def _flatten_state(module: Module) -> Dict[str, np.ndarray]:
 
 def save_weights(module: Module, path: str) -> None:
     """Weights-only save, keyed by dotted path (≙ saveWeights)."""
+    from bigdl_tpu.utils.file import open_file
     state = _flatten_state(module)
-    with open(path, "wb") as f:
+    with open_file(path, "wb") as f:
         np.savez(f, **state)
 
 
 def load_weights(module: Module, path: str, strict: bool = True) -> Module:
     """Load a weights-only file into an already-built architecture."""
-    with np.load(path, allow_pickle=False) as z:
+    from bigdl_tpu.utils.file import np_load_any
+    with np_load_any(path) as z:
         saved = {k: z[k] for k in z.files}
     have = _flatten_state(module)
     missing = set(have) - set(saved)

@@ -145,3 +145,16 @@ def test_main_requires_data_source():
     from bigdl_tpu.examples.lenet import main
     with pytest.raises(SystemExit):
         main(["-e", "1"])
+
+
+def test_ptb_main_real_files(tmp_path):
+    """PTB LM trains end-to-end from ptb.*.txt files on disk."""
+    from bigdl_tpu.examples.ptb_lm import main
+    text = ("the quick brown fox jumps over the lazy dog\n"
+            "a stitch in time saves nine\n") * 120
+    for split in ("train", "valid", "test"):
+        (tmp_path / f"ptb.{split}.txt").write_text(text)
+    model = main(["-f", str(tmp_path), "-e", "1", "-q", "-b", "8",
+                  "--hidden-size", "16", "--num-steps", "8",
+                  "--vocab-size", "30"])
+    assert model is not None

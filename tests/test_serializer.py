@@ -252,3 +252,20 @@ def test_torch_import_with_path_map():
     np.testing.assert_allclose(
         np.asarray(ours.eval_mode().forward(jnp.asarray(x))),
         tm(torch.tensor(x)).detach().numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_module_save_load_remote_and_file_scheme(tmp_path):
+    pytest.importorskip("fsspec")
+    m = nn.Linear(3, 2)
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(2, 3)),
+                    jnp.float32)
+    want = np.asarray(m.forward(x))
+    m.save("memory://bigdl_tpu_test/model.bigdl")
+    m2 = Module.load("memory://bigdl_tpu_test/model.bigdl")
+    np.testing.assert_allclose(np.asarray(m2.forward(x)), want)
+    # file:// URIs are local paths, not literal directories
+    p = f"file://{tmp_path}/m.bigdl"
+    m.save(p)
+    assert (tmp_path / "m.bigdl").exists()
+    m3 = Module.load(p)
+    np.testing.assert_allclose(np.asarray(m3.forward(x)), want)
