@@ -34,6 +34,9 @@ __all__ = ["load_tf_graph", "parse_graphdef", "save_tf_graph",
 
 # NodeDef fields
 _N_NAME, _N_OP, _N_INPUT, _N_DEVICE, _N_ATTR = 1, 2, 3, 4, 5
+
+# ops whose converters return a tuple of outputs (':N' port refs index it)
+_TUPLE_OUT_OPS = frozenset({"Split", "SplitV", "Unpack"})
 # attr map entry
 _MAP_KEY, _MAP_VALUE = 1, 2
 # AttrValue
@@ -222,14 +225,33 @@ def load_tf_graph(path_or_bytes, inputs: Sequence[str],
         input_nodes.append(gn)
 
     def resolve(name: str):
-        name = _clean(name)
-        if name in graph_nodes:
-            return graph_nodes[name]
-        n = nodes.get(name)
-        if n is None:
-            raise ValueError(f"unknown node {name!r}")
-        gn = build(n)
-        graph_nodes[name] = gn
+        base = _clean(name)
+        producer = nodes.get(base)
+        # ':N' selects output port N of a tuple-producing op (Split &c);
+        # single-output ops ignore the port (Switch's two ports collapse
+        # to one passthrough — selection happens at Merge)
+        port = 0
+        if ":" in name:
+            suffix = name.rsplit(":", 1)[1]
+            if suffix.isdigit():
+                port = int(suffix)
+        tuple_out = producer is not None and producer.op in _TUPLE_OUT_OPS
+        key = f"{base}:{port}" if tuple_out else base
+        if key in graph_nodes:
+            return graph_nodes[key]
+        if base in graph_nodes:
+            gn = graph_nodes[base]
+        else:
+            if producer is None:
+                raise ValueError(f"unknown node {name!r}")
+            gn = build(producer)
+            graph_nodes[base] = gn
+        if tuple_out:
+            # _Lambda unpacks a tuple input into positional args
+            sel = _Lambda(lambda *parts, p=port: parts[p],
+                          f"{base}:{port}")
+            gn = node_of(sel, gn)
+            graph_nodes[key] = gn
         return gn
 
     def data_inputs(n: TFNode):
@@ -758,7 +780,8 @@ def _register_defaults():
             if const_of(n.inputs[2]) is not None else 1.0
         off = float(np.asarray(const_of(n.inputs[3])).reshape(-1)[0]) \
             if const_of(n.inputs[3]) is not None else 0.0
-        ax = int(n.attrs.get("axis", -1) or -1)
+        ax_attr = n.attrs.get("axis")
+        ax = -1 if ax_attr is None else int(ax_attr)
 
         def fn(x):
             y = jax.nn.one_hot(x.astype(_jnp.int32), depth) \
@@ -796,18 +819,106 @@ def _register_defaults():
 
     _TF_CONVERTERS["Fill"] = fill
 
+    def _resize_coords(out_n, in_n, align_corners, half_pixel):
+        i = _jnp.arange(out_n, dtype=_jnp.float32)
+        if align_corners and out_n > 1:
+            return i * ((in_n - 1) / (out_n - 1))
+        if half_pixel:
+            return (i + 0.5) * (in_n / out_n) - 0.5
+        return i * (in_n / out_n)
+
+    def _tf1_resize(x, h, w, method, align_corners, half_pixel):
+        """TF1-exact resize: honors align_corners / half_pixel_centers /
+        asymmetric (the TF1 default) coordinate mappings, which differ
+        from jax.image.resize's fixed half-pixel sampling."""
+        in_h, in_w = x.shape[1], x.shape[2]
+        ys = _resize_coords(h, in_h, align_corners, half_pixel)
+        xs = _resize_coords(w, in_w, align_corners, half_pixel)
+        if method == "nearest":
+            yi = (_jnp.floor(ys + 0.5) if half_pixel
+                  else _jnp.floor(ys)).astype(_jnp.int32)
+            xi = (_jnp.floor(xs + 0.5) if half_pixel
+                  else _jnp.floor(xs)).astype(_jnp.int32)
+            yi = _jnp.clip(yi, 0, in_h - 1)
+            xi = _jnp.clip(xi, 0, in_w - 1)
+            return x[:, yi][:, :, xi]
+        ys = _jnp.clip(ys, 0.0, in_h - 1)
+        xs = _jnp.clip(xs, 0.0, in_w - 1)
+        y0 = _jnp.floor(ys).astype(_jnp.int32)
+        x0 = _jnp.floor(xs).astype(_jnp.int32)
+        y1 = _jnp.minimum(y0 + 1, in_h - 1)
+        x1 = _jnp.minimum(x0 + 1, in_w - 1)
+        wy = (ys - y0)[None, :, None, None]
+        wx = (xs - x0)[None, None, :, None]
+        top = x[:, y0][:, :, x0] * (1 - wx) + x[:, y0][:, :, x1] * wx
+        bot = x[:, y1][:, :, x0] * (1 - wx) + x[:, y1][:, :, x1] * wx
+        return top * (1 - wy) + bot * wy
+
     def resize(n, nodes, const_of, resolve, node_of, layer_map):
         size = const_of(n.inputs[1])
         h, w = (int(x) for x in np.asarray(size).reshape(-1))
         method = ("bilinear" if n.op == "ResizeBilinear"
                   else "nearest")
-        m = _Lambda(lambda x: jax.image.resize(
-            x, (x.shape[0], h, w, x.shape[3]), method), n.name)
+        ac = bool(n.attrs.get("align_corners", False))
+        hp = bool(n.attrs.get("half_pixel_centers", False))
+        m = _Lambda(lambda x: _tf1_resize(x, h, w, method, ac, hp),
+                    n.name)
         layer_map[n.name] = m
         return node_of(m, resolve(n.inputs[0]))
 
     _TF_CONVERTERS["ResizeBilinear"] = resize
     _TF_CONVERTERS["ResizeNearestNeighbor"] = resize
+
+    def tf_switch(n, nodes, const_of, resolve, node_of, layer_map):
+        """Switch passes its data input through; branch selection
+        happens at the matching Merge (under XLA both branches compute
+        and a select picks one — nn/tf/ControlOps.scala's dead-tensor
+        routing has no compiled equivalent, and needs none for
+        side-effect-free math graphs)."""
+        m = _Lambda(lambda x: x, n.name)
+        layer_map[n.name] = m
+        return node_of(m, resolve(n.inputs[0]))
+
+    _TF_CONVERTERS["Switch"] = tf_switch
+
+    def tf_merge(n, nodes, const_of, resolve, node_of, layer_map):
+        data_ins = [i for i in n.inputs if not i.startswith("^")]
+        if len(data_ins) != 2:
+            raise ValueError(f"Merge {n.name}: only 2-way cond merges "
+                             f"are importable")
+
+        def find_switch(name, depth=0):
+            base = _clean(name)
+            nd = nodes.get(base)
+            if nd is None or depth > 50:
+                return None, None
+            if nd.op == "Switch":
+                return nd, 1 if name.endswith(":1") else 0
+            for i in nd.inputs:
+                if i.startswith("^"):
+                    continue
+                sw, port = find_switch(i, depth + 1)
+                if sw is not None:
+                    return sw, port
+            return None, None
+
+        sw0, p0 = find_switch(data_ins[0])
+        sw1, p1 = find_switch(data_ins[1])
+        if sw0 is None or sw1 is None or sw0.name != sw1.name \
+                or {p0, p1} != {0, 1}:
+            raise ValueError(
+                f"Merge {n.name}: unsupported control-flow pattern — "
+                f"only the Switch/Merge cond pair imports; loops should "
+                f"be rebuilt with bigdl_tpu.ops.WhileLoop")
+        false_in = data_ins[0] if p0 == 0 else data_ins[1]
+        true_in = data_ins[1] if p0 == 0 else data_ins[0]
+        m = _Lambda(lambda f, t, p: _jnp.where(
+            _jnp.asarray(p).astype(bool), t, f), n.name)
+        layer_map[n.name] = m
+        return node_of(m, resolve(false_in), resolve(true_in),
+                       resolve(sw0.inputs[1]))
+
+    _TF_CONVERTERS["Merge"] = tf_merge
 
     def mirror_pad(n, nodes, const_of, resolve, node_of, layer_map):
         p = const_of(n.inputs[1])

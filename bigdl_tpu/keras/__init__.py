@@ -2,3 +2,7 @@
 
 from bigdl_tpu.keras.layers import *     # noqa: F401,F403
 from bigdl_tpu.keras.topology import Sequential  # noqa: F401
+from bigdl_tpu.keras.converter import (  # noqa: F401
+    load_keras, load_keras_json, load_keras_hdf5_weights,
+    register_keras_def_converter,
+)

@@ -1,0 +1,354 @@
+"""Keras-1.2.2 model converter: JSON definitions + HDF5 weights.
+
+Reference: pyspark/bigdl/keras/converter.py:32-420 (DefinitionLoader /
+WeightLoader / LayerConverter — loads real Keras-1.2.2 model JSON and
+HDF5 weight files and rebuilds them as BigDL models).  Same capability
+here over the ``bigdl_tpu.keras`` layer set.
+
+Supported definitions: Sequential and functional ``Model`` JSON with
+the layer classes in ``_DEF_CONVERTERS``.  Supported weights: Dense,
+Convolution2D (``dim_ordering="tf"``), BatchNormalization, Embedding.
+Explicit boundaries (loud errors, not silent drops): ``"th"``
+(NCHW) image ordering — this framework is NHWC-native — and recurrent
+weight import (per-gate Keras arrays vs our fused cells).
+
+Embedding ids follow this framework's 1-based convention: our id
+``i + 1`` is Keras index ``i`` (weight rows map directly).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from bigdl_tpu.core.module import Module, Parameter
+import bigdl_tpu.keras.layers as KL
+from bigdl_tpu.keras.topology import Sequential
+
+__all__ = ["load_keras", "load_keras_json", "load_keras_hdf5_weights",
+           "register_keras_def_converter"]
+
+
+def _dims(seq):
+    # None stays None (variable-length dims, e.g. LSTM timesteps);
+    # layers that need a concrete value fail where they consume it
+    return tuple(None if d is None else int(d) for d in seq)
+
+
+def _in_shape(cfg: dict):
+    bis = cfg.get("batch_input_shape")
+    if bis:
+        return _dims(bis[1:])
+    if cfg.get("input_shape"):
+        return _dims(cfg["input_shape"])
+    if cfg.get("input_dim"):
+        return (int(cfg["input_dim"]),)
+    return None
+
+
+def _check_tf_ordering(cfg: dict, cls: str):
+    ordering = cfg.get("dim_ordering", "tf")
+    if ordering == "th":
+        raise ValueError(
+            f"{cls}: dim_ordering='th' (NCHW) models are not supported — "
+            f"this framework is NHWC-native; re-save the Keras model "
+            f"with dim_ordering='tf'")
+
+
+def _dense(cfg):
+    return KL.Dense(int(cfg["output_dim"]),
+                    activation=cfg.get("activation"),
+                    bias=cfg.get("bias", True),
+                    input_shape=_in_shape(cfg))
+
+
+def _activation(cfg):
+    return KL.Activation(cfg["activation"], input_shape=_in_shape(cfg))
+
+
+def _dropout(cfg):
+    return KL.Dropout(float(cfg["p"]), input_shape=_in_shape(cfg))
+
+
+def _flatten(cfg):
+    return KL.Flatten(input_shape=_in_shape(cfg))
+
+
+def _reshape(cfg):
+    return KL.Reshape([int(d) for d in cfg["target_shape"]],
+                      input_shape=_in_shape(cfg))
+
+
+def _conv2d(cfg):
+    _check_tf_ordering(cfg, "Convolution2D")
+    return KL.Convolution2D(
+        int(cfg["nb_filter"]), int(cfg["nb_row"]), int(cfg["nb_col"]),
+        activation=cfg.get("activation"),
+        border_mode=cfg.get("border_mode", "valid"),
+        subsample=tuple(cfg.get("subsample", (1, 1))),
+        bias=cfg.get("bias", True),
+        input_shape=_in_shape(cfg))
+
+
+def _pool2d(cls):
+    def cv(cfg):
+        _check_tf_ordering(cfg, cls.__name__)
+        return cls(pool_size=tuple(cfg.get("pool_size", (2, 2))),
+                   strides=(tuple(cfg["strides"]) if cfg.get("strides")
+                            else None),
+                   border_mode=cfg.get("border_mode", "valid"),
+                   input_shape=_in_shape(cfg))
+    return cv
+
+
+def _global_avg(cfg):
+    _check_tf_ordering(cfg, "GlobalAveragePooling2D")
+    return KL.GlobalAveragePooling2D(input_shape=_in_shape(cfg))
+
+
+def _bn(cfg):
+    mode = cfg.get("mode", 0)
+    if mode != 0:
+        raise ValueError(f"BatchNormalization mode={mode} not supported "
+                         f"(only feature-wise mode 0)")
+    return KL.BatchNormalization(
+        epsilon=float(cfg.get("epsilon", 1e-3)),
+        momentum=float(cfg.get("momentum", 0.99)),
+        input_shape=_in_shape(cfg))
+
+
+def _embedding(cfg):
+    return KL.Embedding(int(cfg["input_dim"]), int(cfg["output_dim"]),
+                        input_shape=_in_shape(cfg))
+
+
+def _recurrent(cls):
+    def cv(cfg):
+        return cls(int(cfg["output_dim"]),
+                   return_sequences=cfg.get("return_sequences", False),
+                   input_shape=_in_shape(cfg))
+    return cv
+
+
+def _highway(cfg):
+    return KL.Highway(activation=cfg.get("activation", "tanh"),
+                      input_shape=_in_shape(cfg))
+
+
+def _merge(cfg):
+    return KL.Merge(mode=cfg.get("mode", "sum"),
+                    concat_axis=int(cfg.get("concat_axis", -1)))
+
+
+def _input_layer(cfg):
+    shape = _in_shape(cfg)
+    if shape is None:
+        raise ValueError("InputLayer without batch_input_shape")
+    return KL.InputLayer(shape)
+
+
+_DEF_CONVERTERS: Dict[str, Callable[[dict], Module]] = {
+    "Dense": _dense, "Activation": _activation, "Dropout": _dropout,
+    "Flatten": _flatten, "Reshape": _reshape,
+    "Convolution2D": _conv2d,
+    "MaxPooling2D": _pool2d(KL.MaxPooling2D),
+    "AveragePooling2D": _pool2d(KL.AveragePooling2D),
+    "GlobalAveragePooling2D": _global_avg,
+    "BatchNormalization": _bn, "Embedding": _embedding,
+    "LSTM": _recurrent(KL.LSTM), "GRU": _recurrent(KL.GRU),
+    "SimpleRNN": _recurrent(KL.SimpleRNN),
+    "Highway": _highway, "Merge": _merge, "InputLayer": _input_layer,
+}
+
+
+def register_keras_def_converter(class_name: str,
+                                 fn: Callable[[dict], Module]) -> None:
+    """Register/override a Keras class_name → layer converter
+    (≙ the reference's customized-converter hook)."""
+    _DEF_CONVERTERS[class_name] = fn
+
+
+def _convert_layer(spec: dict) -> Module:
+    cls = spec["class_name"]
+    conv = _DEF_CONVERTERS.get(cls)
+    if conv is None:
+        raise ValueError(f"no Keras converter for class {cls!r}; "
+                         f"register one with "
+                         f"register_keras_def_converter")
+    layer = conv(spec.get("config", {}))
+    name = spec.get("config", {}).get("name") or spec.get("name")
+    if name:
+        layer.set_name(name)
+    return layer
+
+
+def load_keras_json(source) -> Module:
+    """Keras-1.2.2 model JSON (string, dict, or path) → model
+    (≙ DefinitionLoader, keras/converter.py)."""
+    if isinstance(source, dict):
+        spec = source
+    elif isinstance(source, str) and source.lstrip().startswith("{"):
+        spec = json.loads(source)
+    else:
+        with open(source) as f:
+            spec = json.load(f)
+    cls = spec.get("class_name")
+    if cls == "Sequential":
+        model = Sequential()
+        for layer_spec in spec["config"]:
+            model.add(_convert_layer(layer_spec))
+        return model
+    if cls == "Model":
+        return _load_functional(spec["config"])
+    raise ValueError(f"unsupported top-level Keras class {cls!r}")
+
+
+def _load_functional(cfg: dict) -> Module:
+    """Functional-API graph → nn.Graph via the Node DSL."""
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.nn.containers import node_of
+
+    layers = {spec["name"]: spec for spec in cfg["layers"]}
+    nodes: Dict[str, Any] = {}
+
+    def build(name: str):
+        if name in nodes:
+            return nodes[name]
+        spec = layers[name]
+        if spec["class_name"] == "InputLayer":
+            gn = nn.Input()
+            nodes[name] = gn
+            return gn
+        inbound = spec.get("inbound_nodes") or []
+        prev_names = [ref[0] for ref in inbound[0]] if inbound else []
+        prevs = [build(p) for p in prev_names]
+        layer = _convert_layer(spec)
+        gn = node_of(layer, *prevs)
+        nodes[name] = gn
+        return gn
+
+    outs = [build(ref[0]) for ref in cfg["output_layers"]]
+    # Graph maps forward() arguments positionally: input order must be
+    # the model's declared input_layers order, not traversal order
+    inputs = [build(ref[0]) for ref in cfg["input_layers"]]
+    return nn.Graph(inputs, outs)
+
+
+# ---- HDF5 weights (≙ WeightLoader) ----------------------------------------
+
+def _h5_layer_weights(h5path: str) -> Dict[str, List[np.ndarray]]:
+    import h5py
+    out: Dict[str, List[np.ndarray]] = {}
+    with h5py.File(h5path, "r") as f:
+        root = f["model_weights"] if "model_weights" in f else f
+        layer_names = [n.decode() if isinstance(n, bytes) else n
+                       for n in root.attrs.get("layer_names", [])]
+        for lname in layer_names:
+            g = root[lname]
+            wnames = [n.decode() if isinstance(n, bytes) else n
+                      for n in g.attrs.get("weight_names", [])]
+            out[lname] = [np.asarray(g[w]) for w in wnames]
+    return out
+
+
+def _set_dense(layer, w):
+    lin = layer.inner
+    if not hasattr(lin, "weight"):   # Sequential(linear, activation)
+        lin = lin.layers[0] if hasattr(lin, "layers") else lin.modules()[0]
+    lin.weight = Parameter(w[0].T)   # keras (in, out) → ours (out, in)
+    if len(w) > 1 and getattr(lin, "bias", None) is not None:
+        lin.bias = Parameter(w[1])
+
+
+def _set_conv(layer, w):
+    conv = layer.inner
+    if not hasattr(conv, "weight"):
+        conv = conv.layers[0] if hasattr(conv, "layers") \
+            else conv.modules()[0]
+    kw = w[0]
+    if kw.ndim != 4:
+        raise ValueError(f"Convolution2D weight rank {kw.ndim}")
+    if kw.shape[:2] != tuple(np.asarray(conv.weight.shape[:2])):
+        # 'th' layout (out, in, rows, cols) → HWIO
+        kw = np.transpose(kw, (2, 3, 1, 0))
+    conv.weight = Parameter(kw)
+    if len(w) > 1 and getattr(conv, "bias", None) is not None:
+        conv.bias = Parameter(w[1])
+
+
+def _set_bn(layer, w):
+    bn = layer.inner
+    # keras 1.2.2 order: gamma, beta, running_mean, running_std
+    bn.weight = Parameter(w[0])
+    bn.bias = Parameter(w[1])
+    if len(w) > 2:
+        bn.running_mean = np.asarray(w[2], np.float32)
+    if len(w) > 3:
+        bn.running_var = np.asarray(w[3], np.float32)
+
+
+def _set_embedding(layer, w):
+    emb = layer.inner
+    emb.weight = Parameter(w[0])
+
+
+_WEIGHT_SETTERS = {
+    KL.Dense: _set_dense, KL.Convolution2D: _set_conv,
+    KL.BatchNormalization: _set_bn, KL.Embedding: _set_embedding,
+}
+
+
+def load_keras_hdf5_weights(model: Module, h5path: str,
+                            strict: bool = True) -> Module:
+    """Copy Keras-1.2.2 HDF5 weights into a converted model by layer
+    name (≙ WeightLoader.load_weights_from_hdf5)."""
+    weights = _h5_layer_weights(h5path)
+    named = {m.get_name(): m for _, m in model.named_modules()}
+    for lname, w in weights.items():
+        if not w:
+            continue
+        layer = named.get(lname)
+        if layer is None:
+            if strict:
+                raise KeyError(f"weight file layer {lname!r} not found "
+                               f"in the model")
+            continue
+        setter = _WEIGHT_SETTERS.get(type(layer))
+        if setter is None:
+            raise NotImplementedError(
+                f"weight import for {type(layer).__name__} "
+                f"(layer {lname!r}) is not supported — recurrent and "
+                f"custom layers must be loaded manually")
+        if not getattr(layer, "built", True):
+            raise RuntimeError(
+                f"layer {lname!r} is not built; call model.build("
+                f"input_shape) before loading weights")
+        setter(layer, [np.asarray(x) for x in w])
+    return model
+
+
+def load_keras(json_path: Optional[str] = None,
+               hdf5_path: Optional[str] = None) -> Module:
+    """Load a Keras-1.2.2 model: definition from JSON (or from the
+    HDF5's ``model_config`` attribute) plus optional HDF5 weights
+    (≙ keras/converter.py load_* entry points)."""
+    if json_path is None and hdf5_path is None:
+        raise ValueError("provide json_path and/or hdf5_path")
+    if json_path is None:
+        import h5py
+        with h5py.File(hdf5_path, "r") as f:
+            raw = f.attrs.get("model_config")
+            if raw is None:
+                raise ValueError(
+                    f"{hdf5_path!r} holds no model_config — pass the "
+                    f"model JSON explicitly")
+            if isinstance(raw, bytes):
+                raw = raw.decode("utf-8")
+        model = load_keras_json(raw)
+    else:
+        model = load_keras_json(json_path)
+    if hdf5_path is not None:
+        load_keras_hdf5_weights(model, hdf5_path)
+    return model

@@ -12,6 +12,10 @@ from bigdl_tpu.ops.attention_kernels import (
 )
 from bigdl_tpu.ops import operations  # noqa: F401
 from bigdl_tpu.ops.operations import *  # noqa: F401,F403
+from bigdl_tpu.ops.control import (  # noqa: F401
+    Cond, Scan, TensorArrayScan, WhileLoop,
+)
 
-__all__ = ["dot_product_attention", "flash_attention"] \
+__all__ = ["dot_product_attention", "flash_attention",
+           "Cond", "WhileLoop", "Scan", "TensorArrayScan"] \
     + list(operations.__all__)

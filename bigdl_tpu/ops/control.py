@@ -1,0 +1,127 @@
+"""Control-flow modules: data-dependent branching and loops inside jit.
+
+Reference: nn/Scheduler.scala + nn/FrameManager.scala (DynamicGraph's
+runtime interpreter for control-flow nodes) and nn/tf/ControlOps.scala
+(Switch/Merge/Enter/Exit/NextIteration), nn/tf/DataFlowOps.scala
+(TensorArray).  The reference needed a scheduler because the JVM had to
+*interpret* control-flow ops per element; under XLA the compiler owns
+control flow, so the TPU-native redesign is thin Module wrappers over
+``lax.cond`` / ``lax.while_loop`` / ``lax.scan`` — same capability
+(conditional branches, data-dependent loops, per-step accumulation),
+compiled instead of interpreted, and differentiable where XLA supports
+it (cond/scan; while_loop is forward-only).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from bigdl_tpu.core.module import Module
+
+__all__ = ["Cond", "WhileLoop", "Scan", "TensorArrayScan"]
+
+
+class Cond(Module):
+    """``forward((pred, x))`` → ``true_branch(x)`` when pred else
+    ``false_branch(x)``; both branches are compiled, one executes
+    (≙ the Switch/Merge pair of nn/tf/ControlOps.scala:1-326 — but as
+    structured ``lax.cond`` instead of dataflow dead-tensor routing)."""
+
+    def __init__(self, true_branch: Module, false_branch: Module):
+        super().__init__()
+        self.true_branch = true_branch
+        self.false_branch = false_branch
+
+    def forward(self, inputs):
+        pred, x = inputs
+        pred = jnp.asarray(pred)
+        if pred.ndim:
+            pred = pred.reshape(())
+        return lax.cond(pred,
+                        lambda v: self.true_branch(v),
+                        lambda v: self.false_branch(v), x)
+
+
+class WhileLoop(Module):
+    """``forward(state)`` iterates ``body`` while ``cond_fn(state)``
+    holds (≙ Enter/NextIteration/Exit frames of ControlOps + the
+    FrameManager loop bookkeeping, as one ``lax.while_loop``).
+
+    ``max_iterations`` adds the reference's loop guard: the condition
+    becomes ``cond_fn(state) & (i < max_iterations)``."""
+
+    def __init__(self, cond_fn: Callable, body: Module,
+                 max_iterations: Optional[int] = None):
+        super().__init__()
+        self.cond_fn = cond_fn
+        self.body = body
+        self.max_iterations = max_iterations
+
+    def forward(self, state):
+        if self.max_iterations is None:
+            return lax.while_loop(self.cond_fn,
+                                  lambda s: self.body(s), state)
+        limit = self.max_iterations
+
+        def cond(carry):
+            i, s = carry
+            return jnp.logical_and(i < limit,
+                                   jnp.asarray(self.cond_fn(s)))
+
+        def body(carry):
+            i, s = carry
+            return i + 1, self.body(s)
+
+        _, out = lax.while_loop(cond, body,
+                                (jnp.zeros((), jnp.int32), state))
+        return out
+
+
+class Scan(Module):
+    """Apply ``body`` over the time axis carrying state:
+    ``forward((state0, xs))`` → ``(stateN, ys)`` where
+    ``body((state, x_t))`` → ``(state', y_t)``.  The compiled analog of
+    the Scheduler stepping a DynamicGraph per timestep."""
+
+    def __init__(self, body: Module, time_axis: int = 1):
+        super().__init__()
+        self.body = body
+        self.time_axis = time_axis
+
+    def forward(self, inputs):
+        state0, xs = inputs
+        t_ax = self.time_axis
+        xs_t = jnp.moveaxis(xs, t_ax, 0)
+
+        def step(state, x_t):
+            state2, y = self.body((state, x_t))
+            return state2, y
+
+        stateN, ys = lax.scan(step, state0, xs_t)
+        return stateN, jnp.moveaxis(ys, 0, t_ax)
+
+
+class TensorArrayScan(Module):
+    """Per-step write-then-stack accumulation — the XLA-native shape of
+    nn/tf/DataFlowOps.scala's TensorArray (write inside a loop, stack at
+    exit).  ``forward(xs)`` applies ``body`` to each timestep and stacks
+    the results; equivalent to TensorArray.scatter+stack semantics."""
+
+    def __init__(self, body: Module, time_axis: int = 1):
+        super().__init__()
+        self.body = body
+        self.time_axis = time_axis
+
+    def forward(self, xs):
+        t_ax = self.time_axis
+        xs_t = jnp.moveaxis(xs, t_ax, 0)
+
+        def step(_, x_t):
+            return None, self.body(x_t)
+
+        _, ys = lax.scan(step, None, xs_t)
+        return jnp.moveaxis(ys, 0, t_ax)

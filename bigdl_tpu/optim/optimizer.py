@@ -348,6 +348,7 @@ class Optimizer:
         from bigdl_tpu.utils.file import is_remote_path
         if is_remote_path(self.checkpoint_path):
             try:
+                import re
                 import fsspec
                 fs, root = fsspec.core.url_to_fs(self.checkpoint_path)
                 entries = [e for e in fs.ls(root, detail=True)
@@ -356,11 +357,22 @@ class Optimizer:
                            and e["name"].endswith(".npz")]
                 if not entries:
                     return None
-                best = max(entries,
-                           key=lambda e: e.get("mtime") or e["name"])
+                mtimes = [e.get("mtime") for e in entries]
+                if all(m is not None for m in mtimes):
+                    best = max(entries, key=lambda e: e["mtime"])
+                else:
+                    # no reliable mtimes: order by the numeric iteration
+                    # suffix (checkpoint.<neval>.npz), then name
+                    def key(e):
+                        m = re.search(r"checkpoint\.(\d+)\.npz$",
+                                      e["name"])
+                        return (int(m.group(1)) if m else -1, e["name"])
+                    best = max(entries, key=key)
                 scheme = self.checkpoint_path.split("://", 1)[0]
                 return f"{scheme}://{best['name']}"
             except Exception:
+                logger.warning("could not list remote checkpoint dir %s",
+                               self.checkpoint_path, exc_info=True)
                 return None
         if not os.path.isdir(self.checkpoint_path):
             return None

@@ -1,0 +1,117 @@
+"""Control-flow modules (≙ nn/Scheduler + nn/FrameManager + nn/tf
+ControlOps/DataFlowOps, redesigned as lax.cond/while/scan) and the TF
+Switch/Merge cond-pattern import."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.core.module import Module
+from bigdl_tpu.ops import Cond, Scan, TensorArrayScan, WhileLoop
+from bigdl_tpu.utils import set_seed
+
+
+class _Lam(Module):
+    def __init__(self, fn):
+        super().__init__()
+        self.fn = fn
+
+    def forward(self, x):
+        return self.fn(x)
+
+
+def test_cond_branches():
+    set_seed(0)
+    c = Cond(_Lam(lambda x: x * 2.0), _Lam(lambda x: -x))
+    x = jnp.asarray([1.0, 2.0])
+    np.testing.assert_allclose(np.asarray(c((True, x))), [2.0, 4.0])
+    np.testing.assert_allclose(np.asarray(c((False, x))), [-1.0, -2.0])
+    # under jit with a traced predicate
+    f = jax.jit(lambda p, v: c((p, v)))
+    np.testing.assert_allclose(np.asarray(f(jnp.asarray(True), x)),
+                               [2.0, 4.0])
+
+
+def test_cond_with_parameterized_branches_grads():
+    set_seed(1)
+    from bigdl_tpu.core.module import combine, partition
+    c = Cond(nn.Linear(4, 4), nn.Identity())
+    x = jnp.ones((2, 4))
+    params, rest = partition(c)
+
+    def loss(p, pred):
+        return jnp.sum(combine(p, rest)((pred, x)) ** 2)
+
+    g_true = jax.grad(loss)(params, jnp.asarray(True))
+    leaves = jax.tree_util.tree_leaves(g_true)
+    assert any(float(jnp.abs(l).max()) > 0 for l in leaves)
+
+
+def test_while_loop_and_guard():
+    body = _Lam(lambda s: s + 1.0)
+    w = WhileLoop(lambda s: s < 10.0, body)
+    assert float(w(jnp.asarray(0.0))) == 10.0
+    w2 = WhileLoop(lambda s: s < 10.0, body, max_iterations=3)
+    assert float(w2(jnp.asarray(0.0))) == 3.0
+
+
+def test_scan_carries_state():
+    class Acc(Module):
+        def forward(self, inputs):
+            state, x = inputs
+            s2 = state + x
+            return s2, s2
+
+    s = Scan(Acc(), time_axis=1)
+    xs = jnp.asarray(np.ones((2, 5, 3), np.float32))
+    final, ys = s((jnp.zeros((2, 3)), xs))
+    np.testing.assert_allclose(np.asarray(final), np.full((2, 3), 5.0))
+    np.testing.assert_allclose(np.asarray(ys)[:, -1], np.full((2, 3), 5.0))
+    np.testing.assert_allclose(np.asarray(ys)[:, 0], np.ones((2, 3)))
+
+
+def test_tensor_array_scan():
+    t = TensorArrayScan(_Lam(lambda x: x * 2.0), time_axis=1)
+    xs = jnp.asarray(np.arange(12, dtype=np.float32).reshape(2, 3, 2))
+    out = np.asarray(t(xs))
+    np.testing.assert_allclose(out, np.asarray(xs) * 2.0)
+
+
+def test_tf_switch_merge_cond_import():
+    from tests.test_tensorflow_interop import (
+        attr, const_node, graphdef, node,
+    )
+    from bigdl_tpu.interop.tensorflow import load_tf_graph
+    gd = graphdef(
+        node("x", "Placeholder"),
+        const_node("zero", np.asarray([0.0], np.float32)),
+        node("s", "Sum", ["x", "axes"]),
+        const_node("axes", np.asarray([0], np.int32)),
+        node("pred", "Greater", ["s", "zero"]),
+        node("sw", "Switch", ["x", "pred"]),
+        const_node("two", np.asarray(2.0, np.float32)),
+        node("tbr", "Mul", ["sw:1", "two"]),
+        node("fbr", "Neg", ["sw"]),
+        node("out", "Merge", ["fbr", "tbr"]),
+    )
+    model, _ = load_tf_graph(gd, ["x"], ["out"])
+    x_pos = jnp.asarray([1.0, 2.0])
+    x_neg = jnp.asarray([-1.0, -2.0])
+    np.testing.assert_allclose(np.asarray(model(x_pos)), [2.0, 4.0])
+    np.testing.assert_allclose(np.asarray(model(x_neg)), [1.0, 2.0])
+
+
+def test_tf_merge_rejects_loop_pattern():
+    from tests.test_tensorflow_interop import graphdef, node
+    from bigdl_tpu.interop.tensorflow import load_tf_graph
+    gd = graphdef(
+        node("x", "Placeholder"),
+        node("a", "Neg", ["x"]),
+        node("b", "Neg", ["x"]),
+        node("out", "Merge", ["a", "b"]),
+    )
+    with pytest.raises(ValueError, match="Switch/Merge"):
+        load_tf_graph(gd, ["x"], ["out"])

@@ -4,6 +4,8 @@
 import numpy as np
 import pytest
 
+import jax.numpy as jnp
+
 from bigdl_tpu import keras
 from bigdl_tpu.utils import set_seed
 
@@ -120,3 +122,182 @@ def test_unknown_names_raise():
     with pytest.raises(RuntimeError):
         keras.Sequential().add(keras.Dense(2, input_shape=(3,))).fit(
             np.ones((8, 3), np.float32), np.ones((8, 2), np.float32))
+
+
+# ---- Keras-1.2.2 JSON/HDF5 converter (≙ pyspark keras/converter.py) ------
+
+def _h5_weights(path, layers):
+    """Write a Keras-1.2.2-layout HDF5 weight file."""
+    h5py = pytest.importorskip("h5py")
+    with h5py.File(path, "w") as f:
+        f.attrs["layer_names"] = np.array(
+            [n.encode() for n in layers], dtype="S32")
+        for lname, ws in layers.items():
+            g = f.create_group(lname)
+            wnames = [f"{lname}/w_{i}".encode()
+                      for i in range(len(ws))]
+            g.attrs["weight_names"] = np.array(wnames, dtype="S64")
+            for nm, w in zip(wnames, ws):
+                g.create_dataset(nm.decode(), data=w)
+
+
+def test_keras_json_dense_sequential(tmp_path):
+    from bigdl_tpu.keras import load_keras
+    spec = {"class_name": "Sequential", "config": [
+        {"class_name": "Dense", "config": {
+            "name": "fc1", "output_dim": 5, "activation": "relu",
+            "batch_input_shape": [None, 4]}},
+        {"class_name": "Dense", "config": {
+            "name": "fc2", "output_dim": 3, "activation": "softmax"}},
+    ]}
+    rng = np.random.RandomState(0)
+    w1, b1 = rng.randn(4, 5).astype(np.float32), \
+        rng.randn(5).astype(np.float32)
+    w2, b2 = rng.randn(5, 3).astype(np.float32), \
+        rng.randn(3).astype(np.float32)
+    jp = tmp_path / "model.json"
+    jp.write_text(__import__("json").dumps(spec))
+    hp = str(tmp_path / "weights.h5")
+    _h5_weights(hp, {"fc1": [w1, b1], "fc2": [w2, b2]})
+    model = load_keras(str(jp), hp)
+    x = rng.randn(2, 4).astype(np.float32)
+    got = np.asarray(model.eval_mode().forward(jnp.asarray(x)))
+    h = np.maximum(x @ w1 + b1, 0)
+    logits = h @ w2 + b2
+    want = np.exp(logits) / np.exp(logits).sum(1, keepdims=True)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_keras_json_conv_tf_ordering(tmp_path):
+    from bigdl_tpu.keras import load_keras_hdf5_weights, load_keras_json
+    spec = {"class_name": "Sequential", "config": [
+        {"class_name": "Convolution2D", "config": {
+            "name": "c1", "nb_filter": 2, "nb_row": 3, "nb_col": 3,
+            "dim_ordering": "tf", "border_mode": "same",
+            "batch_input_shape": [None, 6, 6, 3]}},
+        {"class_name": "Flatten", "config": {"name": "fl"}},
+    ]}
+    model = load_keras_json(spec)
+    rng = np.random.RandomState(1)
+    kw = rng.randn(3, 3, 3, 2).astype(np.float32)
+    kb = rng.randn(2).astype(np.float32)
+    hp = str(tmp_path / "w.h5")
+    _h5_weights(hp, {"c1": [kw, kb]})
+    load_keras_hdf5_weights(model, hp)
+    x = rng.randn(1, 6, 6, 3).astype(np.float32)
+    got = np.asarray(model.eval_mode().forward(jnp.asarray(x)))
+    assert got.shape == (1, 72)
+    tor = pytest.importorskip("torch")
+    want = tor.nn.functional.conv2d(
+        tor.tensor(x.transpose(0, 3, 1, 2)),
+        tor.tensor(kw.transpose(3, 2, 0, 1)), tor.tensor(kb),
+        padding=1).permute(0, 2, 3, 1).reshape(1, -1).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_keras_th_ordering_rejected():
+    from bigdl_tpu.keras import load_keras_json
+    spec = {"class_name": "Sequential", "config": [
+        {"class_name": "Convolution2D", "config": {
+            "name": "c1", "nb_filter": 2, "nb_row": 3, "nb_col": 3,
+            "dim_ordering": "th",
+            "batch_input_shape": [None, 3, 6, 6]}}]}
+    with pytest.raises(ValueError, match="th"):
+        load_keras_json(spec)
+
+
+def test_keras_functional_model_with_merge():
+    from bigdl_tpu.keras import load_keras_json
+    spec = {"class_name": "Model", "config": {
+        "layers": [
+            {"class_name": "InputLayer", "name": "inp",
+             "config": {"name": "inp", "batch_input_shape": [None, 4]},
+             "inbound_nodes": []},
+            {"class_name": "Dense", "name": "a",
+             "config": {"name": "a", "output_dim": 4,
+                        "activation": "relu"},
+             "inbound_nodes": [[["inp", 0, 0]]]},
+            {"class_name": "Dense", "name": "b",
+             "config": {"name": "b", "output_dim": 4},
+             "inbound_nodes": [[["inp", 0, 0]]]},
+            {"class_name": "Merge", "name": "m",
+             "config": {"name": "m", "mode": "concat",
+                        "concat_axis": -1},
+             "inbound_nodes": [[["a", 0, 0], ["b", 0, 0]]]},
+        ],
+        "input_layers": [["inp", 0, 0]],
+        "output_layers": [["m", 0, 0]],
+    }}
+    model = load_keras_json(spec)
+    x = np.random.RandomState(0).randn(2, 4).astype(np.float32)
+    out = np.asarray(model.eval_mode().forward(jnp.asarray(x)))
+    assert out.shape == (2, 8)
+
+
+def test_keras_model_config_in_h5(tmp_path):
+    h5py = pytest.importorskip("h5py")
+    from bigdl_tpu.keras import load_keras
+    spec = {"class_name": "Sequential", "config": [
+        {"class_name": "Dense", "config": {
+            "name": "fc", "output_dim": 2,
+            "batch_input_shape": [None, 3]}}]}
+    rng = np.random.RandomState(2)
+    w, b = rng.randn(3, 2).astype(np.float32), \
+        rng.randn(2).astype(np.float32)
+    hp = str(tmp_path / "full.h5")
+    _h5_weights(hp, {"fc": [w, b]})
+    with h5py.File(hp, "a") as f:
+        f.attrs["model_config"] = __import__("json").dumps(spec)
+    model = load_keras(hdf5_path=hp)
+    x = rng.randn(2, 3).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(model.eval_mode().forward(jnp.asarray(x))),
+        x @ w + b, rtol=1e-5, atol=1e-6)
+
+
+def test_keras_unknown_class_errors():
+    from bigdl_tpu.keras import load_keras_json
+    spec = {"class_name": "Sequential", "config": [
+        {"class_name": "FancyCustomLayer", "config": {}}]}
+    with pytest.raises(ValueError, match="FancyCustomLayer"):
+        load_keras_json(spec)
+
+
+def test_keras_functional_input_order():
+    """Graph inputs must follow input_layers order, not DFS order."""
+    from bigdl_tpu.keras import load_keras_json
+    spec = {"class_name": "Model", "config": {
+        "layers": [
+            {"class_name": "InputLayer", "name": "ia",
+             "config": {"name": "ia", "batch_input_shape": [None, 2]},
+             "inbound_nodes": []},
+            {"class_name": "InputLayer", "name": "ib",
+             "config": {"name": "ib", "batch_input_shape": [None, 2]},
+             "inbound_nodes": []},
+            {"class_name": "Merge", "name": "m",
+             "config": {"name": "m", "mode": "concat",
+                        "concat_axis": -1},
+             # output traversal reaches ib FIRST
+             "inbound_nodes": [[["ib", 0, 0], ["ia", 0, 0]]]},
+        ],
+        "input_layers": [["ia", 0, 0], ["ib", 0, 0]],
+        "output_layers": [["m", 0, 0]],
+    }}
+    model = load_keras_json(spec)
+    xa = jnp.asarray(np.zeros((1, 2), np.float32))
+    xb = jnp.asarray(np.ones((1, 2), np.float32))
+    out = np.asarray(model.eval_mode().forward((xa, xb)))
+    # concat order is (ib, ia) per the merge, fed positionally (ia, ib)
+    np.testing.assert_allclose(out, [[1, 1, 0, 0]])
+
+
+def test_keras_lstm_variable_timesteps():
+    from bigdl_tpu.keras import load_keras_json
+    spec = {"class_name": "Sequential", "config": [
+        {"class_name": "LSTM", "config": {
+            "name": "l", "output_dim": 4,
+            "batch_input_shape": [None, None, 3]}}]}
+    model = load_keras_json(spec)
+    x = np.random.RandomState(0).randn(2, 7, 3).astype(np.float32)
+    out = np.asarray(model.eval_mode().forward(jnp.asarray(x)))
+    assert out.shape == (2, 4)

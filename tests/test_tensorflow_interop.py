@@ -381,11 +381,12 @@ def test_strided_slice_and_split():
         node("sp", "Split", ["ax", "x"],
              [attr("num_split", [(3, VARINT, 2)])]),
     )
-    model, _ = load_tf_graph(gd, ["x"], ["sl", "sp"])
-    sl, sp = model(jnp.asarray(x))
+    model, _ = load_tf_graph(gd, ["x"], ["sl", "sp", "sp:1"])
+    sl, sp0, sp1 = model(jnp.asarray(x))
     np.testing.assert_allclose(np.asarray(sl), x[1:3, 0:4:2])
-    assert len(sp) == 2
-    np.testing.assert_allclose(np.asarray(sp[0]), x[:, :3])
+    # a bare 'sp' reference means output port 0, TF-style
+    np.testing.assert_allclose(np.asarray(sp0), x[:, :3])
+    np.testing.assert_allclose(np.asarray(sp1), x[:, 3:])
 
 
 def test_leaky_relu_and_select():
@@ -436,3 +437,73 @@ def test_tf_session_train(tmp_path):
     # trained model separates the synthetic classes
     preds = np.asarray(sess.predict(x_probe)).argmax(axis=1)
     assert (preds == np.arange(4)).mean() >= 0.75
+
+
+def test_split_output_port_consumption():
+    """':N' refs into a tuple-producing op select that output."""
+    x = np.arange(12, dtype=np.float32).reshape(2, 6)
+    gd = graphdef(
+        node("x", "Placeholder"),
+        const_node("ax", np.asarray(1, np.int32)),
+        node("sp", "Split", ["ax", "x"],
+             [attr("num_split", [(3, VARINT, 2)])]),
+        node("r", "Relu", ["sp:1"]),
+        node("a", "Add", ["sp", "sp:1"]),
+    )
+    model, _ = load_tf_graph(gd, ["x"], ["r", "a"])
+    r, a = model(jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(r), np.maximum(x[:, 3:], 0))
+    np.testing.assert_allclose(np.asarray(a), x[:, :3] + x[:, 3:])
+
+
+def test_one_hot_axis_zero():
+    gd = graphdef(
+        node("x", "Placeholder"),
+        const_node("depth", np.asarray(3, np.int32)),
+        const_node("on", np.asarray(1.0, np.float32)),
+        const_node("off", np.asarray(0.0, np.float32)),
+        node("oh", "OneHot", ["x", "depth", "on", "off"],
+             [attr("axis", [(3, VARINT, 0)])]),
+    )
+    model, _ = load_tf_graph(gd, ["x"], ["oh"])
+    out = np.asarray(model(jnp.asarray([0, 2], np.int32)))
+    assert out.shape == (3, 2)
+    np.testing.assert_allclose(out, np.eye(3, dtype=np.float32)[:, [0, 2]])
+
+
+@pytest.mark.parametrize("align_corners,half_pixel", [
+    (True, False), (False, True), (False, False)])
+def test_resize_bilinear_tf1_modes(align_corners, half_pixel):
+    x = np.random.RandomState(0).rand(1, 4, 5, 2).astype(np.float32)
+    attrs = [attr("align_corners", [(5, VARINT, int(align_corners))]),
+             attr("half_pixel_centers", [(5, VARINT, int(half_pixel))])]
+    gd = graphdef(
+        node("x", "Placeholder"),
+        const_node("size", np.asarray([8, 10], np.int32)),
+        node("rz", "ResizeBilinear", ["x", "size"], attrs),
+    )
+    model, _ = load_tf_graph(gd, ["x"], ["rz"])
+    got = np.asarray(model(jnp.asarray(x)))
+    assert got.shape == (1, 8, 10, 2)
+    tx = torch.tensor(x.transpose(0, 3, 1, 2))
+    if align_corners:
+        want = F.interpolate(tx, size=(8, 10), mode="bilinear",
+                             align_corners=True)
+    elif half_pixel:
+        want = F.interpolate(tx, size=(8, 10), mode="bilinear",
+                             align_corners=False)
+    else:
+        # asymmetric (TF1 default): src = dst * scale, clamped
+        ys = np.minimum(np.arange(8) * 4 / 8, 3)
+        xs = np.minimum(np.arange(10) * 5 / 10, 4)
+        y0 = np.floor(ys).astype(int); y1 = np.minimum(y0 + 1, 3)
+        x0 = np.floor(xs).astype(int); x1 = np.minimum(x0 + 1, 4)
+        wy = (ys - y0)[None, :, None, None]
+        wx = (xs - x0)[None, None, :, None]
+        top = x[:, y0][:, :, x0] * (1 - wx) + x[:, y0][:, :, x1] * wx
+        bot = x[:, y1][:, :, x0] * (1 - wx) + x[:, y1][:, :, x1] * wx
+        want_np = top * (1 - wy) + bot * wy
+        np.testing.assert_allclose(got, want_np, rtol=1e-5, atol=1e-6)
+        return
+    np.testing.assert_allclose(
+        got, want.permute(0, 2, 3, 1).numpy(), rtol=1e-4, atol=1e-5)
