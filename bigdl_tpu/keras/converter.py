@@ -149,6 +149,60 @@ def _input_layer(cfg):
     return KL.InputLayer(shape)
 
 
+def _cfg_layer(cls, *fields, check_ordering: bool = False, **defaults):
+    """Converter that maps listed config fields to constructor args."""
+    def cv(cfg):
+        if check_ordering:
+            _check_tf_ordering(cfg, cls.__name__)
+        kwargs = dict(defaults)
+        for f in fields:
+            if f in cfg:
+                kwargs[f] = cfg[f]
+        return cls(input_shape=_in_shape(cfg), **kwargs)
+    return cv
+
+
+def _pool1d(cls):
+    def cv(cfg):
+        if cfg.get("border_mode", "valid") != "valid":
+            raise ValueError(
+                f"{cls.__name__}: border_mode="
+                f"{cfg.get('border_mode')!r} is not supported "
+                f"(only 'valid')")
+        return cls(pool_length=int(cfg.get("pool_length", 2)),
+                   stride=(int(cfg["stride"]) if cfg.get("stride")
+                           else None),
+                   input_shape=_in_shape(cfg))
+    return cv
+
+
+def _conv1d(cfg):
+    return KL.Convolution1D(
+        int(cfg["nb_filter"]), int(cfg["filter_length"]),
+        activation=cfg.get("activation"),
+        border_mode=cfg.get("border_mode", "valid"),
+        subsample_length=int(cfg.get("subsample_length", 1)),
+        input_shape=_in_shape(cfg))
+
+
+def _zero_pad2d(cfg):
+    _check_tf_ordering(cfg, "ZeroPadding2D")
+    return KL.ZeroPadding2D(tuple(cfg.get("padding", (1, 1))),
+                            input_shape=_in_shape(cfg))
+
+
+def _upsample2d(cfg):
+    _check_tf_ordering(cfg, "UpSampling2D")
+    return KL.UpSampling2D(tuple(cfg.get("size", (2, 2))),
+                           input_shape=_in_shape(cfg))
+
+
+def _td_dense(cfg):
+    return KL.TimeDistributedDense(
+        int(cfg["output_dim"]), activation=cfg.get("activation"),
+        input_shape=_in_shape(cfg))
+
+
 _DEF_CONVERTERS: Dict[str, Callable[[dict], Module]] = {
     "Dense": _dense, "Activation": _activation, "Dropout": _dropout,
     "Flatten": _flatten, "Reshape": _reshape,
@@ -160,6 +214,25 @@ _DEF_CONVERTERS: Dict[str, Callable[[dict], Module]] = {
     "LSTM": _recurrent(KL.LSTM), "GRU": _recurrent(KL.GRU),
     "SimpleRNN": _recurrent(KL.SimpleRNN),
     "Highway": _highway, "Merge": _merge, "InputLayer": _input_layer,
+    "Convolution1D": _conv1d,
+    "MaxPooling1D": _pool1d(KL.MaxPooling1D),
+    "AveragePooling1D": _pool1d(KL.AveragePooling1D),
+    "GlobalMaxPooling1D": _cfg_layer(KL.GlobalMaxPooling1D),
+    "GlobalAveragePooling1D": _cfg_layer(KL.GlobalAveragePooling1D),
+    "GlobalMaxPooling2D": _cfg_layer(KL.GlobalMaxPooling2D,
+                                     check_ordering=True),
+    "ZeroPadding2D": _zero_pad2d, "UpSampling2D": _upsample2d,
+    "RepeatVector": _cfg_layer(KL.RepeatVector, "n"),
+    "Permute": _cfg_layer(KL.Permute, "dims"),
+    "Masking": _cfg_layer(KL.Masking, "mask_value"),
+    "TimeDistributedDense": _td_dense,
+    "ELU": _cfg_layer(KL.ELU, "alpha"),
+    "LeakyReLU": _cfg_layer(KL.LeakyReLU, "alpha"),
+    "ThresholdedReLU": _cfg_layer(KL.ThresholdedReLU, "theta"),
+    "SpatialDropout2D": _cfg_layer(KL.SpatialDropout2D, "p",
+                                   check_ordering=True),
+    "GaussianNoise": _cfg_layer(KL.GaussianNoise, "sigma"),
+    "GaussianDropout": _cfg_layer(KL.GaussianDropout, "p"),
 }
 
 

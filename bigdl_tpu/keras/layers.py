@@ -23,6 +23,12 @@ __all__ = [
     "Flatten", "Reshape", "Convolution2D", "MaxPooling2D",
     "AveragePooling2D", "GlobalAveragePooling2D", "BatchNormalization",
     "Embedding", "LSTM", "GRU", "SimpleRNN", "Highway", "Merge",
+    "Convolution1D", "MaxPooling1D", "AveragePooling1D",
+    "GlobalMaxPooling1D", "GlobalAveragePooling1D", "GlobalMaxPooling2D",
+    "ZeroPadding2D", "UpSampling2D", "RepeatVector", "Permute",
+    "Masking", "TimeDistributedDense", "Bidirectional", "ELU",
+    "LeakyReLU", "ThresholdedReLU", "SpatialDropout2D",
+    "GaussianNoise", "GaussianDropout",
 ]
 
 
@@ -298,6 +304,256 @@ class Highway(KerasLayer):
     def build_layer(self, input_shape):
         act = _activation_module(self.activation)
         return nn.Highway(input_shape[-1], activation=act), input_shape
+
+
+class Convolution1D(KerasLayer):
+    """(≙ nn/keras/Convolution1D.scala).  Input (steps, features)."""
+
+    def __init__(self, nb_filter: int, filter_length: int,
+                 activation: Optional[str] = None,
+                 border_mode: str = "valid", subsample_length: int = 1,
+                 input_shape: Optional[Sequence[int]] = None):
+        super().__init__(input_shape)
+        if border_mode != "valid":
+            raise ValueError("Convolution1D supports border_mode='valid'")
+        self.nb_filter = nb_filter
+        self.filter_length = filter_length
+        self.activation = activation
+        self.subsample_length = subsample_length
+
+    def build_layer(self, input_shape):
+        steps, feat = input_shape
+        conv = nn.TemporalConvolution(feat, self.nb_filter,
+                                      self.filter_length,
+                                      self.subsample_length)
+        act = _activation_module(self.activation)
+        mod = conv if act is None else nn.Sequential(conv, act)
+        out_steps = None if steps is None else \
+            (steps - self.filter_length) // self.subsample_length + 1
+        return mod, (out_steps, self.nb_filter)
+
+
+class MaxPooling1D(KerasLayer):
+    def __init__(self, pool_length: int = 2, stride: Optional[int] = None,
+                 input_shape: Optional[Sequence[int]] = None):
+        super().__init__(input_shape)
+        self.pool_length = pool_length
+        self.stride = stride or pool_length
+
+    def build_layer(self, input_shape):
+        steps, feat = input_shape
+        out = None if steps is None else \
+            (steps - self.pool_length) // self.stride + 1
+        return nn.TemporalMaxPooling(self.pool_length, self.stride), \
+            (out, feat)
+
+
+class AveragePooling1D(MaxPooling1D):
+    def build_layer(self, input_shape):
+        steps, feat = input_shape
+        out = None if steps is None else \
+            (steps - self.pool_length) // self.stride + 1
+        pool = nn.Sequential(
+            nn.Unsqueeze(2), nn.SpatialAveragePooling(
+                self.pool_length, 1, self.stride, 1,
+                data_format="NHWC"), nn.Squeeze(2))
+        return pool, (out, feat)
+
+
+class GlobalMaxPooling1D(KerasLayer):
+    def build_layer(self, input_shape):
+        return nn.Max(2), (input_shape[-1],)
+
+
+class GlobalAveragePooling1D(KerasLayer):
+    def build_layer(self, input_shape):
+        return nn.Mean(2), (input_shape[-1],)
+
+
+class GlobalMaxPooling2D(KerasLayer):
+    def build_layer(self, input_shape):
+        h, w, c = input_shape
+        return nn.Sequential(nn.Max(2), nn.Max(2)), (c,)
+
+
+class ZeroPadding2D(KerasLayer):
+    def __init__(self, padding: Tuple[int, int] = (1, 1),
+                 input_shape: Optional[Sequence[int]] = None):
+        super().__init__(input_shape)
+        self.padding = tuple(padding)
+
+    def build_layer(self, input_shape):
+        h, w, c = input_shape
+        ph, pw = self.padding
+        pad = nn.SpatialZeroPadding(pw, pw, ph, ph, data_format="NHWC")
+        out_h = None if h is None else h + 2 * ph
+        out_w = None if w is None else w + 2 * pw
+        return pad, (out_h, out_w, c)
+
+
+class UpSampling2D(KerasLayer):
+    def __init__(self, size: Tuple[int, int] = (2, 2),
+                 input_shape: Optional[Sequence[int]] = None):
+        super().__init__(input_shape)
+        self.size = tuple(size)
+
+    def build_layer(self, input_shape):
+        h, w, c = input_shape
+        up = nn.UpSampling2D(self.size, data_format="NHWC")
+        out_h = None if h is None else h * self.size[0]
+        out_w = None if w is None else w * self.size[1]
+        return up, (out_h, out_w, c)
+
+
+class RepeatVector(KerasLayer):
+    """(≙ nn/keras/RepeatVector.scala): (features,) → (n, features)."""
+
+    def __init__(self, n: int, input_shape: Optional[Sequence[int]] = None):
+        super().__init__(input_shape)
+        self.n = n
+
+    def build_layer(self, input_shape):
+        # dim=2: replicate after the batch axis (1-based batched dims)
+        return nn.Replicate(self.n, dim=2), (self.n,) + tuple(input_shape)
+
+
+class Permute(KerasLayer):
+    """Permute non-batch dims; Keras 1-based ``dims``."""
+
+    def __init__(self, dims: Sequence[int],
+                 input_shape: Optional[Sequence[int]] = None):
+        super().__init__(input_shape)
+        self.dims = tuple(dims)
+
+    def build_layer(self, input_shape):
+        # express the permutation as swaps for nn.Transpose (whose pairs
+        # are 1-based over the BATCHED array; non-batch pos k ↔ k+1)
+        order = [0] + list(self.dims)   # order[pos] = source dim at pos
+        cur = list(range(len(order)))   # cur[pos] = source currently there
+        pairs = []
+        for pos in range(1, len(order)):
+            j = cur.index(order[pos])
+            if j != pos:
+                pairs.append((pos + 1, j + 1))
+                cur[pos], cur[j] = cur[j], cur[pos]
+        tr = nn.Transpose(pairs) if pairs else nn.Identity()
+        return tr, tuple(input_shape[d - 1] for d in self.dims)
+
+
+class Masking(KerasLayer):
+    def __init__(self, mask_value: float = 0.0,
+                 input_shape: Optional[Sequence[int]] = None):
+        super().__init__(input_shape)
+        self.mask_value = mask_value
+
+    def build_layer(self, input_shape):
+        return nn.Masking(self.mask_value), input_shape
+
+
+class TimeDistributedDense(KerasLayer):
+    """(≙ nn/keras TimeDistributed(Dense)): Dense at every timestep."""
+
+    def __init__(self, output_dim: int, activation: Optional[str] = None,
+                 input_shape: Optional[Sequence[int]] = None):
+        super().__init__(input_shape)
+        self.output_dim = output_dim
+        self.activation = activation
+
+    def build_layer(self, input_shape):
+        steps, feat = input_shape
+        lin = nn.Linear(feat, self.output_dim)
+        act = _activation_module(self.activation)
+        inner = lin if act is None else nn.Sequential(lin, act)
+        return nn.TimeDistributed(inner), (steps, self.output_dim)
+
+
+class Bidirectional(KerasLayer):
+    """Wrap an LSTM/GRU/SimpleRNN layer bidirectionally
+    (≙ nn/keras/Bidirectional.scala); merge_mode concat or sum."""
+
+    def __init__(self, layer: "_RecurrentLayer",
+                 merge_mode: str = "concat",
+                 input_shape: Optional[Sequence[int]] = None):
+        super().__init__(input_shape or layer.input_shape)
+        if merge_mode not in ("concat", "sum"):
+            raise ValueError(f"unsupported merge_mode {merge_mode!r}")
+        self.layer = layer
+        self.merge_mode = merge_mode
+
+    def build_layer(self, input_shape):
+        seq_len, feat = input_shape
+        if not self.layer.return_sequences:
+            raise ValueError(
+                "Bidirectional requires return_sequences=True")
+        merge = (nn.JoinTable(3) if self.merge_mode == "concat"
+                 else nn.CAddTable())
+        rec = nn.BiRecurrent(merge=merge,
+                             cell=self.layer.make_cell(feat))
+        out_dim = (self.layer.output_dim * 2
+                   if self.merge_mode == "concat"
+                   else self.layer.output_dim)
+        return rec, (seq_len, out_dim)
+
+
+class ELU(KerasLayer):
+    def __init__(self, alpha: float = 1.0,
+                 input_shape: Optional[Sequence[int]] = None):
+        super().__init__(input_shape)
+        self.alpha = alpha
+
+    def build_layer(self, input_shape):
+        return nn.ELU(self.alpha), input_shape
+
+
+class LeakyReLU(KerasLayer):
+    def __init__(self, alpha: float = 0.3,
+                 input_shape: Optional[Sequence[int]] = None):
+        super().__init__(input_shape)
+        self.alpha = alpha
+
+    def build_layer(self, input_shape):
+        return nn.LeakyReLU(self.alpha), input_shape
+
+
+class ThresholdedReLU(KerasLayer):
+    def __init__(self, theta: float = 1.0,
+                 input_shape: Optional[Sequence[int]] = None):
+        super().__init__(input_shape)
+        self.theta = theta
+
+    def build_layer(self, input_shape):
+        return nn.Threshold(self.theta, 0.0), input_shape
+
+
+class SpatialDropout2D(KerasLayer):
+    def __init__(self, p: float = 0.5,
+                 input_shape: Optional[Sequence[int]] = None):
+        super().__init__(input_shape)
+        self.p = p
+
+    def build_layer(self, input_shape):
+        return nn.SpatialDropout2D(self.p, data_format="NHWC"), \
+            input_shape
+
+
+class GaussianNoise(KerasLayer):
+    def __init__(self, sigma: float,
+                 input_shape: Optional[Sequence[int]] = None):
+        super().__init__(input_shape)
+        self.sigma = sigma
+
+    def build_layer(self, input_shape):
+        return nn.GaussianNoise(self.sigma), input_shape
+
+
+class GaussianDropout(KerasLayer):
+    def __init__(self, p: float,
+                 input_shape: Optional[Sequence[int]] = None):
+        super().__init__(input_shape)
+        self.p = p
+
+    def build_layer(self, input_shape):
+        return nn.GaussianDropout(self.p), input_shape
 
 
 class Merge(KerasLayer):

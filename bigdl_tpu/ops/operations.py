@@ -33,6 +33,7 @@ __all__ = [
     "Rank", "Rint", "Round", "Rsqrt", "SelectOp", "Sign", "Slice",
     "SquaredDifference", "SumOp", "TileOp", "TopK", "TruncateDiv",
     "TruncatedNormal", "BucketizedCol", "CrossEntropy", "DepthwiseConv2D",
+    "TensorOp",
 ]
 
 
@@ -443,3 +444,119 @@ class DepthwiseConv2D(Operation):
             x, w, window_strides=self.strides, padding=self.padding,
             feature_group_count=c,
             dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+class TensorOp(Operation):
+    """Composable tensor-function op (reference nn/ops/TensorOp.scala):
+    arithmetic operators and chainable transform methods build a fused
+    pointwise pipeline — ``(TensorOp() * 2.0 + 1.0).sqrt()`` is one op
+    whose forward applies the whole chain (XLA fuses it for free).
+    """
+
+    def __init__(self, fn=None):
+        super().__init__()
+        self.fn = fn or (lambda x: x)
+
+    def forward(self, x):
+        return self.fn(x)
+
+    # -- composition -------------------------------------------------------
+    def then(self, g) -> "TensorOp":
+        f = self.fn
+        return TensorOp(lambda x: g(f(x)))
+
+    def __add__(self, other):
+        if isinstance(other, TensorOp):
+            f, g = self.fn, other.fn
+            return TensorOp(lambda x: f(x) + g(x))
+        return self.then(lambda y: y + other)
+
+    def __sub__(self, other):
+        if isinstance(other, TensorOp):
+            f, g = self.fn, other.fn
+            return TensorOp(lambda x: f(x) - g(x))
+        return self.then(lambda y: y - other)
+
+    def __mul__(self, other):
+        if isinstance(other, TensorOp):
+            f, g = self.fn, other.fn
+            return TensorOp(lambda x: f(x) * g(x))
+        return self.then(lambda y: y * other)
+
+    def __truediv__(self, other):
+        if isinstance(other, TensorOp):
+            f, g = self.fn, other.fn
+            return TensorOp(lambda x: f(x) / g(x))
+        return self.then(lambda y: y / other)
+
+    def __pow__(self, p):
+        return self.then(lambda y: y ** p)
+
+    # -- chainable transforms (TensorOp.scala method set) -------------------
+    def abs(self):
+        return self.then(jnp.abs)
+
+    def sqrt(self):
+        return self.then(jnp.sqrt)
+
+    def rsqrt(self):
+        return self.then(jax.lax.rsqrt)
+
+    def square(self):
+        return self.then(jnp.square)
+
+    def exp(self):
+        return self.then(jnp.exp)
+
+    def log(self):
+        return self.then(jnp.log)
+
+    def log1p(self):
+        return self.then(jnp.log1p)
+
+    def floor(self):
+        return self.then(jnp.floor)
+
+    def ceil(self):
+        return self.then(jnp.ceil)
+
+    def negative(self):
+        return self.then(jnp.negative)
+
+    def inv(self):
+        return self.then(lambda y: 1.0 / y)
+
+    def sigmoid(self):
+        return self.then(jax.nn.sigmoid)
+
+    def tanh(self):
+        return self.then(jnp.tanh)
+
+    def relu(self):
+        return self.then(jax.nn.relu)
+
+    def elu(self):
+        return self.then(jax.nn.elu)
+
+    def softmax(self):
+        return self.then(lambda y: jax.nn.softmax(y, axis=-1))
+
+    def softplus(self):
+        return self.then(jax.nn.softplus)
+
+    def softsign(self):
+        return self.then(jax.nn.soft_sign)
+
+    def clamp(self, lo, hi):
+        return self.then(lambda y: jnp.clip(y, lo, hi))
+
+    def sum(self, axis=None, keepdims=False):
+        return self.then(lambda y: jnp.sum(y, axis=axis,
+                                           keepdims=keepdims))
+
+    def mean(self, axis=None, keepdims=False):
+        return self.then(lambda y: jnp.mean(y, axis=axis,
+                                            keepdims=keepdims))
+
+    def t(self):
+        return self.then(lambda y: jnp.swapaxes(y, -1, -2))

@@ -301,3 +301,101 @@ def test_keras_lstm_variable_timesteps():
     x = np.random.RandomState(0).randn(2, 7, 3).astype(np.float32)
     out = np.asarray(model.eval_mode().forward(jnp.asarray(x)))
     assert out.shape == (2, 4)
+
+
+def test_new_keras_layers_forward_shapes():
+    """Every new wrapper builds and produces its inferred shape."""
+    from bigdl_tpu import keras as K
+    set_seed(0)
+    rng = np.random.RandomState(0)
+    cases = [
+        (K.Convolution1D(4, 3, input_shape=(10, 6)), (2, 10, 6), (2, 8, 4)),
+        (K.MaxPooling1D(2, input_shape=(10, 6)), (2, 10, 6), (2, 5, 6)),
+        (K.AveragePooling1D(2, input_shape=(10, 6)), (2, 10, 6),
+         (2, 5, 6)),
+        (K.GlobalMaxPooling1D(input_shape=(10, 6)), (2, 10, 6), (2, 6)),
+        (K.GlobalAveragePooling1D(input_shape=(10, 6)), (2, 10, 6),
+         (2, 6)),
+        (K.GlobalMaxPooling2D(input_shape=(5, 6, 3)), (2, 5, 6, 3),
+         (2, 3)),
+        (K.ZeroPadding2D((1, 2), input_shape=(5, 6, 3)), (2, 5, 6, 3),
+         (2, 7, 10, 3)),
+        (K.UpSampling2D((2, 3), input_shape=(4, 5, 3)), (2, 4, 5, 3),
+         (2, 8, 15, 3)),
+        (K.RepeatVector(4, input_shape=(6,)), (2, 6), (2, 4, 6)),
+        (K.Permute((2, 1), input_shape=(3, 5)), (2, 3, 5), (2, 5, 3)),
+        (K.Masking(0.0, input_shape=(4, 3)), (2, 4, 3), (2, 4, 3)),
+        (K.TimeDistributedDense(7, input_shape=(4, 3)), (2, 4, 3),
+         (2, 4, 7)),
+        (K.ELU(input_shape=(5,)), (2, 5), (2, 5)),
+        (K.LeakyReLU(input_shape=(5,)), (2, 5), (2, 5)),
+        (K.ThresholdedReLU(0.5, input_shape=(5,)), (2, 5), (2, 5)),
+    ]
+    for layer, in_shape, want in cases:
+        x = jnp.asarray(rng.randn(*in_shape).astype(np.float32))
+        out = layer.eval_mode().forward(x)
+        assert tuple(out.shape) == want, \
+            (type(layer).__name__, tuple(out.shape), want)
+        assert layer.output_shape == want[1:], type(layer).__name__
+
+
+def test_permute_values():
+    from bigdl_tpu import keras as K
+    x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    lay = K.Permute((2, 1), input_shape=(3, 4))
+    out = np.asarray(lay.eval_mode().forward(jnp.asarray(x)))
+    np.testing.assert_array_equal(out, x.transpose(0, 2, 1))
+    x2 = np.arange(48, dtype=np.float32).reshape(2, 2, 3, 4)
+    lay2 = K.Permute((3, 1, 2), input_shape=(2, 3, 4))
+    out2 = np.asarray(lay2.eval_mode().forward(jnp.asarray(x2)))
+    np.testing.assert_array_equal(out2, x2.transpose(0, 3, 1, 2))
+
+
+def test_bidirectional_lstm():
+    from bigdl_tpu import keras as K
+    set_seed(2)
+    layer = K.Bidirectional(
+        K.LSTM(4, return_sequences=True, input_shape=(6, 3)))
+    x = jnp.asarray(np.random.RandomState(1).randn(2, 6, 3)
+                    .astype(np.float32))
+    out = layer.eval_mode().forward(x)
+    assert tuple(out.shape) == (2, 6, 8)
+    assert layer.output_shape == (6, 8)
+
+
+def test_new_layers_via_json_converter():
+    from bigdl_tpu.keras import load_keras_json
+    spec = {"class_name": "Sequential", "config": [
+        {"class_name": "Convolution1D", "config": {
+            "name": "c", "nb_filter": 4, "filter_length": 3,
+            "activation": "relu", "batch_input_shape": [None, 10, 6]}},
+        {"class_name": "GlobalMaxPooling1D", "config": {"name": "g"}},
+        {"class_name": "RepeatVector", "config": {"name": "r", "n": 5}},
+        {"class_name": "TimeDistributedDense", "config": {
+            "name": "t", "output_dim": 2}},
+    ]}
+    model = load_keras_json(spec)
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 10, 6)
+                    .astype(np.float32))
+    out = model.eval_mode().forward(x)
+    assert tuple(out.shape) == (2, 5, 2)
+
+
+def test_pool1d_same_border_rejected():
+    from bigdl_tpu.keras import load_keras_json
+    spec = {"class_name": "Sequential", "config": [
+        {"class_name": "MaxPooling1D", "config": {
+            "name": "p", "pool_length": 2, "border_mode": "same",
+            "batch_input_shape": [None, 10, 6]}}]}
+    with pytest.raises(ValueError, match="border_mode"):
+        load_keras_json(spec)
+
+
+def test_th_ordering_rejected_for_global_pool():
+    from bigdl_tpu.keras import load_keras_json
+    spec = {"class_name": "Sequential", "config": [
+        {"class_name": "GlobalMaxPooling2D", "config": {
+            "name": "g", "dim_ordering": "th",
+            "batch_input_shape": [None, 3, 5, 6]}}]}
+    with pytest.raises(ValueError, match="th"):
+        load_keras_json(spec)

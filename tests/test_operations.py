@@ -140,3 +140,20 @@ def test_cast_rank_range():
     assert ops.Cast(jnp.int32)(x).dtype == jnp.int32
     assert int(ops.Rank()(jnp.ones((2, 3, 4)))) == 3
     np.testing.assert_array_equal(ops.RangeOps()((1, 7, 2)), [1, 3, 5])
+
+
+def test_tensor_op_combinators():
+    """TensorOp chaining (reference nn/ops/TensorOp.scala)."""
+    from bigdl_tpu.ops import TensorOp
+    x = jnp.asarray([[1.0, -4.0], [9.0, 16.0]])
+    op = (TensorOp() * 2.0 + 2.0).abs().sqrt()
+    np.testing.assert_allclose(np.asarray(op(x)),
+                               np.sqrt(np.abs(np.asarray(x) * 2 + 2)))
+    # op-op arithmetic: (f + g)(x) = f(x) + g(x)
+    combo = TensorOp(lambda v: v * 3.0) + TensorOp(jnp.abs)
+    np.testing.assert_allclose(np.asarray(combo(x)),
+                               np.asarray(x) * 3 + np.abs(np.asarray(x)))
+    # reductions and activations chain
+    s = TensorOp().relu().sum(axis=1)
+    np.testing.assert_allclose(
+        np.asarray(s(x)), np.maximum(np.asarray(x), 0).sum(1))
