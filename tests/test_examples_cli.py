@@ -158,3 +158,25 @@ def test_ptb_main_real_files(tmp_path):
                   "--hidden-size", "16", "--num-steps", "8",
                   "--vocab-size", "30"])
     assert model is not None
+
+
+def test_textclassifier_synthetic():
+    from bigdl_tpu.examples.text_classifier import main
+    model = main(["--synthetic", "256", "-e", "2", "-q", "-b", "32",
+                  "--seq-len", "32"])
+    assert model is not None
+
+
+def test_textclassifier_folder(tmp_path):
+    """Class-per-subdirectory corpus (the reference's 20news layout)."""
+    from bigdl_tpu.examples.text_classifier import main
+    texts = {"sport": "the game was won by the home team in overtime",
+             "tech": "the compiler fuses the kernel into the graph"}
+    for cls, line in texts.items():
+        d = tmp_path / cls
+        d.mkdir()
+        for i in range(24):
+            (d / f"doc{i}.txt").write_text(line + f" sample {i}")
+    model = main(["-f", str(tmp_path), "-e", "1", "-q", "-b", "8",
+                  "--seq-len", "16", "--vocab-size", "100"])
+    assert model is not None
