@@ -15,7 +15,13 @@ from bigdl_tpu.ops.operations import *  # noqa: F401,F403
 from bigdl_tpu.ops.control import (  # noqa: F401
     Cond, Scan, TensorArrayScan, WhileLoop,
 )
+from bigdl_tpu.ops.feature_columns import (  # noqa: F401
+    CategoricalColHashBucket, CategoricalColVocaList, CrossCol,
+    IndicatorCol, Kv2Tensor, MkString,
+)
 
 __all__ = ["dot_product_attention", "flash_attention",
-           "Cond", "WhileLoop", "Scan", "TensorArrayScan"] \
+           "Cond", "WhileLoop", "Scan", "TensorArrayScan",
+           "CategoricalColHashBucket", "CategoricalColVocaList",
+           "CrossCol", "IndicatorCol", "MkString", "Kv2Tensor"] \
     + list(operations.__all__)

@@ -157,3 +157,74 @@ def test_tensor_op_combinators():
     s = TensorOp().relu().sum(axis=1)
     np.testing.assert_allclose(
         np.asarray(s(x)), np.maximum(np.asarray(x), 0).sum(1))
+
+
+def test_feature_column_ops_wide_and_deep():
+    """Feature-column host ops (reference nn/ops/CategoricalCol*,
+    CrossCol, IndicatorCol, MkString, Kv2Tensor — the wide-and-deep
+    input path)."""
+    from bigdl_tpu.ops import (
+        CategoricalColHashBucket, CategoricalColVocaList, CrossCol,
+        IndicatorCol, Kv2Tensor, MkString,
+    )
+    from bigdl_tpu.ops.feature_columns import java_string_hash
+
+    # hash bucketing: deterministic, in range, multi-value support
+    hb = CategoricalColHashBucket(hash_bucket_size=100)
+    sp = hb(np.asarray(["apple", "pear,plum", ""], dtype=object))
+    assert sp.shape == (3, 2)
+    vals = np.asarray(sp.values)
+    # ids are 1-based: 0 is the padding sentinel
+    assert ((1 <= vals) & (vals <= 100)).all()
+    assert vals[0] == java_string_hash("apple") % 100 + 1
+    dense = CategoricalColHashBucket(100, is_sparse=False)(
+        np.asarray(["apple", "pear,plum", ""], dtype=object))
+    assert dense.shape == (3, 2) and dense[2, 0] == 0
+
+    # vocabulary lookup: strict raises, default maps to len(vocab)
+    vl = CategoricalColVocaList(["a", "b"], is_set_default=True)
+    spv = vl(np.asarray(["a", "b,zzz"], dtype=object))
+    got = np.asarray(spv.values).tolist()
+    assert got == [1, 2, 3]
+    with pytest.raises(ValueError, match="vocabulary"):
+        CategoricalColVocaList(["a"])(np.asarray(["q"], dtype=object))
+
+    # crossing: cartesian product per row, hashed
+    cc = CrossCol(hash_bucket_size=50)
+    spc = cc([np.asarray(["u1", "u2"], dtype=object),
+              np.asarray(["x,y", "x"], dtype=object)])
+    assert spc.shape == (2, 2)
+    assert np.asarray(spc.values)[0] ==         java_string_hash("u1_x") % 50 + 1
+
+    # indicator: multi-hot with counts
+    ind = IndicatorCol(feat_len=5)
+    out = ind(spv)
+    assert out.shape == (2, 5)
+    assert out[0, 0] == 1.0 and out[1, 1] == 1.0 and out[1, 2] == 1.0
+
+    # MkString round-trips sparse ids to strings (0 = padding skipped)
+    s = MkString()(spv)
+    assert list(s) == ["1", "2,3"]
+
+    # padding entries in fixed-capacity sparse tensors are ignored
+    from bigdl_tpu.nn.sparse import SparseTensor
+    padded = SparseTensor(np.asarray([[0, 0], [0, 0]], np.int32),
+                          np.asarray([3, 0], np.int32), (1, 4))
+    np.testing.assert_allclose(IndicatorCol(5)(padded),
+                               [[0, 0, 1, 0, 0]])
+    assert list(MkString()(padded)) == ["3"]
+
+    # Kv2Tensor key validation + duplicate-key summing parity
+    with pytest.raises(ValueError, match="out of range"):
+        Kv2Tensor()((np.asarray(["7:1.0"], dtype=object), 4))
+    dup_dense = Kv2Tensor()((np.asarray(["0:1.0,0:2.0"], dtype=object), 2))
+    dup_sparse = Kv2Tensor(trans_type=1)(
+        (np.asarray(["0:1.0,0:2.0"], dtype=object), 2))
+    np.testing.assert_allclose(dup_dense,
+                               np.asarray(dup_sparse.to_dense())
+                               .reshape(1, 2))
+
+    # Kv2Tensor: "k:v" strings to dense
+    kv = Kv2Tensor()
+    out = kv((np.asarray(["0:1.5,2:3.0", "1:2.0"], dtype=object), 4))
+    np.testing.assert_allclose(out, [[1.5, 0, 3.0, 0], [0, 2.0, 0, 0]])
