@@ -1,0 +1,197 @@
+"""ImageNet-style training main for the large vision models
+(reference models/resnet/TrainImageNet.scala + models/inception/
+Train.scala; README recipe at models/resnet/README.md:85-150).
+
+    bigdl-tpu-imagenet -f /data/imagenet --model resnet50 -b 256 --bf16
+    bigdl-tpu-imagenet --synthetic 512 --model inception-v1 -e 1
+
+Data layout: ``<folder>/train/<class>/*.jpg`` and
+``<folder>/val/<class>/*.jpg`` (class-per-subdirectory).  The input
+pipeline is the reference's: resize-256 → random-crop-224 + HFlip +
+channel-normalize for training, center-crop for validation — all
+host-side so the jitted step gets ready NHWC arrays.
+"""
+
+from __future__ import annotations
+
+import os
+
+from bigdl_tpu.examples.common import apply_common, base_parser, setup
+
+# ImageNet RGB mean/std on the [0, 255] scale (reference
+# models/resnet/ImageNet dataset constants)
+MEAN = (123.68, 116.779, 103.939)
+STD = (58.395, 57.12, 57.375)
+
+
+def _build_model(name: str, class_num: int):
+    from bigdl_tpu import models
+    table = {"resnet50": lambda: models.resnet50(class_num),
+             "inception-v1": lambda: models.Inception_v1(class_num),
+             "vgg16": lambda: models.Vgg_16(class_num)}
+    if name not in table:
+        raise SystemExit(f"unknown --model {name!r} "
+                         f"(choose from {sorted(table)})")
+    return table[name]()
+
+
+class _Augment:
+    """Sample-level wrapper over the vision FeatureTransformers.
+    Resize scales with the crop size (256 is the reference value for
+    224-px crops)."""
+
+    def __init__(self, train: bool, size: int = 224):
+        from bigdl_tpu.transform.vision import (
+            CenterCrop, ChannelNormalize, HFlip, RandomCrop,
+            RandomTransformer, Resize,
+        )
+        r = max(size * 256 // 224, size)
+        if train:
+            self.stages = [Resize(r, r), RandomCrop(size, size),
+                           RandomTransformer(HFlip(), 0.5),
+                           ChannelNormalize(*MEAN, *STD)]
+        else:
+            self.stages = [Resize(r, r), CenterCrop(size, size),
+                           ChannelNormalize(*MEAN, *STD)]
+
+    def __call__(self, it):
+        from bigdl_tpu.dataset.dataset import Sample
+        from bigdl_tpu.transform.vision import ImageFeature
+        for s in it:
+            feat = ImageFeature(s.feature)
+            for t in self.stages:
+                feat = t(feat)
+            yield Sample(feat.image, s.label)
+
+
+def _list_image_folder(path: str):
+    """Lazy ImageNet listing: (file path, 1-based label) pairs — images
+    decode inside the pipeline, never all-at-once in host RAM."""
+    classes = sorted(d for d in os.listdir(path)
+                     if os.path.isdir(os.path.join(path, d)))
+    items = []
+    for ci, cls in enumerate(classes):
+        cdir = os.path.join(path, cls)
+        items.extend((os.path.join(cdir, fn), ci + 1)
+                     for fn in sorted(os.listdir(cdir)))
+    return items, len(classes)
+
+
+class _Decode:
+    """(path, label) → Sample(HWC float32, label)."""
+
+    def __call__(self, it):
+        import numpy as np
+        from PIL import Image
+        from bigdl_tpu.dataset.dataset import Sample
+        for path, label in it:
+            img = np.asarray(Image.open(path).convert("RGB"), np.float32)
+            yield Sample(img, label)
+
+
+def _synthetic(n: int, size: int, classes: int, seed: int):
+    """Per-class prototypes generated lazily from the label's own seed,
+    so the full --classes head is honored without a classes-sized
+    prototype tensor in RAM."""
+    import numpy as np
+    from bigdl_tpu.dataset.dataset import Sample
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, classes, size=n)
+    out = []
+    for l in labels:
+        proto = np.random.default_rng(10_000 + int(l)).normal(
+            size=(size, size, 3))
+        out.append(Sample((proto + 0.25 * rng.normal(
+            size=(size, size, 3))).astype(np.float32), int(l) + 1))
+    return out, classes
+
+
+def main(argv=None):
+    p = base_parser("Train ResNet-50 / Inception-v1 / VGG16 on ImageNet")
+    p.add_argument("--model", default="resnet50",
+                   choices=["resnet50", "inception-v1", "vgg16"])
+    p.add_argument("--classes", type=int, default=1000)
+    p.add_argument("--image-size", type=int, default=224)
+    p.add_argument("--momentum", type=float, default=0.9)
+    p.add_argument("--weight-decay", type=float, default=1e-4)
+    p.add_argument("--warmup-epochs", type=int, default=0)
+    p.set_defaults(batch_size=256, learning_rate=0.1, max_epoch=90)
+    args = p.parse_args(argv)
+    train_summary, val_summary = setup(args, f"imagenet-{args.model}")
+
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.dataset import DataSet, SampleToMiniBatch
+    from bigdl_tpu.optim import (
+        Loss, Optimizer, Poly, SGD, SequentialSchedule, Top1Accuracy,
+        Top5Accuracy, Trigger, Warmup,
+    )
+
+    size = args.image_size
+    val_data = None
+    if args.synthetic:
+        classes = args.classes
+        train, _ = _synthetic(args.synthetic, size, classes, seed=0)
+        val, _ = _synthetic(max(args.synthetic // 8, args.batch_size),
+                            size, classes, seed=1)
+        n_train = len(train)
+        train_data = (DataSet.array(train)
+                      .transform(SampleToMiniBatch(args.batch_size)))
+        if args.cache_device:
+            train_data = train_data.cache_on_device()
+        val_data = (DataSet.array(val, shuffle=False)
+                    .transform(SampleToMiniBatch(args.batch_size)))
+    else:
+        if args.cache_device:
+            raise SystemExit(
+                "--cache-device would freeze the random crops/flips of "
+                "epoch 1 and replay them forever; it is only valid with "
+                "--synthetic data")
+        train_items, classes = _list_image_folder(
+            os.path.join(args.folder, "train"))
+        n_train = len(train_items)
+        train_data = (DataSet.array(train_items)
+                      .transform(_Decode())
+                      .transform(_Augment(train=True, size=size))
+                      .transform(SampleToMiniBatch(args.batch_size)))
+        val_dir = os.path.join(args.folder, "val")
+        if os.path.isdir(val_dir):
+            val_items, _ = _list_image_folder(val_dir)
+            val_data = (DataSet.array(val_items, shuffle=False)
+                        .transform(_Decode())
+                        .transform(_Augment(train=False, size=size))
+                        .transform(SampleToMiniBatch(args.batch_size)))
+
+    model = _build_model(args.model, classes)
+    iters_per_epoch = max(n_train // args.batch_size, 1)
+    total_iters = args.max_epoch * iters_per_epoch
+    if args.warmup_epochs > 0:
+        # linear ramp to the base lr over the warmup epochs, then Poly
+        # (the reference's large-batch recipe, SGD.SequentialSchedule)
+        warm_iters = args.warmup_epochs * iters_per_epoch
+        schedule = (SequentialSchedule(iters_per_epoch)
+                    .add(Warmup(args.learning_rate / warm_iters),
+                         warm_iters)
+                    .add(Poly(0.5, total_iters - warm_iters),
+                         total_iters - warm_iters))
+    else:
+        schedule = Poly(0.5, total_iters)
+    method = SGD(args.learning_rate, momentum=args.momentum,
+                 dampening=0.0, weight_decay=args.weight_decay,
+                 nesterov=True, learning_rate_schedule=schedule)
+    opt = (Optimizer(model, train_data, nn.CrossEntropyCriterion())
+           .set_optim_method(method)
+           .set_end_when(Trigger.max_epoch(args.max_epoch)))
+    if val_data is not None:
+        methods = [Top1Accuracy(), Loss(nn.CrossEntropyCriterion())]
+        if classes >= 5:
+            methods.insert(1, Top5Accuracy())
+        opt.set_validation(Trigger.every_epoch(), val_data, methods)
+    apply_common(opt, args, train_summary, val_summary)
+    opt.optimize()
+    if val_data is not None:
+        print(f"Final validation score: {opt.state['score']:.4f}")
+    return model
+
+
+if __name__ == "__main__":
+    main()

@@ -180,3 +180,29 @@ def test_textclassifier_folder(tmp_path):
     model = main(["-f", str(tmp_path), "-e", "1", "-q", "-b", "8",
                   "--seq-len", "16", "--vocab-size", "100"])
     assert model is not None
+
+
+def test_imagenet_main_synthetic():
+    from bigdl_tpu.examples.imagenet import main
+    model = main(["--synthetic", "32", "--model", "resnet50", "-e", "1",
+                  "-b", "16", "-q", "--image-size", "32",
+                  "--classes", "4"])
+    assert model is not None
+
+
+def test_imagenet_main_folder(tmp_path):
+    """Real image-folder path through the vision augmentation pipeline."""
+    PIL = pytest.importorskip("PIL")
+    from PIL import Image
+    rng = np.random.default_rng(0)
+    for split in ("train", "val"):
+        for cls in ("cat", "dog"):
+            d = tmp_path / split / cls
+            d.mkdir(parents=True)
+            for i in range(8):
+                arr = rng.integers(0, 255, size=(40, 40, 3)).astype("uint8")
+                Image.fromarray(arr).save(d / f"{i}.png")
+    from bigdl_tpu.examples.imagenet import main
+    model = main(["-f", str(tmp_path), "--model", "inception-v1",
+                  "-e", "1", "-b", "8", "-q", "--classes", "2"])
+    assert model is not None
