@@ -62,8 +62,26 @@ class BatchNormalization(Module):
 
     def forward(self, x):
         if self.training:
-            mean = jnp.mean(x, axis=self.reduce_axes)
-            var = jnp.var(x, axis=self.reduce_axes)
+            # Shifted one-pass statistics: with K = running_mean (a
+            # constant under autodiff), E[x-K] and E[(x-K)^2] are
+            # *independent* reductions, so XLA multi-output-fuses them
+            # into a single sweep over the activation; jnp.var(x) needs
+            # E[x] first, forcing a second full read — measurably slower
+            # on HBM-bound BN-heavy convnets.  var = E[(x-K)^2] -
+            # E[x-K]^2 is exact algebra whose f32 cancellation error
+            # scales with |E[x]-K|/std, small both at init (K=0 and conv
+            # outputs are zero-centered) and in steady state (K tracks
+            # the batch mean) — unlike the unshifted E[x^2]-E[x]^2 fast
+            # path, which loses all precision for |mean|/std >~ 3e3.
+            # Stats accumulate in f32 regardless of compute dtype.
+            xf = x.astype(jnp.float32)
+            k = jax.lax.stop_gradient(
+                self.running_mean.astype(jnp.float32))
+            xs = xf - k
+            d_mean = jnp.mean(xs, axis=self.reduce_axes)
+            d_sq = jnp.mean(jnp.square(xs), axis=self.reduce_axes)
+            var = jnp.maximum(d_sq - jnp.square(d_mean), 0.0)
+            mean = k + d_mean
             m = self.momentum
             self.running_mean = (1 - m) * self.running_mean + m * mean
             n = 1
@@ -73,11 +91,21 @@ class BatchNormalization(Module):
             self.running_var = (1 - m) * self.running_var + m * unbiased
         else:
             mean, var = self.running_mean, self.running_var
-        inv = jax.lax.rsqrt(var + self.eps)
-        y = (x - mean) * inv
+        # Normalize subtract-first in f32: (x - mean) of two nearby
+        # values is exact, whereas folding mean into a shift vector
+        # (x*scale + (bias - mean*scale)) differences two large
+        # intermediates and loses the output to cancellation for
+        # large-|mean| channels — fatal in bf16.  The whole chain is one
+        # fused elementwise pass either way (reads x in its dtype,
+        # writes y in its dtype), so f32 register math costs nothing.
+        xf = x.astype(jnp.float32)
+        inv = jax.lax.rsqrt(var.astype(jnp.float32) + self.eps)
+        scale = (inv * self.weight.astype(jnp.float32) if self.affine
+                 else inv)
+        y = (xf - mean.astype(jnp.float32)) * scale
         if self.affine:
-            y = y * self.weight + self.bias
-        return y
+            y = y + self.bias.astype(jnp.float32)
+        return y.astype(x.dtype)
 
 
 class SpatialBatchNormalization(BatchNormalization):

@@ -144,6 +144,50 @@ def test_batchnorm_train_and_eval_match_torch():
         ref_e.detach().numpy(), rtol=1e-3, atol=1e-4)
 
 
+def test_batchnorm_large_mean_variance_stability():
+    """The one-pass variance must not catastrophically cancel for a
+    channel whose mean is huge relative to its std once the running
+    mean tracks it (regression for the unshifted E[x^2]-E[x]^2 form,
+    which returns var ~ 0 for |mean|/std > ~3e3 in f32)."""
+    rng = np.random.default_rng(0)
+    x = (3000.0 + 0.1 * rng.normal(size=(8, 4, 4, 3))).astype(np.float32)
+    layer = nn.SpatialBatchNormalization(3, affine=False)
+    # steady state: running mean near the data mean (exactness only
+    # needs |E[x] - K| << |E[x]|, not equality)
+    layer.running_mean = jnp.asarray([2999.0, 3000.5, 3001.0])
+    out = np.asarray(layer.forward(jnp.asarray(x)))
+    true_var = x.astype(np.float64).reshape(-1, 3).var(axis=0)
+    got = np.asarray(layer.running_var)  # momentum 0.1 from var=1.0
+    implied_batch_var = (got - 0.9 * 1.0) / 0.1
+    np.testing.assert_allclose(implied_batch_var, true_var, rtol=0.05)
+    # the normalized OUTPUT must be accurate too (regression for the
+    # folded x*scale+shift form, which cancels in the output)
+    mean64 = x.astype(np.float64).reshape(-1, 3).mean(axis=0)
+    ref = ((x.astype(np.float64) - mean64)
+           / np.sqrt(true_var + 1e-5)).astype(np.float32)
+    np.testing.assert_allclose(out, ref, rtol=2e-2, atol=2e-3)
+
+
+def test_batchnorm_bf16_moderate_mean_output_accuracy():
+    """bf16 activations with mean ~50, std ~1: the input still encodes
+    the signal (ulp at 50 is 0.25), and subtract-first normalization
+    must return an O(1)-accurate output.  The folded x*scale+shift form
+    differences two ~50 bf16 intermediates and was ~25% wrong here."""
+    rng = np.random.default_rng(1)
+    x = (50.0 + rng.normal(size=(8, 8, 8, 3))).astype(np.float32)
+    x_bf = np.asarray(jnp.asarray(x, jnp.bfloat16).astype(np.float32))
+    layer = nn.SpatialBatchNormalization(3, affine=False)
+    layer.running_mean = jnp.asarray([50.0, 50.0, 50.0])
+    out = np.asarray(layer.forward(
+        jnp.asarray(x, jnp.bfloat16)).astype(jnp.float32))
+    mean64 = x_bf.astype(np.float64).reshape(-1, 3).mean(axis=0)
+    var64 = x_bf.astype(np.float64).reshape(-1, 3).var(axis=0)
+    ref = ((x_bf.astype(np.float64) - mean64)
+           / np.sqrt(var64 + 1e-5)).astype(np.float32)
+    # output is written in bf16, so per-element error ~ bf16 ulp at O(1)
+    np.testing.assert_allclose(out, ref, rtol=0.03, atol=0.03)
+
+
 def test_layernorm_matches_torch():
     x = rnd(4, 12)
     layer = nn.LayerNormalization(12, eps=1e-5)
