@@ -7,9 +7,10 @@ Train.scala; README recipe at models/resnet/README.md:85-150).
 
 Data layout: ``<folder>/train/<class>/*.jpg`` and
 ``<folder>/val/<class>/*.jpg`` (class-per-subdirectory).  The input
-pipeline is the reference's: resize-256 → random-crop-224 + HFlip +
-channel-normalize for training, center-crop for validation — all
-host-side so the jitted step gets ready NHWC arrays.
+pipeline is the reference's: aspect-preserving short-side-256 scale →
+random-crop-224 + HFlip + channel-normalize for training,
+center-crop-224 for validation — all host-side so the jitted step gets
+ready NHWC arrays.
 """
 
 from __future__ import annotations
@@ -24,34 +25,40 @@ MEAN = (123.68, 116.779, 103.939)
 STD = (58.395, 57.12, 57.375)
 
 
+MODELS = {"resnet50": "resnet50",
+          "inception-v1": "Inception_v1",
+          "vgg16": "Vgg_16"}
+
+
 def _build_model(name: str, class_num: int):
     from bigdl_tpu import models
-    table = {"resnet50": lambda: models.resnet50(class_num),
-             "inception-v1": lambda: models.Inception_v1(class_num),
-             "vgg16": lambda: models.Vgg_16(class_num)}
-    if name not in table:
-        raise SystemExit(f"unknown --model {name!r} "
-                         f"(choose from {sorted(table)})")
-    return table[name]()
+    return getattr(models, MODELS[name])(class_num)
 
 
 class _Augment:
-    """Sample-level wrapper over the vision FeatureTransformers.
-    Resize scales with the crop size (256 is the reference value for
-    224-px crops)."""
+    """Sample-level wrapper over the vision FeatureTransformers:
+    aspect-preserving short-side scale (256 for 224-px crops, scaled
+    with the crop size) followed by random/center crop."""
 
     def __init__(self, train: bool, size: int = 224):
         from bigdl_tpu.transform.vision import (
-            CenterCrop, ChannelNormalize, HFlip, RandomCrop,
-            RandomTransformer, Resize,
+            AspectScale, CenterCrop, ChannelNormalize, HFlip, RandomCrop,
+            RandomTransformer,
         )
+        # short-side resize preserving aspect ratio, then crop — the
+        # standard recipe (reference RandomAlterAspect/RandomCropper for
+        # train, Resize(short=256)+CenterCrop(224) for eval); a square
+        # Resize(r, r) would distort non-square images.  The long side
+        # is uncapped: a max_size cap could shrink the short side below
+        # the crop and crash batching on extreme panoramas.
         r = max(size * 256 // 224, size)
+        scale = AspectScale(r, max_size=10 ** 9)
         if train:
-            self.stages = [Resize(r, r), RandomCrop(size, size),
+            self.stages = [scale, RandomCrop(size, size),
                            RandomTransformer(HFlip(), 0.5),
                            ChannelNormalize(*MEAN, *STD)]
         else:
-            self.stages = [Resize(r, r), CenterCrop(size, size),
+            self.stages = [scale, CenterCrop(size, size),
                            ChannelNormalize(*MEAN, *STD)]
 
     def __call__(self, it):
@@ -64,17 +71,31 @@ class _Augment:
             yield Sample(feat.image, s.label)
 
 
-def _list_image_folder(path: str):
+IMAGE_EXTS = (".jpg", ".jpeg", ".png", ".bmp", ".gif", ".webp", ".ppm")
+
+
+def _list_image_folder(path: str, class_to_label=None):
     """Lazy ImageNet listing: (file path, 1-based label) pairs — images
-    decode inside the pipeline, never all-at-once in host RAM."""
+    decode inside the pipeline, never all-at-once in host RAM.  Only
+    image-extension files are listed (a stray README/.DS_Store must not
+    abort a run mid-epoch).  Pass the training split's ``class_to_label``
+    for the val split so labels share one mapping even when a class is
+    missing from val."""
     classes = sorted(d for d in os.listdir(path)
                      if os.path.isdir(os.path.join(path, d)))
+    if class_to_label is None:
+        class_to_label = {cls: ci + 1 for ci, cls in enumerate(classes)}
     items = []
-    for ci, cls in enumerate(classes):
+    for cls in classes:
+        if cls not in class_to_label:
+            raise SystemExit(
+                f"class directory {cls!r} in {path} has no corresponding "
+                f"training class (train classes: {sorted(class_to_label)})")
         cdir = os.path.join(path, cls)
-        items.extend((os.path.join(cdir, fn), ci + 1)
-                     for fn in sorted(os.listdir(cdir)))
-    return items, len(classes)
+        items.extend((os.path.join(cdir, fn), class_to_label[cls])
+                     for fn in sorted(os.listdir(cdir))
+                     if fn.lower().endswith(IMAGE_EXTS))
+    return items, len(class_to_label), class_to_label
 
 
 class _Decode:
@@ -108,8 +129,7 @@ def _synthetic(n: int, size: int, classes: int, seed: int):
 
 def main(argv=None):
     p = base_parser("Train ResNet-50 / Inception-v1 / VGG16 on ImageNet")
-    p.add_argument("--model", default="resnet50",
-                   choices=["resnet50", "inception-v1", "vgg16"])
+    p.add_argument("--model", default="resnet50", choices=sorted(MODELS))
     p.add_argument("--classes", type=int, default=1000)
     p.add_argument("--image-size", type=int, default=224)
     p.add_argument("--momentum", type=float, default=0.9)
@@ -146,7 +166,7 @@ def main(argv=None):
                 "--cache-device would freeze the random crops/flips of "
                 "epoch 1 and replay them forever; it is only valid with "
                 "--synthetic data")
-        train_items, classes = _list_image_folder(
+        train_items, classes, class_map = _list_image_folder(
             os.path.join(args.folder, "train"))
         n_train = len(train_items)
         train_data = (DataSet.array(train_items)
@@ -155,7 +175,7 @@ def main(argv=None):
                       .transform(SampleToMiniBatch(args.batch_size)))
         val_dir = os.path.join(args.folder, "val")
         if os.path.isdir(val_dir):
-            val_items, _ = _list_image_folder(val_dir)
+            val_items, _, _ = _list_image_folder(val_dir, class_map)
             val_data = (DataSet.array(val_items, shuffle=False)
                         .transform(_Decode())
                         .transform(_Augment(train=False, size=size))
@@ -164,18 +184,29 @@ def main(argv=None):
     model = _build_model(args.model, classes)
     iters_per_epoch = max(n_train // args.batch_size, 1)
     total_iters = args.max_epoch * iters_per_epoch
+    base_lr = args.learning_rate
     if args.warmup_epochs > 0:
-        # linear ramp to the base lr over the warmup epochs, then Poly
-        # (the reference's large-batch recipe, SGD.SequentialSchedule)
+        # Linear ramp from a small starting lr up to the requested
+        # --learning-rate (the peak), then Poly decay from the peak over
+        # the remaining budget — the reference's large-batch recipe
+        # (models/resnet/TrainImageNet.scala warmup: delta =
+        # (maxLr - lr) / warmupIters inside SGD.SequentialSchedule).
+        # SequentialSchedule hands each stage's final lr to the next
+        # stage, so Poly decays exactly from the peak.
         warm_iters = args.warmup_epochs * iters_per_epoch
+        if warm_iters >= total_iters:
+            p.error(f"--warmup-epochs ({args.warmup_epochs}) must be "
+                    f"smaller than --max-epoch ({args.max_epoch})")
+        start_lr = args.learning_rate / warm_iters
+        base_lr = start_lr
         schedule = (SequentialSchedule(iters_per_epoch)
-                    .add(Warmup(args.learning_rate / warm_iters),
-                         warm_iters)
+                    .add(Warmup((args.learning_rate - start_lr)
+                                / warm_iters), warm_iters)
                     .add(Poly(0.5, total_iters - warm_iters),
                          total_iters - warm_iters))
     else:
         schedule = Poly(0.5, total_iters)
-    method = SGD(args.learning_rate, momentum=args.momentum,
+    method = SGD(base_lr, momentum=args.momentum,
                  dampening=0.0, weight_decay=args.weight_decay,
                  nesterov=True, learning_rate_schedule=schedule)
     opt = (Optimizer(model, train_data, nn.CrossEntropyCriterion())

@@ -206,3 +206,91 @@ def test_imagenet_main_folder(tmp_path):
     model = main(["-f", str(tmp_path), "--model", "inception-v1",
                   "-e", "1", "-b", "8", "-q", "--classes", "2"])
     assert model is not None
+
+
+def test_imagenet_warmup_schedule_ramps_to_peak():
+    """Warmup must ramp ~0 -> peak lr, then Poly decays FROM the peak
+    (regression: the ramp used to start at the peak and reach 2x it,
+    and warmup_epochs == max_epoch produced a 0/0 NaN lr)."""
+    from bigdl_tpu.optim.methods import Poly, SequentialSchedule, Warmup
+    peak, iters_per_epoch, max_epoch, warm_epochs = 0.4, 10, 9, 3
+    total = max_epoch * iters_per_epoch
+    warm = warm_epochs * iters_per_epoch
+    start = peak / warm
+    sched = (SequentialSchedule(iters_per_epoch)
+             .add(Warmup((peak - start) / warm), warm)
+             .add(Poly(0.5, total - warm), total - warm))
+    lr0 = float(sched(start, 0, 0))
+    lr_end_warm = float(sched(start, warm, 0))
+    lr_mid = float(sched(start, (warm + total) // 2, 0))
+    lr_last = float(sched(start, total - 1, 0))
+    assert abs(lr0 - start) < 1e-6
+    assert abs(lr_end_warm - peak) < 1e-6
+    assert 0.0 < lr_mid < peak
+    assert 0.0 <= lr_last < lr_mid
+    import math
+    for s in range(0, total + 5):
+        assert math.isfinite(float(sched(start, s, 0)))
+
+
+def test_imagenet_main_rejects_warmup_ge_epochs():
+    import pytest as _pytest
+    from bigdl_tpu.examples.imagenet import main
+    with _pytest.raises(SystemExit):
+        main(["--synthetic", "32", "-e", "1", "--warmup-epochs", "1",
+              "-b", "16", "-q", "--image-size", "32", "--classes", "4"])
+
+
+def test_image_folder_listing_filters_and_shares_class_map(tmp_path):
+    from PIL import Image
+    rng = np.random.default_rng(0)
+    for cls in ("a", "b", "c"):
+        d = tmp_path / "train" / cls
+        d.mkdir(parents=True)
+        arr = rng.integers(0, 255, size=(8, 8, 3)).astype("uint8")
+        Image.fromarray(arr).save(d / "x.png")
+    # stray non-image files must be ignored, not decoded
+    (tmp_path / "train" / "a" / "README.txt").write_text("notes")
+    (tmp_path / "train" / "b" / ".DS_Store").write_bytes(b"\x00junk")
+    # val/ is missing class "b": labels must come from the TRAIN mapping
+    for cls in ("a", "c"):
+        d = tmp_path / "val" / cls
+        d.mkdir(parents=True)
+        arr = rng.integers(0, 255, size=(8, 8, 3)).astype("uint8")
+        Image.fromarray(arr).save(d / "y.jpg")
+    from bigdl_tpu.examples.imagenet import _list_image_folder
+    train_items, classes, cmap = _list_image_folder(str(tmp_path / "train"))
+    assert classes == 3 and len(train_items) == 3
+    assert all(p.lower().endswith((".png", ".jpg")) for p, _ in train_items)
+    val_items, _, _ = _list_image_folder(str(tmp_path / "val"), cmap)
+    labels = {p.split("/")[-2]: l for p, l in val_items}
+    assert labels == {"a": cmap["a"], "c": cmap["c"]}
+    # a val class unknown to train fails loudly, not silently
+    d = tmp_path / "val" / "zzz"
+    d.mkdir()
+    Image.fromarray(rng.integers(0, 255, size=(8, 8, 3)).astype("uint8")
+                    ).save(d / "z.png")
+    with pytest.raises(SystemExit):
+        _list_image_folder(str(tmp_path / "val"), cmap)
+
+
+def test_augment_preserves_aspect_ratio():
+    """Eval recipe = short-side scale + center crop (not a distorting
+    square resize): the scale stage must keep the image's geometry."""
+    from bigdl_tpu.dataset.dataset import Sample
+    from bigdl_tpu.examples.imagenet import _Augment
+    from bigdl_tpu.transform.vision import ImageFeature
+    img = np.zeros((300, 600, 3), np.float32)
+    aug = _Augment(train=False, size=64)
+    scaled = aug.stages[0](ImageFeature(img)).image
+    # short side -> r = max(64*256//224, 64) = 73; ratio preserved
+    assert scaled.shape[0] == 73
+    assert abs(scaled.shape[1] - 146) <= 1
+    # an extreme panorama must still yield a full-size crop (an
+    # aspect cap that shrinks the short side would crash batching)
+    pano = np.zeros((200, 3000, 3), np.float32)
+    out = list(_Augment(train=False, size=224)([Sample(pano, 1)]))
+    assert out[0].feature.shape == (224, 224, 3)
+    # end-to-end shape on the normal image too
+    out = list(aug([Sample(img, 1)]))
+    assert out[0].feature.shape == (64, 64, 3)
