@@ -5,5 +5,6 @@ from bigdl_tpu.dataset.dataset import (
 from bigdl_tpu.dataset.transformer import (
     Transformer, SampleToMiniBatch, Identity as IdentityTransformer,
 )
+from bigdl_tpu.dataset.prefetch import ParallelMap, Prefetch
 from bigdl_tpu.dataset import image
 from bigdl_tpu.dataset import text

@@ -1,0 +1,128 @@
+"""Concurrent pipeline stages: background prefetch + thread-pool map.
+
+TPU-native analog of the reference's multithreaded batching
+(dataset/image/MTLabeledBGRImgToBatch.scala,
+transform/vision/image/MTImageFeatureToBatch.scala): on Spark the goal
+was to keep ``coresPerNode`` busy decoding; on TPU the goal is to
+overlap host-side decode/augment with device compute so the jitted
+step never waits on the input pipeline.  Python threads are the right
+tool because the heavy per-sample work (PIL decode, numpy resize)
+releases the GIL.
+
+Usage::
+
+    ds = (DataSet.array(paths)
+          .transform(ParallelMap(decode_and_augment, workers=8))
+          .transform(SampleToMiniBatch(bs))
+          .transform(Prefetch(n_ahead=2)))
+
+``Prefetch`` should be the LAST stage so ready minibatches queue up
+while the step function runs.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Iterator
+
+from bigdl_tpu.dataset.transformer import Transformer
+
+__all__ = ["Prefetch", "ParallelMap"]
+
+_STOP = object()
+
+
+class _Failure:
+    __slots__ = ("exc",)
+
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
+class Prefetch(Transformer):
+    """Run the upstream iterator in a daemon thread, handing items over
+    a bounded queue.  ``n_ahead`` bounds host memory (items buffered
+    beyond the one being consumed).  Upstream exceptions re-raise at the
+    consumer's next ``__next__``; dropping the iterator early stops the
+    producer promptly (it blocks on the queue, sees the stop flag)."""
+
+    def __init__(self, n_ahead: int = 2):
+        assert n_ahead >= 1
+        self.n_ahead = n_ahead
+
+    def apply(self, it: Iterator) -> Iterator:
+        q: "queue.Queue" = queue.Queue(maxsize=self.n_ahead)
+        stop = threading.Event()
+
+        def put_checked(item) -> bool:
+            """Blocking put that gives up once the consumer is gone;
+            True if the item was enqueued."""
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def produce():
+            try:
+                for item in it:
+                    if not put_checked(item):
+                        return
+                put_checked(_STOP)
+            except BaseException as e:  # noqa: BLE001 — relayed to consumer
+                put_checked(_Failure(e))
+
+        t = threading.Thread(target=produce, daemon=True)
+        t.start()
+
+        def consume():
+            try:
+                while True:
+                    item = q.get()
+                    if item is _STOP:
+                        return
+                    if isinstance(item, _Failure):
+                        raise item.exc
+                    yield item
+            finally:
+                stop.set()
+
+        return consume()
+
+
+class ParallelMap(Transformer):
+    """Order-preserving thread-pool map of a per-item function over the
+    stream (≙ the reference's MT* transformers' per-thread pipelines).
+    ``fn`` takes one item and returns one item; it runs concurrently on
+    ``workers`` threads, results are yielded in input order, and at most
+    ``workers + queue_factor*workers`` items are in flight (bounds
+    memory on huge listings)."""
+
+    def __init__(self, fn: Callable, workers: int = 4,
+                 queue_factor: int = 2):
+        assert workers >= 1
+        self.fn = fn
+        self.workers = workers
+        self.in_flight = workers * (1 + queue_factor)
+
+    def apply(self, it: Iterator) -> Iterator:
+        from concurrent.futures import ThreadPoolExecutor
+
+        def run():
+            pending: "queue.SimpleQueue" = queue.SimpleQueue()
+            with ThreadPoolExecutor(self.workers) as pool:
+                n = 0
+                for item in it:
+                    pending.put(pool.submit(self.fn, item))
+                    n += 1
+                    if n >= self.in_flight:
+                        yield pending.get().result()
+                        n -= 1
+                while n:
+                    yield pending.get().result()
+                    n -= 1
+
+        return run()

@@ -52,7 +52,7 @@ class _Augment:
         # is uncapped: a max_size cap could shrink the short side below
         # the crop and crash batching on extreme panoramas.
         r = max(size * 256 // 224, size)
-        scale = AspectScale(r, max_size=10 ** 9)
+        scale = AspectScale(r, max_size=None)
         if train:
             self.stages = [scale, RandomCrop(size, size),
                            RandomTransformer(HFlip(), 0.5),
@@ -98,16 +98,30 @@ def _list_image_folder(path: str, class_to_label=None):
     return items, len(class_to_label), class_to_label
 
 
-class _Decode:
-    """(path, label) → Sample(HWC float32, label)."""
+def _decode_rgb(path):
+    """path → HWC float32 RGB array (single decode expression shared by
+    every pipeline so EXIF/color handling cannot diverge)."""
+    import numpy as np
+    from PIL import Image
+    return np.asarray(Image.open(path).convert("RGB"), np.float32)
 
-    def __call__(self, it):
-        import numpy as np
-        from PIL import Image
+
+class _DecodeAugment:
+    """Per-item decode + augment for ParallelMap: PIL decode and numpy
+    resampling release the GIL, so worker threads genuinely overlap
+    (≙ the reference's MTImageFeatureToBatch per-thread pipelines)."""
+
+    def __init__(self, train: bool, size: int):
+        self._aug = _Augment(train=train, size=size)
+
+    def __call__(self, item):
         from bigdl_tpu.dataset.dataset import Sample
-        for path, label in it:
-            img = np.asarray(Image.open(path).convert("RGB"), np.float32)
-            yield Sample(img, label)
+        from bigdl_tpu.transform.vision import ImageFeature
+        path, label = item
+        feat = ImageFeature(_decode_rgb(path))
+        for t in self._aug.stages:
+            feat = t(feat)
+        return Sample(feat.image, label)
 
 
 def _synthetic(n: int, size: int, classes: int, seed: int):
@@ -135,6 +149,8 @@ def main(argv=None):
     p.add_argument("--momentum", type=float, default=0.9)
     p.add_argument("--weight-decay", type=float, default=1e-4)
     p.add_argument("--warmup-epochs", type=int, default=0)
+    p.add_argument("--workers", type=int, default=8,
+                   help="decode/augment threads (folder input)")
     p.set_defaults(batch_size=256, learning_rate=0.1, max_epoch=90)
     args = p.parse_args(argv)
     train_summary, val_summary = setup(args, f"imagenet-{args.model}")
@@ -166,20 +182,25 @@ def main(argv=None):
                 "--cache-device would freeze the random crops/flips of "
                 "epoch 1 and replay them forever; it is only valid with "
                 "--synthetic data")
+        from bigdl_tpu.dataset.prefetch import ParallelMap, Prefetch
         train_items, classes, class_map = _list_image_folder(
             os.path.join(args.folder, "train"))
         n_train = len(train_items)
         train_data = (DataSet.array(train_items)
-                      .transform(_Decode())
-                      .transform(_Augment(train=True, size=size))
-                      .transform(SampleToMiniBatch(args.batch_size)))
+                      .transform(ParallelMap(
+                          _DecodeAugment(train=True, size=size),
+                          workers=args.workers))
+                      .transform(SampleToMiniBatch(args.batch_size))
+                      .transform(Prefetch(2)))
         val_dir = os.path.join(args.folder, "val")
         if os.path.isdir(val_dir):
             val_items, _, _ = _list_image_folder(val_dir, class_map)
             val_data = (DataSet.array(val_items, shuffle=False)
-                        .transform(_Decode())
-                        .transform(_Augment(train=False, size=size))
-                        .transform(SampleToMiniBatch(args.batch_size)))
+                        .transform(ParallelMap(
+                            _DecodeAugment(train=False, size=size),
+                            workers=args.workers))
+                        .transform(SampleToMiniBatch(args.batch_size))
+                        .transform(Prefetch(2)))
 
     model = _build_model(args.model, classes)
     iters_per_epoch = max(n_train // args.batch_size, 1)

@@ -368,9 +368,11 @@ class Resize(FeatureTransformer):
 class AspectScale(FeatureTransformer):
     """Resize so the short side is ``min_size`` with the long side capped
     at ``max_size`` (reference ScaleResize/AspectScale semantics used by
-    detection pipelines)."""
+    detection pipelines).  ``max_size=None`` disables the cap — the
+    short side is then always exactly ``min_size``, which crop-based
+    classification pipelines rely on."""
 
-    def __init__(self, min_size: int, max_size: int = 1000,
+    def __init__(self, min_size: int, max_size: Optional[int] = 1000,
                  scale_multiple: int = 1):
         self.min_size, self.max_size = min_size, max_size
         self.mult = scale_multiple
@@ -378,7 +380,7 @@ class AspectScale(FeatureTransformer):
     def transform(self, f):
         h, w = f.image.shape[:2]
         scale = self.min_size / min(h, w)
-        if max(h, w) * scale > self.max_size:
+        if self.max_size is not None and max(h, w) * scale > self.max_size:
             scale = self.max_size / max(h, w)
         nh, nw = int(round(h * scale)), int(round(w * scale))
         if self.mult > 1:

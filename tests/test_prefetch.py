@@ -1,0 +1,142 @@
+"""Concurrent pipeline stages (reference MTImageFeatureToBatch /
+MTLabeledBGRImgToBatch multithreaded batching analog)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from bigdl_tpu.dataset.dataset import DataSet
+from bigdl_tpu.dataset.prefetch import ParallelMap, Prefetch
+
+
+def test_prefetch_preserves_stream():
+    out = list(Prefetch(3)(iter(range(100))))
+    assert out == list(range(100))
+
+
+def test_prefetch_propagates_upstream_exception():
+    def bad():
+        yield 1
+        yield 2
+        raise ValueError("decode failed")
+
+    it = Prefetch(2)(bad())
+    assert next(it) == 1
+    assert next(it) == 2
+    with pytest.raises(ValueError, match="decode failed"):
+        next(it)
+
+
+def test_prefetch_early_drop_stops_producer():
+    produced = []
+
+    def src():
+        for i in range(10_000):
+            produced.append(i)
+            yield i
+
+    it = Prefetch(2)(src())
+    for _ in range(3):
+        next(it)
+    it.close()  # generator drop
+    time.sleep(0.3)
+    n = len(produced)
+    time.sleep(0.3)
+    # producer must have stopped (bounded queue + stop flag), not
+    # drained all 10k items
+    assert len(produced) == n
+    assert n < 100
+
+
+def test_prefetch_producer_exits_when_consumer_drops_after_exhaustion():
+    """Regression: the final _STOP/_Failure puts must honor the stop
+    flag — a producer that exhausted its upstream while the queue was
+    full used to block in q.put forever after the consumer went away,
+    leaking the thread and its buffered items."""
+    before = {t.ident for t in threading.enumerate()}
+    it = Prefetch(1)(iter(range(3)))  # 3 items > n_ahead=1
+    next(it)
+    time.sleep(0.2)   # let the producer fill the queue and reach _STOP
+    it.close()
+    deadline = time.time() + 5.0
+    while time.time() < deadline:
+        leaked = [t for t in threading.enumerate()
+                  if t.ident not in before and t.is_alive()]
+        if not leaked:
+            break
+        time.sleep(0.05)
+    assert not leaked, f"prefetch producer thread leaked: {leaked}"
+
+
+def test_prefetch_overlaps_producer_and_consumer():
+    """With 50ms produce + 50ms consume x 6 items, serial is ~600ms;
+    overlapped must be well under it."""
+    def src():
+        for i in range(6):
+            time.sleep(0.05)
+            yield i
+
+    t0 = time.perf_counter()
+    for _ in Prefetch(2)(src()):
+        time.sleep(0.05)
+    overlapped = time.perf_counter() - t0
+    assert overlapped < 0.5, overlapped
+
+
+def test_parallel_map_order_and_concurrency():
+    active = []
+    peak = []
+    lock = threading.Lock()
+
+    def fn(i):
+        with lock:
+            active.append(i)
+            peak.append(len(active))
+        time.sleep(0.02)
+        with lock:
+            active.remove(i)
+        return i * i
+
+    out = list(ParallelMap(fn, workers=4)(iter(range(40))))
+    assert out == [i * i for i in range(40)]
+    assert max(peak) > 1  # actually ran concurrently
+
+
+def test_parallel_map_propagates_fn_exception_in_order():
+    def fn(i):
+        if i == 5:
+            raise RuntimeError("boom")
+        return i
+
+    it = ParallelMap(fn, workers=3)(iter(range(10)))
+    got = []
+    with pytest.raises(RuntimeError, match="boom"):
+        for v in it:
+            got.append(v)
+    assert got == [0, 1, 2, 3, 4]
+
+
+def test_parallel_map_bounds_in_flight():
+    submitted = []
+
+    def fn(i):
+        submitted.append(i)
+        time.sleep(0.01)
+        return i
+
+    pm = ParallelMap(fn, workers=2, queue_factor=1)
+    it = pm(iter(range(1000)))
+    next(it)
+    # after one yield, at most in_flight + 1 items were ever submitted
+    assert len(submitted) <= pm.in_flight + 1
+    it.close()
+
+
+def test_pipeline_integration_with_dataset():
+    data = DataSet.array(list(range(32)), shuffle=False) \
+        .transform(ParallelMap(lambda x: np.float32(x) * 2, workers=3)) \
+        .transform(Prefetch(2))
+    got = list(data.data(train=False))
+    assert got == [np.float32(i) * 2 for i in range(32)]
