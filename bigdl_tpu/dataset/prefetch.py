@@ -75,10 +75,12 @@ class Prefetch(Transformer):
             except BaseException as e:  # noqa: BLE001 — relayed to consumer
                 put_checked(_Failure(e))
 
-        t = threading.Thread(target=produce, daemon=True)
-        t.start()
-
         def consume():
+            # start the producer lazily, from inside the generator: a
+            # never-advanced generator never runs its try/finally, so an
+            # eagerly-started thread could never be told to stop
+            t = threading.Thread(target=produce, daemon=True)
+            t.start()
             try:
                 while True:
                     item = q.get()
@@ -113,7 +115,8 @@ class ParallelMap(Transformer):
 
         def run():
             pending: "queue.SimpleQueue" = queue.SimpleQueue()
-            with ThreadPoolExecutor(self.workers) as pool:
+            pool = ThreadPoolExecutor(self.workers)
+            try:
                 n = 0
                 for item in it:
                     pending.put(pool.submit(self.fn, item))
@@ -124,5 +127,9 @@ class ParallelMap(Transformer):
                 while n:
                     yield pending.get().result()
                     n -= 1
+            finally:
+                # early close / mid-stream exception: drop queued work
+                # instead of decoding it pointlessly to completion
+                pool.shutdown(wait=False, cancel_futures=True)
 
         return run()

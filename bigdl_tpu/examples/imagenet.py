@@ -61,14 +61,19 @@ class _Augment:
             self.stages = [scale, CenterCrop(size, size),
                            ChannelNormalize(*MEAN, *STD)]
 
+    def apply_one(self, image):
+        """HWC array → augmented HWC array (single copy of the stage
+        loop, shared by the sequential and ParallelMap paths)."""
+        from bigdl_tpu.transform.vision import ImageFeature
+        feat = ImageFeature(image)
+        for t in self.stages:
+            feat = t(feat)
+        return feat.image
+
     def __call__(self, it):
         from bigdl_tpu.dataset.dataset import Sample
-        from bigdl_tpu.transform.vision import ImageFeature
         for s in it:
-            feat = ImageFeature(s.feature)
-            for t in self.stages:
-                feat = t(feat)
-            yield Sample(feat.image, s.label)
+            yield Sample(self.apply_one(s.feature), s.label)
 
 
 IMAGE_EXTS = (".jpg", ".jpeg", ".png", ".bmp", ".gif", ".webp", ".ppm")
@@ -109,19 +114,47 @@ def _decode_rgb(path):
 class _DecodeAugment:
     """Per-item decode + augment for ParallelMap: PIL decode and numpy
     resampling release the GIL, so worker threads genuinely overlap
-    (≙ the reference's MTImageFeatureToBatch per-thread pipelines)."""
+    (≙ the reference's MTImageFeatureToBatch per-thread pipelines).
+
+    Each worker thread gets its OWN _Augment: RandomCrop and
+    RandomTransformer hold legacy np.random.RandomState instances,
+    which are not thread-safe — sharing one across workers could
+    corrupt the Mersenne state or correlate the augmentation streams.
+    Fresh RandomState() instances seed from OS entropy, so per-thread
+    streams are independent."""
 
     def __init__(self, train: bool, size: int):
-        self._aug = _Augment(train=train, size=size)
+        import threading
+        self._train, self._size = train, size
+        self._local = threading.local()
+
+    def _aug(self) -> _Augment:
+        aug = getattr(self._local, "aug", None)
+        if aug is None:
+            aug = self._local.aug = _Augment(train=self._train,
+                                             size=self._size)
+        return aug
 
     def __call__(self, item):
         from bigdl_tpu.dataset.dataset import Sample
-        from bigdl_tpu.transform.vision import ImageFeature
         path, label = item
-        feat = ImageFeature(_decode_rgb(path))
-        for t in self._aug.stages:
-            feat = t(feat)
-        return Sample(feat.image, label)
+        return Sample(self._aug().apply_one(_decode_rgb(path)), label)
+
+
+def eval_pipeline(folder: str, size: int, batch_size: int,
+                  workers: int = 8, class_map=None):
+    """Class-per-subdirectory folder → (DataSet, n_classes, class_map)
+    through the threaded eval augment path — the one evaluation pipeline
+    shared by the imagenet, loadmodel, and quantize CLIs."""
+    from bigdl_tpu.dataset.dataset import DataSet
+    from bigdl_tpu.dataset.prefetch import ParallelMap
+    from bigdl_tpu.dataset.transformer import SampleToMiniBatch
+    items, classes, cmap = _list_image_folder(folder, class_map)
+    data = (DataSet.array(items, shuffle=False)
+            .transform(ParallelMap(_DecodeAugment(train=False, size=size),
+                                   workers=workers))
+            .transform(SampleToMiniBatch(batch_size)))
+    return data, classes, cmap
 
 
 def _synthetic(n: int, size: int, classes: int, seed: int):
@@ -194,13 +227,10 @@ def main(argv=None):
                       .transform(Prefetch(2)))
         val_dir = os.path.join(args.folder, "val")
         if os.path.isdir(val_dir):
-            val_items, _, _ = _list_image_folder(val_dir, class_map)
-            val_data = (DataSet.array(val_items, shuffle=False)
-                        .transform(ParallelMap(
-                            _DecodeAugment(train=False, size=size),
-                            workers=args.workers))
-                        .transform(SampleToMiniBatch(args.batch_size))
-                        .transform(Prefetch(2)))
+            val_data, _, _ = eval_pipeline(
+                val_dir, size, args.batch_size, workers=args.workers,
+                class_map=class_map)
+            val_data = val_data.transform(Prefetch(2))
 
     model = _build_model(args.model, classes)
     iters_per_epoch = max(n_train // args.batch_size, 1)

@@ -1,0 +1,100 @@
+"""Model-serving CLI over HTTP (reference example/udfpredictor — model
+serving behind Spark SQL UDFs — rebuilt on PredictionService, the
+reference's thread-safe concurrent inference pool,
+optim/PredictionService.scala:56-129).
+
+    bigdl-tpu-serve --model trained.bigdl --port 8500
+
+Protocol (stdlib-only on both ends):
+
+* ``POST /predict`` with an ``.npy``-serialized array body →
+  ``.npy``-serialized output array (``application/octet-stream``).
+* ``GET /healthz`` → ``{"status": "ok"}``.
+
+Client::
+
+    buf = io.BytesIO(); np.save(buf, x)
+    conn = http.client.HTTPConnection("localhost", 8500)
+    conn.request("POST", "/predict", buf.getvalue())
+    y = np.load(io.BytesIO(conn.getresponse().read()))
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+logger = logging.getLogger("bigdl_tpu.serve")
+
+
+def make_server(service, host: str, port: int) -> ThreadingHTTPServer:
+    """ThreadingHTTPServer wired to a PredictionService; concurrency is
+    bounded by the service's ticket pool, not the HTTP threads."""
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *fargs):
+            logger.info("%s " + fmt, self.address_string(), *fargs)
+
+        def _reply(self, code: int, body: bytes,
+                   ctype: str = "application/octet-stream"):
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                self._reply(200, json.dumps({"status": "ok"}).encode(),
+                            "application/json")
+            else:
+                self._reply(404, b"not found", "text/plain")
+
+        def do_POST(self):
+            if self.path != "/predict":
+                self._reply(404, b"not found", "text/plain")
+                return
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                payload = self.rfile.read(n)
+                self._reply(200, service.predict_bytes(payload))
+            except Exception as e:  # noqa: BLE001 — client-facing error
+                self._reply(400, json.dumps(
+                    {"error": f"{type(e).__name__}: {e}"}).encode(),
+                    "application/json")
+
+    return ThreadingHTTPServer((host, port), Handler)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description="Serve a model over HTTP")
+    p.add_argument("--model", required=True, help="bigdl-format model file")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8500)
+    p.add_argument("--concurrency", type=int, default=4,
+                   help="max in-flight predictions")
+    p.add_argument("-q", "--quiet", action="store_true")
+    args = p.parse_args(argv)
+    logging.basicConfig(
+        level=logging.WARNING if args.quiet else logging.INFO)
+
+    from bigdl_tpu.optim.predictor import PredictionService
+    from bigdl_tpu.utils.serializer import load_module
+
+    service = PredictionService(load_module(args.model),
+                                concurrency=args.concurrency)
+    server = make_server(service, args.host, args.port)
+    logger.info("serving on %s:%d", args.host, server.server_port)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.server_close()
+    return server
+
+
+if __name__ == "__main__":
+    main()

@@ -294,3 +294,25 @@ def test_augment_preserves_aspect_ratio():
     # end-to-end shape on the normal image too
     out = list(aug([Sample(img, 1)]))
     assert out[0].feature.shape == (64, 64, 3)
+
+
+def test_decode_augment_uses_per_thread_rngs():
+    """RandomState is not thread-safe: each ParallelMap worker must get
+    its own _Augment (own RandomCrop/RandomTransformer RNG streams)."""
+    import threading
+    from bigdl_tpu.examples.imagenet import _DecodeAugment
+    da = _DecodeAugment(train=True, size=32)
+    augs = {}
+
+    def grab(name):
+        augs[name] = da._aug()
+        assert da._aug() is augs[name]  # cached within the thread
+
+    threads = [threading.Thread(target=grab, args=(i,)) for i in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len({id(a) for a in augs.values()}) == 3
+    rngs = [a.stages[1].rng for a in augs.values()]  # RandomCrop rng
+    assert len({id(r) for r in rngs}) == 3

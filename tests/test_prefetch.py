@@ -70,6 +70,38 @@ def test_prefetch_producer_exits_when_consumer_drops_after_exhaustion():
     assert not leaked, f"prefetch producer thread leaked: {leaked}"
 
 
+def test_prefetch_never_advanced_generator_starts_no_thread():
+    """Regression: an eagerly-started producer could never be stopped
+    if the consumer generator was dropped before its first next() —
+    the thread must start lazily on first advance."""
+    before = threading.active_count()
+    it = Prefetch(2)(iter(range(100)))
+    time.sleep(0.1)
+    assert threading.active_count() == before  # nothing started yet
+    it.close()
+    assert threading.active_count() == before
+
+
+def test_parallel_map_early_close_cancels_queued_work():
+    """Regression: generator close must drop queued fn calls
+    (shutdown(cancel_futures=True)), not run them all to completion."""
+    started = []
+
+    def fn(i):
+        started.append(i)
+        time.sleep(0.05)
+        return i
+
+    it = ParallelMap(fn, workers=2, queue_factor=4)(iter(range(1000)))
+    next(it)
+    it.close()
+    time.sleep(0.3)  # in-flight items finish; queued ones must not run
+    n = len(started)
+    time.sleep(0.3)
+    assert len(started) == n
+    assert n <= 2 * (1 + 4) + 2  # nothing beyond the in-flight window
+
+
 def test_prefetch_overlaps_producer_and_consumer():
     """With 50ms produce + 50ms consume x 6 items, serial is ~600ms;
     overlapped must be well under it."""

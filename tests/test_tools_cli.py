@@ -1,0 +1,141 @@
+"""Model-tool CLIs: loadmodel (import + validate), quantize (int8),
+serve (HTTP PredictionService) — reference example/loadmodel,
+example/mkldnn int8, example/udfpredictor."""
+
+import io
+import threading
+
+import numpy as np
+import pytest
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.utils.serializer import save_module
+
+
+def _small_cnn(classes=3, size=16):
+    from bigdl_tpu.utils import set_seed
+    set_seed(7)
+    return nn.Sequential(
+        nn.SpatialConvolution(3, 8, 3, 3, 1, 1, -1, -1),
+        nn.SpatialBatchNormalization(8),
+        nn.ReLU(),
+        nn.SpatialMaxPooling(2, 2, 2, 2),
+        nn.Reshape((8 * (size // 2) * (size // 2),)),
+        nn.Linear(8 * (size // 2) * (size // 2), classes),
+    )
+
+
+@pytest.fixture()
+def image_folder(tmp_path):
+    from PIL import Image
+    rng = np.random.default_rng(0)
+    for cls in ("a", "b", "c"):
+        d = tmp_path / "val" / cls
+        d.mkdir(parents=True)
+        for i in range(4):
+            arr = rng.integers(0, 255, size=(20, 20, 3)).astype("uint8")
+            Image.fromarray(arr).save(d / f"{i}.png")
+    return tmp_path
+
+
+def test_loadmodel_predict_and_evaluate(tmp_path, image_folder):
+    model = _small_cnn()
+    mpath = tmp_path / "m.bigdl"
+    save_module(model, str(mpath))
+    img = image_folder / "val" / "a" / "0.png"
+    from bigdl_tpu.examples.loadmodel import main
+    res = main(["--format", "bigdl", "--model", str(mpath),
+                "--predict", str(img), "--image-size", "16", "-q"])
+    pairs = res[str(img)]
+    assert len(pairs) == 3  # 3-class model: top-5 clips to class count
+    assert all(1 <= c <= 3 for c, _ in pairs)
+    res = main(["--format", "bigdl", "--model", str(mpath),
+                "--evaluate", str(image_folder / "val"),
+                "--image-size", "16", "-b", "4", "-q"])
+    assert 0.0 <= res["Top1Accuracy"] <= 1.0
+    assert np.isfinite(res["Loss"])
+
+
+def test_loadmodel_format_dispatch(tmp_path):
+    """The --format switch must route to each interop loader."""
+    from tests.test_t7_table_metrics import _write_torch_module
+    from bigdl_tpu.examples.loadmodel import load_model
+    wt = np.random.default_rng(1).normal(size=(2, 5)).astype(np.float32)
+    b = np.zeros(2, np.float32)
+    t7 = str(tmp_path / "lin.t7")
+    _write_torch_module(t7, "nn.Linear", {"weight": wt, "bias": b})
+    m = load_model("torch", t7)
+    assert isinstance(m, nn.Linear)
+    np.testing.assert_allclose(np.asarray(m.weight), wt)
+    with pytest.raises(SystemExit, match="prototxt"):
+        load_model("caffe", t7)
+
+
+def test_quantize_cli(tmp_path, image_folder):
+    model = _small_cnn()
+    mpath, qpath = tmp_path / "m.bigdl", tmp_path / "q.bigdl"
+    save_module(model, str(mpath))
+    from bigdl_tpu.examples.quantize import main
+    res = main(["--model", str(mpath), "--output", str(qpath),
+                "--evaluate", str(image_folder / "val"),
+                "--image-size", "16", "-b", "4", "-q"])
+    assert qpath.exists()
+    assert res["bytes_int8"] < res["bytes_fp32"]
+    # int8 top-1 should track fp32 closely on this tiny set
+    assert abs(res["top1_int8"] - res["top1_fp32"]) <= 0.5
+
+
+def test_serve_http_roundtrip(tmp_path):
+    from bigdl_tpu.examples.serve import make_server
+    from bigdl_tpu.optim.predictor import PredictionService
+    model = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    service = PredictionService(model, concurrency=2)
+    server = make_server(service, "127.0.0.1", 0)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    try:
+        import http.client
+        port = server.server_port
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+        conn.request("GET", "/healthz")
+        assert conn.getresponse().read() == b'{"status": "ok"}'
+        x = np.random.default_rng(0).normal(size=(5, 4)).astype(np.float32)
+        buf = io.BytesIO()
+        np.save(buf, x, allow_pickle=False)
+        conn.request("POST", "/predict", buf.getvalue())
+        out = np.load(io.BytesIO(conn.getresponse().read()),
+                      allow_pickle=False)
+        assert out.shape == (5, 2)
+        ref = np.asarray(model.clone().eval_mode().forward(x))
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+        # malformed payload -> 400 with an error body, server stays up
+        conn.request("POST", "/predict", b"not-an-npy")
+        r = conn.getresponse()
+        assert r.status == 400 and b"error" in r.read()
+        conn.request("GET", "/healthz")
+        assert conn.getresponse().status == 200
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+def test_loadmodel_predict_batches_and_class_warning(tmp_path,
+                                                     image_folder, caplog):
+    import logging as _logging
+    model = _small_cnn()
+    mpath = tmp_path / "m.bigdl"
+    save_module(model, str(mpath))
+    imgs = [str(image_folder / "val" / c / "0.png") for c in ("a", "b", "c")]
+    from bigdl_tpu.examples.loadmodel import main
+    # batch_size 2 over 3 images: predict path must chunk, not stack all
+    res = main(["--format", "bigdl", "--model", str(mpath),
+                "--predict", *imgs, "--image-size", "16", "-b", "2", "-q"])
+    assert set(res) == set(imgs)
+    # 3-class model scored on a folder pruned to 2 classes -> warning
+    import shutil
+    shutil.rmtree(image_folder / "val" / "c")
+    with caplog.at_level(_logging.WARNING):
+        main(["--format", "bigdl", "--model", str(mpath),
+              "--evaluate", str(image_folder / "val"),
+              "--image-size", "16", "-b", "4", "-q"])
+    assert any("class directories" in r.message for r in caplog.records)
