@@ -121,7 +121,13 @@ def _decode_tensor(raw: bytes) -> np.ndarray:
     n = int(np.prod(shape)) if shape else arr.size
     if arr.size == 1 and n > 1:
         arr = np.full(n, arr[0], np_dt)  # splat scalar
-    return arr.reshape(shape) if shape else arr
+    if shape:
+        return arr.reshape(shape)
+    if _T_SHAPE in msg and arr.size == 1:
+        # explicitly-empty shape proto = rank-0 scalar; (1,) here breaks
+        # shape agreement, e.g. a while-loop carry init vs body output
+        return arr.reshape(())
+    return arr
 
 
 def parse_graphdef(data: bytes) -> List[TFNode]:
@@ -206,7 +212,26 @@ def load_tf_graph(path_or_bytes, inputs: Sequence[str],
         with open(path_or_bytes, "rb") as f:
             data = f.read()
     nodes = {n.name: n for n in parse_graphdef(data)}
+    model, layer_map = _build_graph(nodes, inputs, outputs)
+    # strip converter-internal dunder cache entries (e.g. while frames)
+    layer_map = {k: v for k, v in layer_map.items()
+                 if not k.startswith("__")}
+    return model, layer_map
 
+
+def _build_graph(nodes: Dict[str, "TFNode"], inputs: Sequence[str],
+                 outputs: Sequence[str]):
+    """Build a Graph model over an already-parsed node dict.  ``inputs``
+    become Input placeholders; also called re-entrantly by the
+    while-loop importer to construct cond/body subgraphs whose
+    boundaries are frame nodes (Merge/Switch/invariant-Enter)."""
+    # Work on a private copy: the fusion pre-pass annotates node attrs
+    # (_fused_bias), and a re-entrant subgraph build must not override
+    # the enclosing build's fusion decisions (its consumer counts bump
+    # different outputs).
+    nodes = {name: TFNode(nd.name, nd.op, list(nd.inputs),
+                          dict(nd.attrs))
+             for name, nd in nodes.items()}
     consts: Dict[str, np.ndarray] = {}
     for n in nodes.values():
         if n.op == "Const":
@@ -919,6 +944,149 @@ def _register_defaults():
                        resolve(sw0.inputs[1]))
 
     _TF_CONVERTERS["Merge"] = tf_merge
+
+    def tf_exit(n, nodes, const_of, resolve, node_of, layer_map):
+        """Import a whole TF-v1 while-loop frame as ONE lax.while_loop.
+
+        The reference executes Enter/Merge/Switch/Exit/NextIteration
+        frames with a dynamic Scheduler/FrameManager
+        (nn/Scheduler.scala, nn/FrameManager.scala, nn/tf/
+        ControlOps.scala); under XLA the whole frame compiles to a
+        single `lax.while_loop`, so the importer pattern-matches the
+        frame once (triggered at its first Exit) and every Exit selects
+        its variable from the loop's carry tuple."""
+        sw = nodes.get(_clean(n.inputs[0]))
+        if sw is None or sw.op != "Switch":
+            raise ValueError(f"Exit {n.name}: expected a Switch input")
+        merge = nodes.get(_clean(sw.inputs[0]))
+        loopcond = nodes.get(_clean(sw.inputs[1]))
+        if merge is None or merge.op != "Merge" \
+                or loopcond is None or loopcond.op != "LoopCond":
+            raise ValueError(
+                f"Exit {n.name}: not a canonical while-loop frame "
+                f"(Switch must read a Merge and a LoopCond)")
+        enter = next((nodes[_clean(i)] for i in merge.inputs
+                      if nodes.get(_clean(i)) is not None
+                      and nodes[_clean(i)].op == "Enter"), None)
+        if enter is None:
+            raise ValueError(f"Exit {n.name}: loop Merge has no Enter")
+        frame = enter.attrs.get("frame_name", "")
+        key = f"__tf_while__:{frame}"
+        if key not in layer_map:
+            by_consumer: Dict[str, list] = {}
+            for nd in nodes.values():
+                for i in nd.inputs:
+                    if not i.startswith("^"):
+                        by_consumer.setdefault(_clean(i), []).append(nd)
+
+            def consumers_of(name, op):
+                return [nd for nd in by_consumer.get(name, [])
+                        if nd.op == op]
+
+            enters = sorted(
+                (nd for nd in nodes.values() if nd.op == "Enter"
+                 and nd.attrs.get("frame_name", "") == frame),
+                key=lambda nd: nd.name)
+            carried, invariant = [], []
+            for e in enters:
+                merges = consumers_of(e.name, "Merge")
+                if not merges:
+                    invariant.append(e)  # loop-invariant capture
+                    continue
+                mg = merges[0]
+                nis = [nodes[_clean(i)] for i in mg.inputs
+                       if nodes.get(_clean(i)) is not None
+                       and nodes[_clean(i)].op == "NextIteration"]
+                sws = consumers_of(mg.name, "Switch")
+                if not nis or not sws:
+                    raise ValueError(
+                        f"while frame {frame!r}: variable {e.name} has "
+                        f"no NextIteration/Switch")
+                exits = consumers_of(sws[0].name, "Exit")
+                carried.append((e, mg, sws[0], nis[0], exits))
+            merge_names = [c[1].name for c in carried]
+            switch_names = [c[2].name for c in carried]
+            inv_names = [e.name for e in invariant]
+
+            def reachable_seeds(out_names, seed_names):
+                """Static walk from outputs to find which boundary
+                seeds a subgraph actually consumes, in stable seed
+                order (Graph rejects unconnected inputs)."""
+                seed_set, seen = set(seed_names), set()
+                stack = [_clean(o) for o in out_names]
+                while stack:
+                    nm = stack.pop()
+                    if nm in seen:
+                        continue
+                    seen.add(nm)
+                    if nm in seed_set:
+                        continue
+                    nd = nodes.get(nm)
+                    if nd is not None:
+                        stack.extend(_clean(i) for i in nd.inputs
+                                     if not i.startswith("^"))
+                return [s for s in seed_names if s in seen]
+
+            all_seeds = merge_names + switch_names + inv_names
+            cond_outs = [loopcond.inputs[0]]
+            body_outs = [c[3].inputs[0] for c in carried]
+            cond_in = reachable_seeds(cond_outs, all_seeds)
+            body_in = reachable_seeds(body_outs, all_seeds)
+            cond_model, _ = _build_graph(nodes, cond_in, cond_outs)
+            body_model, _ = _build_graph(nodes, body_in, body_outs)
+            nvars, ninv = len(carried), len(inv_names)
+
+            def run(*args):
+                inits, invs = args[:nvars], args[nvars:]
+
+                def env(carry):
+                    e = {}
+                    for i, c in enumerate(carry):
+                        e[merge_names[i]] = c
+                        e[switch_names[i]] = c
+                    for j, v in enumerate(invs):
+                        e[inv_names[j]] = v
+                    return e
+
+                def cond_fn(carry):
+                    p = cond_model.forward(
+                        *[env(carry)[nm] for nm in cond_in])
+                    return _jnp.reshape(_jnp.asarray(p).astype(bool), ())
+
+                def body_fn(carry):
+                    out = body_model.forward(
+                        *[env(carry)[nm] for nm in body_in])
+                    if not isinstance(out, (tuple, list)):
+                        out = (out,)
+                    return tuple(out)
+
+                import jax as _jax
+                return _jax.lax.while_loop(cond_fn, body_fn,
+                                           tuple(inits))
+
+            loop_mod = _Lambda(run, f"while:{frame}")
+            layer_map[f"while:{frame}"] = loop_mod
+            init_gns = [resolve(c[0].inputs[0]) for c in carried]
+            inv_gns = [resolve(e.inputs[0]) for e in invariant]
+            exit_idx = {ex.name: i for i, c in enumerate(carried)
+                        for ex in c[4]}
+            layer_map[key] = (node_of(loop_mod, *init_gns, *inv_gns),
+                              exit_idx)
+        loop_gn, exit_idx = layer_map[key]
+        if n.name not in exit_idx:
+            raise ValueError(
+                f"Exit {n.name}: not reachable from frame {frame!r}'s "
+                f"loop variables (unsupported multi-Switch frame "
+                f"layout?)")
+        sel = _Lambda(lambda *parts, p=exit_idx[n.name]: parts[p], n.name)
+        layer_map[n.name] = sel
+        return node_of(sel, loop_gn)
+
+    _TF_CONVERTERS["Exit"] = tf_exit
+    # frame plumbing that is only ever reached through tf_exit's
+    # subgraph seeding; direct passthrough keeps stray references sane
+    _TF_CONVERTERS["LoopCond"] = simple(lambda x: x)
+    _TF_CONVERTERS["Enter"] = simple(lambda x: x)
 
     def mirror_pad(n, nodes, const_of, resolve, node_of, layer_map):
         p = const_of(n.inputs[1])

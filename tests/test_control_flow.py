@@ -115,3 +115,111 @@ def test_tf_merge_rejects_loop_pattern():
     )
     with pytest.raises(ValueError, match="Switch/Merge"):
         load_tf_graph(gd, ["x"], ["out"])
+
+
+def test_tf_while_loop_import():
+    """A canonical TF-v1 while frame (Enter/Merge/LoopCond/Switch/
+    NextIteration/Exit, with a loop-invariant Enter) imports as one
+    lax.while_loop; each Exit selects its carry variable."""
+    from tests.test_tensorflow_interop import attr, const_node, graphdef, \
+        node
+    from bigdl_tpu.interop.protowire import BYTES
+    from bigdl_tpu.interop.tensorflow import load_tf_graph
+    fr = [attr("frame_name", [(2, BYTES, b"loop")])]
+    gd = graphdef(
+        node("x", "Placeholder"),
+        const_node("i0", np.asarray(0.0, np.float32)),
+        const_node("lim", np.asarray(5.0, np.float32)),
+        const_node("one", np.asarray(1.0, np.float32)),
+        const_node("two", np.asarray(2.0, np.float32)),
+        node("i_enter", "Enter", ["i0"], fr),
+        node("a_enter", "Enter", ["x"], fr),
+        node("lim_enter", "Enter", ["lim"], fr),  # invariant: no Merge
+        node("i_merge", "Merge", ["i_enter", "i_next"]),
+        node("a_merge", "Merge", ["a_enter", "a_next"]),
+        node("pred", "Less", ["i_merge", "lim_enter"]),
+        node("lc", "LoopCond", ["pred"]),
+        node("i_sw", "Switch", ["i_merge", "lc"]),
+        node("a_sw", "Switch", ["a_merge", "lc"]),
+        node("i_body", "Add", ["i_sw:1", "one"]),
+        node("a_body", "Mul", ["a_sw:1", "two"]),
+        node("i_next", "NextIteration", ["i_body"]),
+        node("a_next", "NextIteration", ["a_body"]),
+        node("i_exit", "Exit", ["i_sw"]),
+        node("a_exit", "Exit", ["a_sw"]),
+    )
+    model, layer_map = load_tf_graph(gd, ["x"], ["a_exit", "i_exit"])
+    a, i = model(jnp.asarray([1.5, -2.0]))
+    np.testing.assert_allclose(np.asarray(a), [1.5 * 32, -2.0 * 32])
+    np.testing.assert_allclose(np.asarray(i), 5.0)
+    assert "while:loop" in layer_map
+    # the imported loop must also be jittable end-to-end
+    import jax
+    out = jax.jit(lambda m, x: m.forward(x)[0])(model, jnp.asarray([2.0]))
+    np.testing.assert_allclose(np.asarray(out), [64.0])
+
+
+def test_tf_while_subgraph_build_does_not_override_outer_fusion():
+    """Regression: the re-entrant cond/body _build_graph used to re-run
+    the BiasAdd-fusion pre-pass on the SHARED node dict, marking a
+    MatMul+BiasAdd pair as fused even though the outer graph observes
+    the pre-bias MatMul output."""
+    from tests.test_tensorflow_interop import attr, const_node, graphdef, \
+        node
+    from bigdl_tpu.interop.protowire import BYTES
+    from bigdl_tpu.interop.tensorflow import load_tf_graph
+    fr = [attr("frame_name", [(2, BYTES, b"f2")])]
+    gd = graphdef(
+        node("x", "Placeholder"),
+        const_node("w", np.eye(2, dtype=np.float32)),
+        const_node("bias", np.asarray([10.0, 10.0], np.float32)),
+        node("mm", "MatMul", ["x", "w"]),
+        node("ba", "BiasAdd", ["mm", "bias"]),
+        const_node("i0", np.asarray(0.0, np.float32)),
+        const_node("lim", np.asarray(3.0, np.float32)),
+        const_node("one", np.asarray(1.0, np.float32)),
+        node("i_enter", "Enter", ["i0"], fr),
+        node("i_merge", "Merge", ["i_enter", "i_next"]),
+        node("pred", "Less", ["i_merge", "lim"]),
+        node("lc", "LoopCond", ["pred"]),
+        node("i_sw", "Switch", ["i_merge", "lc"]),
+        node("i_body", "Add", ["i_sw:1", "one"]),
+        node("i_next", "NextIteration", ["i_body"]),
+        node("i_exit", "Exit", ["i_sw"]),
+    )
+    # outer outputs observe BOTH mm (pre-bias) and ba (post-bias):
+    # the outer pre-pass must keep them distinct even after the loop's
+    # subgraph builds run their own pre-pass
+    model, _ = load_tf_graph(gd, ["x"], ["i_exit", "mm", "ba"])
+    i, mm, ba = model(jnp.asarray([[1.0, 2.0]]))
+    np.testing.assert_allclose(np.asarray(i), 3.0)
+    np.testing.assert_allclose(np.asarray(mm), [[1.0, 2.0]])
+    np.testing.assert_allclose(np.asarray(ba), [[11.0, 12.0]])
+
+
+def test_tf_while_variable_with_two_exits():
+    """One Switch legally feeding two Exit nodes: both must resolve to
+    the same carry variable (used to KeyError on the second)."""
+    from tests.test_tensorflow_interop import attr, const_node, graphdef, \
+        node
+    from bigdl_tpu.interop.protowire import BYTES
+    from bigdl_tpu.interop.tensorflow import load_tf_graph
+    fr = [attr("frame_name", [(2, BYTES, b"f3")])]
+    gd = graphdef(
+        node("x", "Placeholder"),
+        const_node("lim", np.asarray(4.0, np.float32)),
+        const_node("one", np.asarray(1.0, np.float32)),
+        node("i_enter", "Enter", ["x"], fr),
+        node("i_merge", "Merge", ["i_enter", "i_next"]),
+        node("pred", "Less", ["i_merge", "lim"]),
+        node("lc", "LoopCond", ["pred"]),
+        node("i_sw", "Switch", ["i_merge", "lc"]),
+        node("i_body", "Add", ["i_sw:1", "one"]),
+        node("i_next", "NextIteration", ["i_body"]),
+        node("exit_a", "Exit", ["i_sw"]),
+        node("exit_b", "Exit", ["i_sw"]),
+    )
+    model, _ = load_tf_graph(gd, ["x"], ["exit_a", "exit_b"])
+    a, b = model(jnp.asarray(0.0))
+    np.testing.assert_allclose(np.asarray(a), 4.0)
+    np.testing.assert_allclose(np.asarray(b), 4.0)
