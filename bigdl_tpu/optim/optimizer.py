@@ -314,8 +314,8 @@ class Optimizer:
     def _validate(self, model, eval_step) -> Dict[str, ValidationResult]:
         results: Optional[List[ValidationResult]] = None
         for batch in self.val_dataset.data(train=False):
-            stats = eval_step(model, jnp.asarray(batch.get_input()),
-                              jnp.asarray(batch.get_target()))
+            stats = eval_step(model, _stage(batch.get_input()),
+                              _stage(batch.get_target()))
             batch_results = [m.to_result(n, d)
                              for m, (n, d) in zip(self.val_methods, stats)]
             results = batch_results if results is None else [
@@ -558,11 +558,8 @@ class Optimizer:
                         jax.profiler.start_trace(self.profile_dir)
                         prof_active = True
                     it_start = time.time()
-                    x = jax.device_put(jnp.asarray(batch.get_input()),
-                                       x_sharding)
-                    y = jax.device_put(jnp.asarray(batch.get_target()),
-                                       x_sharding) \
-                        if batch.get_target() is not None else None
+                    x = _stage(batch.get_input(), x_sharding)
+                    y = _stage(batch.get_target(), x_sharding)
                     rng = jax.random.fold_in(seed_key, self.state["neval"])
                     t_data = time.time() - it_start
                     params_groups, rest, opt_states, loss = step(
@@ -701,6 +698,19 @@ class Optimizer:
 
 def _to_plain(tree):
     return jax.tree_util.tree_map(lambda x: np.asarray(x), tree)
+
+
+def _stage(value, sharding=None):
+    """Batch value (array, or any pytree of arrays — tuple/list/Table —
+    for multi-input models) → device arrays, optionally sharded."""
+    if value is None:
+        return None
+
+    def put(leaf):
+        arr = jnp.asarray(leaf)
+        return arr if sharding is None else jax.device_put(arr, sharding)
+
+    return jax.tree_util.tree_map(put, value)
 
 
 def _scheduled_lr(method, opt_state, epoch, steps_back: int = 0):

@@ -316,3 +316,36 @@ def test_decode_augment_uses_per_thread_rngs():
     assert len({id(a) for a in augs.values()}) == 3
     rngs = [a.stages[1].rng for a in augs.values()]  # RandomCrop rng
     assert len({id(r) for r in rngs}) == 3
+
+
+def test_treelstm_sexpr_parser():
+    from bigdl_tpu.examples.treelstm_sentiment import parse_sexpr
+    label, tokens, nodes = parse_sexpr(
+        "(3 (2 It) (4 (2 's) (4 good)))")
+    assert label == 3
+    assert tokens == ["It", "'s", "good"]
+    # post-order: leaf It, leaf 's, leaf good, ('s+good), root
+    assert nodes == [(-1, -1, 0), (-1, -1, 1), (-1, -1, 2),
+                     (1, 2, -1), (0, 3, -1)]
+
+
+def test_treelstm_main_synthetic():
+    from bigdl_tpu.examples.treelstm_sentiment import main
+    model = main(["--synthetic", "96", "-e", "1", "-q", "-b", "16",
+                  "--embedding-dim", "16", "--hidden-size", "16",
+                  "--max-nodes", "24", "--max-tokens", "16",
+                  "--vocab-size", "100"])
+    assert model is not None
+
+
+def test_treelstm_main_sst_files(tmp_path):
+    from bigdl_tpu.examples.treelstm_sentiment import main
+    lines = ["(3 (2 it) (4 (2 's) (4 good)))",
+             "(1 (2 it) (0 (2 's) (0 bad)))",
+             "(2 (2 a) (2 film))"] * 8
+    (tmp_path / "train.txt").write_text("\n".join(lines))
+    (tmp_path / "dev.txt").write_text("\n".join(lines[:6]))
+    model = main(["-f", str(tmp_path), "-e", "1", "-q", "-b", "8",
+                  "--embedding-dim", "8", "--hidden-size", "8",
+                  "--max-nodes", "8", "--max-tokens", "8"])
+    assert model is not None

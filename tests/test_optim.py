@@ -475,3 +475,49 @@ def test_checkpoint_remote_filesystem():
     back = load_pytree(path)
     np.testing.assert_array_equal(back["w"], tree["w"])
     assert back["meta"]["epoch"] == 3
+
+
+def test_optimizer_multi_input_model():
+    """Tuple (Table-activity) minibatch inputs must flow through the
+    jitted train step, sharded staging, and validation (regression:
+    jnp.asarray(tuple) raised on inhomogeneous shapes)."""
+    import numpy as np
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.core.module import Module
+    from bigdl_tpu.dataset.dataset import DataSet, MiniBatch
+    from bigdl_tpu.optim import Optimizer, Top1Accuracy, Trigger
+    from bigdl_tpu.optim.methods import SGD
+    from bigdl_tpu.utils import set_seed
+
+    set_seed(3)
+
+    class TwoTower(Module):
+        def __init__(self):
+            super().__init__()
+            self.a = nn.Linear(6, 8)
+            self.b = nn.Linear(3, 8)
+            self.head = nn.Linear(8, 4)
+            self.out = nn.LogSoftMax()
+
+        def forward(self, xs):
+            xa, xb = xs
+            h = jnp.tanh(self.a.forward(xa) + self.b.forward(xb))
+            return self.out.forward(self.head.forward(h))
+
+    rng = np.random.default_rng(0)
+    batches = []
+    for _ in range(4):
+        xa = rng.normal(size=(16, 6)).astype(np.float32)
+        xb = rng.normal(size=(16, 3)).astype(np.float32)
+        y = rng.integers(1, 5, size=(16,)).astype(np.int32)
+        batches.append(MiniBatch((xa, xb), y))
+    data = DataSet.array(batches)
+    opt = (Optimizer(TwoTower(), data, nn.ClassNLLCriterion())
+           .set_optim_method(SGD(0.2))
+           .set_end_when(Trigger.max_epoch(3))
+           .set_validation(Trigger.every_epoch(),
+                           DataSet.array(batches[:1], shuffle=False),
+                           [Top1Accuracy()]))
+    model = opt.optimize()
+    assert model is not None
+    assert np.isfinite(opt.state["loss"])
