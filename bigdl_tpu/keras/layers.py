@@ -13,6 +13,7 @@ from __future__ import annotations
 
 from typing import Optional, Sequence, Tuple
 
+import jax.numpy as jnp
 import numpy as np
 
 import bigdl_tpu.nn as nn
@@ -585,3 +586,482 @@ class Merge(KerasLayer):
             first = x[0] if isinstance(x, (tuple, list)) else x
             self.build(tuple(first.shape[1:]))
         return self.inner.forward(x)
+
+
+# --------------------------------------------------------------------------
+# 3-D / atrous / separable / locally-connected convolution family
+# --------------------------------------------------------------------------
+
+def _conv_out(size: int, k: int, s: int, same: bool) -> int:
+    return -(-size // s) if same else (size - k) // s + 1
+
+
+class Convolution3D(KerasLayer):
+    """NDHWC 3-D conv (≙ nn/keras/Convolution3D.scala; input_shape =
+    (dim1, dim2, dim3, channels))."""
+
+    def __init__(self, nb_filter: int, kernel_dim1: int, kernel_dim2: int,
+                 kernel_dim3: int, activation: Optional[str] = None,
+                 border_mode: str = "valid",
+                 subsample: Tuple[int, int, int] = (1, 1, 1),
+                 bias: bool = True,
+                 input_shape: Optional[Sequence[int]] = None):
+        super().__init__(input_shape)
+        if border_mode not in ("valid", "same"):
+            raise ValueError(f"unsupported border_mode {border_mode!r}")
+        self.nb_filter = nb_filter
+        self.kernel = (kernel_dim1, kernel_dim2, kernel_dim3)
+        self.activation = activation
+        self.same = border_mode == "same"
+        self.subsample = subsample
+        self.bias = bias
+
+    def build_layer(self, input_shape):
+        d, h, w, c = input_shape
+        k1, k2, k3 = self.kernel
+        s1, s2, s3 = self.subsample
+        pad = -1 if self.same else 0
+        conv = nn.VolumetricConvolution(
+            c, self.nb_filter, k1, k3, k2, s1, s3, s2,
+            pad_t=pad, pad_w=pad, pad_h=pad, with_bias=self.bias,
+            data_format="NDHWC")
+        act = _activation_module(self.activation)
+        mod = conv if act is None else nn.Sequential(conv, act)
+        out = (_conv_out(d, k1, s1, self.same),
+               _conv_out(h, k2, s2, self.same),
+               _conv_out(w, k3, s3, self.same), self.nb_filter)
+        return mod, out
+
+
+class _Pooling3D(KerasLayer):
+    pool_cls: type = None
+
+    def __init__(self, pool_size: Tuple[int, int, int] = (2, 2, 2),
+                 strides: Optional[Tuple[int, int, int]] = None,
+                 border_mode: str = "valid",
+                 input_shape: Optional[Sequence[int]] = None):
+        super().__init__(input_shape)
+        if border_mode != "valid":
+            raise ValueError("3D pooling supports border_mode='valid'")
+        self.pool_size = pool_size
+        self.strides = strides or pool_size
+
+    def build_layer(self, input_shape):
+        d, h, w, c = input_shape
+        k1, k2, k3 = self.pool_size
+        s1, s2, s3 = self.strides
+        pool = self.pool_cls(k1, k3, k2, s1, s3, s2)
+        out = ((d - k1) // s1 + 1, (h - k2) // s2 + 1,
+               (w - k3) // s3 + 1, c)
+        return pool, out
+
+
+class MaxPooling3D(_Pooling3D):
+    pool_cls = nn.VolumetricMaxPooling
+
+
+class AveragePooling3D(_Pooling3D):
+    pool_cls = nn.VolumetricAveragePooling
+
+
+class GlobalAveragePooling3D(KerasLayer):
+    def build_layer(self, input_shape):
+        return nn.GlobalAveragePooling3D(), (input_shape[-1],)
+
+
+class GlobalMaxPooling3D(KerasLayer):
+    def build_layer(self, input_shape):
+        return nn.GlobalMaxPooling3D(), (input_shape[-1],)
+
+
+class AtrousConvolution2D(KerasLayer):
+    """Dilated conv (≙ nn/keras/AtrousConvolution2D.scala), NHWC."""
+
+    def __init__(self, nb_filter: int, nb_row: int, nb_col: int,
+                 atrous_rate: Tuple[int, int] = (1, 1),
+                 activation: Optional[str] = None,
+                 subsample: Tuple[int, int] = (1, 1), bias: bool = True,
+                 input_shape: Optional[Sequence[int]] = None):
+        super().__init__(input_shape)
+        self.nb_filter, self.nb_row, self.nb_col = nb_filter, nb_row, nb_col
+        self.rate = atrous_rate
+        self.activation = activation
+        self.subsample = subsample
+        self.bias = bias
+
+    def build_layer(self, input_shape):
+        h, w, c = input_shape
+        conv = nn.SpatialDilatedConvolution(
+            c, self.nb_filter, self.nb_col, self.nb_row,
+            self.subsample[1], self.subsample[0], 0, 0,
+            self.rate[1], self.rate[0], data_format="NHWC",
+            with_bias=self.bias)
+        act = _activation_module(self.activation)
+        mod = conv if act is None else nn.Sequential(conv, act)
+        eff_r = (self.nb_row - 1) * self.rate[0] + 1
+        eff_c = (self.nb_col - 1) * self.rate[1] + 1
+        out = ((h - eff_r) // self.subsample[0] + 1,
+               (w - eff_c) // self.subsample[1] + 1, self.nb_filter)
+        return mod, out
+
+
+class AtrousConvolution1D(KerasLayer):
+    """Dilated temporal conv (≙ nn/keras/AtrousConvolution1D.scala):
+    lowered onto the 2-D dilated conv with a singleton width."""
+
+    def __init__(self, nb_filter: int, filter_length: int,
+                 atrous_rate: int = 1, activation: Optional[str] = None,
+                 subsample_length: int = 1, bias: bool = True,
+                 input_shape: Optional[Sequence[int]] = None):
+        super().__init__(input_shape)
+        self.nb_filter = nb_filter
+        self.filter_length = filter_length
+        self.rate = atrous_rate
+        self.activation = activation
+        self.subsample = subsample_length
+        self.bias = bias
+
+    def build_layer(self, input_shape):
+        steps, dim = input_shape
+        conv = nn.SpatialDilatedConvolution(
+            dim, self.nb_filter, 1, self.filter_length,
+            1, self.subsample, 0, 0, 1, self.rate,
+            data_format="NHWC", with_bias=self.bias)
+        inner = nn.Sequential(
+            nn.Reshape((steps, 1, dim)), conv)
+        eff = (self.filter_length - 1) * self.rate + 1
+        out_steps = (steps - eff) // self.subsample + 1
+        inner.add(nn.Reshape((out_steps, self.nb_filter)))
+        act = _activation_module(self.activation)
+        if act is not None:
+            inner.add(act)
+        return inner, (out_steps, self.nb_filter)
+
+
+class SeparableConvolution2D(KerasLayer):
+    """Depthwise-separable conv (≙ nn/keras/SeparableConvolution2D.scala)."""
+
+    def __init__(self, nb_filter: int, nb_row: int, nb_col: int,
+                 depth_multiplier: int = 1,
+                 activation: Optional[str] = None,
+                 border_mode: str = "valid",
+                 subsample: Tuple[int, int] = (1, 1), bias: bool = True,
+                 input_shape: Optional[Sequence[int]] = None):
+        super().__init__(input_shape)
+        if border_mode not in ("valid", "same"):
+            raise ValueError(f"unsupported border_mode {border_mode!r}")
+        self.nb_filter, self.nb_row, self.nb_col = nb_filter, nb_row, nb_col
+        self.depth_multiplier = depth_multiplier
+        self.activation = activation
+        self.same = border_mode == "same"
+        self.subsample = subsample
+        self.bias = bias
+
+    def build_layer(self, input_shape):
+        h, w, c = input_shape
+        pad = -1 if self.same else 0
+        conv = nn.SpatialSeparableConvolution(
+            c, self.nb_filter, self.depth_multiplier,
+            self.nb_col, self.nb_row, self.subsample[1],
+            self.subsample[0], pad, pad, has_bias=self.bias,
+            data_format="NHWC")
+        act = _activation_module(self.activation)
+        mod = conv if act is None else nn.Sequential(conv, act)
+        out = (_conv_out(h, self.nb_row, self.subsample[0], self.same),
+               _conv_out(w, self.nb_col, self.subsample[1], self.same),
+               self.nb_filter)
+        return mod, out
+
+
+class Deconvolution2D(KerasLayer):
+    """Transposed conv (≙ nn/keras/Deconvolution2D.scala), NHWC."""
+
+    def __init__(self, nb_filter: int, nb_row: int, nb_col: int,
+                 activation: Optional[str] = None,
+                 subsample: Tuple[int, int] = (1, 1), bias: bool = True,
+                 input_shape: Optional[Sequence[int]] = None):
+        super().__init__(input_shape)
+        self.nb_filter, self.nb_row, self.nb_col = nb_filter, nb_row, nb_col
+        self.activation = activation
+        self.subsample = subsample
+        self.bias = bias
+
+    def build_layer(self, input_shape):
+        h, w, c = input_shape
+        conv = nn.SpatialFullConvolution(
+            c, self.nb_filter, self.nb_col, self.nb_row,
+            self.subsample[1], self.subsample[0],
+            no_bias=not self.bias, data_format="NHWC")
+        act = _activation_module(self.activation)
+        mod = conv if act is None else nn.Sequential(conv, act)
+        out = ((h - 1) * self.subsample[0] + self.nb_row,
+               (w - 1) * self.subsample[1] + self.nb_col, self.nb_filter)
+        return mod, out
+
+
+class LocallyConnected1D(KerasLayer):
+    """(≙ nn/keras/LocallyConnected1D.scala)"""
+
+    def __init__(self, nb_filter: int, filter_length: int,
+                 activation: Optional[str] = None,
+                 subsample_length: int = 1, bias: bool = True,
+                 input_shape: Optional[Sequence[int]] = None):
+        super().__init__(input_shape)
+        self.nb_filter = nb_filter
+        self.filter_length = filter_length
+        self.activation = activation
+        self.subsample = subsample_length
+        self.bias = bias
+
+    def build_layer(self, input_shape):
+        steps, dim = input_shape
+        lc = nn.LocallyConnected1D(
+            steps, dim, self.nb_filter, self.filter_length,
+            self.subsample, with_bias=self.bias)
+        act = _activation_module(self.activation)
+        mod = lc if act is None else nn.Sequential(lc, act)
+        out_steps = (steps - self.filter_length) // self.subsample + 1
+        return mod, (out_steps, self.nb_filter)
+
+
+class LocallyConnected2D(KerasLayer):
+    """(≙ nn/keras/LocallyConnected2D.scala), NHWC."""
+
+    def __init__(self, nb_filter: int, nb_row: int, nb_col: int,
+                 activation: Optional[str] = None,
+                 subsample: Tuple[int, int] = (1, 1), bias: bool = True,
+                 input_shape: Optional[Sequence[int]] = None):
+        super().__init__(input_shape)
+        self.nb_filter, self.nb_row, self.nb_col = nb_filter, nb_row, nb_col
+        self.activation = activation
+        self.subsample = subsample
+        self.bias = bias
+
+    def build_layer(self, input_shape):
+        h, w, c = input_shape
+        lc = nn.LocallyConnected2D(
+            c, w, h, self.nb_filter, self.nb_col, self.nb_row,
+            self.subsample[1], self.subsample[0], with_bias=self.bias,
+            data_format="NHWC")
+        act = _activation_module(self.activation)
+        mod = lc if act is None else nn.Sequential(lc, act)
+        out = ((h - self.nb_row) // self.subsample[0] + 1,
+               (w - self.nb_col) // self.subsample[1] + 1, self.nb_filter)
+        return mod, out
+
+
+# --------------------------------------------------------------------------
+# cropping / padding / upsampling / dropout / misc
+# --------------------------------------------------------------------------
+
+class _JnpOp(Module):
+    """Private elementwise/jnp-backed helper for thin keras wrappers."""
+
+    def __init__(self, fn):
+        super().__init__()
+        self._fn = fn
+
+    def forward(self, x):
+        return self._fn(x)
+
+
+class Cropping1D(KerasLayer):
+    def __init__(self, cropping: Tuple[int, int] = (1, 1),
+                 input_shape: Optional[Sequence[int]] = None):
+        super().__init__(input_shape)
+        self.cropping = cropping
+
+    def build_layer(self, input_shape):
+        steps, dim = input_shape
+        l, r = self.cropping
+        mod = _JnpOp(lambda x: x[:, l:x.shape[1] - r, :])
+        return mod, (steps - l - r, dim)
+
+
+class Cropping2D(KerasLayer):
+    def __init__(self, cropping=((0, 0), (0, 0)),
+                 input_shape: Optional[Sequence[int]] = None):
+        super().__init__(input_shape)
+        self.cropping = cropping
+
+    def build_layer(self, input_shape):
+        h, w, c = input_shape
+        (t, b), (l, r) = self.cropping
+        return nn.Cropping2D((t, b), (l, r), data_format="NHWC"), \
+            (h - t - b, w - l - r, c)
+
+
+class Cropping3D(KerasLayer):
+    def __init__(self, cropping=((1, 1), (1, 1), (1, 1)),
+                 input_shape: Optional[Sequence[int]] = None):
+        super().__init__(input_shape)
+        self.cropping = cropping
+
+    def build_layer(self, input_shape):
+        d, h, w, c = input_shape
+        c1, c2, c3 = self.cropping
+        return nn.Cropping3D(c1, c2, c3, data_format="NDHWC"), \
+            (d - sum(c1), h - sum(c2), w - sum(c3), c)
+
+
+class ZeroPadding1D(KerasLayer):
+    def __init__(self, padding: int = 1,
+                 input_shape: Optional[Sequence[int]] = None):
+        super().__init__(input_shape)
+        self.padding = padding
+
+    def build_layer(self, input_shape):
+        steps, dim = input_shape
+        p = self.padding
+        mod = _JnpOp(lambda x: jnp.pad(x, ((0, 0), (p, p), (0, 0))))
+        return mod, (steps + 2 * p, dim)
+
+
+class ZeroPadding3D(KerasLayer):
+    def __init__(self, padding: Tuple[int, int, int] = (1, 1, 1),
+                 input_shape: Optional[Sequence[int]] = None):
+        super().__init__(input_shape)
+        self.padding = padding
+
+    def build_layer(self, input_shape):
+        d, h, w, c = input_shape
+        p1, p2, p3 = self.padding
+        mod = _JnpOp(lambda x: jnp.pad(
+            x, ((0, 0), (p1, p1), (p2, p2), (p3, p3), (0, 0))))
+        return mod, (d + 2 * p1, h + 2 * p2, w + 2 * p3, c)
+
+
+class UpSampling1D(KerasLayer):
+    def __init__(self, length: int = 2,
+                 input_shape: Optional[Sequence[int]] = None):
+        super().__init__(input_shape)
+        self.length = length
+
+    def build_layer(self, input_shape):
+        steps, dim = input_shape
+        return nn.UpSampling1D(self.length), (steps * self.length, dim)
+
+
+class UpSampling3D(KerasLayer):
+    def __init__(self, size: Tuple[int, int, int] = (2, 2, 2),
+                 input_shape: Optional[Sequence[int]] = None):
+        super().__init__(input_shape)
+        self.size = size
+
+    def build_layer(self, input_shape):
+        d, h, w, c = input_shape
+        s1, s2, s3 = self.size
+        return nn.UpSampling3D(self.size), (d * s1, h * s2, w * s3, c)
+
+
+class SpatialDropout1D(KerasLayer):
+    def __init__(self, p: float = 0.5,
+                 input_shape: Optional[Sequence[int]] = None):
+        super().__init__(input_shape)
+        self.p = p
+
+    def build_layer(self, input_shape):
+        return nn.SpatialDropout1D(self.p), input_shape
+
+
+class SpatialDropout3D(KerasLayer):
+    def __init__(self, p: float = 0.5,
+                 input_shape: Optional[Sequence[int]] = None):
+        super().__init__(input_shape)
+        self.p = p
+
+    def build_layer(self, input_shape):
+        return nn.SpatialDropout3D(self.p, data_format="NHWC"), \
+            input_shape
+
+
+class MaxoutDense(KerasLayer):
+    """(≙ nn/keras/MaxoutDense.scala over nn.Maxout)"""
+
+    def __init__(self, output_dim: int, nb_feature: int = 4,
+                 bias: bool = True,
+                 input_shape: Optional[Sequence[int]] = None):
+        super().__init__(input_shape)
+        self.output_dim = output_dim
+        self.nb_feature = nb_feature
+        self.bias = bias
+
+    def build_layer(self, input_shape):
+        return nn.Maxout(input_shape[-1], self.output_dim,
+                         self.nb_feature, with_bias=self.bias), \
+            (self.output_dim,)
+
+
+class SReLU(KerasLayer):
+    """(≙ nn/keras/SReLU.scala)"""
+
+    def build_layer(self, input_shape):
+        return nn.SReLU(input_shape), input_shape
+
+
+class SoftMax(KerasLayer):
+    """(≙ nn/keras/SoftMax.scala)"""
+
+    def build_layer(self, input_shape):
+        return nn.SoftMax(), input_shape
+
+
+class TimeDistributed(KerasLayer):
+    """Apply an inner keras layer to every time step
+    (≙ nn/keras/TimeDistributed.scala)."""
+
+    def __init__(self, layer: KerasLayer,
+                 input_shape: Optional[Sequence[int]] = None):
+        super().__init__(input_shape)
+        # plain-object slot: assigning a Module attribute would register
+        # the layer as a submodule HERE as well as inside the built
+        # nn.TimeDistributed, duplicating every parameter in the pytree
+        object.__setattr__(self, "_wrapped", layer)
+
+    def build_layer(self, input_shape):
+        step_shape = tuple(input_shape[1:])
+        out_step = self._wrapped.build(step_shape)
+        return nn.TimeDistributed(self._wrapped), \
+            (input_shape[0],) + tuple(out_step)
+
+
+class ConvLSTM2D(KerasLayer):
+    """Convolutional LSTM over [time, rows, cols, channels]
+    (≙ nn/keras/ConvLSTM2D.scala on nn.ConvLSTMPeephole).  Square
+    kernels only, SAME padding; returns the full sequence when
+    ``return_sequences`` else the last step."""
+
+    def __init__(self, nb_filter: int, nb_kernel: int,
+                 subsample: int = 1, return_sequences: bool = False,
+                 input_shape: Optional[Sequence[int]] = None):
+        super().__init__(input_shape)
+        self.nb_filter = nb_filter
+        self.nb_kernel = nb_kernel
+        self.subsample = subsample
+        self.return_sequences = return_sequences
+
+    def build_layer(self, input_shape):
+        t, h, w, c = input_shape
+        cell = nn.ConvLSTMPeephole(
+            c, self.nb_filter, self.nb_kernel, self.nb_kernel,
+            stride=self.subsample)
+        rec = nn.Recurrent(cell)
+        oh = -(-h // self.subsample)
+        ow = -(-w // self.subsample)
+        if self.return_sequences:
+            return rec, (t, oh, ow, self.nb_filter)
+        mod = nn.Sequential(rec, _JnpOp(lambda x: x[:, -1]))
+        return mod, (oh, ow, self.nb_filter)
+
+
+__all__ += [
+    "Convolution3D", "MaxPooling3D", "AveragePooling3D",
+    "GlobalAveragePooling3D", "GlobalMaxPooling3D",
+    "AtrousConvolution1D", "AtrousConvolution2D",
+    "SeparableConvolution2D", "Deconvolution2D",
+    "LocallyConnected1D", "LocallyConnected2D",
+    "Cropping1D", "Cropping2D", "Cropping3D",
+    "ZeroPadding1D", "ZeroPadding3D", "UpSampling1D", "UpSampling3D",
+    "SpatialDropout1D", "SpatialDropout3D", "MaxoutDense", "SReLU",
+    "SoftMax", "TimeDistributed", "ConvLSTM2D",
+]

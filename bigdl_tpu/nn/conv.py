@@ -129,19 +129,21 @@ class SpatialDilatedConvolution(Module):
                  pad_w: int = 0, pad_h: int = 0,
                  dilation_w: int = 1, dilation_h: int = 1,
                  w_regularizer=None, b_regularizer=None,
-                 data_format: str = "NHWC"):
+                 data_format: str = "NHWC", with_bias: bool = True):
         super().__init__()
         self.stride = (dh, dw)
         self.pad = (pad_h, pad_w)
         self.dilation = (dilation_h, dilation_w)
         self.data_format = data_format
+        self.with_bias = with_bias
         fan_in = n_input_plane * kh * kw
         bound = 1.0 / math.sqrt(fan_in)
         self.weight = Parameter(jax.random.uniform(
             next_key(), (kh, kw, n_input_plane, n_output_plane),
             minval=-bound, maxval=bound))
-        self.bias = Parameter(jax.random.uniform(
-            next_key(), (n_output_plane,), minval=-bound, maxval=bound))
+        if with_bias:
+            self.bias = Parameter(jax.random.uniform(
+                next_key(), (n_output_plane,), minval=-bound, maxval=bound))
 
     def forward(self, x):
         x = _to_nhwc(x, self.data_format)
@@ -151,7 +153,8 @@ class SpatialDilatedConvolution(Module):
             padding=_pad_spec(*self.pad),
             rhs_dilation=self.dilation,
             dimension_numbers=("NHWC", "HWIO", "NHWC"))
-        y = y + self.bias
+        if self.with_bias:
+            y = y + self.bias
         return _from_nhwc(y, self.data_format)
 
 
@@ -430,10 +433,11 @@ class LocallyConnected1D(Module):
                  output_frame_size: int, kernel_w: int, stride_w: int = 1,
                  propagate_back: bool = True,
                  w_regularizer=None, b_regularizer=None,
-                 init_weight=None, init_bias=None):
+                 init_weight=None, init_bias=None, with_bias: bool = True):
         super().__init__()
         self.kernel_w = kernel_w
         self.stride_w = stride_w
+        self.with_bias = with_bias
         n_out_frame = (n_input_frame - kernel_w) // stride_w + 1
         self.n_output_frame = n_out_frame
         fan_in = kernel_w * input_frame_size
@@ -445,11 +449,12 @@ class LocallyConnected1D(Module):
                 next_key(),
                 (n_out_frame, output_frame_size, kernel_w,
                  input_frame_size), minval=-bound, maxval=bound))
-        self.bias = Parameter(
-            init_bias if init_bias is not None
-            else jax.random.uniform(next_key(),
-                                    (n_out_frame, output_frame_size),
-                                    minval=-bound, maxval=bound))
+        if with_bias:
+            self.bias = Parameter(
+                init_bias if init_bias is not None
+                else jax.random.uniform(next_key(),
+                                        (n_out_frame, output_frame_size),
+                                        minval=-bound, maxval=bound))
 
     def forward(self, x):
         # x: (B, T, in) → windows (B, n_out, kw, in)
@@ -457,7 +462,7 @@ class LocallyConnected1D(Module):
                + jnp.arange(self.kernel_w)[None, :])
         win = x[:, idx]                      # (B, n_out, kw, in)
         y = jnp.einsum("bokc,olkc->bol", win, self.weight)
-        return y + self.bias
+        return y + self.bias if self.with_bias else y
 
 
 class SpatialConvolutionMap(Module):

@@ -24,7 +24,8 @@ __all__ = [
     "SpatialMaxPooling", "SpatialAveragePooling", "TemporalMaxPooling",
     "VolumetricMaxPooling", "VolumetricAveragePooling",
     "UpSampling1D", "UpSampling2D", "UpSampling3D", "ResizeBilinear",
-    "GlobalAveragePooling2D",
+    "GlobalAveragePooling2D", "GlobalAveragePooling3D",
+    "GlobalMaxPooling3D",
 ]
 
 
@@ -159,6 +160,22 @@ class GlobalAveragePooling2D(SpatialAveragePooling):
         if self.data_format == "NHWC":
             return y[:, 0, 0, :]
         return y[:, :, 0, 0]
+
+
+class GlobalAveragePooling3D(Module):
+    """Global average over the three spatial dims of NDHWC
+    (keras GlobalAveragePooling3D; reduces to [batch, channels])."""
+
+    def forward(self, x):
+        return jnp.mean(x, axis=(1, 2, 3))
+
+
+class GlobalMaxPooling3D(Module):
+    """Global max over the three spatial dims of NDHWC
+    (keras GlobalMaxPooling3D)."""
+
+    def forward(self, x):
+        return jnp.max(x, axis=(1, 2, 3))
 
 
 class TemporalMaxPooling(Module):

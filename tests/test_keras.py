@@ -4,9 +4,11 @@
 import numpy as np
 import pytest
 
+import jax
 import jax.numpy as jnp
 
 from bigdl_tpu import keras
+from bigdl_tpu import keras as kl
 from bigdl_tpu.utils import set_seed
 
 
@@ -399,3 +401,79 @@ def test_th_ordering_rejected_for_global_pool():
             "batch_input_shape": [None, 3, 5, 6]}}]}
     with pytest.raises(ValueError, match="th"):
         load_keras_json(spec)
+
+
+@pytest.mark.parametrize("layer_fn,in_shape", [
+    (lambda: kl.Convolution3D(4, 2, 2, 2), (5, 6, 7, 3)),
+    (lambda: kl.Convolution3D(4, 2, 2, 2, border_mode="same",
+                              subsample=(2, 2, 2)), (5, 6, 7, 3)),
+    (lambda: kl.MaxPooling3D(), (4, 6, 8, 3)),
+    (lambda: kl.AveragePooling3D((2, 2, 2), (1, 2, 2)), (4, 6, 8, 3)),
+    (lambda: kl.GlobalAveragePooling3D(), (4, 6, 8, 3)),
+    (lambda: kl.GlobalMaxPooling3D(), (4, 6, 8, 3)),
+    (lambda: kl.AtrousConvolution2D(4, 3, 3, atrous_rate=(2, 2)),
+     (9, 9, 2)),
+    (lambda: kl.AtrousConvolution1D(4, 3, atrous_rate=2), (10, 5)),
+    (lambda: kl.SeparableConvolution2D(6, 3, 3, depth_multiplier=2),
+     (8, 8, 3)),
+    (lambda: kl.Deconvolution2D(4, 3, 3, subsample=(2, 2)), (5, 5, 3)),
+    (lambda: kl.LocallyConnected1D(4, 3), (8, 5)),
+    (lambda: kl.LocallyConnected2D(4, 3, 3), (6, 7, 2)),
+    (lambda: kl.Cropping1D((1, 2)), (8, 3)),
+    (lambda: kl.Cropping2D(((1, 1), (2, 0))), (6, 8, 3)),
+    (lambda: kl.Cropping3D(), (6, 6, 6, 2)),
+    (lambda: kl.ZeroPadding1D(2), (5, 3)),
+    (lambda: kl.ZeroPadding3D((1, 2, 3)), (4, 4, 4, 2)),
+    (lambda: kl.UpSampling1D(3), (4, 2)),
+    (lambda: kl.UpSampling3D((2, 1, 2)), (3, 4, 5, 2)),
+    (lambda: kl.MaxoutDense(6, nb_feature=3), (10,)),
+    (lambda: kl.SReLU(), (7,)),
+    (lambda: kl.SoftMax(), (9,)),
+    (lambda: kl.TimeDistributed(kl.Dense(4)), (5, 7)),
+    (lambda: kl.ConvLSTM2D(4, 3), (3, 6, 6, 2)),
+    (lambda: kl.ConvLSTM2D(4, 3, return_sequences=True), (3, 6, 6, 2)),
+])
+def test_keras_wrapper_shape_contract(layer_fn, in_shape):
+    """Every wrapper's inferred output shape must match the shape the
+    built module actually produces (batch excluded), and the forward
+    must be finite."""
+    from bigdl_tpu.core.module import forward_context
+    layer = layer_fn()
+    out_shape = layer.build(in_shape)
+    x = jnp.asarray(np.random.default_rng(0).normal(
+        size=(2,) + tuple(in_shape)).astype(np.float32))
+    with forward_context(rng=jax.random.key(0)):
+        y = layer.forward(x)
+    assert tuple(y.shape) == (2,) + tuple(out_shape), \
+        f"inferred {out_shape}, got {y.shape[1:]}"
+    assert bool(jnp.all(jnp.isfinite(y)))
+
+
+def test_keras_bias_flag_respected():
+    """bias=False must remove the bias parameter, not silently keep it
+    (regression: atrous/locally-connected wrappers ignored the flag)."""
+    for layer, shape in [
+        (kl.AtrousConvolution2D(4, 3, 3, bias=False), (9, 9, 2)),
+        (kl.AtrousConvolution1D(4, 3, bias=False), (10, 5)),
+        (kl.LocallyConnected1D(4, 3, bias=False), (8, 5)),
+        (kl.Deconvolution2D(4, 3, 3, bias=False), (5, 5, 3)),
+        (kl.SeparableConvolution2D(6, 3, 3, bias=False), (8, 8, 3)),
+    ]:
+        layer.build(shape)
+        from bigdl_tpu.core.module import partition
+        paths = [jax.tree_util.keystr(kp) for kp, _ in
+                 jax.tree_util.tree_leaves_with_path(partition(layer)[0])]
+        assert not any("bias" in p_ for p_ in paths), \
+            f"{type(layer).__name__}: {paths}"
+
+
+def test_keras_time_distributed_single_registration():
+    """TimeDistributed must register the wrapped layer once (regression:
+    it appeared as both self.layer and inside nn.TimeDistributed,
+    duplicating every parameter leaf)."""
+    layer = kl.TimeDistributed(kl.Dense(4))
+    layer.build((5, 7))
+    from bigdl_tpu.core.module import partition
+    leaves = jax.tree_util.tree_leaves(partition(layer)[0])
+    assert len(leaves) == 2, len(leaves)  # weight + bias only
+    assert layer.n_parameters() == 7 * 4 + 4
