@@ -1,8 +1,11 @@
-"""PTB word-level LSTM language model main (reference
-example/languagemodel/PTBWordLM.scala).
+"""PTB word-level language model main (reference
+example/languagemodel/PTBWordLM.scala; ``--model transformer`` swaps the
+LSTM for the decoder-only Transformer LM, the reference
+nn/Transformer.scala LanguageModel configuration).
 
     bigdl-tpu-ptb -f /data/ptb -b 32 -e 13          # real Penn Treebank
     bigdl-tpu-ptb --synthetic 40000 -e 2            # Markov-chain corpus
+    bigdl-tpu-ptb --synthetic 40000 --model transformer --remat
 """
 
 from __future__ import annotations
@@ -18,6 +21,12 @@ def main(argv=None):
     p.add_argument("--hidden-size", type=int, default=200)
     p.add_argument("--num-layers", type=int, default=2)
     p.add_argument("--num-steps", type=int, default=20)
+    p.add_argument("--model", default="lstm",
+                   choices=["lstm", "transformer"])
+    p.add_argument("--num-heads", type=int, default=4,
+                   help="attention heads (transformer)")
+    p.add_argument("--remat", action="store_true",
+                   help="rematerialize transformer blocks (saves HBM)")
     p.set_defaults(batch_size=32, learning_rate=1.0, max_epoch=13)
     args = p.parse_args(argv)
     train_summary, val_summary = setup(args, "ptb")
@@ -52,10 +61,23 @@ def main(argv=None):
         data = data.cache_on_device()
     val_data = to_dataset(valid_ids, shuffle=False)
 
-    model = PTBModel(input_size=vocab + 1,
-                     hidden_size=args.hidden_size,
-                     output_size=vocab + 1,
-                     num_layers=args.num_layers)
+    if args.model == "transformer":
+        from bigdl_tpu.models import transformer_lm
+        lm = transformer_lm(vocab_size=vocab,
+                            hidden_size=args.hidden_size,
+                            num_layers=args.num_layers,
+                            num_heads=args.num_heads,
+                            filter_size=4 * args.hidden_size,
+                            max_len=args.num_steps,
+                            remat=args.remat)
+        # logits -> per-step log-probs, matching the LSTM head so the
+        # same TimeDistributedCriterion drives both models
+        model = nn.Sequential(lm, nn.LogSoftMax())
+    else:
+        model = PTBModel(input_size=vocab + 1,
+                         hidden_size=args.hidden_size,
+                         output_size=vocab + 1,
+                         num_layers=args.num_layers)
     criterion = nn.TimeDistributedCriterion(
         nn.ClassNLLCriterion(), size_average=False, dimension=2)
     opt = (Optimizer(model, data, criterion)

@@ -1,0 +1,99 @@
+"""Decoder-only Transformer language model.
+
+The reference's Transformer (nn/Transformer.scala:749, `TranslationModel`
+/ `LanguageModel` modes) covers encoder-decoder and LM configurations;
+this is the LM configuration as a standalone model family, built from
+the same attention stack (nn/attention.py) plus:
+
+* weight-tied embedding/output head (standard LM practice; the
+  reference ties via `embeddingSharedWeights`),
+* `jax.checkpoint` (rematerialization) per block when
+  ``remat=True`` — trades recompute for activation memory so long
+  sequences fit HBM,
+* a causal+padding additive bias built once per batch.
+
+TPU notes: the per-block compute is three dense matmuls + attention —
+all MXU work; under a mesh, `parallel.tensor_parallel_rules
+(column=[".*q_layer.*|.*k_layer.*|.*v_layer.*|.*filter_layer.*"],
+row=[".*output_layer.*|.*out_layer.*"])` gives Megatron-style TP, and
+`parallel.ring_attention` substitutes for in-block attention when the
+sequence axis is sharded.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.core.module import Module, ModuleList, Parameter
+from bigdl_tpu.nn.attention import (TransformerDecoderLayer, causal_bias,
+                                    padding_bias, position_encoding)
+from bigdl_tpu.nn.linear import LookupTable
+from bigdl_tpu.nn.normalization import LayerNormalization
+
+__all__ = ["TransformerLM", "transformer_lm"]
+
+
+class TransformerLM(Module):
+    """``forward(tokens [B,T] int, 1-based; 0 = padding) → logits
+    [B, T, vocab+1]`` (index 0 of the logit axis is the padding id and
+    is never a target)."""
+
+    def __init__(self, vocab_size: int, hidden_size: int = 256,
+                 num_layers: int = 4, num_heads: int = 4,
+                 filter_size: int = 1024, max_len: int = 512,
+                 dropout: float = 0.0, remat: bool = False):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self.max_len = max_len
+        self.remat = remat
+        self.embedding = LookupTable(vocab_size + 1, hidden_size)
+        # N(0, 1/H) init (reference embeddingSharedWeights / T2T): with
+        # the weight-tied head, unit-std embeddings would give init
+        # logits of std sqrt(H) and a start loss far above ln(vocab)
+        self.embedding.weight = Parameter(
+            self.embedding.weight * hidden_size ** -0.5)
+        self.blocks = ModuleList([
+            TransformerDecoderLayer(hidden_size, num_heads, filter_size,
+                                    attention_dropout=dropout,
+                                    ffn_dropout=dropout,
+                                    with_cross_attention=False)
+            for _ in range(num_layers)])
+        self.final_norm = LayerNormalization(hidden_size)
+
+    def forward(self, tokens):
+        B, T = tokens.shape
+        if T > self.max_len:
+            raise ValueError(
+                f"sequence length {T} exceeds max_len={self.max_len}")
+        # 0 is padding; clamp for the gather, bias masks it out of loss
+        x = self.embedding.forward(jnp.maximum(tokens, 1))
+        x = x * (self.hidden_size ** 0.5)
+        x = x + position_encoding(T, self.hidden_size, dtype=x.dtype)
+        bias = causal_bias(T, dtype=x.dtype) \
+            + padding_bias(tokens).astype(x.dtype)
+
+        for blk in self.blocks:
+            if self.remat:
+                # recompute the block in backward instead of storing its
+                # activations (jax.checkpoint); module buffers are not
+                # mutated in these blocks so the functional wrap is safe
+                def run(blk_, x_, bias_):
+                    return blk_.forward(x_, self_bias=bias_)
+                x = jax.checkpoint(run)(blk, x, bias)
+            else:
+                x = blk.forward(x, self_bias=bias)
+        x = self.final_norm(x)
+        # weight-tied output head: logits against the embedding matrix
+        emb = self.embedding.weight            # [vocab+1, H]
+        return jnp.einsum("bth,vh->btv", x, emb)
+
+
+def transformer_lm(vocab_size: int, hidden_size: int = 256,
+                   num_layers: int = 4, num_heads: int = 4,
+                   filter_size: int = 1024, max_len: int = 512,
+                   dropout: float = 0.0, remat: bool = False) \
+        -> TransformerLM:
+    """Factory mirroring the models/* builder convention."""
+    return TransformerLM(vocab_size, hidden_size, num_layers, num_heads,
+                         filter_size, max_len, dropout, remat)

@@ -349,3 +349,12 @@ def test_treelstm_main_sst_files(tmp_path):
                   "--embedding-dim", "8", "--hidden-size", "8",
                   "--max-nodes", "8", "--max-tokens", "8"])
     assert model is not None
+
+
+def test_ptb_main_transformer():
+    from bigdl_tpu.examples.ptb_lm import main
+    model = main(["--synthetic", "2000", "-e", "1", "-q", "-b", "8",
+                  "--model", "transformer", "--remat",
+                  "--hidden-size", "16", "--num-steps", "8",
+                  "--num-heads", "2", "--vocab-size", "50"])
+    assert model is not None
