@@ -26,8 +26,10 @@ import jax
 import jax.numpy as jnp
 
 from bigdl_tpu.core.module import Module, ModuleList, Parameter
-from bigdl_tpu.nn.attention import (TransformerDecoderLayer, causal_bias,
-                                    padding_bias, position_encoding)
+from bigdl_tpu.nn.attention import (SequenceBeamSearch,
+                                    TransformerDecoderLayer, causal_bias,
+                                    incremental_bias, padding_bias,
+                                    position_encoding)
 from bigdl_tpu.nn.linear import LookupTable
 from bigdl_tpu.nn.normalization import LayerNormalization
 
@@ -87,6 +89,132 @@ class TransformerLM(Module):
         # weight-tied output head: logits against the embedding matrix
         emb = self.embedding.weight            # [vocab+1, H]
         return jnp.einsum("bth,vh->btv", x, emb)
+
+
+    # ---- incremental decoding (KV cache) -------------------------------
+
+    def init_cache(self, batch: int, dtype=jnp.float32):
+        """Per-block KV caches sized to ``max_len``, plus the per-slot
+        padding flags the full forward expresses via padding_bias (one
+        pytree, so everything flows through scan/while_loop and beam
+        gathering together)."""
+        return {
+            "layers": [{"self": blk.self_attn.init_cache(
+                batch, self.max_len, dtype)} for blk in self.blocks],
+            "pad": jnp.zeros((batch, self.max_len), bool),
+        }
+
+    def decode_step(self, tokens, index, caches, with_logits=True):
+        """One token step: ``tokens [B, 1]`` at position ``index`` →
+        (logits [B, vocab+1], new caches).  Equivalent to column
+        ``index`` of the full forward incl. its padding mask (tested),
+        at O(T) cost instead of O(T^2).  ``with_logits=False`` skips
+        the vocab projection (prefill)."""
+        pad = jax.lax.dynamic_update_slice(
+            caches["pad"], tokens == 0, (0, index))
+        x = self.embedding.forward(jnp.maximum(tokens, 1))
+        x = x * (self.hidden_size ** 0.5)
+        pos = jax.lax.dynamic_slice_in_dim(
+            position_encoding(self.max_len, self.hidden_size,
+                              dtype=x.dtype), index, 1, axis=0)
+        x = x + pos[None]
+        bias = incremental_bias(self.max_len, index, pad, x.dtype)
+        new_layers = []
+        for blk, cache in zip(self.blocks, caches["layers"]):
+            x, nc = blk.forward(x, self_bias=bias, cache=cache,
+                                cache_index=index)
+            new_layers.append(nc)
+        new_caches = {"layers": new_layers, "pad": pad}
+        if not with_logits:
+            return None, new_caches
+        x = self.final_norm(x)
+        logits = jnp.einsum("bth,vh->btv", x, self.embedding.weight)
+        return logits[:, 0], new_caches
+
+    def _prefill(self, prompt, caches):
+        """Feed prompt[:, :-1] into the caches without computing any
+        vocab projections; the last prompt token is fed by the first
+        decode step instead."""
+        Tp = prompt.shape[1]
+        if Tp == 1:
+            return caches
+
+        def prompt_step(caches, t):
+            tok = jax.lax.dynamic_slice_in_dim(prompt, t, 1, axis=1)
+            _, caches = self.decode_step(tok, t, caches,
+                                         with_logits=False)
+            return caches, None
+
+        caches, _ = jax.lax.scan(prompt_step, caches, jnp.arange(Tp - 1))
+        return caches
+
+    @staticmethod
+    def _mask_padding_logit(logits):
+        """Logit index 0 is the padding id and never a target, so its
+        tied-head row is untrained noise — it must not win argmax/top_k."""
+        neg = jnp.asarray(-1e9, logits.dtype)
+        return logits.at[..., 0].set(neg)
+
+    def generate(self, prompt, max_new_tokens: int, eos_id=None):
+        """Greedy continuation: ``prompt [B, Tp]`` →
+        ``[B, Tp + max_new_tokens]``; positions after ``eos_id`` (when
+        given) are padded with 0."""
+        B, Tp = prompt.shape
+        if Tp + max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt {Tp} + {max_new_tokens} new tokens exceeds "
+                f"max_len={self.max_len}")
+        prompt = jnp.asarray(prompt, jnp.int32)
+        caches = self._prefill(prompt, self.init_cache(B))
+
+        def gen_step(carry, t):
+            tok, caches, done = carry
+            logits, caches = self.decode_step(tok, t, caches)
+            nxt = jnp.argmax(self._mask_padding_logit(logits),
+                             axis=-1).astype(jnp.int32)
+            nxt = jnp.where(done, 0, nxt)
+            if eos_id is not None:
+                done = done | (nxt == eos_id)
+            return (nxt[:, None], caches, done), nxt
+
+        done0 = jnp.zeros((B,), bool)
+        (_, _, _), toks = jax.lax.scan(
+            gen_step, (prompt[:, -1:], caches, done0),
+            Tp - 1 + jnp.arange(max_new_tokens))
+        return jnp.concatenate([prompt, toks.T], axis=1)
+
+    def generate_beam(self, prompt, beam_size: int = 4,
+                      max_new_tokens: int = 20, eos_id: int = -1,
+                      alpha: float = 0.6):
+        """Length-normalized beam search continuation via
+        nn.SequenceBeamSearch; returns (sequences [B, beam, T_new],
+        scores [B, beam]).  ``eos_id=-1`` (no EOS) decodes to the full
+        budget."""
+        B, Tp = prompt.shape
+        if Tp + max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt {Tp} + {max_new_tokens} new tokens exceeds "
+                f"max_len={self.max_len}")
+        prompt = jnp.asarray(prompt, jnp.int32)
+        caches = self._prefill(prompt, self.init_cache(B))
+        # the search feeds a zero "start" id at step 0; carry the true
+        # last prompt token inside the cache pytree so it rides the
+        # per-beam replication/gathering
+        cache = dict(caches, tok0=prompt[:, -1:])
+        vocab = self.embedding.weight.shape[0]
+        search = SequenceBeamSearch(vocab, beam_size, alpha,
+                                    max_new_tokens, eos_id)
+
+        def logits_fn(ids, i, cache):
+            tok = jnp.where(i == 0, cache["tok0"], ids.astype(jnp.int32))
+            logits, sub = self.decode_step(
+                tok, Tp - 1 + i,
+                {"layers": cache["layers"], "pad": cache["pad"]})
+            return self._mask_padding_logit(logits), dict(
+                sub, tok0=cache["tok0"])
+
+        search.set_logit_fn(logits_fn)
+        return search.search(B, cache)
 
 
 def transformer_lm(vocab_size: int, hidden_size: int = 256,

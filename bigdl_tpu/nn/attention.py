@@ -33,7 +33,8 @@ from bigdl_tpu.ops.attention_kernels import xla_attention, _NEG_INF
 __all__ = [
     "Attention", "FeedForwardNetwork", "TransformerEncoderLayer",
     "TransformerDecoderLayer", "Transformer", "SequenceBeamSearch",
-    "position_encoding", "padding_bias", "causal_bias", "shift_right_3d",
+    "position_encoding", "padding_bias", "causal_bias",
+    "incremental_bias", "shift_right_3d",
 ]
 
 
@@ -72,6 +73,22 @@ def causal_bias(length: int, dtype=jnp.float32):
     TransformerOperation.attentionBiasLowerTriangle:156)."""
     mask = jnp.tril(jnp.ones((length, length), bool))
     return jnp.where(mask, 0.0, _NEG_INF).astype(dtype)[None, None]
+
+
+def incremental_bias(max_len: int, index, pad=None, dtype=jnp.float32):
+    """Additive attention bias over a fixed-size KV cache for one decode
+    step at position ``index``: slots beyond ``index`` (not yet written)
+    are masked, and so are per-batch padding slots when ``pad``
+    ([B, max_len] bool) is given.  Returns [1,1,1,max_len] (no pad) or
+    [B,1,1,max_len].  Shared by every incremental decoder so the
+    cache-masking logic has one home."""
+    invalid = jnp.arange(max_len) > index
+    if pad is not None:
+        invalid = invalid[None, :] | pad
+        return jnp.where(invalid, _NEG_INF, 0.0).astype(dtype)[
+            :, None, None, :]
+    return jnp.where(invalid, _NEG_INF, 0.0).astype(dtype)[
+        None, None, None, :]
 
 
 def shift_right_3d(x):
@@ -401,10 +418,7 @@ class Transformer(Module):
         max_len = cache[0]["self"]["k"].shape[2]
         pos = position_encoding(max_len, self.hidden_size, dtype=emb.dtype)
         x = emb + jax.lax.dynamic_slice_in_dim(pos, step, 1, axis=0)[None]
-        # bias over the cache: positions > step are invalid
-        valid = jnp.arange(max_len) <= step
-        self_bias = jnp.where(valid, 0.0, _NEG_INF).astype(
-            jnp.float32)[None, None, None, :]
+        self_bias = incremental_bias(max_len, step)
         new_cache = []
         for layer, layer_cache in zip(self.decoder_layers, cache):
             x, lc = layer(x, self_bias, enc_out, enc_bias,
