@@ -49,6 +49,7 @@ class TransformerLM(Module):
         self.hidden_size = hidden_size
         self.max_len = max_len
         self.remat = remat
+        self.seq_parallel = False
         self.embedding = LookupTable(vocab_size + 1, hidden_size)
         # N(0, 1/H) init (reference embeddingSharedWeights / T2T): with
         # the weight-tied head, unit-std embeddings would give init
@@ -63,6 +64,28 @@ class TransformerLM(Module):
             for _ in range(num_layers)])
         self.final_norm = LayerNormalization(hidden_size)
 
+    def set_sequence_parallel(self, mesh, axis: str = "seq") \
+            -> "TransformerLM":
+        """Run every block's self-attention through ring attention over
+        ``mesh[axis]`` (sequence/context parallelism — contexts longer
+        than one chip's HBM; see parallel/ring_attention.py).  The
+        projection weights are SHARED with the existing Attention
+        modules, so this toggles execution strategy, not parameters.
+        The ring applies the causal mask itself; padded batches are not
+        supported on this path (contiguous LM batching has none)."""
+        from bigdl_tpu.parallel.ring_attention import RingSelfAttention
+        for blk in self.blocks:
+            if isinstance(blk.self_attn, RingSelfAttention):
+                # reconfiguration: update in place, never keep a stale
+                # mesh/axis from an earlier call
+                blk.self_attn.mesh = mesh
+                blk.self_attn.seq_axis = axis
+            else:
+                blk.self_attn = RingSelfAttention.from_attention(
+                    blk.self_attn, mesh, axis, causal=True)
+        self.seq_parallel = True
+        return self
+
     def forward(self, tokens):
         B, T = tokens.shape
         if T > self.max_len:
@@ -72,8 +95,13 @@ class TransformerLM(Module):
         x = self.embedding.forward(jnp.maximum(tokens, 1))
         x = x * (self.hidden_size ** 0.5)
         x = x + position_encoding(T, self.hidden_size, dtype=x.dtype)
-        bias = causal_bias(T, dtype=x.dtype) \
-            + padding_bias(tokens).astype(x.dtype)
+        if self.seq_parallel:
+            # ring attention applies causality per block pair; an
+            # additive bias would defeat its O(T/n) memory (docstring)
+            bias = None
+        else:
+            bias = causal_bias(T, dtype=x.dtype) \
+                + padding_bias(tokens).astype(x.dtype)
 
         for blk in self.blocks:
             if self.remat:
@@ -132,21 +160,37 @@ class TransformerLM(Module):
         return logits[:, 0], new_caches
 
     def _prefill(self, prompt, caches):
-        """Feed prompt[:, :-1] into the caches without computing any
-        vocab projections; the last prompt token is fed by the first
-        decode step instead."""
+        """Write prompt[:, :-1]'s per-layer K/V into the caches with ONE
+        dense forward over the whole prompt (parallel over T, MXU-
+        friendly) rather than Tp sequential decode steps; the last
+        prompt token is fed by the first decode step instead."""
         Tp = prompt.shape[1]
         if Tp == 1:
             return caches
-
-        def prompt_step(caches, t):
-            tok = jax.lax.dynamic_slice_in_dim(prompt, t, 1, axis=1)
-            _, caches = self.decode_step(tok, t, caches,
-                                         with_logits=False)
-            return caches, None
-
-        caches, _ = jax.lax.scan(prompt_step, caches, jnp.arange(Tp - 1))
-        return caches
+        ptoks = prompt[:, :-1]
+        T = Tp - 1
+        pad_cols = jax.lax.dynamic_update_slice(
+            caches["pad"], ptoks == 0, (0, 0))
+        x = self.embedding.forward(jnp.maximum(ptoks, 1))
+        x = x * (self.hidden_size ** 0.5)
+        x = x + position_encoding(T, self.hidden_size, dtype=x.dtype)
+        bias = causal_bias(T, dtype=x.dtype) \
+            + padding_bias(ptoks).astype(x.dtype)
+        new_layers = []
+        for blk, cache in zip(self.blocks, caches["layers"]):
+            attn = blk.self_attn
+            xn = blk.self_norm(x)
+            kv = cache["self"]
+            k = attn._split_heads(attn.k_layer(xn)).astype(kv["k"].dtype)
+            v = attn._split_heads(attn.v_layer(xn)).astype(kv["v"].dtype)
+            new_layers.append({"self": {
+                "k": jax.lax.dynamic_update_slice(kv["k"], k,
+                                                  (0, 0, 0, 0)),
+                "v": jax.lax.dynamic_update_slice(kv["v"], v,
+                                                  (0, 0, 0, 0)),
+            }})
+            x = blk.forward(x, self_bias=bias)
+        return {"layers": new_layers, "pad": pad_cols}
 
     @staticmethod
     def _mask_padding_logit(logits):

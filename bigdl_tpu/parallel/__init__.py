@@ -7,6 +7,6 @@ from bigdl_tpu.parallel.sharding import (
     fsdp_spec, tensor_parallel_rules,
 )
 from bigdl_tpu.parallel.ring_attention import (
-    ring_attention, ring_self_attention,
+    RingSelfAttention, ring_attention, ring_self_attention,
 )
 from bigdl_tpu.parallel.pipeline import gpipe, Pipeline

@@ -31,7 +31,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-__all__ = ["ring_attention", "ring_self_attention"]
+__all__ = ["ring_attention", "ring_self_attention",
+           "RingSelfAttention"]
 
 _NEG_INF = -1e9
 
@@ -129,3 +130,74 @@ def ring_self_attention(q, k, v, mesh: Mesh, axis: str = "seq", *,
         mesh=mesh, in_specs=(spec, spec, spec, spec), out_specs=spec,
         check_vma=False)
     return fn(q, k, v, bias)
+
+
+from bigdl_tpu.core.module import Module
+from bigdl_tpu.nn.attention import Attention, causal_bias
+
+
+class RingSelfAttention(Attention):
+    """Drop-in for :class:`bigdl_tpu.nn.attention.Attention` that runs
+    the training-time self-attention through the ring schedule (O(T/n)
+    activation memory per chip).
+
+    Routing: incremental decoding (``cache=...``) and cross-attention
+    always use the dense path; a non-None additive ``bias`` also routes
+    dense (broadcasting [B,1,1,T] to [B,H,T,T] would defeat the ring's
+    memory point) with causality folded into the bias so semantics stay
+    identical; training with ``attention_dropout > 0`` raises — the
+    ring never materializes the softmax weights, so dropping them is
+    impossible, and silently skipping dropout would change training.
+
+    Build with :meth:`from_attention` to wrap an existing trained
+    Attention — the four projection Linears are SHARED (same modules,
+    same parameters, no RNG draws), so swapping in/out never touches
+    weights.
+    """
+
+    def __init__(self, hidden_size, num_heads, mesh, axis="seq",
+                 causal=True, attention_dropout=0.0):
+        super().__init__(hidden_size, num_heads, attention_dropout)
+        self.mesh = mesh
+        self.seq_axis = axis
+        self.causal = causal
+
+    def forward(self, x, y=None, bias=None, cache=None, cache_index=None):
+        if cache is not None or (y is not None and y is not x):
+            return Attention.forward(self, x, y, bias, cache, cache_index)
+        if self.training and self.attention_dropout > 0.0:
+            raise ValueError(
+                "attention dropout is not supported on the ring path "
+                "(the softmax weights are never materialized); train "
+                "with the dense Attention or attention_dropout=0")
+        if bias is not None:
+            # dense fallback with equivalent masking: the ring would
+            # have applied causality itself, so fold it into the bias
+            if self.causal:
+                bias = bias + causal_bias(x.shape[1], dtype=bias.dtype)
+            return Attention.forward(self, x, None, bias)
+        q = self._split_heads(self.q_layer(x))
+        k = self._split_heads(self.k_layer(x))
+        v = self._split_heads(self.v_layer(x))
+        ctxt = ring_self_attention(q, k, v, self.mesh, self.seq_axis,
+                                   causal=self.causal)
+        return self.output_layer(self._combine_heads(ctxt))
+
+    @classmethod
+    def from_attention(cls, attn, mesh, axis="seq", causal=True):
+        # rng-neutral construction: Attention.__init__ would draw four
+        # throwaway Linear inits from the global RNG stream
+        ring = object.__new__(cls)
+        Module.__init__(ring)
+        ring.hidden_size = attn.hidden_size
+        ring.num_heads = attn.num_heads
+        ring.attention_dropout = attn.attention_dropout
+        ring.mesh = mesh
+        ring.seq_axis = axis
+        ring.causal = causal
+        # share the projection modules (and thus the parameters)
+        ring.q_layer = attn.q_layer
+        ring.k_layer = attn.k_layer
+        ring.v_layer = attn.v_layer
+        ring.output_layer = attn.output_layer
+        return ring
