@@ -6,5 +6,6 @@ from bigdl_tpu.dataset.transformer import (
     Transformer, SampleToMiniBatch, Identity as IdentityTransformer,
 )
 from bigdl_tpu.dataset.prefetch import ParallelMap, Prefetch
+from bigdl_tpu.dataset.datamining import RowTransformer, RowToSample
 from bigdl_tpu.dataset import image
 from bigdl_tpu.dataset import text

@@ -176,20 +176,35 @@ class TransformerLM(Module):
         x = x + position_encoding(T, self.hidden_size, dtype=x.dtype)
         bias = causal_bias(T, dtype=x.dtype) \
             + padding_bias(ptoks).astype(x.dtype)
+        from bigdl_tpu.nn.attention import _residual_dropout
+        from bigdl_tpu.ops import dot_product_attention
         new_layers = []
         for blk, cache in zip(self.blocks, caches["layers"]):
+            # inline the block's attention so the K/V computed for the
+            # cache are the ones used (blk.forward would recompute the
+            # norm and all projections a second time)
             attn = blk.self_attn
             xn = blk.self_norm(x)
             kv = cache["self"]
-            k = attn._split_heads(attn.k_layer(xn)).astype(kv["k"].dtype)
-            v = attn._split_heads(attn.v_layer(xn)).astype(kv["v"].dtype)
+            k = attn._split_heads(attn.k_layer(xn))
+            v = attn._split_heads(attn.v_layer(xn))
             new_layers.append({"self": {
-                "k": jax.lax.dynamic_update_slice(kv["k"], k,
-                                                  (0, 0, 0, 0)),
-                "v": jax.lax.dynamic_update_slice(kv["v"], v,
-                                                  (0, 0, 0, 0)),
+                "k": jax.lax.dynamic_update_slice(
+                    kv["k"], k.astype(kv["k"].dtype), (0, 0, 0, 0)),
+                "v": jax.lax.dynamic_update_slice(
+                    kv["v"], v.astype(kv["v"].dtype), (0, 0, 0, 0)),
             }})
-            x = blk.forward(x, self_bias=bias)
+            if blk.training and attn.attention_dropout > 0.0:
+                # rare train-mode prefill: the materialized-dropout path
+                # must run; recomputing k/v there is acceptable
+                y = attn(xn, None, bias)
+            else:
+                q = attn._split_heads(attn.q_layer(xn))
+                ctxt = dot_product_attention(q, k, v, bias)
+                y = attn.output_layer(attn._combine_heads(ctxt))
+            x = x + _residual_dropout(y, blk.ffn_dropout, blk.training)
+            y = blk.ffn(blk.ffn_norm(x))
+            x = x + _residual_dropout(y, blk.ffn_dropout, blk.training)
         return {"layers": new_layers, "pad": pad_cols}
 
     @staticmethod

@@ -165,17 +165,24 @@ class RingSelfAttention(Attention):
     def forward(self, x, y=None, bias=None, cache=None, cache_index=None):
         if cache is not None or (y is not None and y is not x):
             return Attention.forward(self, x, y, bias, cache, cache_index)
+        if bias is not None:
+            # dense fallback with equivalent masking: the ring would
+            # have applied causality itself, so fold it into the bias.
+            # (Attention.forward's materialized path also handles
+            # training-time attention dropout, so no restriction here.)
+            if self.causal:
+                bias = bias + causal_bias(x.shape[1], dtype=bias.dtype)
+            return Attention.forward(self, x, None, bias)
         if self.training and self.attention_dropout > 0.0:
             raise ValueError(
                 "attention dropout is not supported on the ring path "
                 "(the softmax weights are never materialized); train "
                 "with the dense Attention or attention_dropout=0")
-        if bias is not None:
-            # dense fallback with equivalent masking: the ring would
-            # have applied causality itself, so fold it into the bias
-            if self.causal:
-                bias = bias + causal_bias(x.shape[1], dtype=bias.dtype)
-            return Attention.forward(self, x, None, bias)
+        n_shards = self.mesh.shape[self.seq_axis]
+        if x.shape[1] % n_shards:
+            raise ValueError(
+                f"sequence length {x.shape[1]} is not divisible by the "
+                f"{self.seq_axis!r} mesh axis size {n_shards}")
         q = self._split_heads(self.q_layer(x))
         k = self._split_heads(self.k_layer(x))
         v = self._split_heads(self.v_layer(x))
@@ -189,6 +196,7 @@ class RingSelfAttention(Attention):
         # throwaway Linear inits from the global RNG stream
         ring = object.__new__(cls)
         Module.__init__(ring)
+        ring.training = attn.training  # Module.__init__ resets to True
         ring.hidden_size = attn.hidden_size
         ring.num_heads = attn.num_heads
         ring.attention_dropout = attn.attention_dropout

@@ -38,3 +38,40 @@ def test_local_dataset_chained_transforms():
         .transform(SampleToMiniBatch(4))
     batches = list(ds.data(train=False))
     assert len(batches) == 2 and batches[0].input.shape == (4, 2)
+
+
+def test_row_transformer_modes():
+    """Tabular rows -> tensors (reference datamining/RowTransformer:
+    atomic, numeric, and grouped modes) over dicts, structured arrays,
+    and namedtuples."""
+    from collections import namedtuple
+    from bigdl_tpu.dataset.datamining import RowToSample, RowTransformer
+
+    rows = [{"age": 30, "scores": [1.0, 2.0], "income": 5.5, "y": 2},
+            {"age": 40, "scores": [3.0, 4.0], "income": 6.5, "y": 1}]
+    atomic = RowTransformer.atomic(["age", "scores"])
+    out = list(atomic(iter(rows)))
+    np.testing.assert_allclose(out[0]["age"], [30.0])
+    np.testing.assert_allclose(out[1]["scores"], [3.0, 4.0])
+
+    grouped = RowTransformer({"num": ["age", "income"], "s": ["scores"]})
+    g = list(grouped(iter(rows)))[0]
+    np.testing.assert_allclose(g["num"], [30.0, 5.5])
+    np.testing.assert_allclose(g["s"], [1.0, 2.0])
+
+    samples = list(RowToSample(["age", "scores", "income"], "y")(
+        iter(rows)))
+    np.testing.assert_allclose(samples[0].feature, [30.0, 1.0, 2.0, 5.5])
+    assert samples[0].label == 2 and samples[1].label == 1
+
+    # numpy structured arrays
+    arr = np.array([(1.5, 2.5, 3)], dtype=[("a", "f4"), ("b", "f4"),
+                                           ("y", "i4")])
+    s, = RowToSample(["a", "b"], "y")(iter(arr))
+    np.testing.assert_allclose(s.feature, [1.5, 2.5])
+    assert s.label == 3
+
+    # namedtuples (attribute access fallback)
+    Row = namedtuple("Row", ["a", "b"])
+    out, = RowTransformer.numeric(["a", "b"])(iter([Row(7.0, 8.0)]))
+    np.testing.assert_allclose(out["all"], [7.0, 8.0])

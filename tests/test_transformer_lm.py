@@ -285,3 +285,29 @@ def test_ring_attention_dropout_training_raises():
     with pytest.raises(ValueError, match="ring"):
         with forward_context(rng=jax.random.key(0)):
             m.forward(toks)
+
+
+def test_eval_mode_survives_sequence_parallel_swap():
+    """set_sequence_parallel after eval_mode() must not resurrect
+    training=True on the swapped attention modules (regression: the
+    rng-neutral constructor reset the flag, making generation with
+    dropout>0 raise)."""
+    from jax.sharding import Mesh
+    m = _model(max_len=64, dropout=0.1).eval_mode()
+    m.set_sequence_parallel(Mesh(np.asarray(jax.devices()[:8]), ("seq",)))
+    assert not m.blocks[0].self_attn.training
+    rng = np.random.default_rng(14)
+    toks = jnp.asarray(rng.integers(1, 51, (2, 16)), jnp.int32)
+    out = m.forward(toks)  # must not raise
+    assert bool(jnp.all(jnp.isfinite(out)))
+    out2 = m.generate(jnp.asarray(rng.integers(1, 51, (1, 4))), 3)
+    assert out2.shape == (1, 7)
+
+
+def test_ring_rejects_indivisible_sequence():
+    from jax.sharding import Mesh
+    m = _model(max_len=64).eval_mode()
+    m.set_sequence_parallel(Mesh(np.asarray(jax.devices()[:8]), ("seq",)))
+    toks = jnp.asarray(np.random.default_rng(15).integers(1, 51, (1, 12)))
+    with pytest.raises(ValueError, match="divisible"):
+        m.forward(toks)
