@@ -15,7 +15,7 @@ The reference's three construction modes are mirrored:
 
 from __future__ import annotations
 
-from typing import Dict, Mapping, Optional, Sequence
+from typing import Dict, Optional, Sequence
 
 import numpy as np
 
@@ -26,12 +26,14 @@ __all__ = ["RowTransformer", "RowToSample"]
 
 
 def _get(row, field):
-    if isinstance(row, Mapping):
-        return row[field]
     try:
-        return row[field]          # structured array / pandas Series
-    except (KeyError, IndexError, TypeError):
-        return getattr(row, field)  # namedtuple / object
+        return row[field]          # dict / structured array / pandas
+    except TypeError:
+        # namedtuple/object rows don't support string indexing; a
+        # MISSING field must keep raising (KeyError/IndexError) — a
+        # broad fallback would silently return unrelated attributes
+        # (e.g. pandas Series.size) as feature values
+        return getattr(row, field)
 
 
 class RowTransformer(Transformer):

@@ -1,6 +1,7 @@
 """Data pipeline tests (reference dataset/ specs, SURVEY §2.7)."""
 
 import numpy as np
+import pytest
 
 from bigdl_tpu.dataset import DataSet, Sample, SampleToMiniBatch
 from bigdl_tpu.dataset.dataset import DistributedDataSet
@@ -75,3 +76,13 @@ def test_row_transformer_modes():
     Row = namedtuple("Row", ["a", "b"])
     out, = RowTransformer.numeric(["a", "b"])(iter([Row(7.0, 8.0)]))
     np.testing.assert_allclose(out["all"], [7.0, 8.0])
+
+
+def test_row_transformer_missing_field_raises():
+    """A missing field must fail loudly, not silently resolve to an
+    unrelated attribute of the row object (regression: pandas
+    Series.size was returned for a missing 'size' column)."""
+    from bigdl_tpu.dataset.datamining import RowTransformer
+    t = RowTransformer.numeric(["age", "size"])
+    with pytest.raises((KeyError, AttributeError)):
+        t.transform_row({"age": 30.0, "income": 5.5})
