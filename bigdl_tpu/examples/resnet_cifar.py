@@ -59,5 +59,11 @@ def main(argv=None):
     return model
 
 
+def cli():
+    """Console entry: discard main()'s return value so the generated
+    script exits 0 (sys.exit(<object>) would exit 1)."""
+    main()
+
+
 if __name__ == "__main__":
     main()

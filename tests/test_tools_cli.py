@@ -139,3 +139,19 @@ def test_loadmodel_predict_batches_and_class_warning(tmp_path,
               "--evaluate", str(image_folder / "val"),
               "--image-size", "16", "-b", "4", "-q"])
     assert any("class directories" in r.message for r in caplog.records)
+
+
+def test_perf_harness_cli():
+    """DistriOptimizerPerf-analog: drives the real Optimizer loop and
+    reports steady-state throughput."""
+    from bigdl_tpu.examples.perf import main
+    out = main(["--model", "lenet", "-b", "16", "--iterations", "3",
+                "--epochs", "3"])
+    assert out["records_per_sec"] > 0
+    assert out["ms_per_iteration"] > 0
+    assert out["epochs_timed"] == 2  # every epoch after the compile epoch
+    out = main(["--model", "transformer-lm", "-b", "8", "--seq-len", "16",
+                "--vocab-size", "50", "--hidden-size", "16",
+                "--num-layers", "1", "--num-heads", "2",
+                "--iterations", "2", "--epochs", "2"])
+    assert out["records_per_sec"] > 0
