@@ -216,3 +216,20 @@ class Graph(Module):
                 else m.forward(tuple(args))
         outs = tuple(values[o] for o in self.output_ids)
         return outs[0] if len(outs) == 1 else outs
+
+
+# -- structural aliases ------------------------------------------------------
+# The reference's execution-machinery split collapses under XLA:
+# * BaseModule (nn/BaseModule.scala) is "a module defined by an internal
+#   built graph" — any Module here can hold a Graph attribute;
+# * DynamicContainer (nn/DynamicContainer.scala) is the add()-accepting
+#   container base — Container already is one;
+# * DynamicGraph (nn/DynamicGraph.scala) executes graphs with a
+#   Scheduler/FrameManager for control-flow ops — control flow compiles
+#   to lax.cond/while_loop inside a static Graph (see ops/control.py and
+#   the TF while-frame importer), so the static executor serves both.
+BaseModule = Module
+DynamicContainer = Container
+DynamicGraph = Graph
+
+__all__ += ["BaseModule", "DynamicContainer", "DynamicGraph"]

@@ -27,7 +27,7 @@ __all__ = [
     "CMinTable", "CAveTable", "JoinTable", "SplitTable", "SelectTable",
     "NarrowTable", "FlattenTable", "MixtureTable", "DotProduct",
     "CosineDistance", "PairwiseDistance", "MM", "MV",
-    "BifurcateSplitTable", "CrossProduct",
+    "BifurcateSplitTable", "CrossProduct", "TableOperation",
 ]
 
 
@@ -259,3 +259,22 @@ class CrossProduct(Module):
             for j in range(i + 1, len(xs)):
                 outs.append(jnp.sum(xs[i] * xs[j], axis=-1, keepdims=True))
         return jnp.concatenate(outs, axis=-1)
+
+
+class TableOperation(Module):
+    """Apply a two-input table layer (CMulTable, CSubTable, …) after
+    expanding the smaller tensor to the larger one's shape (reference
+    nn/TableOperation.scala — used by wide-and-deep to combine a scalar
+    gate with a feature map)."""
+
+    def __init__(self, operation_layer: Module):
+        super().__init__()
+        self.operation_layer = operation_layer
+
+    def forward(self, inputs):
+        a, b = inputs
+        if a.size > b.size:
+            b = jnp.broadcast_to(b, a.shape)
+        elif b.size > a.size:
+            a = jnp.broadcast_to(a, b.shape)
+        return self.operation_layer.forward((a, b))

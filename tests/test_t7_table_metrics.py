@@ -267,3 +267,24 @@ def test_logger_second_file_is_additive(tmp_path):
     assert len(ours) == 2
     for h in ours:
         logging.getLogger("bigdl_tpu").removeHandler(h)
+
+
+def test_table_operation_broadcasts_smaller_input():
+    """nn/TableOperation.scala: expand the smaller tensor to the larger
+    one's shape, then run the wrapped two-input table layer —
+    whichever side is smaller."""
+    t = nn.TableOperation(nn.CMulTable())
+    a = jnp.arange(24, dtype=jnp.float32).reshape(2, 3, 4)
+    b = jnp.full((1, 1, 4), 2.0)
+    np.testing.assert_allclose(np.asarray(t.forward((a, b))),
+                               np.asarray(a) * 2.0)
+    np.testing.assert_allclose(np.asarray(t.forward((b, a))),
+                               np.asarray(a) * 2.0)
+
+
+def test_structural_aliases_exist():
+    """BaseModule/DynamicContainer/DynamicGraph collapse into the static
+    execution machinery under XLA (see containers.py rationale)."""
+    assert nn.BaseModule is nn.Module
+    assert nn.DynamicContainer is nn.Container
+    assert nn.DynamicGraph is nn.Graph
