@@ -560,3 +560,154 @@ class TensorOp(Operation):
 
     def t(self):
         return self.then(lambda y: jnp.swapaxes(y, -1, -2))
+
+
+class ApproximateEqual(Operation):
+    """|a - b| < tolerance (reference nn/ops/ApproximateEqual.scala)."""
+
+    def __init__(self, tolerance: float = 1e-5):
+        super().__init__()
+        self.tolerance = float(tolerance)
+
+    def forward(self, xs):
+        a, b = xs
+        return jnp.abs(a - b) < self.tolerance
+
+
+class Gather(Operation):
+    """Gather rows of params by indices along axis 0 (reference
+    nn/ops/Gather.scala; TF Gather).  Indices are 0-based like TF."""
+
+    def forward(self, xs):
+        params, indices = xs
+        return jnp.take(params, jnp.asarray(indices, jnp.int32), axis=0)
+
+
+class InTopK(Operation):
+    """targets[i] in top-k of predictions[i] (reference
+    nn/ops/InTopK.scala).  ``start_from_1``: 1-based target ids."""
+
+    def __init__(self, k: int, start_from_1: bool = False):
+        super().__init__()
+        self.k = int(k)
+        self.start_from_1 = start_from_1
+
+    def forward(self, xs):
+        predictions, targets = xs
+        targets = jnp.asarray(targets, jnp.int32)
+        if self.start_from_1:
+            targets = targets - 1
+        n_classes = predictions.shape[1]
+        valid = (targets >= 0) & (targets < n_classes)
+        safe = jnp.clip(targets, 0, n_classes - 1)
+        target_score = jnp.take_along_axis(
+            predictions, safe[:, None], axis=1)[:, 0]
+        rank = jnp.sum(predictions > target_score[:, None], axis=1)
+        # out-of-range targets are False, matching TF in_top_k (the
+        # gather's clamping must not silently score another class)
+        return valid & (rank < self.k)
+
+
+class SegmentSum(Operation):
+    """Sum rows sharing a segment id; ids must be sorted ascending
+    (reference nn/ops/SegmentSum.scala; TF segment_sum).  Output has
+    ``max(id)+1`` rows."""
+
+    def __init__(self, num_segments=None):
+        super().__init__()
+        # static segment count keeps the op jit-traceable (shape must
+        # be static under XLA); without it the count is read from the
+        # ids EAGERLY, which only works outside jit
+        self.num_segments = num_segments
+
+    def forward(self, xs):
+        data, segment_ids = xs
+        segment_ids = jnp.asarray(segment_ids, jnp.int32)
+        num = self.num_segments
+        if num is None:
+            num = int(np.asarray(segment_ids)[-1]) + 1 \
+                if segment_ids.size else 0
+        return jax.ops.segment_sum(data, segment_ids, num_segments=num)
+
+
+class ModuleToOperation(Operation):
+    """Use any Module as a forward-only op (reference
+    nn/ops/ModuleToOperation.scala)."""
+
+    def __init__(self, module: Module):
+        super().__init__()
+        self.module = module
+
+    def forward(self, x):
+        return self.module.forward(x)
+
+
+class Dilation2D(Operation):
+    """Grayscale morphological dilation: out[b,y,x,c] = max over the
+    (dilated) window of input + filter (reference
+    nn/ops/Dilation2D.scala; TF tf.nn.dilation2d).  NHWC input,
+    [kh, kw, C] filter; strides/rates are the TF 4-element lists."""
+
+    def __init__(self, strides, rates, padding: str = "VALID"):
+        super().__init__()
+        self.strides = tuple(strides)
+        self.rates = tuple(rates)
+        self.padding = padding.upper()
+
+    def forward(self, xs):
+        x, filt = xs
+        kh, kw, c = filt.shape
+        _, sh, sw, _ = self.strides
+        _, rh, rw, _ = self.rates
+        if self.padding == "SAME":
+            # TF treats padded elements as -inf (they must never win
+            # the max); patches would zero-fill, so pad explicitly
+            eff_h, eff_w = (kh - 1) * rh + 1, (kw - 1) * rw + 1
+            H, W = x.shape[1], x.shape[2]
+            ph = max((-(-H // sh) - 1) * sh + eff_h - H, 0)
+            pw = max((-(-W // sw) - 1) * sw + eff_w - W, 0)
+            # patches extract via a conv (0 x -inf = NaN), so pad
+            # with a huge finite negative instead of -inf
+            neg = jnp.finfo(x.dtype).min / 2
+            x = jnp.pad(x, ((0, 0), (ph // 2, ph - ph // 2),
+                            (pw // 2, pw - pw // 2), (0, 0)),
+                        constant_values=neg)
+        # patches: [B, H', W', C*kh*kw] in (c, kh, kw) minor order
+        patches = jax.lax.conv_general_dilated_patches(
+            x, (kh, kw), (sh, sw), "VALID",
+            rhs_dilation=(rh, rw),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        b, oh, ow, _ = patches.shape
+        patches = patches.reshape(b, oh, ow, c, kh * kw)
+        filt_flat = jnp.transpose(filt, (2, 0, 1)).reshape(c, kh * kw)
+        return jnp.max(patches + filt_flat, axis=-1)
+
+
+class Substr(Operation):
+    """Substring over byte-string arrays (reference nn/ops/Substr.scala;
+    TF Substr).  Host-side op: inputs are numpy object/bytes arrays,
+    (pos, len) scalars."""
+
+    def forward(self, xs):
+        strings, pos, length = xs
+        pos, length = int(pos), int(length)
+        arr = np.asarray(strings, dtype=object)
+        if arr.shape == ():
+            return arr[()][pos:pos + length]
+        out = np.empty(arr.shape, dtype=object)
+        for idx in np.ndindex(arr.shape):
+            out[idx] = arr[idx][pos:pos + length]
+        return out
+
+
+# reference nn/ops names whose natural spelling clashed with jnp
+# builtins when these ops were first written
+Maximum = MaximumOp
+Minimum = MinimumOp
+# reference nn/ops/Compare.scala: the abstract base of the comparison
+# ops (Greater/Less/... extend it) — our _Binary plays that role
+Compare = _Binary
+
+__all__ += ["ApproximateEqual", "Gather", "InTopK", "SegmentSum",
+            "ModuleToOperation", "Dilation2D", "Substr", "Maximum",
+            "Minimum", "Compare"]

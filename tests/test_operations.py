@@ -228,3 +228,86 @@ def test_feature_column_ops_wide_and_deep():
     kv = Kv2Tensor()
     out = kv((np.asarray(["0:1.5,2:3.0", "1:2.0"], dtype=object), 4))
     np.testing.assert_allclose(out, [[1.5, 0, 3.0, 0], [0, 2.0, 0, 0]])
+
+
+def test_remaining_reference_ops():
+    """The last 10 nn/ops files: ApproximateEqual, Gather, InTopK,
+    SegmentSum, ModuleToOperation, Dilation2D, Substr + aliases."""
+    from bigdl_tpu import ops
+
+    assert np.asarray(ops.ApproximateEqual(0.1)(
+        (jnp.asarray([1.0, 2.0]), jnp.asarray([1.05, 3.0])))).tolist() \
+        == [True, False]
+
+    params = jnp.asarray([[1.0, 2], [3, 4], [5, 6]])
+    np.testing.assert_allclose(
+        np.asarray(ops.Gather()((params, jnp.asarray([2, 0])))),
+        [[5.0, 6], [1, 2]])
+
+    preds = jnp.asarray([[0.1, 0.9, 0.0], [0.8, 0.1, 0.1]])
+    got = np.asarray(ops.InTopK(1)((preds, jnp.asarray([1, 1]))))
+    assert got.tolist() == [True, False]
+    got = np.asarray(ops.InTopK(1, start_from_1=True)(
+        (preds, jnp.asarray([2, 2]))))
+    assert got.tolist() == [True, False]
+
+    data = jnp.asarray([[1.0, 2], [3, 4], [5, 6]])
+    np.testing.assert_allclose(
+        np.asarray(ops.SegmentSum()((data, jnp.asarray([0, 0, 1])))),
+        [[4.0, 6], [5, 6]])
+
+    import bigdl_tpu.nn as nn
+    m2o = ops.ModuleToOperation(nn.ReLU())
+    np.testing.assert_allclose(
+        np.asarray(m2o(jnp.asarray([-1.0, 2.0]))), [0.0, 2.0])
+
+    # Dilation2D against a hand-computed 1-channel case
+    x = jnp.asarray(np.arange(16, dtype=np.float32).reshape(1, 4, 4, 1))
+    filt = jnp.zeros((2, 2, 1), jnp.float32)
+    out = np.asarray(ops.Dilation2D((1, 1, 1, 1), (1, 1, 1, 1))(
+        (x, filt)))
+    # max of each 2x2 window (filter zero)
+    want = np.asarray([[5, 6, 7], [9, 10, 11], [13, 14, 15]],
+                      np.float32).reshape(1, 3, 3, 1)
+    np.testing.assert_allclose(out, want)
+    # non-zero filter adds before the max
+    filt2 = jnp.asarray([[[0.0]], [[10.0]]])  # kh=2,kw=1? -> (2,1,1)
+    out2 = np.asarray(ops.Dilation2D((1, 1, 1, 1), (1, 1, 1, 1))(
+        (x, jnp.reshape(filt2, (2, 1, 1)))))
+    # window col of 2: max(x[y,x], x[y+1,x]+10) = x[y+1,x]+10
+    np.testing.assert_allclose(out2[0, :, :, 0],
+                               np.arange(16).reshape(4, 4)[1:, :] + 10)
+
+    subs = ops.Substr()((np.asarray([b"hello", b"world"], object), 1, 3))
+    assert subs.tolist() == [b"ell", b"orl"]
+
+    assert ops.Maximum is ops.MaximumOp and ops.Minimum is ops.MinimumOp
+
+
+def test_new_ops_edge_cases():
+    """Review regressions: SAME dilation must -inf-pad (borders of a
+    negative image stay negative); Substr handles 0-d; InTopK returns
+    False for out-of-range targets; SegmentSum jits with a static
+    num_segments."""
+    from bigdl_tpu import ops
+
+    x = jnp.full((1, 3, 3, 1), -5.0)
+    filt = jnp.zeros((2, 2, 1), jnp.float32)
+    out = np.asarray(ops.Dilation2D((1, 1, 1, 1), (1, 1, 1, 1),
+                                    padding="SAME")((x, filt)))
+    assert out.shape == (1, 3, 3, 1)
+    np.testing.assert_allclose(out, -5.0)
+
+    assert ops.Substr()((np.asarray(b"hello", object), 1, 3)) == b"ell"
+
+    preds = jnp.asarray([[0.1, 0.9, 0.0]])
+    assert np.asarray(ops.InTopK(3)((preds, jnp.asarray([5])))).tolist() \
+        == [False]
+    assert np.asarray(ops.InTopK(3, start_from_1=True)(
+        (preds, jnp.asarray([0])))).tolist() == [False]
+
+    seg = ops.SegmentSum(num_segments=2)
+    fn = jax.jit(lambda d, i: seg((d, i)))
+    np.testing.assert_allclose(
+        np.asarray(fn(jnp.asarray([[1.0], [2.0], [4.0]]),
+                      jnp.asarray([0, 0, 1]))), [[3.0], [4.0]])
