@@ -1099,6 +1099,14 @@ def _register_defaults():
 
     _TF_CONVERTERS["MirrorPad"] = mirror_pad
 
+    # identity-like runtime-check/annotation ops common in exported
+    # graphs (≙ nn/tf/Assert, CheckNumerics handling): the check has no
+    # compiled equivalent worth a host sync — pass the value through
+    _TF_CONVERTERS["StopGradient"] = simple(jax.lax.stop_gradient)
+    _TF_CONVERTERS["CheckNumerics"] = simple(lambda x: x)
+    _TF_CONVERTERS["PlaceholderWithDefault"] = simple(lambda x: x)
+    _TF_CONVERTERS["Assert"] = simple(lambda *xs: None)
+
 
 _register_defaults()
 

@@ -603,9 +603,11 @@ class InTopK(Operation):
         target_score = jnp.take_along_axis(
             predictions, safe[:, None], axis=1)[:, 0]
         rank = jnp.sum(predictions > target_score[:, None], axis=1)
-        # out-of-range targets are False, matching TF in_top_k (the
-        # gather's clamping must not silently score another class)
-        return valid & (rank < self.k)
+        # out-of-range targets and non-finite target predictions are
+        # False, matching TF in_top_k (the gather's clamping must not
+        # silently score another class, and NaN comparisons being False
+        # must not count as rank 0)
+        return valid & jnp.isfinite(target_score) & (rank < self.k)
 
 
 class SegmentSum(Operation):
@@ -668,7 +670,9 @@ class Dilation2D(Operation):
             pw = max((-(-W // sw) - 1) * sw + eff_w - W, 0)
             # patches extract via a conv (0 x -inf = NaN), so pad
             # with a huge finite negative instead of -inf
-            neg = jnp.finfo(x.dtype).min / 2
+            neg = (jnp.iinfo(x.dtype).min // 2
+                   if jnp.issubdtype(x.dtype, jnp.integer)
+                   else jnp.finfo(x.dtype).min / 2)
             x = jnp.pad(x, ((0, 0), (ph // 2, ph - ph // 2),
                             (pw // 2, pw - pw // 2), (0, 0)),
                         constant_values=neg)
@@ -692,11 +696,21 @@ class Substr(Operation):
         strings, pos, length = xs
         pos, length = int(pos), int(length)
         arr = np.asarray(strings, dtype=object)
+
+        def sub(s):
+            if pos < 0 or pos > len(s):
+                # TF Substr raises InvalidArgumentError here; silently
+                # returning b'' would hide the bad offset
+                raise ValueError(
+                    f"Substr pos {pos} out of range for input of "
+                    f"length {len(s)}")
+            return s[pos:pos + length]
+
         if arr.shape == ():
-            return arr[()][pos:pos + length]
+            return sub(arr[()])
         out = np.empty(arr.shape, dtype=object)
         for idx in np.ndindex(arr.shape):
-            out[idx] = arr[idx][pos:pos + length]
+            out[idx] = sub(arr[idx])
         return out
 
 

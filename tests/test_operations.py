@@ -311,3 +311,24 @@ def test_new_ops_edge_cases():
     np.testing.assert_allclose(
         np.asarray(fn(jnp.asarray([[1.0], [2.0], [4.0]]),
                       jnp.asarray([0, 0, 1]))), [[3.0], [4.0]])
+
+
+def test_new_ops_tf_edge_semantics():
+    """TF-matching edges from review: NaN target prediction is not in
+    top-k; integer-dtype SAME dilation works; Substr raises on bad pos."""
+    from bigdl_tpu import ops
+
+    preds = jnp.asarray([[jnp.nan, 0.5]])
+    assert np.asarray(ops.InTopK(1)((preds, jnp.asarray([0])))).tolist() \
+        == [False]
+
+    x = jnp.full((1, 3, 3, 1), -5, jnp.int32)
+    out = np.asarray(ops.Dilation2D((1, 1, 1, 1), (1, 1, 1, 1),
+                                    padding="SAME")(
+        (x, jnp.zeros((2, 2, 1), jnp.int32))))
+    np.testing.assert_array_equal(out, np.full((1, 3, 3, 1), -5))
+
+    with pytest.raises(ValueError, match="out of range"):
+        ops.Substr()((np.asarray([b"hi"], object), 5, 2))
+    with pytest.raises(ValueError, match="out of range"):
+        ops.Substr()((np.asarray(b"hello", object), -2, 2))

@@ -507,3 +507,22 @@ def test_resize_bilinear_tf1_modes(align_corners, half_pixel):
         return
     np.testing.assert_allclose(
         got, want.permute(0, 2, 3, 1).numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_annotation_ops_pass_through():
+    """StopGradient/CheckNumerics/PlaceholderWithDefault import as
+    identity (StopGradient blocks gradients too)."""
+    import jax
+    from bigdl_tpu.interop.tensorflow import load_tf_graph
+    gd = graphdef(
+        node("x", "Placeholder"),
+        node("sg", "StopGradient", ["x"]),
+        node("cn", "CheckNumerics", ["sg"]),
+        node("pd", "PlaceholderWithDefault", ["cn"]),
+        node("out", "Neg", ["pd"]),
+    )
+    model, _ = load_tf_graph(gd, ["x"], ["out"])
+    x = jnp.asarray([1.0, -2.0])
+    np.testing.assert_allclose(np.asarray(model(x)), [-1.0, 2.0])
+    g = jax.grad(lambda v: float(0) + model.forward(v).sum())(x)
+    np.testing.assert_allclose(np.asarray(g), [0.0, 0.0])  # StopGradient
