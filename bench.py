@@ -4,6 +4,25 @@ the available accelerator (one TPU chip under the driver).
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
 
+Phase-resilient design (round-4 rework).  Rounds 1-3 produced zero valid
+perf evidence: r01 died in backend init, r02 shipped a physically
+impossible number (async dispatch never forced to completion), r03 hung
+inside a single monolithic 540 s watchdog and emitted ``value: 0.0``,
+discarding everything measured before the hang.  This rewrite makes that
+failure mode impossible:
+
+* Every phase runs in a daemon worker thread with its OWN deadline; a
+  hung XLA dispatch (the tunneled backend stalls sometimes) abandons
+  that phase and moves on instead of wedging the run.
+* A shared RESULT dict is updated the moment each sub-measurement lands;
+  the global watchdog emits the BEST-SO-FAR partial result — never 0.0.
+* Phase order puts the headline first: backend init -> model step
+  (compile + timed loop) -> optimizer loop -> roofline.  A roofline
+  stall (what killed r03) can no longer erase the step time.
+* Timing forces real completion with a scalar readback (``float()``) —
+  ``block_until_ready`` returned early on the tunneled backend, which is
+  how r02 shipped a 204%-of-spec MFU.
+
 The headline number drives the FRAMEWORK loop (``Optimizer.optimize()``
 with mesh + bf16 compute + async loss readback), not a hand-rolled
 bypass; the raw jitted-step number is reported alongside so a gap
@@ -11,40 +30,158 @@ between the two reads as framework overhead to fix.
 
 MFU is reported against two rooflines:
   * ``mfu_vs_spec``     — public peak bf16 FLOP/s for the device kind;
-    flagged ``mfu_vs_spec_suspect`` when > 1 (a virtualized chip can
-    out-run its nominal spec, which makes the spec denominator wrong).
+    flagged ``mfu_vs_spec_suspect`` when > 1.
   * ``mfu_vs_measured`` — an empirically calibrated roofline: a chained
-    big-matmul microbench run on the same chip right before the model
-    bench.  This is the honest utilization number.
+    big-matmul microbench run on the same chip (escalating sizes, each
+    under its own deadline).
 
 Baseline for vs_baseline: the reference's published ResNet-50 recipe —
 BigDL trains ResNet-50 at global batch 8192 on 2048 Xeon cores
-(models/resnet/README.md:85-150); whitepaper-era Broadwell measurements
-imply ~35 img/s per 32-core executor.  vs_baseline = our img/s on ONE
-chip / 35 (chip-for-executor speedup).
-
-Never exits with a raw traceback: backend init is retried with backoff,
-and any failure still emits a machine-readable diagnostic JSON line.
+(reference: models/resnet/README.md:85-150); whitepaper-era Broadwell
+measurements imply ~35 img/s per 32-core executor.  vs_baseline = our
+img/s on ONE chip / 35 (chip-for-executor speedup).  Per-iteration
+throughput telemetry matches optim/DistriOptimizer.scala:425-431.
 """
 
 from __future__ import annotations
 
 import json
 import logging
+import os
 import sys
+import threading
 import time
+import traceback
 
 import numpy as np
 
+# ---------------------------------------------------------------------------
+# Budget + emission plumbing
+# ---------------------------------------------------------------------------
 
-def _emit(obj):
-    print(json.dumps(obj), flush=True)
+T_START = time.monotonic()
+TOTAL_BUDGET_S = float(os.environ.get("BIGDL_TPU_BENCH_BUDGET_S", "500"))
+
+RESULT = {
+    "metric": "resnet50_train_img_per_sec",
+    "value": 0.0,
+    "unit": "images/sec/chip",
+    "vs_baseline": 0.0,
+    "phases": {},
+}
+_LOCK = threading.Lock()
+_EMITTED = threading.Event()
 
 
-def _emit_failure(reason: str):
-    _emit({"metric": "resnet50_train_img_per_sec", "value": 0.0,
-           "unit": "images/sec/chip", "vs_baseline": 0.0, "error": reason})
+def _elapsed() -> float:
+    return time.monotonic() - T_START
 
+
+def _remaining() -> float:
+    return TOTAL_BUDGET_S - _elapsed()
+
+
+def _log(msg: str) -> None:
+    sys.stderr.write(f"[bench +{_elapsed():6.1f}s] {msg}\n")
+    sys.stderr.flush()
+
+
+def _update(**kv) -> None:
+    """Record measurements the moment they land, so a later hang cannot
+    erase them; keeps the headline `value` in sync with the best number
+    measured so far (optimizer loop preferred over raw step)."""
+    with _LOCK:
+        RESULT.update(kv)
+        head = RESULT.get("optimizer_img_per_sec") or RESULT.get(
+            "raw_step_img_per_sec")
+        if head:
+            RESULT["value"] = round(head, 2)
+            RESULT["vs_baseline"] = round(head / 35.0, 2)
+        flops = RESULT.get("flops_per_step")
+        step = RESULT.get("optimizer_step_time_ms") or RESULT.get(
+            "raw_step_time_ms")
+        if flops and step:
+            sec = step / 1e3
+            peak_m = RESULT.get("peak_measured_flops")
+            peak_s = RESULT.get("peak_spec_flops")
+            if peak_m:
+                RESULT["mfu_vs_measured"] = round(flops / sec / peak_m, 4)
+            if peak_s:
+                m = round(flops / sec / peak_s, 4)
+                RESULT["mfu_vs_spec"] = m
+                if m > 1.0:
+                    RESULT["mfu_vs_spec_suspect"] = True
+
+
+def _emit_final(tag: str) -> None:
+    """Print the single JSON result line exactly once (watchdog and the
+    normal path race; atomic test-and-set under the lock)."""
+    with _LOCK:
+        if _EMITTED.is_set():
+            return
+        _EMITTED.set()
+        if tag != "done":
+            RESULT["partial"] = tag
+        line = json.dumps(RESULT)
+    print(line, flush=True)
+
+
+# ---------------------------------------------------------------------------
+# Phase runner: per-phase deadline in a daemon thread
+# ---------------------------------------------------------------------------
+
+def run_phase(name: str, fn, deadline_s: float):
+    """Run fn() on a daemon thread, waiting at most deadline_s.  Returns
+    the value or None.  A timed-out phase is abandoned (the thread may
+    stay wedged in a native call; daemon threads don't block exit)."""
+    deadline_s = min(deadline_s, max(_remaining() - 15.0, 5.0))
+    _log(f"phase {name}: start (deadline {deadline_s:.0f}s)")
+    box = {}
+
+    def target():
+        try:
+            box["value"] = fn()
+        except Exception:
+            box["error"] = traceback.format_exc()
+
+    t = threading.Thread(target=target, daemon=True, name=f"bench-{name}")
+    t0 = time.monotonic()
+    t.start()
+    t.join(deadline_s)
+    dt = time.monotonic() - t0
+    if t.is_alive():
+        _log(f"phase {name}: TIMED OUT after {dt:.1f}s (abandoned)")
+        with _LOCK:
+            RESULT["phases"][name] = f"timeout {dt:.0f}s"
+        return None
+    if "error" in box:
+        sys.stderr.write(box["error"])
+        with _LOCK:
+            RESULT["phases"][name] = "error: " + box["error"].strip(
+            ).splitlines()[-1][:200]
+        return None
+    with _LOCK:
+        RESULT["phases"][name] = f"ok {dt:.1f}s"
+    _log(f"phase {name}: done in {dt:.1f}s")
+    return box.get("value")
+
+
+def _start_watchdog():
+    def fire():
+        _log(f"watchdog: total budget {TOTAL_BUDGET_S:.0f}s exceeded; "
+             f"emitting best-so-far partial result")
+        _emit_final("watchdog")
+        os._exit(3)
+
+    t = threading.Timer(max(TOTAL_BUDGET_S - _elapsed(), 1.0), fire)
+    t.daemon = True
+    t.start()
+    return t
+
+
+# ---------------------------------------------------------------------------
+# Phases
+# ---------------------------------------------------------------------------
 
 # Dense bf16 peak FLOP/s per chip by device_kind substring (public specs).
 _PEAK_BF16 = [
@@ -61,133 +198,43 @@ def _peak_flops(device_kind: str):
     return None
 
 
-def _init_backend(attempts: int = 3, deadline_s: float = 150.0):
-    """jax.devices() with retry/backoff under an overall deadline — one
-    transient backend hiccup must not erase the round's perf evidence,
-    but a slow-failing init must not eat the whole driver budget either."""
+def phase_backend():
+    """jax.devices() with in-phase retry; one transient hiccup must not
+    erase the round's perf evidence."""
     import jax
-    t0 = time.time()
-    delay = 5.0
+    if os.environ.get("BIGDL_TPU_BENCH_FORCE_CPU"):
+        # the axon sitecustomize overrides JAX_PLATFORMS; win the
+        # override war the same way tests/conftest.py does
+        jax.config.update("jax_platforms", "cpu")
     last = None
-    for i in range(attempts):
+    for i in range(3):
         try:
-            devs = jax.devices()
-            return jax, devs[0]
-        except Exception as e:  # backend UNAVAILABLE, chip held, ...
+            dev = jax.devices()[0]
+            _log(f"backend up: {dev.platform} / "
+                 f"{getattr(dev, 'device_kind', '?')}")
+            _update(device_kind=getattr(dev, "device_kind", dev.platform),
+                    platform=dev.platform)
+            peak = _peak_flops(getattr(dev, "device_kind", ""))
+            if peak:
+                _update(peak_spec_flops=peak)
+            return dev
+        except Exception as e:
             last = e
-            sys.stderr.write(
-                f"[bench] backend init attempt {i + 1}/{attempts} failed: "
-                f"{type(e).__name__}: {e}\n")
-            if i + 1 == attempts or time.time() - t0 + delay > deadline_s:
-                break
+            _log(f"backend init attempt {i + 1}/3 failed: "
+                 f"{type(e).__name__}: {e}")
             try:
                 import jax.extend.backend
                 jax.extend.backend.clear_backends()
             except Exception:
                 pass
-            time.sleep(delay)
-            delay *= 2
-    raise RuntimeError(
-        f"backend init failed after {time.time() - t0:.0f}s "
-        f"(is another process holding the chip?): {last}") from last
+            time.sleep(5.0 * (i + 1))
+    raise RuntimeError(f"backend init failed: {last}") from last
 
 
-def _start_watchdog(budget_s: float = 540.0):
-    """If the bench hasn't finished within budget (e.g. backend init or
-    compile blocked indefinitely), emit the diagnostic JSON line and
-    hard-exit — the driver must always receive parseable output."""
-    import threading
-
-    def fire():
-        _emit_failure(f"watchdog: bench exceeded {budget_s:.0f}s "
-                      f"(blocked backend init or compile)")
-        import os
-        os._exit(2)
-
-    t = threading.Timer(budget_s, fire)
-    t.daemon = True
-    t.start()
-    return t
-
-
-def main():
-    watchdog = _start_watchdog()
-    try:
-        jax, dev = _init_backend()
-    except Exception as e:
-        _emit_failure(f"backend_init: {e}")
-        watchdog.cancel()
-        return
-    try:
-        _bench(jax, dev)
-    except Exception as e:
-        import traceback
-        sys.stderr.write(traceback.format_exc())
-        _emit_failure(f"{type(e).__name__}: {e}")
-    finally:
-        watchdog.cancel()
-
-
-def _measure_peak(jax, on_tpu: bool) -> float:
-    """Empirical bf16 matmul roofline of this chip: chained square
-    matmuls (each output feeds the next, so XLA cannot elide any) timed
-    after warmup.  Returns achieved FLOP/s.
-
-    Timing forces completion with a scalar readback — on the tunneled
-    bench backend ``block_until_ready`` returns before the work is done,
-    which is how round 2 shipped a 204%-of-spec MFU."""
+def _build_step(on_tpu: bool, batch: int, size: int):
+    """Build the jitted fwd+bwd+update for ResNet-50 and AOT-compile it."""
+    import jax
     import jax.numpy as jnp
-
-    n = 8192 if on_tpu else 512
-    chain_len = 8
-
-    @jax.jit
-    def chain(a, b):
-        for _ in range(chain_len):
-            a = jnp.matmul(a, b, preferred_element_type=jnp.bfloat16)
-        return a
-
-    a = jnp.full((n, n), 0.5, jnp.bfloat16)
-    b = jnp.full((n, n), 1e-4, jnp.bfloat16)
-
-    def run(reps):
-        out = a
-        for _ in range(reps):
-            out = chain(out, b)
-        return float(jnp.sum(out, dtype=jnp.float32))
-
-    run(1)  # compile chain + the readback reduction
-    reps = 16 if on_tpu else 2
-    t0 = time.perf_counter()
-    run(reps)
-    dt = time.perf_counter() - t0
-    flops = 2.0 * n * n * n * chain_len * reps
-    peak = flops / dt
-    sys.stderr.write(f"[bench] measured matmul roofline: "
-                     f"{peak / 1e12:.1f} TFLOP/s bf16 ({n}^3 x{chain_len}, "
-                     f"{dt:.2f}s)\n")
-    return peak
-
-
-class _TimedData:
-    """Wraps a dataset with per-epoch iterator timestamps, so the bench
-    can time steady-state epochs of the real Optimizer loop."""
-
-    def __init__(self, inner):
-        self.inner = inner
-        self.epoch_starts = []
-
-    def data(self, train=True):
-        self.epoch_starts.append(time.perf_counter())
-        return self.inner.data(train)
-
-    def size(self) -> int:
-        return self.inner.size()
-
-
-def _bench(jax, dev):
-    import jax.numpy as jnp
-
     from bigdl_tpu.core.module import partition, combine, cast_floating
     import bigdl_tpu.nn as nn
     from bigdl_tpu.models import resnet50
@@ -196,17 +243,10 @@ def _bench(jax, dev):
 
     logging.getLogger("bigdl_tpu.optim").setLevel(logging.WARNING)
     set_seed(0)
-    on_tpu = dev.platform != "cpu"
-    batch = 128 if on_tpu else 8
-    size = 224 if on_tpu else 64
-
-    peak_measured = _measure_peak(jax, on_tpu)
-    peak_spec = _peak_flops(getattr(dev, "device_kind", ""))
 
     model = resnet50(class_num=1000)
     criterion = nn.CrossEntropyCriterion()
     method = SGD(0.1, momentum=0.9, dampening=0.0)
-
     params_tree, rest = partition(model)
     opt_state = method.init_state(params_tree)
 
@@ -230,12 +270,10 @@ def _bench(jax, dev):
     x = jnp.asarray(x_np)
     y = jnp.asarray(y_np)
 
-    # AOT compile ONCE; the same executable serves cost analysis and the
-    # timed loop (a second trace/compile would double the startup cost).
-    t_c = time.perf_counter()
+    t_c = time.monotonic()
     compiled = jitted.lower(params_tree, rest, opt_state, x, y).compile()
-    sys.stderr.write(
-        f"[bench] raw step compiled in {time.perf_counter() - t_c:.1f}s\n")
+    _update(compile_s=round(time.monotonic() - t_c, 1))
+    _log(f"raw step compiled in {time.monotonic() - t_c:.1f}s")
 
     # FLOPs per step, preferring XLA's own cost analysis of the program
     # we actually execute (fwd+bwd+update); analytic ResNet-50 fallback.
@@ -252,96 +290,187 @@ def _bench(jax, dev):
     if flops_per_step is None:
         # 4.089e9 MACs fwd per 224px image; x2 FLOP/MAC; train ~ 3x fwd
         flops_per_step = 3 * 2 * 4.089e9 * batch * (size / 224.0) ** 2
+    _update(flops_per_step=flops_per_step)
+    return compiled, (params_tree, rest, opt_state, x, y), (x_np, y_np)
 
-    # warmup (float() forces real completion; see _measure_peak)
+
+def phase_raw_step(on_tpu: bool, batch: int, size: int):
+    compiled, state, host_batch = _build_step(on_tpu, batch, size)
+    params_tree, rest, opt_state, x, y = state
+
+    # warmup (float() forces real completion on the tunneled backend)
     params_tree, rest, opt_state, loss = compiled(
         params_tree, rest, opt_state, x, y)
-    float(loss)
+    _log(f"warmup step done, loss={float(loss):.3f}")
 
-    iters = 20 if on_tpu else 3
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        params_tree, rest, opt_state, loss = compiled(
-            params_tree, rest, opt_state, x, y)
-    float(loss)
-    dt = time.perf_counter() - t0
-    raw_step_time = dt / iters
-    raw_img_per_sec = batch / raw_step_time
+    # Timed loops in escalating rep counts: land a coarse number fast,
+    # refine while budget remains.
+    for iters in ((5, 20) if on_tpu else (2, 3)):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            params_tree, rest, opt_state, loss = compiled(
+                params_tree, rest, opt_state, x, y)
+        float(loss)
+        dt = time.perf_counter() - t0
+        _update(raw_step_time_ms=round(dt / iters * 1e3, 2),
+                raw_step_img_per_sec=round(batch / (dt / iters), 2))
+        _log(f"raw step: {dt / iters * 1e3:.2f} ms/step over {iters} iters "
+             f"({batch / (dt / iters):.1f} img/s)")
+    return host_batch
 
-    # ---- the framework loop: Optimizer.optimize() on a 1-chip mesh ------
-    opt_step_time = opt_img_per_sec = None
-    opt_error = None
-    try:
-        from bigdl_tpu.dataset.dataset import DataSet, MiniBatch
-        from bigdl_tpu.optim import Optimizer, Trigger
 
-        iters_per_epoch = 20 if on_tpu else 3
-        epochs = 4
-        # The batches share one host buffer, so the HBM cache holds it
-        # once; epochs after the first pay zero host->device transfer
-        # (cache_on_device ≙ the reference's CachedDistriDataSet).
-        data = _TimedData(
-            DataSet.array([MiniBatch(x_np, y_np)
-                           for _ in range(iters_per_epoch)], shuffle=False)
-            .cache_on_device())
-        model2 = resnet50(class_num=1000)
-        opt = (Optimizer(model2, data, nn.CrossEntropyCriterion())
-               .set_optim_method(SGD(0.1, momentum=0.9, dampening=0.0))
-               .set_end_when(Trigger.max_epoch(epochs))
-               .set_compute_dtype(jnp.bfloat16)
-               .set_log_interval(iters_per_epoch))
-        t_c = time.perf_counter()
-        opt.optimize()
-        sys.stderr.write(f"[bench] optimizer loop ({epochs} epochs) in "
-                         f"{time.perf_counter() - t_c:.1f}s\n")
-        # epoch 1 pays trace+compile; steady state = best later epoch
-        starts = data.epoch_starts
-        epoch_times = [b - a for a, b in zip(starts[1:], starts[2:])]
-        opt_step_time = min(epoch_times) / iters_per_epoch
-        opt_img_per_sec = batch / opt_step_time
-    except Exception as e:
-        import traceback
-        sys.stderr.write(traceback.format_exc())
-        opt_error = f"{type(e).__name__}: {e}"
+class _TimedData:
+    """Wraps a dataset with per-epoch iterator timestamps, so the bench
+    can time steady-state epochs of the real Optimizer loop."""
 
-    def mfu(per_step_flops, step_time, peak):
-        if not (peak and on_tpu and step_time):
-            return None
-        return round(per_step_flops / step_time / peak, 4)
+    def __init__(self, inner):
+        self.inner = inner
+        self.epoch_starts = []
 
-    headline = opt_img_per_sec if opt_img_per_sec else raw_img_per_sec
-    out = {
-        "metric": f"resnet50_train_img_per_sec_bs{batch}_{size}px_"
-                  f"{dev.platform}",
-        "value": round(headline, 2),
-        "unit": "images/sec/chip",
-        # reference: ~35 img/s per 32-core executor (module docstring)
-        "vs_baseline": round(headline / 35.0, 2),
-        "raw_step_img_per_sec": round(raw_img_per_sec, 2),
-        "raw_step_time_ms": round(raw_step_time * 1e3, 2),
-        "flops_per_step": flops_per_step,
-        "peak_measured_flops": peak_measured,
-        "device_kind": getattr(dev, "device_kind", dev.platform),
-    }
-    if opt_img_per_sec:
-        out["optimizer_img_per_sec"] = round(opt_img_per_sec, 2)
-        out["optimizer_step_time_ms"] = round(opt_step_time * 1e3, 2)
-        overhead = 1.0 - opt_img_per_sec / raw_img_per_sec
-        out["optimizer_overhead_pct"] = round(100.0 * overhead, 1)
-    if opt_error:
-        out["optimizer_error"] = opt_error
-    m_spec = mfu(flops_per_step, opt_step_time or raw_step_time, peak_spec)
-    m_meas = mfu(flops_per_step, opt_step_time or raw_step_time,
-                 peak_measured)
-    if m_spec is not None:
-        out["mfu_vs_spec"] = m_spec
-        if m_spec > 1.0:
-            # >100% of nominal spec: the spec denominator is wrong for
-            # this (virtualized) part — trust mfu_vs_measured instead
-            out["mfu_vs_spec_suspect"] = True
-    if m_meas is not None:
-        out["mfu_vs_measured"] = m_meas
-    _emit(out)
+    def data(self, train=True):
+        self.epoch_starts.append(time.perf_counter())
+        return self.inner.data(train)
+
+    def size(self) -> int:
+        return self.inner.size()
+
+
+def phase_optimizer_loop(on_tpu: bool, batch: int, size: int, host_batch):
+    """The framework loop: Optimizer.optimize() on a 1-chip mesh.  This
+    is the headline path (matches the reference's Throughput telemetry,
+    optim/DistriOptimizer.scala:425-431)."""
+    import jax.numpy as jnp
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.dataset.dataset import DataSet, MiniBatch
+    from bigdl_tpu.models import resnet50
+    from bigdl_tpu.optim import Optimizer, Trigger
+    from bigdl_tpu.optim.methods import SGD
+
+    x_np, y_np = host_batch
+    iters_per_epoch = 10 if on_tpu else 3
+    epochs = 4
+    # The batches share one host buffer, so the HBM cache holds it once;
+    # epochs after the first pay zero host->device transfer
+    # (cache_on_device ≙ the reference's CachedDistriDataSet).
+    data = _TimedData(
+        DataSet.array([MiniBatch(x_np, y_np)
+                       for _ in range(iters_per_epoch)], shuffle=False)
+        .cache_on_device())
+    model2 = resnet50(class_num=1000)
+    opt = (Optimizer(model2, data, nn.CrossEntropyCriterion())
+           .set_optim_method(SGD(0.1, momentum=0.9, dampening=0.0))
+           .set_end_when(Trigger.max_epoch(epochs))
+           .set_compute_dtype(jnp.bfloat16)
+           .set_log_interval(iters_per_epoch))
+    t_c = time.monotonic()
+    opt.optimize()
+    _log(f"optimizer loop ({epochs} epochs) in {time.monotonic() - t_c:.1f}s")
+    # epoch 1 pays trace+compile; steady state = best later epoch
+    starts = data.epoch_starts
+    epoch_times = [b - a for a, b in zip(starts[1:], starts[2:])]
+    if epoch_times:
+        step_t = min(epoch_times) / iters_per_epoch
+        upd = dict(optimizer_step_time_ms=round(step_t * 1e3, 2),
+                   optimizer_img_per_sec=round(batch / step_t, 2))
+        raw = RESULT.get("raw_step_img_per_sec")
+        if raw:
+            upd["optimizer_overhead_pct"] = round(
+                100.0 * (1.0 - (batch / step_t) / raw), 1)
+        _update(**upd)
+
+
+def phase_roofline(on_tpu: bool):
+    """Empirical bf16 matmul roofline: chained square matmuls (each
+    output feeds the next so XLA cannot elide any), timed after warmup
+    with a scalar readback.  Escalating sizes, each its own sub-deadline:
+    the r03 hang at 8192 can cost at most one slice of budget now, and a
+    smaller measured roofline is kept as a lower bound."""
+    import jax
+    import jax.numpy as jnp
+
+    chain_len = 8
+
+    def measure(n, reps):
+        @jax.jit
+        def chain(a, b):
+            for _ in range(chain_len):
+                a = jnp.matmul(a, b, preferred_element_type=jnp.bfloat16)
+            return a
+
+        a = jnp.full((n, n), 0.5, jnp.bfloat16)
+        b = jnp.full((n, n), 1e-4, jnp.bfloat16)
+
+        def run(r):
+            out = a
+            for _ in range(r):
+                out = chain(out, b)
+            return float(jnp.sum(out, dtype=jnp.float32))
+
+        run(1)  # compile chain + the readback reduction
+        t0 = time.perf_counter()
+        run(reps)
+        dt = time.perf_counter() - t0
+        peak = 2.0 * n * n * n * chain_len * reps / dt
+        _log(f"roofline n={n}: {peak / 1e12:.1f} TFLOP/s bf16 ({dt:.2f}s)")
+        return peak
+
+    sizes = ((2048, 16), (4096, 16), (8192, 8)) if on_tpu else ((512, 2),)
+    best = None
+    for n, reps in sizes:
+        if _remaining() < 45.0:
+            _log(f"roofline: skipping n>={n} (budget)")
+            break
+        # each size gets its own abandonment deadline via a nested phase
+        val = run_phase(f"roofline_{n}", lambda n=n, r=reps: measure(n, r),
+                        deadline_s=40.0)
+        if val is None:
+            break  # a wedged dispatch rarely recovers; keep lower bound
+        best = max(best or 0.0, val)
+        _update(peak_measured_flops=best)
+    return best
+
+
+# ---------------------------------------------------------------------------
+
+def main():
+    _start_watchdog()
+    dev = run_phase("backend_init", phase_backend, deadline_s=150.0)
+    if dev is None:
+        _emit_final("backend_init_failed")
+        return
+
+    on_tpu = dev.platform != "cpu"
+    batch = 128 if on_tpu else 8
+    size = 224 if on_tpu else 64
+    _update(metric=f"resnet50_train_img_per_sec_bs{batch}_{size}px_"
+                   f"{dev.platform}")
+
+    host_batch = run_phase(
+        "raw_step", lambda: phase_raw_step(on_tpu, batch, size),
+        deadline_s=240.0)
+    if host_batch is None:
+        rng = np.random.default_rng(0)
+        host_batch = (rng.normal(size=(batch, size, size, 3)).astype(
+            np.float32), rng.integers(1, 1001, size=(batch,)))
+
+    if _remaining() > 90.0:
+        run_phase("optimizer_loop",
+                  lambda: phase_optimizer_loop(on_tpu, batch, size,
+                                               host_batch),
+                  deadline_s=180.0)
+    else:
+        RESULT["phases"]["optimizer_loop"] = "skipped (budget)"
+    if _remaining() > 60.0:
+        run_phase("roofline", lambda: phase_roofline(on_tpu),
+                  deadline_s=150.0)
+    else:
+        RESULT["phases"]["roofline"] = "skipped (budget)"
+
+    _emit_final("done")
+    # hard-exit: abandoned phase threads may be wedged inside native XLA
+    # calls; normal interpreter teardown can SIGABRT after our JSON is
+    # already out — exit 0 deliberately once the result line is printed
+    os._exit(0)
 
 
 if __name__ == "__main__":

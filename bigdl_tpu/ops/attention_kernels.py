@@ -14,10 +14,19 @@ TPU-first redesign:
   running (max, sum) recurrence, so HBM traffic is O(T) not O(T²) and the
   QK^T / PV matmuls hit the MXU at [block_q, d] × [d, block_k] tile sizes.
 
+  Training-ready: the function carries a ``jax.custom_vjp`` whose
+  backward is itself blockwise Pallas — the forward additionally emits
+  the per-row logsumexp, and the backward recomputes P tile-by-tile
+  (dQ kernel gridded over Q blocks; dK/dV kernel gridded over K blocks),
+  never materializing the [Tq, Tk] score matrix.  The bias cotangent IS
+  O(Tq·Tk); it is produced by a *separate* pallas_call so that when the
+  bias is not differentiated (causal/padding masks — the common case)
+  jit's dead-code elimination drops that kernel entirely.
+
 * :func:`dot_product_attention` — the public entry: dispatches to the
   Pallas kernel on TPU (when shapes tile cleanly) and to a pure-XLA
   einsum implementation elsewhere; both paths are numerically equivalent
-  (tested against each other and against torch SDPA).
+  (tested against each other and against torch SDPA, values and grads).
 
 Shapes follow [batch, heads, length, head_dim] ("BHTD").
 """
@@ -26,7 +35,7 @@ from __future__ import annotations
 
 import functools
 import os
-from typing import Optional
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -72,24 +81,36 @@ def xla_attention(q, k, v, bias=None, *, causal: bool = False,
 
 
 # ---------------------------------------------------------------------------
-# Pallas flash kernel
+# Pallas flash kernels
 # ---------------------------------------------------------------------------
 
-def _flash_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, *,
-                  block_k: int, causal: bool, scale: float, block_q: int):
+class _FlashCfg(NamedTuple):
+    """Static kernel configuration (hashable: used as a custom_vjp
+    nondiff argument)."""
+    causal: bool
+    scale: float
+    block_q: int
+    block_k: int
+    interpret: bool
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, *,
+                      cfg: _FlashCfg):
     """One (batch*head, q-block) program: stream K/V blocks, online softmax.
 
     Refs are VMEM tiles: q_ref [block_q, d]; k_ref/v_ref [Tk, d] (whole
     K/V for this batch-head — fine for the Tk ≲ 4k tiles we target; the
     ring-attention layer shards longer sequences before this kernel);
-    bias_ref [block_q, Tk] or None; o_ref [block_q, d].
+    bias_ref [block_q, Tk] or None; o_ref [block_q, d]; lse_ref
+    [block_q, 1] (per-row logsumexp saved for the backward).
     """
+    block_q, block_k = cfg.block_q, cfg.block_k
     q_idx = pl.program_id(1)
     tk = k_ref.shape[0]
     d = q_ref.shape[1]
     nblocks = tk // block_k
 
-    q = q_ref[...].astype(jnp.float32) * scale
+    q = q_ref[...].astype(jnp.float32) * cfg.scale
 
     def body(i, carry):
         acc, m_prev, l_prev = carry
@@ -101,7 +122,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, *,
         if bias_ref is not None:
             s = s + bias_ref[:, pl.dslice(i * block_k, block_k)].astype(
                 jnp.float32)
-        if causal:
+        if cfg.causal:
             q_pos = q_idx * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             k_pos = i * block_k + jax.lax.broadcasted_iota(
@@ -120,7 +141,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, *,
     acc0 = jnp.zeros((block_q, d), jnp.float32)
     m0 = jnp.full((block_q,), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((block_q,), jnp.float32)
-    if causal:
+    if cfg.causal:
         # skip fully-masked K blocks beyond the diagonal
         nblocks_eff = jnp.minimum(
             nblocks, ((q_idx + 1) * block_q + block_k - 1) // block_k)
@@ -128,13 +149,376 @@ def _flash_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, *,
     else:
         acc, m, l = jax.lax.fori_loop(0, nblocks, body, (acc0, m0, l0))
     o_ref[...] = (acc / l[:, None]).astype(o_ref.dtype)
+    lse_ref[...] = (m + jnp.log(l))[:, None].astype(jnp.float32)
+
+
+def _fwd_impl(q, k, v, bias, cfg: _FlashCfg):
+    """Run the forward kernel; returns (out [B,H,Tq,D], lse [B*H,Tq,1])."""
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    block_q, block_k = cfg.block_q, cfg.block_k
+
+    qr = q.reshape(b * h, tq, d)
+    kr = k.reshape(b * h, tk, d)
+    vr = v.reshape(b * h, tk, d)
+
+    in_specs = [
+        pl.BlockSpec((None, block_q, d), lambda bh, i: (bh, i, 0)),
+        pl.BlockSpec((None, tk, d), lambda bh, i: (bh, 0, 0)),
+        pl.BlockSpec((None, tk, d), lambda bh, i: (bh, 0, 0)),
+    ]
+    args = [qr, kr, vr]
+    if bias is not None:
+        biasr = jnp.broadcast_to(bias, (b, h, tq, tk)).reshape(b * h, tq, tk)
+        in_specs.append(
+            pl.BlockSpec((None, block_q, tk), lambda bh, i: (bh, i, 0)))
+        args.append(biasr)
+        kern = functools.partial(_flash_fwd_kernel, cfg=cfg)
+    else:
+        def kern(q_ref, k_ref, v_ref, o_ref, lse_ref):
+            _flash_fwd_kernel(q_ref, k_ref, v_ref, None, o_ref, lse_ref,
+                              cfg=cfg)
+
+    out, lse = pl.pallas_call(
+        kern,
+        grid=(b * h, tq // block_q),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((None, block_q, d), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((None, block_q, 1), lambda bh, i: (bh, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, tq, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, tq, 1), jnp.float32),
+        ],
+        interpret=cfg.interpret,
+    )(*args)
+    return out.reshape(b, h, tq, d), lse
+
+
+def _recompute_p(q_scaled, k_blk, bias_blk, lse, q_pos0, k_pos0, cfg,
+                 shape):
+    """Shared tile recompute for the backward kernels: the normalized
+    softmax tile P = exp(s - lse) (masked entries → exp(-1e9-lse) = 0)."""
+    s = jax.lax.dot_general(q_scaled, k_blk, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    if bias_blk is not None:
+        s = s + bias_blk
+    if cfg.causal:
+        q_pos = q_pos0 + jax.lax.broadcasted_iota(jnp.int32, shape, 0)
+        k_pos = k_pos0 + jax.lax.broadcasted_iota(jnp.int32, shape, 1)
+        s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+    return jnp.exp(s - lse), s
+
+
+def _flash_dq_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
+                     delta_ref, dq_ref, *, cfg: _FlashCfg):
+    """dQ for one (batch*head, q-block): stream K/V blocks.
+    dQ = scale * Σ_blocks [P ∘ (dO V^T − Δ)] K."""
+    block_q, block_k = cfg.block_q, cfg.block_k
+    q_idx = pl.program_id(1)
+    tk = k_ref.shape[0]
+    d = q_ref.shape[1]
+    nblocks = tk // block_k
+
+    q = q_ref[...].astype(jnp.float32) * cfg.scale
+    do = do_ref[...].astype(jnp.float32)
+    lse = lse_ref[...].astype(jnp.float32)        # [block_q, 1]
+    delta = delta_ref[...].astype(jnp.float32)    # [block_q, 1]
+
+    def body(i, acc):
+        k_blk = k_ref[pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
+        bias_blk = None
+        if bias_ref is not None:
+            bias_blk = bias_ref[:, pl.dslice(i * block_k, block_k)].astype(
+                jnp.float32)
+        p, _ = _recompute_p(q, k_blk, bias_blk, lse,
+                            q_idx * block_q, i * block_k, cfg,
+                            (block_q, block_k))
+        dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        return acc + jax.lax.dot_general(
+            ds, k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    if cfg.causal:
+        nblocks_eff = jnp.minimum(
+            nblocks, ((q_idx + 1) * block_q + block_k - 1) // block_k)
+        acc = jax.lax.fori_loop(0, nblocks_eff, body, acc0)
+    else:
+        acc = jax.lax.fori_loop(0, nblocks, body, acc0)
+    dq_ref[...] = (acc * cfg.scale).astype(dq_ref.dtype)
+
+
+def _flash_dkv_kernel(k_ref, v_ref, q_ref, bias_ref, do_ref, lse_ref,
+                      delta_ref, dk_ref, dv_ref, *, cfg: _FlashCfg):
+    """dK/dV for one (batch*head, k-block): stream Q/dO blocks.
+    dV = P^T dO;  dK = scale * [P ∘ (dO V^T − Δ)]^T Q."""
+    block_q, block_k = cfg.block_q, cfg.block_k
+    k_idx = pl.program_id(1)
+    tq = q_ref.shape[0]
+    d = k_ref.shape[1]
+    nblocks = tq // block_q
+
+    k = k_ref[...].astype(jnp.float32)
+    v = v_ref[...].astype(jnp.float32)
+
+    def body(i, carry):
+        dk_acc, dv_acc = carry
+        q_blk = q_ref[pl.dslice(i * block_q, block_q), :].astype(
+            jnp.float32) * cfg.scale
+        do_blk = do_ref[pl.dslice(i * block_q, block_q), :].astype(
+            jnp.float32)
+        lse_blk = lse_ref[pl.dslice(i * block_q, block_q), :].astype(
+            jnp.float32)
+        delta_blk = delta_ref[pl.dslice(i * block_q, block_q), :].astype(
+            jnp.float32)
+        bias_blk = None
+        if bias_ref is not None:
+            bias_blk = bias_ref[pl.dslice(i * block_q, block_q), :].astype(
+                jnp.float32)
+        p, _ = _recompute_p(q_blk, k, bias_blk, lse_blk,
+                            i * block_q, k_idx * block_k, cfg,
+                            (block_q, block_k))
+        dv_acc = dv_acc + jax.lax.dot_general(
+            p, do_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do_blk, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_blk)
+        # q_blk already carries `scale`, so this accumulates scale·ds^T·q
+        dk_acc = dk_acc + jax.lax.dot_general(
+            ds, q_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dk_acc, dv_acc
+
+    acc0 = (jnp.zeros((block_k, d), jnp.float32),
+            jnp.zeros((block_k, d), jnp.float32))
+    if cfg.causal:
+        # q blocks strictly before this k block are fully masked
+        i_start = (k_idx * block_k) // block_q
+        dk, dv = jax.lax.fori_loop(i_start, nblocks, body, acc0)
+    else:
+        dk, dv = jax.lax.fori_loop(0, nblocks, body, acc0)
+    dk_ref[...] = dk.astype(dk_ref.dtype)
+    dv_ref[...] = dv.astype(dv_ref.dtype)
+
+
+def _flash_dbias_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
+                        delta_ref, ds_ref, *, cfg: _FlashCfg):
+    """dBias tile [block_q, Tk] for one (batch*head, q-block): dS itself.
+    Materializes O(Tq·Tk) — only ever run when the bias is actually
+    differentiated (a separate pallas_call so jit DCE removes it when the
+    bias is a constant mask)."""
+    block_q, block_k = cfg.block_q, cfg.block_k
+    q_idx = pl.program_id(1)
+    tk = k_ref.shape[0]
+    nblocks = tk // block_k
+
+    q = q_ref[...].astype(jnp.float32) * cfg.scale
+    do = do_ref[...].astype(jnp.float32)
+    lse = lse_ref[...].astype(jnp.float32)
+    delta = delta_ref[...].astype(jnp.float32)
+
+    def body(i, _):
+        k_blk = k_ref[pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
+        bias_blk = bias_ref[:, pl.dslice(i * block_k, block_k)].astype(
+            jnp.float32)
+        p, _s = _recompute_p(q, k_blk, bias_blk, lse,
+                             q_idx * block_q, i * block_k, cfg,
+                             (block_q, block_k))
+        dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds_ref[:, pl.dslice(i * block_k, block_k)] = (
+            p * (dp - delta)).astype(ds_ref.dtype)
+        return 0
+
+    jax.lax.fori_loop(0, nblocks, body, 0)
+
+
+def _bwd_prep(q, k, bias, out, do):
+    """Shared backward prologue: flattened (B*H) views, Δ, broadcast bias.
+    Δ_i = Σ_d dO_id · O_id  (= Σ_j P_ij dP_ij), computed once in XLA."""
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    dor = do.reshape(b * h, tq, d)
+    delta = jnp.sum(dor.astype(jnp.float32)
+                    * out.reshape(b * h, tq, d).astype(jnp.float32),
+                    axis=-1, keepdims=True)
+    biasr = None
+    if bias is not None:
+        biasr = jnp.broadcast_to(bias, (b, h, tq, tk)).reshape(b * h, tq, tk)
+    return dor, delta, biasr
+
+
+def _bwd_impl(q, k, v, bias, out, lse, do, cfg: _FlashCfg, *,
+              prep=None):
+    """Blockwise backward: returns (dq, dk, dv)."""
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    block_q, block_k = cfg.block_q, cfg.block_k
+
+    qr = q.reshape(b * h, tq, d)
+    kr = k.reshape(b * h, tk, d)
+    vr = v.reshape(b * h, tk, d)
+    dor, delta, biasr = prep if prep is not None else _bwd_prep(
+        q, k, bias, out, do)
+
+    q_spec = pl.BlockSpec((None, block_q, d), lambda bh, i: (bh, i, 0))
+    kv_full = pl.BlockSpec((None, tk, d), lambda bh, i: (bh, 0, 0))
+    row_spec = pl.BlockSpec((None, block_q, 1), lambda bh, i: (bh, i, 0))
+
+    # ---- dQ: grid over q blocks --------------------------------------
+    dq_specs = [q_spec, kv_full, kv_full]
+    dq_args = [qr, kr, vr]
+    if biasr is not None:
+        dq_specs.append(
+            pl.BlockSpec((None, block_q, tk), lambda bh, i: (bh, i, 0)))
+        dq_args.append(biasr)
+        dq_kern = functools.partial(_flash_dq_kernel, cfg=cfg)
+    else:
+        def dq_kern(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dq_ref):
+            _flash_dq_kernel(q_ref, k_ref, v_ref, None, do_ref, lse_ref,
+                             delta_ref, dq_ref, cfg=cfg)
+    dq_args += [dor, lse, delta]
+    dq_specs += [q_spec, row_spec, row_spec]
+    dq = pl.pallas_call(
+        dq_kern,
+        grid=(b * h, tq // block_q),
+        in_specs=dq_specs,
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((b * h, tq, d), q.dtype),
+        interpret=cfg.interpret,
+    )(*dq_args)
+
+    # ---- dK/dV: grid over k blocks -----------------------------------
+    kblk_spec = pl.BlockSpec((None, block_k, d), lambda bh, j: (bh, j, 0))
+    q_full = pl.BlockSpec((None, tq, d), lambda bh, j: (bh, 0, 0))
+    row_full = pl.BlockSpec((None, tq, 1), lambda bh, j: (bh, 0, 0))
+    dkv_specs = [kblk_spec, kblk_spec, q_full]
+    dkv_args = [kr, vr, qr]
+    if biasr is not None:
+        dkv_specs.append(
+            pl.BlockSpec((None, tq, block_k), lambda bh, j: (bh, 0, j)))
+        dkv_args.append(biasr)
+        dkv_kern = functools.partial(_flash_dkv_kernel, cfg=cfg)
+    else:
+        def dkv_kern(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
+                     dk_ref, dv_ref):
+            _flash_dkv_kernel(k_ref, v_ref, q_ref, None, do_ref, lse_ref,
+                              delta_ref, dk_ref, dv_ref, cfg=cfg)
+    dkv_args += [dor, lse, delta]
+    dkv_specs += [q_full, row_full, row_full]
+    dk, dv = pl.pallas_call(
+        dkv_kern,
+        grid=(b * h, tk // block_k),
+        in_specs=dkv_specs,
+        out_specs=[kblk_spec, kblk_spec],
+        out_shape=[jax.ShapeDtypeStruct((b * h, tk, d), k.dtype),
+                   jax.ShapeDtypeStruct((b * h, tk, d), v.dtype)],
+        interpret=cfg.interpret,
+    )(*dkv_args)
+
+    return (dq.reshape(b, h, tq, d), dk.reshape(b, h, tk, d),
+            dv.reshape(b, h, tk, d))
+
+
+def _dbias_impl(q, k, v, bias, lse, cfg: _FlashCfg, *, prep):
+    """Bias cotangent dS, reduced back to the (possibly broadcast) bias
+    shape.  A standalone pallas_call: unused ⇒ DCE'd under jit."""
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    block_q = cfg.block_q
+
+    qr = q.reshape(b * h, tq, d)
+    kr = k.reshape(b * h, tk, d)
+    vr = v.reshape(b * h, tk, d)
+    dor, delta, biasr = prep
+
+    q_spec = pl.BlockSpec((None, block_q, d), lambda bh, i: (bh, i, 0))
+    kv_full = pl.BlockSpec((None, tk, d), lambda bh, i: (bh, 0, 0))
+    row_spec = pl.BlockSpec((None, block_q, 1), lambda bh, i: (bh, i, 0))
+    wide = pl.BlockSpec((None, block_q, tk), lambda bh, i: (bh, i, 0))
+
+    ds = pl.pallas_call(
+        functools.partial(_flash_dbias_kernel, cfg=cfg),
+        grid=(b * h, tq // block_q),
+        in_specs=[q_spec, kv_full, kv_full, wide, q_spec, row_spec,
+                  row_spec],
+        out_specs=wide,
+        out_shape=jax.ShapeDtypeStruct((b * h, tq, tk), jnp.float32),
+        interpret=cfg.interpret,
+    )(qr, kr, vr, biasr, dor, lse, delta)
+
+    ds = ds.reshape(b, h, tq, tk)
+    # un-broadcast: right-align the bias shape against [B, H, Tq, Tk]
+    # (numpy broadcasting aligns trailing dims), then sum over every dim
+    # the original bias had as 1 (or lacked entirely)
+    aligned = (1,) * (4 - bias.ndim) + tuple(bias.shape)
+    for axis, (full, orig) in enumerate(zip((b, h, tq, tk), aligned)):
+        if orig == 1 and full != 1:
+            ds = jnp.sum(ds, axis=axis, keepdims=True)
+    while ds.ndim > bias.ndim:
+        ds = jnp.squeeze(ds, axis=0)
+    return ds.astype(bias.dtype)
+
+
+# ---- custom_vjp wiring ----------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _flash3(q, k, v, cfg: _FlashCfg):
+    out, _ = _fwd_impl(q, k, v, None, cfg)
+    return out
+
+
+def _flash3_fwd(q, k, v, cfg):
+    out, lse = _fwd_impl(q, k, v, None, cfg)
+    return out, (q, k, v, out, lse)
+
+
+def _flash3_bwd(cfg, res, do):
+    q, k, v, out, lse = res
+    return _bwd_impl(q, k, v, None, out, lse, do, cfg)
+
+
+_flash3.defvjp(_flash3_fwd, _flash3_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def _flash4(q, k, v, bias, cfg: _FlashCfg):
+    out, _ = _fwd_impl(q, k, v, bias, cfg)
+    return out
+
+
+def _flash4_fwd(q, k, v, bias, cfg):
+    out, lse = _fwd_impl(q, k, v, bias, cfg)
+    return out, (q, k, v, bias, out, lse)
+
+
+def _flash4_bwd(cfg, res, do):
+    q, k, v, bias, out, lse = res
+    prep = _bwd_prep(q, k, bias, out, do)
+    dq, dk, dv = _bwd_impl(q, k, v, bias, out, lse, do, cfg, prep=prep)
+    dbias = _dbias_impl(q, k, v, bias, lse, cfg, prep=prep)
+    return dq, dk, dv, dbias
+
+
+_flash4.defvjp(_flash4_fwd, _flash4_bwd)
 
 
 def flash_attention(q, k, v, bias=None, *, causal: bool = False,
                     scale: Optional[float] = None,
                     block_q: int = 128, block_k: int = 128,
                     interpret: bool = False):
-    """Blockwise online-softmax attention as a Pallas TPU kernel.
+    """Blockwise online-softmax attention as a Pallas TPU kernel, with a
+    blockwise Pallas backward (``jax.custom_vjp``) so it is safe under
+    ``jax.grad`` — the reference trains its Transformer/Attention stack
+    (nn/Transformer.scala:749, nn/Attention.scala), so must we.
 
     Requires Tq % block_q == 0 and Tk % block_k == 0 (the public
     :func:`dot_product_attention` pads/dispatches).  bias, if given, must
@@ -150,39 +534,12 @@ def flash_attention(q, k, v, bias=None, *, causal: bool = False,
         # end-aligned (tril k=tk-tq) — refuse the ambiguous case instead
         # of silently diverging
         raise ValueError("flash_attention causal requires tq == tk")
-
-    qr = q.reshape(b * h, tq, d)
-    kr = k.reshape(b * h, tk, d)
-    vr = v.reshape(b * h, tk, d)
-
-    in_specs = [
-        pl.BlockSpec((None, block_q, d), lambda bh, i: (bh, i, 0)),
-        pl.BlockSpec((None, tk, d), lambda bh, i: (bh, 0, 0)),
-        pl.BlockSpec((None, tk, d), lambda bh, i: (bh, 0, 0)),
-    ]
-    args = [qr, kr, vr]
-    if bias is not None:
-        bias = jnp.broadcast_to(bias, (b, h, tq, tk)).reshape(b * h, tq, tk)
-        in_specs.append(
-            pl.BlockSpec((None, block_q, tk), lambda bh, i: (bh, i, 0)))
-        args.append(bias)
-        kern = functools.partial(_flash_kernel, block_k=block_k,
-                                 causal=causal, scale=scale, block_q=block_q)
-    else:
-        def kern(q_ref, k_ref, v_ref, o_ref):
-            _flash_kernel(q_ref, k_ref, v_ref, None, o_ref,
-                          block_k=block_k, causal=causal, scale=scale,
-                          block_q=block_q)
-
-    out = pl.pallas_call(
-        kern,
-        grid=(b * h, tq // block_q),
-        in_specs=in_specs,
-        out_specs=pl.BlockSpec((None, block_q, d), lambda bh, i: (bh, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, tq, d), q.dtype),
-        interpret=interpret,
-    )(*args)
-    return out.reshape(b, h, tq, d)
+    cfg = _FlashCfg(causal=bool(causal), scale=float(scale),
+                    block_q=int(block_q), block_k=int(block_k),
+                    interpret=bool(interpret))
+    if bias is None:
+        return _flash3(q, k, v, cfg)
+    return _flash4(q, k, v, bias, cfg)
 
 
 # ---------------------------------------------------------------------------
