@@ -38,8 +38,13 @@ __all__ = ["TransformerLM", "transformer_lm"]
 
 class TransformerLM(Module):
     """``forward(tokens [B,T] int, 1-based; 0 = padding) → logits
-    [B, T, vocab+1]`` (index 0 of the logit axis is the padding id and
-    is never a target)."""
+    [B, T, vocab+1]``.
+
+    Logit-axis convention (locked by test_train_then_generate_token_
+    convention): the framework's criteria are 1-based — target token t
+    trains logit index t-1 — so logit index 0 is token 1's TRAINED slot
+    and the LAST index (vocab_size) is the only never-trained row.
+    Generation therefore emits ``argmax + 1`` and masks the last row."""
 
     def __init__(self, vocab_size: int, hidden_size: int = 256,
                  num_layers: int = 4, num_heads: int = 4,
@@ -72,7 +77,9 @@ class TransformerLM(Module):
         projection weights are SHARED with the existing Attention
         modules, so this toggles execution strategy, not parameters.
         The ring applies the causal mask itself; padded batches are not
-        supported on this path (contiguous LM batching has none)."""
+        supported on this path (contiguous LM batching has none): a
+        padded batch raises ValueError eagerly, and NaN-poisons the
+        output under jit (tracers can't raise on data)."""
         from bigdl_tpu.parallel.ring_attention import RingSelfAttention
         for blk in self.blocks:
             if isinstance(blk.self_attn, RingSelfAttention):
@@ -97,7 +104,23 @@ class TransformerLM(Module):
         x = x + position_encoding(T, self.hidden_size, dtype=x.dtype)
         if self.seq_parallel:
             # ring attention applies causality per block pair; an
-            # additive bias would defeat its O(T/n) memory (docstring)
+            # additive bias would defeat its O(T/n) memory.  Padded
+            # batches are NOT supported here — fail loudly instead of
+            # silently diverging from the dense path (contiguous LM
+            # batching has none): eagerly that's a ValueError; under
+            # jit (tokens traced) the activations are NaN-poisoned so
+            # the loss/logits are unmistakably wrong, not subtly so
+            if not isinstance(tokens, jax.core.Tracer):
+                if bool(jnp.any(tokens == 0)):
+                    raise ValueError(
+                        "sequence-parallel TransformerLM does not "
+                        "support padded batches (token 0): the ring "
+                        "path has no padding mask; use contiguous LM "
+                        "batching")
+            else:
+                x = x + jnp.where(jnp.any(tokens == 0),
+                                  jnp.asarray(jnp.nan, x.dtype),
+                                  jnp.asarray(0, x.dtype))
             bias = None
         else:
             bias = causal_bias(T, dtype=x.dtype) \
@@ -208,11 +231,14 @@ class TransformerLM(Module):
         return {"layers": new_layers, "pad": pad_cols}
 
     @staticmethod
-    def _mask_padding_logit(logits):
-        """Logit index 0 is the padding id and never a target, so its
-        tied-head row is untrained noise — it must not win argmax/top_k."""
+    def _mask_untrained_logit(logits):
+        """The framework's criteria are 1-based (ClassNLL/CrossEntropy:
+        target token t trains logit index t-1), so logit index
+        ``vocab_size`` (the last row of the tied head) is never a target
+        and stays untrained noise — it must not win argmax/top_k.
+        (Logit index 0 IS trained: it is token 1's slot.)"""
         neg = jnp.asarray(-1e9, logits.dtype)
-        return logits.at[..., 0].set(neg)
+        return logits.at[..., -1].set(neg)
 
     def generate(self, prompt, max_new_tokens: int, eos_id=None):
         """Greedy continuation: ``prompt [B, Tp]`` →
@@ -229,8 +255,10 @@ class TransformerLM(Module):
         def gen_step(carry, t):
             tok, caches, done = carry
             logits, caches = self.decode_step(tok, t, caches)
-            nxt = jnp.argmax(self._mask_padding_logit(logits),
-                             axis=-1).astype(jnp.int32)
+            # logit index i is token i+1's slot (1-based criteria), so
+            # the emitted token id is argmax + 1
+            nxt = jnp.argmax(self._mask_untrained_logit(logits),
+                             axis=-1).astype(jnp.int32) + 1
             nxt = jnp.where(done, 0, nxt)
             if eos_id is not None:
                 done = done | (nxt == eos_id)
@@ -261,19 +289,33 @@ class TransformerLM(Module):
         # per-beam replication/gathering
         cache = dict(caches, tok0=prompt[:, -1:])
         vocab = self.embedding.weight.shape[0]
-        search = SequenceBeamSearch(vocab, beam_size, alpha,
-                                    max_new_tokens, eos_id)
+        # the search operates in LOGIT-INDEX space (ids start at 0 =
+        # pad/start, reference SequenceBeamSearch.scala); our criteria
+        # are 1-based, so EOS token id t lives at logit index t-1
+        search = SequenceBeamSearch(
+            vocab, beam_size, alpha, max_new_tokens,
+            eos_id - 1 if eos_id >= 0 else eos_id)
 
         def logits_fn(ids, i, cache):
-            tok = jnp.where(i == 0, cache["tok0"], ids.astype(jnp.int32))
+            # ids are the previous step's logit indices → token id + 1
+            tok = jnp.where(i == 0, cache["tok0"],
+                            ids.astype(jnp.int32) + 1)
             logits, sub = self.decode_step(
                 tok, Tp - 1 + i,
                 {"layers": cache["layers"], "pad": cache["pad"]})
-            return self._mask_padding_logit(logits), dict(
+            return self._mask_untrained_logit(logits), dict(
                 sub, tok0=cache["tok0"])
 
         search.set_logit_fn(logits_fn)
-        return search.search(B, cache)
+        seqs, scores = search.search(B, cache)
+        # back to token-id space; re-pad positions after the first EOS
+        # (they were 0 in index space and must stay 0 in token space)
+        toks = seqs + 1
+        if eos_id >= 0:
+            eos_before = jnp.cumsum(toks == eos_id, axis=-1) \
+                - (toks == eos_id)
+            toks = jnp.where(eos_before > 0, 0, toks)
+        return toks, scores
 
 
 def transformer_lm(vocab_size: int, hidden_size: int = 256,

@@ -580,7 +580,12 @@ class Gather(Operation):
 
     def forward(self, xs):
         params, indices = xs
-        return jnp.take(params, jnp.asarray(indices, jnp.int32), axis=0)
+        # TF gather errors on out-of-bounds on CPU and zero-fills on
+        # GPU; jnp.take's default silently CLAMPS (neither).  Zero-fill
+        # (the TF-GPU behavior) is the XLA-friendly choice that never
+        # returns a wrong-but-plausible row
+        return jnp.take(params, jnp.asarray(indices, jnp.int32), axis=0,
+                        mode="fill", fill_value=0)
 
 
 class InTopK(Operation):
@@ -627,6 +632,11 @@ class SegmentSum(Operation):
         segment_ids = jnp.asarray(segment_ids, jnp.int32)
         num = self.num_segments
         if num is None:
+            if isinstance(segment_ids, jax.core.Tracer):
+                raise ValueError(
+                    "SegmentSum under jit needs a static segment count: "
+                    "construct it as SegmentSum(num_segments=N) (the "
+                    "output shape cannot depend on traced values)")
             num = int(np.asarray(segment_ids)[-1]) + 1 \
                 if segment_ids.size else 0
         return jax.ops.segment_sum(data, segment_ids, num_segments=num)

@@ -8,6 +8,7 @@ import pytest
 
 from bigdl_tpu.core.module import combine, partition
 from bigdl_tpu.models import transformer_lm
+from bigdl_tpu.models.transformer_lm import TransformerLM
 from bigdl_tpu.utils import set_seed
 
 import bigdl_tpu.nn as nn
@@ -145,7 +146,9 @@ def test_greedy_generate_consistent_with_full_forward():
     seq = np.asarray(prompt)
     for t in range(5):
         logits = np.asarray(m.forward(jnp.asarray(seq)))[:, -1]
-        nxt = int(np.argmax(logits, axis=-1)[0])
+        # 1-based criterion convention: logit index i = token i+1's
+        # slot; the untrained last row is excluded from the argmax
+        nxt = int(np.argmax(logits[:, :-1], axis=-1)[0]) + 1
         assert out[0, 4 + t] == nxt, (t, out, nxt)
         seq = np.concatenate([seq, [[nxt]]], axis=1)
 
@@ -190,21 +193,89 @@ def test_incremental_decode_matches_full_forward_with_padding():
                                    rtol=2e-4, atol=2e-5)
 
 
-def test_generate_never_emits_padding_token():
-    """Logit 0 (the untrained padding row of the tied head) must be
-    masked out of argmax/top_k."""
+def test_generate_never_emits_untrained_or_pad_token():
+    """The tied head's LAST logit row (index vocab_size) is never a
+    criterion target (1-based convention: target t trains index t-1),
+    so it must be masked out of argmax/top_k — otherwise generation
+    could emit the out-of-vocab token vocab_size+1.  Token 0 (padding)
+    must never be emitted either."""
     m = _model().eval_mode()
-    # bias the model so token 0's logit would dominate if unmasked
+    # bias the model so the untrained last row would dominate if unmasked
     from bigdl_tpu.core.module import Parameter
     w = np.array(m.embedding.weight)  # writable copy
-    w[0] = 10.0  # giant norm: with LN'd hidden, logit 0 would win
+    w[-1] = 10.0  # giant norm: with LN'd hidden, the last logit wins
     m.embedding.weight = Parameter(jnp.asarray(w))
     rng = np.random.default_rng(10)
     prompt = jnp.asarray(rng.integers(1, 51, (2, 3)), jnp.int32)
     out = np.asarray(m.generate(prompt, max_new_tokens=6))
     assert (out[:, 3:] != 0).all(), out
+    assert (out[:, 3:] <= 50).all(), out   # never the out-of-vocab id
     seqs, _ = m.generate_beam(prompt, beam_size=2, max_new_tokens=4)
     assert (np.asarray(seqs) != 0).all(), seqs
+    assert (np.asarray(seqs) <= 50).all(), seqs
+
+
+def test_train_then_generate_token_convention():
+    """ADVICE r03 (high): a model trained with the framework's own
+    1-based criteria must generate the continuation in TOKEN space —
+    train next=cur+1, prompt [5,6,7,8] must continue 9,10,11 (the bug
+    emitted raw logit indices, i.e. 8,8,8 shifted down by one)."""
+    from bigdl_tpu.core.module import partition, combine
+    from bigdl_tpu.optim.methods import Adam
+
+    set_seed(1)
+    vocab = 20
+    m = TransformerLM(vocab, hidden_size=32, num_layers=1, num_heads=2,
+                      filter_size=64, max_len=16)
+    rng = np.random.default_rng(2)
+    starts = rng.integers(1, vocab - 8, size=(64,))
+    seqs = starts[:, None] + np.arange(9)[None, :]   # ascending runs
+    x = jnp.asarray(seqs[:, :-1], jnp.int32)
+    y = jnp.asarray(seqs[:, 1:], jnp.int32)
+    crit = nn.CrossEntropyCriterion()
+    params, rest = partition(m)
+    method = Adam(5e-3)
+    state = method.init_state(params)
+
+    @jax.jit
+    def step(p, s):
+        def loss_fn(p):
+            logits = combine(p, rest).forward(x)
+            return crit(logits.reshape(-1, vocab + 1), y.reshape(-1))
+        loss, g = jax.value_and_grad(loss_fn)(p)
+        p, s = method.update(g, p, s)
+        return p, s, loss
+
+    for _ in range(120):
+        params, state, loss = step(params, state)
+    trained = combine(params, rest).eval_mode()
+    out = np.asarray(trained.generate(
+        jnp.asarray([[5, 6, 7, 8]], jnp.int32), max_new_tokens=3))
+    np.testing.assert_array_equal(out[0], [5, 6, 7, 8, 9, 10, 11])
+    seqs_b, _ = trained.generate_beam(
+        jnp.asarray([[5, 6, 7, 8]], jnp.int32), beam_size=2,
+        max_new_tokens=3)
+    np.testing.assert_array_equal(np.asarray(seqs_b)[0, 0], [9, 10, 11])
+
+
+def test_sequence_parallel_rejects_padded_batch():
+    """ADVICE r03 (medium): the ring path has no padding mask — padded
+    batches must fail loudly, not silently diverge from dense."""
+    from jax.sharding import Mesh
+
+    m = _model(max_len=64).eval_mode()
+    mesh = Mesh(np.asarray(jax.devices()[:8]), ("seq",))
+    m.set_sequence_parallel(mesh, "seq")
+    toks = np.ones((2, 16), np.int32)
+    toks[1, 10:] = 0
+    with pytest.raises(ValueError, match="padded"):
+        m.forward(jnp.asarray(toks))
+    # under jit the tokens are traced and can't raise: the output must
+    # be NaN-poisoned (loudly wrong), while a clean batch stays finite
+    jf = jax.jit(m.forward)
+    assert not np.isfinite(np.asarray(jf(jnp.asarray(toks)))).all()
+    clean = np.ones((2, 16), np.int32)
+    assert np.isfinite(np.asarray(jf(jnp.asarray(clean)))).all()
 
 
 def test_sequence_parallel_matches_dense():
