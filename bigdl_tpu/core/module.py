@@ -486,7 +486,10 @@ class Module:
     def __repr__(self):
         parts = []
         for n, p in self._params.items():
-            parts.append(f"{n}:{tuple(p.shape)}")
+            # p can be None on a partition()'d half — repr must never
+            # throw (error messages embed it)
+            parts.append(
+                f"{n}:{tuple(p.shape) if hasattr(p, 'shape') else p!r}")
         inner = ", ".join(parts)
         subs = "".join(
             "\n  " + repr(m).replace("\n", "\n  ") for m in self.modules())

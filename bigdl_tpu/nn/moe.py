@@ -6,20 +6,24 @@ expert parallelism is new TPU-first capability (SURVEY §2.6: EP absent
 from the reference).
 
 Design: top-k token routing with load-balancing auxiliary loss (the
-standard Shazeer/Switch recipe).  Two execution paths:
+standard Shazeer/Switch recipe).  Three execution paths:
 
 * dense (single device / no expert axis): every expert runs over all
   tokens via ``vmap`` over stacked expert parameters; outputs combine
   with the routing weights.  O(E·T) compute — exact, used for tests and
   small E.
-* expert-parallel (``forward_on_mesh``): experts are sharded over the
-  ``expert`` mesh axis under shard_map; each device computes ONLY its
-  local experts' contribution for all tokens and the weighted partial
-  outputs are ``psum``'d over the axis.  Routing weights zero out
-  non-selected experts so the psum reconstructs the exact dense result.
-  (Capacity-based all_to_all dispatch is a further optimization; the
-  psum formulation is exact and keeps the MXU busy at E/n experts per
-  chip.)
+* expert-parallel all_to_all (``set_mesh(..., capacity_factor=f)``) —
+  THE scalable path: tokens are sharded over the expert axis alongside
+  the experts; each device builds a capacity-bounded dispatch for its
+  local S = B·T/n tokens (position-in-expert via cumsum, overflow
+  DROPPED per the Switch policy), ships [E, C, H] expert buffers with
+  ``lax.all_to_all``, runs its E/n local experts over the n·C received
+  slots, and reverses the exchange to combine.  Per-device activation
+  memory is O(f·k·B·T·H/n) — tokens/device, NOT the full batch.
+* expert-parallel psum fallback (``capacity_factor=None``): each device
+  computes its local experts' contribution over fully-replicated
+  activations and psums.  Exact (no capacity drops) but O(B·T·H)
+  replicated memory — right for small E / small batches only.
 """
 
 from __future__ import annotations
@@ -36,6 +40,10 @@ from bigdl_tpu.nn.linear import Linear
 from bigdl_tpu.utils.rng import next_key
 
 __all__ = ["MoE"]
+
+# Per-device (inside-shard_map) buffer shapes of the most recent a2a
+# trace — a debug/test hook (module attrs would pollute the pytree).
+LAST_A2A_SHAPES = {}
 
 
 class MoE(Module):
@@ -59,22 +67,37 @@ class MoE(Module):
         self.aux_loss = jnp.zeros(())
         self.expert_mesh = None
         self.expert_axis = "expert"
+        self.capacity_factor = None
 
-    def set_mesh(self, mesh: Mesh, axis: str = "expert") -> "MoE":
+    def set_mesh(self, mesh: Mesh, axis: str = "expert",
+                 capacity_factor: Optional[float] = None) -> "MoE":
         """Route ``forward`` through the expert-parallel path on this
         mesh, so the layer composes with the Optimizer (whose jitted
-        step just calls ``model.forward``)."""
+        step just calls ``model.forward``).
+
+        ``capacity_factor``: when set, use capacity-based all_to_all
+        token dispatch (per-expert, per-source-device capacity
+        C = max(1, round(f·k·S/E)) with S = B·T/n local tokens; tokens
+        beyond capacity are dropped, Switch-style).  ``None`` keeps the
+        exact psum fallback (replicated activations — small E only)."""
         self.expert_mesh = mesh
         self.expert_axis = axis
+        self.capacity_factor = capacity_factor
         return self
 
     # -- routing -----------------------------------------------------------
 
-    def _route(self, x):
-        """Returns combine weights [B, T, E] (zero for non-top-k) and
-        stores the load-balancing aux loss."""
+    def _gate_probs(self, x):
+        """Softmax routing probabilities [B, T, E] (fp32)."""
         logits = self.gate(x)  # [B, T, E]
-        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        return jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+
+    def _route(self, x, probs=None):
+        """Returns combine weights [B, T, E] (zero for non-top-k) and
+        stores the load-balancing aux loss.  ``probs`` lets a caller
+        that already ran the gate avoid running it twice."""
+        if probs is None:
+            probs = self._gate_probs(x)
         top_vals, _ = jax.lax.top_k(probs, self.top_k)
         thresh = top_vals[..., -1:]
         mask = probs >= thresh
@@ -108,9 +131,100 @@ class MoE(Module):
         outs = self._apply_stacked(self._stacked_experts(), x)  # [E,B,T,H]
         return jnp.einsum("ebth,bte->bth", outs, weights)
 
-    # -- expert-parallel path ---------------------------------------------
+    # -- expert-parallel paths --------------------------------------------
+
+    def _dispatch_combine(self, probs, capacity: int):
+        """Capacity-bounded dispatch/combine tensors for S local tokens.
+
+        probs [S, E] fp32 → (dispatch [S, E, C] 0/1, combine [S, E, C]).
+        Slot-by-slot greedy assignment (top-1 choices claim positions
+        before top-2, the Switch/GShard priority); position-in-expert by
+        cumsum over the device-local token order; tokens whose position
+        exceeds the capacity are dropped (their combine weight is 0 —
+        the residual stream carries them unchanged)."""
+        S, E = probs.shape
+        top_vals, top_idx = jax.lax.top_k(probs, self.top_k)
+        denom = jnp.sum(top_vals, axis=-1, keepdims=True)  # renormalize
+        dispatch = jnp.zeros((S, E, capacity), jnp.float32)
+        combine = jnp.zeros((S, E, capacity), jnp.float32)
+        counts = jnp.zeros((E,), jnp.int32)
+        for slot in range(self.top_k):
+            mask = jax.nn.one_hot(top_idx[:, slot], E,
+                                  dtype=jnp.int32)       # [S, E]
+            pos_e = jnp.cumsum(mask, axis=0) - mask + counts[None, :]
+            pos = jnp.sum(pos_e * mask, axis=1)          # [S]
+            counts = counts + jnp.sum(mask, axis=0)
+            keep = (pos < capacity).astype(jnp.float32)  # overflow drop
+            slot_hot = (mask.astype(jnp.float32)[:, :, None]
+                        * jax.nn.one_hot(pos, capacity)[:, None, :]
+                        * keep[:, None, None])           # [S, E, C]
+            dispatch = dispatch + slot_hot
+            w = (top_vals[:, slot] / denom[:, 0])
+            combine = combine + slot_hot * w[:, None, None]
+        return dispatch, combine
 
     def forward_on_mesh(self, x, mesh: Mesh, axis: str = "expert"):
+        if self.capacity_factor is not None:
+            return self._forward_a2a(x, mesh, axis, self.capacity_factor)
+        return self._forward_psum(x, mesh, axis)
+
+    def _forward_a2a(self, x, mesh: Mesh, axis: str,
+                     capacity_factor: float):
+        """Scalable EP: tokens sharded over the expert axis; per-device
+        capacity-bounded dispatch; two all_to_all exchanges bracket the
+        local expert compute.  Per-device shapes (recorded in
+        the module-level ``LAST_A2A_SHAPES`` while tracing, for the memory
+        test): dispatch [S, E, C], expert buffers [E, C, H] and
+        [E/n, n·C, H] — all O(B·T/n), never the full batch."""
+        B, T, H = x.shape
+        E, k = self.num_experts, self.top_k
+        n = mesh.shape[axis]
+        s_total = B * T
+        assert E % n == 0, (E, n)
+        assert s_total % n == 0, (s_total, n)
+        S = s_total // n
+        capacity = max(1, int(round(capacity_factor * k * S / E)))
+
+        # routing probs computed once, full-batch (the gate is tiny);
+        # aux loss uses the pre-capacity mask exactly like the dense path
+        probs = self._gate_probs(x)                   # [B, T, E]
+        self._route(x, probs=probs)                   # sets self.aux_loss
+        xf = x.reshape(s_total, H)
+        pf = probs.reshape(s_total, E)
+        stacked = self._stacked_experts()
+
+        moe = self
+
+        def shard_fn(stacked_local, x_loc, p_loc):
+            # x_loc [S, H]; p_loc [S, E]; stacked_local leaves [E/n, ...]
+            dispatch, combine = moe._dispatch_combine(p_loc, capacity)
+            expert_in = jnp.einsum("sec,sh->ech", dispatch,
+                                   x_loc.astype(jnp.float32))  # [E, C, H]
+            expert_in = expert_in.astype(x_loc.dtype)
+            # ship each device its local experts' slots from everyone
+            recv = jax.lax.all_to_all(expert_in, axis, split_axis=0,
+                                      concat_axis=1, tiled=True)
+            # recv [E/n, n*C, H]
+            LAST_A2A_SHAPES.update(
+                dispatch=dispatch.shape, expert_in=expert_in.shape,
+                recv=recv.shape)
+            outs = jax.vmap(lambda tree, xe: tree(xe),
+                            in_axes=(0, 0))(stacked_local, recv)
+            back = jax.lax.all_to_all(outs, axis, split_axis=1,
+                                      concat_axis=0, tiled=True)
+            # back [E, C, H]
+            y = jnp.einsum("sec,ech->sh", combine,
+                           back.astype(jnp.float32))
+            return y.astype(x_loc.dtype)
+
+        fn = jax.shard_map(
+            shard_fn, mesh=mesh,
+            in_specs=(jax.tree_util.tree_map(lambda _: P(axis), stacked),
+                      P(axis), P(axis)),
+            out_specs=P(axis), check_vma=False)
+        return fn(stacked, xf, pf).reshape(B, T, H)
+
+    def _forward_psum(self, x, mesh: Mesh, axis: str = "expert"):
         n = mesh.shape[axis]
         assert self.num_experts % n == 0, (self.num_experts, n)
         weights = self._route(x)

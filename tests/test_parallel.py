@@ -214,6 +214,82 @@ def test_moe_backward_on_mesh_matches_dense():
                                    rtol=1e-3, atol=1e-5)
 
 
+def test_moe_a2a_matches_dense_at_ample_capacity():
+    """The capacity-based all_to_all EP path (VERDICT r03 #4) must equal
+    the dense path exactly when nothing overflows — forward and grads."""
+    from bigdl_tpu.core.module import partition, combine
+    from bigdl_tpu.utils import set_seed
+    set_seed(4)
+    moe = MoE(16, [nn.FeedForwardNetwork(16, 32) for _ in range(8)],
+              top_k=2).eval_mode()
+    x = rnd(2, 6, 16, seed=21)   # B*T = 12 tokens, S = 3 per device
+    params, rest = partition(moe)
+    mesh = Mesh(np.array(jax.devices()[:4]), ("expert",))
+
+    def loss_dense(p):
+        return jnp.sum(combine(p, rest).forward(x) ** 2)
+
+    def loss_a2a(p):
+        m = combine(p, rest).set_mesh(mesh, capacity_factor=4.0)
+        with mesh:
+            return jnp.sum(m.forward(x) ** 2)
+
+    with mesh:
+        out_a2a = combine(params, rest).set_mesh(
+            mesh, capacity_factor=4.0).forward(x)
+    out_dense = combine(params, rest).forward(x)
+    np.testing.assert_allclose(np.asarray(out_a2a), np.asarray(out_dense),
+                               rtol=1e-4, atol=1e-5)
+
+    g_d = jax.grad(loss_dense)(params)
+    g_m = jax.grad(loss_a2a)(params)
+    for a, b in zip(jax.tree_util.tree_leaves(g_d),
+                    jax.tree_util.tree_leaves(g_m)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-3, atol=1e-5)
+
+
+def test_moe_a2a_per_device_memory_is_tokens_over_n():
+    """Per-device activation buffers on the a2a path are O(B·T/n) —
+    dispatch [S, E, C] and expert buffers [E/n, n·C, H] with S=B·T/n —
+    not the full replicated batch the psum fallback uses."""
+    from bigdl_tpu.utils import set_seed
+    set_seed(4)
+    B, T, H, E, k, n = 2, 8, 16, 8, 2, 4
+    moe = MoE(H, [nn.FeedForwardNetwork(H, 32) for _ in range(E)],
+              top_k=k).eval_mode()
+    x = rnd(B, T, H, seed=22)
+    mesh = Mesh(np.array(jax.devices()[:n]), ("expert",))
+    f = 2.0
+    S = B * T // n
+    C = max(1, round(f * k * S / E))
+    with mesh:
+        out = moe.set_mesh(mesh, capacity_factor=f).forward(x)
+    assert out.shape == (B, T, H)
+    from bigdl_tpu.nn.moe import LAST_A2A_SHAPES as shapes
+    assert shapes["dispatch"] == (S, E, C), shapes
+    assert shapes["expert_in"] == (E, C, H), shapes
+    assert shapes["recv"] == (E // n, n * C, H), shapes
+
+
+def test_moe_a2a_capacity_overflow_drops_tokens():
+    """With a starvation-level capacity the layer must stay finite and
+    diverge from dense (dropped tokens contribute zero), locking the
+    Switch overflow policy."""
+    from bigdl_tpu.utils import set_seed
+    set_seed(4)
+    moe = MoE(16, [nn.FeedForwardNetwork(16, 32) for _ in range(4)],
+              top_k=2).eval_mode()
+    x = rnd(2, 8, 16, seed=23)
+    dense = np.asarray(moe.forward(x))
+    mesh = Mesh(np.array(jax.devices()[:4]), ("expert",))
+    with mesh:
+        tiny = np.asarray(
+            moe.set_mesh(mesh, capacity_factor=0.25).forward(x))
+    assert np.isfinite(tiny).all()
+    assert not np.allclose(tiny, dense, atol=1e-4)
+
+
 def _train_seq_model(build, mesh_cfg=None, n_iter=3):
     """Optimizer-driven training of a [B,T,H]->[B,T,H] model against an
     MSE target; returns final loss + trained params."""
@@ -285,6 +361,15 @@ def test_moe_optimizer_training_equivalence():
     loss_m, params_m = _train_seq_model(mesh_build, mesh_cfg=cfg)
     np.testing.assert_allclose(loss_m, loss_d, rtol=1e-4)
     for a, b in zip(params_d, params_m):
+        np.testing.assert_allclose(a, b, rtol=1e-3, atol=1e-5)
+
+    def a2a_build():
+        return MoE(16, [nn.FeedForwardNetwork(16, 32) for _ in range(4)],
+                   top_k=2).set_mesh(mesh, capacity_factor=2.0)
+
+    loss_a, params_a = _train_seq_model(a2a_build, mesh_cfg=cfg)
+    np.testing.assert_allclose(loss_a, loss_d, rtol=1e-4)
+    for a, b in zip(params_d, params_a):
         np.testing.assert_allclose(a, b, rtol=1e-3, atol=1e-5)
 
 
