@@ -92,21 +92,27 @@ class MoE(Module):
         logits = self.gate(x)  # [B, T, E]
         return jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
 
+    def _set_aux_loss(self, probs, mask):
+        """Switch-style load-balancing loss:
+        E · Σ_e (fraction routed to e)·(mean prob of e)."""
+        frac = jnp.mean(mask.astype(jnp.float32), axis=(0, 1))
+        mean_p = jnp.mean(probs, axis=(0, 1))
+        self.aux_loss = self.num_experts * jnp.sum(frac * mean_p)
+
+    def _topk_mask(self, probs):
+        top_vals, _ = jax.lax.top_k(probs, self.top_k)
+        return probs >= top_vals[..., -1:]
+
     def _route(self, x, probs=None):
         """Returns combine weights [B, T, E] (zero for non-top-k) and
         stores the load-balancing aux loss.  ``probs`` lets a caller
         that already ran the gate avoid running it twice."""
         if probs is None:
             probs = self._gate_probs(x)
-        top_vals, _ = jax.lax.top_k(probs, self.top_k)
-        thresh = top_vals[..., -1:]
-        mask = probs >= thresh
+        mask = self._topk_mask(probs)
         weights = jnp.where(mask, probs, 0.0)
         weights = weights / jnp.sum(weights, axis=-1, keepdims=True)
-        # Switch-style aux loss: E * Σ_e (fraction routed to e)·(mean prob e)
-        frac = jnp.mean(mask.astype(jnp.float32), axis=(0, 1))
-        mean_p = jnp.mean(probs, axis=(0, 1))
-        self.aux_loss = self.num_experts * jnp.sum(frac * mean_p)
+        self._set_aux_loss(probs, mask)
         return weights.astype(x.dtype)
 
     def _stacked_experts(self):
@@ -186,9 +192,10 @@ class MoE(Module):
         capacity = max(1, int(round(capacity_factor * k * S / E)))
 
         # routing probs computed once, full-batch (the gate is tiny);
-        # aux loss uses the pre-capacity mask exactly like the dense path
+        # aux loss uses the pre-capacity mask exactly like the dense
+        # path (per-shard top_k for dispatch happens in _dispatch_combine)
         probs = self._gate_probs(x)                   # [B, T, E]
-        self._route(x, probs=probs)                   # sets self.aux_loss
+        self._set_aux_loss(probs, self._topk_mask(probs))
         xf = x.reshape(s_total, H)
         pf = probs.reshape(s_total, E)
         stacked = self._stacked_experts()
