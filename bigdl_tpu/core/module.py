@@ -437,6 +437,36 @@ class Module:
     def is_frozen(self) -> bool:
         return bool(self._static.get("_frozen", False))
 
+    # -- per-layer regularizers + gradient lr-scaling ----------------------
+    # (≙ layer wRegularizer/bRegularizer ctor args, nn/Linear.scala:48 +
+    # AbstractModule.setScaleW/setScaleB; applied by the Optimizer's step
+    # as pure per-leaf transforms — see optim/regularizer.py)
+
+    def set_regularizers(self, w_regularizer=None,
+                         b_regularizer=None) -> "Module":
+        """Attach L1/L2/L1L2 regularizers to THIS module's own params:
+        ``w_regularizer`` covers params whose name does not contain
+        "bias", ``b_regularizer`` the rest.  Writes the SAME static
+        slots as the layer constructor args (e.g. nn.Linear(...,
+        w_regularizer=...)), so either spelling reaches the optimizer."""
+        self.w_regularizer = w_regularizer
+        self.b_regularizer = b_regularizer
+        return self
+
+    def set_scale_w(self, scale: float) -> "Module":
+        """Gradient scale for weight-like params, propagated to all
+        submodules (≙ AbstractModule.setScaleW; Container propagates)."""
+        self.apply_to_modules(
+            lambda m: m._static.__setitem__("_scale_w", float(scale)))
+        return self
+
+    def set_scale_b(self, scale: float) -> "Module":
+        """Gradient scale for bias params, propagated to all submodules
+        (≙ AbstractModule.setScaleB)."""
+        self.apply_to_modules(
+            lambda m: m._static.__setitem__("_scale_b", float(scale)))
+        return self
+
     # -- misc --------------------------------------------------------------
 
     def clone(self) -> "Module":

@@ -4,6 +4,9 @@ from bigdl_tpu.optim.methods import (
     Default, Step, MultiStep, EpochStep, EpochDecay, Poly, Exponential,
     NaturalExp, Warmup, SequentialSchedule, Plateau, EpochSchedule,
 )
+from bigdl_tpu.optim.regularizer import (
+    Regularizer, L1L2Regularizer, L1Regularizer, L2Regularizer,
+)
 from bigdl_tpu.optim.trigger import Trigger
 from bigdl_tpu.optim.validation import (
     ValidationMethod, ValidationResult, Top1Accuracy, Top5Accuracy,

@@ -241,7 +241,7 @@ class Optimizer:
 
     # ---- the jitted SPMD train step -------------------------------------
 
-    def _build_step(self, mesh, group_names):
+    def _build_step(self, mesh, group_names, spec_groups=None):
         criterion = self.criterion
         clip_const = self.grad_clip_const
         clip_norm = self.grad_clip_norm
@@ -264,6 +264,22 @@ class Optimizer:
 
         merge_groups = self._merge_groups_host  # jit-traceable as-is
 
+        def apply_reg(gs, ps, specs):
+            """Per-layer regularizers + scaleW/scaleB:
+            g_eff = scale·(g + l1·sign(p) + l2·p) — the reference's
+            accGradParameters algebra (optim/Regularizer.scala,
+            nn/Linear.scala:144-166) as a pure leaf transform."""
+            out = []
+            for g, p, (l1, l2, sc) in zip(gs, ps, specs):
+                if l1:
+                    g = g + l1 * jnp.sign(p)
+                if l2:
+                    g = g + l2 * p
+                if sc != 1.0:
+                    g = g * sc
+                out.append(g)
+            return out
+
         def step(params_groups, rest, opt_states, x, y, rng, epoch):
             from bigdl_tpu.core.module import cast_floating
 
@@ -285,6 +301,10 @@ class Optimizer:
 
             (loss, m2), grads_groups = jax.value_and_grad(
                 loss_fn, has_aux=True)(params_groups)
+            if spec_groups is not None:
+                grads_groups = [
+                    apply_reg(g, p, sp) for g, p, sp in
+                    zip(grads_groups, params_groups, spec_groups)]
             grads_groups = [clip(g) for g in grads_groups]
             new_groups, new_states = [], []
             for g, p, s, meth in zip(grads_groups, params_groups,
@@ -444,7 +464,15 @@ class Optimizer:
             saved = jax.tree_util.tree_map(jnp.asarray, saved_opt)
             opt_states = saved
 
-        step = self._build_step(mesh, group_names)
+        from bigdl_tpu.optim.regularizer import leaf_reg_specs
+        leaf_specs = leaf_reg_specs(model)
+        assert len(leaf_specs) == len(leaves)
+        if any(s != (0.0, 0.0, 1.0) for s in leaf_specs):
+            spec_groups = [[leaf_specs[i] for i in idxs]
+                           for idxs in self._group_idx]
+        else:
+            spec_groups = None  # no per-layer reg/scale anywhere
+        step = self._build_step(mesh, group_names, spec_groups)
         eval_step = self._build_eval_step() if self.val_methods else None
         x_sharding = batch_sharding(mesh)
 

@@ -1,0 +1,92 @@
+"""Per-layer L1/L2/L1L2 regularizers and gradient lr-scaling.
+
+Reference: optim/Regularizer.scala — ``L1L2Regularizer(l1, l2)``'s
+``accRegularization(parameter, gradParameter, scale)`` adds
+``scale·l1·sign(p)`` and ``scale·l2·p`` onto the gradient inside each
+layer's ``accGradParameters`` (call sites e.g. nn/Linear.scala:163-166),
+AFTER the raw gradient was itself accumulated with the layer's
+``scaleW``/``scaleB`` factor (nn/Linear.scala:144-158, scales from
+nn/abstractnn/AbstractModule.scala setScaleW/setScaleB).  Net effect per
+parameter:
+
+    g_eff = scale · (g_raw + l1·sign(p) + l2·p)
+
+TPU-native design: layers don't mutate gradients — the Optimizer's
+jitted step applies the same algebra as a pure per-leaf transform,
+driven by (l1, l2, scale) specs collected from the module tree
+(``leaf_reg_specs``, aligned with ``core.module.param_paths`` order).
+Regularizers are frozen dataclasses so they ride the pytree's static
+aux data with stable equality (no spurious recompiles).
+
+Attachment API (on every Module):
+  ``m.set_regularizers(w_regularizer=L2Regularizer(1e-4))`` — this
+  module's own weight-like params (names not containing "bias");
+  ``b_regularizer`` for bias params.
+  ``m.set_scale_w(s)`` / ``m.set_scale_b(s)`` — lr scaling, propagated
+  to submodules like the reference's Container.setScaleW.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Tuple
+
+__all__ = ["Regularizer", "L1L2Regularizer", "L1Regularizer",
+           "L2Regularizer", "leaf_reg_specs"]
+
+
+@dataclass(frozen=True)
+class L1L2Regularizer:
+    """Adds ``l1·sign(p) + l2·p`` to the gradient
+    (≙ optim/Regularizer.scala L1L2Regularizer)."""
+    l1: float = 0.0
+    l2: float = 0.0
+
+
+Regularizer = L1L2Regularizer  # the reference's base trait, one impl
+
+
+def L1Regularizer(l1: float) -> L1L2Regularizer:
+    """≙ optim/Regularizer.scala L1Regularizer (L1L2 with l2=0)."""
+    return L1L2Regularizer(l1=l1)
+
+
+def L2Regularizer(l2: float) -> L1L2Regularizer:
+    """≙ optim/Regularizer.scala L2Regularizer (L1L2 with l1=0)."""
+    return L1L2Regularizer(l2=l2)
+
+
+def leaf_reg_specs(mod) -> List[Tuple[float, float, float]]:
+    """(l1, l2, scale) per trainable-param leaf, aligned with
+    ``core.module.param_paths(mod)`` / ``partition(mod)[0]`` flattening
+    order (frozen modules excluded, exactly like param_paths)."""
+    from bigdl_tpu.core.module import Module, ModuleList
+
+    specs: List[Tuple[float, float, float]] = []
+
+    def rec(obj):
+        if isinstance(obj, Module):
+            if not obj.is_frozen():
+                st = obj._static
+                # the same slots the layer ctor args use
+                # (nn/linear.py:42, nn/conv.py:80)
+                wreg = st.get("w_regularizer")
+                breg = st.get("b_regularizer")
+                sw = float(st.get("_scale_w", 1.0))
+                sb = float(st.get("_scale_b", 1.0))
+                for n in obj._params:
+                    is_bias = "bias" in n
+                    reg = breg if is_bias else wreg
+                    specs.append((
+                        float(getattr(reg, "l1", 0.0) or 0.0),
+                        float(getattr(reg, "l2", 0.0) or 0.0),
+                        sb if is_bias else sw,
+                    ))
+            for n in obj._modules:
+                rec(obj._modules[n])
+        elif isinstance(obj, ModuleList):
+            for m in obj._items:
+                rec(m)
+
+    rec(mod)
+    return specs

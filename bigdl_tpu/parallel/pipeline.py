@@ -190,22 +190,48 @@ class Pipeline(Module):
         return x
 
     def _stacked(self):
-        """Stack per-block pytrees leaf-wise onto a leading stage axis."""
+        """Stack per-block pytrees leaf-wise onto a leading stage axis.
+        Positional (leaf-list) stacking under block 0's treedef, so
+        blocks differing only in display ``name`` still stack."""
         trees = list(self.blocks)
-        return jax.tree_util.tree_map(
-            lambda *leaves: jnp.stack(leaves), *trees)
+        flats = [jax.tree_util.tree_flatten(t)[0] for t in trees]
+        treedef0 = jax.tree_util.tree_structure(trees[0])
+        stacked = [jnp.stack(ls) for ls in zip(*flats)]
+        return jax.tree_util.tree_unflatten(treedef0, stacked)
+
+    @staticmethod
+    def _struct_sig(obj):
+        """Structural signature ignoring the display ``name`` (pure
+        metadata) but keeping everything that affects compute: classes,
+        param/buffer slots, static config, leaf shapes/dtypes.  Blocks
+        renamed for logging must still take the sharded stacked path —
+        falling back to the switch path replicates ALL stages' params
+        on every device (an S-fold memory regression)."""
+        from bigdl_tpu.core.module import Module, ModuleList
+
+        def rec(o):
+            if isinstance(o, Module):
+                return (type(o), tuple(o._params.keys()),
+                        tuple((n, tuple(b.shape), str(b.dtype))
+                              for n, b in o._buffers.items()),
+                        tuple((n, tuple(p.shape), str(p.dtype))
+                              for n, p in o._params.items()),
+                        tuple((n, rec(m)) for n, m in o._modules.items()),
+                        tuple(sorted(o._static.items(),
+                                     key=lambda kv: kv[0])),
+                        o.training)
+            if isinstance(o, ModuleList):
+                return ("modlist", tuple(rec(m) for m in o._items))
+            return ("leaf",)
+
+        return rec(obj)
 
     def _blocks_homogeneous(self) -> bool:
-        """True when EVERY block has the same pytree structure and leaf
-        shapes — the stacked path stacks per-block leaves, so per-stage
+        """True when EVERY block shares a compute-equivalent structure —
+        the stacked path stacks per-block leaves, so per-stage
         similarity is not enough (e.g. [Linear, ReLU] × S must take the
         switch path even though the stages match each other)."""
-        def sig(tree):
-            leaves, treedef = jax.tree_util.tree_flatten(tree)
-            return treedef, tuple(
-                (l.shape, l.dtype) for l in leaves)
-
-        sigs = [sig(b) for b in self.blocks]
+        sigs = [self._struct_sig(b) for b in self.blocks]
         return all(s == sigs[0] for s in sigs[1:])
 
     def forward_on_mesh(self, x, mesh: Mesh, axis: str = "pipe"):
@@ -215,7 +241,9 @@ class Pipeline(Module):
         per_stage = n // s
 
         if self._blocks_homogeneous():
+            LAST_PIPE_SHAPES["layout"] = "stacked"
             return self._forward_stacked(x, mesh, axis, s, per_stage)
+        LAST_PIPE_SHAPES["layout"] = "switch"
         groups = tuple(tuple(list(self.blocks)[i:i + per_stage])
                        for i in range(0, n, per_stage))
         return self._forward_hetero(x, groups, mesh, axis, s)

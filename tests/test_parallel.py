@@ -132,6 +132,26 @@ def test_pipeline_heterogeneous_stages():
                                    rtol=1e-4, atol=1e-5)
 
 
+def test_pipeline_renamed_blocks_stay_stacked():
+    """Blocks differing only in display name (set_name for logging) are
+    compute-identical and MUST take the sharded stacked path — the
+    switch fallback replicates all stages' params on every device."""
+    from bigdl_tpu.utils import set_seed
+    set_seed(6)
+    blocks = [nn.TransformerEncoderLayer(16, 2, 32) for _ in range(4)]
+    for i, b in enumerate(blocks):
+        b.name = f"stage{i}"
+    pipe = Pipeline(blocks, num_microbatches=4).eval_mode()
+    x = rnd(8, 6, 16, seed=18)
+    ref = pipe.forward(x)
+    with Mesh(np.array(jax.devices()[:4]), ("pipe",)) as mesh:
+        out = pipe.forward_on_mesh(x, mesh)
+    from bigdl_tpu.parallel.pipeline import LAST_PIPE_SHAPES
+    assert LAST_PIPE_SHAPES["layout"] == "stacked"
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-5)
+
+
 def test_pipeline_mixed_blocks_within_stage():
     """[Linear, ReLU] × S stages match each other but the BLOCKS differ,
     so per-block stacking is impossible — must route to the switch path
