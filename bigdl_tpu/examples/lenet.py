@@ -23,9 +23,12 @@ def main(argv=None):
     )
 
     if args.synthetic:
-        train, test = (synthetic_mnist(args.synthetic, seed=0),
-                       synthetic_mnist(max(args.synthetic // 4, args.batch_size),
-                                       seed=1))
+        # hold out a split of ONE generation: synthetic_mnist's class
+        # prototypes are seed-dependent, so a differently-seeded test
+        # set would be a different task (validation stuck near chance)
+        n_test = max(args.synthetic // 4, args.batch_size)
+        samples = synthetic_mnist(args.synthetic + n_test, seed=0)
+        train, test = samples[:args.synthetic], samples[args.synthetic:]
     else:
         train = mnist_samples(args.folder, train=True)
         test = mnist_samples(args.folder, train=False)

@@ -7,10 +7,12 @@ here over the ``bigdl_tpu.keras`` layer set.
 
 Supported definitions: Sequential and functional ``Model`` JSON with
 the layer classes in ``_DEF_CONVERTERS``.  Supported weights: Dense,
-Convolution2D (``dim_ordering="tf"``), BatchNormalization, Embedding.
-Explicit boundaries (loud errors, not silent drops): ``"th"``
-(NCHW) image ordering — this framework is NHWC-native — and recurrent
-weight import (per-gate Keras arrays vs our fused cells).
+Convolution2D (``dim_ordering="tf"``), BatchNormalization, Embedding,
+and the recurrent family — LSTM/GRU/SimpleRNN per-gate Keras arrays
+are repacked into our fused cells (same positional semantics as the
+reference's convert_lstm/convert_gru/convert_simplernn).  Explicit
+boundary (loud error, not a silent drop): ``"th"`` (NCHW) image
+ordering — this framework is NHWC-native; re-save with ``"tf"``.
 
 Embedding ids follow this framework's 1-based convention: our id
 ``i + 1`` is Keras index ``i`` (weight rows map directly).
@@ -126,9 +128,21 @@ def _embedding(cfg):
 
 def _recurrent(cls):
     def cv(cfg):
+        if cfg.get("stateful"):
+            raise ValueError(f"{cls.__name__}: stateful=True is not "
+                             f"supported (reference parity)")
+        kw = {}
+        # keras-1.x defaults: activation='tanh',
+        # inner_activation='hard_sigmoid' — honor what the config says
+        # (the reference maps both, converter.py generate_lstm_cell)
+        if "activation" in cfg:
+            kw["activation"] = cfg["activation"]
+        if "inner_activation" in cfg and cls is not KL.SimpleRNN:
+            kw["inner_activation"] = cfg["inner_activation"]
         return cls(int(cfg["output_dim"]),
                    return_sequences=cfg.get("return_sequences", False),
-                   input_shape=_in_shape(cfg))
+                   go_backwards=cfg.get("go_backwards", False),
+                   input_shape=_in_shape(cfg), **kw)
     return cv
 
 
@@ -328,8 +342,15 @@ def _h5_layer_weights(h5path: str) -> Dict[str, List[np.ndarray]]:
 
 def _set_dense(layer, w):
     lin = layer.inner
-    if not hasattr(lin, "weight"):   # Sequential(linear, activation)
-        lin = lin.layers[0] if hasattr(lin, "layers") else lin.modules()[0]
+    while not hasattr(lin, "weight"):
+        # unwrap containers: Sequential(linear, activation) /
+        # TimeDistributed(linear)
+        if hasattr(lin, "layers"):
+            lin = lin.layers[0]
+        elif hasattr(lin, "layer"):
+            lin = lin.layer
+        else:
+            lin = lin.modules()[0]
     lin.weight = Parameter(w[0].T)   # keras (in, out) → ours (out, in)
     if len(w) > 1 and getattr(lin, "bias", None) is not None:
         lin.bias = Parameter(w[1])
@@ -367,9 +388,61 @@ def _set_embedding(layer, w):
     emb.weight = Parameter(w[0])
 
 
+def _rnn_cell(layer):
+    """The fused cell inside a built recurrent wrapper — the Recurrent
+    module may sit behind Reverse (go_backwards) / Select stages."""
+    inner = layer.inner
+    for _, m in [("", inner)] + list(inner.named_modules()):
+        if hasattr(m, "cell"):
+            return m.cell
+    raise ValueError(f"no recurrent cell found inside {layer!r}")
+
+
+def _set_lstm(layer, w):
+    """Keras-1.2.2 LSTM stores 12 per-gate arrays in (i, c, f, o) gate
+    groups: [W_i,U_i,b_i, W_c,U_c,b_c, W_f,U_f,b_f, W_o,U_o,b_o]
+    (reference repacking: pyspark converter.py convert_lstm).  Our
+    fused cell packs columns (i, f, g=c, o); keras keeps (in, out)
+    orientation like us, so no transposes."""
+    if len(w) != 12:
+        raise ValueError(f"LSTM expects 12 weight arrays, got {len(w)}")
+    wi, ui, bi, wc, uc, bc, wf, uf, bf, wo, uo, bo = w
+    cell = _rnn_cell(layer)
+    cell.w_input = Parameter(np.concatenate([wi, wf, wc, wo], axis=1))
+    cell.w_hidden = Parameter(np.concatenate([ui, uf, uc, uo], axis=1))
+    cell.bias = Parameter(np.concatenate([bi, bf, bc, bo]))
+
+
+def _set_gru(layer, w):
+    """Keras-1.2.2 GRU: [W_z,U_z,b_z, W_r,U_r,b_r, W_h,U_h,b_h]
+    (reference convert_gru reads exactly these positions).  Our cell
+    packs (r, z) gates + a separate candidate, like nn/GRU.scala."""
+    if len(w) != 9:
+        raise ValueError(f"GRU expects 9 weight arrays, got {len(w)}")
+    wz, uz, bz, wr, ur, br, wh, uh, bh = w
+    cell = _rnn_cell(layer)
+    cell.w_input = Parameter(np.concatenate([wr, wz, wh], axis=1))
+    cell.w_hidden = Parameter(np.concatenate([ur, uz], axis=1))
+    cell.w_candidate = Parameter(uh)
+    cell.bias = Parameter(np.concatenate([br, bz, bh]))
+
+
+def _set_simplernn(layer, w):
+    """Keras-1.2.2 SimpleRNN: [W, U, b] (reference convert_simplernn)."""
+    if len(w) != 3:
+        raise ValueError(
+            f"SimpleRNN expects 3 weight arrays, got {len(w)}")
+    cell = _rnn_cell(layer)
+    cell.w_input = Parameter(w[0])
+    cell.w_hidden = Parameter(w[1])
+    cell.bias = Parameter(w[2])
+
+
 _WEIGHT_SETTERS = {
     KL.Dense: _set_dense, KL.Convolution2D: _set_conv,
     KL.BatchNormalization: _set_bn, KL.Embedding: _set_embedding,
+    KL.LSTM: _set_lstm, KL.GRU: _set_gru, KL.SimpleRNN: _set_simplernn,
+    KL.TimeDistributedDense: _set_dense,
 }
 
 
@@ -392,8 +465,8 @@ def load_keras_hdf5_weights(model: Module, h5path: str,
         if setter is None:
             raise NotImplementedError(
                 f"weight import for {type(layer).__name__} "
-                f"(layer {lname!r}) is not supported — recurrent and "
-                f"custom layers must be loaded manually")
+                f"(layer {lname!r}) is not supported — custom layers "
+                f"must be loaded manually")
         if not getattr(layer, "built", True):
             raise RuntimeError(
                 f"layer {lname!r} is not built; call model.build("

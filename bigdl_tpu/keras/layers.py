@@ -264,11 +264,22 @@ class Embedding(KerasLayer):
 
 
 class _RecurrentLayer(KerasLayer):
+    """Keras-1.x recurrent defaults: activation='tanh',
+    inner_activation='hard_sigmoid' (NOT plain sigmoid); go_backwards
+    prepends Reverse on the time axis (reference
+    pyspark converter.py __process_recurrent_layer:885-895)."""
+
     def __init__(self, output_dim: int, return_sequences: bool = False,
+                 activation: Optional[str] = "tanh",
+                 inner_activation: Optional[str] = "hard_sigmoid",
+                 go_backwards: bool = False,
                  input_shape: Optional[Sequence[int]] = None):
         super().__init__(input_shape)
         self.output_dim = output_dim
         self.return_sequences = return_sequences
+        self.activation = activation
+        self.inner_activation = inner_activation
+        self.go_backwards = go_backwards
 
     def make_cell(self, input_size):
         raise NotImplementedError
@@ -276,24 +287,36 @@ class _RecurrentLayer(KerasLayer):
     def build_layer(self, input_shape):
         seq_len, feat = input_shape
         rec = nn.Recurrent(self.make_cell(feat))
-        if self.return_sequences:
-            return rec, (seq_len, self.output_dim)
-        return nn.Sequential(rec, nn.Select(2, -1)), (self.output_dim,)
+        stages = ([nn.Reverse(2)] if self.go_backwards else []) + [rec]
+        if not self.return_sequences:
+            stages.append(nn.Select(2, -1))
+        mod = stages[0] if len(stages) == 1 else nn.Sequential(*stages)
+        out = (seq_len, self.output_dim) if self.return_sequences \
+            else (self.output_dim,)
+        return mod, out
 
 
 class LSTM(_RecurrentLayer):
     def make_cell(self, input_size):
-        return nn.LSTM(input_size, self.output_dim)
+        return nn.LSTM(input_size, self.output_dim,
+                       activation=_activation_module(self.activation),
+                       inner_activation=_activation_module(
+                           self.inner_activation))
 
 
 class GRU(_RecurrentLayer):
     def make_cell(self, input_size):
-        return nn.GRU(input_size, self.output_dim)
+        return nn.GRU(input_size, self.output_dim,
+                      activation=_activation_module(self.activation),
+                      inner_activation=_activation_module(
+                          self.inner_activation))
 
 
 class SimpleRNN(_RecurrentLayer):
     def make_cell(self, input_size):
-        return nn.RnnCell(input_size, self.output_dim, nn.Tanh())
+        act = _activation_module(self.activation)
+        return nn.RnnCell(input_size, self.output_dim,
+                          act if act is not None else nn.Tanh())
 
 
 class Highway(KerasLayer):

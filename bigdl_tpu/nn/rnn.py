@@ -193,10 +193,14 @@ class GRU(Cell):
     """GRU (reference nn/GRU.scala). Gate order r,z then candidate."""
 
     def __init__(self, input_size: int, output_size: int, p: float = 0.0,
+                 activation: Optional[Module] = None,
+                 inner_activation: Optional[Module] = None,
                  w_regularizer=None, u_regularizer=None, b_regularizer=None):
         super().__init__()
         self.hidden_size = output_size
         self.p = float(p)
+        self.activation = activation
+        self.inner_activation = inner_activation
         stdv = 1.0 / math.sqrt(output_size)
         self.w_input = Parameter(jax.random.uniform(
             next_key(), (input_size, 3 * output_size),
@@ -218,10 +222,14 @@ class GRU(Cell):
 
     def step(self, xproj_t, h):
         H = self.hidden_size
+        inner = (lambda v: self.inner_activation(v)) \
+            if self.inner_activation else jax.nn.sigmoid
+        act = (lambda v: self.activation(v)) if self.activation \
+            else jnp.tanh
         x_rz, x_g = xproj_t[..., :2 * H], xproj_t[..., 2 * H:]
-        rz = jax.nn.sigmoid(x_rz + h @ self.w_hidden)
+        rz = inner(x_rz + h @ self.w_hidden)
         r, z = jnp.split(rz, 2, axis=-1)
-        g = jnp.tanh(x_g + (r * h) @ self.w_candidate)
+        g = act(x_g + (r * h) @ self.w_candidate)
         h_new = (1 - z) * g + z * h
         return h_new, h_new
 

@@ -477,3 +477,143 @@ def test_keras_time_distributed_single_registration():
     leaves = jax.tree_util.tree_leaves(partition(layer)[0])
     assert len(leaves) == 2, len(leaves)  # weight + bias only
     assert layer.n_parameters() == 7 * 4 + 4
+
+
+# ---- recurrent weight import (VERDICT r03 #9) -----------------------------
+# Keras-1.2.2 per-gate arrays -> fused cells, same positional semantics
+# as the reference's convert_lstm/convert_gru/convert_simplernn
+# (pyspark/bigdl/keras/converter.py:218-241).
+
+def _load_rnn(tmp_path, cls_name, cfg_extra, weights):
+    from bigdl_tpu.keras import load_keras_hdf5_weights, load_keras_json
+    spec = {"class_name": "Sequential", "config": [
+        {"class_name": cls_name, "config": dict({
+            "name": "rnn1", "return_sequences": True}, **cfg_extra)},
+    ]}
+    model = load_keras_json(spec)
+    hp = str(tmp_path / "w.h5")
+    _h5_weights(hp, {"rnn1": weights})
+    load_keras_hdf5_weights(model, hp)
+    return model.eval_mode()
+
+
+def test_keras_lstm_weight_import_matches_torch(tmp_path):
+    """Oracle: torch LSTM == keras-1.2.2 LSTM equations.  Torch packs
+    (i,f,g,o); keras 1.2.2 lists per-gate groups (i,c,f,o)."""
+    tor = pytest.importorskip("torch")
+    T, F, H = 5, 3, 4
+    rng = np.random.RandomState(7)
+    tl = tor.nn.LSTM(F, H, batch_first=True)
+    w_ih = tl.weight_ih_l0.detach().numpy()   # [4H, F] (i,f,g,o)
+    w_hh = tl.weight_hh_l0.detach().numpy()
+    b = (tl.bias_ih_l0 + tl.bias_hh_l0).detach().numpy()
+    gi, gf, gg, go = [slice(k * H, (k + 1) * H) for k in range(4)]
+    weights = [w_ih[gi].T, w_hh[gi].T, b[gi],     # i
+               w_ih[gg].T, w_hh[gg].T, b[gg],     # c (torch "g")
+               w_ih[gf].T, w_hh[gf].T, b[gf],     # f
+               w_ih[go].T, w_hh[go].T, b[go]]     # o
+    # torch gates are plain sigmoid; keras-1.x DEFAULT is hard_sigmoid,
+    # so the config must say sigmoid explicitly for this oracle
+    model = _load_rnn(tmp_path, "LSTM",
+                      {"output_dim": H, "activation": "tanh",
+                       "inner_activation": "sigmoid",
+                       "batch_input_shape": [None, T, F]}, weights)
+    x = rng.randn(2, T, F).astype(np.float32)
+    got = np.asarray(model.forward(jnp.asarray(x)))
+    want, _ = tl(tor.tensor(x))
+    np.testing.assert_allclose(got, want.detach().numpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_keras_gru_weight_import_matches_keras_equations(tmp_path):
+    """Torch GRU applies r AFTER the U_h matmul, keras 1.2.2 before —
+    so the oracle is the keras equations in numpy (z,r,h groups)."""
+    T, F, H = 4, 3, 5
+    rng = np.random.RandomState(8)
+    wz, wr, wh = (rng.randn(F, H).astype(np.float32) for _ in range(3))
+    uz, ur, uh = (rng.randn(H, H).astype(np.float32) for _ in range(3))
+    bz, br, bh = (rng.randn(H).astype(np.float32) * 0.1 for _ in range(3))
+    # keras-1.x default gates are HARD sigmoid: clip(0.2x + 0.5, 0, 1)
+    model = _load_rnn(tmp_path, "GRU",
+                      {"output_dim": H,
+                       "batch_input_shape": [None, T, F]},
+                      [wz, uz, bz, wr, ur, br, wh, uh, bh])
+    x = rng.randn(2, T, F).astype(np.float32)
+    got = np.asarray(model.forward(jnp.asarray(x)))
+
+    def hard_sig(v):
+        return np.clip(0.2 * v + 0.5, 0.0, 1.0)
+
+    h = np.zeros((2, H), np.float32)
+    want = []
+    for t in range(T):
+        xt = x[:, t]
+        z = hard_sig(xt @ wz + h @ uz + bz)
+        r = hard_sig(xt @ wr + h @ ur + br)
+        hh = np.tanh(xt @ wh + (r * h) @ uh + bh)
+        h = z * h + (1 - z) * hh
+        want.append(h)
+    np.testing.assert_allclose(got, np.stack(want, axis=1),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_keras_lstm_default_hard_sigmoid_differs_from_sigmoid(tmp_path):
+    """A default-config keras LSTM must import with hard_sigmoid gates
+    (regression: the converter used to drop inner_activation and the
+    model silently computed sigmoid gates)."""
+    T, F, H = 4, 3, 4
+    rng = np.random.RandomState(11)
+    ws = [rng.randn(*s).astype(np.float32) for s in
+          [(F, H), (H, H), (H,)] * 4]
+    m_default = _load_rnn(tmp_path, "LSTM",
+                          {"output_dim": H,
+                           "batch_input_shape": [None, T, F]}, ws)
+    m_sigmoid = _load_rnn(tmp_path, "LSTM",
+                          {"output_dim": H, "inner_activation": "sigmoid",
+                           "batch_input_shape": [None, T, F]}, ws)
+    x = rng.randn(2, T, F).astype(np.float32) * 2
+    out_d = np.asarray(m_default.forward(jnp.asarray(x)))
+    out_s = np.asarray(m_sigmoid.forward(jnp.asarray(x)))
+    assert not np.allclose(out_d, out_s, atol=1e-4)
+
+
+def test_keras_simplernn_go_backwards(tmp_path):
+    """go_backwards prepends Reverse on the time axis (reference
+    __process_recurrent_layer:885-895)."""
+    T, F, H = 4, 3, 5
+    rng = np.random.RandomState(12)
+    w = rng.randn(F, H).astype(np.float32)
+    u = rng.randn(H, H).astype(np.float32)
+    b = np.zeros(H, np.float32)
+    model = _load_rnn(tmp_path, "SimpleRNN",
+                      {"output_dim": H, "go_backwards": True,
+                       "batch_input_shape": [None, T, F]}, [w, u, b])
+    x = rng.randn(2, T, F).astype(np.float32)
+    got = np.asarray(model.forward(jnp.asarray(x)))
+    h = np.zeros((2, H), np.float32)
+    want = []
+    for t in reversed(range(T)):
+        h = np.tanh(x[:, t] @ w + h @ u + b)
+        want.append(h)
+    np.testing.assert_allclose(got, np.stack(want, axis=1),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_keras_simplernn_weight_import(tmp_path):
+    T, F, H = 4, 3, 5
+    rng = np.random.RandomState(9)
+    w = rng.randn(F, H).astype(np.float32)
+    u = rng.randn(H, H).astype(np.float32)
+    b = rng.randn(H).astype(np.float32) * 0.1
+    model = _load_rnn(tmp_path, "SimpleRNN",
+                      {"output_dim": H,
+                       "batch_input_shape": [None, T, F]}, [w, u, b])
+    x = rng.randn(2, T, F).astype(np.float32)
+    got = np.asarray(model.forward(jnp.asarray(x)))
+    h = np.zeros((2, H), np.float32)
+    want = []
+    for t in range(T):
+        h = np.tanh(x[:, t] @ w + h @ u + b)
+        want.append(h)
+    np.testing.assert_allclose(got, np.stack(want, axis=1),
+                               rtol=1e-4, atol=1e-5)
