@@ -131,6 +131,11 @@ def _recurrent(cls):
         if cfg.get("stateful"):
             raise ValueError(f"{cls.__name__}: stateful=True is not "
                              f"supported (reference parity)")
+        if cfg.get("dropout_U"):
+            raise ValueError(
+                f"{cls.__name__}: dropout_U={cfg['dropout_U']} "
+                f"(recurrent-state dropout) is not supported; "
+                f"dropout_W maps to the cells' input dropout")
         kw = {}
         # keras-1.x defaults: activation='tanh',
         # inner_activation='hard_sigmoid' — honor what the config says
@@ -139,6 +144,10 @@ def _recurrent(cls):
             kw["activation"] = cfg["activation"]
         if "inner_activation" in cfg and cls is not KL.SimpleRNN:
             kw["inner_activation"] = cfg["inner_activation"]
+        if cfg.get("dropout_W") and cls is not KL.SimpleRNN:
+            kw["dropout_w"] = float(cfg["dropout_W"])
+        elif cfg.get("dropout_W"):
+            raise ValueError("SimpleRNN: dropout_W is not supported")
         return cls(int(cfg["output_dim"]),
                    return_sequences=cfg.get("return_sequences", False),
                    go_backwards=cfg.get("go_backwards", False),
@@ -392,7 +401,7 @@ def _rnn_cell(layer):
     """The fused cell inside a built recurrent wrapper — the Recurrent
     module may sit behind Reverse (go_backwards) / Select stages."""
     inner = layer.inner
-    for _, m in [("", inner)] + list(inner.named_modules()):
+    for _, m in inner.named_modules():   # yields inner itself first
         if hasattr(m, "cell"):
             return m.cell
     raise ValueError(f"no recurrent cell found inside {layer!r}")

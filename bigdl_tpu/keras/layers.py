@@ -272,7 +272,7 @@ class _RecurrentLayer(KerasLayer):
     def __init__(self, output_dim: int, return_sequences: bool = False,
                  activation: Optional[str] = "tanh",
                  inner_activation: Optional[str] = "hard_sigmoid",
-                 go_backwards: bool = False,
+                 go_backwards: bool = False, dropout_w: float = 0.0,
                  input_shape: Optional[Sequence[int]] = None):
         super().__init__(input_shape)
         self.output_dim = output_dim
@@ -280,6 +280,14 @@ class _RecurrentLayer(KerasLayer):
         self.activation = activation
         self.inner_activation = inner_activation
         self.go_backwards = go_backwards
+        self.dropout_w = float(dropout_w)
+
+    @staticmethod
+    def _act(name):
+        """Explicit activation module: 'linear' must become Identity,
+        not the cell's tanh/sigmoid default (None means default)."""
+        mod = _activation_module(name)
+        return nn.Identity() if mod is None else mod
 
     def make_cell(self, input_size):
         raise NotImplementedError
@@ -298,25 +306,22 @@ class _RecurrentLayer(KerasLayer):
 
 class LSTM(_RecurrentLayer):
     def make_cell(self, input_size):
-        return nn.LSTM(input_size, self.output_dim,
-                       activation=_activation_module(self.activation),
-                       inner_activation=_activation_module(
-                           self.inner_activation))
+        return nn.LSTM(input_size, self.output_dim, p=self.dropout_w,
+                       activation=self._act(self.activation),
+                       inner_activation=self._act(self.inner_activation))
 
 
 class GRU(_RecurrentLayer):
     def make_cell(self, input_size):
-        return nn.GRU(input_size, self.output_dim,
-                      activation=_activation_module(self.activation),
-                      inner_activation=_activation_module(
-                          self.inner_activation))
+        return nn.GRU(input_size, self.output_dim, p=self.dropout_w,
+                      activation=self._act(self.activation),
+                      inner_activation=self._act(self.inner_activation))
 
 
 class SimpleRNN(_RecurrentLayer):
     def make_cell(self, input_size):
-        act = _activation_module(self.activation)
         return nn.RnnCell(input_size, self.output_dim,
-                          act if act is not None else nn.Tanh())
+                          self._act(self.activation))
 
 
 class Highway(KerasLayer):

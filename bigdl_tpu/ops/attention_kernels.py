@@ -9,16 +9,20 @@ flash/blockwise attention at all).
 TPU-first redesign:
 
 * :func:`flash_attention` — a Pallas TPU kernel implementing blockwise
-  online-softmax attention (Flash-Attention-style): Q tiles stay resident
-  in VMEM, K/V stream through in blocks, the softmax is computed with the
-  running (max, sum) recurrence, so HBM traffic is O(T) not O(T²) and the
-  QK^T / PV matmuls hit the MXU at [block_q, d] × [d, block_k] tile sizes.
+  online-softmax attention (Flash-Attention-style).  K/V/bias are
+  STREAMED block-by-block through the pallas grid (the kernel never
+  holds a full [Tk, d] panel in VMEM — r03's ~4k ceiling is gone): the
+  grid is (batch·heads, q-blocks, k-blocks) with the online-softmax
+  (max, sum, acc) recurrence carried in VMEM scratch across the
+  sequential k dimension, so HBM traffic is O(T) per query block and
+  the QK^T / PV matmuls hit the MXU at [block_q, d] × [d, block_k]
+  tile sizes while Pallas double-buffers the incoming K/V blocks.
 
   Training-ready: the function carries a ``jax.custom_vjp`` whose
   backward is itself blockwise Pallas — the forward additionally emits
   the per-row logsumexp, and the backward recomputes P tile-by-tile
-  (dQ kernel gridded over Q blocks; dK/dV kernel gridded over K blocks),
-  never materializing the [Tq, Tk] score matrix.  The bias cotangent IS
+  (dQ kernel streaming K/V; dK/dV kernel streaming Q/dO), never
+  materializing the [Tq, Tk] score matrix.  The bias cotangent IS
   O(Tq·Tk); it is produced by a *separate* pallas_call so that when the
   bias is not differentiated (causal/padding masks — the common case)
   jit's dead-code elimination drops that kernel entirely.
@@ -81,7 +85,7 @@ def xla_attention(q, k, v, bias=None, *, causal: bool = False,
 
 
 # ---------------------------------------------------------------------------
-# Pallas flash kernels
+# Pallas flash kernels — K/V streamed through the grid
 # ---------------------------------------------------------------------------
 
 class _FlashCfg(NamedTuple):
@@ -94,62 +98,77 @@ class _FlashCfg(NamedTuple):
     interpret: bool
 
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, *,
-                      cfg: _FlashCfg):
-    """One (batch*head, q-block) program: stream K/V blocks, online softmax.
+def _scratch(shape):
+    """VMEM scratch allocation (fp32 accumulator carried across the
+    sequential k grid dimension)."""
+    if pltpu is None:  # pragma: no cover
+        raise RuntimeError(
+            "flash_attention needs jax.experimental.pallas.tpu (VMEM "
+            "scratch accumulators); use force='xla' / "
+            "BIGDL_TPU_ATTENTION=xla on this backend")
+    return pltpu.VMEM(shape, jnp.float32)
 
-    Refs are VMEM tiles: q_ref [block_q, d]; k_ref/v_ref [Tk, d] (whole
-    K/V for this batch-head — fine for the Tk ≲ 4k tiles we target; the
-    ring-attention layer shards longer sequences before this kernel);
-    bias_ref [block_q, Tk] or None; o_ref [block_q, d]; lse_ref
-    [block_q, 1] (per-row logsumexp saved for the backward).
-    """
+
+def _causal_mask(s, q_pos0, k_pos0, shape):
+    q_pos = q_pos0 + jax.lax.broadcasted_iota(jnp.int32, shape, 0)
+    k_pos = k_pos0 + jax.lax.broadcasted_iota(jnp.int32, shape, 1)
+    return jnp.where(q_pos >= k_pos, s, _NEG_INF)
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
+                      acc_ref, m_ref, l_ref, *, cfg: _FlashCfg,
+                      nk: int):
+    """One (bh, q-block, k-block) program.  Refs are VMEM tiles: q_ref
+    [block_q, d]; k_ref/v_ref [block_k, d] (ONE streamed block);
+    bias_ref [block_q, block_k] or None; o_ref [block_q, d]; lse_ref
+    [block_q, 1].  acc/m/l are VMEM scratch carrying the online-softmax
+    state across the sequential k dimension."""
     block_q, block_k = cfg.block_q, cfg.block_k
     q_idx = pl.program_id(1)
-    tk = k_ref.shape[0]
-    d = q_ref.shape[1]
-    nblocks = tk // block_k
+    k_idx = pl.program_id(2)
 
-    q = q_ref[...].astype(jnp.float32) * cfg.scale
+    @pl.when(k_idx == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
 
-    def body(i, carry):
-        acc, m_prev, l_prev = carry
-        k_blk = k_ref[pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
+    # causal: blocks entirely above the diagonal contribute nothing
+    needed = True
+    if cfg.causal:
+        needed = k_idx * block_k <= q_idx * block_q + block_q - 1
+
+    @pl.when(needed)
+    def _body():
+        q = q_ref[...].astype(jnp.float32) * cfg.scale
+        k_blk = k_ref[...].astype(jnp.float32)
+        v_blk = v_ref[...].astype(jnp.float32)
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)  # [block_q, block_k]
         if bias_ref is not None:
-            s = s + bias_ref[:, pl.dslice(i * block_k, block_k)].astype(
-                jnp.float32)
+            s = s + bias_ref[...].astype(jnp.float32)
         if cfg.causal:
-            q_pos = q_idx * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_pos = i * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+            s = _causal_mask(s, q_idx * block_q, k_idx * block_k,
+                             (block_q, block_k))
+        m_prev = m_ref[...][:, 0]
+        l_prev = l_ref[...][:, 0]
         m_cur = jnp.max(s, axis=-1)
         m_new = jnp.maximum(m_prev, m_cur)
         p = jnp.exp(s - m_new[:, None])
         alpha = jnp.exp(m_prev - m_new)
-        l_new = l_prev * alpha + jnp.sum(p, axis=-1)
-        acc = acc * alpha[:, None] + jax.lax.dot_general(
+        l_ref[...] = (l_prev * alpha + jnp.sum(p, axis=-1))[:, None]
+        m_ref[...] = m_new[:, None]
+        acc_ref[...] = acc_ref[...] * alpha[:, None] + jax.lax.dot_general(
             p, v_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        return acc, m_new, l_new
 
-    acc0 = jnp.zeros((block_q, d), jnp.float32)
-    m0 = jnp.full((block_q,), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q,), jnp.float32)
-    if cfg.causal:
-        # skip fully-masked K blocks beyond the diagonal
-        nblocks_eff = jnp.minimum(
-            nblocks, ((q_idx + 1) * block_q + block_k - 1) // block_k)
-        acc, m, l = jax.lax.fori_loop(0, nblocks_eff, body, (acc0, m0, l0))
-    else:
-        acc, m, l = jax.lax.fori_loop(0, nblocks, body, (acc0, m0, l0))
-    o_ref[...] = (acc / l[:, None]).astype(o_ref.dtype)
-    lse_ref[...] = (m + jnp.log(l))[:, None].astype(jnp.float32)
+    @pl.when(k_idx == nk - 1)
+    def _finish():
+        l = l_ref[...][:, 0]
+        o_ref[...] = (acc_ref[...] / l[:, None]).astype(o_ref.dtype)
+        lse_ref[...] = (m_ref[...][:, 0] + jnp.log(l))[:, None].astype(
+            jnp.float32)
 
 
 def _fwd_impl(q, k, v, bias, cfg: _FlashCfg):
@@ -157,40 +176,43 @@ def _fwd_impl(q, k, v, bias, cfg: _FlashCfg):
     b, h, tq, d = q.shape
     tk = k.shape[2]
     block_q, block_k = cfg.block_q, cfg.block_k
+    nk = tk // block_k
 
     qr = q.reshape(b * h, tq, d)
     kr = k.reshape(b * h, tk, d)
     vr = v.reshape(b * h, tk, d)
 
     in_specs = [
-        pl.BlockSpec((None, block_q, d), lambda bh, i: (bh, i, 0)),
-        pl.BlockSpec((None, tk, d), lambda bh, i: (bh, 0, 0)),
-        pl.BlockSpec((None, tk, d), lambda bh, i: (bh, 0, 0)),
+        pl.BlockSpec((None, block_q, d), lambda bh, i, j: (bh, i, 0)),
+        pl.BlockSpec((None, block_k, d), lambda bh, i, j: (bh, j, 0)),
+        pl.BlockSpec((None, block_k, d), lambda bh, i, j: (bh, j, 0)),
     ]
     args = [qr, kr, vr]
     if bias is not None:
         biasr = jnp.broadcast_to(bias, (b, h, tq, tk)).reshape(b * h, tq, tk)
-        in_specs.append(
-            pl.BlockSpec((None, block_q, tk), lambda bh, i: (bh, i, 0)))
+        in_specs.append(pl.BlockSpec((None, block_q, block_k),
+                                     lambda bh, i, j: (bh, i, j)))
         args.append(biasr)
-        kern = functools.partial(_flash_fwd_kernel, cfg=cfg)
+        kern = functools.partial(_flash_fwd_kernel, cfg=cfg, nk=nk)
     else:
-        def kern(q_ref, k_ref, v_ref, o_ref, lse_ref):
+        def kern(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m, l):
             _flash_fwd_kernel(q_ref, k_ref, v_ref, None, o_ref, lse_ref,
-                              cfg=cfg)
+                              acc, m, l, cfg=cfg, nk=nk)
 
     out, lse = pl.pallas_call(
         kern,
-        grid=(b * h, tq // block_q),
+        grid=(b * h, tq // block_q, nk),
         in_specs=in_specs,
         out_specs=[
-            pl.BlockSpec((None, block_q, d), lambda bh, i: (bh, i, 0)),
-            pl.BlockSpec((None, block_q, 1), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((None, block_q, d), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((None, block_q, 1), lambda bh, i, j: (bh, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b * h, tq, d), q.dtype),
             jax.ShapeDtypeStruct((b * h, tq, 1), jnp.float32),
         ],
+        scratch_shapes=[_scratch((block_q, d)), _scratch((block_q, 1)),
+                        _scratch((block_q, 1))],
         interpret=cfg.interpret,
     )(*args)
     return out.reshape(b, h, tq, d), lse
@@ -205,139 +227,125 @@ def _recompute_p(q_scaled, k_blk, bias_blk, lse, q_pos0, k_pos0, cfg,
     if bias_blk is not None:
         s = s + bias_blk
     if cfg.causal:
-        q_pos = q_pos0 + jax.lax.broadcasted_iota(jnp.int32, shape, 0)
-        k_pos = k_pos0 + jax.lax.broadcasted_iota(jnp.int32, shape, 1)
-        s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
-    return jnp.exp(s - lse), s
+        s = _causal_mask(s, q_pos0, k_pos0, shape)
+    return jnp.exp(s - lse)
 
 
 def _flash_dq_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
-                     delta_ref, dq_ref, *, cfg: _FlashCfg):
-    """dQ for one (batch*head, q-block): stream K/V blocks.
+                     delta_ref, dq_ref, acc_ref, *, cfg: _FlashCfg,
+                     nk: int):
+    """dQ for one (bh, q-block, k-block): K/V stream through the grid.
     dQ = scale * Σ_blocks [P ∘ (dO V^T − Δ)] K."""
     block_q, block_k = cfg.block_q, cfg.block_k
     q_idx = pl.program_id(1)
-    tk = k_ref.shape[0]
-    d = q_ref.shape[1]
-    nblocks = tk // block_k
+    k_idx = pl.program_id(2)
 
-    q = q_ref[...].astype(jnp.float32) * cfg.scale
-    do = do_ref[...].astype(jnp.float32)
-    lse = lse_ref[...].astype(jnp.float32)        # [block_q, 1]
-    delta = delta_ref[...].astype(jnp.float32)    # [block_q, 1]
+    @pl.when(k_idx == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    def body(i, acc):
-        k_blk = k_ref[pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
+    needed = True
+    if cfg.causal:
+        needed = k_idx * block_k <= q_idx * block_q + block_q - 1
+
+    @pl.when(needed)
+    def _body():
+        q = q_ref[...].astype(jnp.float32) * cfg.scale
+        do = do_ref[...].astype(jnp.float32)
+        lse = lse_ref[...].astype(jnp.float32)        # [block_q, 1]
+        delta = delta_ref[...].astype(jnp.float32)    # [block_q, 1]
+        k_blk = k_ref[...].astype(jnp.float32)
+        v_blk = v_ref[...].astype(jnp.float32)
         bias_blk = None
         if bias_ref is not None:
-            bias_blk = bias_ref[:, pl.dslice(i * block_k, block_k)].astype(
-                jnp.float32)
-        p, _ = _recompute_p(q, k_blk, bias_blk, lse,
-                            q_idx * block_q, i * block_k, cfg,
-                            (block_q, block_k))
+            bias_blk = bias_ref[...].astype(jnp.float32)
+        p = _recompute_p(q, k_blk, bias_blk, lse,
+                         q_idx * block_q, k_idx * block_k, cfg,
+                         (block_q, block_k))
         dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta)
-        return acc + jax.lax.dot_general(
+        acc_ref[...] = acc_ref[...] + jax.lax.dot_general(
             ds, k_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    acc0 = jnp.zeros((block_q, d), jnp.float32)
-    if cfg.causal:
-        nblocks_eff = jnp.minimum(
-            nblocks, ((q_idx + 1) * block_q + block_k - 1) // block_k)
-        acc = jax.lax.fori_loop(0, nblocks_eff, body, acc0)
-    else:
-        acc = jax.lax.fori_loop(0, nblocks, body, acc0)
-    dq_ref[...] = (acc * cfg.scale).astype(dq_ref.dtype)
+    @pl.when(k_idx == nk - 1)
+    def _finish():
+        dq_ref[...] = (acc_ref[...] * cfg.scale).astype(dq_ref.dtype)
 
 
 def _flash_dkv_kernel(k_ref, v_ref, q_ref, bias_ref, do_ref, lse_ref,
-                      delta_ref, dk_ref, dv_ref, *, cfg: _FlashCfg):
-    """dK/dV for one (batch*head, k-block): stream Q/dO blocks.
-    dV = P^T dO;  dK = scale * [P ∘ (dO V^T − Δ)]^T Q."""
+                      delta_ref, dk_ref, dv_ref, dk_acc, dv_acc, *,
+                      cfg: _FlashCfg, nq: int):
+    """dK/dV for one (bh, k-block, q-block): Q/dO stream through the
+    grid.  dV = P^T dO;  dK = scale * [P ∘ (dO V^T − Δ)]^T Q."""
     block_q, block_k = cfg.block_q, cfg.block_k
     k_idx = pl.program_id(1)
-    tq = q_ref.shape[0]
-    d = k_ref.shape[1]
-    nblocks = tq // block_q
+    q_idx = pl.program_id(2)
 
-    k = k_ref[...].astype(jnp.float32)
-    v = v_ref[...].astype(jnp.float32)
+    @pl.when(q_idx == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
 
-    def body(i, carry):
-        dk_acc, dv_acc = carry
-        q_blk = q_ref[pl.dslice(i * block_q, block_q), :].astype(
-            jnp.float32) * cfg.scale
-        do_blk = do_ref[pl.dslice(i * block_q, block_q), :].astype(
-            jnp.float32)
-        lse_blk = lse_ref[pl.dslice(i * block_q, block_q), :].astype(
-            jnp.float32)
-        delta_blk = delta_ref[pl.dslice(i * block_q, block_q), :].astype(
-            jnp.float32)
+    needed = True
+    if cfg.causal:
+        # q blocks strictly before this k block are fully masked
+        needed = q_idx * block_q + block_q - 1 >= k_idx * block_k
+
+    @pl.when(needed)
+    def _body():
+        k = k_ref[...].astype(jnp.float32)
+        v = v_ref[...].astype(jnp.float32)
+        q_blk = q_ref[...].astype(jnp.float32) * cfg.scale
+        do_blk = do_ref[...].astype(jnp.float32)
+        lse_blk = lse_ref[...].astype(jnp.float32)
+        delta_blk = delta_ref[...].astype(jnp.float32)
         bias_blk = None
         if bias_ref is not None:
-            bias_blk = bias_ref[pl.dslice(i * block_q, block_q), :].astype(
-                jnp.float32)
-        p, _ = _recompute_p(q_blk, k, bias_blk, lse_blk,
-                            i * block_q, k_idx * block_k, cfg,
-                            (block_q, block_k))
-        dv_acc = dv_acc + jax.lax.dot_general(
+            bias_blk = bias_ref[...].astype(jnp.float32)
+        p = _recompute_p(q_blk, k, bias_blk, lse_blk,
+                         q_idx * block_q, k_idx * block_k, cfg,
+                         (block_q, block_k))
+        dv_acc[...] = dv_acc[...] + jax.lax.dot_general(
             p, do_blk, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do_blk, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta_blk)
         # q_blk already carries `scale`, so this accumulates scale·ds^T·q
-        dk_acc = dk_acc + jax.lax.dot_general(
+        dk_acc[...] = dk_acc[...] + jax.lax.dot_general(
             ds, q_blk, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        return dk_acc, dv_acc
 
-    acc0 = (jnp.zeros((block_k, d), jnp.float32),
-            jnp.zeros((block_k, d), jnp.float32))
-    if cfg.causal:
-        # q blocks strictly before this k block are fully masked
-        i_start = (k_idx * block_k) // block_q
-        dk, dv = jax.lax.fori_loop(i_start, nblocks, body, acc0)
-    else:
-        dk, dv = jax.lax.fori_loop(0, nblocks, body, acc0)
-    dk_ref[...] = dk.astype(dk_ref.dtype)
-    dv_ref[...] = dv.astype(dv_ref.dtype)
+    @pl.when(q_idx == nq - 1)
+    def _finish():
+        dk_ref[...] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[...] = dv_acc[...].astype(dv_ref.dtype)
 
 
 def _flash_dbias_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
                         delta_ref, ds_ref, *, cfg: _FlashCfg):
-    """dBias tile [block_q, Tk] for one (batch*head, q-block): dS itself.
-    Materializes O(Tq·Tk) — only ever run when the bias is actually
-    differentiated (a separate pallas_call so jit DCE removes it when the
-    bias is a constant mask)."""
+    """dBias tile [block_q, block_k] for one (bh, q-block, k-block):
+    dS itself.  Materializes O(Tq·Tk) — only ever run when the bias is
+    actually differentiated (a separate pallas_call so jit DCE removes
+    it when the bias is a constant mask)."""
     block_q, block_k = cfg.block_q, cfg.block_k
     q_idx = pl.program_id(1)
-    tk = k_ref.shape[0]
-    nblocks = tk // block_k
+    k_idx = pl.program_id(2)
 
     q = q_ref[...].astype(jnp.float32) * cfg.scale
     do = do_ref[...].astype(jnp.float32)
     lse = lse_ref[...].astype(jnp.float32)
     delta = delta_ref[...].astype(jnp.float32)
-
-    def body(i, _):
-        k_blk = k_ref[pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
-        bias_blk = bias_ref[:, pl.dslice(i * block_k, block_k)].astype(
-            jnp.float32)
-        p, _s = _recompute_p(q, k_blk, bias_blk, lse,
-                             q_idx * block_q, i * block_k, cfg,
-                             (block_q, block_k))
-        dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        ds_ref[:, pl.dslice(i * block_k, block_k)] = (
-            p * (dp - delta)).astype(ds_ref.dtype)
-        return 0
-
-    jax.lax.fori_loop(0, nblocks, body, 0)
+    k_blk = k_ref[...].astype(jnp.float32)
+    v_blk = v_ref[...].astype(jnp.float32)
+    p = _recompute_p(q, k_blk, bias_ref[...].astype(jnp.float32), lse,
+                     q_idx * block_q, k_idx * block_k, cfg,
+                     (block_q, block_k))
+    dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds_ref[...] = (p * (dp - delta)).astype(ds_ref.dtype)
 
 
 def _bwd_prep(q, k, bias, out, do):
@@ -361,6 +369,7 @@ def _bwd_impl(q, k, v, bias, out, lse, do, cfg: _FlashCfg, *,
     b, h, tq, d = q.shape
     tk = k.shape[2]
     block_q, block_k = cfg.block_q, cfg.block_k
+    nq, nk = tq // block_q, tk // block_k
 
     qr = q.reshape(b * h, tq, d)
     kr = k.reshape(b * h, tk, d)
@@ -368,59 +377,65 @@ def _bwd_impl(q, k, v, bias, out, lse, do, cfg: _FlashCfg, *,
     dor, delta, biasr = prep if prep is not None else _bwd_prep(
         q, k, bias, out, do)
 
-    q_spec = pl.BlockSpec((None, block_q, d), lambda bh, i: (bh, i, 0))
-    kv_full = pl.BlockSpec((None, tk, d), lambda bh, i: (bh, 0, 0))
-    row_spec = pl.BlockSpec((None, block_q, 1), lambda bh, i: (bh, i, 0))
+    q_spec = pl.BlockSpec((None, block_q, d), lambda bh, i, j: (bh, i, 0))
+    kv_spec = pl.BlockSpec((None, block_k, d), lambda bh, i, j: (bh, j, 0))
+    row_spec = pl.BlockSpec((None, block_q, 1), lambda bh, i, j: (bh, i, 0))
+    bias_spec = pl.BlockSpec((None, block_q, block_k),
+                             lambda bh, i, j: (bh, i, j))
 
-    # ---- dQ: grid over q blocks --------------------------------------
-    dq_specs = [q_spec, kv_full, kv_full]
+    # ---- dQ: grid (bh, q-block, k-block) ------------------------------
+    dq_specs = [q_spec, kv_spec, kv_spec]
     dq_args = [qr, kr, vr]
     if biasr is not None:
-        dq_specs.append(
-            pl.BlockSpec((None, block_q, tk), lambda bh, i: (bh, i, 0)))
+        dq_specs.append(bias_spec)
         dq_args.append(biasr)
-        dq_kern = functools.partial(_flash_dq_kernel, cfg=cfg)
+        dq_kern = functools.partial(_flash_dq_kernel, cfg=cfg, nk=nk)
     else:
         def dq_kern(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dq_ref):
+                    dq_ref, acc):
             _flash_dq_kernel(q_ref, k_ref, v_ref, None, do_ref, lse_ref,
-                             delta_ref, dq_ref, cfg=cfg)
+                             delta_ref, dq_ref, acc, cfg=cfg, nk=nk)
     dq_args += [dor, lse, delta]
     dq_specs += [q_spec, row_spec, row_spec]
     dq = pl.pallas_call(
         dq_kern,
-        grid=(b * h, tq // block_q),
+        grid=(b * h, nq, nk),
         in_specs=dq_specs,
         out_specs=q_spec,
         out_shape=jax.ShapeDtypeStruct((b * h, tq, d), q.dtype),
+        scratch_shapes=[_scratch((block_q, d))],
         interpret=cfg.interpret,
     )(*dq_args)
 
-    # ---- dK/dV: grid over k blocks -----------------------------------
-    kblk_spec = pl.BlockSpec((None, block_k, d), lambda bh, j: (bh, j, 0))
-    q_full = pl.BlockSpec((None, tq, d), lambda bh, j: (bh, 0, 0))
-    row_full = pl.BlockSpec((None, tq, 1), lambda bh, j: (bh, 0, 0))
-    dkv_specs = [kblk_spec, kblk_spec, q_full]
+    # ---- dK/dV: grid (bh, k-block, q-block) ---------------------------
+    kblk_spec = pl.BlockSpec((None, block_k, d), lambda bh, j, i: (bh, j, 0))
+    qstream = pl.BlockSpec((None, block_q, d), lambda bh, j, i: (bh, i, 0))
+    rowstream = pl.BlockSpec((None, block_q, 1),
+                             lambda bh, j, i: (bh, i, 0))
+    bias_stream = pl.BlockSpec((None, block_q, block_k),
+                               lambda bh, j, i: (bh, i, j))
+    dkv_specs = [kblk_spec, kblk_spec, qstream]
     dkv_args = [kr, vr, qr]
     if biasr is not None:
-        dkv_specs.append(
-            pl.BlockSpec((None, tq, block_k), lambda bh, j: (bh, 0, j)))
+        dkv_specs.append(bias_stream)
         dkv_args.append(biasr)
-        dkv_kern = functools.partial(_flash_dkv_kernel, cfg=cfg)
+        dkv_kern = functools.partial(_flash_dkv_kernel, cfg=cfg, nq=nq)
     else:
         def dkv_kern(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
-                     dk_ref, dv_ref):
+                     dk_ref, dv_ref, dk_acc, dv_acc):
             _flash_dkv_kernel(k_ref, v_ref, q_ref, None, do_ref, lse_ref,
-                              delta_ref, dk_ref, dv_ref, cfg=cfg)
+                              delta_ref, dk_ref, dv_ref, dk_acc, dv_acc,
+                              cfg=cfg, nq=nq)
     dkv_args += [dor, lse, delta]
-    dkv_specs += [q_full, row_full, row_full]
+    dkv_specs += [qstream, rowstream, rowstream]
     dk, dv = pl.pallas_call(
         dkv_kern,
-        grid=(b * h, tk // block_k),
+        grid=(b * h, nk, nq),
         in_specs=dkv_specs,
         out_specs=[kblk_spec, kblk_spec],
         out_shape=[jax.ShapeDtypeStruct((b * h, tk, d), k.dtype),
                    jax.ShapeDtypeStruct((b * h, tk, d), v.dtype)],
+        scratch_shapes=[_scratch((block_k, d)), _scratch((block_k, d))],
         interpret=cfg.interpret,
     )(*dkv_args)
 
@@ -433,24 +448,25 @@ def _dbias_impl(q, k, v, bias, lse, cfg: _FlashCfg, *, prep):
     shape.  A standalone pallas_call: unused ⇒ DCE'd under jit."""
     b, h, tq, d = q.shape
     tk = k.shape[2]
-    block_q = cfg.block_q
+    block_q, block_k = cfg.block_q, cfg.block_k
 
     qr = q.reshape(b * h, tq, d)
     kr = k.reshape(b * h, tk, d)
     vr = v.reshape(b * h, tk, d)
     dor, delta, biasr = prep
 
-    q_spec = pl.BlockSpec((None, block_q, d), lambda bh, i: (bh, i, 0))
-    kv_full = pl.BlockSpec((None, tk, d), lambda bh, i: (bh, 0, 0))
-    row_spec = pl.BlockSpec((None, block_q, 1), lambda bh, i: (bh, i, 0))
-    wide = pl.BlockSpec((None, block_q, tk), lambda bh, i: (bh, i, 0))
+    q_spec = pl.BlockSpec((None, block_q, d), lambda bh, i, j: (bh, i, 0))
+    kv_spec = pl.BlockSpec((None, block_k, d), lambda bh, i, j: (bh, j, 0))
+    row_spec = pl.BlockSpec((None, block_q, 1), lambda bh, i, j: (bh, i, 0))
+    tile = pl.BlockSpec((None, block_q, block_k),
+                        lambda bh, i, j: (bh, i, j))
 
     ds = pl.pallas_call(
         functools.partial(_flash_dbias_kernel, cfg=cfg),
-        grid=(b * h, tq // block_q),
-        in_specs=[q_spec, kv_full, kv_full, wide, q_spec, row_spec,
+        grid=(b * h, tq // block_q, tk // block_k),
+        in_specs=[q_spec, kv_spec, kv_spec, tile, q_spec, row_spec,
                   row_spec],
-        out_specs=wide,
+        out_specs=tile,
         out_shape=jax.ShapeDtypeStruct((b * h, tq, tk), jnp.float32),
         interpret=cfg.interpret,
     )(qr, kr, vr, biasr, dor, lse, delta)

@@ -1,0 +1,13 @@
+#!/usr/bin/env bash
+# Build the distributable artifacts (≙ the reference's make-dist.sh,
+# which produced dist/lib/bigdl-VERSION-jar-with-dependencies.jar plus
+# a python zip; here: a wheel + sdist under dist/).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+python -m pip wheel --no-deps -w dist .
+python - <<'PY'
+import glob
+print("dist artifacts:")
+for p in sorted(glob.glob("dist/*")):
+    print("  ", p)
+PY

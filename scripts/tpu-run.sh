@@ -1,0 +1,34 @@
+#!/usr/bin/env bash
+# Launch a bigdl-tpu entrypoint on every host of a TPU pod slice.
+#
+# The reference launches cluster jobs with
+# scripts/spark-submit-with-bigdl.sh (driver + executors via Spark).
+# On TPU there is no driver/executor split: the SAME program runs on
+# every host (SPMD), so "submit" = "run this command on all hosts of
+# the slice".  This wraps the gcloud fan-out; on a single TPU VM it
+# just execs the command.
+#
+#   scripts/tpu-run.sh bigdl-tpu-imagenet -f gs://bucket/imagenet -b 1024
+#   TPU_NAME=my-pod ZONE=us-central2-b scripts/tpu-run.sh \
+#       bigdl-tpu-resnet-cifar -f /data/cifar
+#
+# Env:
+#   TPU_NAME  pod/VM name  -> fan out with gcloud (absent: run locally)
+#   ZONE      gcloud zone (required with TPU_NAME)
+#   WORKER    gcloud worker selector (default: all)
+set -euo pipefail
+
+if [[ $# -lt 1 ]]; then
+  echo "usage: $0 <bigdl-tpu-entrypoint-or-python-cmd> [args...]" >&2
+  exit 2
+fi
+
+if [[ -z "${TPU_NAME:-}" ]]; then
+  # single host: the current machine IS the worker
+  exec "$@"
+fi
+
+: "${ZONE:?set ZONE with TPU_NAME}"
+exec gcloud compute tpus tpu-vm ssh "${TPU_NAME}" \
+  --zone "${ZONE}" --worker="${WORKER:-all}" \
+  --command "$(printf '%q ' "$@")"

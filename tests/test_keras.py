@@ -617,3 +617,46 @@ def test_keras_simplernn_weight_import(tmp_path):
         want.append(h)
     np.testing.assert_allclose(got, np.stack(want, axis=1),
                                rtol=1e-4, atol=1e-5)
+
+
+def test_keras_recurrent_linear_activation_is_identity(tmp_path):
+    """activation='linear' must import as identity, not silently fall
+    back to the cell's tanh default."""
+    T, F, H = 3, 2, 4
+    rng = np.random.RandomState(13)
+    w = rng.randn(F, H).astype(np.float32)
+    u = rng.randn(H, H).astype(np.float32) * 0.1
+    b = np.zeros(H, np.float32)
+    model = _load_rnn(tmp_path, "SimpleRNN",
+                      {"output_dim": H, "activation": "linear",
+                       "batch_input_shape": [None, T, F]}, [w, u, b])
+    x = rng.randn(2, T, F).astype(np.float32)
+    got = np.asarray(model.forward(jnp.asarray(x)))
+    h = np.zeros((2, H), np.float32)
+    want = []
+    for t in range(T):
+        h = x[:, t] @ w + h @ u + b    # identity activation
+        want.append(h)
+    np.testing.assert_allclose(got, np.stack(want, axis=1),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_keras_recurrent_dropout_flags(tmp_path):
+    """dropout_W maps to the cell's input dropout; dropout_U (recurrent
+    state dropout) is rejected loudly, not silently dropped."""
+    from bigdl_tpu.keras import load_keras_json
+    spec_u = {"class_name": "Sequential", "config": [
+        {"class_name": "LSTM", "config": {
+            "name": "l", "output_dim": 4, "dropout_U": 0.3,
+            "batch_input_shape": [None, 3, 2]}}]}
+    with pytest.raises(ValueError, match="dropout_U"):
+        load_keras_json(spec_u)
+    spec_w = {"class_name": "Sequential", "config": [
+        {"class_name": "LSTM", "config": {
+            "name": "l", "output_dim": 4, "dropout_W": 0.25,
+            "batch_input_shape": [None, 3, 2]}}]}
+    model = load_keras_json(spec_w)
+    model.build((3, 2))
+    from bigdl_tpu.keras.converter import _rnn_cell
+    layer = model.layers[0] if hasattr(model, "layers") else model
+    assert _rnn_cell(layer).p == 0.25
