@@ -141,6 +141,23 @@ class _DecodeAugment:
         return Sample(self._aug().apply_one(_decode_rgb(path)), label)
 
 
+def train_pipeline(folder: str, size: int, batch_size: int,
+                   workers: int = 8):
+    """Class-per-subdirectory folder → (DataSet, n_classes, class_map)
+    through the threaded TRAIN augment path (random crop/flip) +
+    double-buffered prefetch — the pipeline the training main builds."""
+    from bigdl_tpu.dataset.dataset import DataSet
+    from bigdl_tpu.dataset.prefetch import ParallelMap, Prefetch
+    from bigdl_tpu.dataset.transformer import SampleToMiniBatch
+    items, classes, cmap = _list_image_folder(folder)
+    data = (DataSet.array(items)
+            .transform(ParallelMap(_DecodeAugment(train=True, size=size),
+                                   workers=workers))
+            .transform(SampleToMiniBatch(batch_size))
+            .transform(Prefetch(2)))
+    return data, classes, cmap
+
+
 def eval_pipeline(folder: str, size: int, batch_size: int,
                   workers: int = 8, class_map=None):
     """Class-per-subdirectory folder → (DataSet, n_classes, class_map)
@@ -215,16 +232,11 @@ def main(argv=None):
                 "--cache-device would freeze the random crops/flips of "
                 "epoch 1 and replay them forever; it is only valid with "
                 "--synthetic data")
-        from bigdl_tpu.dataset.prefetch import ParallelMap, Prefetch
-        train_items, classes, class_map = _list_image_folder(
-            os.path.join(args.folder, "train"))
-        n_train = len(train_items)
-        train_data = (DataSet.array(train_items)
-                      .transform(ParallelMap(
-                          _DecodeAugment(train=True, size=size),
-                          workers=args.workers))
-                      .transform(SampleToMiniBatch(args.batch_size))
-                      .transform(Prefetch(2)))
+        from bigdl_tpu.dataset.prefetch import Prefetch
+        train_data, classes, class_map = train_pipeline(
+            os.path.join(args.folder, "train"), size, args.batch_size,
+            workers=args.workers)
+        n_train = train_data.size()
         val_dir = os.path.join(args.folder, "val")
         if os.path.isdir(val_dir):
             val_data, _, _ = eval_pipeline(

@@ -191,6 +191,7 @@ def test_pooler_level_assignment():
     np.testing.assert_allclose(np.asarray(out), 1.0, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_region_proposal_shapes():
     set_seed(1)
     rpn = RegionProposal(8, anchor_sizes=[32, 64], aspect_ratios=[1.0],
@@ -224,6 +225,7 @@ def test_proposal_shapes():
     np.testing.assert_allclose(np.asarray(rois[:, 0]), 0.0)
 
 
+@pytest.mark.slow
 def test_box_head_end_to_end_shapes():
     set_seed(3)
     head = BoxHead(in_channels=4, resolution=3, scales=[0.25, 0.125],
@@ -244,6 +246,7 @@ def test_box_head_end_to_end_shapes():
     assert ((lb >= 1) & (lb < 5)).all()
 
 
+@pytest.mark.slow
 def test_mask_head_shapes():
     set_seed(4)
     mh = MaskHead(in_channels=4, resolution=4, scales=[0.25],
@@ -329,6 +332,7 @@ def test_nms_jit_and_roi_align_jit():
 
 # ---------------- SSD-VGG16 (BASELINE config #5) ----------------
 
+@pytest.mark.slow
 def test_ssd_vgg16_300_architecture():
     """Canonical SSD-300: source maps 38/19/10/5/3/1 and 8,732 priors."""
     from bigdl_tpu.models import ssd_vgg16_300
@@ -347,6 +351,7 @@ def test_ssd_vgg16_300_architecture():
     assert np.isfinite(np.asarray(out)).all()
 
 
+@pytest.mark.slow
 def test_ssd_caffe_weight_import(tmp_path):
     """A caffemodel's blobs land in the same-named SSD layers (the
     reference's import-and-infer path, CaffeLoader.scala:57)."""
@@ -425,6 +430,7 @@ def test_nms_pre_topk_matches_full():
                                   np.asarray(idx_cap)[np.asarray(val_cap)])
 
 
+@pytest.mark.slow
 def test_boxhead_masks_padded_proposals():
     """Regression (round-1 advisor #1): padded proposal slots must not
     produce detections when the validity mask is supplied."""
@@ -447,6 +453,7 @@ def test_boxhead_masks_padded_proposals():
     assert int(valid_unmasked.sum()) > int(valid_masked.sum())
 
 
+@pytest.mark.slow
 def test_ssd_int8_quantized_inference():
     """BASELINE config #5: int8-quantized SSD inference runs and stays
     close to the fp32 detections (whitepaper fig10 recipe: <0.1%

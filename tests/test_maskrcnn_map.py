@@ -27,6 +27,7 @@ def test_autoencoder_shapes_and_range():
     assert (o >= 0).all() and (o <= 1).all()
 
 
+@pytest.mark.slow
 def test_maskrcnn_forward_shapes():
     set_seed(1)
     cfg = MaskRCNNParams(

@@ -49,6 +49,7 @@ def test_ring_attention_with_bias(seq_mesh):
                                rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_ring_attention_grads_match(seq_mesh):
     q, k, v = rnd(1, 2, 64, 8, seed=8), rnd(1, 2, 64, 8, seed=9), \
         rnd(1, 2, 64, 8, seed=10)
@@ -62,6 +63,7 @@ def test_ring_attention_grads_match(seq_mesh):
                                rtol=1e-3, atol=1e-4)
 
 
+@pytest.mark.slow
 def test_pipeline_matches_sequential():
     from bigdl_tpu.utils import set_seed
     set_seed(0)
@@ -94,6 +96,7 @@ def test_pipeline_per_device_memory_is_microbatch_ring():
     assert shapes["carry"] == (mb, 6, 16), shapes
 
 
+@pytest.mark.slow
 def test_pipeline_heterogeneous_stages():
     """Stages with different structures (Linear vs parameterless blocks)
     run via the lax.switch path and match sequential execution, forward
@@ -251,6 +254,7 @@ def test_fsdp_spec_lands_on_model():
     assert n_sharded >= 4, f"fsdp landed on only {n_sharded} leaves"
 
 
+@pytest.mark.slow
 def test_pipeline_backward_matches_sequential():
     """Grads through the GPipe ppermute schedule == sequential grads."""
     from bigdl_tpu.core.module import partition, combine
@@ -281,6 +285,7 @@ def test_pipeline_backward_matches_sequential():
                                    rtol=1e-3, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_moe_backward_on_mesh_matches_dense():
     """Grads through the expert-parallel psum path == dense grads."""
     from bigdl_tpu.core.module import partition, combine
@@ -307,6 +312,7 @@ def test_moe_backward_on_mesh_matches_dense():
                                    rtol=1e-3, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_moe_a2a_matches_dense_at_ample_capacity():
     """The capacity-based all_to_all EP path (VERDICT r03 #4) must equal
     the dense path exactly when nothing overflows — forward and grads."""
@@ -365,6 +371,7 @@ def test_moe_a2a_per_device_memory_is_tokens_over_n():
     assert shapes["recv"] == (E // n, n * C, H), shapes
 
 
+@pytest.mark.slow
 def test_moe_a2a_capacity_overflow_drops_tokens():
     """With a starvation-level capacity the layer must stay finite and
     diverge from dense (dropped tokens contribute zero), locking the
@@ -410,6 +417,7 @@ def _train_seq_model(build, mesh_cfg=None, n_iter=3):
     return opt.state["loss"], leaves
 
 
+@pytest.mark.slow
 def test_pipeline_optimizer_training_equivalence():
     """A Pipeline with set_mesh trains through the Optimizer and matches
     the sequential-path training run exactly."""
@@ -434,6 +442,7 @@ def test_pipeline_optimizer_training_equivalence():
         np.testing.assert_allclose(a, b, rtol=1e-3, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_moe_optimizer_training_equivalence():
     """A MoE layer with set_mesh trains through the Optimizer and
     matches dense-path training (EP backward + update end to end)."""

@@ -204,6 +204,7 @@ def test_spatial_convolution_map_one_to_one():
     assert d[0] > 1e-3 and d[1] < 1e-6 and d[2] < 1e-6
 
 
+@pytest.mark.slow
 def test_conv_lstm_3d_step():
     set_seed(8)
     cell = nn.ConvLSTMPeephole3D(2, 3, kernel_i=3, kernel_c=3)
@@ -220,6 +221,7 @@ def test_rnn_alias():
     assert nn.RNN is nn.RnnCell
 
 
+@pytest.mark.slow
 def test_recurrent_drives_conv_lstm_2d_and_3d():
     set_seed(9)
     rec2 = nn.Recurrent(nn.ConvLSTMPeephole(2, 3))
@@ -243,6 +245,7 @@ def test_group_norm_zero_mean_unit_var():
     np.testing.assert_allclose(v, 1.0, atol=1e-4)
 
 
+@pytest.mark.slow
 def test_mask_head_use_gn():
     set_seed(11)
     from bigdl_tpu.nn.detection import MaskHead

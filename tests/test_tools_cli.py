@@ -141,6 +141,7 @@ def test_loadmodel_predict_batches_and_class_warning(tmp_path,
     assert any("class directories" in r.message for r in caplog.records)
 
 
+@pytest.mark.slow
 def test_perf_harness_cli():
     """DistriOptimizerPerf-analog: drives the real Optimizer loop and
     reports steady-state throughput."""

@@ -44,6 +44,7 @@ def test_causality():
     assert not np.allclose(out_a[0, 7:], out_b[0, 7:])
 
 
+@pytest.mark.slow
 def test_remat_matches_plain():
     """jax.checkpoint must change memory, not math: same loss and grads."""
     set_seed(0)
@@ -84,6 +85,7 @@ def test_tied_embedding_head():
     assert len(emb_leaves) == 1  # no separate head weight
 
 
+@pytest.mark.slow
 def test_trains_via_optimizer():
     from bigdl_tpu.dataset.dataset import DataSet, MiniBatch
     from bigdl_tpu.optim import Optimizer, Trigger
@@ -135,6 +137,7 @@ def test_incremental_decode_matches_full_forward():
                                    rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_greedy_generate_consistent_with_full_forward():
     """Each generated token must be the argmax of the full forward over
     the sequence so far."""
@@ -278,6 +281,7 @@ def test_sequence_parallel_rejects_padded_batch():
     assert np.isfinite(np.asarray(jf(jnp.asarray(clean)))).all()
 
 
+@pytest.mark.slow
 def test_sequence_parallel_matches_dense():
     """set_sequence_parallel (ring attention over the seq axis) must
     reproduce the dense forward and its gradients on an 8-way mesh,
@@ -358,6 +362,7 @@ def test_ring_attention_dropout_training_raises():
             m.forward(toks)
 
 
+@pytest.mark.slow
 def test_eval_mode_survives_sequence_parallel_swap():
     """set_sequence_parallel after eval_mode() must not resurrect
     training=True on the swapped attention modules (regression: the
