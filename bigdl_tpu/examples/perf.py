@@ -121,8 +121,8 @@ def bench_input_pipeline(folder, image_size, batch_size, workers,
                 Image.fromarray(arr).save(f"{cdir}/{i}.jpg",
                                           quality=85)
     elif folder is None:
-        raise SystemExit(
-            "--input-pipeline synthetic needs --synthetic-images > 0")
+        raise ValueError(
+            "bench_input_pipeline needs a folder or synthetic_n > 0")
 
     try:
         from bigdl_tpu.examples.imagenet import train_pipeline

@@ -69,8 +69,8 @@ class TransformerLM(Module):
             for _ in range(num_layers)])
         self.final_norm = LayerNormalization(hidden_size)
 
-    def set_sequence_parallel(self, mesh, axis: str = "seq") \
-            -> "TransformerLM":
+    def set_sequence_parallel(self, mesh, axis: str = "seq",
+                              kernel=None) -> "TransformerLM":
         """Run every block's self-attention through ring attention over
         ``mesh[axis]`` (sequence/context parallelism — contexts longer
         than one chip's HBM; see parallel/ring_attention.py).  The
@@ -87,9 +87,11 @@ class TransformerLM(Module):
                 # mesh/axis from an earlier call
                 blk.self_attn.mesh = mesh
                 blk.self_attn.seq_axis = axis
+                blk.self_attn.ring_kernel = kernel
             else:
                 blk.self_attn = RingSelfAttention.from_attention(
-                    blk.self_attn, mesh, axis, causal=True)
+                    blk.self_attn, mesh, axis, causal=True,
+                    kernel=kernel)
         self.seq_parallel = True
         return self
 

@@ -50,7 +50,8 @@ try:  # TPU-specific memory spaces; absent on some backends
 except Exception:  # pragma: no cover
     pltpu = None
 
-__all__ = ["dot_product_attention", "flash_attention", "xla_attention"]
+__all__ = ["dot_product_attention", "flash_attention",
+           "flash_attention_partial", "xla_attention"]
 
 _NEG_INF = -1e9  # matches the reference's attention mask fill
                  # (nn/TransformerOperation.scala attentionBiasLowerTriangle)
@@ -482,6 +483,119 @@ def _dbias_impl(q, k, v, bias, lse, cfg: _FlashCfg, *, prep):
     while ds.ndim > bias.ndim:
         ds = jnp.squeeze(ds, axis=0)
     return ds.astype(bias.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Partial (carry-in/carry-out) flash step — the ring-attention kernel
+# ---------------------------------------------------------------------------
+
+def _flash_partial_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref,
+                          acc_in, m_in, l_in, acc_out, m_out, l_out, *,
+                          cfg: _FlashCfg):
+    """One (bh, q-block, k-block) program merging THIS K/V chunk into a
+    running online-softmax state.  qoff/koff are scalar-prefetched
+    GLOBAL positions of the chunks (traced values from the ring's
+    axis_index arithmetic).  The output refs double as accumulators —
+    their block index is constant over the inner k dimension, so they
+    stay VMEM-resident across it."""
+    block_q, block_k = cfg.block_q, cfg.block_k
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _seed():
+        acc_out[...] = acc_in[...].astype(jnp.float32)
+        m_out[...] = m_in[...].astype(jnp.float32)
+        l_out[...] = l_in[...].astype(jnp.float32)
+
+    q_pos0 = qoff_ref[0] + i * block_q
+    k_pos0 = koff_ref[0] + j * block_k
+    needed = True
+    if cfg.causal:
+        needed = k_pos0 <= q_pos0 + block_q - 1
+
+    @pl.when(needed)
+    def _body():
+        q = q_ref[...].astype(jnp.float32) * cfg.scale
+        k_blk = k_ref[...].astype(jnp.float32)
+        v_blk = v_ref[...].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        if cfg.causal:
+            s = _causal_mask(s, q_pos0, k_pos0, (block_q, block_k))
+        m_prev = m_out[...][:, 0]
+        l_prev = l_out[...][:, 0]
+        m_cur = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m_prev - m_new)
+        l_out[...] = (l_prev * alpha + jnp.sum(p, axis=-1))[:, None]
+        m_out[...] = m_new[:, None]
+        acc_out[...] = acc_out[...] * alpha[:, None] + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+
+def flash_attention_partial(q, k, v, acc, m, l, *, q_offset, k_offset,
+                            causal: bool = False,
+                            scale: Optional[float] = None,
+                            block_q: int = 128, block_k: int = 128,
+                            interpret: bool = False):
+    """Merge blockwise attention of q [B,H,Tq,D] against ONE K/V chunk
+    [B,H,Tk,D] into the running online-softmax state
+    (acc [B,H,Tq,D] fp32, m/l [B,H,Tq] fp32); returns the updated
+    state.  q_offset/k_offset are the chunks' global sequence positions
+    (traced scalars fine — scalar-prefetched into the kernel), so the
+    causal mask is exact across ring steps.  The caller finishes with
+    ``out = acc / l[..., None]``.  Forward-only (the ring layer remats
+    around it); no bias (the ring routes biased attention dense)."""
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    assert tq % block_q == 0 and tk % block_k == 0, (tq, tk)
+    if pltpu is None:  # pragma: no cover
+        raise RuntimeError(
+            "flash_attention_partial needs jax.experimental.pallas.tpu "
+            "(scalar prefetch); use kernel='xla' / BIGDL_TPU_ATTENTION="
+            "xla on this backend")
+    cfg = _FlashCfg(causal=bool(causal), scale=float(scale),
+                    block_q=int(block_q), block_k=int(block_k),
+                    interpret=bool(interpret))
+    qr = q.reshape(b * h, tq, d)
+    kr = k.reshape(b * h, tk, d)
+    vr = v.reshape(b * h, tk, d)
+    accr = acc.reshape(b * h, tq, d).astype(jnp.float32)
+    mr = m.reshape(b * h, tq, 1).astype(jnp.float32)
+    lr = l.reshape(b * h, tq, 1).astype(jnp.float32)
+
+    # with scalar prefetch, index maps receive the prefetch refs too
+    q_spec = pl.BlockSpec((None, block_q, d),
+                          lambda bh, i, j, *refs: (bh, i, 0))
+    kv_spec = pl.BlockSpec((None, block_k, d),
+                           lambda bh, i, j, *refs: (bh, j, 0))
+    row_spec = pl.BlockSpec((None, block_q, 1),
+                            lambda bh, i, j, *refs: (bh, i, 0))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b * h, tq // block_q, tk // block_k),
+        in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
+        out_specs=[q_spec, row_spec, row_spec],
+    )
+    acc2, m2, l2 = pl.pallas_call(
+        functools.partial(_flash_partial_kernel, cfg=cfg),
+        grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct((b * h, tq, d), jnp.float32),
+                   jax.ShapeDtypeStruct((b * h, tq, 1), jnp.float32),
+                   jax.ShapeDtypeStruct((b * h, tq, 1), jnp.float32)],
+        interpret=cfg.interpret,
+    )(jnp.asarray(q_offset, jnp.int32).reshape(1),
+      jnp.asarray(k_offset, jnp.int32).reshape(1),
+      qr, kr, vr, accr, mr, lr)
+    return (acc2.reshape(b, h, tq, d), m2.reshape(b, h, tq),
+            l2.reshape(b, h, tq))
 
 
 # ---- custom_vjp wiring ----------------------------------------------------

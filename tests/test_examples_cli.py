@@ -374,6 +374,7 @@ def test_perf_input_pipeline_synthetic():
     """HOST jpeg->batch throughput mode (VERDICT r03 weak #7: no
     input-pipeline number existed anywhere).  Small and unmarked: the
     only default-run coverage of train_pipeline/bench_input_pipeline."""
+    pytest.importorskip("PIL")
     from bigdl_tpu.examples.perf import main
     out = main(["--input-pipeline", "synthetic", "--synthetic-images",
                 "32", "-b", "8", "--workers", "4", "--image-size", "64"])
