@@ -6,13 +6,16 @@ HDF5 weight files and rebuilds them as BigDL models).  Same capability
 here over the ``bigdl_tpu.keras`` layer set.
 
 Supported definitions: Sequential and functional ``Model`` JSON with
-the layer classes in ``_DEF_CONVERTERS``.  Supported weights: Dense,
-Convolution2D (``dim_ordering="tf"``), BatchNormalization, Embedding,
-and the recurrent family — LSTM/GRU/SimpleRNN per-gate Keras arrays
-are repacked into our fused cells (same positional semantics as the
-reference's convert_lstm/convert_gru/convert_simplernn).  Explicit
-boundary (loud error, not a silent drop): ``"th"`` (NCHW) image
-ordering — this framework is NHWC-native; re-save with ``"tf"``.
+the layer classes in ``_DEF_CONVERTERS``.  Both image orderings load:
+``dim_ordering="tf"`` (NHWC) builds TPU-native-layout layers, and
+``"th"`` (NCHW — the keras-1.x default) builds the same layers with
+``data_format="NCHW"`` so the model's tensor layout survives end to
+end (feed it NCHW inputs, exactly like keras did).  Supported weights:
+Dense, Convolution2D (both kernel layouts), BatchNormalization,
+Embedding, and the recurrent family — LSTM/GRU/SimpleRNN per-gate
+Keras arrays are repacked into our fused cells (same positional
+semantics as the reference's convert_lstm/convert_gru/
+convert_simplernn).
 
 Embedding ids follow this framework's 1-based convention: our id
 ``i + 1`` is Keras index ``i`` (weight rows map directly).
@@ -50,13 +53,15 @@ def _in_shape(cfg: dict):
     return None
 
 
-def _check_tf_ordering(cfg: dict, cls: str):
+def _ordering(cfg: dict) -> str:
+    """Keras-1.2.2 dim_ordering: "tf" (NHWC) or "th" (NCHW — the keras
+    1.x DEFAULT).  th models run with data_format="NCHW" layers so
+    their tensor layout survives end to end (≙ the reference, which is
+    NCHW-native)."""
     ordering = cfg.get("dim_ordering", "tf")
-    if ordering == "th":
-        raise ValueError(
-            f"{cls}: dim_ordering='th' (NCHW) models are not supported — "
-            f"this framework is NHWC-native; re-save the Keras model "
-            f"with dim_ordering='tf'")
+    if ordering not in ("tf", "th"):
+        raise ValueError(f"unknown dim_ordering {ordering!r}")
+    return ordering
 
 
 def _dense(cfg):
@@ -84,30 +89,28 @@ def _reshape(cfg):
 
 
 def _conv2d(cfg):
-    _check_tf_ordering(cfg, "Convolution2D")
     return KL.Convolution2D(
         int(cfg["nb_filter"]), int(cfg["nb_row"]), int(cfg["nb_col"]),
         activation=cfg.get("activation"),
         border_mode=cfg.get("border_mode", "valid"),
         subsample=tuple(cfg.get("subsample", (1, 1))),
-        bias=cfg.get("bias", True),
+        bias=cfg.get("bias", True), dim_ordering=_ordering(cfg),
         input_shape=_in_shape(cfg))
 
 
 def _pool2d(cls):
     def cv(cfg):
-        _check_tf_ordering(cfg, cls.__name__)
         return cls(pool_size=tuple(cfg.get("pool_size", (2, 2))),
                    strides=(tuple(cfg["strides"]) if cfg.get("strides")
                             else None),
                    border_mode=cfg.get("border_mode", "valid"),
+                   dim_ordering=_ordering(cfg),
                    input_shape=_in_shape(cfg))
     return cv
 
 
-def _global_avg(cfg):
-    _check_tf_ordering(cfg, "GlobalAveragePooling2D")
-    return KL.GlobalAveragePooling2D(input_shape=_in_shape(cfg))
+# (GlobalAveragePooling2D uses the generic _cfg_layer with ordering —
+# see _DEF_CONVERTERS)
 
 
 def _bn(cfg):
@@ -115,9 +118,13 @@ def _bn(cfg):
     if mode != 0:
         raise ValueError(f"BatchNormalization mode={mode} not supported "
                          f"(only feature-wise mode 0)")
+    # keras-1.2.2 BN has `axis` (th conv nets use axis=1) rather than
+    # dim_ordering; axis 1 on 4-D input = channels-first
+    axis = int(cfg.get("axis", -1))
     return KL.BatchNormalization(
         epsilon=float(cfg.get("epsilon", 1e-3)),
         momentum=float(cfg.get("momentum", 0.99)),
+        dim_ordering="th" if axis == 1 else "tf",
         input_shape=_in_shape(cfg))
 
 
@@ -172,12 +179,12 @@ def _input_layer(cfg):
     return KL.InputLayer(shape)
 
 
-def _cfg_layer(cls, *fields, check_ordering: bool = False, **defaults):
+def _cfg_layer(cls, *fields, with_ordering: bool = False, **defaults):
     """Converter that maps listed config fields to constructor args."""
     def cv(cfg):
-        if check_ordering:
-            _check_tf_ordering(cfg, cls.__name__)
         kwargs = dict(defaults)
+        if with_ordering:
+            kwargs["dim_ordering"] = _ordering(cfg)
         for f in fields:
             if f in cfg:
                 kwargs[f] = cfg[f]
@@ -209,14 +216,14 @@ def _conv1d(cfg):
 
 
 def _zero_pad2d(cfg):
-    _check_tf_ordering(cfg, "ZeroPadding2D")
     return KL.ZeroPadding2D(tuple(cfg.get("padding", (1, 1))),
+                            dim_ordering=_ordering(cfg),
                             input_shape=_in_shape(cfg))
 
 
 def _upsample2d(cfg):
-    _check_tf_ordering(cfg, "UpSampling2D")
     return KL.UpSampling2D(tuple(cfg.get("size", (2, 2))),
+                           dim_ordering=_ordering(cfg),
                            input_shape=_in_shape(cfg))
 
 
@@ -232,7 +239,8 @@ _DEF_CONVERTERS: Dict[str, Callable[[dict], Module]] = {
     "Convolution2D": _conv2d,
     "MaxPooling2D": _pool2d(KL.MaxPooling2D),
     "AveragePooling2D": _pool2d(KL.AveragePooling2D),
-    "GlobalAveragePooling2D": _global_avg,
+    "GlobalAveragePooling2D": _cfg_layer(
+        KL.GlobalAveragePooling2D, with_ordering=True),
     "BatchNormalization": _bn, "Embedding": _embedding,
     "LSTM": _recurrent(KL.LSTM), "GRU": _recurrent(KL.GRU),
     "SimpleRNN": _recurrent(KL.SimpleRNN),
@@ -243,7 +251,7 @@ _DEF_CONVERTERS: Dict[str, Callable[[dict], Module]] = {
     "GlobalMaxPooling1D": _cfg_layer(KL.GlobalMaxPooling1D),
     "GlobalAveragePooling1D": _cfg_layer(KL.GlobalAveragePooling1D),
     "GlobalMaxPooling2D": _cfg_layer(KL.GlobalMaxPooling2D,
-                                     check_ordering=True),
+                                     with_ordering=True),
     "ZeroPadding2D": _zero_pad2d, "UpSampling2D": _upsample2d,
     "RepeatVector": _cfg_layer(KL.RepeatVector, "n"),
     "Permute": _cfg_layer(KL.Permute, "dims"),
@@ -253,7 +261,7 @@ _DEF_CONVERTERS: Dict[str, Callable[[dict], Module]] = {
     "LeakyReLU": _cfg_layer(KL.LeakyReLU, "alpha"),
     "ThresholdedReLU": _cfg_layer(KL.ThresholdedReLU, "theta"),
     "SpatialDropout2D": _cfg_layer(KL.SpatialDropout2D, "p",
-                                   check_ordering=True),
+                                   with_ordering=True),
     "GaussianNoise": _cfg_layer(KL.GaussianNoise, "sigma"),
     "GaussianDropout": _cfg_layer(KL.GaussianDropout, "p"),
 }
@@ -373,9 +381,17 @@ def _set_conv(layer, w):
     kw = w[0]
     if kw.ndim != 4:
         raise ValueError(f"Convolution2D weight rank {kw.ndim}")
-    if kw.shape[:2] != tuple(np.asarray(conv.weight.shape[:2])):
-        # 'th' layout (out, in, rows, cols) → HWIO
+    # the kernel layout follows the LAYER's dim_ordering, never a shape
+    # heuristic (a th Conv2D(3,3,3) on RGB has the same shape either
+    # way and would silently load untransposed): th stores
+    # (out, in, rows, cols), tf stores HWIO like us
+    if getattr(layer, "dim_ordering", "tf") == "th":
         kw = np.transpose(kw, (2, 3, 1, 0))
+    if tuple(kw.shape) != tuple(np.asarray(conv.weight.shape)):
+        raise ValueError(
+            f"Convolution2D weight shape {kw.shape} does not match the "
+            f"layer's {tuple(np.asarray(conv.weight.shape))} "
+            f"(dim_ordering={getattr(layer, 'dim_ordering', 'tf')!r})")
     conv.weight = Parameter(kw)
     if len(w) > 1 and getattr(conv, "bias", None) is not None:
         conv.bias = Parameter(w[1])

@@ -146,14 +146,16 @@ class Reshape(KerasLayer):
 
 
 class Convolution2D(KerasLayer):
-    """NHWC conv (≙ nn/keras/Convolution2D.scala; input_shape =
-    (rows, cols, channels))."""
+    """2-D conv (≙ nn/keras/Convolution2D.scala).  dim_ordering "tf":
+    input_shape = (rows, cols, channels); "th": (channels, rows, cols)
+    — the underlying conv runs data_format="NCHW" so th models keep
+    their tensor layout end to end."""
 
     def __init__(self, nb_filter: int, nb_row: int, nb_col: int,
                  activation: Optional[str] = None,
                  border_mode: str = "valid",
                  subsample: Tuple[int, int] = (1, 1),
-                 bias: bool = True,
+                 bias: bool = True, dim_ordering: str = "tf",
                  input_shape: Optional[Sequence[int]] = None):
         super().__init__(input_shape)
         if border_mode not in ("valid", "same"):
@@ -165,9 +167,14 @@ class Convolution2D(KerasLayer):
         self.border_mode = border_mode
         self.subsample = subsample
         self.bias = bias
+        self.dim_ordering = dim_ordering
 
     def build_layer(self, input_shape):
-        h, w, c = input_shape
+        th = self.dim_ordering == "th"
+        if th:
+            c, h, w = input_shape
+        else:
+            h, w, c = input_shape
         if self.border_mode == "same":
             # true SAME padding (pad=-1) keeps inference and execution in
             # agreement for even kernels / odd dims
@@ -181,10 +188,13 @@ class Convolution2D(KerasLayer):
         conv = nn.SpatialConvolution(
             c, self.nb_filter, self.nb_col, self.nb_row,
             self.subsample[1], self.subsample[0], pad_w, pad_h,
-            with_bias=self.bias, data_format="NHWC")
+            with_bias=self.bias,
+            data_format="NCHW" if th else "NHWC")
         act = _activation_module(self.activation)
         mod = conv if act is None else nn.Sequential(conv, act)
-        return mod, (out_h, out_w, self.nb_filter)
+        out = (self.nb_filter, out_h, out_w) if th \
+            else (out_h, out_w, self.nb_filter)
+        return mod, out
 
 
 class _Pooling2D(KerasLayer):
@@ -192,15 +202,20 @@ class _Pooling2D(KerasLayer):
 
     def __init__(self, pool_size: Tuple[int, int] = (2, 2),
                  strides: Optional[Tuple[int, int]] = None,
-                 border_mode: str = "valid",
+                 border_mode: str = "valid", dim_ordering: str = "tf",
                  input_shape: Optional[Sequence[int]] = None):
         super().__init__(input_shape)
         self.pool_size = pool_size
         self.strides = strides or pool_size
         self.border_mode = border_mode
+        self.dim_ordering = dim_ordering
 
     def build_layer(self, input_shape):
-        h, w, c = input_shape
+        th = self.dim_ordering == "th"
+        if th:
+            c, h, w = input_shape
+        else:
+            h, w, c = input_shape
         pad_h = pad_w = 0
         if self.border_mode == "same":
             out_h = -(-h // self.strides[0])
@@ -212,8 +227,9 @@ class _Pooling2D(KerasLayer):
         pool = self.pool_cls(
             self.pool_size[1], self.pool_size[0],
             self.strides[1], self.strides[0], pad_w, pad_h,
-            data_format="NHWC")
-        return pool, (out_h, out_w, c)
+            data_format="NCHW" if th else "NHWC")
+        out = (c, out_h, out_w) if th else (out_h, out_w, c)
+        return pool, out
 
 
 class MaxPooling2D(_Pooling2D):
@@ -225,27 +241,39 @@ class AveragePooling2D(_Pooling2D):
 
 
 class GlobalAveragePooling2D(KerasLayer):
+    def __init__(self, dim_ordering: str = "tf",
+                 input_shape: Optional[Sequence[int]] = None):
+        super().__init__(input_shape)
+        self.dim_ordering = dim_ordering
+
     def build_layer(self, input_shape):
+        if self.dim_ordering == "th":
+            c = input_shape[0]
+            return nn.GlobalAveragePooling2D(data_format="NCHW"), (c,)
         h, w, c = input_shape
         return nn.GlobalAveragePooling2D(), (c,)
 
 
 class BatchNormalization(KerasLayer):
     def __init__(self, epsilon: float = 1e-3, momentum: float = 0.99,
+                 dim_ordering: str = "tf",
                  input_shape: Optional[Sequence[int]] = None):
         super().__init__(input_shape)
         self.epsilon = epsilon
         self.momentum = momentum
+        self.dim_ordering = dim_ordering
 
     def build_layer(self, input_shape):
-        c = input_shape[-1]
+        th = self.dim_ordering == "th"
         if len(input_shape) == 3:
+            c = input_shape[0] if th else input_shape[-1]
             bn = nn.SpatialBatchNormalization(
                 c, eps=self.epsilon, momentum=1 - self.momentum,
-                data_format="NHWC")
+                data_format="NCHW" if th else "NHWC")
         else:
             bn = nn.BatchNormalization(
-                c, eps=self.epsilon, momentum=1 - self.momentum)
+                input_shape[-1], eps=self.epsilon,
+                momentum=1 - self.momentum)
         return bn, input_shape
 
 
@@ -400,38 +428,59 @@ class GlobalAveragePooling1D(KerasLayer):
 
 
 class GlobalMaxPooling2D(KerasLayer):
+    def __init__(self, dim_ordering: str = "tf",
+                 input_shape: Optional[Sequence[int]] = None):
+        super().__init__(input_shape)
+        self.dim_ordering = dim_ordering
+
     def build_layer(self, input_shape):
+        if self.dim_ordering == "th":
+            c = input_shape[0]
+            # NCHW: max over the two trailing spatial dims
+            return nn.Sequential(nn.Max(3), nn.Max(3)), (c,)
         h, w, c = input_shape
         return nn.Sequential(nn.Max(2), nn.Max(2)), (c,)
 
 
 class ZeroPadding2D(KerasLayer):
     def __init__(self, padding: Tuple[int, int] = (1, 1),
+                 dim_ordering: str = "tf",
                  input_shape: Optional[Sequence[int]] = None):
         super().__init__(input_shape)
         self.padding = tuple(padding)
+        self.dim_ordering = dim_ordering
 
     def build_layer(self, input_shape):
-        h, w, c = input_shape
+        th = self.dim_ordering == "th"
+        c, h, w = input_shape if th else \
+            (input_shape[2], input_shape[0], input_shape[1])
         ph, pw = self.padding
-        pad = nn.SpatialZeroPadding(pw, pw, ph, ph, data_format="NHWC")
+        pad = nn.SpatialZeroPadding(
+            pw, pw, ph, ph, data_format="NCHW" if th else "NHWC")
         out_h = None if h is None else h + 2 * ph
         out_w = None if w is None else w + 2 * pw
-        return pad, (out_h, out_w, c)
+        out = (c, out_h, out_w) if th else (out_h, out_w, c)
+        return pad, out
 
 
 class UpSampling2D(KerasLayer):
     def __init__(self, size: Tuple[int, int] = (2, 2),
+                 dim_ordering: str = "tf",
                  input_shape: Optional[Sequence[int]] = None):
         super().__init__(input_shape)
         self.size = tuple(size)
+        self.dim_ordering = dim_ordering
 
     def build_layer(self, input_shape):
-        h, w, c = input_shape
-        up = nn.UpSampling2D(self.size, data_format="NHWC")
+        th = self.dim_ordering == "th"
+        c, h, w = input_shape if th else \
+            (input_shape[2], input_shape[0], input_shape[1])
+        up = nn.UpSampling2D(self.size,
+                             data_format="NCHW" if th else "NHWC")
         out_h = None if h is None else h * self.size[0]
         out_w = None if w is None else w * self.size[1]
-        return up, (out_h, out_w, c)
+        out = (c, out_h, out_w) if th else (out_h, out_w, c)
+        return up, out
 
 
 class RepeatVector(KerasLayer):
@@ -555,14 +604,15 @@ class ThresholdedReLU(KerasLayer):
 
 
 class SpatialDropout2D(KerasLayer):
-    def __init__(self, p: float = 0.5,
+    def __init__(self, p: float = 0.5, dim_ordering: str = "tf",
                  input_shape: Optional[Sequence[int]] = None):
         super().__init__(input_shape)
         self.p = p
+        self.dim_ordering = dim_ordering
 
     def build_layer(self, input_shape):
-        return nn.SpatialDropout2D(self.p, data_format="NHWC"), \
-            input_shape
+        fmt = "NCHW" if self.dim_ordering == "th" else "NHWC"
+        return nn.SpatialDropout2D(self.p, data_format=fmt), input_shape
 
 
 class GaussianNoise(KerasLayer):

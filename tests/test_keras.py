@@ -197,15 +197,44 @@ def test_keras_json_conv_tf_ordering(tmp_path):
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
 
 
-def test_keras_th_ordering_rejected():
-    from bigdl_tpu.keras import load_keras_json
+def test_keras_th_ordering_end_to_end(tmp_path):
+    """dim_ordering='th' (NCHW, the keras-1.x default; VERDICT r03
+    missing #5): conv -> pool -> flatten -> dense with th weights,
+    oracle = torch executing the same NCHW math.  The NCHW flatten
+    order must match the Dense weights (the part a transpose-at-import
+    shortcut would get wrong)."""
+    tor = pytest.importorskip("torch")
+    from bigdl_tpu.keras import load_keras_hdf5_weights, load_keras_json
     spec = {"class_name": "Sequential", "config": [
         {"class_name": "Convolution2D", "config": {
-            "name": "c1", "nb_filter": 2, "nb_row": 3, "nb_col": 3,
-            "dim_ordering": "th",
-            "batch_input_shape": [None, 3, 6, 6]}}]}
-    with pytest.raises(ValueError, match="th"):
-        load_keras_json(spec)
+            "name": "c1", "nb_filter": 4, "nb_row": 3, "nb_col": 3,
+            "dim_ordering": "th", "activation": "relu",
+            "batch_input_shape": [None, 3, 8, 8]}},
+        {"class_name": "MaxPooling2D", "config": {
+            "name": "p1", "pool_size": [2, 2], "dim_ordering": "th"}},
+        {"class_name": "Flatten", "config": {"name": "fl"}},
+        {"class_name": "Dense", "config": {
+            "name": "fc", "output_dim": 5}},
+    ]}
+    model = load_keras_json(spec)
+    rng = np.random.RandomState(3)
+    kw = rng.randn(4, 3, 3, 3).astype(np.float32)   # th: (out,in,r,c)
+    kb = rng.randn(4).astype(np.float32)
+    fw = rng.randn(4 * 3 * 3, 5).astype(np.float32)  # keras (in, out)
+    fb = rng.randn(5).astype(np.float32)
+    hp = str(tmp_path / "w.h5")
+    _h5_weights(hp, {"c1": [kw, kb], "fc": [fw, fb]})
+    load_keras_hdf5_weights(model, hp)
+
+    x = rng.randn(2, 3, 8, 8).astype(np.float32)     # NCHW input
+    got = np.asarray(model.eval_mode().forward(jnp.asarray(x)))
+
+    h = tor.nn.functional.relu(tor.nn.functional.conv2d(
+        tor.tensor(x), tor.tensor(kw), tor.tensor(kb)))
+    h = tor.nn.functional.max_pool2d(h, 2)
+    h = h.reshape(2, -1)                              # NCHW flatten
+    want = (h @ tor.tensor(fw) + tor.tensor(fb)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
 
 
 def test_keras_functional_model_with_merge():
@@ -393,14 +422,22 @@ def test_pool1d_same_border_rejected():
         load_keras_json(spec)
 
 
-def test_th_ordering_rejected_for_global_pool():
+def test_th_ordering_global_pools():
+    """th global pooling reduces the trailing spatial dims (channels
+    stay axis 1)."""
     from bigdl_tpu.keras import load_keras_json
-    spec = {"class_name": "Sequential", "config": [
-        {"class_name": "GlobalMaxPooling2D", "config": {
-            "name": "g", "dim_ordering": "th",
-            "batch_input_shape": [None, 3, 5, 6]}}]}
-    with pytest.raises(ValueError, match="th"):
-        load_keras_json(spec)
+    rng = np.random.RandomState(4)
+    x = rng.randn(2, 3, 5, 6).astype(np.float32)
+    for cls, red in (("GlobalMaxPooling2D", np.max),
+                     ("GlobalAveragePooling2D", np.mean)):
+        spec = {"class_name": "Sequential", "config": [
+            {"class_name": cls, "config": {
+                "name": "g", "dim_ordering": "th",
+                "batch_input_shape": [None, 3, 5, 6]}}]}
+        m = load_keras_json(spec)
+        got = np.asarray(m.eval_mode().forward(jnp.asarray(x)))
+        np.testing.assert_allclose(got, red(x, axis=(2, 3)),
+                                   rtol=1e-5, atol=1e-6, err_msg=cls)
 
 
 @pytest.mark.parametrize("layer_fn,in_shape", [
