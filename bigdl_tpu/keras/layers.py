@@ -33,6 +33,29 @@ __all__ = [
 ]
 
 
+def _ordering_value(v: str) -> str:
+    """Validate a keras-1.2.2 dim_ordering at construction time (the
+    same loudness border_mode gets): "tf" = NHWC, "th" = NCHW."""
+    if v not in ("tf", "th"):
+        raise ValueError(f"unknown dim_ordering {v!r} (use 'tf' or 'th')")
+    return v
+
+
+def _chw(input_shape, th: bool):
+    """(channels, height, width) from a batchless 3-D shape in either
+    ordering."""
+    if th:
+        c, h, w = input_shape
+    else:
+        h, w, c = input_shape
+    return c, h, w
+
+
+def _spatial_out(th: bool, c, h, w):
+    """Batchless output shape in the layer's own ordering."""
+    return (c, h, w) if th else (h, w, c)
+
+
 def _activation_module(name: Optional[str]) -> Optional[Module]:
     if name is None or name == "linear":
         return None
@@ -167,14 +190,11 @@ class Convolution2D(KerasLayer):
         self.border_mode = border_mode
         self.subsample = subsample
         self.bias = bias
-        self.dim_ordering = dim_ordering
+        self.dim_ordering = _ordering_value(dim_ordering)
 
     def build_layer(self, input_shape):
         th = self.dim_ordering == "th"
-        if th:
-            c, h, w = input_shape
-        else:
-            h, w, c = input_shape
+        c, h, w = _chw(input_shape, th)
         if self.border_mode == "same":
             # true SAME padding (pad=-1) keeps inference and execution in
             # agreement for even kernels / odd dims
@@ -192,9 +212,7 @@ class Convolution2D(KerasLayer):
             data_format="NCHW" if th else "NHWC")
         act = _activation_module(self.activation)
         mod = conv if act is None else nn.Sequential(conv, act)
-        out = (self.nb_filter, out_h, out_w) if th \
-            else (out_h, out_w, self.nb_filter)
-        return mod, out
+        return mod, _spatial_out(th, self.nb_filter, out_h, out_w)
 
 
 class _Pooling2D(KerasLayer):
@@ -208,14 +226,11 @@ class _Pooling2D(KerasLayer):
         self.pool_size = pool_size
         self.strides = strides or pool_size
         self.border_mode = border_mode
-        self.dim_ordering = dim_ordering
+        self.dim_ordering = _ordering_value(dim_ordering)
 
     def build_layer(self, input_shape):
         th = self.dim_ordering == "th"
-        if th:
-            c, h, w = input_shape
-        else:
-            h, w, c = input_shape
+        c, h, w = _chw(input_shape, th)
         pad_h = pad_w = 0
         if self.border_mode == "same":
             out_h = -(-h // self.strides[0])
@@ -228,8 +243,7 @@ class _Pooling2D(KerasLayer):
             self.pool_size[1], self.pool_size[0],
             self.strides[1], self.strides[0], pad_w, pad_h,
             data_format="NCHW" if th else "NHWC")
-        out = (c, out_h, out_w) if th else (out_h, out_w, c)
-        return pool, out
+        return pool, _spatial_out(th, c, out_h, out_w)
 
 
 class MaxPooling2D(_Pooling2D):
@@ -244,14 +258,13 @@ class GlobalAveragePooling2D(KerasLayer):
     def __init__(self, dim_ordering: str = "tf",
                  input_shape: Optional[Sequence[int]] = None):
         super().__init__(input_shape)
-        self.dim_ordering = dim_ordering
+        self.dim_ordering = _ordering_value(dim_ordering)
 
     def build_layer(self, input_shape):
-        if self.dim_ordering == "th":
-            c = input_shape[0]
-            return nn.GlobalAveragePooling2D(data_format="NCHW"), (c,)
-        h, w, c = input_shape
-        return nn.GlobalAveragePooling2D(), (c,)
+        th = self.dim_ordering == "th"
+        c, _, _ = _chw(input_shape, th)
+        fmt = "NCHW" if th else "NHWC"
+        return nn.GlobalAveragePooling2D(data_format=fmt), (c,)
 
 
 class BatchNormalization(KerasLayer):
@@ -261,7 +274,7 @@ class BatchNormalization(KerasLayer):
         super().__init__(input_shape)
         self.epsilon = epsilon
         self.momentum = momentum
-        self.dim_ordering = dim_ordering
+        self.dim_ordering = _ordering_value(dim_ordering)
 
     def build_layer(self, input_shape):
         th = self.dim_ordering == "th"
@@ -431,15 +444,14 @@ class GlobalMaxPooling2D(KerasLayer):
     def __init__(self, dim_ordering: str = "tf",
                  input_shape: Optional[Sequence[int]] = None):
         super().__init__(input_shape)
-        self.dim_ordering = dim_ordering
+        self.dim_ordering = _ordering_value(dim_ordering)
 
     def build_layer(self, input_shape):
-        if self.dim_ordering == "th":
-            c = input_shape[0]
-            # NCHW: max over the two trailing spatial dims
-            return nn.Sequential(nn.Max(3), nn.Max(3)), (c,)
-        h, w, c = input_shape
-        return nn.Sequential(nn.Max(2), nn.Max(2)), (c,)
+        th = self.dim_ordering == "th"
+        c, _, _ = _chw(input_shape, th)
+        # NCHW: max over the two trailing spatial dims; NHWC: dims 2,3
+        dim = 3 if th else 2
+        return nn.Sequential(nn.Max(dim), nn.Max(dim)), (c,)
 
 
 class ZeroPadding2D(KerasLayer):
@@ -448,19 +460,17 @@ class ZeroPadding2D(KerasLayer):
                  input_shape: Optional[Sequence[int]] = None):
         super().__init__(input_shape)
         self.padding = tuple(padding)
-        self.dim_ordering = dim_ordering
+        self.dim_ordering = _ordering_value(dim_ordering)
 
     def build_layer(self, input_shape):
         th = self.dim_ordering == "th"
-        c, h, w = input_shape if th else \
-            (input_shape[2], input_shape[0], input_shape[1])
+        c, h, w = _chw(input_shape, th)
         ph, pw = self.padding
         pad = nn.SpatialZeroPadding(
             pw, pw, ph, ph, data_format="NCHW" if th else "NHWC")
         out_h = None if h is None else h + 2 * ph
         out_w = None if w is None else w + 2 * pw
-        out = (c, out_h, out_w) if th else (out_h, out_w, c)
-        return pad, out
+        return pad, _spatial_out(th, c, out_h, out_w)
 
 
 class UpSampling2D(KerasLayer):
@@ -469,18 +479,16 @@ class UpSampling2D(KerasLayer):
                  input_shape: Optional[Sequence[int]] = None):
         super().__init__(input_shape)
         self.size = tuple(size)
-        self.dim_ordering = dim_ordering
+        self.dim_ordering = _ordering_value(dim_ordering)
 
     def build_layer(self, input_shape):
         th = self.dim_ordering == "th"
-        c, h, w = input_shape if th else \
-            (input_shape[2], input_shape[0], input_shape[1])
+        c, h, w = _chw(input_shape, th)
         up = nn.UpSampling2D(self.size,
                              data_format="NCHW" if th else "NHWC")
         out_h = None if h is None else h * self.size[0]
         out_w = None if w is None else w * self.size[1]
-        out = (c, out_h, out_w) if th else (out_h, out_w, c)
-        return up, out
+        return up, _spatial_out(th, c, out_h, out_w)
 
 
 class RepeatVector(KerasLayer):
@@ -608,7 +616,7 @@ class SpatialDropout2D(KerasLayer):
                  input_shape: Optional[Sequence[int]] = None):
         super().__init__(input_shape)
         self.p = p
-        self.dim_ordering = dim_ordering
+        self.dim_ordering = _ordering_value(dim_ordering)
 
     def build_layer(self, input_shape):
         fmt = "NCHW" if self.dim_ordering == "th" else "NHWC"

@@ -99,6 +99,16 @@ class _FlashCfg(NamedTuple):
     interpret: bool
 
 
+def _dimsem(*sems):
+    """TPU compiler hint: which grid dims are parallel (megacore-
+    splittable) vs sequential ("arbitrary" — carries a VMEM/output
+    accumulator).  No-op where pltpu is unavailable."""
+    if pltpu is None:  # pragma: no cover
+        return {}
+    return {"compiler_params": pltpu.CompilerParams(
+        dimension_semantics=sems)}
+
+
 def _scratch(shape):
     """VMEM scratch allocation (fp32 accumulator carried across the
     sequential k grid dimension)."""
@@ -215,6 +225,7 @@ def _fwd_impl(q, k, v, bias, cfg: _FlashCfg):
         scratch_shapes=[_scratch((block_q, d)), _scratch((block_q, 1)),
                         _scratch((block_q, 1))],
         interpret=cfg.interpret,
+        **_dimsem("parallel", "parallel", "arbitrary"),
     )(*args)
     return out.reshape(b, h, tq, d), lse
 
@@ -406,6 +417,7 @@ def _bwd_impl(q, k, v, bias, out, lse, do, cfg: _FlashCfg, *,
         out_shape=jax.ShapeDtypeStruct((b * h, tq, d), q.dtype),
         scratch_shapes=[_scratch((block_q, d))],
         interpret=cfg.interpret,
+        **_dimsem("parallel", "parallel", "arbitrary"),
     )(*dq_args)
 
     # ---- dK/dV: grid (bh, k-block, q-block) ---------------------------
@@ -438,6 +450,7 @@ def _bwd_impl(q, k, v, bias, out, lse, do, cfg: _FlashCfg, *,
                    jax.ShapeDtypeStruct((b * h, tk, d), v.dtype)],
         scratch_shapes=[_scratch((block_k, d)), _scratch((block_k, d))],
         interpret=cfg.interpret,
+        **_dimsem("parallel", "parallel", "arbitrary"),
     )(*dkv_args)
 
     return (dq.reshape(b, h, tq, d), dk.reshape(b, h, tk, d),
@@ -470,6 +483,7 @@ def _dbias_impl(q, k, v, bias, lse, cfg: _FlashCfg, *, prep):
         out_specs=tile,
         out_shape=jax.ShapeDtypeStruct((b * h, tq, tk), jnp.float32),
         interpret=cfg.interpret,
+        **_dimsem("parallel", "parallel", "parallel"),
     )(qr, kr, vr, biasr, dor, lse, delta)
 
     ds = ds.reshape(b, h, tq, tk)
@@ -591,6 +605,7 @@ def flash_attention_partial(q, k, v, acc, m, l, *, q_offset, k_offset,
                    jax.ShapeDtypeStruct((b * h, tq, 1), jnp.float32),
                    jax.ShapeDtypeStruct((b * h, tq, 1), jnp.float32)],
         interpret=cfg.interpret,
+        **_dimsem("parallel", "parallel", "arbitrary"),
     )(jnp.asarray(q_offset, jnp.int32).reshape(1),
       jnp.asarray(k_offset, jnp.int32).reshape(1),
       qr, kr, vr, accr, mr, lr)

@@ -224,3 +224,81 @@ def test_distkldiv_divides_by_nelement():
         jnp.asarray(logp), jnp.asarray(t))
     ref = F.kl_div(torch.tensor(logp), torch.tensor(t), reduction="mean")
     np.testing.assert_allclose(float(ours), float(ref), rtol=1e-3)
+
+
+# -------------------------------------------------------------------------
+# Parametrized gradient sweep: every torch-comparable criterion's
+# input-gradient must match torch (the reference's per-criterion specs
+# check backward too).  Cases: (name, ours, torch_fn, make_(input,target)).
+# -------------------------------------------------------------------------
+
+def _r(*shape, seed=0):
+    return np.random.RandomState(seed).randn(*shape).astype(np.float32)
+
+
+def _sig01(*shape, seed=0):
+    return (1 / (1 + np.exp(-_r(*shape, seed=seed)))).astype(np.float32)
+
+
+GRAD_CASES = [
+    ("MSE", lambda: nn.MSECriterion(),
+     lambda a, t: F.mse_loss(a, t), lambda: (_r(4, 5, seed=1), _r(4, 5, seed=2))),
+    ("Abs", lambda: nn.AbsCriterion(),
+     lambda a, t: F.l1_loss(a, t), lambda: (_r(4, 5, seed=3), _r(4, 5, seed=4))),
+    ("BCE", lambda: nn.BCECriterion(),
+     lambda a, t: F.binary_cross_entropy(a, t),
+     lambda: (_sig01(4, 5, seed=5), (_r(4, 5, seed=6) > 0).astype(np.float32))),
+    ("SmoothL1", lambda: nn.SmoothL1Criterion(),
+     lambda a, t: F.smooth_l1_loss(a, t),
+     lambda: (_r(4, 5, seed=7), _r(4, 5, seed=8))),
+    ("SoftMargin", lambda: nn.SoftMarginCriterion(),
+     lambda a, t: F.soft_margin_loss(a, t),
+     lambda: (_r(4, 5, seed=9),
+              np.sign(_r(4, 5, seed=10)).astype(np.float32))),
+    ("ClassNLL", lambda: nn.ClassNLLCriterion(),
+     lambda a, t: F.nll_loss(a, t),
+     lambda: (np.log(_sig01(6, 4, seed=11) + 0.1),
+              np.random.RandomState(12).randint(1, 5, size=(6,)))),
+    ("CrossEntropy", lambda: nn.CrossEntropyCriterion(),
+     lambda a, t: F.cross_entropy(a, t),
+     lambda: (_r(6, 4, seed=13),
+              np.random.RandomState(14).randint(1, 5, size=(6,)))),
+    ("DistKLDiv", lambda: nn.DistKLDivCriterion(),
+     lambda a, t: F.kl_div(a, t, reduction="batchmean") * t.shape[0]
+     / t.numel(),
+     lambda: (np.log(_sig01(4, 5, seed=15) + 0.05),
+              _sig01(4, 5, seed=16))),
+    ("Poisson", lambda: nn.PoissonCriterion(),
+     lambda a, t: F.poisson_nll_loss(torch.log(a), t, log_input=True,
+                                     full=False),
+     lambda: (_sig01(4, 5, seed=17) + 0.5, _sig01(4, 5, seed=18))),
+    ("MultiMargin", lambda: nn.MultiMarginCriterion(),
+     lambda a, t: F.multi_margin_loss(a, t),
+     lambda: (_r(6, 4, seed=19),
+              np.random.RandomState(20).randint(1, 5, size=(6,)))),
+    ("HingeEmbedding", lambda: nn.HingeEmbeddingCriterion(margin=1.0),
+     lambda a, t: F.hinge_embedding_loss(a, t, margin=1.0),
+     lambda: (np.abs(_r(4, 5, seed=21)),
+              np.sign(_r(4, 5, seed=22)).astype(np.float32))),
+    ("MultiLabelSoftMargin", lambda: nn.MultiLabelSoftMarginCriterion(),
+     lambda a, t: F.multilabel_soft_margin_loss(a, t),
+     lambda: (_r(4, 5, seed=23), (_r(4, 5, seed=24) > 0).astype(np.float32))),
+]
+
+
+@pytest.mark.parametrize("case", GRAD_CASES, ids=lambda c: c[0])
+def test_criterion_grad_sweep(case):
+    name, make_ours, torch_fn, make_io = case
+    crit = make_ours()
+    a_np, t_np = make_io()
+    one_based = name in ("ClassNLL", "CrossEntropy", "MultiMargin")
+
+    g_ours = jax.grad(
+        lambda a: crit(a, jnp.asarray(t_np)))(jnp.asarray(a_np))
+
+    ta = torch.tensor(a_np, requires_grad=True)
+    tt = torch.tensor(t_np - 1) if one_based else torch.tensor(t_np)
+    loss = torch_fn(ta, tt)
+    loss.backward()
+    np.testing.assert_allclose(np.asarray(g_ours), ta.grad.numpy(),
+                               rtol=1e-4, atol=1e-5, err_msg=name)
