@@ -243,6 +243,51 @@ def _recompute_p(q_scaled, k_blk, bias_blk, lse, q_pos0, k_pos0, cfg,
     return jnp.exp(s - lse)
 
 
+def _dq_accum(acc_ref, q_ref, k_ref, v_ref, bias_blk, do_ref,
+              lse_ref, delta_ref, q_pos0, k_pos0, cfg: _FlashCfg):
+    """Shared dQ tile step: acc += [P ∘ (dO V^T − Δ)] K (P recomputed
+    from the q/k tiles + lse).  Used by the full backward (positions
+    from program_id) and the ring partial backward (positions scalar-
+    prefetched)."""
+    q = q_ref[...].astype(jnp.float32) * cfg.scale
+    do = do_ref[...].astype(jnp.float32)
+    lse = lse_ref[...].astype(jnp.float32)
+    delta = delta_ref[...].astype(jnp.float32)
+    k_blk = k_ref[...].astype(jnp.float32)
+    v_blk = v_ref[...].astype(jnp.float32)
+    p = _recompute_p(q, k_blk, bias_blk, lse, q_pos0, k_pos0, cfg,
+                     (cfg.block_q, cfg.block_k))
+    dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = p * (dp - delta)
+    acc_ref[...] = acc_ref[...] + jax.lax.dot_general(
+        ds, k_blk, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+
+def _dkv_accum(dk_acc, dv_acc, k_ref, v_ref, q_ref, bias_blk, do_ref,
+               lse_ref, delta_ref, q_pos0, k_pos0, cfg: _FlashCfg):
+    """Shared dK/dV tile step: dV += P^T dO; dK += scale·dS^T Q."""
+    k = k_ref[...].astype(jnp.float32)
+    v = v_ref[...].astype(jnp.float32)
+    q_blk = q_ref[...].astype(jnp.float32) * cfg.scale
+    do_blk = do_ref[...].astype(jnp.float32)
+    lse_blk = lse_ref[...].astype(jnp.float32)
+    delta_blk = delta_ref[...].astype(jnp.float32)
+    p = _recompute_p(q_blk, k, bias_blk, lse_blk, q_pos0, k_pos0, cfg,
+                     (cfg.block_q, cfg.block_k))
+    dv_acc[...] = dv_acc[...] + jax.lax.dot_general(
+        p, do_blk, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    dp = jax.lax.dot_general(do_blk, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = p * (dp - delta_blk)
+    # q_blk already carries `scale`, so this accumulates scale·ds^T·q
+    dk_acc[...] = dk_acc[...] + jax.lax.dot_general(
+        ds, q_blk, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+
 def _flash_dq_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
                      delta_ref, dq_ref, acc_ref, *, cfg: _FlashCfg,
                      nk: int):
@@ -262,24 +307,12 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
 
     @pl.when(needed)
     def _body():
-        q = q_ref[...].astype(jnp.float32) * cfg.scale
-        do = do_ref[...].astype(jnp.float32)
-        lse = lse_ref[...].astype(jnp.float32)        # [block_q, 1]
-        delta = delta_ref[...].astype(jnp.float32)    # [block_q, 1]
-        k_blk = k_ref[...].astype(jnp.float32)
-        v_blk = v_ref[...].astype(jnp.float32)
         bias_blk = None
         if bias_ref is not None:
             bias_blk = bias_ref[...].astype(jnp.float32)
-        p = _recompute_p(q, k_blk, bias_blk, lse,
-                         q_idx * block_q, k_idx * block_k, cfg,
-                         (block_q, block_k))
-        dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        ds = p * (dp - delta)
-        acc_ref[...] = acc_ref[...] + jax.lax.dot_general(
-            ds, k_blk, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+        _dq_accum(acc_ref, q_ref, k_ref, v_ref, bias_blk, do_ref,
+                  lse_ref, delta_ref, q_idx * block_q, k_idx * block_k,
+                  cfg)
 
     @pl.when(k_idx == nk - 1)
     def _finish():
@@ -307,28 +340,12 @@ def _flash_dkv_kernel(k_ref, v_ref, q_ref, bias_ref, do_ref, lse_ref,
 
     @pl.when(needed)
     def _body():
-        k = k_ref[...].astype(jnp.float32)
-        v = v_ref[...].astype(jnp.float32)
-        q_blk = q_ref[...].astype(jnp.float32) * cfg.scale
-        do_blk = do_ref[...].astype(jnp.float32)
-        lse_blk = lse_ref[...].astype(jnp.float32)
-        delta_blk = delta_ref[...].astype(jnp.float32)
         bias_blk = None
         if bias_ref is not None:
             bias_blk = bias_ref[...].astype(jnp.float32)
-        p = _recompute_p(q_blk, k, bias_blk, lse_blk,
-                         q_idx * block_q, k_idx * block_k, cfg,
-                         (block_q, block_k))
-        dv_acc[...] = dv_acc[...] + jax.lax.dot_general(
-            p, do_blk, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        dp = jax.lax.dot_general(do_blk, v, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_blk)
-        # q_blk already carries `scale`, so this accumulates scale·ds^T·q
-        dk_acc[...] = dk_acc[...] + jax.lax.dot_general(
-            ds, q_blk, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+        _dkv_accum(dk_acc, dv_acc, k_ref, v_ref, q_ref, bias_blk,
+                   do_ref, lse_ref, delta_ref, q_idx * block_q,
+                   k_idx * block_k, cfg)
 
     @pl.when(q_idx == nq - 1)
     def _finish():
@@ -611,6 +628,148 @@ def flash_attention_partial(q, k, v, acc, m, l, *, q_offset, k_offset,
       qr, kr, vr, accr, mr, lr)
     return (acc2.reshape(b, h, tq, d), m2.reshape(b, h, tq),
             l2.reshape(b, h, tq))
+
+
+def _flash_dq_partial_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref,
+                             do_ref, lse_ref, delta_ref, dq_ref,
+                             acc_ref, *, cfg: _FlashCfg, nk: int):
+    """dQ contribution of ONE visiting K/V chunk (ring backward).
+    lse/delta are the FINAL whole-sequence values, so
+    P = exp(s - lse) is already normalized; offsets are global."""
+    block_q, block_k = cfg.block_q, cfg.block_k
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    q_pos0 = qoff_ref[0] + i * block_q
+    k_pos0 = koff_ref[0] + j * block_k
+    needed = True
+    if cfg.causal:
+        needed = k_pos0 <= q_pos0 + block_q - 1
+
+    @pl.when(needed)
+    def _body():
+        _dq_accum(acc_ref, q_ref, k_ref, v_ref, None, do_ref, lse_ref,
+                  delta_ref, q_pos0, k_pos0, cfg)
+
+    @pl.when(j == nk - 1)
+    def _finish():
+        dq_ref[...] = (acc_ref[...] * cfg.scale).astype(dq_ref.dtype)
+
+
+def _flash_dkv_partial_kernel(qoff_ref, koff_ref, k_ref, v_ref, q_ref,
+                              do_ref, lse_ref, delta_ref, dk_ref,
+                              dv_ref, dk_acc, dv_acc, *,
+                              cfg: _FlashCfg, nq: int):
+    """dK/dV of ONE visiting chunk w.r.t. THIS device's Q/dO (ring
+    backward); grid (bh, local k-blocks, local q-blocks)."""
+    block_q, block_k = cfg.block_q, cfg.block_k
+    j = pl.program_id(1)
+    i = pl.program_id(2)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    q_pos0 = qoff_ref[0] + i * block_q
+    k_pos0 = koff_ref[0] + j * block_k
+    needed = True
+    if cfg.causal:
+        needed = q_pos0 + block_q - 1 >= k_pos0
+
+    @pl.when(needed)
+    def _body():
+        _dkv_accum(dk_acc, dv_acc, k_ref, v_ref, q_ref, None, do_ref,
+                   lse_ref, delta_ref, q_pos0, k_pos0, cfg)
+
+    @pl.when(i == nq - 1)
+    def _finish():
+        dk_ref[...] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[...] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _partial_rows(x, b, h, t):
+    return x.reshape(b * h, t, 1).astype(jnp.float32)
+
+
+def flash_attention_dq_partial(q, k, v, do, lse, delta, *, q_offset,
+                               k_offset, causal, scale, block_q,
+                               block_k, interpret):
+    """dQ contribution of one visiting chunk (see ring backward).
+    q/do [B,H,Tq,D]; k/v [B,H,Tk,D]; lse/delta [B,H,Tq] fp32 (FINAL
+    whole-sequence logsumexp / Δ rows)."""
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    cfg = _FlashCfg(bool(causal), float(scale), int(block_q),
+                    int(block_k), bool(interpret))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b * h, tq // block_q, tk // block_k),
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda bh, i, j, *r: (bh, i, 0)),
+            pl.BlockSpec((None, block_k, d), lambda bh, i, j, *r: (bh, j, 0)),
+            pl.BlockSpec((None, block_k, d), lambda bh, i, j, *r: (bh, j, 0)),
+            pl.BlockSpec((None, block_q, d), lambda bh, i, j, *r: (bh, i, 0)),
+            pl.BlockSpec((None, block_q, 1), lambda bh, i, j, *r: (bh, i, 0)),
+            pl.BlockSpec((None, block_q, 1), lambda bh, i, j, *r: (bh, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, d),
+                               lambda bh, i, j, *r: (bh, i, 0)),
+        scratch_shapes=[_scratch((block_q, d))],
+    )
+    dq = pl.pallas_call(
+        functools.partial(_flash_dq_partial_kernel, cfg=cfg,
+                          nk=tk // block_k),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b * h, tq, d), jnp.float32),
+        interpret=cfg.interpret,
+        **_dimsem("parallel", "parallel", "arbitrary"),
+    )(jnp.asarray(q_offset, jnp.int32).reshape(1),
+      jnp.asarray(k_offset, jnp.int32).reshape(1),
+      q.reshape(b * h, tq, d), k.reshape(b * h, tk, d),
+      v.reshape(b * h, tk, d), do.reshape(b * h, tq, d),
+      _partial_rows(lse, b, h, tq), _partial_rows(delta, b, h, tq))
+    return dq.reshape(b, h, tq, d)
+
+
+def flash_attention_dkv_partial(q, k, v, do, lse, delta, *, q_offset,
+                                k_offset, causal, scale, block_q,
+                                block_k, interpret):
+    """(dK, dV) of one visiting chunk against this device's Q/dO."""
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    cfg = _FlashCfg(bool(causal), float(scale), int(block_q),
+                    int(block_k), bool(interpret))
+    kblk = pl.BlockSpec((None, block_k, d), lambda bh, j, i, *r: (bh, j, 0))
+    qstream = pl.BlockSpec((None, block_q, d),
+                           lambda bh, j, i, *r: (bh, i, 0))
+    rowstream = pl.BlockSpec((None, block_q, 1),
+                             lambda bh, j, i, *r: (bh, i, 0))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b * h, tk // block_k, tq // block_q),
+        in_specs=[kblk, kblk, qstream, qstream, rowstream, rowstream],
+        out_specs=[kblk, kblk],
+        scratch_shapes=[_scratch((block_k, d)), _scratch((block_k, d))],
+    )
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_dkv_partial_kernel, cfg=cfg,
+                          nq=tq // block_q),
+        grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct((b * h, tk, d), jnp.float32),
+                   jax.ShapeDtypeStruct((b * h, tk, d), jnp.float32)],
+        interpret=cfg.interpret,
+        **_dimsem("parallel", "parallel", "arbitrary"),
+    )(jnp.asarray(q_offset, jnp.int32).reshape(1),
+      jnp.asarray(k_offset, jnp.int32).reshape(1),
+      k.reshape(b * h, tk, d), v.reshape(b * h, tk, d),
+      q.reshape(b * h, tq, d), do.reshape(b * h, tq, d),
+      _partial_rows(lse, b, h, tq), _partial_rows(delta, b, h, tq))
+    return dk.reshape(b, h, tk, d), dv.reshape(b, h, tk, d)
 
 
 # ---- custom_vjp wiring ----------------------------------------------------

@@ -127,13 +127,9 @@ def _ring_xla(q, k, v, axis_name: str, causal: bool, scale: float,
     return (acc / safe_l[..., None]).astype(q.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
-def _ring_flash(q, k, v, cfg):
-    """Flash ring: each step merges the visiting chunk through the
-    Pallas flash-partial kernel — O(block) score tiles, never O(Tc²).
-    Backward recomputes through the XLA ring's vjp (same math; the
-    fully-blockwise ring backward kernel is a future step — the same
-    interim the r03 verdict accepted for flash_attention itself)."""
+def _ring_flash_impl(q, k, v, cfg):
+    """Flash-ring forward; returns (out, lse) — the final per-row
+    logsumexp is the residual the blockwise backward needs."""
     axis_name, causal, scale, blk, interpret = cfg
     from bigdl_tpu.ops.attention_kernels import flash_attention_partial
 
@@ -172,20 +168,82 @@ def _ring_flash(q, k, v, cfg):
     acc, m, l, _, _ = jax.lax.fori_loop(
         0, n, body, (acc0, m0, l0, k, v))
     safe_l = jnp.where(l == 0.0, 1.0, l)
-    return (acc / safe_l[..., None]).astype(q.dtype)
+    out = (acc / safe_l[..., None]).astype(q.dtype)
+    return out, m + jnp.log(safe_l)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _ring_flash(q, k, v, cfg):
+    """Flash ring: each step merges the visiting chunk through the
+    Pallas flash-partial kernel — O(block) score tiles, never O(Tc²).
+    The backward is blockwise too (a second ring pass): dK/dV
+    accumulators ROTATE WITH their K/V chunk, each device adding its
+    contribution as the chunk visits, so after n steps every chunk —
+    and its gradient — is back home."""
+    out, _ = _ring_flash_impl(q, k, v, cfg)
+    return out
 
 
 def _ring_flash_fwd(q, k, v, cfg):
-    return _ring_flash(q, k, v, cfg), (q, k, v)
+    out, lse = _ring_flash_impl(q, k, v, cfg)
+    return out, (q, k, v, out, lse)
 
 
 def _ring_flash_bwd(cfg, res, g):
-    axis_name, causal, scale, _blk, _interp = cfg
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: _ring_xla(q_, k_, v_, axis_name, causal,
-                                     scale, None), q, k, v)
-    return vjp(g)
+    axis_name, causal, scale, blk, interpret = cfg
+    from bigdl_tpu.ops.attention_kernels import (
+        flash_attention_dq_partial, flash_attention_dkv_partial)
+
+    q, k, v, out, lse = res
+    n = jax.lax.psum(1, axis_name)
+    me = jax.lax.axis_index(axis_name)
+    b, h, tc, d = q.shape
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    g32 = g.astype(jnp.float32)
+    # Δ rows (Σ_j P_ij dP_ij) — whole-sequence, like lse
+    delta = jnp.sum(g32 * out.astype(jnp.float32), axis=-1)
+
+    z = jnp.zeros((b, h, tc, d), jnp.float32)
+
+    def body(s, carry):
+        dq, k_cur, v_cur, dk_cur, dv_cur = carry
+        src = (me - s) % n
+        qoff, koff = me * tc, src * tc
+
+        def dq_step(_):
+            return flash_attention_dq_partial(
+                q, k_cur, v_cur, g32, lse, delta, q_offset=qoff,
+                k_offset=koff, causal=causal, scale=scale, block_q=blk,
+                block_k=blk, interpret=interpret)
+
+        def dkv_step(_):
+            return flash_attention_dkv_partial(
+                q, k_cur, v_cur, g32, lse, delta, q_offset=qoff,
+                k_offset=koff, causal=causal, scale=scale, block_q=blk,
+                block_k=blk, interpret=interpret)
+
+        if causal:
+            contrib = src <= me
+            dq = dq + jax.lax.cond(contrib, dq_step,
+                                   lambda _: z, None)
+            dk_c, dv_c = jax.lax.cond(contrib, dkv_step,
+                                      lambda _: (z, z), None)
+        else:
+            dq = dq + dq_step(None)
+            dk_c, dv_c = dkv_step(None)
+        dk_cur = dk_cur + dk_c
+        dv_cur = dv_cur + dv_c
+        # the chunk and its accumulated gradient rotate together; after
+        # n steps both are back on the chunk's home device
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        dk_nxt = jax.lax.ppermute(dk_cur, axis_name, perm)
+        dv_nxt = jax.lax.ppermute(dv_cur, axis_name, perm)
+        return dq, k_nxt, v_nxt, dk_nxt, dv_nxt
+
+    dq, _, _, dk, dv = jax.lax.fori_loop(
+        0, n, body, (z, k, v, z, z))
+    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype))
 
 
 _ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)

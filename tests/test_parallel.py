@@ -62,19 +62,27 @@ def test_ring_attention_flash_kernel_matches_full(seq_mesh, causal):
                                rtol=1e-4, atol=1e-5)
 
 
-def test_ring_attention_flash_kernel_grads(seq_mesh):
-    """Grads through the flash ring (custom_vjp recomputing via the
-    XLA ring) must match full attention."""
-    q, k, v = rnd(1, 2, 64, 8, seed=34), rnd(1, 2, 64, 8, seed=35), \
-        rnd(1, 2, 64, 8, seed=36)
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_flash_kernel_grads(seq_mesh, causal):
+    """Grads through the flash ring's BLOCKWISE backward (dK/dV
+    accumulators rotating with their chunks) must match full attention
+    for q, k, AND v."""
+    # T=192 on the 8-way mesh: tc=24, block 8 -> nk=3 blocks per
+    # chunk, covering the partial kernels' cross-block accumulation
+    q, k, v = rnd(1, 2, 192, 8, seed=34), rnd(1, 2, 192, 8, seed=35), \
+        rnd(1, 2, 192, 8, seed=36)
 
     g_ring = jax.grad(
-        lambda q_: jnp.sum(ring_self_attention(
-            q_, k, v, seq_mesh, causal=True, kernel="flash") ** 2))(q)
+        lambda args: jnp.sum(ring_self_attention(
+            *args, seq_mesh, causal=causal, kernel="flash") ** 2))(
+        (q, k, v))
     g_full = jax.grad(
-        lambda q_: jnp.sum(xla_attention(q_, k, v, causal=True) ** 2))(q)
-    np.testing.assert_allclose(np.asarray(g_ring), np.asarray(g_full),
-                               rtol=1e-3, atol=1e-4)
+        lambda args: jnp.sum(xla_attention(
+            *args, causal=causal) ** 2))((q, k, v))
+    for gr, gf, name in zip(g_ring, g_full, "qkv"):
+        np.testing.assert_allclose(np.asarray(gr), np.asarray(gf),
+                                   rtol=1e-3, atol=1e-4,
+                                   err_msg=f"d{name}")
 
 
 @pytest.mark.slow
