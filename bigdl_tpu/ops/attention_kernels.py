@@ -704,6 +704,12 @@ def flash_attention_dq_partial(q, k, v, do, lse, delta, *, q_offset,
     whole-sequence logsumexp / Δ rows)."""
     b, h, tq, d = q.shape
     tk = k.shape[2]
+    assert tq % block_q == 0 and tk % block_k == 0, (tq, tk)
+    if pltpu is None:  # pragma: no cover
+        raise RuntimeError(
+            "the flash partial backward needs jax.experimental.pallas"
+            ".tpu (scalar prefetch); use kernel='xla' / "
+            "BIGDL_TPU_ATTENTION=xla on this backend")
     cfg = _FlashCfg(bool(causal), float(scale), int(block_q),
                     int(block_k), bool(interpret))
     grid_spec = pltpu.PrefetchScalarGridSpec(
@@ -742,6 +748,12 @@ def flash_attention_dkv_partial(q, k, v, do, lse, delta, *, q_offset,
     """(dK, dV) of one visiting chunk against this device's Q/dO."""
     b, h, tq, d = q.shape
     tk = k.shape[2]
+    assert tq % block_q == 0 and tk % block_k == 0, (tq, tk)
+    if pltpu is None:  # pragma: no cover
+        raise RuntimeError(
+            "the flash partial backward needs jax.experimental.pallas"
+            ".tpu (scalar prefetch); use kernel='xla' / "
+            "BIGDL_TPU_ATTENTION=xla on this backend")
     cfg = _FlashCfg(bool(causal), float(scale), int(block_q),
                     int(block_k), bool(interpret))
     kblk = pl.BlockSpec((None, block_k, d), lambda bh, j, i, *r: (bh, j, 0))
