@@ -35,6 +35,13 @@ def _build_model(name: str, class_num: int):
     return getattr(models, MODELS[name])(class_num)
 
 
+def _short_side(size: int) -> int:
+    """Short-side resize target for a given crop size (256 for 224
+    crops, scaled proportionally) — shared by the augment recipe AND
+    the native decoder's minimum decode size so they cannot drift."""
+    return max(size * 256 // 224, size)
+
+
 class _Augment:
     """Sample-level wrapper over the vision FeatureTransformers:
     aspect-preserving short-side scale (256 for 224-px crops, scaled
@@ -51,7 +58,7 @@ class _Augment:
         # Resize(r, r) would distort non-square images.  The long side
         # is uncapped: a max_size cap could shrink the short side below
         # the crop and crash batching on extreme panoramas.
-        r = max(size * 256 // 224, size)
+        r = _short_side(size)
         scale = AspectScale(r, max_size=None)
         if train:
             self.stages = [scale, RandomCrop(size, size),
@@ -103,10 +110,27 @@ def _list_image_folder(path: str, class_to_label=None):
     return items, len(class_to_label), class_to_label
 
 
-def _decode_rgb(path):
+def _decode_rgb(path, min_short: int = 0):
     """path → HWC float32 RGB array (single decode expression shared by
-    every pipeline so EXIF/color handling cannot diverge)."""
+    every pipeline so color handling cannot diverge).
+
+    JPEGs go through the native libjpeg decoder when it built
+    (bigdl_tpu.native.jpeg_decode_scaled): with ``min_short`` > 0 it
+    DCT-downscales during decode so a 4000px photo headed for a 256px
+    short side never materializes at full resolution — the AspectScale
+    stage downstream then only closes the last <=2x gap.  Everything
+    else (PNG/BMP/..., no native lib, corrupt data) falls back to PIL."""
     import numpy as np
+    if path.lower().endswith((".jpg", ".jpeg")):
+        from bigdl_tpu.native import jpeg_decode_scaled
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
+            arr = jpeg_decode_scaled(data, min_short)
+        except OSError:
+            arr = None
+        if arr is not None:
+            return arr.astype(np.float32)
     from PIL import Image
     return np.asarray(Image.open(path).convert("RGB"), np.float32)
 
@@ -126,6 +150,8 @@ class _DecodeAugment:
     def __init__(self, train: bool, size: int):
         import threading
         self._train, self._size = train, size
+        # the augment's short-side target: decode no smaller than this
+        self._min_short = _short_side(size)
         self._local = threading.local()
 
     def _aug(self) -> _Augment:
@@ -138,7 +164,9 @@ class _DecodeAugment:
     def __call__(self, item):
         from bigdl_tpu.dataset.dataset import Sample
         path, label = item
-        return Sample(self._aug().apply_one(_decode_rgb(path)), label)
+        return Sample(
+            self._aug().apply_one(_decode_rgb(path, self._min_short)),
+            label)
 
 
 def train_pipeline(folder: str, size: int, batch_size: int,

@@ -38,9 +38,11 @@ def load_model(fmt: str, model_path: str, prototxt: str = None):
 def _prep_images(paths, size):
     """Decode + eval-augment via the single shared _Augment path."""
     import numpy as np
-    from bigdl_tpu.examples.imagenet import _Augment, _decode_rgb
+    from bigdl_tpu.examples.imagenet import (_Augment, _decode_rgb,
+                                             _short_side)
     aug = _Augment(train=False, size=size)
-    return np.stack([aug.apply_one(_decode_rgb(p)) for p in paths])
+    ms = _short_side(size)
+    return np.stack([aug.apply_one(_decode_rgb(p, ms)) for p in paths])
 
 
 def check_class_count(model, folder_classes: int, size: int) -> None:

@@ -28,6 +28,7 @@ __all__ = [
     "available", "lib", "crc32c", "masked_crc32c",
     "quantize_rows", "dequantize_rows", "mix_precision_gemm",
     "tfrecord_frame", "tfrecord_scan",
+    "jpeg_available", "jpeg_decode_scaled",
 ]
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
@@ -39,8 +40,10 @@ _tried = False
 
 
 def _sources():
+    # jpeg.cc builds separately (it links -ljpeg; see _jpeg_lib) so a
+    # missing libjpeg cannot take down the main library
     return sorted(os.path.join(_SRC, f) for f in os.listdir(_SRC)
-                  if f.endswith(".cc"))
+                  if f.endswith(".cc") and f != "jpeg.cc")
 
 
 def _needs_build() -> bool:
@@ -111,6 +114,89 @@ def lib() -> Optional[ctypes.CDLL]:
 
 def available() -> bool:
     return lib() is not None
+
+
+# --------------------------------------------------------------------------
+# JPEG decode with DCT-domain downscaling (own shared library: -ljpeg)
+# --------------------------------------------------------------------------
+
+_JPEG_SRC = os.path.join(_SRC, "jpeg.cc")
+_JPEG_LIB_PATH = os.path.join(_HERE, "libbigdl_jpeg.so")
+_jpeg_lib_handle: Optional[ctypes.CDLL] = None
+_jpeg_tried = False
+
+
+def _jpeg_lib() -> Optional[ctypes.CDLL]:
+    global _jpeg_lib_handle, _jpeg_tried
+    if _jpeg_lib_handle is not None or _jpeg_tried:
+        return _jpeg_lib_handle
+    with _lock:
+        if _jpeg_lib_handle is not None or _jpeg_tried:
+            return _jpeg_lib_handle
+        _jpeg_tried = True
+        if os.environ.get("BIGDL_TPU_NATIVE_JPEG", "1") == "0":
+            return None
+        needs = (not os.path.exists(_JPEG_LIB_PATH)
+                 or os.path.getmtime(_JPEG_SRC)
+                 > os.path.getmtime(_JPEG_LIB_PATH))
+        if needs:
+            cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+                   "-o", _JPEG_LIB_PATH, _JPEG_SRC, "-ljpeg"]
+            try:
+                res = subprocess.run(cmd, capture_output=True,
+                                     timeout=120)
+                if res.returncode != 0:
+                    sys.stderr.write(
+                        "bigdl_tpu.native jpeg build failed (PIL "
+                        "fallback): "
+                        + res.stderr.decode()[:300].strip() + "\n")
+                    return None
+            except (OSError, subprocess.TimeoutExpired) as e:
+                sys.stderr.write(
+                    f"bigdl_tpu.native jpeg build unavailable: {e}\n")
+                return None
+        try:
+            l = ctypes.CDLL(_JPEG_LIB_PATH)
+        except OSError:
+            return None
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        l.bigdl_jpeg_scaled_dims.restype = ctypes.c_int
+        l.bigdl_jpeg_scaled_dims.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int)]
+        l.bigdl_jpeg_decode_scaled.restype = ctypes.c_int
+        l.bigdl_jpeg_decode_scaled.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int, u8p,
+            ctypes.c_int, ctypes.c_int]
+        _jpeg_lib_handle = l
+        return _jpeg_lib_handle
+
+
+def jpeg_available() -> bool:
+    return _jpeg_lib() is not None
+
+
+def jpeg_decode_scaled(data: bytes,
+                       min_short: int = 0) -> Optional[np.ndarray]:
+    """Decode JPEG bytes to an HWC uint8 RGB array, DCT-downscaled so
+    the short side stays >= ``min_short`` (0 = full size).  None when
+    the native path is unavailable or the data isn't decodable JPEG —
+    callers fall back to PIL."""
+    l = _jpeg_lib()
+    if l is None:
+        return None
+    h = ctypes.c_int()
+    w = ctypes.c_int()
+    if l.bigdl_jpeg_scaled_dims(data, len(data), int(min_short),
+                                ctypes.byref(h), ctypes.byref(w)):
+        return None
+    out = np.empty((h.value, w.value, 3), np.uint8)
+    if l.bigdl_jpeg_decode_scaled(
+            data, len(data), int(min_short),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            h.value, w.value):
+        return None
+    return out
 
 
 # --------------------------------------------------------------------------

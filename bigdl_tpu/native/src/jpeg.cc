@@ -1,0 +1,137 @@
+// Native JPEG decode with DCT-domain downscaling.
+//
+// Reference parity: the BigDL-core OpenCV JNI decode path
+// (transform/vision/image/opencv/OpenCVMat.scala imdecode call sites)
+// — the host-side image decode that feeds the training pipeline.  The
+// TPU-native win over decode-full-then-resize: libjpeg can produce a
+// N/8-scaled image directly from the DCT coefficients, so a 4032px
+// photo headed for a 256px short side decodes ~8x less pixel data.
+//
+// Built as its OWN shared library (libbigdl_jpeg.so) so the -ljpeg
+// link requirement cannot take down the main native library's build.
+// All entry points return nonzero on any libjpeg error (custom
+// error_exit longjmps instead of libjpeg's default exit()).
+
+#include <csetjmp>
+#include <cstddef>
+#include <cstdio>
+
+#include <jpeglib.h>
+
+namespace {
+
+struct ErrMgr {
+  jpeg_error_mgr pub;
+  jmp_buf jb;
+  int warnings;
+};
+
+void err_exit(j_common_ptr cinfo) {
+  ErrMgr* e = reinterpret_cast<ErrMgr*>(cinfo->err);
+  longjmp(e->jb, 1);
+}
+
+// Count corrupt-data warnings (e.g. premature EOF -> gray fill) so the
+// caller can REJECT truncated files instead of silently training on
+// them; the PIL fallback raises on the same data, and the two paths
+// must not diverge (imagenet._decode_rgb docstring).
+void err_count(j_common_ptr cinfo, int msg_level) {
+  if (msg_level < 0) {
+    reinterpret_cast<ErrMgr*>(cinfo->err)->warnings++;
+  }
+}
+void err_silent_msg(j_common_ptr) {}
+
+// Largest DCT downscale (out of 1/8, 2/8, 4/8, 8/8 — supported by
+// every libjpeg lineage) whose SHORT side stays >= min_short.
+int pick_scale_num(long h, long w, long min_short) {
+  const int nums[] = {1, 2, 4, 8};
+  long s = h < w ? h : w;
+  for (int num : nums) {
+    if (s * num / 8 >= min_short) return num;
+  }
+  return 8;
+}
+
+bool setup(jpeg_decompress_struct* cinfo, ErrMgr* err,
+           const unsigned char* data, int len, int min_short) {
+  cinfo->err = jpeg_std_error(&err->pub);
+  err->pub.error_exit = err_exit;
+  err->pub.emit_message = err_count;
+  err->pub.output_message = err_silent_msg;
+  err->warnings = 0;
+  jpeg_create_decompress(cinfo);
+  jpeg_mem_src(cinfo, const_cast<unsigned char*>(data),
+               static_cast<unsigned long>(len));
+  if (jpeg_read_header(cinfo, TRUE) != JPEG_HEADER_OK) return false;
+  cinfo->out_color_space = JCS_RGB;
+  cinfo->scale_denom = 8;
+  cinfo->scale_num = min_short > 0
+      ? pick_scale_num(cinfo->image_height, cinfo->image_width,
+                       min_short)
+      : 8;
+  jpeg_calc_output_dimensions(cinfo);
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Scaled output dims for (data, min_short); 0 on success.
+int bigdl_jpeg_scaled_dims(const unsigned char* data, int len,
+                           int min_short, int* out_h, int* out_w) {
+  jpeg_decompress_struct cinfo;
+  ErrMgr err;
+  if (setjmp(err.jb)) {
+    jpeg_destroy_decompress(&cinfo);
+    return 1;
+  }
+  if (!setup(&cinfo, &err, data, len, min_short)) {
+    jpeg_destroy_decompress(&cinfo);
+    return 2;
+  }
+  *out_h = static_cast<int>(cinfo.output_height);
+  *out_w = static_cast<int>(cinfo.output_width);
+  jpeg_destroy_decompress(&cinfo);
+  return 0;
+}
+
+// Decode into caller-allocated out[out_h * out_w * 3] (RGB, uint8).
+// out_h/out_w must come from bigdl_jpeg_scaled_dims with the same
+// min_short.  0 on success.
+int bigdl_jpeg_decode_scaled(const unsigned char* data, int len,
+                             int min_short, unsigned char* out,
+                             int out_h, int out_w) {
+  jpeg_decompress_struct cinfo;
+  ErrMgr err;
+  if (setjmp(err.jb)) {
+    jpeg_destroy_decompress(&cinfo);
+    return 1;
+  }
+  if (!setup(&cinfo, &err, data, len, min_short)) {
+    jpeg_destroy_decompress(&cinfo);
+    return 2;
+  }
+  if (static_cast<int>(cinfo.output_height) != out_h ||
+      static_cast<int>(cinfo.output_width) != out_w) {
+    jpeg_destroy_decompress(&cinfo);
+    return 3;
+  }
+  jpeg_start_decompress(&cinfo);
+  if (cinfo.output_components != 3) {
+    jpeg_destroy_decompress(&cinfo);
+    return 4;
+  }
+  const size_t stride = static_cast<size_t>(out_w) * 3;
+  while (cinfo.output_scanline < cinfo.output_height) {
+    JSAMPROW row = out + static_cast<size_t>(cinfo.output_scanline)
+        * stride;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  return err.warnings > 0 ? 5 : 0;
+}
+
+}  // extern "C"

@@ -130,3 +130,71 @@ def test_tfrecord_scan_huge_length_is_safe():
     frame[0:8] = struct.pack("<Q", 0xFFFFFFFFFFFFFFF8)
     spans = native.tfrecord_scan(bytes(frame), verify_crc=False)
     assert spans == []  # treated as truncated tail, no crash
+
+
+def test_native_jpeg_decode_matches_pil_exact():
+    """Full-size native decode must be byte-exact vs PIL (both wrap
+    libjpeg with the default DCT method)."""
+    pytest.importorskip("PIL")
+    import io
+    from PIL import Image
+    from bigdl_tpu.native import jpeg_available, jpeg_decode_scaled
+    if not jpeg_available():
+        pytest.skip("libjpeg toolchain unavailable")
+    rng = np.random.default_rng(0)
+    arr = rng.integers(0, 256, size=(96, 130, 3), dtype=np.uint8)
+    buf = io.BytesIO()
+    Image.fromarray(arr).save(buf, format="JPEG", quality=92)
+    data = buf.getvalue()
+    ours = jpeg_decode_scaled(data, 0)
+    ref = np.asarray(Image.open(io.BytesIO(data)).convert("RGB"))
+    # <=1 LSB: Pillow may bundle a different libjpeg build than g++
+    # links (turbo SIMD variants differ in last-bit rounding)
+    assert np.abs(ours.astype(int) - ref.astype(int)).max() <= 1
+
+
+def test_native_jpeg_dct_downscale_and_fallback():
+    pytest.importorskip("PIL")
+    import io
+    from PIL import Image
+    from bigdl_tpu.native import jpeg_available, jpeg_decode_scaled
+    if not jpeg_available():
+        pytest.skip("libjpeg toolchain unavailable")
+    rng = np.random.default_rng(1)
+    arr = rng.integers(0, 256, size=(400, 600, 3), dtype=np.uint8)
+    buf = io.BytesIO()
+    Image.fromarray(arr).save(buf, format="JPEG", quality=92)
+    out = jpeg_decode_scaled(buf.getvalue(), 150)
+    # 4/8 scale: short side 200 >= 150, aspect preserved
+    assert out.shape == (200, 300, 3)
+    # grayscale converts to RGB like PIL's convert("RGB")
+    gbuf = io.BytesIO()
+    Image.fromarray(arr[..., 0]).save(gbuf, format="JPEG")
+    g = jpeg_decode_scaled(gbuf.getvalue(), 0)
+    assert g.shape == (400, 600, 3)
+    assert (g[..., 0] == g[..., 1]).all()
+    # garbage -> None (callers fall back to PIL)
+    assert jpeg_decode_scaled(b"definitely not a jpeg", 10) is None
+    # TRUNCATED file -> None too (gray-filled silent decode would
+    # diverge from the PIL fallback, which raises on the same bytes)
+    whole = buf.getvalue()
+    assert jpeg_decode_scaled(whole[: len(whole) // 2], 0) is None
+
+
+def test_decode_rgb_native_and_pil_paths_agree(tmp_path):
+    """The pipeline's _decode_rgb must give the same full-size pixels
+    through either backend, and the min_short fast path must feed the
+    augment something AspectScale-compatible."""
+    pytest.importorskip("PIL")
+    from PIL import Image
+    from bigdl_tpu.examples.imagenet import _decode_rgb
+    rng = np.random.default_rng(2)
+    p = str(tmp_path / "x.jpg")
+    Image.fromarray(rng.integers(0, 256, size=(300, 450, 3),
+                                 dtype=np.uint8)).save(p, quality=90)
+    full = _decode_rgb(p)
+    assert full.shape == (300, 450, 3) and full.dtype == np.float32
+    fast = _decode_rgb(p, min_short=140)
+    # short side stays >= the augment target, aspect preserved
+    assert min(fast.shape[:2]) >= 140
+    assert abs(fast.shape[1] / fast.shape[0] - 1.5) < 0.02
