@@ -122,13 +122,15 @@ def _decode_rgb(path, min_short: int = 0):
     else (PNG/BMP/..., no native lib, corrupt data) falls back to PIL."""
     import numpy as np
     if path.lower().endswith((".jpg", ".jpeg")):
-        from bigdl_tpu.native import jpeg_decode_scaled
-        try:
-            with open(path, "rb") as f:
-                data = f.read()
-            arr = jpeg_decode_scaled(data, min_short)
-        except OSError:
-            arr = None
+        from bigdl_tpu.native import jpeg_available, jpeg_decode_scaled
+        arr = None
+        if jpeg_available():   # cached; don't double-read on PIL hosts
+            try:
+                with open(path, "rb") as f:
+                    data = f.read()
+                arr = jpeg_decode_scaled(data, min_short)
+            except OSError:
+                arr = None
         if arr is not None:
             return arr.astype(np.float32)
     from PIL import Image

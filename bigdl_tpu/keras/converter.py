@@ -162,6 +162,15 @@ def _recurrent(cls):
     return cv
 
 
+def _bidirectional(cfg):
+    sub = cfg["layer"]
+    inner = _convert_layer(sub)
+    return KL.Bidirectional(
+        inner, merge_mode=cfg.get("merge_mode", "concat"),
+        input_shape=_in_shape(cfg)
+        or _in_shape(sub.get("config", {})))
+
+
 def _highway(cfg):
     return KL.Highway(activation=cfg.get("activation", "tanh"),
                       input_shape=_in_shape(cfg))
@@ -244,6 +253,7 @@ _DEF_CONVERTERS: Dict[str, Callable[[dict], Module]] = {
     "BatchNormalization": _bn, "Embedding": _embedding,
     "LSTM": _recurrent(KL.LSTM), "GRU": _recurrent(KL.GRU),
     "SimpleRNN": _recurrent(KL.SimpleRNN),
+    "Bidirectional": _bidirectional,
     "Highway": _highway, "Merge": _merge, "InputLayer": _input_layer,
     "Convolution1D": _conv1d,
     "MaxPooling1D": _pool1d(KL.MaxPooling1D),
@@ -423,7 +433,7 @@ def _rnn_cell(layer):
     raise ValueError(f"no recurrent cell found inside {layer!r}")
 
 
-def _set_lstm(layer, w):
+def _lstm_cell_params(w):
     """Keras-1.2.2 LSTM stores 12 per-gate arrays in (i, c, f, o) gate
     groups: [W_i,U_i,b_i, W_c,U_c,b_c, W_f,U_f,b_f, W_o,U_o,b_o]
     (reference repacking: pyspark converter.py convert_lstm).  Our
@@ -432,42 +442,80 @@ def _set_lstm(layer, w):
     if len(w) != 12:
         raise ValueError(f"LSTM expects 12 weight arrays, got {len(w)}")
     wi, ui, bi, wc, uc, bc, wf, uf, bf, wo, uo, bo = w
-    cell = _rnn_cell(layer)
-    cell.w_input = Parameter(np.concatenate([wi, wf, wc, wo], axis=1))
-    cell.w_hidden = Parameter(np.concatenate([ui, uf, uc, uo], axis=1))
-    cell.bias = Parameter(np.concatenate([bi, bf, bc, bo]))
+    return {"w_input": np.concatenate([wi, wf, wc, wo], axis=1),
+            "w_hidden": np.concatenate([ui, uf, uc, uo], axis=1),
+            "bias": np.concatenate([bi, bf, bc, bo])}
 
 
-def _set_gru(layer, w):
+def _gru_cell_params(w):
     """Keras-1.2.2 GRU: [W_z,U_z,b_z, W_r,U_r,b_r, W_h,U_h,b_h]
     (reference convert_gru reads exactly these positions).  Our cell
     packs (r, z) gates + a separate candidate, like nn/GRU.scala."""
     if len(w) != 9:
         raise ValueError(f"GRU expects 9 weight arrays, got {len(w)}")
     wz, uz, bz, wr, ur, br, wh, uh, bh = w
-    cell = _rnn_cell(layer)
-    cell.w_input = Parameter(np.concatenate([wr, wz, wh], axis=1))
-    cell.w_hidden = Parameter(np.concatenate([ur, uz], axis=1))
-    cell.w_candidate = Parameter(uh)
-    cell.bias = Parameter(np.concatenate([br, bz, bh]))
+    return {"w_input": np.concatenate([wr, wz, wh], axis=1),
+            "w_hidden": np.concatenate([ur, uz], axis=1),
+            "w_candidate": uh,
+            "bias": np.concatenate([br, bz, bh])}
 
 
-def _set_simplernn(layer, w):
+def _simplernn_cell_params(w):
     """Keras-1.2.2 SimpleRNN: [W, U, b] (reference convert_simplernn)."""
     if len(w) != 3:
         raise ValueError(
             f"SimpleRNN expects 3 weight arrays, got {len(w)}")
-    cell = _rnn_cell(layer)
-    cell.w_input = Parameter(w[0])
-    cell.w_hidden = Parameter(w[1])
-    cell.bias = Parameter(w[2])
+    return {"w_input": w[0], "w_hidden": w[1], "bias": w[2]}
 
+
+def _apply_cell_params(cell, params):
+    for name, value in params.items():
+        setattr(cell, name, Parameter(value))
+
+
+def _set_lstm(layer, w):
+    _apply_cell_params(_rnn_cell(layer), _lstm_cell_params(w))
+
+
+def _set_gru(layer, w):
+    _apply_cell_params(_rnn_cell(layer), _gru_cell_params(w))
+
+
+def _set_simplernn(layer, w):
+    _apply_cell_params(_rnn_cell(layer), _simplernn_cell_params(w))
+
+
+_CELL_PACKERS = {}  # filled after the KL classes are bound below
+
+
+def _set_bidirectional(layer, w):
+    """Keras-1.2.2 Bidirectional: forward weights then backward weights
+    (reference convert_bidirectional splits at the midpoint).  Each
+    half repacks exactly like the wrapped layer type, into the
+    BiRecurrent's fwd/bwd cells."""
+    inner = layer.layer
+    packer = _CELL_PACKERS.get(type(inner))
+    if packer is None:
+        raise NotImplementedError(
+            f"Bidirectional weight import for "
+            f"{type(inner).__name__} is not supported")
+    half = len(w) // 2
+    bi = layer.inner
+    _apply_cell_params(bi.fwd.cell, packer(w[:half]))
+    _apply_cell_params(bi.bwd.cell, packer(w[half:]))
+
+
+_CELL_PACKERS.update({
+    KL.LSTM: _lstm_cell_params, KL.GRU: _gru_cell_params,
+    KL.SimpleRNN: _simplernn_cell_params,
+})
 
 _WEIGHT_SETTERS = {
     KL.Dense: _set_dense, KL.Convolution2D: _set_conv,
     KL.BatchNormalization: _set_bn, KL.Embedding: _set_embedding,
     KL.LSTM: _set_lstm, KL.GRU: _set_gru, KL.SimpleRNN: _set_simplernn,
     KL.TimeDistributedDense: _set_dense,
+    KL.Bidirectional: _set_bidirectional,
 }
 
 

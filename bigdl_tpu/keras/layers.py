@@ -571,6 +571,12 @@ class Bidirectional(KerasLayer):
         if not self.layer.return_sequences:
             raise ValueError(
                 "Bidirectional requires return_sequences=True")
+        if getattr(self.layer, "go_backwards", False):
+            # keras flips go_backwards for the backward copy; honoring
+            # it would swap the halves — reject rather than silently
+            # diverge (same policy as stateful/dropout_U)
+            raise ValueError(
+                "Bidirectional over go_backwards=True is not supported")
         merge = (nn.JoinTable(3) if self.merge_mode == "concat"
                  else nn.CAddTable())
         rec = nn.BiRecurrent(merge=merge,

@@ -190,6 +190,11 @@ def jpeg_decode_scaled(data: bytes,
     if l.bigdl_jpeg_scaled_dims(data, len(data), int(min_short),
                                 ctypes.byref(h), ctypes.byref(w)):
         return None
+    # decompression-bomb guard (PIL's error threshold: 2x its default
+    # MAX_IMAGE_PIXELS): oversized headers fall back to PIL, which
+    # raises its DecompressionBombError — consistent failure mode
+    if h.value * w.value > 2 * 89478485:
+        return None
     out = np.empty((h.value, w.value, 3), np.uint8)
     if l.bigdl_jpeg_decode_scaled(
             data, len(data), int(min_short),

@@ -697,3 +697,47 @@ def test_keras_recurrent_dropout_flags(tmp_path):
     from bigdl_tpu.keras.converter import _rnn_cell
     layer = model.layers[0] if hasattr(model, "layers") else model
     assert _rnn_cell(layer).p == 0.25
+
+
+def test_keras_bidirectional_lstm_import_matches_torch(tmp_path):
+    """Bidirectional LSTM: forward weights then backward weights
+    (reference convert_bidirectional midpoint split); oracle = torch
+    nn.LSTM(bidirectional=True), whose output is [fwd, bwd-aligned]
+    concat — the same semantics as BiRecurrent."""
+    tor = pytest.importorskip("torch")
+    from bigdl_tpu.keras import load_keras_hdf5_weights, load_keras_json
+    T, F, H = 5, 3, 4
+    tl = tor.nn.LSTM(F, H, batch_first=True, bidirectional=True)
+
+    def keras_half(sfx):
+        w_ih = getattr(tl, f"weight_ih_l0{sfx}").detach().numpy()
+        w_hh = getattr(tl, f"weight_hh_l0{sfx}").detach().numpy()
+        b = (getattr(tl, f"bias_ih_l0{sfx}")
+             + getattr(tl, f"bias_hh_l0{sfx}")).detach().numpy()
+        gi, gf, gg, go = [slice(k * H, (k + 1) * H) for k in range(4)]
+        return [w_ih[gi].T, w_hh[gi].T, b[gi],
+                w_ih[gg].T, w_hh[gg].T, b[gg],
+                w_ih[gf].T, w_hh[gf].T, b[gf],
+                w_ih[go].T, w_hh[go].T, b[go]]
+
+    weights = keras_half("") + keras_half("_reverse")
+    spec = {"class_name": "Sequential", "config": [
+        {"class_name": "Bidirectional", "config": {
+            "name": "bi", "merge_mode": "concat",
+            "batch_input_shape": [None, T, F],
+            "layer": {"class_name": "LSTM", "config": {
+                "name": "inner", "output_dim": H,
+                "return_sequences": True, "activation": "tanh",
+                "inner_activation": "sigmoid"}}}},
+    ]}
+    model = load_keras_json(spec)
+    hp = str(tmp_path / "w.h5")
+    _h5_weights(hp, {"bi": weights})
+    load_keras_hdf5_weights(model, hp)
+
+    rng = np.random.RandomState(21)
+    x = rng.randn(2, T, F).astype(np.float32)
+    got = np.asarray(model.eval_mode().forward(jnp.asarray(x)))
+    want, _ = tl(tor.tensor(x))
+    np.testing.assert_allclose(got, want.detach().numpy(),
+                               rtol=1e-4, atol=1e-5)
