@@ -70,7 +70,8 @@ class TransformerLM(Module):
         self.final_norm = LayerNormalization(hidden_size)
 
     def set_sequence_parallel(self, mesh, axis: str = "seq",
-                              kernel=None) -> "TransformerLM":
+                              kernel=None,
+                              head_axis=None) -> "TransformerLM":
         """Run every block's self-attention through ring attention over
         ``mesh[axis]`` (sequence/context parallelism — contexts longer
         than one chip's HBM; see parallel/ring_attention.py).  The
@@ -88,10 +89,11 @@ class TransformerLM(Module):
                 blk.self_attn.mesh = mesh
                 blk.self_attn.seq_axis = axis
                 blk.self_attn.ring_kernel = kernel
+                blk.self_attn.head_axis = head_axis
             else:
                 blk.self_attn = RingSelfAttention.from_attention(
                     blk.self_attn, mesh, axis, causal=True,
-                    kernel=kernel)
+                    kernel=kernel, head_axis=head_axis)
         self.seq_parallel = True
         return self
 

@@ -276,11 +276,19 @@ def ring_attention(q, k, v, axis_name: str, *, causal: bool = False,
 def ring_self_attention(q, k, v, mesh: Mesh, axis: str = "seq", *,
                         causal: bool = False,
                         scale: Optional[float] = None, bias=None,
-                        kernel: Optional[str] = None):
+                        kernel: Optional[str] = None,
+                        head_axis: Optional[str] = None):
     """Global entry: q/k/v [B, H, T, D] (T divisible by mesh axis size)
     are sequence-sharded over ``axis`` and attended with the ring
-    schedule.  Equivalent to full attention, O(T/n) memory per chip."""
-    spec = P(None, None, axis, None)
+    schedule.  Equivalent to full attention, O(T/n) memory per chip.
+
+    ``head_axis``: also shard the head dimension over this mesh axis —
+    attention is per-head independent, so when the surrounding
+    projections are tensor-parallel (Megatron column-split over heads)
+    this keeps the TP sharding THROUGH the ring instead of forcing
+    GSPMD to all-gather heads at the shard_map boundary (the
+    "involuntary full rematerialization" SPMD warning)."""
+    spec = P(None, head_axis, axis, None)
     if bias is None:
         fn = jax.shard_map(
             functools.partial(ring_attention, axis_name=axis,
@@ -323,12 +331,14 @@ class RingSelfAttention(Attention):
     """
 
     def __init__(self, hidden_size, num_heads, mesh, axis="seq",
-                 causal=True, attention_dropout=0.0, kernel=None):
+                 causal=True, attention_dropout=0.0, kernel=None,
+                 head_axis=None):
         super().__init__(hidden_size, num_heads, attention_dropout)
         self.mesh = mesh
         self.seq_axis = axis
         self.causal = causal
         self.ring_kernel = kernel   # "flash" | "xla" | None=auto
+        self.head_axis = head_axis  # TP mesh axis for the head dim
 
     def forward(self, x, y=None, bias=None, cache=None, cache_index=None):
         if cache is not None or (y is not None and y is not x):
@@ -351,18 +361,27 @@ class RingSelfAttention(Attention):
             raise ValueError(
                 f"sequence length {x.shape[1]} is not divisible by the "
                 f"{self.seq_axis!r} mesh axis size {n_shards}")
+        head_axis = getattr(self, "head_axis", None)
+        if head_axis is not None:
+            n_head_shards = self.mesh.shape[head_axis]
+            if self.num_heads % n_head_shards:
+                raise ValueError(
+                    f"num_heads {self.num_heads} is not divisible by "
+                    f"the {head_axis!r} mesh axis size {n_head_shards}")
         q = self._split_heads(self.q_layer(x))
         k = self._split_heads(self.k_layer(x))
         v = self._split_heads(self.v_layer(x))
         ctxt = ring_self_attention(q, k, v, self.mesh, self.seq_axis,
                                    causal=self.causal,
                                    kernel=getattr(self, "ring_kernel",
-                                                  None))
+                                                  None),
+                                   head_axis=getattr(self, "head_axis",
+                                                     None))
         return self.output_layer(self._combine_heads(ctxt))
 
     @classmethod
     def from_attention(cls, attn, mesh, axis="seq", causal=True,
-                       kernel=None):
+                       kernel=None, head_axis=None):
         # rng-neutral construction: Attention.__init__ would draw four
         # throwaway Linear inits from the global RNG stream
         ring = object.__new__(cls)
@@ -375,6 +394,7 @@ class RingSelfAttention(Attention):
         ring.seq_axis = axis
         ring.causal = causal
         ring.ring_kernel = kernel
+        ring.head_axis = head_axis
         # share the projection modules (and thus the parameters)
         ring.q_layer = attn.q_layer
         ring.k_layer = attn.k_layer

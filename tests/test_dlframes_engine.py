@@ -53,6 +53,7 @@ def test_dl_estimator_regression():
     assert np.abs(preds - y).mean() < 0.1
 
 
+@pytest.mark.slow
 def test_sklearn_pipeline_compat():
     """DLEstimator must compose in sklearn Pipelines (the analog of the
     reference's Spark ML pipeline integration)."""

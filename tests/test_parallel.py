@@ -511,6 +511,7 @@ def test_moe_optimizer_training_equivalence():
         np.testing.assert_allclose(a, b, rtol=1e-3, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_moe_trains():
     """Gradient flows through routing + experts; aux loss finite."""
     from bigdl_tpu.utils import set_seed
@@ -531,3 +532,54 @@ def test_moe_trains():
     # gate must receive gradient (routing is differentiable via weights)
     gate_grad = grads.gate._params["weight"]
     assert float(jnp.abs(gate_grad).max()) > 0
+
+
+@pytest.mark.slow
+def test_tp_sp_composition_matches_dense():
+    """TP (Megatron head-sharded projections, model axis) composes with
+    SP (ring attention, seq axis) on ONE mesh: head_axis keeps the TP
+    sharding THROUGH the ring's shard_map (no forced head all-gather),
+    and loss + all grads match the dense model."""
+    from bigdl_tpu.core.module import combine, partition
+    from bigdl_tpu.models import transformer_lm
+    from bigdl_tpu.parallel import tensor_parallel_rules
+    from bigdl_tpu.parallel.sharding import shard_model_params
+    from bigdl_tpu.utils import set_seed
+
+    set_seed(0)
+    lm = transformer_lm(vocab_size=30, hidden_size=16, num_layers=2,
+                        num_heads=2, filter_size=32,
+                        max_len=64).eval_mode()
+    rng = np.random.default_rng(0)
+    toks = jnp.asarray(rng.integers(1, 31, (2, 16)), jnp.int32)
+    targets = jnp.asarray(rng.integers(1, 31, (2, 16)), jnp.int32)
+    crit = nn.CrossEntropyCriterion()
+
+    def loss_grads(model):
+        params, rest = partition(model)
+
+        def f(p):
+            out = combine(p, rest).forward(toks).reshape(-1, 31)
+            return crit(out, targets.reshape(-1))
+
+        l, g = jax.value_and_grad(f)(params)
+        return float(l), {jax.tree_util.keystr(kp): np.asarray(v)
+                          for kp, v in
+                          jax.tree_util.tree_leaves_with_path(g)}
+
+    l_dense, g_dense = loss_grads(lm)
+    devs = np.array(jax.devices()[:8]).reshape(2, 4)
+    mesh = Mesh(devs, ("model", "seq"))
+    rules = tensor_parallel_rules(
+        column=[r".*q_layer.*", r".*k_layer.*", r".*v_layer.*",
+                r".*filter_layer.*"],
+        row=[r".*output_layer.*"])
+    with mesh:
+        lm2 = shard_model_params(lm, mesh, rules)
+        lm2.set_sequence_parallel(mesh, "seq", head_axis="model")
+        l_both, g_both = loss_grads(lm2)
+    np.testing.assert_allclose(l_both, l_dense, rtol=1e-4)
+    assert set(g_both) == set(g_dense)
+    for kp in g_dense:
+        np.testing.assert_allclose(g_both[kp], g_dense[kp],
+                                   rtol=3e-3, atol=3e-4, err_msg=kp)

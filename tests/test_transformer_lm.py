@@ -123,6 +123,7 @@ def test_trains_via_optimizer():
     assert losses < 3.0  # well below ln(41) ~ 3.71 => it is learning
 
 
+@pytest.mark.slow
 def test_incremental_decode_matches_full_forward():
     """decode_step with the KV cache must reproduce each column of the
     full forward exactly (eval mode)."""
@@ -168,6 +169,7 @@ def test_generate_stops_at_eos():
     assert (out[0, 4:] == 0).all()   # padded after EOS
 
 
+@pytest.mark.slow
 def test_beam_size_one_matches_greedy():
     m = _model().eval_mode()
     rng = np.random.default_rng(8)
