@@ -375,8 +375,7 @@ class RingSelfAttention(Attention):
                                    causal=self.causal,
                                    kernel=getattr(self, "ring_kernel",
                                                   None),
-                                   head_axis=getattr(self, "head_axis",
-                                                     None))
+                                   head_axis=head_axis)
         return self.output_layer(self._combine_heads(ctxt))
 
     @classmethod

@@ -583,3 +583,43 @@ def test_tp_sp_composition_matches_dense():
     for kp in g_dense:
         np.testing.assert_allclose(g_both[kp], g_dense[kp],
                                    rtol=3e-3, atol=3e-4, err_msg=kp)
+
+
+@pytest.mark.slow
+def test_dp_ep_composition_training_equivalence():
+    """DP (batch over data axis) composes with EP (a2a token dispatch
+    over the expert axis) on one mesh, through the full Optimizer loop:
+    loss and trained params match the dense single-device run."""
+    from bigdl_tpu.parallel import MeshConfig
+    from bigdl_tpu.dataset.dataset import Sample, DataSet
+    from bigdl_tpu.dataset import SampleToMiniBatch
+    from bigdl_tpu.optim import Optimizer, SGD, Trigger
+    from bigdl_tpu.utils import set_seed
+
+    def train(mesh_cfg, moe_mesh=None):
+        set_seed(42)
+        moe = MoE(16, [nn.FeedForwardNetwork(16, 32) for _ in range(4)],
+                  top_k=2)
+        if moe_mesh is not None:
+            moe.set_mesh(moe_mesh, "expert", capacity_factor=2.0)
+        rng = np.random.default_rng(9)
+        samples = [Sample(rng.normal(size=(6, 16)).astype(np.float32),
+                          rng.normal(size=(6, 16)).astype(np.float32))
+                   for _ in range(16)]
+        data = (DataSet.array(samples, shuffle=False)
+                .transform(SampleToMiniBatch(8)))
+        opt = (Optimizer(moe, data, nn.MSECriterion())
+               .set_optim_method(SGD(0.05))
+               .set_end_when(Trigger.max_iteration(4))
+               .set_mesh(mesh_cfg))
+        opt.optimize()
+        return float(opt.state["loss"]), [
+            np.asarray(l) for l in
+            jax.tree_util.tree_leaves(moe.parameters())]
+
+    l_ref, p_ref = train(MeshConfig(data=1))
+    cfg = MeshConfig(data=2, expert=4)
+    l_both, p_both = train(cfg, cfg.build())
+    np.testing.assert_allclose(l_both, l_ref, rtol=1e-4)
+    for a, b in zip(p_ref, p_both):
+        np.testing.assert_allclose(a, b, rtol=1e-3, atol=1e-5)
