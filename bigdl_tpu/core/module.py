@@ -442,15 +442,21 @@ class Module:
     # AbstractModule.setScaleW/setScaleB; applied by the Optimizer's step
     # as pure per-leaf transforms — see optim/regularizer.py)
 
-    def set_regularizers(self, w_regularizer=None,
-                         b_regularizer=None) -> "Module":
+    _KEEP_REGULARIZER = ("__keep__",)
+
+    def set_regularizers(self, w_regularizer=_KEEP_REGULARIZER,
+                         b_regularizer=_KEEP_REGULARIZER) -> "Module":
         """Attach L1/L2/L1L2 regularizers to THIS module's own params:
         ``w_regularizer`` covers params whose name does not contain
         "bias", ``b_regularizer`` the rest.  Writes the SAME static
         slots as the layer constructor args (e.g. nn.Linear(...,
-        w_regularizer=...)), so either spelling reaches the optimizer."""
-        self.w_regularizer = w_regularizer
-        self.b_regularizer = b_regularizer
+        w_regularizer=...)), so either spelling reaches the optimizer.
+        Only the arguments you pass are changed — setting one slot
+        never wipes the other; pass ``None`` explicitly to clear."""
+        if w_regularizer is not Module._KEEP_REGULARIZER:
+            self.w_regularizer = w_regularizer
+        if b_regularizer is not Module._KEEP_REGULARIZER:
+            self.b_regularizer = b_regularizer
         return self
 
     def set_scale_w(self, scale: float) -> "Module":

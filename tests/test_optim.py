@@ -651,3 +651,19 @@ def test_regularizer_specs_align_with_frozen_modules():
     assert by_path["layers[1].weight"] == (0.0, 0.7, 1.0)
     assert by_path["layers[1].bias"] == (0.0, 0.0, 1.0)
     assert by_path["layers[2].weight"] == (0.0, 0.0, 1.0)
+
+
+def test_set_regularizers_does_not_wipe_other_slot():
+    """Regression (doc example hazard): setting one regularizer slot
+    must not silently clear the other; explicit None clears."""
+    from bigdl_tpu.optim import L1Regularizer, L2Regularizer
+    from bigdl_tpu.optim.regularizer import leaf_reg_specs
+    m = nn.Linear(4, 3, w_regularizer=L2Regularizer(1e-4))
+    m.set_regularizers(b_regularizer=L1Regularizer(1e-5))
+    specs = dict(zip(["weight", "bias"], leaf_reg_specs(m)))
+    assert specs["weight"] == (0.0, 1e-4, 1.0), specs
+    assert specs["bias"] == (1e-5, 0.0, 1.0), specs
+    m.set_regularizers(w_regularizer=None)   # explicit clear
+    specs = dict(zip(["weight", "bias"], leaf_reg_specs(m)))
+    assert specs["weight"] == (0.0, 0.0, 1.0), specs
+    assert specs["bias"] == (1e-5, 0.0, 1.0), specs
