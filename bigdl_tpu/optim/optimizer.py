@@ -415,6 +415,7 @@ class Optimizer:
             except KeyboardInterrupt:
                 raise
             except Exception as e:
+                self._flush_summaries()  # keep the failed attempt's tail
                 now = time.time()
                 if last_failure is not None and \
                         now - last_failure > self.retry_interval_s:
@@ -429,6 +430,11 @@ class Optimizer:
                     "(%d retr%s left)", type(e).__name__, e, ckpt,
                     retries_left, "y" if retries_left == 1 else "ies")
                 self._resume_from = ckpt
+
+    def _flush_summaries(self) -> None:
+        for s in (self.train_summary, self.val_summary):
+            if s is not None and hasattr(s, "flush"):
+                s.flush()
 
     def _optimize_once(self) -> Module:
         from bigdl_tpu.core.module import param_paths
@@ -632,6 +638,12 @@ class Optimizer:
             flush_pending(params_groups, rest, opt_states)
             if prof_active:
                 jax.profiler.stop_trace()
+
+        # drain the async summary writers: without this, a run that
+        # ends before the writer thread's next flush loses its tail —
+        # or, for short runs, every scalar (the daemon thread dies with
+        # the process).  The retry/crash path flushes in optimize().
+        self._flush_summaries()
 
         # write trained params back into the user's module (in place)
         trained = combine(self._merge_groups_host(params_groups), rest)

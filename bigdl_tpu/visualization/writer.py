@@ -93,9 +93,18 @@ class FileWriter:
             finally:
                 self._queue.task_done()
 
-    def flush(self) -> "FileWriter":
-        self._queue.join()  # drainer task_done()s after the write completes
-        self._record.flush()
+    def flush(self, timeout: float = 10.0) -> "FileWriter":
+        # bounded drain: a writer thread killed by an I/O error (disk
+        # full, closed file) must not hang callers on queue.join()
+        deadline = time.time() + timeout
+        while (self._queue.unfinished_tasks
+               and self._thread.is_alive()
+               and time.time() < deadline):
+            time.sleep(0.01)
+        try:
+            self._record.flush()
+        except ValueError:  # file already closed
+            pass
         return self
 
     def close(self) -> None:

@@ -133,3 +133,37 @@ def test_optimizer_writes_summaries(tmp_path):
     assert len(val) == 2
     train_sum.close()
     val_sum.close()
+
+
+def test_optimizer_flushes_summaries_at_end(tmp_path):
+    """Regression: the async FileWriter drains when optimize() returns,
+    so scalars are READABLE immediately — without waiting for the
+    writer thread's next flush cadence (short runs used to lose every
+    scalar if the process exited first)."""
+    import numpy as np
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.dataset.dataset import DataSet, MiniBatch
+    from bigdl_tpu.optim import Optimizer, SGD, Trigger
+    from bigdl_tpu.utils import set_seed
+    import glob
+
+    set_seed(0)
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(32, 8)).astype(np.float32)
+    y = rng.integers(1, 5, size=(32,)).astype(np.int32)
+    m = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4),
+                      nn.LogSoftMax())
+    summary = TrainSummary(str(tmp_path), "app")
+    (Optimizer(m, DataSet.array(
+        [MiniBatch(x[i:i + 16], y[i:i + 16]) for i in (0, 16)]),
+        nn.ClassNLLCriterion())
+     .set_optim_method(SGD(0.1))
+     .set_end_when(Trigger.max_epoch(3))
+     .set_train_summary(summary)
+     .optimize())
+    summary.close()
+    f = glob.glob(str(tmp_path / "app" / "train" / "*tfevents*"))[0]
+    rd = FileReader(f)
+    tags = sorted({s.tag for ev in rd.events() for s in ev.scalars})
+    assert "Loss" in tags and "Throughput" in tags, tags
+    assert len(rd.scalars("Loss")) >= 2
