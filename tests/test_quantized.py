@@ -160,3 +160,19 @@ def test_int8_resnet50_imagenet_shape_fidelity():
     assert rel < 0.05, rel
     assert agree >= 0.75, agree   # docs cite this test's agreement
     assert np.isfinite(out_q).all()
+
+
+def test_quantized_dilated_conv_preserves_dilation():
+    """SpatialDilatedConvolution quantizes through the same int8 conv
+    with rhs_dilation carried (≙ nn/quantized covers the dilated conv
+    too, Quantizer.scala)."""
+    set_seed(7)
+    conv = nn.SpatialDilatedConvolution(3, 8, 3, 3, 1, 1, 2, 2, 2, 2)
+    q = quantize(conv)
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(2, 10, 10, 3)),
+                    jnp.float32)
+    want = np.asarray(conv.forward(x))
+    got = np.asarray(q.forward(x))
+    assert want.shape == got.shape
+    rel = np.abs(got - want) / (np.abs(want).max() + 1e-8)
+    assert rel.max() < 0.03, rel.max()
