@@ -6,7 +6,7 @@ pipelines, Keras-style API, distributed data/tensor/pipeline/sequence
 parallel training) on JAX/XLA/Pallas over TPU device meshes.
 """
 
-__version__ = "0.3.0"
+__version__ = "0.4.0"
 
 from bigdl_tpu.core import (
     Module, ModuleList, Parameter, partition, combine,
