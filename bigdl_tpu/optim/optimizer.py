@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import logging
 import os
+import threading
 import time
 from functools import partial
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
@@ -92,6 +93,7 @@ class Optimizer:
         self.sharding_rules = ShardingRules()
         self.compute_dtype = None  # e.g. jnp.bfloat16 for mixed precision
         self.log_interval: Optional[int] = None  # None = auto
+        self.iters_per_dispatch = 1
         self.profile_dir: Optional[str] = None
         self.profile_steps: Tuple[int, int] = (2, 5)
         self.train_summary = None
@@ -190,6 +192,26 @@ class Optimizer:
         self.log_interval = int(n)
         return self
 
+    def set_iterations_per_dispatch(self, k: int) -> "Optimizer":
+        """Run up to ``k`` consecutive train steps inside ONE compiled
+        dispatch (a ``lax.scan`` over a stacked window of minibatches).
+        The TPU-idiomatic fix for per-dispatch launch latency, exactly
+        analogous to the reference collapsing ~500 Spark tasks/iteration
+        into 1 multithreaded task per node after measuring >10% spent in
+        task scheduling (docs/docs/whitepaper.md:171-177, fig 8): on a
+        high-latency host<->device link each dispatch pays a fixed
+        launch cost; a k-step window pays it once.
+
+        Semantics are preserved: windows are trimmed so that validation,
+        checkpoint, and end triggers still fire on the exact iteration
+        they would have with ``k=1``, and per-iteration loss/throughput
+        logging is unchanged (losses come back as a stacked array).
+        Loss-reading triggers (minLoss) force ``k=1``.  Batches inside a
+        window must be uniform in shape; ragged tails fall back to
+        single-step dispatch so only two programs are ever compiled."""
+        self.iters_per_dispatch = max(1, int(k))
+        return self
+
     def set_profiler(self, logdir: str,
                      start_iteration: int = 2,
                      num_iterations: int = 5) -> "Optimizer":
@@ -241,7 +263,8 @@ class Optimizer:
 
     # ---- the jitted SPMD train step -------------------------------------
 
-    def _build_step(self, mesh, group_names, spec_groups=None):
+    def _build_step(self, mesh, group_names, spec_groups=None,
+                    window=False):
         criterion = self.criterion
         clip_const = self.grad_clip_const
         clip_norm = self.grad_clip_norm
@@ -318,7 +341,24 @@ class Optimizer:
                 new_rest = cast_floating(new_rest, jnp.float32)
             return new_groups, new_rest, new_states, loss
 
-        return jax.jit(step, donate_argnums=(0, 1, 2))
+        if not window:
+            return jax.jit(step, donate_argnums=(0, 1, 2))
+
+        def window_step(params_groups, rest, opt_states, xs, ys, rngs,
+                        epoch):
+            """k steps inside one dispatch: scan over the stacked window
+            (leading axis = iteration), losses returned stacked."""
+            def body(carry, inp):
+                pg, r, os_ = carry
+                x, y, rng = inp
+                npg, nr, nos, loss = step(pg, r, os_, x, y, rng, epoch)
+                return (npg, nr, nos), loss
+
+            (pg, r, os_), losses = jax.lax.scan(
+                body, (params_groups, rest, opt_states), (xs, ys, rngs))
+            return pg, r, os_, losses
+
+        return jax.jit(window_step, donate_argnums=(0, 1, 2))
 
     # ---- evaluation ------------------------------------------------------
 
@@ -415,6 +455,7 @@ class Optimizer:
             except KeyboardInterrupt:
                 raise
             except Exception as e:
+                self._stop_flush_worker()
                 self._flush_summaries()  # keep the failed attempt's tail
                 now = time.time()
                 if last_failure is not None and \
@@ -435,6 +476,32 @@ class Optimizer:
         for s in (self.train_summary, self.val_summary):
             if s is not None and hasattr(s, "flush"):
                 s.flush()
+
+    def _stop_flush_worker(self) -> None:
+        """Stop the async loss-drain worker (no-op if none is running);
+        called on the failure path so a crashed attempt's worker doesn't
+        outlive it and race the retry's fresh worker."""
+        q = getattr(self, "_flushq", None)
+        t = getattr(self, "_flush_thread", None)
+        self._flushq = None
+        self._flush_thread = None
+        if q is not None:
+            # drain stale jobs first: the queue is bounded, so a
+            # blocking put(None) could wedge behind a worker stuck in a
+            # device readback — exactly the hang this path must bound
+            import queue as _queue
+            while True:
+                try:
+                    q.get_nowait()
+                    q.task_done()
+                except _queue.Empty:
+                    break
+            try:
+                q.put_nowait(None)
+            except _queue.Full:
+                pass  # worker is wedged mid-readback; it's a daemon
+        if t is not None:
+            t.join(timeout=30.0)
 
     def _optimize_once(self) -> Module:
         from bigdl_tpu.core.module import param_paths
@@ -513,26 +580,60 @@ class Optimizer:
         # pending: (neval, epoch, n_records, records_cum, loss_device)
         pending: List[Tuple] = []
         window = {"start": time.time(), "data_t": 0.0}
+        drain_state = {"last_ready": 0.0}
+        # (n_iterations, completion_to_completion_s, data_stage_s) per
+        # flushed window — lets harnesses compute steady-state step time
+        # with the compile-bearing first window excluded (bench.py)
+        self.window_timings: List[Tuple[int, float, float]] = []
         prof_start, prof_num = self.profile_steps
         prof_active = False
         prof_done = False
 
-        def flush_pending(params_groups, rest, opt_states):
-            if not pending:
-                return
-            # ONE device->host transfer for the whole window: per-scalar
-            # float() readbacks pay a full round trip each, which on a
-            # high-latency host<->device link dwarfs the payload
-            losses = np.asarray(jnp.stack([l for *_, l in pending])
-                                ).astype(float).tolist()
-            window_dt = time.time() - window["start"]
-            per_iter = window_dt / len(pending)
+        def consume_window(entries, wstart, data_t, params_groups,
+                           opt_states, rest):
+            """Readback + log one flushed window.  Minimal device->host
+            transfers: per-scalar float() readbacks pay a full round
+            trip each, which on a high-latency host<->device link
+            dwarfs the payload.  Single-step iterations contribute
+            scalar losses (batched into ONE stacked readback); windowed
+            dispatches contribute (stacked_losses, idx) pairs — one
+            readback per window array, never per iteration."""
+            scalars = [l for *_, l in entries
+                       if not isinstance(l, tuple)]
+            stacked_host = (np.asarray(jnp.stack(scalars)).astype(float)
+                            if scalars else None)
+            win_cache: Dict[int, np.ndarray] = {}
+            losses = []
+            si = 0
+            for *_, l in entries:
+                if isinstance(l, tuple):
+                    arr, idx = l
+                    host = win_cache.get(id(arr))
+                    if host is None:
+                        host = np.asarray(arr).astype(float)
+                        win_cache[id(arr)] = host
+                    losses.append(float(host[idx]))
+                else:
+                    losses.append(float(stacked_host[si]))
+                    si += 1
+            # The readbacks above block until the window's work really
+            # finished, so this timestamp is completion, not dispatch.
+            # Under the async drain several windows can be in flight at
+            # once with dispatch-time starts; completion-to-completion
+            # (prev window's ready time) is the honest denominator, or
+            # the r02 async-dispatch lie returns through the back door.
+            t_ready = time.time()
+            window_dt = t_ready - max(wstart, drain_state["last_ready"])
+            drain_state["last_ready"] = t_ready
+            per_iter = window_dt / len(entries)
             self.metrics.add("device step time",
-                             max(window_dt - window["data_t"], 0.0)
-                             / len(pending), count=len(pending))
-            n_pend = len(pending)
+                             max(window_dt - data_t, 0.0)
+                             / len(entries), count=len(entries))
+            self.window_timings.append(
+                (len(entries), window_dt, data_t))
+            n_pend = len(entries)
             for idx, ((neval_i, epoch_i, n_i, cum_i, _), lf) in enumerate(
-                    zip(pending, losses)):
+                    zip(entries, losses)):
                 logger.info(
                     "Epoch %d %d/%d][Iteration %d][Wall Clock %.3fs] "
                     "Trained %d records in %.4f seconds. Throughput is "
@@ -560,17 +661,116 @@ class Optimizer:
                     "Parameters")
                     if hasattr(self.train_summary,
                                "get_summary_trigger") else None)
-                last_neval = pending[-1][0]
+                last_neval = entries[-1][0]
                 if trig is not None and any(
                         trig({**self.state, "neval": ne, "epoch": ep})
-                        for (ne, ep, *_r) in pending):
+                        for (ne, ep, *_r) in entries):
                     self.train_summary.save_parameters(
                         combine(self._merge_groups_host(params_groups),
                                 rest), last_neval)
             self.state["loss"] = losses[-1]
-            pending.clear()
-            window["start"] = time.time()
-            window["data_t"] = 0.0
+
+        # Async loss drain: with no summary writer attached and no
+        # loss-reading trigger, nothing in the loop needs the loss value
+        # synchronously — a worker thread does the (blocking) readback
+        # and logging while the main thread keeps the device queue full.
+        # (With a summary writer, consume_window touches params/opt
+        # state host-side; those buffers are donated to the next
+        # dispatch, so that path stays synchronous.)
+        flush_async = self.train_summary is None and not needs_loss
+        flushq: Optional["_queue.Queue"] = None
+        flush_thread = None
+        if flush_async:
+            import queue as _queue
+
+            flushq = _queue.Queue(maxsize=4)
+
+            def _drain():
+                while True:
+                    job = flushq.get()
+                    if job is None:
+                        return
+                    try:
+                        consume_window(*job)
+                    except Exception:
+                        logger.exception("async loss readback failed")
+                    finally:
+                        flushq.task_done()
+
+            flush_thread = threading.Thread(
+                target=_drain, daemon=True, name="bigdl-loss-drain")
+            flush_thread.start()
+            # expose for the failure path (_stop_flush_worker)
+            self._flushq = flushq
+            self._flush_thread = flush_thread
+
+        def flush_pending(params_groups, rest, opt_states, sync=False):
+            if pending:
+                job = (list(pending), window["start"], window["data_t"],
+                       params_groups, opt_states, rest)
+                if flushq is not None:
+                    flushq.put(job)
+                else:
+                    consume_window(*job)
+                pending.clear()
+                window["start"] = time.time()
+                window["data_t"] = 0.0
+            if sync and flushq is not None:
+                flushq.join()
+
+        k_req = max(1, int(self.iters_per_dispatch))
+        wstep = None
+        w_sharding = None
+        stage_cache: Dict[Tuple[int, ...], Any] = {}
+        stage_cache_bytes = [0]
+        cacheable_windows = False
+        if k_req > 1:
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+            from bigdl_tpu.dataset.dataset import DeviceCachedDataSet
+            wstep = self._build_step(mesh, group_names, spec_groups,
+                                     window=True)
+            w_sharding = NamedSharding(mesh, P(None, *x_sharding.spec))
+            # An UNSHUFFLED device-cached dataset serves the same
+            # MiniBatch objects in the same order every epoch, so the
+            # stacked window can be staged once and reused (stacking k
+            # batches is a large HBM copy; on cached data it would
+            # recur every epoch for identical bytes).  Shuffled epochs
+            # produce fresh window keys every time — caching those
+            # would fill HBM with never-reused stacked copies.
+            cacheable_windows = (
+                isinstance(self.dataset, DeviceCachedDataSet)
+                and not getattr(self.dataset._inner, "_shuffle", True))
+
+        def safe_window(sizes: List[int]) -> int:
+            """Largest window <= len(sizes) such that no trigger fires
+            before its LAST iteration — replays the loop's bookkeeping
+            over predicted states.  Loss-reading triggers force 1 (loss
+            changes mid-window); score-based triggers are exact because
+            score only changes at validation, which ends a window."""
+            w = len(sizes)
+            if self.profile_dir and not prof_done:
+                nv = self.state["neval"]
+                if nv < prof_start:
+                    w = min(w, prof_start - nv)
+                else:
+                    w = min(w, max(prof_start + prof_num - nv, 1))
+            trigs = [t for t in (self.end_when, self.val_trigger,
+                                 self.checkpoint_trigger) if t is not None]
+            if any(getattr(t, "needs_loss", False) for t in trigs):
+                return 1
+            st = dict(self.state)
+            st["is_epoch_end"] = False
+            for i in range(w):
+                st["records"] += sizes[i]
+                st["neval"] += 1
+                if ((self.val_trigger is not None
+                     and self.val_trigger(st))
+                        or (self.checkpoint_trigger is not None
+                            and self.checkpoint_trigger(st))
+                        or self.end_when(st)):
+                    return i + 1
+            return w
 
         saw_batches = False
         with mesh:
@@ -578,54 +778,147 @@ class Optimizer:
                 epoch = self.state["epoch"]
                 epoch_start = time.time()
                 self.state["records"] = 0
-                for batch in self.dataset.data(train=True):
+                batch_iter = iter(self.dataset.data(train=True))
+                lookahead: List = []
+                stop = False
+                while not stop:
+                    while len(lookahead) < k_req:
+                        try:
+                            lookahead.append(next(batch_iter))
+                        except StopIteration:
+                            break
+                    if not lookahead:
+                        break
+                    want = (safe_window([b.size() for b in lookahead])
+                            if k_req > 1 else 1)
+                    group = [lookahead.pop(0)]
+                    if want > 1:
+                        sig0 = _batch_sig(group[0])
+                        while (lookahead and len(group) < want
+                               and _batch_sig(lookahead[0]) == sig0):
+                            group.append(lookahead.pop(0))
+                    if len(group) != k_req:
+                        # ragged tail / trimmed window: single-step path
+                        # (a window of any OTHER length would compile a
+                        # third program; exactly two programs keeps
+                        # compile cost flat — pick k dividing trigger
+                        # periods to stay on the fast path)
+                        lookahead[0:0] = group[1:]
+                        group = group[:1]
                     saw_batches = True
-                    if batch.size() % n_data:
-                        raise ValueError(
-                            f"global batch size {batch.size()} is not "
-                            f"divisible by the mesh's data-parallel extent "
-                            f"{n_data}; choose a batch size that is a "
-                            f"multiple of it")
+                    for b in group:
+                        if b.size() % n_data:
+                            raise ValueError(
+                                f"global batch size {b.size()} is not "
+                                f"divisible by the mesh's data-parallel "
+                                f"extent {n_data}; choose a batch size "
+                                f"that is a multiple of it")
                     if (self.profile_dir and not prof_active
                             and not prof_done
                             and self.state["neval"] >= prof_start):
                         jax.profiler.start_trace(self.profile_dir)
                         prof_active = True
                     it_start = time.time()
-                    x = _stage(batch.get_input(), x_sharding)
-                    y = _stage(batch.get_target(), x_sharding)
-                    rng = jax.random.fold_in(seed_key, self.state["neval"])
-                    t_data = time.time() - it_start
-                    params_groups, rest, opt_states, loss = step(
-                        params_groups, rest, opt_states, x, y, rng, epoch)
+                    if len(group) > 1:
+                        ckey = (tuple(id(b) for b in group)
+                                if cacheable_windows else None)
+                        hit = (stage_cache.get(ckey)
+                               if ckey is not None else None)
+                        staged = hit[0] if hit is not None else None
+                        if staged is None:
+                            staged = (
+                                _stage_window([b.get_input()
+                                               for b in group],
+                                              w_sharding),
+                                _stage_window([b.get_target()
+                                               for b in group],
+                                              w_sharding))
+                            if ckey is not None:
+                                nbytes = sum(
+                                    getattr(a, "nbytes", 0)
+                                    for part in staged
+                                    for a in jax.tree_util.tree_leaves(
+                                        part))
+                                budget = int(os.environ.get(
+                                    "BIGDL_TPU_WINDOW_CACHE_BYTES",
+                                    str(2 << 30)))
+                                # bound by BYTES, FIFO-evicting: entry
+                                # counts say nothing about HBM held by
+                                # stacked k-batch windows
+                                while (stage_cache and
+                                       stage_cache_bytes[0] + nbytes
+                                       > budget):
+                                    _, old_b = stage_cache.pop(
+                                        next(iter(stage_cache)))
+                                    stage_cache_bytes[0] -= old_b
+                                if nbytes <= budget:
+                                    stage_cache[ckey] = (staged, nbytes)
+                                    stage_cache_bytes[0] += nbytes
+                        xs, ys = staged
+                        base = self.state["neval"]
+                        rngs = jax.vmap(
+                            lambda i: jax.random.fold_in(seed_key, i))(
+                            jnp.arange(base, base + len(group)))
+                        t_data = time.time() - it_start
+                        params_groups, rest, opt_states, losses = wstep(
+                            params_groups, rest, opt_states, xs, ys, rngs,
+                            epoch)
+                        # (stacked, idx) markers: flush reads the whole
+                        # window back in ONE transfer, no per-step slices
+                        loss_list = [(losses, i)
+                                     for i in range(len(group))]
+                    else:
+                        batch = group[0]
+                        x = _stage(batch.get_input(), x_sharding)
+                        y = _stage(batch.get_target(), x_sharding)
+                        rng = jax.random.fold_in(seed_key,
+                                                 self.state["neval"])
+                        t_data = time.time() - it_start
+                        params_groups, rest, opt_states, loss = step(
+                            params_groups, rest, opt_states, x, y, rng,
+                            epoch)
+                        loss_list = [loss]
                     self.metrics.add("data load and transfer", t_data)
                     window["data_t"] += t_data
-                    n = batch.size()
-                    self.state["records"] += n
-                    pending.append((self.state["neval"], epoch, n,
-                                    self.state["records"], loss))
-                    if prof_active and (self.state["neval"]
-                                        >= prof_start + prof_num - 1):
-                        jax.block_until_ready(loss)
-                        jax.profiler.stop_trace()
-                        prof_active = False
-                        prof_done = True
-                    if len(pending) >= interval:
-                        flush_pending(params_groups, rest, opt_states)
-                    self.state["neval"] += 1
-                    self.state["is_epoch_end"] = False
-                    if self._want_validate_checkpoint():
-                        flush_pending(params_groups, rest, opt_states)
-                        self._maybe_validate_checkpoint(
-                            params_groups, rest, opt_states, eval_step)
-                        # don't bill validation/checkpoint wall time to
-                        # the next window's "device step time"
-                        window["start"] = time.time()
-                    if self.end_when(self.state):
-                        break
+                    for b, loss_i in zip(group, loss_list):
+                        n = b.size()
+                        self.state["records"] += n
+                        pending.append((self.state["neval"], epoch, n,
+                                        self.state["records"], loss_i))
+                        if prof_active and (self.state["neval"]
+                                            >= prof_start + prof_num - 1):
+                            jax.block_until_ready(
+                                loss_i[0] if isinstance(loss_i, tuple)
+                                else loss_i)
+                            jax.profiler.stop_trace()
+                            prof_active = False
+                            prof_done = True
+                        if len(pending) >= interval:
+                            flush_pending(params_groups, rest, opt_states)
+                        self.state["neval"] += 1
+                        self.state["is_epoch_end"] = False
+                        if self._want_validate_checkpoint():
+                            # sync: the checkpoint records state["loss"],
+                            # and validation logs should follow the
+                            # iterations they validate
+                            flush_pending(params_groups, rest, opt_states,
+                                          sync=True)
+                            self._maybe_validate_checkpoint(
+                                params_groups, rest, opt_states, eval_step)
+                            # don't bill validation/checkpoint wall time
+                            # to the next window's "device step time"
+                            window["start"] = time.time()
+                        # no break: the whole window's updates are
+                        # already applied to the params, so the
+                        # remaining entries' bookkeeping (neval,
+                        # records, loss logging) must complete even if
+                        # a custom end trigger fires mid-window —
+                        # otherwise checkpoints disagree with weights
+                        stop = stop or bool(self.end_when(self.state))
                 self.state["epoch"] += 1
                 self.state["is_epoch_end"] = True
-                flush_pending(params_groups, rest, opt_states)
+                flush_pending(params_groups, rest, opt_states,
+                              sync=self._want_validate_checkpoint())
                 logger.info("Epoch %d finished in %.2f s", epoch,
                             time.time() - epoch_start)
                 if not saw_batches:
@@ -635,9 +928,14 @@ class Optimizer:
                 self._maybe_validate_checkpoint(
                     params_groups, rest, opt_states, eval_step)
                 window["start"] = time.time()
-            flush_pending(params_groups, rest, opt_states)
+            flush_pending(params_groups, rest, opt_states, sync=True)
             if prof_active:
                 jax.profiler.stop_trace()
+        if flushq is not None:
+            flushq.put(None)  # worker exits after draining earlier jobs
+            flush_thread.join(timeout=60.0)
+            self._flushq = None
+            self._flush_thread = None
 
         # drain the async summary writers: without this, a run that
         # ends before the writer thread's next flush loses its tail —
@@ -738,6 +1036,30 @@ class Optimizer:
 
 def _to_plain(tree):
     return jax.tree_util.tree_map(lambda x: np.asarray(x), tree)
+
+
+def _batch_sig(b):
+    """Stackability signature of a minibatch: pytree structure + leaf
+    shapes/dtypes of (input, target).  Batches in one dispatch window
+    must match so they can be stacked on a new leading axis."""
+    leaves, treedef = jax.tree_util.tree_flatten(
+        (b.get_input(), b.get_target()))
+    return (treedef,
+            tuple((tuple(np.shape(l)),
+                   str(getattr(l, "dtype", None) or np.asarray(l).dtype))
+                  for l in leaves))
+
+
+def _stage_window(vals, sharding=None):
+    """Stack per-iteration batch pytrees on a new leading axis (window
+    dim) and stage to the device; the window dim is unsharded, the batch
+    dim keeps the data-parallel sharding."""
+    stacked = jax.tree_util.tree_map(
+        lambda *ls: jnp.stack([jnp.asarray(l) for l in ls]), *vals)
+    if sharding is not None:
+        stacked = jax.tree_util.tree_map(
+            lambda a: jax.device_put(a, sharding), stacked)
+    return stacked
 
 
 def _stage(value, sharding=None):

@@ -1,0 +1,161 @@
+"""iterations-per-dispatch windowing: k train steps inside one compiled
+dispatch (lax.scan) must be semantically identical to k single-step
+dispatches — same trained weights, same per-iteration logging, and
+triggers firing on the exact same iterations (the TPU analog of the
+reference collapsing Spark task-scheduling overhead into one task per
+node, docs/docs/whitepaper.md:171-177)."""
+
+import glob
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.optim import Optimizer, SGD, Trigger, Top1Accuracy
+from bigdl_tpu.dataset import DataSet, SampleToMiniBatch
+from bigdl_tpu.dataset.image import synthetic_mnist, GreyImgNormalizer
+from bigdl_tpu.parallel import MeshConfig
+from bigdl_tpu.utils import set_seed
+
+
+def _pipeline(n=256, batch=32, seed=0):
+    return DataSet.array(synthetic_mnist(n, seed=seed), shuffle=False) \
+        .transform(GreyImgNormalizer(128.0, 128.0)) \
+        .transform(SampleToMiniBatch(batch))
+
+
+def _mlp():
+    return nn.Sequential(
+        nn.Flatten(), nn.Linear(784, 32), nn.Tanh(),
+        nn.Linear(32, 10), nn.LogSoftMax())
+
+
+def _train(k, epochs=2, **kw):
+    set_seed(23)
+    model = _mlp()
+    opt = (Optimizer(model, _pipeline(), nn.ClassNLLCriterion())
+           .set_optim_method(SGD(0.1, momentum=0.9, dampening=0.0))
+           .set_end_when(Trigger.max_epoch(epochs))
+           .set_iterations_per_dispatch(k))
+    for name, val in kw.items():
+        getattr(opt, name)(*val)
+    opt.optimize()
+    return model, opt
+
+
+def test_window_matches_single_step():
+    """k=4 windows train to the SAME weights as k=1 (bit-level math is
+    identical: scan runs the same step function over the same batches)."""
+    m1, _ = _train(1)
+    m4, _ = _train(4)
+    p1 = m1.parameters()
+    p4 = m4.parameters()
+    import jax
+    for a, b in zip(jax.tree_util.tree_leaves(p1),
+                    jax.tree_util.tree_leaves(p4)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=2e-6)
+
+
+def test_window_ragged_tail_and_counts():
+    """8 batches/epoch with k=3: windows of 3+3 then 2 single-step
+    dispatches; iteration count and records must match k=1 exactly."""
+    _, opt3 = _train(3)
+    _, opt1 = _train(1)
+    assert opt3.state["neval"] == opt1.state["neval"]
+    assert opt3.state["records"] == opt1.state["records"]
+
+
+def test_window_checkpoint_trigger_alignment():
+    """A several_iteration(3) checkpoint trigger with k=4 must fire on
+    iterations 3, 6, 9, ... exactly as with k=1 (windows are trimmed so
+    a trigger lands on a window boundary)."""
+    nevals = {}
+    for k in (1, 4):
+        with tempfile.TemporaryDirectory() as d:
+            set_seed(23)
+            model = _mlp()
+            opt = (Optimizer(model, _pipeline(), nn.ClassNLLCriterion())
+                   .set_optim_method(SGD(0.1))
+                   .set_end_when(Trigger.max_epoch(1))
+                   .set_checkpoint(d, Trigger.several_iteration(3),
+                                   is_overwrite=False)
+                   .set_iterations_per_dispatch(k))
+            opt.optimize()
+            files = sorted(glob.glob(os.path.join(d, "checkpoint*.npz")))
+            nevals[k] = [os.path.basename(f).split(".")[1] for f in files]
+    assert nevals[1] == nevals[4]
+    assert nevals[1]  # fired at least once
+
+
+def test_window_validation_score_and_mesh():
+    """Windowed dispatch composes with an 8-device data mesh and
+    every-epoch validation; the model still learns."""
+    set_seed(23)
+    model = _mlp()
+    opt = (Optimizer(model, _pipeline(512, 64), nn.ClassNLLCriterion())
+           .set_optim_method(SGD(0.1))
+           .set_end_when(Trigger.max_epoch(3))
+           .set_mesh(MeshConfig(data=8))
+           .set_validation(Trigger.every_epoch(),
+                           _pipeline(256, 64, seed=7), [Top1Accuracy()])
+           .set_iterations_per_dispatch(4))
+    opt.optimize()
+    assert opt.state["score"] > 0.8
+
+
+def test_window_device_cached_reuse_and_shuffled_safety():
+    """cache_on_device + windows: unshuffled datasets reuse the staged
+    window across epochs; shuffled ones must not cache (fresh orders
+    would pile stacked copies into device memory) yet still train to
+    the same place as the unwindowed run."""
+    for shuffle in (False, True):
+        set_seed(23)
+        model = _mlp()
+        data = DataSet.array(synthetic_mnist(256, seed=0),
+                             shuffle=shuffle) \
+            .transform(GreyImgNormalizer(128.0, 128.0)) \
+            .transform(SampleToMiniBatch(32)).cache_on_device()
+        opt = (Optimizer(model, data, nn.ClassNLLCriterion())
+               .set_optim_method(SGD(0.1, momentum=0.9, dampening=0.0))
+               .set_end_when(Trigger.max_epoch(2))
+               .set_iterations_per_dispatch(4))
+        opt.optimize()
+        assert opt.state["neval"] == 17  # 8 batches x 2 epochs + 1
+    # unshuffled cached windows match the plain k=1 run exactly
+    set_seed(23)
+    m_cached = _mlp()
+    data = DataSet.array(synthetic_mnist(256, seed=0), shuffle=False) \
+        .transform(GreyImgNormalizer(128.0, 128.0)) \
+        .transform(SampleToMiniBatch(32)).cache_on_device()
+    (Optimizer(m_cached, data, nn.ClassNLLCriterion())
+     .set_optim_method(SGD(0.1, momentum=0.9, dampening=0.0))
+     .set_end_when(Trigger.max_epoch(2))
+     .set_iterations_per_dispatch(4)).optimize()
+    m_plain, _ = _train(1)
+    import jax
+    for a, b in zip(jax.tree_util.tree_leaves(m_cached.parameters()),
+                    jax.tree_util.tree_leaves(m_plain.parameters())):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=2e-6)
+
+
+def test_window_min_loss_trigger_forces_single_step():
+    """A loss-reading end trigger (minLoss) cannot be windowed: loss
+    changes mid-window.  The loop must fall back to k=1 dispatches and
+    stop on the exact iteration the loss crosses the threshold."""
+    set_seed(23)
+    model = _mlp()
+    opt = (Optimizer(model, _pipeline(), nn.ClassNLLCriterion())
+           .set_optim_method(SGD(0.1))
+           .set_end_when(Trigger.or_(Trigger.max_epoch(50),
+                                     Trigger.min_loss(1.5)))
+           .set_iterations_per_dispatch(4))
+    opt.optimize()
+    assert opt.state["loss"] < 1.5
+    # stopped promptly after crossing, not at a window boundary past it
+    assert opt.state["epoch"] <= 50
