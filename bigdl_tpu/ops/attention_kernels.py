@@ -89,6 +89,55 @@ def xla_attention(q, k, v, bias=None, *, causal: bool = False,
 # Pallas flash kernels — K/V streamed through the grid
 # ---------------------------------------------------------------------------
 
+def _auto_blocks(tq: int, tk: int, d: int, bias: bool = False):
+    """Pick (block_q, block_k) for the flash kernels: the largest pair
+    dividing the sequence lengths whose f32 score-shaped tiles fit the
+    TPU scoped-VMEM budget.
+
+    Block size is THE perf knob here.  At [128, 128] the grid for
+    T=4096, B*H=64 is 65k programs of ~4 MFLOP each, so fixed
+    per-program cost (DMA waits, grid bookkeeping) dominates the MXU
+    work: measured 47x slower than [1024, 1024] on v5e.  Bigger tiles
+    amortize that cost; the cap is the ~16 MiB scoped VMEM that must
+    hold the f32 score-shaped intermediates (3 in the backward — p, dp,
+    ds; with a bias, two more: the upcast bias tile and the dbias
+    kernel's ds output) plus the streamed q/k/v/do tiles."""
+    def divisors(t, choices):
+        return [b for b in choices if t % b == 0]
+
+    per_tile = 20 if bias else 12  # f32 score-shaped tiles, bytes/elem
+    best = None
+    for bq in divisors(tq, (1024, 768, 512, 384, 256, 128)) or [tq]:
+        for bk in divisors(tk, (1024, 768, 512, 384, 256, 128)) or [tk]:
+            vmem = per_tile * bq * bk + 6 * (bq + bk) * d
+            if vmem > 14 * 2 ** 20:
+                continue
+            key = (bq * bk, bk)
+            if best is None or key > best[0]:
+                best = (key, bq, bk)
+    if best is not None:
+        return best[1], best[2]
+    # nothing fits (odd lengths whose only listed divisor — the length
+    # itself — blows the budget): fall back to the largest small
+    # divisor, mirroring the ring's historic _pick_block tiling so a
+    # forced kernel='flash' still runs instead of tripping the
+    # divisibility assert
+    fb = lambda t: next(b for b in (128, 64, 32, 16, 8, 4, 2, 1)
+                        if t % b == 0)
+    return fb(tq), fb(tk)
+
+
+def _resolve_blocks(block_q, block_k, tq, tk, d, bias=False):
+    """Fill None block sizes from :func:`_auto_blocks`; explicit sizes
+    win.  Shared by every flash entry point so forward and backward
+    kernels agree on the tiling."""
+    if block_q is None or block_k is None:
+        abq, abk = _auto_blocks(tq, tk, d, bias=bias)
+        block_q = block_q or abq
+        block_k = block_k or abk
+    return int(block_q), int(block_k)
+
+
 class _FlashCfg(NamedTuple):
     """Static kernel configuration (hashable: used as a custom_vjp
     nondiff argument)."""
@@ -151,12 +200,16 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
 
     @pl.when(needed)
     def _body():
-        q = q_ref[...].astype(jnp.float32) * cfg.scale
-        k_blk = k_ref[...].astype(jnp.float32)
-        v_blk = v_ref[...].astype(jnp.float32)
+        # dots run in the INPUT dtype (bf16 inputs drive the MXU at
+        # native rate — upcasting to f32 first runs the MXU at a
+        # fraction of peak) with f32 accumulation; the scale applies to
+        # the f32 product, matching xla_attention's ordering
+        q = q_ref[...]
+        k_blk = k_ref[...]
+        v_blk = v_ref[...]
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)  # [block_q, block_k]
+            preferred_element_type=jnp.float32) * cfg.scale
         if bias_ref is not None:
             s = s + bias_ref[...].astype(jnp.float32)
         if cfg.causal:
@@ -171,7 +224,7 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
         l_ref[...] = (l_prev * alpha + jnp.sum(p, axis=-1))[:, None]
         m_ref[...] = m_new[:, None]
         acc_ref[...] = acc_ref[...] * alpha[:, None] + jax.lax.dot_general(
-            p, v_blk, (((1,), (0,)), ((), ())),
+            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(k_idx == nk - 1)
@@ -230,12 +283,14 @@ def _fwd_impl(q, k, v, bias, cfg: _FlashCfg):
     return out.reshape(b, h, tq, d), lse
 
 
-def _recompute_p(q_scaled, k_blk, bias_blk, lse, q_pos0, k_pos0, cfg,
+def _recompute_p(q, k_blk, bias_blk, lse, q_pos0, k_pos0, cfg,
                  shape):
     """Shared tile recompute for the backward kernels: the normalized
-    softmax tile P = exp(s - lse) (masked entries → exp(-1e9-lse) = 0)."""
-    s = jax.lax.dot_general(q_scaled, k_blk, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32)
+    softmax tile P = exp(s - lse) (masked entries → exp(-1e9-lse) = 0).
+    q/k are the raw input-dtype tiles — the dot runs at MXU-native rate
+    and the scale applies to the f32 product (same order as forward)."""
+    s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * cfg.scale
     if bias_blk is not None:
         s = s + bias_blk
     if cfg.causal:
@@ -249,42 +304,43 @@ def _dq_accum(acc_ref, q_ref, k_ref, v_ref, bias_blk, do_ref,
     from the q/k tiles + lse).  Used by the full backward (positions
     from program_id) and the ring partial backward (positions scalar-
     prefetched)."""
-    q = q_ref[...].astype(jnp.float32) * cfg.scale
-    do = do_ref[...].astype(jnp.float32)
+    q = q_ref[...]
+    do = do_ref[...]
     lse = lse_ref[...].astype(jnp.float32)
     delta = delta_ref[...].astype(jnp.float32)
-    k_blk = k_ref[...].astype(jnp.float32)
-    v_blk = v_ref[...].astype(jnp.float32)
+    k_blk = k_ref[...]
+    v_blk = v_ref[...]
     p = _recompute_p(q, k_blk, bias_blk, lse, q_pos0, k_pos0, cfg,
                      (cfg.block_q, cfg.block_k))
     dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
     ds = p * (dp - delta)
     acc_ref[...] = acc_ref[...] + jax.lax.dot_general(
-        ds, k_blk, (((1,), (0,)), ((), ())),
+        ds.astype(k_blk.dtype), k_blk, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)
 
 
 def _dkv_accum(dk_acc, dv_acc, k_ref, v_ref, q_ref, bias_blk, do_ref,
                lse_ref, delta_ref, q_pos0, k_pos0, cfg: _FlashCfg):
-    """Shared dK/dV tile step: dV += P^T dO; dK += scale·dS^T Q."""
-    k = k_ref[...].astype(jnp.float32)
-    v = v_ref[...].astype(jnp.float32)
-    q_blk = q_ref[...].astype(jnp.float32) * cfg.scale
-    do_blk = do_ref[...].astype(jnp.float32)
+    """Shared dK/dV tile step: dV += P^T dO; dK += dS^T Q (the caller's
+    finish step multiplies dK by `scale` once, so every dot here runs on
+    raw input-dtype tiles at MXU-native rate)."""
+    k = k_ref[...]
+    v = v_ref[...]
+    q_blk = q_ref[...]
+    do_blk = do_ref[...]
     lse_blk = lse_ref[...].astype(jnp.float32)
     delta_blk = delta_ref[...].astype(jnp.float32)
     p = _recompute_p(q_blk, k, bias_blk, lse_blk, q_pos0, k_pos0, cfg,
                      (cfg.block_q, cfg.block_k))
     dv_acc[...] = dv_acc[...] + jax.lax.dot_general(
-        p, do_blk, (((0,), (0,)), ((), ())),
+        p.astype(do_blk.dtype), do_blk, (((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)
     dp = jax.lax.dot_general(do_blk, v, (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
     ds = p * (dp - delta_blk)
-    # q_blk already carries `scale`, so this accumulates scale·ds^T·q
     dk_acc[...] = dk_acc[...] + jax.lax.dot_general(
-        ds, q_blk, (((0,), (0,)), ((), ())),
+        ds.astype(q_blk.dtype), q_blk, (((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)
 
 
@@ -349,7 +405,7 @@ def _flash_dkv_kernel(k_ref, v_ref, q_ref, bias_ref, do_ref, lse_ref,
 
     @pl.when(q_idx == nq - 1)
     def _finish():
-        dk_ref[...] = dk_acc[...].astype(dk_ref.dtype)
+        dk_ref[...] = (dk_acc[...] * cfg.scale).astype(dk_ref.dtype)
         dv_ref[...] = dv_acc[...].astype(dv_ref.dtype)
 
 
@@ -363,12 +419,12 @@ def _flash_dbias_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
     q_idx = pl.program_id(1)
     k_idx = pl.program_id(2)
 
-    q = q_ref[...].astype(jnp.float32) * cfg.scale
-    do = do_ref[...].astype(jnp.float32)
+    q = q_ref[...]
+    do = do_ref[...]
     lse = lse_ref[...].astype(jnp.float32)
     delta = delta_ref[...].astype(jnp.float32)
-    k_blk = k_ref[...].astype(jnp.float32)
-    v_blk = v_ref[...].astype(jnp.float32)
+    k_blk = k_ref[...]
+    v_blk = v_ref[...]
     p = _recompute_p(q, k_blk, bias_ref[...].astype(jnp.float32), lse,
                      q_idx * block_q, k_idx * block_k, cfg,
                      (block_q, block_k))
@@ -547,12 +603,12 @@ def _flash_partial_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref,
 
     @pl.when(needed)
     def _body():
-        q = q_ref[...].astype(jnp.float32) * cfg.scale
-        k_blk = k_ref[...].astype(jnp.float32)
-        v_blk = v_ref[...].astype(jnp.float32)
+        q = q_ref[...]
+        k_blk = k_ref[...]
+        v_blk = v_ref[...]
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
+            preferred_element_type=jnp.float32) * cfg.scale
         if cfg.causal:
             s = _causal_mask(s, q_pos0, k_pos0, (block_q, block_k))
         m_prev = m_out[...][:, 0]
@@ -564,14 +620,15 @@ def _flash_partial_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref,
         l_out[...] = (l_prev * alpha + jnp.sum(p, axis=-1))[:, None]
         m_out[...] = m_new[:, None]
         acc_out[...] = acc_out[...] * alpha[:, None] + jax.lax.dot_general(
-            p, v_blk, (((1,), (0,)), ((), ())),
+            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
 
 def flash_attention_partial(q, k, v, acc, m, l, *, q_offset, k_offset,
                             causal: bool = False,
                             scale: Optional[float] = None,
-                            block_q: int = 128, block_k: int = 128,
+                            block_q: Optional[int] = None,
+                            block_k: Optional[int] = None,
                             interpret: bool = False):
     """Merge blockwise attention of q [B,H,Tq,D] against ONE K/V chunk
     [B,H,Tk,D] into the running online-softmax state
@@ -585,6 +642,7 @@ def flash_attention_partial(q, k, v, acc, m, l, *, q_offset, k_offset,
     tk = k.shape[2]
     if scale is None:
         scale = 1.0 / (d ** 0.5)
+    block_q, block_k = _resolve_blocks(block_q, block_k, tq, tk, d)
     assert tq % block_q == 0 and tk % block_k == 0, (tq, tk)
     if pltpu is None:  # pragma: no cover
         raise RuntimeError(
@@ -688,7 +746,7 @@ def _flash_dkv_partial_kernel(qoff_ref, koff_ref, k_ref, v_ref, q_ref,
 
     @pl.when(i == nq - 1)
     def _finish():
-        dk_ref[...] = dk_acc[...].astype(dk_ref.dtype)
+        dk_ref[...] = (dk_acc[...] * cfg.scale).astype(dk_ref.dtype)
         dv_ref[...] = dv_acc[...].astype(dv_ref.dtype)
 
 
@@ -704,6 +762,7 @@ def flash_attention_dq_partial(q, k, v, do, lse, delta, *, q_offset,
     whole-sequence logsumexp / Δ rows)."""
     b, h, tq, d = q.shape
     tk = k.shape[2]
+    block_q, block_k = _resolve_blocks(block_q, block_k, tq, tk, d)
     assert tq % block_q == 0 and tk % block_k == 0, (tq, tk)
     if pltpu is None:  # pragma: no cover
         raise RuntimeError(
@@ -748,6 +807,7 @@ def flash_attention_dkv_partial(q, k, v, do, lse, delta, *, q_offset,
     """(dK, dV) of one visiting chunk against this device's Q/dO."""
     b, h, tq, d = q.shape
     tk = k.shape[2]
+    block_q, block_k = _resolve_blocks(block_q, block_k, tq, tk, d)
     assert tq % block_q == 0 and tk % block_k == 0, (tq, tk)
     if pltpu is None:  # pragma: no cover
         raise RuntimeError(
@@ -829,13 +889,16 @@ _flash4.defvjp(_flash4_fwd, _flash4_bwd)
 
 def flash_attention(q, k, v, bias=None, *, causal: bool = False,
                     scale: Optional[float] = None,
-                    block_q: int = 128, block_k: int = 128,
+                    block_q: Optional[int] = None,
+                    block_k: Optional[int] = None,
                     interpret: bool = False):
     """Blockwise online-softmax attention as a Pallas TPU kernel, with a
     blockwise Pallas backward (``jax.custom_vjp``) so it is safe under
     ``jax.grad`` — the reference trains its Transformer/Attention stack
     (nn/Transformer.scala:749, nn/Attention.scala), so must we.
 
+    block_q/block_k default to the largest tiling that fits VMEM (see
+    :func:`_auto_blocks` — small blocks are grid-overhead-bound).
     Requires Tq % block_q == 0 and Tk % block_k == 0 (the public
     :func:`dot_product_attention` pads/dispatches).  bias, if given, must
     broadcast to [B, H, Tq, Tk].
@@ -844,6 +907,8 @@ def flash_attention(q, k, v, bias=None, *, causal: bool = False,
     tk = k.shape[2]
     if scale is None:
         scale = 1.0 / (d ** 0.5)
+    block_q, block_k = _resolve_blocks(block_q, block_k, tq, tk, d,
+                                       bias=bias is not None)
     assert tq % block_q == 0 and tk % block_k == 0
     if causal and tq != tk:
         # the kernel's causal mask is start-aligned; xla_attention's is

@@ -267,7 +267,9 @@ def ring_attention(q, k, v, axis_name: str, *, causal: bool = False,
         scale = 1.0 / (d ** 0.5)
     if bias is None and _use_flash_blocks(tc, d, kernel):
         from bigdl_tpu.ops.attention_kernels import _on_tpu
-        cfg = (axis_name, bool(causal), float(scale), _pick_block(tc),
+        # blk=None → the partial kernels auto-pick the largest VMEM-
+        # fitting tiling (small blocks are grid-overhead-bound)
+        cfg = (axis_name, bool(causal), float(scale), None,
                not _on_tpu())
         return _ring_flash(q, k, v, cfg)
     return _ring_xla(q, k, v, axis_name, causal, scale, bias)

@@ -1,0 +1,153 @@
+"""Perf lab: A/B step-time experiments for the ResNet-50 training step.
+
+Times a k-step lax.scan window (device-busy speed, same shape as the
+Optimizer's iterations-per-dispatch path) for the stock model and
+variants, so a candidate optimization gets a number before it touches
+the framework.  Run on the real chip:
+
+    python scripts/perf_lab.py base s2d
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_step(model_fn, batch, size, window=10, unroll=1, xs_bf16=False):
+    import jax
+    import jax.numpy as jnp
+    from bigdl_tpu.core.module import partition, combine, cast_floating
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.optim.methods import SGD
+    from bigdl_tpu.utils import set_seed
+
+    set_seed(0)
+    model = model_fn()
+    criterion = nn.CrossEntropyCriterion()
+    method = SGD(0.1, momentum=0.9, dampening=0.0)
+    params_tree, rest = partition(model)
+    opt_state = method.init_state(params_tree)
+
+    def step(carry, xy):
+        params, rest, opt_state = carry
+        x, y = xy
+
+        def loss_fn(p):
+            m = cast_floating(combine(p, rest), jnp.bfloat16)
+            out = m.forward(x.astype(jnp.bfloat16)).astype(jnp.float32)
+            return criterion(out, y), m
+
+        (loss, m2), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        params, opt_state2 = method.update(grads, params, opt_state)
+        _, rest2 = partition(m2)
+        rest2 = cast_floating(rest2, jnp.float32)
+        return (params, rest2, opt_state2), loss
+
+    def window_fn(params, rest, opt_state, xs, ys):
+        (params, rest, opt_state), losses = jax.lax.scan(
+            step, (params, rest, opt_state), (xs, ys), unroll=unroll)
+        return params, rest, opt_state, losses
+
+    jitted = jax.jit(window_fn, donate_argnums=(0, 1, 2))
+
+    rng = np.random.default_rng(0)
+    xs = jnp.asarray(rng.normal(size=(window, batch, size, size, 3)).astype(
+        np.float32))
+    if xs_bf16:
+        xs = xs.astype(jnp.bfloat16)
+    ys = jnp.asarray(rng.integers(1, 1001, size=(window, batch)))
+
+    t0 = time.monotonic()
+    compiled = jitted.lower(params_tree, rest, opt_state, xs, ys).compile()
+    compile_s = time.monotonic() - t0
+    cost = compiled.cost_analysis()
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else {}
+    flops = float(cost.get("flops", -1.0)) if cost else -1.0
+    return compiled, (params_tree, rest, opt_state, xs, ys), compile_s, flops
+
+
+def time_step(name, model_fn, batch=128, size=224, window=10, reps=3,
+              **kw):
+    compiled, state, compile_s, flops = build_step(model_fn, batch, size,
+                                                   window, **kw)
+    params, rest, opt_state, xs, ys = state
+    # warmup
+    params, rest, opt_state, losses = compiled(params, rest, opt_state,
+                                               xs, ys)
+    l0 = float(losses[-1])
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        params, rest, opt_state, losses = compiled(params, rest, opt_state,
+                                                   xs, ys)
+    lf = float(losses[-1])
+    dt = (time.perf_counter() - t0) / (reps * window)
+    print(f"[{name}] {dt * 1e3:7.2f} ms/step  {batch / dt:8.1f} img/s  "
+          f"compile {compile_s:5.1f}s  loss {l0:.3f}->{lf:.3f}  "
+          f"flops/step {flops / window / 1e12 if flops > 0 else -1:.3f}T",
+          flush=True)
+    return dt
+
+
+def model_base():
+    from bigdl_tpu.models import resnet50
+    return resnet50(class_num=1000)
+
+
+def model_s2d():
+    """ResNet-50 with a space-to-depth stem: the 7x7/s2 conv on 3
+    channels (3/128 of a lane's worth of input depth) becomes a 4x4/s1
+    conv on a [112,112,12] space-to-depth view.  Numerically equivalent
+    (the 7x7 kernel zero-pads to 8x8 and regroups); the MXU sees 12
+    input channels instead of 3."""
+    import jax
+    import jax.numpy as jnp
+    from bigdl_tpu.models.resnet import Bottleneck, ResNet
+
+    class S2DResNet(ResNet):
+        def forward(self, x):
+            n, h, w, c = x.shape
+            x = x.reshape(n, h // 2, 2, w // 2, 2, c).transpose(
+                0, 1, 3, 2, 4, 5).reshape(n, h // 2, w // 2, 4 * c)
+            # stem kernel [7,7,3,64] -> zero-pad top/left to [8,8,3,64]
+            # -> regroup to [4,4,12,64]
+            wgt = self.stem_conv.weight  # HWIO
+            wgt = jnp.pad(wgt, ((1, 0), (1, 0), (0, 0), (0, 0)))
+            wgt = wgt.reshape(4, 2, 4, 2, 3, 64).transpose(
+                0, 2, 1, 3, 4, 5).reshape(4, 4, 12, 64)
+            y = jax.lax.conv_general_dilated(
+                x, wgt, window_strides=(1, 1), padding=((2, 1), (2, 1)),
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+            y = jax.nn.relu(self.stem_bn(y))
+            y = self.stem_pool(y)
+            for b in self.blocks:
+                y = b(y)
+            y = jnp.mean(y, axis=(1, 2))
+            return self.head(y)
+
+    return S2DResNet(Bottleneck, [3, 4, 6, 3], 1000)
+
+
+def main():
+    which = sys.argv[1:] or ["base"]
+    import jax
+    dev = jax.devices()[0]
+    print(f"device: {dev.platform} {getattr(dev, 'device_kind', '?')}",
+          flush=True)
+    for name in which:
+        if name == "base":
+            time_step("base", model_base)
+        elif name.startswith("bs"):
+            time_step(name, model_base, batch=int(name[2:]))
+        else:
+            print(f"unknown experiment {name}")
+
+
+if __name__ == "__main__":
+    main()

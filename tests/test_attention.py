@@ -247,3 +247,23 @@ def test_transformer_translation_mode():
     out = model(src, tgt)
     assert out.shape == (2, 2, 15)
     assert np.isfinite(np.asarray(out)).all()
+
+
+def test_auto_blocks_divide_and_fit():
+    from bigdl_tpu.ops.attention_kernels import _auto_blocks
+
+    # big clean lengths -> large square tiles
+    assert _auto_blocks(4096, 4096, 64) == (1024, 1024)
+    # a bias adds two more f32 score-shaped tiles; the picker must
+    # shrink below the unbiased choice to stay inside scoped VMEM
+    bq, bk = _auto_blocks(4096, 4096, 64, bias=True)
+    assert 20 * bq * bk + 6 * (bq + bk) * 64 <= 14 * 2 ** 20
+    assert (bq * bk) < 1024 * 1024
+    # awkward lengths (divisible by 8, not 128, too big for one tile)
+    # must still return exact divisors, never the old (128, 128)
+    for t in (1160, 2056, 3000):
+        bq, bk = _auto_blocks(t, t, 64)
+        assert t % bq == 0 and t % bk == 0, (t, bq, bk)
+    # explicit sizes always win over auto
+    from bigdl_tpu.ops.attention_kernels import _resolve_blocks
+    assert _resolve_blocks(256, None, 4096, 4096, 64) == (256, 1024)
