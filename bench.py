@@ -358,10 +358,13 @@ def phase_optimizer_loop(on_tpu: bool, batch: int, size: int, host_batch):
     # Completion-to-completion window timings from the loss-drain worker
     # (loop dispatches are fully async — wall-clock epoch gaps would
     # measure dispatch rate, the r02 lie).  Window 1 bears the compile;
-    # steady state = best later window.
+    # steady state = the AGGREGATE span over the later windows (a
+    # min() over per-window rates reads impossibly fast whenever the
+    # drain lags one window and the next completions bunch together).
     steady = opt.window_timings[1:]
     if steady:
-        step_t = min(dt / n for n, dt, _ in steady)
+        step_t = sum(dt for _, dt, _ in steady) / sum(
+            n for n, _, _ in steady)
         upd = dict(optimizer_step_time_ms=round(step_t * 1e3, 2),
                    optimizer_img_per_sec=round(batch / step_t, 2))
         raw = RESULT.get("raw_step_img_per_sec")

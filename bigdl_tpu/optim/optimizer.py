@@ -598,11 +598,36 @@ class Optimizer:
             scalar losses (batched into ONE stacked readback); windowed
             dispatches contribute (stacked_losses, idx) pairs — one
             readback per window array, never per iteration."""
+            # Pin the completion timestamp FIRST with one blocking
+            # transfer of the window's last loss buffer.  A pure
+            # transfer blocks exactly until that step's own output
+            # exists; anything built with device ops (a jnp.stack of
+            # the window) enqueues behind every already-dispatched
+            # later step in the stream, so its completion reflects the
+            # whole queue and the per-window timings below collapse to
+            # host-processing gaps (observed 10x-optimistic step times
+            # on the transformer perf CLI before this ordering).
+            win_cache: Dict[int, np.ndarray] = {}
+            last = entries[-1][-1]
+            if isinstance(last, tuple):
+                win_cache[id(last[0])] = np.asarray(last[0]).astype(float)
+            else:
+                np.asarray(last)
+            # Completion, not dispatch.  Under the async drain several
+            # windows can be in flight at once with dispatch-time
+            # starts; completion-to-completion (prev window's ready
+            # time) is the honest denominator, or the r02
+            # async-dispatch lie returns through the back door.
+            t_ready = time.time()
+            # Value readbacks can now batch freely (ONE stacked
+            # transfer for scalar losses — per-scalar round trips on a
+            # high-latency link would throttle the drain and, through
+            # queue backpressure, the training loop itself); whatever
+            # the stream does with the stack no longer skews timing.
             scalars = [l for *_, l in entries
                        if not isinstance(l, tuple)]
             stacked_host = (np.asarray(jnp.stack(scalars)).astype(float)
                             if scalars else None)
-            win_cache: Dict[int, np.ndarray] = {}
             losses = []
             si = 0
             for *_, l in entries:
@@ -616,13 +641,6 @@ class Optimizer:
                 else:
                     losses.append(float(stacked_host[si]))
                     si += 1
-            # The readbacks above block until the window's work really
-            # finished, so this timestamp is completion, not dispatch.
-            # Under the async drain several windows can be in flight at
-            # once with dispatch-time starts; completion-to-completion
-            # (prev window's ready time) is the honest denominator, or
-            # the r02 async-dispatch lie returns through the back door.
-            t_ready = time.time()
             window_dt = t_ready - max(wstart, drain_state["last_ready"])
             drain_state["last_ready"] = t_ready
             per_iter = window_dt / len(entries)

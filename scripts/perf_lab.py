@@ -143,6 +143,8 @@ def main():
     for name in which:
         if name == "base":
             time_step("base", model_base)
+        elif name == "s2d":
+            time_step("s2d", model_s2d)
         elif name.startswith("bs"):
             time_step(name, model_base, batch=int(name[2:]))
         else:
