@@ -374,6 +374,33 @@ def phase_optimizer_loop(on_tpu: bool, batch: int, size: int, host_batch):
         _update(**upd)
 
 
+def phase_transformer(on_tpu: bool):
+    """Secondary metric: decoder-only transformer LM training through
+    the same Optimizer loop (L6 H512 T2048 b8, bf16, flash attention).
+    The reference trains its Transformer stack too (nn/Transformer.
+    scala:749); long-context throughput is where the Pallas flash
+    kernels earn their keep."""
+    import contextlib
+
+    from bigdl_tpu.examples.perf import main as perf_main
+
+    seq, batch = (2048, 8) if on_tpu else (128, 2)
+    # perf_main prints its own JSON line; keep bench's stdout contract
+    # (exactly ONE result line) by routing it to stderr
+    with contextlib.redirect_stdout(sys.stderr):
+        out = perf_main(["--model", "transformer-lm", "--seq-len",
+                         str(seq), "-b", str(batch), "--hidden-size",
+                         "512", "--num-layers", "6", "--num-heads", "8",
+                         "--vocab-size", "32000", "--bf16",
+                         "--iterations", "10", "--epochs", "4"])
+    if out.get("windows_timed"):
+        step_ms = out["ms_per_iteration"]
+        _update(transformer_lm_ms_per_step=step_ms,
+                transformer_lm_tokens_per_sec=round(
+                    batch * seq / (step_ms / 1e3), 1),
+                transformer_lm_config=f"L6-H512-T{seq}-b{batch}-bf16")
+
+
 def phase_roofline(on_tpu: bool):
     """Empirical bf16 matmul roofline: chained square matmuls (each
     output feeds the next so XLA cannot elide any), timed after warmup
@@ -464,6 +491,11 @@ def main():
                   deadline_s=150.0)
     else:
         RESULT["phases"]["roofline"] = "skipped (budget)"
+    if _remaining() > 75.0:
+        run_phase("transformer", lambda: phase_transformer(on_tpu),
+                  deadline_s=110.0)
+    else:
+        RESULT["phases"]["transformer"] = "skipped (budget)"
 
     _emit_final("done")
     # hard-exit: abandoned phase threads may be wedged inside native XLA

@@ -341,8 +341,44 @@ class Optimizer:
                 new_rest = cast_floating(new_rest, jnp.float32)
             return new_groups, new_rest, new_states, loss
 
+        def _aot(jitted):
+            """Compile once on first call, then reuse the executable.
+            Plain jax.jit keys its cache on the CONCRETE layouts of the
+            incoming arrays: call 1 sees host-staged default layouts,
+            while call 2's inputs are call 1's donated outputs in XLA's
+            preferred layouts — a different key, so the SECOND window
+            of a run recompiles the whole program (observed as a ~27 s
+            mid-loop stall on the tunneled v5e, poisoning the steady-
+            state telemetry).  One AOT executable relayouts call 1's
+            inputs once; donation aliasing makes every later call match
+            exactly."""
+            cache: Dict[Tuple, Any] = {}
+
+            def sig(args):
+                # shape/dtype signature only — NOT layouts (dodging the
+                # relayout recompile is the point) and NOT scalar
+                # values (epoch changes every epoch); ragged tails and
+                # padded variable-length batches land on their own
+                # entries exactly as jit would retrace
+                out = []
+                for leaf in jax.tree_util.tree_leaves(args):
+                    if hasattr(leaf, "shape"):
+                        out.append((tuple(leaf.shape), str(leaf.dtype)))
+                    else:
+                        out.append((type(leaf).__name__,))
+                return tuple(out)
+
+            def call(*args):
+                key = sig(args)
+                fn = cache.get(key)
+                if fn is None:
+                    fn = cache[key] = jitted.lower(*args).compile()
+                return fn(*args)
+
+            return call
+
         if not window:
-            return jax.jit(step, donate_argnums=(0, 1, 2))
+            return _aot(jax.jit(step, donate_argnums=(0, 1, 2)))
 
         def window_step(params_groups, rest, opt_states, xs, ys, rngs,
                         epoch):
@@ -358,7 +394,7 @@ class Optimizer:
                 body, (params_groups, rest, opt_states), (xs, ys, rngs))
             return pg, r, os_, losses
 
-        return jax.jit(window_step, donate_argnums=(0, 1, 2))
+        return _aot(jax.jit(window_step, donate_argnums=(0, 1, 2)))
 
     # ---- evaluation ------------------------------------------------------
 
@@ -619,14 +655,17 @@ class Optimizer:
             # time) is the honest denominator, or the r02
             # async-dispatch lie returns through the back door.
             t_ready = time.time()
-            # Value readbacks can now batch freely (ONE stacked
-            # transfer for scalar losses — per-scalar round trips on a
-            # high-latency link would throttle the drain and, through
-            # queue backpressure, the training loop itself); whatever
-            # the stream does with the stack no longer skews timing.
+            # Value readbacks batch via device_get (one pytree transfer
+            # with the copies issued concurrently — per-scalar
+            # np.asarray round trips on a high-latency link would
+            # throttle the drain and, through queue backpressure, the
+            # training loop itself).  NOT a jnp.stack: that is a device
+            # op that queues behind every in-flight step, which both
+            # lags the drain and once poisoned the timing.
             scalars = [l for *_, l in entries
                        if not isinstance(l, tuple)]
-            stacked_host = (np.asarray(jnp.stack(scalars)).astype(float)
+            stacked_host = (np.asarray(jax.device_get(scalars),
+                                       dtype=float)
                             if scalars else None)
             losses = []
             si = 0

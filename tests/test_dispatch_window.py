@@ -159,3 +159,24 @@ def test_window_min_loss_trigger_forces_single_step():
     assert opt.state["loss"] < 1.5
     # stopped promptly after crossing, not at a window boundary past it
     assert opt.state["epoch"] <= 50
+
+
+def test_ragged_batch_shapes_through_aot_cache():
+    """The AOT executable cache (one compiled program per shape
+    signature, dodging jit's layout-keyed recompile) must retrace for a
+    ragged tail batch instead of rejecting it: 40 samples at batch 16
+    -> one full window of k=2 at batch 16 plus a ragged size-8 batch
+    down the single-step path, every epoch."""
+    set_seed(3)
+    model = _mlp()
+    data = DataSet.array(synthetic_mnist(40, seed=0), shuffle=False) \
+        .transform(GreyImgNormalizer(128.0, 128.0)) \
+        .transform(SampleToMiniBatch(16, drop_last=False))
+    opt = (Optimizer(model, data, nn.ClassNLLCriterion())
+           .set_optim_method(SGD(0.1, momentum=0.9, dampening=0.0))
+           .set_end_when(Trigger.max_epoch(3))
+           .set_iterations_per_dispatch(2))
+    opt.optimize()
+    # 3 batches/epoch (4+4+2 samples) x 3 epochs + 1
+    assert opt.state["neval"] == 10
+    assert opt.state["loss"] < 2.5
