@@ -380,19 +380,18 @@ def phase_transformer(on_tpu: bool):
     The reference trains its Transformer stack too (nn/Transformer.
     scala:749); long-context throughput is where the Pallas flash
     kernels earn their keep."""
-    import contextlib
-
     from bigdl_tpu.examples.perf import main as perf_main
 
     seq, batch = (2048, 8) if on_tpu else (128, 2)
-    # perf_main prints its own JSON line; keep bench's stdout contract
-    # (exactly ONE result line) by routing it to stderr
-    with contextlib.redirect_stdout(sys.stderr):
-        out = perf_main(["--model", "transformer-lm", "--seq-len",
-                         str(seq), "-b", str(batch), "--hidden-size",
-                         "512", "--num-layers", "6", "--num-heads", "8",
-                         "--vocab-size", "32000", "--bf16",
-                         "--iterations", "10", "--epochs", "4"])
+    # emit=False: bench's stdout contract is exactly ONE result line
+    # (and a process-global redirect from this abandonable worker
+    # thread could leave stdout hijacked after a phase timeout)
+    out = perf_main(["--model", "transformer-lm", "--seq-len",
+                     str(seq), "-b", str(batch), "--hidden-size",
+                     "512", "--num-layers", "6", "--num-heads", "8",
+                     "--vocab-size", "32000", "--bf16",
+                     "--iterations", "10", "--epochs", "4"],
+                    emit=False)
     if out.get("windows_timed"):
         step_ms = out["ms_per_iteration"]
         _update(transformer_lm_ms_per_step=step_ms,

@@ -177,6 +177,6 @@ def test_ragged_batch_shapes_through_aot_cache():
            .set_end_when(Trigger.max_epoch(3))
            .set_iterations_per_dispatch(2))
     opt.optimize()
-    # 3 batches/epoch (4+4+2 samples) x 3 epochs + 1
+    # 3 batches/epoch (16+16+8 samples) x 3 epochs + 1
     assert opt.state["neval"] == 10
     assert opt.state["loss"] < 2.5

@@ -158,3 +158,15 @@ def test_perf_harness_cli():
                 "--num-layers", "1", "--num-heads", "2",
                 "--iterations", "2", "--epochs", "2"])
     assert out["records_per_sec"] > 0
+
+
+def test_perf_generate_mode():
+    """--generate measures KV-cache greedy decode instead of training."""
+    from bigdl_tpu.examples.perf import main
+    out = main(["--model", "transformer-lm", "--generate", "8",
+                "--seq-len", "16", "-b", "2", "--hidden-size", "32",
+                "--num-layers", "1", "--num-heads", "2",
+                "--vocab-size", "50"])
+    assert out["mode"] == "generate"
+    assert out["decode_tokens_per_sec"] > 0
+    assert out["new_tokens"] == 8
