@@ -196,6 +196,58 @@ def bench_generate(args):
     }
 
 
+def bench_int8_inference(args):
+    """fp32-vs-int8 inference latency on the same trained-shape model
+    (reference: whitepaper.md:192-196 claims up to 2x on BigQuant CPU
+    GEMM; here both paths are XLA on the accelerator — int8 rides the
+    MXU's int8 throughput via dot_general/conv preferred_element_type).
+    """
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from bigdl_tpu.nn.quantized import Quantizer
+    from bigdl_tpu.utils import set_seed
+
+    set_seed(0)
+    model, _, make_batch = build(args.model, args)
+    model.eval_mode()
+    x_np, _ = make_batch(args.batch_size)
+    x = jnp.asarray(x_np)
+    qmodel = Quantizer.quantize(model)  # clones internally
+    if args.bf16:
+        # compare against the bf16 production baseline, mirroring the
+        # training/--generate modes; int8 path keeps its own dtypes
+        from bigdl_tpu.core.module import cast_floating
+        model = cast_floating(model, jnp.bfloat16)
+        x = x.astype(jnp.bfloat16)
+
+    def timed(m):
+        fwd = jax.jit(lambda inp: m.forward(inp))
+        out = fwd(x)
+        np.asarray(out)  # forced completion
+        reps = 10
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = fwd(x)
+        np.asarray(out)
+        return (time.perf_counter() - t0) / reps
+
+    t_base = timed(model)
+    t_int8 = timed(qmodel)
+    base = "bf16" if args.bf16 else "fp32"
+    return {
+        "model": args.model,
+        "mode": "int8-infer",
+        "batch_size": args.batch_size,
+        "baseline_dtype": base,
+        f"{base}_ms": round(t_base * 1e3, 3),
+        "int8_ms": round(t_int8 * 1e3, 3),
+        "int8_speedup": round(t_base / t_int8, 3),
+        f"{base}_img_per_sec": round(args.batch_size / t_base, 1),
+        "int8_img_per_sec": round(args.batch_size / t_int8, 1),
+    }
+
+
 def main(argv=None, emit=True):
     p = argparse.ArgumentParser(
         description="Benchmark the Optimizer training loop on a model")
@@ -224,6 +276,9 @@ def main(argv=None, emit=True):
                    help="transformer-lm only: measure KV-cache greedy "
                         "decode of N new tokens after a --seq-len "
                         "prompt instead of training")
+    p.add_argument("--int8-infer", action="store_true",
+                   help="measure fp32-vs-int8 inference latency on the "
+                        "quantized model instead of training")
     args = p.parse_args(argv)
 
     if args.input_pipeline:
@@ -244,6 +299,12 @@ def main(argv=None, emit=True):
 
     if args.generate:
         out = bench_generate(args)
+        if emit:
+            print(json.dumps(out), flush=True)
+        return out
+
+    if args.int8_infer:
+        out = bench_int8_inference(args)
         if emit:
             print(json.dumps(out), flush=True)
         return out

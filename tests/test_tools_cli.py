@@ -170,3 +170,12 @@ def test_perf_generate_mode():
     assert out["mode"] == "generate"
     assert out["decode_tokens_per_sec"] > 0
     assert out["new_tokens"] == 8
+
+
+def test_perf_int8_infer_mode():
+    """--int8-infer reports fp32 vs quantized inference latency."""
+    from bigdl_tpu.examples.perf import main
+    out = main(["--model", "lenet", "--int8-infer", "-b", "8"])
+    assert out["mode"] == "int8-infer"
+    assert out["fp32_ms"] > 0 and out["int8_ms"] > 0
+    assert out["int8_speedup"] > 0
