@@ -43,6 +43,11 @@ def setup(args, app_name: str):
     logging.basicConfig(
         level=logging.WARNING if args.quiet else logging.INFO,
         format="%(asctime)s %(levelname)s %(name)s - %(message)s")
+    # multi-host bootstrap (no-op off-pod; ≙ the reference's cluster
+    # Engine.init): must run before any backend use so every host sees
+    # the global device set
+    from bigdl_tpu.utils import Engine
+    Engine.init_distributed()
     if not args.folder and args.synthetic is None:
         raise SystemExit(
             f"{app_name}: provide --folder DATA_DIR or --synthetic N")

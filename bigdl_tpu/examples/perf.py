@@ -216,10 +216,12 @@ def bench_int8_inference(args):
     qmodel = Quantizer.quantize(model)  # clones internally
     if args.bf16:
         # compare against the bf16 production baseline, mirroring the
-        # training/--generate modes; int8 path keeps its own dtypes
+        # training/--generate modes; int8 path keeps its own dtypes.
+        # cast_floating on the input leaves integer batches (token
+        # ids) alone
         from bigdl_tpu.core.module import cast_floating
         model = cast_floating(model, jnp.bfloat16)
-        x = x.astype(jnp.bfloat16)
+        x = cast_floating(x, jnp.bfloat16)
 
     def timed(m):
         fwd = jax.jit(lambda inp: m.forward(inp))

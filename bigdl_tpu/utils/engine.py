@@ -113,6 +113,64 @@ class Engine:
             s.inited = True
 
     @classmethod
+    def init_distributed(cls, coordinator_address: Optional[str] = None,
+                         num_processes: Optional[int] = None,
+                         process_id: Optional[int] = None) -> None:
+        """Bootstrap the multi-host runtime (≙ the reference's cluster
+        init: Engine.init parsing the Spark master + AllReduceParameter
+        port setup — here it is ``jax.distributed.initialize``, which
+        wires the DCN coordinator so every host sees the global device
+        set).
+
+        On Cloud TPU pod slices all arguments are auto-discovered (call
+        with none); elsewhere pass the coordinator explicitly or set
+        BIGDL_TPU_COORDINATOR / BIGDL_TPU_NUM_PROCESSES /
+        BIGDL_TPU_PROCESS_ID.  Idempotent: a second call is a no-op, so
+        library code may call it defensively.  Single-process runs
+        (num_processes == 1 discovered or requested) skip the
+        coordinator entirely."""
+        coordinator_address = (coordinator_address
+                               or get_property("bigdl.coordinator") or None)
+        if num_processes is None:
+            env = get_property("bigdl.num.processes")
+            num_processes = int(env) if env else None
+        if process_id is None:
+            env = get_property("bigdl.process.id")
+            process_id = int(env) if env else None
+        # a multi-host run is identifiable by explicit args, the env
+        # tier above, a launcher-set coordinator, or a TPU pod slice
+        # (worker hostnames published by the TPU runtime); anything
+        # else is a single-process run and must NOT touch the
+        # coordinator (jax.distributed.initialize would error once any
+        # backend work has happened — e.g. under tests)
+        multi = (num_processes not in (None, 1)
+                 or coordinator_address is not None
+                 or os.environ.get("JAX_COORDINATOR_ADDRESS")
+                 or os.environ.get("TPU_WORKER_HOSTNAMES", "").count(",")
+                 > 0)
+        with cls._lock:
+            if getattr(cls._state, "dist_inited", False):
+                return
+            if not multi:
+                cls._state.dist_inited = True
+                return
+            import jax
+            try:
+                jax.distributed.initialize(
+                    coordinator_address=coordinator_address,
+                    num_processes=num_processes,
+                    process_id=process_id)
+            except RuntimeError as e:
+                # already initialized elsewhere (e.g. by the launcher):
+                # jax phrases this "should only be called once" (0.9's
+                # exact text) / "already initialized" in other versions
+                msg = str(e).lower()
+                if "already" not in msg and "once" not in msg:
+                    raise
+            cls._state.dist_inited = True
+        cls.init()  # re-discover topology with the global view
+
+    @classmethod
     def _ensure(cls):
         if not cls._state.inited:
             cls.init()

@@ -120,3 +120,18 @@ def test_optimizer_version_switch():
     Engine.set_optimizer_version("optimizerV1")
     with pytest.raises(AssertionError):
         Engine.set_optimizer_version("bogus")
+
+
+def test_init_distributed_single_process_and_idempotent(monkeypatch):
+    """num_processes==1 (explicit or via the env tier) must skip the
+    DCN coordinator entirely and later calls must be no-ops — library
+    code calls this defensively."""
+    Engine.reset()
+    monkeypatch.setenv("BIGDL_TPU_NUM_PROCESSES", "1")
+    Engine.init_distributed()
+    assert getattr(Engine._state, "dist_inited", False)
+    # second call (different args) is a no-op, not a re-init attempt
+    Engine.init_distributed(coordinator_address="bogus:1234",
+                            num_processes=8, process_id=0)
+    assert Engine.node_number() >= 1
+    Engine.reset()
