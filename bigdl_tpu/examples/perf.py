@@ -51,11 +51,14 @@ def build(name: str, args):
         return (models.Vgg_16(args.classes),
                 nn.CrossEntropyCriterion(), image_batch)
     if name == "transformer-lm":
+        # synthetic batches are contiguous (tokens 1..V, no padding):
+        # padded_inputs=False keeps the causal mask inside the kernel
+        # (flash skips above-diagonal blocks, no [B,H,T,T] bias)
         lm = models.transformer_lm(
             vocab_size=args.vocab_size, hidden_size=args.hidden_size,
             num_layers=args.num_layers, num_heads=args.num_heads,
             filter_size=4 * args.hidden_size, max_len=args.seq_len,
-            remat=args.remat)
+            remat=args.remat, padded_inputs=False)
         from bigdl_tpu.core.module import Module
 
         class Flat(Module):
@@ -282,6 +285,10 @@ def main(argv=None, emit=True):
                    help="measure fp32-vs-int8 inference latency on the "
                         "quantized model instead of training")
     args = p.parse_args(argv)
+
+    # multi-host bootstrap (no-op off-pod) before any backend use
+    from bigdl_tpu.utils import Engine
+    Engine.init_distributed()
 
     if args.input_pipeline:
         if args.input_pipeline == "synthetic":

@@ -49,12 +49,19 @@ class TransformerLM(Module):
     def __init__(self, vocab_size: int, hidden_size: int = 256,
                  num_layers: int = 4, num_heads: int = 4,
                  filter_size: int = 1024, max_len: int = 512,
-                 dropout: float = 0.0, remat: bool = False):
+                 dropout: float = 0.0, remat: bool = False,
+                 padded_inputs: bool = True):
         super().__init__()
         self.hidden_size = hidden_size
         self.max_len = max_len
         self.remat = remat
         self.seq_parallel = False
+        # padded_inputs=False: contiguous LM batching (no token-0
+        # padding) — the causal mask moves INSIDE the attention kernel
+        # (flash skips above-diagonal blocks; no [B,H,T,T] bias is
+        # materialized or streamed).  Padding in that mode fails loudly
+        # like the sequence-parallel path.
+        self.padded_inputs = padded_inputs
         self.embedding = LookupTable(vocab_size + 1, hidden_size)
         # N(0, 1/H) init (reference embeddingSharedWeights / T2T): with
         # the weight-tied head, unit-std embeddings would give init
@@ -106,26 +113,31 @@ class TransformerLM(Module):
         x = self.embedding.forward(jnp.maximum(tokens, 1))
         x = x * (self.hidden_size ** 0.5)
         x = x + position_encoding(T, self.hidden_size, dtype=x.dtype)
-        if self.seq_parallel:
-            # ring attention applies causality per block pair; an
-            # additive bias would defeat its O(T/n) memory.  Padded
-            # batches are NOT supported here — fail loudly instead of
-            # silently diverging from the dense path (contiguous LM
-            # batching has none): eagerly that's a ValueError; under
-            # jit (tokens traced) the activations are NaN-poisoned so
-            # the loss/logits are unmistakably wrong, not subtly so
+        causal_in_kernel = False
+        if self.seq_parallel or not self.padded_inputs:
+            # Both modes handle causality INSIDE the attention kernel
+            # (the ring applies it per block pair; the dense causal
+            # flash path skips above-diagonal blocks) — an additive
+            # bias would defeat their O-of-memory/traffic win.  Padded
+            # batches are NOT supported on either — fail loudly instead
+            # of silently diverging (contiguous LM batching has none):
+            # eagerly that's a ValueError; under jit (tokens traced)
+            # the activations are NaN-poisoned so the loss/logits are
+            # unmistakably wrong, not subtly so
+            mode = ("sequence-parallel" if self.seq_parallel
+                    else "padded_inputs=False")
             if not isinstance(tokens, jax.core.Tracer):
                 if bool(jnp.any(tokens == 0)):
                     raise ValueError(
-                        "sequence-parallel TransformerLM does not "
-                        "support padded batches (token 0): the ring "
-                        "path has no padding mask; use contiguous LM "
-                        "batching")
+                        f"{mode} TransformerLM does not support padded "
+                        "batches (token 0): this path has no padding "
+                        "mask; use contiguous LM batching")
             else:
                 x = x + jnp.where(jnp.any(tokens == 0),
                                   jnp.asarray(jnp.nan, x.dtype),
                                   jnp.asarray(0, x.dtype))
             bias = None
+            causal_in_kernel = not self.seq_parallel
         else:
             bias = causal_bias(T, dtype=x.dtype) \
                 + padding_bias(tokens).astype(x.dtype)
@@ -136,10 +148,12 @@ class TransformerLM(Module):
                 # activations (jax.checkpoint); module buffers are not
                 # mutated in these blocks so the functional wrap is safe
                 def run(blk_, x_, bias_):
-                    return blk_.forward(x_, self_bias=bias_)
+                    return blk_.forward(x_, self_bias=bias_,
+                                        self_causal=causal_in_kernel)
                 x = jax.checkpoint(run)(blk, x, bias)
             else:
-                x = blk.forward(x, self_bias=bias)
+                x = blk.forward(x, self_bias=bias,
+                                self_causal=causal_in_kernel)
         x = self.final_norm(x)
         # weight-tied output head: logits against the embedding matrix
         emb = self.embedding.weight            # [vocab+1, H]
@@ -325,8 +339,9 @@ class TransformerLM(Module):
 def transformer_lm(vocab_size: int, hidden_size: int = 256,
                    num_layers: int = 4, num_heads: int = 4,
                    filter_size: int = 1024, max_len: int = 512,
-                   dropout: float = 0.0, remat: bool = False) \
-        -> TransformerLM:
+                   dropout: float = 0.0, remat: bool = False,
+                   padded_inputs: bool = True) -> TransformerLM:
     """Factory mirroring the models/* builder convention."""
     return TransformerLM(vocab_size, hidden_size, num_layers, num_heads,
-                         filter_size, max_len, dropout, remat)
+                         filter_size, max_len, dropout, remat,
+                         padded_inputs=padded_inputs)

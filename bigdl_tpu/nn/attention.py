@@ -138,7 +138,12 @@ class Attention(Module):
         b, h, t, d = x.shape
         return x.transpose(0, 2, 1, 3).reshape(b, t, h * d)
 
-    def forward(self, x, y=None, bias=None, cache=None, cache_index=None):
+    def forward(self, x, y=None, bias=None, cache=None, cache_index=None,
+                causal=False):
+        """``causal=True`` applies the lower-triangular mask inside the
+        attention kernel instead of via an additive bias — on TPU the
+        flash path then skips above-diagonal blocks entirely and never
+        materializes/streams a [B, H, Tq, Tk] bias."""
         self_attention = y is None
         y = x if self_attention else y
         q = self._split_heads(self.q_layer(x))
@@ -174,13 +179,17 @@ class Attention(Module):
             logits = logits / math.sqrt(d)
             if bias is not None:
                 logits = logits + bias.astype(jnp.float32)
+            if causal:
+                tq, tk = logits.shape[-2], logits.shape[-1]
+                mask = jnp.tril(jnp.ones((tq, tk), bool), k=tk - tq)
+                logits = jnp.where(mask, logits, -1e9)
             w = jax.nn.softmax(logits, axis=-1)
             keep = jax.random.bernoulli(
                 next_rng_key(), 1.0 - self.attention_dropout, w.shape)
             w = jnp.where(keep, w / (1.0 - self.attention_dropout), 0.0)
             ctxt = jnp.einsum("bhqk,bhkd->bhqd", w.astype(v.dtype), v)
         else:
-            ctxt = dot_product_attention(q, k, v, bias)
+            ctxt = dot_product_attention(q, k, v, bias, causal=causal)
         out = self.output_layer(self._combine_heads(ctxt))
         if cache is not None:
             return out, new_cache
@@ -260,7 +269,7 @@ class TransformerDecoderLayer(Module):
         self.ffn = FeedForwardNetwork(hidden_size, filter_size, ffn_dropout)
 
     def forward(self, x, self_bias=None, enc_out=None, enc_bias=None,
-                cache=None, cache_index=None):
+                cache=None, cache_index=None, self_causal=False):
         new_cache = None
         if cache is not None:
             y, self_cache = self.self_attn(
@@ -269,7 +278,8 @@ class TransformerDecoderLayer(Module):
             new_cache = dict(cache)
             new_cache["self"] = self_cache
         else:
-            y = self.self_attn(self.self_norm(x), None, self_bias)
+            y = self.self_attn(self.self_norm(x), None, self_bias,
+                               causal=self_causal)
         x = x + _residual_dropout(y, self.ffn_dropout, self.training)
         if self.with_cross_attention and enc_out is not None:
             if cache is not None and "cross" in cache:

@@ -342,8 +342,26 @@ class RingSelfAttention(Attention):
         self.ring_kernel = kernel   # "flash" | "xla" | None=auto
         self.head_axis = head_axis  # TP mesh axis for the head dim
 
-    def forward(self, x, y=None, bias=None, cache=None, cache_index=None):
+    def forward(self, x, y=None, bias=None, cache=None, cache_index=None,
+                causal=False):
+        # `causal` (kernel-side masking) is accepted for Attention API
+        # compatibility; the ring applies its own causality from
+        # self.causal, so a redundant True is absorbed — but a True on
+        # a non-causal ring would be silently dropped, so refuse it
+        if causal and not self.causal:
+            raise ValueError(
+                "RingSelfAttention was built with causal=False; "
+                "kernel-side causal masking is not available on this "
+                "ring — rebuild with causal=True")
         if cache is not None or (y is not None and y is not x):
+            if causal:
+                # kernel-side masking is start-of-cache-aligned and the
+                # decode path masks via its own incremental bias;
+                # silently forwarding would mis-mask mid-cache steps
+                raise ValueError(
+                    "causal=True is not supported on the cache/cross-"
+                    "attention path; pass the decode-time incremental "
+                    "bias instead")
             return Attention.forward(self, x, y, bias, cache, cache_index)
         if bias is not None:
             # dense fallback with equivalent masking: the ring would

@@ -267,3 +267,42 @@ def test_auto_blocks_divide_and_fit():
     # explicit sizes always win over auto
     from bigdl_tpu.ops.attention_kernels import _resolve_blocks
     assert _resolve_blocks(256, None, 4096, 4096, 64) == (256, 1024)
+
+
+def test_padded_inputs_false_matches_bias_path():
+    """padded_inputs=False moves the causal mask into the attention
+    kernel; on a pad-free batch it must match the additive-bias path
+    exactly (values and grads), and a padded batch must fail loudly."""
+    import jax
+    from bigdl_tpu.models.transformer_lm import TransformerLM
+    from bigdl_tpu.core.module import partition, combine
+    from bigdl_tpu.utils import set_seed
+
+    set_seed(11)
+    m_bias = TransformerLM(vocab_size=50, hidden_size=32, num_layers=2,
+                           num_heads=2, filter_size=64, max_len=16)
+    set_seed(11)
+    m_ck = TransformerLM(vocab_size=50, hidden_size=32, num_layers=2,
+                         num_heads=2, filter_size=64, max_len=16,
+                         padded_inputs=False)
+    toks = jnp.asarray(
+        np.random.default_rng(0).integers(1, 51, size=(3, 16)))
+
+    def loss(m, t):
+        params, rest = partition(m)
+        def f(p):
+            return jnp.sum(combine(p, rest).forward(t) ** 2)
+        return jax.value_and_grad(f)(params)
+
+    v1, g1 = loss(m_bias, toks)
+    v2, g2 = loss(m_ck, toks)
+    np.testing.assert_allclose(np.asarray(v1), np.asarray(v2),
+                               rtol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(g1),
+                    jax.tree_util.tree_leaves(g2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+    # padding must fail loudly, not silently attend to pad positions
+    padded = toks.at[0, -3:].set(0)
+    with pytest.raises(ValueError, match="padded"):
+        m_ck.forward(padded)
