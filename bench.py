@@ -277,16 +277,8 @@ def _build_step(on_tpu: bool, batch: int, size: int):
 
     # FLOPs per step, preferring XLA's own cost analysis of the program
     # we actually execute (fwd+bwd+update); analytic ResNet-50 fallback.
-    flops_per_step = None
-    try:
-        cost = compiled.cost_analysis()
-        if isinstance(cost, (list, tuple)):
-            cost = cost[0] if cost else {}
-        f = float(cost.get("flops", -1.0)) if cost else -1.0
-        if f > 0:
-            flops_per_step = f
-    except Exception:
-        pass
+    from bigdl_tpu.utils.xla_cost import compiled_flops
+    flops_per_step = compiled_flops(compiled)
     if flops_per_step is None:
         # 4.089e9 MACs fwd per 224px image; x2 FLOP/MAC; train ~ 3x fwd
         flops_per_step = 3 * 2 * 4.089e9 * batch * (size / 224.0) ** 2

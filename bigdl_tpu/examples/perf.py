@@ -20,7 +20,8 @@ import json
 import time
 
 
-MODELS = ("lenet", "resnet50", "inception-v1", "vgg16", "transformer-lm")
+MODELS = ("lenet", "resnet50", "inception-v1", "vgg16", "transformer-lm",
+          "ptb-lstm")
 
 
 def build(name: str, args):
@@ -50,6 +51,13 @@ def build(name: str, args):
     if name == "vgg16":
         return (models.Vgg_16(args.classes),
                 nn.CrossEntropyCriterion(), image_batch)
+    def token_batch(b):
+        return (rng.integers(
+                    1, args.vocab_size + 1,
+                    size=(b, args.seq_len)).astype(np.int32),
+                rng.integers(1, args.vocab_size + 1,
+                             size=(b * args.seq_len,)).astype(np.int32))
+
     if name == "transformer-lm":
         # synthetic batches are contiguous (tokens 1..V, no padding):
         # padded_inputs=False keeps the causal mask inside the kernel
@@ -59,25 +67,35 @@ def build(name: str, args):
             num_layers=args.num_layers, num_heads=args.num_heads,
             filter_size=4 * args.hidden_size, max_len=args.seq_len,
             remat=args.remat, padded_inputs=False)
-        from bigdl_tpu.core.module import Module
+        return _FlatLM(lm), nn.CrossEntropyCriterion(), token_batch
+    if name == "ptb-lstm":
+        # The reference's PTB word LM (example/languagemodel/
+        # PTBModel.scala): embedding -> stacked LSTM (lax.scan over
+        # time) -> TimeDistributed decoder -> logsoftmax, trained with
+        # ClassNLL on flattened [B*T] targets.
+        from bigdl_tpu.models.rnn_lm import PTBModel
 
-        class Flat(Module):
-            def __init__(self):
-                super().__init__()
-                self.lm = lm
-
-            def forward(self, x):
-                out = self.lm.forward(x)
-                return out.reshape(-1, out.shape[-1])
-
-        def lm_batch(b):
-            return (rng.integers(
-                        1, args.vocab_size + 1,
-                        size=(b, args.seq_len)).astype(np.int32),
-                    rng.integers(1, args.vocab_size + 1,
-                                 size=(b * args.seq_len,)).astype(np.int32))
-        return Flat(), nn.CrossEntropyCriterion(), lm_batch
+        lm = PTBModel(args.vocab_size, hidden_size=args.hidden_size,
+                      num_layers=args.num_layers)
+        return _FlatLM(lm), nn.ClassNLLCriterion(), token_batch
     raise SystemExit(f"unknown --model {name!r}")
+
+
+def _FlatLM(lm):
+    """Wrap a [B,T,V]-output LM to emit [B*T, V] for the flat-target
+    criteria (both LM perf models share this)."""
+    from bigdl_tpu.core.module import Module
+
+    class Flat(Module):
+        def __init__(self):
+            super().__init__()
+            self.lm = lm
+
+        def forward(self, x):
+            out = self.lm.forward(x)
+            return out.reshape(-1, out.shape[-1])
+
+    return Flat()
 
 
 def bench_input_pipeline(folder, image_size, batch_size, workers,
@@ -370,6 +388,12 @@ def main(argv=None, emit=True):
             opt.window_timings[0][1] if opt.window_timings else total, 2),
         "bf16": bool(args.bf16),
     }
+    if opt.compiled_flops_per_iteration:
+        # XLA's own FLOP count of the executed program (fwd+bwd+update),
+        # already normalized per train iteration by the Optimizer
+        flops_step = opt.compiled_flops_per_iteration
+        out["flops_per_iteration"] = flops_step
+        out["model_tflops_per_sec"] = round(flops_step / step_s / 1e12, 3)
     if not steady:
         out["warning"] = ("single dispatch window: time includes "
                           "compile; run more iterations/epochs for "

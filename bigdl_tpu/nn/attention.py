@@ -154,6 +154,17 @@ class Attention(Module):
         new_cache = None
         if cache is not None:
             if self_attention:
+                if causal:
+                    # The kernel mask is end-aligned (k = tk - tq): with
+                    # a decode cache tq=1 vs tk=max_len it would admit
+                    # every slot, including uninitialized future ones —
+                    # silently wrong logits.  Decode callers must pass
+                    # the position mask as an additive bias (the
+                    # TransformerLM decode_step path does).
+                    raise ValueError(
+                        "causal=True is unsupported with a decode cache: "
+                        "the kernel mask cannot know the cache fill; "
+                        "pass the decode position mask as `bias` instead")
                 k_step = self._split_heads(self.k_layer(y))
                 v_step = self._split_heads(self.v_layer(y))
                 k = jax.lax.dynamic_update_slice(
@@ -182,7 +193,7 @@ class Attention(Module):
             if causal:
                 tq, tk = logits.shape[-2], logits.shape[-1]
                 mask = jnp.tril(jnp.ones((tq, tk), bool), k=tk - tq)
-                logits = jnp.where(mask, logits, -1e9)
+                logits = jnp.where(mask, logits, _NEG_INF)
             w = jax.nn.softmax(logits, axis=-1)
             keep = jax.random.bernoulli(
                 next_rng_key(), 1.0 - self.attention_dropout, w.shape)
@@ -272,6 +283,14 @@ class TransformerDecoderLayer(Module):
                 cache=None, cache_index=None, self_causal=False):
         new_cache = None
         if cache is not None:
+            if self_causal and self_bias is None:
+                # the intent cannot be honored on the cache path (see
+                # Attention.forward): decode callers carry causality in
+                # the position bias
+                raise ValueError(
+                    "self_causal with a decode cache needs the decode "
+                    "position mask passed as self_bias; the kernel-side "
+                    "causal mask only applies to full-sequence forwards")
             y, self_cache = self.self_attn(
                 self.self_norm(x), None, self_bias,
                 cache=cache["self"], cache_index=cache_index)

@@ -26,6 +26,7 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.ad_checkpoint import checkpoint_name
 
 from bigdl_tpu.core.module import Module, Parameter
 from bigdl_tpu.core import init as init_methods
@@ -111,6 +112,13 @@ class SpatialConvolution(Module):
             feature_group_count=self.n_group)
         if self.with_bias:
             y = y + self.bias
+        # Remat anchor: under jax.checkpoint with a
+        # save_only_these_names policy, conv outputs are the natural
+        # residual set for conv->BN->ReLU chains — the elementwise tail
+        # is recomputed in the backward from the conv output instead of
+        # being round-tripped through HBM.  A no-op outside such a
+        # policy.
+        y = checkpoint_name(y, "conv_out")
         y = _from_nhwc(y, self.data_format)
         return y[0] if unbatched else y
 

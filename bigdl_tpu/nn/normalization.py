@@ -23,6 +23,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 
 from bigdl_tpu.core.module import Module, Parameter
 from bigdl_tpu.utils.rng import next_key
@@ -82,6 +83,12 @@ class BatchNormalization(Module):
             d_sq = jnp.mean(jnp.square(xs), axis=self.reduce_axes)
             var = jnp.maximum(d_sq - jnp.square(d_mean), 0.0)
             mean = k + d_mean
+            # Remat anchors (no-ops outside a names-policy checkpoint):
+            # batch stats are C-sized — saving them costs nothing and
+            # spares the backward a full re-reduction over the
+            # activation when the normalize chain is rematerialized.
+            mean = checkpoint_name(mean, "bn_stat")
+            var = checkpoint_name(var, "bn_stat")
             m = self.momentum
             self.running_mean = (1 - m) * self.running_mean + m * mean
             n = 1

@@ -52,6 +52,7 @@ from bigdl_tpu.parallel.sharding import (
     ShardingRules, shard_model_params, replicated,
 )
 from bigdl_tpu.utils.file import save_checkpoint, load_checkpoint
+from bigdl_tpu.utils.xla_cost import compiled_flops
 from bigdl_tpu.optim.metrics import Metrics
 from bigdl_tpu.utils.rng import get_seed
 
@@ -102,6 +103,10 @@ class Optimizer:
         self.state: Dict[str, Any] = {"epoch": 1, "neval": 1,
                                       "records": 0, "loss": float("nan"),
                                       "score": float("-inf")}
+        # XLA cost analysis of the compiled train program, normalized to
+        # one train iteration (window programs divide by their own
+        # length); None until first compile
+        self.compiled_flops_per_iteration: Optional[float] = None
         self._resume_from: Optional[str] = None
         self._last_val_neval = -1
         self._last_ckpt_neval = -1
@@ -341,7 +346,7 @@ class Optimizer:
                 new_rest = cast_floating(new_rest, jnp.float32)
             return new_groups, new_rest, new_states, loss
 
-        def _aot(jitted):
+        def _aot(jitted, steps_of=lambda args: 1):
             """Compile once on first call, then reuse the executable.
             Plain jax.jit keys its cache on the CONCRETE layouts of the
             incoming arrays: call 1 sees host-staged default layouts,
@@ -373,12 +378,28 @@ class Optimizer:
                 fn = cache.get(key)
                 if fn is None:
                     fn = cache[key] = jitted.lower(*args).compile()
+                    f = compiled_flops(fn)
+                    if f:
+                        # expose XLA's own FLOP count of the program
+                        # actually executed (fwd+bwd+update), normalized
+                        # by the train steps THIS program covers (the
+                        # window length it was compiled for, not the
+                        # configured k — ragged windows normalize
+                        # correctly) — ≙ the analytic flops/step the
+                        # reference's Throughput log never had.  max():
+                        # keep the steadiest (largest) program's count
+                        # if several signatures compile.
+                        prev = self.compiled_flops_per_iteration or 0.0
+                        self.compiled_flops_per_iteration = max(
+                            prev, f / max(steps_of(args), 1))
                 return fn(*args)
 
             return call
 
         if not window:
             return _aot(jax.jit(step, donate_argnums=(0, 1, 2)))
+        # windowed: args = (params_groups, rest, opt_states, xs, ys,
+        # rngs, epoch); xs' leading axis is the steps per dispatch
 
         def window_step(params_groups, rest, opt_states, xs, ys, rngs,
                         epoch):
@@ -394,7 +415,9 @@ class Optimizer:
                 body, (params_groups, rest, opt_states), (xs, ys, rngs))
             return pg, r, os_, losses
 
-        return _aot(jax.jit(window_step, donate_argnums=(0, 1, 2)))
+        return _aot(jax.jit(window_step, donate_argnums=(0, 1, 2)),
+                    steps_of=lambda args: int(jax.tree_util.tree_leaves(
+                        args[3])[0].shape[0]))
 
     # ---- evaluation ------------------------------------------------------
 

@@ -1,0 +1,28 @@
+"""XLA cost-analysis helpers shared by the throughput harnesses.
+
+The reference logs throughput as records/second only
+(optim/DistriOptimizer.scala:425-431); here every harness can also
+state FLOP/s because XLA counts the FLOPs of the exact program being
+executed.  jax's ``Compiled.cost_analysis()`` return shape has changed
+across versions (dict vs single-element list of dicts), so the
+unwrapping lives in exactly one place.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+__all__ = ["compiled_flops"]
+
+
+def compiled_flops(compiled) -> Optional[float]:
+    """FLOPs of an AOT-compiled executable per invocation, or None when
+    cost analysis is unavailable (some backends return nothing)."""
+    try:
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        f = float(cost.get("flops", -1.0)) if cost else -1.0
+        return f if f > 0 else None
+    except Exception:
+        return None

@@ -19,7 +19,8 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def build_step(model_fn, batch, size, window=10, unroll=1, xs_bf16=False):
+def build_step(model_fn, batch, size, window=10, unroll=1, xs_bf16=False,
+               remat=None):
     import jax
     import jax.numpy as jnp
     from bigdl_tpu.core.module import partition, combine, cast_floating
@@ -34,13 +35,20 @@ def build_step(model_fn, batch, size, window=10, unroll=1, xs_bf16=False):
     params_tree, rest = partition(model)
     opt_state = method.init_state(params_tree)
 
+    def apply(p, r, x):
+        m = cast_floating(combine(p, r), jnp.bfloat16)
+        out = m.forward(x.astype(jnp.bfloat16)).astype(jnp.float32)
+        return out, m
+
+    if remat is not None:
+        apply = jax.checkpoint(apply, policy=remat)
+
     def step(carry, xy):
         params, rest, opt_state = carry
         x, y = xy
 
         def loss_fn(p):
-            m = cast_floating(combine(p, rest), jnp.bfloat16)
-            out = m.forward(x.astype(jnp.bfloat16)).astype(jnp.float32)
+            out, m = apply(p, rest, x)
             return criterion(out, y), m
 
         (loss, m2), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
@@ -66,10 +74,8 @@ def build_step(model_fn, batch, size, window=10, unroll=1, xs_bf16=False):
     t0 = time.monotonic()
     compiled = jitted.lower(params_tree, rest, opt_state, xs, ys).compile()
     compile_s = time.monotonic() - t0
-    cost = compiled.cost_analysis()
-    if isinstance(cost, (list, tuple)):
-        cost = cost[0] if cost else {}
-    flops = float(cost.get("flops", -1.0)) if cost else -1.0
+    from bigdl_tpu.utils.xla_cost import compiled_flops
+    flops = compiled_flops(compiled) or -1.0
     return compiled, (params_tree, rest, opt_state, xs, ys), compile_s, flops
 
 
@@ -140,13 +146,29 @@ def main():
     dev = jax.devices()[0]
     print(f"device: {dev.platform} {getattr(dev, 'device_kind', '?')}",
           flush=True)
+    small = bool(os.environ.get("BIGDL_TPU_PERFLAB_SMALL"))
+    shape = dict(batch=8, size=64, window=2, reps=1) if small else {}
     for name in which:
         if name == "base":
-            time_step("base", model_base)
+            time_step("base", model_base, **shape)
         elif name == "s2d":
-            time_step("s2d", model_s2d)
+            time_step("s2d", model_s2d, **shape)
+        elif name == "remat":
+            # Save conv outputs + BN stats; rematerialize the BN/ReLU
+            # elementwise tail in the backward.  On an HBM-bound step
+            # this trades a little recompute for round-tripping ~half
+            # the activation bytes through HBM.
+            time_step("remat", model_base, **shape,
+                      remat=jax.checkpoint_policies.save_only_these_names(
+                          "conv_out", "bn_stat"))
+        elif name == "remat_conv":
+            # As above but recompute the BN stat reductions too.
+            time_step("remat_conv", model_base, **shape,
+                      remat=jax.checkpoint_policies.save_only_these_names(
+                          "conv_out"))
         elif name.startswith("bs"):
-            time_step(name, model_base, batch=int(name[2:]))
+            time_step(name, model_base, batch=int(name[2:]), **{
+                k: v for k, v in shape.items() if k != "batch"})
         else:
             print(f"unknown experiment {name}")
 

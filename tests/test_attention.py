@@ -102,7 +102,7 @@ def test_flash_dbias_unbroadcast(bias_shape):
     np.testing.assert_allclose(np.asarray(gf), np.asarray(gx),
                                rtol=2e-3, atol=2e-4)
 
-
+@pytest.mark.slow
 def test_transformer_training_step_forces_flash(monkeypatch):
     """VERDICT r03 'done' criterion: a TransformerLM training step with
     the dispatch forced to the flash kernel (interpret mode on CPU) under
@@ -196,6 +196,29 @@ def test_transformer_causality():
     assert not np.allclose(np.asarray(l1[:, 4]), np.asarray(l2[:, 4]))
 
 
+def test_attention_causal_flag_matches_bias_small():
+    """Quick default-suite lock on the kernel-side causal path (the
+    heavyweight TransformerLM parity test is @slow): nn.Attention with
+    causal=True must equal an explicit lower-triangular additive bias,
+    and the decode-cache misuse paths must fail loudly."""
+    h, heads, b, t = 16, 2, 2, 8
+    x = jnp.asarray(rnd(b, t, h, seed=23))
+    layer = nn.Attention(h, heads).eval_mode()
+    tril = np.tril(np.ones((t, t), np.float32))
+    bias = jnp.asarray(np.where(tril, 0.0, -1e9)[None, None])
+    np.testing.assert_allclose(np.asarray(layer(x, causal=True)),
+                               np.asarray(layer(x, None, bias)),
+                               rtol=1e-5, atol=1e-6)
+    cache = layer.init_cache(b, t)
+    with pytest.raises(ValueError, match="decode cache"):
+        layer(x[:, :1], cache=cache, cache_index=0, causal=True)
+    dec = nn.TransformerDecoderLayer(h, heads, 32,
+                                     with_cross_attention=False).eval_mode()
+    with pytest.raises(ValueError, match="self_bias"):
+        dec(x[:, :1], cache={"self": dec.self_attn.init_cache(b, t)},
+            cache_index=0, self_causal=True)
+
+
 def test_incremental_decode_matches_full_forward():
     model = nn.Transformer(vocab_size=13, hidden_size=16, num_heads=2,
                            filter_size=32, num_hidden_layers=2,
@@ -268,7 +291,7 @@ def test_auto_blocks_divide_and_fit():
     from bigdl_tpu.ops.attention_kernels import _resolve_blocks
     assert _resolve_blocks(256, None, 4096, 4096, 64) == (256, 1024)
 
-
+@pytest.mark.slow
 def test_padded_inputs_false_matches_bias_path():
     """padded_inputs=False moves the causal mask into the attention
     kernel; on a pad-free batch it must match the additive-bias path

@@ -209,7 +209,7 @@ def test_region_proposal_shapes():
     assert (b[:, 2] >= b[:, 0] - 1).all() and (b[:, 3] >= b[:, 1] - 1).all()
     assert b.min() >= -1e-5 and b.max() <= 63.0 + 1e-4
 
-
+@pytest.mark.slow
 def test_proposal_shapes():
     set_seed(2)
     prop = Proposal(pre_nms_topn=60, post_nms_topn=10,
@@ -281,7 +281,7 @@ def test_prior_box_values():
     var = out[1].reshape(-1, 4)
     np.testing.assert_allclose(var[0], [0.1, 0.1, 0.2, 0.2], rtol=1e-6)
 
-
+@pytest.mark.slow
 def test_detection_output_ssd():
     # 2 priors, 3 classes; zero loc deltas → boxes = priors
     priors = np.array([[0.1, 0.1, 0.3, 0.3], [0.6, 0.6, 0.9, 0.9]],
@@ -408,7 +408,7 @@ def test_ssd_detection_output_map():
     gts = [(np.array([1, 2]), priors[[0, 2]])]
     assert m.evaluate(dets, gts) == 1.0
 
-
+@pytest.mark.slow
 def test_nms_pre_topk_matches_full():
     """Regression (round-1 advisor #2): pre-top-k capping must not
     change the result when the winners are inside the cap."""

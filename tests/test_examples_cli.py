@@ -105,7 +105,7 @@ def test_lenet_main_real_files(mnist_dir):
     model = main(["-f", mnist_dir, "-e", "1", "-b", "16", "-q"])
     assert model is not None
 
-
+@pytest.mark.slow
 def test_ptb_main_synthetic():
     from bigdl_tpu.examples.ptb_lm import main
     model = main(["--synthetic", "2000", "-e", "1", "-q", "-b", "8",
@@ -368,6 +368,20 @@ def test_ptb_main_transformer():
                   "--hidden-size", "16", "--num-steps", "8",
                   "--num-heads", "2", "--vocab-size", "50"])
     assert model is not None
+
+
+@pytest.mark.slow
+def test_perf_ptb_lstm_training():
+    """bigdl-tpu-perf --model ptb-lstm: the BASELINE PTB-LSTM config's
+    perf path (embedding -> stacked LSTM scan -> TimeDistributed
+    decoder) through the Optimizer loop."""
+    from bigdl_tpu.examples.perf import main
+    out = main(["--model", "ptb-lstm", "-b", "8", "--seq-len", "8",
+                "--vocab-size", "50", "--hidden-size", "16",
+                "--num-layers", "2", "--iterations", "2",
+                "--epochs", "3"], emit=False)
+    assert out["records_per_sec"] > 0
+    assert out["windows_timed"] >= 1
 
 
 def test_perf_input_pipeline_synthetic():

@@ -62,6 +62,7 @@ def test_ring_attention_flash_kernel_matches_full(seq_mesh, causal):
                                rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("causal", [False, True])
 def test_ring_attention_flash_kernel_grads(seq_mesh, causal):
     """Grads through the flash ring's BLOCKWISE backward (dK/dV
@@ -113,7 +114,7 @@ def test_pipeline_matches_sequential():
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=1e-4, atol=1e-5)
 
-
+@pytest.mark.slow
 def test_pipeline_per_device_memory_is_microbatch_ring():
     """VERDICT r03 #5: per-device pipeline buffers must be the SHARDED
     microbatch ring (M/S in + M/S out slots + ONE working activation),
@@ -383,7 +384,7 @@ def test_moe_a2a_matches_dense_at_ample_capacity():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-3, atol=1e-5)
 
-
+@pytest.mark.slow
 def test_moe_a2a_per_device_memory_is_tokens_over_n():
     """Per-device activation buffers on the a2a path are O(B·T/n) —
     dispatch [S, E, C] and expert buffers [E/n, n·C, H] with S=B·T/n —

@@ -97,7 +97,7 @@ def test_module_quantize_convenience():
     q = m.quantize()
     assert type(q.layers[0]) is QuantizedLinear
 
-
+@pytest.mark.slow
 def test_int8_accuracy_delta_on_trained_lenet():
     """VERDICT r03 #7 / whitepaper.md:179-196 parity: quantize a model
     TRAINED in-suite and measure the fp32->int8 top-1 delta with the

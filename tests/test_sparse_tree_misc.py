@@ -102,7 +102,7 @@ def _chain_tree():
     children[4] = [2, 3]
     return children, leaf_ids
 
-
+@pytest.mark.slow
 def test_binary_tree_lstm_shapes_and_grad():
     set_seed(3)
     model = nn.BinaryTreeLSTM(input_size=4, hidden_size=6)

@@ -179,7 +179,7 @@ def test_beam_size_one_matches_greedy():
     np.testing.assert_array_equal(np.asarray(seqs)[:, 0, :], greedy)
     assert np.all(np.isfinite(np.asarray(scores)))
 
-
+@pytest.mark.slow
 def test_incremental_decode_matches_full_forward_with_padding():
     """A prompt containing 0-padding must produce the same logits
     incrementally as forward(), whose padding_bias masks pad slots
@@ -219,7 +219,7 @@ def test_generate_never_emits_untrained_or_pad_token():
     assert (np.asarray(seqs) != 0).all(), seqs
     assert (np.asarray(seqs) <= 50).all(), seqs
 
-
+@pytest.mark.slow
 def test_train_then_generate_token_convention():
     """ADVICE r03 (high): a model trained with the framework's own
     1-based criteria must generate the continuation in TOKEN space —
