@@ -456,6 +456,30 @@ def main():
     # remaining budget still fits compile + the raw-step measurement
     dev = run_phase("backend_init", phase_backend, deadline_s=260.0)
     if dev is None:
+        # The tunneled chip comes and goes (r04: unreachable for a whole
+        # session, then back).  Point the reader at the most recent
+        # CONFIRMED full run committed in-repo — clearly labeled as
+        # prior evidence, never merged into this run's (empty)
+        # measurements.
+        try:
+            import glob
+            here = os.path.dirname(os.path.abspath(__file__))
+            # date-stamped files sort lexicographically: last = newest
+            candidates = sorted(glob.glob(
+                os.path.join(here, "BENCH_measured_*.json")))
+            fname = os.path.basename(candidates[-1])
+            with open(candidates[-1]) as f:
+                prior = json.load(f)
+            RESULT["last_confirmed_run"] = {
+                "file": fname,
+                "metric": prior.get("metric"),
+                "value": prior.get("value"),
+                "mfu_vs_measured": prior.get("mfu_vs_measured"),
+                "note": "prior full-TPU run from this round; backend "
+                        "unreachable at bench time",
+            }
+        except Exception:
+            pass
         _emit_final("backend_init_failed")
         return
 

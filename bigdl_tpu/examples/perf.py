@@ -67,7 +67,7 @@ def build(name: str, args):
             num_layers=args.num_layers, num_heads=args.num_heads,
             filter_size=4 * args.hidden_size, max_len=args.seq_len,
             remat=args.remat, padded_inputs=False)
-        return _FlatLM(lm), nn.CrossEntropyCriterion(), token_batch
+        return _flat_lm(lm), nn.CrossEntropyCriterion(), token_batch
     if name == "ptb-lstm":
         # The reference's PTB word LM (example/languagemodel/
         # PTBModel.scala): embedding -> stacked LSTM (lax.scan over
@@ -77,13 +77,14 @@ def build(name: str, args):
 
         lm = PTBModel(args.vocab_size, hidden_size=args.hidden_size,
                       num_layers=args.num_layers)
-        return _FlatLM(lm), nn.ClassNLLCriterion(), token_batch
+        return _flat_lm(lm), nn.ClassNLLCriterion(), token_batch
     raise SystemExit(f"unknown --model {name!r}")
 
 
-def _FlatLM(lm):
+def _flat_lm(lm):
     """Wrap a [B,T,V]-output LM to emit [B*T, V] for the flat-target
-    criteria (both LM perf models share this)."""
+    criteria (both LM perf models share this).  A factory (not a
+    module-level class) so bigdl_tpu imports stay lazy for CLI startup."""
     from bigdl_tpu.core.module import Module
 
     class Flat(Module):

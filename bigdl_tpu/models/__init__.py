@@ -11,3 +11,4 @@ from bigdl_tpu.models.maskrcnn import (
 )
 from bigdl_tpu.models.ssd import SSDVGG16, ssd_vgg16_300
 from bigdl_tpu.models.transformer_lm import TransformerLM, transformer_lm
+from bigdl_tpu.models.ncf import NeuralCF

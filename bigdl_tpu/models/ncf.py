@@ -1,0 +1,58 @@
+"""Neural Collaborative Filtering (NCF / NeuMF).
+
+The reference ships the evaluation half of this recipe in core —
+``HitRatio``/``NDCG`` with the 1-positive + negNum-negatives protocol
+(optim/ValidationMethod.scala:883,950) — and the MovieLens reader in
+Python (pyspark/bigdl/dataset/movielens.py); this model is the standard
+consumer of both: a GMF branch (elementwise product of user/item
+embeddings) and an MLP branch over concatenated embeddings, fused by a
+final linear into one interaction probability.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.core.module import Module
+
+__all__ = ["NeuralCF"]
+
+
+class NeuralCF(Module):
+    """NeuMF: sigmoid(Linear([gmf_u * gmf_i ; MLP([mlp_u ; mlp_i])])).
+
+    Input: int id pairs ``[..., 2]`` (user, item), 1-based like the raw
+    MovieLens files and LookupTable.  Output: scores ``[...]`` in (0,1).
+    The leading shape is free, so the same forward scores a training
+    batch ``[B, 2]`` and a HitRatio evaluation batch ``[B, 1+neg, 2]``.
+    """
+
+    def __init__(self, user_count: int, item_count: int,
+                 embed_dim: int = 16, mlp_dims=(32, 16, 8)):
+        super().__init__()
+        self.gmf_user = nn.LookupTable(user_count, embed_dim)
+        self.gmf_item = nn.LookupTable(item_count, embed_dim)
+        self.mlp_user = nn.LookupTable(user_count, embed_dim)
+        self.mlp_item = nn.LookupTable(item_count, embed_dim)
+        layers = []
+        nin = 2 * embed_dim
+        for nout in mlp_dims:
+            layers += [nn.Linear(nin, nout), nn.ReLU()]
+            nin = nout
+        self.mlp = nn.Sequential(*layers)
+        self.head = nn.Linear(self.mlp_dims_out(mlp_dims) + embed_dim, 1)
+
+    @staticmethod
+    def mlp_dims_out(mlp_dims) -> int:
+        return mlp_dims[-1] if mlp_dims else 0
+
+    def forward(self, pairs):
+        users = pairs[..., 0]
+        items = pairs[..., 1]
+        gmf = self.gmf_user(users) * self.gmf_item(items)
+        mlp = self.mlp(jnp.concatenate(
+            [self.mlp_user(users), self.mlp_item(items)], axis=-1))
+        score = self.head(jnp.concatenate([gmf, mlp], axis=-1))
+        return jax.nn.sigmoid(score[..., 0])

@@ -370,6 +370,77 @@ def test_ptb_main_transformer():
     assert model is not None
 
 
+def test_movielens_reader(tmp_path):
+    """ratings.dat parsing (reference pyspark/bigdl/dataset/
+    movielens.py:26-52): ml-1m layout and flat layout, id projections."""
+    from bigdl_tpu.dataset.movielens import (
+        get_id_pairs, get_id_ratings, read_data_sets,
+    )
+    rows = "1::31::5::978300019\n2::12::3::978300020\n1::7::4::978300021\n"
+    d = tmp_path / "ml-1m"
+    d.mkdir()
+    (d / "ratings.dat").write_text(rows)
+    data = read_data_sets(str(tmp_path))
+    assert data.shape == (3, 4) and data[0, 1] == 31
+    assert get_id_pairs(str(tmp_path)).shape == (3, 2)
+    assert get_id_ratings(str(tmp_path))[1].tolist() == [2, 12, 3]
+    with pytest.raises(FileNotFoundError):
+        read_data_sets(str(tmp_path / "nowhere"))
+
+
+def test_ncf_model_shapes_and_leave_one_out():
+    """NeuralCF scores [B,2] training pairs and [B,1+neg,2] HitRatio
+    rows with one forward; leave-one-out holds out exactly one item per
+    user and samples negatives from the user's unseen items."""
+    import jax.numpy as jnp
+    from bigdl_tpu.dataset.movielens import synthetic_ratings
+    from bigdl_tpu.examples.ncf import leave_one_out
+    from bigdl_tpu.models.ncf import NeuralCF
+
+    ratings = synthetic_ratings(n_users=12, n_items=20, per_user=5)
+    pairs, labels, eval_rows = leave_one_out(ratings, neg_train=3,
+                                             neg_eval=10)
+    assert pairs.shape == (12 * 4 * (1 + 3), 2)
+    assert labels.mean() == pytest.approx(0.25)
+    assert eval_rows.shape == (12, 11, 2)
+    for rows in eval_rows:
+        u = rows[0, 0]
+        seen = set(ratings[ratings[:, 0] == u][:, 1].tolist())
+        assert int(rows[0, 1]) in seen           # held-out positive
+        assert not (set(rows[1:, 1].tolist()) & seen)  # negatives unseen
+
+    m = NeuralCF(12, 20, embed_dim=4).eval_mode()
+    s1 = m.forward(jnp.asarray(pairs[:6]))
+    s2 = m.forward(jnp.asarray(eval_rows[:3]))
+    assert s1.shape == (6,) and s2.shape == (3, 11)
+    assert float(s1.min()) >= 0.0 and float(s1.max()) <= 1.0
+
+
+@pytest.mark.slow
+def test_ncf_main_learns_above_chance():
+    """bigdl-tpu-ncf end to end on the latent-structured synthetic set:
+    HitRatio@10 over 40-row eval lists (chance = 0.25) must end well
+    above chance after training."""
+    from bigdl_tpu.examples.ncf import main
+
+    m = main(["--synthetic", "640", "-e", "10", "-b", "32", "-r", "0.005",
+              "--embed-dim", "8", "-q"])
+    assert m is not None
+    # the final validation score rides on the model's optimizer; re-run
+    # evaluation directly for the assertion
+    import numpy as np
+    import jax.numpy as jnp
+    from bigdl_tpu.dataset.movielens import synthetic_ratings
+    from bigdl_tpu.examples.ncf import leave_one_out
+
+    ratings = synthetic_ratings(n_users=80, n_items=40, per_user=8)
+    _, _, eval_rows = leave_one_out(ratings, 4, 39)
+    out = np.asarray(m.eval_mode().forward(jnp.asarray(eval_rows)))
+    rank = (out > out[:, :1]).sum(axis=1) + 1
+    hr = float((rank <= 10).mean())
+    assert hr > 0.40, f"HitRatio@10 {hr} not above chance (0.25)"
+
+
 @pytest.mark.slow
 def test_perf_ptb_lstm_training():
     """bigdl-tpu-perf --model ptb-lstm: the BASELINE PTB-LSTM config's

@@ -579,6 +579,75 @@ SWEEP = [
     ("JoinTable", lambda: nn.JoinTable(2),
      lambda o: (lambda a, b: torch.cat([a, b], dim=1)),
      lambda: [rnd(3, 4, seed=81), rnd(3, 5, seed=82)]),
+
+    # -- shape / indexing ops (Torch 1-based dims -> torch 0-based) --------
+    ("HardTanh", lambda: nn.HardTanh(-0.5, 0.5),
+     lambda o: (lambda x: F.hardtanh(x, -0.5, 0.5)),
+     lambda: [rnd(3, 7, seed=88)]),
+    ("Contiguous", lambda: nn.Contiguous(), lambda o: (lambda x: x),
+     lambda: [rnd(3, 7, seed=89)]),
+    ("GaussianDropout_eval", lambda: nn.GaussianDropout(0.3),
+     lambda o: (lambda x: x), lambda: [rnd(3, 7, seed=90)]),
+    ("GaussianNoise_eval", lambda: nn.GaussianNoise(0.3),
+     lambda o: (lambda x: x), lambda: [rnd(3, 7, seed=91)]),
+    ("Select", lambda: nn.Select(2, 1),
+     lambda o: (lambda x: x.select(1, 0)), lambda: [rnd(2, 3, 4, seed=92)]),
+    ("Narrow", lambda: nn.Narrow(2, 1, 2),
+     lambda o: (lambda x: x.narrow(1, 0, 2)), lambda: [rnd(2, 5, 4, seed=93)]),
+    ("Reverse", lambda: nn.Reverse(2),
+     lambda o: (lambda x: x.flip(1)), lambda: [rnd(2, 5, 4, seed=94)]),
+    ("Tile", lambda: nn.Tile(2, 3),
+     lambda o: (lambda x: x.repeat(1, 3, 1)), lambda: [rnd(2, 3, 4, seed=95)]),
+    ("Replicate", lambda: nn.Replicate(3, 1),
+     lambda o: (lambda x: x.unsqueeze(0).expand(3, -1, -1, -1)),
+     lambda: [rnd(2, 3, 4, seed=96)]),
+    ("Padding_end", lambda: nn.Padding(2, 2, 3),
+     lambda o: (lambda x: F.pad(x, (0, 0, 0, 2))),
+     lambda: [rnd(2, 3, 4, seed=97)]),
+    ("Padding_front", lambda: nn.Padding(2, -2, 3),
+     lambda o: (lambda x: F.pad(x, (0, 0, 2, 0))),
+     lambda: [rnd(2, 3, 4, seed=98)]),
+    ("View", lambda: nn.View(12),
+     lambda o: (lambda x: x.reshape(x.shape[0], 12)),
+     lambda: [rnd(2, 3, 4, seed=99)]),
+    ("Reshape", lambda: nn.Reshape([4, 3]),
+     lambda o: (lambda x: x.reshape(x.shape[0], 4, 3)),
+     lambda: [rnd(2, 3, 4, seed=100)]),
+    ("Pack", lambda: nn.Pack(1),
+     lambda o: (lambda a, b: torch.stack([a, b], dim=0)),
+     lambda: [rnd(2, 4, seed=101), rnd(2, 4, seed=102)]),
+    ("MV", lambda: nn.MV(),
+     lambda o: (lambda a, v: torch.bmm(a, v.unsqueeze(2)).squeeze(2)),
+     lambda: [rnd(2, 3, 4, seed=103), rnd(2, 4, seed=104)]),
+    ("Cropping3D", lambda: nn.Cropping3D((1, 1), (0, 1), (1, 0)),
+     lambda o: (lambda x: x[:, 1:-1, 0:-1, 1:, :]),
+     lambda: [rnd(2, 5, 5, 5, 2, seed=108)]),
+
+    # -- parameterized tail ------------------------------------------------
+    ("Euclidean", lambda: nn.Euclidean(4, 3),
+     lambda o: (lambda x: torch.cdist(
+         x, torch.tensor(np.asarray(o.weight)))),
+     lambda: [rnd(5, 4, seed=105)]),
+    ("Cosine", lambda: nn.Cosine(4, 3),
+     lambda o: (lambda x: F.cosine_similarity(
+         x.unsqueeze(1),
+         torch.tensor(np.asarray(o.weight)).unsqueeze(0), dim=2)),
+     lambda: [rnd(5, 4, seed=106)]),
+    ("Maxout", lambda: nn.Maxout(4, 3, 2),
+     lambda o: (lambda x: F.linear(
+         x, torch.tensor(np.asarray(o.layer.weight)),
+         torch.tensor(np.asarray(o.layer.bias))
+     ).reshape(-1, 2, 3).amax(1)),
+     lambda: [rnd(5, 4, seed=107)]),
+    ("VolumetricFullConvolution",
+     lambda: nn.VolumetricFullConvolution(2, 3, 2, 2, 2, 2, 2, 2),
+     # ours NDHWC, weight [kt,kh,kw,in,out]; torch NCDHW, [in,out,kT,kH,kW]
+     lambda o: (lambda x: F.conv_transpose3d(
+         x.permute(0, 4, 1, 2, 3),
+         torch.tensor(np.transpose(np.asarray(o.weight), (3, 4, 0, 1, 2))),
+         torch.tensor(np.asarray(o.bias)),
+         stride=2).permute(0, 2, 3, 4, 1)),
+     lambda: [rnd(1, 3, 4, 4, 2, seed=109)]),
 ]
 
 
