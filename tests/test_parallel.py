@@ -587,6 +587,70 @@ def test_tp_sp_composition_matches_dense():
 
 
 @pytest.mark.slow
+def test_dp_pp_composition_training_equivalence():
+    """DP (batch over data axis) composes with PP (GPipe microbatch ring
+    over the pipe axis) on one mesh through the full Optimizer loop:
+    loss and trained params match the single-device sequential run."""
+    from bigdl_tpu.parallel import MeshConfig
+
+    def build(mesh=None):
+        pipe = Pipeline([nn.TransformerEncoderLayer(16, 2, 32)
+                         for _ in range(4)], num_microbatches=2)
+        return pipe.set_mesh(mesh) if mesh is not None else pipe
+
+    l_ref, p_ref = _train_seq_model(build, n_iter=4)
+    cfg = MeshConfig(data=2, pipe=4)
+    mesh = cfg.build()
+    l_both, p_both = _train_seq_model(lambda: build(mesh), mesh_cfg=cfg,
+                                      n_iter=4)
+    np.testing.assert_allclose(l_both, l_ref, rtol=1e-4)
+    for a, b in zip(p_ref, p_both):
+        np.testing.assert_allclose(a, b, rtol=1e-3, atol=1e-5)
+
+
+@pytest.mark.slow
+def test_dp_sp_composition_training_equivalence():
+    """DP (batch over data axis) composes with SP (ring attention over
+    the seq axis) through the full Optimizer loop on a TransformerLM:
+    loss and trained params match the dense single-device run."""
+    from bigdl_tpu.models import transformer_lm
+    from bigdl_tpu.parallel import MeshConfig
+    from bigdl_tpu.dataset.dataset import Sample, DataSet
+    from bigdl_tpu.dataset import SampleToMiniBatch
+    from bigdl_tpu.optim import Optimizer, SGD, Trigger
+    from bigdl_tpu.utils import set_seed
+
+    def train(mesh_cfg, sp_mesh=None):
+        set_seed(5)
+        lm = transformer_lm(vocab_size=30, hidden_size=16, num_layers=2,
+                            num_heads=2, filter_size=32, max_len=32)
+        if sp_mesh is not None:
+            lm.set_sequence_parallel(sp_mesh, "seq")
+        rng = np.random.default_rng(7)
+        samples = [Sample(rng.integers(1, 31, size=(32,)).astype(np.int32),
+                          rng.integers(1, 31, size=(32,)).astype(np.int32))
+                   for _ in range(8)]
+        data = (DataSet.array(samples, shuffle=False)
+                .transform(SampleToMiniBatch(4)))
+        crit = nn.TimeDistributedCriterion(nn.CrossEntropyCriterion())
+        opt = (Optimizer(lm, data, crit)
+               .set_optim_method(SGD(0.05))
+               .set_end_when(Trigger.max_iteration(4))
+               .set_mesh(mesh_cfg))
+        opt.optimize()
+        return float(opt.state["loss"]), [
+            np.asarray(l) for l in
+            jax.tree_util.tree_leaves(lm.parameters())]
+
+    l_ref, p_ref = train(MeshConfig(data=1))
+    cfg = MeshConfig(data=2, seq=4)
+    l_both, p_both = train(cfg, cfg.build())
+    np.testing.assert_allclose(l_both, l_ref, rtol=1e-4)
+    for a, b in zip(p_ref, p_both):
+        np.testing.assert_allclose(a, b, rtol=1e-3, atol=2e-5)
+
+
+@pytest.mark.slow
 def test_dp_ep_composition_training_equivalence():
     """DP (batch over data axis) composes with EP (a2a token dispatch
     over the expert axis) on one mesh, through the full Optimizer loop:
