@@ -464,12 +464,25 @@ def main():
         try:
             import glob
             here = os.path.dirname(os.path.abspath(__file__))
-            # date-stamped files sort lexicographically: last = newest
-            candidates = sorted(glob.glob(
-                os.path.join(here, "BENCH_measured_*.json")))
-            fname = os.path.basename(candidates[-1])
-            with open(candidates[-1]) as f:
-                prior = json.load(f)
+            # date-stamped files sort lexicographically: last = newest;
+            # only real-chip runs count as confirmed evidence
+            prior = None
+            for path in sorted(glob.glob(
+                    os.path.join(here, "BENCH_measured_*.json")),
+                    reverse=True):
+                try:
+                    with open(path) as f:
+                        cand = json.load(f)
+                except Exception:
+                    continue  # a corrupt file must not hide older runs
+                # "confirmed" = a COMPLETE real-chip run: not a
+                # watchdog/phase partial, with a nonzero headline
+                if (cand.get("platform") == "tpu"
+                        and "partial" not in cand and cand.get("value")):
+                    prior, fname = cand, os.path.basename(path)
+                    break
+            if prior is None:
+                raise FileNotFoundError("no confirmed TPU run on disk")
             RESULT["last_confirmed_run"] = {
                 "file": fname,
                 "metric": prior.get("metric"),
