@@ -51,12 +51,19 @@ from bigdl_tpu.parallel.mesh import (
 from bigdl_tpu.parallel.sharding import (
     ShardingRules, shard_model_params, replicated,
 )
-from bigdl_tpu.utils.file import save_checkpoint, load_checkpoint
+from bigdl_tpu.utils.file import (
+    save_checkpoint, save_checkpoint_sharded, load_checkpoint,
+)
 from bigdl_tpu.utils.xla_cost import compiled_flops
 from bigdl_tpu.optim.metrics import Metrics
 from bigdl_tpu.utils.rng import get_seed
 
 logger = logging.getLogger("bigdl_tpu.optim")
+
+# driver scalars persisted in SHARDED checkpoints: a fixed contract so
+# the saved orbax tree and the resume-time abstract tree always match
+# structurally (self.state grows transient keys during the loop)
+_DRIVER_KEYS = ("epoch", "neval", "records", "loss", "score")
 
 __all__ = ["Optimizer"]
 
@@ -86,6 +93,7 @@ class Optimizer:
         self.val_dataset = None
         self.val_methods: Optional[List[ValidationMethod]] = None
         self.checkpoint_path: Optional[str] = None
+        self.checkpoint_sharded = False
         self.checkpoint_trigger: Optional[Trigger] = None
         self.overwrite_checkpoint = True
         self.grad_clip_const: Optional[Tuple[float, float]] = None
@@ -147,10 +155,16 @@ class Optimizer:
         return self
 
     def set_checkpoint(self, path: str, trigger: Trigger,
-                       is_overwrite: bool = True) -> "Optimizer":
+                       is_overwrite: bool = True,
+                       sharded: bool = False) -> "Optimizer":
+        """``sharded=True`` writes orbax checkpoint DIRECTORIES whose
+        array shards are saved by their owning hosts — required once
+        parameters are sharded across hosts (the default ``.npz``
+        format gathers every leaf to the saving host)."""
         self.checkpoint_path = path
         self.checkpoint_trigger = trigger
         self.overwrite_checkpoint = is_overwrite
+        self.checkpoint_sharded = sharded
         return self
 
     def resume(self, checkpoint_file: str) -> "Optimizer":
@@ -473,7 +487,9 @@ class Optimizer:
                 entries = [e for e in fs.ls(root, detail=True)
                            if os.path.basename(
                                e["name"]).startswith("checkpoint")
-                           and e["name"].endswith(".npz")]
+                           and (e["name"].endswith(".npz")
+                                or e["name"].rstrip("/")
+                                .endswith(".orbax"))]
                 if not entries:
                     return None
                 mtimes = [e.get("mtime") for e in entries]
@@ -483,8 +499,9 @@ class Optimizer:
                     # no reliable mtimes: order by the numeric iteration
                     # suffix (checkpoint.<neval>.npz), then name
                     def key(e):
-                        m = re.search(r"checkpoint\.(\d+)\.npz$",
-                                      e["name"])
+                        m = re.search(
+                            r"checkpoint\.(\d+)\.(?:npz|orbax)/?$",
+                            e["name"])
                         return (int(m.group(1)) if m else -1, e["name"])
                     best = max(entries, key=key)
                 scheme = self.checkpoint_path.split("://", 1)[0]
@@ -497,7 +514,8 @@ class Optimizer:
             return None
         files = [os.path.join(self.checkpoint_path, f)
                  for f in os.listdir(self.checkpoint_path)
-                 if f.startswith("checkpoint") and f.endswith(".npz")]
+                 if f.startswith("checkpoint")
+                 and (f.endswith(".npz") or f.endswith(".orbax"))]
         return max(files, key=os.path.getmtime) if files else None
 
     # ---- main loop (≙ DistriOptimizer.optimize, :823) --------------------
@@ -567,7 +585,11 @@ class Optimizer:
         mesh = self.mesh_config.build()
         model = self.model.train_mode()
 
-        if self._resume_from:
+        from bigdl_tpu.utils.file import is_sharded_checkpoint_path
+        resume_sharded = bool(self._resume_from) \
+            and is_sharded_checkpoint_path(self._resume_from)
+        saved_opt = None
+        if self._resume_from and not resume_sharded:
             model_state, saved_opt, driver = load_checkpoint(
                 self._resume_from)
             model.load_parameters(model_state["params"])
@@ -592,7 +614,49 @@ class Optimizer:
                    else [self.optim_methods[g] for g in group_names])
         opt_states = [m.init_state(pg)
                       for m, pg in zip(methods, params_groups)]
-        if self._resume_from:
+        if resume_sharded:
+            # restore INTO the sharded layout: the freshly-built (and
+            # already sharded) params/opt-state trees provide the
+            # abstract targets, so each host reads only its own shards
+            from bigdl_tpu.utils.file import load_checkpoint_sharded
+
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            def _abstract(x):
+                sh = getattr(x, "sharding", None)
+                if not isinstance(sh, NamedSharding):
+                    # uncommitted leaves (e.g. fresh scalar step
+                    # counters) must come back replicated over THIS
+                    # mesh, or the restored single-device arrays clash
+                    # with mesh-sharded params inside one jit
+                    sh = NamedSharding(mesh, PartitionSpec())
+                return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sh)
+
+            abstract = jax.tree_util.tree_map(_abstract, {
+                "model": {"params": model.parameters(),
+                          "buffers": model.buffers()},
+                "optim": opt_states,
+                # driver scalars live inside the same orbax tree (one
+                # atomic commit); current state supplies the dtypes,
+                # the fixed key set keeps save/restore structures equal
+                "driver": {k: np.asarray(self.state[k])
+                           for k in _DRIVER_KEYS if k in self.state},
+            })
+            ms, opt_restored, driver = load_checkpoint_sharded(
+                self._resume_from, abstract_state=abstract)
+            model.load_parameters(ms["params"])
+            if "buffers" in ms:
+                model.load_buffers(ms["buffers"])
+            params_tree, rest = partition(model)
+            leaves = jax.tree_util.tree_leaves(params_tree)
+            params_groups = [[leaves[i] for i in idxs]
+                             for _, idxs in groups]
+            opt_states = opt_restored
+            self.state.update(driver)
+            logger.info("resumed sharded checkpoint %s at epoch %s "
+                        "iteration %s", self._resume_from,
+                        self.state["epoch"], self.state["neval"])
+        elif self._resume_from:
             saved = jax.tree_util.tree_map(jnp.asarray, saved_opt)
             opt_states = saved
 
@@ -1085,16 +1149,33 @@ class Optimizer:
             self._last_ckpt_neval = self.state["neval"]
             tag = "" if self.overwrite_checkpoint \
                 else f".{self.state['neval']}"
-            path = os.path.join(self.checkpoint_path, f"checkpoint{tag}.npz")
             temp = combine(merged, rest)
+            driver = {k: v for k, v in self.state.items()
+                      if isinstance(v, (int, float))}
             with self.metrics.time("checkpoint time"):
-                save_checkpoint(
-                    path,
-                    {"params": _to_plain(temp.parameters()),
-                     "buffers": _to_plain(temp.buffers())},
-                    [s for s in opt_states],
-                    {k: v for k, v in self.state.items()
-                     if isinstance(v, (int, float))})
+                if self.checkpoint_sharded:
+                    # device arrays pass through unchanged: each host
+                    # writes its own shards, no gather.  The driver
+                    # rides inside the orbax tree under a FIXED key set
+                    # (strict orbax restores match structures exactly;
+                    # self.state grows transient keys mid-loop)
+                    path = os.path.join(self.checkpoint_path,
+                                        f"checkpoint{tag}.orbax")
+                    save_checkpoint_sharded(
+                        path,
+                        {"params": temp.parameters(),
+                         "buffers": temp.buffers()},
+                        [s for s in opt_states],
+                        {k: driver[k] for k in _DRIVER_KEYS
+                         if k in driver})
+                else:
+                    path = os.path.join(self.checkpoint_path,
+                                        f"checkpoint{tag}.npz")
+                    save_checkpoint(
+                        path,
+                        {"params": _to_plain(temp.parameters()),
+                         "buffers": _to_plain(temp.buffers())},
+                        [s for s in opt_states], driver)
             logger.info("checkpoint written to %s", path)
 
     def _sync_into(self, target: Module, source: Module):

@@ -78,7 +78,9 @@ def main(argv=None):
     # only a REAL-chip run may become the repo's confirmed-evidence
     # file (bench.py's failure partial cites the newest one; a
     # CPU-forced smoke run must never shadow TPU numbers)
-    if bench.get("raw_step_img_per_sec") and bench.get("platform") == "tpu":
+    if (bench.get("raw_step_img_per_sec")
+            and bench.get("platform") == "tpu"
+            and "partial" not in bench):
         with open(os.path.join(
                 REPO, f"BENCH_measured_{date}.json"), "w") as f:
             json.dump(bench, f)
