@@ -28,7 +28,7 @@ from bigdl_tpu.core.module import Module, ModuleList, Parameter, \
 from bigdl_tpu.nn.linear import Linear
 from bigdl_tpu.nn.normalization import LayerNormalization
 from bigdl_tpu.ops import dot_product_attention
-from bigdl_tpu.ops.attention_kernels import xla_attention, _NEG_INF
+from bigdl_tpu.ops.attention_kernels import _NEG_INF
 
 __all__ = [
     "Attention", "FeedForwardNetwork", "TransformerEncoderLayer",

@@ -276,6 +276,43 @@ def test_sharded_checkpoint_resume_roundtrip(tmp_path):
     assert opt2.state["loss"] < opt.state["loss"] + 0.2
 
 
+@pytest.mark.slow
+def test_sharded_checkpoint_multi_group_methods(tmp_path):
+    """Per-submodule optim methods (reference setOptimMethods) produce
+    GROUP-structured optimizer state; the sharded checkpoint must carry
+    that structure through orbax's strict restore."""
+    from bigdl_tpu.parallel import MeshConfig, ShardingRules
+
+    def build():
+        set_seed(4)
+        return nn.Sequential(
+            nn.Sequential(nn.Linear(16, 32), nn.ReLU()).set_name("trunk"),
+            nn.Sequential(nn.Linear(32, 4), nn.LogSoftMax())
+            .set_name("head"))
+
+    rng = np.random.default_rng(0)
+    samples = [Sample(rng.normal(size=(16,)).astype(np.float32),
+                      int(rng.integers(1, 5))) for _ in range(64)]
+    data = DataSet.array(samples).transform(SampleToMiniBatch(16))
+    methods = lambda: {"trunk": SGD(0.1, momentum=0.9),  # noqa: E731
+                       "head": Adam(1e-2)}
+    cfg = MeshConfig(data=2, fsdp=4)
+    opt = (Optimizer(build(), data, nn.ClassNLLCriterion())
+           .set_optim_methods(methods())
+           .set_end_when(Trigger.max_epoch(1))
+           .set_mesh(cfg, ShardingRules(fsdp=True))
+           .set_checkpoint(str(tmp_path), Trigger.every_epoch(),
+                           sharded=True))
+    opt.optimize()
+    opt2 = (Optimizer(build(), data, nn.ClassNLLCriterion())
+            .set_optim_methods(methods())
+            .set_end_when(Trigger.max_epoch(3))
+            .set_mesh(cfg, ShardingRules(fsdp=True))
+            .resume(os.path.join(str(tmp_path), "checkpoint.orbax")))
+    opt2.optimize()
+    assert opt2.state["epoch"] == 4
+
+
 def test_frozen_submodule_not_updated():
     set_seed(2)
     model = _mlp()
