@@ -124,9 +124,12 @@ def test_no_pickle_in_persistence_code():
         assert "allow_pickle=True" not in src
 
 
-@pytest.mark.parametrize("family", ["graph", "rnn", "transformer", "moe"])
+@pytest.mark.parametrize("family", ["graph", "rnn", "transformer", "moe",
+                                    "ncf", "autoencoder"])
 def test_roundtrip_layer_families(tmp_path, family):
-    from bigdl_tpu.models import PTBModel, lenet5_graph
+    from bigdl_tpu.models import (
+        Autoencoder, NeuralCF, PTBModel, lenet5_graph,
+    )
     from bigdl_tpu.nn.moe import MoE
     set_seed(3)
     rng = np.random.default_rng(0)
@@ -139,6 +142,15 @@ def test_roundtrip_layer_families(tmp_path, family):
     elif family == "transformer":
         m = nn.Sequential(nn.TransformerEncoderLayer(16, 2, 32))
         x = jnp.asarray(rng.normal(size=(2, 5, 16)), jnp.float32)
+    elif family == "ncf":
+        m = NeuralCF(12, 20, embed_dim=4)
+        x = jnp.asarray(
+            np.stack([rng.integers(1, 13, size=(5,)),
+                      rng.integers(1, 21, size=(5,))], axis=-1),
+            jnp.int32)
+    elif family == "autoencoder":
+        m = Autoencoder(class_num=8)
+        x = jnp.asarray(rng.normal(size=(2, 28, 28)), jnp.float32)
     else:
         m = MoE(8, [nn.FeedForwardNetwork(8, 16) for _ in range(4)],
                 top_k=2)
