@@ -10,7 +10,7 @@ import jax.numpy as jnp
 
 import bigdl_tpu.nn as nn
 from bigdl_tpu.nn.quantized import (
-    QuantizedLinear, QuantizedSpatialConvolution, quantize,
+    QuantizedLinear, QuantizedSpatialConvolution, Quantizer, quantize,
 )
 from bigdl_tpu.utils import set_seed
 
@@ -89,6 +89,27 @@ def test_quantized_model_jits():
     y = fn(q, x)
     assert y.shape == (4, 2)
     assert np.isfinite(np.asarray(y)).all()
+
+
+def test_quantize_ncf_scores_close():
+    """int8 inference extends to the recommender: NeuralCF's four MLP/
+    head Linears swap to QuantizedLinear (embedding lookups stay fp, as
+    the reference quantizes only Linear/conv — nn/quantized/
+    Quantizer.scala) and scores stay within sigmoid noise of fp32."""
+    from bigdl_tpu.models import NeuralCF
+
+    set_seed(0)
+    m = NeuralCF(20, 30, embed_dim=8).eval_mode()
+    rng = np.random.default_rng(0)
+    pairs = jnp.asarray(np.stack([rng.integers(1, 21, size=(16,)),
+                                  rng.integers(1, 31, size=(16,))], -1),
+                        jnp.int32)
+    base = np.asarray(m.forward(pairs))
+    q = Quantizer.quantize(m)
+    n_q = sum(isinstance(mod, QuantizedLinear)
+              for _, mod in q.named_modules())
+    assert n_q == 4, n_q
+    assert np.abs(np.asarray(q.forward(pairs)) - base).max() < 0.05
 
 
 def test_module_quantize_convenience():
