@@ -27,6 +27,7 @@ STD = (58.395, 57.12, 57.375)
 
 MODELS = {"resnet50": "resnet50",
           "inception-v1": "Inception_v1",
+          "inception-v2": "Inception_v2",
           "vgg16": "Vgg_16"}
 
 

@@ -20,8 +20,8 @@ import json
 import time
 
 
-MODELS = ("lenet", "resnet50", "inception-v1", "vgg16", "transformer-lm",
-          "ptb-lstm")
+MODELS = ("lenet", "resnet50", "inception-v1", "inception-v2", "vgg16",
+          "transformer-lm", "ptb-lstm")
 
 
 def build(name: str, args):
@@ -46,8 +46,13 @@ def build(name: str, args):
         return (models.resnet50(args.classes),
                 nn.CrossEntropyCriterion(), image_batch)
     if name == "inception-v1":
+        # both inception towers end in log_softmax: ClassNLL consumes
+        # the log-probs directly
         return (models.Inception_v1(args.classes),
-                nn.CrossEntropyCriterion(), image_batch)
+                nn.ClassNLLCriterion(), image_batch)
+    if name == "inception-v2":
+        return (models.Inception_v2(args.classes),
+                nn.ClassNLLCriterion(), image_batch)
     if name == "vgg16":
         return (models.Vgg_16(args.classes),
                 nn.CrossEntropyCriterion(), image_batch)

@@ -2,7 +2,7 @@ from bigdl_tpu.models.lenet import LeNet5, lenet5_graph
 from bigdl_tpu.models.resnet import (
     ResNet, resnet_cifar, resnet50, BasicBlock, Bottleneck,
 )
-from bigdl_tpu.models.inception import Inception_v1
+from bigdl_tpu.models.inception import Inception_v1, Inception_v2
 from bigdl_tpu.models.vgg import VggForCifar10, Vgg_16, Vgg_19
 from bigdl_tpu.models.rnn_lm import PTBModel, SimpleRNN
 from bigdl_tpu.models.autoencoder import Autoencoder, autoencoder

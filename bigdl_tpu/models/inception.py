@@ -1,7 +1,9 @@
-"""Inception v1 (GoogLeNet) — reference models/inception/Inception_v1.scala.
+"""Inception v1 (GoogLeNet) and v2 (BN-Inception).
 
-NHWC; each inception module is four parallel towers concatenated on the
-channel axis (reference's Concat(2) over NCHW ⇒ channel-last concat here).
+Reference: models/inception/Inception_v1.scala and Inception_v2.scala.
+NHWC; each inception module is parallel towers concatenated on the
+channel axis (reference's Concat(2) over NCHW ⇒ channel-last concat
+here).
 """
 
 from __future__ import annotations
@@ -13,7 +15,7 @@ import bigdl_tpu.nn as nn
 from bigdl_tpu.core import init as init_methods
 from bigdl_tpu.core.module import Module
 
-__all__ = ["Inception_v1", "inception_module"]
+__all__ = ["Inception_v1", "Inception_v2", "inception_module"]
 
 
 def _conv(nin, nout, kw, kh, sw=1, sh=1, pw=0, ph=0, name=""):
@@ -92,4 +94,102 @@ class Inception_v1(Module):
         y = jnp.mean(y, axis=(1, 2))
         if self.has_dropout and self.training:
             y = self.dropout(y)
+        return jax.nn.log_softmax(self.head(y))
+
+
+def _cbr(nin, nout, k, stride=1, pad=0, name=""):
+    """conv → BN(eps 1e-3) → ReLU, the v2 building unit (reference
+    Inception_v2.scala adds SpatialBatchNormalization(·, 1e-3) after
+    every convolution)."""
+    return [_conv(nin, nout, k, k, stride, stride, pad, pad, name),
+            nn.SpatialBatchNormalization(nout, eps=1e-3),
+            nn.ReLU()]
+
+
+class InceptionV2Module(Module):
+    """One BN-inception block (reference Inception_Layer_v2, Inception_
+    v2.scala:28).  config = (c1 | c3r,c3 | d3r,d3 | pool_type,proj):
+    optional 1x1 tower, a 3x3 tower, a DOUBLE-3x3 tower, and a pool
+    tower with optional projection.  ``pool_type=="max"`` with proj 0
+    is the reference's grid-reduction block: both conv towers stride 2,
+    the pool strides 2, and the input rides through the pool tower
+    unprojected."""
+
+    def __init__(self, input_size, c1, c3r, c3, d3r, d3,
+                 pool_type="avg", pool_proj=0, name="inception"):
+        super().__init__()
+        downsample = pool_type == "max" and pool_proj == 0
+        self.downsample = downsample
+        stride = 2 if downsample else 1
+        if c1:
+            self.b1 = nn.Sequential(*_cbr(input_size, c1, 1))
+        self.has_b1 = bool(c1)
+        self.b2 = nn.Sequential(*_cbr(input_size, c3r, 1),
+                                *_cbr(c3r, c3, 3, stride, 1))
+        self.b3 = nn.Sequential(*_cbr(input_size, d3r, 1),
+                                *_cbr(d3r, d3, 3, 1, 1),
+                                *_cbr(d3, d3, 3, stride, 1))
+        if downsample:
+            pool = nn.SpatialMaxPooling(3, 3, 2, 2).ceil()
+        elif pool_type == "max":
+            pool = nn.SpatialMaxPooling(3, 3, 1, 1, 1, 1).ceil()
+        else:
+            pool = nn.SpatialAveragePooling(3, 3, 1, 1, 1, 1).ceil()
+        layers = [pool]
+        if pool_proj:
+            layers += _cbr(input_size, pool_proj, 1)
+        self.b4 = nn.Sequential(*layers)
+        self.set_name(name)
+
+    def forward(self, x):
+        if self.downsample and (x.shape[1] % 2 or x.shape[2] % 2):
+            # stride-2 conv towers floor the output size while the
+            # ceil()-ed pool tower rounds up — on an ODD grid they
+            # disagree by one pixel and the concat dies with an opaque
+            # XLA shape error (the reference has the same constraint;
+            # its fixed 224px recipe never hits it)
+            raise ValueError(
+                f"Inception_v2 grid-reduction block {self.name!r} needs "
+                f"an even feature map, got {x.shape[1]}x{x.shape[2]}; "
+                f"use an input size divisible by 32 (e.g. 224)")
+        towers = ([self.b1(x)] if self.has_b1 else []) \
+            + [self.b2(x), self.b3(x), self.b4(x)]
+        return jnp.concatenate(towers, axis=-1)
+
+
+class Inception_v2(Module):
+    """BN-Inception main tower (reference Inception_v2_NoAuxClassifier,
+    Inception_v2.scala:185; the full Inception_v2 object adds two
+    train-time aux classifier heads — same design stance as v1 here:
+    main path, aux heads are train-time extras)."""
+
+    def __init__(self, class_num: int = 1000):
+        super().__init__()
+        self.stem = nn.Sequential(
+            *_cbr(3, 64, 7, 2, 3, "conv1/7x7_s2"),
+            nn.SpatialMaxPooling(3, 3, 2, 2).ceil(),
+            *_cbr(64, 64, 1, name="conv2/3x3_reduce"),
+            *_cbr(64, 192, 3, 1, 1, "conv2/3x3"),
+            nn.SpatialMaxPooling(3, 3, 2, 2).ceil())
+        cfg = [
+            (192, 64, 64, 64, 64, 96, "avg", 32, "3a"),
+            (256, 64, 64, 96, 64, 96, "avg", 64, "3b"),
+            (320, 0, 128, 160, 64, 96, "max", 0, "3c"),
+            (576, 224, 64, 96, 96, 128, "avg", 128, "4a"),
+            (576, 192, 96, 128, 96, 128, "avg", 128, "4b"),
+            (576, 160, 128, 160, 128, 160, "avg", 96, "4c"),
+            (576, 96, 128, 192, 160, 192, "avg", 96, "4d"),
+            (576, 0, 128, 192, 192, 256, "max", 0, "4e"),
+            (1024, 352, 192, 320, 160, 224, "avg", 128, "5a"),
+            (1024, 352, 192, 320, 192, 224, "max", 128, "5b"),
+        ]
+        self.blocks = nn.ModuleList(
+            [InceptionV2Module(*c[:-1], name=c[-1]) for c in cfg])
+        self.head = nn.Linear(1024, class_num)
+
+    def forward(self, x):
+        y = self.stem(x)
+        for b in self.blocks:
+            y = b(y)
+        y = jnp.mean(y, axis=(1, 2))  # ≙ 7x7 global average pool
         return jax.nn.log_softmax(self.head(y))

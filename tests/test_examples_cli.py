@@ -214,6 +214,44 @@ def test_imagenet_main_folder(tmp_path):
     assert model is not None
 
 
+@pytest.mark.slow
+def test_inception_v2_forward_grad_and_blocks():
+    """BN-Inception (reference Inception_v2.scala): channel progression
+    through all ten blocks matches the reference configs exactly, the
+    head is trainable, and grid-reduction blocks halve the grid (64px
+    input — the global-mean head is resolution-agnostic — but ten
+    BN-conv blocks still compile slowly on the 1-core box: slow
+    suite)."""
+    import jax
+    import jax.numpy as jnp
+    from bigdl_tpu.core.module import combine, partition
+    from bigdl_tpu.models import Inception_v2
+    from bigdl_tpu.utils import set_seed
+
+    set_seed(0)
+    m = Inception_v2(class_num=5).eval_mode()
+    x = jnp.asarray(np.random.default_rng(0).normal(
+        size=(2, 64, 64, 3)), np.float32)
+    y = m.stem(x)
+    widths = []
+    for b in m.blocks:
+        y = b(y)
+        widths.append(y.shape[-1])
+    assert widths == [256, 320, 576, 576, 576, 576, 576, 1024, 1024,
+                      1024], widths
+    assert y.shape[1] == 2  # 64px -> /8 stem -> /2 (3c) -> /2 (4e)
+
+    out = m.forward(x)
+    assert out.shape == (2, 5)
+    np.testing.assert_allclose(np.exp(np.asarray(out)).sum(-1), 1.0,
+                               rtol=1e-5)  # log-probs tail
+    params, rest = partition(m)
+    g = jax.grad(lambda p: jnp.sum(
+        combine(p, rest).forward(x) ** 2))(params)
+    assert all(np.isfinite(np.asarray(l)).all()
+               for l in jax.tree_util.tree_leaves(g))
+
+
 def test_imagenet_warmup_schedule_ramps_to_peak():
     """Warmup must ramp ~0 -> peak lr, then Poly decays FROM the peak
     (regression: the ramp used to start at the peak and reach 2x it,
