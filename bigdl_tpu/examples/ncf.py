@@ -48,10 +48,20 @@ def leave_one_out(ratings: np.ndarray, neg_train: int, neg_eval: int,
             for j in rng.choice(unseen, size=neg_train, replace=True):
                 train_pairs.append((u, int(j)))
                 train_labels.append(0.0)
-        # tiny item sets: sample with replacement rather than dropping
-        # the user (duplicated negatives only make the rank stricter)
-        negs = rng.choice(unseen, size=neg_eval,
-                          replace=len(unseen) < neg_eval)
+        # Eval rows must be one fixed shape ([1+neg_eval, 2]) for the
+        # stacked batch, so a heavy user whose unseen pool is smaller
+        # than neg_eval cannot simply get fewer negatives.  Take every
+        # distinct unseen item first and only pad the remainder with
+        # repeats — the maximum-distinct choice; the duplicates only
+        # make the 1-vs-N rank STRICTER than the reference protocol,
+        # never easier (acceptable for the synthetic smoke runs; real
+        # MovieLens pools are ≫ neg_eval so this branch never pads).
+        if len(unseen) >= neg_eval:
+            negs = rng.choice(unseen, size=neg_eval, replace=False)
+        else:
+            pad = rng.choice(unseen, size=neg_eval - len(unseen),
+                             replace=True)
+            negs = np.concatenate([rng.permutation(unseen), pad])
         eval_rows.append(np.asarray(
             [(u, holdout)] + [(u, int(j)) for j in negs], dtype=np.int32))
     return (np.asarray(train_pairs, dtype=np.int32),

@@ -112,9 +112,13 @@ class Optimizer:
                                       "records": 0, "loss": float("nan"),
                                       "score": float("-inf")}
         # XLA cost analysis of the compiled train program, normalized to
-        # one train iteration (window programs divide by their own
-        # length); None until first compile
+        # one train iteration as an EXECUTION-WEIGHTED average across
+        # compiled signatures (a ragged final batch compiles a smaller
+        # program; weighting by steps actually run keeps the average
+        # honest where a max() would overstate); None until first compile
         self.compiled_flops_per_iteration: Optional[float] = None
+        self._executed_flops = 0.0
+        self._executed_steps = 0
         self._resume_from: Optional[str] = None
         self._last_val_neval = -1
         self._last_ckpt_neval = -1
@@ -389,23 +393,27 @@ class Optimizer:
 
             def call(*args):
                 key = sig(args)
-                fn = cache.get(key)
-                if fn is None:
-                    fn = cache[key] = jitted.lower(*args).compile()
+                entry = cache.get(key)
+                if entry is None:
+                    fn = jitted.lower(*args).compile()
                     f = compiled_flops(fn)
-                    if f:
-                        # expose XLA's own FLOP count of the program
-                        # actually executed (fwd+bwd+update), normalized
-                        # by the train steps THIS program covers (the
-                        # window length it was compiled for, not the
-                        # configured k — ragged windows normalize
-                        # correctly) — ≙ the analytic flops/step the
-                        # reference's Throughput log never had.  max():
-                        # keep the steadiest (largest) program's count
-                        # if several signatures compile.
-                        prev = self.compiled_flops_per_iteration or 0.0
-                        self.compiled_flops_per_iteration = max(
-                            prev, f / max(steps_of(args), 1))
+                    # XLA's own FLOP count of the program actually
+                    # executed (fwd+bwd+update), normalized by the train
+                    # steps THIS program covers (the window length it
+                    # was compiled for, not the configured k — ragged
+                    # windows normalize correctly) — ≙ the analytic
+                    # flops/step the reference's Throughput log never had
+                    per_step = (f / max(steps_of(args), 1)) if f else None
+                    entry = cache[key] = (fn, per_step)
+                fn, per_step = entry
+                if per_step:
+                    # weight by steps actually executed so mixed batch
+                    # signatures (ragged tails) average correctly
+                    n = max(steps_of(args), 1)
+                    self._executed_flops += per_step * n
+                    self._executed_steps += n
+                    self.compiled_flops_per_iteration = (
+                        self._executed_flops / self._executed_steps)
                 return fn(*args)
 
             return call

@@ -151,12 +151,32 @@ def save_checkpoint(path: str, model_state: Dict, optim_state: Any,
                             for k, v in driver_state.items()}}, path)
 
 
+# Files orbax's StandardCheckpointer leaves at the checkpoint root; any
+# one of them identifies a directory as an orbax checkpoint (version
+# differences mean not all are always present).
+_ORBAX_MARKERS = ("_CHECKPOINT_METADATA", "manifest.ocdbt",
+                  "commit_success.txt", "d")
+
+
 def is_sharded_checkpoint_path(path: str) -> bool:
     """Sharded checkpoints are directories named ``*.orbax``; remote
-    paths can't be isdir()-probed, so the naming convention decides."""
+    paths can't be isdir()-probed, so the naming convention decides.
+    Local directories WITHOUT the suffix only qualify when they contain
+    an orbax marker file — an unrelated directory (e.g. one full of
+    .npz files) must not be routed into orbax restore, whose failure
+    mode is an opaque internal error."""
     p = strip_file_scheme(path)
-    return (p.rstrip("/").endswith(".orbax")
-            or (not is_remote_path(p) and os.path.isdir(p)))
+    if p.rstrip("/").endswith(".orbax"):
+        return True
+    if not is_remote_path(p) and os.path.isdir(p):
+        if any(os.path.exists(os.path.join(p, m)) for m in _ORBAX_MARKERS):
+            return True
+        raise ValueError(
+            f"'{path}' is a directory but not an orbax sharded "
+            "checkpoint (no .orbax suffix and no orbax metadata "
+            "inside); pass the .npz checkpoint file itself, or a "
+            "directory written by save_checkpoint_sharded")
+    return False
 
 
 def load_checkpoint(path: str) -> Tuple[Dict, Any, Dict]:
