@@ -52,11 +52,24 @@ class BasicBlock(Module):
 
 
 class Bottleneck(Module):
-    """1x1/3x3/1x1 bottleneck (reference ResNet.scala bottleneck)."""
+    """1x1/3x3/1x1 bottleneck (reference ResNet.scala bottleneck).
+
+    ``fused=True`` (or env BIGDL_TPU_FUSED_CONVBN) routes the training
+    forward through the fused conv+BN+ReLU Pallas kernels
+    (ops/conv_bn_kernels.py): the 1x1 convs run as matmul kernels whose
+    epilogue accumulates the following BN's batch statistics, and
+    conv3's kernel applies bn2's normalize+ReLU on the fly — the
+    normalized activation between conv2 and conv3 never touches HBM.
+    Numerics match the unfused path (same rounding points; test-locked).
+    Eval mode, non-NHWC, and non-TPU backends fall back to the plain
+    path (``fused="force"`` or env "force" overrides the backend check
+    and runs the kernels in interpret mode — tests/debug only).
+    """
 
     expansion = 4
 
-    def __init__(self, nin, planes, stride=1, zero_init_residual=True):
+    def __init__(self, nin, planes, stride=1, zero_init_residual=True,
+                 fused=False):
         super().__init__()
         nout = planes * self.expansion
         self.conv1 = _conv(nin, planes, 1)
@@ -71,21 +84,119 @@ class Bottleneck(Module):
             self.down_conv = _conv(nin, nout, 1, stride, 0)
             self.down_bn = nn.SpatialBatchNormalization(nout)
         self.has_down = stride != 1 or nin != nout
+        self.fused = fused
+
+    _FUSABLE = frozenset({"conv1", "conv3"})
+
+    def _fused_selection(self):
+        """Which convs to fuse.  env BIGDL_TPU_FUSED_CONVBN may be "0"
+        (off everywhere), "1" (default set), "force" (fuse even off-TPU,
+        via the interpret-mode kernels — tests/debug only), or a comma
+        list drawn from {conv1, conv3} (optionally with "force").
+
+        Off-TPU the kernels only run in Pallas interpret mode — orders
+        of magnitude slower than XLA — so without an explicit "force"
+        (env or ``fused="force"``) the plain path is used there."""
+        import os
+        from bigdl_tpu.ops.attention_kernels import _on_tpu
+        env = os.environ.get("BIGDL_TPU_FUSED_CONVBN")
+        if env == "0" or (not self.fused and not env):
+            return None
+        if not self.training or self.bn1.data_format != "NHWC":
+            return None
+        parts = {p.strip() for p in (env or "").split(",")
+                 if p.strip() not in ("", "0", "1")}
+        force = self.fused == "force" or "force" in parts
+        parts -= {"force"}
+        unknown = parts - self._FUSABLE
+        if unknown:
+            raise ValueError(
+                f"BIGDL_TPU_FUSED_CONVBN: unknown selector(s) "
+                f"{sorted(unknown)}; valid: {sorted(self._FUSABLE)}, "
+                "force, 0, 1")
+        if not force and not _on_tpu():
+            return None
+        return parts or set(self._FUSABLE)
 
     def forward(self, x):
         import jax
+        sel = self._fused_selection()
+        if sel is not None:
+            return self._forward_fused(x, sel)
         y = jax.nn.relu(self.bn1(self.conv1(x)))
         y = jax.nn.relu(self.bn2(self.conv2(y)))
         y = self.bn3(self.conv3(y))
         sc = self.down_bn(self.down_conv(x)) if self.has_down else x
         return jax.nn.relu(y + sc)
 
+    def _forward_fused(self, x, sel):
+        import jax
+        from bigdl_tpu.ops.attention_kernels import _on_tpu
+        from bigdl_tpu.ops import conv_bn_kernels as ck
+
+        interp = not _on_tpu()
+        stop = jax.lax.stop_gradient
+
+        def norm_vectors(bn, mean, var):
+            """(mean, scale, beta) f32 vectors folding bn's batch stats
+            to the kernel's subtract-first normalize form."""
+            inv = jax.lax.rsqrt(var.astype(jnp.float32) + bn.eps)
+            return (mean.astype(jnp.float32),
+                    inv * bn.weight.astype(jnp.float32),
+                    bn.bias.astype(jnp.float32))
+
+        # conv1: plain 1x1 matmul + bn1-stats epilogue
+        b, h, w, cin = x.shape
+        w1 = self.conv1.weight[0, 0]
+        m1, n1 = b * h * w, w1.shape[1]
+        if "conv1" in sel and ck.fused_block_supported(
+                m1, cin, n1, x.dtype.itemsize):
+            y1, s1, s2 = ck.fused_matmul_bn(
+                x.reshape(m1, cin), w1,
+                kshift=stop(self.bn1.running_mean), interpret=interp)
+            y1 = y1.reshape(b, h, w, n1)
+            mean1, var1 = self.bn1.fold_stats(s1 / m1, s2 / m1, m1)
+        else:
+            y1 = self.conv1(x)
+            d1, q1 = self.bn1.batch_stats(y1)
+            mean1, var1 = self.bn1.fold_stats(d1, q1, m1)
+        z1 = jax.nn.relu(self.bn1.normalize(y1, mean1, var1))
+
+        # conv2: 3x3 (and any stride) stays on the XLA conv emitter;
+        # only its BN statistics are computed here so that bn2's
+        # normalize+relu can ride conv3's kernel instead of a
+        # materialized elementwise pass
+        y2 = self.conv2(z1)
+        d2, q2 = self.bn2.batch_stats(y2)
+        mean2, var2 = self.bn2.fold_stats(d2, q2, self.bn2.stat_count(y2))
+
+        bb, hh, ww, p = y2.shape
+        w3 = self.conv3.weight[0, 0]
+        m3, n3 = bb * hh * ww, w3.shape[1]
+        if "conv3" in sel and ck.fused_block_supported(
+                m3, p, n3, y2.dtype.itemsize):
+            y3, t1, t2 = ck.fused_matmul_bn(
+                y2.reshape(m3, p), w3,
+                norm=norm_vectors(self.bn2, mean2, var2),
+                kshift=stop(self.bn3.running_mean), interpret=interp)
+            y3 = y3.reshape(bb, hh, ww, n3)
+            mean3, var3 = self.bn3.fold_stats(t1 / m3, t2 / m3, m3)
+        else:
+            z2 = jax.nn.relu(self.bn2.normalize(y2, mean2, var2))
+            y3 = self.conv3(z2)
+            d3, q3 = self.bn3.batch_stats(y3)
+            mean3, var3 = self.bn3.fold_stats(d3, q3, m3)
+
+        z3 = self.bn3.normalize(y3, mean3, var3)
+        sc = self.down_bn(self.down_conv(x)) if self.has_down else x
+        return jax.nn.relu(z3 + sc)
+
 
 class ResNet(Module):
     """Reference ResNet.scala apply(): ImageNet stem + 4 stages."""
 
     def __init__(self, block, layers, class_num=1000, cifar=False,
-                 zero_init_residual=True):
+                 zero_init_residual=True, fused=False):
         super().__init__()
         self.cifar = cifar
         if cifar:
@@ -106,8 +217,9 @@ class ResNet(Module):
         blocks = []
         for w, s, n in zip(widths, strides, layers):
             for i in range(n):
+                kw = {"fused": fused} if block is Bottleneck else {}
                 blocks.append(block(nin, w, s if i == 0 else 1,
-                                    zero_init_residual))
+                                    zero_init_residual, **kw))
                 nin = w * block.expansion
         self.blocks = nn.ModuleList(blocks)
         self.head = nn.Linear(nin, class_num,
@@ -131,6 +243,9 @@ def resnet_cifar(depth: int = 20, class_num: int = 10) -> ResNet:
     return ResNet(BasicBlock, [n, n, n], class_num, cifar=True)
 
 
-def resnet50(class_num: int = 1000) -> ResNet:
-    """ImageNet ResNet-50 (reference TrainImageNet recipe)."""
-    return ResNet(Bottleneck, [3, 4, 6, 3], class_num)
+def resnet50(class_num: int = 1000, fused: bool = False) -> ResNet:
+    """ImageNet ResNet-50 (reference TrainImageNet recipe).
+
+    ``fused=True``: train-mode bottlenecks use the fused conv+BN+ReLU
+    Pallas kernels (see Bottleneck docstring)."""
+    return ResNet(Bottleneck, [3, 4, 6, 3], class_num, fused=fused)

@@ -61,50 +61,59 @@ class BatchNormalization(Module):
         self.running_mean = jnp.zeros(n_output)
         self.running_var = jnp.ones(n_output)
 
-    def forward(self, x):
-        if self.training:
-            # Shifted one-pass statistics: with K = running_mean (a
-            # constant under autodiff), E[x-K] and E[(x-K)^2] are
-            # *independent* reductions, so XLA multi-output-fuses them
-            # into a single sweep over the activation; jnp.var(x) needs
-            # E[x] first, forcing a second full read — measurably slower
-            # on HBM-bound BN-heavy convnets.  var = E[(x-K)^2] -
-            # E[x-K]^2 is exact algebra whose f32 cancellation error
-            # scales with |E[x]-K|/std, small both at init (K=0 and conv
-            # outputs are zero-centered) and in steady state (K tracks
-            # the batch mean) — unlike the unshifted E[x^2]-E[x]^2 fast
-            # path, which loses all precision for |mean|/std >~ 3e3.
-            # Stats accumulate in f32 regardless of compute dtype.
-            xf = x.astype(jnp.float32)
-            k = jax.lax.stop_gradient(
-                self.running_mean.astype(jnp.float32))
-            xs = xf - k
-            d_mean = jnp.mean(xs, axis=self.reduce_axes)
-            d_sq = jnp.mean(jnp.square(xs), axis=self.reduce_axes)
-            var = jnp.maximum(d_sq - jnp.square(d_mean), 0.0)
-            mean = k + d_mean
-            # Remat anchors (no-ops outside a names-policy checkpoint):
-            # batch stats are C-sized — saving them costs nothing and
-            # spares the backward a full re-reduction over the
-            # activation when the normalize chain is rematerialized.
-            mean = checkpoint_name(mean, "bn_stat")
-            var = checkpoint_name(var, "bn_stat")
-            m = self.momentum
-            self.running_mean = (1 - m) * self.running_mean + m * mean
-            n = 1
-            for a in self.reduce_axes:
-                n *= x.shape[a]
-            unbiased = var * n / max(n - 1, 1)
-            self.running_var = (1 - m) * self.running_var + m * unbiased
-        else:
-            mean, var = self.running_mean, self.running_var
-        # Normalize subtract-first in f32: (x - mean) of two nearby
-        # values is exact, whereas folding mean into a shift vector
-        # (x*scale + (bias - mean*scale)) differences two large
-        # intermediates and loses the output to cancellation for
-        # large-|mean| channels — fatal in bf16.  The whole chain is one
-        # fused elementwise pass either way (reads x in its dtype,
-        # writes y in its dtype), so f32 register math costs nothing.
+    def batch_stats(self, x):
+        """Shifted one-pass statistics: with K = running_mean (a
+        constant under autodiff), E[x-K] and E[(x-K)^2] are
+        *independent* reductions, so XLA multi-output-fuses them
+        into a single sweep over the activation; jnp.var(x) needs
+        E[x] first, forcing a second full read — measurably slower
+        on HBM-bound BN-heavy convnets.  var = E[(x-K)^2] -
+        E[x-K]^2 is exact algebra whose f32 cancellation error
+        scales with |E[x]-K|/std, small both at init (K=0 and conv
+        outputs are zero-centered) and in steady state (K tracks
+        the batch mean) — unlike the unshifted E[x^2]-E[x]^2 fast
+        path, which loses all precision for |mean|/std >~ 3e3.
+        Stats accumulate in f32 regardless of compute dtype.
+
+        Exposed separately so the fused conv+BN Pallas path
+        (ops/conv_bn_kernels.py) can produce the same (d_mean, d_sq)
+        as a kernel epilogue and share :meth:`fold_stats`."""
+        xf = x.astype(jnp.float32)
+        k = jax.lax.stop_gradient(
+            self.running_mean.astype(jnp.float32))
+        xs = xf - k
+        d_mean = jnp.mean(xs, axis=self.reduce_axes)
+        d_sq = jnp.mean(jnp.square(xs), axis=self.reduce_axes)
+        return d_mean, d_sq
+
+    def fold_stats(self, d_mean, d_sq, n: int):
+        """Turn shifted stats into (mean, var) and update the running
+        buffers (momentum + unbiased correction, exactly the reference's
+        BatchNormalization.scala update)."""
+        k = jax.lax.stop_gradient(
+            self.running_mean.astype(jnp.float32))
+        var = jnp.maximum(d_sq - jnp.square(d_mean), 0.0)
+        mean = k + d_mean
+        # Remat anchors (no-ops outside a names-policy checkpoint):
+        # batch stats are C-sized — saving them costs nothing and
+        # spares the backward a full re-reduction over the
+        # activation when the normalize chain is rematerialized.
+        mean = checkpoint_name(mean, "bn_stat")
+        var = checkpoint_name(var, "bn_stat")
+        m = self.momentum
+        self.running_mean = (1 - m) * self.running_mean + m * mean
+        unbiased = var * n / max(n - 1, 1)
+        self.running_var = (1 - m) * self.running_var + m * unbiased
+        return mean, var
+
+    def normalize(self, x, mean, var):
+        """Normalize subtract-first in f32: (x - mean) of two nearby
+        values is exact, whereas folding mean into a shift vector
+        (x*scale + (bias - mean*scale)) differences two large
+        intermediates and loses the output to cancellation for
+        large-|mean| channels — fatal in bf16.  The whole chain is one
+        fused elementwise pass either way (reads x in its dtype,
+        writes y in its dtype), so f32 register math costs nothing."""
         xf = x.astype(jnp.float32)
         inv = jax.lax.rsqrt(var.astype(jnp.float32) + self.eps)
         scale = (inv * self.weight.astype(jnp.float32) if self.affine
@@ -113,6 +122,20 @@ class BatchNormalization(Module):
         if self.affine:
             y = y + self.bias.astype(jnp.float32)
         return y.astype(x.dtype)
+
+    def stat_count(self, x) -> int:
+        n = 1
+        for a in self.reduce_axes:
+            n *= x.shape[a]
+        return n
+
+    def forward(self, x):
+        if self.training:
+            d_mean, d_sq = self.batch_stats(x)
+            mean, var = self.fold_stats(d_mean, d_sq, self.stat_count(x))
+        else:
+            mean, var = self.running_mean, self.running_var
+        return self.normalize(x, mean, var)
 
 
 class SpatialBatchNormalization(BatchNormalization):

@@ -1,0 +1,208 @@
+"""Fused conv+BN+ReLU Pallas kernels (ops/conv_bn_kernels.py) vs the
+unfused XLA path: values, gradients, and running-stat updates must
+match.  Runs the kernels in interpret mode on CPU (same code path the
+TPU compiles).
+
+Reference for WHAT must hold: the reference's fused mkl-dnn conv+BN
+produces the same training math as its unfused nn/ layers
+(nn/mkldnn/SpatialBatchNormalization.scala); here the oracle is our own
+unfused module chain.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu.ops.conv_bn_kernels import (
+    fused_block_supported, fused_matmul_bn, fused_matmul_bn_reference,
+)
+
+
+def _rand(key, shape, dtype=jnp.float32):
+    return jax.random.normal(jax.random.PRNGKey(key), shape).astype(dtype)
+
+
+class TestFusedOp:
+    def test_plain_matmul_with_stats(self):
+        x = _rand(0, (256, 64))
+        w = _rand(1, (64, 128)) * 0.1
+        k = _rand(2, (128,)) * 0.01
+        y, s1, s2 = fused_matmul_bn(x, w, kshift=k, interpret=True)
+        yr, r1, r2 = fused_matmul_bn_reference(x, w, kshift=k)
+        np.testing.assert_allclose(y, yr, rtol=2e-5, atol=2e-5)
+        np.testing.assert_allclose(s1, r1, rtol=2e-4, atol=2e-3)
+        np.testing.assert_allclose(s2, r2, rtol=2e-4, atol=2e-3)
+
+    def test_input_fusion(self):
+        x = _rand(0, (128, 32)) * 2 + 0.3
+        w = _rand(1, (32, 64)) * 0.1
+        norm = (_rand(2, (32,)) * 0.1, jnp.abs(_rand(3, (32,))) + 0.5,
+                _rand(4, (32,)) * 0.2)
+        k = jnp.zeros((64,))
+        y, s1, s2 = fused_matmul_bn(x, w, norm=norm, kshift=k,
+                                    interpret=True)
+        yr, r1, r2 = fused_matmul_bn_reference(x, w, norm=norm, kshift=k)
+        np.testing.assert_allclose(y, yr, rtol=2e-5, atol=2e-5)
+        np.testing.assert_allclose(s1, r1, rtol=2e-4, atol=2e-3)
+
+    def test_no_stats(self):
+        x = _rand(0, (128, 32))
+        w = _rand(1, (32, 64))
+        y = fused_matmul_bn(x, w, interpret=True)
+        yr = fused_matmul_bn_reference(x, w)
+        np.testing.assert_allclose(y, yr, rtol=2e-5, atol=2e-5)
+
+    def test_gradients_match_reference(self):
+        """Full vjp — including the gradient THROUGH the emitted batch
+        statistics (the stats feed a downstream loss term here, exactly
+        as the next layer's normalize would)."""
+        x = _rand(0, (128, 32)) * 1.5
+        w = _rand(1, (32, 64)) * 0.2
+        norm = (_rand(2, (32,)) * 0.1, jnp.abs(_rand(3, (32,))) + 0.5,
+                _rand(4, (32,)) * 0.2)
+        k = _rand(5, (64,)) * 0.01
+
+        def loss_fused(x, w, norm):
+            y, s1, s2 = fused_matmul_bn(x, w, norm=norm, kshift=k,
+                                        interpret=True)
+            return (jnp.sum(y * y) + jnp.sum(jnp.sin(s1))
+                    + jnp.sum(jnp.cos(s2) * 0.1))
+
+        def loss_ref(x, w, norm):
+            y, s1, s2 = fused_matmul_bn_reference(x, w, norm=norm,
+                                                  kshift=k)
+            return (jnp.sum(y * y) + jnp.sum(jnp.sin(s1))
+                    + jnp.sum(jnp.cos(s2) * 0.1))
+
+        gf = jax.grad(loss_fused, argnums=(0, 1, 2))(x, w, norm)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(x, w, norm)
+        for a, b in zip(jax.tree_util.tree_leaves(gf),
+                        jax.tree_util.tree_leaves(gr)):
+            np.testing.assert_allclose(a, b, rtol=3e-4, atol=3e-3)
+
+    def test_gradients_no_input_fusion(self):
+        x = _rand(0, (128, 32))
+        w = _rand(1, (32, 64)) * 0.2
+        k = jnp.zeros((64,))
+
+        def loss(op):
+            def f(x, w):
+                y, s1, s2 = op(x, w, kshift=k)
+                return jnp.sum(y ** 2) + jnp.sum(s1 * 0.3) + jnp.sum(s2) * 0.1
+            return f
+
+        gf = jax.grad(loss(lambda *a, **kw: fused_matmul_bn(
+            *a, interpret=True, **kw)), argnums=(0, 1))(x, w)
+        gr = jax.grad(loss(fused_matmul_bn_reference), argnums=(0, 1))(x, w)
+        np.testing.assert_allclose(gf[0], gr[0], rtol=3e-4, atol=3e-3)
+        np.testing.assert_allclose(gf[1], gr[1], rtol=3e-4, atol=3e-3)
+
+    def test_bf16_paths_agree(self):
+        x = _rand(0, (256, 64), jnp.bfloat16)
+        w = (_rand(1, (64, 128)) * 0.1).astype(jnp.bfloat16)
+        k = jnp.zeros((128,))
+        y, s1, s2 = fused_matmul_bn(x, w, kshift=k, interpret=True)
+        yr, r1, r2 = fused_matmul_bn_reference(x, w, kshift=k)
+        assert y.dtype == jnp.bfloat16
+        np.testing.assert_allclose(y.astype(np.float32),
+                                   yr.astype(np.float32),
+                                   rtol=2e-2, atol=2e-2)
+        np.testing.assert_allclose(s1, r1, rtol=2e-2, atol=0.5)
+
+    def test_block_support_probe(self):
+        assert fused_block_supported(256, 64, 128)
+        assert not fused_block_supported(97, 64, 128)  # prime M
+        # resident w + dW alone exceed the VMEM budget
+        assert not fused_block_supported(4096, 2048, 2048)
+
+
+class TestFusedBottleneck:
+    def _make_pair(self, stride=1, cin=32, planes=8):
+        """Two bottlenecks with identical params, one fused."""
+        from bigdl_tpu.models.resnet import Bottleneck
+        from bigdl_tpu.utils import set_seed
+        set_seed(7)
+        a = Bottleneck(cin, planes, stride=stride)
+        set_seed(7)
+        b = Bottleneck(cin, planes, stride=stride, fused="force")
+        return a, b
+
+    def test_forward_matches_unfused(self):
+        a, b = self._make_pair()
+        x = _rand(11, (4, 8, 8, 32))
+        ya = a.train_mode()(x)
+        yb = b.train_mode()(x)
+        np.testing.assert_allclose(ya, yb, rtol=3e-5, atol=3e-5)
+
+    def test_forward_matches_strided(self):
+        a, b = self._make_pair(stride=2)
+        x = _rand(12, (4, 8, 8, 32))
+        np.testing.assert_allclose(a.train_mode()(x), b.train_mode()(x),
+                                   rtol=3e-5, atol=3e-5)
+
+    def test_running_stats_match(self):
+        a, b = self._make_pair()
+        x = _rand(13, (4, 8, 8, 32))
+        a.train_mode()(x)
+        b.train_mode()(x)
+        for bn in ("bn1", "bn2", "bn3"):
+            np.testing.assert_allclose(
+                getattr(a, bn).running_mean, getattr(b, bn).running_mean,
+                rtol=1e-4, atol=1e-5, err_msg=bn)
+            np.testing.assert_allclose(
+                getattr(a, bn).running_var, getattr(b, bn).running_var,
+                rtol=1e-4, atol=1e-5, err_msg=bn)
+
+    def test_gradients_match_unfused(self):
+        from bigdl_tpu.core.module import partition, combine
+        a, b = self._make_pair()
+        x = _rand(14, (4, 8, 8, 32))
+
+        def loss_of(mod):
+            params, rest = partition(mod.train_mode())
+
+            def loss(params, x):
+                m = combine(params, rest)
+                return jnp.sum(m(x) ** 2)
+            return params, loss
+
+        pa, la = loss_of(a)
+        pb, lb = loss_of(b)
+        ga = jax.grad(la)(pa, x)
+        gb = jax.grad(lb)(pb, x)
+        la_, lb_ = jax.tree_util.tree_leaves(ga), jax.tree_util.tree_leaves(gb)
+        assert len(la_) == len(lb_)
+        for u, v in zip(la_, lb_):
+            np.testing.assert_allclose(u, v, rtol=5e-4, atol=5e-4)
+
+    def test_eval_mode_ignores_fused(self):
+        a, b = self._make_pair()
+        x = _rand(15, (2, 8, 8, 32))
+        np.testing.assert_allclose(a.eval_mode()(x), b.eval_mode()(x),
+                                   rtol=1e-6, atol=1e-6)
+
+    def test_env_kill_switch(self, monkeypatch):
+        _, b = self._make_pair()
+        monkeypatch.setenv("BIGDL_TPU_FUSED_CONVBN", "0")
+        assert b.train_mode()._fused_selection() is None
+
+    def test_env_subset(self, monkeypatch):
+        _, b = self._make_pair()
+        monkeypatch.setenv("BIGDL_TPU_FUSED_CONVBN", "conv3")
+        assert b.train_mode()._fused_selection() == {"conv3"}
+
+
+class TestFusedResNet50Slice:
+    def test_resnet_fused_flag_trains(self):
+        """A short jitted train step on a fused CIFAR-scale bottleneck
+        stack — the integration path the perf harness uses."""
+        from bigdl_tpu.models.resnet import ResNet, Bottleneck
+        model = ResNet(Bottleneck, [1, 1], class_num=10, cifar=True,
+                       fused="force")
+        # cifar path uses BasicBlock normally; build directly with
+        # Bottleneck to exercise the fused blocks
+        x = _rand(20, (8, 8, 8, 3))
+        out = model.train_mode()(x)
+        assert out.shape == (8, 10)
+        assert bool(jnp.isfinite(out).all())
