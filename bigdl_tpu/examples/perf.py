@@ -43,7 +43,8 @@ def build(name: str, args):
                     rng.integers(1, 11, size=(b,)))
         return models.LeNet5(10), nn.ClassNLLCriterion(), mnist_batch
     if name == "resnet50":
-        return (models.resnet50(args.classes),
+        return (models.resnet50(args.classes,
+                                fused=getattr(args, "fused", False)),
                 nn.CrossEntropyCriterion(), image_batch)
     if name == "inception-v1":
         # both inception towers end in log_softmax: ClassNLL consumes
@@ -299,6 +300,9 @@ def main(argv=None, emit=True):
     p.add_argument("--num-layers", type=int, default=4)
     p.add_argument("--num-heads", type=int, default=4)
     p.add_argument("--remat", action="store_true")
+    p.add_argument("--fused", action="store_true",
+                   help="resnet50: fused conv+BN+ReLU Pallas bottleneck "
+                        "path (TPU; falls back to plain off-TPU)")
     p.add_argument("--bf16", action="store_true")
     p.add_argument("--learning-rate", type=float, default=0.01)
     p.add_argument("--generate", type=int, default=0, metavar="N",

@@ -12,17 +12,28 @@ from __future__ import annotations
 
 from typing import Optional
 
-__all__ = ["compiled_flops"]
+__all__ = ["compiled_flops", "compiled_bytes"]
+
+
+def _cost_dict(compiled) -> dict:
+    try:
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        return cost or {}
+    except Exception:
+        return {}
 
 
 def compiled_flops(compiled) -> Optional[float]:
     """FLOPs of an AOT-compiled executable per invocation, or None when
     cost analysis is unavailable (some backends return nothing)."""
-    try:
-        cost = compiled.cost_analysis()
-        if isinstance(cost, (list, tuple)):
-            cost = cost[0] if cost else {}
-        f = float(cost.get("flops", -1.0)) if cost else -1.0
-        return f if f > 0 else None
-    except Exception:
-        return None
+    f = float(_cost_dict(compiled).get("flops", -1.0))
+    return f if f > 0 else None
+
+
+def compiled_bytes(compiled) -> Optional[float]:
+    """XLA's bytes-accessed estimate per invocation (HBM traffic on
+    TPU), or None when unavailable."""
+    b = float(_cost_dict(compiled).get("bytes accessed", -1.0))
+    return b if b > 0 else None
