@@ -248,8 +248,12 @@ def phase_backend():
         # override war the same way tests/conftest.py does
         jax.config.update("jax_platforms", "cpu")
     else:
-        # single wait sized to leave the headline phases a real chance
-        wait = max(min(200.0, _remaining() - 180.0), 30.0)
+        # wait sized to keep the HALF-wedged recovery window (r04 note:
+        # a tunnel that comes up in 3-4 minutes must not be forfeited;
+        # compile + the raw-step headline still fit the remainder), with
+        # a floor that tolerates a routine ~60s cold init even when the
+        # budget is already thin
+        wait = max(min(260.0, _remaining() - 140.0), 75.0)
         for attempt in (0, 1):
             ok = _probe_backend_subprocess(wait)
             if ok:
@@ -658,8 +662,9 @@ def main():
     # The gate and the deadline both reserve the optimizer loop's
     # budget (~130s): the HEADLINE phase must never be starved by the
     # secondary comparison.
-    if on_tpu and _remaining() > 280.0 and not os.environ.get(
-            "BIGDL_TPU_BENCH_NO_FUSED"):
+    if on_tpu and os.environ.get("BIGDL_TPU_BENCH_NO_FUSED"):
+        RESULT["phases"]["fused_step"] = "skipped (BIGDL_TPU_BENCH_NO_FUSED)"
+    elif on_tpu and _remaining() > 280.0:
         run_phase("fused_step",
                   lambda: phase_fused_step(on_tpu, batch, size),
                   deadline_s=min(150.0, _remaining() - 130.0))

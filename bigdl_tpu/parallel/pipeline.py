@@ -49,7 +49,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from bigdl_tpu.core.module import Module, ModuleList
 
-__all__ = ["gpipe", "Pipeline"]
+__all__ = ["gpipe", "one_f_one_b", "Pipeline"]
 
 # Per-device (inside-shard_map) buffer shapes of the most recent pipeline
 # trace — a debug/test hook (module attrs would pollute the pytree).
@@ -153,6 +153,184 @@ def gpipe(stage_apply: Callable, stacked_params, x, mesh: Mesh,
     specs = jax.tree_util.tree_map(lambda _: P(axis), stacked_params)
     return _run_pipe(apply3, stacked_params, specs, x, mesh, axis,
                      num_microbatches)
+
+
+# ---------------------------------------------------------------------------
+# 1F1B: pipelined TRAINING STEP (fwd + loss + bwd in one schedule)
+# ---------------------------------------------------------------------------
+
+def _1f1b_loop(stage_params, x_loc, y_loc, stage_apply, loss_fn,
+               axis_name: str, m_real: int, s_total: int):
+    """Per-device lockstep 1F1B loop (runs under shard_map).
+
+    Why a separate schedule: ``jax.grad`` THROUGH the gpipe fori_loop
+    stores every tick's residuals — per device that is O(M) microbatch
+    activations plus stage intermediates.  1F1B starts microbatch m's
+    backward the same tick its forward clears the last stage (the loss
+    lives INSIDE the schedule), so a stage needs at most 2(S-1)+1
+    in-flight stage-inputs: a RING of static size R = 2S-1, independent
+    of M.  The backward recomputes the stage forward from the saved
+    input (jax.vjp at backward time — full-remat pipeline, the standard
+    trade: O(S·mb) memory for one extra forward of compute).
+
+    Timing (stage s, microbatch m, S stages): F at tick m+s; loss+its
+    backward at the last stage the SAME tick its F completes
+    (m+S-1); B at stage s at tick m + 2(S-1) - s.  Total ticks
+    M + 2S - 2 — the same (S-1)/(M+S-1) bubble FRACTION as GPipe
+    (each tick does 1F+1B instead of twice the ticks at half the
+    work); the win is memory, not bubble.
+
+    Returns (loss_sum, grads_local, dx_loc): the summed per-microbatch
+    losses (psum'd), this device's stage-parameter cotangents, and the
+    home shard of input cotangents.
+    """
+    me = jax.lax.axis_index(axis_name)
+    chunk = x_loc.shape[0]
+    m_total = chunk * s_total        # static: shapes depend on it
+    ring_n = 2 * s_total - 1
+    ticks = m_total + 2 * s_total - 2
+
+    perm_down = [(i, i + 1) for i in range(s_total - 1)]
+    perm_up = [(i + 1, i) for i in range(s_total - 1)]
+
+    def strip(tree):
+        return jax.tree_util.tree_map(lambda l: l[0], tree)
+
+    params_me = strip(stage_params)
+    carry_f0 = jnp.zeros_like(x_loc[0])
+    ring0 = jnp.zeros((ring_n,) + x_loc.shape[1:], x_loc.dtype)
+    # the bwd carry rides the STAGE-BOUNDARY shape (uniform, like fwd)
+    carry_b0 = jnp.zeros_like(x_loc[0])
+    grads0 = jax.tree_util.tree_map(jnp.zeros_like, params_me)
+    dx_loc0 = jnp.zeros_like(x_loc)
+    LAST_PIPE_SHAPES.update(ring=ring0.shape, ticks_1f1b=ticks)
+
+    def fwd_of(p, xi):
+        return stage_apply(p, xi)
+
+    def tick(t, state):
+        carry_f, carry_b, ring, grads, dx_loc, loss_sum = state
+
+        # ---- forward lane: stage me runs F of microbatch mf = t - me.
+        # The x feed is for STAGE 0's microbatch — a UNIFORM index
+        # (every device must agree on whose microbatch rides the masked
+        # psum; a per-device index would mix different requests)
+        feed_idx = jnp.clip(t, 0, m_total - 1)
+        mine = jax.lax.dynamic_index_in_dim(
+            x_loc, feed_idx % chunk, 0, keepdims=False)
+        feed = jax.lax.psum(
+            jnp.where((me == feed_idx // chunk) & (t < m_total),
+                      mine, 0), axis_name)
+        inp = jnp.where(me == 0, feed, carry_f)
+        # save the stage input (only when this device's F is real)
+        mf = t - me
+        f_valid = (mf >= 0) & (mf < m_total)
+        slot = jnp.clip(mf, 0, m_total - 1) % ring_n
+        old = jax.lax.dynamic_index_in_dim(ring, slot, 0, keepdims=False)
+        ring = jax.lax.dynamic_update_index_in_dim(
+            ring, jnp.where(f_valid, inp, old), slot, 0)
+        out_f = fwd_of(params_me, inp)
+
+        # ---- loss at the last stage, same tick as its F.  The target
+        # feed is for STAGE S-1's microbatch — again a uniform index
+        last_mb = t - (s_total - 1)
+        last_idx = jnp.clip(last_mb, 0, m_total - 1)
+        y_mine = jax.lax.dynamic_index_in_dim(
+            y_loc, last_idx % chunk, 0, keepdims=False)
+        y_feed = jax.lax.psum(
+            jnp.where(me == last_idx // chunk, y_mine, 0), axis_name)
+        # at stage S-1, B(m) shares the tick with F(m): differentiate
+        # the loss of THIS tick's forward output
+        loss_m, dy_local = jax.value_and_grad(loss_fn)(
+            out_f.astype(jnp.float32), y_feed)
+        loss_sum = loss_sum + jnp.where(
+            (last_mb >= 0) & (last_mb < m_real) & (me == s_total - 1),
+            loss_m, 0.0)
+
+        # ---- backward lane: B of microbatch mb from the saved input
+        mb = t - (2 * (s_total - 1) - me)
+        b_valid = (mb >= 0) & (mb < m_real)
+        mb_c = jnp.clip(mb, 0, m_total - 1)
+        cot = jnp.where(me == s_total - 1,
+                        dy_local.astype(carry_b.dtype), carry_b)
+        cot = jnp.where(b_valid, cot, 0)
+        saved = jax.lax.dynamic_index_in_dim(
+            ring, mb_c % ring_n, 0, keepdims=False)
+        _, pull = jax.vjp(fwd_of, params_me, saved)
+        dp, dxi = pull(cot.astype(out_f.dtype))
+        grads = jax.tree_util.tree_map(jnp.add, grads, dp)
+
+        # stage 0's dxi is the pipeline-input cotangent: home it with
+        # the uniform STAGE-0 backward index
+        dx_mb = t - 2 * (s_total - 1)
+        dx_idx = jnp.clip(dx_mb, 0, m_total - 1)
+        dx_bcast = jax.lax.psum(
+            jnp.where(me == 0, dxi, 0), axis_name)
+        hslot = dx_idx % chunk
+        old_dx = jax.lax.dynamic_index_in_dim(dx_loc, hslot, 0,
+                                              keepdims=False)
+        dx_loc = jax.lax.dynamic_update_index_in_dim(
+            dx_loc, jnp.where((dx_mb >= 0) & (dx_mb < m_real)
+                              & (me == dx_idx // chunk),
+                              dx_bcast, old_dx), hslot, 0)
+
+        carry_f = jax.lax.ppermute(out_f, axis_name, perm_down)
+        carry_b = jax.lax.ppermute(dxi, axis_name, perm_up)
+        return carry_f, carry_b, ring, grads, dx_loc, loss_sum
+
+    _, _, _, grads, dx_loc, loss_sum = jax.lax.fori_loop(
+        0, ticks, tick, (carry_f0, carry_b0, ring0, grads0, dx_loc0,
+                         jnp.float32(0.0)))
+    return jax.lax.psum(loss_sum, axis_name), grads, dx_loc
+
+
+def one_f_one_b(stage_apply: Callable, loss_fn: Callable, stacked_params,
+                x, targets, mesh: Mesh, axis: str = "pipe",
+                num_microbatches: int = 1):
+    """Pipelined training step with the 1F1B schedule.
+
+    stage_apply(stage_params, x_mb) -> y_mb applies one stage;
+    loss_fn(last_out_mb, target_mb) -> scalar per-microbatch loss;
+    stacked_params has a leading stage axis S = mesh.shape[axis].
+
+    Returns (loss, grads, dx): loss = mean over microbatches;
+    grads = stacked [S, ...] parameter cotangents of the MEAN loss;
+    dx [B, ...] input cotangents.  Unlike :func:`gpipe` + ``jax.grad``
+    (which stashes O(M) tick residuals under autodiff), per-device
+    activation memory is the 2S-1 slot ring — asserted in
+    tests/test_parallel.py.
+    """
+    s = mesh.shape[axis]
+    b = x.shape[0]
+    m = num_microbatches
+    assert b % m == 0, (b, m)
+    x_mb = x.reshape((m, b // m) + x.shape[1:])
+    t_mb = targets.reshape((m, b // m) + targets.shape[1:])
+    m_pad = -m % s
+    if m_pad:
+        x_mb = jnp.concatenate(
+            [x_mb, jnp.zeros((m_pad,) + x_mb.shape[1:], x_mb.dtype)], 0)
+        t_mb = jnp.concatenate(
+            [t_mb, jnp.zeros((m_pad,) + t_mb.shape[1:], t_mb.dtype)], 0)
+
+    specs = jax.tree_util.tree_map(lambda _: P(axis), stacked_params)
+    fn = jax.shard_map(
+        functools.partial(_1f1b_loop, stage_apply=stage_apply,
+                          loss_fn=loss_fn, axis_name=axis, m_real=m,
+                          s_total=s),
+        mesh=mesh,
+        in_specs=(specs, P(axis), P(axis)),
+        out_specs=(P(), specs, P(axis)),
+        check_vma=False,
+    )
+    loss_sum, grads, dx_mb = fn(stacked_params, x_mb, t_mb)
+    # mean over the real microbatches; grads follow the same scale.
+    # shard_map concatenates the per-device (stripped) grad trees along
+    # the leading axis — restore the [S, ...] stacked layout
+    grads = jax.tree_util.tree_map(
+        lambda g, p: (g / m).reshape(p.shape), grads, stacked_params)
+    dx = dx_mb[:m].reshape((b,) + dx_mb.shape[2:]) / m
+    return loss_sum / m, grads, dx
 
 
 class Pipeline(Module):
@@ -265,6 +443,38 @@ class Pipeline(Module):
 
         return gpipe(stage_apply, stacked, x, mesh, axis,
                      self.num_microbatches)
+
+    def train_step_on_mesh(self, x, targets, loss_fn, mesh: Mesh = None,
+                           axis: str = None, ):
+        """1F1B pipelined training step: ``(loss, grads, dx)`` where
+        grads is the stacked [S, per_stage, ...] parameter-cotangent
+        pytree of the mean-over-microbatches loss (see
+        :func:`one_f_one_b`).  Requires the homogeneous stacked layout —
+        the memory benefit is pointless with replicated parameters."""
+        mesh = mesh if mesh is not None else self.pipe_mesh
+        axis = axis if axis is not None else self.pipe_axis
+        if not self._blocks_homogeneous():
+            raise NotImplementedError(
+                "1F1B needs the stacked (homogeneous) stage layout; "
+                "group blocks into structurally-equal stages")
+        s = mesh.shape[axis]
+        n = len(self.blocks)
+        assert n % s == 0, (n, s)
+        per_stage = n // s
+
+        def stage_apply(stage_tree, x_mb):
+            def one(i, acc):
+                blk = jax.tree_util.tree_map(
+                    lambda l: jax.lax.dynamic_index_in_dim(
+                        l, i, 0, keepdims=False), stage_tree)
+                return blk(acc)
+            return jax.lax.fori_loop(0, per_stage, one, x_mb)
+
+        stacked = jax.tree_util.tree_map(
+            lambda l: l.reshape((s, per_stage) + l.shape[1:]),
+            self._stacked())
+        return one_f_one_b(stage_apply, loss_fn, stacked, x, targets,
+                           mesh, axis, self.num_microbatches)
 
     def _forward_hetero(self, x, groups, mesh, axis, s):
         """Structurally-different stages: one lax.switch over per-stage

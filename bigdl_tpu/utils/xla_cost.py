@@ -25,15 +25,21 @@ def _cost_dict(compiled) -> dict:
         return {}
 
 
+def _cost_value(compiled, key: str) -> Optional[float]:
+    try:
+        v = float(_cost_dict(compiled).get(key, -1.0))
+    except Exception:  # non-numeric entry: unavailable, not fatal
+        return None
+    return v if v > 0 else None
+
+
 def compiled_flops(compiled) -> Optional[float]:
     """FLOPs of an AOT-compiled executable per invocation, or None when
     cost analysis is unavailable (some backends return nothing)."""
-    f = float(_cost_dict(compiled).get("flops", -1.0))
-    return f if f > 0 else None
+    return _cost_value(compiled, "flops")
 
 
 def compiled_bytes(compiled) -> Optional[float]:
     """XLA's bytes-accessed estimate per invocation (HBM traffic on
     TPU), or None when unavailable."""
-    b = float(_cost_dict(compiled).get("bytes accessed", -1.0))
-    return b if b > 0 else None
+    return _cost_value(compiled, "bytes accessed")
