@@ -958,13 +958,17 @@ class Optimizer:
                         lookahead[0:0] = group[1:]
                         group = group[:1]
                     saw_batches = True
+                    nproc = jax.process_count()
                     for b in group:
-                        if b.size() % n_data:
+                        # b.size() is the PER-PROCESS batch; the global
+                        # batch this step consumes is nproc shards of it
+                        if (b.size() * nproc) % n_data:
                             raise ValueError(
-                                f"global batch size {b.size()} is not "
-                                f"divisible by the mesh's data-parallel "
-                                f"extent {n_data}; choose a batch size "
-                                f"that is a multiple of it")
+                                f"global batch size {b.size() * nproc} "
+                                f"({b.size()} per process x {nproc}) is "
+                                f"not divisible by the mesh's "
+                                f"data-parallel extent {n_data}; choose "
+                                f"a batch size that is a multiple of it")
                     if (self.profile_dir and not prof_active
                             and not prof_done
                             and self.state["neval"] >= prof_start):
@@ -1219,10 +1223,33 @@ def _batch_sig(b):
                   for l in leaves))
 
 
+def _put_sharded(arr, sharding):
+    """Host batch → global device array.  Single-process: device_put.
+    Multi-process (jax.distributed): each host holds only ITS shard of
+    the global batch (DistributedDataSet), so the global array must be
+    assembled from per-process locals — device_put would misread the
+    local shard as the whole global value.  ≙ the reference's
+    per-partition Sample batches feeding one logical DistriOptimizer
+    step (optim/DistriOptimizer.scala taskData)."""
+    if jax.process_count() > 1:
+        return jax.make_array_from_process_local_data(
+            sharding, np.asarray(arr))
+    return jax.device_put(jnp.asarray(arr), sharding)
+
+
 def _stage_window(vals, sharding=None):
     """Stack per-iteration batch pytrees on a new leading axis (window
     dim) and stage to the device; the window dim is unsharded, the batch
-    dim keeps the data-parallel sharding."""
+    dim keeps the data-parallel sharding.  Multi-process runs stack on
+    the host (make_array_from_process_local_data needs host locals);
+    single-process keeps the on-device stack so device-cached batches
+    never round-trip through the host."""
+    multi = jax.process_count() > 1
+    if sharding is not None and multi:
+        return jax.tree_util.tree_map(
+            lambda *ls: _put_sharded(np.stack([np.asarray(l)
+                                               for l in ls]), sharding),
+            *vals)
     stacked = jax.tree_util.tree_map(
         lambda *ls: jnp.stack([jnp.asarray(l) for l in ls]), *vals)
     if sharding is not None:
@@ -1238,8 +1265,9 @@ def _stage(value, sharding=None):
         return None
 
     def put(leaf):
-        arr = jnp.asarray(leaf)
-        return arr if sharding is None else jax.device_put(arr, sharding)
+        if sharding is None:
+            return jnp.asarray(leaf)
+        return _put_sharded(leaf, sharding)
 
     return jax.tree_util.tree_map(put, value)
 

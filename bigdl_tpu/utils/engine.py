@@ -115,7 +115,8 @@ class Engine:
     @classmethod
     def init_distributed(cls, coordinator_address: Optional[str] = None,
                          num_processes: Optional[int] = None,
-                         process_id: Optional[int] = None) -> None:
+                         process_id: Optional[int] = None,
+                         timeout_s: Optional[float] = None) -> None:
         """Bootstrap the multi-host runtime (≙ the reference's cluster
         init: Engine.init parsing the Spark master + AllReduceParameter
         port setup — here it is ``jax.distributed.initialize``, which
@@ -155,11 +156,18 @@ class Engine:
                 cls._state.dist_inited = True
                 return
             import jax
+            kw = {}
+            if timeout_s is not None:
+                # surface dead-coordinator failures in bounded time
+                # (jax's default handshake timeout is 300s); floor at
+                # 1s so a sub-second request doesn't truncate to an
+                # already-expired deadline
+                kw["initialization_timeout"] = max(1, round(timeout_s))
             try:
                 jax.distributed.initialize(
                     coordinator_address=coordinator_address,
                     num_processes=num_processes,
-                    process_id=process_id)
+                    process_id=process_id, **kw)
             except RuntimeError as e:
                 # already initialized elsewhere (e.g. by the launcher):
                 # jax phrases this "should only be called once" (0.9's

@@ -1,0 +1,99 @@
+"""Worker process for the 2-process ``jax.distributed`` loopback test
+(tests/test_distributed_multiprocess.py) — NOT a test module.
+
+Each worker: CPU backend with 2 virtual devices, Engine.init_distributed
+over the loopback coordinator, a deterministic per-process data shard
+(DistributedDataSet), a real Optimizer.optimize() over the 4-device
+global mesh with orbax sharded checkpoints, then a resume leg that
+continues training from the sharded checkpoint.  Process 0 writes the
+final (replicated) parameters for the parent test to compare against a
+single-process run — the analog of the reference running its full
+distributed loop on a local SparkContext
+(reference: optim/DistriOptimizerSpec.scala:139).
+
+argv: <port> <process_id> <num_processes> <outdir>
+"""
+
+import os
+import sys
+
+
+def build_samples():
+    import numpy as np
+    rng = np.random.default_rng(7)
+    n = 32
+    xs = rng.normal(size=(n, 12)).astype(np.float32)
+    w = rng.normal(size=(12,))
+    ys = (xs @ w > 0).astype(np.int64) + 1  # labels 1/2, reference style
+    return xs, ys
+
+
+def main():
+    port, pid, nproc, outdir = (sys.argv[1], int(sys.argv[2]),
+                                int(sys.argv[3]), sys.argv[4])
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from bigdl_tpu.utils.engine import Engine
+    Engine.init_distributed(f"127.0.0.1:{port}", nproc, pid,
+                            timeout_s=60)
+    assert jax.process_count() == nproc, jax.process_count()
+    assert len(jax.devices()) == 2 * nproc
+    assert Engine.node_number() == nproc
+
+    import numpy as np
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.dataset import DataSet, Sample, SampleToMiniBatch
+    from bigdl_tpu.optim import Optimizer, Trigger
+    from bigdl_tpu.optim.methods import SGD
+    from bigdl_tpu.utils import set_seed
+
+    xs, ys = build_samples()
+    samples = [Sample(xs[i], ys[i]) for i in range(len(xs))]
+    # per-process shard of the GLOBAL sample list (round-robin), local
+    # minibatches of 4 -> global batches of 8 assembled by
+    # make_array_from_process_local_data inside the Optimizer
+    ds = (DataSet.sharded(samples, shuffle=False,
+                            process_index=pid, process_count=nproc)
+          .transform(SampleToMiniBatch(4)))
+    assert ds.size() == len(samples)
+
+    def make_model():
+        set_seed(123)
+        return nn.Sequential(nn.Linear(12, 16), nn.Tanh(),
+                             nn.Linear(16, 2))
+
+    ckdir = os.path.join(outdir, "ck")
+    os.makedirs(ckdir, exist_ok=True)
+
+    # leg 1: epochs 1-3 with per-epoch sharded checkpoints
+    opt = (Optimizer(make_model(), ds, nn.CrossEntropyCriterion())
+           .set_optim_method(SGD(0.1))
+           .set_end_when(Trigger.max_epoch(3))
+           .set_checkpoint(ckdir, Trigger.every_epoch(), sharded=True))
+    opt.optimize()
+
+    # leg 2: resume from the sharded checkpoint, continue to epoch 5
+    opt2 = (Optimizer(make_model(), ds, nn.CrossEntropyCriterion())
+            .set_optim_method(SGD(0.1))
+            .set_end_when(Trigger.max_epoch(5))
+            .resume(os.path.join(ckdir, "checkpoint.orbax")))
+    trained = opt2.optimize()
+    assert opt2.state["epoch"] == 6, opt2.state  # ran epochs 4 and 5
+
+    if pid == 0:
+        flat = {
+            jax.tree_util.keystr(path): np.asarray(v)  # replicated
+            for path, v in jax.tree_util.tree_flatten_with_path(
+                trained.parameters())[0]
+        }
+        np.savez(os.path.join(outdir, "params.npz"), **flat)
+        with open(os.path.join(outdir, "ok"), "w") as f:
+            f.write("done")
+    # all processes must exit cleanly for the parent to pass
+    print(f"worker {pid}: done", flush=True)
+
+
+if __name__ == "__main__":
+    main()
