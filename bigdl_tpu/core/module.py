@@ -197,23 +197,30 @@ class Module:
         if name in ("training", "name"):
             object.__setattr__(self, name, value)
             return
-        # remove from previous slot if re-assigned with different kind
-        for d in (self._params, self._buffers, self._modules, self._static):
-            d.pop(name, None)
+        # Remove from previous slot ONLY if re-assigned with a different
+        # kind.  Same-kind re-assignment updates in place: dict order is
+        # pytree STRUCTURE, so a pop-and-reinsert would make the tree
+        # definition depend on which forward path assigned a buffer
+        # last (e.g. MoE.aux_loss/drop_rate) — a jit cache-miss-or-error
+        # class of bug.
         if isinstance(value, Parameter):
-            self._params[name] = value.value
+            target, stored = self._params, value.value
         elif _is_array(value):
-            self._buffers[name] = jnp.asarray(value)
+            target, stored = self._buffers, jnp.asarray(value)
         elif isinstance(value, (Module, ModuleList)):
-            self._modules[name] = value
+            target, stored = self._modules, value
         elif isinstance(value, (list, tuple)) and value and \
                 all(isinstance(v, Module) for v in value):
-            self._modules[name] = ModuleList(list(value))
+            target, stored = self._modules, ModuleList(list(value))
         else:
             if isinstance(value, list):
                 # static aux must be hashable for jit caching
                 value = tuple(value)
-            self._static[name] = value
+            target, stored = self._static, value
+        for d in (self._params, self._buffers, self._modules, self._static):
+            if d is not target:
+                d.pop(name, None)
+        target[name] = stored
         object.__setattr__(self, name, _SENTINEL)
 
     def __getattribute__(self, name):

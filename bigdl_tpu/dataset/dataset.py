@@ -132,6 +132,14 @@ class LocalDataSet:
             it = t(it)
         return it
 
+    def per_process_sharded(self) -> bool:
+        """Whether each process holds only ITS shard of the global data
+        (DistributedDataSet).  Multi-process training requires this —
+        the Optimizer assembles global batches from per-process locals
+        and a replicated dataset would silently duplicate every
+        sample process_count times."""
+        return False
+
     def cache_on_device(self, sharding=None) -> "DeviceCachedDataSet":
         """Cache the post-transform minibatch stream in device memory so
         epochs after the first pay zero host->HBM transfer.  TPU-native
@@ -157,6 +165,9 @@ class DeviceCachedDataSet:
 
     def size(self) -> int:
         return self._inner.size()
+
+    def per_process_sharded(self) -> bool:
+        return self._inner.per_process_sharded()
 
     def _put(self, memo, value):
         import jax
@@ -211,3 +222,6 @@ class DistributedDataSet(LocalDataSet):
 
     def size(self) -> int:
         return self._global_size
+
+    def per_process_sharded(self) -> bool:
+        return True

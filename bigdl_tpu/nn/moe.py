@@ -65,6 +65,9 @@ class MoE(Module):
         self.experts = ModuleList(experts)
         self.gate = Linear(hidden_size, self.num_experts, with_bias=False)
         self.aux_loss = jnp.zeros(())
+        # overflow-drop fraction of the last a2a forward (0 on the
+        # dense/psum paths, which never drop)
+        self.drop_rate = jnp.zeros(())
         self.expert_mesh = None
         self.expert_axis = "expert"
         self.capacity_factor = None
@@ -130,6 +133,9 @@ class MoE(Module):
     # -- dense path --------------------------------------------------------
 
     def forward(self, x):
+        # reset so the telemetry never carries a stale a2a value onto a
+        # path that cannot drop (comment contract at __init__)
+        self.drop_rate = jnp.zeros(())
         if self.expert_mesh is not None:
             return self.forward_on_mesh(x, self.expert_mesh,
                                         self.expert_axis)
@@ -154,6 +160,7 @@ class MoE(Module):
         dispatch = jnp.zeros((S, E, capacity), jnp.float32)
         combine = jnp.zeros((S, E, capacity), jnp.float32)
         counts = jnp.zeros((E,), jnp.int32)
+        kept = jnp.zeros((), jnp.float32)
         for slot in range(self.top_k):
             mask = jax.nn.one_hot(top_idx[:, slot], E,
                                   dtype=jnp.int32)       # [S, E]
@@ -161,15 +168,23 @@ class MoE(Module):
             pos = jnp.sum(pos_e * mask, axis=1)          # [S]
             counts = counts + jnp.sum(mask, axis=0)
             keep = (pos < capacity).astype(jnp.float32)  # overflow drop
+            kept = kept + jnp.sum(keep)
             slot_hot = (mask.astype(jnp.float32)[:, :, None]
                         * jax.nn.one_hot(pos, capacity)[:, None, :]
                         * keep[:, None, None])           # [S, E, C]
             dispatch = dispatch + slot_hot
             w = (top_vals[:, slot] / denom[:, 0])
             combine = combine + slot_hot * w[:, None, None]
-        return dispatch, combine
+        # fraction of routed (token, slot) assignments that overflowed
+        # this shard's per-expert capacity — the telemetry the reference
+        # never needed (its MoE is single-node); exposed via
+        # ``self.drop_rate`` so training loops can watch whether the
+        # aux loss is balancing load well enough
+        drop_rate = 1.0 - kept / (S * self.top_k)
+        return dispatch, combine, drop_rate
 
     def forward_on_mesh(self, x, mesh: Mesh, axis: str = "expert"):
+        self.drop_rate = jnp.zeros(())  # psum path cannot drop
         if self.capacity_factor is not None:
             return self._forward_a2a(x, mesh, axis, self.capacity_factor)
         return self._forward_psum(x, mesh, axis)
@@ -204,7 +219,8 @@ class MoE(Module):
 
         def shard_fn(stacked_local, x_loc, p_loc):
             # x_loc [S, H]; p_loc [S, E]; stacked_local leaves [E/n, ...]
-            dispatch, combine = moe._dispatch_combine(p_loc, capacity)
+            dispatch, combine, drop = moe._dispatch_combine(p_loc,
+                                                            capacity)
             expert_in = jnp.einsum("sec,sh->ech", dispatch,
                                    x_loc.astype(jnp.float32))  # [E, C, H]
             expert_in = expert_in.astype(x_loc.dtype)
@@ -222,14 +238,17 @@ class MoE(Module):
             # back [E, C, H]
             y = jnp.einsum("sec,ech->sh", combine,
                            back.astype(jnp.float32))
-            return y.astype(x_loc.dtype)
+            return (y.astype(x_loc.dtype),
+                    jax.lax.pmean(drop, axis))
 
         fn = jax.shard_map(
             shard_fn, mesh=mesh,
             in_specs=(jax.tree_util.tree_map(lambda _: P(axis), stacked),
                       P(axis), P(axis)),
-            out_specs=P(axis), check_vma=False)
-        return fn(stacked, xf, pf).reshape(B, T, H)
+            out_specs=(P(axis), P()), check_vma=False)
+        y, drop = fn(stacked, xf, pf)
+        self.drop_rate = jax.lax.stop_gradient(drop)
+        return y.reshape(B, T, H)
 
     def _forward_psum(self, x, mesh: Mesh, axis: str = "expert"):
         n = mesh.shape[axis]

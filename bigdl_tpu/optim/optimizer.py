@@ -592,6 +592,13 @@ class Optimizer:
         from bigdl_tpu.core.module import param_paths
         mesh = self.mesh_config.build()
         model = self.model.train_mode()
+        if jax.process_count() > 1 and not getattr(
+                self.dataset, "per_process_sharded", lambda: False)():
+            raise ValueError(
+                "multi-process training needs a per-process-sharded "
+                "dataset (DataSet.sharded); a replicated dataset would "
+                "silently feed every sample process_count times per "
+                "epoch")
 
         from bigdl_tpu.utils.file import is_sharded_checkpoint_path
         resume_sharded = bool(self._resume_from) \
@@ -913,8 +920,9 @@ class Optimizer:
                 return 1
             st = dict(self.state)
             st["is_epoch_end"] = False
+            nproc_ = jax.process_count()
             for i in range(w):
-                st["records"] += sizes[i]
+                st["records"] += sizes[i] * nproc_
                 st["neval"] += 1
                 if ((self.val_trigger is not None
                      and self.val_trigger(st))
@@ -1037,7 +1045,8 @@ class Optimizer:
                     self.metrics.add("data load and transfer", t_data)
                     window["data_t"] += t_data
                     for b, loss_i in zip(group, loss_list):
-                        n = b.size()
+                        # records are GLOBAL: b.size() is per-process
+                        n = b.size() * nproc
                         self.state["records"] += n
                         pending.append((self.state["neval"], epoch, n,
                                         self.state["records"], loss_i))

@@ -119,3 +119,34 @@ def test_forward_context_rng():
     assert not has_rng()
     with pytest.raises(RuntimeError):
         next_rng_key()
+
+
+def test_buffer_reassignment_keeps_pytree_structure():
+    """Same-kind attribute re-assignment must update in place: dict
+    order is pytree STRUCTURE, so if different forward paths assign
+    buffers in different orders the module's treedef would flip between
+    jit traces (observed with MoE.aux_loss/drop_rate)."""
+    import jax
+    import jax.numpy as jnp
+    from bigdl_tpu.core.module import Module
+
+    class M(Module):
+        def __init__(self):
+            super().__init__()
+            self.a = jnp.zeros(())
+            self.b = jnp.zeros(())
+
+        def forward(self, x, path=0):
+            if path:
+                self.b = jnp.sum(x)
+                self.a = jnp.sum(x) * 2
+            else:
+                self.a = jnp.sum(x)
+            return x
+
+    m = M()
+    t0 = jax.tree_util.tree_structure(m)
+    m.forward(jnp.ones(3), path=0)
+    assert jax.tree_util.tree_structure(m) == t0
+    m.forward(jnp.ones(3), path=1)
+    assert jax.tree_util.tree_structure(m) == t0

