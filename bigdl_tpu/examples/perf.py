@@ -377,6 +377,12 @@ def main(argv=None, emit=True):
         # path feeding DistriOptimizer)
         from bigdl_tpu.examples.imagenet import train_pipeline
         real_tmp = write_jpeg_tree(args.real_jpeg_train)
+        # exceptions anywhere below (or a harness deadline) must not
+        # leak the multi-MB tree: tie cleanup to interpreter exit (a
+        # SIGKILL leaks regardless; a finally would too)
+        import atexit
+        import shutil
+        atexit.register(shutil.rmtree, real_tmp, ignore_errors=True)
         data, n_classes, _ = train_pipeline(
             real_tmp, args.image_size, args.batch_size,
             workers=args.workers)
@@ -450,9 +456,6 @@ def main(argv=None, emit=True):
         out["warning"] = ("single dispatch window: time includes "
                           "compile; run more iterations/epochs for "
                           "steady-state numbers")
-    if real_tmp:
-        import shutil
-        shutil.rmtree(real_tmp, ignore_errors=True)
     if emit:
         print(json.dumps(out), flush=True)
     return out

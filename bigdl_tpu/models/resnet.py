@@ -57,9 +57,11 @@ class Bottleneck(Module):
     ``fused=True`` (or env BIGDL_TPU_FUSED_CONVBN) routes the training
     forward through the fused conv+BN+ReLU Pallas kernels
     (ops/conv_bn_kernels.py): the 1x1 convs run as matmul kernels whose
-    epilogue accumulates the following BN's batch statistics, and
-    conv3's kernel applies bn2's normalize+ReLU on the fly — the
-    normalized activation between conv2 and conv3 never touches HBM.
+    epilogue accumulates the following BN's batch statistics; the
+    stride-1 3x3 conv2 runs as the 9-shift kernel with bn1's
+    normalize+ReLU applied on the fly; conv3's kernel applies bn2's the
+    same way — the normalized activations inside the block never touch
+    HBM (strided conv2 keeps the XLA emitter).
     Numerics match the unfused path (same rounding points; test-locked).
     Eval mode, non-NHWC, and non-TPU backends fall back to the plain
     path (``fused="force"`` or env "force" overrides the backend check
@@ -86,13 +88,14 @@ class Bottleneck(Module):
         self.has_down = stride != 1 or nin != nout
         self.fused = fused
 
-    _FUSABLE = frozenset({"conv1", "conv3"})
+    _FUSABLE = frozenset({"conv1", "conv2", "conv3"})
 
     def _fused_selection(self):
         """Which convs to fuse.  env BIGDL_TPU_FUSED_CONVBN may be "0"
         (off everywhere), "1" (default set), "force" (fuse even off-TPU,
         via the interpret-mode kernels — tests/debug only), or a comma
-        list drawn from {conv1, conv3} (optionally with "force").
+        list drawn from {conv1, conv2, conv3} (optionally with
+        "force").
 
         Off-TPU the kernels only run in Pallas interpret mode — orders
         of magnitude slower than XLA — so without an explicit "force"
@@ -160,15 +163,28 @@ class Bottleneck(Module):
             y1 = self.conv1(x)
             d1, q1 = self.bn1.batch_stats(y1)
             mean1, var1 = self.bn1.fold_stats(d1, q1, m1)
-        z1 = jax.nn.relu(self.bn1.normalize(y1, mean1, var1))
-
-        # conv2: 3x3 (and any stride) stays on the XLA conv emitter;
-        # only its BN statistics are computed here so that bn2's
-        # normalize+relu can ride conv3's kernel instead of a
-        # materialized elementwise pass
-        y2 = self.conv2(z1)
-        d2, q2 = self.bn2.batch_stats(y2)
-        mean2, var2 = self.bn2.fold_stats(d2, q2, self.bn2.stat_count(y2))
+        # conv2: stride-1 3x3 goes through the fused 9-shift Pallas
+        # kernel with bn1's normalize+relu applied on the fly (z1 never
+        # materialized in that case) and bn2's stats as the epilogue;
+        # strided conv2 (first block of a stage) stays on the XLA conv
+        # emitter with only its BN statistics computed here
+        stride1 = self.conv2.stride == (1, 1)
+        w2 = self.conv2.weight
+        if ("conv2" in sel and stride1
+                and ck.fused_conv3x3_supported(
+                    y1.shape[1], y1.shape[2], y1.shape[3], w2.shape[-1],
+                    y1.dtype.itemsize)):
+            y2, u1, u2 = ck.fused_conv3x3_bn(
+                y1, w2, norm=norm_vectors(self.bn1, mean1, var1),
+                kshift=stop(self.bn2.running_mean), interpret=interp)
+            m2n = self.bn2.stat_count(y2)
+            mean2, var2 = self.bn2.fold_stats(u1 / m2n, u2 / m2n, m2n)
+        else:
+            z1 = jax.nn.relu(self.bn1.normalize(y1, mean1, var1))
+            y2 = self.conv2(z1)
+            d2, q2 = self.bn2.batch_stats(y2)
+            mean2, var2 = self.bn2.fold_stats(d2, q2,
+                                              self.bn2.stat_count(y2))
 
         bb, hh, ww, p = y2.shape
         w3 = self.conv3.weight[0, 0]

@@ -61,7 +61,9 @@ except Exception:  # pragma: no cover
     pltpu = None
 
 __all__ = ["fused_matmul_bn", "fused_matmul_bn_reference",
-           "fused_block_supported", "shifted_batch_stats"]
+           "fused_block_supported", "fused_conv3x3_bn",
+           "fused_conv3x3_bn_reference", "fused_conv3x3_supported",
+           "shifted_batch_stats"]
 
 _VMEM_BUDGET = 11 * 1024 * 1024  # leave headroom under the ~16MiB VMEM
 
@@ -367,3 +369,375 @@ def fused_matmul_bn(x2d, w2d, *, norm=None, kshift=None,
         mean_in = scale_in = beta_in = _row(None, k)
     ks = _row(kshift, n) if kshift is not None else _row(None, n)
     return _fused_core(x2d, w2d, mean_in, scale_in, beta_in, ks, cfg)
+
+
+# ---------------------------------------------------------------------------
+# 3x3 stride-1 SAME conv with fused input normalize+relu and stats
+# epilogue — the bottleneck's conv2 (conv-as-9-shifted-matmuls; the MXU
+# sees [BH*W, C] x [C, Co] tiles, HBM sees each activation row once).
+# Halo rows ride as two extra 1-row block refs (pallas blocks cannot
+# overlap); image-boundary rows are zero-masked in VMEM, which IS the
+# SAME zero padding.
+# ---------------------------------------------------------------------------
+
+class _Conv3Cfg(NamedTuple):
+    fuse_input: bool
+    emit_stats: bool
+    block_h: int
+    interpret: bool
+
+
+def _pick_block_h(h: int, w: int, c: int, co: int,
+                  itemsize: int) -> Optional[int]:
+    """Block over H.  Resident: w9 (input width) + dW9 (f32) =
+    9*C*Co*(itemsize+4); per row-of-block: the haloed x/z/dy tiles (at
+    the input width) plus the f32 working copies."""
+    resident = 9 * c * co * (itemsize + 4)
+    if resident > _VMEM_BUDGET:
+        return None
+    per_row = w * (c * (2 * itemsize + 8) + co * (itemsize + 8))
+    avail = _VMEM_BUDGET - resident
+    target = (avail // max(per_row, 1)) - 2
+    if target < 1:
+        return None  # even a 1-row block would blow the VMEM budget
+    return _divisor_block(h, min(int(target), h), step=1)
+
+
+def fused_conv3x3_supported(h: int, w: int, c: int, co: int,
+                            itemsize: int = 2) -> bool:
+    return _pick_block_h(h, w, c, co, itemsize) is not None
+
+
+def _nz_rows(x, mean, scale, beta, fuse_input, out_dtype):
+    """normalize+relu rows in f32 registers, rounded to the compute
+    dtype (the same rounding point as the unfused path's materialized
+    activation)."""
+    if not fuse_input:
+        return x
+    u = (x.astype(jnp.float32) - mean) * scale + beta
+    return jax.nn.relu(u).astype(out_dtype)
+
+
+def _wshift(rows, dw):
+    """SAME-padding column shift: output col w consumes input col
+    w + dw - 1."""
+    if dw == 0:
+        pad = jnp.zeros_like(rows[:, :1])
+        return jnp.concatenate([pad, rows[:, :-1]], axis=1)
+    if dw == 2:
+        pad = jnp.zeros_like(rows[:, :1])
+        return jnp.concatenate([rows[:, 1:], pad], axis=1)
+    return rows
+
+
+def _conv3_fwd_kernel(xt_ref, xm_ref, xb_ref, w_ref, mean_ref,
+                      scale_ref, beta_ref, kshift_ref,
+                      y_ref, s1_ref, s2_ref, *, cfg: _Conv3Cfg):
+    i = pl.program_id(1)
+    ni = pl.num_programs(1)
+    first = (pl.program_id(0) == 0) & (i == 0)
+    bh = cfg.block_h
+    dt = xm_ref.dtype
+
+    xm = xm_ref[0]                       # [BH, W, C]
+    xt = xt_ref[0, 0][None]              # [1, W, C]
+    xb = xb_ref[0, 0][None]
+    # boundary rows are zero AFTER normalize+relu (SAME zero padding of
+    # the conv INPUT z, which is the normalized activation)
+    zt = _nz_rows(xt, mean_ref[:], scale_ref[:], beta_ref[:],
+                  cfg.fuse_input, dt) * jnp.where(i > 0, 1, 0).astype(dt)
+    zb = _nz_rows(xb, mean_ref[:], scale_ref[:], beta_ref[:],
+                  cfg.fuse_input, dt) * jnp.where(i < ni - 1, 1,
+                                                  0).astype(dt)
+    zm = _nz_rows(xm, mean_ref[:], scale_ref[:], beta_ref[:],
+                  cfg.fuse_input, dt)
+    z = jnp.concatenate([zt, zm, zb], axis=0)   # [BH+2, W, C]
+
+    w_, c = z.shape[1], z.shape[2]
+    co = w_ref.shape[-1]
+    acc = jnp.zeros((bh * w_, co), jnp.float32)
+    for dh in range(3):
+        rows = z[dh:dh + bh]
+        for dw in range(3):
+            patch = _wshift(rows, dw).reshape(bh * w_, c)
+            acc += jnp.dot(patch, w_ref[dh, dw],
+                           preferred_element_type=jnp.float32)
+    yc = acc.astype(dt).reshape(bh, w_, co)
+    y_ref[0] = yc
+    if cfg.emit_stats:
+        yf = yc.astype(jnp.float32) - kshift_ref[0][None]
+        p1 = jnp.sum(yf, axis=(0, 1), keepdims=False)[None]
+        p2 = jnp.sum(yf * yf, axis=(0, 1), keepdims=False)[None]
+
+        @pl.when(first)
+        def _init():
+            s1_ref[:] = p1
+            s2_ref[:] = p2
+
+        @pl.when(~first)
+        def _acc():
+            s1_ref[:] += p1
+            s2_ref[:] += p2
+
+
+def _conv3_bwd_kernel(xt_ref, xm_ref, xb_ref, w_ref, mean_ref,
+                      scale_ref, beta_ref, kshift_ref,
+                      yt_ref, ym_ref, yb_ref,
+                      dyt_ref, dym_ref, dyb_ref, gm_ref, gs_ref,
+                      dx_ref, dw_ref, dsx_ref, dsu_ref,
+                      *, cfg: _Conv3Cfg):
+    """One pass per block: recompute z (haloed), fold the stats
+    cotangents into dy using the SAVED forward output y (haloed — so
+    halo rows fold exactly without a 2-deep recompute), accumulate the
+    9 dW tiles and the BN-chain channel sums, and produce dx for the
+    block's main rows (complete thanks to the dy halo)."""
+    i = pl.program_id(1)
+    ni = pl.num_programs(1)
+    first = (pl.program_id(0) == 0) & (i == 0)
+    bh = cfg.block_h
+    dt = xm_ref.dtype
+
+    mean, scale, beta = mean_ref[:], scale_ref[:], beta_ref[:]
+    xm = xm_ref[0]
+    top_on = jnp.where(i > 0, 1, 0).astype(dt)
+    bot_on = jnp.where(i < ni - 1, 1, 0).astype(dt)
+    zt = _nz_rows(xt_ref[0, 0][None], mean, scale, beta,
+                  cfg.fuse_input, dt) * top_on
+    zb = _nz_rows(xb_ref[0, 0][None], mean, scale, beta,
+                  cfg.fuse_input, dt) * bot_on
+    zm = _nz_rows(xm, mean, scale, beta, cfg.fuse_input, dt)
+    z = jnp.concatenate([zt, zm, zb], axis=0)      # [BH+2, W, C]
+
+    w_, c = z.shape[1], z.shape[2]
+    co = dym_ref.shape[-1]
+
+    def fold(dy_raw, y_raw):
+        dy = dy_raw.astype(jnp.float32)
+        if cfg.emit_stats:
+            yf = y_raw.astype(jnp.float32)
+            dy = dy + gm_ref[0][None] + gs_ref[0][None] * (
+                yf - kshift_ref[0][None])
+        return dy
+
+    dym = fold(dym_ref[0], ym_ref[0])              # [BH, W, Co] f32
+    dyt = fold(dyt_ref[0, 0][None], yt_ref[0, 0][None]) \
+        * top_on.astype(jnp.float32)
+    dyb = fold(dyb_ref[0, 0][None], yb_ref[0, 0][None]) \
+        * bot_on.astype(jnp.float32)
+    dym_l = dym.astype(dt)
+    dy3 = jnp.concatenate([dyt.astype(dt), dym_l, dyb.astype(dt)],
+                          axis=0)                  # [BH+2, W, Co]
+
+    # dW[dh,dw] += z_patch^T dy_main
+    for dh in range(3):
+        rows = z[dh:dh + bh]
+        for dw in range(3):
+            patch = _wshift(rows, dw).reshape(bh * w_, c)
+            dwp = jax.lax.dot_general(
+                patch, dym_l.reshape(bh * w_, co),
+                (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+
+            @pl.when(first)
+            def _init(dh=dh, dw=dw, dwp=dwp):
+                dw_ref[dh, dw] = dwp
+
+            @pl.when(~first)
+            def _acc(dh=dh, dw=dw, dwp=dwp):
+                dw_ref[dh, dw] += dwp
+
+    # dgrad (transposed conv): dz[r,w] = sum_{dh,dw} dy[r+1-(2-dh),
+    # w+1-(2-dw)] @ w[dh,dw]^T — expressed as the same 9-shift pattern
+    # on the haloed dy with flipped taps and swapped channels
+    dz = jnp.zeros((bh * w_, c), jnp.float32)
+    for dh in range(3):
+        rows = dy3[dh:dh + bh]
+        for dw in range(3):
+            patch = _wshift(rows, dw).reshape(bh * w_, co)
+            dz += jax.lax.dot_general(
+                patch, w_ref[2 - dh, 2 - dw],
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+    dz = dz.reshape(bh, w_, c)
+
+    if cfg.fuse_input:
+        u = (xm.astype(jnp.float32) - mean) * scale + beta
+        du = jnp.where(u > 0, dz, 0.0)
+        px = jnp.sum(du * xm.astype(jnp.float32), axis=(0, 1))[None]
+        pu = jnp.sum(du, axis=(0, 1))[None]
+
+        @pl.when(first)
+        def _inits():
+            dsx_ref[:] = px
+            dsu_ref[:] = pu
+
+        @pl.when(~first)
+        def _accs():
+            dsx_ref[:] += px
+            dsu_ref[:] += pu
+
+        dx = du * scale
+    else:
+        dx = dz
+    dx_ref[0] = dx.astype(dx_ref.dtype)
+
+
+def fused_conv3x3_bn_reference(x4d, w, norm=None, kshift=None):
+    """jnp mirror (same rounding points) of the fused 3x3 op."""
+    if norm is not None:
+        mean, scale, beta = norm
+        xf = x4d.astype(jnp.float32)
+        z = jax.nn.relu((xf - mean) * scale + beta).astype(x4d.dtype)
+    else:
+        z = x4d
+    y = jax.lax.conv_general_dilated(
+        z, w, (1, 1), ((1, 1), (1, 1)),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=jnp.float32).astype(x4d.dtype)
+    if kshift is None:
+        return y
+    s1, s2 = shifted_batch_stats(y, kshift)
+    return y, s1, s2
+
+
+def _conv3_specs(b, h, w_, c, co, bh):
+    main = pl.BlockSpec((1, bh, w_, c), lambda b_, i: (b_, i, 0, 0))
+    top = pl.BlockSpec(
+        (1, 1, w_, c),
+        lambda b_, i: (b_, jnp.maximum(i * bh - 1, 0), 0, 0))
+    bot = pl.BlockSpec(
+        (1, 1, w_, c),
+        lambda b_, i: (b_, jnp.minimum((i + 1) * bh, h - 1), 0, 0))
+    vec_c = pl.BlockSpec((1, c), lambda b_, i: (0, 0))
+    vec_co = pl.BlockSpec((1, co), lambda b_, i: (0, 0))
+    wspec = pl.BlockSpec((3, 3, c, co), lambda b_, i: (0, 0, 0, 0))
+    return main, top, bot, vec_c, vec_co, wspec
+
+
+def _conv3_params():
+    if pltpu is None:
+        return None
+    return pltpu.CompilerParams(
+        dimension_semantics=("arbitrary", "arbitrary"))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6,))
+def _conv3_core(x, w, mean_in, scale_in, beta_in, kshift,
+                cfg: _Conv3Cfg):
+    return _conv3_fwd(x, w, mean_in, scale_in, beta_in, kshift, cfg)[0]
+
+
+def _conv3_fwd(x, w, mean_in, scale_in, beta_in, kshift, cfg: _Conv3Cfg):
+    b, h, w_, c = x.shape
+    co = w.shape[-1]
+    bh = cfg.block_h
+    main, top, bot, vec_c, vec_co, wspec = _conv3_specs(
+        b, h, w_, c, co, bh)
+    ymain = pl.BlockSpec((1, bh, w_, co), lambda b_, i: (b_, i, 0, 0))
+    scal = pl.BlockSpec((1, co), lambda b_, i: (0, 0))
+    outs = [jax.ShapeDtypeStruct((b, h, w_, co), x.dtype),
+            jax.ShapeDtypeStruct((1, co), jnp.float32),
+            jax.ShapeDtypeStruct((1, co), jnp.float32)]
+    y, s1, s2 = pl.pallas_call(
+        functools.partial(_conv3_fwd_kernel, cfg=cfg),
+        grid=(b, h // bh),
+        in_specs=[top, main, bot, wspec, vec_c, vec_c, vec_c, vec_co],
+        out_specs=[ymain, scal, scal],
+        out_shape=outs,
+        compiler_params=_conv3_params(),
+        interpret=cfg.interpret,
+    )(x, x, x, w, mean_in, scale_in, beta_in, kshift)
+    result = (y, s1[0], s2[0]) if cfg.emit_stats else y
+    return result, (x, w, mean_in, scale_in, beta_in, kshift, y)
+
+
+def _conv3_bwd(cfg: _Conv3Cfg, res, ct):
+    x, w, mean_in, scale_in, beta_in, kshift, y = res
+    b, h, w_, c = x.shape
+    co = w.shape[-1]
+    bh = cfg.block_h
+    if cfg.emit_stats:
+        dy, gm, gs = ct
+        gm_row = gm.reshape(1, co).astype(jnp.float32)
+        gs_row = (2.0 * gs).reshape(1, co).astype(jnp.float32)
+    else:
+        dy = ct
+        gm_row = jnp.zeros((1, co), jnp.float32)
+        gs_row = gm_row
+    main, top, bot, vec_c, vec_co, wspec = _conv3_specs(
+        b, h, w_, c, co, bh)
+    ymain = pl.BlockSpec((1, bh, w_, co), lambda b_, i: (b_, i, 0, 0))
+    ytop = pl.BlockSpec(
+        (1, 1, w_, co),
+        lambda b_, i: (b_, jnp.maximum(i * bh - 1, 0), 0, 0))
+    ybot = pl.BlockSpec(
+        (1, 1, w_, co),
+        lambda b_, i: (b_, jnp.minimum((i + 1) * bh, h - 1), 0, 0))
+    dwspec = pl.BlockSpec((3, 3, c, co), lambda b_, i: (0, 0, 0, 0))
+    outs = [jax.ShapeDtypeStruct((b, h, w_, c), x.dtype),
+            jax.ShapeDtypeStruct((3, 3, c, co), jnp.float32),
+            jax.ShapeDtypeStruct((1, c), jnp.float32),
+            jax.ShapeDtypeStruct((1, c), jnp.float32)]
+    dx, dw, dsx, dsu = pl.pallas_call(
+        functools.partial(_conv3_bwd_kernel, cfg=cfg),
+        grid=(b, h // bh),
+        in_specs=[top, main, bot, wspec, vec_c, vec_c, vec_c, vec_co,
+                  ytop, ymain, ybot, ytop, ymain, ybot,
+                  vec_co, vec_co],
+        out_specs=[main, dwspec,
+                   pl.BlockSpec((1, c), lambda b_, i: (0, 0)),
+                   pl.BlockSpec((1, c), lambda b_, i: (0, 0))],
+        out_shape=outs,
+        compiler_params=_conv3_params(),
+        interpret=cfg.interpret,
+    )(x, x, x, w, mean_in, scale_in, beta_in, kshift,
+      y, y, y, dy, dy, dy, gm_row, gs_row)
+    dw = dw.astype(w.dtype)
+    if cfg.fuse_input:
+        dsu_v = dsu[0]
+        dscale = dsx[0] - jnp.asarray(mean_in, jnp.float32)[0] * dsu_v
+        dmean = -jnp.asarray(scale_in, jnp.float32)[0] * dsu_v
+        return (dx, dw, dmean.reshape(1, c), dscale.reshape(1, c),
+                dsu_v.reshape(1, c), jnp.zeros_like(kshift))
+    zk = jnp.zeros((1, c), jnp.float32)
+    return dx, dw, zk, zk, zk, jnp.zeros_like(kshift)
+
+
+_conv3_core.defvjp(_conv3_fwd, _conv3_bwd)
+
+
+def fused_conv3x3_bn(x4d, w, *, norm=None, kshift=None,
+                     block_h: Optional[int] = None,
+                     interpret: bool = False):
+    """Fused (normalize → relu → 3x3 stride-1 SAME conv → batch-stats)
+    for NHWC inputs — the bottleneck's conv2.
+
+    x4d: [B, H, W, C]; w: [3, 3, C, Co] (HWIO);
+    norm: optional (mean, scale, beta) f32 [C] (the previous BN folded
+      to subtract-first form); kshift: optional f32 [Co] (next BN's
+      running_mean, stop-gradient — see fused_matmul_bn).
+
+    Returns y [B, H, W, Co] (+ (sum(y-K), sum((y-K)^2)) when kshift
+    given).  jax.custom_vjp: single fused Pallas backward per block
+    (dgrad + the 9 wgrad tiles + BN-chain channel sums), halo rows via
+    1-row block refs, stats fold on halo rows taken from the SAVED
+    forward output so no 2-deep halo is needed.
+    """
+    b, h, w_, c = x4d.shape
+    assert w.shape[:3] == (3, 3, c), (w.shape, x4d.shape)
+    co = w.shape[-1]
+    if block_h is None:
+        block_h = _pick_block_h(h, w_, c, co, x4d.dtype.itemsize)
+    if block_h is None or h % block_h:
+        raise ValueError(
+            f"fused_conv3x3_bn cannot tile H={h} W={w_} C={c} Co={co}; "
+            "use fused_conv3x3_supported() to pre-check")
+    cfg = _Conv3Cfg(fuse_input=norm is not None,
+                    emit_stats=kshift is not None,
+                    block_h=int(block_h), interpret=bool(interpret))
+    if norm is not None:
+        mean_in, scale_in, beta_in = (_row(v, c) for v in norm)
+    else:
+        mean_in = scale_in = beta_in = _row(None, c)
+    ks = _row(kshift, co) if kshift is not None else _row(None, co)
+    return _conv3_core(x4d, w, mean_in, scale_in, beta_in, ks, cfg)

@@ -206,3 +206,90 @@ class TestFusedResNet50Slice:
         out = model.train_mode()(x)
         assert out.shape == (8, 10)
         assert bool(jnp.isfinite(out).all())
+
+
+class TestFusedConv3x3:
+    def test_forward_and_stats(self):
+        from bigdl_tpu.ops.conv_bn_kernels import (
+            fused_conv3x3_bn, fused_conv3x3_bn_reference)
+        x = _rand(40, (2, 8, 6, 4))
+        w = _rand(41, (3, 3, 4, 8)) * 0.2
+        norm = (_rand(42, (4,)) * 0.1, jnp.abs(_rand(43, (4,))) + 0.5,
+                _rand(44, (4,)) * 0.2)
+        k = _rand(45, (8,)) * 0.05
+        y, s1, s2 = fused_conv3x3_bn(x, w, norm=norm, kshift=k,
+                                     block_h=4, interpret=True)
+        yr, r1, r2 = fused_conv3x3_bn_reference(x, w, norm=norm, kshift=k)
+        np.testing.assert_allclose(y, yr, rtol=2e-5, atol=2e-5)
+        np.testing.assert_allclose(s1, r1, rtol=2e-4, atol=2e-3)
+        np.testing.assert_allclose(s2, r2, rtol=2e-4, atol=2e-3)
+
+    def test_gradients_incl_stats_path(self):
+        from bigdl_tpu.ops.conv_bn_kernels import (
+            fused_conv3x3_bn, fused_conv3x3_bn_reference)
+        x = _rand(46, (2, 8, 6, 4))
+        w = _rand(47, (3, 3, 4, 8)) * 0.2
+        norm = (_rand(48, (4,)) * 0.1, jnp.abs(_rand(49, (4,))) + 0.5,
+                _rand(50, (4,)) * 0.2)
+        k = _rand(51, (8,)) * 0.05
+
+        def loss(op):
+            def f(x, w, norm):
+                y, s1, s2 = op(x, w, norm=norm, kshift=k)
+                return (jnp.sum(y ** 2) + jnp.sum(jnp.sin(s1))
+                        + 0.1 * jnp.sum(jnp.cos(s2)))
+            return f
+
+        gf = jax.grad(loss(lambda *a, **kw: fused_conv3x3_bn(
+            *a, block_h=4, interpret=True, **kw)),
+            argnums=(0, 1, 2))(x, w, norm)
+        gr = jax.grad(loss(fused_conv3x3_bn_reference),
+                      argnums=(0, 1, 2))(x, w, norm)
+        for a, b in zip(jax.tree_util.tree_leaves(gf),
+                        jax.tree_util.tree_leaves(gr)):
+            scale = max(float(jnp.max(jnp.abs(b))), 1.0)
+            np.testing.assert_allclose(np.asarray(a) / scale,
+                                       np.asarray(b) / scale,
+                                       rtol=2e-4, atol=2e-5)
+
+    def test_no_input_fusion_no_stats(self):
+        from bigdl_tpu.ops.conv_bn_kernels import (
+            fused_conv3x3_bn, fused_conv3x3_bn_reference)
+        x = _rand(52, (1, 6, 6, 8))
+        w = _rand(53, (3, 3, 8, 8)) * 0.2
+        y = fused_conv3x3_bn(x, w, block_h=3, interpret=True)
+        yr = fused_conv3x3_bn_reference(x, w)
+        np.testing.assert_allclose(y, yr, rtol=2e-5, atol=2e-5)
+
+    def test_block_with_conv2_fused_matches_unfused(self):
+        """All three convs fused (the full tranche) vs the plain path."""
+        from bigdl_tpu.models.resnet import Bottleneck
+        from bigdl_tpu.utils import set_seed
+        set_seed(7)
+        a = Bottleneck(32, 8)
+        set_seed(7)
+        b = Bottleneck(32, 8, fused="force")
+        x = _rand(54, (4, 8, 8, 32))
+        np.testing.assert_allclose(a.train_mode()(x), b.train_mode()(x),
+                                   rtol=3e-5, atol=3e-5)
+
+    def test_block_grads_with_conv2_fused(self):
+        from bigdl_tpu.core.module import partition, combine
+        from bigdl_tpu.models.resnet import Bottleneck
+        from bigdl_tpu.utils import set_seed
+        set_seed(7)
+        a = Bottleneck(32, 8)
+        set_seed(7)
+        b = Bottleneck(32, 8, fused="force")
+        x = _rand(55, (4, 8, 8, 32))
+
+        def grads(mod):
+            params, rest = partition(mod.train_mode())
+
+            def loss(params, x):
+                return jnp.sum(combine(params, rest)(x) ** 2)
+            return jax.grad(loss)(params, x)
+
+        for u, v in zip(jax.tree_util.tree_leaves(grads(a)),
+                        jax.tree_util.tree_leaves(grads(b))):
+            np.testing.assert_allclose(u, v, rtol=8e-4, atol=8e-4)
