@@ -91,6 +91,14 @@ def main(argv=None):
         ("inception_v1", ["--model", "inception-v1", "-b", "128",
                           "--bf16", "--iterations", "10", "--epochs",
                           "5"], 420),
+        # the round-5 fused conv+BN tranche vs the XLA path (bench.py
+        # also races these; redundancy is cheap on a flaky tunnel)
+        ("resnet50_fused", ["--model", "resnet50", "-b", "128",
+                            "--bf16", "--fused", "--iterations", "10",
+                            "--epochs", "5"], 420),
+        ("resnet50_xla", ["--model", "resnet50", "-b", "128",
+                          "--bf16", "--iterations", "10",
+                          "--epochs", "5"], 420),
     ]
     if not args.quick:
         sweep += [

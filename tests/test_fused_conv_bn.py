@@ -135,12 +135,14 @@ class TestFusedBottleneck:
         yb = b.train_mode()(x)
         np.testing.assert_allclose(ya, yb, rtol=3e-5, atol=3e-5)
 
+    @pytest.mark.slow
     def test_forward_matches_strided(self):
         a, b = self._make_pair(stride=2)
         x = _rand(12, (4, 8, 8, 32))
         np.testing.assert_allclose(a.train_mode()(x), b.train_mode()(x),
                                    rtol=3e-5, atol=3e-5)
 
+    @pytest.mark.slow
     def test_running_stats_match(self):
         a, b = self._make_pair()
         x = _rand(13, (4, 8, 8, 32))
@@ -194,6 +196,7 @@ class TestFusedBottleneck:
 
 
 class TestFusedResNet50Slice:
+    @pytest.mark.slow
     def test_resnet_fused_flag_trains(self):
         """A short jitted train step on a fused CIFAR-scale bottleneck
         stack — the integration path the perf harness uses."""
