@@ -367,6 +367,7 @@ def phase_raw_step(on_tpu: bool, batch: int, size: int):
     # warmup (float() forces real completion on the tunneled backend)
     params_tree, rest, opt_state, loss = compiled(
         params_tree, rest, opt_state, x, y)
+    _update(raw_warmup_loss=round(float(loss), 4))
     _log(f"warmup step done, loss={float(loss):.3f}")
 
     # Timed loops in escalating rep counts: land a coarse number fast,
@@ -396,7 +397,21 @@ def phase_fused_step(on_tpu: bool, batch: int, size: int):
     params_tree, rest, opt_state, x, y = state
     params_tree, rest, opt_state, loss = compiled(
         params_tree, rest, opt_state, x, y)
-    _log(f"fused warmup step done, loss={float(loss):.3f}")
+    fused_loss = float(loss)
+    _log(f"fused warmup step done, loss={fused_loss:.3f}")
+    # numerics cross-check: same seed + same batch, so the first-step
+    # loss must match the XLA variant to bf16 tolerance — the kernels
+    # are interpret-tested; a compiled-mode divergence (Mosaic bug, a
+    # layout assumption) must never promote a broken-but-fast variant
+    raw_loss = RESULT.get("raw_warmup_loss")
+    suspect = (raw_loss is not None
+               and abs(fused_loss - raw_loss)
+               > 0.05 * max(abs(raw_loss), 1.0))
+    if suspect:
+        _update(fused_numerics_suspect=True,
+                fused_warmup_loss=round(fused_loss, 4))
+        _log(f"fused warmup loss {fused_loss:.4f} diverges from raw "
+             f"{raw_loss:.4f}; fused will NOT be promoted")
     for iters in ((5, 20) if on_tpu else (2,)):
         t0 = time.perf_counter()
         for _ in range(iters):
@@ -411,7 +426,7 @@ def phase_fused_step(on_tpu: bool, batch: int, size: int):
     raw_ms = RESULT.get("raw_step_time_ms")
     fused_ms = RESULT.get("fused_step_time_ms")
     if raw_ms and fused_ms:
-        win = fused_ms < raw_ms * 0.995
+        win = fused_ms < raw_ms * 0.995 and not suspect
         _update(fused_wins=bool(win),
                 fused_speedup_vs_xla=round(raw_ms / fused_ms, 4))
         b0, b1 = RESULT.get("bytes_per_step"), RESULT.get(
