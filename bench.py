@@ -217,8 +217,16 @@ def _probe_backend_subprocess(wait_s: float) -> Optional[bool]:
     retry), or None (still hanging — wedged; do NOT start another
     client)."""
     import subprocess
-    code = ("import jax; d = jax.devices(); "
-            "print(d[0].platform, flush=True)")
+    # the child tolerates a closed read end: after the abandon path
+    # below closes our pipe fd, its final print must not turn the clean
+    # "connects, prints, exits" teardown into a BrokenPipeError crash
+    code = ("import jax, os, sys\n"
+            "d = jax.devices()\n"
+            "try:\n"
+            "    print(d[0].platform, flush=True)\n"
+            "except BrokenPipeError:\n"
+            "    os.dup2(os.open(os.devnull, os.O_WRONLY),\n"
+            "            sys.stdout.fileno())\n")
     proc = subprocess.Popen([sys.executable, "-c", code],
                             stdout=subprocess.PIPE,
                             stderr=subprocess.DEVNULL, text=True)
@@ -232,7 +240,17 @@ def _probe_backend_subprocess(wait_s: float) -> Optional[bool]:
         time.sleep(1.0)
     _log(f"backend probe: still hanging after {wait_s:.0f}s — "
          f"abandoning the child UNKILLED (pid {proc.pid}; a kill "
-         "mid-init is what wedges the tunnel)")
+         "mid-init is what wedges the tunnel). If that child turns out "
+         "to exit on its own after this run, the tunnel was merely "
+         "slow, not wedged — retrying on the NEXT run is safe")
+    try:
+        # fd hygiene only: the abandoned Popen (and its pipe fd) would
+        # otherwise leak for the life of the bench process.  The child
+        # handles the resulting BrokenPipeError on its single print (see
+        # the probe code above), so its teardown stays clean.
+        proc.stdout.close()
+    except OSError:
+        pass
     return None
 
 

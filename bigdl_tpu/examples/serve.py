@@ -29,6 +29,21 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 logger = logging.getLogger("bigdl_tpu.serve")
 
 
+class BatchedBytesFrontend:
+    """Adapter giving a ``bigdl_tpu.serving.ModelServer`` the same
+    ``predict_bytes`` surface as PredictionService: each request body is
+    ONE npy-serialized sample (no batch axis), and concurrent HTTP
+    threads coalesce into padded device batches via the dynamic
+    batcher."""
+
+    def __init__(self, server):
+        self._server = server
+
+    def predict_bytes(self, payload: bytes) -> bytes:
+        from bigdl_tpu.optim.predictor import npy_call_bytes
+        return npy_call_bytes(self._server.submit, payload)
+
+
 def make_server(service, host: str, port: int) -> ThreadingHTTPServer:
     """ThreadingHTTPServer wired to a PredictionService; concurrency is
     bounded by the service's ticket pool, not the HTTP threads."""
@@ -75,6 +90,14 @@ def main(argv=None):
     p.add_argument("--port", type=int, default=8500)
     p.add_argument("--concurrency", type=int, default=4,
                    help="max in-flight predictions")
+    p.add_argument("--dynamic-batch", type=int, default=None,
+                   metavar="MAX_BATCH",
+                   help="coalesce concurrent requests into padded "
+                        "device batches (bigdl_tpu.serving); each POST "
+                        "body is then ONE sample without a batch axis")
+    p.add_argument("--batch-timeout-ms", type=float, default=5.0,
+                   help="max wait before a partial batch is served "
+                        "(only with --dynamic-batch)")
     p.add_argument("-q", "--quiet", action="store_true")
     args = p.parse_args(argv)
     logging.basicConfig(
@@ -85,6 +108,12 @@ def main(argv=None):
 
     service = PredictionService(load_module(args.model),
                                 concurrency=args.concurrency)
+    batcher = None
+    if args.dynamic_batch is not None:
+        # bucket_sizes rejects 0/negative rather than silently ignoring
+        batcher = service.serve(max_batch=args.dynamic_batch,
+                                batch_timeout_ms=args.batch_timeout_ms)
+        service = BatchedBytesFrontend(batcher)
     server = make_server(service, args.host, args.port)
     logger.info("serving on %s:%d", args.host, server.server_port)
     try:
@@ -93,6 +122,10 @@ def main(argv=None):
         pass
     finally:
         server.server_close()
+        if batcher is not None:
+            # the documented drain: queued requests are answered before
+            # the scheduler thread exits
+            batcher.shutdown(drain=True)
     return server
 
 

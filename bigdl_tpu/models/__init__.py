@@ -12,3 +12,46 @@ from bigdl_tpu.models.maskrcnn import (
 from bigdl_tpu.models.ssd import SSDVGG16, ssd_vgg16_300
 from bigdl_tpu.models.transformer_lm import TransformerLM, transformer_lm
 from bigdl_tpu.models.ncf import NeuralCF
+
+# ---------------------------------------------------------------------------
+# Zoo registry: name → builder, for CLI entry points (serving demo, tools)
+# that take a model by name.  Only models constructible with no required
+# arguments are listed; kwargs pass through to the builder.
+# ---------------------------------------------------------------------------
+
+_ZOO = {
+    "lenet5": LeNet5,
+    "lenet5_graph": lenet5_graph,
+    "autoencoder": autoencoder,
+    "resnet_cifar": resnet_cifar,
+    "vgg_cifar10": VggForCifar10,
+}
+
+# per-sample (unbatched) input shape each zoo model expects, used by the
+# serving CLI to parse stdin rows and warm up bucket shapes
+_ZOO_SAMPLE_SHAPES = {
+    "lenet5": (784,),
+    "lenet5_graph": (784,),
+    "autoencoder": (784,),
+    "resnet_cifar": (32, 32, 3),
+    "vgg_cifar10": (32, 32, 3),
+}
+
+
+def zoo(name: str, **kwargs):
+    """Build a zoo model by name (e.g. ``zoo('lenet5', class_num=10)``)."""
+    try:
+        builder = _ZOO[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown zoo model {name!r}; available: {sorted(_ZOO)}") \
+            from None
+    return builder(**kwargs)
+
+
+def zoo_sample_shape(name: str):
+    """Per-sample input shape for a zoo model (serving CLI contract)."""
+    if name not in _ZOO_SAMPLE_SHAPES:
+        raise ValueError(f"no registered sample shape for {name!r}; "
+                         f"available: {sorted(_ZOO_SAMPLE_SHAPES)}")
+    return _ZOO_SAMPLE_SHAPES[name]

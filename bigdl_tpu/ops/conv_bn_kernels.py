@@ -49,6 +49,7 @@ Used by models/resnet.py's fused bottleneck path (BIGDL_TPU_FUSED_CONVBN).
 from __future__ import annotations
 
 import functools
+import math
 from typing import NamedTuple, Optional, Tuple
 
 import jax
@@ -85,18 +86,35 @@ def _divisor_block(m: int, target: int, step: int = 8) -> Optional[int]:
     return best
 
 
+def _sublane(itemsize: int) -> int:
+    """Mosaic's minimum second-to-minor tile dim per dtype: bf16 packs
+    as (16, 128) tiles, f32 as (8, 128)."""
+    return 16 if itemsize == 2 else 8
+
+
 def _pick_block_m(m: int, k: int, n: int, itemsize: int) -> Optional[int]:
     """Block over M so that w + dW (resident) + the f32 working tiles fit
     VMEM.  The backward is the fattest occupant: w (bf16) + dW (f32)
     resident = 6*K*N bytes, plus ~(2 f32 + 1 input-width) copies of both
-    the [BM,K] and [BM,N] tiles in flight."""
+    the [BM,K] and [BM,N] tiles in flight.
+
+    Blocks are rounded to the dtype's sublane multiple where a divisor
+    exists (bf16 tiles are (16, 128): a block_m of 8 would lower via
+    relayouts); when M has no aligned divisor we keep the old 8-step
+    pick so the supported-problem set is unchanged."""
     resident = 6 * k * n
     if resident > _VMEM_BUDGET:
         return None
     per_row = (k + n) * (8 + itemsize) + k * 4
     avail = _VMEM_BUDGET - resident
     target = max(avail // max(per_row, 1), 8)
-    return _divisor_block(m, min(int(target), 1024))
+    cap = min(int(target), 1024)
+    sub = _sublane(itemsize)
+    if sub != 8:
+        aligned = _divisor_block(m, cap, step=sub)
+        if aligned is not None:
+            return aligned
+    return _divisor_block(m, cap)
 
 
 def fused_block_supported(m: int, k: int, n: int,
@@ -400,7 +418,18 @@ def _pick_block_h(h: int, w: int, c: int, co: int,
     target = (avail // max(per_row, 1)) - 2
     if target < 1:
         return None  # even a 1-row block would blow the VMEM budget
-    return _divisor_block(h, min(int(target), h), step=1)
+    cap = min(int(target), h)
+    # prefer block_h with block_h*W a multiple of the dtype sublane
+    # count (the tiles flatten to (block_h*W, C) rows): smallest step
+    # that makes the product aligned is sublane/gcd(sublane, W).  Fall
+    # back to any divisor so the supported set is unchanged.
+    sub = _sublane(itemsize)
+    step = sub // math.gcd(sub, w)
+    if step > 1:
+        aligned = _divisor_block(h, cap, step=step)
+        if aligned is not None:
+            return aligned
+    return _divisor_block(h, cap, step=1)
 
 
 def fused_conv3x3_supported(h: int, w: int, c: int, co: int,

@@ -599,6 +599,20 @@ class Optimizer:
                 "dataset (DataSet.sharded); a replicated dataset would "
                 "silently feed every sample process_count times per "
                 "epoch")
+        if jax.process_count() > 1 and self.val_dataset is not None \
+                and getattr(self.val_dataset, "per_process_sharded",
+                            lambda: False)():
+            # _validate aggregates eval stats process-locally, so a
+            # sharded val split would give each process a different
+            # score: score-based triggers (best-score checkpointing,
+            # end_when) then branch differently per process and the
+            # owning-host sharded-checkpoint collectives desynchronize
+            # (hang) — require replicated validation data instead
+            raise ValueError(
+                "validation dataset must be replicated across "
+                "processes, not per-process-sharded: every process has "
+                "to compute identical validation scores or score-based "
+                "triggers desynchronize the checkpoint collectives")
 
         from bigdl_tpu.utils.file import is_sharded_checkpoint_path
         resume_sharded = bool(self._resume_from) \

@@ -29,7 +29,8 @@ import jax.numpy as jnp
 from bigdl_tpu.core.module import Module
 from bigdl_tpu.optim.validation import ValidationMethod, ValidationResult
 
-__all__ = ["Predictor", "Evaluator", "PredictionService"]
+__all__ = ["Predictor", "Evaluator", "PredictionService", "jit_forward",
+           "npy_call_bytes"]
 
 
 def _as_dataset(data, batch_size: int, shuffle: bool = False):
@@ -54,6 +55,27 @@ def _as_dataset(data, batch_size: int, shuffle: bool = False):
     raise TypeError(f"cannot build a dataset from {type(data)}")
 
 
+def jit_forward(model: Module):
+    """The one inference-executable builder: clone to eval mode and jit
+    the forward with the model as a traced argument.  Shared by
+    Predictor, PredictionService, and serving's Module backend so the
+    forward path cannot drift between them."""
+    model = model.clone().eval_mode()
+    return model, jax.jit(lambda m, x: m.forward(x))
+
+
+def npy_call_bytes(fn, payload: bytes) -> bytes:
+    """The npy wire codec (array in → ``fn`` → array out), shared by
+    ``PredictionService.predict_bytes`` and the HTTP frontends so the
+    format cannot drift between serving modes."""
+    import io
+    x = np.load(io.BytesIO(payload), allow_pickle=False)
+    y = fn(x)
+    buf = io.BytesIO()
+    np.save(buf, y, allow_pickle=False)
+    return buf.getvalue()
+
+
 def _pad_batch(x, target: int):
     """Pad the leading axis to ``target`` rows (repeat-last padding)."""
     def pad(a):
@@ -72,9 +94,8 @@ class Predictor:
     152 ``predict``, :119 ``predictClass``)."""
 
     def __init__(self, model: Module, batch_size: int = 32):
-        self.model = model.clone().eval_mode()
+        self.model, self._fn = jit_forward(model)
         self.batch_size = batch_size
-        self._fn = jax.jit(lambda m, x: m.forward(x))
 
     def _iter_batches(self, data):
         ds = _as_dataset(data, self.batch_size)
@@ -148,8 +169,7 @@ class PredictionService:
     def __init__(self, model: Module, concurrency: int = 4):
         if concurrency < 1:
             raise ValueError("concurrency must be >= 1")
-        self.model = model.clone().eval_mode()
-        self._fn = jax.jit(lambda m, x: m.forward(x))
+        self.model, self._fn = jit_forward(model)
         self._tickets: "queue.Queue[int]" = queue.Queue()
         for i in range(concurrency):
             self._tickets.put(i)
@@ -163,16 +183,26 @@ class PredictionService:
             x = (tuple(jnp.asarray(a) for a in activity)
                  if isinstance(activity, (tuple, list))
                  else jnp.asarray(activity))
-            return np.asarray(self._fn(self.model, x))
+            y = self._fn(self.model, x)
+            # multi-head (Table-output) models return a tuple; keep the
+            # structure instead of np.asarray-ing it into a raggedness
+            # error / silently stacked head axis
+            return (tuple(np.asarray(a) for a in y)
+                    if isinstance(y, (tuple, list)) else np.asarray(y))
         finally:
             self._tickets.put(ticket)
+
+    def serve(self, **kwargs):
+        """Put a dynamic batcher in front of this service: returns a
+        ``bigdl_tpu.serving.ModelServer`` whose backend is this
+        service's ticketed ``predict`` (kwargs: ``max_batch``,
+        ``batch_timeout_ms``, ``queue_capacity``, ``admission``).
+        Concurrent single-sample submitters then share padded bucket
+        batches instead of each paying a device dispatch."""
+        from bigdl_tpu.serving import ModelServer
+        return ModelServer(self, **kwargs)
 
     def predict_bytes(self, payload: bytes) -> bytes:
         """Byte-level request/response (≙ PredictionService.scala:129
         protobuf Activity encoding): npy-serialized arrays in, npy out."""
-        import io
-        x = np.load(io.BytesIO(payload), allow_pickle=False)
-        y = self.predict(x)
-        buf = io.BytesIO()
-        np.save(buf, y, allow_pickle=False)
-        return buf.getvalue()
+        return npy_call_bytes(self.predict, payload)

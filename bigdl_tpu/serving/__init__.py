@@ -1,0 +1,25 @@
+"""bigdl_tpu.serving — dynamic-batching TPU inference runtime.
+
+The request-level layer above ``optim.predictor``: concurrent
+single-sample submissions are coalesced into padded power-of-two
+buckets (one XLA executable, few shapes), guarded by admission control,
+and measured end to end.  See docs/serving.md.
+"""
+
+from bigdl_tpu.serving.admission import (      # noqa: F401
+    BoundedRequestQueue, QueueFullError, Request, RequestSheddedError,
+    ServerClosedError,
+)
+from bigdl_tpu.serving.batching import (       # noqa: F401
+    bucket_sizes, pick_bucket, split_outputs, stack_requests,
+)
+from bigdl_tpu.serving.metrics import MetricsRegistry      # noqa: F401
+from bigdl_tpu.serving.scheduler import BatchScheduler     # noqa: F401
+from bigdl_tpu.serving.server import ModelServer           # noqa: F401
+
+__all__ = [
+    "ModelServer", "MetricsRegistry", "BatchScheduler",
+    "BoundedRequestQueue", "Request",
+    "QueueFullError", "RequestSheddedError", "ServerClosedError",
+    "bucket_sizes", "pick_bucket", "stack_requests", "split_outputs",
+]

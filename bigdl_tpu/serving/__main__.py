@@ -1,0 +1,121 @@
+"""``python -m bigdl_tpu.serving`` — stdin/stdout serving demo.
+
+Serves a zoo model behind the dynamic batcher.  Each stdin line is one
+sample: whitespace-separated floats, reshaped to the model's per-sample
+input shape.  Each stdout line is ``<index>\t<class>\t<score>`` (argmax
+1-based, matching ``Predictor.predict_class``).  The final metrics
+snapshot goes to stderr as JSON; ``--log-dir`` additionally publishes
+TensorBoard event files via the visualization writer.
+
+    # 3 random "MNIST" samples through int8 LeNet-5, batched:
+    python -m bigdl_tpu.serving --model lenet5 --quantize --synthetic 3
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List
+
+import numpy as np
+
+
+def _raise(e: Exception):
+    raise e
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m bigdl_tpu.serving",
+        description="dynamic-batching inference demo over a zoo model")
+    p.add_argument("--model", default="lenet5",
+                   help="zoo model name (see bigdl_tpu.models.zoo)")
+    p.add_argument("--max-batch", type=int, default=8)
+    p.add_argument("--batch-timeout-ms", type=float, default=5.0)
+    p.add_argument("--queue-capacity", type=int, default=None)
+    p.add_argument("--policy", default="block",
+                   choices=("block", "reject", "shed_oldest"))
+    p.add_argument("--quantize", action="store_true",
+                   help="serve the int8-quantized model (nn.quantized)")
+    p.add_argument("--synthetic", type=int, default=None, metavar="N",
+                   help="serve N random samples instead of reading stdin")
+    p.add_argument("--no-warmup", action="store_true",
+                   help="skip pre-compiling the bucket shapes")
+    p.add_argument("--log-dir", default=None,
+                   help="publish metrics as TensorBoard event files here")
+    return p
+
+
+def main(argv=None, stdin=None, stdout=None, stderr=None) -> int:
+    args = build_parser().parse_args(argv)
+    stdin = stdin if stdin is not None else sys.stdin
+    stdout = stdout if stdout is not None else sys.stdout
+    stderr = stderr if stderr is not None else sys.stderr
+
+    from bigdl_tpu.models import zoo, zoo_sample_shape
+    from bigdl_tpu.serving import ModelServer
+
+    model = zoo(args.model)
+    shape = zoo_sample_shape(args.model)
+    if args.quantize:
+        from bigdl_tpu.nn.quantized import quantize
+        model = quantize(model)
+
+    server = ModelServer(
+        model, max_batch=args.max_batch,
+        batch_timeout_ms=args.batch_timeout_ms,
+        queue_capacity=args.queue_capacity, admission=args.policy)
+    if not args.no_warmup:
+        server.warmup(np.zeros(shape, np.float32))
+
+    if args.synthetic is not None:
+        rng = np.random.default_rng(0)
+        samples = [rng.normal(size=shape).astype(np.float32)
+                   for _ in range(args.synthetic)]
+    else:
+        samples = None  # stream stdin below
+
+    def sample_lines():
+        if samples is not None:
+            yield from samples
+            return
+        for line in stdin:
+            if not line.strip():
+                continue
+            yield np.array(line.split(), dtype=np.float32).reshape(shape)
+
+    futures: List = []
+    try:
+        for s in sample_lines():
+            # reject/shed_oldest are part of the demo: an overloaded
+            # submit becomes an error row, not a crash
+            try:
+                futures.append(server.submit_async(s))
+            except Exception as e:
+                futures.append(e)
+        for i, f in enumerate(futures):
+            try:
+                row = np.asarray(f.result() if not isinstance(f, Exception)
+                                 else _raise(f))
+            except Exception as e:
+                print(f"{i}\tERROR\t{type(e).__name__}", file=stdout)
+                continue
+            cls = int(np.argmax(row)) + 1
+            print(f"{i}\t{cls}\t{float(np.max(row)):.6f}", file=stdout)
+    finally:
+        server.shutdown(drain=True)
+
+    snap = server.metrics.snapshot()
+    print(json.dumps(snap, sort_keys=True), file=stderr)
+    if args.log_dir:
+        from bigdl_tpu.visualization import ServingSummary
+        summary = ServingSummary(args.log_dir, f"serve-{args.model}")
+        server.publish_metrics(summary, step=0)
+        summary.close()
+        print(f"metrics event file: {summary.writer_path}", file=stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,163 @@
+"""Serving metrics: latency quantiles, queue depth, batch occupancy.
+
+The reference surfaces training throughput through TensorBoard event
+files (visualization/TrainSummary.scala); serving reuses the exact same
+event-file writer so inference metrics land next to training metrics in
+one TensorBoard run:
+
+* ``latency_ms/p50|p90|p99`` — end-to-end per-request latency (enqueue
+  to result), the number admission control exists to protect;
+* ``queue_depth``            — backlog sampled at every dispatch;
+* ``batch_occupancy``        — histogram of *real* rows per executed
+  batch (occupancy near 1 means the batcher adds latency for nothing;
+  near ``max_batch`` means it is earning its keep);
+* ``padded_waste``           — padded rows / dispatched rows: the price
+  of bucketed static shapes, flops burned on rows that are dropped.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["MetricsRegistry"]
+
+
+def _quantiles_ms(lats_s: np.ndarray) -> Dict[str, float]:
+    if lats_s.size == 0:
+        return {"p50": 0.0, "p90": 0.0, "p99": 0.0}
+    q = np.quantile(lats_s, [0.5, 0.9, 0.99]) * 1e3
+    return {"p50": float(q[0]), "p90": float(q[1]), "p99": float(q[2])}
+
+# keep at most this many per-request latencies for quantile estimation;
+# beyond it we subsample uniformly (reservoir) so a long-lived server
+# doesn't grow host memory without bound
+_RESERVOIR = 65536
+
+
+class MetricsRegistry:
+    """Thread-safe accumulator for the serving data plane.  The
+    scheduler calls :meth:`record_batch`; anyone may :meth:`snapshot` or
+    :meth:`publish` concurrently."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._latencies_s: List[float] = []
+        self._seen = 0            # total latencies offered (for reservoir)
+        self._occupancy: Dict[int, int] = {}   # real rows -> batch count
+        # queue depth as running aggregates, not a list: a long-lived
+        # server must not grow host memory per batch (same rationale as
+        # the latency reservoir)
+        self._depth_sum = 0
+        self._depth_n = 0
+        self._depth_max = 0
+        self._rows_real = 0
+        self._rows_padded = 0
+        self._batches = 0
+        self._requests = 0
+        self._shed = 0
+        self._rejected = 0
+        self._rng = np.random.default_rng(0)
+
+    # ---- recording -------------------------------------------------------
+
+    def record_batch(self, n_real: int, bucket: int, queue_depth: int,
+                     latencies_s) -> None:
+        with self._lock:
+            self._batches += 1
+            self._requests += n_real
+            self._rows_real += n_real
+            self._rows_padded += bucket - n_real
+            self._occupancy[n_real] = self._occupancy.get(n_real, 0) + 1
+            self._depth_sum += queue_depth
+            self._depth_n += 1
+            self._depth_max = max(self._depth_max, queue_depth)
+            for lat in latencies_s:
+                self._seen += 1
+                if len(self._latencies_s) < _RESERVOIR:
+                    self._latencies_s.append(float(lat))
+                else:
+                    j = int(self._rng.integers(self._seen))
+                    if j < _RESERVOIR:
+                        self._latencies_s[j] = float(lat)
+
+    def record_shed(self, n: int = 1) -> None:
+        with self._lock:
+            self._shed += n
+
+    def record_rejected(self, n: int = 1) -> None:
+        with self._lock:
+            self._rejected += n
+
+    # ---- reading ---------------------------------------------------------
+
+    def latency_quantiles_ms(self) -> Dict[str, float]:
+        with self._lock:
+            lats = np.asarray(self._latencies_s, dtype=np.float64)
+        return _quantiles_ms(lats)
+
+    def occupancy_histogram(self) -> Dict[int, int]:
+        with self._lock:
+            return dict(self._occupancy)
+
+    def padded_waste(self) -> float:
+        with self._lock:
+            total = self._rows_real + self._rows_padded
+            return (self._rows_padded / total) if total else 0.0
+
+    def snapshot(self) -> Dict[str, object]:
+        """One coherent dict of everything: every field is read under a
+        single lock acquisition, so a dispatch landing mid-snapshot
+        cannot leave e.g. ``requests`` and the quantiles disagreeing."""
+        with self._lock:
+            lats = np.asarray(self._latencies_s, dtype=np.float64)
+            total_rows = self._rows_real + self._rows_padded
+            snap = {
+                "requests": self._requests,
+                "batches": self._batches,
+                "shed": self._shed,
+                "rejected": self._rejected,
+                "rows_real": self._rows_real,
+                "rows_padded": self._rows_padded,
+                "occupancy": dict(self._occupancy),
+                "padded_waste": (self._rows_padded / total_rows
+                                 if total_rows else 0.0),
+                "queue_depth_mean": (self._depth_sum / self._depth_n
+                                     if self._depth_n else 0.0),
+                "queue_depth_max": self._depth_max,
+            }
+        snap["latency_ms"] = _quantiles_ms(lats)
+        return snap
+
+    # ---- TensorBoard export ---------------------------------------------
+
+    def publish(self, summary, step: int) -> None:
+        """Write the current snapshot through a ``visualization.Summary``
+        (e.g. :class:`~bigdl_tpu.visualization.ServingSummary`) so stock
+        TensorBoard renders it; scalars under ``serving/*`` plus a
+        batch-occupancy histogram."""
+        snap = self.snapshot()
+        lat = snap["latency_ms"]
+        for tag, val in (
+                ("serving/latency_ms_p50", lat["p50"]),
+                ("serving/latency_ms_p90", lat["p90"]),
+                ("serving/latency_ms_p99", lat["p99"]),
+                ("serving/queue_depth_mean", snap["queue_depth_mean"]),
+                ("serving/queue_depth_max", snap["queue_depth_max"]),
+                ("serving/padded_waste", snap["padded_waste"]),
+                ("serving/requests", snap["requests"]),
+                ("serving/batches", snap["batches"]),
+                ("serving/shed", snap["shed"]),
+                ("serving/rejected", snap["rejected"]),
+        ):
+            summary.add_scalar(tag, float(val), step)
+        occ = snap["occupancy"]
+        if occ:
+            # weighted form: O(distinct batch sizes), not O(batches) —
+            # a long-lived server must not expand one float per batch
+            sizes = sorted(occ)
+            summary.add_histogram(
+                "serving/batch_occupancy", np.asarray(sizes, np.float64),
+                step, weights=[occ[s] for s in sizes])

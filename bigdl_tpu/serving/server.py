@@ -1,0 +1,192 @@
+"""ModelServer: the serving frontend.
+
+Wires admission control → batch scheduler → a compiled backend into one
+object with the reference ``PredictionService`` surface (submit a
+sample, get a result) plus the pieces a TPU deployment needs around it:
+bucket warmup (pre-compile every batch shape at startup, so the first
+user request never pays an XLA compile), metrics, and drain-on-shutdown.
+
+Backends — anything that can run a padded batch:
+
+* a :class:`~bigdl_tpu.core.module.Module` (including ``quantize``-d
+  int8 models): cloned to eval mode and jit-compiled, one executable
+  shared across all buckets' shapes via the XLA compile cache;
+* a :class:`~bigdl_tpu.optim.predictor.PredictionService`: reuses its
+  ticketed thread-safe ``predict`` (useful to put one dynamic batcher in
+  front of an existing service);
+* any callable ``f(batched_input) -> batched_output``.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from concurrent.futures import Future
+from typing import Callable, List, Optional, Sequence, Union
+
+import numpy as np
+
+from bigdl_tpu.serving.admission import (
+    BoundedRequestQueue, QueueFullError, Request, ServerClosedError,
+)
+from bigdl_tpu.serving.batching import bucket_sizes
+from bigdl_tpu.serving.metrics import MetricsRegistry
+from bigdl_tpu.serving.scheduler import BatchScheduler
+
+__all__ = ["ModelServer"]
+
+logger = logging.getLogger(__name__)
+
+
+def _module_backend(model) -> Callable:
+    """The shared jit-compiled eval-mode forward, plus serving's own
+    host conversion (tuple outputs, blocking device readback)."""
+    import jax.numpy as jnp
+    from bigdl_tpu.optim.predictor import jit_forward
+    model, fn = jit_forward(model)
+
+    def run(x):
+        xs = (tuple(jnp.asarray(a) for a in x)
+              if isinstance(x, (tuple, list)) else jnp.asarray(x))
+        y = fn(model, xs)
+        # block until the result is on host so recorded latency covers
+        # the device round-trip, not just dispatch
+        return (tuple(np.asarray(a) for a in y)
+                if isinstance(y, (tuple, list)) else np.asarray(y))
+    return run
+
+
+def _resolve_backend(backend) -> Callable:
+    from bigdl_tpu.core.module import Module
+    from bigdl_tpu.optim.predictor import PredictionService
+    if isinstance(backend, Module):
+        return _module_backend(backend)
+    if isinstance(backend, PredictionService):
+        return backend.predict
+    if callable(backend):
+        return backend
+    raise TypeError(f"cannot serve a {type(backend).__name__}: expected a "
+                    "Module, PredictionService, or callable")
+
+
+class ModelServer:
+    """Dynamic-batching inference server.
+
+    >>> server = ModelServer(model, max_batch=16, batch_timeout_ms=3.0)
+    >>> server.warmup(np.zeros((784,), np.float32))
+    >>> y = server.submit(x)                  # blocking, single sample
+    >>> ys = server.submit_many(list_of_x)    # batch of blocking submits
+    >>> server.shutdown()                     # drains the queue
+    """
+
+    def __init__(self, backend, max_batch: int = 32,
+                 batch_timeout_ms: float = 5.0,
+                 queue_capacity: Optional[int] = None,
+                 admission: str = "block",
+                 metrics: Optional[MetricsRegistry] = None):
+        self._run_batch = _resolve_backend(backend)
+        self.buckets = bucket_sizes(max_batch)
+        self.max_batch = max_batch
+        cap = queue_capacity if queue_capacity is not None else 8 * max_batch
+        self.metrics = metrics if metrics is not None else MetricsRegistry()
+        self._queue = BoundedRequestQueue(
+            cap, policy=admission, on_shed=self.metrics.record_shed)
+        self._scheduler = BatchScheduler(
+            self._queue, self._run_batch,
+            self.buckets, batch_timeout_ms, metrics=self.metrics)
+        self._scheduler.start()
+        self._shutdown = False
+
+    # ---- submission ------------------------------------------------------
+
+    def submit_async(self, sample,
+                     timeout: Optional[float] = None) -> Future:
+        """Admit one sample (an array, or tuple of arrays, WITHOUT a
+        batch axis) and return a Future of its output row.  Raises
+        QueueFullError / ServerClosedError per the admission policy;
+        ``timeout`` bounds the admission wait under the ``block``
+        policy (otherwise a wedged backend + full queue would hang the
+        submitter forever)."""
+        if self._shutdown:
+            raise ServerClosedError("server is shut down")
+        req = Request(sample)
+        try:
+            self._queue.put(req, timeout=timeout)
+        except QueueFullError:
+            self.metrics.record_rejected()
+            raise
+        return req.future
+
+    def submit(self, sample, timeout: Optional[float] = None):
+        """Blocking single-sample inference (≙ PredictionService.predict,
+        but coalesced with concurrent callers into one device batch).
+        ``timeout`` covers admission AND the result wait."""
+        deadline = (None if timeout is None
+                    else time.perf_counter() + timeout)
+        fut = self.submit_async(sample, timeout=timeout)
+        remaining = (None if deadline is None
+                     else max(deadline - time.perf_counter(), 0.0))
+        return fut.result(remaining)
+
+    def submit_many(self, samples: Sequence,
+                    timeout: Optional[float] = None) -> List:
+        """Submit a burst and wait for all results, preserving order.
+        All samples are enqueued before the first wait, so a burst from
+        one caller coalesces exactly like concurrent callers do."""
+        futures = [self.submit_async(s) for s in samples]
+        deadline = (None if timeout is None
+                    else time.perf_counter() + timeout)
+        out = []
+        for f in futures:
+            remaining = (None if deadline is None
+                         else max(deadline - time.perf_counter(), 0.0))
+            out.append(f.result(remaining))
+        return out
+
+    # ---- lifecycle -------------------------------------------------------
+
+    def warmup(self, example_sample) -> "ModelServer":
+        """Pre-compile every bucket shape by running a zeros batch
+        through the backend, largest first (the compile cache then holds
+        all shapes before traffic arrives)."""
+        ex = example_sample
+        parts = (tuple(np.asarray(a) for a in ex)
+                 if isinstance(ex, (tuple, list)) else (np.asarray(ex),))
+        tuple_input = isinstance(ex, (tuple, list))
+        t0 = time.perf_counter()
+        for b in reversed(self.buckets):
+            zeros = tuple(np.zeros((b,) + p.shape, p.dtype) for p in parts)
+            self._run_batch(zeros if tuple_input else zeros[0])
+        logger.info("warmup: compiled %d bucket shapes %s in %.2fs",
+                    len(self.buckets), list(self.buckets),
+                    time.perf_counter() - t0)
+        return self
+
+    def queue_depth(self) -> int:
+        return len(self._queue)
+
+    def publish_metrics(self, summary, step: int = 0) -> None:
+        """Export the metrics snapshot through a visualization Summary
+        (see :class:`bigdl_tpu.visualization.ServingSummary`)."""
+        self.metrics.publish(summary, step)
+
+    def shutdown(self, drain: bool = True,
+                 timeout: Optional[float] = 30.0) -> None:
+        """Stop admitting requests.  With ``drain`` (default) every
+        already-queued request is still served before the dispatch
+        thread exits; otherwise queued requests fail with
+        ServerClosedError."""
+        if self._shutdown:
+            return
+        self._shutdown = True
+        self._queue.close(discard=not drain)
+        self._scheduler.join(timeout)
+        if self._scheduler.alive:
+            logger.warning("serving scheduler did not drain within %ss",
+                           timeout)
+
+    def __enter__(self) -> "ModelServer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()

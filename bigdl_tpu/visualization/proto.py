@@ -98,28 +98,37 @@ def _default_bucket_limits() -> List[float]:
 _BUCKET_LIMITS = _default_bucket_limits()
 
 
-def make_histogram(values: np.ndarray) -> HistogramValue:
+def make_histogram(values: np.ndarray,
+                   weights: Optional[np.ndarray] = None) -> HistogramValue:
     """Build a TensorBoard histogram from raw values
     (≙ Summary.histogram, visualization/Summary.scala:97).
 
-    Non-finite values (NaN/±inf — diverging training) are dropped rather
-    than crashing the writer; overflow values land in the final +inf
-    bucket."""
+    ``weights`` lets pre-aggregated data (e.g. a ``{value: count}``
+    tally) stay O(distinct values) instead of expanding to one entry
+    per observation.  Non-finite values (NaN/±inf — diverging training)
+    are dropped rather than crashing the writer; overflow values land
+    in the final +inf bucket."""
     values = np.asarray(values, dtype=np.float64).ravel()
-    values = values[np.isfinite(values)]
+    w = (np.ones_like(values) if weights is None
+         else np.asarray(weights, dtype=np.float64).ravel())
+    if w.shape != values.shape:
+        raise ValueError(f"weights shape {w.shape} != values "
+                         f"shape {values.shape}")
+    mask = np.isfinite(values)
+    values, w = values[mask], w[mask]
     limits = np.asarray(_BUCKET_LIMITS[:-1])
     idx = np.minimum(np.searchsorted(limits, values, side="left"),
                      len(_BUCKET_LIMITS) - 1)
-    counts = np.bincount(idx, minlength=len(_BUCKET_LIMITS))
+    counts = np.bincount(idx, weights=w, minlength=len(_BUCKET_LIMITS))
     # trim trailing empty buckets (TensorBoard convention keeps one extra)
     nz = np.nonzero(counts)[0]
     end = min((nz[-1] + 2) if len(nz) else 1, len(_BUCKET_LIMITS))
     return HistogramValue(
         minimum=float(values.min()) if values.size else 0.0,
         maximum=float(values.max()) if values.size else 0.0,
-        num=float(values.size),
-        total=float(values.sum()),
-        sum_squares=float(np.square(values).sum()),
+        num=float(w.sum()),
+        total=float((values * w).sum()),
+        sum_squares=float((np.square(values) * w).sum()),
         bucket_limit=_BUCKET_LIMITS[:end],
         bucket=list(counts[:end].astype(float)),
     )

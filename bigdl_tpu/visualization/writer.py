@@ -25,7 +25,7 @@ from bigdl_tpu.visualization.proto import (
 )
 
 __all__ = ["RecordWriter", "FileWriter", "Summary", "TrainSummary",
-           "ValidationSummary"]
+           "ValidationSummary", "ServingSummary"]
 
 _file_seq = itertools.count()
 
@@ -138,10 +138,14 @@ class Summary:
             scalars=[ScalarValue(tag, float(value))]))
         return self
 
-    def add_histogram(self, tag: str, values, step: int) -> "Summary":
+    def add_histogram(self, tag: str, values, step: int,
+                      weights=None) -> "Summary":
+        """``weights`` forwards to ``make_histogram`` so pre-aggregated
+        ``{value: count}`` data need not expand to raw observations."""
         self._writer.add_event(Event(
             wall_time=time.time(), step=int(step),
-            histograms=[(tag, make_histogram(np.asarray(values)))]))
+            histograms=[(tag, make_histogram(np.asarray(values),
+                                             weights=weights))]))
         return self
 
     def read_scalar(self, tag: str) -> List[Tuple[int, float]]:
@@ -199,3 +203,12 @@ class ValidationSummary(Summary):
     """Per-validation-method scalars (≙ ValidationSummary.scala)."""
 
     tag = "validation"
+
+
+class ServingSummary(Summary):
+    """Inference-serving metrics (latency quantiles, queue depth, batch
+    occupancy) written by ``bigdl_tpu.serving.MetricsRegistry.publish``
+    — same event-file format, so serving metrics land in the same
+    TensorBoard run as train/validation."""
+
+    tag = "serving"

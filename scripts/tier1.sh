@@ -1,0 +1,15 @@
+#!/usr/bin/env bash
+# Tier-1 verify — THE line builders and CI must both run (ROADMAP.md).
+# Any edit here must be mirrored into ROADMAP.md "Tier-1 verify" and
+# vice versa; the whole point of this wrapper is that there is exactly
+# one encoding of the command.
+set -o pipefail
+cd "$(dirname "$0")/.."
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+  -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
+  -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log \
+  | tr -cd . | wc -c)
+exit $rc

@@ -296,3 +296,41 @@ class TestFusedConv3x3:
         for u, v in zip(jax.tree_util.tree_leaves(grads(a)),
                         jax.tree_util.tree_leaves(grads(b))):
             np.testing.assert_allclose(u, v, rtol=8e-4, atol=8e-4)
+
+
+class TestBlockPickers:
+    """Sublane alignment of the VMEM block picks (ADVICE r05: bf16 tiles
+    are (16, 128), f32 (8, 128); misaligned blocks lower via relayouts)."""
+
+    def test_block_m_bf16_prefers_16_multiples(self):
+        from bigdl_tpu.ops.conv_bn_kernels import _pick_block_m
+        for m in (128, 256, 512, 1024, 3136):
+            bm = _pick_block_m(m, 256, 256, itemsize=2)
+            assert bm is not None and bm % 16 == 0
+
+    def test_block_m_falls_back_when_no_aligned_divisor(self):
+        from bigdl_tpu.ops.conv_bn_kernels import _pick_block_m
+        # 24 has no 16-multiple divisor; the old 8-step pick must survive
+        assert _pick_block_m(24, 256, 256, itemsize=2) == 24
+
+    def test_block_m_f32_keeps_8_multiples(self):
+        from bigdl_tpu.ops.conv_bn_kernels import _pick_block_m
+        for m in (128, 24, 1024):
+            bm = _pick_block_m(m, 256, 256, itemsize=4)
+            assert bm is not None and bm % 8 == 0
+
+    def test_block_h_aligns_flattened_rows_where_divisors_allow(self):
+        from bigdl_tpu.ops.conv_bn_kernels import _pick_block_h
+        for h, w, sub in ((56, 56, 16), (28, 28, 16), (32, 32, 16),
+                          (56, 56, 8), (28, 28, 8)):
+            itemsize = 2 if sub == 16 else 4
+            bh = _pick_block_h(h, w, 64, 64, itemsize)
+            assert bh is not None and (bh * w) % sub == 0
+
+    def test_block_h_fallback_keeps_support(self):
+        from bigdl_tpu.ops.conv_bn_kernels import (
+            _pick_block_h, fused_conv3x3_supported,
+        )
+        # 7x7 (ResNet tail) has no aligned divisor: still supported
+        assert fused_conv3x3_supported(7, 7, 64, 64, itemsize=2)
+        assert _pick_block_h(7, 7, 64, 64, itemsize=2) is not None

@@ -1,0 +1,627 @@
+"""Tests for bigdl_tpu.serving: dynamic batching, admission control,
+scheduler deadlines, metrics, warmup, and drain-on-shutdown.
+
+The load-bearing assertion (ISSUE 1 acceptance): N concurrent
+single-sample requests complete in <= ceil(N / max_batch) model
+invocations, proved with a counting backend wrapper.
+"""
+
+import io
+import math
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.serving import (
+    ModelServer, MetricsRegistry, QueueFullError, RequestSheddedError,
+    ServerClosedError, bucket_sizes, pick_bucket, split_outputs,
+    stack_requests,
+)
+from bigdl_tpu.utils import set_seed
+
+
+def _model():
+    set_seed(3)
+    return nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 3),
+                         nn.LogSoftMax())
+
+
+def _forward_batch(model, xs):
+    import jax.numpy as jnp
+    return np.asarray(model.eval_mode().forward(
+        jnp.stack([jnp.asarray(x) for x in xs])))
+
+
+class CountingBackend:
+    """Counts device-side invocations; optionally gated so tests can
+    hold the scheduler inside a dispatch while they fill the queue."""
+
+    def __init__(self, model, gated: bool = False):
+        import jax
+        import jax.numpy as jnp
+        m = model.clone().eval_mode()
+        fn = jax.jit(lambda mm, x: mm.forward(x))
+        self._run = lambda x: np.asarray(fn(m, jnp.asarray(x)))
+        self.calls = 0
+        self.batch_rows = []
+        self.entered = threading.Event()
+        self.gate = threading.Event()
+        if not gated:
+            self.gate.set()
+
+    def __call__(self, x):
+        self.entered.set()
+        assert self.gate.wait(timeout=30), "backend gate never released"
+        self.calls += 1
+        self.batch_rows.append(np.asarray(x).shape[0])
+        return self._run(x)
+
+
+# ---------------------------------------------------------------------------
+# bucketing primitives
+# ---------------------------------------------------------------------------
+
+def test_bucket_sizes_powers_of_two():
+    assert bucket_sizes(8) == (1, 2, 4, 8)
+    assert bucket_sizes(1) == (1,)
+    assert bucket_sizes(24) == (1, 2, 4, 8, 16, 24)  # non-pow2 terminal
+
+
+def test_pick_bucket_smallest_fit():
+    b = bucket_sizes(16)
+    assert pick_bucket(1, b) == 1
+    assert pick_bucket(3, b) == 4
+    assert pick_bucket(16, b) == 16
+    with pytest.raises(ValueError):
+        pick_bucket(17, b)
+
+
+def test_stack_and_split_ragged_padding():
+    xs = [np.full((3,), i, np.float32) for i in range(3)]
+    batch = stack_requests(xs, bucket=4)
+    assert batch.shape == (4, 3)
+    # pad row repeats the last real sample, exactly like _pad_batch
+    np.testing.assert_array_equal(batch[3], batch[2])
+    rows = split_outputs(batch, 3)
+    assert len(rows) == 3
+    np.testing.assert_array_equal(rows[1], xs[1])
+
+
+def test_stack_tuple_samples():
+    xs = [(np.full((2,), i, np.float32), np.full((5,), -i, np.float32))
+          for i in range(3)]
+    cols = stack_requests(xs, bucket=4)
+    assert isinstance(cols, tuple) and len(cols) == 2
+    assert cols[0].shape == (4, 2) and cols[1].shape == (4, 5)
+    rows = split_outputs(cols, 3)
+    assert rows[2][0][0] == 2 and rows[2][1][0] == -2
+
+
+# ---------------------------------------------------------------------------
+# the acceptance test: coalescing proof + metrics
+# ---------------------------------------------------------------------------
+
+def test_concurrent_requests_coalesce_and_metrics_account():
+    model = _model()
+    backend = CountingBackend(model)
+    n, max_batch = 12, 4
+    server = ModelServer(backend, max_batch=max_batch,
+                         batch_timeout_ms=500.0)
+    rng = np.random.default_rng(0)
+    xs = [rng.normal(size=(4,)).astype(np.float32) for _ in range(n)]
+    outs = [None] * n
+    errs = []
+
+    def work(i):
+        try:
+            outs[i] = server.submit(xs[i], timeout=30)
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    threads = [threading.Thread(target=work, args=(i,)) for i in range(n)]
+    [t.start() for t in threads]
+    [t.join() for t in threads]
+    server.shutdown()
+    assert not errs
+    # the coalescing proof: every request served, in at most
+    # ceil(N / max_batch) compiled invocations
+    assert backend.calls <= math.ceil(n / max_batch)
+    want = _forward_batch(model, xs)
+    np.testing.assert_allclose(np.stack(outs), want, rtol=1e-5)
+
+    snap = server.metrics.snapshot()
+    assert snap["requests"] == n
+    lat = snap["latency_ms"]
+    assert lat["p50"] > 0 and lat["p99"] >= lat["p50"] > 0
+    occ = snap["occupancy"]
+    assert sum(size * count for size, count in occ.items()) == n
+    assert sum(occ.values()) == snap["batches"] == backend.calls
+
+
+def test_submit_many_coalesces_from_one_caller():
+    model = _model()
+    backend = CountingBackend(model)
+    server = ModelServer(backend, max_batch=8, batch_timeout_ms=200.0)
+    rng = np.random.default_rng(1)
+    xs = [rng.normal(size=(4,)).astype(np.float32) for _ in range(8)]
+    outs = server.submit_many(xs, timeout=30)
+    server.shutdown()
+    assert backend.calls <= 1  # 8 samples, one full bucket-8 dispatch
+    np.testing.assert_allclose(np.stack(outs), _forward_batch(model, xs),
+                               rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# scheduler deadline + ragged shapes
+# ---------------------------------------------------------------------------
+
+def test_lone_request_served_at_timeout():
+    model = _model()
+    backend = CountingBackend(model)
+    server = ModelServer(backend, max_batch=8, batch_timeout_ms=20.0)
+    x = np.ones((4,), np.float32)
+    t0 = time.perf_counter()
+    y = server.submit(x, timeout=30)
+    elapsed = time.perf_counter() - t0
+    server.shutdown()
+    assert y.shape == (3,)
+    assert elapsed < 20.0, "lone request waited far beyond the deadline"
+    # one request -> one batch at bucket 1, occupancy histogram {1: 1}
+    assert server.metrics.occupancy_histogram() == {1: 1}
+    assert backend.batch_rows == [1]
+
+
+def test_undersized_batch_pads_to_bucket_and_drops():
+    model = _model()
+    backend = CountingBackend(model)
+    server = ModelServer(backend, max_batch=8, batch_timeout_ms=100.0)
+    rng = np.random.default_rng(2)
+    xs = [rng.normal(size=(4,)).astype(np.float32) for _ in range(3)]
+    outs = server.submit_many(xs, timeout=30)
+    server.shutdown()
+    assert len(outs) == 3
+    # 3 requests ride a padded bucket-of-4 dispatch
+    assert 4 in backend.batch_rows
+    assert server.metrics.padded_waste() > 0
+    np.testing.assert_allclose(np.stack(outs), _forward_batch(model, xs),
+                               rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# admission control
+# ---------------------------------------------------------------------------
+
+def _gated_server(policy, capacity=2):
+    model = _model()
+    backend = CountingBackend(model, gated=True)
+    server = ModelServer(backend, max_batch=1, batch_timeout_ms=0.0,
+                         queue_capacity=capacity, admission=policy)
+    return model, backend, server
+
+
+def _fill(server, backend, capacity):
+    """One request held inside the backend + ``capacity`` queued."""
+    x = np.ones((4,), np.float32)
+    futs = [server.submit_async(x)]
+    assert backend.entered.wait(timeout=10)
+    for _ in range(capacity):
+        futs.append(server.submit_async(x))
+    deadline = time.perf_counter() + 10
+    while server.queue_depth() < capacity:
+        assert time.perf_counter() < deadline
+        time.sleep(0.005)
+    return x, futs
+
+
+def test_queue_full_reject_policy():
+    _, backend, server = _gated_server("reject", capacity=2)
+    x, futs = _fill(server, backend, 2)
+    with pytest.raises(QueueFullError):
+        server.submit_async(x)
+    assert server.metrics.snapshot()["rejected"] == 1
+    backend.gate.set()
+    server.shutdown(drain=True)
+    for f in futs:
+        assert f.result(timeout=10).shape == (3,)
+
+
+def test_queue_full_shed_oldest_policy():
+    _, backend, server = _gated_server("shed_oldest", capacity=2)
+    x, futs = _fill(server, backend, 2)
+    late = server.submit_async(2 * x)
+    # the OLDEST queued request (futs[1]; futs[0] is already on device)
+    # was shed in favor of the newcomer
+    with pytest.raises(RequestSheddedError):
+        futs[1].result(timeout=10)
+    assert server.metrics.snapshot()["shed"] == 1
+    backend.gate.set()
+    server.shutdown(drain=True)
+    assert futs[0].result(timeout=10).shape == (3,)
+    assert futs[2].result(timeout=10).shape == (3,)
+    assert late.result(timeout=10).shape == (3,)
+
+
+def test_queue_full_block_policy_waits_for_space():
+    _, backend, server = _gated_server("block", capacity=1)
+    x, futs = _fill(server, backend, 1)
+    done = threading.Event()
+    extra = []
+
+    def blocked_submit():
+        extra.append(server.submit_async(x))
+        done.set()
+
+    t = threading.Thread(target=blocked_submit)
+    t.start()
+    time.sleep(0.05)
+    assert not done.is_set(), "blocking submit should wait on a full queue"
+    backend.gate.set()  # scheduler drains -> space frees -> submit admitted
+    assert done.wait(timeout=10)
+    t.join()
+    server.shutdown(drain=True)
+    assert extra[0].result(timeout=10).shape == (3,)
+
+
+# ---------------------------------------------------------------------------
+# shutdown semantics
+# ---------------------------------------------------------------------------
+
+def test_shutdown_drains_queued_requests():
+    _, backend, server = _gated_server("block", capacity=4)
+    x, futs = _fill(server, backend, 4)
+    stopper = threading.Thread(target=server.shutdown,
+                               kwargs={"drain": True, "timeout": 30})
+    stopper.start()
+    backend.gate.set()
+    stopper.join(timeout=30)
+    assert not stopper.is_alive()
+    for f in futs:  # every admitted request was still served
+        assert f.result(timeout=10).shape == (3,)
+    with pytest.raises(ServerClosedError):
+        server.submit(x)
+
+
+def test_shutdown_discard_fails_queued_requests():
+    _, backend, server = _gated_server("block", capacity=3)
+    x, futs = _fill(server, backend, 3)
+    stopper = threading.Thread(target=server.shutdown,
+                               kwargs={"drain": False, "timeout": 30})
+    stopper.start()
+    backend.gate.set()
+    stopper.join(timeout=30)
+    assert futs[0].result(timeout=10).shape == (3,)  # in-flight finishes
+    for f in futs[1:]:
+        with pytest.raises(ServerClosedError):
+            f.result(timeout=10)
+
+
+def test_backend_error_propagates_to_futures():
+    def broken(x):
+        raise RuntimeError("device on fire")
+
+    server = ModelServer(broken, max_batch=2, batch_timeout_ms=5.0)
+    fut = server.submit_async(np.ones((4,), np.float32))
+    with pytest.raises(RuntimeError, match="device on fire"):
+        fut.result(timeout=10)
+    # the scheduler survives a failing batch and serves the next one
+    fut2 = server.submit_async(np.ones((4,), np.float32))
+    with pytest.raises(RuntimeError):
+        fut2.result(timeout=10)
+    server.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# backends: Module, quantized int8, PredictionService
+# ---------------------------------------------------------------------------
+
+def test_module_backend_and_warmup():
+    model = _model()
+    server = ModelServer(model, max_batch=4, batch_timeout_ms=5.0)
+    server.warmup(np.zeros((4,), np.float32))
+    # warmup never touches request metrics
+    assert server.metrics.snapshot()["requests"] == 0
+    y = server.submit(np.ones((4,), np.float32), timeout=30)
+    server.shutdown()
+    want = _forward_batch(model, [np.ones((4,), np.float32)])[0]
+    np.testing.assert_allclose(y, want, rtol=1e-5)
+
+
+def test_quantized_int8_backend():
+    from bigdl_tpu.nn.quantized import quantize
+    model = _model()
+    qmodel = quantize(model)
+    server = ModelServer(qmodel, max_batch=4, batch_timeout_ms=10.0)
+    rng = np.random.default_rng(4)
+    xs = [rng.normal(size=(4,)).astype(np.float32) for _ in range(5)]
+    outs = server.submit_many(xs, timeout=30)
+    server.shutdown()
+    # row-wise activation quantization makes padded rows inert: serving
+    # through buckets must agree with the quantized model's own batch
+    want = _forward_batch(qmodel, xs)
+    np.testing.assert_allclose(np.stack(outs), want, rtol=1e-5, atol=1e-6)
+
+
+def test_prediction_service_serve_frontend():
+    from bigdl_tpu.optim import PredictionService
+    model = _model()
+    svc = PredictionService(model, concurrency=2)
+    server = svc.serve(max_batch=4, batch_timeout_ms=10.0)
+    rng = np.random.default_rng(5)
+    xs = [rng.normal(size=(4,)).astype(np.float32) for _ in range(6)]
+    outs = server.submit_many(xs, timeout=30)
+    server.shutdown()
+    np.testing.assert_allclose(np.stack(outs), _forward_batch(model, xs),
+                               rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# metrics export through the visualization event-file writer
+# ---------------------------------------------------------------------------
+
+def test_metrics_publish_tensorboard_roundtrip(tmp_path):
+    from bigdl_tpu.visualization import ServingSummary
+    model = _model()
+    server = ModelServer(model, max_batch=4, batch_timeout_ms=5.0)
+    rng = np.random.default_rng(6)
+    server.submit_many([rng.normal(size=(4,)).astype(np.float32)
+                        for _ in range(6)], timeout=30)
+    server.shutdown()
+    summary = ServingSummary(str(tmp_path), "serve-test")
+    server.publish_metrics(summary, step=7)
+    summary.flush()
+    got = dict(summary.read_scalar("serving/latency_ms_p50"))
+    assert got[7] > 0
+    reqs = dict(summary.read_scalar("serving/requests"))
+    assert reqs[7] == 6.0
+    summary.close()
+
+
+def test_metrics_registry_empty_snapshot():
+    reg = MetricsRegistry()
+    snap = reg.snapshot()
+    assert snap["requests"] == 0
+    assert snap["latency_ms"]["p99"] == 0.0
+    assert snap["padded_waste"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# CLI demo (python -m bigdl_tpu.serving)
+# ---------------------------------------------------------------------------
+
+def test_cli_stdin_stdout_autoencoder():
+    from bigdl_tpu.serving.__main__ import main
+    rng = np.random.default_rng(7)
+    lines = "\n".join(" ".join(f"{v:.4f}" for v in rng.normal(size=784))
+                      for _ in range(3))
+    stdout, stderr = io.StringIO(), io.StringIO()
+    rc = main(["--model", "autoencoder", "--max-batch", "2",
+               "--no-warmup"],
+              stdin=io.StringIO(lines + "\n"), stdout=stdout, stderr=stderr)
+    assert rc == 0
+    out_lines = stdout.getvalue().strip().splitlines()
+    assert len(out_lines) == 3
+    idx, cls, score = out_lines[1].split("\t")
+    assert idx == "1" and int(cls) >= 1 and np.isfinite(float(score))
+    import json
+    snap = json.loads(stderr.getvalue().strip().splitlines()[-1])
+    assert snap["requests"] == 3
+
+
+@pytest.mark.slow
+def test_cli_synthetic_lenet5_quantized(tmp_path):
+    """Heavy end-to-end: int8 LeNet-5 through warmup of every bucket
+    plus TensorBoard metrics publication."""
+    from bigdl_tpu.serving.__main__ import main
+    stdout, stderr = io.StringIO(), io.StringIO()
+    rc = main(["--model", "lenet5", "--quantize", "--synthetic", "5",
+               "--max-batch", "4", "--log-dir", str(tmp_path)],
+              stdin=io.StringIO(""), stdout=stdout, stderr=stderr)
+    assert rc == 0
+    assert len(stdout.getvalue().strip().splitlines()) == 5
+    assert "metrics event file" in stderr.getvalue()
+
+
+@pytest.mark.slow
+def test_soak_mixed_concurrency_fifo_order():
+    """Soak: many bursts from many threads; every result must match the
+    oracle (no cross-request row mixups under sustained load)."""
+    model = _model()
+    server = ModelServer(model, max_batch=8, batch_timeout_ms=2.0,
+                         queue_capacity=256)
+    rng = np.random.default_rng(8)
+    xs = [rng.normal(size=(4,)).astype(np.float32) for _ in range(200)]
+    outs = [None] * len(xs)
+
+    def work(lo, hi):
+        for i in range(lo, hi):
+            outs[i] = server.submit(xs[i], timeout=60)
+
+    threads = [threading.Thread(target=work, args=(i * 25, (i + 1) * 25))
+               for i in range(8)]
+    [t.start() for t in threads]
+    [t.join() for t in threads]
+    server.shutdown()
+    np.testing.assert_allclose(np.stack(outs), _forward_batch(model, xs),
+                               rtol=1e-5)
+    assert server.metrics.snapshot()["requests"] == 200
+
+
+def test_zoo_registry():
+    from bigdl_tpu.models import zoo, zoo_sample_shape
+    m = zoo("autoencoder")
+    assert hasattr(m, "forward")
+    assert zoo_sample_shape("lenet5") == (784,)
+    with pytest.raises(ValueError):
+        zoo("not_a_model")
+
+
+def test_cancelled_future_does_not_kill_scheduler():
+    """A future cancelled while queued must be dropped at dispatch, not
+    raise InvalidStateError inside the single scheduler thread."""
+    _, backend, server = _gated_server("block", capacity=4)
+    x, futs = _fill(server, backend, 3)
+    assert futs[1].cancel()  # still queued -> cancellable
+    backend.gate.set()
+    # the remaining queued requests are still served by a live scheduler
+    assert futs[0].result(timeout=10).shape == (3,)
+    assert futs[2].result(timeout=10).shape == (3,)
+    assert futs[3].result(timeout=10).shape == (3,)
+    y = server.submit(x, timeout=10)  # scheduler survived the cancel
+    assert y.shape == (3,)
+    server.shutdown()
+
+
+def test_cli_overload_prints_error_rows():
+    """Under shed_oldest the CLI emits ERROR rows for shed requests and
+    still prints the metrics snapshot."""
+    import json
+    from bigdl_tpu.serving.__main__ import main
+    stdout, stderr = io.StringIO(), io.StringIO()
+    rc = main(["--model", "autoencoder", "--synthetic", "40",
+               "--max-batch", "1", "--batch-timeout-ms", "0",
+               "--queue-capacity", "1", "--policy", "shed_oldest",
+               "--no-warmup"],
+              stdin=io.StringIO(""), stdout=stdout, stderr=stderr)
+    assert rc == 0
+    lines = stdout.getvalue().strip().splitlines()
+    assert len(lines) == 40  # one row per sample, served or ERROR
+    snap = json.loads(stderr.getvalue().strip().splitlines()[-1])
+    served = sum(1 for ln in lines if "\tERROR\t" not in ln)
+    assert served == snap["requests"]
+    assert snap["shed"] == sum(1 for ln in lines if "RequestSheddedError" in ln)
+
+
+def test_shed_of_cancelled_future_does_not_crash_submitter():
+    """shed_oldest popping a future the client already cancelled must
+    not raise InvalidStateError in the submitting thread."""
+    _, backend, server = _gated_server("shed_oldest", capacity=2)
+    x, futs = _fill(server, backend, 2)
+    assert futs[1].cancel()          # oldest queued request, cancelled
+    late = server.submit_async(x)    # sheds the cancelled one: no crash
+    backend.gate.set()
+    server.shutdown(drain=True)
+    assert late.result(timeout=10).shape == (3,)
+
+
+def test_discard_shutdown_with_cancelled_future():
+    _, backend, server = _gated_server("block", capacity=2)
+    x, futs = _fill(server, backend, 2)
+    assert futs[1].cancel()
+    stopper = threading.Thread(target=server.shutdown,
+                               kwargs={"drain": False, "timeout": 30})
+    stopper.start()
+    backend.gate.set()
+    stopper.join(timeout=30)
+    assert not stopper.is_alive()    # close() survived the cancelled future
+    with pytest.raises(ServerClosedError):
+        futs[2].result(timeout=10)
+
+
+def test_tuple_output_model_through_both_backends():
+    """Multi-head models (tuple outputs, different head shapes) must
+    round-trip per-request through Module AND PredictionService
+    backends."""
+    from bigdl_tpu.core.module import Module
+    from bigdl_tpu.optim import PredictionService
+
+    class TwoHead(Module):
+        def __init__(self):
+            super().__init__()
+            self.a = nn.Linear(4, 3)
+            self.b = nn.Linear(4, 5)
+
+        def forward(self, x):
+            return self.a(x), self.b(x)
+
+    set_seed(9)
+    model = TwoHead()
+    rng = np.random.default_rng(10)
+    xs = [rng.normal(size=(4,)).astype(np.float32) for _ in range(3)]
+    import jax.numpy as jnp
+    ref = model.clone().eval_mode()
+    wa, wb = (np.asarray(a) for a in ref.forward(
+        jnp.stack([jnp.asarray(x) for x in xs])))
+
+    for backend in (model, PredictionService(model)):
+        server = ModelServer(backend, max_batch=2, batch_timeout_ms=10.0)
+        outs = server.submit_many(xs, timeout=30)
+        server.shutdown()
+        for i, (ya, yb) in enumerate(outs):
+            assert ya.shape == (3,) and yb.shape == (5,)
+            np.testing.assert_allclose(ya, wa[i], rtol=1e-5)
+            np.testing.assert_allclose(yb, wb[i], rtol=1e-5)
+
+
+def test_http_server_with_dynamic_batching():
+    """examples/serve.py --dynamic-batch path: concurrent HTTP clients
+    coalesce through the ModelServer behind the npy byte protocol."""
+    import http.client
+    from bigdl_tpu.examples.serve import make_server, BatchedBytesFrontend
+
+    model = _model()
+    backend = CountingBackend(model)
+    mserver = ModelServer(backend, max_batch=4, batch_timeout_ms=200.0)
+    httpd = make_server(BatchedBytesFrontend(mserver), "127.0.0.1", 0)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        port = httpd.server_port
+        rng = np.random.default_rng(11)
+        xs = [rng.normal(size=(4,)).astype(np.float32) for _ in range(8)]
+        outs = [None] * len(xs)
+
+        def post(i):
+            buf = io.BytesIO()
+            np.save(buf, xs[i], allow_pickle=False)
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+            conn.request("POST", "/predict", buf.getvalue())
+            outs[i] = np.load(io.BytesIO(conn.getresponse().read()),
+                              allow_pickle=False)
+            conn.close()
+
+        threads = [threading.Thread(target=post, args=(i,))
+                   for i in range(len(xs))]
+        [th.start() for th in threads]
+        [th.join() for th in threads]
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        mserver.shutdown()
+    np.testing.assert_allclose(np.stack(outs), _forward_batch(model, xs),
+                               rtol=1e-5)
+    # HTTP threads coalesced: fewer device calls than requests
+    assert backend.calls <= math.ceil(len(xs) / 4)
+
+
+def test_submit_timeout_bounds_blocked_admission():
+    """submit(x, timeout=N) must give up after ~N even when the queue is
+    full under the block policy (wedged-backend scenario)."""
+    _, backend, server = _gated_server("block", capacity=1)
+    x, futs = _fill(server, backend, 1)
+    t0 = time.perf_counter()
+    with pytest.raises(QueueFullError):
+        server.submit(x, timeout=0.3)
+    assert time.perf_counter() - t0 < 5.0
+    backend.gate.set()
+    server.shutdown(drain=True)
+    for f in futs:
+        assert f.result(timeout=10).shape == (3,)
+
+
+def test_weighted_histogram_matches_expanded():
+    """make_histogram(values, weights) ≡ make_histogram(expanded)."""
+    from bigdl_tpu.visualization.proto import make_histogram
+    occ = {1: 3, 2: 7, 4: 2, 8: 1}
+    sizes = sorted(occ)
+    weighted = make_histogram(np.asarray(sizes, np.float64),
+                              weights=[occ[s] for s in sizes])
+    expanded = make_histogram(np.concatenate(
+        [np.full(c, s, np.float64) for s, c in occ.items()]))
+    assert weighted.num == expanded.num == 13
+    assert weighted.sum == expanded.sum
+    assert weighted.sum_squares == expanded.sum_squares
+    assert weighted.bucket == expanded.bucket
+    assert weighted.min == expanded.min and weighted.max == expanded.max
