@@ -238,6 +238,18 @@ def _probe_backend_subprocess(wait_s: float) -> Optional[bool]:
             _log(f"backend probe: rc={rc} out={out!r}")
             return rc == 0 and bool(out)
         time.sleep(1.0)
+    # one final poll: the 1s poll cadence leaves a window where the
+    # child EXITED just after the deadline — that child answered
+    # (healthy or not), so the tunnel is not wedged; classify it like
+    # any other exit (False → retryable) instead of abandoning (None →
+    # terminal, no more clients this run)
+    rc = proc.poll()
+    if rc is not None:
+        out = (proc.stdout.read() or "").strip()
+        _log(f"backend probe: exited just past the {wait_s:.0f}s "
+             f"deadline (rc={rc} out={out!r}) — slow, not wedged; "
+             "retry is safe")
+        return rc == 0 and bool(out)
     _log(f"backend probe: still hanging after {wait_s:.0f}s — "
          f"abandoning the child UNKILLED (pid {proc.pid}; a kill "
          "mid-init is what wedges the tunnel). If that child turns out "

@@ -23,6 +23,11 @@ def base_parser(description: str) -> argparse.ArgumentParser:
     p.add_argument("-r", "--learning-rate", type=float, default=0.05)
     p.add_argument("--checkpoint", default=None,
                    help="directory for per-epoch checkpoints")
+    p.add_argument("--keep-checkpoints", type=_positive_int, default=None,
+                   metavar="N",
+                   help="keep the newest N good checkpoint generations "
+                        "(numbered checkpoints + retention GC; default: "
+                        "one overwritten checkpoint file)")
     p.add_argument("--state", default=None,
                    help="checkpoint file to resume from")
     p.add_argument("--summary-dir", default=None,
@@ -65,7 +70,8 @@ def apply_common(opt, args, train_summary=None, val_summary=None):
         import jax.numpy as jnp
         opt.set_compute_dtype(jnp.bfloat16)
     if args.checkpoint:
-        opt.set_checkpoint(args.checkpoint, Trigger.every_epoch())
+        opt.set_checkpoint(args.checkpoint, Trigger.every_epoch(),
+                           keep_n=args.keep_checkpoints)
     if args.state:
         opt.resume(args.state)
     if train_summary is not None:

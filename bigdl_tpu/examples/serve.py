@@ -116,6 +116,21 @@ def main(argv=None):
         service = BatchedBytesFrontend(batcher)
     server = make_server(service, args.host, args.port)
     logger.info("serving on %s:%d", args.host, server.server_port)
+    # SIGTERM (the orchestrator's stop notice) takes the same graceful
+    # path as Ctrl-C: unwind serve_forever, then drain the batcher so
+    # in-flight batched requests complete before the process exits
+    # (mirrors the training loop's preemption handling)
+    import signal
+
+    def _sigterm(signum, frame):
+        logger.info("signal %d: shutting down, draining in-flight "
+                    "requests", signum)
+        raise KeyboardInterrupt
+
+    try:
+        prev_term = signal.signal(signal.SIGTERM, _sigterm)
+    except ValueError:  # non-main thread (tests): keep default handling
+        prev_term = None
     try:
         server.serve_forever()
     except KeyboardInterrupt:
@@ -126,6 +141,8 @@ def main(argv=None):
             # the documented drain: queued requests are answered before
             # the scheduler thread exits
             batcher.shutdown(drain=True)
+        if prev_term is not None:
+            signal.signal(signal.SIGTERM, prev_term)
     return server
 
 

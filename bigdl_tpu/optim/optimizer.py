@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import logging
 import os
+import random
 import threading
 import time
 from functools import partial
@@ -51,9 +52,8 @@ from bigdl_tpu.parallel.mesh import (
 from bigdl_tpu.parallel.sharding import (
     ShardingRules, shard_model_params, replicated,
 )
-from bigdl_tpu.utils.file import (
-    save_checkpoint, save_checkpoint_sharded, load_checkpoint,
-)
+from bigdl_tpu.utils import chaos
+from bigdl_tpu.utils.file import CheckpointManager, load_checkpoint
 from bigdl_tpu.utils.xla_cost import compiled_flops
 from bigdl_tpu.optim.metrics import Metrics
 from bigdl_tpu.utils.rng import get_seed
@@ -64,6 +64,22 @@ logger = logging.getLogger("bigdl_tpu.optim")
 # the saved orbax tree and the resume-time abstract tree always match
 # structurally (self.state grows transient keys during the loop)
 _DRIVER_KEYS = ("epoch", "neval", "records", "loss", "score")
+
+# Exception types that signal a PROGRAMMING error: retrying from a
+# checkpoint would re-run the same code into the same wall, burning the
+# whole retry budget on a bug.  Everything else (OSError, RuntimeError —
+# including jaxlib's XlaRuntimeError subclass — ConnectionError,
+# chaos.FaultInjected) is treated as transient: preemption, collective
+# timeouts, and IO blips all surface as runtime errors.
+_NON_RETRYABLE = (ValueError, TypeError, KeyError, IndexError,
+                  AttributeError, NameError, AssertionError,
+                  NotImplementedError, ZeroDivisionError, ImportError,
+                  SyntaxError)
+
+
+def _is_transient(e: BaseException) -> bool:
+    return not isinstance(e, _NON_RETRYABLE)
+
 
 __all__ = ["Optimizer"]
 
@@ -126,6 +142,17 @@ class Optimizer:
             "BIGDL_TPU_FAILURE_RETRY_TIMES", "5"))
         self.retry_interval_s = float(os.environ.get(
             "BIGDL_TPU_FAILURE_RETRY_INTERVAL_S", "120"))
+        self.retry_backoff_s = float(os.environ.get(
+            "BIGDL_TPU_FAILURE_BACKOFF_S", "1.0"))
+        self.retry_backoff_cap_s = float(os.environ.get(
+            "BIGDL_TPU_FAILURE_BACKOFF_CAP_S", "60.0"))
+        self.retry_jitter = 0.25
+        self.checkpoint_keep_n: Optional[int] = None
+        self._ckpt_mgr: Optional[CheckpointManager] = None
+        # preemption (SIGTERM) handling: the handler only sets this
+        # flag; the loop acts on it at the next safe step boundary
+        self._preempt_requested = False
+        self.preempted = False
 
     # ---- configuration (reference Optimizer.scala setters) -------------
 
@@ -160,15 +187,25 @@ class Optimizer:
 
     def set_checkpoint(self, path: str, trigger: Trigger,
                        is_overwrite: bool = True,
-                       sharded: bool = False) -> "Optimizer":
+                       sharded: bool = False,
+                       keep_n: Optional[int] = None) -> "Optimizer":
         """``sharded=True`` writes orbax checkpoint DIRECTORIES whose
         array shards are saved by their owning hosts — required once
         parameters are sharded across hosts (the default ``.npz``
-        format gathers every leaf to the saving host)."""
+        format gathers every leaf to the saving host).
+
+        ``keep_n`` keeps that many good checkpoint generations and
+        garbage-collects older ones (implies numbered checkpoints, so
+        ``is_overwrite`` is forced off).  All checkpoints commit
+        atomically with a CRC manifest; resume-after-failure walks back
+        past corrupt or uncommitted generations (see
+        docs/fault_tolerance.md)."""
         self.checkpoint_path = path
         self.checkpoint_trigger = trigger
-        self.overwrite_checkpoint = is_overwrite
+        self.overwrite_checkpoint = is_overwrite and keep_n is None
         self.checkpoint_sharded = sharded
+        self.checkpoint_keep_n = keep_n
+        self._ckpt_mgr = None
         return self
 
     def resume(self, checkpoint_file: str) -> "Optimizer":
@@ -465,6 +502,11 @@ class Optimizer:
             raise ValueError(
                 "validation dataset produced no batches (empty split, or "
                 "fewer samples than one batch)")
+        if getattr(self, "_val_sharded", False):
+            from bigdl_tpu.optim.validation import (
+                aggregate_across_processes,
+            )
+            results = aggregate_across_processes(results)
         out = {}
         for m, r in zip(self.val_methods, results):
             out[m.fmt] = r
@@ -472,90 +514,151 @@ class Optimizer:
         return out
 
     def set_failure_retry(self, times: int,
-                          interval_s: float = 120.0) -> "Optimizer":
-        """Retry training from the latest checkpoint after a failure, up
-        to ``times`` retries; the counter resets when more than
-        ``interval_s`` passed since the previous failure (reference
-        bigdl.failure.retryTimes / retryTimeInterval,
+                          interval_s: float = 120.0,
+                          backoff_s: Optional[float] = None,
+                          backoff_cap_s: Optional[float] = None,
+                          jitter: Optional[float] = None) -> "Optimizer":
+        """Retry training from the latest GOOD checkpoint after a
+        transient failure, up to ``times`` retries; the counter resets
+        when more than ``interval_s`` passed since the previous failure
+        (reference bigdl.failure.retryTimes / retryTimeInterval,
         DistriOptimizer.scala:901-983).  On TPU pods this covers
-        preemption and transient runtime errors."""
+        preemption and transient runtime errors.
+
+        Between retries the driver sleeps ``backoff_s * 2**attempt``
+        (capped at ``backoff_cap_s``) with ±``jitter`` relative noise —
+        a whole pod retrying in lockstep would stampede the storage /
+        scheduler that just failed it.  Programming errors (ValueError,
+        TypeError, ...) are re-raised immediately without burning
+        retries."""
         self.retry_times = int(times)
         self.retry_interval_s = float(interval_s)
+        if backoff_s is not None:
+            self.retry_backoff_s = float(backoff_s)
+        if backoff_cap_s is not None:
+            self.retry_backoff_cap_s = float(backoff_cap_s)
+        if jitter is not None:
+            self.retry_jitter = float(jitter)
         return self
 
+    def _ckpt_manager(self) -> CheckpointManager:
+        if self._ckpt_mgr is None \
+                or self._ckpt_mgr.directory != self.checkpoint_path:
+            self._ckpt_mgr = CheckpointManager(
+                self.checkpoint_path, keep_n=self.checkpoint_keep_n)
+        return self._ckpt_mgr
+
     def _latest_checkpoint(self) -> Optional[str]:
+        """Newest checkpoint that is committed AND passes integrity
+        validation — NOT simply the newest file: the failure this path
+        serves (a crash mid-checkpoint) is exactly the one that leaves
+        the newest file truncated, and resuming from it would fail
+        every retry."""
         if not self.checkpoint_path:
             return None
-        from bigdl_tpu.utils.file import is_remote_path
-        if is_remote_path(self.checkpoint_path):
-            try:
-                import re
-                import fsspec
-                fs, root = fsspec.core.url_to_fs(self.checkpoint_path)
-                entries = [e for e in fs.ls(root, detail=True)
-                           if os.path.basename(
-                               e["name"]).startswith("checkpoint")
-                           and (e["name"].endswith(".npz")
-                                or e["name"].rstrip("/")
-                                .endswith(".orbax"))]
-                if not entries:
-                    return None
-                mtimes = [e.get("mtime") for e in entries]
-                if all(m is not None for m in mtimes):
-                    best = max(entries, key=lambda e: e["mtime"])
-                else:
-                    # no reliable mtimes: order by the numeric iteration
-                    # suffix (checkpoint.<neval>.npz), then name
-                    def key(e):
-                        m = re.search(
-                            r"checkpoint\.(\d+)\.(?:npz|orbax)/?$",
-                            e["name"])
-                        return (int(m.group(1)) if m else -1, e["name"])
-                    best = max(entries, key=key)
-                scheme = self.checkpoint_path.split("://", 1)[0]
-                return f"{scheme}://{best['name']}"
-            except Exception:
-                logger.warning("could not list remote checkpoint dir %s",
-                               self.checkpoint_path, exc_info=True)
-                return None
-        if not os.path.isdir(self.checkpoint_path):
+        try:
+            return self._ckpt_manager().latest_good()
+        except Exception:
+            logger.warning("could not determine latest good checkpoint "
+                           "in %s", self.checkpoint_path, exc_info=True)
             return None
-        files = [os.path.join(self.checkpoint_path, f)
-                 for f in os.listdir(self.checkpoint_path)
-                 if f.startswith("checkpoint")
-                 and (f.endswith(".npz") or f.endswith(".orbax"))]
-        return max(files, key=os.path.getmtime) if files else None
+
+    def _backoff_delay(self, attempt: int) -> float:
+        base = min(self.retry_backoff_s * (2.0 ** attempt),
+                   self.retry_backoff_cap_s)
+        j = self.retry_jitter
+        return max(base * random.uniform(1.0 - j, 1.0 + j), 0.0)
+
+    # ---- preemption (SIGTERM) handling -----------------------------------
+
+    def _install_preemption_handler(self):
+        """SIGTERM (the TPU-pod preemption notice) must not kill the
+        process mid-collective — a host dying inside a psum wedges every
+        other host in the ring.  The handler only sets a flag; the train
+        loop honors it at the next step boundary by writing a final
+        checkpoint and returning cleanly.  Returns a restore() callable;
+        no-op off the main thread (signal.signal would raise).
+
+        Multi-host note: the flag is process-local.  TPU maintenance
+        events deliver the preemption notice to EVERY worker, and each
+        host then breaks at the same step boundary (steps are lockstep
+        SPMD), so the final-checkpoint collectives line up.  Signaling
+        a SUBSET of hosts by hand is outside that contract — the
+        signaled hosts would enter the checkpoint collective while the
+        rest keep training."""
+        self._preempt_requested = False
+        self.preempted = False
+        if threading.current_thread() is not threading.main_thread():
+            return lambda: None
+        import signal
+
+        def handler(signum, frame):
+            logger.warning(
+                "received signal %d (preemption notice): requesting a "
+                "final checkpoint at the next step boundary", signum)
+            self._preempt_requested = True
+
+        try:
+            prev = signal.signal(signal.SIGTERM, handler)
+        except (ValueError, OSError):  # pragma: no cover - exotic host
+            return lambda: None
+
+        def restore():
+            try:
+                signal.signal(signal.SIGTERM, prev)
+            except (ValueError, OSError):  # pragma: no cover
+                pass
+        return restore
 
     # ---- main loop (≙ DistriOptimizer.optimize, :823) --------------------
 
     def optimize(self) -> Module:
-        """Run training, retrying from the latest checkpoint on failure
-        (≙ the reference's retry loop around optimize,
-        DistriOptimizer.scala:901-983)."""
+        """Run training, retrying from the latest good checkpoint on
+        transient failure with exponential backoff (≙ the reference's
+        retry loop around optimize, DistriOptimizer.scala:901-983).
+        Programming errors re-raise immediately; SIGTERM triggers a
+        final checkpoint and a clean return (``self.preempted`` set)."""
         retries_left = self.retry_times
         last_failure = None
-        while True:
-            try:
-                return self._optimize_once()
-            except KeyboardInterrupt:
-                raise
-            except Exception as e:
-                self._stop_flush_worker()
-                self._flush_summaries()  # keep the failed attempt's tail
-                now = time.time()
-                if last_failure is not None and \
-                        now - last_failure > self.retry_interval_s:
-                    retries_left = self.retry_times
-                last_failure = now
-                ckpt = self._latest_checkpoint()
-                if retries_left <= 0 or ckpt is None:
+        attempt = 0
+        restore_signal = self._install_preemption_handler()
+        try:
+            while True:
+                try:
+                    return self._optimize_once()
+                except KeyboardInterrupt:
                     raise
-                retries_left -= 1
-                logger.warning(
-                    "training failed (%s: %s); resuming from %s "
-                    "(%d retr%s left)", type(e).__name__, e, ckpt,
-                    retries_left, "y" if retries_left == 1 else "ies")
-                self._resume_from = ckpt
+                except Exception as e:
+                    self._stop_flush_worker()
+                    self._flush_summaries()  # keep the failed tail
+                    if not _is_transient(e):
+                        logger.error(
+                            "training failed with non-retryable %s: %s "
+                            "(programming error — retrying would hit the "
+                            "same wall)", type(e).__name__, e)
+                        raise
+                    now = time.time()
+                    if last_failure is not None and \
+                            now - last_failure > self.retry_interval_s:
+                        retries_left = self.retry_times
+                        attempt = 0
+                    last_failure = now
+                    ckpt = self._latest_checkpoint()
+                    if retries_left <= 0 or ckpt is None:
+                        raise
+                    retries_left -= 1
+                    delay = self._backoff_delay(attempt)
+                    attempt += 1
+                    logger.warning(
+                        "training failed (%s: %s); resuming from %s in "
+                        "%.1fs (%d retr%s left)", type(e).__name__, e,
+                        ckpt, delay, retries_left,
+                        "y" if retries_left == 1 else "ies")
+                    if delay > 0:
+                        time.sleep(delay)
+                    self._resume_from = ckpt
+        finally:
+            restore_signal()
 
     def _flush_summaries(self) -> None:
         for s in (self.train_summary, self.val_summary):
@@ -599,20 +702,16 @@ class Optimizer:
                 "dataset (DataSet.sharded); a replicated dataset would "
                 "silently feed every sample process_count times per "
                 "epoch")
-        if jax.process_count() > 1 and self.val_dataset is not None \
-                and getattr(self.val_dataset, "per_process_sharded",
-                            lambda: False)():
-            # _validate aggregates eval stats process-locally, so a
-            # sharded val split would give each process a different
-            # score: score-based triggers (best-score checkpointing,
-            # end_when) then branch differently per process and the
-            # owning-host sharded-checkpoint collectives desynchronize
-            # (hang) — require replicated validation data instead
-            raise ValueError(
-                "validation dataset must be replicated across "
-                "processes, not per-process-sharded: every process has "
-                "to compute identical validation scores or score-based "
-                "triggers desynchronize the checkpoint collectives")
+        # Per-process-sharded validation splits are supported: _validate
+        # accumulates (n, d) stats process-locally, then psums the
+        # counts across processes so every process computes IDENTICAL
+        # global scores — score-based triggers (best-score
+        # checkpointing, end_when) stay in lockstep and the owning-host
+        # sharded-checkpoint collectives never desynchronize.
+        self._val_sharded = (
+            jax.process_count() > 1 and self.val_dataset is not None
+            and getattr(self.val_dataset, "per_process_sharded",
+                        lambda: False)())
 
         from bigdl_tpu.utils.file import is_sharded_checkpoint_path
         resume_sharded = bool(self._resume_from) \
@@ -996,6 +1095,11 @@ class Optimizer:
                             and self.state["neval"] >= prof_start):
                         jax.profiler.start_trace(self.profile_dir)
                         prof_active = True
+                    # fault-injection hook: raises BEFORE the window
+                    # dispatches, so injected failures land between
+                    # steps exactly like a real preemption
+                    for _ci in range(len(group)):
+                        chaos.on_step(self.state["neval"] + _ci)
                     it_start = time.time()
                     if len(group) > 1:
                         ckey = (tuple(id(b) for b in group)
@@ -1093,7 +1197,24 @@ class Optimizer:
                         # records, loss logging) must complete even if
                         # a custom end trigger fires mid-window —
                         # otherwise checkpoints disagree with weights
-                        stop = stop or bool(self.end_when(self.state))
+                        stop = (stop or bool(self.end_when(self.state))
+                                or self._preempt_requested)
+                if self._preempt_requested:
+                    # SIGTERM landed: this is the requested safe step
+                    # boundary — no collective is in flight.  Write the
+                    # final checkpoint and return cleanly instead of
+                    # dying mid-epoch (the epoch counter must NOT
+                    # advance: the epoch is unfinished and resume has
+                    # to replay its remaining batches).
+                    flush_pending(params_groups, rest, opt_states,
+                                  sync=True)
+                    self._preemption_checkpoint(params_groups, rest,
+                                                opt_states)
+                    self.preempted = True
+                    logger.warning(
+                        "preemption: exiting training cleanly at epoch "
+                        "%d iteration %d", epoch, self.state["neval"])
+                    break
                 self.state["epoch"] += 1
                 self.state["is_epoch_end"] = True
                 flush_pending(params_groups, rest, opt_states,
@@ -1182,36 +1303,59 @@ class Optimizer:
                         sched.on_metric(self.state["score"])
         if do_ckpt:
             self._last_ckpt_neval = self.state["neval"]
-            tag = "" if self.overwrite_checkpoint \
-                else f".{self.state['neval']}"
             temp = combine(merged, rest)
             driver = {k: v for k, v in self.state.items()
                       if isinstance(v, (int, float))}
             with self.metrics.time("checkpoint time"):
-                if self.checkpoint_sharded:
-                    # device arrays pass through unchanged: each host
-                    # writes its own shards, no gather.  The driver
-                    # rides inside the orbax tree under a FIXED key set
-                    # (strict orbax restores match structures exactly;
-                    # self.state grows transient keys mid-loop)
-                    path = os.path.join(self.checkpoint_path,
-                                        f"checkpoint{tag}.orbax")
-                    save_checkpoint_sharded(
-                        path,
-                        {"params": temp.parameters(),
-                         "buffers": temp.buffers()},
-                        [s for s in opt_states],
-                        {k: driver[k] for k in _DRIVER_KEYS
-                         if k in driver})
-                else:
-                    path = os.path.join(self.checkpoint_path,
-                                        f"checkpoint{tag}.npz")
-                    save_checkpoint(
-                        path,
-                        {"params": _to_plain(temp.parameters()),
-                         "buffers": _to_plain(temp.buffers())},
-                        [s for s in opt_states], driver)
+                path = self._write_checkpoint(temp, opt_states, driver)
             logger.info("checkpoint written to %s", path)
+
+    def _write_checkpoint(self, temp, opt_states, driver) -> str:
+        """One checkpoint generation through the CheckpointManager:
+        atomic payload commit, CRC manifest, retention GC."""
+        mgr = self._ckpt_manager()
+        if self.checkpoint_sharded:
+            # device arrays pass through unchanged: each host writes
+            # its own shards, no gather.  The driver rides inside the
+            # orbax tree under a FIXED key set (strict orbax restores
+            # match structures exactly; self.state grows transient keys
+            # mid-loop)
+            return mgr.save(
+                {"params": temp.parameters(), "buffers": temp.buffers()},
+                [s for s in opt_states],
+                {k: driver[k] for k in _DRIVER_KEYS if k in driver},
+                generation=self.state["neval"],
+                overwrite=self.overwrite_checkpoint, sharded=True)
+        return mgr.save(
+            {"params": _to_plain(temp.parameters()),
+             "buffers": _to_plain(temp.buffers())},
+            [s for s in opt_states], driver,
+            generation=self.state["neval"],
+            overwrite=self.overwrite_checkpoint, sharded=False)
+
+    def _preemption_checkpoint(self, params_groups, rest, opt_states):
+        """The final checkpoint a SIGTERM requests; written outside any
+        trigger schedule so no progress since the last periodic
+        checkpoint is lost to the preemption."""
+        if not self.checkpoint_path:
+            logger.warning("preemption: no checkpoint path configured; "
+                           "exiting without a final checkpoint")
+            return
+        if self._last_ckpt_neval == self.state["neval"]:
+            return  # this exact boundary is already checkpointed
+        self._last_ckpt_neval = self.state["neval"]
+        temp = combine(self._merge_groups_host(params_groups), rest)
+        driver = {k: v for k, v in self.state.items()
+                  if isinstance(v, (int, float))}
+        try:
+            with self.metrics.time("checkpoint time"):
+                path = self._write_checkpoint(temp, opt_states, driver)
+            logger.info("preemption checkpoint written to %s", path)
+        except Exception:
+            # best effort: a failed final save must not turn a clean
+            # preemption exit into a crash (the periodic checkpoint
+            # still exists)
+            logger.exception("preemption checkpoint failed")
 
     def _sync_into(self, target: Module, source: Module):
         """Copy arrays from the trained functional copy back into the

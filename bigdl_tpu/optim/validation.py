@@ -24,7 +24,7 @@ __all__ = [
     "ValidationMethod", "Top1Accuracy", "Top5Accuracy", "TopKAccuracy",
     "Loss", "MAE", "HitRatio", "NDCG", "MeanAveragePrecision",
     "MeanAveragePrecisionObjectDetection", "PrecisionRecallAUC",
-    "TreeNNAccuracy",
+    "TreeNNAccuracy", "aggregate_across_processes",
 ]
 
 
@@ -169,6 +169,40 @@ class NDCG(ValidationMethod):
         gain = jnp.where(rank <= self.k,
                          jnp.log(2.0) / jnp.log(rank + 1.0), 0.0)
         return jnp.sum(gain), jnp.asarray(float(output.shape[0]))
+
+
+def aggregate_across_processes(results):
+    """Merge per-process validation accumulators into GLOBAL results:
+    each metric's (numerator, denominator) is summed over every process
+    (a psum on the counts), so a per-process-SHARDED validation split —
+    each host evaluating only its own samples — yields the same score
+    on every process.  That identity is what keeps score-based triggers
+    (best-score checkpointing, end_when) in lockstep across hosts; the
+    TPU equivalent of the reference's RDD aggregate over partitions.
+
+    Single-process: returns the results unchanged.  Array-accumulating
+    metrics (MAP/AUC) hold ragged per-process score lists that a count
+    psum cannot merge — they still require replicated validation data.
+    """
+    import jax
+    if jax.process_count() == 1:
+        return results
+    for r in results:
+        if isinstance(r, _ArrayResult):
+            raise ValueError(
+                f"{r.fmt} accumulates raw score arrays and cannot be "
+                "merged across processes by summing counts; evaluate it "
+                "on a replicated (non-sharded) validation dataset")
+    from jax.experimental import multihost_utils
+    # float64: counts above 2^24 (a 16.7M-sample val split) would round
+    # in float32 and skew the score (jax downcasts the gather to f32
+    # unless jax_enable_x64 is on — enable it for val splits that big)
+    stats = np.asarray([[r.numerator, r.denominator] for r in results],
+                       np.float64)
+    gathered = np.asarray(multihost_utils.process_allgather(stats))
+    total = gathered.reshape(-1, stats.shape[0], 2).sum(axis=0)
+    return [ValidationResult(float(n), float(d), r.fmt)
+            for r, (n, d) in zip(results, total)]
 
 
 # --------------------------------------------------------------------------

@@ -15,11 +15,14 @@ from bigdl_tpu.serving.batching import (       # noqa: F401
 )
 from bigdl_tpu.serving.metrics import MetricsRegistry      # noqa: F401
 from bigdl_tpu.serving.scheduler import BatchScheduler     # noqa: F401
-from bigdl_tpu.serving.server import ModelServer           # noqa: F401
+from bigdl_tpu.serving.server import (         # noqa: F401
+    ModelServer, install_shutdown_signals,
+)
 
 __all__ = [
     "ModelServer", "MetricsRegistry", "BatchScheduler",
     "BoundedRequestQueue", "Request",
     "QueueFullError", "RequestSheddedError", "ServerClosedError",
     "bucket_sizes", "pick_bucket", "stack_requests", "split_outputs",
+    "install_shutdown_signals",
 ]

@@ -55,6 +55,7 @@ def main(argv=None, stdin=None, stdout=None, stderr=None) -> int:
 
     from bigdl_tpu.models import zoo, zoo_sample_shape
     from bigdl_tpu.serving import ModelServer
+    from bigdl_tpu.serving.server import install_shutdown_signals
 
     model = zoo(args.model)
     shape = zoo_sample_shape(args.model)
@@ -86,14 +87,21 @@ def main(argv=None, stdin=None, stdout=None, stderr=None) -> int:
             yield np.array(line.split(), dtype=np.float32).reshape(shape)
 
     futures: List = []
+    restore_signals = install_shutdown_signals(server)
     try:
-        for s in sample_lines():
-            # reject/shed_oldest are part of the demo: an overloaded
-            # submit becomes an error row, not a crash
-            try:
-                futures.append(server.submit_async(s))
-            except Exception as e:
-                futures.append(e)
+        try:
+            for s in sample_lines():
+                # reject/shed_oldest are part of the demo: an overloaded
+                # submit becomes an error row, not a crash
+                try:
+                    futures.append(server.submit_async(s))
+                except Exception as e:
+                    futures.append(e)
+        except KeyboardInterrupt:
+            # SIGTERM/SIGINT mid-stream: stop reading, but the requests
+            # already admitted still drain and print below
+            print(f"interrupted: draining {len(futures)} in-flight "
+                  "requests", file=stderr)
         for i, f in enumerate(futures):
             try:
                 row = np.asarray(f.result() if not isinstance(f, Exception)
@@ -105,6 +113,7 @@ def main(argv=None, stdin=None, stdout=None, stderr=None) -> int:
             print(f"{i}\t{cls}\t{float(np.max(row)):.6f}", file=stdout)
     finally:
         server.shutdown(drain=True)
+        restore_signals()
 
     snap = server.metrics.snapshot()
     print(json.dumps(snap, sort_keys=True), file=stderr)

@@ -20,6 +20,7 @@ Backends — anything that can run a padded batch:
 from __future__ import annotations
 
 import logging
+import threading
 import time
 from concurrent.futures import Future
 from typing import Callable, List, Optional, Sequence, Union
@@ -33,9 +34,51 @@ from bigdl_tpu.serving.batching import bucket_sizes
 from bigdl_tpu.serving.metrics import MetricsRegistry
 from bigdl_tpu.serving.scheduler import BatchScheduler
 
-__all__ = ["ModelServer"]
+__all__ = ["ModelServer", "install_shutdown_signals"]
 
 logger = logging.getLogger(__name__)
+
+
+def install_shutdown_signals(server: "ModelServer",
+                             signals: Optional[Sequence[int]] = None):
+    """SIGTERM/SIGINT → graceful drain (mirrors the optimizer's
+    preemption handling): the handler raises KeyboardInterrupt in the
+    main thread so blocking loops (stdin reads, ``serve_forever``)
+    unwind into the caller's ``shutdown(drain=True)`` path — every
+    already-admitted request is still served before exit, instead of
+    dying with futures in flight.  (The handler deliberately does NOT
+    flip the server's shutdown state itself: ``shutdown()`` is
+    idempotent-guarded, and pre-marking it would turn the caller's real
+    drain call into a no-op.)
+
+    Returns a ``restore()`` callable reinstating the previous handlers.
+    No-op (returns a dummy restore) off the main thread, where
+    ``signal.signal`` is illegal."""
+    import signal as _signal
+    if threading.current_thread() is not threading.main_thread():
+        return lambda: None
+    sigs = tuple(signals) if signals is not None \
+        else (_signal.SIGTERM, _signal.SIGINT)
+    prev = {}
+
+    def handler(signum, frame):
+        logger.info("signal %s: unwinding to drain %d queued requests "
+                    "before exit", signum, len(server._queue))
+        raise KeyboardInterrupt
+
+    for s in sigs:
+        try:
+            prev[s] = _signal.signal(s, handler)
+        except (ValueError, OSError):  # pragma: no cover - exotic host
+            continue
+
+    def restore():
+        for s, h in prev.items():
+            try:
+                _signal.signal(s, h)
+            except (ValueError, OSError):  # pragma: no cover
+                pass
+    return restore
 
 
 def _module_backend(model) -> Callable:

@@ -1,0 +1,183 @@
+"""Fault-injection harness (chaos hooks) for the fault-tolerance path.
+
+The retry/checkpoint machinery exists for failures that are awkward to
+produce on demand: a TPU-pod preemption mid-collective, a crash halfway
+through a checkpoint write, a flaky filesystem.  This module injects
+exactly those faults at well-defined points so tests (and operators, via
+env vars) can PROVE crash→resume works instead of assuming it.
+
+Faults are driven either by the API::
+
+    from bigdl_tpu.utils import chaos
+    chaos.install(fail_at_step=7)            # raise at iteration 7
+    chaos.install(truncate_checkpoint=2)     # torn-write the 2nd commit
+    chaos.install(crash_checkpoint=2)        # die before the 2nd commit
+    chaos.install(io_fail_p=0.2, seed=1)     # 20% of writes raise OSError
+    ...
+    chaos.reset()
+
+or by environment variables (picked up lazily on the first hook call, so
+``BIGDL_TPU_CHAOS_FAIL_STEP=7 python train.py`` needs no code changes):
+
+* ``BIGDL_TPU_CHAOS_FAIL_STEP``     — raise :class:`FaultInjected` when
+  training reaches this iteration (fires once).
+* ``BIGDL_TPU_CHAOS_CRASH_CKPT``    — raise during the n-th checkpoint
+  save after the payload exists but BEFORE the commit marker/manifest:
+  the classic crash-mid-checkpoint, leaving an uncommitted generation.
+* ``BIGDL_TPU_CHAOS_TRUNCATE_CKPT`` — truncate the n-th checkpoint
+  payload after it commits (a torn write on a non-atomic store): the
+  manifest exists but the payload fails its CRC.
+* ``BIGDL_TPU_CHAOS_IO_FAIL_P``     — each checkpoint write raises
+  ``OSError`` with this probability (``BIGDL_TPU_CHAOS_SEED`` seeds it).
+
+Production code calls the module-level hook functions (``on_step``,
+``on_io_write``, ``on_checkpoint_payload``); each is a no-op returning
+immediately when no controller is installed and no env var is set.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import random
+import threading
+from typing import List, Optional
+
+__all__ = ["FaultInjected", "ChaosController", "install", "reset",
+           "active", "on_step", "on_io_write", "on_checkpoint_payload"]
+
+logger = logging.getLogger("bigdl_tpu.chaos")
+
+
+class FaultInjected(RuntimeError):
+    """A deliberately injected fault.  Subclasses RuntimeError so the
+    optimizer's exception classifier treats it as transient/retryable —
+    the faults it stands in for (preemption, IO blips) are."""
+
+
+class ChaosController:
+    """Holds the armed faults and their one-shot/counter state."""
+
+    def __init__(self, fail_at_step: Optional[int] = None,
+                 crash_checkpoint: Optional[int] = None,
+                 truncate_checkpoint: Optional[int] = None,
+                 truncate_keep_bytes: int = 64,
+                 io_fail_p: float = 0.0, seed: int = 0):
+        self.fail_at_step = fail_at_step
+        self.crash_checkpoint = crash_checkpoint
+        self.truncate_checkpoint = truncate_checkpoint
+        self.truncate_keep_bytes = int(truncate_keep_bytes)
+        self.io_fail_p = float(io_fail_p)
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self.checkpoint_writes = 0
+        self.events: List[str] = []
+
+    def _fire(self, what: str) -> None:
+        self.events.append(what)
+        logger.warning("chaos: %s", what)
+
+    def on_step(self, neval: int) -> None:
+        if self.fail_at_step is not None and neval >= self.fail_at_step:
+            self.fail_at_step = None  # one-shot: the retry must succeed
+            self._fire(f"injected failure at iteration {neval}")
+            raise FaultInjected(f"chaos: injected failure at iteration "
+                                f"{neval}")
+
+    def on_io_write(self, path: str) -> None:
+        if self.io_fail_p and self._rng.random() < self.io_fail_p:
+            self._fire(f"injected IO failure writing {path}")
+            raise OSError(f"chaos: injected IO failure writing {path}")
+
+    def on_checkpoint_payload(self, path: str) -> None:
+        """Called after a checkpoint payload is durably on disk, before
+        its manifest/commit marker is written."""
+        with self._lock:
+            self.checkpoint_writes += 1
+            n = self.checkpoint_writes
+        if self.crash_checkpoint is not None and n == self.crash_checkpoint:
+            self._fire(f"crash before commit marker of {path}")
+            raise FaultInjected(
+                f"chaos: crash mid-checkpoint (payload {path} written, "
+                f"commit marker not)")
+        if self.truncate_checkpoint is not None \
+                and n == self.truncate_checkpoint:
+            keep = self.truncate_keep_bytes
+            if os.path.isfile(path):
+                with open(path, "r+b") as f:
+                    f.truncate(keep)
+            elif os.path.isdir(path):
+                # sharded dir: tear it by dropping the orbax commit
+                # markers (the analogous "payload present, not committed")
+                for root, _dirs, files in os.walk(path):
+                    for m in ("commit_success.txt",
+                              "_CHECKPOINT_METADATA"):
+                        if m in files:
+                            os.remove(os.path.join(root, m))
+            self._fire(f"truncated checkpoint payload {path} "
+                       f"to {keep} bytes")
+
+
+_active: Optional[ChaosController] = None
+_env_checked = False
+
+_ENV_KEYS = ("BIGDL_TPU_CHAOS_FAIL_STEP", "BIGDL_TPU_CHAOS_CRASH_CKPT",
+             "BIGDL_TPU_CHAOS_TRUNCATE_CKPT", "BIGDL_TPU_CHAOS_IO_FAIL_P")
+
+
+def _from_env() -> Optional[ChaosController]:
+    e = os.environ
+    if not any(e.get(k) for k in _ENV_KEYS):
+        return None
+
+    def _i(name):
+        v = e.get(name)
+        return int(v) if v else None
+
+    return ChaosController(
+        fail_at_step=_i("BIGDL_TPU_CHAOS_FAIL_STEP"),
+        crash_checkpoint=_i("BIGDL_TPU_CHAOS_CRASH_CKPT"),
+        truncate_checkpoint=_i("BIGDL_TPU_CHAOS_TRUNCATE_CKPT"),
+        io_fail_p=float(e.get("BIGDL_TPU_CHAOS_IO_FAIL_P") or 0.0),
+        seed=int(e.get("BIGDL_TPU_CHAOS_SEED") or 0))
+
+
+def install(**kwargs) -> ChaosController:
+    """Arm a set of faults; returns the controller (its ``events`` list
+    records what actually fired)."""
+    global _active
+    _active = ChaosController(**kwargs)
+    return _active
+
+
+def reset() -> None:
+    """Disarm all faults (and allow env vars to be re-read)."""
+    global _active, _env_checked
+    _active = None
+    _env_checked = False
+
+
+def active() -> Optional[ChaosController]:
+    global _active, _env_checked
+    if _active is None and not _env_checked:
+        _env_checked = True
+        _active = _from_env()
+    return _active
+
+
+def on_step(neval: int) -> None:
+    c = active()
+    if c is not None:
+        c.on_step(neval)
+
+
+def on_io_write(path: str) -> None:
+    c = active()
+    if c is not None:
+        c.on_io_write(path)
+
+
+def on_checkpoint_payload(path: str) -> None:
+    c = active()
+    if c is not None:
+        c.on_checkpoint_payload(path)

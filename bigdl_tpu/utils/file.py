@@ -13,16 +13,24 @@ neither).
 from __future__ import annotations
 
 import json
+import logging
 import os
-from typing import Any, Dict, List, Tuple
+import secrets
+import time
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
+
+from bigdl_tpu.utils import chaos
 
 __all__ = ["save_pytree", "load_pytree", "save_checkpoint",
            "load_checkpoint", "save_checkpoint_sharded",
            "load_checkpoint_sharded", "is_sharded_checkpoint_path",
            "open_file", "is_remote_path", "np_load_any",
-           "strip_file_scheme"]
+           "strip_file_scheme", "CheckpointManager"]
+
+logger = logging.getLogger("bigdl_tpu.utils.file")
 
 PYTREE_FORMAT_VERSION = 2
 
@@ -110,14 +118,91 @@ def _check_legacy(files) -> None:
             "current version")
 
 
-def save_pytree(tree: Any, path: str) -> None:
+_TMP_MARKER = ".tmp-"
+
+
+def _crc_and_size(path: str) -> Tuple[int, int]:
+    """Stream CRC32 + byte size of a (local or remote) file.  Reading
+    the payload back after writing it is deliberate: it verifies the
+    bytes are actually retrievable before the manifest declares them
+    committed."""
+    crc, size = 0, 0
+    with open_file(path, "rb") as f:
+        while True:
+            chunk = f.read(1 << 20)
+            if not chunk:
+                break
+            crc = zlib.crc32(chunk, crc)
+            size += len(chunk)
+    return crc & 0xFFFFFFFF, size
+
+
+def _fsync_dir(dirpath: str) -> None:
+    try:
+        fd = os.open(dirpath, os.O_RDONLY)
+    except OSError:  # pragma: no cover - e.g. non-POSIX dir handles
+        return
+    try:
+        os.fsync(fd)
+    except OSError:  # pragma: no cover
+        pass
+    finally:
+        os.close(fd)
+
+
+def _atomic_write_local(path: str, write_fn) -> Tuple[int, int]:
+    """tmp + fsync + atomic rename: a crash at ANY point leaves either
+    the previous file or the complete new one at ``path``, never a
+    truncated hybrid.  The directory is fsync'd after the rename so the
+    commit itself survives power loss.  Returns (crc32, size) of the
+    written payload, computed by reading the tmp file back before the
+    rename."""
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    os.makedirs(d, exist_ok=True)
+    chaos.on_io_write(path)
+    tmp = os.path.join(
+        d, f".{os.path.basename(path)}{_TMP_MARKER}"
+           f"{os.getpid()}-{secrets.token_hex(4)}")
+    try:
+        with open(tmp, "wb") as f:
+            write_fn(f)
+            f.flush()
+            os.fsync(f.fileno())
+        crc, size = _crc_and_size(tmp)
+        os.replace(tmp, path)
+    except BaseException:
+        # a real kill -9 leaves the tmp behind (CheckpointManager.gc
+        # sweeps those); a raised error can tidy up after itself
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
+    _fsync_dir(d)
+    return crc, size
+
+
+def save_pytree(tree: Any, path: str) -> Tuple[int, int]:
+    """Write the tree; returns (crc32, size) of the payload.  Local
+    paths commit atomically (tmp + fsync + rename); remote object
+    stores cannot rename atomically, so callers that need a commit
+    signal there layer a marker on top (CheckpointManager's manifest)."""
     arrays: List[np.ndarray] = []
     structure = _encode(tree, arrays, "root")
     payload = {f"a{i}": a for i, a in enumerate(arrays)}
-    with open_file(path, "wb") as f:
+
+    def write(f):
         np.savez(f, __structure__=_json_bytes(
             {"format": PYTREE_FORMAT_VERSION, "root": structure}),
             **payload)
+
+    p = strip_file_scheme(path)
+    if is_remote_path(p):
+        chaos.on_io_write(p)
+        with open_file(p, "wb") as f:
+            write(f)
+        return _crc_and_size(p)
+    return _atomic_write_local(p, write)
 
 
 def np_load_any(path: str):
@@ -143,12 +228,14 @@ def load_pytree(path: str) -> Any:
 
 
 def save_checkpoint(path: str, model_state: Dict, optim_state: Any,
-                    driver_state: Dict) -> None:
+                    driver_state: Dict) -> Tuple[int, int]:
     """Write a full training checkpoint (≙ checkpoint() writing model +
-    optimMethod, AbstractOptimizer.scala:205-226)."""
-    save_pytree({"model": model_state, "optim": optim_state,
-                 "driver": {k: np.asarray(v)
-                            for k, v in driver_state.items()}}, path)
+    optimMethod, AbstractOptimizer.scala:205-226).  Returns (crc32,
+    size) of the committed payload."""
+    return save_pytree({"model": model_state, "optim": optim_state,
+                        "driver": {k: np.asarray(v)
+                                   for k, v in driver_state.items()}},
+                       path)
 
 
 # Files orbax's StandardCheckpointer leaves at the checkpoint root; any
@@ -254,3 +341,372 @@ def _orbax_checkpointer():
             "(pip install 'bigdl-tpu[sharded]'); the default .npz "
             "format has no extra dependency") from e
     return ocp.StandardCheckpointer()
+
+
+# --------------------------------------------------------------------------
+# CheckpointManager — durable, verifiable, generation-numbered checkpoints
+# --------------------------------------------------------------------------
+
+# orbax markers whose presence means the directory checkpoint committed
+_ORBAX_COMMIT_MARKERS = ("commit_success.txt", "_CHECKPOINT_METADATA")
+
+MANIFEST_FORMAT = 1
+
+
+class CheckpointManager:
+    """Atomic, verifiable training checkpoints with retention GC.
+
+    Layout under ``directory`` (local path or fsspec URL)::
+
+        checkpoint.<gen>.npz            numbered payload
+        checkpoint.<gen>.manifest.json  commit marker + CRC32/size record
+        checkpoint.npz                  single overwritten payload
+        checkpoint.manifest.json        (manifest still records the gen)
+        checkpoint.<gen>.orbax/         sharded payload (orbax markers)
+
+    Commit protocol: the payload is written first (atomically via
+    tmp + fsync + rename on local disks; orbax's own two-phase commit
+    for sharded directories), THEN the manifest.  Manifest presence is
+    therefore the commit marker — the only commit signal on remote
+    object stores, where rename is copy+delete and a crash mid-write
+    leaves a truncated object at the final path.  The manifest records
+    the payload's CRC32 and size, so ``latest_good()`` can distinguish
+    "committed and intact" from "committed then torn/bitrotted" and
+    fall back generation-by-generation to the newest checkpoint that
+    actually loads — exactly what the failure-retry loop needs after a
+    crash mid-checkpoint (the reference's retry,
+    DistriOptimizer.scala:901-983, always trusted the newest file).
+    """
+
+    def __init__(self, directory: str, keep_n: Optional[int] = None,
+                 prefix: str = "checkpoint"):
+        self.directory = directory
+        self.keep_n = keep_n
+        self.prefix = prefix
+
+    # ---- fs plumbing (local + fsspec) -----------------------------------
+
+    def _is_remote(self) -> bool:
+        return is_remote_path(strip_file_scheme(self.directory))
+
+    def _root(self) -> str:
+        return strip_file_scheme(self.directory)
+
+    def _join(self, name: str) -> str:
+        if self._is_remote():
+            return self._root().rstrip("/") + "/" + name
+        return os.path.join(self._root(), name)
+
+    def _fs(self):
+        import fsspec
+        fs, root = fsspec.core.url_to_fs(self._root())
+        return fs, root
+
+    def _listdir(self) -> List[str]:
+        if self._is_remote():
+            try:
+                fs, root = self._fs()
+                return [os.path.basename(e.rstrip("/"))
+                        for e in fs.ls(root, detail=False)]
+            except FileNotFoundError:
+                return []
+        root = self._root()
+        if not os.path.isdir(root):
+            return []
+        return os.listdir(root)
+
+    def _exists(self, path: str) -> bool:
+        if self._is_remote():
+            import fsspec
+            fs, p = fsspec.core.url_to_fs(path)
+            return fs.exists(p)
+        return os.path.exists(path)
+
+    def _delete(self, path: str) -> None:
+        if self._is_remote():
+            import fsspec
+            fs, p = fsspec.core.url_to_fs(path)
+            fs.rm(p, recursive=True)
+            return
+        if os.path.isdir(path):
+            import shutil
+            shutil.rmtree(path, ignore_errors=True)
+        else:
+            try:
+                os.remove(path)
+            except FileNotFoundError:
+                pass
+
+    # ---- naming ----------------------------------------------------------
+
+    def payload_name(self, generation: Optional[int],
+                     sharded: bool = False) -> str:
+        tag = "" if generation is None else f".{generation}"
+        return f"{self.prefix}{tag}" + (".orbax" if sharded else ".npz")
+
+    @staticmethod
+    def _manifest_name(payload_name: str) -> str:
+        stem = payload_name.rstrip("/")
+        for suf in (".npz", ".orbax"):
+            if stem.endswith(suf):
+                stem = stem[:-len(suf)]
+                break
+        return stem + ".manifest.json"
+
+    # ---- save ------------------------------------------------------------
+
+    def save(self, model_state: Dict, optim_state: Any,
+             driver_state: Dict, *, generation: int,
+             overwrite: bool = False, sharded: bool = False) -> str:
+        """Write one checkpoint generation: payload, then (payload
+        verified durable) its manifest, then retention GC.  With
+        ``overwrite`` the payload file name is fixed (``checkpoint.npz``)
+        but the manifest still records the true generation so resume
+        ordering never depends on mtime."""
+        name = self.payload_name(None if overwrite else generation,
+                                 sharded=sharded)
+        path = self._join(name)
+        if sharded:
+            save_checkpoint_sharded(path, model_state, optim_state,
+                                    driver_state)
+            crc = size = None
+        else:
+            crc, size = save_checkpoint(path, model_state, optim_state,
+                                        driver_state)
+        chaos.on_checkpoint_payload(path)
+        if _is_primary_process():
+            self._write_manifest(name, generation, crc, size, sharded)
+            if self.keep_n:
+                self.gc()
+        return path
+
+    def _write_manifest(self, payload_name: str, generation: int,
+                        crc: Optional[int], size: Optional[int],
+                        sharded: bool) -> None:
+        manifest = {"format": MANIFEST_FORMAT, "generation": int(generation),
+                    "payload": payload_name, "sharded": bool(sharded),
+                    "crc32": crc, "size": size, "time": time.time()}
+        data = json.dumps(manifest, sort_keys=True).encode("utf-8")
+        mpath = self._join(self._manifest_name(payload_name))
+        if self._is_remote():
+            with open_file(mpath, "wb") as f:
+                f.write(data)
+        else:
+            _atomic_write_local(mpath, lambda f: f.write(data))
+
+    # ---- inspection / fallback ------------------------------------------
+
+    def _manifests(self) -> List[Dict]:
+        """All parseable manifests, unordered; unparseable ones are
+        skipped with a warning (a torn manifest means an uncommitted
+        generation)."""
+        out = []
+        for n in self._listdir():
+            if not (n.startswith(self.prefix)
+                    and n.endswith(".manifest.json")):
+                continue
+            try:
+                with open_file(self._join(n), "rb") as f:
+                    man = json.loads(f.read().decode("utf-8"))
+                man["_manifest_name"] = n
+                out.append(man)
+            except Exception:
+                logger.warning("unreadable checkpoint manifest %s "
+                               "(treating its generation as uncommitted)",
+                               n, exc_info=True)
+        return out
+
+    def generations(self) -> List[int]:
+        """Committed generation numbers, ascending (no CRC validation)."""
+        return sorted(m.get("generation", -1) for m in self._manifests())
+
+    def validate(self, manifest: Dict) -> bool:
+        """Does the manifest's payload exist and match its recorded
+        size + CRC (orbax dirs: are the commit markers present)?"""
+        path = self._join(manifest["payload"])
+        try:
+            if manifest.get("sharded"):
+                return self._orbax_committed(path)
+            if not self._exists(path):
+                return False
+            crc, size = _crc_and_size(path)
+            if manifest.get("size") is not None \
+                    and size != manifest["size"]:
+                return False
+            if manifest.get("crc32") is not None \
+                    and crc != manifest["crc32"]:
+                return False
+            return True
+        except Exception:
+            logger.warning("error validating checkpoint %s", path,
+                           exc_info=True)
+            return False
+
+    def latest_good(self) -> Optional[str]:
+        """Path of the newest checkpoint that is committed AND intact,
+        walking back generation-by-generation past corrupt, truncated,
+        or uncommitted ones; falls back to an mtime-ordered load-probe
+        sweep (legacy manifest-less files, and payloads whose manifest
+        is stale but whose bytes are complete).  None if nothing
+        survives."""
+        manifested = set()
+        for man in sorted(self._manifests(),
+                          key=lambda m: (m.get("generation", -1),
+                                         m.get("time", 0.0)),
+                          reverse=True):
+            manifested.add(man["payload"])
+            path = self._join(man["payload"])
+            if self.validate(man):
+                return path
+            logger.warning(
+                "checkpoint generation %s (%s) failed validation "
+                "(truncated or uncommitted write?); falling back to the "
+                "previous generation", man.get("generation"), path)
+        # Fallback sweep over EVERY payload, including ones whose
+        # manifest just failed CRC: in overwrite mode a crash between
+        # the payload rename and the manifest write leaves a STALE
+        # manifest next to a complete, loadable payload — the load
+        # probe, not the stale CRC, is the truth there.  (A genuinely
+        # torn payload fails the probe too: a truncated .npz is a torn
+        # zip and np.load raises.)  Also covers manifest-less files
+        # from older sessions.
+        for path in self._legacy_candidates():
+            if self._probe_loadable(path):
+                if os.path.basename(path.rstrip("/")) in manifested:
+                    logger.warning(
+                        "checkpoint %s fails its manifest CRC (stale "
+                        "manifest from an interrupted commit?) but "
+                        "loads cleanly; using it", path)
+                return path
+            logger.warning("checkpoint %s is unreadable; falling back",
+                           path)
+        return None
+
+    def _legacy_candidates(self) -> List[str]:
+        """All checkpoint*.npz/.orbax payloads, newest first — by mtime
+        locally, by numeric suffix when mtimes are unreliable (object
+        stores)."""
+        names = [n for n in self._listdir()
+                 if n.startswith(self.prefix)
+                 and not n.startswith(".")
+                 and _TMP_MARKER not in n
+                 and (n.endswith(".npz")
+                      or n.rstrip("/").endswith(".orbax"))]
+        if not names:
+            return []
+        if self._is_remote():
+            import re
+
+            def key(n):
+                m = re.search(r"\.(\d+)\.(?:npz|orbax)/?$", n)
+                return (int(m.group(1)) if m else -1, n)
+            return [self._join(n) for n in sorted(names, key=key,
+                                                  reverse=True)]
+        return sorted((self._join(n) for n in names),
+                      key=os.path.getmtime, reverse=True)
+
+    def _orbax_committed(self, path: str) -> bool:
+        """Orbax's own two-phase commit leaves marker files at the
+        checkpoint root (StandardCheckpointer saves under ``<dir>/tree``,
+        so probe both levels)."""
+        base = path.rstrip("/")
+        return any(self._exists(f"{base}{sub}/{m}")
+                   for sub in ("", "/tree")
+                   for m in _ORBAX_COMMIT_MARKERS)
+
+    def _probe_loadable(self, path: str) -> bool:
+        try:
+            if path.rstrip("/").endswith(".orbax"):
+                return self._orbax_committed(path)
+            # a truncated .npz is a torn zip: np.load raises on it
+            with np_load_any(path) as z:
+                return "__structure__" in z.files
+        except Exception:
+            return False
+
+    # ---- retention -------------------------------------------------------
+
+    def _present_and_sized(self, man: Dict) -> bool:
+        """Cheap goodness check for GC accounting: payload present and
+        (locally) the recorded byte size — full CRC reads happen at
+        resume, not on every save."""
+        p = self._join(man["payload"])
+        if not self._exists(p):
+            return False
+        if man.get("sharded"):
+            # a present-but-unmarked orbax dir is a torn two-phase
+            # commit: it must not count toward keep_n, or GC could
+            # delete the last generation that actually restores
+            return self._orbax_committed(p)
+        if man.get("size") is None or self._is_remote():
+            return True
+        try:
+            return os.path.getsize(p) == man["size"]
+        except OSError:
+            return False
+
+    def gc(self) -> List[str]:
+        """Retention: keep the newest ``keep_n`` committed-and-present
+        numbered generations, delete older payloads + manifests, and
+        sweep stale tmp files from interrupted writes.  The unnumbered
+        overwrite checkpoint is never collected.  (Presence/size checks
+        only — full CRC validation happens at resume, not on every
+        save.)"""
+        removed: List[str] = []
+        if self.keep_n:
+            entries = []
+            for man in self._manifests():
+                name = man["payload"]
+                if name == self.payload_name(None, sharded=False) or \
+                        name == self.payload_name(None, sharded=True):
+                    continue  # overwrite-mode file: not generational
+                entries.append(man)
+            entries.sort(key=lambda m: (m.get("generation", -1),
+                                        m.get("time", 0.0)), reverse=True)
+            good = [m for m in entries
+                    if self._present_and_sized(m)][:self.keep_n]
+            keep = {m["payload"] for m in good}
+            newest_good = (good[0].get("generation", -1) if good
+                           else None)
+            for man in entries:
+                if man["payload"] in keep:
+                    continue
+                if newest_good is not None \
+                        and man.get("generation", -1) > newest_good:
+                    # bad generation newer than every good one: leave it
+                    # for latest_good() to report, don't silently erase
+                    continue
+                for name in (man["payload"], man["_manifest_name"]):
+                    p = self._join(name)
+                    try:
+                        self._delete(p)
+                        removed.append(p)
+                    except Exception:
+                        logger.warning("checkpoint GC could not delete %s",
+                                       p, exc_info=True)
+        if not self._is_remote():
+            # interrupted atomic writes leave hidden tmp files; sweep
+            # ones old enough that no writer can still own them
+            now = time.time()
+            for n in self._listdir():
+                if _TMP_MARKER not in n:
+                    continue
+                p = self._join(n)
+                try:
+                    if now - os.path.getmtime(p) > 300.0:
+                        os.remove(p)
+                        removed.append(p)
+                except OSError:
+                    pass
+        return removed
+
+
+def _is_primary_process() -> bool:
+    """Manifest writes and GC are driver-side decisions: exactly one
+    writer per cluster (every process still participates in the orbax
+    payload collectives)."""
+    try:
+        import jax
+        return jax.process_index() == 0
+    except Exception:  # pragma: no cover - jax not initialized
+        return True

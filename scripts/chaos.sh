@@ -1,0 +1,10 @@
+#!/usr/bin/env bash
+# Fault-injection suite, standalone: proves crash→resume end-to-end
+# (atomic checkpoint commit, CRC walkback past torn generations,
+# retry/backoff classification, SIGTERM preemption drain).  See
+# docs/fault_tolerance.md; extra pytest args pass through, e.g.
+#   scripts/chaos.sh -k preemption -v
+set -o pipefail
+cd "$(dirname "$0")/.."
+exec env JAX_PLATFORMS=cpu python -m pytest tests/test_fault_tolerance.py \
+  -v -p no:cacheprovider -p no:xdist -p no:randomly "$@"

@@ -10,6 +10,14 @@ timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
   -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
   -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
 rc=${PIPESTATUS[0]}
-echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log \
+dots=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log \
   | tr -cd . | wc -c)
+echo DOTS_PASSED=$dots
+# delta vs the recorded baseline so a regression is visible at a glance;
+# update scripts/tier1_baseline.txt when a PR legitimately moves the count
+base_file="$(dirname "$0")/tier1_baseline.txt"
+if [ -f "$base_file" ]; then
+  base=$(tr -cd 0-9 < "$base_file")
+  echo "DOTS_DELTA=$((dots - base)) (baseline $base)"
+fi
 exit $rc

@@ -748,3 +748,62 @@ def test_set_regularizers_does_not_wipe_other_slot():
     specs = dict(zip(["weight", "bias"], leaf_reg_specs(m)))
     assert specs["weight"] == (0.0, 0.0, 1.0), specs
     assert specs["bias"] == (1e-5, 0.0, 1.0), specs
+
+
+def test_aggregate_across_processes_single_process_identity():
+    """Single process: the cross-process (n, d) psum is the identity."""
+    from bigdl_tpu.optim.validation import (
+        ValidationResult, aggregate_across_processes,
+    )
+    rs = [ValidationResult(3.0, 4.0, "Top1Accuracy"),
+          ValidationResult(1.5, 6.0, "Loss")]
+    out = aggregate_across_processes(rs)
+    assert out is rs
+
+
+def test_aggregate_across_processes_sums_counts(monkeypatch):
+    """With >1 processes the (numerator, denominator) pairs are summed
+    globally; the allgather is faked to a 2-process stack so the psum
+    arithmetic is checked without a pod."""
+    import jax as _jax
+    from jax.experimental import multihost_utils
+    from bigdl_tpu.optim import validation as V
+    monkeypatch.setattr(_jax, "process_count", lambda: 2)
+    monkeypatch.setattr(multihost_utils, "process_allgather",
+                        lambda x: np.stack([x, 2.0 * x]))
+    rs = [V.ValidationResult(3.0, 4.0, "Top1Accuracy"),
+          V.ValidationResult(1.0, 2.0, "Loss")]
+    out = V.aggregate_across_processes(rs)
+    assert [(r.fmt, r.numerator, r.denominator) for r in out] == [
+        ("Top1Accuracy", 9.0, 12.0), ("Loss", 3.0, 6.0)]
+
+
+def test_aggregate_across_processes_rejects_array_metrics(monkeypatch):
+    """MAP/AUC accumulate ragged raw-score arrays that a count psum
+    cannot merge — they must demand replicated validation data."""
+    import jax as _jax
+    from bigdl_tpu.optim import validation as V
+    monkeypatch.setattr(_jax, "process_count", lambda: 2)
+    r = V.MAPResult("MAP@all", np.zeros((4, 3), np.float32),
+                    np.ones((4,), np.int32))
+    with pytest.raises(ValueError, match="replicated"):
+        V.aggregate_across_processes([r])
+
+
+def test_sharded_val_dataset_accepted_single_process(tmp_path):
+    """PR 1 rejected per-process-sharded validation datasets outright;
+    with cross-process (n, d) aggregation they are supported — the
+    optimizer must not raise and validation must still run."""
+    set_seed(61)
+    rng = np.random.default_rng(11)
+    samples = [Sample(rng.normal(size=(6,)).astype(np.float32),
+                      int(rng.integers(1, 5))) for _ in range(32)]
+    data = DataSet.array(samples).transform(SampleToMiniBatch(16))
+    val = DataSet.array(samples[:16]).transform(SampleToMiniBatch(16))
+    model = nn.Sequential(nn.Linear(6, 4), nn.LogSoftMax())
+    opt = (Optimizer(model, data, nn.ClassNLLCriterion())
+           .set_optim_method(SGD(0.1))
+           .set_end_when(Trigger.max_epoch(2))
+           .set_validation(Trigger.every_epoch(), val, [Top1Accuracy()]))
+    opt.optimize()
+    assert np.isfinite(opt.state["score"])

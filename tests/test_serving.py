@@ -625,3 +625,37 @@ def test_weighted_histogram_matches_expanded():
     assert weighted.sum_squares == expanded.sum_squares
     assert weighted.bucket == expanded.bucket
     assert weighted.min == expanded.min and weighted.max == expanded.max
+
+
+def test_shutdown_signal_unwinds_into_drain():
+    """install_shutdown_signals (ISSUE 2 satellite): SIGTERM raises
+    KeyboardInterrupt in the main thread so the caller's
+    shutdown(drain=True) path runs — every already-admitted request is
+    still answered."""
+    import os
+    import signal as sg
+
+    from bigdl_tpu.serving.server import install_shutdown_signals
+
+    server = ModelServer(lambda x: np.asarray(x) * 2.0, max_batch=4,
+                         batch_timeout_ms=1.0)
+    restore = install_shutdown_signals(server, signals=(sg.SIGTERM,))
+    try:
+        futs = [server.submit_async(np.full((3,), i, np.float32))
+                for i in range(4)]
+        with pytest.raises(KeyboardInterrupt):
+            os.kill(os.getpid(), sg.SIGTERM)
+            # give the interpreter a bytecode boundary to deliver on
+            for _ in range(1000):
+                time.sleep(0.001)
+        # the drain path the unwound caller runs:
+        server.shutdown(drain=True)
+        for i, f in enumerate(futs):
+            np.testing.assert_allclose(f.result(timeout=5),
+                                       np.full((3,), 2.0 * i))
+        with pytest.raises(ServerClosedError):
+            server.submit_async(np.zeros((3,), np.float32))
+    finally:
+        restore()
+    # the previous SIGTERM disposition is back
+    assert sg.getsignal(sg.SIGTERM) is sg.SIG_DFL
