@@ -478,6 +478,15 @@ def phase_optimizer_loop(on_tpu: bool, batch: int, size: int, host_batch):
     from bigdl_tpu.optim.methods import SGD
 
     x_np, y_np = host_batch
+    # unified telemetry rides along: the step-phase histograms
+    # (data-wait vs device step) land in a JSON snapshot next to the
+    # BENCH artifact, so a future perf round can attribute a regression
+    # without re-running a TPU profile
+    try:
+        from bigdl_tpu import telemetry
+        telemetry.enable()
+    except Exception:
+        telemetry = None
     iters_per_epoch = 10 if on_tpu else 3
     # 10 epochs -> 9 steady windows on the chip (marginal cost <1s per
     # extra window): the aggregate-span estimator gets enough windows
@@ -527,6 +536,21 @@ def phase_optimizer_loop(on_tpu: bool, batch: int, size: int, host_batch):
             upd["optimizer_overhead_pct"] = round(
                 100.0 * (1.0 - (batch / step_t) / raw), 1)
         _update(**upd)
+    if telemetry is not None:
+        try:
+            from bigdl_tpu.telemetry.export import json_snapshot
+            from bigdl_tpu.telemetry.runtime import sample_runtime
+            sample_runtime()
+            snap_path = os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                "BENCH_telemetry.json")
+            with open(snap_path, "w", encoding="utf-8") as f:
+                json.dump(json_snapshot(), f)
+            _update(telemetry_snapshot=os.path.basename(snap_path))
+            _log(f"telemetry snapshot written to {snap_path}")
+        except Exception:
+            _log("telemetry snapshot failed (non-fatal):\n"
+                 + traceback.format_exc())
 
 
 def phase_transformer(on_tpu: bool):

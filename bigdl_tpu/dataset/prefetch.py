@@ -26,7 +26,9 @@ import queue
 import threading
 from typing import Callable, Iterator
 
+from bigdl_tpu import telemetry
 from bigdl_tpu.dataset.transformer import Transformer
+from bigdl_tpu.telemetry import families as _tm
 
 __all__ = ["Prefetch", "ParallelMap"]
 
@@ -54,10 +56,32 @@ class Prefetch(Transformer):
     def apply(self, it: Iterator) -> Iterator:
         q: "queue.Queue" = queue.Queue(maxsize=self.n_ahead)
         stop = threading.Event()
+        # metric handles resolved once per stream, not per item: the
+        # registry get-or-create is a lock + dict lookup the per-batch
+        # path shouldn't repay (reset() zeroes in place, so cached
+        # handles stay valid)
+        m_depth = _tm.prefetch_queue_depth()
+        m_producer_wait = _tm.prefetch_producer_wait_total()
+        m_consumer_wait = _tm.prefetch_consumer_wait_total()
 
         def put_checked(item) -> bool:
             """Blocking put that gives up once the consumer is gone;
             True if the item was enqueued."""
+            if stop.is_set():
+                # a departed consumer leaves free slots; probing first
+                # would keep feeding the dead queue (and pulling
+                # upstream work) until it fills
+                return False
+            try:
+                # non-blocking probe first: a full queue at this instant
+                # IS the producer-ahead/consumer-behind signal, counted
+                # once per item (the timed put below would only raise
+                # after its full timeout, hiding short waits)
+                q.put_nowait(item)
+                return True
+            except queue.Full:
+                if telemetry.enabled():
+                    m_producer_wait.inc()
             while not stop.is_set():
                 try:
                     q.put(item, timeout=0.1)
@@ -83,7 +107,18 @@ class Prefetch(Transformer):
             t.start()
             try:
                 while True:
-                    item = q.get()
+                    if telemetry.enabled():
+                        # depth BEFORE the take = batches ready while
+                        # the step ran; an empty queue here means the
+                        # input pipeline made the step wait
+                        m_depth.set(q.qsize())
+                        try:
+                            item = q.get_nowait()
+                        except queue.Empty:
+                            m_consumer_wait.inc()
+                            item = q.get()
+                    else:
+                        item = q.get()
                     if item is _STOP:
                         return
                     if isinstance(item, _Failure):

@@ -10,6 +10,11 @@ Protocol (stdlib-only on both ends):
 * ``POST /predict`` with an ``.npy``-serialized array body →
   ``.npy``-serialized output array (``application/octet-stream``).
 * ``GET /healthz`` → ``{"status": "ok"}``.
+* ``GET /metrics`` → Prometheus text exposition from the unified
+  ``bigdl_tpu.telemetry`` registry: serving latency quantiles, queue
+  depth, batch occupancy — plus every optimizer/checkpoint family (one
+  scrape config covers training and serving roles; see
+  docs/observability.md).
 
 Client::
 
@@ -64,6 +69,10 @@ def make_server(service, host: str, port: int) -> ThreadingHTTPServer:
             if self.path == "/healthz":
                 self._reply(200, json.dumps({"status": "ok"}).encode(),
                             "application/json")
+            elif self.path == "/metrics":
+                from bigdl_tpu.telemetry import prometheus_text
+                self._reply(200, prometheus_text().encode(),
+                            "text/plain; version=0.0.4; charset=utf-8")
             else:
                 self._reply(404, b"not found", "text/plain")
 
@@ -98,10 +107,30 @@ def main(argv=None):
     p.add_argument("--batch-timeout-ms", type=float, default=5.0,
                    help="max wait before a partial batch is served "
                         "(only with --dynamic-batch)")
+    p.add_argument("--no-telemetry", action="store_true",
+                   help="disable the unified telemetry registry (the "
+                        "/metrics endpoint then exposes an empty "
+                        "catalog)")
     p.add_argument("-q", "--quiet", action="store_true")
     args = p.parse_args(argv)
     logging.basicConfig(
         level=logging.WARNING if args.quiet else logging.INFO)
+
+    # serving enables telemetry by default: the scrape endpoint is the
+    # reason this process exists to an SRE, and the serving hot path
+    # only pays pull-time collection (docs/observability.md).  The flag
+    # must actively disable — BIGDL_TPU_TELEMETRY=1 in the environment
+    # enables at import, and skipping enable() would not undo that.
+    from bigdl_tpu import telemetry
+    if args.no_telemetry:
+        # disable AND clear: BIGDL_TPU_TELEMETRY=1 enables at import,
+        # which preregisters the catalog — without the clear, /metrics
+        # would still expose every family at zero
+        telemetry.disable()
+        telemetry.get_registry().clear()
+        telemetry.reset_spans()
+    else:
+        telemetry.enable()
 
     from bigdl_tpu.optim.predictor import PredictionService
     from bigdl_tpu.utils.serializer import load_module

@@ -52,6 +52,8 @@ from bigdl_tpu.parallel.mesh import (
 from bigdl_tpu.parallel.sharding import (
     ShardingRules, shard_model_params, replicated,
 )
+from bigdl_tpu import telemetry
+from bigdl_tpu.telemetry import families as _tm, tracing as _tt
 from bigdl_tpu.utils import chaos
 from bigdl_tpu.utils.file import CheckpointManager, load_checkpoint
 from bigdl_tpu.utils.xla_cost import compiled_flops
@@ -647,6 +649,8 @@ class Optimizer:
                     if retries_left <= 0 or ckpt is None:
                         raise
                     retries_left -= 1
+                    if telemetry.enabled():
+                        _tm.optimizer_retries_total().inc()
                     delay = self._backoff_delay(attempt)
                     attempt += 1
                     logger.warning(
@@ -870,6 +874,7 @@ class Optimizer:
             # time) is the honest denominator, or the r02
             # async-dispatch lie returns through the back door.
             t_ready = time.time()
+            t_ready_pc = time.perf_counter()  # span clock (tracing)
             # Value readbacks batch via device_get (one pytree transfer
             # with the copies issued concurrently — per-scalar
             # np.asarray round trips on a high-latency link would
@@ -903,6 +908,22 @@ class Optimizer:
                              / len(entries), count=len(entries))
             self.window_timings.append(
                 (len(entries), window_dt, data_t))
+            if telemetry.enabled():
+                # the honest per-iteration device time (same number the
+                # "device step time" Metrics line reports), observed
+                # once per iteration the window covered; the span marks
+                # the completion-to-completion interval in the trace
+                amortized = (max(window_dt - data_t, 0.0)
+                             / len(entries))
+                h = _tm.optimizer_step_seconds()
+                for _ in entries:
+                    h.observe(amortized)
+                # perf_counter endpoints: tracing's clock — mixing the
+                # loop's time.time() stamps in would strand these spans
+                # ~an epoch away from every span() on the trace timeline
+                _tt.record_span("optimizer/step", t_ready_pc - window_dt,
+                                t_ready_pc, iterations=len(entries),
+                                data_wait_s=round(data_t, 6))
             n_pend = len(entries)
             for idx, ((neval_i, epoch_i, n_i, cum_i, _), lf) in enumerate(
                     zip(entries, losses)):
@@ -1161,6 +1182,15 @@ class Optimizer:
                             epoch)
                         loss_list = [loss]
                     self.metrics.add("data load and transfer", t_data)
+                    if telemetry.enabled():
+                        _tm.optimizer_data_wait_seconds().observe(t_data)
+                        # span endpoints on tracing's perf_counter
+                        # clock (it_start is time.time); the dispatch
+                        # call between interval end and here is an
+                        # async enqueue, so the shift is negligible
+                        pc = time.perf_counter()
+                        _tt.record_span("optimizer/data_wait",
+                                        pc - t_data, pc)
                     window["data_t"] += t_data
                     for b, loss_i in zip(group, loss_list):
                         # records are GLOBAL: b.size() is per-process
@@ -1285,8 +1315,13 @@ class Optimizer:
         if do_val:
             self._last_val_neval = self.state["neval"]
             current = combine(merged, rest).eval_mode()
-            with self.metrics.time("validation time"):
+            t_val0 = time.perf_counter()
+            with self.metrics.time("validation time"), \
+                    _tt.span("optimizer/validation"):
                 results = self._validate(current, eval_step)
+            if telemetry.enabled():
+                _tm.optimizer_validation_seconds().observe(
+                    time.perf_counter() - t_val0)
             current.train_mode()
             if results:
                 first = next(iter(results.values()))

@@ -22,6 +22,10 @@ import jax
 __all__ = ["module_forward_times", "times_by_module_type", "profile_trace"]
 
 
+# sentinel: "the module had NO instance-level forward before patching"
+_ABSENT = object()
+
+
 @contextmanager
 def _timed(model, records: List):
     """Temporarily wrap every submodule's forward with a blocking timer.
@@ -31,6 +35,11 @@ def _timed(model, records: List):
     patched = []
     for path, mod in model.named_modules():
         orig = mod.forward
+        # A module may already carry an INSTANCE-level forward (a user
+        # monkeypatch, or a previous tool's wrapper); a bare delattr on
+        # restore would destroy it and expose the class method instead.
+        # Save the exact prior binding and put it back.
+        prior = mod.__dict__.get("forward", _ABSENT)
 
         def make(orig=orig, path=path, mod=mod):
             def timed_forward(*a, **k):
@@ -45,23 +54,34 @@ def _timed(model, records: List):
         # object.__setattr__: Module.__setattr__ would classify a plain
         # function into _static and pollute the pytree aux data.
         object.__setattr__(mod, "forward", make())
-        patched.append(mod)
+        patched.append((mod, prior))
     try:
         yield
     finally:
-        for mod in patched:
-            try:
-                object.__delattr__(mod, "forward")
-            except AttributeError:
-                pass
+        for mod, prior in patched:
+            if prior is _ABSENT:
+                try:
+                    object.__delattr__(mod, "forward")
+                except AttributeError:
+                    pass
+            else:
+                object.__setattr__(mod, "forward", prior)
 
 
 def module_forward_times(model, *inputs) -> List[Tuple[str, str, float]]:
     """Run one eager forward and return [(path, type, seconds)] per
-    submodule, outermost last (≙ AbstractModule.getTimes)."""
+    submodule, outermost last (≙ AbstractModule.getTimes).  With
+    telemetry enabled, timings also land in the unified registry as the
+    ``module_forward_seconds`` histogram labeled by module type."""
     records: List[Tuple[str, str, float]] = []
     with _timed(model, records):
         model.forward(*inputs)
+    from bigdl_tpu import telemetry
+    if telemetry.enabled():
+        from bigdl_tpu.telemetry import families
+        hist = families.module_forward_seconds()
+        for _path, tname, sec in records:
+            hist.labels(tname).observe(sec)
     return records
 
 

@@ -60,6 +60,15 @@ class MetricsRegistry:
         self._shed = 0
         self._rejected = 0
         self._rng = np.random.default_rng(0)
+        # Mirror this registry into bigdl_tpu.telemetry: a pull-time
+        # collector (weakref'd) copies snapshot() into the unified
+        # registry on scrape — the record_batch hot path is untouched
+        # and the public snapshot schema is unchanged.
+        try:
+            from bigdl_tpu.telemetry.families import bridge_serving_metrics
+            bridge_serving_metrics(self)
+        except Exception:  # pragma: no cover - telemetry must never
+            pass           # break serving construction
 
     # ---- recording -------------------------------------------------------
 

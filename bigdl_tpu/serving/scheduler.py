@@ -21,11 +21,13 @@ import threading
 import time
 from typing import Callable, List, Optional, Sequence
 
+from bigdl_tpu import telemetry
 from bigdl_tpu.serving.admission import BoundedRequestQueue, Request
 from bigdl_tpu.serving.batching import (
     pick_bucket, split_outputs, stack_requests,
 )
 from bigdl_tpu.serving.metrics import MetricsRegistry
+from bigdl_tpu.telemetry import tracing
 
 __all__ = ["BatchScheduler"]
 
@@ -103,20 +105,39 @@ class BatchScheduler:
         n = len(batch)
         bucket = pick_bucket(n, self._buckets)
         depth = len(self._queue)
+        # request-path spans (enqueue -> batch -> execute -> reply);
+        # tel is latched once so a mid-batch disable cannot emit a
+        # parentless half of the trace
+        tel = telemetry.enabled()
+        t_formed = time.perf_counter() if tel else 0.0
+        batch_span = None
+        if tel:
+            # queue wait covers enqueue -> batch formed, per request
+            for r in batch:
+                tracing.record_span("serving/enqueue", r.t_enqueue,
+                                    t_formed)
         try:
-            x = stack_requests([r.sample for r in batch], bucket)
-            rows = split_outputs(self._execute(x), n)
+            with tracing.span("serving/batch", n_real=n,
+                              bucket=bucket) as batch_span:
+                x = stack_requests([r.sample for r in batch], bucket)
+                with tracing.span("serving/execute", bucket=bucket):
+                    rows = split_outputs(self._execute(x), n)
         except Exception as e:
             for r in batch:
                 if not r.future.done():
                     r.future.set_exception(e)
             logger.exception("serving batch of %d failed", n)
             return
-        done = time.perf_counter()
+        t_reply0 = time.perf_counter()
+        done = t_reply0
         lats = []
         for r, row in zip(batch, rows):
             lats.append(done - r.t_enqueue)
             r.future.set_result(row)
+        if tel:
+            tracing.record_span("serving/reply", t_reply0,
+                                time.perf_counter(),
+                                parent_id=batch_span, requests=n)
         self.metrics.record_batch(n_real=n, bucket=bucket,
                                   queue_depth=depth, latencies_s=lats)
 

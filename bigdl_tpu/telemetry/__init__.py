@@ -1,0 +1,83 @@
+"""``bigdl_tpu.telemetry`` — unified metrics, tracing, and runtime
+observability across training and serving.
+
+One substrate replaces three disconnected fragments (the serving-local
+``MetricsRegistry``, the eager module timer, log-line-only retry/chaos
+events): thread-safe Counter/Gauge/Histogram primitives in a
+process-global registry, ``span()`` tracing with Chrome-trace export,
+Prometheus/JSON/TensorBoard exposition, and host/device runtime
+sampling.  See ``docs/observability.md`` for the full metric and span
+catalog.
+
+**Disabled by default.**  Every instrumentation site in the hot path
+guards with :func:`enabled` — a single module-global bool read — so a
+training step pays a few branch checks and nothing else until an
+operator opts in::
+
+    from bigdl_tpu import telemetry
+    telemetry.enable()                    # or BIGDL_TPU_TELEMETRY=1
+    ... train / serve ...
+    print(telemetry.prometheus_text())
+    telemetry.write_chrome_trace("trace.json")
+"""
+
+from __future__ import annotations
+
+import os as _os
+
+from bigdl_tpu.telemetry.metrics import (      # noqa: F401
+    Counter, Gauge, Histogram, TelemetryRegistry, get_registry,
+)
+from bigdl_tpu.telemetry.tracing import (      # noqa: F401
+    span, record_span, current_span, propagate, finished_spans,
+    reset_spans, set_ring_capacity, chrome_trace, write_chrome_trace,
+)
+from bigdl_tpu.telemetry.export import (       # noqa: F401
+    prometheus_text, json_snapshot, publish_summary, PeriodicExporter,
+)
+
+__all__ = [
+    "enable", "disable", "enabled", "reset",
+    "Counter", "Gauge", "Histogram", "TelemetryRegistry", "get_registry",
+    "span", "record_span", "current_span", "propagate", "finished_spans",
+    "reset_spans", "set_ring_capacity", "chrome_trace",
+    "write_chrome_trace",
+    "prometheus_text", "json_snapshot", "publish_summary",
+    "PeriodicExporter",
+]
+
+# THE hot-path switch: instrumentation sites read this through
+# enabled(); everything else in the package is cold-path.
+_ENABLED = False
+
+
+def enable() -> None:
+    """Turn instrumentation on and pre-register the full metric
+    catalog (so exports immediately show every family, at zero)."""
+    global _ENABLED
+    from bigdl_tpu.telemetry import families
+    families.preregister()
+    _ENABLED = True
+
+
+def disable() -> None:
+    global _ENABLED
+    _ENABLED = False
+
+
+def enabled() -> bool:
+    """Is instrumentation on?  Hot paths call this once per decision —
+    it must stay a bare global read."""
+    return _ENABLED
+
+
+def reset() -> None:
+    """Test-friendly full reset: zero every metric in place (handles
+    stay valid) and drop all buffered spans."""
+    get_registry().reset()
+    reset_spans()
+
+
+if _os.environ.get("BIGDL_TPU_TELEMETRY", "").lower() in (
+        "1", "true", "on", "yes"):
+    enable()

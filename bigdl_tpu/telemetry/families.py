@@ -1,0 +1,263 @@
+"""Canonical metric-family declarations.
+
+Every metric NAME in the codebase is declared exactly once, here, as a
+get-or-create accessor; instrumentation sites import the accessor
+instead of re-spelling the string.  ``scripts/metrics_lint.py``
+enforces this statically (duplicate or non-``snake_case`` names fail,
+as do names missing from the table in ``docs/observability.md``).
+
+Two consequences worth the indirection:
+
+* ``preregister()`` can materialize the whole catalog, so a process
+  that only serves still exposes the optimizer/checkpoint families
+  (at zero) on ``/metrics`` — one scrape config covers every role.
+* Renames are single-file diffs that the lint cross-checks against the
+  documentation table.
+"""
+
+from __future__ import annotations
+
+import weakref
+from typing import List
+
+from bigdl_tpu.telemetry.metrics import (
+    Counter, Gauge, Histogram, get_registry,
+)
+
+__all__ = ["preregister", "bridge_serving_metrics"]
+
+
+# ---- optimizer step-phase breakdown ---------------------------------------
+
+def optimizer_data_wait_seconds() -> Histogram:
+    return get_registry().histogram(
+        "optimizer_data_wait_seconds",
+        "Host time staging one iteration's batch (fetch + device put)")
+
+
+def optimizer_step_seconds() -> Histogram:
+    return get_registry().histogram(
+        "optimizer_step_seconds",
+        "Device step time per iteration, amortized over the async "
+        "readback window that completed it (completion-to-completion, "
+        "minus data-wait)")
+
+
+def optimizer_validation_seconds() -> Histogram:
+    return get_registry().histogram(
+        "optimizer_validation_seconds",
+        "Wall time of one validation sweep")
+
+
+def optimizer_retries_total() -> Counter:
+    return get_registry().counter(
+        "optimizer_retries_total",
+        "Transient-failure retries taken by Optimizer.optimize()")
+
+
+# ---- checkpointing ---------------------------------------------------------
+
+def checkpoint_commit_seconds() -> Histogram:
+    return get_registry().histogram(
+        "checkpoint_commit_seconds",
+        "CheckpointManager.save wall time: payload + manifest + GC")
+
+
+def checkpoint_torn_generations_total() -> Counter:
+    return get_registry().counter(
+        "checkpoint_torn_generations_total",
+        "Generations latest_good() walked past as corrupt, truncated, "
+        "or uncommitted")
+
+
+# ---- chaos (fault injection) ----------------------------------------------
+
+def chaos_faults_injected_total() -> Counter:
+    return get_registry().counter(
+        "chaos_faults_injected_total",
+        "Faults the chaos harness actually fired")
+
+
+# ---- input pipeline --------------------------------------------------------
+
+def prefetch_queue_depth() -> Gauge:
+    return get_registry().gauge(
+        "prefetch_queue_depth",
+        "Ready minibatches buffered by Prefetch, sampled at each "
+        "consumer get")
+
+
+def prefetch_producer_wait_total() -> Counter:
+    return get_registry().counter(
+        "prefetch_producer_wait_total",
+        "Producer blocked-on-full-queue events (consumer is the "
+        "bottleneck)")
+
+
+def prefetch_consumer_wait_total() -> Counter:
+    return get_registry().counter(
+        "prefetch_consumer_wait_total",
+        "Consumer blocked-on-empty-queue events (input pipeline is the "
+        "bottleneck: the step waited on data)")
+
+
+# ---- per-module eager profiling -------------------------------------------
+
+def module_forward_seconds() -> Histogram:
+    return get_registry().histogram(
+        "module_forward_seconds",
+        "Eager per-module forward wall time from optim.profiling",
+        labelnames=("module_type",))
+
+
+# ---- host / device runtime -------------------------------------------------
+
+def process_rss_bytes() -> Gauge:
+    return get_registry().gauge(
+        "process_rss_bytes", "Resident set size of this process")
+
+
+def gc_collections_total() -> Counter:
+    return get_registry().counter(
+        "gc_collections_total",
+        "CPython garbage-collector runs", labelnames=("generation",))
+
+
+def device_memory_bytes_in_use() -> Gauge:
+    return get_registry().gauge(
+        "device_memory_bytes_in_use",
+        "Accelerator memory in use (jax device memory_stats)",
+        labelnames=("device",))
+
+
+def device_memory_bytes_limit() -> Gauge:
+    return get_registry().gauge(
+        "device_memory_bytes_limit",
+        "Accelerator memory capacity (jax device memory_stats)",
+        labelnames=("device",))
+
+
+# ---- serving bridge --------------------------------------------------------
+# The serving MetricsRegistry keeps its own lock-coherent snapshot (its
+# public schema is unchanged); this bridge mirrors that snapshot into
+# the telemetry registry at READ time via a collector — the serving hot
+# path never touches telemetry.
+
+def serving_latency_ms() -> Gauge:
+    return get_registry().gauge(
+        "serving_latency_ms",
+        "End-to-end request latency quantiles (enqueue to result)",
+        labelnames=("quantile",))
+
+
+def serving_queue_depth() -> Gauge:
+    return get_registry().gauge(
+        "serving_queue_depth",
+        "Mean backlog sampled at each dispatch")
+
+
+def serving_queue_depth_max() -> Gauge:
+    return get_registry().gauge(
+        "serving_queue_depth_max", "Max backlog seen at any dispatch")
+
+
+def serving_requests_total() -> Counter:
+    return get_registry().counter(
+        "serving_requests_total", "Requests served")
+
+
+def serving_batches_total() -> Counter:
+    return get_registry().counter(
+        "serving_batches_total", "Device batches executed")
+
+
+def serving_shed_total() -> Counter:
+    return get_registry().counter(
+        "serving_shed_total", "Requests shed by admission control")
+
+
+def serving_rejected_total() -> Counter:
+    return get_registry().counter(
+        "serving_rejected_total", "Requests rejected at admission")
+
+
+def serving_padded_waste_ratio() -> Gauge:
+    return get_registry().gauge(
+        "serving_padded_waste_ratio",
+        "Padded rows / dispatched rows (flops burned on dropped rows)")
+
+
+def serving_batch_occupancy() -> Gauge:
+    return get_registry().gauge(
+        "serving_batch_occupancy",
+        "Batches executed with this many real rows",
+        labelnames=("rows",))
+
+
+_PREREGISTER = (
+    optimizer_data_wait_seconds, optimizer_step_seconds,
+    optimizer_validation_seconds, optimizer_retries_total,
+    checkpoint_commit_seconds, checkpoint_torn_generations_total,
+    chaos_faults_injected_total,
+    prefetch_queue_depth, prefetch_producer_wait_total,
+    prefetch_consumer_wait_total,
+    module_forward_seconds,
+    process_rss_bytes, gc_collections_total,
+    device_memory_bytes_in_use, device_memory_bytes_limit,
+    serving_latency_ms, serving_queue_depth, serving_queue_depth_max,
+    serving_requests_total, serving_batches_total, serving_shed_total,
+    serving_rejected_total, serving_padded_waste_ratio,
+    serving_batch_occupancy,
+)
+
+
+def preregister() -> None:
+    """Materialize every family so exports show the full catalog (at
+    zero) even in a process that hasn't exercised a subsystem yet —
+    the /metrics endpoint of a fresh server already names the
+    optimizer/checkpoint families a dashboard will chart."""
+    for accessor in _PREREGISTER:
+        accessor()
+
+
+def bridge_serving_metrics(serving_registry) -> None:
+    """Mirror a serving ``MetricsRegistry`` into the telemetry registry
+    via a pull collector.  Holds only a weakref — once a shut-down
+    server's registry is garbage collected the collector unregisters
+    itself (returning ``COLLECTOR_DONE``), freezing the last-mirrored
+    values at their final reading.
+
+    The serving families are unlabeled: with several serving
+    registries LIVE in one process the last-registered collector wins
+    each scrape.  One data plane per process is the deployment shape
+    (``bigdl-tpu-serve``); a multi-server process should construct one
+    shared ``MetricsRegistry`` and pass it to each ``ModelServer``."""
+    from bigdl_tpu.telemetry.metrics import COLLECTOR_DONE
+    ref = weakref.ref(serving_registry)
+
+    def collect():
+        from bigdl_tpu import telemetry
+        reg = ref()
+        if reg is None:
+            return COLLECTOR_DONE
+        if not telemetry.enabled():
+            # the operator opted out (--no-telemetry): stay inert and
+            # create NO families, so the exposition really is empty
+            return None
+        snap = reg.snapshot()
+        lat = snap["latency_ms"]
+        g = serving_latency_ms()
+        for q in ("p50", "p90", "p99"):
+            g.labels(q).set(lat[q])
+        serving_queue_depth().set(snap["queue_depth_mean"])
+        serving_queue_depth_max().set(snap["queue_depth_max"])
+        serving_requests_total().set_total(snap["requests"])
+        serving_batches_total().set_total(snap["batches"])
+        serving_shed_total().set_total(snap["shed"])
+        serving_rejected_total().set_total(snap["rejected"])
+        serving_padded_waste_ratio().set(snap["padded_waste"])
+        occ = serving_batch_occupancy()
+        for rows, n in snap["occupancy"].items():
+            occ.labels(rows).set(n)
+
+    get_registry().register_collector(collect)

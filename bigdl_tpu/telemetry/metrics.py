@@ -1,0 +1,389 @@
+"""Metric primitives: Counter / Gauge / Histogram with label support.
+
+One process-global (but resettable) registry unifies the three
+observability fragments this repo grew separately — the serving-local
+``MetricsRegistry``, the eager per-module timer in ``optim/profiling``,
+and the fault-tolerance layer's retry/chaos events — so ONE Prometheus
+scrape (or JSON snapshot) answers "where does a step's wall time go"
+across training and serving.
+
+Design constraints, in priority order:
+
+* **Zero hot-path cost when disabled.**  Instrumentation sites guard
+  with :func:`bigdl_tpu.telemetry.enabled` (one module-global bool
+  read); nothing here is imported into a jit trace.
+* **Thread-safe.**  The optimizer's loss-drain worker, the serving
+  scheduler, the prefetch producer, and a Prometheus scrape thread all
+  record/read concurrently; every mutation and every snapshot takes the
+  owning metric's lock.
+* **Resettable, not re-creatable.**  ``reset()`` zeroes values IN PLACE
+  so module-level metric handles cached by instrumented code stay valid
+  across tests (a registry swap would leave them writing into a ghost).
+
+Metric names follow Prometheus conventions: ``snake_case``, ``_total``
+suffix on counters, ``_seconds``/``_bytes`` units.  Every name is
+declared exactly once, in :mod:`bigdl_tpu.telemetry.families` —
+``scripts/metrics_lint.py`` enforces both rules statically.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+__all__ = ["Counter", "Gauge", "Histogram", "TelemetryRegistry",
+           "get_registry", "DEFAULT_BUCKETS"]
+
+# Latency-oriented default buckets (seconds): sub-millisecond dispatch
+# overheads through minute-scale checkpoint commits.
+DEFAULT_BUCKETS: Tuple[float, ...] = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0, float("inf"))
+
+
+class _Child:
+    """Per-label-set value holder.  The parent metric's lock guards all
+    mutation; children never outlive their parent."""
+
+    __slots__ = ("_lock", "value")
+
+    def __init__(self, lock: threading.Lock):
+        self._lock = lock
+        self.value = 0.0
+
+
+class _Metric:
+    """Base: name, help text, label names, and a child per label-value
+    tuple (the no-label case uses the single ``()`` child)."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str = "",
+                 labelnames: Sequence[str] = ()):
+        self.name = name
+        self.help = help
+        self.labelnames = tuple(labelnames)
+        self._lock = threading.Lock()
+        self._children: Dict[Tuple[str, ...], object] = {}
+        if not self.labelnames:
+            self._children[()] = self._make_child()
+
+    def _make_child(self):
+        return _Child(self._lock)
+
+    def labels(self, *values) -> "_Metric":
+        """Bound view for one label-value tuple; children are created on
+        first use and cached (bounded cardinality is the caller's
+        contract — label values should be enums, not request ids)."""
+        if len(values) != len(self.labelnames):
+            raise ValueError(
+                f"metric {self.name!r} takes {len(self.labelnames)} "
+                f"label value(s) {self.labelnames}, got {len(values)}")
+        key = tuple(str(v) for v in values)
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = self._children[key] = self._make_child()
+        return _Bound(self, child)
+
+    def _default_child(self):
+        if self.labelnames:
+            raise ValueError(
+                f"metric {self.name!r} has labels {self.labelnames}; "
+                f"call .labels(...) first")
+        return self._children[()]
+
+    def _reset(self) -> None:
+        with self._lock:
+            for child in self._children.values():
+                self._zero(child)
+
+    @staticmethod
+    def _zero(child) -> None:
+        child.value = 0.0
+
+    def samples(self) -> List[Tuple[Tuple[str, ...], float]]:
+        """[(label_values, value)] under one lock acquisition."""
+        with self._lock:
+            return [(k, c.value) for k, c in sorted(self._children.items())]
+
+
+class _Bound:
+    """A metric narrowed to one label set: forwards the value ops."""
+
+    __slots__ = ("_metric", "_child")
+
+    def __init__(self, metric: _Metric, child):
+        self._metric = metric
+        self._child = child
+
+    def __getattr__(self, item):
+        op = getattr(type(self._metric), "_op_" + item, None)
+        if op is None:
+            raise AttributeError(item)
+        metric, child = self._metric, self._child
+        return lambda *a, **k: op(metric, child, *a, **k)
+
+
+class Counter(_Metric):
+    """Monotonically increasing count (``_total`` names)."""
+
+    kind = "counter"
+
+    def _op_inc(self, child, n: float = 1.0) -> None:
+        if n < 0:
+            raise ValueError("counters only go up")
+        with self._lock:
+            child.value += n
+
+    def _op_value(self, child) -> float:
+        with self._lock:
+            return child.value
+
+    # collectors mirroring an external monotonic count (serving bridge)
+    def _op_set_total(self, child, v: float) -> None:
+        with self._lock:
+            child.value = float(v)
+
+    def inc(self, n: float = 1.0) -> None:
+        self._op_inc(self._default_child(), n)
+
+    def set_total(self, v: float) -> None:
+        self._op_set_total(self._default_child(), v)
+
+    def value(self) -> float:
+        return self._op_value(self._default_child())
+
+
+class Gauge(_Metric):
+    """A value that goes up and down (queue depth, RSS)."""
+
+    kind = "gauge"
+
+    def _op_set(self, child, v: float) -> None:
+        with self._lock:
+            child.value = float(v)
+
+    def _op_inc(self, child, n: float = 1.0) -> None:
+        with self._lock:
+            child.value += n
+
+    def _op_dec(self, child, n: float = 1.0) -> None:
+        with self._lock:
+            child.value -= n
+
+    def _op_value(self, child) -> float:
+        with self._lock:
+            return child.value
+
+    def set(self, v: float) -> None:
+        self._op_set(self._default_child(), v)
+
+    def inc(self, n: float = 1.0) -> None:
+        self._op_inc(self._default_child(), n)
+
+    def dec(self, n: float = 1.0) -> None:
+        self._op_dec(self._default_child(), n)
+
+    def value(self) -> float:
+        return self._op_value(self._default_child())
+
+
+class _HistChild:
+    __slots__ = ("counts", "sum", "count")
+
+    def __init__(self, n_buckets: int):
+        self.counts = [0] * n_buckets
+        self.sum = 0.0
+        self.count = 0
+
+
+class Histogram(_Metric):
+    """Prometheus-style cumulative-bucket histogram.  ``observe`` is a
+    bisect + three in-place updates under the metric lock — cheap enough
+    for per-iteration phase timings."""
+
+    kind = "histogram"
+
+    def __init__(self, name: str, help: str = "",
+                 labelnames: Sequence[str] = (),
+                 buckets: Optional[Sequence[float]] = None):
+        bs = tuple(buckets) if buckets is not None else DEFAULT_BUCKETS
+        if not bs or sorted(bs) != list(bs):
+            raise ValueError("histogram buckets must be sorted")
+        if bs[-1] != float("inf"):
+            bs = bs + (float("inf"),)
+        self.buckets = bs
+        super().__init__(name, help, labelnames)
+
+    def _make_child(self):
+        return _HistChild(len(self.buckets))
+
+    @staticmethod
+    def _zero(child) -> None:
+        child.counts = [0] * len(child.counts)
+        child.sum = 0.0
+        child.count = 0
+
+    def _op_observe(self, child, v: float) -> None:
+        i = bisect.bisect_left(self.buckets, v)
+        with self._lock:
+            child.counts[i] += 1
+            child.sum += v
+            child.count += 1
+
+    def _op_snapshot(self, child) -> Dict:
+        with self._lock:
+            return {"buckets": list(self.buckets),
+                    "counts": list(child.counts),
+                    "sum": child.sum, "count": child.count}
+
+    def observe(self, v: float) -> None:
+        self._op_observe(self._default_child(), v)
+
+    def snapshot(self) -> Dict:
+        return self._op_snapshot(self._default_child())
+
+    def samples(self) -> List[Tuple[Tuple[str, ...], Dict]]:
+        with self._lock:
+            return [(k, {"buckets": list(self.buckets),
+                         "counts": list(c.counts),
+                         "sum": c.sum, "count": c.count})
+                    for k, c in sorted(self._children.items())]
+
+
+_KINDS = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}
+
+# a collector returning this sentinel is dropped from the registry —
+# how a bridge whose weakref'd source died retires itself instead of
+# running (and accumulating) forever
+COLLECTOR_DONE = object()
+
+
+class TelemetryRegistry:
+    """Get-or-create home for every metric in the process.
+
+    ``collectors`` are pull hooks run before every snapshot/export —
+    the serving ``MetricsRegistry`` bridge lives there, so its
+    reservoir quantiles land in this registry at read time with zero
+    cost on the serving hot path."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, _Metric] = {}
+        self._collectors: List[Callable[[], None]] = []
+
+    # ---- registration ----------------------------------------------------
+
+    def _get_or_create(self, cls, name: str, help: str,
+                       labelnames: Sequence[str], **kw) -> _Metric:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = cls(name, help, labelnames, **kw)
+                return m
+        if type(m) is not cls:
+            raise ValueError(
+                f"metric {name!r} already registered as {m.kind}, "
+                f"cannot re-register as {cls.kind}")
+        if tuple(labelnames) != m.labelnames:
+            raise ValueError(
+                f"metric {name!r} already registered with labels "
+                f"{m.labelnames}, got {tuple(labelnames)}")
+        return m
+
+    def counter(self, name: str, help: str = "",
+                labelnames: Sequence[str] = ()) -> Counter:
+        return self._get_or_create(Counter, name, help, labelnames)
+
+    def gauge(self, name: str, help: str = "",
+              labelnames: Sequence[str] = ()) -> Gauge:
+        return self._get_or_create(Gauge, name, help, labelnames)
+
+    def histogram(self, name: str, help: str = "",
+                  labelnames: Sequence[str] = (),
+                  buckets: Optional[Sequence[float]] = None) -> Histogram:
+        return self._get_or_create(Histogram, name, help, labelnames,
+                                   buckets=buckets)
+
+    def register_collector(self, fn: Callable[[], object]) -> None:
+        """``fn()`` runs before every snapshot/export; it should pull
+        from its source and write into this registry.  Exceptions are
+        swallowed (a dead source must not break a scrape).  A collector
+        returning :data:`COLLECTOR_DONE` is unregistered — sources held
+        by weakref retire their collector once garbage collected."""
+        with self._lock:
+            self._collectors.append(fn)
+
+    # ---- reading ---------------------------------------------------------
+
+    def run_collectors(self) -> None:
+        with self._lock:
+            collectors = list(self._collectors)
+        done = []
+        for fn in collectors:
+            try:
+                if fn() is COLLECTOR_DONE:
+                    done.append(fn)
+            except Exception:
+                pass
+        if done:
+            with self._lock:
+                for fn in done:
+                    try:
+                        self._collectors.remove(fn)
+                    except ValueError:
+                        pass
+
+    def metrics(self) -> List[_Metric]:
+        with self._lock:
+            return [self._metrics[n] for n in sorted(self._metrics)]
+
+    def get(self, name: str) -> Optional[_Metric]:
+        with self._lock:
+            return self._metrics.get(name)
+
+    def snapshot(self) -> Dict[str, Dict]:
+        """JSON-able dump: {name: {kind, help, labels, values}}, with
+        histogram values as {buckets, counts, sum, count}.  The +Inf
+        bucket bound is rendered as the string ``"+Inf"`` — a float
+        inf would make ``json.dumps`` emit the bare ``Infinity`` token,
+        which strict RFC-8259 parsers (jq, JSON.parse) reject."""
+        self.run_collectors()
+        out: Dict[str, Dict] = {}
+        inf = float("inf")
+        for m in self.metrics():
+            values = []
+            for k, v in m.samples():
+                if isinstance(v, dict) and "buckets" in v:
+                    v = dict(v, buckets=["+Inf" if b == inf else b
+                                         for b in v["buckets"]])
+                values.append({"labels": dict(zip(m.labelnames, k)),
+                               "value": v})
+            out[m.name] = {"kind": m.kind, "help": m.help,
+                           "label_names": list(m.labelnames),
+                           "values": values}
+        return out
+
+    # ---- lifecycle -------------------------------------------------------
+
+    def reset(self) -> None:
+        """Zero every metric IN PLACE (handles stay valid); collectors
+        are kept — their sources decide their own reset story."""
+        for m in self.metrics():
+            m._reset()
+
+    def clear(self) -> None:
+        """Forget everything, including collectors (tests that assert
+        exact exposition content start from an empty registry)."""
+        with self._lock:
+            self._metrics.clear()
+            self._collectors.clear()
+
+
+_REGISTRY = TelemetryRegistry()
+
+
+def get_registry() -> TelemetryRegistry:
+    return _REGISTRY

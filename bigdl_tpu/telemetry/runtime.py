@@ -1,0 +1,121 @@
+"""Host/device runtime sampling: RSS, GC, accelerator memory.
+
+A training job that OOMs the host (input pipeline buffering) or the
+device (stacked dispatch windows) usually telegraphed it for minutes in
+exactly these numbers.  ``sample_runtime()`` takes one reading into the
+telemetry registry; :class:`RuntimeSampler` does it on a cadence.
+
+Everything degrades gracefully: no ``/proc`` (non-Linux) falls back to
+``resource.getrusage``, and device memory stats are skipped wherever
+``jax.local_devices()`` or ``memory_stats()`` is unavailable (CPU
+backends, older runtimes) — sampling never raises.
+"""
+
+from __future__ import annotations
+
+import gc
+import os
+import threading
+from typing import Optional
+
+from bigdl_tpu.telemetry import families
+
+__all__ = ["sample_runtime", "RuntimeSampler"]
+
+_PAGESIZE = os.sysconf("SC_PAGE_SIZE") if hasattr(os, "sysconf") else 4096
+
+
+def _rss_bytes() -> Optional[int]:
+    try:
+        with open("/proc/self/statm", "rb") as f:
+            return int(f.read().split()[1]) * _PAGESIZE
+    except Exception:
+        pass
+    try:
+        import resource
+        import sys
+        ru = resource.getrusage(resource.RUSAGE_SELF)
+        # ru_maxrss is KiB on Linux, bytes on macOS; either way it is a
+        # high-water mark, not current RSS — better than nothing
+        scale = 1 if sys.platform == "darwin" else 1024
+        return int(ru.ru_maxrss) * scale
+    except Exception:
+        return None
+
+
+def sample_runtime(include_devices: bool = True) -> None:
+    """One reading of host RSS, GC collection counts, and (where the
+    backend exposes ``memory_stats``) per-device memory into the
+    telemetry registry."""
+    rss = _rss_bytes()
+    if rss is not None:
+        families.process_rss_bytes().set(rss)
+    try:
+        stats = gc.get_stats()
+        ctr = families.gc_collections_total()
+        for gen, st in enumerate(stats):
+            ctr.labels(gen).set_total(st.get("collections", 0))
+    except Exception:
+        pass
+    if not include_devices:
+        return
+    try:
+        import jax
+        devices = jax.local_devices()
+    except Exception:
+        return
+    in_use = families.device_memory_bytes_in_use()
+    limit = families.device_memory_bytes_limit()
+    for d in devices:
+        try:
+            ms = d.memory_stats()
+        except Exception:
+            continue
+        if not ms:
+            continue
+        key = f"{d.platform}:{d.id}"
+        if "bytes_in_use" in ms:
+            in_use.labels(key).set(ms["bytes_in_use"])
+        if "bytes_limit" in ms:
+            limit.labels(key).set(ms["bytes_limit"])
+
+
+class RuntimeSampler:
+    """Daemon thread calling :func:`sample_runtime` every
+    ``interval_s``; ``stop()`` joins cleanly (one final sample)."""
+
+    def __init__(self, interval_s: float = 10.0,
+                 include_devices: bool = True):
+        self.interval_s = float(interval_s)
+        self.include_devices = include_devices
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.samples = 0
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            sample_runtime(self.include_devices)
+            self.samples += 1
+        sample_runtime(self.include_devices)
+        self.samples += 1
+
+    def start(self) -> "RuntimeSampler":
+        if self._thread is not None:
+            raise RuntimeError("sampler already started")
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="bigdl-telemetry-runtime")
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 10.0) -> None:
+        if self._thread is None:
+            return
+        self._stop.set()
+        self._thread.join(timeout)
+        self._thread = None
+
+    def __enter__(self) -> "RuntimeSampler":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

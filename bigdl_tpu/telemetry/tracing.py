@@ -1,0 +1,220 @@
+"""Span tracing: where a step's wall time actually goes.
+
+``span("optimizer/step")`` is a context manager recording one timed
+interval.  Spans nest via a thread-local stack (a span opened inside
+another becomes its child), finished spans land in a bounded ring
+buffer, and the whole buffer exports to Chrome trace-event JSON —
+loadable in Perfetto / ``chrome://tracing`` — so the data-wait /
+compiled-step / validation / checkpoint-commit breakdown of a training
+run is one file away instead of unanswerable.
+
+Clock: ``time.perf_counter()``, the same clock the serving scheduler
+and optimizer already stamp with, so :func:`record_span` can adopt
+timestamps measured elsewhere (e.g. a request's ``t_enqueue``)
+retroactively.  Trace timestamps are exported relative to the module's
+load instant; ``wall_time_of`` converts to epoch seconds when needed.
+
+Cross-thread propagation: a worker thread adopts a parent with::
+
+    token = tracing.current_span()          # in the submitting thread
+    with tracing.propagate(token):          # in the worker
+        with tracing.span("serving/execute"):
+            ...
+
+When telemetry is disabled (the default) ``span`` yields a shared
+no-op — the hot path pays one bool read and one dict-free function
+call, nothing else.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from typing import Dict, Iterator, List, Optional
+
+__all__ = ["span", "record_span", "current_span", "propagate",
+           "finished_spans", "reset_spans", "set_ring_capacity",
+           "chrome_trace", "write_chrome_trace", "wall_time_of"]
+
+# perf_counter <-> wall-clock anchor, captured once at import
+_EPOCH_PERF = time.perf_counter()
+_EPOCH_WALL = time.time()
+
+_DEFAULT_CAPACITY = 16384
+
+_ids = itertools.count(1)
+_tls = threading.local()
+
+_buf_lock = threading.Lock()
+_buffer: deque = deque(maxlen=_DEFAULT_CAPACITY)
+_dropped = 0
+
+
+class SpanRecord:
+    """One finished span.  Plain object, not a dataclass: this is
+    allocated on every traced interval."""
+
+    __slots__ = ("name", "t_start", "t_end", "span_id", "parent_id",
+                 "thread", "args")
+
+    def __init__(self, name, t_start, t_end, span_id, parent_id,
+                 thread, args):
+        self.name = name
+        self.t_start = t_start
+        self.t_end = t_end
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.thread = thread
+        self.args = args
+
+    @property
+    def duration_s(self) -> float:
+        return self.t_end - self.t_start
+
+
+def _stack() -> List[int]:
+    st = getattr(_tls, "stack", None)
+    if st is None:
+        st = _tls.stack = []
+    return st
+
+
+def _record(rec: SpanRecord) -> None:
+    global _dropped
+    with _buf_lock:
+        if len(_buffer) == _buffer.maxlen:
+            _dropped += 1
+        _buffer.append(rec)
+
+
+def current_span() -> Optional[int]:
+    """The innermost open span id on THIS thread (a propagation token
+    for worker threads), or None."""
+    st = getattr(_tls, "stack", None)
+    return st[-1] if st else None
+
+
+@contextmanager
+def propagate(parent_id: Optional[int]) -> Iterator[None]:
+    """Adopt ``parent_id`` as this thread's span parent for the block —
+    the cross-thread half of parent/child propagation."""
+    st = _stack()
+    if parent_id is None:
+        yield
+        return
+    st.append(parent_id)
+    try:
+        yield
+    finally:
+        st.pop()
+
+
+@contextmanager
+def span(name: str, **args) -> Iterator[Optional[int]]:
+    """Record one timed interval.  Yields the span id (None when
+    telemetry is disabled).  ``args`` become Chrome-trace args."""
+    from bigdl_tpu import telemetry
+    if not telemetry.enabled():
+        yield None
+        return
+    st = _stack()
+    parent = st[-1] if st else None
+    sid = next(_ids)
+    st.append(sid)
+    t0 = time.perf_counter()
+    try:
+        yield sid
+    finally:
+        t1 = time.perf_counter()
+        st.pop()
+        _record(SpanRecord(name, t0, t1, sid, parent,
+                           threading.get_ident(), args or None))
+
+
+def record_span(name: str, t_start: float, t_end: float,
+                parent_id: Optional[int] = None, **args) -> Optional[int]:
+    """Record a span from timestamps measured elsewhere (both on the
+    ``time.perf_counter`` clock).  Used where the interval's endpoints
+    are only known after the fact — e.g. the optimizer's async loss
+    drain learns a window's completion time in a worker thread, and a
+    serving request's queue wait starts at its ``t_enqueue``."""
+    from bigdl_tpu import telemetry
+    if not telemetry.enabled():
+        return None
+    if parent_id is None:
+        parent_id = current_span()
+    sid = next(_ids)
+    _record(SpanRecord(name, t_start, t_end, sid, parent_id,
+                       threading.get_ident(), args or None))
+    return sid
+
+
+# ---- reading / export ------------------------------------------------------
+
+def finished_spans() -> List[SpanRecord]:
+    with _buf_lock:
+        return list(_buffer)
+
+
+def dropped_spans() -> int:
+    with _buf_lock:
+        return _dropped
+
+
+def reset_spans() -> None:
+    global _dropped
+    with _buf_lock:
+        _buffer.clear()
+        _dropped = 0
+
+
+def set_ring_capacity(n: int) -> None:
+    """Resize the finished-span ring (keeps the newest spans)."""
+    global _buffer
+    if n < 1:
+        raise ValueError("ring capacity must be >= 1")
+    with _buf_lock:
+        _buffer = deque(_buffer, maxlen=n)
+
+
+def wall_time_of(t_perf: float) -> float:
+    """perf_counter timestamp -> epoch seconds (approximate: anchored
+    at module import)."""
+    return _EPOCH_WALL + (t_perf - _EPOCH_PERF)
+
+
+def chrome_trace() -> Dict:
+    """The ring buffer as a Chrome trace-event object: complete ("X")
+    events with microsecond ts/dur, pid/tid, and span/parent ids in
+    args — ``json.dump`` it and load in Perfetto."""
+    events = []
+    for rec in finished_spans():
+        args = {"span_id": rec.span_id}
+        if rec.parent_id is not None:
+            args["parent_id"] = rec.parent_id
+        if rec.args:
+            args.update(rec.args)
+        events.append({
+            "ph": "X",
+            "name": rec.name,
+            "cat": "bigdl_tpu",
+            "ts": (rec.t_start - _EPOCH_PERF) * 1e6,
+            "dur": max(rec.t_end - rec.t_start, 0.0) * 1e6,
+            "pid": os.getpid(),
+            "tid": rec.thread,
+            "args": args,
+        })
+    return {"traceEvents": events, "displayTimeUnit": "ms",
+            "otherData": {"dropped_spans": dropped_spans()}}
+
+
+def write_chrome_trace(path: str) -> str:
+    """Serialize :func:`chrome_trace` to ``path`` (JSON)."""
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(chrome_trace(), f)
+    return path

@@ -76,6 +76,13 @@ class ChaosController:
     def _fire(self, what: str) -> None:
         self.events.append(what)
         logger.warning("chaos: %s", what)
+        try:
+            from bigdl_tpu import telemetry
+            if telemetry.enabled():
+                from bigdl_tpu.telemetry import families
+                families.chaos_faults_injected_total().inc()
+        except Exception:  # chaos must stay injectable even if
+            pass           # telemetry is broken mid-bisect
 
     def on_step(self, neval: int) -> None:
         if self.fail_at_step is not None and neval >= self.fail_at_step:

@@ -22,6 +22,8 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from bigdl_tpu import telemetry
+from bigdl_tpu.telemetry import families as _tm, tracing as _tt
 from bigdl_tpu.utils import chaos
 
 __all__ = ["save_pytree", "load_pytree", "save_checkpoint",
@@ -466,18 +468,24 @@ class CheckpointManager:
         name = self.payload_name(None if overwrite else generation,
                                  sharded=sharded)
         path = self._join(name)
-        if sharded:
-            save_checkpoint_sharded(path, model_state, optim_state,
-                                    driver_state)
-            crc = size = None
-        else:
-            crc, size = save_checkpoint(path, model_state, optim_state,
+        t0 = time.perf_counter()
+        with _tt.span("checkpoint/commit", generation=generation,
+                      sharded=sharded):
+            if sharded:
+                save_checkpoint_sharded(path, model_state, optim_state,
                                         driver_state)
-        chaos.on_checkpoint_payload(path)
-        if _is_primary_process():
-            self._write_manifest(name, generation, crc, size, sharded)
-            if self.keep_n:
-                self.gc()
+                crc = size = None
+            else:
+                crc, size = save_checkpoint(path, model_state, optim_state,
+                                            driver_state)
+            chaos.on_checkpoint_payload(path)
+            if _is_primary_process():
+                self._write_manifest(name, generation, crc, size, sharded)
+                if self.keep_n:
+                    self.gc()
+        if telemetry.enabled():
+            _tm.checkpoint_commit_seconds().observe(
+                time.perf_counter() - t0)
         return path
 
     def _write_manifest(self, payload_name: str, generation: int,
@@ -562,6 +570,8 @@ class CheckpointManager:
                 "checkpoint generation %s (%s) failed validation "
                 "(truncated or uncommitted write?); falling back to the "
                 "previous generation", man.get("generation"), path)
+            if telemetry.enabled():
+                _tm.checkpoint_torn_generations_total().inc()
         # Fallback sweep over EVERY payload, including ones whose
         # manifest just failed CRC: in overwrite mode a crash between
         # the payload rename and the manifest write leaves a STALE
@@ -580,6 +590,8 @@ class CheckpointManager:
                 return path
             logger.warning("checkpoint %s is unreadable; falling back",
                            path)
+            if telemetry.enabled():
+                _tm.checkpoint_torn_generations_total().inc()
         return None
 
     def _legacy_candidates(self) -> List[str]:

@@ -70,3 +70,9 @@ def log_file(path: str) -> None:
     lg = logging.getLogger("bigdl_tpu")
     _drop_ours(lg, path)
     lg.addHandler(_file_handler(path))
+    # The framework logs its per-iteration telemetry at INFO; with the
+    # "bigdl_tpu" logger left at NOTSET it inherits the root logger's
+    # default WARNING and the file would stay silent.  Raise verbosity
+    # only — a user who already opted into DEBUG keeps it.
+    if lg.getEffectiveLevel() > logging.INFO:
+        lg.setLevel(logging.INFO)

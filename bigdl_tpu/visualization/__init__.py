@@ -2,7 +2,7 @@
 
 from bigdl_tpu.visualization.writer import (   # noqa: F401
     RecordWriter, FileWriter, Summary, TrainSummary, ValidationSummary,
-    ServingSummary,
+    ServingSummary, TelemetrySummary,
 )
 from bigdl_tpu.visualization.reader import FileReader  # noqa: F401
 from bigdl_tpu.visualization.proto import (    # noqa: F401

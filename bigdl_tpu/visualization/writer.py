@@ -25,7 +25,7 @@ from bigdl_tpu.visualization.proto import (
 )
 
 __all__ = ["RecordWriter", "FileWriter", "Summary", "TrainSummary",
-           "ValidationSummary", "ServingSummary"]
+           "ValidationSummary", "ServingSummary", "TelemetrySummary"]
 
 _file_seq = itertools.count()
 
@@ -212,3 +212,20 @@ class ServingSummary(Summary):
     TensorBoard run as train/validation."""
 
     tag = "serving"
+
+
+class TelemetrySummary(Summary):
+    """The unified ``bigdl_tpu.telemetry`` registry in TensorBoard:
+    counters/gauges as ``telemetry/<name>`` scalars, histograms as TB
+    histograms — same event-file run as train/validation/serving.
+
+    >>> ts = TelemetrySummary(log_dir, app_name)
+    >>> ts.publish(step)        # one snapshot of every metric
+    """
+
+    tag = "telemetry"
+
+    def publish(self, step: int) -> "TelemetrySummary":
+        from bigdl_tpu.telemetry import publish_summary
+        publish_summary(self, step)
+        return self

@@ -1,0 +1,185 @@
+#!/usr/bin/env python
+"""Static lint for telemetry metric/span names.
+
+Walks ``bigdl_tpu/`` ASTs for metric registrations — calls named
+``counter`` / ``gauge`` / ``histogram`` with a literal string first
+argument — and span usages (``span`` / ``record_span``), then fails on:
+
+* non-``snake_case`` metric names (``^[a-z][a-z0-9_]*$``) or span names
+  (same, in ``/``-separated segments);
+* a metric name registered at more than one site — the convention is
+  one declaration per name, in ``bigdl_tpu/telemetry/families.py``, so
+  renames are single-file diffs and two subsystems can never silently
+  claim the same family with different meanings;
+* any metric or span name missing from the catalog tables in
+  ``docs/observability.md`` — if it's worth recording it's worth
+  documenting, and dashboards are built from the table, not the code.
+
+Documented-but-unregistered names are reported as warnings only (docs
+may legitimately describe a family a gated backend registers lazily).
+
+Usage::
+
+    python scripts/metrics_lint.py              # fatal: exit 1 on error
+    python scripts/metrics_lint.py --warn-only  # CI ride-along: exit 0
+
+``scripts/tier1.sh`` runs the ``--warn-only`` form after the test
+suite; run the fatal form before shipping a new metric.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import os
+import re
+import sys
+from typing import Dict, List, NamedTuple, Set, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PACKAGE = os.path.join(REPO, "bigdl_tpu")
+DOC = os.path.join(REPO, "docs", "observability.md")
+
+_METRIC_FNS = {"counter", "gauge", "histogram"}
+_SPAN_FNS = {"span", "record_span"}
+
+_METRIC_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+_SPAN_RE = re.compile(r"^[a-z][a-z0-9_]*(/[a-z][a-z0-9_]*)*$")
+
+# a name in backticks is "documented" wherever it appears in the doc
+_DOC_NAME_RE = re.compile(r"`([a-z][a-z0-9_/]*)`")
+
+
+class Site(NamedTuple):
+    name: str
+    kind: str
+    file: str
+    line: int
+
+
+def _callee_name(call: ast.Call) -> str:
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return ""
+
+
+def collect(root: str) -> Tuple[List[Site], List[Site]]:
+    metrics: List[Site] = []
+    spans: List[Site] = []
+    for dirpath, _dirs, files in os.walk(root):
+        for fname in sorted(files):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            rel = os.path.relpath(path, REPO)
+            with open(path, "r", encoding="utf-8") as f:
+                try:
+                    tree = ast.parse(f.read(), filename=rel)
+                except SyntaxError as e:
+                    print(f"metrics_lint: cannot parse {rel}: {e}",
+                          file=sys.stderr)
+                    continue
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call) or not node.args:
+                    continue
+                arg0 = node.args[0]
+                if not (isinstance(arg0, ast.Constant)
+                        and isinstance(arg0.value, str)):
+                    continue
+                callee = _callee_name(node)
+                if callee in _METRIC_FNS:
+                    metrics.append(Site(arg0.value, callee, rel,
+                                        node.lineno))
+                elif callee in _SPAN_FNS:
+                    spans.append(Site(arg0.value, callee, rel,
+                                      node.lineno))
+    return metrics, spans
+
+
+def documented_names(doc_path: str) -> Set[str]:
+    if not os.path.isfile(doc_path):
+        return set()
+    with open(doc_path, "r", encoding="utf-8") as f:
+        return set(_DOC_NAME_RE.findall(f.read()))
+
+
+def lint() -> Tuple[List[str], List[str]]:
+    """Returns (errors, warnings)."""
+    errors: List[str] = []
+    warnings: List[str] = []
+    metrics, spans = collect(PACKAGE)
+    docs = documented_names(DOC)
+    if not os.path.isfile(DOC):
+        errors.append(f"missing catalog doc {os.path.relpath(DOC, REPO)}")
+
+    by_name: Dict[str, List[Site]] = {}
+    for s in metrics:
+        by_name.setdefault(s.name, []).append(s)
+        if not _METRIC_RE.match(s.name):
+            errors.append(
+                f"{s.file}:{s.line}: metric name {s.name!r} is not "
+                f"snake_case")
+    for name, sites in sorted(by_name.items()):
+        if len(sites) > 1:
+            where = ", ".join(f"{s.file}:{s.line}" for s in sites)
+            errors.append(
+                f"metric {name!r} registered at {len(sites)} sites "
+                f"({where}); declare each family once, in "
+                f"bigdl_tpu/telemetry/families.py")
+        if name not in docs:
+            s = sites[0]
+            errors.append(
+                f"{s.file}:{s.line}: metric {name!r} missing from the "
+                f"docs/observability.md catalog")
+
+    seen_spans: Set[str] = set()
+    for s in spans:
+        if not _SPAN_RE.match(s.name):
+            errors.append(
+                f"{s.file}:{s.line}: span name {s.name!r} is not "
+                f"snake_case path segments")
+        if s.name not in docs and s.name not in seen_spans:
+            errors.append(
+                f"{s.file}:{s.line}: span {s.name!r} missing from the "
+                f"docs/observability.md catalog")
+        seen_spans.add(s.name)
+
+    registered = set(by_name) | seen_spans
+    for name in sorted(docs - registered):
+        # only flag names that LOOK like catalog entries (metrics end in
+        # known unit/total suffixes or contain '/'; plain words in prose
+        # backticks are not the catalog's problem)
+        if "/" in name or re.search(
+                r"_(total|seconds|bytes|ms|ratio|depth|max)$", name):
+            warnings.append(
+                f"docs/observability.md documents {name!r} but nothing "
+                f"registers it")
+    return errors, warnings
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--warn-only", action="store_true",
+                   help="always exit 0 (CI ride-along mode)")
+    args = p.parse_args(argv)
+    errors, warnings = lint()
+    for w in warnings:
+        print(f"metrics_lint: warning: {w}")
+    for e in errors:
+        print(f"metrics_lint: {'warning' if args.warn_only else 'error'}:"
+              f" {e}")
+    if errors and not args.warn_only:
+        print(f"metrics_lint: FAILED ({len(errors)} error(s))")
+        return 1
+    print(f"metrics_lint: OK ({len(errors)} issue(s), "
+          f"{len(warnings)} warning(s))"
+          if not errors else
+          f"metrics_lint: {len(errors)} issue(s) (non-fatal)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -20,4 +20,7 @@ if [ -f "$base_file" ]; then
   base=$(tr -cd 0-9 < "$base_file")
   echo "DOTS_DELTA=$((dots - base)) (baseline $base)"
 fi
+# telemetry catalog lint: non-fatal here (ride-along visibility); the
+# standalone `python scripts/metrics_lint.py` form is fatal
+python "$(dirname "$0")/metrics_lint.py" --warn-only || true
 exit $rc
