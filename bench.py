@@ -544,10 +544,18 @@ def phase_optimizer_loop(on_tpu: bool, batch: int, size: int, host_batch):
             snap_path = os.path.join(
                 os.path.dirname(os.path.abspath(__file__)),
                 "BENCH_telemetry.json")
+            snap = json_snapshot()
             with open(snap_path, "w", encoding="utf-8") as f:
-                json.dump(json_snapshot(), f)
+                json.dump(snap, f, default=str)
             _update(telemetry_snapshot=os.path.basename(snap_path))
             _log(f"telemetry snapshot written to {snap_path}")
+            # flight-recorder summary: the snapshot embeds the event
+            # ring, so a bench run's retries/faults/commits are
+            # attributable after the fact
+            ev = snap.get("events", {})
+            _log(f"flight recorder: {ev.get('buffered', 0)} events "
+                 f"{ev.get('by_kind', {})} ({ev.get('dropped', 0)} "
+                 f"dropped)")
         except Exception:
             _log("telemetry snapshot failed (non-fatal):\n"
                  + traceback.format_exc())

@@ -9,12 +9,19 @@ Protocol (stdlib-only on both ends):
 
 * ``POST /predict`` with an ``.npy``-serialized array body →
   ``.npy``-serialized output array (``application/octet-stream``).
-* ``GET /healthz`` → ``{"status": "ok"}``.
+* ``GET /healthz`` → ``{"status": "ok"}``, or **503**
+  ``{"status": "draining"}`` once shutdown has begun — a load balancer
+  keeps routing to a replica that answers 200, so a draining one must
+  stop saying "ok" while it finishes its in-flight work.
 * ``GET /metrics`` → Prometheus text exposition from the unified
   ``bigdl_tpu.telemetry`` registry: serving latency quantiles, queue
   depth, batch occupancy — plus every optimizer/checkpoint family (one
   scrape config covers training and serving roles; see
   docs/observability.md).
+* ``GET /statusz`` / ``GET /tracez`` / ``POST /profilez`` — live
+  introspection (status page, recent spans, on-demand time-boxed
+  ``jax.profiler`` capture returning its logdir); see
+  docs/observability.md "Health & introspection".
 
 Client::
 
@@ -49,11 +56,17 @@ class BatchedBytesFrontend:
         return npy_call_bytes(self._server.submit, payload)
 
 
-def make_server(service, host: str, port: int) -> ThreadingHTTPServer:
+def make_server(service, host: str, port: int,
+                statusz_fn=None) -> ThreadingHTTPServer:
     """ThreadingHTTPServer wired to a PredictionService; concurrency is
-    bounded by the service's ticket pool, not the HTTP threads."""
+    bounded by the service's ticket pool, not the HTTP threads.  The
+    returned server carries ``health_state`` (flip ``["draining"]`` to
+    make ``/healthz`` answer 503) and ``debugz`` (the
+    /statusz|/tracez|/profilez logic; its ``statusz_fn`` may be set
+    after construction)."""
+    from bigdl_tpu.telemetry.debugz import Debugz, DebugzHandlerMixin
 
-    class Handler(BaseHTTPRequestHandler):
+    class Handler(DebugzHandlerMixin, BaseHTTPRequestHandler):
         def log_message(self, fmt, *fargs):
             logger.info("%s " + fmt, self.address_string(), *fargs)
 
@@ -66,9 +79,19 @@ def make_server(service, host: str, port: int) -> ThreadingHTTPServer:
             self.wfile.write(body)
 
         def do_GET(self):
+            if self.handle_debugz("GET"):
+                return
             if self.path == "/healthz":
-                self._reply(200, json.dumps({"status": "ok"}).encode(),
-                            "application/json")
+                if self.server.health_state.get("draining"):
+                    # non-200: the LB must stop routing here while the
+                    # in-flight batches finish
+                    self._reply(503, json.dumps(
+                        {"status": "draining"}).encode(),
+                        "application/json")
+                else:
+                    self._reply(200,
+                                json.dumps({"status": "ok"}).encode(),
+                                "application/json")
             elif self.path == "/metrics":
                 from bigdl_tpu.telemetry import prometheus_text
                 self._reply(200, prometheus_text().encode(),
@@ -77,6 +100,8 @@ def make_server(service, host: str, port: int) -> ThreadingHTTPServer:
                 self._reply(404, b"not found", "text/plain")
 
         def do_POST(self):
+            if self.handle_debugz("POST"):
+                return
             if self.path != "/predict":
                 self._reply(404, b"not found", "text/plain")
                 return
@@ -89,7 +114,10 @@ def make_server(service, host: str, port: int) -> ThreadingHTTPServer:
                     {"error": f"{type(e).__name__}: {e}"}).encode(),
                     "application/json")
 
-    return ThreadingHTTPServer((host, port), Handler)
+    server = ThreadingHTTPServer((host, port), Handler)
+    server.health_state = {"draining": False}
+    server.debugz = Debugz(statusz_fn=statusz_fn)
+    return server
 
 
 def main(argv=None):
@@ -144,6 +172,16 @@ def main(argv=None):
                                 batch_timeout_ms=args.batch_timeout_ms)
         service = BatchedBytesFrontend(batcher)
     server = make_server(service, args.host, args.port)
+
+    def _statusz():
+        info = {"role": "server", "model": args.model,
+                "dynamic_batch": args.dynamic_batch,
+                "draining": server.health_state.get("draining", False)}
+        if batcher is not None:
+            info["queue_depth"] = batcher.queue_depth()
+        return info
+
+    server.debugz.statusz_fn = _statusz
     logger.info("serving on %s:%d", args.host, server.server_port)
     # SIGTERM (the orchestrator's stop notice) takes the same graceful
     # path as Ctrl-C: unwind serve_forever, then drain the batcher so
@@ -165,11 +203,24 @@ def main(argv=None):
     except KeyboardInterrupt:
         pass
     finally:
-        server.server_close()
+        # shutdown has begun: from here /healthz answers 503 draining,
+        # so the load balancer stops routing to this replica while the
+        # already-admitted requests finish
+        server.health_state["draining"] = True
         if batcher is not None:
-            # the documented drain: queued requests are answered before
-            # the scheduler thread exits
+            # keep answering HTTP (now-503 health checks, in-flight
+            # predicts) on a background accept loop while the batcher
+            # drains: the documented drain answers every queued request
+            # before the scheduler thread exits
+            import threading
+
+            t = threading.Thread(target=server.serve_forever,
+                                 daemon=True, name="bigdl-serve-drain")
+            t.start()
             batcher.shutdown(drain=True)
+            server.shutdown()
+            t.join(timeout=10.0)
+        server.server_close()
         if prev_term is not None:
             signal.signal(signal.SIGTERM, prev_term)
     return server

@@ -28,6 +28,7 @@ Triggers, ValidationMethods, checkpoint/resume with epoch position
 from __future__ import annotations
 
 import logging
+import math
 import os
 import random
 import threading
@@ -53,7 +54,9 @@ from bigdl_tpu.parallel.sharding import (
     ShardingRules, shard_model_params, replicated,
 )
 from bigdl_tpu import telemetry
+from bigdl_tpu.telemetry import events as _te
 from bigdl_tpu.telemetry import families as _tm, tracing as _tt
+from bigdl_tpu.telemetry.health import HealthWatchdog
 from bigdl_tpu.utils import chaos
 from bigdl_tpu.utils.file import CheckpointManager, load_checkpoint
 from bigdl_tpu.utils.xla_cost import compiled_flops
@@ -155,6 +158,18 @@ class Optimizer:
         # flag; the loop acts on it at the next safe step boundary
         self._preempt_requested = False
         self.preempted = False
+        # health watchdog + introspection sidecar: both OFF by default
+        # (a run without them pays nothing new; see
+        # set_health_watchdog / set_debug_server)
+        self.watchdog: Optional[HealthWatchdog] = None
+        self.watchdog_halted = False
+        self._halt_requested = False
+        self.debug_host: Optional[str] = None
+        self.debug_port: Optional[int] = None
+        self.debug_server = None
+        self._last_ckpt_generation: Optional[int] = None
+        self._last_ckpt_path: Optional[str] = None
+        self._run_started: Optional[float] = None
 
     # ---- configuration (reference Optimizer.scala setters) -------------
 
@@ -284,6 +299,50 @@ class Optimizer:
         self.profile_steps = (int(start_iteration), int(num_iterations))
         return self
 
+    def set_health_watchdog(self, watchdog: Optional[HealthWatchdog]
+                            = None, **kwargs) -> "Optimizer":
+        """Arm the training-health watchdog: in-graph non-finite
+        detection on loss and global gradient norm (the norm reuses the
+        grad-clip computation when ``grad_clip_norm`` is set), EWMA
+        loss-spike and step-time-outlier detection, and a
+        data-starvation detector — each anomaly class with a ``warn`` /
+        ``skip_step`` / ``checkpoint_and_halt`` policy (see
+        :class:`bigdl_tpu.telemetry.health.HealthWatchdog` and
+        docs/observability.md).  Pass a configured watchdog, OR kwargs
+        forwarded to its constructor — never both, that raises (the
+        kwargs would be silently ignored, and a policy the caller
+        believes is set but isn't is exactly the failure this subsystem
+        exists to prevent).  No arguments arms the defaults (non-finite
+        halts, the rest warn).
+
+        The watchdog needs per-iteration loss readback, so it forces
+        ``log_interval`` to 1 and single-step dispatch — health
+        monitoring trades the batched-readback optimization for
+        detection latency of one step.  Disarm with
+        ``self.watchdog = None``."""
+        if watchdog is not None and kwargs:
+            raise ValueError(
+                "set_health_watchdog: pass a configured HealthWatchdog "
+                "OR constructor kwargs, not both (the kwargs would be "
+                f"silently ignored: {sorted(kwargs)})")
+        self.watchdog = (watchdog if watchdog is not None
+                         else HealthWatchdog(**kwargs))
+        return self
+
+    def set_debug_server(self, port: int = 0,
+                         host: str = "127.0.0.1") -> "Optimizer":
+        """Serve live introspection endpoints — ``GET /statusz`` (step,
+        epoch, last good checkpoint generation, watchdog state, recent
+        flight-recorder events), ``GET /tracez`` (recent spans), ``POST
+        /profilez`` (time-boxed jax.profiler capture), plus
+        ``/healthz`` and ``/metrics`` — on a sidecar HTTP thread for
+        the duration of ``optimize()``.  ``port=0`` picks an ephemeral
+        port (read it from ``self.debug_server.port`` once running).
+        Off unless called."""
+        self.debug_host = host
+        self.debug_port = int(port)
+        return self
+
     def set_train_summary(self, summary) -> "Optimizer":
         self.train_summary = summary
         return self
@@ -326,26 +385,40 @@ class Optimizer:
     # ---- the jitted SPMD train step -------------------------------------
 
     def _build_step(self, mesh, group_names, spec_groups=None,
-                    window=False):
+                    window=False, health=False):
+        assert not (window and health), \
+            "watchdog monitoring forces single-step dispatch"
         criterion = self.criterion
         clip_const = self.grad_clip_const
         clip_norm = self.grad_clip_norm
         methods = ([self.optim_method] if group_names == ["__default__"]
                    else [self.optim_methods[g] for g in group_names])
         compute_dtype = self.compute_dtype
+        # nonfinite-guard policy is a TRACE-TIME constant: the guard
+        # compiles into the step only when the watchdog wants updates
+        # discarded (skip_step / checkpoint_and_halt)
+        guard_updates = health and self.watchdog is not None \
+            and self.watchdog.guard_updates
 
         def clip(grads):
+            """Clip one group's grads; returns (clipped, l2_norm).  The
+            norm is computed at most once — the watchdog's in-graph
+            monitor reuses the grad-clip norm when ``grad_clip_norm``
+            is set instead of paying a second reduction — and is None
+            when nothing needs it."""
             if clip_const is not None:
                 lo, hi = clip_const
                 grads = jax.tree_util.tree_map(
                     lambda g: jnp.clip(g, lo, hi), grads)
-            if clip_norm is not None:
+            total = None
+            if clip_norm is not None or health:
                 leaves = jax.tree_util.tree_leaves(grads)
                 total = jnp.sqrt(sum(jnp.sum(g.astype(jnp.float32) ** 2)
                                      for g in leaves))
+            if clip_norm is not None:
                 scale = jnp.minimum(1.0, clip_norm / (total + 1e-12))
                 grads = jax.tree_util.tree_map(lambda g: g * scale, grads)
-            return grads
+            return grads, total
 
         merge_groups = self._merge_groups_host  # jit-traceable as-is
 
@@ -390,7 +463,16 @@ class Optimizer:
                 grads_groups = [
                     apply_reg(g, p, sp) for g, p, sp in
                     zip(grads_groups, params_groups, spec_groups)]
-            grads_groups = [clip(g) for g in grads_groups]
+            clipped = [clip(g) for g in grads_groups]
+            grads_groups = [g for g, _t in clipped]
+            gnorm = None
+            if health:
+                # global (pre-clip-scale) grad L2 norm, fused into the
+                # step: per-group norms already exist for clipping, so
+                # the global one is one combine away
+                totals = [t for _g, t in clipped]
+                gnorm = (totals[0] if len(totals) == 1
+                         else jnp.sqrt(sum(t ** 2 for t in totals)))
             new_groups, new_states = [], []
             for g, p, s, meth in zip(grads_groups, params_groups,
                                      opt_states, methods):
@@ -401,6 +483,22 @@ class Optimizer:
             if compute_dtype is not None:
                 # buffers (BN stats) ride back to fp32 master copies
                 new_rest = cast_floating(new_rest, jnp.float32)
+            if guard_updates:
+                # watchdog skip/halt policy: a nonfinite loss or grad
+                # norm discards the whole update in-graph — params,
+                # optimizer state, and buffers keep their pre-step
+                # values, so the final checkpoint after a halt holds
+                # uncontaminated weights (and skip_step keeps training
+                # on the last good state)
+                ok = jnp.isfinite(loss) & jnp.isfinite(gnorm)
+                keep = lambda n, o: jnp.where(ok, n, o)  # noqa: E731
+                new_groups = jax.tree_util.tree_map(
+                    keep, new_groups, params_groups)
+                new_states = jax.tree_util.tree_map(
+                    keep, new_states, opt_states)
+                new_rest = jax.tree_util.tree_map(keep, new_rest, rest)
+            if health:
+                return new_groups, new_rest, new_states, loss, gnorm
             return new_groups, new_rest, new_states, loss
 
         def _aot(jitted, steps_of=lambda args: 1):
@@ -612,6 +710,123 @@ class Optimizer:
                 pass
         return restore
 
+    # ---- introspection sidecar + watchdog plumbing -----------------------
+
+    def statusz(self) -> Dict[str, Any]:
+        """The trainer's contribution to ``GET /statusz`` (see
+        :mod:`bigdl_tpu.telemetry.debugz`): live step/epoch/loss, the
+        last good checkpoint generation, watchdog state, run flags.
+        Non-finite floats are stringified (``events.json_safe``) — the
+        page must stay valid strict JSON even while the loss is NaN
+        (that being exactly when an operator scrapes it)."""
+        _j = _te.json_safe
+        st = self.state
+        out: Dict[str, Any] = {
+            "role": "trainer",
+            "epoch": st.get("epoch"),
+            "iteration": st.get("neval"),
+            "records": st.get("records"),
+            "loss": _j(st.get("loss")),
+            "score": _j(st.get("score")),
+            "run_uptime_s": (None if self._run_started is None
+                             else time.time() - self._run_started),
+            "preempted": self.preempted,
+            "watchdog_halted": self.watchdog_halted,
+            "checkpoint": {
+                "path": self.checkpoint_path,
+                "last_generation": self._last_ckpt_generation,
+                "last_payload": self._last_ckpt_path,
+            },
+        }
+        if self.watchdog is not None:
+            out["watchdog"] = self.watchdog.state()
+        return out
+
+    def _start_debug_server(self) -> None:
+        if self.debug_port is None or self.debug_server is not None:
+            return
+        try:
+            from bigdl_tpu.telemetry.debugz import Debugz, DebugzServer
+            self.debug_server = DebugzServer(
+                Debugz(statusz_fn=self.statusz),
+                host=self.debug_host or "127.0.0.1",
+                port=self.debug_port).start()
+        except Exception:
+            logger.exception("debug server failed to start (training "
+                             "continues without introspection endpoints)")
+            self.debug_server = None
+
+    def _stop_debug_server(self) -> None:
+        srv = self.debug_server
+        self.debug_server = None
+        if srv is not None:
+            try:
+                srv.stop()
+            except Exception:  # pragma: no cover - best effort
+                logger.exception("debug server failed to stop")
+
+    def _watchdog_step_check(self, wd: HealthWatchdog, loss, gnorm,
+                             neval: int) -> None:
+        """Per-iteration host check, watchdog mode only: ONE batched
+        device transfer of (loss, grad-norm) — the extra readback the
+        watchdog trades for one-step detection latency.  With the
+        watchdog off this method is never called and the loop performs
+        zero additional per-step host transfers.  ``wd`` is the
+        attempt-start snapshot, NOT ``self.watchdog`` — the documented
+        mid-run disarm (``self.watchdog = None``) must not crash an
+        iteration already in flight; it takes effect on the next
+        ``optimize()``."""
+        lf, gn = (float(v) for v in jax.device_get((loss, gnorm)))
+        if telemetry.enabled() and math.isfinite(gn):
+            _tm.grad_norm().observe(gn)
+        wd.observe_step(neval, lf, gn)
+        if wd.halt_requested:
+            self._halt_requested = True
+
+    def _dump_flight_recorder(self, reason: str,
+                              error: Optional[BaseException] = None) \
+            -> Optional[str]:
+        """Write the flight-recorder ring to ``flight_recorder.json``
+        next to the checkpoint — the black box a halted or dead run
+        leaves behind.  Best-effort: never raises into the crash path
+        it documents; no-op without a checkpoint path (nowhere durable
+        to leave it).  Primary process only: in a multi-host run every
+        process halts/crashes together, and concurrent writers on a
+        shared checkpoint store would tear the one artifact the
+        postmortem depends on."""
+        if not self.checkpoint_path:
+            logger.debug("no checkpoint path configured; skipping "
+                         "flight-recorder dump")
+            return None
+        try:
+            from bigdl_tpu.utils.file import (
+                _is_primary_process, is_remote_path, open_file,
+                strip_file_scheme,
+            )
+            if not _is_primary_process():
+                return None
+            _te.record_event(
+                "flight_recorder_dump", reason=reason,
+                **({"error": f"{type(error).__name__}: {error}"}
+                   if error is not None else {}))
+            root = strip_file_scheme(self.checkpoint_path)
+            if is_remote_path(root):
+                path = root.rstrip("/") + "/flight_recorder.json"
+            else:
+                os.makedirs(root, exist_ok=True)
+                path = os.path.join(root, "flight_recorder.json")
+            # dumps_events is THE wire format — same serializer as
+            # events.dump_events, just routed through open_file so
+            # fsspec checkpoint stores get the dump too
+            with open_file(path, "wb") as f:
+                f.write(_te.dumps_events().encode("utf-8"))
+            logger.warning("flight recorder dumped to %s (%s)", path,
+                           reason)
+            return path
+        except Exception:
+            logger.exception("flight-recorder dump failed")
+            return None
+
     # ---- main loop (≙ DistriOptimizer.optimize, :823) --------------------
 
     def optimize(self) -> Module:
@@ -619,11 +834,19 @@ class Optimizer:
         transient failure with exponential backoff (≙ the reference's
         retry loop around optimize, DistriOptimizer.scala:901-983).
         Programming errors re-raise immediately; SIGTERM triggers a
-        final checkpoint and a clean return (``self.preempted`` set)."""
+        final checkpoint and a clean return (``self.preempted`` set),
+        and a watchdog ``checkpoint_and_halt`` verdict does the same
+        with ``self.watchdog_halted`` set plus a flight-recorder dump
+        next to the checkpoint.  An unhandled crash (non-retryable, or
+        retries exhausted) also dumps the flight recorder before
+        re-raising — the dead run leaves a black box."""
         retries_left = self.retry_times
         last_failure = None
         attempt = 0
+        self.watchdog_halted = False
+        self._run_started = time.time()
         restore_signal = self._install_preemption_handler()
+        self._start_debug_server()
         try:
             while True:
                 try:
@@ -638,6 +861,7 @@ class Optimizer:
                             "training failed with non-retryable %s: %s "
                             "(programming error — retrying would hit the "
                             "same wall)", type(e).__name__, e)
+                        self._dump_flight_recorder("crash", error=e)
                         raise
                     now = time.time()
                     if last_failure is not None and \
@@ -647,12 +871,17 @@ class Optimizer:
                     last_failure = now
                     ckpt = self._latest_checkpoint()
                     if retries_left <= 0 or ckpt is None:
+                        self._dump_flight_recorder("crash", error=e)
                         raise
                     retries_left -= 1
                     if telemetry.enabled():
                         _tm.optimizer_retries_total().inc()
                     delay = self._backoff_delay(attempt)
                     attempt += 1
+                    _te.record_event(
+                        "retry", error=f"{type(e).__name__}: {e}",
+                        resume_from=ckpt, retries_left=retries_left,
+                        backoff_s=round(delay, 3))
                     logger.warning(
                         "training failed (%s: %s); resuming from %s in "
                         "%.1fs (%d retr%s left)", type(e).__name__, e,
@@ -663,6 +892,7 @@ class Optimizer:
                     self._resume_from = ckpt
         finally:
             restore_signal()
+            self._stop_debug_server()
 
     def _flush_summaries(self) -> None:
         for s in (self.train_summary, self.val_summary):
@@ -699,6 +929,10 @@ class Optimizer:
         from bigdl_tpu.core.module import param_paths
         mesh = self.mesh_config.build()
         model = self.model.train_mode()
+        wd = self.watchdog
+        self._halt_requested = False
+        if wd is not None:
+            wd.start_run()  # fresh EWMA baselines for this attempt
         if jax.process_count() > 1 and not getattr(
                 self.dataset, "per_process_sharded", lambda: False)():
             raise ValueError(
@@ -800,7 +1034,8 @@ class Optimizer:
                            for idxs in self._group_idx]
         else:
             spec_groups = None  # no per-layer reg/scale anywhere
-        step = self._build_step(mesh, group_names, spec_groups)
+        step = self._build_step(mesh, group_names, spec_groups,
+                                health=wd is not None)
         eval_step = self._build_eval_step() if self.val_methods else None
         x_sharding = batch_sharding(mesh)
 
@@ -823,14 +1058,18 @@ class Optimizer:
             t is not None and getattr(t, "needs_loss", False)
             for t in (self.end_when, self.val_trigger,
                       self.checkpoint_trigger))
+        # the watchdog judges every iteration's loss, so it needs the
+        # same per-iteration (and synchronous) readback a loss-reading
+        # trigger does — detection within one step is the contract
+        needs_loss = needs_loss or wd is not None
         interval = self.log_interval
         if interval is None:
             interval = 1 if needs_loss else 8
         elif needs_loss and interval > 1:
             logger.warning(
                 "log_interval=%d ignored: a loss-reading trigger "
-                "(minLoss) requires per-iteration loss readback",
-                interval)
+                "(minLoss) or the health watchdog requires "
+                "per-iteration loss readback", interval)
             interval = 1
         # pending: (neval, epoch, n_records, records_cum, loss_device)
         pending: List[Tuple] = []
@@ -908,6 +1147,16 @@ class Optimizer:
                              / len(entries), count=len(entries))
             self.window_timings.append(
                 (len(entries), window_dt, data_t))
+            if wd is not None:
+                # completion-timestamp stream → step-time-outlier and
+                # data-starvation judgment (sync in watchdog mode, so a
+                # halt verdict is seen before the next dispatch; the
+                # attempt-start snapshot, so a mid-run disarm can't
+                # crash the drain)
+                wd.observe_window(window_dt, data_t, len(entries),
+                                  step=entries[-1][0])
+                if wd.halt_requested:
+                    self._halt_requested = True
             if telemetry.enabled():
                 # the honest per-iteration device time (same number the
                 # "device step time" Metrics line reports), observed
@@ -1012,6 +1261,12 @@ class Optimizer:
                 flushq.join()
 
         k_req = max(1, int(self.iters_per_dispatch))
+        if wd is not None and k_req > 1:
+            logger.warning(
+                "iterations_per_dispatch=%d ignored: the health "
+                "watchdog needs per-iteration loss readback "
+                "(single-step dispatch)", k_req)
+            k_req = 1
         wstep = None
         w_sharding = None
         stage_cache: Dict[Tuple[int, ...], Any] = {}
@@ -1177,9 +1432,16 @@ class Optimizer:
                         rng = jax.random.fold_in(seed_key,
                                                  self.state["neval"])
                         t_data = time.time() - it_start
-                        params_groups, rest, opt_states, loss = step(
-                            params_groups, rest, opt_states, x, y, rng,
-                            epoch)
+                        if wd is not None:
+                            (params_groups, rest, opt_states, loss,
+                             gnorm) = step(params_groups, rest,
+                                           opt_states, x, y, rng, epoch)
+                            self._watchdog_step_check(
+                                wd, loss, gnorm, self.state["neval"])
+                        else:
+                            params_groups, rest, opt_states, loss = \
+                                step(params_groups, rest, opt_states,
+                                     x, y, rng, epoch)
                         loss_list = [loss]
                     self.metrics.add("data load and transfer", t_data)
                     if telemetry.enabled():
@@ -1228,22 +1490,47 @@ class Optimizer:
                         # a custom end trigger fires mid-window —
                         # otherwise checkpoints disagree with weights
                         stop = (stop or bool(self.end_when(self.state))
-                                or self._preempt_requested)
-                if self._preempt_requested:
-                    # SIGTERM landed: this is the requested safe step
+                                or self._preempt_requested
+                                or self._halt_requested)
+                if self._preempt_requested or self._halt_requested:
+                    # SIGTERM, or a watchdog checkpoint_and_halt
+                    # verdict, landed: this is the requested safe step
                     # boundary — no collective is in flight.  Write the
-                    # final checkpoint and return cleanly instead of
-                    # dying mid-epoch (the epoch counter must NOT
-                    # advance: the epoch is unfinished and resume has
-                    # to replay its remaining batches).
+                    # final checkpoint (the watchdog's in-graph guard
+                    # already discarded any nonfinite update, so the
+                    # saved weights are good) and return cleanly
+                    # instead of dying mid-epoch (the epoch counter
+                    # must NOT advance: the epoch is unfinished and
+                    # resume has to replay its remaining batches).
+                    halting = self._halt_requested
                     flush_pending(params_groups, rest, opt_states,
                                   sync=True)
-                    self._preemption_checkpoint(params_groups, rest,
-                                                opt_states)
-                    self.preempted = True
-                    logger.warning(
-                        "preemption: exiting training cleanly at epoch "
-                        "%d iteration %d", epoch, self.state["neval"])
+                    self._preemption_checkpoint(
+                        params_groups, rest, opt_states,
+                        reason="watchdog halt" if halting
+                        else "preemption")
+                    if halting:
+                        self.watchdog_halted = True
+                        _te.record_event(
+                            "watchdog_halt", epoch=epoch,
+                            iteration=self.state["neval"],
+                            checkpoint_generation=(
+                                self._last_ckpt_generation))
+                        self._dump_flight_recorder("watchdog_halt")
+                        logger.warning(
+                            "watchdog: halting training at epoch %d "
+                            "iteration %d (final checkpoint written, "
+                            "flight recorder dumped)", epoch,
+                            self.state["neval"])
+                    else:
+                        self.preempted = True
+                        _te.record_event(
+                            "preemption", epoch=epoch,
+                            iteration=self.state["neval"])
+                        logger.warning(
+                            "preemption: exiting training cleanly at "
+                            "epoch %d iteration %d", epoch,
+                            self.state["neval"])
                     break
                 self.state["epoch"] += 1
                 self.state["is_epoch_end"] = True
@@ -1355,26 +1642,32 @@ class Optimizer:
             # orbax tree under a FIXED key set (strict orbax restores
             # match structures exactly; self.state grows transient keys
             # mid-loop)
-            return mgr.save(
+            path = mgr.save(
                 {"params": temp.parameters(), "buffers": temp.buffers()},
                 [s for s in opt_states],
                 {k: driver[k] for k in _DRIVER_KEYS if k in driver},
                 generation=self.state["neval"],
                 overwrite=self.overwrite_checkpoint, sharded=True)
-        return mgr.save(
-            {"params": _to_plain(temp.parameters()),
-             "buffers": _to_plain(temp.buffers())},
-            [s for s in opt_states], driver,
-            generation=self.state["neval"],
-            overwrite=self.overwrite_checkpoint, sharded=False)
+        else:
+            path = mgr.save(
+                {"params": _to_plain(temp.parameters()),
+                 "buffers": _to_plain(temp.buffers())},
+                [s for s in opt_states], driver,
+                generation=self.state["neval"],
+                overwrite=self.overwrite_checkpoint, sharded=False)
+        # /statusz reports the last generation this run committed
+        self._last_ckpt_generation = self.state["neval"]
+        self._last_ckpt_path = path
+        return path
 
-    def _preemption_checkpoint(self, params_groups, rest, opt_states):
-        """The final checkpoint a SIGTERM requests; written outside any
-        trigger schedule so no progress since the last periodic
-        checkpoint is lost to the preemption."""
+    def _preemption_checkpoint(self, params_groups, rest, opt_states,
+                               reason: str = "preemption"):
+        """The final checkpoint a SIGTERM (or a watchdog halt verdict)
+        requests; written outside any trigger schedule so no progress
+        since the last periodic checkpoint is lost."""
         if not self.checkpoint_path:
-            logger.warning("preemption: no checkpoint path configured; "
-                           "exiting without a final checkpoint")
+            logger.warning("%s: no checkpoint path configured; "
+                           "exiting without a final checkpoint", reason)
             return
         if self._last_ckpt_neval == self.state["neval"]:
             return  # this exact boundary is already checkpointed
@@ -1385,12 +1678,12 @@ class Optimizer:
         try:
             with self.metrics.time("checkpoint time"):
                 path = self._write_checkpoint(temp, opt_states, driver)
-            logger.info("preemption checkpoint written to %s", path)
+            logger.info("%s checkpoint written to %s", reason, path)
         except Exception:
             # best effort: a failed final save must not turn a clean
-            # preemption exit into a crash (the periodic checkpoint
-            # still exists)
-            logger.exception("preemption checkpoint failed")
+            # preemption/halt exit into a crash (the periodic
+            # checkpoint still exists)
+            logger.exception("%s checkpoint failed", reason)
 
     def _sync_into(self, target: Module, source: Module):
         """Copy arrays from the trained functional copy back into the

@@ -13,6 +13,7 @@ the fused XLA ops).  Both are provided here.
 
 from __future__ import annotations
 
+import logging
 import time
 from contextlib import contextmanager
 from typing import Dict, List, Tuple
@@ -20,6 +21,8 @@ from typing import Dict, List, Tuple
 import jax
 
 __all__ = ["module_forward_times", "times_by_module_type", "profile_trace"]
+
+logger = logging.getLogger("bigdl_tpu.optim")
 
 
 # sentinel: "the module had NO instance-level forward before patching"
@@ -99,9 +102,34 @@ def times_by_module_type(records) -> Dict[str, Tuple[int, float]]:
 def profile_trace(logdir: str):
     """jax.profiler trace context — view in TensorBoard's profile tab.
     The whole-step source of truth on real hardware (fused XLA ops,
-    per-op HLO timings, HBM traffic)."""
-    jax.profiler.start_trace(logdir)
+    per-op HLO timings, HBM traffic).
+
+    Reentrancy-tolerant: jax.profiler allows ONE trace per process, and
+    a capture that died between start and stop (a crashed ``/profilez``
+    request, a KeyboardInterrupt mid-trace) used to leave the profiler
+    wedged so every later capture failed with "already started".  Here
+    a failing start stops the orphaned trace and retries once, and
+    start/stop are always paired — the body's exception is never masked
+    by stop's."""
+    try:
+        jax.profiler.start_trace(logdir)
+    except Exception:
+        # an orphaned trace from a previous crashed capture holds the
+        # profiler; reclaim it and retry once (a second failure is a
+        # real error and propagates)
+        try:
+            jax.profiler.stop_trace()
+        except Exception:
+            pass
+        jax.profiler.start_trace(logdir)
     try:
         yield
     finally:
-        jax.profiler.stop_trace()
+        try:
+            jax.profiler.stop_trace()
+        except Exception:
+            # someone else already stopped it (or the backend tore the
+            # trace down); the capture is over either way, and raising
+            # here would mask the body's own exception
+            logger.warning("jax.profiler.stop_trace failed "
+                           "(trace already stopped?)", exc_info=True)

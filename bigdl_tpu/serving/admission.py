@@ -121,6 +121,11 @@ class BoundedRequestQueue:
             # callbacks inline on set_exception
             _fail_future(shed.future, RequestSheddedError(
                 "request shed by a newer arrival under shed_oldest"))
+            from bigdl_tpu.telemetry import events as _te
+            _te.record_event(
+                "admission_shed",
+                queued_s=round(time.perf_counter() - shed.t_enqueue, 6),
+                capacity=self.capacity)
             if self._on_shed is not None:
                 self._on_shed()
 

@@ -35,6 +35,10 @@ from bigdl_tpu.telemetry.tracing import (      # noqa: F401
 from bigdl_tpu.telemetry.export import (       # noqa: F401
     prometheus_text, json_snapshot, publish_summary, PeriodicExporter,
 )
+from bigdl_tpu.telemetry.events import (       # noqa: F401
+    record_event, recent_events, event_counts, dropped_events,
+    reset_events, dump_events,
+)
 
 __all__ = [
     "enable", "disable", "enabled", "reset",
@@ -44,6 +48,8 @@ __all__ = [
     "write_chrome_trace",
     "prometheus_text", "json_snapshot", "publish_summary",
     "PeriodicExporter",
+    "record_event", "recent_events", "event_counts", "dropped_events",
+    "reset_events", "dump_events",
 ]
 
 # THE hot-path switch: instrumentation sites read this through
@@ -73,9 +79,11 @@ def enabled() -> bool:
 
 def reset() -> None:
     """Test-friendly full reset: zero every metric in place (handles
-    stay valid) and drop all buffered spans."""
+    stay valid), drop all buffered spans, and clear the flight
+    recorder."""
     get_registry().reset()
     reset_spans()
+    reset_events()
 
 
 if _os.environ.get("BIGDL_TPU_TELEMETRY", "").lower() in (

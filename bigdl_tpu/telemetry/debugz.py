@@ -1,0 +1,261 @@
+"""Live introspection endpoints: ``/statusz``, ``/tracez``,
+``/profilez``.
+
+The question an operator asks a misbehaving process is not "what is
+your p99" (Prometheus already has it) but "what are you doing *right
+now*, and what went wrong *recently*?" — answered here without
+restarting anything:
+
+* ``GET  /statusz``  — one JSON page: uptime, telemetry state, the
+  flight-recorder tail, plus whatever the owning process contributes
+  (the optimizer: step/epoch, last good checkpoint generation,
+  watchdog state; the serving CLI: model, queue depth, drain state).
+* ``GET  /tracez``   — the newest spans from the PR-3 ring buffer
+  (``?limit=N``, default 200), so "where did the last second go" is a
+  curl away.
+* ``POST /profilez`` — a time-boxed ``jax.profiler`` capture (body:
+  ``{"duration_s": 1.0, "logdir": "..."}``, both optional) via
+  ``optim.profiling.profile_trace``; returns the logdir to point
+  TensorBoard's profile tab at.  One capture at a time — a concurrent
+  POST gets 409.
+
+One :class:`DebugzHandlerMixin` serves all three, mounted on the
+``examples/serve.py`` HTTP server and on the opt-in trainer sidecar
+(:class:`DebugzServer`, see ``Optimizer.set_debug_server``).  Both are
+**off by default** on the trainer; nothing here is imported into a hot
+path.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import tempfile
+import threading
+import time
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Optional
+
+__all__ = ["Debugz", "DebugzHandlerMixin", "DebugzServer",
+           "ProfileBusyError"]
+
+logger = logging.getLogger("bigdl_tpu.debugz")
+
+# profilez duration clamp: long enough to catch a slow step, short
+# enough that a stray POST can't wedge an HTTP thread for minutes
+_MAX_PROFILE_S = 30.0
+_MIN_PROFILE_S = 0.01
+
+
+class ProfileBusyError(RuntimeError):
+    """A profile capture is already in progress (jax.profiler allows
+    one trace at a time; concurrent POSTs get 409)."""
+
+
+class Debugz:
+    """The endpoint logic, HTTP-free (unit-testable; the handler mixin
+    is glue).  ``statusz_fn`` is the owning process's contribution to
+    the status page — a zero-arg callable returning a JSON-able dict,
+    merged over the base fields."""
+
+    def __init__(self, statusz_fn: Optional[Callable[[], Dict]] = None):
+        self.statusz_fn = statusz_fn
+        self._t0 = time.time()
+        self._profile_busy = threading.Lock()
+
+    def statusz(self) -> Dict:
+        from bigdl_tpu import telemetry
+        from bigdl_tpu.telemetry import events, tracing
+        ev = events.events_summary(50)
+        base: Dict = {
+            "time": time.time(),
+            "pid": os.getpid(),
+            "uptime_s": time.time() - self._t0,
+            "telemetry_enabled": telemetry.enabled(),
+            "spans": {"buffered": len(tracing.finished_spans()),
+                      "dropped": tracing.dropped_spans()},
+            "events": {"counts": ev["counts"], "dropped": ev["dropped"],
+                       "recent": ev["recent"]},
+        }
+        if self.statusz_fn is not None:
+            try:
+                extra = self.statusz_fn()
+            except Exception as e:  # a broken provider must not 500
+                extra = {"statusz_error": f"{type(e).__name__}: {e}"}
+            if extra:
+                base.update(extra)
+        return base
+
+    def tracez(self, limit: int = 200) -> Dict:
+        from bigdl_tpu.telemetry import tracing
+        spans = tracing.finished_spans()
+        limit = max(int(limit), 0)
+        out = []
+        # NOT spans[-limit:]: a -0 slice is the whole ring, and
+        # limit=0 must mean "just the counters, no spans"
+        for rec in spans[len(spans) - min(limit, len(spans)):]:
+            d = {"name": rec.name,
+                 "start_time": tracing.wall_time_of(rec.t_start),
+                 "duration_s": rec.duration_s,
+                 "span_id": rec.span_id,
+                 "thread": rec.thread}
+            if rec.parent_id is not None:
+                d["parent_id"] = rec.parent_id
+            if rec.args:
+                d["args"] = rec.args
+            out.append(d)
+        return {"buffered": len(spans),
+                "dropped": tracing.dropped_spans(),
+                "limit": limit, "spans": out}
+
+    def profilez(self, duration_s: float = 1.0,
+                 logdir: Optional[str] = None) -> Dict:
+        """Run a time-boxed ``jax.profiler`` capture and return the
+        logdir.  Device activity dispatched by OTHER threads during the
+        window (the training loop, in-flight serving batches) is what
+        the trace is for; a token op is issued so the logdir is
+        non-empty even on an idle process."""
+        duration_s = min(max(float(duration_s), _MIN_PROFILE_S),
+                         _MAX_PROFILE_S)
+        if not self._profile_busy.acquire(blocking=False):
+            raise ProfileBusyError(
+                "a profile capture is already in progress")
+        try:
+            if logdir is None:
+                logdir = tempfile.mkdtemp(prefix="bigdl-profilez-")
+            import jax
+            import jax.numpy as jnp
+            from bigdl_tpu.optim.profiling import profile_trace
+            t0 = time.perf_counter()
+            with profile_trace(logdir):
+                jax.block_until_ready(jnp.zeros((1,)))  # idle-proof token
+                time.sleep(duration_s)
+            n_files = sum(len(files) for _r, _d, files in os.walk(logdir))
+            logger.info("profilez: %.2fs capture -> %s (%d files)",
+                        duration_s, logdir, n_files)
+            return {"logdir": logdir, "duration_s": duration_s,
+                    "wall_s": time.perf_counter() - t0,
+                    "files": n_files}
+        finally:
+            self._profile_busy.release()
+
+
+class DebugzHandlerMixin:
+    """Mix into a ``BaseHTTPRequestHandler`` whose server carries a
+    ``debugz`` attribute; call ``self.handle_debugz("GET"/"POST")``
+    first in ``do_GET``/``do_POST`` — returns True when the request was
+    one of ours."""
+
+    def _debugz_json(self, code: int, obj: Dict) -> None:
+        body = json.dumps(obj, default=str).encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def handle_debugz(self, method: str) -> bool:
+        dz: Optional[Debugz] = getattr(self.server, "debugz", None)
+        if dz is None:
+            return False
+        path, _, query = self.path.partition("?")
+        if method == "GET" and path == "/statusz":
+            self._debugz_json(200, dz.statusz())
+            return True
+        if method == "GET" and path == "/tracez":
+            params = urllib.parse.parse_qs(query)
+            try:
+                limit = int(params.get("limit", ["200"])[0])
+            except ValueError:
+                self._debugz_json(400, {"error": "limit must be an int"})
+                return True
+            self._debugz_json(200, dz.tracez(limit=limit))
+            return True
+        if method == "POST" and path == "/profilez":
+            n = int(self.headers.get("Content-Length", 0) or 0)
+            raw = self.rfile.read(n) if n else b""
+            try:
+                opts = json.loads(raw) if raw.strip() else {}
+                if not isinstance(opts, dict):
+                    raise ValueError("body must be a JSON object")
+            except ValueError as e:
+                self._debugz_json(400, {"error": f"bad profilez body: {e}"})
+                return True
+            try:
+                result = dz.profilez(
+                    duration_s=opts.get("duration_s", 1.0),
+                    logdir=opts.get("logdir"))
+            except ProfileBusyError as e:
+                self._debugz_json(409, {"error": str(e)})
+                return True
+            except Exception as e:  # noqa: BLE001 - client-facing error
+                self._debugz_json(500,
+                                  {"error": f"{type(e).__name__}: {e}"})
+                return True
+            self._debugz_json(200, result)
+            return True
+        return False
+
+
+class DebugzServer:
+    """The trainer's opt-in introspection sidecar: a tiny threaded HTTP
+    server with the debugz routes plus ``/healthz`` and ``/metrics``
+    (Prometheus), so one port answers liveness, scrape, AND "what are
+    you doing".  Off by default; see ``Optimizer.set_debug_server``."""
+
+    def __init__(self, debugz: Debugz, host: str = "127.0.0.1",
+                 port: int = 0):
+        class Handler(DebugzHandlerMixin, BaseHTTPRequestHandler):
+            def log_message(self, fmt, *fargs):  # quiet by default
+                logger.debug("%s " + fmt, self.address_string(), *fargs)
+
+            def do_GET(self):
+                if self.handle_debugz("GET"):
+                    return
+                if self.path == "/healthz":
+                    self._debugz_json(200, {"status": "ok"})
+                elif self.path == "/metrics":
+                    from bigdl_tpu.telemetry import prometheus_text
+                    body = prometheus_text().encode("utf-8")
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type",
+                        "text/plain; version=0.0.4; charset=utf-8")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                else:
+                    self._debugz_json(404, {"error": "not found"})
+
+            def do_POST(self):
+                if self.handle_debugz("POST"):
+                    return
+                self._debugz_json(404, {"error": "not found"})
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.debugz = debugz
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_port
+
+    def start(self) -> "DebugzServer":
+        if self._thread is not None:
+            raise RuntimeError("debug server already started")
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="bigdl-debugz")
+        self._thread.start()
+        logger.info("debug server listening on port %d", self.port)
+        return self
+
+    def stop(self, timeout: float = 5.0) -> None:
+        if self._thread is None:
+            return
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout)
+        self._thread = None

@@ -1,0 +1,198 @@
+"""Flight recorder: a bounded ring of structured runtime events.
+
+Metrics aggregate (how many retries?) and spans time (how long was the
+step?); neither answers the postmortem question "what happened to this
+run, in order, just before it died?".  The flight recorder does: every
+operationally interesting occurrence — a transient-failure retry, an
+injected chaos fault, a preemption notice, a checkpoint commit or
+``latest_good()`` walkback, an admission-control shed, a watchdog
+verdict — lands here as one structured record, and the whole ring dumps
+to JSON next to the checkpoint when the watchdog halts a run or the
+optimizer loop dies, so a dead run leaves a black box.
+
+Unlike metrics/tracing, recording is **always on**: every call site is
+cold-path (events fire on failures and lifecycle edges, never per
+step), one record is an append into a bounded deque under a lock, and
+the whole point is that the black box exists even for the run where
+nobody thought to enable telemetry.  When the ring is full the oldest
+record is evicted and ``dropped_events()`` counts it — the recorder
+never grows without bound and never throws away the *newest* history,
+which is the part a postmortem reads first.
+
+    from bigdl_tpu.telemetry import events
+    events.record_event("retry", error="XlaRuntimeError: ...",
+                        resume_from="ckpt/checkpoint.12.npz")
+    ...
+    events.dump_events("flight_recorder.json")
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+__all__ = ["record_event", "recent_events", "event_counts",
+           "dropped_events", "reset_events", "set_event_capacity",
+           "events_summary", "events_dict", "dumps_events",
+           "dump_events", "json_safe"]
+
+_DEFAULT_CAPACITY = 2048
+
+_lock = threading.Lock()
+_buffer: deque = deque(maxlen=_DEFAULT_CAPACITY)
+_dropped = 0
+
+
+class EventRecord:
+    """One recorded occurrence.  ``kind`` is a stable snake_case tag
+    (the query key of a postmortem); ``fields`` carry the specifics and
+    must be JSON-serializable-ish (str() is the fallback on dump)."""
+
+    __slots__ = ("kind", "t_wall", "fields")
+
+    def __init__(self, kind: str, t_wall: float, fields: Optional[Dict]):
+        self.kind = kind
+        self.t_wall = t_wall
+        self.fields = fields
+
+    def to_dict(self) -> Dict:
+        d = {"kind": self.kind, "time": self.t_wall}
+        if self.fields:
+            d.update(self.fields)
+        return d
+
+
+def json_safe(v):
+    """Non-finite floats become strings, so every serialization that
+    carries the value (statusz page, flight-recorder dump,
+    json_snapshot) stays strict RFC-8259 JSON — a bare ``NaN`` token
+    would break jq/JSON.parse exactly when an operator scrapes a
+    NaN-loss incident.  THE one implementation of that rule: the
+    watchdog's verdicts and the optimizer's statusz reuse it.  Numpy
+    scalars unwrap to their Python value first (np.float32 is not a
+    ``float`` subclass)."""
+    if type(v).__module__ == "numpy" and getattr(v, "shape", None) == ():
+        v = v.item()
+    if isinstance(v, float) and not math.isfinite(v):
+        return str(v)
+    return v
+
+
+def record_event(kind: str, **fields) -> None:
+    """Append one event to the ring (thread-safe, never raises into the
+    caller's path — a broken recorder must not break a checkpoint
+    commit).  Field values are made JSON-safe at record time."""
+    global _dropped
+    try:
+        if fields:
+            fields = {k: json_safe(v) for k, v in fields.items()}
+        rec = EventRecord(kind, time.time(), fields or None)
+        with _lock:
+            if len(_buffer) == _buffer.maxlen:
+                _dropped += 1
+            _buffer.append(rec)
+    except Exception:  # pragma: no cover - recorder must stay inert
+        pass
+
+
+def recent_events(n: Optional[int] = None) -> List[Dict]:
+    """The newest ``n`` events (all, if None), oldest first, as dicts."""
+    with _lock:
+        recs = list(_buffer)
+    if n is not None and n >= 0:
+        # NOT recs[-n:]: a -0 slice is the WHOLE list, and n=0 must
+        # mean "none"
+        recs = recs[len(recs) - min(n, len(recs)):]
+    return [r.to_dict() for r in recs]
+
+
+def event_counts() -> Dict[str, int]:
+    """{kind: occurrences currently buffered} — the one-line shape of a
+    run's history (note: evicted events are not re-counted here)."""
+    with _lock:
+        recs = list(_buffer)
+    out: Dict[str, int] = {}
+    for r in recs:
+        out[r.kind] = out.get(r.kind, 0) + 1
+    return out
+
+
+def dropped_events() -> int:
+    with _lock:
+        return _dropped
+
+
+def reset_events() -> None:
+    global _dropped
+    with _lock:
+        _buffer.clear()
+        _dropped = 0
+
+
+def set_event_capacity(n: int) -> None:
+    """Resize the ring (keeps the newest events)."""
+    global _buffer
+    if n < 1:
+        raise ValueError("event ring capacity must be >= 1")
+    with _lock:
+        _buffer = deque(_buffer, maxlen=n)
+
+
+def events_summary(recent_n: int = 50) -> Dict:
+    """One coherent locked pass over the ring: buffered/dropped
+    counters, per-kind counts, and the newest ``recent_n`` events —
+    the shape ``/statusz`` and ``json_snapshot`` embed.  A single
+    snapshot (not four separate reads) so the numbers can't disagree
+    with each other mid-scrape, and only the tail is converted to
+    dicts."""
+    with _lock:
+        recs = list(_buffer)
+        dropped = _dropped
+    counts: Dict[str, int] = {}
+    for r in recs:
+        counts[r.kind] = counts.get(r.kind, 0) + 1
+    n = max(int(recent_n), 0)
+    tail = recs[len(recs) - min(n, len(recs)):]
+    return {"buffered": len(recs), "dropped": dropped, "counts": counts,
+            "recent": [r.to_dict() for r in tail]}
+
+
+def events_dict() -> Dict:
+    """The whole ring as one JSON-able dict — what :func:`dump_events`
+    writes and what ``/statusz`` embeds a tail of."""
+    with _lock:
+        recs = list(_buffer)
+        dropped = _dropped
+    counts: Dict[str, int] = {}
+    for r in recs:
+        counts[r.kind] = counts.get(r.kind, 0) + 1
+    return {
+        "time": time.time(),
+        "pid": os.getpid(),
+        "dropped": dropped,
+        "counts": counts,
+        "events": [r.to_dict() for r in recs],
+    }
+
+
+def dumps_events() -> str:
+    """:func:`events_dict` serialized as JSON — THE flight-recorder
+    wire format, shared by :func:`dump_events` and the optimizer's
+    next-to-the-checkpoint dump so the two can never drift.
+    Non-serializable field values degrade to ``str()`` rather than
+    failing the dump — a postmortem artifact that refuses to write
+    because one field held an exception object is worse than one with
+    a stringified field."""
+    return json.dumps(events_dict(), default=str, indent=2)
+
+
+def dump_events(path: str) -> str:
+    """Serialize the ring to ``path`` as JSON (the black-box dump)."""
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(dumps_events())
+    return path

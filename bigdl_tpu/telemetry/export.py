@@ -23,6 +23,7 @@ import threading
 import time
 from typing import Callable, Dict, Optional
 
+from bigdl_tpu.telemetry import events as _events
 from bigdl_tpu.telemetry import tracing
 from bigdl_tpu.telemetry.metrics import (
     Counter, Gauge, Histogram, TelemetryRegistry, get_registry,
@@ -85,7 +86,9 @@ def prometheus_text(registry: Optional[TelemetryRegistry] = None) -> str:
 
 def json_snapshot(registry: Optional[TelemetryRegistry] = None) -> Dict:
     """One coherent JSON-able dict: every metric (collectors included)
-    plus a summary of the span ring buffer."""
+    plus summaries of the span ring buffer and the flight recorder —
+    the latter is how ``BENCH_telemetry.json`` carries a bench run's
+    retry/fault/checkpoint event history."""
     registry = registry or get_registry()
     spans = tracing.finished_spans()
     by_name: Dict[str, Dict] = {}
@@ -93,12 +96,15 @@ def json_snapshot(registry: Optional[TelemetryRegistry] = None) -> Dict:
         agg = by_name.setdefault(s.name, {"count": 0, "total_s": 0.0})
         agg["count"] += 1
         agg["total_s"] += s.duration_s
+    ev = _events.events_summary(50)
     return {
         "time": time.time(),
         "metrics": registry.snapshot(),
         "spans": {"buffered": len(spans),
                   "dropped": tracing.dropped_spans(),
                   "by_name": by_name},
+        "events": {"buffered": ev["buffered"], "dropped": ev["dropped"],
+                   "by_kind": ev["counts"], "recent": ev["recent"]},
     }
 
 

@@ -55,6 +55,31 @@ def optimizer_retries_total() -> Counter:
         "Transient-failure retries taken by Optimizer.optimize()")
 
 
+# ---- training health (watchdog) -------------------------------------------
+
+def training_nonfinite_total() -> Counter:
+    return get_registry().counter(
+        "training_nonfinite_total",
+        "Non-finite loss / gradient-norm detections by the health "
+        "watchdog")
+
+
+def training_anomalies_total() -> Counter:
+    return get_registry().counter(
+        "training_anomalies_total",
+        "Health-watchdog verdicts by anomaly kind",
+        labelnames=("kind",))
+
+
+def grad_norm() -> Histogram:
+    return get_registry().histogram(
+        "grad_norm",
+        "Global (pre-clip-scale) gradient L2 norm per iteration, "
+        "observed when the health watchdog is on",
+        buckets=(0.0001, 0.001, 0.01, 0.1, 0.5, 1.0, 2.0, 5.0, 10.0,
+                 100.0, 1e3, 1e6, float("inf")))
+
+
 # ---- checkpointing ---------------------------------------------------------
 
 def checkpoint_commit_seconds() -> Histogram:
@@ -197,6 +222,7 @@ def serving_batch_occupancy() -> Gauge:
 _PREREGISTER = (
     optimizer_data_wait_seconds, optimizer_step_seconds,
     optimizer_validation_seconds, optimizer_retries_total,
+    training_nonfinite_total, training_anomalies_total, grad_norm,
     checkpoint_commit_seconds, checkpoint_torn_generations_total,
     chaos_faults_injected_total,
     prefetch_queue_depth, prefetch_producer_wait_total,

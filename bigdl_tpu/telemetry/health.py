@@ -1,0 +1,302 @@
+"""Training-health watchdog: interpret the signals, live.
+
+PR 3 gave the repo raw signals (step-phase histograms, spans, the
+completion-timestamp stream); this module is the layer above that
+*judges* them while the run is still cheap to save.  Four anomaly
+classes, each with a configurable policy:
+
+``nonfinite``
+    The loss or the global gradient norm came back NaN/Inf.  Detection
+    is **in-graph** (``jnp.isfinite`` reductions fused into the
+    existing train step; the norm reuses the grad-clip norm when
+    ``grad_clip_norm`` is set, so it is computed once) and surfaces on
+    the host with the per-step loss readback.  Policies: ``warn``,
+    ``skip_step`` (the update is discarded in-graph — params, optimizer
+    state, and buffers keep their pre-step values via a fused
+    ``jnp.where`` — and training continues), ``checkpoint_and_halt``.
+
+``loss_spike``
+    Finite loss far above its EWMA (mean + deviation tracking): the
+    divergence signature that precedes NaN by many steps.  Policies:
+    ``warn``, ``checkpoint_and_halt``.
+
+``step_time_outlier``
+    A completion-to-completion window whose per-iteration time is a
+    large multiple of its EWMA — a mid-run recompile, a contended chip,
+    a collective stall.  Policies: ``warn``, ``checkpoint_and_halt``.
+
+``data_starvation``
+    Data-wait fraction over a rolling window of flushed readback
+    windows above a threshold: the step is waiting on the input
+    pipeline.  Policies: ``warn``, ``checkpoint_and_halt``.
+
+``checkpoint_and_halt`` reuses the PR-2 preemption machinery — the
+optimizer writes a final checkpoint at the next step boundary (good by
+construction for ``nonfinite``: the poisoned update was discarded
+in-graph) and returns cleanly with ``watchdog_halted`` set, after
+dumping the flight recorder next to the checkpoint.
+
+Every verdict increments ``training_anomalies_total{kind}`` (plus
+``training_nonfinite_total`` for the nonfinite kinds), records a
+flight-recorder event, and lands in a bounded history that ``/statusz``
+serves.  The watchdog is **off by default**; a run without one pays
+nothing new (see ``Optimizer.set_health_watchdog``).
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+from bigdl_tpu.telemetry import events as _events
+
+__all__ = ["HealthWatchdog", "Verdict", "POLICIES", "ANOMALY_CLASSES"]
+
+logger = logging.getLogger("bigdl_tpu.health")
+
+POLICIES = ("warn", "skip_step", "checkpoint_and_halt")
+
+# policy classes -> the verdict kinds they govern
+ANOMALY_CLASSES = {
+    "nonfinite": ("nonfinite_loss", "nonfinite_grad"),
+    "loss_spike": ("loss_spike",),
+    "step_time_outlier": ("step_time_outlier",),
+    "data_starvation": ("data_starvation",),
+}
+
+
+class Verdict:
+    """One anomaly judgment: what was seen, at which step, and what the
+    configured policy did about it."""
+
+    __slots__ = ("kind", "action", "step", "value", "message", "t_wall")
+
+    def __init__(self, kind: str, action: str, step: int, value: float,
+                 message: str):
+        self.kind = kind
+        self.action = action
+        self.step = step
+        self.value = value
+        self.message = message
+        self.t_wall = time.time()
+
+    def to_dict(self) -> Dict:
+        # value may be the offending NaN/Inf itself: json_safe keeps
+        # /statusz (watchdog.recent_verdicts) strict JSON during the
+        # incident it reports
+        return {"kind": self.kind, "action": self.action,
+                "step": self.step, "value": _events.json_safe(self.value),
+                "message": self.message, "time": self.t_wall}
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return (f"Verdict({self.kind}, action={self.action}, "
+                f"step={self.step}, value={self.value!r})")
+
+
+class _Ewma:
+    """EWMA of a stream plus EWMA of its absolute deviation — the cheap
+    robust-ish baseline an outlier is judged against."""
+
+    __slots__ = ("alpha", "mean", "dev", "n")
+
+    def __init__(self, alpha: float):
+        self.alpha = alpha
+        self.mean: Optional[float] = None
+        self.dev = 0.0
+        self.n = 0
+
+    def update(self, v: float) -> None:
+        if self.mean is None:
+            self.mean = v
+        else:
+            self.dev += self.alpha * (abs(v - self.mean) - self.dev)
+            self.mean += self.alpha * (v - self.mean)
+        self.n += 1
+
+
+class HealthWatchdog:
+    """Host-side anomaly judge.  The optimizer calls ``observe_step``
+    with each iteration's (loss, grad-norm) readback and
+    ``observe_window`` with each flushed readback window's timing;
+    everything else (state for ``/statusz``, the halt flag the loop
+    polls) is derived.  Thread-safe: the loop writes, a ``/statusz``
+    scrape reads."""
+
+    def __init__(self,
+                 nonfinite: str = "checkpoint_and_halt",
+                 loss_spike: str = "warn",
+                 step_time_outlier: str = "warn",
+                 data_starvation: str = "warn",
+                 ewma_alpha: float = 0.1,
+                 spike_factor: float = 10.0,
+                 spike_grace_steps: int = 10,
+                 step_time_factor: float = 10.0,
+                 step_time_grace_windows: int = 5,
+                 starvation_fraction: float = 0.6,
+                 starvation_windows: int = 16,
+                 max_history: int = 64):
+        policies = {"nonfinite": nonfinite, "loss_spike": loss_spike,
+                    "step_time_outlier": step_time_outlier,
+                    "data_starvation": data_starvation}
+        for cls, pol in policies.items():
+            if pol not in POLICIES:
+                raise ValueError(
+                    f"unknown watchdog policy {pol!r} for {cls!r}; pick "
+                    f"from {POLICIES}")
+            if pol == "skip_step" and cls != "nonfinite":
+                # only a nonfinite update can be skipped: the in-graph
+                # guard decides before the update lands; host-side
+                # classes judge AFTER the update already applied
+                raise ValueError(
+                    f"policy 'skip_step' only applies to 'nonfinite' "
+                    f"(got it for {cls!r}); host-side anomalies are "
+                    f"judged after the update is already applied")
+        self.policies = policies
+        self.ewma_alpha = float(ewma_alpha)
+        self.spike_factor = float(spike_factor)
+        self.spike_grace_steps = int(spike_grace_steps)
+        self.step_time_factor = float(step_time_factor)
+        self.step_time_grace_windows = int(step_time_grace_windows)
+        self.starvation_fraction = float(starvation_fraction)
+        self.starvation_windows = int(starvation_windows)
+        self._lock = threading.Lock()
+        self.history: deque = deque(maxlen=int(max_history))
+        self.counts: Dict[str, int] = {}
+        self.halt_requested = False
+        self.steps_seen = 0
+        self._loss = _Ewma(self.ewma_alpha)
+        self._step_t = _Ewma(self.ewma_alpha)
+        self._data_win: deque = deque(maxlen=self.starvation_windows)
+
+    # ---- configuration-derived -------------------------------------------
+
+    @property
+    def guard_updates(self) -> bool:
+        """Should the train step discard nonfinite updates in-graph?
+        True for both ``skip_step`` (training continues on the last
+        good params) and ``checkpoint_and_halt`` (the final checkpoint
+        must hold pre-anomaly weights to be worth resuming from)."""
+        return self.policies["nonfinite"] != "warn"
+
+    # ---- run lifecycle ----------------------------------------------------
+
+    def start_run(self) -> None:
+        """Reset the per-attempt baselines (EWMA, rolling windows, halt
+        flag).  History and counts persist across retries — the anomaly
+        record is the run's, not the attempt's."""
+        with self._lock:
+            self.halt_requested = False
+            self._loss = _Ewma(self.ewma_alpha)
+            self._step_t = _Ewma(self.ewma_alpha)
+            self._data_win.clear()
+
+    # ---- observations -----------------------------------------------------
+
+    def observe_step(self, step: int, loss: float,
+                     grad_norm: Optional[float] = None) -> List[Verdict]:
+        """Judge one iteration's host-side loss (and, when the in-graph
+        monitor is wired, global grad norm) readback."""
+        verdicts: List[Verdict] = []
+        self.steps_seen += 1
+        if not math.isfinite(loss):
+            verdicts.append(self._verdict(
+                "nonfinite_loss", self.policies["nonfinite"], step, loss,
+                f"loss is {loss} at iteration {step}"))
+        if grad_norm is not None and not math.isfinite(grad_norm):
+            verdicts.append(self._verdict(
+                "nonfinite_grad", self.policies["nonfinite"], step,
+                grad_norm,
+                f"global gradient norm is {grad_norm} at iteration "
+                f"{step}"))
+        if math.isfinite(loss):
+            ew = self._loss
+            if ew.n >= self.spike_grace_steps and ew.mean is not None:
+                floor = max(ew.dev, 1e-3 * max(abs(ew.mean), 1e-6))
+                if loss - ew.mean > self.spike_factor * floor:
+                    verdicts.append(self._verdict(
+                        "loss_spike", self.policies["loss_spike"], step,
+                        loss,
+                        f"loss {loss:.6g} spiked above its EWMA "
+                        f"{ew.mean:.6g} (dev {ew.dev:.3g}) at iteration "
+                        f"{step}"))
+            # a spiking loss still feeds the EWMA (the baseline must
+            # follow a genuinely shifting loss, or one spike would
+            # condemn every later step); a nonfinite one must not
+            # (NaN poisons the mean permanently)
+            ew.update(loss)
+        return verdicts
+
+    def observe_window(self, window_s: float, data_wait_s: float,
+                       n_iterations: int,
+                       step: Optional[int] = None) -> List[Verdict]:
+        """Judge one flushed readback window from the completion-
+        timestamp stream: per-iteration step time vs its EWMA, and the
+        data-wait fraction over a rolling window of windows."""
+        verdicts: List[Verdict] = []
+        step = -1 if step is None else int(step)
+        per_iter = window_s / max(n_iterations, 1)
+        ew = self._step_t
+        if ew.n >= self.step_time_grace_windows and ew.mean is not None:
+            floor = max(ew.dev, 0.05 * max(ew.mean, 1e-6))
+            if per_iter - ew.mean > self.step_time_factor * floor:
+                verdicts.append(self._verdict(
+                    "step_time_outlier",
+                    self.policies["step_time_outlier"], step, per_iter,
+                    f"per-iteration time {per_iter:.4g}s is an outlier "
+                    f"vs EWMA {ew.mean:.4g}s (recompile? contended "
+                    f"chip? collective stall?)"))
+        ew.update(per_iter)
+        self._data_win.append((max(data_wait_s, 0.0), max(window_s, 0.0)))
+        if len(self._data_win) == self._data_win.maxlen:
+            tot = sum(w for _d, w in self._data_win)
+            waited = sum(d for d, _w in self._data_win)
+            if tot > 0 and waited / tot >= self.starvation_fraction:
+                verdicts.append(self._verdict(
+                    "data_starvation",
+                    self.policies["data_starvation"], step, waited / tot,
+                    f"input pipeline starvation: {waited / tot:.0%} of "
+                    f"the last {len(self._data_win)} windows' wall time "
+                    f"was spent waiting on data"))
+                self._data_win.clear()  # don't re-fire every step
+        return verdicts
+
+    # ---- verdicts ---------------------------------------------------------
+
+    def _verdict(self, kind: str, action: str, step: int, value: float,
+                 message: str) -> Verdict:
+        v = Verdict(kind, action, step, value, message)
+        with self._lock:
+            self.history.append(v)
+            self.counts[kind] = self.counts.get(kind, 0) + 1
+            if action == "checkpoint_and_halt":
+                self.halt_requested = True
+        logger.warning("watchdog: %s -> %s", message, action)
+        _events.record_event("watchdog", anomaly=kind, action=action,
+                             step=step, value=value, message=message)
+        from bigdl_tpu import telemetry
+        if telemetry.enabled():
+            from bigdl_tpu.telemetry import families
+            families.training_anomalies_total().labels(kind).inc()
+            if kind in ANOMALY_CLASSES["nonfinite"]:
+                families.training_nonfinite_total().inc()
+        return v
+
+    # ---- introspection ----------------------------------------------------
+
+    def state(self) -> Dict:
+        """The watchdog's judgment so far, JSON-able — what ``/statusz``
+        serves under ``watchdog``."""
+        with self._lock:
+            return {
+                "policies": dict(self.policies),
+                "halt_requested": self.halt_requested,
+                "steps_seen": self.steps_seen,
+                "anomaly_counts": dict(self.counts),
+                "loss_ewma": self._loss.mean,
+                "step_time_ewma": self._step_t.mean,
+                "recent_verdicts": [v.to_dict() for v in self.history],
+            }

@@ -77,6 +77,8 @@ class ChaosController:
         self.events.append(what)
         logger.warning("chaos: %s", what)
         try:
+            from bigdl_tpu.telemetry import events as _te
+            _te.record_event("chaos_fault", what=what)
             from bigdl_tpu import telemetry
             if telemetry.enabled():
                 from bigdl_tpu.telemetry import families

@@ -23,6 +23,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from bigdl_tpu import telemetry
+from bigdl_tpu.telemetry import events as _te
 from bigdl_tpu.telemetry import families as _tm, tracing as _tt
 from bigdl_tpu.utils import chaos
 
@@ -483,6 +484,9 @@ class CheckpointManager:
                 self._write_manifest(name, generation, crc, size, sharded)
                 if self.keep_n:
                     self.gc()
+        _te.record_event("checkpoint_commit", generation=int(generation),
+                         payload=name, sharded=bool(sharded),
+                         seconds=round(time.perf_counter() - t0, 6))
         if telemetry.enabled():
             _tm.checkpoint_commit_seconds().observe(
                 time.perf_counter() - t0)
@@ -570,6 +574,10 @@ class CheckpointManager:
                 "checkpoint generation %s (%s) failed validation "
                 "(truncated or uncommitted write?); falling back to the "
                 "previous generation", man.get("generation"), path)
+            _te.record_event("checkpoint_walkback",
+                             generation=man.get("generation"),
+                             payload=man.get("payload"),
+                             reason="failed validation")
             if telemetry.enabled():
                 _tm.checkpoint_torn_generations_total().inc()
         # Fallback sweep over EVERY payload, including ones whose
@@ -590,6 +598,10 @@ class CheckpointManager:
                 return path
             logger.warning("checkpoint %s is unreadable; falling back",
                            path)
+            _te.record_event(
+                "checkpoint_walkback",
+                payload=os.path.basename(path.rstrip("/")),
+                reason="unreadable payload")
             if telemetry.enabled():
                 _tm.checkpoint_torn_generations_total().inc()
         return None

@@ -26,11 +26,19 @@ def _cost_dict(compiled) -> dict:
 
 
 def _cost_value(compiled, key: str) -> Optional[float]:
+    """The analysis value for ``key``, or None when genuinely
+    unavailable.  Zero is a legitimate answer (a trivial compiled fn
+    really does execute 0 FLOPs) and is distinct from a missing key;
+    only absence, negatives (XLA's "don't know" sentinel), and
+    non-numeric entries report None."""
+    d = _cost_dict(compiled)
+    if key not in d:
+        return None
     try:
-        v = float(_cost_dict(compiled).get(key, -1.0))
+        v = float(d[key])
     except Exception:  # non-numeric entry: unavailable, not fatal
         return None
-    return v if v > 0 else None
+    return v if v >= 0 else None
 
 
 def compiled_flops(compiled) -> Optional[float]:

@@ -1,0 +1,108 @@
+#!/usr/bin/env bash
+# Health-watchdog smoke: a chaos-enabled mini-train with the watchdog
+# armed — one injected transient fault (retry path) plus one poisoned
+# NaN batch (halt path) — asserting that a live /statusz scrape
+# answers during the run and that the checkpoint_and_halt verdict
+# leaves a good checkpoint with a flight-recorder JSON dump beside it,
+# from which latest_good() resume completes cleanly.  See
+# docs/observability.md "Health & introspection".
+#
+# Standalone: exits non-zero on any failed assertion.
+# scripts/tier1.sh runs it warn-only after the suite.
+set -o pipefail
+cd "$(dirname "$0")/.."
+exec env JAX_PLATFORMS=cpu python - <<'PY'
+import http.client
+import json
+import os
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+from bigdl_tpu import nn, telemetry
+from bigdl_tpu.dataset import DataSet, SampleToMiniBatch
+from bigdl_tpu.dataset.dataset import Sample
+from bigdl_tpu.optim import Optimizer, Trigger
+from bigdl_tpu.utils import chaos
+from bigdl_tpu.utils.file import CheckpointManager, load_checkpoint
+
+telemetry.enable()
+telemetry.reset()
+
+rng = np.random.default_rng(0)
+samples = [Sample(rng.normal(size=(6,)).astype(np.float32),
+                  int(rng.integers(1, 5))) for _ in range(32)]
+# poison one sample: a NaN batch is the non-finite-loss injection
+samples[-1] = Sample(np.full((6,), np.nan, np.float32), 1)
+model = nn.Sequential(nn.Linear(6, 8), nn.ReLU(), nn.Linear(8, 4),
+                      nn.LogSoftMax())
+dataset = DataSet.array(samples).transform(SampleToMiniBatch(16))
+ckdir = tempfile.mkdtemp(prefix="health-smoke-")
+
+chaos.reset()
+chaos.install(fail_at_step=2)  # one transient fault -> one retry event
+opt = (Optimizer(model, dataset, nn.ClassNLLCriterion())
+       .set_end_when(Trigger.max_epoch(6))
+       .set_checkpoint(ckdir, Trigger.several_iteration(1))
+       .set_failure_retry(3, interval_s=300, backoff_s=0.01,
+                          backoff_cap_s=0.02)
+       .set_health_watchdog()          # nonfinite -> checkpoint_and_halt
+       .set_debug_server(0))
+
+done = []
+t = threading.Thread(target=lambda: done.append(opt.optimize()))
+t.start()
+statusz = None
+deadline = time.time() + 120
+while time.time() < deadline and t.is_alive():
+    srv = opt.debug_server
+    if srv is not None:
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                              timeout=10)
+            conn.request("GET", "/statusz")
+            statusz = json.loads(conn.getresponse().read())
+            conn.close()
+        except Exception:
+            pass
+    time.sleep(0.05)
+t.join(120)
+chaos.reset()
+
+assert not t.is_alive(), "training did not finish"
+assert statusz is not None, "/statusz never answered during the run"
+assert statusz["role"] == "trainer" and "iteration" in statusz, statusz
+assert opt.watchdog_halted, "watchdog did not halt on the NaN batch"
+
+fr_path = os.path.join(ckdir, "flight_recorder.json")
+assert os.path.isfile(fr_path), f"missing flight recorder {fr_path}"
+with open(fr_path) as f:
+    fr = json.load(f)
+kinds = [e["kind"] for e in fr["events"]]
+assert "chaos_fault" in kinds and "retry" in kinds, kinds
+assert "watchdog" in kinds and "watchdog_halt" in kinds, kinds
+verdicts = [e for e in fr["events"] if e["kind"] == "watchdog"]
+assert any(e["anomaly"].startswith("nonfinite") for e in verdicts)
+
+good = CheckpointManager(ckdir).latest_good()
+assert good, "no good checkpoint after halt"
+ms, _opt_state, _driver = load_checkpoint(good)
+import jax
+assert all(np.isfinite(np.asarray(leaf)).all()
+           for leaf in jax.tree_util.tree_leaves(ms["params"])), \
+    "halt checkpoint holds non-finite params"
+
+# resume from the halt checkpoint with clean data -> completes
+clean = DataSet.array(samples[:-1] + [samples[0]]).transform(
+    SampleToMiniBatch(16))
+resumed = (Optimizer(model, clean, nn.ClassNLLCriterion())
+           .set_end_when(Trigger.max_epoch(6))
+           .resume(good))
+resumed.optimize()
+assert not resumed.preempted
+
+print("health_smoke: OK (statusz scraped at iteration "
+      f"{statusz['iteration']}, halt + flight recorder + resume verified)")
+PY

@@ -11,12 +11,15 @@ argument — and span usages (``span`` / ``record_span``), then fails on:
   one declaration per name, in ``bigdl_tpu/telemetry/families.py``, so
   renames are single-file diffs and two subsystems can never silently
   claim the same family with different meanings;
-* any metric or span name missing from the catalog tables in
-  ``docs/observability.md`` — if it's worth recording it's worth
-  documenting, and dashboards are built from the table, not the code.
+* any metric name missing from the catalog tables in
+  ``docs/observability.md``, or any span name missing from its "Span
+  inventory" table — if it's worth recording it's worth documenting,
+  and dashboards are built from the table, not the code.
 
-Documented-but-unregistered names are reported as warnings only (docs
-may legitimately describe a family a gated backend registers lazily).
+The reverse direction is checked too, same rules for both kinds:
+documented-but-unregistered names (a span-inventory row nothing emits,
+a catalog metric nothing registers) are warnings only — docs may
+legitimately describe a family a gated backend registers lazily.
 
 Usage::
 
@@ -106,14 +109,41 @@ def documented_names(doc_path: str) -> Set[str]:
         return set(_DOC_NAME_RE.findall(f.read()))
 
 
+def span_inventory(doc_path: str) -> Set[str]:
+    """Span names from the doc's "## Span inventory" section — the
+    first backticked name of each table row.  Spans get the same
+    treatment as metric families: the INVENTORY table is the contract,
+    not a name incidentally backticked in prose somewhere."""
+    if not os.path.isfile(doc_path):
+        return set()
+    with open(doc_path, "r", encoding="utf-8") as f:
+        text = f.read()
+    out: Set[str] = set()
+    in_section = False
+    for line in text.splitlines():
+        if line.startswith("## "):
+            in_section = line.lower().startswith("## span inventory")
+            continue
+        if not in_section or not line.lstrip().startswith("|"):
+            continue
+        m = _DOC_NAME_RE.search(line)
+        if m and _SPAN_RE.match(m.group(1)):
+            out.add(m.group(1))
+    return out
+
+
 def lint() -> Tuple[List[str], List[str]]:
     """Returns (errors, warnings)."""
     errors: List[str] = []
     warnings: List[str] = []
     metrics, spans = collect(PACKAGE)
     docs = documented_names(DOC)
+    inventory = span_inventory(DOC)
     if not os.path.isfile(DOC):
         errors.append(f"missing catalog doc {os.path.relpath(DOC, REPO)}")
+    elif not inventory:
+        errors.append("docs/observability.md has no parseable 'Span "
+                      "inventory' table")
 
     by_name: Dict[str, List[Site]] = {}
     for s in metrics:
@@ -141,18 +171,24 @@ def lint() -> Tuple[List[str], List[str]]:
             errors.append(
                 f"{s.file}:{s.line}: span name {s.name!r} is not "
                 f"snake_case path segments")
-        if s.name not in docs and s.name not in seen_spans:
+        if s.name not in inventory and s.name not in seen_spans:
             errors.append(
                 f"{s.file}:{s.line}: span {s.name!r} missing from the "
-                f"docs/observability.md catalog")
+                f"docs/observability.md span inventory")
         seen_spans.add(s.name)
 
-    registered = set(by_name) | seen_spans
-    for name in sorted(docs - registered):
-        # only flag names that LOOK like catalog entries (metrics end in
-        # known unit/total suffixes or contain '/'; plain words in prose
-        # backticks are not the catalog's problem)
-        if "/" in name or re.search(
+    # reverse direction, same rules for both kinds: documented but
+    # nothing emits it -> warning
+    for name in sorted(inventory - seen_spans):
+        warnings.append(
+            f"docs/observability.md span inventory lists {name!r} but "
+            f"nothing records it")
+    for name in sorted(docs - set(by_name)):
+        # only flag names that LOOK like metric catalog entries (known
+        # unit/total suffixes; plain words in prose backticks are not
+        # the catalog's problem, and spans are checked above against
+        # the inventory table)
+        if "/" not in name and re.search(
                 r"_(total|seconds|bytes|ms|ratio|depth|max)$", name):
             warnings.append(
                 f"docs/observability.md documents {name!r} but nothing "

@@ -20,7 +20,18 @@ if [ -f "$base_file" ]; then
   base=$(tr -cd 0-9 < "$base_file")
   echo "DOTS_DELTA=$((dots - base)) (baseline $base)"
 fi
-# telemetry catalog lint: non-fatal here (ride-along visibility); the
-# standalone `python scripts/metrics_lint.py` form is fatal
+# telemetry catalog lint (metric families AND span inventory, both
+# directions): non-fatal here (ride-along visibility); the standalone
+# `python scripts/metrics_lint.py` form is fatal
 python "$(dirname "$0")/metrics_lint.py" --warn-only || true
+# health-watchdog smoke (chaos mini-train, /statusz, flight recorder):
+# warn-only ride-along; run scripts/health_smoke.sh standalone for the
+# fatal form.  mktemp, not a fixed /tmp name: parallel runs must not
+# clobber each other's log
+smoke_log=$(mktemp /tmp/health_smoke.XXXXXX.log)
+if bash "$(dirname "$0")/health_smoke.sh" >"$smoke_log" 2>&1; then
+  tail -n 1 "$smoke_log"
+else
+  echo "health_smoke: FAILED (non-fatal ride-along; see $smoke_log)"
+fi
 exit $rc

@@ -1,0 +1,642 @@
+"""Health watchdog, flight recorder, and debugz introspection
+endpoints — plus the satellite fixes that ride along (draining
+/healthz, profile_trace reentrancy, xla_cost zero-vs-missing).
+
+The tentpole acceptance scenario lives in
+``TestWatchdogEndToEnd.test_nonfinite_halt_writes_good_checkpoint...``:
+a poisoned NaN batch under ``checkpoint_and_halt`` stops the run
+within one step of detection, leaves a good checkpoint plus a
+flight-recorder dump whose tail carries the verdict, and
+``latest_good()`` resume completes cleanly.
+"""
+
+import http.client
+import io
+import json
+import math
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from bigdl_tpu import nn, telemetry
+from bigdl_tpu.telemetry import events, families, tracing
+from bigdl_tpu.telemetry.debugz import (
+    Debugz, DebugzServer, ProfileBusyError,
+)
+from bigdl_tpu.telemetry.health import HealthWatchdog
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_on():
+    """Each test starts enabled with zeroed metrics/spans/events and
+    leaves the process disabled (the repo-wide default)."""
+    telemetry.enable()
+    telemetry.reset()
+    yield
+    telemetry.reset()
+    telemetry.disable()
+
+
+def _samples(n=32, dim=6, classes=4, seed=0):
+    from bigdl_tpu.dataset.dataset import Sample
+    rng = np.random.default_rng(seed)
+    return [Sample(rng.normal(size=(dim,)).astype(np.float32),
+                   int(rng.integers(1, classes + 1))) for _ in range(n)]
+
+
+def _poison(samples, i=-1, dim=6):
+    from bigdl_tpu.dataset.dataset import Sample
+    out = list(samples)
+    out[i] = Sample(np.full((dim,), np.nan, np.float32), 1)
+    return out
+
+
+def _model(dim=6, classes=4):
+    return nn.Sequential(nn.Linear(dim, 8), nn.ReLU(),
+                         nn.Linear(8, classes), nn.LogSoftMax())
+
+
+def _dataset(samples, batch=16):
+    from bigdl_tpu.dataset import DataSet, SampleToMiniBatch
+    return DataSet.array(samples).transform(SampleToMiniBatch(batch))
+
+
+def _params_finite(tree) -> bool:
+    return all(np.isfinite(np.asarray(l)).all()
+               for l in jax.tree_util.tree_leaves(tree))
+
+
+# --------------------------------------------------------------------------
+# flight recorder
+# --------------------------------------------------------------------------
+
+class TestFlightRecorder:
+    def test_always_on_and_ordered(self):
+        # recording does NOT require telemetry.enabled(): the black box
+        # must exist for the run where nobody enabled anything
+        telemetry.disable()
+        events.record_event("retry", error="boom", retries_left=2)
+        events.record_event("checkpoint_commit", generation=3)
+        recent = events.recent_events()
+        assert [e["kind"] for e in recent] == ["retry",
+                                               "checkpoint_commit"]
+        assert recent[0]["error"] == "boom"
+        assert recent[0]["time"] <= recent[1]["time"]
+        assert events.event_counts() == {"retry": 1,
+                                         "checkpoint_commit": 1}
+
+    def test_ring_bounded_with_drop_counter_keeps_newest(self):
+        events.set_event_capacity(8)
+        try:
+            for i in range(20):
+                events.record_event("tick", i=i)
+            recent = events.recent_events()
+            assert len(recent) == 8
+            assert [e["i"] for e in recent] == list(range(12, 20))
+            assert events.dropped_events() == 12
+        finally:
+            events.reset_events()
+            events.set_event_capacity(2048)
+
+    def test_zero_n_means_none_not_all(self):
+        for i in range(5):
+            events.record_event("tick", i=i)
+        assert events.recent_events(0) == []
+        assert len(events.recent_events(2)) == 2
+        assert len(events.recent_events(99)) == 5
+
+    def test_nonfinite_fields_stay_strict_json(self):
+        # a NaN value recorded during the incident must not poison the
+        # dump/statusz with a bare NaN token (jq/JSON.parse reject it)
+        events.record_event("watchdog", value=float("nan"),
+                            limit=float("inf"))
+        data = json.loads(events.dumps_events())  # round-trips
+        json.dumps(data, allow_nan=False)         # and is STRICT json
+        assert data["events"][-1]["value"] == "nan"
+        assert data["events"][-1]["limit"] == "inf"
+
+    def test_dump_survives_unserializable_fields(self, tmp_path):
+        events.record_event("crash", error=RuntimeError("kaput"))
+        path = events.dump_events(str(tmp_path / "fr.json"))
+        data = json.loads(open(path).read())
+        assert data["dropped"] == 0
+        assert data["events"][-1]["kind"] == "crash"
+        assert "kaput" in data["events"][-1]["error"]
+        assert data["counts"] == {"crash": 1}
+
+
+# --------------------------------------------------------------------------
+# watchdog unit behavior
+# --------------------------------------------------------------------------
+
+class TestWatchdogUnit:
+    def test_policy_validation(self):
+        with pytest.raises(ValueError, match="unknown watchdog policy"):
+            HealthWatchdog(nonfinite="explode")
+        with pytest.raises(ValueError, match="skip_step"):
+            HealthWatchdog(loss_spike="skip_step")
+        assert HealthWatchdog(nonfinite="skip_step").guard_updates
+        assert not HealthWatchdog(nonfinite="warn").guard_updates
+
+    def test_set_health_watchdog_rejects_instance_plus_kwargs(self):
+        from bigdl_tpu.optim import Optimizer
+        opt = Optimizer(_model(), _dataset(_samples()),
+                        nn.ClassNLLCriterion())
+        with pytest.raises(ValueError, match="not both"):
+            opt.set_health_watchdog(HealthWatchdog(),
+                                    nonfinite="skip_step")
+        # either alone is fine
+        opt.set_health_watchdog(HealthWatchdog(nonfinite="warn"))
+        assert opt.watchdog.policies["nonfinite"] == "warn"
+        opt.set_health_watchdog(nonfinite="skip_step")
+        assert opt.watchdog.policies["nonfinite"] == "skip_step"
+
+    def test_nonfinite_verdicts_counters_and_halt(self):
+        wd = HealthWatchdog(nonfinite="checkpoint_and_halt")
+        vs = wd.observe_step(7, float("nan"), float("inf"))
+        assert [v.kind for v in vs] == ["nonfinite_loss",
+                                       "nonfinite_grad"]
+        assert all(v.action == "checkpoint_and_halt" for v in vs)
+        assert wd.halt_requested
+        assert families.training_nonfinite_total().value() == 2
+        assert families.training_anomalies_total().labels(
+            "nonfinite_loss").value() == 1
+        kinds = [e["anomaly"] for e in events.recent_events()
+                 if e["kind"] == "watchdog"]
+        assert kinds == ["nonfinite_loss", "nonfinite_grad"]
+        # verdict history + events serialize to STRICT json even
+        # though the offending values are NaN/Inf
+        json.dumps(wd.state(), allow_nan=False)
+        json.dumps(events.recent_events(), allow_nan=False)
+        assert wd.state()["recent_verdicts"][0]["value"] == "nan"
+
+    def test_warn_policy_does_not_halt(self):
+        wd = HealthWatchdog(nonfinite="warn")
+        vs = wd.observe_step(1, float("nan"))
+        assert vs and vs[0].action == "warn"
+        assert not wd.halt_requested
+
+    def test_loss_spike_ewma(self):
+        wd = HealthWatchdog(loss_spike="checkpoint_and_halt",
+                            spike_factor=10.0, spike_grace_steps=10)
+        rng = np.random.default_rng(0)
+        for i in range(30):
+            assert wd.observe_step(i, 1.0 + 0.01 * rng.normal()) == []
+        vs = wd.observe_step(30, 100.0)
+        assert [v.kind for v in vs] == ["loss_spike"]
+        assert wd.halt_requested
+        # nan must not poison the EWMA baseline
+        wd2 = HealthWatchdog(nonfinite="warn")
+        wd2.observe_step(0, 1.0)
+        wd2.observe_step(1, float("nan"))
+        assert math.isfinite(wd2.state()["loss_ewma"])
+
+    def test_step_time_outlier(self):
+        wd = HealthWatchdog(step_time_outlier="checkpoint_and_halt",
+                            step_time_factor=10.0,
+                            step_time_grace_windows=5)
+        for _ in range(10):
+            assert wd.observe_window(0.01, 0.0, 1) == []
+        vs = wd.observe_window(5.0, 0.0, 1)
+        assert [v.kind for v in vs] == ["step_time_outlier"]
+        assert wd.halt_requested
+
+    def test_data_starvation_rolling_window(self):
+        wd = HealthWatchdog(starvation_fraction=0.5,
+                            starvation_windows=4)
+        verdicts = []
+        for _ in range(4):
+            verdicts += wd.observe_window(1.0, 0.9, 1)
+        assert [v.kind for v in verdicts] == ["data_starvation"]
+        assert verdicts[0].action == "warn"
+        # the window cleared after the verdict: no immediate re-fire
+        assert wd.observe_window(1.0, 0.9, 1) == []
+
+    def test_state_is_jsonable_and_bounded(self):
+        wd = HealthWatchdog(nonfinite="warn", max_history=3)
+        for i in range(10):
+            wd.observe_step(i, float("nan"))
+        st = json.loads(json.dumps(wd.state()))
+        assert len(st["recent_verdicts"]) == 3
+        assert st["anomaly_counts"]["nonfinite_loss"] == 10
+        assert st["recent_verdicts"][-1]["step"] == 9
+
+
+# --------------------------------------------------------------------------
+# watchdog end-to-end through the optimizer
+# --------------------------------------------------------------------------
+
+class TestWatchdogEndToEnd:
+    def test_nonfinite_halt_writes_good_checkpoint_dump_and_resumes(
+            self, tmp_path):
+        """The acceptance scenario: poisoned NaN batch under
+        checkpoint_and_halt -> stop within one step of detection, good
+        final checkpoint, flight-recorder dump whose tail holds the
+        verdict, latest_good() resume completes cleanly."""
+        from bigdl_tpu.optim import Optimizer, Trigger
+        from bigdl_tpu.utils.file import CheckpointManager, load_checkpoint
+        ck = str(tmp_path / "ck")
+        samples = _poison(_samples())
+        model = _model()
+        opt = (Optimizer(model, _dataset(samples), nn.ClassNLLCriterion())
+               .set_end_when(Trigger.max_epoch(6))
+               .set_checkpoint(ck, Trigger.several_iteration(1))
+               .set_health_watchdog())  # nonfinite -> checkpoint_and_halt
+        opt.optimize()
+        assert opt.watchdog_halted and not opt.preempted
+        # stopped within one step of the verdict
+        verdicts = [v for v in opt.watchdog.history
+                    if v.kind.startswith("nonfinite")]
+        assert verdicts
+        assert opt.state["neval"] <= verdicts[0].step + 1
+        assert families.training_nonfinite_total().value() >= 1
+        # the final checkpoint is GOOD: the in-graph guard discarded
+        # the poisoned update before it reached the params
+        good = CheckpointManager(ck).latest_good()
+        assert good is not None
+        ms, _opt_state, driver = load_checkpoint(good)
+        assert _params_finite(ms["params"])
+        # flight recorder dumped next to the checkpoint, verdict in tail
+        fr = json.loads(open(os.path.join(ck, "flight_recorder.json"))
+                        .read())
+        tail_kinds = [e["kind"] for e in fr["events"]][-6:]
+        assert "watchdog_halt" in tail_kinds
+        assert any(e["kind"] == "watchdog"
+                   and e["anomaly"].startswith("nonfinite")
+                   for e in fr["events"])
+        # resume from the halt checkpoint (clean data) completes
+        clean = _dataset(_samples(seed=1))
+        resumed = (Optimizer(model, clean, nn.ClassNLLCriterion())
+                   .set_end_when(Trigger.max_epoch(6))
+                   .resume(good))
+        resumed.optimize()
+        assert not resumed.preempted and not resumed.watchdog_halted
+        assert _params_finite(model.parameters())
+
+    def test_skip_step_discards_update_and_training_continues(
+            self, tmp_path):
+        from bigdl_tpu.optim import Optimizer, Trigger
+        samples = _poison(_samples())
+        model = _model()
+        opt = (Optimizer(model, _dataset(samples), nn.ClassNLLCriterion())
+               .set_end_when(Trigger.max_epoch(3))
+               .set_gradient_clipping_by_l2_norm(5.0)  # norm reuse path
+               .set_health_watchdog(nonfinite="skip_step"))
+        opt.optimize()
+        assert not opt.watchdog_halted
+        # every epoch hit the poisoned batch; all updates were
+        # discarded in-graph, so params never went NaN
+        assert _params_finite(model.parameters())
+        assert opt.watchdog.counts.get("nonfinite_loss", 0) >= 3
+        assert opt.state["epoch"] == 4  # ran to completion
+
+    def test_watchdog_off_pays_zero_extra_transfers(self, monkeypatch,
+                                                    tmp_path):
+        """The acceptance overhead clause: with the watchdog off the
+        loop performs zero additional per-step host transfers — the
+        single site that does them is never called, and the grad-norm
+        family records nothing."""
+        from bigdl_tpu.optim import Optimizer, Trigger
+        from bigdl_tpu.optim.optimizer import Optimizer as OptClass
+        calls = []
+        orig = OptClass._watchdog_step_check
+
+        def spy(self, *a, **k):
+            calls.append(1)
+            return orig(self, *a, **k)
+
+        monkeypatch.setattr(OptClass, "_watchdog_step_check", spy)
+        opt = (Optimizer(_model(), _dataset(_samples()),
+                         nn.ClassNLLCriterion())
+               .set_end_when(Trigger.max_epoch(2)))
+        opt.optimize()
+        assert calls == []
+        assert families.grad_norm().snapshot()["count"] == 0
+        # zero verdicts (label children from other tests survive
+        # reset() by design — zeroed in place, handles stay valid)
+        assert all(v == 0 for _k, v in
+                   families.training_anomalies_total().samples())
+
+    def test_crash_out_of_retries_dumps_flight_recorder(self, tmp_path):
+        from bigdl_tpu.optim import Optimizer, Trigger
+        from bigdl_tpu.utils import chaos
+        from bigdl_tpu.utils.chaos import FaultInjected
+        ck = str(tmp_path / "ck")
+        chaos.reset()
+        chaos.install(fail_at_step=2)
+        try:
+            opt = (Optimizer(_model(), _dataset(_samples()),
+                             nn.ClassNLLCriterion())
+                   .set_end_when(Trigger.max_epoch(2))
+                   .set_checkpoint(ck, Trigger.every_epoch())
+                   .set_failure_retry(0))
+            with pytest.raises(FaultInjected):
+                opt.optimize()
+        finally:
+            chaos.reset()
+        fr = json.loads(open(os.path.join(ck, "flight_recorder.json"))
+                        .read())
+        kinds = [e["kind"] for e in fr["events"]]
+        assert "chaos_fault" in kinds
+        dump = [e for e in fr["events"]
+                if e["kind"] == "flight_recorder_dump"]
+        assert dump and dump[-1]["reason"] == "crash"
+        assert "FaultInjected" in dump[-1]["error"]
+
+
+# --------------------------------------------------------------------------
+# live /statusz on a running trainer (sidecar)
+# --------------------------------------------------------------------------
+
+class _SlowBatches:
+    """Dataset transform pacing the loop so a scrape lands mid-run."""
+
+    def __call__(self, it):
+        for b in it:
+            time.sleep(0.02)
+            yield b
+
+
+def test_statusz_live_scrape_during_optimize(tmp_path):
+    from bigdl_tpu.optim import Optimizer, Trigger
+    samples = _poison(_samples())  # warn-policy NaNs -> anomaly history
+    ds = _dataset(samples).transform(_SlowBatches())
+    opt = (Optimizer(_model(), ds, nn.ClassNLLCriterion())
+           .set_end_when(Trigger.max_epoch(60))
+           .set_checkpoint(str(tmp_path / "ck"), Trigger.every_epoch())
+           .set_health_watchdog(nonfinite="warn")
+           .set_debug_server(0))
+    done = []
+    t = threading.Thread(target=lambda: done.append(opt.optimize()))
+    t.start()
+    scraped = None
+    try:
+        deadline = time.time() + 120
+        while time.time() < deadline and t.is_alive():
+            srv = opt.debug_server
+            if srv is not None:
+                try:
+                    conn = http.client.HTTPConnection(
+                        "127.0.0.1", srv.port, timeout=10)
+                    conn.request("GET", "/statusz")
+                    j = json.loads(conn.getresponse().read())
+                    conn.close()
+                    if (j["checkpoint"]["last_generation"] is not None
+                            and j["watchdog"]["recent_verdicts"]):
+                        scraped = j
+                        break
+                except (OSError, http.client.HTTPException):
+                    pass
+            time.sleep(0.05)
+    finally:
+        t.join(180)
+    assert not t.is_alive()
+    assert scraped is not None, "statusz never showed ckpt + verdicts"
+    # current step, last checkpoint generation, anomaly history — the
+    # acceptance triple — all in one live scrape
+    assert scraped["role"] == "trainer"
+    assert scraped["iteration"] >= 1
+    assert scraped["checkpoint"]["last_generation"] >= 1
+    assert scraped["watchdog"]["recent_verdicts"][0]["kind"] \
+        == "nonfinite_loss"
+    assert scraped["watchdog"]["policies"]["nonfinite"] == "warn"
+    # the page is strict JSON even with a NaN loss (stringified)
+    assert not isinstance(scraped["loss"], float) \
+        or math.isfinite(scraped["loss"])
+    # sidecar is torn down with the run
+    assert opt.debug_server is None
+
+
+# --------------------------------------------------------------------------
+# debugz endpoints over HTTP (serve.py server + unit logic)
+# --------------------------------------------------------------------------
+
+def _http(port, method, path, body=None, timeout=60):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    conn.request(method, path, body)
+    resp = conn.getresponse()
+    data = resp.read()
+    conn.close()
+    return resp.status, data
+
+
+@pytest.fixture()
+def serve_httpd():
+    from bigdl_tpu.examples.serve import make_server
+    from bigdl_tpu.optim.predictor import PredictionService
+    model = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    service = PredictionService(model, concurrency=2)
+    server = make_server(service, "127.0.0.1", 0,
+                         statusz_fn=lambda: {"role": "server",
+                                             "model": "m.bigdl"})
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    yield server
+    server.shutdown()
+    server.server_close()
+
+
+class TestDebugzHttp:
+    def test_healthz_reports_draining_non_200(self, serve_httpd):
+        port = serve_httpd.server_port
+        status, body = _http(port, "GET", "/healthz")
+        assert status == 200 and json.loads(body) == {"status": "ok"}
+        serve_httpd.health_state["draining"] = True
+        status, body = _http(port, "GET", "/healthz")
+        assert status == 503
+        assert json.loads(body) == {"status": "draining"}
+        # and back: the flag, not a latch, drives the answer
+        serve_httpd.health_state["draining"] = False
+        status, _ = _http(port, "GET", "/healthz")
+        assert status == 200
+
+    def test_statusz_and_tracez_on_serve_server(self, serve_httpd):
+        port = serve_httpd.server_port
+        with tracing.span("serving/enqueue"):
+            pass
+        status, body = _http(port, "GET", "/statusz")
+        j = json.loads(body)
+        assert status == 200
+        assert j["role"] == "server" and j["model"] == "m.bigdl"
+        assert j["telemetry_enabled"] is True
+        assert "events" in j and "uptime_s" in j
+        status, body = _http(port, "GET", "/tracez?limit=5")
+        j = json.loads(body)
+        assert status == 200 and j["limit"] == 5
+        assert any(s["name"] == "serving/enqueue" for s in j["spans"])
+        status, _ = _http(port, "GET", "/tracez?limit=bogus")
+        assert status == 400
+        # limit=0 means "counters only", not "the whole ring"
+        status, body = _http(port, "GET", "/tracez?limit=0")
+        j = json.loads(body)
+        assert status == 200 and j["spans"] == [] and j["buffered"] >= 1
+
+    def test_profilez_returns_nonempty_logdir(self, serve_httpd,
+                                              tmp_path):
+        port = serve_httpd.server_port
+        body = json.dumps({"duration_s": 0.05,
+                           "logdir": str(tmp_path / "prof")}).encode()
+        status, data = _http(port, "POST", "/profilez", body)
+        assert status == 200, data
+        j = json.loads(data)
+        assert j["logdir"] == str(tmp_path / "prof")
+        assert j["files"] >= 1
+        n_files = sum(len(fs) for _r, _d, fs in os.walk(j["logdir"]))
+        assert n_files >= 1
+        # a second capture works (start/stop correctly paired)
+        status, data = _http(port, "POST", "/profilez",
+                             json.dumps({"duration_s": 0.05}).encode())
+        assert status == 200, data
+
+    def test_profilez_rejects_bad_body(self, serve_httpd):
+        port = serve_httpd.server_port
+        status, data = _http(port, "POST", "/profilez", b"not json")
+        assert status == 400 and b"error" in data
+        status, data = _http(port, "POST", "/profilez", b"[1, 2]")
+        assert status == 400
+
+    def test_profilez_concurrent_capture_busy(self):
+        dz = Debugz()
+        started = threading.Event()
+        result = {}
+
+        def long_capture():
+            started.set()
+            result["r"] = dz.profilez(duration_s=1.0)
+
+        t = threading.Thread(target=long_capture)
+        t.start()
+        started.wait(5)
+        time.sleep(0.2)  # let the lock be taken
+        with pytest.raises(ProfileBusyError):
+            dz.profilez(duration_s=0.05)
+        t.join(30)
+        assert result["r"]["files"] >= 1
+
+    def test_sidecar_server_serves_metrics_and_statusz(self):
+        srv = DebugzServer(Debugz(
+            statusz_fn=lambda: {"role": "trainer", "iteration": 7}))
+        srv.start()
+        try:
+            status, body = _http(srv.port, "GET", "/statusz")
+            j = json.loads(body)
+            assert status == 200 and j["iteration"] == 7
+            status, body = _http(srv.port, "GET", "/metrics")
+            assert status == 200
+            assert b"# TYPE training_nonfinite_total counter" in body
+            status, _ = _http(srv.port, "GET", "/healthz")
+            assert status == 200
+            status, _ = _http(srv.port, "GET", "/nope")
+            assert status == 404
+        finally:
+            srv.stop()
+
+    def test_broken_statusz_provider_degrades_not_500(self):
+        def boom():
+            raise RuntimeError("provider died")
+        dz = Debugz(statusz_fn=boom)
+        page = dz.statusz()
+        assert "provider died" in page["statusz_error"]
+
+
+# --------------------------------------------------------------------------
+# satellites: profile_trace reentrancy, xla_cost zero-vs-missing
+# --------------------------------------------------------------------------
+
+class TestProfileTraceReentrancy:
+    def test_recovers_from_orphaned_trace(self, tmp_path):
+        from bigdl_tpu.optim.profiling import profile_trace
+        # simulate a crashed capture that never stopped
+        jax.profiler.start_trace(str(tmp_path / "orphan"))
+        with profile_trace(str(tmp_path / "a")):
+            pass  # must not raise "already started"
+        # profiler is free again: a plain start/stop pair works
+        jax.profiler.start_trace(str(tmp_path / "b"))
+        jax.profiler.stop_trace()
+
+    def test_always_pairs_stop_on_body_exception(self, tmp_path):
+        from bigdl_tpu.optim.profiling import profile_trace
+        with pytest.raises(RuntimeError, match="body blew up"):
+            with profile_trace(str(tmp_path / "c")):
+                raise RuntimeError("body blew up")
+        # the trace was stopped despite the exception
+        with profile_trace(str(tmp_path / "d")):
+            pass
+
+    def test_repeated_captures(self, tmp_path):
+        from bigdl_tpu.optim.profiling import profile_trace
+        for i in range(3):
+            with profile_trace(str(tmp_path / f"r{i}")):
+                jax.block_until_ready(jax.numpy.zeros((1,)))
+
+
+class _FakeCompiled:
+    def __init__(self, cost):
+        self._cost = cost
+
+    def cost_analysis(self):
+        return self._cost
+
+
+class TestXlaCostZeroVsMissing:
+    def test_zero_is_reported_not_none(self):
+        from bigdl_tpu.utils.xla_cost import compiled_bytes, compiled_flops
+        c = _FakeCompiled({"flops": 0.0, "bytes accessed": 0})
+        assert compiled_flops(c) == 0.0
+        assert compiled_bytes(c) == 0.0
+
+    def test_missing_key_is_none(self):
+        from bigdl_tpu.utils.xla_cost import compiled_bytes, compiled_flops
+        c = _FakeCompiled({"something else": 5.0})
+        assert compiled_flops(c) is None
+        assert compiled_bytes(c) is None
+
+    def test_negative_sentinel_and_junk_are_none(self):
+        from bigdl_tpu.utils.xla_cost import compiled_flops
+        assert compiled_flops(_FakeCompiled({"flops": -1.0})) is None
+        assert compiled_flops(_FakeCompiled({"flops": "n/a"})) is None
+
+    def test_list_wrapped_and_raising_analysis(self):
+        from bigdl_tpu.utils.xla_cost import compiled_flops
+        assert compiled_flops(_FakeCompiled([{"flops": 3.0}])) == 3.0
+
+        class Raising:
+            def cost_analysis(self):
+                raise RuntimeError("unavailable on this backend")
+        assert compiled_flops(Raising()) is None
+        assert compiled_flops(_FakeCompiled([])) is None
+
+
+# --------------------------------------------------------------------------
+# serving-layer events + snapshot embedding
+# --------------------------------------------------------------------------
+
+def test_admission_shed_lands_in_flight_recorder():
+    from bigdl_tpu.serving.admission import (
+        BoundedRequestQueue, Request, RequestSheddedError,
+    )
+    q = BoundedRequestQueue(1, policy="shed_oldest")
+    first = Request(np.zeros(2, np.float32))
+    q.put(first)
+    q.put(Request(np.ones(2, np.float32)))  # sheds `first`
+    with pytest.raises(RequestSheddedError):
+        first.future.result(1)
+    shed = [e for e in events.recent_events()
+            if e["kind"] == "admission_shed"]
+    assert shed and shed[0]["capacity"] == 1
+
+
+def test_json_snapshot_embeds_event_summary():
+    from bigdl_tpu.telemetry.export import json_snapshot
+    events.record_event("retry", error="x")
+    events.record_event("retry", error="y")
+    events.record_event("preemption", epoch=1)
+    snap = json.loads(json.dumps(json_snapshot(), default=str))
+    assert snap["events"]["by_kind"] == {"retry": 2, "preemption": 1}
+    assert snap["events"]["buffered"] == 3
+    assert snap["events"]["recent"][-1]["kind"] == "preemption"

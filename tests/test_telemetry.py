@@ -331,6 +331,35 @@ class TestExposition:
         time.sleep(0.15)
         assert exp.exports == n
 
+    def test_periodic_exporter_survives_raising_callback(self):
+        """A callback raising mid-cycle must not kill the daemon: the
+        failure is counted, later cycles still export, and stop() still
+        runs its clean final export."""
+        calls = []
+        stop_seen = threading.Event()
+
+        def fn(snap):
+            calls.append(snap)
+            if len(calls) == 1:
+                raise RuntimeError("exporter backend down")
+            stop_seen.set()
+
+        exp = PeriodicExporter(interval_s=0.03, fn=fn)
+        exp.start()
+        assert stop_seen.wait(5.0), "daemon died after the first error"
+        n_before_stop = len(calls)
+        exp.stop(timeout=5.0)
+        assert exp.errors == 1
+        assert exp.exports >= 1
+        # the clean final export on stop() ran (one more callback at
+        # minimum beyond what the interval loop had already done)
+        assert len(calls) >= n_before_stop + 1
+        assert exp.exports + exp.errors == len(calls)
+        # fully stopped: no further callbacks
+        n = len(calls)
+        time.sleep(0.1)
+        assert len(calls) == n
+
     def test_telemetry_summary_tensorboard_roundtrip(self, tmp_path):
         from bigdl_tpu.visualization import TelemetrySummary
         families.optimizer_retries_total().inc(3)
