@@ -1,0 +1,27 @@
+"""``bigdl_tpu.data`` — the deterministic, checkpointable input-pipeline
+service (docs/data_pipeline.md).
+
+Three pieces on top of the dataset layer's ``(seed, epoch)`` determinism
+contract:
+
+* :class:`~bigdl_tpu.data.pipeline.PipelineState` — snapshot/restore of
+  iterator position, persisted by the CheckpointManager alongside the
+  model payload, so a crashed or preempted run resumes at the exact
+  next batch (sample-accurate resume);
+* :class:`~bigdl_tpu.data.mixing.MixedDataSet` — weighted multi-corpus
+  interleaving with a checkpointable sampler;
+* :class:`~bigdl_tpu.data.device_prefetch.DevicePrefetch` — async
+  double-buffered ``jax.device_put`` so step N runs while batch N+1
+  stages.
+"""
+
+from bigdl_tpu.data.pipeline import (
+    PIPELINE_STATE_VERSION, PipelineState, dataset_seed, epoch_iter,
+    skip_batches, supports_epoch,
+)
+from bigdl_tpu.data.mixing import MixedDataSet
+from bigdl_tpu.data.device_prefetch import DevicePrefetch
+
+__all__ = ["PIPELINE_STATE_VERSION", "PipelineState", "MixedDataSet",
+           "DevicePrefetch", "dataset_seed", "epoch_iter",
+           "skip_batches", "supports_epoch"]

@@ -1,6 +1,6 @@
 from bigdl_tpu.dataset.dataset import (
     DataSet, LocalDataSet, DistributedDataSet, DeviceCachedDataSet,
-    MiniBatch, Sample,
+    MiniBatch, Sample, epoch_permutation,
 )
 from bigdl_tpu.dataset.transformer import (
     Transformer, SampleToMiniBatch, Identity as IdentityTransformer,

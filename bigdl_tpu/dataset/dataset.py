@@ -11,6 +11,21 @@ the per-step global batch; the Optimizer shards it over the mesh's data
 axis with jax.device_put so each chip reads only its slice.  Shuffling is
 a host-side permutation re-drawn each epoch (≙ CachedDistriDataSet
 shuffle, DataSet.scala:260).
+
+Determinism contract (docs/data_pipeline.md): the epoch-E iteration
+order is a pure function of ``(seed, E)`` — :func:`epoch_permutation`
+over the *global* index space — with NO mutable RNG state on the
+dataset object.  Consequences the checkpointable-pipeline service
+(``bigdl_tpu.data``) builds on:
+
+* two runs with the same seed consume identical sample sequences, so a
+  resumed run can skip exactly the batches the crashed run consumed;
+* ``DistributedDataSet`` hosts slice the SAME global permutation, so
+  per-host shards are consistent and non-overlapping every epoch and
+  actually remix across epochs (the old scheme froze each host's
+  round-robin shard at construction and only shuffled within it);
+* ``transform()`` copies share no RNG stream — sibling iteration order
+  cannot depend on how many draws the other copy made.
 """
 
 from __future__ import annotations
@@ -21,7 +36,19 @@ from typing import Callable, Iterator, List, Optional, Sequence, Tuple
 import numpy as np
 
 __all__ = ["Sample", "MiniBatch", "DataSet", "LocalDataSet",
-           "DistributedDataSet", "DeviceCachedDataSet"]
+           "DistributedDataSet", "DeviceCachedDataSet",
+           "epoch_permutation"]
+
+
+def epoch_permutation(n: int, seed: int, epoch: int) -> np.ndarray:
+    """THE canonical epoch-keyed order: a permutation of ``range(n)``
+    that is a pure function of ``(seed, epoch)``.  Every shuffling
+    dataset derives its epoch-E order from this one function, so
+    deterministic replay (and therefore sample-accurate resume, see
+    bigdl_tpu/data/pipeline.py) holds across processes and across
+    crash/restart — there is no RNG state to lose."""
+    ss = np.random.SeedSequence([int(seed) % (2 ** 63), int(epoch)])
+    return np.random.default_rng(ss).permutation(int(n))
 
 
 class Sample:
@@ -73,17 +100,20 @@ class DataSet:
     """Factory namespace (reference DataSet object, DataSet.scala:326)."""
 
     @staticmethod
-    def array(data: Sequence, shuffle: bool = True) -> "LocalDataSet":
-        return LocalDataSet(list(data), shuffle=shuffle)
+    def array(data: Sequence, shuffle: bool = True,
+              seed: Optional[int] = None) -> "LocalDataSet":
+        return LocalDataSet(list(data), shuffle=shuffle, seed=seed)
 
     @staticmethod
     def sharded(data: Sequence, shuffle: bool = True,
                 process_index: Optional[int] = None,
-                process_count: Optional[int] = None) -> "DistributedDataSet":
+                process_count: Optional[int] = None,
+                seed: Optional[int] = None) -> "DistributedDataSet":
         """Per-host shard of a global dataset (≙ DataSet.rdd)."""
         return DistributedDataSet(list(data), shuffle=shuffle,
                                   process_index=process_index,
-                                  process_count=process_count)
+                                  process_count=process_count,
+                                  seed=seed)
 
     @staticmethod
     def image_folder(path: str, shuffle: bool = True) -> "LocalDataSet":
@@ -95,20 +125,43 @@ class DataSet:
 
 class LocalDataSet:
     """Single-host dataset over an in-memory list
-    (reference DataSet.scala:117 LocalDataSet + LocalArrayDataSet)."""
+    (reference DataSet.scala:117 LocalDataSet + LocalArrayDataSet).
 
-    def __init__(self, data: List, shuffle: bool = True):
+    Iteration order is deterministic: epoch ``E``'s order is
+    :func:`epoch_permutation` of ``(seed, E)``, with no mutable RNG on
+    the object.  ``seed=None`` resolves ``bigdl_tpu.utils.set_seed``'s
+    process seed at iteration time.  Callers that don't pass ``epoch``
+    to :meth:`data` get a per-object auto-advancing epoch counter —
+    still deterministic from construction, and independent per
+    ``transform()`` copy."""
+
+    def __init__(self, data: List, shuffle: bool = True,
+                 seed: Optional[int] = None):
         self._data = data
         self._shuffle = shuffle
+        self._seed = seed
         self._transformers = []
-        self._rng = np.random.default_rng(0)
+        # per-object epoch counter for epoch-less data() calls; an int,
+        # so transform() shallow copies diverge independently (each
+        # copy rebinds its own value — nothing mutable is shared)
+        self._auto_epoch = 0
+
+    def seed(self) -> int:
+        """The shuffle seed this dataset derives epoch orders from."""
+        if self._seed is not None:
+            return int(self._seed)
+        from bigdl_tpu.utils.rng import get_seed
+        return int(get_seed())
 
     def transform(self, transformer) -> "LocalDataSet":
         """Append a Transformer stage (reference ``dataset -> transformer``).
 
-        Shallow-copies the dataset object (sharing data/rng) so subclass
-        state — e.g. DistributedDataSet's already-computed shard — is
-        preserved rather than re-derived."""
+        Shallow-copies the dataset object (sharing the data list, which
+        iteration treats as read-only) so subclass state — e.g.
+        DistributedDataSet's process assignment — is preserved rather
+        than re-derived.  Copies share NO random state: epoch orders
+        are pure functions of ``(seed, epoch)``, so sibling datasets
+        iterate independently of each other's history."""
         out = _copy.copy(self)
         out._transformers = self._transformers + [transformer]
         return out
@@ -120,13 +173,35 @@ class LocalDataSet:
         return len(self._data)
 
     def shuffle(self):
-        self._rng.shuffle(self._data)
+        """Advance to the next epoch-keyed permutation (the next
+        ``data()`` pass draws a fresh order).  Never reorders ``_data``
+        in place — ``transform()`` copies share that list, and an
+        in-place shuffle would silently reorder every sibling."""
+        self._auto_epoch += 1
 
-    def data(self, train: bool = True) -> Iterator:
-        """One pass (epoch) iterator; shuffled when train."""
-        order = np.arange(len(self._data))
+    def _resolve_epoch(self, train: bool, epoch: Optional[int]) -> int:
+        if epoch is not None:
+            return int(epoch)
+        epoch = self._auto_epoch
         if train and self._shuffle:
-            order = self._rng.permutation(len(self._data))
+            self._auto_epoch += 1
+        return epoch
+
+    def _order(self, train: bool, epoch: int) -> np.ndarray:
+        """This dataset's epoch-``epoch`` index order (hook point:
+        DistributedDataSet slices its process's rows out of the SAME
+        global permutation)."""
+        if train and self._shuffle:
+            return epoch_permutation(len(self._data), self.seed(), epoch)
+        return np.arange(len(self._data))
+
+    def data(self, train: bool = True, epoch: Optional[int] = None) \
+            -> Iterator:
+        """One pass (epoch) iterator; shuffled when train.  ``epoch``
+        keys the deterministic permutation — the Optimizer passes its
+        epoch counter so a resumed run replays the exact order the
+        crashed run was consuming (docs/data_pipeline.md)."""
+        order = self._order(train, self._resolve_epoch(train, epoch))
         it = (self._data[i] for i in order)
         for t in self._transformers:
             it = t(it)
@@ -155,16 +230,27 @@ class DeviceCachedDataSet:
     """Serves HBM-resident MiniBatches, materialized from the wrapped
     dataset on the first epoch.  Arrays are deduplicated by identity so
     datasets that reuse buffers across batches transfer each buffer
-    once."""
+    once.
+
+    The cache is keyed **per mode** (train vs eval): a train-mode pass
+    may be shuffled/augmented, and serving that cache to evaluation —
+    which the old single-slot cache did whenever train was requested
+    first — silently evaluated on augmented data forever after.  Each
+    mode materializes (and holds in HBM) its own batch list on first
+    use."""
 
     def __init__(self, inner, sharding=None):
         self._inner = inner
         self._sharding = sharding
-        self._cache = None
-        self._rng = np.random.default_rng(0)
+        self._cache: dict = {}  # bool(train) -> list of MiniBatch
+        self._auto_epoch = 0
 
     def size(self) -> int:
         return self._inner.size()
+
+    def seed(self) -> int:
+        from bigdl_tpu.data.pipeline import dataset_seed
+        return dataset_seed(self._inner)
 
     def per_process_sharded(self) -> bool:
         return self._inner.per_process_sharded()
@@ -185,28 +271,52 @@ class DeviceCachedDataSet:
             memo[key] = (value, dev)
         return memo[key][1]
 
-    def data(self, train: bool = True) -> Iterator:
-        if self._cache is None:
+    def data(self, train: bool = True, epoch: Optional[int] = None) \
+            -> Iterator:
+        key = bool(train)
+        cache = self._cache.get(key)
+        if cache is None:
+            # materialize this MODE's batches from a FIXED inner epoch
+            # (0) so the cache contents are deterministic; epoch-to-
+            # epoch variety comes from re-permuting the cached batches
+            # below, not from re-transferring fresh ones
             memo: dict = {}
-            self._cache = [
+            cache = self._cache[key] = [
                 MiniBatch(self._put(memo, b.get_input()),
                           self._put(memo, b.get_target()))
-                for b in self._inner.data(train)]
-        order = np.arange(len(self._cache))
+                for b in _call_data(self._inner, train, 0)]
+        if epoch is None:
+            epoch = self._auto_epoch
+            if train:
+                self._auto_epoch += 1
+        order = np.arange(len(cache))
         if train and getattr(self._inner, "_shuffle", True):
-            order = self._rng.permutation(len(self._cache))
-        return (self._cache[i] for i in order)
+            order = epoch_permutation(len(cache), self.seed(),
+                                      int(epoch))
+        return (cache[i] for i in order)
 
 
 class DistributedDataSet(LocalDataSet):
-    """Each host holds its process's shard (reference
-    DistributedDataSet/CachedDistriDataSet, DataSet.scala:171,247).
-    Shard assignment: round-robin by global index so per-host sizes are
-    balanced; with one process this degrades to LocalDataSet."""
+    """Each host serves its process's rows of the GLOBAL epoch order
+    (reference DistributedDataSet/CachedDistriDataSet,
+    DataSet.scala:171,247).
+
+    Epoch ``E``'s global order is ``epoch_permutation(seed, E)`` over
+    the whole index space; host ``p`` takes every ``process_count``-th
+    entry starting at ``p``.  Because every host computes the SAME
+    permutation, per-host shards are consistent and non-overlapping by
+    construction, per-host sizes stay balanced, and — unlike the old
+    construction-time round-robin slice — the samples a host sees
+    actually remix across epochs (the reference's per-epoch global
+    reshuffle, DataSet.scala:260, not a frozen-shard local shuffle).
+    With ``shuffle=False`` the order degrades to the classic
+    round-robin ``data[p::n]``.  The full global list is referenced
+    (not copied); with one process this degrades to LocalDataSet."""
 
     def __init__(self, data: List, shuffle: bool = True,
                  process_index: Optional[int] = None,
-                 process_count: Optional[int] = None):
+                 process_count: Optional[int] = None,
+                 seed: Optional[int] = None):
         if process_index is None:
             try:
                 import jax
@@ -216,12 +326,21 @@ class DistributedDataSet(LocalDataSet):
                 process_index, process_count = 0, 1
         self.process_index = process_index
         self.process_count = process_count or 1
-        shard = data[process_index::self.process_count]
-        super().__init__(shard, shuffle)
-        self._global_size = len(data)
+        super().__init__(data, shuffle, seed=seed)
 
-    def size(self) -> int:
-        return self._global_size
+    def _order(self, train: bool, epoch: int) -> np.ndarray:
+        # this host's slice of the one global epoch order
+        return super()._order(train, epoch)[
+            self.process_index::self.process_count]
 
     def per_process_sharded(self) -> bool:
         return True
+
+
+def _call_data(dataset, train: bool, epoch: int) -> Iterator:
+    """Call ``dataset.data`` passing ``epoch`` only when the signature
+    accepts it — THE one implementation lives in
+    ``bigdl_tpu.data.pipeline.epoch_iter`` (lazy import: bigdl_tpu.data
+    depends on this module, not vice versa at import time)."""
+    from bigdl_tpu.data.pipeline import epoch_iter
+    return epoch_iter(dataset, epoch=epoch, train=train)

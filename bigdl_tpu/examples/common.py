@@ -39,6 +39,11 @@ def base_parser(description: str) -> argparse.ArgumentParser:
                    help="bfloat16 compute with fp32 master weights")
     p.add_argument("--cache-device", action="store_true",
                    help="cache the dataset in device memory (HBM)")
+    p.add_argument("--device-prefetch", type=_positive_int, default=None,
+                   metavar="N",
+                   help="stage batch N+1 to device while step N runs "
+                        "(async double-buffered H2D; see "
+                        "docs/data_pipeline.md)")
     p.add_argument("-q", "--quiet", action="store_true")
     return p
 
@@ -72,6 +77,8 @@ def apply_common(opt, args, train_summary=None, val_summary=None):
     if args.checkpoint:
         opt.set_checkpoint(args.checkpoint, Trigger.every_epoch(),
                            keep_n=args.keep_checkpoints)
+    if getattr(args, "device_prefetch", None):
+        opt.set_device_prefetch(args.device_prefetch)
     if args.state:
         opt.resume(args.state)
     if train_summary is not None:

@@ -54,6 +54,10 @@ from bigdl_tpu.parallel.sharding import (
     ShardingRules, shard_model_params, replicated,
 )
 from bigdl_tpu import telemetry
+from bigdl_tpu.data.pipeline import (
+    PipelineState, dataset_seed, epoch_iter, skip_batches,
+    supports_epoch, PIPELINE_STATE_VERSION,
+)
 from bigdl_tpu.telemetry import events as _te
 from bigdl_tpu.telemetry import families as _tm, tracing as _tt
 from bigdl_tpu.telemetry.health import HealthWatchdog
@@ -170,6 +174,14 @@ class Optimizer:
         self._last_ckpt_generation: Optional[int] = None
         self._last_ckpt_path: Optional[str] = None
         self._run_started: Optional[float] = None
+        # input-pipeline service (bigdl_tpu.data): batches consumed in
+        # the CURRENT epoch (the PipelineState offset persisted with
+        # every checkpoint), the restore snapshot a resume applies, and
+        # the off-by-default async device-prefetch depth
+        self._epoch_offset = 0
+        self._pipeline_restore: Optional[Dict[str, Any]] = None
+        self.device_prefetch_ahead: Optional[int] = None
+        self._active_dp = None
 
     # ---- configuration (reference Optimizer.scala setters) -------------
 
@@ -327,6 +339,24 @@ class Optimizer:
                 f"silently ignored: {sorted(kwargs)})")
         self.watchdog = (watchdog if watchdog is not None
                          else HealthWatchdog(**kwargs))
+        return self
+
+    def set_device_prefetch(self, n_ahead: int = 1) -> "Optimizer":
+        """Stage batch N+1 into the mesh's data sharding on a
+        background thread while step N runs
+        (:class:`bigdl_tpu.data.DevicePrefetch`): the synchronous
+        host->device transfer leaves the hot loop, at the cost of
+        ``n_ahead`` extra batches of device memory.  Off by default —
+        without this call the data path performs exactly the staging it
+        always did.  ``n_ahead=0`` disables.  Ignored (with a warning)
+        under ``iterations_per_dispatch > 1``, whose window staging
+        stacks batches itself, and under multi-process training, whose
+        loop assembles global batches from per-process locals
+        itself."""
+        n = int(n_ahead)
+        if n < 0:
+            raise ValueError("set_device_prefetch: n_ahead must be >= 0")
+        self.device_prefetch_ahead = n or None
         return self
 
     def set_debug_server(self, port: int = 0,
@@ -737,6 +767,10 @@ class Optimizer:
                 "last_generation": self._last_ckpt_generation,
                 "last_payload": self._last_ckpt_path,
             },
+            "pipeline": {
+                "epoch_offset": self._epoch_offset,
+                "device_prefetch": self.device_prefetch_ahead,
+            },
         }
         if self.watchdog is not None:
             out["watchdog"] = self.watchdog.state()
@@ -827,6 +861,91 @@ class Optimizer:
             logger.exception("flight-recorder dump failed")
             return None
 
+    # ---- input-pipeline state (bigdl_tpu.data) ---------------------------
+
+    def _pipeline_snapshot(self) -> Dict[str, Any]:
+        """The PipelineState persisted with every checkpoint: the
+        shuffle seed, the epoch being consumed, the batches-consumed
+        offset within it, and the mixing sampler's configuration when
+        the dataset exposes one — everything a resume needs to continue
+        at the exact next batch."""
+        sampler = None
+        sampler_fn = getattr(self.dataset, "sampler_state", None)
+        if callable(sampler_fn):
+            try:
+                sampler = sampler_fn()
+            except Exception:  # pragma: no cover - exotic wrapper
+                logger.exception("dataset.sampler_state() failed; "
+                                 "checkpointing without sampler state")
+        snap = PipelineState(
+            seed=dataset_seed(self.dataset),
+            epoch=int(self.state["epoch"]),
+            offset=int(self._epoch_offset),
+            sampler=sampler).snapshot()
+        # cross-check token: the payload this snapshot belongs to (the
+        # checkpoint generation IS neval).  In overwrite mode a crash
+        # between the payload rename and the sidecar write can leave
+        # the PREVIOUS generation's sidecar beside a newer payload that
+        # the load-probe fallback accepts — restore detects the
+        # mismatch and falls back to epoch-start replay instead of
+        # silently skipping the wrong batches.
+        snap["generation"] = int(self.state["neval"])
+        return snap
+
+    def _pipeline_restore_skip(self, ps: Dict[str, Any],
+                               epoch: int) -> int:
+        """Batches of THIS epoch the restored PipelineState says were
+        already consumed — the count the epoch iterator must skip for
+        sample-accurate resume.  Returns 0 (epoch-start replay, the
+        always-safe fallback) whenever the snapshot cannot be applied
+        faithfully: version/seed mismatch, a different epoch, or a
+        dataset whose order isn't replayable across restarts.  A
+        mismatched mixing-sampler configuration raises instead — that
+        resume would silently train on a different sample sequence
+        while claiming accuracy."""
+        try:
+            if int(ps.get("version", -1)) != PIPELINE_STATE_VERSION:
+                logger.warning(
+                    "pipeline state version %s unsupported (want %d); "
+                    "replaying the epoch from its start",
+                    ps.get("version"), PIPELINE_STATE_VERSION)
+                return 0
+            gen = ps.get("generation")
+            if gen is not None and int(gen) != int(self.state["neval"]):
+                logger.warning(
+                    "pipeline state generation %s != restored driver "
+                    "iteration %s (stale sidecar from an interrupted "
+                    "overwrite commit?); replaying the epoch from its "
+                    "start", gen, self.state["neval"])
+                return 0
+            if int(ps.get("epoch", -1)) != int(epoch):
+                return 0  # epoch-boundary snapshot: nothing to skip
+            offset = int(ps.get("offset", 0))
+        except (TypeError, ValueError):
+            logger.warning("malformed pipeline state %r; replaying the "
+                           "epoch from its start", ps)
+            return 0
+        if offset <= 0:
+            return 0
+        seed_now = dataset_seed(self.dataset)
+        if int(ps.get("seed", seed_now)) != seed_now:
+            logger.warning(
+                "pipeline state seed %s != current dataset seed %d: the "
+                "epoch order differs, so skipping %d batches would drop "
+                "the WRONG samples; replaying the epoch from its start",
+                ps.get("seed"), seed_now, offset)
+            return 0
+        if not supports_epoch(self.dataset):
+            logger.warning(
+                "dataset.data() does not accept the epoch keyword; its "
+                "order is not replayable across a restart — replaying "
+                "the epoch from its start (see docs/data_pipeline.md)")
+            return 0
+        restore_fn = getattr(self.dataset, "restore_sampler", None)
+        if callable(restore_fn):
+            restore_fn(ps.get("sampler"))  # raises on config mismatch
+        return offset
+
     # ---- main loop (≙ DistriOptimizer.optimize, :823) --------------------
 
     def optimize(self) -> Module:
@@ -854,6 +973,7 @@ class Optimizer:
                 except KeyboardInterrupt:
                     raise
                 except Exception as e:
+                    self._stop_device_prefetch()
                     self._stop_flush_worker()
                     self._flush_summaries()  # keep the failed tail
                     if not _is_transient(e):
@@ -892,12 +1012,27 @@ class Optimizer:
                     self._resume_from = ckpt
         finally:
             restore_signal()
+            self._stop_device_prefetch()
             self._stop_debug_server()
 
     def _flush_summaries(self) -> None:
         for s in (self.train_summary, self.val_summary):
             if s is not None and hasattr(s, "flush"):
                 s.flush()
+
+    def _stop_device_prefetch(self) -> None:
+        """Close a crashed attempt's DevicePrefetch (no-op if none):
+        its producer thread would otherwise spin forever holding
+        ``n_ahead`` device-resident batches while the retry builds a
+        fresh prefetcher — one leak per retry, compounding exactly in
+        the preemption-heavy runs this subsystem serves."""
+        dp = getattr(self, "_active_dp", None)
+        self._active_dp = None
+        if dp is not None:
+            try:
+                dp.close()
+            except Exception:  # pragma: no cover - best effort
+                logger.exception("device prefetch failed to close")
 
     def _stop_flush_worker(self) -> None:
         """Stop the async loss-drain worker (no-op if none is running);
@@ -1025,6 +1160,16 @@ class Optimizer:
         elif self._resume_from:
             saved = jax.tree_util.tree_map(jnp.asarray, saved_opt)
             opt_states = saved
+
+        # PipelineState sidecar (written by CheckpointManager next to
+        # the payload, CRC'd in the same manifest): the iterator
+        # position a mid-epoch resume continues from.  Absent for
+        # pre-pipeline checkpoints -> epoch-start replay as before.
+        self._pipeline_restore = None
+        if self._resume_from:
+            from bigdl_tpu.utils.file import load_pipeline_state
+            self._pipeline_restore = load_pipeline_state(
+                self._resume_from)
 
         from bigdl_tpu.optim.regularizer import leaf_reg_specs
         leaf_specs = leaf_reg_specs(model)
@@ -1167,6 +1312,11 @@ class Optimizer:
                 h = _tm.optimizer_step_seconds()
                 for _ in entries:
                     h.observe(amortized)
+                # pipeline throughput: global samples this window moved
+                # end-to-end per wall second (the number the Throughput
+                # log line reports, as a scrapeable gauge)
+                _tm.pipeline_samples_per_second().set(
+                    sum(e[2] for e in entries) / max(window_dt, 1e-9))
                 # perf_counter endpoints: tracing's clock — mixing the
                 # loop's time.time() stamps in would strand these spans
                 # ~an epoch away from every span() on the trace timeline
@@ -1321,21 +1471,95 @@ class Optimizer:
                     return i + 1
             return w
 
+        use_dp = bool(self.device_prefetch_ahead)
+        if use_dp and k_req > 1:
+            logger.warning(
+                "device prefetch disabled: iterations_per_dispatch=%d "
+                "stages stacked windows itself", k_req)
+            use_dp = False
+        if use_dp and jax.process_count() > 1:
+            # multi-process staging assembles GLOBAL arrays
+            # (make_array_from_process_local_data); a pre-staged batch
+            # would feed b.size() the global batch, double-counting
+            # records through the * nproc bookkeeping — and collective
+            # assembly from a background thread races the main
+            # thread's dispatches
+            logger.warning(
+                "device prefetch disabled: single-process only (the "
+                "multi-process loop assembles global batches itself)")
+            use_dp = False
+        pipeline_restore = self._pipeline_restore
+        self._pipeline_restore = None
+        self._epoch_offset = 0
         saw_batches = False
         with mesh:
             while not self.end_when(self.state):
                 epoch = self.state["epoch"]
                 epoch_start = time.time()
-                self.state["records"] = 0
-                batch_iter = iter(self.dataset.data(train=True))
+                skip = 0
+                if pipeline_restore is not None:
+                    skip = self._pipeline_restore_skip(pipeline_restore,
+                                                       epoch)
+                    pipeline_restore = None  # applies to one epoch only
+                if skip <= 0:
+                    self.state["records"] = 0
+                # else: mid-epoch resume — the restored driver records
+                # already count this epoch's consumed samples
+                self._epoch_offset = max(skip, 0)
+                batch_iter = iter(epoch_iter(self.dataset, epoch=epoch,
+                                             train=True))
+                if skip > 0:
+                    t_skip = time.time()
+                    skipped = skip_batches(batch_iter, skip)
+                    saw_batches = True  # consumed pre-crash, not absent
+                    _te.record_event(
+                        "pipeline_restore", epoch=epoch, offset=skip,
+                        skipped=skipped,
+                        seconds=round(time.time() - t_skip, 6))
+                    if telemetry.enabled():
+                        _tm.pipeline_restore_skipped_batches_total().inc(
+                            skipped)
+                    logger.info(
+                        "pipeline restore: skipped %d consumed batch(es) "
+                        "of epoch %d, resuming at the next batch "
+                        "(sample-accurate)", skipped, epoch)
+                    if skipped < skip:
+                        logger.warning(
+                            "pipeline restore: epoch %d has only %d "
+                            "batch(es) but the checkpoint consumed %d — "
+                            "did the dataset shrink since the "
+                            "checkpoint?", epoch, skipped, skip)
+                dp = None
+                if use_dp:
+                    from bigdl_tpu.data.device_prefetch import (
+                        DevicePrefetch,
+                    )
+                    dp = DevicePrefetch(
+                        self.device_prefetch_ahead,
+                        sharding=x_sharding).apply(batch_iter)
+                    batch_iter = dp
+                    # exposed for the failure path (_stop_device_prefetch):
+                    # an exception escaping this attempt must not leak
+                    # the producer thread + its device-resident batches
+                    # into the retry's fresh attempt
+                    self._active_dp = dp
                 lookahead: List = []
                 stop = False
                 while not stop:
+                    # fetch wait is DATA time: pulling from the input
+                    # pipeline (decode, augment, a stalled loader) is
+                    # the other half of "the step waited on data"
+                    # alongside device staging — the data-starvation
+                    # detector and optimizer_data_wait_seconds must see
+                    # both or a slow pipeline hides from them
+                    fetch_t0 = time.time()
                     while len(lookahead) < k_req:
                         try:
+                            chaos.on_data_batch()
                             lookahead.append(next(batch_iter))
                         except StopIteration:
                             break
+                    fetch_t = time.time() - fetch_t0
                     if not lookahead:
                         break
                     want = (safe_window([b.size() for b in lookahead])
@@ -1417,7 +1641,7 @@ class Optimizer:
                         rngs = jax.vmap(
                             lambda i: jax.random.fold_in(seed_key, i))(
                             jnp.arange(base, base + len(group)))
-                        t_data = time.time() - it_start
+                        t_data = time.time() - it_start + fetch_t
                         params_groups, rest, opt_states, losses = wstep(
                             params_groups, rest, opt_states, xs, ys, rngs,
                             epoch)
@@ -1431,7 +1655,7 @@ class Optimizer:
                         y = _stage(batch.get_target(), x_sharding)
                         rng = jax.random.fold_in(seed_key,
                                                  self.state["neval"])
-                        t_data = time.time() - it_start
+                        t_data = time.time() - it_start + fetch_t
                         if wd is not None:
                             (params_groups, rest, opt_states, loss,
                              gnorm) = step(params_groups, rest,
@@ -1471,6 +1695,7 @@ class Optimizer:
                         if len(pending) >= interval:
                             flush_pending(params_groups, rest, opt_states)
                         self.state["neval"] += 1
+                        self._epoch_offset += 1
                         self.state["is_epoch_end"] = False
                         if self._want_validate_checkpoint():
                             # sync: the checkpoint records state["loss"],
@@ -1492,6 +1717,9 @@ class Optimizer:
                         stop = (stop or bool(self.end_when(self.state))
                                 or self._preempt_requested
                                 or self._halt_requested)
+                if dp is not None:
+                    dp.close()  # unblock the producer on an early exit
+                    self._active_dp = None
                 if self._preempt_requested or self._halt_requested:
                     # SIGTERM, or a watchdog checkpoint_and_halt
                     # verdict, landed: this is the requested safe step
@@ -1533,7 +1761,8 @@ class Optimizer:
                             self.state["neval"])
                     break
                 self.state["epoch"] += 1
-                self.state["is_epoch_end"] = True
+                self._epoch_offset = 0  # snapshots at the boundary say
+                self.state["is_epoch_end"] = True  # "next epoch, batch 0"
                 flush_pending(params_groups, rest, opt_states,
                               sync=self._want_validate_checkpoint())
                 logger.info("Epoch %d finished in %.2f s", epoch,
@@ -1636,6 +1865,7 @@ class Optimizer:
         """One checkpoint generation through the CheckpointManager:
         atomic payload commit, CRC manifest, retention GC."""
         mgr = self._ckpt_manager()
+        pipeline_state = self._pipeline_snapshot()
         if self.checkpoint_sharded:
             # device arrays pass through unchanged: each host writes
             # its own shards, no gather.  The driver rides inside the
@@ -1647,14 +1877,16 @@ class Optimizer:
                 [s for s in opt_states],
                 {k: driver[k] for k in _DRIVER_KEYS if k in driver},
                 generation=self.state["neval"],
-                overwrite=self.overwrite_checkpoint, sharded=True)
+                overwrite=self.overwrite_checkpoint, sharded=True,
+                pipeline_state=pipeline_state)
         else:
             path = mgr.save(
                 {"params": _to_plain(temp.parameters()),
                  "buffers": _to_plain(temp.buffers())},
                 [s for s in opt_states], driver,
                 generation=self.state["neval"],
-                overwrite=self.overwrite_checkpoint, sharded=False)
+                overwrite=self.overwrite_checkpoint, sharded=False,
+                pipeline_state=pipeline_state)
         # /statusz reports the last generation this run committed
         self._last_ckpt_generation = self.state["neval"]
         self._last_ckpt_path = path
@@ -1762,6 +1994,12 @@ def _stage(value, sharding=None):
     def put(leaf):
         if sharding is None:
             return jnp.asarray(leaf)
+        if isinstance(leaf, jax.Array) \
+                and getattr(leaf, "sharding", None) == sharding:
+            # already staged into the target sharding (DevicePrefetch's
+            # background thread, or an HBM-cached dataset): zero host
+            # transfer on the hot path
+            return leaf
         return _put_sharded(leaf, sharding)
 
     return jax.tree_util.tree_map(put, value)

@@ -126,6 +126,27 @@ def prefetch_consumer_wait_total() -> Counter:
         "bottleneck: the step waited on data)")
 
 
+def pipeline_samples_per_second() -> Gauge:
+    return get_registry().gauge(
+        "pipeline_samples_per_second",
+        "Input-pipeline throughput: global samples consumed per second "
+        "over the latest completed readback window")
+
+
+def device_prefetch_buffer_occupancy() -> Gauge:
+    return get_registry().gauge(
+        "device_prefetch_buffer_occupancy",
+        "Device-resident batches buffered by DevicePrefetch, sampled "
+        "at each consumer get (0 = the step waited on H2D staging)")
+
+
+def pipeline_restore_skipped_batches_total() -> Counter:
+    return get_registry().counter(
+        "pipeline_restore_skipped_batches_total",
+        "Batches skipped while restoring PipelineState (sample-accurate "
+        "mid-epoch resume replays the epoch order up to the offset)")
+
+
 # ---- per-module eager profiling -------------------------------------------
 
 def module_forward_seconds() -> Histogram:
@@ -227,6 +248,8 @@ _PREREGISTER = (
     chaos_faults_injected_total,
     prefetch_queue_depth, prefetch_producer_wait_total,
     prefetch_consumer_wait_total,
+    pipeline_samples_per_second, device_prefetch_buffer_occupancy,
+    pipeline_restore_skipped_batches_total,
     module_forward_seconds,
     process_rss_bytes, gc_collections_total,
     device_memory_bytes_in_use, device_memory_bytes_limit,

@@ -29,10 +29,16 @@ or by environment variables (picked up lazily on the first hook call, so
   manifest exists but the payload fails its CRC.
 * ``BIGDL_TPU_CHAOS_IO_FAIL_P``     — each checkpoint write raises
   ``OSError`` with this probability (``BIGDL_TPU_CHAOS_SEED`` seeds it).
+* ``BIGDL_TPU_CHAOS_STALL_PIPELINE_S`` — delay every training batch
+  fetch by this many seconds (a starved input pipeline, the fault the
+  health watchdog's ``data_starvation`` detector exists for);
+  ``BIGDL_TPU_CHAOS_STALL_PIPELINE_BATCHES`` bounds how many batches
+  stall (default: all of them).
 
 Production code calls the module-level hook functions (``on_step``,
-``on_io_write``, ``on_checkpoint_payload``); each is a no-op returning
-immediately when no controller is installed and no env var is set.
+``on_io_write``, ``on_checkpoint_payload``, ``on_data_batch``); each is
+a no-op returning immediately when no controller is installed and no
+env var is set.
 """
 
 from __future__ import annotations
@@ -41,10 +47,12 @@ import logging
 import os
 import random
 import threading
+import time
 from typing import List, Optional
 
 __all__ = ["FaultInjected", "ChaosController", "install", "reset",
-           "active", "on_step", "on_io_write", "on_checkpoint_payload"]
+           "active", "on_step", "on_io_write", "on_checkpoint_payload",
+           "on_data_batch"]
 
 logger = logging.getLogger("bigdl_tpu.chaos")
 
@@ -62,15 +70,20 @@ class ChaosController:
                  crash_checkpoint: Optional[int] = None,
                  truncate_checkpoint: Optional[int] = None,
                  truncate_keep_bytes: int = 64,
-                 io_fail_p: float = 0.0, seed: int = 0):
+                 io_fail_p: float = 0.0, seed: int = 0,
+                 stall_pipeline_s: float = 0.0,
+                 stall_pipeline_batches: Optional[int] = None):
         self.fail_at_step = fail_at_step
         self.crash_checkpoint = crash_checkpoint
         self.truncate_checkpoint = truncate_checkpoint
         self.truncate_keep_bytes = int(truncate_keep_bytes)
         self.io_fail_p = float(io_fail_p)
+        self.stall_pipeline_s = float(stall_pipeline_s)
+        self.stall_pipeline_batches = stall_pipeline_batches
         self._rng = random.Random(seed)
         self._lock = threading.Lock()
         self.checkpoint_writes = 0
+        self.stalled_batches = 0
         self.events: List[str] = []
 
     def _fire(self, what: str) -> None:
@@ -97,6 +110,25 @@ class ChaosController:
         if self.io_fail_p and self._rng.random() < self.io_fail_p:
             self._fire(f"injected IO failure writing {path}")
             raise OSError(f"chaos: injected IO failure writing {path}")
+
+    def on_data_batch(self) -> None:
+        """Called before each training batch is pulled from the input
+        pipeline: sleeps ``stall_pipeline_s`` to fake a starved
+        pipeline (slow storage, an underprovisioned decode pool).  The
+        flight-recorder event fires once — the fault is one stall
+        campaign, not thousands of per-batch records."""
+        if self.stall_pipeline_s <= 0:
+            return
+        with self._lock:
+            if self.stall_pipeline_batches is not None \
+                    and self.stalled_batches >= self.stall_pipeline_batches:
+                return
+            self.stalled_batches += 1
+            first = self.stalled_batches == 1
+        if first:
+            self._fire(f"stalling input pipeline "
+                       f"{self.stall_pipeline_s}s per batch")
+        time.sleep(self.stall_pipeline_s)
 
     def on_checkpoint_payload(self, path: str) -> None:
         """Called after a checkpoint payload is durably on disk, before
@@ -131,7 +163,8 @@ _active: Optional[ChaosController] = None
 _env_checked = False
 
 _ENV_KEYS = ("BIGDL_TPU_CHAOS_FAIL_STEP", "BIGDL_TPU_CHAOS_CRASH_CKPT",
-             "BIGDL_TPU_CHAOS_TRUNCATE_CKPT", "BIGDL_TPU_CHAOS_IO_FAIL_P")
+             "BIGDL_TPU_CHAOS_TRUNCATE_CKPT", "BIGDL_TPU_CHAOS_IO_FAIL_P",
+             "BIGDL_TPU_CHAOS_STALL_PIPELINE_S")
 
 
 def _from_env() -> Optional[ChaosController]:
@@ -148,7 +181,11 @@ def _from_env() -> Optional[ChaosController]:
         crash_checkpoint=_i("BIGDL_TPU_CHAOS_CRASH_CKPT"),
         truncate_checkpoint=_i("BIGDL_TPU_CHAOS_TRUNCATE_CKPT"),
         io_fail_p=float(e.get("BIGDL_TPU_CHAOS_IO_FAIL_P") or 0.0),
-        seed=int(e.get("BIGDL_TPU_CHAOS_SEED") or 0))
+        seed=int(e.get("BIGDL_TPU_CHAOS_SEED") or 0),
+        stall_pipeline_s=float(
+            e.get("BIGDL_TPU_CHAOS_STALL_PIPELINE_S") or 0.0),
+        stall_pipeline_batches=_i(
+            "BIGDL_TPU_CHAOS_STALL_PIPELINE_BATCHES"))
 
 
 def install(**kwargs) -> ChaosController:
@@ -190,3 +227,9 @@ def on_checkpoint_payload(path: str) -> None:
     c = active()
     if c is not None:
         c.on_checkpoint_payload(path)
+
+
+def on_data_batch() -> None:
+    c = active()
+    if c is not None:
+        c.on_data_batch()

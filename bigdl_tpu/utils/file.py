@@ -31,7 +31,8 @@ __all__ = ["save_pytree", "load_pytree", "save_checkpoint",
            "load_checkpoint", "save_checkpoint_sharded",
            "load_checkpoint_sharded", "is_sharded_checkpoint_path",
            "open_file", "is_remote_path", "np_load_any",
-           "strip_file_scheme", "CheckpointManager"]
+           "strip_file_scheme", "CheckpointManager",
+           "pipeline_state_path", "load_pipeline_state"]
 
 logger = logging.getLogger("bigdl_tpu.utils.file")
 
@@ -346,6 +347,46 @@ def _orbax_checkpointer():
     return ocp.StandardCheckpointer()
 
 
+def pipeline_state_path(payload_path: str) -> str:
+    """The input-pipeline sidecar's path for a checkpoint payload:
+    ``checkpoint.<gen>.npz`` / ``checkpoint.<gen>.orbax`` ->
+    ``checkpoint.<gen>.pipeline.json``.  The sidecar holds the
+    PipelineState (bigdl_tpu/data/pipeline.py) — epoch, batches-consumed
+    offset, shuffle seed, mixing-sampler state — and is CRC'd in the
+    same per-generation manifest as the model payload, so a committed
+    generation is committed *with* its iterator position."""
+    stem = strip_file_scheme(payload_path).rstrip("/")
+    for suf in (".npz", ".orbax"):
+        if stem.endswith(suf):
+            stem = stem[:-len(suf)]
+            break
+    return stem + ".pipeline.json"
+
+
+def load_pipeline_state(payload_path: str) -> Optional[Dict]:
+    """Best-effort read of the pipeline sidecar next to a checkpoint
+    payload; None when absent or unparseable (resume then falls back to
+    replaying the unfinished epoch from its start — the pre-pipeline
+    behavior, never a crash)."""
+    path = pipeline_state_path(payload_path)
+    try:
+        if is_remote_path(path):
+            import fsspec
+            fs, p = fsspec.core.url_to_fs(path)
+            if not fs.exists(p):
+                return None
+        elif not os.path.exists(path):
+            return None
+        with open_file(path, "rb") as f:
+            state = json.loads(f.read().decode("utf-8"))
+        return state if isinstance(state, dict) else None
+    except Exception:
+        logger.warning("unreadable pipeline state sidecar %s (resume "
+                       "will replay the epoch from its start)", path,
+                       exc_info=True)
+        return None
+
+
 # --------------------------------------------------------------------------
 # CheckpointManager — durable, verifiable, generation-numbered checkpoints
 # --------------------------------------------------------------------------
@@ -456,16 +497,28 @@ class CheckpointManager:
                 break
         return stem + ".manifest.json"
 
+    @staticmethod
+    def _pipeline_name(payload_name: str) -> str:
+        return pipeline_state_path(payload_name)
+
     # ---- save ------------------------------------------------------------
 
     def save(self, model_state: Dict, optim_state: Any,
              driver_state: Dict, *, generation: int,
-             overwrite: bool = False, sharded: bool = False) -> str:
+             overwrite: bool = False, sharded: bool = False,
+             pipeline_state: Optional[Dict] = None) -> str:
         """Write one checkpoint generation: payload, then (payload
-        verified durable) its manifest, then retention GC.  With
+        verified durable) the pipeline-state sidecar, then the manifest
+        recording both payloads' CRCs, then retention GC.  With
         ``overwrite`` the payload file name is fixed (``checkpoint.npz``)
         but the manifest still records the true generation so resume
-        ordering never depends on mtime."""
+        ordering never depends on mtime.
+
+        ``pipeline_state`` (a ``PipelineState.snapshot()`` dict) rides
+        as a JSON sidecar committed by the SAME manifest — the iterator
+        position and the weights it matches either both commit or
+        neither does, which is what makes mid-epoch resume
+        sample-accurate instead of replaying the unfinished epoch."""
         name = self.payload_name(None if overwrite else generation,
                                  sharded=sharded)
         path = self._join(name)
@@ -481,7 +534,16 @@ class CheckpointManager:
                                             driver_state)
             chaos.on_checkpoint_payload(path)
             if _is_primary_process():
-                self._write_manifest(name, generation, crc, size, sharded)
+                pinfo = None
+                if pipeline_state is not None:
+                    pinfo = self._write_pipeline_state(name,
+                                                       pipeline_state)
+                    _te.record_event(
+                        "pipeline_snapshot", generation=int(generation),
+                        epoch=pipeline_state.get("epoch"),
+                        offset=pipeline_state.get("offset"))
+                self._write_manifest(name, generation, crc, size, sharded,
+                                     pipeline=pinfo)
                 if self.keep_n:
                     self.gc()
         _te.record_event("checkpoint_commit", generation=int(generation),
@@ -492,12 +554,32 @@ class CheckpointManager:
                 time.perf_counter() - t0)
         return path
 
+    def _write_pipeline_state(self, payload_name: str,
+                              pipeline_state: Dict) -> Dict:
+        """Write the pipeline sidecar for a payload; returns the
+        manifest record ``{"file", "crc32", "size"}``."""
+        pname = self._pipeline_name(payload_name)
+        ppath = self._join(pname)
+        data = json.dumps(pipeline_state, sort_keys=True).encode("utf-8")
+        if self._is_remote():
+            chaos.on_io_write(ppath)
+            with open_file(ppath, "wb") as f:
+                f.write(data)
+            crc, size = _crc_and_size(ppath)
+        else:
+            crc, size = _atomic_write_local(ppath,
+                                            lambda f: f.write(data))
+        return {"file": pname, "crc32": crc, "size": size}
+
     def _write_manifest(self, payload_name: str, generation: int,
                         crc: Optional[int], size: Optional[int],
-                        sharded: bool) -> None:
+                        sharded: bool,
+                        pipeline: Optional[Dict] = None) -> None:
         manifest = {"format": MANIFEST_FORMAT, "generation": int(generation),
                     "payload": payload_name, "sharded": bool(sharded),
                     "crc32": crc, "size": size, "time": time.time()}
+        if pipeline is not None:
+            manifest["pipeline"] = pipeline
         data = json.dumps(manifest, sort_keys=True).encode("utf-8")
         mpath = self._join(self._manifest_name(payload_name))
         if self._is_remote():
@@ -534,9 +616,15 @@ class CheckpointManager:
 
     def validate(self, manifest: Dict) -> bool:
         """Does the manifest's payload exist and match its recorded
-        size + CRC (orbax dirs: are the commit markers present)?"""
+        size + CRC (orbax dirs: are the commit markers present)?  When
+        the manifest records a pipeline-state sidecar, that file must
+        verify too — a generation whose iterator position is torn
+        cannot deliver the sample-accurate resume it promises, so the
+        walkback treats it like any other torn payload."""
         path = self._join(manifest["payload"])
         try:
+            if not self._validate_pipeline(manifest):
+                return False
             if manifest.get("sharded"):
                 return self._orbax_committed(path)
             if not self._exists(path):
@@ -553,6 +641,20 @@ class CheckpointManager:
             logger.warning("error validating checkpoint %s", path,
                            exc_info=True)
             return False
+
+    def _validate_pipeline(self, manifest: Dict) -> bool:
+        rec = manifest.get("pipeline")
+        if not rec:
+            return True  # generation predates (or never had) a sidecar
+        p = self._join(rec["file"])
+        if not self._exists(p):
+            return False
+        crc, size = _crc_and_size(p)
+        if rec.get("size") is not None and size != rec["size"]:
+            return False
+        if rec.get("crc32") is not None and crc != rec["crc32"]:
+            return False
+        return True
 
     def latest_good(self) -> Optional[str]:
         """Path of the newest checkpoint that is committed AND intact,
@@ -700,8 +802,12 @@ class CheckpointManager:
                     # bad generation newer than every good one: leave it
                     # for latest_good() to report, don't silently erase
                     continue
-                for name in (man["payload"], man["_manifest_name"]):
+                for name in (man["payload"], man["_manifest_name"],
+                             self._pipeline_name(man["payload"])):
                     p = self._join(name)
+                    if name.endswith(".pipeline.json") \
+                            and not self._exists(p):
+                        continue  # generation had no sidecar
                     try:
                         self._delete(p)
                         removed.append(p)

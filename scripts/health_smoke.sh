@@ -25,11 +25,15 @@ from bigdl_tpu import nn, telemetry
 from bigdl_tpu.dataset import DataSet, SampleToMiniBatch
 from bigdl_tpu.dataset.dataset import Sample
 from bigdl_tpu.optim import Optimizer, Trigger
-from bigdl_tpu.utils import chaos
+from bigdl_tpu.utils import chaos, set_seed
 from bigdl_tpu.utils.file import CheckpointManager, load_checkpoint
 
 telemetry.enable()
 telemetry.reset()
+# pin the shuffle seed so the poisoned sample (index 31) lands in the
+# SECOND batch of epoch 1: the chaos fault at iteration 2 then fires
+# (and retries) before the NaN batch reaches the watchdog
+set_seed(3)
 
 rng = np.random.default_rng(0)
 samples = [Sample(rng.normal(size=(6,)).astype(np.float32),
@@ -103,6 +107,32 @@ resumed = (Optimizer(model, clean, nn.ClassNLLCriterion())
 resumed.optimize()
 assert not resumed.preempted
 
+# ---- stall-pipeline fault -> data-starvation detector, end-to-end ------
+# chaos delays every batch fetch; the stall dominates each readback
+# window's wall time, so the watchdog's data_starvation detector (PR 4)
+# must fire a warn verdict within a short clean run.
+from bigdl_tpu.telemetry import events as _ev
+from bigdl_tpu.telemetry.health import HealthWatchdog
+chaos.reset()
+chaos.install(stall_pipeline_s=0.05)
+wd = HealthWatchdog(data_starvation="warn", starvation_fraction=0.4,
+                    starvation_windows=3)
+stalled = (Optimizer(model, clean, nn.ClassNLLCriterion())
+           .set_end_when(Trigger.max_epoch(10))
+           .set_health_watchdog(wd))
+stalled.optimize()
+chaos.reset()
+assert wd.counts.get("data_starvation", 0) >= 1, (
+    "stall-pipeline fault did not trip the data-starvation detector: "
+    f"{wd.counts}")
+assert not stalled.watchdog_halted  # warn policy keeps training
+starv = [e for e in _ev.recent_events()
+         if e["kind"] == "watchdog"
+         and e.get("anomaly") == "data_starvation"]
+assert starv, "no data_starvation verdict in the flight recorder"
+
 print("health_smoke: OK (statusz scraped at iteration "
-      f"{statusz['iteration']}, halt + flight recorder + resume verified)")
+      f"{statusz['iteration']}, halt + flight recorder + resume + "
+      f"stall->starvation ({wd.counts['data_starvation']} verdicts) "
+      "verified)")
 PY

@@ -34,4 +34,13 @@ if bash "$(dirname "$0")/health_smoke.sh" >"$smoke_log" 2>&1; then
 else
   echo "health_smoke: FAILED (non-fatal ride-along; see $smoke_log)"
 fi
+# data-pipeline smoke (seeded order equality + snapshot/restore):
+# warn-only ride-along; run scripts/data_smoke.sh standalone for the
+# fatal form
+data_log=$(mktemp /tmp/data_smoke.XXXXXX.log)
+if bash "$(dirname "$0")/data_smoke.sh" >"$data_log" 2>&1; then
+  tail -n 1 "$data_log"
+else
+  echo "data_smoke: FAILED (non-fatal ride-along; see $data_log)"
+fi
 exit $rc

@@ -91,6 +91,67 @@ def main():
         np.savez(os.path.join(outdir, "params.npz"), **flat)
         with open(os.path.join(outdir, "ok"), "w") as f:
             f.write("done")
+
+    # leg 3: sample-accurate mid-epoch resume under a SHUFFLED
+    # DistributedDataSet (bigdl_tpu.data PipelineState).  Oracle run
+    # vs chaos-crashed run with per-iteration sharded checkpoints:
+    # the crashed run resumes from latest_good()'s pipeline sidecar
+    # and must reproduce the oracle's per-iteration losses exactly —
+    # any replayed or skipped global sample shifts the epoch order
+    # (which remixes across hosts every epoch) and breaks equality.
+    from bigdl_tpu.utils import chaos
+
+    class LossLog:
+        def __init__(self):
+            self.losses = {}
+
+        def add_scalar(self, name, v, step):
+            if name == "Loss":
+                self.losses[step] = v
+
+        def flush(self):
+            pass
+
+    def leg3_run(ckdir3=None, crash_at=None):
+        set_seed(99)
+        chaos.reset()
+        log = LossLog()
+        ds3 = (DataSet.sharded(samples, shuffle=True, seed=99,
+                               process_index=pid, process_count=nproc)
+               .transform(SampleToMiniBatch(4)))
+        opt3 = (Optimizer(make_model(), ds3, nn.CrossEntropyCriterion())
+                .set_optim_method(SGD(0.1))
+                .set_end_when(Trigger.max_epoch(2))
+                .set_train_summary(log))
+        if ckdir3 is not None:
+            opt3.set_checkpoint(ckdir3, Trigger.several_iteration(1),
+                                sharded=True)
+            # backoff long enough that the primary's manifest landed
+            # before the peer's latest_good() probe
+            opt3.set_failure_retry(3, interval_s=300, backoff_s=1.0,
+                                   backoff_cap_s=2.0)
+        if crash_at is not None:
+            chaos.install(fail_at_step=crash_at)
+        opt3.optimize()
+        chaos.reset()
+        return opt3, log.losses
+
+    oracle, oracle_losses = leg3_run()
+    ckdir3 = os.path.join(outdir, "ck3")
+    os.makedirs(ckdir3, exist_ok=True)
+    crashed, crashed_losses = leg3_run(ckdir3=ckdir3, crash_at=6)
+    for key in ("epoch", "neval", "records"):
+        assert crashed.state[key] == oracle.state[key], (
+            key, crashed.state[key], oracle.state[key])
+    assert set(crashed_losses) == set(oracle_losses)
+    for step, v in oracle_losses.items():
+        assert abs(crashed_losses[step] - v) < 1e-5, (
+            f"iteration {step}: resumed loss {crashed_losses[step]} "
+            f"!= oracle {v}")
+    if pid == 0:
+        with open(os.path.join(outdir, "ok_pipeline"), "w") as f:
+            f.write("sample-accurate")
+
     # all processes must exit cleanly for the parent to pass
     print(f"worker {pid}: done", flush=True)
 

@@ -1,0 +1,706 @@
+"""bigdl_tpu.data — deterministic, checkpointable input pipeline.
+
+Covers the determinism contract (epoch-keyed orders, global remix for
+DistributedDataSet, independent transform() siblings), PipelineState
+persistence through the CheckpointManager manifest, sample-accurate
+crash/SIGTERM resume (the consumed sequence across crash+resume equals
+the uninterrupted run's — proven by per-iteration loss equality, which
+any replayed or skipped batch would break), weighted mixing with a
+checkpointable sampler, async device prefetch (overlap + unchanged
+semantics + off-by-default inertness), and the stall-pipeline chaos
+fault tripping the data-starvation watchdog.
+"""
+
+import json
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+from bigdl_tpu import nn
+from bigdl_tpu.data import (
+    DevicePrefetch, MixedDataSet, PipelineState, skip_batches,
+)
+from bigdl_tpu.dataset import DataSet, SampleToMiniBatch
+from bigdl_tpu.dataset.dataset import (
+    DeviceCachedDataSet, DistributedDataSet, LocalDataSet, Sample,
+    epoch_permutation,
+)
+from bigdl_tpu.dataset.transformer import Transformer
+from bigdl_tpu.optim import Optimizer, Trigger
+from bigdl_tpu.optim.methods import SGD
+from bigdl_tpu.utils import chaos, set_seed
+from bigdl_tpu.utils.file import (
+    CheckpointManager, load_pipeline_state, pipeline_state_path,
+)
+
+
+@pytest.fixture(autouse=True)
+def _chaos_reset():
+    chaos.reset()
+    yield
+    chaos.reset()
+
+
+def _indexed_samples(n=32, dim=6, classes=4):
+    """Sample i's feature is the constant i — batch contents identify
+    the global indices they came from."""
+    return [Sample(np.full((dim,), i, np.float32), (i % classes) + 1)
+            for i in range(n)]
+
+
+def _model(dim=6, classes=4):
+    return nn.Sequential(nn.Linear(dim, 8), nn.ReLU(),
+                         nn.Linear(8, classes), nn.LogSoftMax())
+
+
+class _RecordBatches(Transformer):
+    """Terminal stage logging each pulled batch's sample indices into a
+    shared list (one list per test run)."""
+
+    def __init__(self, log):
+        self.log = log
+
+    def apply(self, it):
+        for b in it:
+            self.log.append(tuple(int(v)
+                                  for v in np.asarray(b.input)[:, 0]))
+            yield b
+
+
+class _LossLog:
+    """train_summary stub capturing per-iteration losses by neval."""
+
+    def __init__(self):
+        self.losses = {}
+
+    def add_scalar(self, name, value, step):
+        if name == "Loss":
+            self.losses[step] = value
+
+    def flush(self):
+        pass
+
+
+def _pipeline(samples, batch=8, log=None):
+    ds = DataSet.array(samples).transform(SampleToMiniBatch(batch))
+    if log is not None:
+        ds = ds.transform(_RecordBatches(log))
+    return ds
+
+
+def _fast_retry(opt, times=3):
+    return opt.set_failure_retry(times, interval_s=300,
+                                 backoff_s=0.01, backoff_cap_s=0.02)
+
+
+# --------------------------------------------------------------------------
+# determinism contract
+# --------------------------------------------------------------------------
+
+class TestDeterministicIteration:
+    def test_epoch_permutation_is_pure(self):
+        a = epoch_permutation(100, 7, 3)
+        b = epoch_permutation(100, 7, 3)
+        np.testing.assert_array_equal(a, b)
+        assert list(a) != list(epoch_permutation(100, 7, 4))
+        assert list(a) != list(epoch_permutation(100, 8, 3))
+        assert sorted(a) == list(range(100))
+
+    def test_two_runs_consume_identical_orders(self):
+        set_seed(21)
+        data = _indexed_samples(16)
+        runs = []
+        for _ in range(2):
+            ds = DataSet.array(data)
+            runs.append([[s.feature[0] for s in ds.data(True, epoch=e)]
+                         for e in (1, 2, 3)])
+        assert runs[0] == runs[1]
+        assert runs[0][0] != runs[0][1]  # epochs actually remix
+
+    def test_distributed_shards_remix_and_stay_disjoint(self):
+        """Each epoch: per-host shards partition the GLOBAL index space
+        (consistent + non-overlapping), and a host's shard changes
+        between epochs — the reference's per-epoch global reshuffle,
+        not a frozen round-robin shard shuffled locally."""
+        set_seed(33)
+        data = _indexed_samples(24)
+        per_epoch = {}
+        for e in (1, 2):
+            shards = []
+            for p in range(3):
+                ds = DistributedDataSet(data, process_index=p,
+                                        process_count=3)
+                shards.append([int(s.feature[0])
+                               for s in ds.data(True, epoch=e)])
+            flat = sum(shards, [])
+            assert sorted(flat) == list(range(24))  # disjoint cover
+            per_epoch[e] = shards
+        # remix: at least one host sees a different SET of samples
+        assert any(set(per_epoch[1][p]) != set(per_epoch[2][p])
+                   for p in range(3))
+
+    def test_unshuffled_distributed_keeps_round_robin(self):
+        ds = DistributedDataSet(_indexed_samples(10), shuffle=False,
+                                process_index=1, process_count=4)
+        assert [int(s.feature[0]) for s in ds.data(train=False)] \
+            == [1, 5, 9]
+        assert ds.size() == 10
+
+    def test_transform_siblings_have_independent_streams(self):
+        """Regression: transform() shallow copies used to share one
+        mutable RNG, so a sibling's iteration order depended on how
+        many draws the other copy had made."""
+        set_seed(13)
+        base = DataSet.array(_indexed_samples(16))
+        a = base.transform(SampleToMiniBatch(4))
+        b = base.transform(SampleToMiniBatch(4))
+        b_expected = [tuple(np.asarray(x.input)[:, 0])
+                      for x in b.data(True, epoch=0)]
+        # burn several draws on sibling a ...
+        for _ in range(3):
+            list(a.data(True))
+        # ... b's next epoch-0 pass is unchanged
+        fresh = DataSet.array(_indexed_samples(16)) \
+            .transform(SampleToMiniBatch(4))
+        got = [tuple(np.asarray(x.input)[:, 0])
+               for x in fresh.data(True, epoch=0)]
+        assert got == b_expected
+
+    def test_shuffle_does_not_mutate_shared_data_list(self):
+        """Regression: shuffle() used to reorder the _data list in
+        place, silently reordering every transform() sibling."""
+        set_seed(13)
+        ds = DataSet.array(_indexed_samples(8))
+        sibling = ds.transform(SampleToMiniBatch(4))
+        before = [int(s.feature[0]) for s in ds._data]
+        ds.shuffle()
+        assert [int(s.feature[0]) for s in ds._data] == before
+        assert sibling._data is ds._data  # still shared, still intact
+
+
+# --------------------------------------------------------------------------
+# DeviceCachedDataSet per-mode cache (satellite regression)
+# --------------------------------------------------------------------------
+
+class TestDeviceCachePerMode:
+    def test_train_first_does_not_poison_eval(self):
+        """Regression: the HBM cache was built from the FIRST call's
+        train flag and then served for the other mode — a train-first
+        call permanently served shuffled batches to evaluation."""
+        set_seed(29)
+        inner = _pipeline(_indexed_samples(16), batch=4)
+        cached = DeviceCachedDataSet(inner)
+        train_first = [tuple(np.asarray(b.get_input())[:, 0])
+                       for b in cached.data(train=True)]
+        eval_batches = [tuple(np.asarray(b.get_input())[:, 0])
+                        for b in cached.data(train=False)]
+        # eval serves the unshuffled natural order, whatever train did
+        assert eval_batches == [(0, 1, 2, 3), (4, 5, 6, 7),
+                                (8, 9, 10, 11), (12, 13, 14, 15)]
+        assert sorted(sum(train_first, ())) == list(range(16))
+
+    def test_train_cache_reshuffles_deterministically(self):
+        set_seed(29)
+        cached = DeviceCachedDataSet(_pipeline(_indexed_samples(16),
+                                               batch=4))
+        e1 = [tuple(np.asarray(b.get_input())[:, 0])
+              for b in cached.data(True, epoch=1)]
+        e1b = [tuple(np.asarray(b.get_input())[:, 0])
+               for b in cached.data(True, epoch=1)]
+        e2 = [tuple(np.asarray(b.get_input())[:, 0])
+              for b in cached.data(True, epoch=2)]
+        assert e1 == e1b and e1 != e2
+
+
+# --------------------------------------------------------------------------
+# PipelineState persistence (CheckpointManager manifest)
+# --------------------------------------------------------------------------
+
+class TestPipelineStatePersistence:
+    def _save(self, tmp_path, mgr, gen, pipeline):
+        return mgr.save({"params": {"w": np.ones((2,))}, "buffers": {}},
+                        [{"t": np.asarray(gen)}], {"epoch": gen},
+                        generation=gen, pipeline_state=pipeline)
+
+    def test_snapshot_restore_roundtrip(self):
+        ps = PipelineState(seed=7, epoch=3, offset=5,
+                           sampler={"kind": "weighted_mixing"})
+        snap = ps.snapshot()
+        back = PipelineState.restore(json.loads(json.dumps(snap)))
+        assert (back.seed, back.epoch, back.offset) == (7, 3, 5)
+        assert back.sampler == {"kind": "weighted_mixing"}
+        with pytest.raises(ValueError, match="version"):
+            PipelineState.restore({**snap, "version": 99})
+
+    def test_sidecar_written_and_crcd_in_manifest(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path))
+        ps = PipelineState(seed=1, epoch=2, offset=3).snapshot()
+        path = self._save(tmp_path, mgr, 4, ps)
+        side = pipeline_state_path(path)
+        assert os.path.isfile(side)
+        assert load_pipeline_state(path) == ps
+        man = next(m for m in mgr._manifests()
+                   if m["generation"] == 4)
+        assert man["pipeline"]["file"].endswith(".pipeline.json")
+        assert man["pipeline"]["crc32"] is not None
+        assert mgr.validate(man)
+
+    def test_torn_sidecar_fails_validation_and_walks_back(self,
+                                                          tmp_path):
+        mgr = CheckpointManager(str(tmp_path))
+        ps = PipelineState(seed=1, epoch=1, offset=1).snapshot()
+        p1 = self._save(tmp_path, mgr, 1, ps)
+        p2 = self._save(tmp_path, mgr, 2, ps)
+        with open(pipeline_state_path(p2), "w") as f:
+            f.write('{"torn": tru')  # torn write
+        assert mgr.latest_good() == p1
+
+    def test_gc_sweeps_pipeline_sidecars(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path), keep_n=1)
+        for g in (1, 2, 3):
+            last = self._save(
+                tmp_path, mgr,
+                g, PipelineState(seed=0, epoch=g, offset=0).snapshot())
+        names = os.listdir(tmp_path)
+        assert sum(n.endswith(".pipeline.json") for n in names) == 1
+        assert load_pipeline_state(last)["epoch"] == 3
+
+    def test_missing_sidecar_is_none_not_crash(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path))
+        path = self._save(tmp_path, mgr, 1, None)
+        assert load_pipeline_state(path) is None
+
+
+# --------------------------------------------------------------------------
+# sample-accurate resume (the acceptance scenario)
+# --------------------------------------------------------------------------
+
+def _train(dataset, *, summary=None, epochs=3, ckpt=None,
+           ckpt_trigger=None, retry=False, seed=17):
+    set_seed(seed)
+    opt = (Optimizer(_model(), dataset, nn.ClassNLLCriterion())
+           .set_optim_method(SGD(0.1))
+           .set_end_when(Trigger.max_epoch(epochs)))
+    if summary is not None:
+        opt.set_train_summary(summary)
+    if ckpt is not None:
+        opt.set_checkpoint(str(ckpt),
+                           ckpt_trigger or Trigger.several_iteration(1))
+    if retry:
+        _fast_retry(opt)
+    return opt
+
+
+class TestSampleAccurateResume:
+    def test_crash_mid_epoch_resumes_at_exact_next_batch(self, tmp_path):
+        """Chaos crash at iteration 6 (mid epoch 2 of 4-iteration
+        epochs), checkpoints every iteration.  The consumed sequence
+        across crash+resume must equal the uninterrupted run's: every
+        iteration's loss matches (a replayed or skipped batch would
+        shift the data order and break it), the resumed epoch's pull
+        order matches, and the final driver state is identical."""
+        clean_losses, clean_pulls = _LossLog(), []
+        clean = _train(_pipeline(_indexed_samples(), log=clean_pulls),
+                       summary=clean_losses)
+        clean.optimize()
+
+        from bigdl_tpu.telemetry import events as te
+        te.reset_events()
+        faulty_losses, faulty_pulls = _LossLog(), []
+        chaos.install(fail_at_step=6)
+        faulty = _train(_pipeline(_indexed_samples(), log=faulty_pulls),
+                        summary=faulty_losses, ckpt=tmp_path,
+                        retry=True)
+        faulty.optimize()
+
+        for key in ("epoch", "neval", "records"):
+            assert faulty.state[key] == clean.state[key], key
+        # no replayed, no skipped samples: losses agree per iteration
+        assert set(faulty_losses.losses) == set(clean_losses.losses)
+        for step, v in clean_losses.losses.items():
+            assert faulty_losses.losses[step] == pytest.approx(
+                v, abs=1e-6), f"iteration {step} diverged"
+        # the flight recorder carries the pipeline lifecycle
+        kinds = te.event_counts()
+        assert kinds.get("pipeline_snapshot", 0) > 0
+        assert kinds.get("pipeline_restore", 0) >= 1
+
+    def test_resumed_epoch_pull_order_matches_uninterrupted(
+            self, tmp_path):
+        """The resumed run rebuilds the SAME epoch order the crashed
+        run was consuming: its pulls for the interrupted epoch equal
+        the uninterrupted run's pulls for that epoch (the first
+        ``offset`` of them as skip-replay, the rest stepped)."""
+        clean_pulls = []
+        clean = _train(_pipeline(_indexed_samples(), log=clean_pulls),
+                       epochs=2)
+        clean.optimize()
+        epoch2_clean = clean_pulls[4:8]  # 4 iters/epoch
+
+        chaos.install(fail_at_step=6)  # 2 batches into epoch 2
+        faulty_pulls = []
+        faulty = _train(_pipeline(_indexed_samples(), log=faulty_pulls),
+                        epochs=2, ckpt=tmp_path, retry=True)
+        faulty.optimize()
+        # pulls: epoch1(4) + epoch2 pre-crash(2; the second pulled but
+        # never stepped) + resumed epoch2 replay-from-checkpoint:
+        # 1 skip-replay + 3 live = the full epoch again
+        assert faulty_pulls[:4] == clean_pulls[:4]
+        assert faulty_pulls[-4:] == epoch2_clean
+        assert faulty.state["neval"] == clean.state["neval"]
+
+    def test_sigterm_preemption_resume_sample_accurate(self, tmp_path):
+        """SIGTERM mid-epoch → final checkpoint at the step boundary
+        with the PipelineState offset; a fresh optimizer resumes at the
+        exact next batch and finishes with the uninterrupted run's
+        driver state and per-iteration losses — the fault_tolerance.md
+        'resume replays the unfinished epoch' caveat is gone."""
+        clean_losses = _LossLog()
+        clean = _train(_pipeline(_indexed_samples()),
+                       summary=clean_losses, seed=19)
+        clean.optimize()
+
+        class KillOnce(Transformer):
+            def __init__(self):
+                self.batches = 0
+
+            def apply(self, it):
+                for b in it:
+                    self.batches += 1
+                    if self.batches == 6:  # mid epoch 2
+                        os.kill(os.getpid(), signal.SIGTERM)
+                    yield b
+
+        set_seed(19)
+        leg1_losses = _LossLog()
+        ds = _pipeline(_indexed_samples()).transform(KillOnce())
+        opt = _train(ds, summary=leg1_losses, seed=19, ckpt=tmp_path,
+                     ckpt_trigger=Trigger.every_epoch())
+        opt.optimize()
+        assert opt.preempted
+        assert opt.state["epoch"] == 2  # unfinished epoch not advanced
+
+        ckpt = CheckpointManager(str(tmp_path)).latest_good()
+        ps = load_pipeline_state(ckpt)
+        assert ps is not None and ps["epoch"] == 2 and ps["offset"] > 0
+
+        leg2_losses = _LossLog()
+        set_seed(19)
+        opt2 = (Optimizer(_model(), _pipeline(_indexed_samples()),
+                          nn.ClassNLLCriterion())
+                .set_optim_method(SGD(0.1))
+                .set_end_when(Trigger.max_epoch(3))
+                .set_train_summary(leg2_losses)
+                .resume(ckpt))
+        opt2.optimize()
+        assert not opt2.preempted
+        for key in ("epoch", "neval", "records"):
+            assert opt2.state[key] == clean.state[key], key
+        merged = {**leg1_losses.losses, **leg2_losses.losses}
+        assert set(merged) == set(clean_losses.losses)
+        for step, v in clean_losses.losses.items():
+            assert merged[step] == pytest.approx(v, abs=1e-6), \
+                f"iteration {step} diverged"
+
+    def test_stale_sidecar_generation_mismatch_replays_epoch(
+            self, tmp_path, caplog):
+        """Overwrite-mode crash window: the previous generation's
+        sidecar next to a newer payload must NOT be applied (its offset
+        would skip the wrong batches); restore detects the generation
+        mismatch and falls back to epoch-start replay."""
+        opt = _train(_pipeline(_indexed_samples()), epochs=2,
+                     ckpt=tmp_path)
+        opt.optimize()
+        path = CheckpointManager(str(tmp_path)).latest_good()
+        side = pipeline_state_path(path)
+        with open(side) as f:
+            ps = json.load(f)
+        ps["generation"] -= 1  # sidecar from one commit earlier
+        ps["offset"] = max(ps.get("offset", 1), 1)
+        with open(side, "w") as f:
+            json.dump(ps, f)
+        set_seed(17)
+        opt2 = (Optimizer(_model(), _pipeline(_indexed_samples()),
+                          nn.ClassNLLCriterion())
+                .set_optim_method(SGD(0.1))
+                .set_end_when(Trigger.max_epoch(3))
+                .resume(path))
+        with caplog.at_level("WARNING", logger="bigdl_tpu.optim"):
+            opt2.optimize()
+        assert opt2.state["epoch"] == 4
+        assert any("stale sidecar" in r.message for r in caplog.records)
+
+    def test_resume_without_sidecar_replays_epoch_start(self, tmp_path):
+        """A pre-pipeline checkpoint (no sidecar) must resume exactly
+        as before: replay the unfinished epoch from its start."""
+        chaos.install(fail_at_step=6)
+        pulls = []
+        opt = _train(_pipeline(_indexed_samples(), log=pulls),
+                     epochs=2, ckpt=tmp_path, retry=True)
+        # strip every sidecar as soon as it is written
+        real_save = CheckpointManager.save
+
+        def save_no_sidecar(self, *a, **kw):
+            kw["pipeline_state"] = None
+            return real_save(self, *a, **kw)
+
+        CheckpointManager.save = save_no_sidecar
+        try:
+            opt.optimize()
+        finally:
+            CheckpointManager.save = real_save
+        # epoch 2 was replayed in full: its 4 batches appear twice
+        # (once pre-crash partially, once fully after resume)
+        assert opt.state["epoch"] == 3
+        assert len(pulls) > 8  # strictly more pulls than a clean run
+
+
+# --------------------------------------------------------------------------
+# weighted mixing
+# --------------------------------------------------------------------------
+
+class TestMixedDataSet:
+    def _corpora(self):
+        a = DataSet.array([Sample(np.zeros((6,), np.float32), 1)
+                           for _ in range(8)], shuffle=False)
+        b = DataSet.array([Sample(np.ones((6,), np.float32), 2)
+                           for _ in range(8)], shuffle=False)
+        return a, b
+
+    def test_deterministic_weighted_interleave(self):
+        a, b = self._corpora()
+        m = MixedDataSet([a, b], weights=[3, 1], seed=5)
+        e1 = [s.label for s in m.data(True, epoch=1)]
+        assert e1 == [s.label for s in m.data(True, epoch=1)]
+        assert e1 != [s.label for s in m.data(True, epoch=2)]
+        assert len(e1) == 16 and m.size() == 16
+        share = sum(1 for x in e1 if x == 1) / len(e1)
+        assert share > 0.5  # the weight-3 corpus dominates
+
+    def test_small_corpus_cycles_with_reshuffle(self):
+        small = DataSet.array(_indexed_samples(4))
+        big = DataSet.array(_indexed_samples(32))
+        set_seed(3)
+        m = MixedDataSet([small, big], weights=[1, 1], seed=3,
+                         items_per_epoch=24)
+        items = list(m.data(True, epoch=1))
+        assert len(items) == 24  # small corpus wrapped, stream endless
+
+    def test_sampler_restore_rejects_changed_mixture(self):
+        a, b = self._corpora()
+        st = MixedDataSet([a, b], weights=[3, 1], seed=5).sampler_state()
+        MixedDataSet([a, b], weights=[3, 1], seed=5).restore_sampler(st)
+        with pytest.raises(ValueError, match="weights"):
+            MixedDataSet([a, b], weights=[1, 1],
+                         seed=5).restore_sampler(st)
+        with pytest.raises(ValueError, match="seed"):
+            MixedDataSet([a, b], weights=[3, 1],
+                         seed=6).restore_sampler(st)
+        with pytest.raises(ValueError, match="corpora"):
+            MixedDataSet([a], weights=[1], seed=5).restore_sampler(st)
+
+    def test_sharded_mixture_yields_per_process_share(self):
+        """Regression: with per-process-sharded children, each host
+        must yield size()/process_count items per epoch — serving the
+        global count would consume every sample process_count times.
+        All hosts draw the same child-choice sequence, so global
+        batches stay consistent."""
+        data_a = _indexed_samples(16)
+        data_b = [Sample(np.full((6,), 100 + i, np.float32), 1)
+                  for i in range(16)]
+        per_host = []
+        for p in range(2):
+            a = DistributedDataSet(data_a, shuffle=False,
+                                   process_index=p, process_count=2)
+            b = DistributedDataSet(data_b, shuffle=False,
+                                   process_index=p, process_count=2)
+            m = MixedDataSet([a, b], weights=[1, 1], seed=9)
+            assert m.size() == 32  # global, like DistributedDataSet
+            items = list(m.data(True, epoch=1))
+            assert len(items) == 16  # this host's share, not global
+            per_host.append(items)
+        # same choice sequence on every host: draw t picked the same
+        # child (features < 100 = child a, >= 100 = child b)
+        kinds = [[int(s.feature[0]) >= 100 for s in items]
+                 for items in per_host]
+        assert kinds[0] == kinds[1]
+        # and the hosts served disjoint rows of each child
+        got0 = {int(s.feature[0]) for s in per_host[0]}
+        got1 = {int(s.feature[0]) for s in per_host[1]}
+        assert not (got0 & got1)
+
+    def test_sharded_child_smaller_than_process_count_rejected(self):
+        """A corpus with fewer samples than processes leaves some
+        hosts' shards empty — rejected at construction, not as a
+        mid-epoch crash on one host while the others wedge in a
+        collective."""
+        tiny = DistributedDataSet(_indexed_samples(1), shuffle=False,
+                                  process_index=0, process_count=2)
+        big = DistributedDataSet(_indexed_samples(16), shuffle=False,
+                                 process_index=0, process_count=2)
+        with pytest.raises(ValueError, match="shards would be empty"):
+            MixedDataSet([tiny, big], weights=[1, 1], seed=2)
+
+    def test_mixed_sampler_state_rides_in_checkpoint(self, tmp_path):
+        a, b = self._corpora()
+        m = MixedDataSet([a, b], weights=[3, 1], seed=5) \
+            .transform(SampleToMiniBatch(8))
+        set_seed(5)
+        opt = (Optimizer(_model(), m, nn.ClassNLLCriterion())
+               .set_optim_method(SGD(0.1))
+               .set_end_when(Trigger.max_epoch(1))
+               .set_checkpoint(str(tmp_path),
+                               Trigger.several_iteration(1)))
+        opt.optimize()
+        ckpt = CheckpointManager(str(tmp_path)).latest_good()
+        ps = load_pipeline_state(ckpt)
+        assert ps["sampler"]["kind"] == "weighted_mixing"
+        assert ps["sampler"]["children"] == 2
+
+
+# --------------------------------------------------------------------------
+# async device prefetch
+# --------------------------------------------------------------------------
+
+class TestDevicePrefetch:
+    def test_batch_n_plus_1_device_resident_before_n_drained(self):
+        """The overlap demonstration: with the consumer holding batch N
+        (step N conceptually still running — its result undrained), the
+        producer has already staged batch N+1 (and N+2) into device
+        memory."""
+        import jax
+        from bigdl_tpu.dataset.dataset import MiniBatch
+        from bigdl_tpu.parallel.mesh import MeshConfig, batch_sharding
+        mesh = MeshConfig(data=-1).build()
+        sh = batch_sharding(mesh)
+        batches = [MiniBatch(np.full((8, 6), i, np.float32),
+                             np.ones((8,), np.int64)) for i in range(6)]
+        it = DevicePrefetch(2, sharding=sh).apply(iter(batches))
+        b0 = next(it)  # "step 0 running"; nothing else consumed
+        deadline = time.time() + 10
+        while it.occupancy() < 2 and time.time() < deadline:
+            time.sleep(0.005)
+        assert it.occupancy() >= 2, \
+            "batch N+1 was not staged while batch N was outstanding"
+        assert isinstance(b0.get_input(), jax.Array)
+        assert b0.get_input().sharding == sh  # already mesh-sharded
+        rest = list(it)
+        assert len(rest) == 5 and it.staged_total == 6
+        np.testing.assert_array_equal(
+            np.asarray(rest[0].get_input())[:, 0], np.full((8,), 1.0))
+
+    def test_prefetch_on_off_identical_losses(self):
+        def run(dp):
+            log = _LossLog()
+            opt = _train(_pipeline(_indexed_samples()), summary=log,
+                         epochs=2, seed=23)
+            if dp:
+                opt.set_device_prefetch(2)
+            opt.optimize()
+            return log.losses
+
+        assert run(False) == run(True)
+
+    def test_prefetch_closed_on_crash_and_retry(self, tmp_path):
+        """Regression: an exception escaping the epoch loop must close
+        the active prefetcher (its producer thread would otherwise
+        spin forever holding device-resident batches, one leak per
+        retry) — and the crash+retry run still matches the clean run's
+        final driver state."""
+        clean = _train(_pipeline(_indexed_samples()), epochs=3, seed=25)
+        clean.optimize()
+
+        chaos.install(fail_at_step=6)
+        opt = _train(_pipeline(_indexed_samples()), epochs=3, seed=25,
+                     ckpt=tmp_path, retry=True)
+        opt.set_device_prefetch(2)
+        opt.optimize()
+        assert opt._active_dp is None  # crashed attempt's dp closed
+        for key in ("epoch", "neval", "records"):
+            assert opt.state[key] == clean.state[key], key
+
+    def test_upstream_error_relayed_to_consumer(self):
+        def boom():
+            yield from _pipeline(_indexed_samples(8)).data(train=False)
+            raise RuntimeError("decode failed")
+
+        it = DevicePrefetch(1).apply(boom())
+        with pytest.raises(RuntimeError, match="decode failed"):
+            list(it)
+
+
+# --------------------------------------------------------------------------
+# off-by-default discipline (PR 3/4 pattern)
+# --------------------------------------------------------------------------
+
+class TestOffByDefault:
+    def test_unused_subsystem_constructs_nothing_and_stages_as_before(
+            self, monkeypatch):
+        """With the pipeline subsystem unused: DevicePrefetch is never
+        constructed, and the loop performs exactly the per-step host
+        transfers it always did — one staging call per batch tensor
+        (x and y), nothing more."""
+        import bigdl_tpu.data.device_prefetch as dp_mod
+        import bigdl_tpu.optim.optimizer as opt_mod
+
+        def forbidden(*a, **k):
+            raise AssertionError("DevicePrefetch constructed without "
+                                 "set_device_prefetch")
+
+        monkeypatch.setattr(dp_mod.DevicePrefetch, "apply", forbidden)
+        stage_calls = []
+        real_stage = opt_mod._stage
+
+        def counting_stage(value, sharding=None):
+            stage_calls.append(1)
+            return real_stage(value, sharding)
+
+        monkeypatch.setattr(opt_mod, "_stage", counting_stage)
+        opt = _train(_pipeline(_indexed_samples()), epochs=2, seed=27)
+        opt.optimize()
+        iters = opt.state["neval"] - 1
+        assert len(stage_calls) == 2 * iters  # x + y per step, exactly
+
+
+# --------------------------------------------------------------------------
+# chaos stall-pipeline fault + data-starvation watchdog
+# --------------------------------------------------------------------------
+
+class TestStallPipelineFault:
+    def test_stall_sleeps_and_bounds(self):
+        ctl = chaos.install(stall_pipeline_s=0.03,
+                            stall_pipeline_batches=2)
+        t0 = time.time()
+        for _ in range(4):
+            chaos.on_data_batch()
+        dt = time.time() - t0
+        assert 0.05 <= dt < 0.5
+        assert ctl.stalled_batches == 2
+        assert sum("stalling input pipeline" in e
+                   for e in ctl.events) == 1  # one campaign, one event
+
+    def test_env_driven_stall(self, monkeypatch):
+        chaos.reset()
+        monkeypatch.setenv("BIGDL_TPU_CHAOS_STALL_PIPELINE_S", "0.5")
+        monkeypatch.setenv("BIGDL_TPU_CHAOS_STALL_PIPELINE_BATCHES", "3")
+        ctl = chaos.active()
+        assert ctl is not None and ctl.stall_pipeline_s == 0.5
+        assert ctl.stall_pipeline_batches == 3
+
+    def test_stall_trips_data_starvation_detector(self):
+        """End-to-end: the injected pipeline stall dominates each
+        window's wall time, so PR 4's data-starvation detector fires a
+        verdict within a short run."""
+        from bigdl_tpu.telemetry.health import HealthWatchdog
+        chaos.install(stall_pipeline_s=0.05)
+        wd = HealthWatchdog(data_starvation="warn",
+                            starvation_fraction=0.4,
+                            starvation_windows=3)
+        opt = _train(_pipeline(_indexed_samples()), epochs=3, seed=31)
+        opt.set_health_watchdog(wd)
+        opt.optimize()
+        assert wd.counts.get("data_starvation", 0) >= 1, wd.counts
+        assert not opt.watchdog_halted  # warn policy keeps training

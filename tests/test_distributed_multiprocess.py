@@ -65,6 +65,12 @@ def test_two_process_train_checkpoint_resume(tmp_path):
     for p, out in zip(procs, outs):
         assert p.returncode == 0, f"worker failed:\n{out[-4000:]}"
     assert os.path.exists(os.path.join(outdir, "ok"))
+    # leg 3 inside the workers: shuffled DistributedDataSet, chaos
+    # crash mid-epoch, PipelineState resume reproduces the oracle's
+    # per-iteration losses exactly (sample-accurate multi-process
+    # resume) — asserted in dist_worker.py, marker written on success
+    assert os.path.exists(os.path.join(outdir, "ok_pipeline")), \
+        "sample-accurate multi-process resume leg did not complete"
 
     # ---- single-process oracle: identical schedule, identical global
     # batch composition ([process-0 shard rows | process-1 shard rows])
