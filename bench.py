@@ -184,19 +184,12 @@ def _start_watchdog():
 # Phases
 # ---------------------------------------------------------------------------
 
-# Dense bf16 peak FLOP/s per chip by device_kind substring (public specs).
-_PEAK_BF16 = [
-    ("v6", 918e12), ("v5p", 459e12), ("v5e", 197e12), ("v5 lite", 197e12),
-    ("v5litepod", 197e12), ("v4", 275e12), ("v3", 123e12), ("v2", 46e12),
-]
-
-
 def _peak_flops(device_kind: str):
-    kind = (device_kind or "").lower()
-    for key, peak in _PEAK_BF16:
-        if key in kind:
-            return peak
-    return None
+    """Public dense bf16 peak FLOP/s — the table lives in
+    telemetry.perf now (one declaration for bench, chip-session, and
+    the attribution layer)."""
+    from bigdl_tpu.telemetry.perf import device_peak_flops
+    return device_peak_flops(device_kind)
 
 
 def _probe_backend_subprocess(wait_s: float) -> Optional[bool]:
@@ -272,6 +265,12 @@ def phase_backend():
     in-process init.  A HANGING probe is terminal for this run — more
     clients would pile onto a wedged tunnel — but a probe that exits
     unhealthy (crash, transient error) gets one retry."""
+    if os.environ.get("BIGDL_TPU_BENCH_FORCE_PROBE_FAIL"):
+        # CI seam (scripts/perf_smoke.sh): simulate the wedged tunnel
+        # so the carried-forward publication path is exercised on CPU
+        raise RuntimeError(
+            "backend probe failure forced "
+            "(BIGDL_TPU_BENCH_FORCE_PROBE_FAIL=1)")
     import jax
     if os.environ.get("BIGDL_TPU_BENCH_FORCE_CPU"):
         # the axon sitecustomize overrides JAX_PLATFORMS; win the
@@ -536,6 +535,27 @@ def phase_optimizer_loop(on_tpu: bool, batch: int, size: int, host_batch):
             upd["optimizer_overhead_pct"] = round(
                 100.0 * (1.0 - (batch / step_t) / raw), 1)
         _update(**upd)
+    # step-time attribution: phases + residual summing to the measured
+    # wall step (telemetry.perf); recomputed after the roofline phase
+    # so mfu_vs_measured joins the table
+    attribution = None
+    try:
+        if opt.compiled_flops_per_iteration:
+            _update(optimizer_flops_per_step=(
+                opt.compiled_flops_per_iteration))
+        _OPT_WINDOW_RECORDS[:] = list(opt.window_records)
+        attribution = _build_attribution()
+        if attribution:
+            _update(attribution=attribution)
+            ph = attribution["phases_s"]
+            _log("attribution (s/step): "
+                 + " ".join(f"{k}={v:.6f}" for k, v in ph.items())
+                 + f" residual={attribution['residual_s']:.6f}"
+                 + f" wall={attribution['wall_step_s']:.6f}"
+                 + f" dominant={attribution['dominant_phase']}")
+    except Exception:
+        _log("perf attribution failed (non-fatal):\n"
+             + traceback.format_exc())
     if telemetry is not None:
         try:
             from bigdl_tpu.telemetry.export import json_snapshot
@@ -545,6 +565,11 @@ def phase_optimizer_loop(on_tpu: bool, batch: int, size: int, host_batch):
                 os.path.dirname(os.path.abspath(__file__)),
                 "BENCH_telemetry.json")
             snap = json_snapshot()
+            if attribution:
+                # the attribution table rides in the artifact so a
+                # future perf round reads where the time went without
+                # re-running a TPU profile
+                snap["perf_attribution"] = attribution
             with open(snap_path, "w", encoding="utf-8") as f:
                 json.dump(snap, f, default=str)
             _update(telemetry_snapshot=os.path.basename(snap_path))
@@ -671,6 +696,97 @@ def phase_roofline(on_tpu: bool):
 
 
 # ---------------------------------------------------------------------------
+# Perf attribution + durable-evidence plumbing (telemetry.perf)
+# ---------------------------------------------------------------------------
+
+# the optimizer loop's per-window phase records, kept so the
+# attribution table can be re-derived AFTER the roofline phase measures
+# this run's peak (phase order puts the headline loop first)
+_OPT_WINDOW_RECORDS: list = []
+
+
+def _build_attribution():
+    """Attribution report (phases + residual + MFU + boundedness) from
+    the optimizer loop's window records and whatever cost/roofline
+    numbers have landed in RESULT so far."""
+    from bigdl_tpu.telemetry import perf
+    if not _OPT_WINDOW_RECORDS:
+        return None
+    pfx = ("fused_" if RESULT.get("optimizer_loop_variant") == "fused"
+           else "")
+    return perf.attribution_report(
+        _OPT_WINDOW_RECORDS,
+        # prefer the optimizer loop's own execution-weighted FLOP
+        # count (the program the windows actually ran); fall back to
+        # the raw-step program's
+        flops_per_step=(RESULT.get("optimizer_flops_per_step")
+                        or RESULT.get(pfx + "flops_per_step")
+                        or RESULT.get("flops_per_step")),
+        bytes_per_step=(RESULT.get(pfx + "bytes_per_step")
+                        or RESULT.get("bytes_per_step")),
+        peak_spec_flops=RESULT.get("peak_spec_flops"),
+        peak_measured_flops=RESULT.get("peak_measured_flops"),
+        device_kind=RESULT.get("device_kind"))
+
+
+def _refresh_attribution():
+    """Re-derive the attribution table once the same-run roofline has
+    landed (mfu_vs_measured becomes computable), and rewrite the
+    telemetry snapshot's embedded copy so artifact and result line
+    agree."""
+    try:
+        att = _build_attribution()
+        if not att:
+            return
+        _update(attribution=att)
+        snap_name = RESULT.get("telemetry_snapshot")
+        if snap_name:
+            path = os.path.join(
+                os.path.dirname(os.path.abspath(__file__)), snap_name)
+            with open(path, "r", encoding="utf-8") as f:
+                snap = json.load(f)
+            snap["perf_attribution"] = att
+            with open(path, "w", encoding="utf-8") as f:
+                json.dump(snap, f, default=str)
+    except Exception:
+        _log("attribution refresh failed (non-fatal):\n"
+             + traceback.format_exc())
+
+
+def _publish_carried_forward():
+    """Emit the newest confirmed on-device artifact as this round's
+    result, marked ``carried_forward: true`` with the ORIGINAL
+    measurement timestamp — the wedged-tunnel degradation VERDICT items
+    1 and 6 asked for.  Falls back to the old 0.0 partial only when no
+    confirmed evidence exists on disk."""
+    try:
+        from bigdl_tpu.telemetry import perf
+        here = os.path.dirname(os.path.abspath(__file__))
+        found = perf.latest_confirmed(here)
+        if found is None:
+            raise FileNotFoundError(
+                "no confirmed on-device BENCH artifact on disk")
+        path, doc = found
+        out = perf.carried_forward_result(
+            doc, path,
+            note="backend unreachable at bench time; republishing the "
+                 "latest confirmed on-device evidence")
+        out["probe_failure"] = RESULT["phases"].get("backend_init")
+        perf.record_carried_forward_round()
+        with _LOCK:
+            if _EMITTED.is_set():
+                return
+            _EMITTED.set()
+            line = json.dumps(out)
+        print(line, flush=True)
+        _log(f"published carried-forward round from "
+             f"{os.path.basename(path)} (value {out.get('value')}, "
+             f"original_timestamp {out.get('original_timestamp')})")
+    except Exception:
+        _log("carried-forward publication failed; emitting the "
+             "explicitly-partial result:\n" + traceback.format_exc())
+        _emit_final("backend_init_failed")
+
 
 def main():
     _start_watchdog()
@@ -680,44 +796,13 @@ def main():
     # remaining budget still fits compile + the raw-step measurement
     dev = run_phase("backend_init", phase_backend, deadline_s=340.0)
     if dev is None:
-        # The tunneled chip comes and goes (r04: unreachable for a whole
-        # session, then back).  Point the reader at the most recent
-        # CONFIRMED full run committed in-repo — clearly labeled as
-        # prior evidence, never merged into this run's (empty)
-        # measurements.
-        try:
-            import glob
-            here = os.path.dirname(os.path.abspath(__file__))
-            # date-stamped files sort lexicographically: last = newest;
-            # only real-chip runs count as confirmed evidence
-            prior = None
-            for path in sorted(glob.glob(
-                    os.path.join(here, "BENCH_measured_*.json")),
-                    reverse=True):
-                try:
-                    with open(path) as f:
-                        cand = json.load(f)
-                except Exception:
-                    continue  # a corrupt file must not hide older runs
-                # "confirmed" = a COMPLETE real-chip run: not a
-                # watchdog/phase partial, with a nonzero headline
-                if (cand.get("platform") == "tpu"
-                        and "partial" not in cand and cand.get("value")):
-                    prior, fname = cand, os.path.basename(path)
-                    break
-            if prior is None:
-                raise FileNotFoundError("no confirmed TPU run on disk")
-            RESULT["last_confirmed_run"] = {
-                "file": fname,
-                "metric": prior.get("metric"),
-                "value": prior.get("value"),
-                "mfu_vs_measured": prior.get("mfu_vs_measured"),
-                "note": "prior full-TPU run from this round; backend "
-                        "unreachable at bench time",
-            }
-        except Exception:
-            pass
-        _emit_final("backend_init_failed")
+        # The tunneled chip comes and goes (r04: unreachable for a
+        # whole session, then back).  A wedged backend must never again
+        # publish a 0.0 round: re-emit the newest CONFIRMED on-device
+        # artifact, clearly marked carried_forward with its original
+        # timestamp.  Only with no confirmed evidence anywhere on disk
+        # does the explicitly-partial 0.0 line go out.
+        _publish_carried_forward()
         return
 
     on_tpu = dev.platform != "cpu"
@@ -758,6 +843,9 @@ def main():
     if _remaining() > 60.0:
         run_phase("roofline", lambda: phase_roofline(on_tpu),
                   deadline_s=150.0)
+        # the roofline landed after the optimizer loop: fold the
+        # measured peak into the attribution table + snapshot copy
+        _refresh_attribution()
     else:
         RESULT["phases"]["roofline"] = "skipped (budget)"
     if _remaining() > 75.0:
@@ -770,6 +858,21 @@ def main():
                   deadline_s=100.0)
     else:
         RESULT["phases"]["int8_infer"] = "skipped (budget)"
+
+    # RoundArtifact provenance on the result line itself: schema
+    # version, run timestamp, git rev, and the confirmed-on-device flag
+    # latest_confirmed() keys on when a later wedged round degrades to
+    # carrying this one forward
+    try:
+        from bigdl_tpu.telemetry import perf
+        _update(schema_version=perf.ROUND_ARTIFACT_VERSION,
+                timestamp=time.time(),
+                git_rev=perf.git_revision(
+                    os.path.dirname(os.path.abspath(__file__))),
+                confirmed_on_device=bool(on_tpu and RESULT.get("value")))
+    except Exception:
+        _log("provenance stamping failed (non-fatal):\n"
+             + traceback.format_exc())
 
     _emit_final("done")
     # hard-exit: abandoned phase threads may be wedged inside native XLA

@@ -60,6 +60,7 @@ from bigdl_tpu.data.pipeline import (
 )
 from bigdl_tpu.telemetry import events as _te
 from bigdl_tpu.telemetry import families as _tm, tracing as _tt
+from bigdl_tpu.telemetry import perf as _tp
 from bigdl_tpu.telemetry.health import HealthWatchdog
 from bigdl_tpu.utils import chaos
 from bigdl_tpu.utils.file import CheckpointManager, load_checkpoint
@@ -772,6 +773,12 @@ class Optimizer:
                 "device_prefetch": self.device_prefetch_ahead,
             },
         }
+        # step-time attribution so far this run (telemetry.perf): where
+        # wall time is going, live, without waiting for the artifact
+        try:
+            out["perf"] = _tp.optimizer_perf_status(self)
+        except Exception:  # pragma: no cover - introspection best effort
+            out["perf"] = None
         if self.watchdog is not None:
             out["watchdog"] = self.watchdog.state()
         return out
@@ -1218,25 +1225,47 @@ class Optimizer:
             interval = 1
         # pending: (neval, epoch, n_records, records_cum, loss_device)
         pending: List[Tuple] = []
-        window = {"start": time.time(), "data_t": 0.0}
+        window = {"start": time.time(), "data_t": 0.0, "fetch_t": 0.0,
+                  "disp_t": 0.0}
         drain_state = {"last_ready": 0.0}
         # (n_iterations, completion_to_completion_s, data_stage_s) per
         # flushed window — lets harnesses compute steady-state step time
         # with the compile-bearing first window excluded (bench.py)
         self.window_timings: List[Tuple[int, float, float]] = []
+        # richer per-window phase records for telemetry.perf step-time
+        # attribution (data-wait / host-staging / device-compute /
+        # readback + the wall they must sum to); same window boundaries
+        # as window_timings, but BOUNDED — a ~14-key dict per window
+        # over a multi-million-iteration run would otherwise grow
+        # without limit, and /statusz aggregates the whole thing per
+        # poll (attribution over the newest windows is what an operator
+        # wants anyway)
+        from collections import deque
+        self.window_records: Any = deque(maxlen=int(os.environ.get(
+            "BIGDL_TPU_WINDOW_RECORDS_CAP", "4096")))
         prof_start, prof_num = self.profile_steps
         prof_active = False
         prof_done = False
 
-        def consume_window(entries, wstart, data_t, params_groups,
-                           opt_states, rest):
+        def consume_window(entries, wstart, data_t, fetch_t, disp_t,
+                           params_groups, opt_states, rest):
             """Readback + log one flushed window.  Minimal device->host
             transfers: per-scalar float() readbacks pay a full round
             trip each, which on a high-latency host<->device link
             dwarfs the payload.  Single-step iterations contribute
             scalar losses (batched into ONE stacked readback); windowed
             dispatches contribute (stacked_losses, idx) pairs — one
-            readback per window array, never per iteration."""
+            readback per window array, never per iteration.
+
+            Also times the window's attribution phases for
+            telemetry.perf: ``device_compute`` = the main loop's time
+            inside the dispatch calls (``disp_t`` — an enqueue on an
+            async backend, the execution itself on a synchronous one)
+            plus the pin below (host blocked on device completion);
+            the loss transfer+convert after the pin is ``readback``;
+            ``data_t`` splits into the pipeline fetch (``fetch_t``) vs
+            H2D staging measured in the main loop."""
+            t_enter_pc = time.perf_counter()
             # Pin the completion timestamp FIRST with one blocking
             # transfer of the window's last loss buffer.  A pure
             # transfer blocks exactly until that step's own output
@@ -1284,6 +1313,9 @@ class Optimizer:
                 else:
                     losses.append(float(stacked_host[si]))
                     si += 1
+            readback_s = time.perf_counter() - t_ready_pc
+            block_s = disp_t + (t_ready_pc - t_enter_pc)
+            stage_t = max(data_t - fetch_t, 0.0)
             window_dt = t_ready - max(wstart, drain_state["last_ready"])
             drain_state["last_ready"] = t_ready
             per_iter = window_dt / len(entries)
@@ -1292,6 +1324,16 @@ class Optimizer:
                              / len(entries), count=len(entries))
             self.window_timings.append(
                 (len(entries), window_dt, data_t))
+            self.window_records.append({
+                "iterations": len(entries), "wall_s": window_dt,
+                "data_wait_s": fetch_t, "host_staging_s": stage_t,
+                "device_compute_s": block_s, "readback_s": readback_s,
+                # device_compute components, for debugging attribution:
+                # dispatch-call time vs the completion-pin wait
+                "dispatch_s": disp_t,
+                "pin_wait_s": t_ready_pc - t_enter_pc,
+                "t_ready": t_ready, "sync": not flush_async,
+            })
             if wd is not None:
                 # completion-timestamp stream → step-time-outlier and
                 # data-starvation judgment (sync in watchdog mode, so a
@@ -1317,12 +1359,31 @@ class Optimizer:
                 # log line reports, as a scrapeable gauge)
                 _tm.pipeline_samples_per_second().set(
                     sum(e[2] for e in entries) / max(window_dt, 1e-9))
+                # per-phase attribution: one observation per window per
+                # phase, amortized to per-iteration seconds; the
+                # residual fraction gauge tracks what the phases do NOT
+                # cover (telemetry.perf turns these same records into
+                # the full attribution table)
+                ph = _tm.step_phase_seconds()
+                for pname, tot in (("data_wait", fetch_t),
+                                   ("host_staging", stage_t),
+                                   ("device_compute", block_s),
+                                   ("readback", readback_s)):
+                    ph.labels(pname).observe(tot / len(entries))
+                measured = fetch_t + stage_t + block_s + readback_s
+                _tm.step_unattributed_fraction().set(
+                    max(window_dt - measured, 0.0)
+                    / max(window_dt, 1e-9))
                 # perf_counter endpoints: tracing's clock — mixing the
                 # loop's time.time() stamps in would strand these spans
                 # ~an epoch away from every span() on the trace timeline
                 _tt.record_span("optimizer/step", t_ready_pc - window_dt,
                                 t_ready_pc, iterations=len(entries),
-                                data_wait_s=round(data_t, 6))
+                                data_wait_s=round(data_t, 6),
+                                fetch_s=round(fetch_t, 6),
+                                stage_s=round(stage_t, 6),
+                                device_s=round(block_s, 6),
+                                readback_s=round(readback_s, 6))
             n_pend = len(entries)
             for idx, ((neval_i, epoch_i, n_i, cum_i, _), lf) in enumerate(
                     zip(entries, losses)):
@@ -1399,6 +1460,7 @@ class Optimizer:
         def flush_pending(params_groups, rest, opt_states, sync=False):
             if pending:
                 job = (list(pending), window["start"], window["data_t"],
+                       window["fetch_t"], window["disp_t"],
                        params_groups, opt_states, rest)
                 if flushq is not None:
                     flushq.put(job)
@@ -1407,6 +1469,8 @@ class Optimizer:
                 pending.clear()
                 window["start"] = time.time()
                 window["data_t"] = 0.0
+                window["fetch_t"] = 0.0
+                window["disp_t"] = 0.0
             if sync and flushq is not None:
                 flushq.join()
 
@@ -1642,9 +1706,11 @@ class Optimizer:
                             lambda i: jax.random.fold_in(seed_key, i))(
                             jnp.arange(base, base + len(group)))
                         t_data = time.time() - it_start + fetch_t
+                        t_disp0 = time.perf_counter()
                         params_groups, rest, opt_states, losses = wstep(
                             params_groups, rest, opt_states, xs, ys, rngs,
                             epoch)
+                        window["disp_t"] += time.perf_counter() - t_disp0
                         # (stacked, idx) markers: flush reads the whole
                         # window back in ONE transfer, no per-step slices
                         loss_list = [(losses, i)
@@ -1656,16 +1722,21 @@ class Optimizer:
                         rng = jax.random.fold_in(seed_key,
                                                  self.state["neval"])
                         t_data = time.time() - it_start + fetch_t
+                        t_disp0 = time.perf_counter()
                         if wd is not None:
                             (params_groups, rest, opt_states, loss,
                              gnorm) = step(params_groups, rest,
                                            opt_states, x, y, rng, epoch)
+                            window["disp_t"] += (time.perf_counter()
+                                                 - t_disp0)
                             self._watchdog_step_check(
                                 wd, loss, gnorm, self.state["neval"])
                         else:
                             params_groups, rest, opt_states, loss = \
                                 step(params_groups, rest, opt_states,
                                      x, y, rng, epoch)
+                            window["disp_t"] += (time.perf_counter()
+                                                 - t_disp0)
                         loss_list = [loss]
                     self.metrics.add("data load and transfer", t_data)
                     if telemetry.enabled():
@@ -1678,6 +1749,7 @@ class Optimizer:
                         _tt.record_span("optimizer/data_wait",
                                         pc - t_data, pc)
                     window["data_t"] += t_data
+                    window["fetch_t"] += fetch_t
                     for b, loss_i in zip(group, loss_list):
                         # records are GLOBAL: b.size() is per-process
                         n = b.size() * nproc

@@ -55,6 +55,46 @@ def optimizer_retries_total() -> Counter:
         "Transient-failure retries taken by Optimizer.optimize()")
 
 
+# ---- perf attribution (telemetry.perf) ------------------------------------
+
+def step_phase_seconds() -> Histogram:
+    return get_registry().histogram(
+        "step_phase_seconds",
+        "Per-iteration seconds of each step-time attribution phase "
+        "(data_wait / host_staging / device_compute / readback), "
+        "amortized over the readback window — one observation per "
+        "window per phase",
+        labelnames=("phase",),
+        buckets=(1e-5, 1e-4, 1e-3, 5e-3, 0.01, 0.025, 0.05, 0.1, 0.25,
+                 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, float("inf")))
+
+
+def step_mfu_vs_measured() -> Gauge:
+    return get_registry().gauge(
+        "step_mfu_vs_measured",
+        "Model FLOP utilization of the wall step time against the "
+        "same-run measured matmul roofline (set when a harness "
+        "computes an attribution report with a measured peak)")
+
+
+def step_unattributed_fraction() -> Gauge:
+    return get_registry().gauge(
+        "step_unattributed_fraction",
+        "Fraction of the latest readback window's wall time not "
+        "covered by any measured attribution phase (the honest "
+        "residual, set per window by the loss-drain worker; the run "
+        "aggregate lives in the attribution report — see "
+        "docs/performance.md 'Attributing an MFU gap')")
+
+
+def bench_rounds_carried_forward_total() -> Counter:
+    return get_registry().counter(
+        "bench_rounds_carried_forward_total",
+        "Bench rounds that re-published prior confirmed on-device "
+        "evidence (carried_forward) because the backend was "
+        "unreachable at bench time")
+
+
 # ---- training health (watchdog) -------------------------------------------
 
 def training_nonfinite_total() -> Counter:
@@ -243,6 +283,8 @@ def serving_batch_occupancy() -> Gauge:
 _PREREGISTER = (
     optimizer_data_wait_seconds, optimizer_step_seconds,
     optimizer_validation_seconds, optimizer_retries_total,
+    step_phase_seconds, step_mfu_vs_measured,
+    step_unattributed_fraction, bench_rounds_carried_forward_total,
     training_nonfinite_total, training_anomalies_total, grad_norm,
     checkpoint_commit_seconds, checkpoint_torn_generations_total,
     chaos_faults_injected_total,

@@ -1,0 +1,522 @@
+"""Perf attribution: where a step's wall time goes, and durable
+hardware evidence that survives a wedged chip.
+
+Two halves, one subsystem (the layer every perf round reports through —
+ROADMAP item 1):
+
+**Attribution** — :func:`attribute_windows` decomposes the optimizer's
+completion-timestamp stream (``Optimizer.window_records``, written by
+the loss-drain worker) into four measured phases plus an explicit
+*unattributed residual*:
+
+* ``data_wait``       — host blocked pulling batches from the input
+  pipeline (decode, augment, a stalled loader);
+* ``host_staging``    — host→device transfer + window stacking + rng
+  build between fetch and dispatch;
+* ``device_compute``  — host blocked on the device completing the
+  window (the pure-transfer pin in ``consume_window``; only the
+  NON-overlapped device time can show up in wall time, which is
+  exactly what attribution of wall time wants);
+* ``readback``        — device→host loss transfer + float conversion.
+
+``residual`` is wall minus the measured phases, clamped non-negative —
+the honest "we don't know" number.  When host and device genuinely
+overlap (async drain), the phases can over-sum the
+completion-to-completion wall; the excess is reported as ``overlap``
+rather than silently rescaled, so the published invariant is exact::
+
+    sum(phases) + residual - overlap == wall
+
+:func:`attribution_report` pairs the decomposition with the analytic
+cost model (``utils/xla_cost.cost_breakdown``: compiled FLOPs + bytes
+accessed) to state MFU vs the public spec AND vs the same-run measured
+roofline (overall and device-only), plus a compute-bound vs HBM-bound
+verdict from bytes/step against the device's HBM bandwidth.
+
+**Durable evidence** — a versioned :data:`RoundArtifact <ROUND_SCHEMA>`
+envelope (schema version, device kind, caller-passed timestamp, git
+rev, confirmed-on-device vs carried-forward flags) with a writer that
+promotes ``scripts/chip_session.py`` outputs (including
+``real_jpeg_train``) into BENCH round records, and the
+:func:`latest_confirmed` / :func:`carried_forward_result` pair
+``bench.py`` uses to re-publish the newest confirmed on-device number
+(marked ``carried_forward: true``) instead of emitting 0.0 when the
+tunneled backend wedges (VERDICT r05 items 1 and 6: three straight
+rounds published zero).
+
+This module never imports jax — harnesses consult it before (and
+instead of) touching a possibly-wedged backend.
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import json
+import os
+import subprocess
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = [
+    "PHASES", "attribute_windows", "attribution_report",
+    "roofline_verdict", "device_peak_flops", "device_hbm_bytes_per_s",
+    "optimizer_perf_status",
+    "ROUND_SCHEMA", "ROUND_ARTIFACT_VERSION", "git_revision",
+    "make_round_artifact", "write_round_artifact", "load_round_artifact",
+    "artifact_payload", "artifact_timestamp", "is_confirmed",
+    "latest_confirmed", "carried_forward_result", "promote_chip_session",
+]
+
+# The measured phases, in pipeline order.  ``residual`` is not a phase:
+# it is defined as what the phases do NOT cover.
+PHASES = ("data_wait", "host_staging", "device_compute", "readback")
+
+# Record keys as written by Optimizer's consume_window.
+_PHASE_KEYS = {
+    "data_wait": "data_wait_s",
+    "host_staging": "host_staging_s",
+    "device_compute": "device_compute_s",
+    "readback": "readback_s",
+}
+
+# ---------------------------------------------------------------------------
+# Device capability tables (public numbers, per chip)
+# ---------------------------------------------------------------------------
+
+# Dense bf16 peak FLOP/s by device_kind substring — the same table
+# bench.py's MFU-vs-spec has always used, now declared once.
+_PEAK_BF16_FLOPS = (
+    ("v6", 918e12), ("v5p", 459e12), ("v5e", 197e12), ("v5 lite", 197e12),
+    ("v5litepod", 197e12), ("v4", 275e12), ("v3", 123e12), ("v2", 46e12),
+)
+
+# HBM bandwidth (bytes/s) by device_kind substring — the denominator of
+# the HBM-bound verdict (docs/performance.md measured v5e conv fusions
+# at ~94% of the 819 GB/s figure, so these are usable rooflines).
+_HBM_BYTES_PER_S = (
+    ("v6", 1640e9), ("v5p", 2765e9), ("v5e", 819e9), ("v5 lite", 819e9),
+    ("v5litepod", 819e9), ("v4", 1228e9), ("v3", 900e9), ("v2", 700e9),
+)
+
+
+def _lookup(table, device_kind: Optional[str]) -> Optional[float]:
+    kind = (device_kind or "").lower()
+    for key, value in table:
+        if key in kind:
+            return value
+    return None
+
+
+def device_peak_flops(device_kind: Optional[str]) -> Optional[float]:
+    """Public dense bf16 peak FLOP/s for a ``device_kind`` string, or
+    None for unknown parts (CPU, new chips)."""
+    return _lookup(_PEAK_BF16_FLOPS, device_kind)
+
+
+def device_hbm_bytes_per_s(device_kind: Optional[str]) -> Optional[float]:
+    """Public HBM bandwidth (bytes/s) for a ``device_kind`` string, or
+    None when unknown."""
+    return _lookup(_HBM_BYTES_PER_S, device_kind)
+
+
+# ---------------------------------------------------------------------------
+# Step-time attribution
+# ---------------------------------------------------------------------------
+
+def attribute_windows(records: List[Dict[str, Any]],
+                      skip_first: int = 1) -> Optional[Dict[str, Any]]:
+    """Aggregate the optimizer's per-window phase records into one
+    per-step attribution table.
+
+    ``records`` is ``Optimizer.window_records`` — one dict per flushed
+    readback window with ``iterations``, ``wall_s``
+    (completion-to-completion), and the four measured phase durations.
+    The first ``skip_first`` windows bear compile and are excluded when
+    enough windows exist; with nothing left the full list is used and
+    ``includes_compile_window`` is set so the reader knows the numbers
+    carry one-time costs.
+
+    Returns None for an empty stream; otherwise a dict whose exact
+    invariant is ``sum(phases_s.values()) + residual_s - overlap_s ==
+    wall_step_s`` (see module docstring for why ``overlap`` exists
+    instead of rescaling)."""
+    if not records:
+        return None
+    records = list(records)  # accept any sequence (deque included)
+    steady = records[skip_first:] if len(records) > skip_first else None
+    includes_compile = steady is None
+    if steady is None:
+        steady = list(records)
+    iters = sum(int(r.get("iterations", 1)) for r in steady)
+    iters = max(iters, 1)
+    wall = sum(float(r.get("wall_s", 0.0)) for r in steady)
+    phase_totals = {
+        name: sum(max(float(r.get(key, 0.0)), 0.0) for r in steady)
+        for name, key in _PHASE_KEYS.items()
+    }
+    measured = sum(phase_totals.values())
+    residual = max(wall - measured, 0.0)
+    overlap = max(measured - wall, 0.0)
+    wall_step = wall / iters
+    phases_s = {k: v / iters for k, v in phase_totals.items()}
+    denom = max(wall, 1e-12)
+    fractions = {k: v / denom for k, v in phase_totals.items()}
+    fractions["residual"] = residual / denom
+    # the residual competes for "dominant": when unattributed time
+    # dwarfs every measured phase, naming a sliver phase would steer
+    # the operator at exactly the wrong target (the runbook's "attack
+    # the loop, not the kernels" case)
+    dominant = max(fractions, key=fractions.get)
+    return {
+        "windows": len(steady),
+        "iterations": iters,
+        "wall_step_s": wall_step,
+        "phases_s": phases_s,
+        "residual_s": residual / iters,
+        "overlap_s": overlap / iters,
+        "fractions": fractions,
+        "unattributed_fraction": residual / denom,
+        "dominant_phase": dominant,
+        "includes_compile_window": includes_compile,
+    }
+
+
+def roofline_verdict(flops_per_step: Optional[float],
+                     bytes_per_step: Optional[float],
+                     peak_flops: Optional[float],
+                     hbm_bytes_per_s: Optional[float]) \
+        -> Optional[Dict[str, Any]]:
+    """Compute-bound vs HBM-bound from the analytic cost model: the
+    step's minimum time on the MXU (flops/peak) against its minimum
+    time on the memory system (bytes/bandwidth).  The larger floor is
+    the binding resource; ``attainable_step_s`` is the best step time
+    this program can reach on this device no matter how well scheduled.
+    Returns None when neither floor is computable."""
+    t_compute = (flops_per_step / peak_flops
+                 if flops_per_step and peak_flops else None)
+    t_hbm = (bytes_per_step / hbm_bytes_per_s
+             if bytes_per_step and hbm_bytes_per_s else None)
+    if t_compute is None and t_hbm is None:
+        return None
+    verdict = None
+    if t_compute is not None and t_hbm is not None:
+        verdict = "hbm_bound" if t_hbm > t_compute else "compute_bound"
+    out: Dict[str, Any] = {
+        "verdict": verdict,
+        "min_compute_s": t_compute,
+        "min_hbm_s": t_hbm,
+        "attainable_step_s": max(t for t in (t_compute, t_hbm)
+                                 if t is not None),
+    }
+    if flops_per_step and bytes_per_step:
+        out["arithmetic_intensity_flops_per_byte"] = (
+            flops_per_step / bytes_per_step)
+    if peak_flops and hbm_bytes_per_s:
+        out["machine_balance_flops_per_byte"] = (
+            peak_flops / hbm_bytes_per_s)
+    return out
+
+
+def attribution_report(records: List[Dict[str, Any]],
+                       flops_per_step: Optional[float] = None,
+                       bytes_per_step: Optional[float] = None,
+                       peak_spec_flops: Optional[float] = None,
+                       peak_measured_flops: Optional[float] = None,
+                       hbm_bytes_per_s: Optional[float] = None,
+                       device_kind: Optional[str] = None,
+                       skip_first: int = 1) -> Optional[Dict[str, Any]]:
+    """The full perf-attribution table: phase decomposition + MFU
+    accounting + roofline verdict, as one JSON-able dict (what
+    ``bench.py`` embeds in ``BENCH_telemetry.json`` under
+    ``perf_attribution`` and merges into its result line).
+
+    MFU is stated four ways: ``vs_spec`` / ``vs_measured`` use the
+    wall step time (the headline — what a user experiences), while
+    ``device_vs_spec`` / ``device_vs_measured`` use only the measured
+    device-compute phase (what the chip achieves while actually busy);
+    the gap between the two pairs is precisely what the host phases
+    cost.  ``peak_*`` default from the :func:`device_peak_flops` /
+    :func:`device_hbm_bytes_per_s` tables when ``device_kind`` is
+    given.  When telemetry is enabled, publishes the
+    ``step_mfu_vs_measured`` gauge as a side effect (the
+    ``step_unattributed_fraction`` gauge stays per-window, written
+    only by the drain worker — one writer, one semantic; the run
+    aggregate lives in this report)."""
+    report = attribute_windows(records, skip_first=skip_first)
+    if report is None:
+        return None
+    if peak_spec_flops is None:
+        peak_spec_flops = device_peak_flops(device_kind)
+    if hbm_bytes_per_s is None:
+        hbm_bytes_per_s = device_hbm_bytes_per_s(device_kind)
+    if device_kind:
+        report["device_kind"] = device_kind
+    if flops_per_step:
+        report["flops_per_step"] = float(flops_per_step)
+    if bytes_per_step:
+        report["bytes_per_step"] = float(bytes_per_step)
+    wall_step = report["wall_step_s"]
+    device_step = report["phases_s"]["device_compute"]
+    mfu: Dict[str, Optional[float]] = {}
+    for tag, peak in (("vs_spec", peak_spec_flops),
+                      ("vs_measured", peak_measured_flops)):
+        if flops_per_step and peak and wall_step > 0:
+            mfu[tag] = flops_per_step / wall_step / peak
+        if flops_per_step and peak and device_step > 0:
+            mfu["device_" + tag] = flops_per_step / device_step / peak
+    if mfu:
+        report["mfu"] = mfu
+    roof = roofline_verdict(
+        flops_per_step, bytes_per_step,
+        peak_measured_flops or peak_spec_flops, hbm_bytes_per_s)
+    if roof is not None:
+        report["roofline"] = roof
+    try:
+        from bigdl_tpu import telemetry
+        if telemetry.enabled() and mfu.get("vs_measured") is not None:
+            from bigdl_tpu.telemetry import families as _tm
+            _tm.step_mfu_vs_measured().set(mfu["vs_measured"])
+    except Exception:  # pragma: no cover - telemetry must never break
+        pass           # the harness computing the report
+    return report
+
+
+def optimizer_perf_status(opt) -> Optional[Dict[str, Any]]:
+    """The trainer's ``perf`` contribution to ``GET /statusz``: the
+    cumulative attribution over this run's readback windows plus the
+    latest window raw, so an operator can see where time is going
+    mid-run without waiting for the artifact."""
+    records = getattr(opt, "window_records", None)
+    if not records:
+        return None
+    report = attribute_windows(records)
+    last = records[-1]
+    return {
+        "attribution": report,
+        "last_window": {
+            "iterations": last.get("iterations"),
+            "wall_s": last.get("wall_s"),
+            **{key: last.get(key) for key in _PHASE_KEYS.values()},
+        },
+        "flops_per_step": getattr(opt, "compiled_flops_per_iteration",
+                                  None),
+    }
+
+
+# ---------------------------------------------------------------------------
+# RoundArtifact: durable, versioned hardware evidence
+# ---------------------------------------------------------------------------
+
+ROUND_SCHEMA = "bigdl_tpu.round_artifact"
+ROUND_ARTIFACT_VERSION = 1
+
+
+def git_revision(repo_root: Optional[str] = None) -> Optional[str]:
+    """Short git rev of the working tree, or None outside a checkout
+    (provenance only — never load-bearing)."""
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=10,
+            cwd=repo_root or os.getcwd())
+        rev = out.stdout.strip()
+        return rev if out.returncode == 0 and rev else None
+    except Exception:
+        return None
+
+
+def make_round_artifact(payload: Dict[str, Any], *,
+                        kind: str,
+                        timestamp: float,
+                        device_kind: Optional[str] = None,
+                        platform: Optional[str] = None,
+                        confirmed_on_device: bool = False,
+                        carried_forward: bool = False,
+                        source: Optional[str] = None,
+                        git_rev: Optional[str] = None) -> Dict[str, Any]:
+    """Wrap a measurement dict in the versioned evidence envelope.
+
+    ``timestamp`` is passed in by the caller, never sampled here: a
+    promotion must carry the ORIGINAL measurement time (a chip-session
+    number promoted hours later is evidence from when the chip was
+    healthy, not from when the writer ran)."""
+    if platform is None:
+        platform = payload.get("platform")
+    if device_kind is None:
+        device_kind = payload.get("device_kind")
+    return {
+        "schema": ROUND_SCHEMA,
+        "schema_version": ROUND_ARTIFACT_VERSION,
+        "kind": kind,
+        "timestamp": float(timestamp),
+        "device_kind": device_kind,
+        "platform": platform,
+        "git_rev": git_rev,
+        "confirmed_on_device": bool(confirmed_on_device),
+        "carried_forward": bool(carried_forward),
+        "source": source,
+        "payload": payload,
+    }
+
+
+def write_round_artifact(path: str, artifact: Dict[str, Any]) -> str:
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(artifact, f, indent=1, default=str)
+    return path
+
+
+def load_round_artifact(path: str) -> Optional[Dict[str, Any]]:
+    """Parse ``path`` as JSON, or None on any error (a corrupt file
+    must not hide older evidence from :func:`latest_confirmed`)."""
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            return json.load(f)
+    except Exception:
+        return None
+
+
+def _is_envelope(doc: Dict[str, Any]) -> bool:
+    return isinstance(doc, dict) and doc.get("schema") == ROUND_SCHEMA
+
+
+def artifact_payload(doc: Dict[str, Any]) -> Dict[str, Any]:
+    """The measurement dict inside an artifact — envelope-aware, so
+    legacy flat ``BENCH_measured_*.json`` files read identically."""
+    if _is_envelope(doc):
+        payload = doc.get("payload")
+        return payload if isinstance(payload, dict) else {}
+    return doc if isinstance(doc, dict) else {}
+
+
+def artifact_timestamp(doc: Dict[str, Any],
+                       default: Optional[float] = None) -> Optional[float]:
+    """The measurement's own timestamp: envelope field, else the
+    payload's, else ``default`` (callers pass file mtime)."""
+    for source in (doc, artifact_payload(doc)):
+        ts = source.get("timestamp")
+        if isinstance(ts, (int, float)):
+            return float(ts)
+    return default
+
+
+def is_confirmed(doc: Dict[str, Any]) -> bool:
+    """Does this document carry confirmed ON-DEVICE evidence?
+
+    New schema: ``confirmed_on_device`` and not ``carried_forward``
+    (a carried-forward copy must never become its own source — that
+    would let stale evidence self-launder forward forever) and a
+    nonzero headline value.  Legacy flat files: a complete real-chip
+    run — ``platform == "tpu"``, no ``partial`` marker, nonzero
+    ``value`` (the exact rule ``bench.py`` has always applied)."""
+    if not isinstance(doc, dict):
+        return False
+    payload = artifact_payload(doc)
+    if _is_envelope(doc):
+        return (bool(doc.get("confirmed_on_device"))
+                and not doc.get("carried_forward")
+                and bool(payload.get("value")))
+    return (payload.get("platform") == "tpu"
+            and "partial" not in payload
+            and not payload.get("carried_forward")
+            and bool(payload.get("value")))
+
+
+def latest_confirmed(directory: str, pattern: str = "BENCH_*.json") \
+        -> Optional[Tuple[str, Dict[str, Any]]]:
+    """The newest confirmed-on-device artifact under ``directory``
+    matching ``pattern``, as ``(path, document)`` — newest by the
+    measurement's own timestamp, falling back to file mtime for legacy
+    files.  Driver round wrappers (``BENCH_rNN.json`` carrying only a
+    command transcript) and corrupt files are skipped."""
+    best: Optional[Tuple[float, str, Dict[str, Any]]] = None
+    for path in _glob.glob(os.path.join(directory, pattern)):
+        doc = load_round_artifact(path)
+        if doc is None or not is_confirmed(doc):
+            continue
+        try:
+            mtime = os.path.getmtime(path)
+        except OSError:
+            mtime = 0.0
+        ts = artifact_timestamp(doc, mtime) or mtime
+        if best is None or ts > best[0]:
+            best = (ts, path, doc)
+    if best is None:
+        return None
+    return best[1], best[2]
+
+
+def carried_forward_result(doc: Dict[str, Any], path: str,
+                           note: Optional[str] = None) -> Dict[str, Any]:
+    """A publishable round result built from prior confirmed evidence:
+    the original measurements verbatim, plus ``carried_forward: true``,
+    the source file, and the ORIGINAL timestamp — so a wedged bench
+    window publishes real (clearly labeled) hardware numbers instead of
+    0.0, and nothing downstream can mistake them for a fresh run."""
+    out = dict(artifact_payload(doc))
+    out["carried_forward"] = True
+    out["carried_forward_from"] = os.path.basename(path)
+    ts = artifact_timestamp(doc)
+    if ts is None:
+        try:
+            ts = os.path.getmtime(path)
+        except OSError:
+            ts = None
+    if ts is not None:
+        out["original_timestamp"] = ts
+    if note:
+        out["carried_forward_note"] = note
+    out["schema_version"] = ROUND_ARTIFACT_VERSION
+    return out
+
+
+# Session phases worth promoting into the BENCH round record next to
+# the bench headline (VERDICT r05 item 4: real_jpeg_train has never
+# landed in a round artifact).
+_PROMOTED_SESSION_PHASES = (
+    "real_jpeg_train", "int8_infer", "generate", "resnet50_fused",
+    "resnet50_xla",
+)
+
+
+def promote_chip_session(session: Dict[str, Any], *,
+                         timestamp: float,
+                         out_dir: str,
+                         date: Optional[str] = None,
+                         git_rev: Optional[str] = None) -> Optional[str]:
+    """Promote a ``scripts/chip_session.py`` output dict into a BENCH
+    round record (``BENCH_measured_<date>.json`` in the RoundArtifact
+    schema) — but only when the session's bench phase is a confirmed
+    real-chip run; a CPU smoke or a partial must never shadow TPU
+    evidence.  Non-error secondary phases (``real_jpeg_train``,
+    ``int8_infer``, ...) ride along in the payload so device-fed
+    real-data numbers finally live in the round record instead of a
+    session-local file.  Returns the written path, or None when there
+    is nothing confirmable to promote."""
+    bench = session.get("bench")
+    if not isinstance(bench, dict) or not is_confirmed(bench):
+        return None
+    payload = dict(bench)
+    for tag in _PROMOTED_SESSION_PHASES:
+        extra = session.get(tag)
+        if isinstance(extra, dict) and "error" not in extra:
+            payload[tag] = extra
+    date = date or session.get("date") or "undated"
+    artifact = make_round_artifact(
+        payload, kind="bench", timestamp=timestamp,
+        device_kind=bench.get("device_kind"),
+        platform=bench.get("platform"),
+        confirmed_on_device=True,
+        source="scripts/chip_session.py",
+        git_rev=git_rev)
+    path = os.path.join(out_dir, f"BENCH_measured_{date}.json")
+    return write_round_artifact(path, artifact)
+
+
+def record_carried_forward_round() -> None:
+    """Count a carried-forward round publication (cold path; the
+    counter exists so a dashboard can see how often rounds run on
+    stale evidence)."""
+    try:
+        from bigdl_tpu.telemetry import families as _tm
+        _tm.bench_rounds_carried_forward_total().inc()
+    except Exception:  # pragma: no cover - never break the publisher
+        pass

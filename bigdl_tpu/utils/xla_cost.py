@@ -10,9 +10,9 @@ unwrapping lives in exactly one place.
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Dict, Optional
 
-__all__ = ["compiled_flops", "compiled_bytes"]
+__all__ = ["compiled_flops", "compiled_bytes", "cost_breakdown"]
 
 
 def _cost_dict(compiled) -> dict:
@@ -25,13 +25,12 @@ def _cost_dict(compiled) -> dict:
         return {}
 
 
-def _cost_value(compiled, key: str) -> Optional[float]:
-    """The analysis value for ``key``, or None when genuinely
-    unavailable.  Zero is a legitimate answer (a trivial compiled fn
-    really does execute 0 FLOPs) and is distinct from a missing key;
-    only absence, negatives (XLA's "don't know" sentinel), and
-    non-numeric entries report None."""
-    d = _cost_dict(compiled)
+def _value_of(d: dict, key: str) -> Optional[float]:
+    """The analysis value for ``key`` in an already-unwrapped cost
+    dict, or None when genuinely unavailable.  Zero is a legitimate
+    answer (a trivial compiled fn really does execute 0 FLOPs) and is
+    distinct from a missing key; only absence, negatives (XLA's "don't
+    know" sentinel), and non-numeric entries report None."""
     if key not in d:
         return None
     try:
@@ -39,6 +38,25 @@ def _cost_value(compiled, key: str) -> Optional[float]:
     except Exception:  # non-numeric entry: unavailable, not fatal
         return None
     return v if v >= 0 else None
+
+
+def _cost_value(compiled, key: str) -> Optional[float]:
+    return _value_of(_cost_dict(compiled), key)
+
+
+def cost_breakdown(compiled) -> Dict[str, Optional[float]]:
+    """``{"flops", "bytes", "transcendentals"}`` of an AOT-compiled
+    executable per invocation, in ONE ``cost_analysis()`` pass (the
+    analysis can be expensive on large programs; callers wanting more
+    than one number should not pay it per key).  Each entry follows the
+    same missing-vs-zero contract as :func:`compiled_flops`: 0.0 means
+    the compiler counted zero, None means it could not say."""
+    d = _cost_dict(compiled)
+    return {
+        "flops": _value_of(d, "flops"),
+        "bytes": _value_of(d, "bytes accessed"),
+        "transcendentals": _value_of(d, "transcendentals"),
+    }
 
 
 def compiled_flops(compiled) -> Optional[float]:

@@ -74,15 +74,16 @@ def build_step(model_fn, batch, size, window=10, unroll=1, xs_bf16=False,
     t0 = time.monotonic()
     compiled = jitted.lower(params_tree, rest, opt_state, xs, ys).compile()
     compile_s = time.monotonic() - t0
-    from bigdl_tpu.utils.xla_cost import compiled_flops
-    flops = compiled_flops(compiled) or -1.0
-    return compiled, (params_tree, rest, opt_state, xs, ys), compile_s, flops
+    from bigdl_tpu.utils.xla_cost import cost_breakdown
+    cost = cost_breakdown(compiled)
+    return compiled, (params_tree, rest, opt_state, xs, ys), compile_s, cost
 
 
 def time_step(name, model_fn, batch=128, size=224, window=10, reps=3,
               **kw):
-    compiled, state, compile_s, flops = build_step(model_fn, batch, size,
-                                                   window, **kw)
+    compiled, state, compile_s, cost = build_step(model_fn, batch, size,
+                                                  window, **kw)
+    flops = cost["flops"] or -1.0
     params, rest, opt_state, xs, ys = state
     # warmup
     params, rest, opt_state, losses = compiled(params, rest, opt_state,
@@ -98,6 +99,27 @@ def time_step(name, model_fn, batch=128, size=224, window=10, reps=3,
           f"compile {compile_s:5.1f}s  loss {l0:.3f}->{lf:.3f}  "
           f"flops/step {flops / window / 1e12 if flops > 0 else -1:.3f}T",
           flush=True)
+    # bytes/step + the compute-vs-HBM boundedness of the program on
+    # THIS device, from the same one-pass XLA cost analysis the
+    # attribution layer uses — an A/B variant is judged by whether it
+    # cut the binding resource, not just its ms
+    by = cost["bytes"]
+    if by:
+        import jax
+        from bigdl_tpu.telemetry import perf as perf_attr
+        kind = getattr(jax.devices()[0], "device_kind", "")
+        roof = perf_attr.roofline_verdict(
+            (flops / window) if flops > 0 else None, by / window,
+            perf_attr.device_peak_flops(kind),
+            perf_attr.device_hbm_bytes_per_s(kind))
+        intensity = (roof or {}).get("arithmetic_intensity_flops_per_byte")
+        print(f"[{name}] {by / window / 1e9:7.2f} GB/step"
+              + (f"  {intensity:6.1f} flop/byte" if intensity else "")
+              + (f"  verdict {roof['verdict']}"
+                 if roof and roof.get("verdict") else "")
+              + (f"  attainable {roof['attainable_step_s'] * 1e3:.2f} ms"
+                 if roof and roof.get("verdict") else ""),
+              flush=True)
     return dt
 
 

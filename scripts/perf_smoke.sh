@@ -1,0 +1,100 @@
+#!/usr/bin/env bash
+# Perf-attribution smoke (CPU only), locking the two acceptance
+# behaviors of the perf layer (docs/performance.md "Attributing an MFU
+# gap"):
+#
+#   1. a short Optimizer.optimize() loop emits a step-time attribution
+#      table whose measured phases + residual sum to the measured wall
+#      step time (exact invariant, overlap-aware) with a non-negative
+#      residual, and the step_phase_seconds/step_unattributed_fraction
+#      families carry real observations;
+#   2. bench.py with a FORCED backend-probe failure exits 0 publishing
+#      the latest confirmed on-device artifact marked
+#      carried_forward: true with its original timestamp — never a 0.0
+#      round;
+#   3. the new metric families pass scripts/metrics_lint.py (fatal
+#      form).
+#
+# Standalone: exits non-zero on any failed assertion.
+# scripts/tier1.sh runs it warn-only after the suite.
+set -o pipefail
+cd "$(dirname "$0")/.."
+
+# ---- 1. attribution table from a real optimize loop ---------------------
+env JAX_PLATFORMS=cpu python - <<'PY' || exit 1
+import numpy as np
+
+from bigdl_tpu import nn, telemetry
+from bigdl_tpu.dataset import DataSet, SampleToMiniBatch
+from bigdl_tpu.dataset.dataset import Sample
+from bigdl_tpu.optim import Optimizer, Trigger
+from bigdl_tpu.telemetry import families, perf
+from bigdl_tpu.utils import set_seed
+
+telemetry.enable()
+telemetry.reset()
+set_seed(7)
+
+rng = np.random.default_rng(0)
+samples = [Sample(rng.normal(size=(6,)).astype(np.float32),
+                  int(rng.integers(1, 5))) for _ in range(32)]
+model = nn.Sequential(nn.Linear(6, 8), nn.ReLU(), nn.Linear(8, 4),
+                      nn.LogSoftMax())
+data = DataSet.array(samples).transform(SampleToMiniBatch(16))
+opt = (Optimizer(model, data, nn.ClassNLLCriterion())
+       .set_end_when(Trigger.max_epoch(5)))
+opt.optimize()
+
+assert opt.window_records, "no window records captured"
+rep = perf.attribution_report(opt.window_records)
+assert rep is not None, "no attribution table"
+# the acceptance invariant: phases + residual sum to measured wall
+total = sum(rep["phases_s"].values()) + rep["residual_s"] - rep["overlap_s"]
+assert abs(total - rep["wall_step_s"]) <= 1e-9 * max(rep["wall_step_s"], 1.0), \
+    f"phases do not sum to wall: {rep}"
+assert rep["residual_s"] >= 0.0, rep
+assert set(rep["phases_s"]) == set(perf.PHASES), rep
+assert 0.0 <= rep["unattributed_fraction"] <= 1.0, rep
+
+for phase in perf.PHASES:
+    snap = families.step_phase_seconds().labels(phase).snapshot()
+    assert snap["count"] == len(opt.window_records), (phase, snap)
+
+st = opt.statusz()
+assert st["perf"] and st["perf"]["attribution"], "statusz perf missing"
+print("perf_smoke[1]: attribution OK "
+      f"(wall {rep['wall_step_s'] * 1e3:.2f} ms/step, dominant "
+      f"{rep['dominant_phase']}, residual {rep['residual_s'] * 1e3:.2f} ms, "
+      f"{rep['windows']} windows)")
+PY
+
+# ---- 2. bench.py forced probe failure -> carried-forward, exit 0 --------
+out=$(mktemp /tmp/perf_smoke_bench.XXXXXX.json)
+env JAX_PLATFORMS=cpu BIGDL_TPU_BENCH_FORCE_PROBE_FAIL=1 \
+    BIGDL_TPU_BENCH_BUDGET_S=120 \
+    python bench.py >"$out" 2>/dev/null
+rc=$?
+if [ $rc -ne 0 ]; then
+  echo "perf_smoke: bench.py exited $rc under forced probe failure"
+  exit 1
+fi
+env BENCH_OUT="$out" python - <<'PY' || exit 1
+import json
+import os
+
+with open(os.environ["BENCH_OUT"]) as f:
+    line = f.read().strip().splitlines()[-1]
+result = json.loads(line)
+assert result.get("carried_forward") is True, result
+assert result.get("value"), f"carried-forward round published 0.0: {result}"
+assert result.get("carried_forward_from"), result
+assert result.get("original_timestamp"), result
+print("perf_smoke[2]: carried-forward OK "
+      f"(value {result['value']} from {result['carried_forward_from']})")
+PY
+rm -f "$out"
+
+# ---- 3. new families pass the fatal metrics lint ------------------------
+python scripts/metrics_lint.py || exit 1
+
+echo "perf_smoke: OK (attribution invariant, carried-forward bench, lint)"
