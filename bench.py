@@ -386,6 +386,14 @@ def _build_step(on_tpu: bool, batch: int, size: int, fused: bool = False):
     by = compiled_bytes(compiled)
     if by:
         _update(**{pfx + "bytes_per_step": by})
+    # inter-chip payload of the compiled step (the HLO's collective
+    # outputs; 0.0 on a single-device program) — the comm budget the
+    # mesh-observability layer cross-checks and the comm-bound roofline
+    # verdict consumes
+    from bigdl_tpu.utils.xla_cost import collective_hlo_bytes
+    comm = collective_hlo_bytes(compiled)
+    if comm is not None:
+        _update(**{pfx + "comm_bytes_per_step": comm["total"]})
     return compiled, (params_tree, rest, opt_state, x, y), (x_np, y_np)
 
 
@@ -726,7 +734,9 @@ def _build_attribution():
                         or RESULT.get("bytes_per_step")),
         peak_spec_flops=RESULT.get("peak_spec_flops"),
         peak_measured_flops=RESULT.get("peak_measured_flops"),
-        device_kind=RESULT.get("device_kind"))
+        device_kind=RESULT.get("device_kind"),
+        comm_bytes_per_step=(RESULT.get(pfx + "comm_bytes_per_step")
+                             or RESULT.get("comm_bytes_per_step")))
 
 
 def _refresh_attribution():

@@ -36,6 +36,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from bigdl_tpu.core.module import Module, ModuleList, Parameter
+from bigdl_tpu.telemetry import collectives as _coll
 from bigdl_tpu.nn.linear import Linear
 from bigdl_tpu.utils.rng import next_key
 
@@ -225,7 +226,7 @@ class MoE(Module):
                                    x_loc.astype(jnp.float32))  # [E, C, H]
             expert_in = expert_in.astype(x_loc.dtype)
             # ship each device its local experts' slots from everyone
-            recv = jax.lax.all_to_all(expert_in, axis, split_axis=0,
+            recv = _coll.all_to_all(expert_in, axis, split_axis=0,
                                       concat_axis=1, tiled=True)
             # recv [E/n, n*C, H]
             LAST_A2A_SHAPES.update(
@@ -233,13 +234,13 @@ class MoE(Module):
                 recv=recv.shape)
             outs = jax.vmap(lambda tree, xe: tree(xe),
                             in_axes=(0, 0))(stacked_local, recv)
-            back = jax.lax.all_to_all(outs, axis, split_axis=1,
+            back = _coll.all_to_all(outs, axis, split_axis=1,
                                       concat_axis=0, tiled=True)
             # back [E, C, H]
             y = jnp.einsum("sec,ech->sh", combine,
                            back.astype(jnp.float32))
             return (y.astype(x_loc.dtype),
-                    jax.lax.pmean(drop, axis))
+                    _coll.pmean(drop, axis))
 
         fn = jax.shard_map(
             shard_fn, mesh=mesh,
@@ -264,7 +265,7 @@ class MoE(Module):
             w_local = jax.lax.dynamic_slice_in_dim(
                 w_rep, me * e_local, e_local, axis=2)
             part = jnp.einsum("ebth,bte->bth", outs, w_local)
-            return jax.lax.psum(part, axis)
+            return _coll.psum(part, axis)
 
         fn = jax.shard_map(
             shard_fn, mesh=mesh,

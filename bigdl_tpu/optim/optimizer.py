@@ -91,6 +91,15 @@ def _is_transient(e: BaseException) -> bool:
     return not isinstance(e, _NON_RETRYABLE)
 
 
+def _is_oom(e: BaseException) -> bool:
+    """Does this exception look like a device allocation failure?
+    XLA/PJRT surface HBM exhaustion as an XlaRuntimeError whose status
+    is RESOURCE_EXHAUSTED (message also carries "Out of memory"); the
+    chaos seam (BIGDL_TPU_CHAOS_OOM) fakes the same token."""
+    msg = str(e)
+    return "RESOURCE_EXHAUSTED" in msg or "Out of memory" in msg
+
+
 __all__ = ["Optimizer"]
 
 
@@ -169,6 +178,9 @@ class Optimizer:
         self.watchdog: Optional[HealthWatchdog] = None
         self.watchdog_halted = False
         self._halt_requested = False
+        # fleet telemetry (telemetry.fleet): OFF by default — an
+        # unarmed run performs no allgather and pays nothing new
+        self._fleet_monitor = None
         self.debug_host: Optional[str] = None
         self.debug_port: Optional[int] = None
         self.debug_server = None
@@ -340,6 +352,31 @@ class Optimizer:
                 f"silently ignored: {sorted(kwargs)})")
         self.watchdog = (watchdog if watchdog is not None
                          else HealthWatchdog(**kwargs))
+        return self
+
+    def set_fleet_monitor(self, monitor=None, **kwargs) -> "Optimizer":
+        """Arm cross-process fleet telemetry: once per readback window
+        every process contributes a fixed-shape stats vector (step
+        wall, data-wait, RSS, HBM in use) via one allgather; the
+        derived table — per-host numbers, slowest host, skew ratio —
+        serves on ``/statusz`` under ``fleet`` and publishes the
+        ``fleet_step_skew`` gauge.  With a health watchdog armed too,
+        each sample feeds its ``straggler`` anomaly class (warn by
+        default; see :class:`bigdl_tpu.telemetry.fleet.FleetMonitor`
+        and docs/observability.md).
+
+        Pass a configured monitor OR constructor kwargs, never both
+        (same contract as ``set_health_watchdog``).  In a multi-process
+        run EVERY process must arm it — the per-window allgather is a
+        collective.  Disarm with ``self._fleet_monitor = None``."""
+        from bigdl_tpu.telemetry.fleet import FleetMonitor
+        if monitor is not None and kwargs:
+            raise ValueError(
+                "set_fleet_monitor: pass a configured FleetMonitor OR "
+                "constructor kwargs, not both (the kwargs would be "
+                f"silently ignored: {sorted(kwargs)})")
+        self._fleet_monitor = (monitor if monitor is not None
+                               else FleetMonitor(**kwargs))
         return self
 
     def set_device_prefetch(self, n_ahead: int = 1) -> "Optimizer":
@@ -781,6 +818,11 @@ class Optimizer:
             out["perf"] = None
         if self.watchdog is not None:
             out["watchdog"] = self.watchdog.state()
+        if self._fleet_monitor is not None:
+            try:
+                out["fleet"] = self._fleet_monitor.status()
+            except Exception:  # pragma: no cover - best effort
+                out["fleet"] = None
         return out
 
     def _start_debug_server(self) -> None:
@@ -824,6 +866,19 @@ class Optimizer:
         if wd.halt_requested:
             self._halt_requested = True
 
+    def _postmortem_artifact_path(self, filename: str) -> str:
+        """``<checkpoint dir>/<filename>`` — THE location of postmortem
+        artifacts (flight recorder, OOM forensics), resolved once so
+        the two can never land in different places.  Local dirs are
+        created; remote (fsspec) roots pass through for ``open_file``.
+        Caller guarantees ``checkpoint_path`` is set."""
+        from bigdl_tpu.utils.file import is_remote_path, strip_file_scheme
+        root = strip_file_scheme(self.checkpoint_path)
+        if is_remote_path(root):
+            return root.rstrip("/") + "/" + filename
+        os.makedirs(root, exist_ok=True)
+        return os.path.join(root, filename)
+
     def _dump_flight_recorder(self, reason: str,
                               error: Optional[BaseException] = None) \
             -> Optional[str]:
@@ -840,22 +895,14 @@ class Optimizer:
                          "flight-recorder dump")
             return None
         try:
-            from bigdl_tpu.utils.file import (
-                _is_primary_process, is_remote_path, open_file,
-                strip_file_scheme,
-            )
+            from bigdl_tpu.utils.file import _is_primary_process, open_file
             if not _is_primary_process():
                 return None
             _te.record_event(
                 "flight_recorder_dump", reason=reason,
                 **({"error": f"{type(error).__name__}: {error}"}
                    if error is not None else {}))
-            root = strip_file_scheme(self.checkpoint_path)
-            if is_remote_path(root):
-                path = root.rstrip("/") + "/flight_recorder.json"
-            else:
-                os.makedirs(root, exist_ok=True)
-                path = os.path.join(root, "flight_recorder.json")
+            path = self._postmortem_artifact_path("flight_recorder.json")
             # dumps_events is THE wire format — same serializer as
             # events.dump_events, just routed through open_file so
             # fsspec checkpoint stores get the dump too
@@ -866,6 +913,47 @@ class Optimizer:
             return path
         except Exception:
             logger.exception("flight-recorder dump failed")
+            return None
+
+    def _dump_oom_forensics(self, error: BaseException) \
+            -> Optional[str]:
+        """RESOURCE_EXHAUSTED postmortem: record the ``oom`` flight-
+        recorder event (every process — each ring is its own) and, on
+        the primary process with a checkpoint path configured, write
+        ``oom_forensics.json`` — device memory_stats, HBM peak
+        watermarks, a live-array census, the last attribution window —
+        beside the flight recorder.  Best effort; the expensive report
+        (live-array enumeration at peak memory pressure) is built ONLY
+        where it will actually be written."""
+        try:
+            _te.record_event(
+                "oom", error=f"{type(error).__name__}: "
+                f"{str(error)[:500]}",
+                iteration=self.state.get("neval"),
+                epoch=self.state.get("epoch"))
+            from bigdl_tpu.utils.file import _is_primary_process, open_file
+            if not _is_primary_process():
+                return None
+            if not self.checkpoint_path:
+                logger.warning(
+                    "OOM detected but no checkpoint path is configured; "
+                    "forensics report not written (nowhere durable)")
+                return None
+            from bigdl_tpu.telemetry.runtime import oom_forensics_report
+            last = (self.window_records[-1]
+                    if getattr(self, "window_records", None) else None)
+            report = oom_forensics_report(
+                error=f"{type(error).__name__}: {error}",
+                last_window=last)
+            path = self._postmortem_artifact_path("oom_forensics.json")
+            import json as _json
+            with open_file(path, "wb") as f:
+                f.write(_json.dumps(report, default=str,
+                                    indent=2).encode("utf-8"))
+            logger.warning("OOM forensics dumped to %s", path)
+            return path
+        except Exception:  # pragma: no cover - must not mask the OOM
+            logger.exception("OOM forensics dump failed")
             return None
 
     # ---- input-pipeline state (bigdl_tpu.data) ---------------------------
@@ -983,6 +1071,11 @@ class Optimizer:
                     self._stop_device_prefetch()
                     self._stop_flush_worker()
                     self._flush_summaries()  # keep the failed tail
+                    if _is_oom(e):
+                        # the most common hard-to-debug multi-chip
+                        # failure: capture what held the memory BEFORE
+                        # the retry (or the crash) tears it down
+                        self._dump_oom_forensics(e)
                     if not _is_transient(e):
                         logger.error(
                             "training failed with non-retryable %s: %s "
@@ -1072,6 +1165,9 @@ class Optimizer:
         mesh = self.mesh_config.build()
         model = self.model.train_mode()
         wd = self.watchdog
+        # attempt-start snapshot, same reasoning as ``wd``: a mid-run
+        # disarm must not crash a window already queued for readback
+        fm = self._fleet_monitor
         self._halt_requested = False
         if wd is not None:
             wd.start_run()  # fresh EWMA baselines for this attempt
@@ -1343,6 +1439,19 @@ class Optimizer:
                 wd.observe_window(window_dt, data_t, len(entries),
                                   step=entries[-1][0])
                 if wd.halt_requested:
+                    self._halt_requested = True
+            if fm is not None:
+                # fleet sample on the same window boundary (the window
+                # count is deterministic under SPMD lockstep, so the
+                # allgathers line up across processes); the straggler
+                # verdict rides the watchdog like every other anomaly
+                try:
+                    fm.contribute(window_dt, data_t, len(entries),
+                                  step=entries[-1][0], watchdog=wd)
+                except Exception:
+                    # a fleet hiccup must not kill the training loop
+                    logger.exception("fleet monitor sample failed")
+                if wd is not None and wd.halt_requested:
                     self._halt_requested = True
             if telemetry.enabled():
                 # the honest per-iteration device time (same number the

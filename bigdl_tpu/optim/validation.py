@@ -194,12 +194,15 @@ def aggregate_across_processes(results):
                 "merged across processes by summing counts; evaluate it "
                 "on a replicated (non-sharded) validation dataset")
     from jax.experimental import multihost_utils
+    from bigdl_tpu.telemetry.collectives import account_host_collective
     # float64: counts above 2^24 (a 16.7M-sample val split) would round
     # in float32 and skew the score (jax downcasts the gather to f32
     # unless jax_enable_x64 is on — enable it for val splits that big)
     stats = np.asarray([[r.numerator, r.denominator] for r in results],
                        np.float64)
     gathered = np.asarray(multihost_utils.process_allgather(stats))
+    account_host_collective("process_allgather", "process",
+                            gathered.nbytes)
     total = gathered.reshape(-1, stats.shape[0], 2).sum(axis=0)
     return [ValidationResult(float(n), float(d), r.fmt)
             for r, (n, d) in zip(results, total)]

@@ -48,6 +48,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from bigdl_tpu.core.module import Module, ModuleList
+from bigdl_tpu.telemetry import collectives as _coll
 
 __all__ = ["gpipe", "one_f_one_b", "Pipeline"]
 
@@ -84,7 +85,7 @@ def _pipe_loop(stage_params, x_loc, stage_apply, axis_name: str):
         feed_idx = jnp.clip(t, 0, m_total - 1)
         mine = jax.lax.dynamic_index_in_dim(
             x_loc, feed_idx % chunk, 0, keepdims=False)
-        feed = jax.lax.psum(
+        feed = _coll.psum(
             jnp.where(me == feed_idx // chunk, mine, 0), axis_name)
         inp = jnp.where(me == 0, feed, carry)
         out = stage_apply(stage_params, inp, me)
@@ -92,7 +93,7 @@ def _pipe_loop(stage_params, x_loc, stage_apply, axis_name: str):
         # broadcast the same way and stored only by its home device
         emit_idx = jnp.clip(t - (s_total - 1), 0, m_total - 1)
         valid = t >= s_total - 1
-        y = jax.lax.psum(
+        y = _coll.psum(
             jnp.where(valid & (me == s_total - 1), out, 0), axis_name)
         hslot = emit_idx % chunk
         old = jax.lax.dynamic_index_in_dim(out_loc, hslot, 0,
@@ -100,7 +101,7 @@ def _pipe_loop(stage_params, x_loc, stage_apply, axis_name: str):
         upd = jnp.where(valid & (me == emit_idx // chunk), y, old)
         out_loc = jax.lax.dynamic_update_index_in_dim(
             out_loc, upd, hslot, 0)
-        carry = jax.lax.ppermute(out, axis_name, perm)
+        carry = _coll.ppermute(out, axis_name, perm)
         return carry, out_loc
 
     _, out_loc = jax.lax.fori_loop(0, ticks, tick, (carry0, out_loc0))
@@ -218,7 +219,7 @@ def _1f1b_loop(stage_params, x_loc, y_loc, stage_apply, loss_fn,
         feed_idx = jnp.clip(t, 0, m_total - 1)
         mine = jax.lax.dynamic_index_in_dim(
             x_loc, feed_idx % chunk, 0, keepdims=False)
-        feed = jax.lax.psum(
+        feed = _coll.psum(
             jnp.where((me == feed_idx // chunk) & (t < m_total),
                       mine, 0), axis_name)
         inp = jnp.where(me == 0, feed, carry_f)
@@ -237,7 +238,7 @@ def _1f1b_loop(stage_params, x_loc, y_loc, stage_apply, loss_fn,
         last_idx = jnp.clip(last_mb, 0, m_total - 1)
         y_mine = jax.lax.dynamic_index_in_dim(
             y_loc, last_idx % chunk, 0, keepdims=False)
-        y_feed = jax.lax.psum(
+        y_feed = _coll.psum(
             jnp.where(me == last_idx // chunk, y_mine, 0), axis_name)
         # at stage S-1, B(m) shares the tick with F(m): differentiate
         # the loss of THIS tick's forward output
@@ -264,7 +265,7 @@ def _1f1b_loop(stage_params, x_loc, y_loc, stage_apply, loss_fn,
         # the uniform STAGE-0 backward index
         dx_mb = t - 2 * (s_total - 1)
         dx_idx = jnp.clip(dx_mb, 0, m_total - 1)
-        dx_bcast = jax.lax.psum(
+        dx_bcast = _coll.psum(
             jnp.where(me == 0, dxi, 0), axis_name)
         hslot = dx_idx % chunk
         old_dx = jax.lax.dynamic_index_in_dim(dx_loc, hslot, 0,
@@ -274,14 +275,14 @@ def _1f1b_loop(stage_params, x_loc, y_loc, stage_apply, loss_fn,
                               & (me == dx_idx // chunk),
                               dx_bcast, old_dx), hslot, 0)
 
-        carry_f = jax.lax.ppermute(out_f, axis_name, perm_down)
-        carry_b = jax.lax.ppermute(dxi, axis_name, perm_up)
+        carry_f = _coll.ppermute(out_f, axis_name, perm_down)
+        carry_b = _coll.ppermute(dxi, axis_name, perm_up)
         return carry_f, carry_b, ring, grads, dx_loc, loss_sum
 
     _, _, _, grads, dx_loc, loss_sum = jax.lax.fori_loop(
         0, ticks, tick, (carry_f0, carry_b0, ring0, grads0, dx_loc0,
                          jnp.float32(0.0)))
-    return jax.lax.psum(loss_sum, axis_name), grads, dx_loc
+    return _coll.psum(loss_sum, axis_name), grads, dx_loc
 
 
 def one_f_one_b(stage_apply: Callable, loss_fn: Callable, stacked_params,

@@ -31,6 +31,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from bigdl_tpu.telemetry import collectives as _coll
+
 __all__ = ["ring_attention", "ring_self_attention",
            "RingSelfAttention"]
 
@@ -115,8 +117,8 @@ def _ring_xla(q, k, v, axis_name: str, causal: bool, scale: float,
             blk_bias = cb if blk_bias is None else blk_bias + cb
         acc, m, l = _block_attend(q, k_cur, v_cur, blk_bias, scale,
                                   acc, m, l)
-        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
-        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        k_nxt = _coll.ppermute(k_cur, axis_name, perm)
+        v_nxt = _coll.ppermute(v_cur, axis_name, perm)
         return acc, m, l, k_nxt, v_nxt
 
     acc, m, l, _, _ = jax.lax.fori_loop(
@@ -161,8 +163,8 @@ def _ring_flash_impl(q, k, v, cfg):
                 src <= me, attend, lambda ops: ops, (acc, m, l))
         else:
             acc, m, l = attend((acc, m, l))
-        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
-        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        k_nxt = _coll.ppermute(k_cur, axis_name, perm)
+        v_nxt = _coll.ppermute(v_cur, axis_name, perm)
         return acc, m, l, k_nxt, v_nxt
 
     acc, m, l, _, _ = jax.lax.fori_loop(
@@ -235,10 +237,10 @@ def _ring_flash_bwd(cfg, res, g):
         dv_cur = dv_cur + dv_c
         # the chunk and its accumulated gradient rotate together; after
         # n steps both are back on the chunk's home device
-        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
-        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
-        dk_nxt = jax.lax.ppermute(dk_cur, axis_name, perm)
-        dv_nxt = jax.lax.ppermute(dv_cur, axis_name, perm)
+        k_nxt = _coll.ppermute(k_cur, axis_name, perm)
+        v_nxt = _coll.ppermute(v_cur, axis_name, perm)
+        dk_nxt = _coll.ppermute(dk_cur, axis_name, perm)
+        dv_nxt = _coll.ppermute(dv_cur, axis_name, perm)
         return dq, k_nxt, v_nxt, dk_nxt, dv_nxt
 
     dq, _, _, dk, dv = jax.lax.fori_loop(

@@ -76,7 +76,14 @@ class Debugz:
             "telemetry_enabled": telemetry.enabled(),
             "spans": {"buffered": len(tracing.finished_spans()),
                       "dropped": tracing.dropped_spans()},
-            "events": {"counts": ev["counts"], "dropped": ev["dropped"],
+            # buffered/capacity/dropped up front: a full ring that has
+            # evicted history during an incident must be VISIBLE on the
+            # page, or the silent drops hide exactly the events the
+            # postmortem needed
+            "events": {"buffered": ev["buffered"],
+                       "capacity": ev["capacity"],
+                       "dropped": ev["dropped"],
+                       "counts": ev["counts"],
                        "recent": ev["recent"]},
         }
         if self.statusz_fn is not None:

@@ -38,8 +38,8 @@ from typing import Dict, List, Optional
 
 __all__ = ["record_event", "recent_events", "event_counts",
            "dropped_events", "reset_events", "set_event_capacity",
-           "events_summary", "events_dict", "dumps_events",
-           "dump_events", "json_safe"]
+           "event_capacity", "events_summary", "events_dict",
+           "dumps_events", "dump_events", "json_safe"]
 
 _DEFAULT_CAPACITY = 2048
 
@@ -143,6 +143,11 @@ def set_event_capacity(n: int) -> None:
         _buffer = deque(_buffer, maxlen=n)
 
 
+def event_capacity() -> int:
+    with _lock:
+        return _buffer.maxlen or 0
+
+
 def events_summary(recent_n: int = 50) -> Dict:
     """One coherent locked pass over the ring: buffered/dropped
     counters, per-kind counts, and the newest ``recent_n`` events —
@@ -153,12 +158,14 @@ def events_summary(recent_n: int = 50) -> Dict:
     with _lock:
         recs = list(_buffer)
         dropped = _dropped
+        capacity = _buffer.maxlen or 0
     counts: Dict[str, int] = {}
     for r in recs:
         counts[r.kind] = counts.get(r.kind, 0) + 1
     n = max(int(recent_n), 0)
     tail = recs[len(recs) - min(n, len(recs)):]
-    return {"buffered": len(recs), "dropped": dropped, "counts": counts,
+    return {"buffered": len(recs), "capacity": capacity,
+            "dropped": dropped, "counts": counts,
             "recent": [r.to_dict() for r in tail]}
 
 

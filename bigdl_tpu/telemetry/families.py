@@ -95,6 +95,34 @@ def bench_rounds_carried_forward_total() -> Counter:
         "unreachable at bench time")
 
 
+# ---- mesh observability: collectives + fleet -------------------------------
+
+def collective_bytes_total() -> Counter:
+    return get_registry().counter(
+        "collective_bytes_total",
+        "Per-device payload bytes of explicit collectives, accounted "
+        "at TRACE time per {op, axis} (one compiled step's comm "
+        "budget; see telemetry.collectives for the byte convention)",
+        labelnames=("op", "axis"))
+
+
+def collective_calls_total() -> Counter:
+    return get_registry().counter(
+        "collective_calls_total",
+        "Explicit collective call sites traced, per {op, axis} (one "
+        "count per site per trace — loop bodies count once, like the "
+        "compiled HLO)",
+        labelnames=("op", "axis"))
+
+
+def fleet_step_skew() -> Gauge:
+    return get_registry().gauge(
+        "fleet_step_skew",
+        "Slowest-host / median-host ratio over the latest fleet "
+        "sample (max of the step-wall and data-wait skews; 1.0 = a "
+        "balanced fleet, large = a straggler — see telemetry.fleet)")
+
+
 # ---- training health (watchdog) -------------------------------------------
 
 def training_nonfinite_total() -> Counter:
@@ -223,6 +251,15 @@ def device_memory_bytes_limit() -> Gauge:
         labelnames=("device",))
 
 
+def hbm_bytes_peak() -> Gauge:
+    return get_registry().gauge(
+        "hbm_bytes_peak",
+        "Peak accelerator memory in use per device: the backend's own "
+        "peak_bytes_in_use when memory_stats() provides it, else a "
+        "high-water mark over sampled bytes_in_use (telemetry.runtime)",
+        labelnames=("device",))
+
+
 # ---- serving bridge --------------------------------------------------------
 # The serving MetricsRegistry keeps its own lock-coherent snapshot (its
 # public schema is unchanged); this bridge mirrors that snapshot into
@@ -285,6 +322,8 @@ _PREREGISTER = (
     optimizer_validation_seconds, optimizer_retries_total,
     step_phase_seconds, step_mfu_vs_measured,
     step_unattributed_fraction, bench_rounds_carried_forward_total,
+    collective_bytes_total, collective_calls_total, fleet_step_skew,
+    hbm_bytes_peak,
     training_nonfinite_total, training_anomalies_total, grad_norm,
     checkpoint_commit_seconds, checkpoint_torn_generations_total,
     chaos_faults_injected_total,

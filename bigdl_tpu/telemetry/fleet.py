@@ -1,0 +1,301 @@
+"""Cross-process fleet telemetry: who is the slowest host in the mesh?
+
+Single-process observability (PR 3/4/6) answers "where does MY step's
+wall time go"; under SPMD lockstep the question that actually gates
+scale-out is different: **which host is holding the collective**.  A
+straggling host never shows up in its peers' profiles — their time
+appears as device_compute (blocked inside the psum) while the
+straggler's appears as data_wait — so the only way to see it is to
+compare per-host numbers side by side.  (The reference faced the same
+problem at 256 Spark nodes and solved it destructively by *dropping*
+stragglers, optim/DistriOptimizer.scala; SPMD cannot drop anyone, so it
+must *name* them instead.)
+
+Mechanics: once per readback window (rate-limited by
+``every_n_windows``) each process contributes one compact fixed-shape
+stats vector — step wall, data-wait, RSS, HBM in use — via a single
+``process_allgather``; every process derives the same table, so
+``/statusz`` on ANY host shows the whole fleet.  Two skews are derived:
+
+* ``step_skew`` — slowest / median-of-others per-host wall.  Catches
+  genuinely async fleets (per-host loops drifting apart).
+* ``wait_skew`` — slowest / median-of-others per-host data-wait, with
+  a floor of ``wait_floor_fraction`` of the median wall.  Catches the
+  lockstep-masked straggler: everyone's wall is identical, but one
+  host's wall is data-wait where the others' is collective wait.
+
+``skew = max(step_skew, wait_skew)`` publishes as the
+``fleet_step_skew`` gauge and, when a :class:`HealthWatchdog
+<bigdl_tpu.telemetry.health.HealthWatchdog>` is armed, feeds its
+``straggler`` anomaly class (warn policy by default).
+
+Processes that cannot join a collective (serving replicas, sidecars)
+use the file-based path instead: :func:`write_host_snapshot` drops a
+per-host JSON into a shared directory and :func:`merge_host_snapshots`
+builds the identical table from whatever is there — same derivation
+(:func:`fleet_table`), different transport.
+
+Everything is opt-in (``Optimizer.set_fleet_monitor``); an unarmed run
+performs no allgather and pays nothing new.
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import json
+import os
+import statistics
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from bigdl_tpu import telemetry
+from bigdl_tpu.telemetry import families as _fam
+
+__all__ = ["FleetMonitor", "host_stats", "fleet_table",
+           "write_host_snapshot", "merge_host_snapshots",
+           "FLEET_STAT_FIELDS"]
+
+# the fixed-shape per-host vector, in wire order — one float64 each
+FLEET_STAT_FIELDS = ("process", "time", "step_wall_s", "data_wait_s",
+                     "iterations", "rss_bytes", "hbm_bytes_in_use")
+
+_SNAPSHOT_PREFIX = "fleet_host_"
+
+
+def _local_hbm_in_use() -> float:
+    """Summed ``bytes_in_use`` over this process's devices, 0.0 where
+    the backend exposes no memory_stats (CPU) — missing-key→skip, the
+    runtime-sampler contract."""
+    try:
+        import jax
+        total = 0.0
+        for d in jax.local_devices():
+            try:
+                ms = d.memory_stats()
+            except Exception:
+                continue
+            if ms and "bytes_in_use" in ms:
+                total += float(ms["bytes_in_use"])
+        return total
+    except Exception:
+        return 0.0
+
+
+def host_stats(step_wall_s: float, data_wait_s: float,
+               iterations: int = 1,
+               process: Optional[int] = None) -> Dict[str, float]:
+    """One host's contribution: the window timings the caller measured
+    plus locally sampled RSS and HBM-in-use."""
+    from bigdl_tpu.telemetry.runtime import _rss_bytes
+    if process is None:
+        try:
+            import jax
+            process = jax.process_index()
+        except Exception:
+            process = 0
+    return {
+        "process": float(process),
+        "time": time.time(),
+        "step_wall_s": float(step_wall_s),
+        "data_wait_s": float(data_wait_s),
+        "iterations": float(max(int(iterations), 1)),
+        "rss_bytes": float(_rss_bytes() or 0.0),
+        "hbm_bytes_in_use": _local_hbm_in_use(),
+    }
+
+
+def _skew_of(values: List[float], floor: float) -> Tuple[float, int]:
+    """(slowest / median-of-the-others, argmax index).  The baseline
+    excludes the candidate straggler — with 2 hosts a plain median
+    would be dragged halfway toward the straggler and mask it — and is
+    floored so uniformly-tiny values can't produce a huge ratio out of
+    noise."""
+    i_max = max(range(len(values)), key=lambda i: values[i])
+    others = [v for i, v in enumerate(values) if i != i_max]
+    base = statistics.median(others) if others else values[i_max]
+    base = max(base, floor)
+    if base <= 0:
+        return 1.0, i_max
+    return values[i_max] / base, i_max
+
+
+def fleet_table(rows: List[Dict[str, Any]],
+                wait_floor_fraction: float = 0.05) -> Dict[str, Any]:
+    """Derive the fleet table from per-host stats dicts (from the
+    allgather OR merged snapshots — one derivation for both
+    transports).  Deterministic given the rows, so every process that
+    holds the same allgather result renders the identical table."""
+    hosts = sorted((dict(r) for r in rows),
+                   key=lambda r: int(r["process"]))
+    for h in hosts:
+        iters = max(h.get("iterations", 1.0), 1.0)
+        h["step_wall_per_iter_s"] = h["step_wall_s"] / iters
+        h["data_wait_per_iter_s"] = h["data_wait_s"] / iters
+        wall = max(h["step_wall_s"], 1e-12)
+        h["data_wait_fraction"] = min(h["data_wait_s"] / wall, 1.0)
+        h["process"] = int(h["process"])
+    walls = [h["step_wall_per_iter_s"] for h in hosts]
+    waits = [h["data_wait_per_iter_s"] for h in hosts]
+    med_wall = max(statistics.median(walls), 1e-12)
+    step_skew, i_wall = _skew_of(walls, floor=1e-12)
+    wait_skew, i_wait = _skew_of(
+        waits, floor=wait_floor_fraction * med_wall)
+    if wait_skew >= step_skew:
+        skew, slowest = wait_skew, hosts[i_wait]["process"]
+    else:
+        skew, slowest = step_skew, hosts[i_wall]["process"]
+    return {
+        "processes": len(hosts),
+        "hosts": hosts,
+        "median_step_wall_s": med_wall,
+        "step_skew": step_skew,
+        "wait_skew": wait_skew,
+        "skew": skew,
+        "slowest_process": slowest,
+    }
+
+
+# ---------------------------------------------------------------------------
+# file-based transport (processes that can't share a collective)
+# ---------------------------------------------------------------------------
+
+def write_host_snapshot(directory: str,
+                        stats: Dict[str, Any]) -> str:
+    """Atomically drop one host's stats as
+    ``fleet_host_<process>.json`` under ``directory`` (tmp+rename: a
+    merger must never read a torn write)."""
+    os.makedirs(directory, exist_ok=True)
+    pid = int(stats["process"])
+    path = os.path.join(directory, f"{_SNAPSHOT_PREFIX}{pid}.json")
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(stats, f)
+    os.replace(tmp, path)
+    return path
+
+
+def merge_host_snapshots(directory: str,
+                         max_age_s: Optional[float] = None) \
+        -> Optional[Dict[str, Any]]:
+    """The fleet table from whatever per-host snapshots are on disk
+    (corrupt files skipped; ``max_age_s`` drops hosts that stopped
+    reporting — a dead replica should vanish from the table, not
+    freeze it).  None when no usable snapshot exists."""
+    rows: List[Dict[str, Any]] = []
+    now = time.time()
+    for path in sorted(_glob.glob(
+            os.path.join(directory, _SNAPSHOT_PREFIX + "*.json"))):
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                row = json.load(f)
+            float(row["process"])
+            float(row["step_wall_s"])
+        except Exception:
+            continue
+        if max_age_s is not None \
+                and now - float(row.get("time", now)) > max_age_s:
+            continue
+        rows.append(row)
+    if not rows:
+        return None
+    return fleet_table(rows)
+
+
+# ---------------------------------------------------------------------------
+# the collective transport + the monitor the optimizer arms
+# ---------------------------------------------------------------------------
+
+class FleetMonitor:
+    """Per-window fleet aggregation.  ``contribute()`` is called by the
+    optimizer's readback path with each flushed window's (wall,
+    data-wait, iterations); every ``every_n_windows``-th call performs
+    the allgather, derives the table, publishes the skew gauge, feeds
+    the watchdog's ``straggler`` class, and (when ``snapshot_dir`` is
+    set) drops this host's file snapshot for collective-less peers.
+
+    In a multi-process run every process must contribute at the same
+    window boundaries (the allgather is a collective); the optimizer's
+    windows are deterministic under SPMD lockstep, which is exactly
+    why the cadence is per-window and not per-wall-clock."""
+
+    def __init__(self, every_n_windows: int = 1,
+                 snapshot_dir: Optional[str] = None,
+                 wait_floor_fraction: float = 0.05):
+        self.every_n_windows = max(int(every_n_windows), 1)
+        self.snapshot_dir = snapshot_dir
+        self.wait_floor_fraction = float(wait_floor_fraction)
+        self._lock = threading.Lock()
+        self._windows_seen = 0
+        self.samples = 0
+        self.last_table: Optional[Dict[str, Any]] = None
+        self.last_stats: Optional[Dict[str, Any]] = None
+
+    def contribute(self, step_wall_s: float, data_wait_s: float,
+                   iterations: int = 1, step: Optional[int] = None,
+                   watchdog=None) -> Optional[Dict[str, Any]]:
+        """One window's contribution; returns the fleet table on
+        sampling windows, None on rate-limited ones."""
+        with self._lock:
+            self._windows_seen += 1
+            if self._windows_seen % self.every_n_windows:
+                return None
+        stats = host_stats(step_wall_s, data_wait_s, iterations)
+        table = self._aggregate(stats)
+        with self._lock:
+            self.samples += 1
+            self.last_stats = stats
+            self.last_table = table
+        if self.snapshot_dir:
+            try:
+                write_host_snapshot(self.snapshot_dir, stats)
+            except Exception:  # pragma: no cover - transport best effort
+                pass
+        if telemetry.enabled():
+            try:
+                _fam.fleet_step_skew().set(table["skew"])
+            except Exception:  # pragma: no cover
+                pass
+        if watchdog is not None:
+            watchdog.observe_fleet(
+                -1 if step is None else int(step), table["skew"],
+                table["slowest_process"],
+                f"{table['processes']} host(s), step_skew "
+                f"{table['step_skew']:.2f}, wait_skew "
+                f"{table['wait_skew']:.2f}")
+        return table
+
+    def _aggregate(self, stats: Dict[str, Any]) -> Dict[str, Any]:
+        """One allgather of the fixed-shape vector; single-process this
+        degenerates to a reshape (no distributed runtime touched)."""
+        import numpy as np
+        vec = np.asarray([stats[k] for k in FLEET_STAT_FIELDS],
+                         np.float64)
+        import jax
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            from bigdl_tpu.telemetry.collectives import (
+                account_host_collective,
+            )
+            gathered = np.asarray(
+                multihost_utils.process_allgather(vec))
+            gathered = gathered.reshape(-1, len(FLEET_STAT_FIELDS))
+            account_host_collective("process_allgather", "process",
+                                    gathered.nbytes)
+        else:
+            gathered = vec.reshape(1, -1)
+        rows = [dict(zip(FLEET_STAT_FIELDS, row)) for row in gathered]
+        return fleet_table(rows, self.wait_floor_fraction)
+
+    def status(self) -> Optional[Dict[str, Any]]:
+        """The ``fleet`` section of ``/statusz``: the latest table plus
+        sampling counters (None until the first sample)."""
+        with self._lock:
+            if self.last_table is None:
+                return {"samples": 0, "windows_seen": self._windows_seen,
+                        "every_n_windows": self.every_n_windows}
+            out = dict(self.last_table)
+            out["samples"] = self.samples
+            out["windows_seen"] = self._windows_seen
+            out["every_n_windows"] = self.every_n_windows
+            return out

@@ -66,6 +66,7 @@ ANOMALY_CLASSES = {
     "loss_spike": ("loss_spike",),
     "step_time_outlier": ("step_time_outlier",),
     "data_starvation": ("data_starvation",),
+    "straggler": ("straggler",),
 }
 
 
@@ -131,6 +132,7 @@ class HealthWatchdog:
                  loss_spike: str = "warn",
                  step_time_outlier: str = "warn",
                  data_starvation: str = "warn",
+                 straggler: str = "warn",
                  ewma_alpha: float = 0.1,
                  spike_factor: float = 10.0,
                  spike_grace_steps: int = 10,
@@ -138,10 +140,12 @@ class HealthWatchdog:
                  step_time_grace_windows: int = 5,
                  starvation_fraction: float = 0.6,
                  starvation_windows: int = 16,
+                 straggler_ratio: float = 2.0,
                  max_history: int = 64):
         policies = {"nonfinite": nonfinite, "loss_spike": loss_spike,
                     "step_time_outlier": step_time_outlier,
-                    "data_starvation": data_starvation}
+                    "data_starvation": data_starvation,
+                    "straggler": straggler}
         for cls, pol in policies.items():
             if pol not in POLICIES:
                 raise ValueError(
@@ -163,6 +167,7 @@ class HealthWatchdog:
         self.step_time_grace_windows = int(step_time_grace_windows)
         self.starvation_fraction = float(starvation_fraction)
         self.starvation_windows = int(starvation_windows)
+        self.straggler_ratio = float(straggler_ratio)
         self._lock = threading.Lock()
         self.history: deque = deque(maxlen=int(max_history))
         self.counts: Dict[str, int] = {}
@@ -262,6 +267,23 @@ class HealthWatchdog:
                     f"the last {len(self._data_win)} windows' wall time "
                     f"was spent waiting on data"))
                 self._data_win.clear()  # don't re-fire every step
+        return verdicts
+
+    def observe_fleet(self, step: int, skew: float,
+                      slowest_process: int,
+                      detail: str = "") -> List[Verdict]:
+        """Judge one fleet sample from :class:`telemetry.fleet
+        .FleetMonitor`: the slowest-host/median ratio against
+        ``straggler_ratio``.  Unlike the EWMA classes there is no
+        baseline to learn — skew 1.0 is the definition of balanced, so
+        the threshold is absolute."""
+        verdicts: List[Verdict] = []
+        if math.isfinite(skew) and skew >= self.straggler_ratio:
+            verdicts.append(self._verdict(
+                "straggler", self.policies["straggler"], step, skew,
+                f"process {slowest_process} is a straggler: fleet skew "
+                f"{skew:.2f}x >= {self.straggler_ratio:.2f}x"
+                + (f" ({detail})" if detail else "")))
         return verdicts
 
     # ---- verdicts ---------------------------------------------------------

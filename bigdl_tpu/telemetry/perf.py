@@ -97,6 +97,17 @@ _HBM_BYTES_PER_S = (
     ("v5litepod", 819e9), ("v4", 1228e9), ("v3", 900e9), ("v2", 700e9),
 )
 
+# Aggregate per-chip ICI bandwidth (bytes/s) by device_kind substring —
+# the denominator of the comm-bound verdict: the floor a step's
+# inter-chip payload (collective_bytes_total / collective_hlo_bytes)
+# puts under its time.  Public interconnect figures, converted from the
+# advertised per-chip link Gb/s; treat as rooflines, not guarantees
+# (real ring/torus schedules land below them).
+_ICI_BYTES_PER_S = (
+    ("v6", 448e9), ("v5p", 600e9), ("v5e", 200e9), ("v5 lite", 200e9),
+    ("v5litepod", 200e9), ("v4", 300e9), ("v3", 82e9), ("v2", 62e9),
+)
+
 
 def _lookup(table, device_kind: Optional[str]) -> Optional[float]:
     kind = (device_kind or "").lower()
@@ -116,6 +127,12 @@ def device_hbm_bytes_per_s(device_kind: Optional[str]) -> Optional[float]:
     """Public HBM bandwidth (bytes/s) for a ``device_kind`` string, or
     None when unknown."""
     return _lookup(_HBM_BYTES_PER_S, device_kind)
+
+
+def device_ici_bytes_per_s(device_kind: Optional[str]) -> Optional[float]:
+    """Aggregate per-chip ICI bandwidth (bytes/s) for a ``device_kind``
+    string, or None when unknown."""
+    return _lookup(_ICI_BYTES_PER_S, device_kind)
 
 
 # ---------------------------------------------------------------------------
@@ -183,30 +200,40 @@ def attribute_windows(records: List[Dict[str, Any]],
 def roofline_verdict(flops_per_step: Optional[float],
                      bytes_per_step: Optional[float],
                      peak_flops: Optional[float],
-                     hbm_bytes_per_s: Optional[float]) \
+                     hbm_bytes_per_s: Optional[float],
+                     comm_bytes_per_step: Optional[float] = None,
+                     ici_bytes_per_s: Optional[float] = None) \
         -> Optional[Dict[str, Any]]:
-    """Compute-bound vs HBM-bound from the analytic cost model: the
-    step's minimum time on the MXU (flops/peak) against its minimum
-    time on the memory system (bytes/bandwidth).  The larger floor is
-    the binding resource; ``attainable_step_s`` is the best step time
-    this program can reach on this device no matter how well scheduled.
-    Returns None when neither floor is computable."""
+    """Compute-bound vs HBM-bound vs comm-bound from the analytic cost
+    model: the step's minimum time on the MXU (flops/peak) against its
+    minimum time on the memory system (bytes/bandwidth) and — when a
+    comm budget is known (``collective_hlo_bytes`` /
+    ``collective_bytes_total``) — on the interconnect
+    (comm bytes/ICI bandwidth).  The largest floor is the binding
+    resource; ``attainable_step_s`` is the best step time this program
+    can reach on this device no matter how well scheduled.  Returns
+    None when no floor is computable; ``verdict`` is None with fewer
+    than two floors (nothing to compare)."""
     t_compute = (flops_per_step / peak_flops
                  if flops_per_step and peak_flops else None)
     t_hbm = (bytes_per_step / hbm_bytes_per_s
              if bytes_per_step and hbm_bytes_per_s else None)
-    if t_compute is None and t_hbm is None:
+    t_comm = (comm_bytes_per_step / ici_bytes_per_s
+              if comm_bytes_per_step and ici_bytes_per_s else None)
+    floors = {"compute_bound": t_compute, "hbm_bound": t_hbm,
+              "comm_bound": t_comm}
+    known = {k: v for k, v in floors.items() if v is not None}
+    if not known:
         return None
-    verdict = None
-    if t_compute is not None and t_hbm is not None:
-        verdict = "hbm_bound" if t_hbm > t_compute else "compute_bound"
+    verdict = (max(known, key=known.get) if len(known) > 1 else None)
     out: Dict[str, Any] = {
         "verdict": verdict,
         "min_compute_s": t_compute,
         "min_hbm_s": t_hbm,
-        "attainable_step_s": max(t for t in (t_compute, t_hbm)
-                                 if t is not None),
+        "attainable_step_s": max(known.values()),
     }
+    if t_comm is not None:
+        out["min_comm_s"] = t_comm
     if flops_per_step and bytes_per_step:
         out["arithmetic_intensity_flops_per_byte"] = (
             flops_per_step / bytes_per_step)
@@ -223,7 +250,10 @@ def attribution_report(records: List[Dict[str, Any]],
                        peak_measured_flops: Optional[float] = None,
                        hbm_bytes_per_s: Optional[float] = None,
                        device_kind: Optional[str] = None,
-                       skip_first: int = 1) -> Optional[Dict[str, Any]]:
+                       skip_first: int = 1,
+                       comm_bytes_per_step: Optional[float] = None,
+                       ici_bytes_per_s: Optional[float] = None) \
+        -> Optional[Dict[str, Any]]:
     """The full perf-attribution table: phase decomposition + MFU
     accounting + roofline verdict, as one JSON-able dict (what
     ``bench.py`` embeds in ``BENCH_telemetry.json`` under
@@ -248,12 +278,29 @@ def attribution_report(records: List[Dict[str, Any]],
         peak_spec_flops = device_peak_flops(device_kind)
     if hbm_bytes_per_s is None:
         hbm_bytes_per_s = device_hbm_bytes_per_s(device_kind)
+    if ici_bytes_per_s is None:
+        ici_bytes_per_s = device_ici_bytes_per_s(device_kind)
     if device_kind:
         report["device_kind"] = device_kind
     if flops_per_step:
         report["flops_per_step"] = float(flops_per_step)
     if bytes_per_step:
         report["bytes_per_step"] = float(bytes_per_step)
+    if comm_bytes_per_step:
+        # comm is a named contributor hiding inside device_compute (the
+        # collectives execute on-device) and, when the host can't keep
+        # up with the ICI, inside the residual — state how much of the
+        # measured device phase the comm floor alone explains
+        comm: Dict[str, Any] = {
+            "bytes_per_step": float(comm_bytes_per_step)}
+        if ici_bytes_per_s:
+            t_comm = comm_bytes_per_step / ici_bytes_per_s
+            comm["min_comm_s"] = t_comm
+            dev_s = report["phases_s"]["device_compute"]
+            if dev_s > 0:
+                comm["fraction_of_device_compute"] = min(
+                    t_comm / dev_s, 1.0)
+        report["comm"] = comm
     wall_step = report["wall_step_s"]
     device_step = report["phases_s"]["device_compute"]
     mfu: Dict[str, Optional[float]] = {}
@@ -267,7 +314,9 @@ def attribution_report(records: List[Dict[str, Any]],
         report["mfu"] = mfu
     roof = roofline_verdict(
         flops_per_step, bytes_per_step,
-        peak_measured_flops or peak_spec_flops, hbm_bytes_per_s)
+        peak_measured_flops or peak_spec_flops, hbm_bytes_per_s,
+        comm_bytes_per_step=comm_bytes_per_step,
+        ici_bytes_per_s=ici_bytes_per_s)
     if roof is not None:
         report["roofline"] = roof
     try:

@@ -16,13 +16,23 @@ from __future__ import annotations
 import gc
 import os
 import threading
-from typing import Optional
+import time
+from typing import Any, Dict, List, Optional
 
 from bigdl_tpu.telemetry import families
 
-__all__ = ["sample_runtime", "RuntimeSampler"]
+__all__ = ["sample_runtime", "RuntimeSampler", "hbm_peaks",
+           "reset_hbm_peaks", "device_memory_snapshot",
+           "oom_forensics_report"]
 
 _PAGESIZE = os.sysconf("SC_PAGE_SIZE") if hasattr(os, "sysconf") else 4096
+
+# per-device high-water marks over sampled bytes_in_use — the fallback
+# when the backend's memory_stats() carries no peak_bytes_in_use of its
+# own.  Sampled peaks undercount between samples; backend peaks (used
+# whenever present) are exact.
+_HBM_PEAKS: Dict[str, float] = {}
+_PEAKS_LOCK = threading.Lock()
 
 
 def _rss_bytes() -> Optional[int]:
@@ -66,6 +76,7 @@ def sample_runtime(include_devices: bool = True) -> None:
         return
     in_use = families.device_memory_bytes_in_use()
     limit = families.device_memory_bytes_limit()
+    peak = families.hbm_bytes_peak()
     for d in devices:
         try:
             ms = d.memory_stats()
@@ -78,6 +89,112 @@ def sample_runtime(include_devices: bool = True) -> None:
             in_use.labels(key).set(ms["bytes_in_use"])
         if "bytes_limit" in ms:
             limit.labels(key).set(ms["bytes_limit"])
+        # peak watermark: the backend's own high-water mark when it
+        # keeps one (exact), else a max over our sampled in-use values
+        # (a lower bound); missing both keys -> skip, never invent
+        if "peak_bytes_in_use" in ms:
+            with _PEAKS_LOCK:
+                _HBM_PEAKS[key] = float(ms["peak_bytes_in_use"])
+            peak.labels(key).set(ms["peak_bytes_in_use"])
+        elif "bytes_in_use" in ms:
+            with _PEAKS_LOCK:
+                p = max(_HBM_PEAKS.get(key, 0.0),
+                        float(ms["bytes_in_use"]))
+                _HBM_PEAKS[key] = p
+            peak.labels(key).set(p)
+
+
+def hbm_peaks() -> Dict[str, float]:
+    """The per-device peak watermarks sampled so far this process."""
+    with _PEAKS_LOCK:
+        return dict(_HBM_PEAKS)
+
+
+def reset_hbm_peaks() -> None:
+    """Forget the sampled watermarks (tests; a new run's baseline)."""
+    with _PEAKS_LOCK:
+        _HBM_PEAKS.clear()
+
+
+def device_memory_snapshot() -> List[Dict[str, Any]]:
+    """Every local device's full ``memory_stats()`` dict (empty list
+    when the backend exposes none) — the raw material of the OOM
+    forensics report."""
+    out: List[Dict[str, Any]] = []
+    try:
+        import jax
+        devices = jax.local_devices()
+    except Exception:
+        return out
+    for d in devices:
+        try:
+            ms = d.memory_stats()
+        except Exception:
+            continue
+        if not ms:
+            continue
+        out.append({"device": f"{d.platform}:{d.id}",
+                    "device_kind": getattr(d, "device_kind", None),
+                    "memory_stats": dict(ms)})
+    return out
+
+
+def _live_array_census(max_groups: int = 20) -> Dict[str, Any]:
+    """What is actually holding HBM right now: live jax arrays grouped
+    by (shape, dtype), largest first — the census that turns "OOM at
+    step N" into "the 4096 stacked window copies never freed"."""
+    try:
+        import jax
+        arrays = jax.live_arrays()
+    except Exception:
+        return {"available": False}
+    groups: Dict[Any, Dict[str, Any]] = {}
+    total = 0
+    for a in arrays:
+        try:
+            nbytes = int(a.nbytes)
+            key = (str(a.dtype), tuple(a.shape))
+        except Exception:
+            continue
+        g = groups.setdefault(key, {"dtype": key[0],
+                                    "shape": list(key[1]),
+                                    "count": 0, "bytes": 0})
+        g["count"] += 1
+        g["bytes"] += nbytes
+        total += nbytes
+    top = sorted(groups.values(), key=lambda g: -g["bytes"])
+    return {"available": True, "arrays": sum(g["count"] for g in top),
+            "total_bytes": total, "groups_total": len(top),
+            "top_groups": top[:max_groups]}
+
+
+def oom_forensics_report(error: Optional[str] = None,
+                         last_window: Optional[Dict[str, Any]] = None,
+                         max_groups: int = 20) -> Dict[str, Any]:
+    """The artifact a RESOURCE_EXHAUSTED crash leaves behind: device
+    memory_stats, the peak watermarks, a live-array census, and the
+    last attribution window — everything the postmortem needs that
+    evaporates with the process.  Pure dict builder (the optimizer
+    writes it beside the flight recorder); never raises."""
+    report: Dict[str, Any] = {
+        "kind": "oom_forensics",
+        "time": time.time(),
+        "pid": os.getpid(),
+        "error": error,
+        "rss_bytes": _rss_bytes(),
+    }
+    try:
+        report["devices"] = device_memory_snapshot()
+    except Exception:  # pragma: no cover - forensics is best effort
+        report["devices"] = []
+    report["hbm_bytes_peak"] = hbm_peaks()
+    try:
+        report["live_arrays"] = _live_array_census(max_groups)
+    except Exception:  # pragma: no cover
+        report["live_arrays"] = {"available": False}
+    if last_window is not None:
+        report["last_window"] = dict(last_window)
+    return report
 
 
 class RuntimeSampler:

@@ -34,6 +34,11 @@ or by environment variables (picked up lazily on the first hook call, so
   health watchdog's ``data_starvation`` detector exists for);
   ``BIGDL_TPU_CHAOS_STALL_PIPELINE_BATCHES`` bounds how many batches
   stall (default: all of them).
+* ``BIGDL_TPU_CHAOS_OOM`` — raise a fake device allocation failure
+  (message carries ``RESOURCE_EXHAUSTED``, the grpc/XLA status an OOM
+  surfaces as) when training reaches this iteration, once — the seam
+  the OOM-forensics pipeline is proven through without needing a real
+  chip to run out of HBM.  ``1`` fires at the first step.
 
 Production code calls the module-level hook functions (``on_step``,
 ``on_io_write``, ``on_checkpoint_payload``, ``on_data_batch``); each is
@@ -72,8 +77,10 @@ class ChaosController:
                  truncate_keep_bytes: int = 64,
                  io_fail_p: float = 0.0, seed: int = 0,
                  stall_pipeline_s: float = 0.0,
-                 stall_pipeline_batches: Optional[int] = None):
+                 stall_pipeline_batches: Optional[int] = None,
+                 oom_at_step: Optional[int] = None):
         self.fail_at_step = fail_at_step
+        self.oom_at_step = oom_at_step
         self.crash_checkpoint = crash_checkpoint
         self.truncate_checkpoint = truncate_checkpoint
         self.truncate_keep_bytes = int(truncate_keep_bytes)
@@ -105,6 +112,16 @@ class ChaosController:
             self._fire(f"injected failure at iteration {neval}")
             raise FaultInjected(f"chaos: injected failure at iteration "
                                 f"{neval}")
+        if self.oom_at_step is not None and neval >= self.oom_at_step:
+            self.oom_at_step = None  # one-shot: the retry must succeed
+            self._fire(f"injected OOM at iteration {neval}")
+            # the exact status token a real device OOM carries, so the
+            # optimizer's forensics trigger and any operator tooling
+            # grepping for it see the genuine article
+            raise FaultInjected(
+                f"RESOURCE_EXHAUSTED: chaos-injected out-of-memory at "
+                f"iteration {neval} (fake allocation failure: attempted "
+                f"to allocate 999.99GiB)")
 
     def on_io_write(self, path: str) -> None:
         if self.io_fail_p and self._rng.random() < self.io_fail_p:
@@ -164,7 +181,7 @@ _env_checked = False
 
 _ENV_KEYS = ("BIGDL_TPU_CHAOS_FAIL_STEP", "BIGDL_TPU_CHAOS_CRASH_CKPT",
              "BIGDL_TPU_CHAOS_TRUNCATE_CKPT", "BIGDL_TPU_CHAOS_IO_FAIL_P",
-             "BIGDL_TPU_CHAOS_STALL_PIPELINE_S")
+             "BIGDL_TPU_CHAOS_STALL_PIPELINE_S", "BIGDL_TPU_CHAOS_OOM")
 
 
 def _from_env() -> Optional[ChaosController]:
@@ -185,7 +202,8 @@ def _from_env() -> Optional[ChaosController]:
         stall_pipeline_s=float(
             e.get("BIGDL_TPU_CHAOS_STALL_PIPELINE_S") or 0.0),
         stall_pipeline_batches=_i(
-            "BIGDL_TPU_CHAOS_STALL_PIPELINE_BATCHES"))
+            "BIGDL_TPU_CHAOS_STALL_PIPELINE_BATCHES"),
+        oom_at_step=_i("BIGDL_TPU_CHAOS_OOM"))
 
 
 def install(**kwargs) -> ChaosController:

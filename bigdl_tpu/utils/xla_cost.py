@@ -10,9 +10,11 @@ unwrapping lives in exactly one place.
 
 from __future__ import annotations
 
+import re
 from typing import Dict, Optional
 
-__all__ = ["compiled_flops", "compiled_bytes", "cost_breakdown"]
+__all__ = ["compiled_flops", "compiled_bytes", "cost_breakdown",
+           "collective_hlo_bytes"]
 
 
 def _cost_dict(compiled) -> dict:
@@ -52,11 +54,95 @@ def cost_breakdown(compiled) -> Dict[str, Optional[float]]:
     same missing-vs-zero contract as :func:`compiled_flops`: 0.0 means
     the compiler counted zero, None means it could not say."""
     d = _cost_dict(compiled)
+    comm = collective_hlo_bytes(compiled)
     return {
         "flops": _value_of(d, "flops"),
         "bytes": _value_of(d, "bytes accessed"),
         "transcendentals": _value_of(d, "transcendentals"),
+        "comm_bytes": None if comm is None else comm["total"],
     }
+
+
+# ---------------------------------------------------------------------------
+# Communication bytes out of the compiled module
+# ---------------------------------------------------------------------------
+# XLA's cost-analysis dict lumps collective traffic into "bytes
+# accessed"; the per-op breakdown only exists in the HLO itself.  The
+# collectives' OUTPUT shapes are the per-device payloads (the same
+# convention telemetry.collectives charges at trace time), so summing
+# them per opcode yields the step's comm budget — including the
+# collectives sharding propagation inserted that no wrapper ever saw.
+
+_COLLECTIVE_OPS = ("all-reduce", "all-gather", "all-to-all",
+                   "collective-permute", "reduce-scatter",
+                   "collective-broadcast")
+
+# `op(` is the sync form; async pairs appear as `op-start(`/`op-done(`.
+# Count the -done (its output is just the result); the -start's output
+# tuple aliases the operand and would double-count.
+_COLL_LINE_RE = re.compile(
+    r"=\s*(?P<shapes>[^=]*?)\s(?P<op>"
+    + "|".join(_COLLECTIVE_OPS) + r")(?:-done)?\(")
+
+_SHAPE_RE = re.compile(r"([a-z][a-z0-9]*)\[([0-9,]*)\]")
+
+
+def _shape_bits(dtype: str) -> Optional[int]:
+    """Bit width of an HLO dtype token (f32, bf16, s8, u4, c64,
+    f8e4m3fn, ...); None for tokens that are not dtypes at all — an
+    unknown token must be skipped, not guessed."""
+    if dtype == "pred":
+        return 8
+    m = re.match(r"(?:bf|f|s|u|c)([0-9]+)", dtype)
+    return int(m.group(1)) if m else None
+
+
+def _shapes_nbytes(text: str) -> float:
+    total = 0.0
+    for dtype, dims in _SHAPE_RE.findall(text):
+        bits = _shape_bits(dtype)
+        if bits is None:
+            continue
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        total += n * bits / 8.0
+    return total
+
+
+def comm_bytes_from_hlo_text(text: str) -> Dict[str, float]:
+    """Per-opcode output-payload bytes of the collective ops in an HLO
+    module text, plus ``"total"``.  ``{"total": 0.0}`` is a legitimate
+    answer: the program really has no collectives."""
+    out: Dict[str, float] = {"total": 0.0}
+    for line in text.splitlines():
+        if "-start(" in line:
+            continue  # counted at the matching -done
+        m = _COLL_LINE_RE.search(line)
+        if m is None:
+            continue
+        nbytes = _shapes_nbytes(m.group("shapes"))
+        op = m.group("op")
+        out[op] = out.get(op, 0.0) + nbytes
+        out["total"] += nbytes
+    return out
+
+
+def collective_hlo_bytes(compiled) -> Optional[Dict[str, float]]:
+    """Communication bytes of an AOT-compiled executable, from its
+    optimized HLO: ``{opcode: bytes, ..., "total": bytes}`` per
+    invocation per device, or None when the module text is
+    unavailable.  Zero total means "compiled, and genuinely moves no
+    inter-device bytes" — distinct from None, same contract as
+    :func:`compiled_flops`."""
+    try:
+        text = compiled.as_text()
+    except Exception:
+        return None
+    if not text:
+        return None
+    return comm_bytes_from_hlo_text(text)
 
 
 def compiled_flops(compiled) -> Optional[float]:

@@ -1,0 +1,225 @@
+#!/usr/bin/env bash
+# Mesh-observability smoke: on an 8-virtual-device fake mesh —
+#   1. collective byte accounting: the trace-time {op, axis} counters
+#      must match the compiled HLO's collective payloads within 10%,
+#      and a tp×dp transformer_lm train step's HLO comm budget must be
+#      nonzero and at least the analytic gradient-sync floor;
+#   2. fleet telemetry: a live /statusz scrape mid-train must show the
+#      `fleet` section (per-host step wall + skew ratio), and a
+#      2-process file-snapshot merge (child process writes host 1's
+#      snapshot) must name the injected straggler, tripping the
+#      watchdog's `straggler` anomaly into the flight recorder;
+#   3. OOM forensics: a forced allocation failure
+#      (BIGDL_TPU_CHAOS_OOM seam) must leave the `oom` flight-recorder
+#      event AND an oom_forensics.json artifact beside the checkpoint,
+#      with the run retrying through it.
+# See docs/parallelism.md "Measuring communication" and
+# docs/observability.md.
+#
+# Standalone: exits non-zero on any failed assertion.
+# scripts/tier1.sh runs it warn-only after the suite.
+set -o pipefail
+cd "$(dirname "$0")/.."
+exec env JAX_PLATFORMS=cpu \
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 python - <<'PY'
+import http.client
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from bigdl_tpu import nn, telemetry
+from bigdl_tpu.telemetry import collectives as tcoll
+from bigdl_tpu.telemetry import families as tfam
+from bigdl_tpu.telemetry import fleet as tfleet
+from bigdl_tpu.telemetry.health import HealthWatchdog
+from bigdl_tpu.utils import chaos, set_seed
+from bigdl_tpu.utils.xla_cost import collective_hlo_bytes
+
+telemetry.enable()
+telemetry.reset()
+set_seed(0)
+
+# ---- 1a. wrapper counters vs HLO cross-check (explicit collectives) ----
+def shard_map_compat(f, mesh, in_specs, out_specs):
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    from jax.experimental.shard_map import shard_map
+    return shard_map(f, mesh=mesh, in_specs=in_specs,
+                     out_specs=out_specs, check_rep=False)
+
+mesh1 = Mesh(np.array(jax.devices()[:8]), ("sp",))
+
+def sp_step(a):
+    # the collectives a tp/sp step issues: ring ppermute, gather, psum
+    p = tcoll.ppermute(a, "sp", [(i, (i + 1) % 8) for i in range(8)])
+    g = tcoll.all_gather(a, "sp", tiled=True)
+    s = tcoll.psum(a, "sp")
+    return p.sum() + g.sum() + s.sum()
+
+fn = jax.jit(shard_map_compat(sp_step, mesh1, P("sp"), P()))
+compiled = fn.lower(jnp.ones((8, 64), jnp.float32)).compile()
+wrapper_total = sum(v for _k, v in tfam.collective_bytes_total().samples())
+hlo = collective_hlo_bytes(compiled)
+assert hlo and hlo["total"] > 0, hlo
+assert abs(wrapper_total - hlo["total"]) <= 0.10 * hlo["total"], (
+    wrapper_total, hlo)
+per_op = {k: v for k, v in tfam.collective_bytes_total().samples()}
+assert all(v > 0 for v in per_op.values()), per_op
+
+# ---- 1b. tp×dp transformer_lm step: XLA-inserted comm is measurable ----
+from bigdl_tpu.core.module import combine, partition
+from bigdl_tpu.models import transformer_lm
+from bigdl_tpu.parallel.mesh import batch_sharding, make_mesh
+from bigdl_tpu.parallel.sharding import (
+    grad_allreduce_bytes, shard_model_params, tensor_parallel_rules,
+)
+
+set_seed(0)
+lm = transformer_lm(vocab_size=50, hidden_size=32, num_layers=2,
+                    num_heads=4, filter_size=64, max_len=32)
+mesh2 = make_mesh({"data": 2, "model": 4})
+rules = tensor_parallel_rules(
+    column=[r"q_layer", r"k_layer", r"v_layer", r"ffn\.filter_layer"],
+    row=[r"self_attn\.output_layer", r"ffn\.output_layer"])
+lm = shard_model_params(lm, mesh2, rules)
+params, rest = partition(lm)
+crit = nn.CrossEntropyCriterion()
+
+def lm_loss(params, rest, x, y):
+    out = combine(params, rest).forward(x)
+    return crit(out.reshape(-1, out.shape[-1]), y.reshape(-1))
+
+xsh = batch_sharding(mesh2)
+rng = np.random.default_rng(0)
+x = jax.device_put(jnp.asarray(rng.integers(1, 51, (8, 16))), xsh)
+y = jax.device_put(jnp.asarray(rng.integers(1, 51, (8, 16))), xsh)
+with mesh2:
+    lm_compiled = jax.jit(
+        jax.value_and_grad(lm_loss)).lower(params, rest, x, y).compile()
+lm_comm = collective_hlo_bytes(lm_compiled)
+est = grad_allreduce_bytes(combine(params, rest), mesh2, rules)
+assert lm_comm and lm_comm["total"] > 0, lm_comm
+# ground truth covers at least the analytic dp-gradient floor (the TP
+# activation all-reduces come on top)
+assert lm_comm["total"] >= est["bytes_per_step"], (lm_comm, est)
+
+# ---- 2. fleet section live on /statusz + straggler via merge path ------
+rngd = np.random.default_rng(1)
+from bigdl_tpu.dataset import DataSet, SampleToMiniBatch
+from bigdl_tpu.dataset.dataset import Sample
+from bigdl_tpu.optim import Optimizer, Trigger
+
+samples = [Sample(rngd.normal(size=(6,)).astype(np.float32),
+                  int(rngd.integers(1, 5))) for _ in range(32)]
+dataset = DataSet.array(samples).transform(SampleToMiniBatch(16))
+model = nn.Sequential(nn.Linear(6, 8), nn.ReLU(), nn.Linear(8, 4),
+                      nn.LogSoftMax())
+snapdir = tempfile.mkdtemp(prefix="fleet-smoke-")
+opt = (Optimizer(model, dataset, nn.ClassNLLCriterion())
+       .set_end_when(Trigger.max_epoch(40))
+       .set_fleet_monitor(snapshot_dir=snapdir)
+       .set_debug_server(0))
+done = []
+t = threading.Thread(target=lambda: done.append(opt.optimize()))
+t.start()
+statusz = None
+deadline = time.time() + 120
+while time.time() < deadline and t.is_alive():
+    srv = opt.debug_server
+    if srv is not None:
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                              timeout=10)
+            conn.request("GET", "/statusz")
+            page = json.loads(conn.getresponse().read())
+            conn.close()
+            if page.get("fleet", {}).get("hosts"):
+                statusz = page
+                break
+        except Exception:
+            pass
+    time.sleep(0.05)
+t.join(120)
+assert not t.is_alive(), "training did not finish"
+assert statusz is not None, "/statusz never showed a fleet section"
+fleet = statusz["fleet"]
+assert fleet["processes"] == 1 and "skew" in fleet, fleet
+assert fleet["hosts"][0]["step_wall_s"] > 0, fleet
+# events satellite: ring counters on the same page
+ev = statusz["events"]
+assert {"buffered", "capacity", "dropped", "counts"} <= set(ev), ev
+
+# 2-process file transport: a REAL second process writes host 1's
+# snapshot (a straggler: its wall is all data-wait), then the merged
+# table must name it and the watchdog must record the anomaly
+child = subprocess.run([sys.executable, "-c", f"""
+import sys
+sys.path.insert(0, {repr(os.getcwd())})
+from bigdl_tpu.telemetry import fleet
+host0 = fleet.merge_host_snapshots({repr(snapdir)})["hosts"][0]
+stats = dict(host0)
+stats["process"] = 1
+# a genuine straggler: 6x the peer's wall, the excess all data-wait
+stats["data_wait_s"] = stats["data_wait_s"] + stats["step_wall_s"] * 5
+stats["step_wall_s"] = stats["step_wall_s"] * 6
+fleet.write_host_snapshot({repr(snapdir)}, stats)
+"""], capture_output=True, text=True, timeout=120)
+assert child.returncode == 0, child.stderr[-2000:]
+merged = tfleet.merge_host_snapshots(snapdir)
+assert merged["processes"] == 2, merged
+assert merged["slowest_process"] == 1, merged
+assert merged["skew"] >= 2.0, merged
+wd = HealthWatchdog(straggler="warn", straggler_ratio=2.0)
+wd.observe_fleet(-1, merged["skew"], merged["slowest_process"],
+                 "merged file snapshots")
+assert wd.counts.get("straggler") == 1
+
+# ---- 3. forced OOM -> flight-recorder event + forensics artifact -------
+from bigdl_tpu.telemetry import events as tev
+
+ckdir = tempfile.mkdtemp(prefix="fleet-smoke-ck-")
+chaos.reset()
+os.environ["BIGDL_TPU_CHAOS_OOM"] = "3"
+set_seed(2)
+model2 = nn.Sequential(nn.Linear(6, 8), nn.ReLU(), nn.Linear(8, 4),
+                       nn.LogSoftMax())
+opt2 = (Optimizer(model2, dataset, nn.ClassNLLCriterion())
+        .set_end_when(Trigger.max_epoch(4))
+        .set_checkpoint(ckdir, Trigger.several_iteration(1))
+        .set_failure_retry(3, interval_s=300, backoff_s=0.01,
+                           backoff_cap_s=0.02))
+opt2.optimize()
+os.environ.pop("BIGDL_TPU_CHAOS_OOM", None)
+chaos.reset()
+counts = tev.event_counts()
+assert counts.get("oom", 0) >= 1, counts
+forensics = os.path.join(ckdir, "oom_forensics.json")
+assert os.path.isfile(forensics), forensics
+with open(forensics) as f:
+    rep = json.load(f)
+assert rep["kind"] == "oom_forensics", rep
+assert "RESOURCE_EXHAUSTED" in rep["error"], rep["error"]
+assert "live_arrays" in rep and "devices" in rep
+straggler_events = [e for e in tev.recent_events()
+                    if e["kind"] == "watchdog"
+                    and e.get("anomaly") == "straggler"]
+assert straggler_events, "no straggler verdict in the flight recorder"
+
+print("fleet_smoke: OK (wrapper vs HLO "
+      f"{wrapper_total:.0f}/{hlo['total']:.0f} B, tp*dp transformer_lm "
+      f"comm {lm_comm['total'] / 1e3:.1f} kB/step >= grad floor "
+      f"{est['bytes_per_step'] / 1e3:.1f} kB, fleet statusz at "
+      f"skew {fleet['skew']:.2f}, merged straggler -> process "
+      f"{merged['slowest_process']}, oom event + forensics verified)")
+PY

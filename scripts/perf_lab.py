@@ -104,6 +104,13 @@ def time_step(name, model_fn, batch=128, size=224, window=10, reps=3,
     # attribution layer uses — an A/B variant is judged by whether it
     # cut the binding resource, not just its ms
     by = cost["bytes"]
+    comm = cost.get("comm_bytes")
+    if comm:
+        # the step's inter-chip budget from the compiled HLO — the
+        # number that decides whether a compression hop (ROADMAP item
+        # 3) is worth building before anyone builds it
+        print(f"[{name}] {comm / window / 1e6:7.2f} MB/step inter-chip "
+              f"(HLO collectives)", flush=True)
     if by:
         import jax
         from bigdl_tpu.telemetry import perf as perf_attr
@@ -111,7 +118,9 @@ def time_step(name, model_fn, batch=128, size=224, window=10, reps=3,
         roof = perf_attr.roofline_verdict(
             (flops / window) if flops > 0 else None, by / window,
             perf_attr.device_peak_flops(kind),
-            perf_attr.device_hbm_bytes_per_s(kind))
+            perf_attr.device_hbm_bytes_per_s(kind),
+            comm_bytes_per_step=(comm / window) if comm else None,
+            ici_bytes_per_s=perf_attr.device_ici_bytes_per_s(kind))
         intensity = (roof or {}).get("arithmetic_intensity_flops_per_byte")
         print(f"[{name}] {by / window / 1e9:7.2f} GB/step"
               + (f"  {intensity:6.1f} flop/byte" if intensity else "")

@@ -53,4 +53,13 @@ if bash "$(dirname "$0")/perf_smoke.sh" >"$perf_log" 2>&1; then
 else
   echo "perf_smoke: FAILED (non-fatal ride-along; see $perf_log)"
 fi
+# mesh-observability smoke (collective bytes vs HLO cross-check, fleet
+# /statusz + straggler, forced-OOM forensics): warn-only ride-along;
+# run scripts/fleet_smoke.sh standalone for the fatal form
+fleet_log=$(mktemp /tmp/fleet_smoke.XXXXXX.log)
+if bash "$(dirname "$0")/fleet_smoke.sh" >"$fleet_log" 2>&1; then
+  tail -n 1 "$fleet_log"
+else
+  echo "fleet_smoke: FAILED (non-fatal ride-along; see $fleet_log)"
+fi
 exit $rc

@@ -152,6 +152,55 @@ def main():
         with open(os.path.join(outdir, "ok_pipeline"), "w") as f:
             f.write("sample-accurate")
 
+    # leg 4: fleet telemetry — every process must derive the IDENTICAL
+    # per-host table from the one allgather, and an injected per-batch
+    # sleep on process 1 (a lockstep-masked straggler: its wall shows
+    # as data-wait while the peers' shows as collective wait) must trip
+    # the watchdog's `straggler` anomaly on every host.
+    import hashlib
+    import json as _json
+
+    from bigdl_tpu import telemetry
+    from bigdl_tpu.telemetry.fleet import FleetMonitor
+    from bigdl_tpu.telemetry.health import HealthWatchdog
+    from jax.experimental import multihost_utils
+
+    telemetry.enable()
+    chaos.reset()
+    if pid == 1:
+        chaos.install(stall_pipeline_s=0.25)
+    set_seed(123)
+    ds5 = (DataSet.sharded(samples, shuffle=False,
+                           process_index=pid, process_count=nproc)
+           .transform(SampleToMiniBatch(4)))
+    wd5 = HealthWatchdog(straggler="warn", straggler_ratio=2.0)
+    fm5 = FleetMonitor()
+    opt5 = (Optimizer(make_model(), ds5, nn.CrossEntropyCriterion())
+            .set_optim_method(SGD(0.1))
+            .set_end_when(Trigger.max_epoch(2))
+            .set_health_watchdog(wd5)   # sync windows: allgathers align
+            .set_fleet_monitor(fm5))
+    opt5.optimize()
+    chaos.reset()
+
+    table = fm5.last_table
+    assert table is not None and table["processes"] == nproc, table
+    if nproc > 1:
+        assert wd5.counts.get("straggler", 0) >= 1, wd5.counts
+        assert table["slowest_process"] == 1, table
+    # identical tables everywhere: allgather a digest of the canonical
+    # rendering and require unanimity (floats came from ONE allgather,
+    # so the bits — and the JSON — must match)
+    digest = hashlib.sha256(
+        _json.dumps(table, sort_keys=True).encode()).digest()[:8]
+    h = np.frombuffer(digest, np.uint64)
+    gathered = np.asarray(
+        multihost_utils.process_allgather(h)).ravel()
+    assert (gathered == gathered[0]).all(), gathered
+    if pid == 0:
+        with open(os.path.join(outdir, "ok_fleet"), "w") as f:
+            f.write("straggler-named")
+
     # all processes must exit cleanly for the parent to pass
     print(f"worker {pid}: done", flush=True)
 

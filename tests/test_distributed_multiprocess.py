@@ -71,6 +71,12 @@ def test_two_process_train_checkpoint_resume(tmp_path):
     # resume) — asserted in dist_worker.py, marker written on success
     assert os.path.exists(os.path.join(outdir, "ok_pipeline")), \
         "sample-accurate multi-process resume leg did not complete"
+    # leg 4 inside the workers: the fleet allgather must derive the
+    # identical per-host table on every process, and the injected
+    # per-batch sleep on process 1 must trip the watchdog's
+    # `straggler` anomaly — asserted in dist_worker.py
+    assert os.path.exists(os.path.join(outdir, "ok_fleet")), \
+        "fleet telemetry / straggler-detection leg did not complete"
 
     # ---- single-process oracle: identical schedule, identical global
     # batch composition ([process-0 shard rows | process-1 shard rows])

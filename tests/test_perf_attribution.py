@@ -226,8 +226,10 @@ class TestCostBreakdown:
     def test_all_present(self):
         c = _FakeCompiled({"flops": 100.0, "bytes accessed": 50.0,
                            "transcendentals": 7.0})
+        # comm_bytes: None — the fake has no HLO text to read
         assert cost_breakdown(c) == {"flops": 100.0, "bytes": 50.0,
-                                     "transcendentals": 7.0}
+                                     "transcendentals": 7.0,
+                                     "comm_bytes": None}
 
     def test_zero_is_legitimate_not_missing(self):
         c = _FakeCompiled({"flops": 0.0, "bytes accessed": 0,
@@ -258,7 +260,8 @@ class TestCostBreakdown:
         assert cost_breakdown(c)["bytes"] == 6.0
         bad = _FakeCompiled({}, raise_=True)
         assert cost_breakdown(bad) == {"flops": None, "bytes": None,
-                                       "transcendentals": None}
+                                       "transcendentals": None,
+                                       "comm_bytes": None}
 
     def test_single_pass(self):
         c = _FakeCompiled({"flops": 1.0, "bytes accessed": 2.0,
