@@ -512,6 +512,42 @@ def phase_optimizer_loop(on_tpu: bool, batch: int, size: int, host_batch):
     if use_fused:
         _update(optimizer_loop_variant="fused")
     model2 = resnet50(class_num=1000, fused=use_fused)
+    # gradient-sync mode for this round: flat (default) or hierarchical
+    # with an optional wire codec (BIGDL_TPU_BENCH_SYNC=hierarchical,
+    # BIGDL_TPU_BENCH_WIRE_DTYPE=bf16|int8).  Recorded either way —
+    # comm_wire_dtype + the compression ratio land in the attribution
+    # table and BENCH_telemetry.json so a round artifact always states
+    # which sync mode produced its number.
+    sync_mode = os.environ.get("BIGDL_TPU_BENCH_SYNC", "flat")
+    if sync_mode not in ("flat", "hierarchical"):
+        # a typo must not silently run flat while the artifact records
+        # the typo string as the sync mode that produced the number
+        _log(f"BIGDL_TPU_BENCH_SYNC={sync_mode!r} unknown (expected "
+             f"'flat' or 'hierarchical'); using flat sync")
+        sync_mode = "flat"
+    wire = os.environ.get("BIGDL_TPU_BENCH_WIRE_DTYPE") or None
+    if wire is not None:
+        try:
+            from bigdl_tpu.parallel.compression import get_codec
+            if get_codec(wire) is None:
+                # uncompressed spellings ("fp32"/"none") are a valid
+                # explicit no-op under EITHER sync mode — normalize
+                # silently, don't warn below as if compression were
+                # requested and dropped
+                wire = None
+        except ValueError as e:
+            # same soft-fail as the SYNC typo above: a bad wire dtype
+            # must not abort the whole bench round
+            _log(f"BIGDL_TPU_BENCH_WIRE_DTYPE rejected ({e}); syncing "
+                 f"uncompressed")
+            wire = None
+    if sync_mode != "hierarchical" and wire is not None:
+        # a flat-sync run has no compressed wire — recording the
+        # requested dtype anyway would produce a self-contradictory
+        # artifact (bf16 label on fp32 bytes)
+        _log(f"BIGDL_TPU_BENCH_WIRE_DTYPE={wire} ignored: sync mode is "
+             f"{sync_mode!r} (set BIGDL_TPU_BENCH_SYNC=hierarchical)")
+        wire = None
     opt = (Optimizer(model2, data, nn.CrossEntropyCriterion())
            .set_optim_method(SGD(0.1, momentum=0.9, dampening=0.0))
            .set_end_when(Trigger.max_epoch(epochs))
@@ -523,6 +559,53 @@ def phase_optimizer_loop(on_tpu: bool, batch: int, size: int, host_batch):
            # scan bodies slower than unrolled steps, so windowing is
            # only a win on the accelerator
            .set_iterations_per_dispatch(iters_per_epoch if on_tpu else 1))
+    # one mesh build shared by plan resolution and the byte estimate
+    # (optimize() builds its own): make_mesh re-emits its truncation
+    # warning on every call, and the operator should read it once.
+    # Non-fatal: optimize() raises the same build error fatally below
+    try:
+        bench_mesh = opt.mesh_config.build()
+    except Exception:
+        bench_mesh = None
+    if sync_mode == "hierarchical":
+        opt.set_gradient_sync(hierarchical=True, wire_dtype=wire)
+        # record what the run RESOLVES to, not what was requested:
+        # on a mesh without a dcn axis the wire codec is dropped,
+        # and without batch parallelism the sync degrades to the
+        # flat step — the artifact must describe the bytes it
+        # actually produced
+        try:
+            plan = opt._grad_sync_plan(bench_mesh)
+            if plan is None:
+                sync_mode, wire = "flat", None
+            else:
+                wire = plan["wire_dtype"]
+        except Exception:
+            # optimize() below raises the same error fatally; stamp
+            # the requested mode so even a crashing round's partial
+            # artifact names its sync config
+            pass
+    # stamped before (and independent of) the byte estimate: the
+    # artifact must state which sync mode produced its number even
+    # when the estimator fails
+    _update(comm_sync_mode=sync_mode, comm_wire_dtype=(wire or "fp32"))
+    try:
+        from bigdl_tpu.parallel.sharding import grad_allreduce_bytes
+        if bench_mesh is None:
+            # the shared build above already failed; optimize() below
+            # raises the same error fatally — nothing to estimate
+            raise RuntimeError("mesh build failed; skipping estimate")
+        est = grad_allreduce_bytes(
+            model2, bench_mesh,
+            hierarchical=(sync_mode == "hierarchical"), wire_dtype=wire)
+        _update(comm_compression_ratio=round(
+                    float(est.get("compression_ratio", 1.0)), 4),
+                grad_sync_bytes_per_step=est["bytes_per_step"])
+        if est.get("dcn_bytes_per_step"):
+            _update(dcn_bytes_per_step=est["dcn_bytes_per_step"])
+    except Exception:
+        _log("grad-sync byte estimate failed (non-fatal):\n"
+             + traceback.format_exc())
     t_c = time.monotonic()
     opt.optimize()
     _log(f"optimizer loop ({epochs} epochs) in {time.monotonic() - t_c:.1f}s")
@@ -722,7 +805,7 @@ def _build_attribution():
         return None
     pfx = ("fused_" if RESULT.get("optimizer_loop_variant") == "fused"
            else "")
-    return perf.attribution_report(
+    rep = perf.attribution_report(
         _OPT_WINDOW_RECORDS,
         # prefer the optimizer loop's own execution-weighted FLOP
         # count (the program the windows actually ran); fall back to
@@ -736,7 +819,17 @@ def _build_attribution():
         peak_measured_flops=RESULT.get("peak_measured_flops"),
         device_kind=RESULT.get("device_kind"),
         comm_bytes_per_step=(RESULT.get(pfx + "comm_bytes_per_step")
-                             or RESULT.get("comm_bytes_per_step")))
+                             or RESULT.get("comm_bytes_per_step")),
+        dcn_bytes_per_step=RESULT.get("dcn_bytes_per_step"))
+    if rep is not None:
+        # which sync mode produced this number rides IN the table (and
+        # through it the BENCH_telemetry.json snapshot), so a round
+        # artifact is self-describing about its gradient wire
+        for key in ("comm_sync_mode", "comm_wire_dtype",
+                    "comm_compression_ratio"):
+            if RESULT.get(key) is not None:
+                rep[key] = RESULT[key]
+    return rep
 
 
 def _refresh_attribution():

@@ -39,6 +39,7 @@ from bigdl_tpu.core.module import Module, ModuleList, Parameter
 from bigdl_tpu.telemetry import collectives as _coll
 from bigdl_tpu.nn.linear import Linear
 from bigdl_tpu.utils.rng import next_key
+from bigdl_tpu.parallel.mesh import shard_map_compat
 
 __all__ = ["MoE"]
 
@@ -242,11 +243,11 @@ class MoE(Module):
             return (y.astype(x_loc.dtype),
                     _coll.pmean(drop, axis))
 
-        fn = jax.shard_map(
+        fn = shard_map_compat(
             shard_fn, mesh=mesh,
             in_specs=(jax.tree_util.tree_map(lambda _: P(axis), stacked),
                       P(axis), P(axis)),
-            out_specs=(P(axis), P()), check_vma=False)
+            out_specs=(P(axis), P()))
         y, drop = fn(stacked, xf, pf)
         self.drop_rate = jax.lax.stop_gradient(drop)
         return y.reshape(B, T, H)
@@ -267,9 +268,9 @@ class MoE(Module):
             part = jnp.einsum("ebth,bte->bth", outs, w_local)
             return _coll.psum(part, axis)
 
-        fn = jax.shard_map(
+        fn = shard_map_compat(
             shard_fn, mesh=mesh,
             in_specs=(jax.tree_util.tree_map(lambda _: P(axis), stacked),
                       P(), P()),
-            out_specs=P(), check_vma=False)
+            out_specs=P())
         return fn(stacked, x, weights)

@@ -45,10 +45,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-try:  # TPU-specific memory spaces; absent on some backends
-    from jax.experimental.pallas import tpu as pltpu
-except Exception:  # pragma: no cover
-    pltpu = None
+from bigdl_tpu.ops.pallas_compat import pltpu
+from bigdl_tpu.ops.pallas_compat import compiler_params as _compiler_params
 
 __all__ = ["dot_product_attention", "flash_attention",
            "flash_attention_partial", "xla_attention"]
@@ -154,7 +152,7 @@ def _dimsem(*sems):
     accumulator).  No-op where pltpu is unavailable."""
     if pltpu is None:  # pragma: no cover
         return {}
-    return {"compiler_params": pltpu.CompilerParams(
+    return {"compiler_params": _compiler_params()(
         dimension_semantics=sems)}
 
 

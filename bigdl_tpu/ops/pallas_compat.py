@@ -1,0 +1,24 @@
+"""Version-compat shims for Pallas-TPU, shared by every kernel module
+(the same discipline as ``parallel.mesh.shard_map_compat``: one spelling
+of each jax-version dance, re-imported by call sites)."""
+
+from __future__ import annotations
+
+try:  # TPU-specific bits; absent on some backends
+    from jax.experimental.pallas import tpu as pltpu
+except Exception:  # pragma: no cover
+    pltpu = None
+
+__all__ = ["pltpu", "compiler_params"]
+
+
+def compiler_params():
+    """Version-compat Pallas-TPU params class: jax renamed
+    TPUCompilerParams -> CompilerParams; resolve whichever this jax
+    ships, or None when pallas-tpu itself is absent — so call sites
+    degrade with one ``is None`` check instead of re-guarding the
+    import."""
+    if pltpu is None:
+        return None
+    return getattr(pltpu, "CompilerParams", None) \
+        or pltpu.TPUCompilerParams

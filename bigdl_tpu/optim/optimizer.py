@@ -47,6 +47,7 @@ from bigdl_tpu.core.module import (
 from bigdl_tpu.optim.methods import OptimMethod, SGD, Plateau
 from bigdl_tpu.optim.trigger import Trigger
 from bigdl_tpu.optim.validation import ValidationMethod, ValidationResult
+from bigdl_tpu.parallel.compression import get_codec as _get_wire_codec
 from bigdl_tpu.parallel.mesh import (
     MeshConfig, batch_sharding, data_parallel_mesh,
 )
@@ -136,6 +137,13 @@ class Optimizer:
         self.mesh_config = MeshConfig(data=-1)
         self.sharding_rules = ShardingRules()
         self.compute_dtype = None  # e.g. jnp.bfloat16 for mixed precision
+        # gradient-sync routing (set_gradient_sync): OFF by default —
+        # the flat XLA-inserted sync compiles exactly as it always has
+        self.grad_sync_hierarchical = False
+        self.grad_sync_wire_dtype = None
+        # plan resolution runs once in bench (artifact stamping) and
+        # again at step build — its warnings dedupe per (key, mesh)
+        self._grad_sync_warned: set = set()
         self.log_interval: Optional[int] = None  # None = auto
         self.iters_per_dispatch = 1
         self.profile_dir: Optional[str] = None
@@ -284,6 +292,196 @@ class Optimizer:
         """bf16 compute (≙ FP16 gradient compression — but end-to-end)."""
         self.compute_dtype = dtype
         return self
+
+    def set_gradient_sync(self, hierarchical: bool = False,
+                          wire_dtype=None) -> "Optimizer":
+        """Route the step's gradient mean through
+        :func:`bigdl_tpu.parallel.hierarchy.hierarchical_grad_sync`:
+        reduce-scatter within each slice over the fast (``data``/
+        ``fsdp``) axes, move only the scattered shards across the
+        slow ``dcn`` axis — compressed to ``wire_dtype`` (``"bf16"`` ≙
+        the reference's FP16CompressedTensor, or ``"int8"`` with
+        per-bucket scales and stochastic rounding; fp32 master
+        accumulation either way) — then all-gather within-slice.
+        Cross-slice traffic drops by the slice size versus the flat
+        all-reduce, and the codec shrinks what remains.
+
+        OFF by default: without this call (or with
+        ``hierarchical=False``) the step compiles exactly as before —
+        the flat XLA-inserted sync behind ``NamedSharding``.  The
+        hierarchical path requires a batch-parallel mesh
+        (``MeshConfig(dcn=2, data=-1)``) with fully replicated
+        parameters; meshes with tensor/pipeline axes or sharding rules
+        raise at ``optimize()``.  Models with batch-statistic layers
+        (BatchNorm) switch to shard-local statistics under this path
+        (warned at ``optimize()``).  See docs/parallelism.md
+        "Hierarchical sync & wire compression"."""
+        codec = self._resolve_wire(wire_dtype, hierarchical)
+        self.grad_sync_hierarchical = bool(hierarchical)
+        self.grad_sync_wire_dtype = None if codec is None else wire_dtype
+        return self
+
+    @staticmethod
+    def _resolve_wire(wire_dtype, hierarchical):
+        """The ONE wire-dtype resolver (setter and plan backstop both
+        call it): no-compression spellings ("fp32"/"none"/jnp.float32)
+        normalize to codec None so every consumer (plan, telemetry
+        stamp, estimator) sees one spelling of the uncompressed wire;
+        typos fail at configure, not at trace; a real codec without
+        hierarchical=True is rejected."""
+        codec = _get_wire_codec(wire_dtype)
+        if codec is not None and not hierarchical:
+            raise ValueError(
+                "set_gradient_sync: wire_dtype has no effect "
+                "without hierarchical=True — wire compression "
+                "applies to the hierarchical sync's dcn hop")
+        return codec
+
+    def _grad_sync_warn(self, key, mesh, msg, *args):
+        """Warn once per (reason, mesh shape): bench resolves the plan
+        for artifact stamping and the step build resolves it again —
+        the operator should not read every advisory twice."""
+        k = (key, tuple(sorted(dict(mesh.shape).items())))
+        if k not in self._grad_sync_warned:
+            self._grad_sync_warned.add(k)
+            logger.warning(msg, *args)
+
+    def _grad_sync_plan(self, mesh):
+        """Resolve the set_gradient_sync config against the mesh the
+        step is being built for.  None = flat sync (the default step,
+        byte-identical to a build that never saw this feature)."""
+        if not self.grad_sync_hierarchical:
+            # backstop for a bypassed setter — same resolver, so a
+            # no-compression spelling stays a no-op
+            self._resolve_wire(self.grad_sync_wire_dtype,
+                               hierarchical=False)
+            return None
+        from bigdl_tpu.parallel.hierarchy import (
+            DCN_AXIS, batch_axes_of, fast_batch_axes_of,
+        )
+        batch_axes = batch_axes_of(mesh)
+        n_batch = 1
+        for a in batch_axes:
+            n_batch *= mesh.shape[a]
+        if n_batch <= 1:
+            self._grad_sync_warn(
+                "no-batch", mesh,
+                "hierarchical gradient sync requested but the mesh has "
+                "no batch parallelism (axes %s); using the flat step",
+                dict(mesh.shape))
+            return None
+        non_batch = [a for a in mesh.axis_names
+                     if a not in batch_axes and mesh.shape[a] > 1]
+        if non_batch:
+            raise ValueError(
+                f"hierarchical gradient sync supports batch-parallel "
+                f"meshes (dcn/data/fsdp axes); this mesh also has "
+                f"{non_batch} — use the flat sync when composing with "
+                f"tensor/pipeline/sequence/expert parallelism")
+        if self.sharding_rules is not None and (
+                self.sharding_rules.rules or self.sharding_rules.fsdp):
+            raise ValueError(
+                "hierarchical gradient sync requires fully replicated "
+                "parameters (the primitive reduce-scatters the flat "
+                "concatenated gradient); drop the sharding rules or "
+                "keep the flat sync")
+        # the hierarchical step pmean's the per-shard loss and MEANS
+        # the per-shard gradients — correct only when the criterion is
+        # itself a per-sample mean.  A sum-reduction criterion would
+        # silently train at lr/n_devices with an n_devices-smaller
+        # logged loss than the flat step.
+        crit = self.criterion
+        # walk the whole criterion tree (criteria are Modules):
+        # composites (MultiCriterion/ParallelCriterion's crits,
+        # TimeDistributedCriterion's critrn, CrossEntropyCriterion's
+        # inner) must not smuggle a batch-sum sub-criterion past the
+        # guard.  TimeDistributedCriterion's OWN flag is excluded: it
+        # normalizes over the time axis, whose extent is identical on
+        # every shard, so it never changes the batch math.
+        from bigdl_tpu.nn.criterion import (
+            GaussianCriterion, KLDCriterion, L1HingeEmbeddingCriterion,
+            TimeDistributedCriterion,
+        )
+        # criteria that sum over the batch WITHOUT exposing a
+        # size_average flag — the attribute probe below can't see them
+        _BATCH_SUM_CRITERIA = (KLDCriterion, GaussianCriterion,
+                               L1HingeEmbeddingCriterion)
+        crit_mods = ([m for _, m in crit.named_modules()]
+                     if hasattr(crit, "named_modules") else [crit])
+        if any((getattr(m, "size_average", True) is False
+                and not isinstance(m, TimeDistributedCriterion))
+               or isinstance(m, _BATCH_SUM_CRITERIA)
+               for m in crit_mods):
+            raise ValueError(
+                "hierarchical gradient sync requires a mean-reduction "
+                "criterion (size_average=True): the schedule averages "
+                "per-shard losses/gradients, which changes the math "
+                "for a sum-reduction criterion — use size_average="
+                "True or keep the flat sync")
+        # batch-statistic modules (BatchNorm and friends, detected by
+        # their running_mean buffer): inside the shard_map each device
+        # normalizes with its LOCAL shard's mean/var — the standard
+        # data-parallel BatchNorm — whereas the flat GSPMD step reduces
+        # the statistics over the global sharded batch.  Legitimate and
+        # common (torch DDP's default), but losses will NOT match the
+        # flat step bit-for-bit, and the buffer pmean after each step
+        # averages per-shard variances (biased low by the variance of
+        # the shard means).  Warn, don't reject.
+        bn_mods = [f"{prefix} ({mod.name})"
+                   for prefix, mod in self.model.named_modules()
+                   if "running_mean" in getattr(mod, "_buffers", {})]
+        if bn_mods:
+            shown = ", ".join(bn_mods[:3])
+            if len(bn_mods) > 3:
+                shown += f", ... ({len(bn_mods)} total)"
+            self._grad_sync_warn(
+                "batch-stats", mesh,
+                "hierarchical gradient sync: %s keep(s) batch "
+                "statistics — each device will normalize with its "
+                "local batch shard's mean/var (standard data-parallel "
+                "BatchNorm), not the global-batch statistics the flat "
+                "step computes, so losses/buffers differ slightly from "
+                "flat sync; see docs/parallelism.md 'Hierarchical sync "
+                "& wire compression'", shown)
+        # weighted normalization (class_weights, and the paddingValue
+        # mask it shares a denominator with): the criterion divides by
+        # the LOCAL shard's weight sum, so the step's pmean of local
+        # means is sum(total_s/W_s)/n, not the flat step's global
+        # sum(total_s)/sum(W_s) — per-shard rescaling of loss AND
+        # gradients whenever the W_s differ across shards.  Warn, don't
+        # reject: with uniform weights and no padding rows W_s is the
+        # shard batch size and the two agree exactly.  Detected by a
+        # class_weights buffer or an explicitly configured paddingValue
+        # anywhere in the criterion tree; the default paddingValue=-1
+        # masks too, but whether -1 ever appears in targets is data the
+        # plan can't see, so that case is a docs caveat, not a warning.
+        if any("class_weights" in getattr(m, "_buffers", {})
+               or getattr(m, "padding_value", -1) != -1
+               for m in crit_mods):
+            self._grad_sync_warn(
+                "weighted-criterion", mesh,
+                "hierarchical gradient sync: the criterion normalizes "
+                "by a per-sample weight sum (class weights and/or "
+                "paddingValue masking) — each device divides by its "
+                "LOCAL shard's weight sum, so losses/gradients are "
+                "rescaled per shard versus the flat step's global "
+                "weighted mean when shards draw different class/padding "
+                "mixes; see docs/parallelism.md 'Hierarchical sync & "
+                "wire compression'")
+        wire = self.grad_sync_wire_dtype
+        if _get_wire_codec(wire) is None:
+            wire = None  # uncompressed spellings: one canonical label
+        elif DCN_AXIS not in mesh.axis_names:
+            self._grad_sync_warn(
+                "no-dcn-wire", mesh,
+                "gradient wire compression (%r) requested but the mesh "
+                "has no '%s' axis; there is no slow hop to compress — "
+                "syncing uncompressed", wire, DCN_AXIS)
+            wire = None
+        return {"batch_axes": batch_axes,
+                "fast_axes": fast_batch_axes_of(mesh),
+                "dcn_axis": DCN_AXIS,
+                "wire_dtype": wire}
 
     def set_log_interval(self, n: int) -> "Optimizer":
         """Fetch/log the loss every n iterations instead of every
@@ -453,7 +651,10 @@ class Optimizer:
     # ---- the jitted SPMD train step -------------------------------------
 
     def _build_step(self, mesh, group_names, spec_groups=None,
-                    window=False, health=False):
+                    window=False, health=False, raw=False):
+        """``raw=True`` returns the bare jitted step (no AOT cache
+        wrapper) so :meth:`compile_step` can lower it for HLO
+        introspection."""
         assert not (window and health), \
             "watchdog monitoring forces single-step dispatch"
         criterion = self.criterion
@@ -489,6 +690,64 @@ class Optimizer:
             return grads, total
 
         merge_groups = self._merge_groups_host  # jit-traceable as-is
+        sync_plan = self._grad_sync_plan(mesh)
+        if sync_plan is not None:
+            from jax.sharding import PartitionSpec as _PS
+            from bigdl_tpu.parallel.hierarchy import (
+                hierarchical_grad_sync, shard_map as _shard_map,
+            )
+            from bigdl_tpu.telemetry import collectives as _tc
+            b_axes = sync_plan["batch_axes"]
+
+            def _batch_specs(tree):
+                # batch-leading leaves shard over every batch axis;
+                # scalars (if any) replicate
+                return jax.tree_util.tree_map(
+                    lambda l: (_PS(b_axes) if getattr(l, "ndim", 0) >= 1
+                               else _PS()), tree)
+
+            def _hier_value_and_grad(loss_of, params_groups, rest, x, y,
+                                     rng):
+                def local(pg, rest_, x_, y_, rng_):
+                    # decorrelate per-shard randomness (dropout, int8
+                    # stochastic rounding) by the device's linear
+                    # position on the batch axes
+                    idx = 0
+                    for a in b_axes:
+                        idx = idx * mesh.shape[a] + jax.lax.axis_index(a)
+                    rng_l = jax.random.fold_in(rng_, idx)
+                    (loss, m2), grads = jax.value_and_grad(
+                        lambda g: loss_of(g, rest_, x_, y_, rng_l),
+                        has_aux=True)(pg)
+                    grads = hierarchical_grad_sync(
+                        grads, mesh, dcn_axis=sync_plan["dcn_axis"],
+                        fast_axes=sync_plan["fast_axes"],
+                        wire_dtype=sync_plan["wire_dtype"],
+                        rng=jax.random.fold_in(rng_l, 0x5deece66))
+                    # the logged loss is the global-batch mean, same
+                    # number the flat step reports
+                    loss = _tc.pmean(loss, b_axes)
+                    _, r2 = partition(m2)
+                    # buffers (BN stats) computed from the local shard:
+                    # average across shards so every device carries
+                    # identical buffers.  NOTE this is the mean of
+                    # per-shard statistics (data-parallel BatchNorm),
+                    # not the flat step's global-batch variance —
+                    # _grad_sync_plan warns when the model has such
+                    # modules
+                    r2 = jax.tree_util.tree_map(
+                        lambda b: (_tc.pmean(b, b_axes)
+                                   if jnp.issubdtype(b.dtype,
+                                                     jnp.floating)
+                                   else b), r2)
+                    return loss, grads, r2
+
+                fn = _shard_map(
+                    local, mesh,
+                    in_specs=(_PS(), _PS(), _batch_specs(x),
+                              _batch_specs(y), _PS()),
+                    out_specs=(_PS(), _PS(), _PS()))
+                return fn(params_groups, rest, x, y, rng)
 
         def apply_reg(gs, ps, specs):
             """Per-layer regularizers + scaleW/scaleB:
@@ -509,24 +768,36 @@ class Optimizer:
         def step(params_groups, rest, opt_states, x, y, rng, epoch):
             from bigdl_tpu.core.module import cast_floating
 
-            def loss_fn(groups):
-                m = combine(merge_groups(groups), rest)
-                x_c = x
+            def loss_of(groups, rest_, x_, y_, rng_):
+                m = combine(merge_groups(groups), rest_)
+                x_c = x_
                 if compute_dtype is not None:
                     # cast the whole compute graph (params + activations)
                     # to the compute dtype; grads flow back to fp32 master
                     # params through the casts
                     m = cast_floating(m, compute_dtype)
-                    x_c = cast_floating(x, compute_dtype)
-                with forward_context(rng=rng):
+                    x_c = cast_floating(x_, compute_dtype)
+                with forward_context(rng=rng_):
                     out = m.forward(x_c)
                 if compute_dtype is not None:
                     out = cast_floating(out, jnp.float32)
-                loss = criterion(out, y)
+                loss = criterion(out, y_)
                 return loss, m
 
-            (loss, m2), grads_groups = jax.value_and_grad(
-                loss_fn, has_aux=True)(params_groups)
+            if sync_plan is None:
+                (loss, m2), grads_groups = jax.value_and_grad(
+                    lambda groups: loss_of(groups, rest, x, y, rng),
+                    has_aux=True)(params_groups)
+                sync_rest = None
+            else:
+                # hierarchical sync: the whole fwd+bwd runs per-device
+                # on the LOCAL batch shard inside a shard_map, and the
+                # gradient mean routes through the rs-in-slice /
+                # compressed-dcn-hop / ag-in-slice schedule instead of
+                # the flat XLA-inserted all-reduce
+                loss, grads_groups, sync_rest = _hier_value_and_grad(
+                    loss_of, params_groups, rest, x, y, rng)
+                m2 = None
             if spec_groups is not None:
                 grads_groups = [
                     apply_reg(g, p, sp) for g, p, sp in
@@ -547,7 +818,10 @@ class Optimizer:
                 np_, ns_ = meth.update(g, p, s, epoch)
                 new_groups.append(np_)
                 new_states.append(ns_)
-            _, new_rest = partition(m2)
+            if sync_plan is None:
+                _, new_rest = partition(m2)
+            else:
+                new_rest = sync_rest
             if compute_dtype is not None:
                 # buffers (BN stats) ride back to fp32 master copies
                 new_rest = cast_floating(new_rest, jnp.float32)
@@ -623,6 +897,8 @@ class Optimizer:
 
             return call
 
+        if raw and not window:
+            return jax.jit(step, donate_argnums=(0, 1, 2))
         if not window:
             return _aot(jax.jit(step, donate_argnums=(0, 1, 2)))
         # windowed: args = (params_groups, rest, opt_states, xs, ys,
@@ -645,6 +921,126 @@ class Optimizer:
         return _aot(jax.jit(window_step, donate_argnums=(0, 1, 2)),
                     steps_of=lambda args: int(jax.tree_util.tree_leaves(
                         args[3])[0].shape[0]))
+
+    @staticmethod
+    def _abstract_opt_state(method, pg):
+        """Shape-only opt state for :meth:`compile_step`: the avals the
+        concrete ``init_state(pg)`` would produce, WITHOUT allocating
+        the momentum/variance buffers (full model size per method) on
+        device.  Faithful by the state contract every OptimMethod
+        follows: a params-congruent subtree is ``zeros_like``/
+        ``full_like`` of the params, so each leaf inherits the matching
+        param's committed ``NamedSharding``; everything else (scalar
+        counters, LBFGS's flat history) is a fresh eager array the real
+        dispatch treats as unspecified-sharding input — so its aval
+        carries no sharding, and the lowered program is byte-identical
+        either way (asserted in tests/test_hierarchy.py)."""
+        from jax.sharding import NamedSharding
+        state = jax.eval_shape(method.init_state, pg)
+        pg_def = jax.tree_util.tree_structure(pg)
+
+        def leaf_aval(s, p=None):
+            sh = getattr(p, "sharding", None)
+            if isinstance(sh, NamedSharding):
+                return jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh)
+            return jax.ShapeDtypeStruct(s.shape, s.dtype)
+
+        def subtree_avals(v):
+            if jax.tree_util.tree_structure(v) == pg_def:
+                return jax.tree_util.tree_map(leaf_aval, v, pg)
+            return jax.tree_util.tree_map(leaf_aval, v)
+
+        return {k: subtree_avals(v) for k, v in state.items()}
+
+    def _setup_step_state(self, model, abstract_state: bool = False):
+        """Flatten an already-sharded model into optim-method groups
+        with fresh opt states + per-leaf regularizer spec groups — the
+        ONE pipeline both ``_optimize_once`` and :meth:`compile_step`
+        feed ``_build_step`` from, so the introspected program can
+        never drift from the dispatched one.  ``abstract_state=True``
+        (the compile_step path) swaps the concrete opt states for
+        their avals so introspection never allocates them."""
+        from bigdl_tpu.core.module import param_paths
+        from bigdl_tpu.optim.regularizer import leaf_reg_specs
+        params_tree, rest = partition(model)
+        leaves, self._ptreedef = jax.tree_util.tree_flatten(params_tree)
+        self._n_param_leaves = len(leaves)
+        paths = param_paths(model)
+        assert len(paths) == len(leaves)
+        groups = self._group_indices(paths)
+        group_names = [g for g, _ in groups]
+        self._group_idx = [idxs for _, idxs in groups]
+        params_groups = [[leaves[i] for i in idxs] for _, idxs in groups]
+        methods = ([self.optim_method] if group_names == ["__default__"]
+                   else [self.optim_methods[g] for g in group_names])
+        state_of = (self._abstract_opt_state if abstract_state
+                    else (lambda m, pg: m.init_state(pg)))
+        opt_states = [state_of(m, pg)
+                      for m, pg in zip(methods, params_groups)]
+        leaf_specs = leaf_reg_specs(model)
+        assert len(leaf_specs) == len(leaves)
+        spec_groups = ([[leaf_specs[i] for i in idxs]
+                        for idxs in self._group_idx]
+                       if any(s != (0.0, 0.0, 1.0) for s in leaf_specs)
+                       else None)  # None: no per-layer reg/scale anywhere
+        return (params_groups, rest, group_names, methods, opt_states,
+                spec_groups)
+
+    def compile_step(self, batch):
+        """AOT-compile ONE train step for a host ``MiniBatch`` without
+        running the loop — the introspection hook comm tooling and
+        tests use to read the compiled program ``optimize()`` would
+        dispatch (``utils/xla_cost.collective_hlo_bytes`` /
+        ``cross_group_hlo_bytes`` over it answer "what does this
+        mesh/sync layout actually put on which wire").  Shares the
+        mesh build, sharding, and ``_setup_step_state`` grouping
+        pipeline with the training loop; the opt states are lowered
+        from avals (:meth:`_abstract_opt_state`), so introspecting a
+        model near the HBM limit never allocates a second copy of the
+        optimizer state.  Read-only: the train-mode flip the lowering
+        needs (the program optimize() dispatches IS the training-mode
+        program) is restored per-module on exit, so inspecting an
+        eval_mode'd model doesn't silently re-enable dropout/BN
+        updates for subsequent forwards.
+
+        Always the SINGLE-STEP program: under
+        ``iterations_per_dispatch > 1`` optimize() dispatches the
+        scan-wrapped window instead, whose per-iteration collectives
+        are these same ops inside a scan body (per-STEP byte counts
+        from this hook stay the per-iteration truth; multiply by the
+        window for per-dispatch totals) — warned once so an HLO-level
+        identity comparison isn't attempted against the window
+        program."""
+        if getattr(self, "iters_per_dispatch", 1) > 1:
+            logger.warning(
+                "compile_step introspects the single-step program; "
+                "optimize() will dispatch a %d-step scan window whose "
+                "HLO wraps these same per-iteration collectives in a "
+                "scan body", self.iters_per_dispatch)
+        mesh = self.mesh_config.build()
+        modes = [(m, m.training) for _, m in self.model.named_modules()]
+        try:
+            model = shard_model_params(self.model.train_mode(), mesh,
+                                       self.sharding_rules)
+            (params_groups, rest, group_names, _methods, opt_states,
+             spec_groups) = self._setup_step_state(
+                 model, abstract_state=True)
+            # mirror optimize()'s health wiring: a watchdog-armed run
+            # dispatches the in-graph grad-norm/guard program, and the
+            # introspected HLO must be THAT program, not the bare one
+            step = self._build_step(mesh, group_names, spec_groups,
+                                    health=self.watchdog is not None,
+                                    raw=True)
+            x_sharding = batch_sharding(mesh)
+            with mesh:
+                x = _stage(batch.get_input(), x_sharding)
+                y = _stage(batch.get_target(), x_sharding)
+                rng = jax.random.fold_in(jax.random.key(get_seed()), 0)
+                return step.lower(params_groups, rest, opt_states, x, y,
+                                  rng, 1).compile()
+        finally:
+            for m, flag in modes:
+                m.training = flag
 
     # ---- evaluation ------------------------------------------------------
 
@@ -1161,7 +1557,6 @@ class Optimizer:
             t.join(timeout=30.0)
 
     def _optimize_once(self) -> Module:
-        from bigdl_tpu.core.module import param_paths
         mesh = self.mesh_config.build()
         model = self.model.train_mode()
         wd = self.watchdog
@@ -1205,19 +1600,8 @@ class Optimizer:
                         self.state["neval"])
 
         model = shard_model_params(model, mesh, self.sharding_rules)
-        params_tree, rest = partition(model)
-        leaves, self._ptreedef = jax.tree_util.tree_flatten(params_tree)
-        self._n_param_leaves = len(leaves)
-        paths = param_paths(model)
-        assert len(paths) == len(leaves)
-        groups = self._group_indices(paths)
-        group_names = [g for g, _ in groups]
-        self._group_idx = [idxs for _, idxs in groups]
-        params_groups = [[leaves[i] for i in idxs] for _, idxs in groups]
-        methods = ([self.optim_method] if group_names == ["__default__"]
-                   else [self.optim_methods[g] for g in group_names])
-        opt_states = [m.init_state(pg)
-                      for m, pg in zip(methods, params_groups)]
+        (params_groups, rest, group_names, methods, opt_states,
+         spec_groups) = self._setup_step_state(model)
         if resume_sharded:
             # restore INTO the sharded layout: the freshly-built (and
             # already sharded) params/opt-state trees provide the
@@ -1254,7 +1638,7 @@ class Optimizer:
             params_tree, rest = partition(model)
             leaves = jax.tree_util.tree_leaves(params_tree)
             params_groups = [[leaves[i] for i in idxs]
-                             for _, idxs in groups]
+                             for idxs in self._group_idx]
             opt_states = opt_restored
             self.state.update(driver)
             logger.info("resumed sharded checkpoint %s at epoch %s "
@@ -1274,14 +1658,6 @@ class Optimizer:
             self._pipeline_restore = load_pipeline_state(
                 self._resume_from)
 
-        from bigdl_tpu.optim.regularizer import leaf_reg_specs
-        leaf_specs = leaf_reg_specs(model)
-        assert len(leaf_specs) == len(leaves)
-        if any(s != (0.0, 0.0, 1.0) for s in leaf_specs):
-            spec_groups = [[leaf_specs[i] for i in idxs]
-                           for idxs in self._group_idx]
-        else:
-            spec_groups = None  # no per-layer reg/scale anywhere
         step = self._build_step(mesh, group_names, spec_groups,
                                 health=wd is not None)
         eval_step = self._build_eval_step() if self.val_methods else None
@@ -1291,8 +1667,9 @@ class Optimizer:
         total_records = self.dataset.size()
         wall_start = time.time()
 
+        from bigdl_tpu.parallel.mesh import BATCH_AXES
         n_data = 1
-        for a in ("data", "fsdp"):
+        for a in BATCH_AXES:
             if a in mesh.axis_names:
                 n_data *= mesh.shape[a]
 

@@ -6,6 +6,12 @@ from bigdl_tpu.parallel.sharding import (
     ShardingRules, replicated, shard_model_params, model_shardings,
     fsdp_spec, tensor_parallel_rules,
 )
+from bigdl_tpu.parallel.hierarchy import (
+    DCN_AXIS, hierarchical_grad_sync, batch_axes_of, dcn_slice_map,
+)
+from bigdl_tpu.parallel.compression import (
+    Bf16Codec, Int8Codec, get_codec, wire_bytes, wire_itemsize,
+)
 from bigdl_tpu.parallel.ring_attention import (
     RingSelfAttention, ring_attention, ring_self_attention,
 )

@@ -10,6 +10,10 @@ here.
 
 The canonical axis names used across the framework:
 
+* ``dcn``   — the slow inter-slice network tier (data-center network
+  between ICI slices); batch-like, but gradient sync across it should
+  go through ``parallel.hierarchy`` (≙ the reference's inter-node
+  links, whose slowness motivated FP16CompressedTensor)
 * ``data``  — batch sharding (≙ AllReduceParameter data parallelism)
 * ``fsdp``  — parameter/optimizer-state sharding combined with data
 * ``model`` — tensor parallelism (megatron-style)
@@ -20,6 +24,7 @@ The canonical axis names used across the framework:
 
 from __future__ import annotations
 
+import logging
 from typing import Dict, Optional, Sequence, Tuple
 
 import numpy as np
@@ -28,9 +33,35 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = ["make_mesh", "data_parallel_mesh", "MeshConfig", "P",
-           "NamedSharding", "Mesh", "local_device_count", "batch_sharding"]
+           "NamedSharding", "Mesh", "local_device_count",
+           "batch_sharding", "shard_map_compat"]
 
-AXES = ("data", "fsdp", "model", "pipe", "seq", "expert")
+
+def shard_map_compat(f, mesh, in_specs, out_specs):
+    """Version-compat shard_map — THE one spelling every module maps
+    over a mesh with: ``jax.shard_map`` (with ``check_vma=False``)
+    where the public name exists, else the ``jax.experimental``
+    form (with the equivalent ``check_rep=False``).  Older jax
+    releases only ship the experimental name, newer ones deprecate
+    it; call sites that hardcode either spelling break on the other
+    side of that line."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    from jax.experimental.shard_map import shard_map as _sm
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=False)
+
+logger = logging.getLogger("bigdl_tpu.parallel")
+
+# dcn is OUTERMOST (slowest-varying): devices of one slice stay
+# contiguous in the flattened device order, so the fast axes ride
+# nearest-neighbour ICI while only the dcn axis crosses slices
+AXES = ("dcn", "data", "fsdp", "model", "pipe", "seq", "expert")
+
+# the batch-like axes, in AXES order: a batch-leading array shards over
+# every one of these present in the mesh
+BATCH_AXES = ("dcn", "data", "fsdp")
 
 
 def local_device_count() -> int:
@@ -86,13 +117,52 @@ def make_mesh(axes: Optional[Dict[str, int]] = None,
     sizes = tuple(axes[a] for a in names)
     prod = int(np.prod(sizes))
     if prod < n:
+        dropped = devices[prod:]
+        logger.warning(
+            "mesh axes %s cover only %d of %d devices; dropping device "
+            "id(s) %s (pass -1 on one axis to use every device)",
+            dict(zip(names, sizes)), prod, n,
+            [getattr(d, "id", d) for d in dropped])
         devices = devices[:prod]
-    try:
-        from jax.experimental import mesh_utils
-        mesh_devices = mesh_utils.create_device_mesh(
-            sizes, devices=devices)
-    except Exception:
-        mesh_devices = np.array(devices).reshape(sizes)
+    mesh_devices = None
+    if "dcn" in names and axes["dcn"] > 1:
+        # the dcn axis must follow PHYSICAL slice boundaries or the
+        # hierarchical sync inverts (full-width gradients over the
+        # real DCN, compression on ICI): create_hybrid_device_mesh
+        # places the dcn dim by slice_index and keeps ICI locality
+        # within each slice.  Fake meshes (CPU devices carry no
+        # slice_index) fall through to the flat path below, whose
+        # dcn-outermost ordering IS the slice layout being simulated.
+        try:
+            from jax.experimental import mesh_utils
+            ici_shape = tuple(1 if a == "dcn" else axes[a]
+                              for a in names)
+            dcn_shape = tuple(axes[a] if a == "dcn" else 1
+                              for a in names)
+            mesh_devices = mesh_utils.create_hybrid_device_mesh(
+                ici_shape, dcn_shape, devices=devices)
+        except Exception as e:
+            if any(getattr(d, "slice_index", None) is not None
+                   for d in devices):
+                # a REAL multislice allocation where the hybrid layout
+                # failed: the flat fallback may place the dcn axis
+                # across physical slice boundaries — exactly the
+                # inversion named above — so say so instead of
+                # silently degrading
+                logger.warning(
+                    "create_hybrid_device_mesh failed on a multislice "
+                    "allocation (%s); falling back to a flat device "
+                    "mesh — the 'dcn' axis may not follow physical "
+                    "slice boundaries, inverting the hierarchical "
+                    "sync's fast/slow tiers", e)
+            mesh_devices = None
+    if mesh_devices is None:
+        try:
+            from jax.experimental import mesh_utils
+            mesh_devices = mesh_utils.create_device_mesh(
+                sizes, devices=devices)
+        except Exception:
+            mesh_devices = np.array(devices).reshape(sizes)
     return Mesh(mesh_devices, tuple(names))
 
 
@@ -105,8 +175,10 @@ def data_parallel_mesh(devices=None) -> Mesh:
 def batch_sharding(mesh: Mesh, *, extra_axes: Sequence[str] = ()) \
         -> NamedSharding:
     """Sharding for a batch-leading array: batch dim over every
-    data-like axis present in the mesh."""
-    batch_axes = tuple(a for a in ("data", "fsdp") if a in mesh.axis_names)
+    data-like axis present in the mesh (``dcn`` included — each slice
+    consumes its own sub-batch, which is exactly what makes the
+    hierarchical gradient sync's cross-slice hop small)."""
+    batch_axes = tuple(a for a in BATCH_AXES if a in mesh.axis_names)
     spec = P(batch_axes if batch_axes else None, *extra_axes)
     return NamedSharding(mesh, spec)
 
@@ -120,6 +192,7 @@ class MeshConfig:
         MeshConfig(data=-1)                      # pure DP (default)
         MeshConfig(data=2, model=4)              # DP×TP
         MeshConfig(data=2, pipe=2, model=2)      # 3D
+        MeshConfig(dcn=2, data=-1)               # 2 slices × DP
     """
 
     def __init__(self, **axes: int):

@@ -49,6 +49,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from bigdl_tpu.core.module import Module, ModuleList
 from bigdl_tpu.telemetry import collectives as _coll
+from bigdl_tpu.parallel.mesh import shard_map_compat
 
 __all__ = ["gpipe", "one_f_one_b", "Pipeline"]
 
@@ -124,13 +125,12 @@ def _run_pipe(stage_apply, stacked_params, param_specs, x, mesh,
         x_mb = jnp.concatenate(
             [x_mb, jnp.zeros((m_pad,) + x_mb.shape[1:], x_mb.dtype)], 0)
 
-    fn = jax.shard_map(
+    fn = shard_map_compat(
         functools.partial(_pipe_loop, stage_apply=stage_apply,
                           axis_name=axis),
         mesh=mesh,
         in_specs=(param_specs, P(axis)),
         out_specs=P(axis),
-        check_vma=False,
     )
     y_mb = fn(stacked_params, x_mb)[:m]
     return y_mb.reshape((b,) + y_mb.shape[2:])
@@ -315,14 +315,13 @@ def one_f_one_b(stage_apply: Callable, loss_fn: Callable, stacked_params,
             [t_mb, jnp.zeros((m_pad,) + t_mb.shape[1:], t_mb.dtype)], 0)
 
     specs = jax.tree_util.tree_map(lambda _: P(axis), stacked_params)
-    fn = jax.shard_map(
+    fn = shard_map_compat(
         functools.partial(_1f1b_loop, stage_apply=stage_apply,
                           loss_fn=loss_fn, axis_name=axis, m_real=m,
                           s_total=s),
         mesh=mesh,
         in_specs=(specs, P(axis), P(axis)),
         out_specs=(P(), specs, P(axis)),
-        check_vma=False,
     )
     loss_sum, grads, dx_mb = fn(stacked_params, x_mb, t_mb)
     # mean over the real microbatches; grads follow the same scale.

@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from bigdl_tpu.parallel.mesh import shard_map_compat
 from bigdl_tpu.telemetry import collectives as _coll
 
 __all__ = ["ring_attention", "ring_self_attention",
@@ -294,20 +295,18 @@ def ring_self_attention(q, k, v, mesh: Mesh, axis: str = "seq", *,
     "involuntary full rematerialization" SPMD warning)."""
     spec = P(None, head_axis, axis, None)
     if bias is None:
-        fn = jax.shard_map(
+        fn = shard_map_compat(
             functools.partial(ring_attention, axis_name=axis,
                               causal=causal, scale=scale, kernel=kernel),
-            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-            check_vma=False)
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
         return fn(q, k, v)
     bias = jnp.broadcast_to(
         bias, (q.shape[0], q.shape[1], q.shape[2], k.shape[2]))
-    fn = jax.shard_map(
+    fn = shard_map_compat(
         lambda q_, k_, v_, b_: ring_attention(
             q_, k_, v_, axis_name=axis, causal=causal, scale=scale,
             bias=b_),
-        mesh=mesh, in_specs=(spec, spec, spec, spec), out_specs=spec,
-        check_vma=False)
+        mesh=mesh, in_specs=(spec, spec, spec, spec), out_specs=spec)
     return fn(q, k, v, bias)
 
 

@@ -159,7 +159,10 @@ def model_shardings(model, mesh: Mesh,
 
 
 def grad_allreduce_bytes(model, mesh: Mesh,
-                         rules: Optional[ShardingRules] = None) -> Dict:
+                         rules: Optional[ShardingRules] = None, *,
+                         hierarchical: bool = False,
+                         wire_dtype=None,
+                         dcn_axis: str = "dcn") -> Dict:
     """Analytic per-step gradient-sync payload of this (model, mesh,
     rules) triple: the bytes the XLA-inserted data-parallel gradient
     all-reduce moves per device per step.
@@ -174,7 +177,20 @@ def grad_allreduce_bytes(model, mesh: Mesh,
     sharded layout); a fully replicated leaf contributes its whole
     ``nbytes``.  ≙ the byte count the reference's BlockManager
     all-reduce shipped per node (parameters/AllReduceParameter.scala),
-    which its FP16 ``CompressedTensor`` existed to halve."""
+    which its FP16 ``CompressedTensor`` existed to halve.
+
+    ``hierarchical=True`` models the
+    :func:`bigdl_tpu.parallel.hierarchy.hierarchical_grad_sync`
+    schedule instead of the flat all-reduce, so the analytic floor
+    matches the compressed wire: reduce-scatter over the fast batch
+    axes (``flat/F``), the cross-slice hop at ``wire_dtype`` width
+    (``S`` gathered shards of ``flat/F`` scaled by wire-bytes/element
+    over 4), and the within-slice all-gather (``flat``).  Extra keys:
+    ``dcn_bytes_per_step`` (the slow-tier payload — the number the
+    ``dcn_bound`` roofline floor divides by DCN bandwidth),
+    ``intra_bytes_per_step``, ``flat_fp32_bytes_per_step``,
+    ``wire_dtype``, and ``compression_ratio`` (flat fp32 bytes /
+    actual total wire bytes — what a round artifact records)."""
     from bigdl_tpu.core.module import Module, ModuleList
     rules = rules or ShardingRules()
 
@@ -207,8 +223,64 @@ def grad_allreduce_bytes(model, mesh: Mesh,
                 rec(m, f"{prefix}[{i}]")
 
     rec(model, "")
-    return {"bytes_per_step": total, "param_leaves": leaves,
-            "mesh_axes": dict(mesh.shape)}
+    out = {"bytes_per_step": total, "param_leaves": leaves,
+           "mesh_axes": dict(mesh.shape)}
+    if not hierarchical:
+        # the flat all-reduce on a multi-slice mesh still crosses the
+        # slow tier — with the FULL per-device payload, which is the
+        # whole case for the hierarchical schedule; report it so the
+        # flat baseline gets a dcn roofline floor too
+        if dcn_axis in mesh.axis_names and mesh.shape[dcn_axis] > 1:
+            out["dcn_bytes_per_step"] = total
+        return out
+    # hierarchical mode: model the rs-in-slice / compressed-dcn-hop /
+    # ag-in-slice schedule over the FLAT fp32 gradient (the primitive
+    # concatenates every leaf; leaf-level shard factors don't apply —
+    # it requires replicated params, so a rules-reduced total would
+    # silently model a configuration _grad_sync_plan rejects)
+    if rules.rules or rules.fsdp:
+        raise ValueError(
+            "grad_allreduce_bytes(hierarchical=True) models the "
+            "hierarchical sync, which requires fully replicated "
+            "parameters — drop the sharding rules or estimate the "
+            "flat sync (hierarchical=False)")
+    from bigdl_tpu.parallel.compression import get_codec, wire_bytes
+    from bigdl_tpu.parallel.hierarchy import fast_batch_axes_of
+    flat_fp32 = total
+    F = 1
+    for a in fast_batch_axes_of(mesh):
+        F *= mesh.shape[a]
+    S = mesh.shape[dcn_axis] if dcn_axis in mesh.axis_names else 1
+    # branch on the RESOLVED codec, not the raw wire_dtype: spellings
+    # get_codec maps to no-compression ("fp32", "none", jnp.float32)
+    # run the single-hop uncompressed psum at runtime and must not be
+    # costed as the two-hop codec schedule
+    codec = get_codec(wire_dtype)
+    shard = flat_fp32 / max(F, 1)
+    # per-device output payloads: reduce-scatter emits the 1/F shard,
+    # the in-slice all-gather emits the full flat gradient
+    intra = (shard + flat_fp32) if F > 1 else 0.0
+    if S > 1:
+        # compressed chunk-ownership all-reduce: two hops (all_to_all
+        # the S encoded chunks, all-gather the reduced ones) of one
+        # shard-size payload each — constant in S.  wire_bytes models
+        # the codec's bucket clamp, so small shards cost their true
+        # scale overhead (uncompressed psum: one shard at full width)
+        dcn = (2.0 * wire_bytes(codec, shard / 4.0, n_chunks=S)
+               if codec is not None else shard)
+    else:
+        dcn = 0.0
+    wire_total = intra + dcn
+    out.update({
+        "bytes_per_step": wire_total,
+        "flat_fp32_bytes_per_step": flat_fp32,
+        "intra_bytes_per_step": intra,
+        "dcn_bytes_per_step": dcn,
+        "wire_dtype": (None if codec is None else str(wire_dtype)),
+        "compression_ratio": (flat_fp32 / wire_total
+                              if wire_total else 1.0),
+    })
+    return out
 
 
 def shard_model_params(model, mesh: Mesh,

@@ -52,13 +52,17 @@ from __future__ import annotations
 
 import glob as _glob
 import json
+import logging
 import os
 import subprocess
 from typing import Any, Dict, List, Optional, Tuple
 
+logger = logging.getLogger("bigdl_tpu.telemetry")
+
 __all__ = [
     "PHASES", "attribute_windows", "attribution_report",
     "roofline_verdict", "device_peak_flops", "device_hbm_bytes_per_s",
+    "device_ici_bytes_per_s", "device_dcn_bytes_per_s",
     "optimizer_perf_status",
     "ROUND_SCHEMA", "ROUND_ARTIFACT_VERSION", "git_revision",
     "make_round_artifact", "write_round_artifact", "load_round_artifact",
@@ -108,6 +112,21 @@ _ICI_BYTES_PER_S = (
     ("v5litepod", 200e9), ("v4", 300e9), ("v3", 82e9), ("v2", 62e9),
 )
 
+# Per-chip DCN bandwidth (bytes/s) by device_kind substring — the slow
+# tier BETWEEN slices (data-center network), the denominator of the
+# ``dcn_bound`` verdict over the cross-slice payload
+# (``xla_cost.cross_group_hlo_bytes`` /
+# ``grad_allreduce_bytes(hierarchical=True)["dcn_bytes_per_step"]``).
+# Order-of-magnitude figures from published multislice host NIC specs
+# amortized per chip — one to two decades below ICI, which is exactly
+# why parallel/hierarchy.py exists.  Override with
+# ``BIGDL_TPU_DCN_BYTES_PER_S`` (e.g. to pin the table slow in a smoke
+# test, or to enter a measured fleet number).
+_DCN_BYTES_PER_S = (
+    ("v6", 25e9), ("v5p", 25e9), ("v5e", 12.5e9), ("v5 lite", 12.5e9),
+    ("v5litepod", 12.5e9), ("v4", 12.5e9), ("v3", 6e9), ("v2", 6e9),
+)
+
 
 def _lookup(table, device_kind: Optional[str]) -> Optional[float]:
     kind = (device_kind or "").lower()
@@ -133,6 +152,24 @@ def device_ici_bytes_per_s(device_kind: Optional[str]) -> Optional[float]:
     """Aggregate per-chip ICI bandwidth (bytes/s) for a ``device_kind``
     string, or None when unknown."""
     return _lookup(_ICI_BYTES_PER_S, device_kind)
+
+
+def device_dcn_bytes_per_s(device_kind: Optional[str]) -> Optional[float]:
+    """Per-chip DCN (inter-slice) bandwidth in bytes/s for a
+    ``device_kind`` string, or None when unknown.  The
+    ``BIGDL_TPU_DCN_BYTES_PER_S`` env var overrides the table
+    unconditionally (measured fleet numbers beat public specs; smoke
+    tests pin it slow to force a ``dcn_bound`` verdict)."""
+    env = os.environ.get("BIGDL_TPU_DCN_BYTES_PER_S")
+    if env:
+        try:
+            return float(env)
+        except ValueError:
+            logger.warning(
+                "BIGDL_TPU_DCN_BYTES_PER_S=%r is not a number; "
+                "ignoring the override and using the spec table "
+                "(pass plain bytes/s, e.g. 12.5e9)", env)
+    return _lookup(_DCN_BYTES_PER_S, device_kind)
 
 
 # ---------------------------------------------------------------------------
@@ -202,26 +239,34 @@ def roofline_verdict(flops_per_step: Optional[float],
                      peak_flops: Optional[float],
                      hbm_bytes_per_s: Optional[float],
                      comm_bytes_per_step: Optional[float] = None,
-                     ici_bytes_per_s: Optional[float] = None) \
+                     ici_bytes_per_s: Optional[float] = None,
+                     dcn_bytes_per_step: Optional[float] = None,
+                     dcn_bytes_per_s: Optional[float] = None) \
         -> Optional[Dict[str, Any]]:
-    """Compute-bound vs HBM-bound vs comm-bound from the analytic cost
-    model: the step's minimum time on the MXU (flops/peak) against its
-    minimum time on the memory system (bytes/bandwidth) and — when a
-    comm budget is known (``collective_hlo_bytes`` /
-    ``collective_bytes_total``) — on the interconnect
-    (comm bytes/ICI bandwidth).  The largest floor is the binding
-    resource; ``attainable_step_s`` is the best step time this program
-    can reach on this device no matter how well scheduled.  Returns
-    None when no floor is computable; ``verdict`` is None with fewer
-    than two floors (nothing to compare)."""
+    """Compute-bound vs HBM-bound vs comm-bound vs dcn-bound from the
+    analytic cost model: the step's minimum time on the MXU
+    (flops/peak) against its minimum time on the memory system
+    (bytes/bandwidth), on the interconnect when a comm budget is known
+    (``collective_hlo_bytes`` / ``collective_bytes_total`` over ICI
+    bandwidth), and — on a two-tier mesh — on the SLOW network tier
+    (the cross-slice payload from ``cross_group_hlo_bytes`` or the
+    hierarchical ``grad_allreduce_bytes`` floor, over DCN bandwidth).
+    The largest floor is the binding resource; ``attainable_step_s``
+    is the best step time this program can reach on this device no
+    matter how well scheduled.  A ``dcn_bound`` verdict says: compress
+    the cross-slice hop or grow the slice — more ICI won't help.
+    Returns None when no floor is computable; ``verdict`` is None with
+    fewer than two floors (nothing to compare)."""
     t_compute = (flops_per_step / peak_flops
                  if flops_per_step and peak_flops else None)
     t_hbm = (bytes_per_step / hbm_bytes_per_s
              if bytes_per_step and hbm_bytes_per_s else None)
     t_comm = (comm_bytes_per_step / ici_bytes_per_s
               if comm_bytes_per_step and ici_bytes_per_s else None)
+    t_dcn = (dcn_bytes_per_step / dcn_bytes_per_s
+             if dcn_bytes_per_step and dcn_bytes_per_s else None)
     floors = {"compute_bound": t_compute, "hbm_bound": t_hbm,
-              "comm_bound": t_comm}
+              "comm_bound": t_comm, "dcn_bound": t_dcn}
     known = {k: v for k, v in floors.items() if v is not None}
     if not known:
         return None
@@ -234,6 +279,8 @@ def roofline_verdict(flops_per_step: Optional[float],
     }
     if t_comm is not None:
         out["min_comm_s"] = t_comm
+    if t_dcn is not None:
+        out["min_dcn_s"] = t_dcn
     if flops_per_step and bytes_per_step:
         out["arithmetic_intensity_flops_per_byte"] = (
             flops_per_step / bytes_per_step)
@@ -252,7 +299,9 @@ def attribution_report(records: List[Dict[str, Any]],
                        device_kind: Optional[str] = None,
                        skip_first: int = 1,
                        comm_bytes_per_step: Optional[float] = None,
-                       ici_bytes_per_s: Optional[float] = None) \
+                       ici_bytes_per_s: Optional[float] = None,
+                       dcn_bytes_per_step: Optional[float] = None,
+                       dcn_bytes_per_s: Optional[float] = None) \
         -> Optional[Dict[str, Any]]:
     """The full perf-attribution table: phase decomposition + MFU
     accounting + roofline verdict, as one JSON-able dict (what
@@ -280,6 +329,8 @@ def attribution_report(records: List[Dict[str, Any]],
         hbm_bytes_per_s = device_hbm_bytes_per_s(device_kind)
     if ici_bytes_per_s is None:
         ici_bytes_per_s = device_ici_bytes_per_s(device_kind)
+    if dcn_bytes_per_s is None:
+        dcn_bytes_per_s = device_dcn_bytes_per_s(device_kind)
     if device_kind:
         report["device_kind"] = device_kind
     if flops_per_step:
@@ -301,6 +352,15 @@ def attribution_report(records: List[Dict[str, Any]],
                 comm["fraction_of_device_compute"] = min(
                     t_comm / dev_s, 1.0)
         report["comm"] = comm
+    if dcn_bytes_per_step:
+        # the slow-tier slice of the comm budget, stated on its own:
+        # the dcn hop has its own (much lower) bandwidth floor, and on
+        # a multi-slice step it is usually the one that binds
+        dcn: Dict[str, Any] = {
+            "bytes_per_step": float(dcn_bytes_per_step)}
+        if dcn_bytes_per_s:
+            dcn["min_dcn_s"] = dcn_bytes_per_step / dcn_bytes_per_s
+        report["dcn"] = dcn
     wall_step = report["wall_step_s"]
     device_step = report["phases_s"]["device_compute"]
     mfu: Dict[str, Optional[float]] = {}
@@ -316,7 +376,9 @@ def attribution_report(records: List[Dict[str, Any]],
         flops_per_step, bytes_per_step,
         peak_measured_flops or peak_spec_flops, hbm_bytes_per_s,
         comm_bytes_per_step=comm_bytes_per_step,
-        ici_bytes_per_s=ici_bytes_per_s)
+        ici_bytes_per_s=ici_bytes_per_s,
+        dcn_bytes_per_step=dcn_bytes_per_step,
+        dcn_bytes_per_s=dcn_bytes_per_s)
     if roof is not None:
         report["roofline"] = roof
     try:

@@ -11,10 +11,10 @@ unwrapping lives in exactly one place.
 from __future__ import annotations
 
 import re
-from typing import Dict, Optional
+from typing import Dict, List, Mapping, Optional
 
 __all__ = ["compiled_flops", "compiled_bytes", "cost_breakdown",
-           "collective_hlo_bytes"]
+           "collective_hlo_bytes", "cross_group_hlo_bytes"]
 
 
 def _cost_dict(compiled) -> dict:
@@ -121,6 +121,137 @@ def comm_bytes_from_hlo_text(text: str) -> Dict[str, float]:
             continue  # counted at the matching -done
         m = _COLL_LINE_RE.search(line)
         if m is None:
+            continue
+        nbytes = _shapes_nbytes(m.group("shapes"))
+        op = m.group("op")
+        out[op] = out.get(op, 0.0) + nbytes
+        out["total"] += nbytes
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Cross-group (e.g. cross-slice / DCN) payload classification
+# ---------------------------------------------------------------------------
+# A two-tier mesh cares WHERE the bytes go, not just how many: the
+# hierarchical gradient sync (parallel/hierarchy.py) exists to shrink
+# the cross-slice payload specifically.  The HLO's replica_groups name
+# the participating logical devices, so a collective can be classified
+# by whether its groups span more than one slice.  XLA prints groups
+# two ways; both are decoded:
+#
+# * explicit:  replica_groups={{0,1,2,3},{4,5,6,7}}
+# * iota:      replica_groups=[4,2]<=[2,4]T(1,0)   (meaning: arange over
+#   the <= dims, transposed by T's permutation, reshaped to [4,2])
+#
+# collective-permute prints neither: its topology is
+# source_target_pairs={{0,1},{1,2},...} — each (src, tgt) pair is
+# decoded as a two-device group so a ring strictly inside one slice
+# (ring attention's seq axis, pipeline stage hops) classifies as
+# intra-slice instead of falling through to "spans everything".
+
+_RG_EXPLICIT_RE = re.compile(
+    r"replica_groups=\{(\{[0-9, ]*\}(?:, *\{[0-9, ]*\})*)\}")
+_RG_IOTA_RE = re.compile(
+    r"replica_groups=\[(\d+),(\d+)\]<=\[([0-9,]+)\]"
+    r"(?:T\(([0-9,]+)\))?")
+_STP_RE = re.compile(
+    r"source_target_pairs=\{(\{[0-9, ]*\}(?:, *\{[0-9, ]*\})*)\}")
+
+
+def _replica_groups_of(line: str) -> Optional[List[List[int]]]:
+    """The replica groups of one HLO line, or None when the line
+    carries none (``{}``/absent means "all devices in one group" — the
+    caller decides what that spans)."""
+    m = _RG_EXPLICIT_RE.search(line)
+    if m:
+        groups = []
+        for grp in re.findall(r"\{([0-9, ]*)\}", m.group(1)):
+            ids = [int(t) for t in grp.replace(" ", "").split(",") if t]
+            if ids:
+                groups.append(ids)
+        return groups or None
+    m = _RG_IOTA_RE.search(line)
+    if m:
+        import numpy as _np
+        n_groups, group_size = int(m.group(1)), int(m.group(2))
+        dims = [int(t) for t in m.group(3).split(",") if t]
+        base = _np.arange(int(_np.prod(dims))).reshape(dims)
+        if m.group(4):
+            perm = [int(t) for t in m.group(4).split(",") if t]
+            base = base.transpose(perm)
+        return base.reshape(n_groups, group_size).tolist()
+    m = _STP_RE.search(line)
+    if m:
+        pairs = []
+        for grp in re.findall(r"\{([0-9, ]*)\}", m.group(1)):
+            ids = [int(t) for t in grp.replace(" ", "").split(",") if t]
+            if ids:
+                pairs.append(ids)
+        return pairs or None
+    return None
+
+
+def cross_group_hlo_bytes(compiled_or_text,
+                          group_of: Mapping[int, int]) \
+        -> Optional[Dict[str, float]]:
+    """Collective payload bytes that CROSS device groups, out of a
+    compiled module (or raw HLO text).
+
+    ``group_of`` maps logical device position → group id (for a
+    two-tier mesh: ``parallel.hierarchy.dcn_slice_map(mesh)`` — slice
+    index per device).  A collective counts iff any of its replica
+    groups contains devices from more than one group; same
+    per-opcode-output-payload convention and return shape as
+    :func:`collective_hlo_bytes`, so the two read as "total comm" vs
+    "comm over the slow tier".  Collectives printing no replica groups
+    involve every device and count whenever more than one group
+    exists.  Returns None when the module text is unavailable."""
+    if isinstance(compiled_or_text, str):
+        text = compiled_or_text
+    else:
+        try:
+            text = compiled_or_text.as_text()
+        except Exception:
+            return None
+        if not text:
+            return None
+    multi_group = len(set(group_of.values())) > 1
+
+    # async pairs: the groups live on the -start line, the payload is
+    # counted at the -done — remember each start's groups by its
+    # result variable so the done can look them up through its operand
+    start_groups: Dict[str, Optional[List[List[int]]]] = {}
+    for line in text.splitlines():
+        if "-start(" not in line:
+            continue
+        mv = re.match(r"\s*(?:ROOT\s+)?(%[\w.\-]+)\s*=", line)
+        if mv:
+            start_groups[mv.group(1)] = _replica_groups_of(line)
+
+    def crosses(line: str) -> bool:
+        groups = _replica_groups_of(line)
+        if groups is None and "-done(" in line:
+            operands = re.findall(r"%[\w.\-]+",
+                                  line.split("-done(", 1)[1])
+            for tok in operands:
+                if tok in start_groups:
+                    groups = start_groups[tok]
+                    break
+        if groups is None:
+            return multi_group
+        for grp in groups:
+            ids = {group_of.get(d) for d in grp}
+            ids.discard(None)
+            if len(ids) > 1:
+                return True
+        return False
+
+    out: Dict[str, float] = {"total": 0.0}
+    for line in text.splitlines():
+        if "-start(" in line:
+            continue  # counted at the matching -done
+        m = _COLL_LINE_RE.search(line)
+        if m is None or not crosses(line):
             continue
         nbytes = _shapes_nbytes(m.group("shapes"))
         op = m.group("op")

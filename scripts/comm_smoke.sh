@@ -1,0 +1,117 @@
+#!/usr/bin/env bash
+# Hierarchical-sync / wire-compression smoke: on an 8-virtual-device
+# fake mesh shaped (dcn=2, data=4) —
+#   1. compiled-HLO cross-slice bytes: the hierarchical step's
+#      dcn-axis payload must be <= 55% of the flat fp32 all-reduce
+#      baseline under the bf16 wire and <= 30% under int8
+#      (cross_group_hlo_bytes over dcn_slice_map);
+#   2. int8 codec round-trip error must stay inside the per-bucket
+#      bound (max|bucket|/127), and the hierarchical+bf16 Optimizer
+#      run's final loss must match flat sync within 1e-2 relative at
+#      a fixed seed;
+#   3. roofline: with BIGDL_TPU_DCN_BYTES_PER_S pinned slow, the
+#      verdict over the analytic dcn floor must print `dcn_bound`.
+# See docs/parallelism.md "Hierarchical sync & wire compression".
+#
+# Standalone: exits non-zero on any failed assertion.
+# scripts/tier1.sh runs it warn-only after the suite.
+set -o pipefail
+cd "$(dirname "$0")/.."
+exec env JAX_PLATFORMS=cpu \
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 python - <<'PY'
+import os
+
+import numpy as np
+
+import jax
+
+from bigdl_tpu import nn
+from bigdl_tpu.dataset.dataset import DataSet, MiniBatch, Sample
+from bigdl_tpu.dataset import SampleToMiniBatch
+from bigdl_tpu.optim import Optimizer, Trigger
+from bigdl_tpu.optim.methods import SGD
+from bigdl_tpu.parallel import MeshConfig
+from bigdl_tpu.parallel.compression import Int8Codec
+from bigdl_tpu.parallel.hierarchy import dcn_slice_map
+from bigdl_tpu.parallel.sharding import grad_allreduce_bytes
+from bigdl_tpu.utils import set_seed
+from bigdl_tpu.utils.xla_cost import cross_group_hlo_bytes
+
+rng = np.random.default_rng(5)
+x_np = rng.normal(size=(16, 16)).astype(np.float32)
+y_np = rng.integers(1, 11, size=(16,)).astype(np.int64)
+
+
+def make_opt(hierarchical=False, wire=None, data=None):
+    set_seed(99)
+    model = nn.Sequential(nn.Linear(16, 32), nn.ReLU(),
+                          nn.Linear(32, 10), nn.LogSoftMax())
+    samples = [Sample(x_np[i % 16], int(y_np[i % 16]))
+               for i in range(64)]
+    ds = (DataSet.array(list(samples), shuffle=False)
+          .transform(SampleToMiniBatch(16)))
+    opt = (Optimizer(model, ds, nn.ClassNLLCriterion())
+           .set_optim_method(SGD(0.1))
+           .set_mesh(MeshConfig(dcn=2, data=-1)))
+    if hierarchical:
+        opt.set_gradient_sync(hierarchical=True, wire_dtype=wire)
+    return opt
+
+
+# ---- 1. compiled cross-slice bytes: bf16 halves, int8 quarters ---------
+mesh = MeshConfig(dcn=2, data=-1).build()
+sm = dcn_slice_map(mesh)
+batch = MiniBatch(x_np, y_np)
+base = cross_group_hlo_bytes(make_opt().compile_step(batch), sm)["total"]
+bf16 = cross_group_hlo_bytes(
+    make_opt(True, "bf16").compile_step(batch), sm)["total"]
+int8 = cross_group_hlo_bytes(
+    make_opt(True, "int8").compile_step(batch), sm)["total"]
+assert base > 0
+assert bf16 <= 0.55 * base, (bf16, base)
+assert int8 <= 0.30 * base, (int8, base)
+
+# ---- 2a. int8 codec round-trip error bound -----------------------------
+import jax.numpy as jnp
+v = jnp.asarray(rng.normal(size=(2048,)) * 2.0, jnp.float32)
+codec = Int8Codec(bucket_size=256, stochastic=True)
+out = np.asarray(codec.decode(codec.encode(v, key=jax.random.key(0)),
+                              v.shape[0]))
+vb = np.asarray(v).reshape(-1, 256)
+bound = np.abs(vb).max(axis=1) / 127.0 + 1e-7
+err = np.abs(out - np.asarray(v)).reshape(-1, 256)
+assert (err <= bound[:, None]).all(), (err.max(), bound.min())
+
+# ---- 2b. hierarchical+bf16 trains to the flat-sync loss ----------------
+def train(opt):
+    opt.set_end_when(Trigger.max_iteration(20)).set_log_interval(1)
+    opt.optimize()
+    return float(opt.state["loss"])
+
+l_flat = train(make_opt())
+l_hier = train(make_opt(True, "bf16"))
+assert abs(l_hier - l_flat) <= 1e-2 * abs(l_flat), (l_hier, l_flat)
+
+# ---- 3. dcn_bound verdict when the dcn table is pinned slow ------------
+from bigdl_tpu.telemetry import perf as tperf
+
+os.environ["BIGDL_TPU_DCN_BYTES_PER_S"] = "1e3"  # pathologically slow
+est = grad_allreduce_bytes(
+    make_opt(True, "bf16").model, mesh, hierarchical=True,
+    wire_dtype="bf16")
+roof = tperf.roofline_verdict(
+    1e9, 1e6, 197e12, 819e9,
+    comm_bytes_per_step=est["bytes_per_step"], ici_bytes_per_s=200e9,
+    dcn_bytes_per_step=est["dcn_bytes_per_step"],
+    dcn_bytes_per_s=tperf.device_dcn_bytes_per_s(None))
+os.environ.pop("BIGDL_TPU_DCN_BYTES_PER_S", None)
+assert roof["verdict"] == "dcn_bound", roof
+print(f"roofline verdict: {roof['verdict']} "
+      f"(min_dcn_s {roof['min_dcn_s']:.3e})")
+
+print("comm_smoke: OK (cross-slice bytes flat "
+      f"{base:.0f} B -> bf16 {bf16:.0f} B [{bf16 / base:.0%}] / int8 "
+      f"{int8:.0f} B [{int8 / base:.0%}]; int8 round-trip bounded; "
+      f"hier+bf16 loss {l_hier:.4f} vs flat {l_flat:.4f}; pinned-slow "
+      f"dcn table -> dcn_bound)")
+PY

@@ -106,11 +106,13 @@ def time_step(name, model_fn, batch=128, size=224, window=10, reps=3,
     by = cost["bytes"]
     comm = cost.get("comm_bytes")
     if comm:
-        # the step's inter-chip budget from the compiled HLO — the
-        # number that decides whether a compression hop (ROADMAP item
-        # 3) is worth building before anyone builds it
+        # the step's inter-chip budget from the compiled HLO, stamped
+        # with the sync mode that produced it (perf_lab steps use the
+        # flat XLA-inserted sync at full width; the hierarchical /
+        # compressed numbers come from scripts/comm_smoke.sh and the
+        # bench round's comm_wire_dtype field)
         print(f"[{name}] {comm / window / 1e6:7.2f} MB/step inter-chip "
-              f"(HLO collectives)", flush=True)
+              f"(HLO collectives, sync=flat wire=fp32)", flush=True)
     if by:
         import jax
         from bigdl_tpu.telemetry import perf as perf_attr

@@ -62,4 +62,14 @@ if bash "$(dirname "$0")/fleet_smoke.sh" >"$fleet_log" 2>&1; then
 else
   echo "fleet_smoke: FAILED (non-fatal ride-along; see $fleet_log)"
 fi
+# hierarchical-sync / wire-compression smoke (HLO cross-slice bytes
+# halve under bf16, int8 codec round-trip bound, hier+bf16 loss
+# equivalence, pinned-slow dcn table -> dcn_bound): warn-only
+# ride-along; run scripts/comm_smoke.sh standalone for the fatal form
+comm_log=$(mktemp /tmp/comm_smoke.XXXXXX.log)
+if bash "$(dirname "$0")/comm_smoke.sh" >"$comm_log" 2>&1; then
+  tail -n 1 "$comm_log"
+else
+  echo "comm_smoke: FAILED (non-fatal ride-along; see $comm_log)"
+fi
 exit $rc

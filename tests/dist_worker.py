@@ -201,6 +201,46 @@ def main():
         with open(os.path.join(outdir, "ok_fleet"), "w") as f:
             f.write("straggler-named")
 
+    # leg 5: fake-DCN hierarchical sync — the 4 global devices as a
+    # (dcn=2, data=2) mesh (2 processes × 2 local devices ≙ 2 slices),
+    # trained hierarchical+bf16 via set_gradient_sync and compared to
+    # the flat XLA-inserted sync at the same fixed seed: per-iteration
+    # losses must agree within bf16 wire tolerance, proving the
+    # rs-in-slice / compressed-dcn-hop / ag-in-slice schedule crosses
+    # process boundaries correctly.
+    if nproc == 2:
+        from bigdl_tpu.parallel import MeshConfig
+
+        def leg5_run(hierarchical):
+            set_seed(123)
+            log = LossLog()
+            ds6 = (DataSet.sharded(samples, shuffle=False,
+                                   process_index=pid,
+                                   process_count=nproc)
+                   .transform(SampleToMiniBatch(4)))
+            opt6 = (Optimizer(make_model(), ds6,
+                              nn.CrossEntropyCriterion())
+                    .set_optim_method(SGD(0.1))
+                    .set_end_when(Trigger.max_epoch(2))
+                    .set_mesh(MeshConfig(dcn=2, data=-1))
+                    .set_train_summary(log))
+            if hierarchical:
+                opt6.set_gradient_sync(hierarchical=True,
+                                       wire_dtype="bf16")
+            opt6.optimize()
+            return log.losses
+
+        flat_losses = leg5_run(False)
+        hier_losses = leg5_run(True)
+        assert set(hier_losses) == set(flat_losses)
+        for step, v in flat_losses.items():
+            assert abs(hier_losses[step] - v) <= 1e-2 * max(abs(v), 1.0), (
+                f"iteration {step}: hierarchical+bf16 loss "
+                f"{hier_losses[step]} vs flat {v}")
+        if pid == 0:
+            with open(os.path.join(outdir, "ok_dcn"), "w") as f:
+                f.write("hierarchical-bf16-matches-flat")
+
     # all processes must exit cleanly for the parent to pass
     print(f"worker {pid}: done", flush=True)
 

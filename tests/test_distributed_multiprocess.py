@@ -77,6 +77,12 @@ def test_two_process_train_checkpoint_resume(tmp_path):
     # `straggler` anomaly — asserted in dist_worker.py
     assert os.path.exists(os.path.join(outdir, "ok_fleet")), \
         "fleet telemetry / straggler-detection leg did not complete"
+    # leg 5 inside the workers: the 4 global devices (2 processes × 2
+    # local) as a (dcn=2, data=2) mesh, hierarchical+bf16 gradient
+    # sync (set_gradient_sync) must match the flat-sync run's
+    # per-iteration losses within bf16 tolerance
+    assert os.path.exists(os.path.join(outdir, "ok_dcn")), \
+        "fake-DCN hierarchical-sync leg did not complete"
 
     # ---- single-process oracle: identical schedule, identical global
     # batch composition ([process-0 shard rows | process-1 shard rows])

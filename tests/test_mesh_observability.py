@@ -155,9 +155,6 @@ def test_collective_accounting_matches_hlo_cross_check():
         wrapper_total, hlo)
 
 
-@pytest.mark.skipif(not hasattr(jax, "shard_map"),
-                    reason="repo parallel modules need jax.shard_map "
-                           "(pre-existing env gap)")
 def test_ring_attention_sp_step_cross_check():
     """Satellite: the HLO cross-check within tolerance on a compiled
     sp step (ring attention) — the wrappers see every ppermute the
