@@ -1,0 +1,170 @@
+"""``python -m bigdl_tpu.analysis`` — the graftlint CLI.
+
+Usage::
+
+    python -m bigdl_tpu.analysis                 # AST passes, fatal
+    python -m bigdl_tpu.analysis --warn-only     # CI ride-along
+    python -m bigdl_tpu.analysis --hlo           # + compiled-HLO passes
+    python -m bigdl_tpu.analysis --json out.json # machine report
+    python -m bigdl_tpu.analysis --select clock-discipline,trace-safety
+    python -m bigdl_tpu.analysis --list          # rule catalog
+    python -m bigdl_tpu.analysis --update-baseline  # excuse current
+                                                    # errors (then EDIT
+                                                    # the justifications)
+
+Exit status: 1 when any unsuppressed ``error`` finding remains (and
+not ``--warn-only``), else 0.  ``scripts/lint.sh`` is the fatal
+wrapper CI and ship habits use; see docs/static_analysis.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m bigdl_tpu.analysis",
+        description="graftlint: rule-based static analysis for "
+                    "bigdl_tpu (AST + compiled-HLO passes)")
+    p.add_argument("root", nargs="?", default=None,
+                   help="package root to lint (default: the installed "
+                        "bigdl_tpu package)")
+    p.add_argument("--warn-only", action="store_true",
+                   help="always exit 0 (CI ride-along mode)")
+    p.add_argument("--json", metavar="FILE",
+                   help="write the machine report (all findings incl. "
+                        "suppressed) to FILE")
+    p.add_argument("--select", metavar="RULES",
+                   help="comma-separated rule ids to run (default all)")
+    p.add_argument("--hlo", action="store_true",
+                   help="also run the compiled-HLO passes (compiles "
+                        "probe programs; needs >= 8 devices — forces "
+                        "the virtual-CPU fallback)")
+    p.add_argument("--hlo-only", action="store_true",
+                   help="run ONLY the compiled-HLO passes")
+    p.add_argument("--baseline", metavar="FILE", default=None,
+                   help="baseline file (default "
+                        "scripts/graftlint_baseline.json)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore the baseline (show the full debt)")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="append every active error to the baseline "
+                        "with an empty justification — the lint stays "
+                        "red until each entry is justified by hand")
+    p.add_argument("--show-suppressed", action="store_true",
+                   help="also print pragma/baseline-suppressed findings")
+    p.add_argument("--list", action="store_true",
+                   help="list registered passes and exit")
+    args = p.parse_args(argv)
+
+    if args.hlo or args.hlo_only:
+        # must land before the first backend touch
+        from bigdl_tpu.analysis.hlo_lint import ensure_backend
+        ensure_backend()
+
+    from bigdl_tpu.analysis import (
+        apply_suppressions, counts_of, default_baseline_path,
+        get_passes, load_baseline, load_tree, render_human, render_json,
+        run_ast_passes, write_baseline,
+    )
+    from bigdl_tpu.analysis.hlo_lint import HLO_RULES
+
+    if args.list:
+        for info in get_passes(kind="ast"):
+            print(f"{info.name:24s} [ast] {info.doc}")
+        for rule in HLO_RULES:
+            print(f"{rule:24s} [hlo] see "
+                  f"bigdl_tpu/analysis/hlo_lint.py")
+        return 0
+
+    select = (set(t.strip() for t in args.select.split(",") if t.strip())
+              if args.select else None)
+    ast_select = (None if select is None
+                  else [r for r in select if not r.startswith("hlo-")])
+    if select is not None:
+        unknown_hlo = {r for r in select
+                       if r.startswith("hlo-")} - set(HLO_RULES)
+        if unknown_hlo:
+            p.error(f"unknown HLO rule(s) {sorted(unknown_hlo)}; "
+                    f"known: {list(HLO_RULES)}")
+        if any(r.startswith("hlo-") for r in select) and not (
+                args.hlo or args.hlo_only):
+            # selecting an hlo rule IS asking for the HLO passes — a
+            # run that silently checks nothing and prints OK would be
+            # worse than an error
+            args.hlo = True
+
+    findings = []
+    tree = None
+    ran_rules = {"parse-error"}
+    if not args.hlo_only:
+        tree = load_tree(args.root)
+        if ast_select is None or ast_select:
+            sel = ast_select if ast_select else None
+            tree, findings = run_ast_passes(tree, select=sel)
+            for info in get_passes(kind="ast", select=sel):
+                ran_rules.update(info.rules)
+    if args.hlo or args.hlo_only:
+        from bigdl_tpu.analysis.hlo_lint import run_hlo_passes
+        hlo_select = (None if select is None
+                      else {r for r in select if r.startswith("hlo-")})
+        findings.extend(run_hlo_passes(
+            select=hlo_select if hlo_select else None))
+        ran_rules.update(hlo_select if hlo_select else HLO_RULES)
+    if tree is None:
+        tree = load_tree(args.root)
+
+    baseline_path = args.baseline or default_baseline_path()
+    baseline = [] if args.no_baseline else load_baseline(baseline_path)
+    apply_suppressions(findings, tree, baseline,
+                       baseline_path=baseline_path,
+                       ran_rules=ran_rules)
+
+    if args.update_baseline:
+        # merge with what the FILE holds, not the in-memory view —
+        # --no-baseline + --update-baseline must never rewrite the
+        # baseline from empty and destroy the justified entries
+        entries = list(load_baseline(baseline_path))
+        known = {(e["rule"], e["file"], e["scope"], e["code"])
+                 for e in entries}
+        added = 0
+        for f in findings:
+            if f.suppressed or f.severity != "error":
+                continue
+            key = (f.rule, f.file, f.scope, f.code)
+            if key in known:
+                continue
+            known.add(key)
+            entries.append({**f.key(), "justification": ""})
+            added += 1
+        path = write_baseline(entries, baseline_path)
+        print(f"graftlint: baseline: added {added} entr(ies) to {path} "
+              f"— fill in every empty justification before shipping")
+
+    for line in render_human(findings,
+                             show_suppressed=args.show_suppressed):
+        print(line)
+    counts = counts_of(findings)
+    if args.json:
+        meta = {"root": os.path.relpath(tree.root, tree.repo),
+                "hlo": bool(args.hlo or args.hlo_only),
+                "warn_only": bool(args.warn_only)}
+        with open(args.json, "w", encoding="utf-8") as f:
+            f.write(render_json(findings, meta))
+            f.write("\n")
+    status = (f"{counts['error']} error(s), {counts['warning']} "
+              f"warning(s), {counts['info']} info, "
+              f"{counts['suppressed']} suppressed")
+    if counts["error"] and not args.warn_only:
+        print(f"graftlint: FAILED ({status})")
+        return 1
+    print(f"graftlint: OK ({status})" if not counts["error"]
+          else f"graftlint: {status} (warn-only)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,210 @@
+"""Shared AST infrastructure for the graftlint passes.
+
+One parse of the package per run: :func:`load_tree` walks a source
+root, parses every ``.py`` into a :class:`FileSource` (text, split
+lines, AST, inline-pragma map, import tables), and the passes consume
+the resulting :class:`SourceTree`.  Parsing failures become findings
+(rule ``parse-error``) instead of crashing the run — a lint that dies
+on the broken file it should be reporting is useless mid-incident.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from bigdl_tpu.analysis.findings import Finding
+
+__all__ = ["FileSource", "SourceTree", "load_tree", "repo_root",
+           "call_name", "call_attr_chain", "mesh_axes"]
+
+# `# graftlint: disable=rule-a,rule-b -- optional reason`
+_PRAGMA_RE = re.compile(
+    r"#\s*graftlint:\s*disable=([a-z0-9\-_,\s]+?)(?:\s*--.*)?$")
+
+
+def repo_root() -> str:
+    """The repository root (parent of the ``bigdl_tpu`` package)."""
+    import bigdl_tpu
+    return os.path.dirname(os.path.dirname(
+        os.path.abspath(bigdl_tpu.__file__)))
+
+
+class FileSource:
+    """One parsed source file."""
+
+    __slots__ = ("path", "rel", "module", "text", "lines", "tree",
+                 "pragmas")
+
+    def __init__(self, path: str, rel: str, module: str, text: str,
+                 tree: Optional[ast.AST]):
+        self.path = path
+        self.rel = rel            # repo-relative, posix separators
+        self.module = module      # dotted module name
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = tree
+        # 1-based line -> rules disabled on that line
+        self.pragmas: Dict[int, Set[str]] = {}
+        for i, line in enumerate(self.lines, 1):
+            m = _PRAGMA_RE.search(line)
+            if m:
+                self.pragmas[i] = {
+                    t.strip() for t in m.group(1).split(",") if t.strip()}
+
+    def code_at(self, line: int) -> str:
+        """The stripped source of a 1-based line ("" out of range)."""
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()
+        return ""
+
+    def pragma_disables(self, line: int, rule: str) -> bool:
+        """True when ``rule`` is pragma-disabled for ``line`` — by a
+        trailing comment on the line itself, or by a pragma anywhere in
+        the contiguous block of comment-only lines directly above it
+        (so a pragma's ``-- reason`` may wrap over several comment
+        lines)."""
+        check = [line]
+        ln = line - 1
+        while ln >= 1 and self.lines[ln - 1].lstrip().startswith("#"):
+            check.append(ln)
+            ln -= 1
+        for ln in check:
+            rules = self.pragmas.get(ln)
+            if rules and (rule in rules or "all" in rules):
+                return True
+        return False
+
+
+class SourceTree:
+    """Every parsed file of one lint run, keyed by repo-relative
+    path."""
+
+    def __init__(self, root: str, repo: str):
+        self.root = root          # the directory that was walked
+        self.repo = repo          # repo root (paths are relative to it)
+        self.files: Dict[str, FileSource] = {}
+        self.parse_findings: List[Finding] = []
+
+    def __iter__(self) -> Iterator[FileSource]:
+        for rel in sorted(self.files):
+            yield self.files[rel]
+
+    def get(self, rel: str) -> Optional[FileSource]:
+        return self.files.get(rel)
+
+    def finding(self, rule: str, severity: str, src: FileSource,
+                line: int, message: str, scope: str = "") -> Finding:
+        """A finding anchored in ``src`` with the code line filled in
+        (the baseline identity needs it)."""
+        return Finding(rule, severity, src.rel, line, message,
+                       scope=scope, code=src.code_at(line))
+
+
+def _module_name(rel: str) -> str:
+    mod = rel[:-3] if rel.endswith(".py") else rel
+    mod = mod.replace("/", ".").replace("\\", ".")
+    if mod.endswith(".__init__"):
+        mod = mod[: -len(".__init__")]
+    return mod
+
+
+def load_tree(root: Optional[str] = None,
+              repo: Optional[str] = None) -> SourceTree:
+    """Parse every ``.py`` under ``root`` (default: the ``bigdl_tpu``
+    package) into a :class:`SourceTree`."""
+    repo = repo or repo_root()
+    root = root or os.path.join(repo, "bigdl_tpu")
+    tree = SourceTree(root, repo)
+    for dirpath, dirnames, files in os.walk(root):
+        dirnames[:] = [d for d in sorted(dirnames)
+                       if d != "__pycache__"]
+        for fname in sorted(files):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            rel = os.path.relpath(path, repo).replace(os.sep, "/")
+            with open(path, "r", encoding="utf-8") as f:
+                text = f.read()
+            try:
+                parsed = ast.parse(text, filename=rel)
+            except SyntaxError as e:
+                src = FileSource(path, rel, _module_name(rel), text, None)
+                tree.files[rel] = src
+                tree.parse_findings.append(Finding(
+                    "parse-error", "error", rel, e.lineno or 0,
+                    f"cannot parse: {e.msg}"))
+                continue
+            tree.files[rel] = FileSource(path, rel, _module_name(rel),
+                                         text, parsed)
+    return tree
+
+
+# ---------------------------------------------------------------------------
+# call-site helpers
+# ---------------------------------------------------------------------------
+
+def call_name(node: ast.Call) -> str:
+    """The last name segment of a call's callee (``f`` for both
+    ``f(...)`` and ``a.b.f(...)``), "" when dynamic."""
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return ""
+
+
+def call_attr_chain(node: ast.Call) -> Tuple[str, ...]:
+    """The dotted callee as name segments: ``jax.lax.psum(...)`` ->
+    ("jax", "lax", "psum").  Empty when the base is not a plain name
+    chain (subscripts, calls)."""
+    parts: List[str] = []
+    f = node.func
+    while isinstance(f, ast.Attribute):
+        parts.append(f.attr)
+        f = f.value
+    if isinstance(f, ast.Name):
+        parts.append(f.id)
+        return tuple(reversed(parts))
+    return ()
+
+
+def imports_of(mod_ast: ast.AST) -> Tuple[Dict[str, str],
+                                          Dict[str, Tuple[str, str]]]:
+    """(module-alias table, from-import table) for a module, walking
+    EVERY import statement including function-local ones (a resolution
+    over-approximation a lint is allowed)."""
+    mod_alias: Dict[str, str] = {}
+    from_import: Dict[str, Tuple[str, str]] = {}
+    for node in ast.walk(mod_ast):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                mod_alias[a.asname or a.name.split(".")[0]] = a.name
+        elif isinstance(node, ast.ImportFrom) and node.module \
+                and node.level == 0:
+            for a in node.names:
+                from_import[a.asname or a.name] = (node.module, a.name)
+    return mod_alias, from_import
+
+
+def mesh_axes(tree: SourceTree) -> Set[str]:
+    """The canonical mesh axis names, read from the ``AXES`` tuple
+    literal in ``parallel/mesh.py`` — by AST, so the AST passes never
+    need a live jax import.  Falls back to the known set when the file
+    moved (the collective-discipline pass then still works)."""
+    src = tree.get("bigdl_tpu/parallel/mesh.py")
+    if src is not None and src.tree is not None:
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Assign) \
+                    and any(isinstance(t, ast.Name) and t.id == "AXES"
+                            for t in node.targets) \
+                    and isinstance(node.value, ast.Tuple):
+                names = {e.value for e in node.value.elts
+                         if isinstance(e, ast.Constant)
+                         and isinstance(e.value, str)}
+                if names:
+                    return names
+    return {"dcn", "data", "fsdp", "model", "pipe", "seq", "expert"}

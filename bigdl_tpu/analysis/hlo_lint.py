@@ -1,0 +1,421 @@
+"""Compiled-HLO lint: invariants of the programs training actually
+dispatches.
+
+The AST passes prove source-level discipline; these passes read the
+OPTIMIZED HLO of real compiled steps — through the same
+``Optimizer.compile_step`` + ``utils/xla_cost`` machinery the comm
+tooling uses — and pin the invariants no AST can see:
+
+* ``hlo-cross-slice`` — the single-slice flat-DP step emits ZERO
+  collectives classified as crossing slices (the classifier's ground
+  truth), and the 2-slice flat baseline emits MORE than zero (the
+  classifier is not blind) — together they anchor every ratio below;
+* ``hlo-dcn-ratio`` — the hierarchical step's cross-slice payload vs
+  the flat fp32 all-reduce baseline stays within the PR-8 acceptance
+  envelope (fp32/int8 <= 30%, bf16 <= 55% on the CPU backend, which
+  emulates bf16 collectives in f32);
+* ``hlo-narrow-wire`` — the permanent regression pin for the PR-8
+  widening bug: every dcn-spanning collective of the compressed int8
+  step carries its payload in s8 (the f32 residue — per-bucket scales,
+  the scalar loss pmean — must stay a small fraction of the crossing
+  bytes).  With ``BIGDL_TPU_UNPIN_DCN_WIRE=1`` (the deliberate
+  failure-mode seam in ``parallel/hierarchy.py``) this pass MUST flag
+  the program — asserted in tests, runnable by hand via
+  ``BIGDL_TPU_UNPIN_DCN_WIRE=1 python -m bigdl_tpu.analysis
+  --hlo-only --select hlo-narrow-wire`` (must FAIL);
+* ``hlo-fast-tier`` — the hierarchical schedule's fast-tier
+  reduce-scatter never spans slices (a mesh-layout regression would
+  silently put the full-width scatter on the slow wire);
+* ``hlo-donation`` — donated input buffers actually elide the
+  full-size parameter copy: the entry's ``input_output_alias`` covers
+  at least the model's parameter bytes;
+* ``hlo-recompile`` — lowering the same step twice yields the same
+  program (nondeterministic lowering is per-step recompile risk);
+* ``hlo-host-callback`` — the compiled step contains no host
+  callbacks, and an ``info`` census of collective/custom-call counts
+  per program.
+
+Needs a backend with >= 8 devices (the 2-slice fake-DCN mesh); the CLI
+forces the 8-virtual-CPU-device fallback exactly like the test suite.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Tuple
+
+from bigdl_tpu.analysis.findings import Finding
+
+__all__ = ["ensure_backend", "run_hlo_passes", "narrow_wire_report",
+           "donated_alias_bytes", "HLO_RULES"]
+
+HLO_RULES = ("hlo-cross-slice", "hlo-dcn-ratio", "hlo-narrow-wire",
+             "hlo-fast-tier", "hlo-donation", "hlo-recompile",
+             "hlo-host-callback")
+
+# the PR-8 acceptance envelope (tests/test_hierarchy.py pins the same
+# numbers): cross-slice payload vs the flat fp32 all-reduce baseline
+_RATIO_BOUNDS = {"fp32": 0.30, "int8": 0.30, "bf16": 0.55}
+# f32 residue allowed on the compressed wire: int8 scales are one f32
+# per <=512-element bucket, plus the scalar loss pmean — far under a
+# quarter of the crossing bytes on any real gradient
+_MAX_WIDE_FRACTION = 0.25
+
+_N_DEVICES = 8
+
+
+def ensure_backend(n_devices: int = _N_DEVICES):
+    """Guarantee >= n_devices on a CPU backend (the same
+    virtual-device fallback tests/conftest.py uses), returning the jax
+    module.  Raises with the XLA_FLAGS recipe when the backend was
+    initialized too early to grow."""
+    import os
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    m = re.search(r"--xla_force_host_platform_device_count=(\d+)", flags)
+    if m is None:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
+    elif int(m.group(1)) < n_devices:
+        os.environ["XLA_FLAGS"] = flags.replace(
+            m.group(0),
+            f"--xla_force_host_platform_device_count={n_devices}")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    if len(jax.devices()) >= n_devices:
+        return jax
+    import jax.extend.backend
+
+    jax.extend.backend.clear_backends()
+    if len(jax.devices()) < n_devices:
+        raise RuntimeError(
+            f"hlo_lint needs {n_devices} devices but the jax backend "
+            f"initialized before the device-count flag could land; "
+            f"run with XLA_FLAGS=--xla_force_host_platform_device_count"
+            f"={n_devices} JAX_PLATFORMS=cpu")
+    return jax
+
+
+def _finding(rule: str, severity: str, program: str,
+             message: str) -> Finding:
+    """HLO findings anchor on the program, not a source line — the
+    ``file`` is the pseudo-path ``<hlo>`` and the baseline identity
+    rides (rule, program, invariant)."""
+    return Finding(rule, severity, "<hlo>", 0, message,
+                   scope=program, code=rule)
+
+
+class _Programs:
+    """Lazy cache of the compiled probe programs (compiles are the
+    expensive part; every pass shares one cache)."""
+
+    def __init__(self):
+        self.jax = ensure_backend()
+        self._cache: Dict[Tuple, object] = {}
+        self._meshes: Dict[str, object] = {}
+
+    # -- builders ----------------------------------------------------------
+
+    def _optimizer(self, mesh_axes: Dict[str, int], hierarchical: bool,
+                   wire: Optional[str]):
+        import numpy as np
+
+        import bigdl_tpu.nn as nn
+        from bigdl_tpu.dataset.dataset import Sample
+        from bigdl_tpu.optim import Optimizer, SGD
+        from bigdl_tpu.parallel.mesh import MeshConfig
+        from bigdl_tpu.utils import set_seed
+
+        set_seed(99)
+        model = nn.Sequential(nn.Linear(16, 32), nn.ReLU(),
+                              nn.Linear(32, 10), nn.LogSoftMax())
+        opt = (Optimizer(model, [Sample(np.zeros(16, np.float32), 1)],
+                         nn.ClassNLLCriterion(), batch_size=16)
+               .set_optim_method(SGD(0.1))
+               .set_mesh(MeshConfig(**mesh_axes)))
+        if hierarchical:
+            opt.set_gradient_sync(hierarchical=True, wire_dtype=wire)
+        return opt, model
+
+    def _mini_batch(self):
+        import numpy as np
+
+        from bigdl_tpu.dataset.dataset import MiniBatch
+
+        rng = np.random.default_rng(5)
+        return MiniBatch(rng.normal(size=(16, 16)).astype(np.float32),
+                         rng.integers(1, 11, size=(16,)).astype(np.int64))
+
+    def compiled(self, kind: str):
+        """kind: "flat8" (data=8, single slice), "dcn-flat",
+        "dcn-hier-fp32" / "-bf16" / "-int8"."""
+        if kind in self._cache:
+            return self._cache[kind]
+        if kind == "flat8":
+            opt, _ = self._optimizer({"data": _N_DEVICES}, False, None)
+        elif kind == "dcn-flat":
+            opt, _ = self._optimizer({"dcn": 2, "data": -1}, False, None)
+        else:
+            wire = kind.rsplit("-", 1)[-1]
+            opt, _ = self._optimizer({"dcn": 2, "data": -1}, True,
+                                     None if wire == "fp32" else wire)
+        self._cache[kind] = opt.compile_step(self._mini_batch())
+        return self._cache[kind]
+
+    def param_nbytes(self) -> int:
+        _, model = self._optimizer({"data": _N_DEVICES}, False, None)
+        total = 0
+        for leaf in self.jax.tree_util.tree_leaves(model.parameters()):
+            total += int(leaf.size) * leaf.dtype.itemsize
+        return total
+
+    def slice_map(self, kind: str) -> Dict[int, int]:
+        from bigdl_tpu.parallel.hierarchy import dcn_slice_map
+        from bigdl_tpu.parallel.mesh import make_mesh
+
+        axes = ({"data": _N_DEVICES} if kind == "flat8"
+                else {"dcn": 2, "data": -1})
+        key = "flat8" if kind == "flat8" else "dcn"
+        if key not in self._meshes:
+            self._meshes[key] = make_mesh(
+                axes, self.jax.devices()[:_N_DEVICES])
+        return dcn_slice_map(self._meshes[key])
+
+
+# ---------------------------------------------------------------------------
+# the individual checks (each returns findings; empty = invariant holds)
+# ---------------------------------------------------------------------------
+
+def narrow_wire_report(compiled_or_text, group_of) -> Dict[str, float]:
+    """Byte census of the dcn-CROSSING collectives by dtype width:
+    ``{"narrow_bytes", "wide_bytes", "total", "wide_fraction"}`` —
+    narrow = sub-32-bit payloads (s8, bf16), wide = 32-bit-and-up.
+    The narrow-wire invariant is ``wide_fraction <= 0.25`` AND
+    ``narrow_bytes > 0``."""
+    from bigdl_tpu.utils.xla_cost import (
+        cross_group_hlo_lines, shape_tokens_nbytes,
+    )
+
+    narrow = wide = 0.0
+    for op, shapes, crosses in (cross_group_hlo_lines(
+            compiled_or_text, group_of) or []):
+        if not crosses:
+            continue
+        for _dtype, bits, nbytes in shape_tokens_nbytes(shapes):
+            if bits < 32:
+                narrow += nbytes
+            else:
+                wide += nbytes
+    total = narrow + wide
+    return {"narrow_bytes": narrow, "wide_bytes": wide, "total": total,
+            "wide_fraction": (wide / total) if total else 0.0}
+
+
+def _check_cross_slice(progs: _Programs) -> List[Finding]:
+    from bigdl_tpu.utils.xla_cost import cross_group_hlo_bytes
+
+    out: List[Finding] = []
+    flat8 = cross_group_hlo_bytes(progs.compiled("flat8"),
+                                  progs.slice_map("flat8"))
+    if flat8 is None:
+        out.append(_finding("hlo-cross-slice", "error", "flat8",
+                            "compiled module text unavailable"))
+    elif flat8["total"] != 0.0:
+        out.append(_finding(
+            "hlo-cross-slice", "error", "flat8",
+            f"the single-slice flat-DP step emits {flat8['total']:.0f} "
+            f"bytes of slice-crossing collectives — a single-slice "
+            f"program must emit none (classifier ground truth)"))
+    base = cross_group_hlo_bytes(progs.compiled("dcn-flat"),
+                                 progs.slice_map("dcn-flat"))
+    if base is None or base["total"] <= 0.0:
+        out.append(_finding(
+            "hlo-cross-slice", "error", "dcn-flat",
+            "the 2-slice flat baseline shows no cross-slice bytes — "
+            "the classifier is blind (dcn axis not in the mesh? "
+            "replica-group decoding broken?) and every ratio pin "
+            "downstream is vacuous"))
+    return out
+
+
+def _check_dcn_ratio(progs: _Programs) -> List[Finding]:
+    from bigdl_tpu.utils.xla_cost import cross_group_hlo_bytes
+
+    out: List[Finding] = []
+    sm = progs.slice_map("dcn-flat")
+    base = cross_group_hlo_bytes(progs.compiled("dcn-flat"), sm)
+    if not base or base["total"] <= 0:
+        return out  # hlo-cross-slice already reported the broken base
+    ratios = {}
+    for wire, bound in sorted(_RATIO_BOUNDS.items()):
+        cross = cross_group_hlo_bytes(
+            progs.compiled(f"dcn-hier-{wire}"), sm)
+        if cross is None:
+            out.append(_finding(
+                "hlo-dcn-ratio", "error", f"dcn-hier-{wire}",
+                "compiled module text unavailable — the ratio pin "
+                "cannot be proven"))
+            continue
+        ratio = cross["total"] / base["total"]
+        ratios[wire] = round(ratio, 4)
+        if ratio > bound:
+            out.append(_finding(
+                "hlo-dcn-ratio", "error", f"dcn-hier-{wire}",
+                f"cross-slice payload is {ratio:.1%} of the flat fp32 "
+                f"baseline (bound {bound:.0%}) — the hierarchical "
+                f"schedule regressed ({cross['total']:.0f} / "
+                f"{base['total']:.0f} B)"))
+    out.append(_finding(
+        "hlo-dcn-ratio", "info", "dcn-hier",
+        f"cross-slice bytes vs flat baseline: {ratios} "
+        f"(bounds {_RATIO_BOUNDS}, baseline {base['total']:.0f} B)"))
+    return out
+
+
+def _check_narrow_wire(progs: _Programs) -> List[Finding]:
+    out: List[Finding] = []
+    sm = progs.slice_map("dcn-flat")
+    rep = narrow_wire_report(progs.compiled("dcn-hier-int8"), sm)
+    if rep["narrow_bytes"] <= 0:
+        out.append(_finding(
+            "hlo-narrow-wire", "error", "dcn-hier-int8",
+            f"no sub-32-bit payload crosses the dcn tier — the int8 "
+            f"wire has been widened (the PR-8 optimization_barrier pin "
+            f"is gone or bypassed); crossing bytes: {rep}"))
+    elif rep["wide_fraction"] > _MAX_WIDE_FRACTION:
+        out.append(_finding(
+            "hlo-narrow-wire", "error", "dcn-hier-int8",
+            f"{rep['wide_fraction']:.1%} of the dcn-crossing payload is "
+            f"32-bit+ (allowed {_MAX_WIDE_FRACTION:.0%} for scales + "
+            f"the scalar loss) — part of the compressed wire widened "
+            f"back; crossing bytes: {rep}"))
+    # NOTE bf16 is NOT pinned here: the CPU backend emulates bf16
+    # collectives in f32 (visible in the HLO itself), so the narrow
+    # invariant genuinely does not hold off-TPU — the byte RATIO pin
+    # above still bounds the bf16 wire.
+    return out
+
+
+def _check_fast_tier(progs: _Programs) -> List[Finding]:
+    from bigdl_tpu.utils.xla_cost import cross_group_hlo_bytes
+
+    out: List[Finding] = []
+    sm = progs.slice_map("dcn-flat")
+    for wire in sorted(_RATIO_BOUNDS):
+        cross = cross_group_hlo_bytes(
+            progs.compiled(f"dcn-hier-{wire}"), sm)
+        if cross is None:
+            out.append(_finding(
+                "hlo-fast-tier", "error", f"dcn-hier-{wire}",
+                "compiled module text unavailable — the fast-tier "
+                "invariant cannot be proven"))
+            continue
+        rs = cross.get("reduce-scatter", 0.0)
+        if rs > 0:
+            out.append(_finding(
+                "hlo-fast-tier", "error", f"dcn-hier-{wire}",
+                f"the fast-tier reduce-scatter spans slices "
+                f"({rs:.0f} B cross-slice) — the mesh layout no longer "
+                f"keeps the intra-slice stages on ICI"))
+    return out
+
+
+def donated_alias_bytes(text: str) -> Tuple[float, int]:
+    """(total bytes of entry parameters aliased to outputs, number of
+    aliased parameters) from a compiled module's
+    ``input_output_alias`` map + entry layout."""
+    m = re.search(r"entry_computation_layout=\{\((.*?)\)->", text,
+                  re.DOTALL)
+    if m is None:
+        return 0.0, 0
+    from bigdl_tpu.utils.xla_cost import shape_tokens_nbytes
+
+    param_bytes = [b for _d, _bits, b in shape_tokens_nbytes(m.group(1))]
+    am = re.search(r"input_output_alias=\{(.*?)\}, *\w+=", text,
+                   re.DOTALL)
+    if am is None:
+        am = re.search(r"input_output_alias=\{(.*?)\}", text, re.DOTALL)
+    if am is None:
+        return 0.0, 0
+    aliased = {int(g) for g in re.findall(r":\s*\((\d+)", am.group(1))}
+    total = sum(b for i, b in enumerate(param_bytes) if i in aliased)
+    return total, len(aliased)
+
+
+def _check_donation(progs: _Programs) -> List[Finding]:
+    out: List[Finding] = []
+    text = progs.compiled("flat8").as_text()
+    need = progs.param_nbytes()
+    got, n = donated_alias_bytes(text)
+    if got < need:
+        out.append(_finding(
+            "hlo-donation", "error", "flat8",
+            f"donated inputs alias only {got:.0f} B of outputs but the "
+            f"model holds {need} B of parameters — the full-size "
+            f"parameter copy is NOT elided (donate_argnums dropped? "
+            f"aliasing defeated by a layout change?)"))
+    else:
+        out.append(_finding(
+            "hlo-donation", "info", "flat8",
+            f"donation OK: {n} aliased buffers cover {got:.0f} B >= "
+            f"{need} B of parameters"))
+    return out
+
+
+def _check_recompile(progs: _Programs) -> List[Finding]:
+    out: List[Finding] = []
+    opt, _ = progs._optimizer({"data": _N_DEVICES}, False, None)
+    a = opt.compile_step(progs._mini_batch()).as_text()
+    opt2, _ = progs._optimizer({"data": _N_DEVICES}, False, None)
+    b = opt2.compile_step(progs._mini_batch()).as_text()
+    if a != b:
+        out.append(_finding(
+            "hlo-recompile", "warning", "flat8",
+            "lowering the same step twice produced different HLO — "
+            "nondeterministic lowering busts jit caches and shows up "
+            "as per-step recompiles in production"))
+    return out
+
+
+def _check_host_callback(progs: _Programs) -> List[Finding]:
+    out: List[Finding] = []
+    for kind in ("flat8", "dcn-hier-int8"):
+        text = progs.compiled(kind).as_text()
+        callbacks = len(re.findall(
+            r"custom-call[^\n]*callback", text))
+        custom = text.count("custom-call")
+        colls = sum(text.count(f"{op}(") + text.count(f"{op}-done(")
+                    for op in ("all-reduce", "all-gather", "all-to-all",
+                               "reduce-scatter", "collective-permute"))
+        if callbacks:
+            out.append(_finding(
+                "hlo-host-callback", "error", kind,
+                f"{callbacks} host callback(s) inside the compiled "
+                f"step — each one stalls the device on the host every "
+                f"iteration"))
+        out.append(_finding(
+            "hlo-host-callback", "info", kind,
+            f"program census: {colls} collective op(s), {custom} "
+            f"custom-call(s), {callbacks} host callback(s)"))
+    return out
+
+
+_CHECKS = (_check_cross_slice, _check_dcn_ratio, _check_narrow_wire,
+           _check_fast_tier, _check_donation, _check_recompile,
+           _check_host_callback)
+
+
+def run_hlo_passes(select=None) -> List[Finding]:
+    """Compile the probe programs and run every HLO check (or the
+    subset ``select`` names by rule id)."""
+    progs = _Programs()
+    findings: List[Finding] = []
+    for check in _CHECKS:
+        rule = check.__name__.replace("_check_", "hlo-").replace(
+            "_", "-")
+        if select is not None and rule not in select:
+            continue
+        findings.extend(check(progs))
+    return findings

@@ -1,0 +1,11 @@
+"""graftlint AST passes.  Importing this package registers every pass
+with :mod:`bigdl_tpu.analysis.registry` (one module per rule family —
+adding a rule is adding a file here)."""
+
+from bigdl_tpu.analysis.passes import (  # noqa: F401
+    clock_discipline,
+    collective_discipline,
+    lock_discipline,
+    metrics_catalog,
+    trace_safety,
+)

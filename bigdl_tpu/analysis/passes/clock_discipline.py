@@ -1,0 +1,172 @@
+"""clock-discipline: one trace clock, wall time for timestamps only.
+
+The telemetry contract (``telemetry/tracing.py``): span intervals and
+every in-process DURATION are measured on ``time.perf_counter()`` —
+the monotonic clock spans are exported on — while ``time.time()`` is
+for TIMESTAMPS (manifest stamps, event times, cross-process staleness
+comparisons) where epoch meaning is required.  PR 3's review round
+found optimizer spans stranded ~an epoch off-timeline because the two
+were mixed; wall-clock durations are also simply wrong across an NTP
+step.  This pass flags:
+
+* a ``time.time()`` DIFFERENCE — any subtraction with a wall-tainted
+  operand (a direct call, a local assigned from one, a ``self`` attr
+  assigned from one anywhere in the class, or a module global) — used
+  where a duration on the monotonic clock belongs;
+* a wall-tainted value passed to ``record_span`` — a span stamped off
+  the trace clock's timeline.
+
+Legal wall-clock uses (pure timestamps: storing ``time.time()`` in a
+record, comparing against another process's epoch stamp) either don't
+subtract in-process or carry a pragma naming why wall time is required
+(see ``telemetry/fleet.py`` staleness checks).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from bigdl_tpu.analysis.astutil import SourceTree, call_attr_chain
+from bigdl_tpu.analysis.findings import Finding
+from bigdl_tpu.analysis.registry import register_pass
+
+RULE = "clock-discipline"
+
+
+def _is_wall_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    chain = call_attr_chain(node)
+    return chain[-2:] == ("time", "time") or chain == ("time",)
+
+
+class _FuncTaint(ast.NodeVisitor):
+    """Per-function taint walk.  ``class_attrs`` carries the enclosing
+    class's wall-tainted ``self.X`` names; ``module_names`` the
+    module-global ones."""
+
+    def __init__(self, tree: SourceTree, src, scope: str,
+                 class_attrs: Set[str], module_names: Set[str],
+                 findings: List[Finding]):
+        self.tree = tree
+        self.src = src
+        self.scope = scope
+        self.class_attrs = class_attrs
+        self.module_names = module_names
+        self.locals: Set[str] = set()
+        self.findings = findings
+
+    # -- taint sources -----------------------------------------------------
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        if _is_wall_call(node.value):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    self.locals.add(t.id)
+                elif isinstance(t, ast.Attribute) \
+                        and isinstance(t.value, ast.Name) \
+                        and t.value.id == "self":
+                    self.class_attrs.add(t.attr)
+        self.generic_visit(node)
+
+    def _tainted(self, node: ast.AST) -> bool:
+        if _is_wall_call(node):
+            return True
+        if isinstance(node, ast.Name):
+            return node.id in self.locals or node.id in self.module_names
+        if isinstance(node, ast.Attribute) \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == "self":
+            return node.attr in self.class_attrs
+        return False
+
+    # -- taint sinks -------------------------------------------------------
+
+    def visit_BinOp(self, node: ast.BinOp) -> None:
+        if isinstance(node.op, ast.Sub) and (
+                self._tainted(node.left) or self._tainted(node.right)):
+            self.findings.append(self.tree.finding(
+                RULE, "error", self.src, node.lineno,
+                "wall-clock (time.time) difference used as a duration "
+                "— use time.perf_counter(), the trace clock; wall "
+                "clock is for timestamps only "
+                "(telemetry/tracing.py clock contract)",
+                scope=self.scope))
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        chain = call_attr_chain(node)
+        if chain and chain[-1] == "record_span":
+            stamps = list(node.args[1:3]) + [
+                kw.value for kw in node.keywords
+                if kw.arg in ("t_start", "t_end")]
+            if any(self._tainted(a) for a in stamps):
+                self.findings.append(self.tree.finding(
+                    RULE, "error", self.src, node.lineno,
+                    "record_span stamped with a time.time() value — "
+                    "spans live on the perf_counter trace clock; a "
+                    "wall stamp strands the span off-timeline",
+                    scope=self.scope))
+        self.generic_visit(node)
+
+    # nested defs get their own walker (fresh locals, shared attrs)
+    def visit_FunctionDef(self, node) -> None:
+        if getattr(self, "_entered", False):
+            sub = _FuncTaint(self.tree, self.src,
+                             f"{self.scope}.{node.name}",
+                             self.class_attrs, self.module_names,
+                             self.findings)
+            sub._entered = True
+            for child in node.body:
+                sub.visit(child)
+        else:
+            self._entered = True
+            self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+def _wall_attrs_of_class(cls: ast.ClassDef) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and _is_wall_call(node.value):
+            for t in node.targets:
+                if isinstance(t, ast.Attribute) \
+                        and isinstance(t.value, ast.Name) \
+                        and t.value.id == "self":
+                    out.add(t.attr)
+    return out
+
+
+@register_pass(RULE, doc="time.time() differences used as durations / "
+                         "span stamps off the perf_counter trace clock")
+def run(tree: SourceTree) -> List[Finding]:
+    findings: List[Finding] = []
+    for src in tree:
+        if src.tree is None:
+            continue
+        module_names: Set[str] = {
+            t.id for node in src.tree.body
+            if isinstance(node, ast.Assign) and _is_wall_call(node.value)
+            for t in node.targets if isinstance(t, ast.Name)}
+
+        def walk(body, scope: str, class_attrs: Optional[Set[str]]):
+            for node in body:
+                if isinstance(node, ast.ClassDef):
+                    qual = f"{scope}.{node.name}" if scope else node.name
+                    walk(node.body, qual, _wall_attrs_of_class(node))
+                elif isinstance(node, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    qual = f"{scope}.{node.name}" if scope else node.name
+                    v = _FuncTaint(tree, src, qual,
+                                   class_attrs if class_attrs is not None
+                                   else set(), module_names, findings)
+                    v._entered = True
+                    for child in node.body:
+                        v.visit(child)
+                elif isinstance(node, (ast.If, ast.Try, ast.With)):
+                    walk(ast.iter_child_nodes(node), scope, class_attrs)
+
+        walk(src.tree.body, "", None)
+    return findings

@@ -1,0 +1,114 @@
+"""collective-discipline: every byte on the wire must be accounted.
+
+PR 7's instrumented wrappers (:mod:`bigdl_tpu.telemetry.collectives`)
+exist so that ``collective_bytes_total{op,axis}`` states the true comm
+budget of a compiled step.  A raw ``jax.lax.psum`` call site anywhere
+else moves bytes that silently vanish from that accounting — the exact
+drift the PR-7 review rounds kept re-finding by hand.  Two rules:
+
+* ``collective-discipline``: a raw ``lax.<collective>`` call outside
+  ``telemetry/collectives.py``.  Carve-out: ``lax.psum(<const>, axis)``
+  — the axis-size probe idiom (``psum(1, a)``) constant-folds at trace
+  time and never lowers to a collective, so it moves nothing.
+* ``collective-axis``: a string-literal axis name passed to a
+  collective (wrapper or raw) that is not one of the canonical mesh
+  axes in ``parallel/mesh.AXES`` — a typo'd axis fails at run time
+  deep inside a shard_map; a renamed axis silently stops matching.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from bigdl_tpu.analysis.astutil import (
+    SourceTree, call_attr_chain, mesh_axes,
+)
+from bigdl_tpu.analysis.findings import Finding
+from bigdl_tpu.analysis.registry import register_pass
+
+RULE = "collective-discipline"
+AXIS_RULE = "collective-axis"
+
+_COLLECTIVES = {"psum", "pmean", "all_gather", "all_to_all", "ppermute",
+                "psum_scatter", "reduce_scatter"}
+# the one module allowed to touch jax.lax collectives directly
+_HOME = "bigdl_tpu/telemetry/collectives.py"
+
+# positional index of the axis-name argument per collective
+_AXIS_ARG = {name: 1 for name in _COLLECTIVES}
+
+
+def _scope_stack_walk(tree_node: ast.AST):
+    """Yield (node, scope) with scope the dotted enclosing qualname."""
+    stack: List[tuple] = [(tree_node, "")]
+    while stack:
+        node, scope = stack.pop()
+        yield node, scope
+        child_scope = scope
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            child_scope = f"{scope}.{node.name}" if scope else node.name
+        for child in ast.iter_child_nodes(node):
+            stack.append((child, child_scope))
+
+
+def _axis_literals(call: ast.Call, name: str) -> List[str]:
+    """String-literal axis names passed to a collective call (positional
+    or ``axis_name=``); [] when the axis is a variable."""
+    node = None
+    idx = _AXIS_ARG.get(name)
+    if idx is not None and len(call.args) > idx:
+        node = call.args[idx]
+    for kw in call.keywords:
+        if kw.arg == "axis_name":
+            node = kw.value
+    out: List[str] = []
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        out.append(node.value)
+    elif isinstance(node, (ast.Tuple, ast.List)):
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                out.append(e.value)
+    return out
+
+
+@register_pass(RULE, doc="raw jax.lax collectives bypassing the "
+                         "accounting wrappers; non-canonical axis-name "
+                         "literals", rules=(AXIS_RULE,))
+def run(tree: SourceTree) -> List[Finding]:
+    axes = mesh_axes(tree)
+    findings: List[Finding] = []
+    for src in tree:
+        if src.tree is None:
+            continue
+        for node, scope in _scope_stack_walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = call_attr_chain(node)
+            if not chain or chain[-1] not in _COLLECTIVES:
+                continue
+            name = chain[-1]
+            is_raw = len(chain) >= 2 and chain[-2] == "lax"
+            if is_raw and src.rel != _HOME:
+                # axis-size probe: psum of a literal constant folds at
+                # trace time, no collective is lowered
+                if not (name in ("psum", "pmean") and node.args
+                        and isinstance(node.args[0], ast.Constant)):
+                    findings.append(tree.finding(
+                        RULE, "error", src, node.lineno,
+                        f"raw jax.lax.{name} bypasses the "
+                        f"telemetry.collectives accounting wrappers — "
+                        f"its bytes vanish from collective_bytes_total; "
+                        f"route it through "
+                        f"bigdl_tpu.telemetry.collectives.{name}",
+                        scope=scope))
+            for axis in _axis_literals(node, name):
+                if axis not in axes:
+                    findings.append(tree.finding(
+                        AXIS_RULE, "error", src, node.lineno,
+                        f"axis name {axis!r} passed to {name} is not a "
+                        f"canonical mesh axis "
+                        f"(parallel/mesh.AXES = {sorted(axes)})",
+                        scope=scope))
+    return findings

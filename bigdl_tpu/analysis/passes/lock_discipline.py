@@ -1,0 +1,226 @@
+"""lock-discipline: infer each class's guarded state, flag naked access.
+
+The threaded tiers (telemetry exporters/rings, the serving scheduler,
+the data-pipeline prefetchers) follow one convention: a thread-shared
+class owns a ``threading.Lock`` and every access to the state that
+lock guards happens inside ``with self._lock:``.  The PR 3/4/5 review
+rounds each caught a site that forgot — this pass mechanizes the
+check.
+
+Inference, per class in the thread-shared packages (``telemetry/``,
+``serving/``, ``data/``):
+
+* the class is **thread-shared** iff it assigns a
+  ``threading.Lock()`` / ``RLock()`` / ``Condition()`` to a ``self``
+  attribute (or stores a lock passed in under a ``*lock``-named attr);
+* the **guarded set** is every ``self.X`` read or written inside any
+  ``with self.<lock>:`` block of the class — MINUS attributes never
+  mutated after ``__init__`` (no assignment, augmented assignment,
+  subscript store, or mutator-method call outside the constructor):
+  those are immutable configuration a locked block merely happens to
+  read, not guarded state;
+* a read/write of a guarded attribute OUTSIDE every with-lock block is
+  a finding — except in ``__init__``/``__new__`` (construction
+  happens-before publication, the standard exemption).
+
+Deliberate lock-free reads (racy-but-monotonic counters, snapshot
+fast paths) exist; they carry a pragma or a baseline entry saying WHY
+the race is benign — which is exactly the review the convention wants.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Set
+
+from bigdl_tpu.analysis.astutil import SourceTree, call_attr_chain
+from bigdl_tpu.analysis.findings import Finding
+from bigdl_tpu.analysis.registry import register_pass
+
+RULE = "lock-discipline"
+
+# packages whose classes follow the thread-shared convention
+_SCOPES = ("bigdl_tpu/telemetry/", "bigdl_tpu/serving/",
+           "bigdl_tpu/data/")
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition"}
+_LOCKY_NAME = re.compile(r"(^|_)(lock|mutex|cond)$")
+_EXEMPT_METHODS = {"__init__", "__new__"}
+
+# method calls that mutate the receiver in place (deques, dicts, sets,
+# lists) — `self.x.append(...)` is a write to x even though the
+# attribute node itself is a Load
+_MUTATORS = {"append", "appendleft", "extend", "extendleft", "insert",
+             "pop", "popleft", "remove", "discard", "clear", "add",
+             "update", "setdefault", "sort", "reverse", "rotate"}
+
+
+def _is_lock_ctor(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    chain = call_attr_chain(node)
+    return bool(chain) and chain[-1] in _LOCK_CTORS
+
+
+def _self_attr(node: ast.AST) -> str:
+    """'X' for a ``self.X`` attribute node, else ''."""
+    if isinstance(node, ast.Attribute) and isinstance(node.value,
+                                                      ast.Name) \
+            and node.value.id == "self":
+        return node.attr
+    return ""
+
+
+def _lock_attrs(cls: ast.ClassDef) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                attr = _self_attr(t)
+                if not attr:
+                    continue
+                if _is_lock_ctor(node.value):
+                    out.add(attr)
+                elif _LOCKY_NAME.search(attr) \
+                        and isinstance(node.value, ast.Name):
+                    # e.g. `self._lock = lock` (a shared lock handed in)
+                    out.add(attr)
+    return out
+
+
+def _with_holds_lock(node: ast.With, locks: Set[str]) -> bool:
+    for item in node.items:
+        expr = item.context_expr
+        # `with self._lock:` or `with self._lock as ...:`
+        if _self_attr(expr) in locks:
+            return True
+        # `with self._cond:` via a Call like self._lock.acquire() — not
+        # the convention here; keep the inference narrow
+    return False
+
+
+class _ClassWalk:
+    """Two passes over one class body: collect guarded attrs, then
+    flag naked accesses."""
+
+    def __init__(self, tree: SourceTree, src, scope: str,
+                 cls: ast.ClassDef, findings: List[Finding]):
+        self.tree = tree
+        self.src = src
+        self.scope = scope
+        self.cls = cls
+        self.findings = findings
+        self.locks = _lock_attrs(cls)
+        self.guarded: Set[str] = set()
+        self.mutated: Set[str] = set()   # written after __init__
+
+    def run(self) -> None:
+        if not self.locks:
+            return
+        for meth in self.cls.body:
+            if isinstance(meth, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._collect(meth, under_lock=False)
+                if meth.name not in _EXEMPT_METHODS:
+                    self._collect_writes(meth)
+        self.guarded -= self.locks
+        # immutable configuration (never mutated after __init__) is not
+        # guarded state, however often a locked block reads it
+        self.guarded &= self.mutated
+        if not self.guarded:
+            return
+        for meth in self.cls.body:
+            if isinstance(meth, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and meth.name not in _EXEMPT_METHODS:
+                self._flag(meth, meth.name, under_lock=False)
+
+    # -- pass 1: guarded set ----------------------------------------------
+
+    def _collect(self, node: ast.AST, under_lock: bool) -> None:
+        if isinstance(node, ast.With):
+            inner = under_lock or _with_holds_lock(node, self.locks)
+            for child in ast.iter_child_nodes(node):
+                self._collect(child, inner)
+            return
+        if under_lock:
+            attr = _self_attr(node)
+            if attr:
+                self.guarded.add(attr)
+        for child in ast.iter_child_nodes(node):
+            self._collect(child, under_lock)
+
+    def _collect_writes(self, meth: ast.AST) -> None:
+        """Attrs mutated outside __init__: plain/aug/subscript stores
+        and in-place mutator calls (``self.x.append(...)``)."""
+        for node in ast.walk(meth):
+            attr = _self_attr(node)
+            if attr and isinstance(getattr(node, "ctx", None),
+                                   (ast.Store, ast.Del)):
+                self.mutated.add(attr)
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    if isinstance(t, ast.Subscript):
+                        a = _self_attr(t.value)
+                        if a:
+                            self.mutated.add(a)
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _MUTATORS:
+                a = _self_attr(node.func.value)
+                if a:
+                    self.mutated.add(a)
+
+    # -- pass 2: naked accesses -------------------------------------------
+
+    def _flag(self, node: ast.AST, meth: str, under_lock: bool) -> None:
+        if isinstance(node, ast.With):
+            inner = under_lock or _with_holds_lock(node, self.locks)
+            for child in ast.iter_child_nodes(node):
+                self._flag(child, meth, inner)
+            return
+        if not under_lock:
+            attr = _self_attr(node)
+            if attr and attr in self.guarded:
+                kind = ("write" if isinstance(getattr(node, "ctx", None),
+                                              (ast.Store, ast.Del))
+                        else "read")
+                self.findings.append(self.tree.finding(
+                    RULE, "error", self.src, node.lineno,
+                    f"{kind} of {self.cls.name}.{attr} outside the "
+                    f"lock: this attribute is accessed under "
+                    f"`with self.{sorted(self.locks)[0]}:` elsewhere "
+                    f"in the class — take the lock, or pragma with the "
+                    f"reason the race is benign",
+                    scope=f"{self.scope}.{meth}"))
+                return  # one finding per attribute node
+        for child in ast.iter_child_nodes(node):
+            self._flag(child, meth, under_lock)
+
+
+@register_pass(RULE, doc="reads/writes of lock-guarded attributes "
+                         "outside the lock in thread-shared classes")
+def run(tree: SourceTree) -> List[Finding]:
+    findings: List[Finding] = []
+    for src in tree:
+        if src.tree is None or not src.rel.startswith(_SCOPES):
+            continue
+        scopes: List[tuple] = [(src.tree, "")]
+        classes: Dict[str, ast.ClassDef] = {}
+        while scopes:
+            node, scope = scopes.pop()
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    qual = (f"{scope}.{child.name}" if scope
+                            else child.name)
+                    classes[qual] = child
+                    scopes.append((child, qual))
+                elif isinstance(child, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                    qual = (f"{scope}.{child.name}" if scope
+                            else child.name)
+                    scopes.append((child, qual))
+        for qual in sorted(classes):
+            _ClassWalk(tree, src, qual, classes[qual], findings).run()
+    return findings

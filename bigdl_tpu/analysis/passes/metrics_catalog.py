@@ -1,0 +1,181 @@
+"""metrics-catalog: telemetry metric/span names vs the documented
+catalog (the former ``scripts/metrics_lint.py``, as a registered pass).
+
+Rules (unchanged from the standalone lint — see docs/observability.md):
+
+* metric names snake_case, span names ``/``-separated snake_case;
+* one declaration site per metric family (``telemetry/families.py``);
+* every registered metric in the docs/observability.md catalog, every
+  recorded span in its "Span inventory" table;
+* the reverse direction (documented but never registered/recorded) is
+  a warning — docs may describe families a gated backend registers
+  lazily.
+
+``scripts/metrics_lint.py`` remains as a thin CLI shim over this
+module so ``tier1.sh``, the smokes, and ship habits don't change.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, NamedTuple, Optional, Set, Tuple
+
+from bigdl_tpu.analysis.astutil import SourceTree, call_name, load_tree
+from bigdl_tpu.analysis.findings import Finding
+from bigdl_tpu.analysis.registry import register_pass
+
+RULE = "metrics-catalog"
+
+_METRIC_FNS = {"counter", "gauge", "histogram"}
+_SPAN_FNS = {"span", "record_span"}
+
+_METRIC_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+_SPAN_RE = re.compile(r"^[a-z][a-z0-9_]*(/[a-z][a-z0-9_]*)*$")
+
+# a name in backticks is "documented" wherever it appears in the doc
+_DOC_NAME_RE = re.compile(r"`([a-z][a-z0-9_/]*)`")
+
+
+class Site(NamedTuple):
+    name: str
+    kind: str
+    file: str
+    line: int
+
+
+def collect(tree: SourceTree) -> Tuple[List[Site], List[Site]]:
+    metrics: List[Site] = []
+    spans: List[Site] = []
+    for src in tree:
+        if src.tree is None:
+            continue
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            arg0 = node.args[0]
+            if not (isinstance(arg0, ast.Constant)
+                    and isinstance(arg0.value, str)):
+                continue
+            callee = call_name(node)
+            if callee in _METRIC_FNS:
+                metrics.append(Site(arg0.value, callee, src.rel,
+                                    node.lineno))
+            elif callee in _SPAN_FNS:
+                spans.append(Site(arg0.value, callee, src.rel,
+                                  node.lineno))
+    return metrics, spans
+
+
+def documented_names(doc_path: str) -> Set[str]:
+    if not os.path.isfile(doc_path):
+        return set()
+    with open(doc_path, "r", encoding="utf-8") as f:
+        return set(_DOC_NAME_RE.findall(f.read()))
+
+
+def span_inventory(doc_path: str) -> Set[str]:
+    """Span names from the doc's "## Span inventory" section — the
+    first backticked name of each table row.  The INVENTORY table is
+    the contract, not a name incidentally backticked in prose."""
+    if not os.path.isfile(doc_path):
+        return set()
+    with open(doc_path, "r", encoding="utf-8") as f:
+        text = f.read()
+    out: Set[str] = set()
+    in_section = False
+    for line in text.splitlines():
+        if line.startswith("## "):
+            in_section = line.lower().startswith("## span inventory")
+            continue
+        if not in_section or not line.lstrip().startswith("|"):
+            continue
+        m = _DOC_NAME_RE.search(line)
+        if m and _SPAN_RE.match(m.group(1)):
+            out.add(m.group(1))
+    return out
+
+
+@register_pass(RULE, doc="metric/span names vs the docs/observability.md "
+                         "catalog: naming, single declaration site, "
+                         "both directions")
+def run(tree: SourceTree) -> List[Finding]:
+    doc_path = os.path.join(tree.repo, "docs", "observability.md")
+    doc_rel = "docs/observability.md"
+    findings: List[Finding] = []
+
+    def emit(severity: str, file: str, line: int, message: str) -> None:
+        src = tree.get(file)
+        code = src.code_at(line) if src is not None else ""
+        findings.append(Finding(RULE, severity, file, line, message,
+                                scope="", code=code))
+
+    metrics, spans = collect(tree)
+    docs = documented_names(doc_path)
+    inventory = span_inventory(doc_path)
+    if not os.path.isfile(doc_path):
+        emit("error", doc_rel, 0, f"missing catalog doc {doc_rel}")
+    elif not inventory:
+        emit("error", doc_rel, 0,
+             "docs/observability.md has no parseable 'Span inventory' "
+             "table")
+
+    by_name: Dict[str, List[Site]] = {}
+    for s in metrics:
+        by_name.setdefault(s.name, []).append(s)
+        if not _METRIC_RE.match(s.name):
+            emit("error", s.file, s.line,
+                 f"metric name {s.name!r} is not snake_case")
+    for name, sites in sorted(by_name.items()):
+        if len(sites) > 1:
+            where = ", ".join(f"{s.file}:{s.line}" for s in sites)
+            emit("error", sites[0].file, sites[0].line,
+                 f"metric {name!r} registered at {len(sites)} sites "
+                 f"({where}); declare each family once, in "
+                 f"bigdl_tpu/telemetry/families.py")
+        if name not in docs:
+            s = sites[0]
+            emit("error", s.file, s.line,
+                 f"metric {name!r} missing from the "
+                 f"docs/observability.md catalog")
+
+    seen_spans: Set[str] = set()
+    for s in spans:
+        if not _SPAN_RE.match(s.name):
+            emit("error", s.file, s.line,
+                 f"span name {s.name!r} is not snake_case path segments")
+        if s.name not in inventory and s.name not in seen_spans:
+            emit("error", s.file, s.line,
+                 f"span {s.name!r} missing from the "
+                 f"docs/observability.md span inventory")
+        seen_spans.add(s.name)
+
+    # reverse direction: documented but nothing emits it -> warning
+    for name in sorted(inventory - seen_spans):
+        emit("warning", doc_rel, 0,
+             f"docs/observability.md span inventory lists {name!r} but "
+             f"nothing records it")
+    for name in sorted(docs - set(by_name)):
+        # only names that LOOK like catalog entries (unit/total
+        # suffixes); plain prose backticks are not the catalog's problem
+        if "/" not in name and re.search(
+                r"_(total|seconds|bytes|ms|ratio|depth|max)$", name):
+            emit("warning", doc_rel, 0,
+                 f"docs/observability.md documents {name!r} but nothing "
+                 f"registers it")
+    return findings
+
+
+def lint(root: Optional[str] = None) -> Tuple[List[str], List[str]]:
+    """Compat surface for the ``scripts/metrics_lint.py`` shim:
+    (errors, warnings) as printable strings, same content the
+    standalone lint always printed."""
+    tree = load_tree(root)
+    errors: List[str] = []
+    warnings: List[str] = []
+    for f in run(tree):
+        text = (f"{f.file}:{f.line}: {f.message}" if f.line
+                else f.message)
+        (errors if f.severity == "error" else warnings).append(text)
+    return errors, warnings

@@ -1,0 +1,334 @@
+"""trace-safety: no impure host calls reachable from traced code.
+
+A function traced by ``jax.jit`` / ``shard_map`` / ``pallas_call``
+executes its Python ONCE, at trace time; an impure host call inside it
+(``time.time()``, ``random``, ``print``, an ``.item()`` host sync)
+either bakes a stale value into the compiled program, fires once
+instead of per step, or silently blocks the dispatch pipeline on a
+device→host transfer.  Every one of these is a bug class a review
+round has already caught by eye; this pass walks the call graph so the
+next one is caught by machine.
+
+Mechanics (whole-program, AST only — no jax import):
+
+1. **Roots**: in the trace-owning areas (``optim/optimizer.py``,
+   ``parallel/``, ``ops/``), any function that is (a) passed to /
+   decorated with a tracing transform (``jit``, ``shard_map`` and its
+   compat spellings, ``pallas_call``, ``grad``/``value_and_grad``,
+   ``vmap``/``pmap``, ``lax.scan``/``fori_loop``/``while_loop``/
+   ``cond``/``switch``, ``checkpoint``/``remat``, ``custom_vjp``), or
+   (b) uses mapped-axis primitives (``lax.axis_index``, the
+   ``telemetry.collectives`` wrappers) — such a function only makes
+   sense inside a mapped trace.
+2. **Edges**: from each reached function, calls are resolved through
+   the module's import tables (module-level and function-local) to
+   module-level functions in other ``bigdl_tpu`` modules, to sibling
+   functions of the same module, and ``self.method`` to methods of the
+   enclosing class.  A root's nested ``def``s are part of its body.
+   Dynamic dispatch (``model.forward``, criterion objects, optimizer
+   methods) is out of reach by design — those surfaces are covered by
+   the compiled-HLO passes instead.
+3. **Flags**: host-clock reads, host RNG (``random``/``np.random``),
+   ``print``, host syncs (``.item()``, ``np.asarray``/``np.array``,
+   ``jax.device_get``, ``.block_until_ready()``), and — in ROOT
+   functions only — ``float()``/``int()`` of a parameter (a root's
+   parameters are the traced arrays; transitively-reached helpers
+   routinely coerce static config the same way, which is fine).
+
+Intentional trace-TIME host work (the collectives wrappers' byte
+accounting runs while jax traces, by design) carries a pragma naming
+that fact.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from bigdl_tpu.analysis.astutil import (
+    SourceTree, call_attr_chain, imports_of,
+)
+from bigdl_tpu.analysis.findings import Finding
+from bigdl_tpu.analysis.registry import register_pass
+
+RULE = "trace-safety"
+
+# areas whose functions can BE trace roots (the known trace entry
+# points); edges are followed into any bigdl_tpu module from there
+_ROOT_AREAS = ("bigdl_tpu/optim/optimizer.py", "bigdl_tpu/parallel/",
+               "bigdl_tpu/ops/")
+
+_TRACE_CALLS = {
+    "jit", "pjit", "shard_map", "shard_map_compat", "pallas_call",
+    "grad", "value_and_grad", "vmap", "pmap", "scan", "fori_loop",
+    "while_loop", "cond", "switch", "checkpoint", "remat",
+    "custom_vjp", "custom_jvp", "associative_scan",
+}
+# local aliases of the shard_map compat wrapper seen in the tree
+_TRACE_ALIASES = {"_shard_map", "_sm"}
+
+# calling these only makes sense inside a mapped trace -> implicit root
+_MAPPED_PRIMS = {"axis_index", "psum", "pmean", "all_gather",
+                 "all_to_all", "ppermute", "psum_scatter",
+                 "reduce_scatter", "optimization_barrier"}
+
+_HOST_RNG_MODULES = {"random", "np.random", "numpy.random"}
+_HOST_SYNC_CALLS = {"asarray", "array", "device_get"}
+_HOST_SYNC_METHODS = {"item", "block_until_ready", "tolist"}
+_CLOCK_CALLS = {"time", "perf_counter", "monotonic", "process_time"}
+
+
+class _Func:
+    """One function/lambda we may reach: its AST, module, qualname."""
+
+    __slots__ = ("node", "src", "qual", "cls")
+
+    def __init__(self, node, src, qual: str, cls: Optional[str]):
+        self.node = node
+        self.src = src
+        self.qual = qual
+        self.cls = cls
+
+
+class _ModuleIndex:
+    """Per-module lookup tables the edge resolver needs."""
+
+    def __init__(self, src):
+        self.src = src
+        self.mod_alias, self.from_import = imports_of(src.tree)
+        self.top: Dict[str, _Func] = {}       # module-level functions
+        self.methods: Dict[Tuple[str, str], _Func] = {}
+        self.all_funcs: List[_Func] = []
+        self._index()
+
+    def _index(self) -> None:
+        def walk(body, scope: str, cls: Optional[str]):
+            for node in body:
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    qual = f"{scope}.{node.name}" if scope else node.name
+                    fn = _Func(node, self.src, qual, cls)
+                    self.all_funcs.append(fn)
+                    if not scope:
+                        self.top[node.name] = fn
+                    if cls is not None:
+                        self.methods.setdefault((cls, node.name), fn)
+                    walk(node.body, qual, cls)
+                elif isinstance(node, ast.ClassDef):
+                    qual = f"{scope}.{node.name}" if scope else node.name
+                    walk(node.body, qual, node.name)
+                elif isinstance(node, (ast.If, ast.Try, ast.With)):
+                    walk([c for c in ast.iter_child_nodes(node)
+                          if isinstance(c, ast.stmt)], scope, cls)
+
+        walk(self.src.tree.body, "", None)
+
+
+def _callee_is_tracer(call: ast.Call) -> bool:
+    chain = call_attr_chain(call)
+    if not chain:
+        return False
+    last = chain[-1]
+    return last in _TRACE_CALLS or last in _TRACE_ALIASES
+
+
+class _Pass:
+    def __init__(self, tree: SourceTree):
+        self.tree = tree
+        self.findings: List[Finding] = []
+        self.modules: Dict[str, _ModuleIndex] = {}
+        for src in tree:
+            if src.tree is not None \
+                    and src.rel.startswith("bigdl_tpu/"):
+                self.modules[src.module] = _ModuleIndex(src)
+        self.visited: Set[int] = set()    # id(ast node)
+        # (func, root label, is_root) — roots are enqueued by
+        # find_roots() before walk() adds any transitive callee, so a
+        # function that is both reached and a root keeps is_root=True
+        self.queue: List[Tuple[_Func, str, bool]] = []
+
+    # -- root discovery ----------------------------------------------------
+
+    def find_roots(self) -> None:
+        for mod, idx in self.modules.items():
+            if not idx.src.rel.startswith(_ROOT_AREAS):
+                continue
+            # lexical def environments so `jit(step)` resolves `step`
+            # wherever it is nested
+            self._scan_scope(idx, idx.src.tree.body, [{}], "")
+            # implicit roots: functions using mapped-axis primitives
+            for fn in idx.all_funcs:
+                if self._uses_mapped_prims(fn.node):
+                    self._enqueue(fn, f"{mod}.{fn.qual} (mapped-axis "
+                                      f"primitive user)", is_root=True)
+
+    def _scan_scope(self, idx: _ModuleIndex, body, envs: List[Dict],
+                    scope: str) -> None:
+        # bind this scope's function defs
+        env = envs[-1]
+        for node in body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{scope}.{node.name}" if scope else node.name
+                env[node.name] = _Func(node, idx.src, qual, None)
+        for node in body:
+            for call in [n for n in ast.walk(node)
+                         if isinstance(n, ast.Call)]:
+                if not _callee_is_tracer(call):
+                    continue
+                for arg in list(call.args) + [kw.value
+                                              for kw in call.keywords]:
+                    fn = None
+                    if isinstance(arg, ast.Lambda):
+                        fn = _Func(arg, idx.src,
+                                   f"{scope}.<lambda>" if scope
+                                   else "<lambda>", None)
+                    elif isinstance(arg, ast.Name):
+                        for e in reversed(envs):
+                            if arg.id in e:
+                                fn = e[arg.id]
+                                break
+                        if fn is None:
+                            fn = idx.top.get(arg.id)
+                    if fn is not None:
+                        self._enqueue(
+                            fn, f"{idx.src.module}.{fn.qual} "
+                                f"(traced via "
+                                f"{call_attr_chain(call)[-1]})",
+                            is_root=True)
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                qual = f"{scope}.{node.name}" if scope else node.name
+                self._scan_scope(idx, node.body, envs + [{}], qual)
+            elif isinstance(node, (ast.If, ast.Try, ast.With)):
+                self._scan_scope(
+                    idx, [c for c in ast.iter_child_nodes(node)
+                          if isinstance(c, ast.stmt)], envs, scope)
+
+    def _uses_mapped_prims(self, fnode) -> bool:
+        for n in ast.walk(fnode):
+            if isinstance(n, ast.Call):
+                chain = call_attr_chain(n)
+                if chain and chain[-1] in _MAPPED_PRIMS:
+                    # skip the trace-size probe psum(1, a)
+                    if chain[-1] in ("psum", "pmean") and n.args \
+                            and isinstance(n.args[0], ast.Constant):
+                        continue
+                    return True
+        return False
+
+    # -- reachability ------------------------------------------------------
+
+    def _enqueue(self, fn: _Func, root: str,
+                 is_root: bool = False) -> None:
+        if id(fn.node) in self.visited:
+            return
+        self.visited.add(id(fn.node))
+        self.queue.append((fn, root, is_root))
+
+    def _resolve(self, idx: _ModuleIndex, call: ast.Call,
+                 cls: Optional[str]) -> Optional[_Func]:
+        f = call.func
+        if isinstance(f, ast.Name):
+            name = f.id
+            if name in idx.top:
+                return idx.top[name]
+            tgt = idx.from_import.get(name)
+            if tgt is not None:
+                mod, attr = tgt
+                other = self.modules.get(mod)
+                if other is not None:
+                    return other.top.get(attr)
+            return None
+        if isinstance(f, ast.Attribute):
+            base = f.value
+            if isinstance(base, ast.Name):
+                if base.id == "self" and cls is not None:
+                    return idx.methods.get((cls, f.attr))
+                mod = idx.mod_alias.get(base.id)
+                if mod is not None and mod in self.modules:
+                    return self.modules[mod].top.get(f.attr)
+                tgt = idx.from_import.get(base.id)
+                if tgt is not None:
+                    # `from bigdl_tpu.telemetry import collectives as c`
+                    dotted = f"{tgt[0]}.{tgt[1]}"
+                    if dotted in self.modules:
+                        return self.modules[dotted].top.get(f.attr)
+        return None
+
+    def walk(self) -> None:
+        while self.queue:
+            fn, root, is_root = self.queue.pop()
+            idx = self.modules.get(fn.src.module)
+            if idx is None:
+                continue
+            self._check_body(fn, root, is_root)
+            for n in ast.walk(fn.node):
+                if isinstance(n, ast.Call):
+                    tgt = self._resolve(idx, n, fn.cls)
+                    if tgt is not None:
+                        self._enqueue(tgt, root)
+
+    # -- impurity checks ---------------------------------------------------
+
+    def _check_body(self, fn: _Func, root: str, is_root: bool) -> None:
+        params: Set[str] = set()
+        args = fn.node.args
+        for a in (args.args + args.posonlyargs + args.kwonlyargs):
+            params.add(a.arg)
+        where = (f"in {fn.src.module}.{fn.qual}, reachable from trace "
+                 f"root {root}")
+        for n in ast.walk(fn.node):
+            if not isinstance(n, ast.Call):
+                continue
+            chain = call_attr_chain(n)
+            msg = None
+            if chain:
+                last = chain[-1]
+                dotted = ".".join(chain)
+                if len(chain) >= 2 and chain[-2] == "time" \
+                        and last in _CLOCK_CALLS:
+                    msg = (f"host clock read ({dotted}) inside traced "
+                           f"code executes once at trace time, not per "
+                           f"step")
+                elif any(dotted.startswith(m + ".")
+                         for m in _HOST_RNG_MODULES):
+                    msg = (f"host RNG ({dotted}) inside traced code is "
+                           f"frozen at trace time — use jax.random "
+                           f"with a threaded key")
+                elif chain == ("print",):
+                    msg = ("print() inside traced code fires at trace "
+                           "time only — use jax.debug.print for "
+                           "per-step output")
+                elif last in _HOST_SYNC_CALLS and len(chain) >= 2 \
+                        and chain[-2] in ("np", "numpy", "jax", "onp"):
+                    msg = (f"{dotted} on a traced value forces a "
+                           f"device→host sync (or freezes a tracer at "
+                           f"trace time)")
+                elif last in _HOST_SYNC_METHODS and len(chain) >= 2 \
+                        and chain[-2] not in ("np", "numpy", "random"):
+                    msg = (f".{last}() is a device→host sync — inside "
+                           f"traced code it blocks the dispatch "
+                           f"pipeline (or fails on a tracer)")
+                elif is_root and chain in (("float",), ("int",)) \
+                        and n.args \
+                        and isinstance(n.args[0], ast.Name) \
+                        and n.args[0].id in params:
+                    msg = (f"{last}() of parameter "
+                           f"{n.args[0].id!r} forces a potential "
+                           f"tracer to a host scalar")
+            if msg:
+                self.findings.append(self.tree.finding(
+                    RULE, "error", fn.src, n.lineno,
+                    f"{msg} ({where})",
+                    scope=f"{fn.src.module.split('.', 1)[-1]}"
+                          f".{fn.qual}"))
+
+
+@register_pass(RULE, doc="impure host calls (clock, RNG, print, host "
+                         "syncs) reachable from jit/shard_map traced "
+                         "functions")
+def run(tree: SourceTree) -> List[Finding]:
+    p = _Pass(tree)
+    p.find_roots()
+    p.walk()
+    return p.findings

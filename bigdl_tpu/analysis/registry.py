@@ -1,0 +1,74 @@
+"""Rule/pass registry: every graftlint pass declares itself here.
+
+A pass is a function ``(tree: SourceTree) -> List[Finding]`` (kind
+``"ast"``) or ``() -> List[Finding]`` (kind ``"hlo"`` — compiles real
+programs, needs a jax backend with enough devices).  Registration is a
+decorator so adding a rule is one file in ``analysis/passes/`` and
+nothing else; the CLI and tests enumerate whatever is registered.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, NamedTuple, Optional, Sequence
+
+__all__ = ["register_pass", "get_passes", "pass_names", "PassInfo"]
+
+
+class PassInfo(NamedTuple):
+    name: str        # the rule id findings carry and pragmas name
+    kind: str        # "ast" | "hlo"
+    doc: str         # one-line "what it catches" for --list / docs
+    fn: Callable
+    rules: tuple     # every rule id this pass may emit (>= (name,))
+
+
+_PASSES: Dict[str, PassInfo] = {}
+
+
+def register_pass(name: str, kind: str = "ast", doc: str = "",
+                  rules: tuple = ()) -> Callable:
+    """Decorator: ``@register_pass("trace-safety", doc="...")``.
+    ``rules`` lists extra rule ids the pass emits beyond its own name
+    (baseline staleness is judged only against rules that RAN)."""
+    if kind not in ("ast", "hlo"):
+        raise ValueError(f"unknown pass kind {kind!r}")
+
+    def deco(fn: Callable) -> Callable:
+        if name in _PASSES:
+            raise ValueError(f"pass {name!r} registered twice")
+        _PASSES[name] = PassInfo(name, kind, doc or (fn.__doc__ or "")
+                                 .strip().splitlines()[0], fn,
+                                 (name,) + tuple(rules))
+        return fn
+
+    return deco
+
+
+def _ensure_loaded() -> None:
+    # importing the subpackage registers every pass (side effect)
+    from bigdl_tpu.analysis import passes  # noqa: F401
+
+
+def get_passes(kind: Optional[str] = None,
+               select: Optional[Sequence[str]] = None) -> List[PassInfo]:
+    _ensure_loaded()
+    out = []
+    for name in sorted(_PASSES):
+        p = _PASSES[name]
+        if kind is not None and p.kind != kind:
+            continue
+        if select is not None and name not in select:
+            continue
+        out.append(p)
+    if select:
+        unknown = set(select) - set(_PASSES)
+        if unknown:
+            raise ValueError(
+                f"unknown pass(es) {sorted(unknown)}; "
+                f"known: {sorted(_PASSES)}")
+    return out
+
+
+def pass_names() -> List[str]:
+    _ensure_loaded()
+    return sorted(_PASSES)

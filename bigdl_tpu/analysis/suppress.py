@@ -1,0 +1,127 @@
+"""Suppression machinery: inline pragmas + the reviewed baseline file.
+
+Two ways to silence a finding, both leaving an audit trail:
+
+* ``# graftlint: disable=<rule>[,rule...] -- reason`` on the flagged
+  line (or the line directly above it) — for sites where the
+  explanation belongs next to the code;
+* a baseline entry in ``scripts/graftlint_baseline.json`` — for
+  findings reviewed once and excused with a **mandatory** one-line
+  justification.  An entry without a non-empty ``justification`` is
+  itself an error (the whole point is that every exception carries its
+  reviewed reason), and an entry matching nothing is a ``warning``
+  (stale baseline — the debt it excused was paid; delete the entry).
+
+Baseline identity is ``(rule, file, scope, code)`` — see
+:mod:`bigdl_tpu.analysis.findings` for why line numbers are excluded.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+from bigdl_tpu.analysis.astutil import SourceTree, repo_root
+from bigdl_tpu.analysis.findings import Finding
+
+__all__ = ["default_baseline_path", "load_baseline", "write_baseline",
+           "apply_suppressions"]
+
+_BASELINE_VERSION = 1
+
+
+def default_baseline_path() -> str:
+    return os.path.join(repo_root(), "scripts",
+                        "graftlint_baseline.json")
+
+
+def load_baseline(path: Optional[str] = None) -> List[Dict[str, Any]]:
+    """The baseline entries ([] when the file doesn't exist yet).
+    Raises ValueError on a malformed file — a broken baseline must not
+    silently suppress nothing (or everything)."""
+    path = path or default_baseline_path()
+    if not os.path.isfile(path):
+        return []
+    with open(path, "r", encoding="utf-8") as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict) or doc.get("version") != _BASELINE_VERSION \
+            or not isinstance(doc.get("entries"), list):
+        raise ValueError(
+            f"{path}: not a graftlint baseline "
+            f"(need {{version: {_BASELINE_VERSION}, entries: [...]}})")
+    for e in doc["entries"]:
+        missing = {"rule", "file", "scope", "code"} - set(e)
+        if missing:
+            raise ValueError(
+                f"{path}: baseline entry {e!r} missing {sorted(missing)}")
+    return doc["entries"]
+
+
+def write_baseline(entries: List[Dict[str, Any]],
+                   path: Optional[str] = None) -> str:
+    path = path or default_baseline_path()
+    doc = {"version": _BASELINE_VERSION,
+           "entries": sorted(entries, key=lambda e: (
+               e["rule"], e["file"], e["scope"], e["code"]))}
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return path
+
+
+def _entry_key(e: Dict[str, Any]) -> tuple:
+    return (e["rule"], e["file"], e["scope"], e["code"])
+
+
+def apply_suppressions(findings: List[Finding], tree: SourceTree,
+                       baseline: List[Dict[str, Any]],
+                       baseline_path: str = "",
+                       ran_rules: Optional[set] = None) -> List[Finding]:
+    """Mark pragma- and baseline-suppressed findings in place, and
+    append the baseline's own findings (missing justification = error,
+    stale entry = warning).  ``ran_rules`` names the rule ids that
+    actually executed this run (None = all): staleness is only judged
+    for entries whose rule ran — a ``--select``ed subset must not
+    declare every other pass's baseline debt paid.  Returns the same
+    list for chaining."""
+    by_key: Dict[tuple, Dict[str, Any]] = {}
+    matched: Dict[tuple, bool] = {}
+    base_rel = (os.path.relpath(baseline_path, tree.repo)
+                .replace(os.sep, "/") if baseline_path else
+                "scripts/graftlint_baseline.json")
+    for e in baseline:
+        by_key[_entry_key(e)] = e
+        matched[_entry_key(e)] = False
+
+    for f in findings:
+        src = tree.get(f.file)
+        if src is not None and src.pragma_disables(f.line, f.rule):
+            f.suppressed = "pragma"
+            continue
+        key = (f.rule, f.file, f.scope, f.code)
+        e = by_key.get(key)
+        if e is not None:
+            matched[key] = True
+            if str(e.get("justification", "")).strip():
+                f.suppressed = "baseline"
+            # else: stays active — and the missing justification is
+            # reported below, so the fix is visible in one run
+
+    for key, e in by_key.items():
+        if not str(e.get("justification", "")).strip():
+            findings.append(Finding(
+                "baseline-justification", "error", base_rel, 0,
+                f"baseline entry for [{e['rule']}] {e['file']} "
+                f"({e['scope'] or 'module'}) has no justification — "
+                f"every excused finding must say why",
+                scope=e["scope"], code=e["code"]))
+        elif not matched[key] and (ran_rules is None
+                                   or e["rule"] in ran_rules):
+            findings.append(Finding(
+                "baseline-stale", "warning", base_rel, 0,
+                f"baseline entry for [{e['rule']}] {e['file']} "
+                f"({e['scope'] or 'module'}: {e['code'][:60]!r}) matches "
+                f"no finding — the debt was paid, delete the entry",
+                scope=e["scope"], code=e["code"]))
+    return findings

@@ -1193,7 +1193,7 @@ class Optimizer:
             "loss": _j(st.get("loss")),
             "score": _j(st.get("score")),
             "run_uptime_s": (None if self._run_started is None
-                             else time.time() - self._run_started),
+                             else time.perf_counter() - self._run_started),
             "preempted": self.preempted,
             "watchdog_halted": self.watchdog_halted,
             "checkpoint": {
@@ -1454,7 +1454,7 @@ class Optimizer:
         last_failure = None
         attempt = 0
         self.watchdog_halted = False
-        self._run_started = time.time()
+        self._run_started = time.perf_counter()
         restore_signal = self._install_preemption_handler()
         self._start_debug_server()
         try:
@@ -1479,7 +1479,7 @@ class Optimizer:
                             "same wall)", type(e).__name__, e)
                         self._dump_flight_recorder("crash", error=e)
                         raise
-                    now = time.time()
+                    now = time.perf_counter()
                     if last_failure is not None and \
                             now - last_failure > self.retry_interval_s:
                         retries_left = self.retry_times
@@ -1665,7 +1665,7 @@ class Optimizer:
 
         seed_key = jax.random.key(get_seed())
         total_records = self.dataset.size()
-        wall_start = time.time()
+        wall_start = time.perf_counter()
 
         from bigdl_tpu.parallel.mesh import BATCH_AXES
         n_data = 1
@@ -1698,7 +1698,8 @@ class Optimizer:
             interval = 1
         # pending: (neval, epoch, n_records, records_cum, loss_device)
         pending: List[Tuple] = []
-        window = {"start": time.time(), "data_t": 0.0, "fetch_t": 0.0,
+        window = {"start": time.perf_counter(), "data_t": 0.0,
+                  "fetch_t": 0.0,
                   "disp_t": 0.0}
         drain_state = {"last_ready": 0.0}
         # (n_iterations, completion_to_completion_s, data_stage_s) per
@@ -1759,8 +1760,13 @@ class Optimizer:
             # starts; completion-to-completion (prev window's ready
             # time) is the honest denominator, or the r02
             # async-dispatch lie returns through the back door.
-            t_ready = time.time()
-            t_ready_pc = time.perf_counter()  # span clock (tracing)
+            # ONE clock for completion stamps: perf_counter, the trace
+            # clock — window durations, the span endpoints, and the
+            # record's t_ready all derive from the same monotonic read
+            # (wall time is for timestamps; tracing.wall_time_of
+            # converts when an epoch rendering is wanted)
+            t_ready_pc = time.perf_counter()
+            t_ready = t_ready_pc
             # Value readbacks batch via device_get (one pytree transfer
             # with the copies issued concurrently — per-scalar
             # np.asarray round trips on a high-latency link would
@@ -1860,9 +1866,10 @@ class Optimizer:
                 _tm.step_unattributed_fraction().set(
                     max(window_dt - measured, 0.0)
                     / max(window_dt, 1e-9))
-                # perf_counter endpoints: tracing's clock — mixing the
-                # loop's time.time() stamps in would strand these spans
-                # ~an epoch away from every span() on the trace timeline
+                # perf_counter endpoints: tracing's clock (the whole
+                # loop stamps on it now — a time.time() stamp here once
+                # stranded these spans ~an epoch off the trace timeline,
+                # the bug the clock-discipline lint pins)
                 _tt.record_span("optimizer/step", t_ready_pc - window_dt,
                                 t_ready_pc, iterations=len(entries),
                                 data_wait_s=round(data_t, 6),
@@ -1878,7 +1885,7 @@ class Optimizer:
                     "Trained %d records in %.4f seconds. Throughput is "
                     "%.1f records/second. Loss is %.4f.",
                     epoch_i, cum_i, total_records, neval_i,
-                    time.time() - wall_start, n_i, per_iter,
+                    time.perf_counter() - wall_start, n_i, per_iter,
                     n_i / max(per_iter, 1e-9), lf)
                 if self.train_summary is not None:
                     self.train_summary.add_scalar("Loss", lf, neval_i)
@@ -1953,7 +1960,7 @@ class Optimizer:
                 else:
                     consume_window(*job)
                 pending.clear()
-                window["start"] = time.time()
+                window["start"] = time.perf_counter()
                 window["data_t"] = 0.0
                 window["fetch_t"] = 0.0
                 window["disp_t"] = 0.0
@@ -2045,7 +2052,7 @@ class Optimizer:
         with mesh:
             while not self.end_when(self.state):
                 epoch = self.state["epoch"]
-                epoch_start = time.time()
+                epoch_start = time.perf_counter()
                 skip = 0
                 if pipeline_restore is not None:
                     skip = self._pipeline_restore_skip(pipeline_restore,
@@ -2059,13 +2066,13 @@ class Optimizer:
                 batch_iter = iter(epoch_iter(self.dataset, epoch=epoch,
                                              train=True))
                 if skip > 0:
-                    t_skip = time.time()
+                    t_skip = time.perf_counter()
                     skipped = skip_batches(batch_iter, skip)
                     saw_batches = True  # consumed pre-crash, not absent
                     _te.record_event(
                         "pipeline_restore", epoch=epoch, offset=skip,
                         skipped=skipped,
-                        seconds=round(time.time() - t_skip, 6))
+                        seconds=round(time.perf_counter() - t_skip, 6))
                     if telemetry.enabled():
                         _tm.pipeline_restore_skipped_batches_total().inc(
                             skipped)
@@ -2102,14 +2109,14 @@ class Optimizer:
                     # alongside device staging — the data-starvation
                     # detector and optimizer_data_wait_seconds must see
                     # both or a slow pipeline hides from them
-                    fetch_t0 = time.time()
+                    fetch_t0 = time.perf_counter()
                     while len(lookahead) < k_req:
                         try:
                             chaos.on_data_batch()
                             lookahead.append(next(batch_iter))
                         except StopIteration:
                             break
-                    fetch_t = time.time() - fetch_t0
+                    fetch_t = time.perf_counter() - fetch_t0
                     if not lookahead:
                         break
                     want = (safe_window([b.size() for b in lookahead])
@@ -2150,7 +2157,7 @@ class Optimizer:
                     # steps exactly like a real preemption
                     for _ci in range(len(group)):
                         chaos.on_step(self.state["neval"] + _ci)
-                    it_start = time.time()
+                    it_start = time.perf_counter()
                     if len(group) > 1:
                         ckey = (tuple(id(b) for b in group)
                                 if cacheable_windows else None)
@@ -2191,7 +2198,8 @@ class Optimizer:
                         rngs = jax.vmap(
                             lambda i: jax.random.fold_in(seed_key, i))(
                             jnp.arange(base, base + len(group)))
-                        t_data = time.time() - it_start + fetch_t
+                        t_data = (time.perf_counter() - it_start
+                                  + fetch_t)
                         t_disp0 = time.perf_counter()
                         params_groups, rest, opt_states, losses = wstep(
                             params_groups, rest, opt_states, xs, ys, rngs,
@@ -2207,7 +2215,8 @@ class Optimizer:
                         y = _stage(batch.get_target(), x_sharding)
                         rng = jax.random.fold_in(seed_key,
                                                  self.state["neval"])
-                        t_data = time.time() - it_start + fetch_t
+                        t_data = (time.perf_counter() - it_start
+                                  + fetch_t)
                         t_disp0 = time.perf_counter()
                         if wd is not None:
                             (params_groups, rest, opt_states, loss,
@@ -2265,7 +2274,7 @@ class Optimizer:
                                 params_groups, rest, opt_states, eval_step)
                             # don't bill validation/checkpoint wall time
                             # to the next window's "device step time"
-                            window["start"] = time.time()
+                            window["start"] = time.perf_counter()
                         # no break: the whole window's updates are
                         # already applied to the params, so the
                         # remaining entries' bookkeeping (neval,
@@ -2324,14 +2333,14 @@ class Optimizer:
                 flush_pending(params_groups, rest, opt_states,
                               sync=self._want_validate_checkpoint())
                 logger.info("Epoch %d finished in %.2f s", epoch,
-                            time.time() - epoch_start)
+                            time.perf_counter() - epoch_start)
                 if not saw_batches:
                     raise ValueError(
                         "dataset produced no batches (empty dataset, or "
                         "fewer samples than one batch with drop_last)")
                 self._maybe_validate_checkpoint(
                     params_groups, rest, opt_states, eval_step)
-                window["start"] = time.time()
+                window["start"] = time.perf_counter()
             flush_pending(params_groups, rest, opt_states, sync=True)
             if prof_active:
                 jax.profiler.stop_trace()

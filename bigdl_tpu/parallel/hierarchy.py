@@ -46,6 +46,7 @@ wire_dtype=...)``.
 
 from __future__ import annotations
 
+import os
 from typing import Dict, Optional, Sequence, Tuple
 
 import numpy as np
@@ -75,6 +76,19 @@ FAST_BATCH_AXES = tuple(a for a in _BATCH_AXES if a != DCN_AXIS)
 # the one version-compat shard_map spelling (parallel.mesh owns it),
 # re-exported under the natural name for hierarchy call sites
 shard_map = shard_map_compat
+
+
+def _wire_pinned() -> bool:
+    """The HLO-lint seam for the PR-8 widening bug.  Default True: the
+    compressed dcn hop keeps its narrow dtype pinned on the wire with
+    ``optimization_barrier``s.  ``BIGDL_TPU_UNPIN_DCN_WIRE=1`` (read at
+    TRACE time) deliberately compiles the FAILURE-mode program instead
+    — the decode hoisted above the exchange, so the cross-slice wire
+    carries fp32 — which is what XLA itself produced before the
+    barriers pinned it.  ``analysis/hlo_lint``'s narrow-wire pass must
+    flag that program loudly (and would equally flag a future XLA
+    version that learns to hoist past the barriers)."""
+    return os.environ.get("BIGDL_TPU_UNPIN_DCN_WIRE") != "1"
 
 
 def batch_axes_of(mesh, dcn_axis: str = DCN_AXIS) -> Tuple[str, ...]:
@@ -190,6 +204,27 @@ def hierarchical_grad_sync(grads, mesh, *, dcn_axis: str = DCN_AXIS,
                 shard = jnp.pad(shard, (0, pad_s))
             k = shard.shape[0] // S
             chunks = shard.reshape(S, k)
+            if not _wire_pinned():
+                # the deliberately-unpinned decode (lint seam, see
+                # _wire_pinned): same chunk-ownership schedule, fp32 on
+                # the wire — the program the widening bug produced
+                recv = _coll.all_to_all(chunks, dcn_axis, split_axis=0,
+                                        concat_axis=0)
+                owned = jnp.sum(recv.reshape(S, k), axis=0)
+                gathered = _coll.all_gather(owned, dcn_axis,
+                                            tiled=False)
+                shard = gathered.reshape(-1)[:size]
+                if mean:
+                    shard = shard / float(F * S)
+                if F > 1:
+                    axis = (fast_axes[0] if len(fast_axes) == 1
+                            else tuple(fast_axes))
+                    flat = _coll.all_gather(shard, axis, tiled=True)
+                else:
+                    flat = shard
+                if pad:
+                    flat = flat[:n]
+                return _unflatten_tree(flat, spec)
 
             def _key(i):
                 return None if rng is None else jax.random.fold_in(rng, i)

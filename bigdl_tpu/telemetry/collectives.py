@@ -78,6 +78,9 @@ def _tree_nbytes(tree) -> int:
         shape = getattr(leaf, "shape", None)
         dtype = getattr(leaf, "dtype", None)
         if shape is None or dtype is None:
+            # graftlint: disable=trace-safety -- trace-TIME fallback
+            # for non-array leaves (Python scalars) only; tracers
+            # always carry shape/dtype and never reach this branch
             arr = np.asarray(leaf)
             shape, dtype = arr.shape, arr.dtype
         total += int(np.prod(shape, dtype=np.int64)

@@ -62,7 +62,7 @@ class Debugz:
 
     def __init__(self, statusz_fn: Optional[Callable[[], Dict]] = None):
         self.statusz_fn = statusz_fn
-        self._t0 = time.time()
+        self._t0 = time.perf_counter()
         self._profile_busy = threading.Lock()
 
     def statusz(self) -> Dict:
@@ -72,7 +72,7 @@ class Debugz:
         base: Dict = {
             "time": time.time(),
             "pid": os.getpid(),
-            "uptime_s": time.time() - self._t0,
+            "uptime_s": time.perf_counter() - self._t0,
             "telemetry_enabled": telemetry.enabled(),
             "spans": {"buffered": len(tracing.finished_spans()),
                       "dropped": tracing.dropped_spans()},

@@ -193,9 +193,14 @@ def merge_host_snapshots(directory: str,
             float(row["step_wall_s"])
         except Exception:
             continue
-        if max_age_s is not None \
-                and now - float(row.get("time", now)) > max_age_s:
-            continue
+        if max_age_s is not None:
+            # graftlint: disable=clock-discipline -- staleness vs
+            # ANOTHER process's epoch stamp: perf_counter is not
+            # comparable across processes, the wall clock is the only
+            # shared one
+            age_s = now - float(row.get("time", now))
+            if age_s > max_age_s:
+                continue
         rows.append(row)
     if not rows:
         return None

@@ -92,6 +92,9 @@ class _Metric:
             raise ValueError(
                 f"metric {self.name!r} has labels {self.labelnames}; "
                 f"call .labels(...) first")
+        # graftlint: disable=lock-discipline -- the () child is created
+        # once at construction and never replaced; this read races with
+        # nothing (labelled children are the ones minted under the lock)
         return self._children[()]
 
     def _reset(self) -> None:

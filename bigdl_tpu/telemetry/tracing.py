@@ -41,7 +41,24 @@ __all__ = ["span", "record_span", "current_span", "propagate",
            "finished_spans", "reset_spans", "set_ring_capacity",
            "chrome_trace", "write_chrome_trace", "wall_time_of"]
 
-# perf_counter <-> wall-clock anchor, captured once at import
+# The clock contract (enforced tree-wide by graftlint's
+# clock-discipline pass, docs/static_analysis.md):
+#
+#   * DURATIONS and span endpoints live on ``time.perf_counter()`` —
+#     monotonic, NTP-immune, the only clock two in-process stamps may
+#     be subtracted on;
+#   * TIMESTAMPS (event records, checkpoint manifests, cross-process
+#     staleness checks) live on ``time.time()`` — epoch-meaningful,
+#     comparable across processes, never subtracted from a
+#     perf_counter value.
+#
+# ``(_EPOCH_PERF, _EPOCH_WALL)`` is the one sanctioned bridge between
+# the two: a paired reading captured once at import, so
+# :func:`wall_time_of` can render a perf_counter stamp as approximate
+# epoch seconds for humans.  Code must cross the bridge through that
+# function, not by mixing clocks ad hoc — PR 3's review round found
+# optimizer spans stranded ~an epoch off the trace timeline from
+# exactly such a mix.
 _EPOCH_PERF = time.perf_counter()
 _EPOCH_WALL = time.time()
 

@@ -823,7 +823,11 @@ class CheckpointManager:
                     continue
                 p = self._join(n)
                 try:
-                    if now - os.path.getmtime(p) > 300.0:
+                    # graftlint: disable=clock-discipline -- age vs a
+                    # filesystem mtime (an epoch stamp, possibly from a
+                    # dead writer): only the wall clock compares to it
+                    age_s = now - os.path.getmtime(p)
+                    if age_s > 300.0:
                         os.remove(p)
                         removed.append(p)
                 except OSError:

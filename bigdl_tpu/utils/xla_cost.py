@@ -11,10 +11,11 @@ unwrapping lives in exactly one place.
 from __future__ import annotations
 
 import re
-from typing import Dict, List, Mapping, Optional
+from typing import Dict, List, Mapping, Optional, Tuple
 
 __all__ = ["compiled_flops", "compiled_bytes", "cost_breakdown",
-           "collective_hlo_bytes", "cross_group_hlo_bytes"]
+           "collective_hlo_bytes", "cross_group_hlo_bytes",
+           "cross_group_hlo_lines", "shape_tokens_nbytes"]
 
 
 def _cost_dict(compiled) -> dict:
@@ -98,17 +99,7 @@ def _shape_bits(dtype: str) -> Optional[int]:
 
 
 def _shapes_nbytes(text: str) -> float:
-    total = 0.0
-    for dtype, dims in _SHAPE_RE.findall(text):
-        bits = _shape_bits(dtype)
-        if bits is None:
-            continue
-        n = 1
-        for d in dims.split(","):
-            if d:
-                n *= int(d)
-        total += n * bits / 8.0
-    return total
+    return sum(b for _dtype, _bits, b in shape_tokens_nbytes(text))
 
 
 def comm_bytes_from_hlo_text(text: str) -> Dict[str, float]:
@@ -191,21 +182,33 @@ def _replica_groups_of(line: str) -> Optional[List[List[int]]]:
     return None
 
 
-def cross_group_hlo_bytes(compiled_or_text,
-                          group_of: Mapping[int, int]) \
-        -> Optional[Dict[str, float]]:
-    """Collective payload bytes that CROSS device groups, out of a
-    compiled module (or raw HLO text).
+def shape_tokens_nbytes(text: str) -> List[Tuple[str, int, float]]:
+    """The dtype-shaped tokens of an HLO fragment as
+    ``(dtype, bits, nbytes)`` triples, in order — the per-tensor
+    breakdown :func:`_shapes_nbytes` sums.  Unknown dtype tokens are
+    skipped, not guessed (same contract)."""
+    out: List[Tuple[str, int, float]] = []
+    for dtype, dims in _SHAPE_RE.findall(text):
+        bits = _shape_bits(dtype)
+        if bits is None:
+            continue
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        out.append((dtype, bits, n * bits / 8.0))
+    return out
 
-    ``group_of`` maps logical device position → group id (for a
-    two-tier mesh: ``parallel.hierarchy.dcn_slice_map(mesh)`` — slice
-    index per device).  A collective counts iff any of its replica
-    groups contains devices from more than one group; same
-    per-opcode-output-payload convention and return shape as
-    :func:`collective_hlo_bytes`, so the two read as "total comm" vs
-    "comm over the slow tier".  Collectives printing no replica groups
-    involve every device and count whenever more than one group
-    exists.  Returns None when the module text is unavailable."""
+
+def cross_group_hlo_lines(compiled_or_text,
+                          group_of: Mapping[int, int]) \
+        -> Optional[List[Tuple[str, str, bool]]]:
+    """Every collective line of a compiled module (or raw HLO text) as
+    ``(opcode, output-shapes-text, crosses_groups)`` — the per-line
+    classification :func:`cross_group_hlo_bytes` aggregates, exposed so
+    the HLO lint can also inspect the DTYPE of what crosses (the
+    narrow-wire invariant needs per-tensor dtypes, not just byte
+    sums).  Returns None when the module text is unavailable."""
     if isinstance(compiled_or_text, str):
         text = compiled_or_text
     else:
@@ -246,15 +249,41 @@ def cross_group_hlo_bytes(compiled_or_text,
                 return True
         return False
 
-    out: Dict[str, float] = {"total": 0.0}
+    out: List[Tuple[str, str, bool]] = []
     for line in text.splitlines():
         if "-start(" in line:
             continue  # counted at the matching -done
         m = _COLL_LINE_RE.search(line)
-        if m is None or not crosses(line):
+        if m is None:
             continue
-        nbytes = _shapes_nbytes(m.group("shapes"))
-        op = m.group("op")
+        out.append((m.group("op"), m.group("shapes").strip(),
+                    crosses(line)))
+    return out
+
+
+def cross_group_hlo_bytes(compiled_or_text,
+                          group_of: Mapping[int, int]) \
+        -> Optional[Dict[str, float]]:
+    """Collective payload bytes that CROSS device groups, out of a
+    compiled module (or raw HLO text).
+
+    ``group_of`` maps logical device position → group id (for a
+    two-tier mesh: ``parallel.hierarchy.dcn_slice_map(mesh)`` — slice
+    index per device).  A collective counts iff any of its replica
+    groups contains devices from more than one group; same
+    per-opcode-output-payload convention and return shape as
+    :func:`collective_hlo_bytes`, so the two read as "total comm" vs
+    "comm over the slow tier".  Collectives printing no replica groups
+    involve every device and count whenever more than one group
+    exists.  Returns None when the module text is unavailable."""
+    lines = cross_group_hlo_lines(compiled_or_text, group_of)
+    if lines is None:
+        return None
+    out: Dict[str, float] = {"total": 0.0}
+    for op, shapes, crosses_groups in lines:
+        if not crosses_groups:
+            continue
+        nbytes = _shapes_nbytes(shapes)
         out[op] = out.get(op, 0.0) + nbytes
         out["total"] += nbytes
     return out

@@ -76,14 +76,16 @@ class FileWriter:
         return self
 
     def _run(self):
-        last_flush = time.time()
+        # flush cadence on the monotonic clock: a wall-clock (NTP)
+        # step must not stall or storm the flusher
+        last_flush = time.perf_counter()
         while True:
             try:
                 ev = self._queue.get(timeout=self._flush_secs)
             except queue.Empty:
-                if time.time() - last_flush >= self._flush_secs:
+                if time.perf_counter() - last_flush >= self._flush_secs:
                     self._record.flush()
-                    last_flush = time.time()
+                    last_flush = time.perf_counter()
                 continue
             try:
                 if ev is StopIteration:
@@ -96,10 +98,10 @@ class FileWriter:
     def flush(self, timeout: float = 10.0) -> "FileWriter":
         # bounded drain: a writer thread killed by an I/O error (disk
         # full, closed file) must not hang callers on queue.join()
-        deadline = time.time() + timeout
+        deadline = time.perf_counter() + timeout
         while (self._queue.unfinished_tasks
                and self._thread.is_alive()
-               and time.time() < deadline):
+               and time.perf_counter() < deadline):
             time.sleep(0.01)
         try:
             self._record.flush()

@@ -1,0 +1,56 @@
+#!/usr/bin/env bash
+# graftlint — the fatal static-analysis gate (docs/static_analysis.md).
+#
+#   scripts/lint.sh                 # fatal: AST + compiled-HLO passes
+#   scripts/lint.sh --warn-only     # CI ride-along: report, exit 0
+#   scripts/lint.sh --ast-only      # skip the HLO compiles (fast)
+#
+# Writes the machine report to ANALYSIS_r<N>.json at the repo root —
+# N from $BIGDL_TPU_ROUND when the round driver sets it, else the next
+# free number — so lint debt is a tracked trajectory beside the
+# BENCH_r<N> artifacts, not just a pass/fail bit.
+#
+# The deliberately-broken negative leg (the PR-8 widening reproduced
+# via BIGDL_TPU_UNPIN_DCN_WIRE=1 failing the narrow-wire pass) runs in
+# tests/test_static_analysis.py; run it by hand with:
+#   BIGDL_TPU_UNPIN_DCN_WIRE=1 python -m bigdl_tpu.analysis \
+#     --hlo-only --select hlo-narrow-wire   # must FAIL
+set -o pipefail
+cd "$(dirname "$0")/.."
+
+warn=""
+hlo="--hlo"
+for arg in "$@"; do
+  case "$arg" in
+    --warn-only) warn="--warn-only" ;;
+    --ast-only)  hlo="" ;;
+    *) echo "lint.sh: unknown arg $arg" >&2; exit 2 ;;
+  esac
+done
+
+# Report artifact: a FATAL (ship-gate) run claims ANALYSIS_r<N>.json
+# ($BIGDL_TPU_ROUND, else the next free number) — the committed
+# trajectory.  The warn-only ride-along writes ANALYSIS_latest.json
+# instead: tier1 reruns must neither mint new round artifacts nor
+# overwrite a committed full-gate round report with a reduced
+# (--ast-only) one.
+if [ -n "$warn" ] && [ -z "${BIGDL_TPU_ROUND:-}" ]; then
+  report="ANALYSIS_latest.json"
+else
+  if [ -n "${BIGDL_TPU_ROUND:-}" ]; then
+    n=$(printf '%02d' "$BIGDL_TPU_ROUND")
+  else
+    n=1
+    while [ -e "ANALYSIS_r$(printf '%02d' "$n").json" ]; do
+      n=$((n + 1))
+    done
+    n=$(printf '%02d' "$n")
+  fi
+  report="ANALYSIS_r${n}.json"
+fi
+
+env JAX_PLATFORMS=cpu python -m bigdl_tpu.analysis \
+  $hlo $warn --json "$report"
+rc=$?
+echo "lint.sh: report written to $report"
+exit $rc

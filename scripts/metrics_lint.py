@@ -1,199 +1,29 @@
 #!/usr/bin/env python
-"""Static lint for telemetry metric/span names.
+"""Static lint for telemetry metric/span names — thin shim.
 
-Walks ``bigdl_tpu/`` ASTs for metric registrations — calls named
-``counter`` / ``gauge`` / ``histogram`` with a literal string first
-argument — and span usages (``span`` / ``record_span``), then fails on:
-
-* non-``snake_case`` metric names (``^[a-z][a-z0-9_]*$``) or span names
-  (same, in ``/``-separated segments);
-* a metric name registered at more than one site — the convention is
-  one declaration per name, in ``bigdl_tpu/telemetry/families.py``, so
-  renames are single-file diffs and two subsystems can never silently
-  claim the same family with different meanings;
-* any metric name missing from the catalog tables in
-  ``docs/observability.md``, or any span name missing from its "Span
-  inventory" table — if it's worth recording it's worth documenting,
-  and dashboards are built from the table, not the code.
-
-The reverse direction is checked too, same rules for both kinds:
-documented-but-unregistered names (a span-inventory row nothing emits,
-a catalog metric nothing registers) are warnings only — docs may
-legitimately describe a family a gated backend registers lazily.
-
-Usage::
+The implementation moved into the graftlint framework as the
+``metrics-catalog`` pass (``bigdl_tpu/analysis/passes/
+metrics_catalog.py``); this entry point keeps the CLI, the output
+format, and the exit-code contract ``tier1.sh`` and the smokes rely
+on:
 
     python scripts/metrics_lint.py              # fatal: exit 1 on error
     python scripts/metrics_lint.py --warn-only  # CI ride-along: exit 0
 
-``scripts/tier1.sh`` runs the ``--warn-only`` form after the test
-suite; run the fatal form before shipping a new metric.
+The same rules also run under ``python -m bigdl_tpu.analysis`` (and
+``scripts/lint.sh``) alongside the other passes, where findings can
+additionally be pragma- or baseline-suppressed; this standalone form
+reports the raw pass output exactly as it always did.
 """
 
 from __future__ import annotations
 
 import argparse
-import ast
 import os
-import re
 import sys
-from typing import Dict, List, NamedTuple, Set, Tuple
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-PACKAGE = os.path.join(REPO, "bigdl_tpu")
-DOC = os.path.join(REPO, "docs", "observability.md")
-
-_METRIC_FNS = {"counter", "gauge", "histogram"}
-_SPAN_FNS = {"span", "record_span"}
-
-_METRIC_RE = re.compile(r"^[a-z][a-z0-9_]*$")
-_SPAN_RE = re.compile(r"^[a-z][a-z0-9_]*(/[a-z][a-z0-9_]*)*$")
-
-# a name in backticks is "documented" wherever it appears in the doc
-_DOC_NAME_RE = re.compile(r"`([a-z][a-z0-9_/]*)`")
-
-
-class Site(NamedTuple):
-    name: str
-    kind: str
-    file: str
-    line: int
-
-
-def _callee_name(call: ast.Call) -> str:
-    f = call.func
-    if isinstance(f, ast.Name):
-        return f.id
-    if isinstance(f, ast.Attribute):
-        return f.attr
-    return ""
-
-
-def collect(root: str) -> Tuple[List[Site], List[Site]]:
-    metrics: List[Site] = []
-    spans: List[Site] = []
-    for dirpath, _dirs, files in os.walk(root):
-        for fname in sorted(files):
-            if not fname.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fname)
-            rel = os.path.relpath(path, REPO)
-            with open(path, "r", encoding="utf-8") as f:
-                try:
-                    tree = ast.parse(f.read(), filename=rel)
-                except SyntaxError as e:
-                    print(f"metrics_lint: cannot parse {rel}: {e}",
-                          file=sys.stderr)
-                    continue
-            for node in ast.walk(tree):
-                if not isinstance(node, ast.Call) or not node.args:
-                    continue
-                arg0 = node.args[0]
-                if not (isinstance(arg0, ast.Constant)
-                        and isinstance(arg0.value, str)):
-                    continue
-                callee = _callee_name(node)
-                if callee in _METRIC_FNS:
-                    metrics.append(Site(arg0.value, callee, rel,
-                                        node.lineno))
-                elif callee in _SPAN_FNS:
-                    spans.append(Site(arg0.value, callee, rel,
-                                      node.lineno))
-    return metrics, spans
-
-
-def documented_names(doc_path: str) -> Set[str]:
-    if not os.path.isfile(doc_path):
-        return set()
-    with open(doc_path, "r", encoding="utf-8") as f:
-        return set(_DOC_NAME_RE.findall(f.read()))
-
-
-def span_inventory(doc_path: str) -> Set[str]:
-    """Span names from the doc's "## Span inventory" section — the
-    first backticked name of each table row.  Spans get the same
-    treatment as metric families: the INVENTORY table is the contract,
-    not a name incidentally backticked in prose somewhere."""
-    if not os.path.isfile(doc_path):
-        return set()
-    with open(doc_path, "r", encoding="utf-8") as f:
-        text = f.read()
-    out: Set[str] = set()
-    in_section = False
-    for line in text.splitlines():
-        if line.startswith("## "):
-            in_section = line.lower().startswith("## span inventory")
-            continue
-        if not in_section or not line.lstrip().startswith("|"):
-            continue
-        m = _DOC_NAME_RE.search(line)
-        if m and _SPAN_RE.match(m.group(1)):
-            out.add(m.group(1))
-    return out
-
-
-def lint() -> Tuple[List[str], List[str]]:
-    """Returns (errors, warnings)."""
-    errors: List[str] = []
-    warnings: List[str] = []
-    metrics, spans = collect(PACKAGE)
-    docs = documented_names(DOC)
-    inventory = span_inventory(DOC)
-    if not os.path.isfile(DOC):
-        errors.append(f"missing catalog doc {os.path.relpath(DOC, REPO)}")
-    elif not inventory:
-        errors.append("docs/observability.md has no parseable 'Span "
-                      "inventory' table")
-
-    by_name: Dict[str, List[Site]] = {}
-    for s in metrics:
-        by_name.setdefault(s.name, []).append(s)
-        if not _METRIC_RE.match(s.name):
-            errors.append(
-                f"{s.file}:{s.line}: metric name {s.name!r} is not "
-                f"snake_case")
-    for name, sites in sorted(by_name.items()):
-        if len(sites) > 1:
-            where = ", ".join(f"{s.file}:{s.line}" for s in sites)
-            errors.append(
-                f"metric {name!r} registered at {len(sites)} sites "
-                f"({where}); declare each family once, in "
-                f"bigdl_tpu/telemetry/families.py")
-        if name not in docs:
-            s = sites[0]
-            errors.append(
-                f"{s.file}:{s.line}: metric {name!r} missing from the "
-                f"docs/observability.md catalog")
-
-    seen_spans: Set[str] = set()
-    for s in spans:
-        if not _SPAN_RE.match(s.name):
-            errors.append(
-                f"{s.file}:{s.line}: span name {s.name!r} is not "
-                f"snake_case path segments")
-        if s.name not in inventory and s.name not in seen_spans:
-            errors.append(
-                f"{s.file}:{s.line}: span {s.name!r} missing from the "
-                f"docs/observability.md span inventory")
-        seen_spans.add(s.name)
-
-    # reverse direction, same rules for both kinds: documented but
-    # nothing emits it -> warning
-    for name in sorted(inventory - seen_spans):
-        warnings.append(
-            f"docs/observability.md span inventory lists {name!r} but "
-            f"nothing records it")
-    for name in sorted(docs - set(by_name)):
-        # only flag names that LOOK like metric catalog entries (known
-        # unit/total suffixes; plain words in prose backticks are not
-        # the catalog's problem, and spans are checked above against
-        # the inventory table)
-        if "/" not in name and re.search(
-                r"_(total|seconds|bytes|ms|ratio|depth|max)$", name):
-            warnings.append(
-                f"docs/observability.md documents {name!r} but nothing "
-                f"registers it")
-    return errors, warnings
+sys.path.insert(0, REPO)
 
 
 def main(argv=None) -> int:
@@ -201,6 +31,7 @@ def main(argv=None) -> int:
     p.add_argument("--warn-only", action="store_true",
                    help="always exit 0 (CI ride-along mode)")
     args = p.parse_args(argv)
+    from bigdl_tpu.analysis.passes.metrics_catalog import lint
     errors, warnings = lint()
     for w in warnings:
         print(f"metrics_lint: warning: {w}")

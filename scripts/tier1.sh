@@ -24,6 +24,13 @@ fi
 # directions): non-fatal here (ride-along visibility); the standalone
 # `python scripts/metrics_lint.py` form is fatal
 python "$(dirname "$0")/metrics_lint.py" --warn-only || true
+# graftlint static-analysis suite (trace safety, lock discipline,
+# collective accounting, clock discipline): AST passes only here —
+# warn-only ride-along writing the ANALYSIS_r<N>.json debt artifact;
+# run `scripts/lint.sh` standalone for the fatal form incl. the
+# compiled-HLO invariant passes
+bash "$(dirname "$0")/lint.sh" --warn-only --ast-only \
+  | tail -n 2 || true
 # health-watchdog smoke (chaos mini-train, /statusz, flight recorder):
 # warn-only ride-along; run scripts/health_smoke.sh standalone for the
 # fatal form.  mktemp, not a fixed /tmp name: parallel runs must not

@@ -1,0 +1,569 @@
+"""graftlint: the static-analysis suite (bigdl_tpu/analysis).
+
+Per-rule fixture snippets (true positive / true negative / pragma),
+suppression + baseline round-trips, an end-to-end run over a temp
+package, the zero-error acceptance pin on the shipped tree, and the
+compiled-HLO invariants on the 8-fake-device 2-slice mesh — including
+the deliberately-unpinned decode reproducing the PR-8 widening
+finding."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from bigdl_tpu.analysis import (
+    Finding, apply_suppressions, counts_of, load_baseline, load_tree,
+    pass_names, render_human, render_json, run_ast_passes,
+    write_baseline,
+)
+from bigdl_tpu.analysis.passes import (
+    clock_discipline, collective_discipline, lock_discipline,
+    metrics_catalog, trace_safety,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# fixture plumbing
+# ---------------------------------------------------------------------------
+
+def _mini_repo(tmp_path, files):
+    """A throwaway repo: {relpath: source} -> (root, SourceTree)."""
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return load_tree(root=str(tmp_path / "bigdl_tpu"),
+                     repo=str(tmp_path))
+
+
+def _by_rule(findings, rule):
+    return [f for f in findings if f.rule == rule and not f.suppressed]
+
+
+# ---------------------------------------------------------------------------
+# framework: findings, registry, pragmas, baseline
+# ---------------------------------------------------------------------------
+
+def test_registry_has_every_pass():
+    names = pass_names()
+    # (collective-axis is a second rule id the collective-discipline
+    # pass emits, not a separate registered pass)
+    for expected in ("trace-safety", "lock-discipline",
+                     "collective-discipline", "clock-discipline",
+                     "metrics-catalog"):
+        assert expected in names, names
+
+
+def test_finding_identity_excludes_line():
+    f = Finding("r", "error", "a.py", 42, "m", scope="S.f", code="x = 1")
+    assert f.key() == {"rule": "r", "file": "a.py", "scope": "S.f",
+                       "code": "x = 1"}
+    with pytest.raises(ValueError):
+        Finding("r", "fatal", "a.py", 1, "m")
+
+
+def test_render_json_round_trip():
+    f = Finding("r", "error", "a.py", 1, "m")
+    doc = json.loads(render_json([f], {"root": "pkg"}))
+    assert doc["schema"] == "graftlint_report"
+    assert doc["counts"]["error"] == 1
+    assert doc["findings"][0]["rule"] == "r"
+    assert doc["root"] == "pkg"
+
+
+def test_pragma_same_line_and_comment_block(tmp_path):
+    tree = _mini_repo(tmp_path, {"bigdl_tpu/optim/x.py": """\
+        import time
+        def f():
+            t0 = time.time()
+            a = time.time() - t0  # graftlint: disable=clock-discipline -- test
+            # graftlint: disable=clock-discipline -- reason wraps
+            # over more comment lines before the flagged one
+            b = time.time() - t0
+            c = time.time() - t0
+            return a, b, c
+        """})
+    findings = clock_discipline.run(tree)
+    apply_suppressions(findings, tree, [])
+    active = _by_rule(findings, "clock-discipline")
+    assert len(active) == 1  # only `c = ...` survives
+    assert active[0].code.startswith("c =")
+    assert sum(1 for f in findings if f.suppressed == "pragma") == 2
+
+
+def test_baseline_round_trip_match_stale_and_justification(tmp_path):
+    tree = _mini_repo(tmp_path, {"bigdl_tpu/optim/x.py": """\
+        import time
+        def f():
+            t0 = time.time()
+            return time.time() - t0
+        """})
+    findings = clock_discipline.run(tree)
+    assert len(findings) == 1
+    # a justified entry suppresses; an unjustified one errors; a stale
+    # one warns
+    entries = [dict(findings[0].key(), justification="known; fine"),
+               dict(findings[0].key(), code="nonexistent = 1",
+                    justification="paid off")]
+    path = write_baseline(entries, str(tmp_path / "base.json"))
+    loaded = load_baseline(path)
+    assert len(loaded) == 2
+    apply_suppressions(findings, tree, loaded, baseline_path=path)
+    assert findings[0].suppressed == "baseline"
+    stale = _by_rule(findings, "baseline-stale")
+    assert len(stale) == 1 and stale[0].severity == "warning"
+
+    findings2 = clock_discipline.run(tree)
+    entries2 = [dict(findings2[0].key(), justification="   ")]
+    apply_suppressions(findings2, tree, entries2)
+    assert findings2[0].suppressed is None  # empty reason: NOT excused
+    missing = _by_rule(findings2, "baseline-justification")
+    assert len(missing) == 1 and missing[0].severity == "error"
+
+
+def test_baseline_stale_judged_only_for_ran_rules(tmp_path):
+    tree = _mini_repo(tmp_path, {"bigdl_tpu/optim/x.py": "x = 1\n"})
+    entry = {"rule": "lock-discipline", "file": "a.py", "scope": "C.m",
+             "code": "self.x = 1", "justification": "fine"}
+    fs = apply_suppressions([], tree, [entry],
+                            ran_rules={"clock-discipline"})
+    assert _by_rule(fs, "baseline-stale") == []
+    fs = apply_suppressions([], tree, [entry], ran_rules=None)
+    assert len(_by_rule(fs, "baseline-stale")) == 1
+
+
+def test_baseline_malformed_raises(tmp_path):
+    p = tmp_path / "b.json"
+    p.write_text('{"version": 99, "entries": []}')
+    with pytest.raises(ValueError):
+        load_baseline(str(p))
+
+
+# ---------------------------------------------------------------------------
+# trace-safety
+# ---------------------------------------------------------------------------
+
+def test_trace_safety_positive_negative_and_edge(tmp_path):
+    tree = _mini_repo(tmp_path, {"bigdl_tpu/parallel/x.py": """\
+        import time
+        import random
+        import jax
+        import helpers
+
+        def helper(x):
+            return helpers.unknown(x) + time.time()
+
+        def step(params, x):
+            t = time.time()          # positive: clock in traced root
+            r = random.random()      # positive: host RNG
+            print(x)                 # positive: trace-time print
+            v = x.item()             # positive: host sync
+            s = float(x)             # positive: float(param) in a ROOT
+            return helper(params)    # edge into helper -> its clock too
+
+        step_c = jax.jit(step)
+
+        def not_traced(x):
+            return time.time() - 0   # negative: unreachable from roots
+        """})
+    findings = trace_safety.run(tree)
+    msgs = [f.message for f in findings]
+    lines = sorted(f.line for f in findings)
+    assert any("host clock" in m and "step" in m for m in msgs)
+    assert any("host RNG" in m for m in msgs)
+    assert any("print()" in m for m in msgs)
+    assert any(".item()" in m for m in msgs)
+    assert any("float() of parameter" in m for m in msgs)
+    # the call edge reached helper's clock read (line 7)
+    assert 7 in lines
+    # not_traced's time.time is NOT a trace-safety finding
+    assert all("not_traced" not in (f.scope or "") for f in findings)
+
+
+def test_trace_safety_float_of_param_only_in_roots(tmp_path):
+    """A transitively-reached helper coercing a (static-config) param
+    with float()/int() is NOT flagged — only roots' params are traced
+    arrays."""
+    tree = _mini_repo(tmp_path, {"bigdl_tpu/parallel/x.py": """\
+        import jax
+
+        def cfg_helper(block):
+            return int(block)
+
+        def step(x):
+            return x * cfg_helper(8)
+
+        step_c = jax.jit(step)
+        """})
+    assert trace_safety.run(tree) == []
+
+
+def test_trace_safety_mapped_prim_implicit_root(tmp_path):
+    tree = _mini_repo(tmp_path, {"bigdl_tpu/parallel/x.py": """\
+        import time
+        import jax
+
+        def sync(grads):
+            g = jax.lax.psum(grads, "data")
+            t = time.time()
+            return g, t
+
+        def probe(axis):
+            return jax.lax.psum(1, axis)   # size probe: NOT a root
+        """})
+    findings = trace_safety.run(tree)
+    assert len(findings) == 1
+    assert "sync" in findings[0].scope
+
+
+# ---------------------------------------------------------------------------
+# lock-discipline
+# ---------------------------------------------------------------------------
+
+_LOCK_SRC = """\
+    import threading
+
+    class Shared:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.count = 0          # negative: __init__ exempt
+            self.name = "x"         # immutable config
+            self.items = []
+
+        def bump(self):
+            with self._lock:
+                self.count += 1
+                self.items.append(1)
+
+        def naked_write(self):
+            self.count = 5          # positive: guarded attr, no lock
+
+        def naked_read(self):
+            return self.count       # positive
+
+        def config_read(self):
+            return self.name        # negative: never mutated post-init
+
+        def locked_read(self):
+            with self._lock:
+                return self.count   # negative
+
+    class Unlocked:
+        def __init__(self):
+            self.x = 1
+
+        def touch(self):
+            self.x += 1             # negative: class owns no lock
+    """
+
+
+def test_lock_discipline_positive_negative(tmp_path):
+    tree = _mini_repo(tmp_path,
+                      {"bigdl_tpu/telemetry/x.py": _LOCK_SRC})
+    findings = lock_discipline.run(tree)
+    assert {f.scope for f in findings} == {"Shared.naked_write",
+                                           "Shared.naked_read"}
+    assert all("count" in f.message for f in findings)
+
+
+def test_lock_discipline_scoped_to_threaded_packages(tmp_path):
+    # the same class in a non-threaded package is out of scope
+    tree = _mini_repo(tmp_path, {"bigdl_tpu/nn/x.py": _LOCK_SRC})
+    assert lock_discipline.run(tree) == []
+
+
+def test_lock_discipline_mutator_calls_count_as_writes(tmp_path):
+    tree = _mini_repo(tmp_path, {"bigdl_tpu/data/x.py": """\
+        import threading
+
+        class Q:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.buf = []
+
+            def locked(self):
+                with self._lock:
+                    return list(self.buf)
+
+            def producer(self):
+                self.buf.append(1)   # positive: in-place mutation
+        """})
+    findings = lock_discipline.run(tree)
+    assert len(findings) == 1 and findings[0].scope == "Q.producer"
+
+
+# ---------------------------------------------------------------------------
+# collective-discipline
+# ---------------------------------------------------------------------------
+
+def test_collective_discipline_and_axis_rules(tmp_path):
+    tree = _mini_repo(tmp_path, {
+        "bigdl_tpu/parallel/x.py": """\
+        import jax
+        from bigdl_tpu.telemetry import collectives as _coll
+
+        def bad(x):
+            return jax.lax.psum(x, "data")        # positive: raw
+
+        def size_probe(axis):
+            return jax.lax.psum(1, axis)           # negative: probe
+
+        def good(x):
+            return _coll.psum(x, "data")           # negative: wrapper
+
+        def typo(x):
+            return _coll.all_gather(x, "dcn2")     # positive: bad axis
+        """,
+        "bigdl_tpu/telemetry/collectives.py": """\
+        import jax
+
+        def psum(x, axis_name, **kw):
+            return jax.lax.psum(x, axis_name, **kw)  # negative: home
+        """,
+    })
+    findings = collective_discipline.run(tree)
+    raw = _by_rule(findings, "collective-discipline")
+    axis = _by_rule(findings, "collective-axis")
+    assert len(raw) == 1 and raw[0].scope == "bad"
+    assert len(axis) == 1 and "dcn2" in axis[0].message
+
+
+def test_mesh_axes_parsed_from_real_tree():
+    from bigdl_tpu.analysis.astutil import mesh_axes
+    tree = load_tree()
+    assert mesh_axes(tree) == {"dcn", "data", "fsdp", "model", "pipe",
+                               "seq", "expert"}
+
+
+# ---------------------------------------------------------------------------
+# clock-discipline
+# ---------------------------------------------------------------------------
+
+def test_clock_discipline_taint_paths(tmp_path):
+    tree = _mini_repo(tmp_path, {"bigdl_tpu/optim/x.py": """\
+        import time
+
+        class T:
+            def __init__(self):
+                self.t0 = time.time()
+
+            def up(self):
+                return time.time() - self.t0   # positive: attr taint
+
+        def direct():
+            return time.time() - 5.0           # positive: direct call
+
+        def local_taint():
+            t0 = time.time()
+            return 8.0 - t0                    # positive: local taint
+
+        def stamps_only():
+            rec = {"time": time.time()}        # negative: timestamp
+            return rec
+
+        def perf_ok():
+            t0 = time.perf_counter()
+            return time.perf_counter() - t0    # negative: trace clock
+
+        def span_stamp(tracing):
+            t = time.time()
+            tracing.record_span("x", t, t + 1)  # positive: span stamp
+        """})
+    findings = clock_discipline.run(tree)
+    scopes = sorted(f.scope for f in findings)
+    assert scopes == ["T.up", "direct", "local_taint", "span_stamp"]
+    span = [f for f in findings if f.scope == "span_stamp"][0]
+    assert "record_span" in span.message
+
+
+# ---------------------------------------------------------------------------
+# metrics-catalog through the framework
+# ---------------------------------------------------------------------------
+
+def test_metrics_catalog_pass_reproduces_zero_zero():
+    tree = load_tree()
+    findings = metrics_catalog.run(tree)
+    errors = [f for f in findings if f.severity == "error"]
+    warnings = [f for f in findings if f.severity == "warning"]
+    assert errors == [], render_human(errors)
+    assert warnings == [], render_human(warnings)
+
+
+def test_metrics_lint_shim_still_passes():
+    out = subprocess.run(
+        [sys.executable, "scripts/metrics_lint.py"],
+        capture_output=True, text=True, cwd=REPO)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "metrics_lint: OK (0 issue(s), 0 warning(s))" in out.stdout
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: temp package + the shipped tree
+# ---------------------------------------------------------------------------
+
+def test_e2e_temp_package_all_passes(tmp_path):
+    tree = _mini_repo(tmp_path, {
+        "bigdl_tpu/telemetry/bad.py": """\
+        import threading
+        import time
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.n = 0
+
+            def locked(self):
+                with self._lock:
+                    self.n += 1
+
+            def naked(self):
+                self.n = 2
+
+        def dur():
+            t0 = time.time()
+            return time.time() - t0
+        """,
+        "docs/observability.md": """\
+        ## Span inventory
+
+        | span | where |
+        |------|-------|
+        | `optimizer/step` | the loop |
+        """,
+    })
+    tree, findings = run_ast_passes(tree)
+    apply_suppressions(findings, tree, [])
+    counts = counts_of(findings)
+    rules = {f.rule for f in findings if not f.suppressed}
+    assert counts["error"] >= 2
+    assert {"lock-discipline", "clock-discipline"} <= rules
+    # parse errors are findings, not crashes
+    (tmp_path / "bigdl_tpu" / "broken.py").write_text("def oops(:\n")
+    tree2 = load_tree(root=str(tmp_path / "bigdl_tpu"),
+                      repo=str(tmp_path))
+    assert [f.rule for f in tree2.parse_findings] == ["parse-error"]
+
+
+def test_shipped_tree_is_zero_error_acceptance():
+    """THE acceptance pin: zero unsuppressed findings across all AST
+    passes on the shipped tree, every suppression carrying its reason
+    (pragma text or baseline justification)."""
+    tree, findings = run_ast_passes()
+    baseline = load_baseline()
+    apply_suppressions(findings, tree, baseline)
+    active = [f for f in findings
+              if not f.suppressed and f.severity == "error"]
+    assert active == [], "\n".join(render_human(active))
+    # every baseline entry justifies itself
+    assert all(str(e.get("justification", "")).strip()
+               for e in baseline)
+
+
+def test_cli_fatal_vs_warn_only(tmp_path):
+    (tmp_path / "pkg").mkdir()
+    (tmp_path / "pkg" / "bad.py").write_text(
+        "import time\n\ndef f():\n"
+        "    t0 = time.time()\n    return time.time() - t0\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    base = [sys.executable, "-m", "bigdl_tpu.analysis",
+            str(tmp_path / "pkg"), "--no-baseline"]
+    fatal = subprocess.run(base, capture_output=True, text=True,
+                           cwd=REPO, env=env)
+    assert fatal.returncode == 1, fatal.stdout + fatal.stderr
+    assert "clock-discipline" in fatal.stdout
+    report = tmp_path / "report.json"
+    warn = subprocess.run(base + ["--warn-only", "--json", str(report)],
+                          capture_output=True, text=True, cwd=REPO,
+                          env=env)
+    assert warn.returncode == 0, warn.stdout + warn.stderr
+    doc = json.loads(report.read_text())
+    assert doc["schema"] == "graftlint_report"
+    assert doc["counts"]["error"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# compiled-HLO passes (8-fake-device, 2-slice mesh)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def hlo_programs():
+    from bigdl_tpu.analysis.hlo_lint import _Programs
+    return _Programs()
+
+
+def test_hlo_flat_step_clean(hlo_programs):
+    from bigdl_tpu.analysis.hlo_lint import _check_cross_slice
+    assert _check_cross_slice(hlo_programs) == []
+
+
+def test_hlo_ratio_and_fast_tier_hold(hlo_programs):
+    from bigdl_tpu.analysis.hlo_lint import (
+        _check_dcn_ratio, _check_fast_tier,
+    )
+    ratio = _check_dcn_ratio(hlo_programs)
+    assert [f for f in ratio if f.severity == "error"] == [], \
+        render_human(ratio)
+    assert [f.severity for f in ratio] == ["info"]
+    assert _check_fast_tier(hlo_programs) == []
+
+
+def test_hlo_int8_step_narrow_on_wire(hlo_programs):
+    from bigdl_tpu.analysis.hlo_lint import (
+        _check_narrow_wire, narrow_wire_report,
+    )
+    assert _check_narrow_wire(hlo_programs) == []
+    rep = narrow_wire_report(hlo_programs.compiled("dcn-hier-int8"),
+                             hlo_programs.slice_map("dcn-flat"))
+    assert rep["narrow_bytes"] > 0
+    assert rep["wide_fraction"] <= 0.25
+
+
+def test_hlo_donation_elides_param_copy(hlo_programs):
+    from bigdl_tpu.analysis.hlo_lint import _check_donation
+    findings = _check_donation(hlo_programs)
+    assert [f.severity for f in findings] == ["info"], \
+        render_human(findings)
+
+
+def test_hlo_no_host_callbacks(hlo_programs):
+    from bigdl_tpu.analysis.hlo_lint import _check_host_callback
+    findings = _check_host_callback(hlo_programs)
+    assert all(f.severity == "info" for f in findings), \
+        render_human(findings)
+
+
+def test_hlo_unpinned_decode_reproduces_widening(monkeypatch):
+    """Acceptance: removing the optimization-barrier pin (the
+    BIGDL_TPU_UNPIN_DCN_WIRE seam compiles the decode-above-the-
+    exchange program the PR-8 hoist produced) FAILS the narrow-wire
+    pass loudly — and the byte-ratio pin catches it independently."""
+    from bigdl_tpu.analysis.hlo_lint import (
+        _Programs, _check_dcn_ratio, _check_narrow_wire,
+    )
+    monkeypatch.setenv("BIGDL_TPU_UNPIN_DCN_WIRE", "1")
+    progs = _Programs()
+    narrow = _check_narrow_wire(progs)
+    assert len(narrow) == 1 and narrow[0].severity == "error"
+    assert "widened" in narrow[0].message
+    ratio_errors = [f for f in _check_dcn_ratio(progs)
+                    if f.severity == "error"
+                    and f.scope == "dcn-hier-int8"]
+    assert len(ratio_errors) == 1
+
+
+def test_donated_alias_bytes_parser_units():
+    from bigdl_tpu.analysis.hlo_lint import donated_alias_bytes
+    text = ("HloModule m, input_output_alias={ {0}: (0, {}, may-alias), "
+            "{1}: (2, {}, may-alias) }, entry_computation_layout="
+            "{(f32[4,2]{1,0}, s32[]{:T(1)}, f32[8]{0})->(f32[4,2])}, "
+            "other=x\n")
+    total, n = donated_alias_bytes(text)
+    assert n == 2
+    assert total == 4 * 2 * 4 + 8 * 4  # params 0 and 2
+    assert donated_alias_bytes("no alias here") == (0.0, 0)
