@@ -735,6 +735,72 @@ def phase_int8(on_tpu: bool):
                 int8_config=f"resnet50-b{batch}-{size}px")
 
 
+def phase_generate_serving(on_tpu: bool):
+    """Continuous-batching decode throughput (serving.generation): the
+    ISSUE-10 acceptance workload — mixed-length prompts through the
+    fixed-shape KV slot pool vs the sequential ``generate()`` baseline.
+    Fully measurable on the CPU backend (unlike the MFU campaign), and
+    recorded as its own versioned RoundArtifact so the serving perf
+    trajectory is durable evidence like the training one."""
+    from bigdl_tpu.models import transformer_lm
+    from bigdl_tpu.serving.generation import run_mixed_workload
+    from bigdl_tpu.utils import set_seed
+
+    set_seed(7)
+    if on_tpu:
+        model = transformer_lm(vocab_size=32000, hidden_size=512,
+                               num_layers=6, num_heads=8,
+                               filter_size=1024, max_len=512)
+        n_req, slots, seq_sample = 32, 16, 8
+    else:
+        model = transformer_lm(vocab_size=128, hidden_size=64,
+                               num_layers=2, num_heads=4,
+                               filter_size=128, max_len=256)
+        n_req, slots, seq_sample = 32, 8, 6
+    rng = np.random.default_rng(10)
+    prompts = [rng.integers(1, 129, rng.integers(8, 65)).astype(np.int32)
+               for _ in range(n_req)]
+    max_news = [int(rng.integers(16, 129)) for _ in range(n_req)]
+    out = run_mixed_workload(model.eval_mode(), prompts, max_news,
+                             slots=slots, sequential_sample=seq_sample)
+    _update(gen_serving_tokens_per_sec=out["continuous_tokens_per_sec"],
+            gen_serving_speedup_vs_sequential=out.get(
+                "speedup_vs_sequential"),
+            gen_serving_greedy_equal_checked=out.get(
+                "greedy_equal_checked"),
+            gen_serving_greedy_checked_requests=out.get(
+                "greedy_checked_requests"),
+            gen_serving_slot_occupancy=out["slot_occupancy_mean"],
+            gen_serving_config=f"slots{slots}-req{n_req}-prompts8to64-"
+                               f"new16to128")
+    # durable evidence: its own artifact series (GENSERVE_r<N>.json),
+    # same envelope as the training rounds; latest_confirmed() keys on
+    # the BENCH_* pattern so this series never masquerades as one
+    try:
+        from bigdl_tpu.telemetry import perf
+        here = os.path.dirname(os.path.abspath(__file__))
+        tag = os.environ.get("BIGDL_TPU_ROUND", "latest")
+        payload = dict(out)
+        payload["metric"] = "generate_serving_tokens_per_sec"
+        payload["value"] = out["continuous_tokens_per_sec"]
+        payload["unit"] = "new_tokens/sec"
+        payload["platform"] = "tpu" if on_tpu else "cpu"
+        art = perf.make_round_artifact(
+            payload, kind="generate_serving", timestamp=time.time(),
+            device_kind=RESULT.get("device_kind"),
+            confirmed_on_device=bool(on_tpu),
+            git_rev=perf.git_revision(here))
+        path = perf.write_round_artifact(
+            os.path.join(here, f"GENSERVE_r{tag}.json"), art)
+        _log(f"generate_serving artifact: {os.path.basename(path)} "
+             f"({out['continuous_tokens_per_sec']} tok/s, "
+             f"{out.get('speedup_vs_sequential')}x vs sequential)")
+    except Exception:
+        _log("generate_serving artifact write failed (non-fatal):\n"
+             + traceback.format_exc())
+    return out
+
+
 def phase_roofline(on_tpu: bool):
     """Empirical bf16 matmul roofline: chained square matmuls (each
     output feeds the next so XLA cannot elide any), timed after warmup
@@ -961,6 +1027,12 @@ def main():
                   deadline_s=100.0)
     else:
         RESULT["phases"]["int8_infer"] = "skipped (budget)"
+    if _remaining() > 60.0:
+        run_phase("generate_serving",
+                  lambda: phase_generate_serving(on_tpu),
+                  deadline_s=120.0)
+    else:
+        RESULT["phases"]["generate_serving"] = "skipped (budget)"
 
     # RoundArtifact provenance on the result line itself: schema
     # version, run timestamp, git rev, and the confirmed-on-device flag

@@ -9,6 +9,12 @@ Protocol (stdlib-only on both ends):
 
 * ``POST /predict`` with an ``.npy``-serialized array body →
   ``.npy``-serialized output array (``application/octet-stream``).
+* ``POST /generate`` (with ``--generate MAX_NEW``) with a JSON body
+  ``{"prompt": [token ids], "max_new_tokens": n, "eos_id": t}`` →
+  ``{"tokens": [...]}`` — greedy continuation through the
+  continuous-batching KV slot pool (``bigdl_tpu.serving.generation``):
+  concurrent HTTP generations share decode iterations mid-flight
+  instead of serializing.
 * ``GET /healthz`` → ``{"status": "ok"}``, or **503**
   ``{"status": "draining"}`` once shutdown has begun — a load balancer
   keeps routing to a replica that answers 200, so a draining one must
@@ -56,8 +62,30 @@ class BatchedBytesFrontend:
         return npy_call_bytes(self._server.submit, payload)
 
 
+class GenerateJsonFrontend:
+    """JSON adapter for the continuous-batching generation engine: one
+    request body in, the full greedy token row out.  ``max_new_cap``
+    bounds the per-request decode budget a client may ask for."""
+
+    def __init__(self, server, max_new_cap: int):
+        self._server = server
+        self.max_new_cap = int(max_new_cap)
+
+    def generate_bytes(self, payload: bytes) -> bytes:
+        doc = json.loads(payload.decode("utf-8"))
+        prompt = doc["prompt"]
+        max_new = int(doc.get("max_new_tokens", self.max_new_cap))
+        if not (1 <= max_new <= self.max_new_cap):
+            raise ValueError(
+                f"max_new_tokens must be in [1, {self.max_new_cap}]")
+        row = self._server.submit_generate(
+            prompt, max_new, eos_id=doc.get("eos_id"))
+        return json.dumps({"tokens": [int(t) for t in row]}).encode()
+
+
 def make_server(service, host: str, port: int,
-                statusz_fn=None) -> ThreadingHTTPServer:
+                statusz_fn=None, generate_frontend=None
+                ) -> ThreadingHTTPServer:
     """ThreadingHTTPServer wired to a PredictionService; concurrency is
     bounded by the service's ticket pool, not the HTTP threads.  The
     returned server carries ``health_state`` (flip ``["draining"]`` to
@@ -102,6 +130,24 @@ def make_server(service, host: str, port: int,
         def do_POST(self):
             if self.handle_debugz("POST"):
                 return
+            if self.path == "/generate":
+                if generate_frontend is None:
+                    self._reply(404, json.dumps(
+                        {"error": "generation not enabled; start with "
+                                  "--generate MAX_NEW"}).encode(),
+                        "application/json")
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    payload = self.rfile.read(n)
+                    self._reply(200,
+                                generate_frontend.generate_bytes(payload),
+                                "application/json")
+                except Exception as e:  # noqa: BLE001 — client-facing
+                    self._reply(400, json.dumps(
+                        {"error": f"{type(e).__name__}: {e}"}).encode(),
+                        "application/json")
+                return
             if self.path != "/predict":
                 self._reply(404, b"not found", "text/plain")
                 return
@@ -135,6 +181,14 @@ def main(argv=None):
     p.add_argument("--batch-timeout-ms", type=float, default=5.0,
                    help="max wait before a partial batch is served "
                         "(only with --dynamic-batch)")
+    p.add_argument("--generate", type=int, default=None, metavar="MAX_NEW",
+                   help="enable POST /generate: continuous-batching "
+                        "greedy decoding over the loaded model's KV "
+                        "slot pool, at most MAX_NEW tokens per request "
+                        "(the model must expose the incremental-decode "
+                        "API, e.g. TransformerLM)")
+    p.add_argument("--slots", type=int, default=8,
+                   help="KV slot-pool width for --generate")
     p.add_argument("--no-telemetry", action="store_true",
                    help="disable the unified telemetry registry (the "
                         "/metrics endpoint then exposes an empty "
@@ -163,15 +217,22 @@ def main(argv=None):
     from bigdl_tpu.optim.predictor import PredictionService
     from bigdl_tpu.utils.serializer import load_module
 
-    service = PredictionService(load_module(args.model),
-                                concurrency=args.concurrency)
+    loaded = load_module(args.model)
+    service = PredictionService(loaded, concurrency=args.concurrency)
     batcher = None
     if args.dynamic_batch is not None:
         # bucket_sizes rejects 0/negative rather than silently ignoring
         batcher = service.serve(max_batch=args.dynamic_batch,
                                 batch_timeout_ms=args.batch_timeout_ms)
         service = BatchedBytesFrontend(batcher)
-    server = make_server(service, args.host, args.port)
+    gen_server = None
+    gen_frontend = None
+    if args.generate is not None:
+        from bigdl_tpu.serving import ModelServer
+        gen_server = ModelServer(generator=loaded, slots=args.slots)
+        gen_frontend = GenerateJsonFrontend(gen_server, args.generate)
+    server = make_server(service, args.host, args.port,
+                         generate_frontend=gen_frontend)
 
     def _statusz():
         info = {"role": "server", "model": args.model,
@@ -179,6 +240,8 @@ def main(argv=None):
                 "draining": server.health_state.get("draining", False)}
         if batcher is not None:
             info["queue_depth"] = batcher.queue_depth()
+        if gen_server is not None:
+            info["generation"] = gen_server.generation_stats()
         return info
 
     server.debugz.statusz_fn = _statusz
@@ -207,17 +270,21 @@ def main(argv=None):
         # so the load balancer stops routing to this replica while the
         # already-admitted requests finish
         server.health_state["draining"] = True
-        if batcher is not None:
+        if batcher is not None or gen_server is not None:
             # keep answering HTTP (now-503 health checks, in-flight
-            # predicts) on a background accept loop while the batcher
-            # drains: the documented drain answers every queued request
-            # before the scheduler thread exits
+            # predicts/generates) on a background accept loop while the
+            # batcher and the slot pool drain: the documented drain
+            # answers every queued request — and finishes every
+            # mid-decode generation — before the scheduler threads exit
             import threading
 
             t = threading.Thread(target=server.serve_forever,
                                  daemon=True, name="bigdl-serve-drain")
             t.start()
-            batcher.shutdown(drain=True)
+            if batcher is not None:
+                batcher.shutdown(drain=True)
+            if gen_server is not None:
+                gen_server.shutdown(drain=True)
             server.shutdown()
             t.join(timeout=10.0)
         server.server_close()

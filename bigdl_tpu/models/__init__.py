@@ -19,12 +19,23 @@ from bigdl_tpu.models.ncf import NeuralCF
 # arguments are listed; kwargs pass through to the builder.
 # ---------------------------------------------------------------------------
 
+def _transformer_lm_tiny(**kwargs):
+    """Small decoder-only LM for the serving demos: big enough to show
+    continuous batching winning, small enough to compile in seconds on
+    the CPU backend."""
+    cfg = dict(vocab_size=128, hidden_size=64, num_layers=2, num_heads=4,
+               filter_size=128, max_len=128)
+    cfg.update(kwargs)
+    return transformer_lm(**cfg)
+
+
 _ZOO = {
     "lenet5": LeNet5,
     "lenet5_graph": lenet5_graph,
     "autoencoder": autoencoder,
     "resnet_cifar": resnet_cifar,
     "vgg_cifar10": VggForCifar10,
+    "transformer_lm_tiny": _transformer_lm_tiny,
 }
 
 # per-sample (unbatched) input shape each zoo model expects, used by the

@@ -200,18 +200,17 @@ class TransformerLM(Module):
         logits = jnp.einsum("bth,vh->btv", x, self.embedding.weight)
         return logits[:, 0], new_caches
 
-    def _prefill(self, prompt, caches):
-        """Write prompt[:, :-1]'s per-layer K/V into the caches with ONE
-        dense forward over the whole prompt (parallel over T, MXU-
-        friendly) rather than Tp sequential decode steps; the last
-        prompt token is fed by the first decode step instead."""
-        Tp = prompt.shape[1]
-        if Tp == 1:
-            return caches
-        ptoks = prompt[:, :-1]
-        T = Tp - 1
-        pad_cols = jax.lax.dynamic_update_slice(
-            caches["pad"], ptoks == 0, (0, 0))
+    def prefill_kv(self, ptoks):
+        """Per-layer K/V for every position of ``ptoks`` (a prompt minus
+        its final token) as compact ``[B, heads, T, head_dim]`` arrays,
+        plus the ``[B, T]`` bool padding flags — the parallel-prefill
+        compute WITHOUT a max_len cache allocation.  ``_prefill``
+        scatters these into the front of a fresh cache; the serving slot
+        pool (serving/generation.py) scatters the same rows into
+        individual pool slots instead, so both prefill paths share one
+        implementation and cannot drift."""
+        _B, T = ptoks.shape
+        pad_cols = ptoks == 0
         x = self.embedding.forward(jnp.maximum(ptoks, 1))
         x = x * (self.hidden_size ** 0.5)
         x = x + position_encoding(T, self.hidden_size, dtype=x.dtype)
@@ -219,22 +218,16 @@ class TransformerLM(Module):
             + padding_bias(ptoks).astype(x.dtype)
         from bigdl_tpu.nn.attention import _residual_dropout
         from bigdl_tpu.ops import dot_product_attention
-        new_layers = []
-        for blk, cache in zip(self.blocks, caches["layers"]):
+        layers = []
+        for blk in self.blocks:
             # inline the block's attention so the K/V computed for the
             # cache are the ones used (blk.forward would recompute the
             # norm and all projections a second time)
             attn = blk.self_attn
             xn = blk.self_norm(x)
-            kv = cache["self"]
             k = attn._split_heads(attn.k_layer(xn))
             v = attn._split_heads(attn.v_layer(xn))
-            new_layers.append({"self": {
-                "k": jax.lax.dynamic_update_slice(
-                    kv["k"], k.astype(kv["k"].dtype), (0, 0, 0, 0)),
-                "v": jax.lax.dynamic_update_slice(
-                    kv["v"], v.astype(kv["v"].dtype), (0, 0, 0, 0)),
-            }})
+            layers.append({"k": k, "v": v})
             if blk.training and attn.attention_dropout > 0.0:
                 # rare train-mode prefill: the materialized-dropout path
                 # must run; recomputing k/v there is acceptable
@@ -246,6 +239,29 @@ class TransformerLM(Module):
             x = x + _residual_dropout(y, blk.ffn_dropout, blk.training)
             y = blk.ffn(blk.ffn_norm(x))
             x = x + _residual_dropout(y, blk.ffn_dropout, blk.training)
+        return layers, pad_cols
+
+    def _prefill(self, prompt, caches):
+        """Write prompt[:, :-1]'s per-layer K/V into the caches with ONE
+        dense forward over the whole prompt (parallel over T, MXU-
+        friendly) rather than Tp sequential decode steps; the last
+        prompt token is fed by the first decode step instead."""
+        Tp = prompt.shape[1]
+        if Tp == 1:
+            return caches
+        layers_kv, pad = self.prefill_kv(prompt[:, :-1])
+        pad_cols = jax.lax.dynamic_update_slice(caches["pad"], pad, (0, 0))
+        new_layers = []
+        for kv, cache in zip(layers_kv, caches["layers"]):
+            old = cache["self"]
+            new_layers.append({"self": {
+                "k": jax.lax.dynamic_update_slice(
+                    old["k"], kv["k"].astype(old["k"].dtype),
+                    (0, 0, 0, 0)),
+                "v": jax.lax.dynamic_update_slice(
+                    old["v"], kv["v"].astype(old["v"].dtype),
+                    (0, 0, 0, 0)),
+            }})
         return {"layers": new_layers, "pad": pad_cols}
 
     @staticmethod

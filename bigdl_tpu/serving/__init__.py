@@ -13,6 +13,9 @@ from bigdl_tpu.serving.admission import (      # noqa: F401
 from bigdl_tpu.serving.batching import (       # noqa: F401
     bucket_sizes, pick_bucket, split_outputs, stack_requests,
 )
+from bigdl_tpu.serving.generation import (     # noqa: F401
+    GenerationRequest, GenerationScheduler, SlotPool,
+)
 from bigdl_tpu.serving.metrics import MetricsRegistry      # noqa: F401
 from bigdl_tpu.serving.scheduler import BatchScheduler     # noqa: F401
 from bigdl_tpu.serving.server import (         # noqa: F401
@@ -21,6 +24,7 @@ from bigdl_tpu.serving.server import (         # noqa: F401
 
 __all__ = [
     "ModelServer", "MetricsRegistry", "BatchScheduler",
+    "GenerationScheduler", "GenerationRequest", "SlotPool",
     "BoundedRequestQueue", "Request",
     "QueueFullError", "RequestSheddedError", "ServerClosedError",
     "bucket_sizes", "pick_bucket", "stack_requests", "split_outputs",

@@ -9,6 +9,15 @@ TensorBoard event files via the visualization writer.
 
     # 3 random "MNIST" samples through int8 LeNet-5, batched:
     python -m bigdl_tpu.serving --model lenet5 --quantize --synthetic 3
+
+``--generate N`` switches to continuous-batching generation over an
+incremental-decode zoo model: each stdin line is a prompt of
+whitespace-separated 1-based token ids, each stdout line is
+``<index>\t<generated ids>`` (prompt + up to N new tokens, greedy), and
+mixed-length prompts share the fixed KV slot pool mid-flight:
+
+    python -m bigdl_tpu.serving --model transformer_lm_tiny \
+        --generate 16 --slots 4 --synthetic 8
 """
 
 from __future__ import annotations
@@ -40,6 +49,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="serve the int8-quantized model (nn.quantized)")
     p.add_argument("--synthetic", type=int, default=None, metavar="N",
                    help="serve N random samples instead of reading stdin")
+    p.add_argument("--generate", type=int, default=None, metavar="MAX_NEW",
+                   help="continuous-batching generation mode: stdin "
+                        "lines are token-id prompts; emit up to MAX_NEW "
+                        "greedy tokens each through the KV slot pool")
+    p.add_argument("--slots", type=int, default=4,
+                   help="KV slot-pool width for --generate")
     p.add_argument("--no-warmup", action="store_true",
                    help="skip pre-compiling the bucket shapes")
     p.add_argument("--log-dir", default=None,
@@ -58,6 +73,15 @@ def main(argv=None, stdin=None, stdout=None, stderr=None) -> int:
     from bigdl_tpu.serving.server import install_shutdown_signals
 
     model = zoo(args.model)
+    if args.generate is not None:
+        if args.quantize:
+            # dropping the flag silently would serve fp32 while the
+            # operator believes int8; the quantized wrappers also lack
+            # the incremental-decode API the slot pool needs
+            print("error: --quantize is not supported with --generate "
+                  "(the int8 path has no KV-cache decode)", file=stderr)
+            return 2
+        return _generate_main(args, model, stdin, stdout, stderr)
     shape = zoo_sample_shape(args.model)
     if args.quantize:
         from bigdl_tpu.nn.quantized import quantize
@@ -123,6 +147,70 @@ def main(argv=None, stdin=None, stdout=None, stderr=None) -> int:
         server.publish_metrics(summary, step=0)
         summary.close()
         print(f"metrics event file: {summary.writer_path}", file=stderr)
+    return 0
+
+
+def _generate_main(args, model, stdin, stdout, stderr) -> int:
+    """--generate mode: prompt lines in, greedy continuations out, all
+    sharing the continuous-batching slot pool."""
+    from bigdl_tpu.serving import ModelServer
+    from bigdl_tpu.serving.server import install_shutdown_signals
+
+    server = ModelServer(
+        generator=model, slots=args.slots,
+        gen_queue_capacity=args.queue_capacity, admission=args.policy)
+
+    if args.synthetic is not None:
+        rng = np.random.default_rng(0)
+        vocab = model.embedding.weight.shape[0] - 1
+        max_p = max(1, min(model.max_len - args.generate, 16))
+        prompts = [rng.integers(1, vocab + 1,
+                                rng.integers(1, max_p + 1)).astype(np.int32)
+                   for _ in range(args.synthetic)]
+    else:
+        prompts = None
+
+    def prompt_lines():
+        if prompts is not None:
+            yield from prompts
+            return
+        for line in stdin:
+            if line.strip():
+                yield line   # parsed (fallibly) in the submit loop
+
+    futures: List = []
+    restore_signals = install_shutdown_signals(server)
+    try:
+        try:
+            for p in prompt_lines():
+                # parse AND submit per line: a malformed line becomes
+                # one ERROR row, it must not abort the stream and
+                # discard every already-submitted generation
+                try:
+                    if isinstance(p, str):
+                        p = np.array(p.split(), dtype=np.int32)
+                    futures.append(
+                        server.submit_generate_async(p, args.generate))
+                except Exception as e:
+                    futures.append(e)
+        except KeyboardInterrupt:
+            print(f"interrupted: draining {len(futures)} in-flight "
+                  "generations", file=stderr)
+        for i, f in enumerate(futures):
+            try:
+                row = np.asarray(f.result() if not isinstance(f, Exception)
+                                 else _raise(f))
+            except Exception as e:
+                print(f"{i}\tERROR\t{type(e).__name__}", file=stdout)
+                continue
+            print(f"{i}\t" + " ".join(str(int(t)) for t in row),
+                  file=stdout)
+    finally:
+        server.shutdown(drain=True)
+        restore_signals()
+
+    print(json.dumps(server.generation_stats(), sort_keys=True),
+          file=stderr)
     return 0
 
 

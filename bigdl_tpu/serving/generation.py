@@ -1,0 +1,685 @@
+"""Continuous batching for generation: iteration-level scheduling over a
+fixed-shape KV slot pool.
+
+One-shot serving (scheduler.py) coalesces *single-forward* requests; a
+generation request is different in kind — it is a multi-step loop whose
+length varies per request.  Padding a batch of ``generate()`` calls to
+the slowest request serializes mixed-length traffic (Orca, OSDI '22
+names the problem).  This module schedules at ITERATION granularity
+instead:
+
+* a **slot pool** of S fixed KV-cache rows (the fixed-shape cousin of
+  vLLM's PagedAttention — one contiguous ``max_len`` row per slot, no
+  paging, because XLA wants static shapes);
+* one jitted, shape-stable **pooled decode step** advances every active
+  slot by one token per iteration, each slot at its OWN position, with
+  the pooled caches DONATED so the step updates the pool in place
+  instead of copying ``S x layers x max_len`` of K/V every token;
+* **prefill** is batched by power-of-two prompt-length buckets (reusing
+  ``batching.bucket_sizes``) at a fixed prefill batch width, then the
+  compact per-layer K/V rows are scattered into free slots — so a
+  request joins the pool as soon as a slot frees, mid-flight, and
+  leaves individually at EOS / max-tokens without disturbing the
+  co-resident slots.
+
+The compiled-program budget is O(1) in request count: the decode step
+compiles ONCE per (S, cache dtype) and prefill/scatter once per prompt
+bucket (``trace_counts`` exposes the evidence; tests assert it).
+
+Correctness bar: greedy tokens per request are BIT-IDENTICAL to a solo
+``model.generate()`` call, regardless of which requests share the pool
+or in which order they join and leave.  Two properties make that hold:
+
+* a slot position is always freshly written before it is read — prefill
+  writes positions ``0..Tp-2``, each decode step writes its position's
+  K/V and pad flag before attending — so a new occupant never sees its
+  predecessor's leftovers (no slot-reset pass needed);
+* trailing bucket padding is masked exactly (softmax of a -1e9 logit
+  underflows to 0.0 in f32), so the padded prefill reproduces the solo
+  prefill bit-for-bit at every real position.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from concurrent.futures import Future
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from bigdl_tpu import telemetry
+from bigdl_tpu.serving.admission import (
+    BoundedRequestQueue, ServerClosedError,
+)
+from bigdl_tpu.serving.batching import bucket_sizes, pick_bucket
+from bigdl_tpu.telemetry import tracing
+
+__all__ = ["GenerationRequest", "SlotPool", "GenerationScheduler",
+           "run_mixed_workload"]
+
+logger = logging.getLogger(__name__)
+
+
+class GenerationRequest:
+    """One generation request: prompt + decode budget + its completion
+    future.  Duck-types :class:`admission.Request` (``future``,
+    ``t_enqueue``) so the bounded queue's admission policies —
+    block/reject/shed_oldest — apply to generation unchanged."""
+
+    __slots__ = ("prompt", "max_new_tokens", "eos_id", "on_token",
+                 "future", "t_enqueue")
+
+    def __init__(self, prompt, max_new_tokens: int, eos_id=None,
+                 on_token: Optional[Callable[[int], None]] = None):
+        self.prompt = np.asarray(prompt, np.int32).reshape(-1)
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos_id = eos_id
+        self.on_token = on_token
+        self.future: "Future" = Future()
+        self.t_enqueue = time.perf_counter()
+
+
+class SlotPool:
+    """S fixed KV-cache slots plus the jitted shape-stable programs that
+    advance them.  Host-side per-slot decode state (current token,
+    position, active flag) lives here as numpy arrays; the pooled caches
+    live on device and are donated through every update."""
+
+    def __init__(self, model, slots: int, dtype=None,
+                 prefill_batch: int = 4):
+        import jax.numpy as jnp
+        if getattr(model, "seq_parallel", False):
+            raise ValueError(
+                "sequence-parallel models cannot serve from a slot pool "
+                "(the ring path has no decode cache); build a dense copy")
+        for attr in ("init_cache", "decode_step", "prefill_kv",
+                     "max_len", "_mask_untrained_logit"):
+            if not hasattr(model, attr):
+                raise TypeError(
+                    f"slot-pool generation needs a model with the "
+                    f"incremental-decode API (init_cache/decode_step/"
+                    f"prefill_kv): {type(model).__name__} lacks {attr!r}")
+        if slots < 1:
+            raise ValueError(f"slots must be >= 1, got {slots}")
+        # private eval-mode copy: serving must not flip the caller's
+        # training flags, and dropout in decode would break greedy
+        # equivalence with generate() on an eval'd model
+        self.model = model.clone().eval_mode()
+        self.slots = int(slots)
+        self.dtype = jnp.float32 if dtype is None else dtype
+        self.prefill_batch = max(1, int(prefill_batch))
+        self.max_len = int(model.max_len)
+        self.caches = self.model.init_cache(self.slots, self.dtype)
+        self.tok = np.zeros((self.slots,), np.int32)
+        self.index = np.zeros((self.slots,), np.int32)
+        self.active = np.zeros((self.slots,), bool)
+        # trace-time counters: the increments below run only while jax
+        # traces, so (with jit's cache) they equal compile counts —
+        # tests pin decode == 1 and prefill == one per bucket
+        self.trace_counts: Dict[str, object] = {
+            "decode": 0, "prefill": {}, "scatter": {}}
+        self._build_programs()
+
+    # -- compiled programs --------------------------------------------------
+
+    def _build_programs(self):
+        import jax
+        import jax.numpy as jnp
+        model = self.model
+        counts = self.trace_counts
+
+        def _decode(caches, tok, index, active):
+            counts["decode"] += 1
+
+            def one(cache, tok1, idx1):
+                cache1 = jax.tree_util.tree_map(lambda a: a[None], cache)
+                logits, nc = model.decode_step(tok1[None, None], idx1,
+                                               cache1)
+                nxt = (jnp.argmax(model._mask_untrained_logit(logits),
+                                  axis=-1).astype(jnp.int32) + 1)[0]
+                return jax.tree_util.tree_map(lambda a: a[0], nc), nxt
+
+            new_caches, nxt = jax.vmap(one)(caches, tok, index)
+            # inactive slots still burn a lane (S is shape-stable); mask
+            # their emission so 0 reliably means "nothing emitted"
+            # (active slots emit argmax+1 >= 1, never 0)
+            return new_caches, jnp.where(active, nxt, 0)
+
+        self._decode_jit = jax.jit(_decode, donate_argnums=(0,))
+
+        def _prefill(ptoks):
+            t = int(ptoks.shape[1])
+            counts["prefill"][t + 1] = counts["prefill"].get(t + 1, 0) + 1
+            return model.prefill_kv(ptoks)
+
+        self._prefill_jit = jax.jit(_prefill)
+
+        def _scatter(caches, slot_ids, layers_kv, pads):
+            t = int(pads.shape[1])
+            counts["scatter"][t + 1] = counts["scatter"].get(t + 1, 0) + 1
+            new_layers = []
+            for kv, cache in zip(layers_kv, caches["layers"]):
+                old = cache["self"]
+                # rows for padded prefill lanes carry slot_id == S:
+                # mode="drop" discards the out-of-range scatter instead
+                # of writing a real slot
+                new_layers.append({"self": {
+                    "k": old["k"].at[slot_ids, :, :t, :].set(
+                        kv["k"].astype(old["k"].dtype), mode="drop"),
+                    "v": old["v"].at[slot_ids, :, :t, :].set(
+                        kv["v"].astype(old["v"].dtype), mode="drop"),
+                }})
+            pad = caches["pad"].at[slot_ids, :t].set(pads, mode="drop")
+            return {"layers": new_layers, "pad": pad}
+
+        self._scatter_jit = jax.jit(_scatter, donate_argnums=(0,))
+
+    # -- pool operations ----------------------------------------------------
+
+    def cache_nbytes(self) -> int:
+        import jax
+        return sum(int(leaf.size) * leaf.dtype.itemsize
+                   for leaf in jax.tree_util.tree_leaves(self.caches))
+
+    def decode_hlo_text(self) -> str:
+        """Optimized HLO of the pooled decode step at the live pool
+        shapes — feed to ``analysis.hlo_lint.donated_alias_bytes`` to
+        verify the cache donation really elides the full copy."""
+        import jax
+        import jax.numpy as jnp
+        avals = jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), self.caches)
+        lowered = self._decode_jit.lower(
+            avals,
+            jax.ShapeDtypeStruct((self.slots,), jnp.int32),
+            jax.ShapeDtypeStruct((self.slots,), jnp.int32),
+            jax.ShapeDtypeStruct((self.slots,), jnp.bool_))
+        return lowered.compile().as_text()
+
+    def free_slots(self) -> List[int]:
+        return [i for i in range(self.slots) if not self.active[i]]
+
+    def n_active(self) -> int:
+        return int(self.active.sum())
+
+    def prefill_into(self, prompts: Sequence[np.ndarray],
+                     slot_ids: Sequence[int], bucket: int) -> None:
+        """Batched prefill of ``prompts`` (true lengths <= bucket) into
+        ``slot_ids``, at the fixed prefill batch width so the compiled
+        program is keyed by bucket alone.  Single-token buckets skip the
+        dense prefill entirely (the first decode step writes position
+        0), matching ``generate()``'s Tp == 1 path."""
+        import jax.numpy as jnp
+        n = len(prompts)
+        assert n == len(slot_ids) and 0 < n <= self.prefill_batch
+        if bucket > 1:
+            padded = np.zeros((self.prefill_batch, bucket), np.int32)
+            for i, p in enumerate(prompts):
+                padded[i, :len(p)] = p
+            if n < self.prefill_batch:
+                # dead lanes repeat row 0 (any valid prompt); their
+                # scatter is dropped via the out-of-range slot id
+                padded[n:] = padded[0]
+            ids = np.full((self.prefill_batch,), self.slots, np.int32)
+            ids[:n] = np.asarray(slot_ids, np.int32)
+            layers_kv, pads = self._prefill_jit(jnp.asarray(padded[:, :-1]))
+            self.caches = self._scatter_jit(
+                self.caches, jnp.asarray(ids), layers_kv, pads)
+        for p, s in zip(prompts, slot_ids):
+            # decode resumes from the last REAL prompt token at its true
+            # position — bucket padding never shifts a request
+            self.tok[s] = p[len(p) - 1]
+            self.index[s] = len(p) - 1
+            self.active[s] = True
+
+    def release(self, slot: int) -> None:
+        self.active[slot] = False
+        self.tok[slot] = 0
+        self.index[slot] = 0
+
+    def decode(self) -> np.ndarray:
+        """One pooled decode step: every active slot advances one token
+        at its own position.  Returns the ``[S]`` emitted tokens (0 for
+        inactive slots) after one host readback."""
+        import jax.numpy as jnp
+        self.caches, nxt = self._decode_jit(
+            self.caches, jnp.asarray(self.tok), jnp.asarray(self.index),
+            jnp.asarray(self.active))
+        out = np.asarray(nxt)
+        feed = out.astype(np.int32)
+        self.tok = np.where(self.active, feed, self.tok).astype(np.int32)
+        self.index = np.where(self.active, self.index + 1,
+                              self.index).astype(np.int32)
+        return out
+
+
+class _ActiveSlot:
+    """Host bookkeeping for one occupied slot."""
+
+    __slots__ = ("req", "emitted", "t_first", "eos_id")
+
+    def __init__(self, req: GenerationRequest, eos_id):
+        self.req = req
+        self.emitted: List[int] = []
+        self.t_first: Optional[float] = None
+        self.eos_id = eos_id
+
+
+class GenerationScheduler:
+    """Continuous-batching decode engine: the generation sibling of
+    :class:`BatchScheduler`.  One daemon thread owns the
+    admit -> prefill -> decode -> emit loop; submitters talk to it
+    through a :class:`BoundedRequestQueue` with the same admission
+    policies and drain machinery as one-shot serving.
+
+    >>> engine = GenerationScheduler(lm, slots=8)
+    >>> fut = engine.submit_async([5, 9, 2], max_new_tokens=16)
+    >>> fut.result()        # [Tp + 16] tokens, == lm.generate() solo
+    >>> engine.shutdown()   # drains admitted requests to completion
+    """
+
+    def __init__(self, model, slots: int = 8,
+                 queue_capacity: Optional[int] = None,
+                 admission: str = "block",
+                 prefill_batch: int = 4, dtype=None,
+                 eos_id=None, start: bool = True):
+        self.pool = SlotPool(model, slots, dtype=dtype,
+                             prefill_batch=prefill_batch)
+        self.default_eos_id = eos_id
+        cap = queue_capacity if queue_capacity is not None else 8 * slots
+        self._queue = BoundedRequestQueue(
+            cap, policy=admission, on_shed=self._record_shed)
+        self._prompt_buckets = bucket_sizes(self.pool.max_len)
+        self._slot_state: List[Optional[_ActiveSlot]] = [None] * slots
+        self._lock = threading.Lock()
+        self._requests_done = 0
+        self._tokens_emitted = 0
+        self._decode_steps = 0
+        self._prefill_calls = 0
+        self._decode_s = 0.0
+        self._prefill_s = 0.0
+        self._occupancy_sum = 0
+        self._ttft_sum = 0.0
+        self._ttft_n = 0
+        self._shed = 0
+        self._shutdown = False
+        # tokens/s gauge window (scheduler-thread-only state)
+        self._tps_tokens = 0
+        self._tps_t0 = time.perf_counter()
+        self._thread: Optional[threading.Thread] = None
+        if start:
+            self.start()
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> "GenerationScheduler":
+        if self._thread is not None:
+            raise RuntimeError("generation scheduler already started")
+        self._thread = threading.Thread(
+            target=self._run, name="bigdl-serving-generation", daemon=True)
+        self._thread.start()
+        return self
+
+    @property
+    def alive(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def shutdown(self, drain: bool = True,
+                 timeout: Optional[float] = 30.0) -> None:
+        """Stop admitting.  With ``drain`` (default) every queued
+        request is still generated to completion; otherwise queued
+        requests fail with ServerClosedError.  Requests already IN a
+        slot always finish — a multi-step decode is never abandoned
+        half-emitted."""
+        with self._lock:
+            if self._shutdown:
+                return
+            self._shutdown = True
+        self._queue.close(discard=not drain)
+        if self._thread is not None:
+            self._thread.join(timeout)
+            if self._thread.is_alive():
+                logger.warning(
+                    "generation scheduler did not drain within %ss",
+                    timeout)
+
+    # -- submission ---------------------------------------------------------
+
+    def submit_async(self, prompt, max_new_tokens: int, eos_id=None,
+                     on_token: Optional[Callable[[int], None]] = None,
+                     timeout: Optional[float] = None) -> Future:
+        """Admit one prompt (1-D int tokens) and return a Future of the
+        full ``[Tp + max_new_tokens]`` row — bit-identical to
+        ``model.generate(prompt[None], max_new_tokens, eos_id)[0]``.
+        ``on_token`` (optional) streams each emitted token from the
+        scheduler thread the iteration it is decoded."""
+        req = GenerationRequest(prompt, max_new_tokens, eos_id=eos_id,
+                                on_token=on_token)
+        err = self._validate(req)
+        if err is not None:
+            raise err
+        self._queue.put(req, timeout=timeout)
+        return req.future
+
+    def submit(self, prompt, max_new_tokens: int, eos_id=None,
+               timeout: Optional[float] = None) -> np.ndarray:
+        deadline = (None if timeout is None
+                    else time.perf_counter() + timeout)
+        fut = self.submit_async(prompt, max_new_tokens, eos_id=eos_id,
+                                timeout=timeout)
+        remaining = (None if deadline is None
+                     else max(deadline - time.perf_counter(), 0.0))
+        return fut.result(remaining)
+
+    def _validate(self, req: GenerationRequest) -> Optional[Exception]:
+        tp = len(req.prompt)
+        if tp < 1:
+            return ValueError("empty prompt")
+        if req.max_new_tokens < 1:
+            return ValueError(
+                f"max_new_tokens must be >= 1, got {req.max_new_tokens}")
+        if tp + req.max_new_tokens > self.pool.max_len:
+            return ValueError(
+                f"prompt {tp} + {req.max_new_tokens} new tokens exceeds "
+                f"max_len={self.pool.max_len}")
+        return None
+
+    # -- observability ------------------------------------------------------
+
+    def queue_depth(self) -> int:
+        return len(self._queue)
+
+    def _record_shed(self) -> None:
+        with self._lock:
+            self._shed += 1
+
+    def stats(self) -> Dict[str, object]:
+        """One lock-coherent snapshot of the engine counters (always on;
+        the unified telemetry families mirror a subset when enabled)."""
+        with self._lock:
+            steps = self._decode_steps
+            return {
+                "requests_done": self._requests_done,
+                "tokens_emitted": self._tokens_emitted,
+                "decode_steps": steps,
+                "prefill_calls": self._prefill_calls,
+                "decode_seconds": self._decode_s,
+                "prefill_seconds": self._prefill_s,
+                "slot_occupancy_mean": (self._occupancy_sum / steps
+                                        if steps else 0.0),
+                "queue_to_first_token_s_mean": (
+                    self._ttft_sum / self._ttft_n if self._ttft_n
+                    else 0.0),
+                "shed": self._shed,
+                "slots": self.pool.slots,
+                "tokens_per_second": (self._tokens_emitted / self._decode_s
+                                      if self._decode_s else 0.0),
+            }
+
+    # -- the engine loop ----------------------------------------------------
+
+    def _run(self) -> None:
+        pool = self.pool
+        while True:
+            arrivals: List[GenerationRequest] = []
+            if pool.n_active() == 0:
+                first = self._queue.get(timeout=None)
+                if first is None:
+                    return          # closed + drained, nothing in flight
+                arrivals.append(first)
+            free = pool.slots - pool.n_active() - len(arrivals)
+            if free > 0:
+                arrivals.extend(self._queue.get_nowait_up_to(free))
+            try:
+                if arrivals:
+                    self._admit(arrivals)
+                if pool.n_active():
+                    self._decode_once()
+            except Exception as e:  # noqa: BLE001 - engine must survive
+                # the BatchScheduler invariant, kept: a failing dispatch
+                # fails the affected futures and the loop continues —
+                # it never kills the one engine thread and strands
+                # RUNNING futures forever (per-site handlers below fail
+                # narrowly; this belt catches bookkeeping bugs)
+                logger.exception("generation engine iteration failed")
+                self._fail_in_flight(e)
+
+    def _fail_in_flight(self, exc: Exception) -> None:
+        """Fail every slot-resident request with ``exc`` and free its
+        slot; the engine keeps serving later arrivals (positions are
+        freshly written before read, so a poisoned cache cannot leak
+        into a new occupant)."""
+        for slot in range(self.pool.slots):
+            st = self._slot_state[slot]
+            if st is None:
+                continue
+            if not st.req.future.done():
+                st.req.future.set_exception(exc)
+            self._slot_state[slot] = None
+            self.pool.release(slot)
+
+    def _admit(self, arrivals: List[GenerationRequest]) -> None:
+        pool = self.pool
+        ready: List[GenerationRequest] = []
+        for req in arrivals:
+            err = self._validate(req)   # re-check: queue bypass callers
+            if err is not None:
+                if req.future.set_running_or_notify_cancel():
+                    req.future.set_exception(err)
+                continue
+            # PENDING -> RUNNING here: a future cancelled while queued
+            # drops out without consuming a slot, and cancel() can no
+            # longer race the final set_result
+            if req.future.set_running_or_notify_cancel():
+                ready.append(req)
+        if not ready:
+            return
+        free = pool.free_slots()
+        by_bucket: Dict[int, List[GenerationRequest]] = {}
+        for req in ready:
+            b = pick_bucket(len(req.prompt), self._prompt_buckets)
+            by_bucket.setdefault(b, []).append(req)
+        tel = telemetry.enabled()
+        for bucket in sorted(by_bucket):
+            reqs = by_bucket[bucket]
+            for lo in range(0, len(reqs), pool.prefill_batch):
+                chunk = reqs[lo:lo + pool.prefill_batch]
+                ids = [free.pop(0) for _ in chunk]
+                t0 = time.perf_counter()
+                try:
+                    # tracing.span is its own no-op when telemetry is
+                    # off; prefill is not the per-token hot path
+                    with tracing.span("serving/prefill", bucket=bucket,
+                                      n_real=len(chunk)):
+                        pool.prefill_into([r.prompt for r in chunk],
+                                          ids, bucket)
+                except Exception as e:  # noqa: BLE001 - fail the chunk,
+                    # not the engine: the slots were never activated
+                    logger.exception("prefill of bucket %d failed", bucket)
+                    for req in chunk:
+                        if not req.future.done():
+                            req.future.set_exception(e)
+                    continue
+                dt = time.perf_counter() - t0
+                for req, slot in zip(chunk, ids):
+                    eos = (req.eos_id if req.eos_id is not None
+                           else self.default_eos_id)
+                    self._slot_state[slot] = _ActiveSlot(req, eos)
+                with self._lock:
+                    self._prefill_calls += 1
+                    self._prefill_s += dt
+                if tel:
+                    from bigdl_tpu.telemetry import families
+                    families.generation_phase_seconds().labels(
+                        "prefill").observe(dt)
+
+    def _decode_once(self) -> None:
+        pool = self.pool
+        n_active = pool.n_active()
+        t0 = time.perf_counter()
+        try:
+            out = pool.decode()
+        except Exception as e:  # noqa: BLE001 - fail the residents,
+            # keep the engine thread alive for later arrivals
+            logger.exception("pooled decode step failed")
+            self._fail_in_flight(e)
+            return
+        now = time.perf_counter()
+        dt = now - t0
+        emitted = 0
+        finished: List[int] = []
+        for slot in range(pool.slots):
+            st = self._slot_state[slot]
+            if st is None or not pool.active[slot]:
+                continue
+            tok = int(out[slot])
+            st.emitted.append(tok)
+            emitted += 1
+            if st.t_first is None:
+                st.t_first = now
+            if st.req.on_token is not None:
+                try:
+                    st.req.on_token(tok)
+                except Exception:   # noqa: BLE001 - user callback
+                    logger.exception("on_token callback failed")
+            done = (st.eos_id is not None and tok == st.eos_id) \
+                or len(st.emitted) >= st.req.max_new_tokens
+            if done:
+                finished.append(slot)
+        tel = telemetry.enabled()
+        # counters BEFORE any future resolves: a waiter whose result()
+        # just returned may immediately read stats(), which must
+        # already include the iteration that finished it
+        with self._lock:
+            self._decode_steps += 1
+            self._tokens_emitted += emitted
+            self._decode_s += dt
+            self._occupancy_sum += n_active
+        for slot in finished:
+            st = self._slot_state[slot]
+            self._finish(st, now, tel)
+            self._slot_state[slot] = None
+            pool.release(slot)
+        if tel:
+            self._publish_telemetry(dt, n_active, emitted, now)
+
+    def _finish(self, st: _ActiveSlot, now: float, tel: bool) -> None:
+        req = st.req
+        row = np.zeros((len(req.prompt) + req.max_new_tokens,), np.int32)
+        row[:len(req.prompt)] = req.prompt
+        row[len(req.prompt):len(req.prompt) + len(st.emitted)] = st.emitted
+        ttft = ((st.t_first if st.t_first is not None else now)
+                - req.t_enqueue)
+        with self._lock:
+            # before set_result, same reason as the step counters
+            self._requests_done += 1
+            self._ttft_sum += ttft
+            self._ttft_n += 1
+        # positions after EOS stay 0 — exactly generate()'s padding
+        req.future.set_result(row)
+        if tel:
+            from bigdl_tpu.telemetry import families
+            families.generation_queue_to_first_token_seconds().observe(
+                ttft)
+            tracing.record_span("serving/generate", req.t_enqueue, now,
+                                prompt_len=len(req.prompt),
+                                new_tokens=len(st.emitted))
+
+    def _publish_telemetry(self, dt: float, n_active: int, emitted: int,
+                           now: float) -> None:
+        from bigdl_tpu.telemetry import families
+        families.generation_phase_seconds().labels("decode").observe(dt)
+        families.generation_slot_occupancy().set(n_active / self.pool.slots)
+        # tokens/s over a rolling ~0.5 s window (scheduler-thread-only
+        # counters; the gauge is the published aggregate)
+        self._tps_tokens += emitted
+        elapsed = now - self._tps_t0
+        if elapsed >= 0.5:
+            families.generation_tokens_per_second().set(
+                self._tps_tokens / elapsed)
+            self._tps_tokens = 0
+            self._tps_t0 = now
+
+
+# ---------------------------------------------------------------------------
+# Acceptance harness (shared by bench.py, the smoke script, and tests)
+# ---------------------------------------------------------------------------
+
+def run_mixed_workload(model, prompts: Sequence[np.ndarray],
+                       max_news: Sequence[int], slots: int = 8,
+                       eos_id=None, compare_sequential: bool = True,
+                       prefill_batch: int = 4,
+                       sequential_sample: Optional[int] = None
+                       ) -> Dict[str, object]:
+    """Drive a mixed-length workload through the continuous-batching
+    engine, optionally race the sequential ``generate()`` baseline, and
+    check greedy equivalence per request.  Returns a measurement dict
+    (tokens/s counts only NEW tokens, not prompt tokens).
+
+    ``sequential_sample`` caps the baseline at the first K requests —
+    the comparison is rate-based (tokens/s), so a sampled baseline
+    stays fair while keeping a budgeted bench phase affordable (the
+    sequential path re-traces ``generate()`` per (Tp, max_new) shape;
+    that cost is PART of what continuous batching removes)."""
+    import jax.numpy as jnp
+    engine = GenerationScheduler(model, slots=slots, eos_id=eos_id,
+                                 prefill_batch=prefill_batch,
+                                 queue_capacity=max(len(prompts), 1))
+    try:
+        t0 = time.perf_counter()
+        futs = [engine.submit_async(p, m)
+                for p, m in zip(prompts, max_news)]
+        rows = [f.result(timeout=600) for f in futs]
+        cont_s = time.perf_counter() - t0
+        stats = engine.stats()
+    finally:
+        engine.shutdown()
+    total_new = int(stats["tokens_emitted"])
+    out: Dict[str, object] = {
+        "requests": len(prompts),
+        "slots": slots,
+        "total_new_tokens": total_new,
+        "continuous_seconds": round(cont_s, 4),
+        "continuous_tokens_per_sec": round(total_new / cont_s, 2),
+        "slot_occupancy_mean": round(
+            float(stats["slot_occupancy_mean"]), 3),
+        "queue_to_first_token_s_mean": round(
+            float(stats["queue_to_first_token_s_mean"]), 4),
+        "prefill_seconds": round(float(stats["prefill_seconds"]), 4),
+        "decode_seconds": round(float(stats["decode_seconds"]), 4),
+    }
+    if compare_sequential:
+        k = (len(prompts) if sequential_sample is None
+             else min(int(sequential_sample), len(prompts)))
+        em = model.clone().eval_mode()
+        seq_rows = []
+        t0 = time.perf_counter()
+        for p, m in zip(prompts[:k], max_news[:k]):
+            seq_rows.append(np.asarray(em.generate(
+                jnp.asarray(p, jnp.int32)[None], m, eos_id=eos_id))[0])
+        seq_s = time.perf_counter() - t0
+        # count the baseline's ACTUALLY-emitted tokens, not its budget:
+        # with an eos_id, post-EOS positions are 0 (a real token is
+        # argmax+1 >= 1), and crediting the full budget would inflate
+        # the baseline rate and understate the speedup
+        seq_new = sum(int(np.count_nonzero(r[len(p):]))
+                      for p, r in zip(prompts[:k], seq_rows))
+        equal = all(np.array_equal(a, b)
+                    for a, b in zip(rows[:k], seq_rows))
+        out.update({
+            "sequential_requests": k,
+            "sequential_seconds": round(seq_s, 4),
+            "sequential_tokens_per_sec": round(seq_new / seq_s, 2),
+            "speedup_vs_sequential": round(
+                (total_new / cont_s) / (seq_new / seq_s), 2),
+            # equivalence is verified on exactly the requests the
+            # baseline decoded — the key says so, so a sampled run
+            # cannot record a full-set equivalence claim it never
+            # checked (the full-set property lives in
+            # tests/test_generation.py, where every row is compared)
+            "greedy_equal_checked": bool(equal),
+            "greedy_checked_requests": k,
+        })
+    return out

@@ -62,8 +62,11 @@ def install_shutdown_signals(server: "ModelServer",
     prev = {}
 
     def handler(signum, frame):
-        logger.info("signal %s: unwinding to drain %d queued requests "
-                    "before exit", signum, len(server._queue))
+        # no queue-depth peek here: the handler runs in signal context
+        # on the main thread, and taking the queue lock could deadlock
+        # against an interrupted put() holding it
+        logger.info("signal %s: unwinding to drain queued requests "
+                    "before exit", signum)
         raise KeyboardInterrupt
 
     for s in sigs:
@@ -122,22 +125,62 @@ class ModelServer:
     >>> server.shutdown()                     # drains the queue
     """
 
-    def __init__(self, backend, max_batch: int = 32,
+    def __init__(self, backend=None, max_batch: int = 32,
                  batch_timeout_ms: float = 5.0,
                  queue_capacity: Optional[int] = None,
                  admission: str = "block",
-                 metrics: Optional[MetricsRegistry] = None):
-        self._run_batch = _resolve_backend(backend)
-        self.buckets = bucket_sizes(max_batch)
-        self.max_batch = max_batch
-        cap = queue_capacity if queue_capacity is not None else 8 * max_batch
+                 metrics: Optional[MetricsRegistry] = None,
+                 generator=None, slots: int = 8,
+                 gen_queue_capacity: Optional[int] = None):
+        """``backend`` serves one-shot (single-forward) requests through
+        the dynamic batcher; ``generator`` — an incremental-decode model
+        (e.g. :class:`~bigdl_tpu.models.transformer_lm.TransformerLM`)
+        or a pre-built :class:`GenerationScheduler` — serves multi-step
+        generation requests through the continuous-batching slot pool
+        (``slots`` wide).  Either may be omitted, not both."""
+        if backend is None and generator is None:
+            raise TypeError(
+                "ModelServer needs a backend (one-shot inference), a "
+                "generator (continuous-batching generation), or both")
         self.metrics = metrics if metrics is not None else MetricsRegistry()
-        self._queue = BoundedRequestQueue(
-            cap, policy=admission, on_shed=self.metrics.record_shed)
-        self._scheduler = BatchScheduler(
-            self._queue, self._run_batch,
-            self.buckets, batch_timeout_ms, metrics=self.metrics)
-        self._scheduler.start()
+        self._run_batch = None
+        self._scheduler = None
+        self._queue = None
+        self.buckets = ()
+        self.max_batch = max_batch
+        if backend is not None:
+            self._run_batch = _resolve_backend(backend)
+            self.buckets = bucket_sizes(max_batch)
+            cap = (queue_capacity if queue_capacity is not None
+                   else 8 * max_batch)
+            self._queue = BoundedRequestQueue(
+                cap, policy=admission, on_shed=self.metrics.record_shed)
+            self._scheduler = BatchScheduler(
+                self._queue, self._run_batch,
+                self.buckets, batch_timeout_ms, metrics=self.metrics)
+            self._scheduler.start()
+        self.generation = None
+        if generator is not None:
+            try:
+                from bigdl_tpu.serving.generation import (
+                    GenerationScheduler,
+                )
+                if isinstance(generator, GenerationScheduler):
+                    self.generation = generator
+                else:
+                    self.generation = GenerationScheduler(
+                        generator, slots=slots,
+                        queue_capacity=gen_queue_capacity,
+                        admission=admission)
+            except BaseException:
+                # the one-shot scheduler thread is already running; a
+                # failed generator wiring must not leak it (and its
+                # queue) with no handle to shut it down
+                if self._queue is not None:
+                    self._queue.close(discard=True)
+                if self._scheduler is not None:
+                    self._scheduler.join(5.0)
+                raise
         self._shutdown = False
 
     # ---- submission ------------------------------------------------------
@@ -152,6 +195,10 @@ class ModelServer:
         submitter forever)."""
         if self._shutdown:
             raise ServerClosedError("server is shut down")
+        if self._queue is None:
+            raise RuntimeError(
+                "this server has no one-shot backend (generation-only); "
+                "use submit_generate / submit_generate_async")
         req = Request(sample)
         try:
             self._queue.put(req, timeout=timeout)
@@ -186,12 +233,88 @@ class ModelServer:
             out.append(f.result(remaining))
         return out
 
+    # ---- generation (continuous batching) --------------------------------
+
+    def _gen(self):
+        if self.generation is None:
+            raise RuntimeError(
+                "this server has no generation backend; construct with "
+                "generator=<TransformerLM or GenerationScheduler>")
+        if self._shutdown:
+            raise ServerClosedError("server is shut down")
+        return self.generation
+
+    def submit_generate_async(self, prompt, max_new_tokens: int,
+                              eos_id=None, on_token=None,
+                              timeout: Optional[float] = None) -> Future:
+        """Admit one prompt into the continuous-batching decode engine;
+        returns a Future of the full ``[Tp + max_new_tokens]`` token row
+        (greedy, bit-identical to a solo ``model.generate()``).  Unlike
+        one-shot inference the request is MULTI-STEP: it occupies a KV
+        slot for many decode iterations, and drain waits for every
+        admitted request's last token."""
+        return self._gen().submit_async(
+            prompt, max_new_tokens, eos_id=eos_id, on_token=on_token,
+            timeout=timeout)
+
+    def submit_generate(self, prompt, max_new_tokens: int, eos_id=None,
+                        timeout: Optional[float] = None):
+        """Blocking single-prompt generation (coalesced into the slot
+        pool with concurrent callers).  ``timeout`` covers admission AND
+        the full decode."""
+        return self._gen().submit(prompt, max_new_tokens, eos_id=eos_id,
+                                  timeout=timeout)
+
+    def submit_generate_many(self, prompts: Sequence,
+                             max_new_tokens, eos_id=None,
+                             timeout: Optional[float] = None) -> List:
+        """Submit a burst of prompts and wait for all rows, preserving
+        order.  ``max_new_tokens`` may be one int (applied to every
+        prompt) or a per-prompt sequence of equal length.  All prompts
+        are enqueued before the first wait, so a burst fills the slot
+        pool exactly like concurrent callers."""
+        try:
+            # operator.index: accepts int AND numpy integer scalars
+            # (rng.integers budgets), rejects sequences
+            import operator
+            max_new_tokens = [operator.index(max_new_tokens)] \
+                * len(prompts)
+        except TypeError:
+            max_new_tokens = list(max_new_tokens)
+            if len(max_new_tokens) != len(prompts):
+                raise ValueError(
+                    f"{len(prompts)} prompts but "
+                    f"{len(max_new_tokens)} max_new_tokens entries; "
+                    f"pass one budget per prompt (or a single int)")
+        futures = [self.submit_generate_async(p, m, eos_id=eos_id)
+                   for p, m in zip(prompts, max_new_tokens)]
+        deadline = (None if timeout is None
+                    else time.perf_counter() + timeout)
+        out = []
+        for f in futures:
+            remaining = (None if deadline is None
+                         else max(deadline - time.perf_counter(), 0.0))
+            out.append(f.result(remaining))
+        return out
+
+    def generation_queue_depth(self) -> int:
+        return 0 if self.generation is None \
+            else self.generation.queue_depth()
+
+    def generation_stats(self):
+        return None if self.generation is None \
+            else self.generation.stats()
+
     # ---- lifecycle -------------------------------------------------------
 
     def warmup(self, example_sample) -> "ModelServer":
         """Pre-compile every bucket shape by running a zeros batch
         through the backend, largest first (the compile cache then holds
         all shapes before traffic arrives)."""
+        if self._run_batch is None:
+            raise RuntimeError("warmup needs a one-shot backend; the "
+                               "generation engine compiles per bucket "
+                               "on first use")
         ex = example_sample
         parts = (tuple(np.asarray(a) for a in ex)
                  if isinstance(ex, (tuple, list)) else (np.asarray(ex),))
@@ -206,7 +329,7 @@ class ModelServer:
         return self
 
     def queue_depth(self) -> int:
-        return len(self._queue)
+        return 0 if self._queue is None else len(self._queue)
 
     def publish_metrics(self, summary, step: int = 0) -> None:
         """Export the metrics snapshot through a visualization Summary
@@ -218,15 +341,23 @@ class ModelServer:
         """Stop admitting requests.  With ``drain`` (default) every
         already-queued request is still served before the dispatch
         thread exits; otherwise queued requests fail with
-        ServerClosedError."""
+        ServerClosedError.  Generation requests are multi-step: drain
+        waits for every admitted request's LAST token, and even with
+        ``drain=False`` a request already occupying a KV slot finishes
+        (only still-queued ones are rejected) — a half-emitted
+        generation is never silently dropped."""
         if self._shutdown:
             return
         self._shutdown = True
-        self._queue.close(discard=not drain)
-        self._scheduler.join(timeout)
-        if self._scheduler.alive:
-            logger.warning("serving scheduler did not drain within %ss",
-                           timeout)
+        if self._queue is not None:
+            self._queue.close(discard=not drain)
+        if self.generation is not None:
+            self.generation.shutdown(drain=drain, timeout=timeout)
+        if self._scheduler is not None:
+            self._scheduler.join(timeout)
+            if self._scheduler.alive:
+                logger.warning(
+                    "serving scheduler did not drain within %ss", timeout)
 
     def __enter__(self) -> "ModelServer":
         return self

@@ -317,6 +317,43 @@ def serving_batch_occupancy() -> Gauge:
         labelnames=("rows",))
 
 
+# ---- generation serving (continuous batching, serving.generation) ---------
+
+def generation_tokens_per_second() -> Gauge:
+    return get_registry().gauge(
+        "generation_tokens_per_second",
+        "Aggregate decode throughput of the continuous-batching slot "
+        "pool (new tokens only), over a rolling ~0.5 s window")
+
+
+def generation_slot_occupancy() -> Gauge:
+    return get_registry().gauge(
+        "generation_slot_occupancy",
+        "Active slots / pool size sampled at each pooled decode step "
+        "(1.0 = every KV slot is earning tokens; low = admit more or "
+        "shrink S)")
+
+
+def generation_phase_seconds() -> Histogram:
+    return get_registry().histogram(
+        "generation_phase_seconds",
+        "Wall seconds per generation engine phase: one bucketed "
+        "prompt prefill+scatter, or one pooled decode step",
+        labelnames=("phase",),
+        buckets=(1e-4, 5e-4, 1e-3, 5e-3, 0.01, 0.025, 0.05, 0.1, 0.25,
+                 0.5, 1.0, 2.5, 10.0, float("inf")))
+
+
+def generation_queue_to_first_token_seconds() -> Histogram:
+    return get_registry().histogram(
+        "generation_queue_to_first_token_seconds",
+        "Queue-to-first-token latency per generation request (submit "
+        "to the first emitted token, the slot-wait + prefill cost a "
+        "client observes)",
+        buckets=(1e-3, 5e-3, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+                 10.0, 30.0, float("inf")))
+
+
 _PREREGISTER = (
     optimizer_data_wait_seconds, optimizer_step_seconds,
     optimizer_validation_seconds, optimizer_retries_total,
@@ -338,6 +375,8 @@ _PREREGISTER = (
     serving_requests_total, serving_batches_total, serving_shed_total,
     serving_rejected_total, serving_padded_waste_ratio,
     serving_batch_occupancy,
+    generation_tokens_per_second, generation_slot_occupancy,
+    generation_phase_seconds, generation_queue_to_first_token_seconds,
 )
 
 

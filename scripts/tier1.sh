@@ -79,4 +79,15 @@ if bash "$(dirname "$0")/comm_smoke.sh" >"$comm_log" 2>&1; then
 else
   echo "comm_smoke: FAILED (non-fatal ride-along; see $comm_log)"
 fi
+# continuous-batching generation smoke (mixed-length workload >= 3x the
+# sequential generate() baseline, greedy rows bit-identical, O(1)
+# compile counts, slot-pool cache donation via the HLO alias map):
+# warn-only ride-along; run scripts/serving_gen_smoke.sh standalone for
+# the fatal form
+gen_log=$(mktemp /tmp/serving_gen_smoke.XXXXXX.log)
+if bash "$(dirname "$0")/serving_gen_smoke.sh" >"$gen_log" 2>&1; then
+  tail -n 1 "$gen_log"
+else
+  echo "serving_gen_smoke: FAILED (non-fatal ride-along; see $gen_log)"
+fi
 exit $rc

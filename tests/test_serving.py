@@ -344,6 +344,38 @@ def test_quantized_int8_backend():
     np.testing.assert_allclose(np.stack(outs), want, rtol=1e-5, atol=1e-6)
 
 
+def test_quantized_serving_accuracy_gate():
+    """ROADMAP 4b release gate (≙ BigQuant whitepaper fig10): the int8
+    backend served through the dynamic batcher must hold top-1 accuracy
+    within 0.1% of fp32 on a fixed eval set — quantization fidelity is
+    gated, not just round-trip-tested.  The fp32 model's own argmax is
+    the eval label (teacher-as-ground-truth), so fp32 accuracy is
+    exactly 1.0 and the drop IS the disagreement rate; seeds are pinned
+    so the measurement is deterministic."""
+    from bigdl_tpu.nn.quantized import quantize
+    import jax.numpy as jnp
+
+    model = _model()
+    rng = np.random.default_rng(20)
+    eval_x = rng.normal(size=(2000, 4)).astype(np.float32)
+    labels = np.asarray(model.clone().eval_mode().forward(
+        jnp.asarray(eval_x))).argmax(-1)
+
+    qmodel = quantize(model)
+    server = ModelServer(qmodel, max_batch=16, batch_timeout_ms=2.0,
+                         queue_capacity=2048)
+    outs = []
+    for lo in range(0, len(eval_x), 256):
+        outs.extend(server.submit_many(list(eval_x[lo:lo + 256]),
+                                       timeout=120))
+    server.shutdown()
+    int8_acc = float((np.stack(outs).argmax(-1) == labels).mean())
+    drop = 1.0 - int8_acc
+    assert drop < 0.001, \
+        f"int8 serving accuracy drop {drop:.4%} >= 0.1% " \
+        f"(int8 acc {int8_acc:.4f} on 2000 fixed samples)"
+
+
 def test_prediction_service_serve_frontend():
     from bigdl_tpu.optim import PredictionService
     model = _model()
@@ -596,6 +628,114 @@ def test_http_server_with_dynamic_batching():
     assert backend.calls <= math.ceil(len(xs) / 4)
 
 
+def test_http_generate_endpoint():
+    """examples/serve.py --generate path: POST /generate JSON routes
+    through the continuous-batching engine; concurrent HTTP clients
+    share the slot pool."""
+    import http.client
+    import json
+    from bigdl_tpu.examples.serve import (
+        GenerateJsonFrontend, make_server,
+    )
+    from bigdl_tpu.models import transformer_lm
+
+    set_seed(0)
+    lm = transformer_lm(vocab_size=50, hidden_size=32, num_layers=2,
+                        num_heads=4, filter_size=64,
+                        max_len=64).eval_mode()
+    mserver = ModelServer(generator=lm, slots=2)
+    httpd = make_server(None, "127.0.0.1", 0,
+                        generate_frontend=GenerateJsonFrontend(
+                            mserver, max_new_cap=8))
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        port = httpd.server_port
+        rng = np.random.default_rng(23)
+        prompts = [rng.integers(1, 51, 4).tolist() for _ in range(4)]
+        outs = [None] * len(prompts)
+
+        def post(i):
+            body = json.dumps({"prompt": prompts[i],
+                               "max_new_tokens": 5}).encode()
+            conn = http.client.HTTPConnection("127.0.0.1", port,
+                                              timeout=60)
+            conn.request("POST", "/generate", body)
+            outs[i] = json.loads(conn.getresponse().read())
+            conn.close()
+
+        threads = [threading.Thread(target=post, args=(i,))
+                   for i in range(len(prompts))]
+        [th.start() for th in threads]
+        [th.join() for th in threads]
+        # over-cap budget is a client error, not a crash
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+        conn.request("POST", "/generate", json.dumps(
+            {"prompt": prompts[0], "max_new_tokens": 99}).encode())
+        assert conn.getresponse().status == 400
+        conn.close()
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        mserver.shutdown()
+    import jax.numpy as jnp
+    for p, out in zip(prompts, outs):
+        want = np.asarray(lm.generate(
+            jnp.asarray(p, jnp.int32)[None], 5))[0]
+        assert out["tokens"] == [int(v) for v in want]
+
+
+def test_generation_cli_synthetic():
+    """python -m bigdl_tpu.serving --generate round-trips token-id
+    prompts through the slot pool and prints the stats snapshot."""
+    import json
+    from bigdl_tpu.serving.__main__ import main
+    stdout, stderr = io.StringIO(), io.StringIO()
+    rc = main(["--model", "transformer_lm_tiny", "--generate", "4",
+               "--slots", "2", "--synthetic", "3"],
+              stdin=io.StringIO(""), stdout=stdout, stderr=stderr)
+    assert rc == 0
+    lines = stdout.getvalue().strip().splitlines()
+    assert len(lines) == 3
+    idx, toks = lines[2].split("\t")
+    assert idx == "2" and len(toks.split()) >= 5
+    snap = json.loads(stderr.getvalue().strip().splitlines()[-1])
+    assert snap["requests_done"] == 3
+    assert snap["tokens_emitted"] == 12
+    # --quantize cannot combine with --generate: rejected loudly, never
+    # silently served as fp32
+    err = io.StringIO()
+    rc = main(["--model", "transformer_lm_tiny", "--generate", "4",
+               "--quantize", "--synthetic", "1"],
+              stdin=io.StringIO(""), stdout=io.StringIO(), stderr=err)
+    assert rc == 2 and "--quantize" in err.getvalue()
+    # a malformed stdin line becomes ONE error row; the valid lines
+    # around it still print their generations
+    stdout2, stderr2 = io.StringIO(), io.StringIO()
+    rc = main(["--model", "transformer_lm_tiny", "--generate", "3",
+               "--slots", "2"],
+              stdin=io.StringIO("1 2 3\n4 foo 6\n7 8\n"),
+              stdout=stdout2, stderr=stderr2)
+    assert rc == 0
+    rows = stdout2.getvalue().strip().splitlines()
+    assert len(rows) == 3
+    assert "\tERROR\t" in rows[1]
+    assert len(rows[0].split("\t")[1].split()) == 6
+    assert len(rows[2].split("\t")[1].split()) == 5
+
+
+def test_model_server_generator_failure_does_not_leak_scheduler():
+    """A bad generator must not leave the already-started one-shot
+    scheduler thread running with no handle to stop it."""
+    before = {t.name for t in threading.enumerate()}
+    with pytest.raises(TypeError, match="incremental-decode"):
+        ModelServer(lambda x: np.asarray(x), max_batch=2,
+                    generator=object())
+    time.sleep(0.05)
+    leaked = {t.name for t in threading.enumerate()} - before
+    assert not any("serving" in n for n in leaked), leaked
+
+
 def test_submit_timeout_bounds_blocked_admission():
     """submit(x, timeout=N) must give up after ~N even when the queue is
     full under the block policy (wedged-backend scenario)."""
@@ -625,6 +765,71 @@ def test_weighted_histogram_matches_expanded():
     assert weighted.sum_squares == expanded.sum_squares
     assert weighted.bucket == expanded.bucket
     assert weighted.min == expanded.min and weighted.max == expanded.max
+
+
+def test_generation_drain_mid_decode_finishes_admitted():
+    """ISSUE 10 satellite: generation futures are MULTI-STEP, so drain
+    must wait for every admitted request's LAST token, not just the
+    current dispatch.  shutdown(drain=True) fired mid-decode completes
+    every burst-submitted future with the exact solo-generate row."""
+    import jax.numpy as jnp
+    from bigdl_tpu.models import transformer_lm
+
+    set_seed(0)
+    lm = transformer_lm(vocab_size=50, hidden_size=32, num_layers=2,
+                        num_heads=4, filter_size=64,
+                        max_len=64).eval_mode()
+    rng = np.random.default_rng(21)
+    prompts = [rng.integers(1, 51, rng.integers(2, 10)).astype(np.int32)
+               for _ in range(6)]
+    server = ModelServer(generator=lm, slots=2)
+    futs = [server.submit_generate_async(p, 12) for p in prompts]
+    # let the pool get genuinely mid-decode before draining
+    deadline = time.perf_counter() + 30
+    while server.generation_stats()["decode_steps"] < 2:
+        assert time.perf_counter() < deadline
+        time.sleep(0.01)
+    server.shutdown(drain=True, timeout=120)
+    for p, f in zip(prompts, futs):
+        want = np.asarray(lm.generate(jnp.asarray(p)[None], 12))[0]
+        np.testing.assert_array_equal(f.result(timeout=1), want)
+    with pytest.raises(ServerClosedError):
+        server.submit_generate_async(prompts[0], 2)
+
+
+def test_generation_discard_shutdown_rejects_unadmitted():
+    """shutdown(drain=False) mid-decode: requests already IN a KV slot
+    still finish (a half-emitted generation is never dropped); queued
+    ones reject cleanly with ServerClosedError."""
+    from bigdl_tpu.models import transformer_lm
+
+    set_seed(0)
+    lm = transformer_lm(vocab_size=50, hidden_size=32, num_layers=2,
+                        num_heads=4, filter_size=64,
+                        max_len=64).eval_mode()
+    rng = np.random.default_rng(22)
+    prompts = [rng.integers(1, 51, 6).astype(np.int32)
+               for _ in range(8)]
+    server = ModelServer(generator=lm, slots=2)
+    futs = [server.submit_generate_async(p, 30) for p in prompts]
+    deadline = time.perf_counter() + 30
+    while server.generation_stats()["decode_steps"] < 2:
+        assert time.perf_counter() < deadline
+        time.sleep(0.01)
+    server.shutdown(drain=False, timeout=120)
+    finished = rejected = 0
+    for p, f in zip(prompts, futs):
+        try:
+            row = f.result(timeout=1)
+            assert row.shape == (36,) and row[:6].tolist() == p.tolist()
+            finished += 1
+        except ServerClosedError:
+            rejected += 1
+    assert finished + rejected == len(futs)
+    # the two occupying slots at discard time must have finished; with
+    # 8 long requests over 2 slots some were still queued and rejected
+    assert finished >= 2
+    assert rejected >= 1
 
 
 def test_shutdown_signal_unwinds_into_drain():
