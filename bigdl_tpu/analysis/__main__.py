@@ -5,12 +5,16 @@ Usage::
     python -m bigdl_tpu.analysis                 # AST passes, fatal
     python -m bigdl_tpu.analysis --warn-only     # CI ride-along
     python -m bigdl_tpu.analysis --hlo           # + compiled-HLO passes
+    python -m bigdl_tpu.analysis --budget        # + parallelism budgets
     python -m bigdl_tpu.analysis --json out.json # machine report
     python -m bigdl_tpu.analysis --select clock-discipline,trace-safety
     python -m bigdl_tpu.analysis --list          # rule catalog
     python -m bigdl_tpu.analysis --update-baseline  # excuse current
                                                     # errors (then EDIT
                                                     # the justifications)
+    python -m bigdl_tpu.analysis --update-budget    # re-measure the
+                                                    # probe matrix (then
+                                                    # JUSTIFY the entries)
 
 Exit status: 1 when any unsuppressed ``error`` finding remains (and
 not ``--warn-only``), else 0.  ``scripts/lint.sh`` is the fatal
@@ -45,6 +49,24 @@ def main(argv=None) -> int:
                         "the virtual-CPU fallback)")
     p.add_argument("--hlo-only", action="store_true",
                    help="run ONLY the compiled-HLO passes")
+    p.add_argument("--budget", action="store_true",
+                   help="also run the parallelism-conformance budget "
+                        "passes (lowers the probe matrix — model zoo x "
+                        "strategy compositions — against "
+                        "scripts/parallel_budget.json)")
+    p.add_argument("--budget-only", action="store_true",
+                   help="run ONLY the budget passes")
+    p.add_argument("--budget-file", metavar="FILE", default=None,
+                   help="budget file (default "
+                        "scripts/parallel_budget.json)")
+    p.add_argument("--update-budget", action="store_true",
+                   help="re-measure the probe matrix and merge it into "
+                        "the budget file; new/drifted entries get EMPTY "
+                        "justifications so the gate stays red until "
+                        "each is hand-reviewed")
+    p.add_argument("--no-cache", action="store_true",
+                   help="ignore the /tmp probe-compile cache and "
+                        "re-lower the full matrix")
     p.add_argument("--baseline", metavar="FILE", default=None,
                    help="baseline file (default "
                         "scripts/graftlint_baseline.json)")
@@ -60,16 +82,15 @@ def main(argv=None) -> int:
                    help="list registered passes and exit")
     args = p.parse_args(argv)
 
-    if args.hlo or args.hlo_only:
-        # must land before the first backend touch
-        from bigdl_tpu.analysis.hlo_lint import ensure_backend
-        ensure_backend()
+    budget_mode = (args.budget or args.budget_only
+                   or args.update_budget)
 
     from bigdl_tpu.analysis import (
         apply_suppressions, counts_of, default_baseline_path,
         get_passes, load_baseline, load_tree, render_human, render_json,
         run_ast_passes, write_baseline,
     )
+    from bigdl_tpu.analysis.hlo_budget import BUDGET_RULES
     from bigdl_tpu.analysis.hlo_lint import HLO_RULES
 
     if args.list:
@@ -78,42 +99,84 @@ def main(argv=None) -> int:
         for rule in HLO_RULES:
             print(f"{rule:24s} [hlo] see "
                   f"bigdl_tpu/analysis/hlo_lint.py")
+        for rule in BUDGET_RULES:
+            print(f"{rule:24s} [budget] see "
+                  f"bigdl_tpu/analysis/hlo_budget.py")
         return 0
 
     select = (set(t.strip() for t in args.select.split(",") if t.strip())
               if args.select else None)
     ast_select = (None if select is None
-                  else [r for r in select if not r.startswith("hlo-")])
+                  else [r for r in select if not r.startswith("hlo-")
+                        and r not in BUDGET_RULES])
     if select is not None:
-        unknown_hlo = {r for r in select
-                       if r.startswith("hlo-")} - set(HLO_RULES)
+        unknown_hlo = ({r for r in select if r.startswith("hlo-")}
+                       - set(HLO_RULES) - set(BUDGET_RULES))
         if unknown_hlo:
             p.error(f"unknown HLO rule(s) {sorted(unknown_hlo)}; "
-                    f"known: {list(HLO_RULES)}")
-        if any(r.startswith("hlo-") for r in select) and not (
-                args.hlo or args.hlo_only):
+                    f"known: {list(HLO_RULES) + list(BUDGET_RULES)}")
+        if (select & set(HLO_RULES)) and not (args.hlo or args.hlo_only):
             # selecting an hlo rule IS asking for the HLO passes — a
             # run that silently checks nothing and prints OK would be
             # worse than an error
             args.hlo = True
+        if (select & set(BUDGET_RULES)) and not budget_mode:
+            budget_mode = args.budget = True
+
+    if args.hlo or args.hlo_only or budget_mode:
+        # AFTER select implication (a bare `--select hlo-reshard` must
+        # get the backend too), BEFORE the first backend touch: the
+        # probe compiles need the 8-virtual-device CPU fallback
+        from bigdl_tpu.analysis.hlo_lint import ensure_backend
+        ensure_backend()
 
     findings = []
     tree = None
     ran_rules = {"parse-error"}
-    if not args.hlo_only:
+    if not (args.hlo_only or args.budget_only):
         tree = load_tree(args.root)
         if ast_select is None or ast_select:
             sel = ast_select if ast_select else None
             tree, findings = run_ast_passes(tree, select=sel)
             for info in get_passes(kind="ast", select=sel):
                 ran_rules.update(info.rules)
-    if args.hlo or args.hlo_only:
+    if (args.hlo or args.hlo_only) and not args.budget_only:
         from bigdl_tpu.analysis.hlo_lint import run_hlo_passes
+        # an explicit --hlo with a --select naming no hlo rule still
+        # runs EVERY hlo pass (the flag asked for the family; a run
+        # that silently checks nothing and prints OK would be worse)
         hlo_select = (None if select is None
-                      else {r for r in select if r.startswith("hlo-")})
-        findings.extend(run_hlo_passes(
-            select=hlo_select if hlo_select else None))
+                      else ({r for r in select if r in HLO_RULES}
+                            or None))
+        findings.extend(run_hlo_passes(select=hlo_select))
         ran_rules.update(hlo_select if hlo_select else HLO_RULES)
+    if budget_mode:
+        from bigdl_tpu.analysis.hlo_budget import (
+            PROBES, probe_matrix, run_budget_passes, update_budget,
+        )
+        specs = PROBES()
+        matrix = None
+        if args.update_budget:
+            # lower the matrix ONCE and share it with the verdict run
+            # below (a --no-cache update would otherwise pay the full
+            # re-lower twice for identical results)
+            matrix = probe_matrix(specs, no_cache=args.no_cache)
+            path, added, refreshed = update_budget(
+                budget_path=args.budget_file, specs=specs,
+                matrix=matrix)
+            print(f"graftlint: budget: added {added}, refreshed "
+                  f"{refreshed} entr(ies) in {path} — justify every "
+                  f"empty justification before shipping")
+        # same family semantics as --hlo above: an explicit --budget
+        # with a foreign --select runs every budget rule
+        budget_select = (None if select is None
+                         else ({r for r in select if r in BUDGET_RULES}
+                               or None))
+        findings.extend(run_budget_passes(
+            select=budget_select, budget_path=args.budget_file,
+            no_cache=args.no_cache, specs=specs, matrix=matrix))
+        ran_rules.update(budget_select if budget_select
+                         else BUDGET_RULES)
     if tree is None:
         tree = load_tree(args.root)
 
@@ -151,6 +214,7 @@ def main(argv=None) -> int:
     if args.json:
         meta = {"root": os.path.relpath(tree.root, tree.repo),
                 "hlo": bool(args.hlo or args.hlo_only),
+                "budget": bool(budget_mode),
                 "warn_only": bool(args.warn_only)}
         with open(args.json, "w", encoding="utf-8") as f:
             f.write(render_json(findings, meta))

@@ -1,0 +1,968 @@
+"""Parallelism-conformance budgets: the composition × collective-byte
+matrix gate.
+
+GSPMD-style compilers (GSPMD, Alpa) silently insert resharding
+collectives when a partition spec is wrong — the failure mode is not a
+crash but a 4× collective-byte bill, invisible until someone reads the
+HLO.  Before the Optimizer façade starts composing dp×fsdp×tp×sp×ep×pp
+(ROADMAP item 2), every supported composition's communication contract
+is pinned here the way ``hlo-dcn-ratio`` pins the PR-8 sync envelope:
+a **probe catalog** lowers a small model zoo (cnn, transformer_lm,
+moe, plus the PR-8/PR-9 mlp probe) under every supported strategy
+composition on the 8-fake-device mesh, extracts per-{op, axis}
+collective bytes, FLOPs, donation coverage and temp-HBM watermarks
+from each compiled program, and checks them against the committed,
+per-entry-justified budget file ``scripts/parallel_budget.json`` (same
+baseline/identity/staleness semantics as ``graftlint_baseline.json``).
+
+Rules:
+
+* ``hlo-budget-bytes`` — each composition's {op, axis} collective-byte
+  matrix stays within its entry's declared tolerance; any drift is a
+  red gate naming the offending {op, axis}.  The PR-8 dcn envelope
+  (cross-slice 25.1 % fp32 / 13.1 % int8 of the flat baseline at S=2)
+  lives here as the ``mlp/dcn_hier_*`` entries' bytes, not as
+  hard-coded test constants.
+* ``hlo-reshard`` — collectives in the compiled step that the
+  composition's declared axes + the analytic plan
+  (``parallel/sharding.grad_allreduce_bytes``) do NOT predict: the
+  accidental full-parameter all-gather detector.  The deliberate
+  failure-mode seam ``BIGDL_TPU_BUDGET_MISSPEC=1`` injects a probe
+  whose rule shards parameters over the batch axis while declaring
+  pure dp — GSPMD inserts the classic per-step param all-gather and
+  this rule MUST flag it (asserted in tests; runnable by hand via
+  ``BIGDL_TPU_BUDGET_MISSPEC=1 python -m bigdl_tpu.analysis
+  --budget-only --select hlo-reshard`` — must FAIL).
+* ``hlo-flops-parity`` — per-device FLOPs vs the same model's
+  dp-baseline probe stays under the entry's declared parity bound
+  (perfectly sharded compute is ≈1.0×; silently replicated compute
+  shows up as the shard factor).
+* ``hlo-budget-memory`` — argument+temp HBM watermark per composition
+  vs budget, and donation coverage must not shrink.
+* ``budget-justification`` / ``budget-stale`` — every entry carries a
+  hand-written justification (empty = error, gate stays red after
+  ``--update-budget`` until reviewed); an entry matching no probe is a
+  staleness warning.
+
+Probe compiles are cached under ``$BIGDL_TPU_BUDGET_CACHE`` (default
+``/tmp/bigdl_tpu_hlo_budget``) keyed by (probe, jax version, hash of
+every ``bigdl_tpu`` source file), so ``scripts/lint.sh --budget``
+re-lowers the matrix only when the tree changed; ``--no-cache`` is the
+escape hatch.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Callable, Dict, List, NamedTuple, Optional, Tuple
+
+from bigdl_tpu.analysis.findings import Finding
+
+__all__ = ["BUDGET_RULES", "PROBES", "default_budget_path",
+           "load_budget", "write_budget", "probe_matrix",
+           "run_budget_passes", "update_budget", "tree_fingerprint"]
+
+BUDGET_RULES = ("hlo-budget-bytes", "hlo-reshard", "hlo-flops-parity",
+                "hlo-budget-memory", "budget-justification",
+                "budget-stale")
+
+_BUDGET_VERSION = 1
+_N_DEVICES = 8
+
+# check defaults, overridable per budget entry
+_BYTE_TOLERANCE = 0.05       # relative drift allowed on a byte bucket
+_BYTE_FLOOR = 512.0          # buckets under this never gate (scalars)
+_RESHARD_FLOOR = 2048.0      # unpredicted-collective size threshold
+_PLAN_SLACK = 2.0            # measured grad sync <= slack × analytic
+_MEMORY_TOLERANCE = 0.25     # watermark drift allowed
+_PARITY_BOUND = 1.3          # default per-device flops vs dp baseline
+
+# gradient-sync opcodes the analytic plan speaks for (the plan check
+# compares these, per batch axis, against grad_allreduce_bytes)
+_SYNC_OPS = ("all-reduce",)
+
+
+def default_budget_path() -> str:
+    from bigdl_tpu.analysis.astutil import repo_root
+    return os.path.join(repo_root(), "scripts", "parallel_budget.json")
+
+
+# ---------------------------------------------------------------------------
+# budget file (same shape discipline as scripts/graftlint_baseline.json)
+# ---------------------------------------------------------------------------
+
+def load_budget(path: Optional[str] = None) -> List[Dict]:
+    """The budget entries ([] when the file doesn't exist yet).
+    Raises ValueError on a malformed file — a broken budget must not
+    silently gate nothing."""
+    path = path or default_budget_path()
+    if not os.path.isfile(path):
+        return []
+    with open(path, "r", encoding="utf-8") as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict) or doc.get("version") != _BUDGET_VERSION \
+            or not isinstance(doc.get("entries"), list):
+        raise ValueError(
+            f"{path}: not a parallel-budget file "
+            f"(need {{version: {_BUDGET_VERSION}, entries: [...]}})")
+    for e in doc["entries"]:
+        missing = {"probe", "collective_bytes"} - set(e)
+        if missing:
+            raise ValueError(
+                f"{path}: budget entry {e.get('probe', e)!r} missing "
+                f"{sorted(missing)}")
+    return doc["entries"]
+
+
+def write_budget(entries: List[Dict], path: Optional[str] = None) -> str:
+    path = path or default_budget_path()
+    doc = {"version": _BUDGET_VERSION,
+           "entries": sorted(entries, key=lambda e: e["probe"])}
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return path
+
+
+# ---------------------------------------------------------------------------
+# probe catalog
+# ---------------------------------------------------------------------------
+
+class ProbeSpec(NamedTuple):
+    """One (model, composition) probe: how to lower it and what its
+    declared axes predict."""
+    name: str                 # "<model>/<composition>"
+    model: str
+    composition: str
+    build: Callable[[], Dict]  # -> {"compiled", "mesh", "plan_bytes",
+    #                               "param_bytes"}
+    # axis -> opcodes the composition's plan predicts on that axis;
+    # anything else above the reshard floor is a reshard finding
+    expected: Dict[str, Tuple[str, ...]]
+    flops_baseline: Optional[str] = None   # probe name of dp baseline
+    plan_check: bool = False  # compare sync bytes vs grad_allreduce_bytes
+    negative: bool = False    # failure-mode seam: reshard check only
+
+
+def _sum_param_nbytes(model) -> int:
+    import numpy as np
+
+    from bigdl_tpu.core.module import Module, ModuleList
+    total = 0
+
+    def rec(obj):
+        nonlocal total
+        if isinstance(obj, Module):
+            for p in obj._params.values():
+                total += int(np.prod(p.shape)) * np.dtype(p.dtype).itemsize
+            for m in obj._modules.values():
+                rec(m)
+        elif isinstance(obj, ModuleList):
+            for m in obj._items:
+                rec(m)
+
+    rec(model)
+    return total
+
+
+def _optimizer_probe(make_model, sample_shape, make_batch, axes, rules,
+                     criterion=None, sample_dtype="float32",
+                     hierarchical=False, wire=None) -> Dict:
+    """Lower the training step the Optimizer would dispatch for this
+    (model, mesh, rules) triple — the same ``compile_step`` hook the
+    comm tooling reads."""
+    import numpy as np
+
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.dataset.dataset import Sample
+    from bigdl_tpu.optim import Optimizer, SGD
+    from bigdl_tpu.parallel.mesh import MeshConfig
+    from bigdl_tpu.parallel.sharding import grad_allreduce_bytes
+
+    model = make_model()
+    target = (np.zeros(sample_shape[1], np.int64)
+              if isinstance(sample_shape, tuple)
+              and isinstance(sample_shape[0], tuple) else 1)
+    feat_shape = (sample_shape[0] if isinstance(sample_shape, tuple)
+                  and isinstance(sample_shape[0], tuple) else sample_shape)
+    opt = (Optimizer(model,
+                     [Sample(np.zeros(feat_shape, sample_dtype), target)],
+                     criterion or nn.ClassNLLCriterion(), batch_size=16)
+           .set_optim_method(SGD(0.1))
+           .set_mesh(MeshConfig(**axes), rules))
+    if hierarchical:
+        opt.set_gradient_sync(hierarchical=True, wire_dtype=wire)
+    compiled = opt.compile_step(make_batch())
+    mesh = opt.mesh_config.build()
+    plan = None
+    if not hierarchical:
+        try:
+            plan = grad_allreduce_bytes(model, mesh, rules)["bytes_per_step"]
+        except Exception:
+            plan = None
+    return {"compiled": compiled, "mesh": mesh, "plan_bytes": plan,
+            "param_bytes": _sum_param_nbytes(model)}
+
+
+# -- model builders ---------------------------------------------------------
+
+def _cnn():
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.utils import set_seed
+    set_seed(7)
+    return nn.Sequential(
+        nn.SpatialConvolution(3, 8, 3, 3, pad_w=1, pad_h=1), nn.ReLU(),
+        nn.SpatialMaxPooling(2, 2, 2, 2), nn.Reshape((4 * 4 * 8,)),
+        nn.Linear(4 * 4 * 8, 64), nn.ReLU(), nn.Linear(64, 10),
+        nn.LogSoftMax())
+
+
+def _cnn_batch():
+    import numpy as np
+
+    from bigdl_tpu.dataset.dataset import MiniBatch
+    rng = np.random.default_rng(5)
+    return MiniBatch(rng.normal(size=(16, 8, 8, 3)).astype(np.float32),
+                     rng.integers(1, 11, size=(16,)).astype(np.int64))
+
+
+def _mlp():
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.utils import set_seed
+    set_seed(99)
+    return nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 10),
+                         nn.LogSoftMax())
+
+
+def _mlp_batch():
+    import numpy as np
+
+    from bigdl_tpu.dataset.dataset import MiniBatch
+    rng = np.random.default_rng(5)
+    return MiniBatch(rng.normal(size=(16, 16)).astype(np.float32),
+                     rng.integers(1, 11, size=(16,)).astype(np.int64))
+
+
+def _lm():
+    from bigdl_tpu.models import transformer_lm
+    from bigdl_tpu.utils import set_seed
+    set_seed(31)
+    return transformer_lm(vocab_size=30, hidden_size=16, num_layers=2,
+                          num_heads=2, filter_size=32, max_len=32)
+
+
+def _lm_batch():
+    import numpy as np
+
+    from bigdl_tpu.dataset.dataset import MiniBatch
+    rng = np.random.default_rng(9)
+    return MiniBatch(rng.integers(1, 31, size=(16, 32)).astype(np.int32),
+                     rng.integers(1, 31, size=(16, 32)).astype(np.int64))
+
+
+def _lm_criterion():
+    import bigdl_tpu.nn as nn
+    return nn.TimeDistributedCriterion(nn.CrossEntropyCriterion(),
+                                       dimension=2)
+
+
+def _lm_tp_rules(fsdp=False):
+    from bigdl_tpu.parallel.sharding import tensor_parallel_rules
+    return tensor_parallel_rules(
+        column=[r"q_layer", r"k_layer", r"v_layer", r"filter_layer"],
+        row=[r"output_layer", r"out_layer"], fsdp=fsdp)
+
+
+def _lm_probe(axes, rules) -> Dict:
+    return _optimizer_probe(
+        _lm, ((32,), (32,)), _lm_batch, axes, rules,
+        criterion=_lm_criterion(), sample_dtype="int32")
+
+
+def _misspec_probe() -> Dict:
+    """THE negative leg: every parameter sharded over the batch axis by
+    rule while the composition declares pure dp (replicated params) —
+    GSPMD must insert a full-parameter all-gather every step, exactly
+    the silent reshard this gate exists to catch."""
+    from bigdl_tpu.parallel.sharding import ShardingRules, fsdp_spec
+    bad = ShardingRules(
+        [(r".*", lambda shape, mesh: fsdp_spec(tuple(shape), mesh,
+                                               axis="data"))])
+    return _optimizer_probe(_cnn, (8, 8, 3), _cnn_batch, {"data": 8}, bad)
+
+
+def _functional_probe(build_loss, grad: bool = True) -> Dict:
+    """Lower a fwd+bwd jax program directly (the sp/ep/pp strategies
+    live outside the Optimizer façade until the ROADMAP item-2
+    refactor lands; their conformance is pinned at the jax level the
+    MULTICHIP dryrun proves).  ``grad=False`` for steps that already
+    compute their own gradients in-schedule (1F1B)."""
+    import jax
+    fn, args, mesh, model = build_loss()
+    if grad:
+        fn = jax.value_and_grad(fn)
+    compiled = jax.jit(fn).lower(*args).compile()
+    return {"compiled": compiled, "mesh": mesh, "plan_bytes": None,
+            "param_bytes": (_sum_param_nbytes(model)
+                            if model is not None else None)}
+
+
+def _sp_loss():
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.core.module import combine, partition
+    from bigdl_tpu.models import transformer_lm
+    from bigdl_tpu.utils import set_seed
+
+    set_seed(11)
+    rng = np.random.default_rng(0)
+    lm = transformer_lm(vocab_size=30, hidden_size=16, num_layers=2,
+                        num_heads=2, filter_size=32,
+                        max_len=64).eval_mode()
+    mesh = Mesh(np.array(jax.devices()[:_N_DEVICES]), ("seq",))
+    lm.set_sequence_parallel(mesh, "seq")
+    toks = jnp.asarray(rng.integers(1, 31, (2, 64)), jnp.int32)
+    targets = jnp.asarray(rng.integers(1, 31, (2, 64)), jnp.int32)
+    crit = nn.CrossEntropyCriterion()
+    params, rest = partition(lm)
+
+    def loss(p, toks, targets):
+        out = combine(p, rest).forward(toks).reshape(-1, 31)
+        return crit(out, targets.reshape(-1))
+
+    return loss, (params, toks, targets), mesh, lm
+
+
+def _pp_loss():
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.parallel import Pipeline
+    from bigdl_tpu.utils import set_seed
+
+    set_seed(13)
+    rng = np.random.default_rng(0)
+    pipe = Pipeline([nn.TransformerEncoderLayer(16, 2, 32)
+                     for _ in range(4)], num_microbatches=4).eval_mode()
+    xb = jnp.asarray(rng.normal(size=(8, 6, 16)), jnp.float32)
+    tgt = jnp.asarray(rng.normal(size=(8, 6, 16)), jnp.float32)
+    mesh = Mesh(np.array(jax.devices()[:4]), ("pipe",))
+
+    def mse(out, t):
+        return jnp.mean((out - t) ** 2)
+
+    # 1F1B computes its own gradients in-schedule — the step IS the
+    # fwd+bwd program, no outer value_and_grad
+    def step(x, t):
+        return pipe.train_step_on_mesh(x, t, mse, mesh)
+
+    return step, (xb, tgt), mesh, pipe
+
+
+def _ep_loss(n_devices, capacity):
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.core.module import combine, partition
+    from bigdl_tpu.nn.moe import MoE
+    from bigdl_tpu.utils import set_seed
+
+    set_seed(12)
+    rng = np.random.default_rng(0)
+    moe = MoE(16, [nn.FeedForwardNetwork(16, 32) for _ in range(8)],
+              top_k=2).eval_mode()
+    mesh = Mesh(np.array(jax.devices()[:n_devices]), ("expert",))
+    moe.set_mesh(mesh, capacity_factor=capacity)
+    x = jnp.asarray(rng.normal(size=(2, 8, 16)), jnp.float32)
+    mp, rest = partition(moe)
+
+    def loss(p, x):
+        return jnp.sum(combine(p, rest).forward(x) ** 2)
+
+    return loss, (mp, x), mesh, moe
+
+
+def _build_probes() -> Dict[str, ProbeSpec]:
+    from bigdl_tpu.parallel.sharding import ShardingRules
+    # what each composition legitimately puts on each axis.  Tight for
+    # dp/tp (any extra op above the floor = reshard); broad for the
+    # fsdp families, whose param gathers — and, on the conv net, the
+    # involuntary-remat reshuffles XLA warns about at compile time —
+    # are part of the (budget-pinned) contract.
+    DP = ("all-reduce",)
+    FSDP = ("all-reduce", "all-gather", "reduce-scatter",
+            "collective-permute", "all-to-all")
+    specs = [
+        # -- cnn (conv+MLP; the MULTICHIP dryrun model) ---------------------
+        ProbeSpec(
+            "cnn/dp", "cnn", "dp",
+            lambda: _optimizer_probe(_cnn, (8, 8, 3), _cnn_batch,
+                                     {"data": _N_DEVICES},
+                                     ShardingRules()),
+            expected={"data": DP}, plan_check=True),
+        ProbeSpec(
+            "cnn/fsdp", "cnn", "fsdp",
+            lambda: _optimizer_probe(_cnn, (8, 8, 3), _cnn_batch,
+                                     {"fsdp": _N_DEVICES},
+                                     ShardingRules(fsdp=True)),
+            expected={"fsdp": FSDP}, flops_baseline="cnn/dp"),
+        ProbeSpec(
+            "cnn/dp_fsdp", "cnn", "dp_fsdp",
+            lambda: _optimizer_probe(_cnn, (8, 8, 3), _cnn_batch,
+                                     {"data": 4, "fsdp": 2},
+                                     ShardingRules(fsdp=True)),
+            expected={"data": FSDP, "fsdp": FSDP},
+            flops_baseline="cnn/dp"),
+        ProbeSpec(
+            "cnn/dcn_dp", "cnn", "dcn_dp",
+            lambda: _optimizer_probe(_cnn, (8, 8, 3), _cnn_batch,
+                                     {"dcn": 2, "data": -1},
+                                     ShardingRules()),
+            expected={"dcn": DP, "data": DP},
+            flops_baseline="cnn/dp", plan_check=True),
+        # -- mlp (the PR-8/PR-9 probe model: the dcn sync envelope) ---------
+        ProbeSpec(
+            "mlp/dp", "mlp", "dp",
+            lambda: _optimizer_probe(_mlp, (16,), _mlp_batch,
+                                     {"data": _N_DEVICES},
+                                     ShardingRules()),
+            expected={"data": DP}, plan_check=True),
+        ProbeSpec(
+            "mlp/dcn_dp", "mlp", "dcn_dp",
+            lambda: _optimizer_probe(_mlp, (16,), _mlp_batch,
+                                     {"dcn": 2, "data": -1},
+                                     ShardingRules()),
+            expected={"dcn": DP, "data": DP},
+            flops_baseline="mlp/dp", plan_check=True),
+        ProbeSpec(
+            "mlp/dcn_hier_fp32", "mlp", "dcn_hier_fp32",
+            lambda: _optimizer_probe(_mlp, (16,), _mlp_batch,
+                                     {"dcn": 2, "data": -1},
+                                     ShardingRules(), hierarchical=True),
+            expected={"dcn": ("all-reduce",),
+                      "data": ("reduce-scatter", "all-gather",
+                               "all-reduce")},
+            flops_baseline="mlp/dp"),
+        ProbeSpec(
+            "mlp/dcn_hier_bf16", "mlp", "dcn_hier_bf16",
+            lambda: _optimizer_probe(_mlp, (16,), _mlp_batch,
+                                     {"dcn": 2, "data": -1},
+                                     ShardingRules(), hierarchical=True,
+                                     wire="bf16"),
+            expected={"dcn": ("all-to-all", "all-gather", "all-reduce"),
+                      "data": ("reduce-scatter", "all-gather",
+                               "all-reduce")},
+            flops_baseline="mlp/dp"),
+        ProbeSpec(
+            "mlp/dcn_hier_int8", "mlp", "dcn_hier_int8",
+            lambda: _optimizer_probe(_mlp, (16,), _mlp_batch,
+                                     {"dcn": 2, "data": -1},
+                                     ShardingRules(), hierarchical=True,
+                                     wire="int8"),
+            expected={"dcn": ("all-to-all", "all-gather", "all-reduce"),
+                      "data": ("reduce-scatter", "all-gather",
+                               "all-reduce")},
+            flops_baseline="mlp/dp"),
+        # -- transformer_lm -------------------------------------------------
+        ProbeSpec(
+            "transformer_lm/dp", "transformer_lm", "dp",
+            lambda: _lm_probe({"data": _N_DEVICES}, ShardingRules()),
+            expected={"data": DP}, plan_check=True),
+        ProbeSpec(
+            "transformer_lm/fsdp", "transformer_lm", "fsdp",
+            lambda: _lm_probe({"fsdp": _N_DEVICES},
+                              ShardingRules(fsdp=True)),
+            expected={"fsdp": FSDP},
+            flops_baseline="transformer_lm/dp"),
+        ProbeSpec(
+            "transformer_lm/dp_tp", "transformer_lm", "dp_tp",
+            lambda: _lm_probe({"data": 4, "model": 2}, _lm_tp_rules()),
+            expected={"data": DP, "model": DP},
+            flops_baseline="transformer_lm/dp"),
+        ProbeSpec(
+            # 3-way: model axis gets the FSDP op set too — with
+            # fsdp=True rules in play XLA legitimately stages the
+            # unmatched leaves' gathers across the model axis as well
+            # (pinned byte-for-byte by the budget entry)
+            "transformer_lm/dp_fsdp_tp", "transformer_lm", "dp_fsdp_tp",
+            lambda: _lm_probe({"data": 2, "fsdp": 2, "model": 2},
+                              _lm_tp_rules(fsdp=True)),
+            expected={"data": FSDP, "fsdp": FSDP, "model": FSDP},
+            flops_baseline="transformer_lm/dp"),
+        ProbeSpec(
+            "transformer_lm/sp", "transformer_lm", "sp",
+            lambda: _functional_probe(_sp_loss),
+            expected={"seq": ("collective-permute", "all-gather",
+                              "all-reduce")}),
+        ProbeSpec(
+            "transformer_lm/pp", "transformer_lm", "pp",
+            lambda: _functional_probe(_pp_loss, grad=False),
+            expected={"pipe": ("collective-permute", "all-reduce")}),
+        # -- moe ------------------------------------------------------------
+        ProbeSpec(
+            "moe/ep", "moe", "ep",
+            lambda: _functional_probe(
+                lambda: _ep_loss(_N_DEVICES, 2.0)),
+            expected={"expert": ("all-to-all", "all-reduce",
+                                 "collective-permute")}),
+        ProbeSpec(
+            "moe/ep_psum", "moe", "ep_psum",
+            lambda: _functional_probe(lambda: _ep_loss(4, None)),
+            expected={"expert": ("all-reduce", "collective-permute")}),
+    ]
+    if os.environ.get("BIGDL_TPU_BUDGET_MISSPEC"):
+        specs.append(ProbeSpec(
+            "cnn/misspec_dp", "cnn", "misspec_dp", _misspec_probe,
+            expected={"data": DP}, plan_check=True, negative=True))
+    return {s.name: s for s in specs}
+
+
+def PROBES() -> Dict[str, ProbeSpec]:
+    """The probe catalog (built lazily: probe builders import jax)."""
+    return _build_probes()
+
+
+# ---------------------------------------------------------------------------
+# metric extraction + the /tmp compile cache
+# ---------------------------------------------------------------------------
+
+def tree_fingerprint() -> str:
+    """sha256 over (jax version, every bigdl_tpu source file) — the
+    cache key that makes 'unchanged tree' precise.  Any source edit
+    invalidates every probe: over-invalidation costs one re-lower,
+    under-invalidation would let a stale matrix green-light a
+    regression."""
+    import jax
+
+    from bigdl_tpu.analysis.astutil import repo_root
+    h = hashlib.sha256(jax.__version__.encode())
+    root = os.path.join(repo_root(), "bigdl_tpu")
+    for dirpath, dirnames, files in os.walk(root):
+        dirnames[:] = [d for d in sorted(dirnames) if d != "__pycache__"]
+        for fname in sorted(files):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            h.update(os.path.relpath(path, root).encode())
+            with open(path, "rb") as f:
+                h.update(f.read())
+    return h.hexdigest()[:24]
+
+
+def _cache_dir() -> str:
+    override = os.environ.get("BIGDL_TPU_BUDGET_CACHE")
+    if override:
+        return override
+    # uid-scoped: /tmp is shared, and a fatal ship gate must not trust
+    # metrics another local user could pre-seed under a fixed path
+    import tempfile
+    uid = os.getuid() if hasattr(os, "getuid") else 0
+    return os.path.join(tempfile.gettempdir(),
+                        f"bigdl_tpu_hlo_budget-uid{uid}")
+
+
+def _cache_trusted(path: str) -> bool:
+    """Only read cache entries we own (same shared-/tmp concern)."""
+    if not hasattr(os, "getuid"):
+        return True
+    try:
+        return os.stat(path).st_uid == os.getuid()
+    except OSError:
+        return False
+
+
+def _extract_metrics(spec: ProbeSpec, build: Dict) -> Dict:
+    from bigdl_tpu.parallel.mesh import axis_coord_maps
+    from bigdl_tpu.utils.xla_cost import (
+        collective_hlo_bytes, compiled_flops, per_axis_hlo_bytes,
+    )
+    compiled, mesh = build["compiled"], build["mesh"]
+    matrix = per_axis_hlo_bytes(compiled, axis_coord_maps(mesh))
+    total = collective_hlo_bytes(compiled)
+    out = {
+        "probe": spec.name,
+        "model": spec.model,
+        "composition": spec.composition,
+        "mesh_axes": {a: int(mesh.shape[a]) for a in mesh.axis_names},
+        "collective_bytes": matrix if matrix is not None else None,
+        "collective_total": None if total is None else total["total"],
+        "flops": compiled_flops(compiled),
+        "plan_bytes": build.get("plan_bytes"),
+        "param_bytes": build.get("param_bytes"),
+    }
+    try:
+        text = compiled.as_text()
+    except Exception:
+        text = ""
+    from bigdl_tpu.analysis.hlo_lint import donated_alias_bytes
+    don, n_don = donated_alias_bytes(text) if text else (0.0, 0)
+    out["donated_bytes"] = don
+    out["donated_params"] = n_don
+    try:
+        ma = compiled.memory_analysis()
+        out["argument_bytes"] = int(ma.argument_size_in_bytes)
+        out["temp_bytes"] = int(ma.temp_size_in_bytes)
+        out["output_bytes"] = int(ma.output_size_in_bytes)
+    except Exception:
+        out["argument_bytes"] = out["temp_bytes"] = None
+        out["output_bytes"] = None
+    return out
+
+
+def probe_matrix(specs: Optional[Dict[str, ProbeSpec]] = None,
+                 no_cache: bool = False,
+                 fingerprint: Optional[str] = None) -> Dict[str, Dict]:
+    """Compile (or cache-load) every probe and return
+    ``{probe_name: metrics}``.  A probe whose build raises contributes
+    a ``{"error": ...}`` record — the budget pass turns it into a
+    finding instead of killing the whole gate."""
+    specs = specs or PROBES()
+    fp = fingerprint or tree_fingerprint()
+    cdir = os.path.join(_cache_dir(), fp)
+    out: Dict[str, Dict] = {}
+    backend_ready = False
+    for name in sorted(specs):
+        spec = specs[name]
+        cpath = os.path.join(cdir, name.replace("/", "__") + ".json")
+        if not no_cache and os.path.isfile(cpath) \
+                and _cache_trusted(cpath):
+            try:
+                with open(cpath, "r", encoding="utf-8") as f:
+                    out[name] = json.load(f)
+                continue
+            except Exception:
+                pass  # corrupt cache entry: recompute
+        if not backend_ready:
+            # first cache miss: the probes need the 8-virtual-device
+            # backend regardless of how the caller reached here
+            from bigdl_tpu.analysis.hlo_lint import ensure_backend
+            ensure_backend()
+            backend_ready = True
+        try:
+            metrics = _extract_metrics(spec, spec.build())
+        except Exception as e:  # surfaced as a finding, never a crash
+            out[name] = {"probe": name, "error": f"{type(e).__name__}: {e}"}
+            continue
+        out[name] = metrics
+        try:
+            os.makedirs(cdir, mode=0o700, exist_ok=True)
+            tmp = cpath + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(metrics, f, indent=2, sort_keys=True)
+            os.replace(tmp, cpath)
+        except OSError:
+            pass  # cache is an optimization, not a requirement
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the checks
+# ---------------------------------------------------------------------------
+
+def _finding(rule: str, severity: str, probe: str, message: str,
+             code: str = "") -> Finding:
+    """Budget findings anchor on the budget file; identity rides
+    (rule, probe, code) so entries survive value edits."""
+    return Finding(rule, severity, "scripts/parallel_budget.json", 0,
+                   message, scope=probe, code=code or rule)
+
+
+def _check_bytes(spec: ProbeSpec, metrics: Dict, entry: Optional[Dict],
+                 out: List[Finding]) -> None:
+    if entry is None:
+        out.append(_finding(
+            "hlo-budget-bytes", "error", spec.name,
+            f"no budget entry for probe {spec.name} — every supported "
+            f"composition must carry a justified budget (run "
+            f"--update-budget, then justify the new entry)"))
+        return
+    measured = metrics.get("collective_bytes") or {}
+    budgeted = entry.get("collective_bytes") or {}
+    tol = float(entry.get("tolerance", _BYTE_TOLERANCE))
+    floor = float(entry.get("byte_floor", _BYTE_FLOOR))
+    for key in sorted(set(measured) | set(budgeted)):
+        m = float(measured.get(key, 0.0))
+        b = float(budgeted.get(key, 0.0))
+        if max(m, b) <= floor:
+            continue
+        drift = abs(m - b) / max(b, 1.0)
+        if drift > tol:
+            direction = "grew" if m > b else "shrank"
+            out.append(_finding(
+                "hlo-budget-bytes", "error", spec.name,
+                f"{spec.name}: {{{key}}} {direction} to {m:.0f} B vs budget "
+                f"{b:.0f} B ({drift:+.1%} vs tolerance {tol:.0%}) — "
+                f"the {spec.composition} composition's communication "
+                f"contract moved; re-measure, THEN re-justify the "
+                f"entry if the change is intended", code=key))
+    out.append(_finding(
+        "hlo-budget-bytes", "info", spec.name,
+        f"{spec.name}: matrix {json.dumps(measured, sort_keys=True)} "
+        f"(budget tolerance {tol:.0%})", code="matrix"))
+
+
+def _check_reshard(spec: ProbeSpec, metrics: Dict, entry: Optional[Dict],
+                   out: List[Finding]) -> None:
+    measured = metrics.get("collective_bytes") or {}
+    floor = float((entry or {}).get("reshard_floor_bytes",
+                                    _RESHARD_FLOOR))
+    for key in sorted(measured):
+        nbytes = float(measured[key])
+        if nbytes <= floor:
+            continue  # scalar losses / counters span every axis
+        op, _, axis = key.partition("|")
+        allowed = spec.expected.get(axis)
+        if allowed is None or op not in allowed:
+            out.append(_finding(
+                "hlo-reshard", "error", spec.name,
+                f"{spec.name}: {nbytes:.0f} B of {op} over axis '{axis}' that the "
+                f"{spec.composition} composition's declared plan does "
+                f"not predict (expected on '{axis}': "
+                f"{sorted(allowed) if allowed else 'nothing'}) — a "
+                f"GSPMD-inserted reshard (mis-specified partition "
+                f"spec: the classic silent full-parameter all-gather)",
+                code=key))
+    # the analytic tie-in: measured gradient sync vs the plan's floor
+    plan = metrics.get("plan_bytes")
+    if spec.plan_check and plan:
+        from bigdl_tpu.parallel.mesh import BATCH_AXES
+        slack = float((entry or {}).get("plan_slack", _PLAN_SLACK))
+        sync = sum(float(v) for k, v in measured.items()
+                   if k.partition("|")[0] in _SYNC_OPS
+                   and k.partition("|")[2] in BATCH_AXES)
+        # a flat all-reduce on a multi-axis batch mesh charges every
+        # axis it spans; compare against the plan scaled the same way
+        n_axes = max(1, sum(1 for a in BATCH_AXES
+                            if metrics["mesh_axes"].get(a, 1) > 1))
+        if sync > slack * plan * n_axes + floor:
+            out.append(_finding(
+                "hlo-reshard", "error", spec.name,
+                f"{spec.name}: gradient-sync bytes {sync:.0f} exceed "
+                f"{slack:.1f}x the analytic plan "
+                f"({plan:.0f} B/axis x {n_axes} axes, "
+                f"grad_allreduce_bytes) — the step syncs more than the "
+                f"parameters it owns", code="plan"))
+
+
+def _check_flops(spec: ProbeSpec, metrics: Dict, entry: Optional[Dict],
+                 matrix: Dict[str, Dict], out: List[Finding]) -> None:
+    if spec.flops_baseline is None:
+        return
+    base = matrix.get(spec.flops_baseline, {})
+    flops, base_flops = metrics.get("flops"), base.get("flops")
+    if not flops or not base_flops:
+        out.append(_finding(
+            "hlo-flops-parity", "warning", spec.name,
+            f"{spec.name}: flops unavailable (probe {flops!r}, baseline "
+            f"{spec.flops_baseline} {base_flops!r}) — parity not "
+            f"provable"))
+        return
+    ratio = flops / base_flops
+    bound = float((entry or {}).get("flops_parity_bound", _PARITY_BOUND))
+    if ratio > bound:
+        out.append(_finding(
+            "hlo-flops-parity", "error", spec.name,
+            f"{spec.name}: per-device FLOPs are {ratio:.2f}x the "
+            f"{spec.flops_baseline} baseline (entry bound "
+            f"{bound:.2f}x) — compute is being replicated instead of "
+            f"sharded (a partition spec matched nothing, or an axis "
+            f"stopped dividing)", code="parity"))
+    else:
+        out.append(_finding(
+            "hlo-flops-parity", "info", spec.name,
+            f"{spec.name}: per-device FLOPs {ratio:.2f}x vs "
+            f"{spec.flops_baseline} "
+            f"(bound {bound:.2f}x)", code="parity"))
+
+
+def _check_memory(spec: ProbeSpec, metrics: Dict, entry: Optional[Dict],
+                  out: List[Finding]) -> None:
+    if entry is None:
+        return  # hlo-budget-bytes already demands the entry
+    arg, temp = metrics.get("argument_bytes"), metrics.get("temp_bytes")
+    if arg is None or temp is None:
+        out.append(_finding(
+            "hlo-budget-memory", "warning", spec.name,
+            f"{spec.name}: memory analysis unavailable on this backend — the HBM "
+            "watermark cannot be checked"))
+        return
+    watermark = arg + temp
+    b_arg = entry.get("argument_bytes")
+    b_temp = entry.get("temp_bytes")
+    tol = float(entry.get("memory_tolerance", _MEMORY_TOLERANCE))
+    if b_arg is not None and b_temp is not None:
+        budget_mark = float(b_arg) + float(b_temp)
+        drift = abs(watermark - budget_mark) / max(budget_mark, 1.0)
+        if drift > tol:
+            out.append(_finding(
+                "hlo-budget-memory", "error", spec.name,
+                f"{spec.name}: param+temp HBM watermark {watermark} B vs budget "
+                f"{budget_mark:.0f} B ({drift:+.1%} vs tolerance "
+                f"{tol:.0%}) — the composition's memory footprint "
+                f"moved", code="watermark"))
+    don, b_don = metrics.get("donated_bytes"), entry.get("donated_bytes")
+    if b_don is not None and float(don or 0.0) < float(b_don) * (1 - tol):
+        out.append(_finding(
+            "hlo-budget-memory", "error", spec.name,
+            f"{spec.name}: donation coverage shrank to {don:.0f} B vs budget "
+            f"{float(b_don):.0f} B — donated buffers no longer elide "
+            f"the full-size copy", code="donation"))
+
+
+def run_budget_passes(select=None, budget_path: Optional[str] = None,
+                      no_cache: bool = False,
+                      specs: Optional[Dict[str, ProbeSpec]] = None,
+                      budget: Optional[List[Dict]] = None,
+                      matrix: Optional[Dict[str, Dict]] = None) \
+        -> List[Finding]:
+    """Compile/cache-load the probe matrix and run every budget check
+    (or the subset ``select`` names by rule id).  ``budget`` and
+    ``matrix`` override the file/compiles for tests."""
+    specs = specs or PROBES()
+    if budget is None:
+        budget = load_budget(budget_path)
+    entries = {e["probe"]: e for e in budget}
+
+    def on(rule):
+        return select is None or rule in select
+
+    # the four probe-level rules need compiled programs; the file-level
+    # rules (justification/staleness) are pure JSON checks — a
+    # `--select budget-stale` run must not pay the matrix lowering
+    probe_rules = ("hlo-budget-bytes", "hlo-reshard",
+                   "hlo-flops-parity", "hlo-budget-memory")
+    need_matrix = any(on(r) for r in probe_rules)
+    if matrix is None:
+        matrix = (probe_matrix(specs, no_cache=no_cache)
+                  if need_matrix else {})
+
+    # probe failures must surface under a rule the caller SELECTED, or
+    # a `--select hlo-reshard` negative leg whose probe failed to build
+    # would pass vacuously while the report claims the rule ran
+    fail_rule = ("hlo-budget-bytes" if on("hlo-budget-bytes")
+                 else next((r for r in probe_rules if on(r)),
+                           "hlo-budget-bytes"))
+
+    findings: List[Finding] = []
+    for name in (sorted(specs) if need_matrix else ()):
+        spec, metrics = specs[name], matrix.get(name, {})
+        if metrics.get("error"):
+            findings.append(_finding(
+                fail_rule, "error", name,
+                f"{name}: probe failed to lower: {metrics['error']}"))
+            continue
+        if metrics.get("collective_bytes") is None:
+            findings.append(_finding(
+                fail_rule, "error", name,
+                f"{name}: compiled module text unavailable — the byte matrix "
+                "cannot be measured"))
+            continue
+        entry = entries.get(name)
+        if spec.negative:
+            # failure-mode seam: only the reshard detector applies (a
+            # deliberately broken probe has no budget to conform to)
+            if on("hlo-reshard"):
+                _check_reshard(spec, metrics, entry, findings)
+            continue
+        if on("hlo-budget-bytes"):
+            _check_bytes(spec, metrics, entry, findings)
+        if on("hlo-reshard"):
+            _check_reshard(spec, metrics, entry, findings)
+        if on("hlo-flops-parity"):
+            _check_flops(spec, metrics, entry, matrix, findings)
+        if on("hlo-budget-memory"):
+            _check_memory(spec, metrics, entry, findings)
+
+    base_rel = "scripts/parallel_budget.json"
+    for name in sorted(entries):
+        e = entries[name]
+        if name in specs and not specs[name].negative:
+            if not str(e.get("justification", "")).strip() \
+                    and on("budget-justification"):
+                findings.append(Finding(
+                    "budget-justification", "error", base_rel, 0,
+                    f"budget entry {name} has no justification — every "
+                    f"pinned number must say why it is what it is",
+                    scope=name, code="justification"))
+        elif on("budget-stale"):
+            findings.append(Finding(
+                "budget-stale", "warning", base_rel, 0,
+                f"budget entry {name} matches no probe in the catalog "
+                f"— the composition was removed or renamed; delete the "
+                f"entry", scope=name, code="stale"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# --update-budget
+# ---------------------------------------------------------------------------
+
+_ENTRY_FIELDS = ("collective_bytes", "flops", "argument_bytes",
+                 "temp_bytes", "donated_bytes")
+
+
+def update_budget(budget_path: Optional[str] = None,
+                  no_cache: bool = False,
+                  specs: Optional[Dict[str, ProbeSpec]] = None,
+                  matrix: Optional[Dict[str, Dict]] = None) \
+        -> Tuple[str, int, int]:
+    """Measure the matrix and merge it into the budget file: new
+    probes append with EMPTY justifications (the gate stays red until
+    each is hand-reviewed); drifted entries get their measured fields
+    refreshed and their justification CLEARED — a number that moved
+    needs its reviewed reason re-earned.  Pass ``matrix`` to reuse an
+    already-measured matrix (the CLI shares one between the update and
+    the verdict run).  Returns (path, n_added, n_refreshed)."""
+    specs = specs or PROBES()
+    entries = list(load_budget(budget_path))
+    by_name = {e["probe"]: e for e in entries}
+    if matrix is None:
+        matrix = probe_matrix(specs, no_cache=no_cache)
+    added = refreshed = 0
+    for name in sorted(specs):
+        spec, metrics = specs[name], matrix.get(name, {})
+        if spec.negative or metrics.get("error") \
+                or metrics.get("collective_bytes") is None:
+            continue
+        fresh = {f: metrics.get(f) for f in _ENTRY_FIELDS}
+        e = by_name.get(name)
+        if e is None:
+            entry = dict(probe=name, tolerance=_BYTE_TOLERANCE,
+                         justification="", **fresh)
+            if spec.flops_baseline is not None:
+                entry["flops_parity_bound"] = _PARITY_BOUND
+            entries.append(entry)
+            by_name[name] = entry
+            added += 1
+            continue
+        probe_findings = run_budget_passes(
+            select={"hlo-budget-bytes", "hlo-budget-memory",
+                    "hlo-flops-parity"},
+            specs={name: spec, **({spec.flops_baseline:
+                                   specs[spec.flops_baseline]}
+                                  if spec.flops_baseline in specs
+                                  else {})},
+            budget=entries, matrix=matrix)
+        drifted = any(f.severity == "error" and f.scope == name
+                      for f in probe_findings)
+        if drifted:
+            e.update(fresh)
+            e["justification"] = ""
+            refreshed += 1
+    path = write_budget(entries, budget_path)
+    return path, added, refreshed

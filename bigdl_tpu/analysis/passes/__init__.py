@@ -7,5 +7,6 @@ from bigdl_tpu.analysis.passes import (  # noqa: F401
     collective_discipline,
     lock_discipline,
     metrics_catalog,
+    thread_lifecycle,
     trace_safety,
 )

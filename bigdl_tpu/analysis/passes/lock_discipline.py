@@ -199,6 +199,88 @@ class _ClassWalk:
             self._flag(child, meth, under_lock)
 
 
+# ---------------------------------------------------------------------------
+# lock-order: acquisition-order cycle detection
+# ---------------------------------------------------------------------------
+# Two locks acquired nested in BOTH orders anywhere in the threaded
+# tiers is the static deadlock smell: thread A holds X wanting Y while
+# thread B holds Y wanting X.  PR-10's SIGTERM fix dodged exactly this
+# by moving a queue operation out of signal context by hand; this pass
+# makes the next instance a lint error instead of a review catch.
+#
+# Lock identity is (file, class, attribute) for `with self.X:` —
+# the only acquisition spelling the suite recognizes (same deliberate
+# narrowness as the guarded-set inference above).  Edges come from
+# syntactic nesting: `with self.X:` containing `with self.Y:` adds
+# X -> Y.  Cross-function nesting through calls is out of static
+# reach; the pass documents that limit rather than guessing.
+
+ORDER_RULE = "lock-order"
+
+
+def _order_edges(cls_qual: str, cls: ast.ClassDef, locks: Set[str],
+                 src, edges: Dict) -> None:
+    """Collect (outer_lock -> inner_lock) edges from nested
+    with-blocks, remembering one witness site per edge."""
+
+    def walk(node, held):
+        if isinstance(node, ast.With):
+            acquired = [_self_attr(i.context_expr) for i in node.items
+                        if _self_attr(i.context_expr) in locks]
+            now = list(held)
+            for a in acquired:
+                key_a = f"{cls_qual}.{a}"
+                for h in now:
+                    if h != key_a:
+                        edges.setdefault((h, key_a),
+                                         (src, node.lineno))
+                now = now + [key_a]
+            for child in ast.iter_child_nodes(node):
+                walk(child, now)
+            return
+        for child in ast.iter_child_nodes(node):
+            walk(child, held)
+
+    for meth in cls.body:
+        if isinstance(meth, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            walk(meth, [])
+
+
+@register_pass(ORDER_RULE,
+               doc="a pair of locks acquired nested in both orders "
+                   "across the threaded tiers (static deadlock smell)")
+def run_order(tree: SourceTree) -> List[Finding]:
+    findings: List[Finding] = []
+    edges: Dict = {}  # (outer, inner) -> (src, witness_line)
+    for src in tree:
+        if src.tree is None or not src.rel.startswith(_SCOPES):
+            continue
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.ClassDef):
+                locks = _lock_attrs(node)
+                if locks:
+                    # file-qualified identity: two same-named classes
+                    # in different modules own different locks and
+                    # must not be conflated into a phantom cycle
+                    _order_edges(f"{src.rel}:{node.name}", node, locks,
+                                 src, edges)
+    reported = set()
+    for (a, b), (src, line) in sorted(edges.items(),
+                                      key=lambda kv: kv[0]):
+        if (b, a) in edges and frozenset((a, b)) not in reported:
+            reported.add(frozenset((a, b)))
+            o_src, o_line = edges[(b, a)]
+            findings.append(tree.finding(
+                ORDER_RULE, "error", src, line,
+                f"locks {a} and {b} are acquired nested in BOTH "
+                f"orders ({a}->{b} here, {b}->{a} at "
+                f"{o_src.rel}:{o_line}) — a cross-thread deadlock "
+                f"waiting for its schedule; pick one global order or "
+                f"pragma with the reason both sites can never "
+                f"contend", scope=a))
+    return findings
+
+
 @register_pass(RULE, doc="reads/writes of lock-guarded attributes "
                          "outside the lock in thread-shared classes")
 def run(tree: SourceTree) -> List[Finding]:

@@ -1,0 +1,179 @@
+"""thread-lifecycle: every thread is daemon or joined on shutdown.
+
+The framework starts worker threads in ten-plus places (the loss-drain
+worker, serving schedulers, telemetry exporters, prefetchers, the
+debugz sidecar).  A non-daemon thread nobody joins keeps the process
+alive after ``main`` returns — the classic "training finished but the
+job hangs until the scheduler SIGKILLs it" failure, which PR-2's
+SIGTERM drain and PR-4's exporter-stop contract each fixed by hand
+once.  This pass mechanizes the rule: a ``threading.Thread(...)``
+construction must be
+
+* daemon — ``daemon=True`` in the constructor, or ``<obj>.daemon =
+  True`` before ``start()`` in the same scope; or
+* reachable from a join/stop on the shutdown path — ``self.X.join()``
+  anywhere in the owning class for a ``self.X = Thread(...)``
+  attribute, or ``x.join()`` in the same function for a local.
+
+Threads that are *both* daemon and joined (the exporter pattern:
+daemon so a crash never wedges, joined so a clean stop flushes) are
+the gold standard and trivially pass.  Intentional exceptions carry a
+pragma with the reason, as everywhere in graftlint.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from bigdl_tpu.analysis.astutil import (SourceTree, call_attr_chain,
+                                        imports_of)
+from bigdl_tpu.analysis.findings import Finding
+from bigdl_tpu.analysis.registry import register_pass
+
+RULE = "thread-lifecycle"
+
+
+def _is_thread_ctor(node: ast.AST, aliases: tuple) -> bool:
+    mod_names, thread_names = aliases
+    if not isinstance(node, ast.Call):
+        return False
+    chain = call_attr_chain(node)
+    if len(chain) >= 2 and chain[-1] == "Thread" \
+            and chain[-2] in mod_names:
+        return True
+    return len(chain) == 1 and chain[0] in thread_names
+
+
+def _thread_aliases(mod_ast: ast.AST) -> tuple:
+    """(module names that mean ``threading`` — incl. ``import
+    threading as t`` aliases, local names that mean
+    ``threading.Thread`` via from-imports)."""
+    mods, from_imports = imports_of(mod_ast)
+    mod_names = {alias for alias, mod in mods.items()
+                 if mod == "threading"} | {"threading"}
+    thread_names = {alias for alias, (mod, name) in from_imports.items()
+                    if mod == "threading" and name == "Thread"}
+    return mod_names, thread_names
+
+
+def _ctor_daemon_true(call: ast.Call) -> bool:
+    for kw in call.keywords:
+        if kw.arg == "daemon" and isinstance(kw.value, ast.Constant) \
+                and kw.value.value is True:
+            return True
+    return False
+
+
+def _self_attr(node: ast.AST) -> str:
+    if isinstance(node, ast.Attribute) and isinstance(node.value,
+                                                      ast.Name) \
+            and node.value.id == "self":
+        return node.attr
+    return ""
+
+
+def _joined_or_daemoned(scope: ast.AST, name: str,
+                        is_self_attr: bool) -> bool:
+    """True when ``<name>.join(...)`` is called or ``<name>.daemon =
+    True`` is assigned anywhere inside ``scope`` (the owning class for
+    a self attribute, the enclosing function for a local)."""
+    for node in ast.walk(scope):
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "join":
+            recv = node.func.value
+            if is_self_attr and _self_attr(recv) == name:
+                return True
+            if not is_self_attr and isinstance(recv, ast.Name) \
+                    and recv.id == name:
+                return True
+        elif isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Attribute) and t.attr == "daemon" \
+                        and isinstance(node.value, ast.Constant) \
+                        and node.value.value is True:
+                    recv = t.value
+                    if is_self_attr and _self_attr(recv) == name:
+                        return True
+                    if not is_self_attr and isinstance(recv, ast.Name) \
+                            and recv.id == name:
+                        return True
+    return False
+
+
+def _enclosing(stack: List[ast.AST], kinds) -> Optional[ast.AST]:
+    for node in reversed(stack):
+        if isinstance(node, kinds):
+            return node
+    return None
+
+
+def _scope_name(stack: List[ast.AST]) -> str:
+    parts = [n.name for n in stack
+             if isinstance(n, (ast.ClassDef, ast.FunctionDef,
+                               ast.AsyncFunctionDef))]
+    return ".".join(parts)
+
+
+@register_pass(RULE, doc="threading.Thread constructions that are "
+                         "neither daemon nor reachable from a "
+                         "join/stop on the shutdown path")
+def run(tree: SourceTree) -> List[Finding]:
+    findings: List[Finding] = []
+    for src in tree:
+        if src.tree is None:
+            continue
+        aliases = _thread_aliases(src.tree)
+        # walk with an ancestor stack so each ctor knows its
+        # assignment target, enclosing function, and enclosing class
+        stack: List[ast.AST] = []
+
+        def visit(node):
+            stack.append(node)
+            ctor = None
+            target_attr = target_local = ""
+            if isinstance(node, (ast.Assign, ast.AnnAssign,
+                                 ast.NamedExpr)) \
+                    and node.value is not None \
+                    and _is_thread_ctor(node.value, aliases):
+                ctor = node.value
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    if _self_attr(t):
+                        target_attr = _self_attr(t)
+                    elif isinstance(t, ast.Name):
+                        target_local = t.id
+            elif isinstance(node, ast.Call) \
+                    and _is_thread_ctor(node, aliases) \
+                    and not isinstance(
+                        stack[-2] if len(stack) > 1 else None,
+                        (ast.Assign, ast.AnnAssign, ast.NamedExpr)):
+                ctor = node  # unassigned: Thread(...).start()
+            if ctor is not None and not _ctor_daemon_true(ctor):
+                ok = False
+                func = _enclosing(stack[:-1], (ast.FunctionDef,
+                                               ast.AsyncFunctionDef))
+                cls = _enclosing(stack[:-1], ast.ClassDef)
+                if target_attr and cls is not None:
+                    ok = _joined_or_daemoned(cls, target_attr, True)
+                elif target_local and func is not None:
+                    ok = _joined_or_daemoned(func, target_local, False)
+                if not ok:
+                    what = (f"self.{target_attr}" if target_attr
+                            else target_local or "an unnamed thread")
+                    findings.append(tree.finding(
+                        RULE, "error", src, ctor.lineno,
+                        f"{what} is a non-daemon thread with no "
+                        f"reachable join: it will outlive shutdown "
+                        f"and wedge process exit — pass daemon=True, "
+                        f"or join it on the stop path (or pragma with "
+                        f"the reason it is owned elsewhere)",
+                        scope=_scope_name(stack)))
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+            stack.pop()
+
+        visit(src.tree)
+    return findings

@@ -34,7 +34,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = ["make_mesh", "data_parallel_mesh", "MeshConfig", "P",
            "NamedSharding", "Mesh", "local_device_count",
-           "batch_sharding", "shard_map_compat"]
+           "batch_sharding", "shard_map_compat", "axis_coord_maps"]
 
 
 def shard_map_compat(f, mesh, in_specs, out_specs):
@@ -164,6 +164,30 @@ def make_mesh(axes: Optional[Dict[str, int]] = None,
         except Exception:
             mesh_devices = np.array(devices).reshape(sizes)
     return Mesh(mesh_devices, tuple(names))
+
+
+def axis_coord_maps(mesh: Mesh) -> Dict[str, Dict[int, int]]:
+    """``{axis: {logical_device_position: coordinate_along_axis}}`` for
+    every mesh axis of size > 1 — the per-axis classifier inputs for
+    :func:`bigdl_tpu.utils.xla_cost.per_axis_hlo_bytes`.
+
+    HLO replica groups name devices by their position in the mesh's
+    flattened device order (the same convention as
+    ``parallel.hierarchy.dcn_slice_map``, which is this map's ``dcn``
+    row).  Under the per-axis map a collective "crosses groups" exactly
+    when one of its replica groups holds two devices with different
+    coordinates along that axis — i.e. when its payload moves over that
+    axis's links — so one compiled program classifies into a full
+    {op, axis} byte matrix."""
+    n = int(np.prod(mesh.devices.shape))
+    out: Dict[str, Dict[int, int]] = {}
+    for axis in mesh.axis_names:
+        if mesh.shape[axis] <= 1:
+            continue
+        ai = mesh.axis_names.index(axis)
+        coords = np.indices(mesh.devices.shape)[ai].reshape(-1)
+        out[axis] = {i: int(coords[i]) for i in range(n)}
+    return out
 
 
 def data_parallel_mesh(devices=None) -> Mesh:

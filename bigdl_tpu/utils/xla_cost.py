@@ -15,7 +15,8 @@ from typing import Dict, List, Mapping, Optional, Tuple
 
 __all__ = ["compiled_flops", "compiled_bytes", "cost_breakdown",
            "collective_hlo_bytes", "cross_group_hlo_bytes",
-           "cross_group_hlo_lines", "shape_tokens_nbytes"]
+           "cross_group_hlo_lines", "shape_tokens_nbytes",
+           "per_axis_hlo_bytes"]
 
 
 def _cost_dict(compiled) -> dict:
@@ -286,6 +287,42 @@ def cross_group_hlo_bytes(compiled_or_text,
         nbytes = _shapes_nbytes(shapes)
         out[op] = out.get(op, 0.0) + nbytes
         out["total"] += nbytes
+    return out
+
+
+def per_axis_hlo_bytes(compiled_or_text,
+                       axis_maps: Mapping[str, Mapping[int, int]]) \
+        -> Optional[Dict[str, float]]:
+    """The {op, axis} collective-byte MATRIX of a compiled module:
+    ``{"<op>|<axis>": bytes, ...}`` where a collective charges its
+    per-device output payload to every mesh axis its replica groups
+    span.
+
+    ``axis_maps`` comes from ``parallel.mesh.axis_coord_maps(mesh)``:
+    one ``{device_position: coordinate}`` map per axis, so "spans axis
+    a" is exactly :func:`cross_group_hlo_lines`'s crossing test under
+    axis a's coordinate map.  A collective whose groups span several
+    axes (e.g. a flat all-reduce on a dcn×data mesh) appears under each
+    — the matrix answers "what moves over THIS axis's links", not "how
+    many bytes total" (that is :func:`collective_hlo_bytes`).  Returns
+    None when the module text is unavailable."""
+    if not isinstance(compiled_or_text, str):
+        try:
+            compiled_or_text = compiled_or_text.as_text()
+        except Exception:
+            return None
+        if not compiled_or_text:
+            return None
+    out: Dict[str, float] = {}
+    for axis in sorted(axis_maps):
+        lines = cross_group_hlo_lines(compiled_or_text, axis_maps[axis])
+        if lines is None:
+            return None
+        for op, shapes, crosses in lines:
+            if not crosses:
+                continue
+            key = f"{op}|{axis}"
+            out[key] = out.get(key, 0.0) + _shapes_nbytes(shapes)
     return out
 
 

@@ -24,13 +24,20 @@ fi
 # directions): non-fatal here (ride-along visibility); the standalone
 # `python scripts/metrics_lint.py` form is fatal
 python "$(dirname "$0")/metrics_lint.py" --warn-only || true
-# graftlint static-analysis suite (trace safety, lock discipline,
-# collective accounting, clock discipline): AST passes only here —
-# warn-only ride-along writing the ANALYSIS_r<N>.json debt artifact;
-# run `scripts/lint.sh` standalone for the fatal form incl. the
-# compiled-HLO invariant passes
+# graftlint static-analysis suite (trace safety, lock discipline +
+# lock order, thread lifecycle, collective accounting, clock
+# discipline): AST passes only here — warn-only ride-along writing the
+# ANALYSIS_r<N>.json debt artifact; run `scripts/lint.sh` standalone
+# for the fatal form incl. the compiled-HLO invariant passes
 bash "$(dirname "$0")/lint.sh" --warn-only --ast-only \
   | tail -n 2 || true
+# parallelism-conformance budget matrix (composition x collective-byte
+# gate vs scripts/parallel_budget.json): warn-only ride-along — the
+# probe compiles are cached under /tmp keyed by source hash, so an
+# unchanged tree pays one file-hash pass, not the full re-lower; run
+# `scripts/lint.sh --budget` standalone for the fatal form
+env JAX_PLATFORMS=cpu python -m bigdl_tpu.analysis \
+  --warn-only --budget-only | tail -n 1 || true
 # health-watchdog smoke (chaos mini-train, /statusz, flight recorder):
 # warn-only ride-along; run scripts/health_smoke.sh standalone for the
 # fatal form.  mktemp, not a fixed /tmp name: parallel runs must not

@@ -1,0 +1,363 @@
+"""Parallelism-conformance budgets (bigdl_tpu/analysis/hlo_budget).
+
+Unit legs run the checks over synthetic matrices (no compiles); the
+real-compile legs pin the committed ``scripts/parallel_budget.json``
+against freshly lowered probes — including the PR-8 dcn envelope as
+budget data — and the negative legs prove each gate actually fires:
+a doubled budget entry trips ``hlo-budget-bytes``, a deliberately
+mis-specified sharding rule trips ``hlo-reshard``."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from bigdl_tpu.analysis.findings import render_human
+from bigdl_tpu.analysis.hlo_budget import (
+    BUDGET_RULES, PROBES, ProbeSpec, load_budget, probe_matrix,
+    run_budget_passes, tree_fingerprint, update_budget, write_budget,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _errors(findings, rule=None):
+    return [f for f in findings if f.severity == "error"
+            and (rule is None or f.rule == rule)]
+
+
+def _spec(name="cnn/dp", expected=None, **kw):
+    return ProbeSpec(name, *name.split("/", 1),
+                     build=lambda: (_ for _ in ()).throw(
+                         AssertionError("unit specs never build")),
+                     expected=expected or {"data": ("all-reduce",)},
+                     **kw)
+
+
+def _metrics(name="cnn/dp", bytes_=None, **kw):
+    out = {"probe": name, "model": name.split("/")[0],
+           "composition": name.split("/")[1],
+           "mesh_axes": {"data": 8},
+           "collective_bytes": bytes_ or {"all-reduce|data": 36528.0},
+           "collective_total": 36528.0, "flops": 266881.0,
+           "plan_bytes": None, "param_bytes": 36520,
+           "donated_bytes": 36524.0, "donated_params": 10,
+           "argument_bytes": 38068, "temp_bytes": 78184,
+           "output_bytes": 38000}
+    out.update(kw)
+    return out
+
+
+def _entry(name="cnn/dp", **kw):
+    e = {"probe": name, "tolerance": 0.05,
+         "collective_bytes": {"all-reduce|data": 36528.0},
+         "flops": 266881.0, "argument_bytes": 38068,
+         "temp_bytes": 78184, "donated_bytes": 36524.0,
+         "justification": "unit fixture"}
+    e.update(kw)
+    return e
+
+
+# ---------------------------------------------------------------------------
+# unit legs: the checks over synthetic matrices
+# ---------------------------------------------------------------------------
+
+def test_budget_green_when_matrix_matches():
+    fs = run_budget_passes(specs={"cnn/dp": _spec()},
+                           budget=[_entry()],
+                           matrix={"cnn/dp": _metrics()})
+    assert _errors(fs) == [], render_human(fs)
+
+
+def test_doubled_budget_entry_trips_bytes_gate():
+    """THE staleness negative leg: a budget entry whose bytes doubled
+    (or halved) vs the measured program is a red gate naming the
+    offending {op,axis}."""
+    doubled = _entry(collective_bytes={"all-reduce|data": 73056.0})
+    fs = run_budget_passes(specs={"cnn/dp": _spec()}, budget=[doubled],
+                           matrix={"cnn/dp": _metrics()})
+    errs = _errors(fs, "hlo-budget-bytes")
+    assert len(errs) == 1
+    assert "all-reduce|data" in errs[0].message
+    assert errs[0].code == "all-reduce|data"
+
+
+def test_unbudgeted_collective_is_drift():
+    m = _metrics(bytes_={"all-reduce|data": 36528.0,
+                         "all-gather|data": 50000.0})
+    fs = run_budget_passes(specs={"cnn/dp": _spec()}, budget=[_entry()],
+                           matrix={"cnn/dp": m})
+    assert any("all-gather|data" in f.message
+               for f in _errors(fs, "hlo-budget-bytes"))
+    # ... and the same unexpected op is a reshard finding too
+    assert any("all-gather" in f.message
+               for f in _errors(fs, "hlo-reshard"))
+
+
+def test_scalar_buckets_never_gate():
+    m = _metrics(bytes_={"all-reduce|data": 36528.0,
+                         "all-reduce|dcn": 4.0})
+    fs = run_budget_passes(specs={"cnn/dp": _spec()}, budget=[_entry()],
+                           matrix={"cnn/dp": m})
+    assert _errors(fs) == [], render_human(fs)
+
+
+def test_missing_entry_and_empty_justification_and_stale():
+    specs = {"cnn/dp": _spec()}
+    fs = run_budget_passes(specs=specs, budget=[],
+                           matrix={"cnn/dp": _metrics()})
+    assert any("no budget entry" in f.message
+               for f in _errors(fs, "hlo-budget-bytes"))
+
+    fs = run_budget_passes(specs=specs,
+                           budget=[_entry(justification="  ")],
+                           matrix={"cnn/dp": _metrics()})
+    assert len(_errors(fs, "budget-justification")) == 1
+
+    fs = run_budget_passes(specs=specs,
+                           budget=[_entry(), _entry("gone/probe")],
+                           matrix={"cnn/dp": _metrics()})
+    stale = [f for f in fs if f.rule == "budget-stale"]
+    assert len(stale) == 1 and stale[0].severity == "warning"
+
+
+def test_flops_parity_bound_per_entry():
+    specs = {"cnn/dp": _spec(),
+             "cnn/fsdp": _spec("cnn/fsdp",
+                               expected={"fsdp": ("all-reduce",)},
+                               flops_baseline="cnn/dp")}
+    matrix = {"cnn/dp": _metrics(),
+              "cnn/fsdp": _metrics(
+                  "cnn/fsdp", bytes_={"all-reduce|fsdp": 36528.0},
+                  mesh_axes={"fsdp": 8}, flops=266881.0 * 4)}
+    budget = [_entry(), _entry("cnn/fsdp",
+                               collective_bytes={
+                                   "all-reduce|fsdp": 36528.0},
+                               flops_parity_bound=1.3)]
+    fs = run_budget_passes(specs=specs, budget=budget, matrix=matrix)
+    errs = _errors(fs, "hlo-flops-parity")
+    assert len(errs) == 1 and "4.00x" in errs[0].message
+    # raising the entry's bound (with its justification) clears it
+    budget[1]["flops_parity_bound"] = 4.5
+    fs = run_budget_passes(specs=specs, budget=budget, matrix=matrix)
+    assert _errors(fs, "hlo-flops-parity") == []
+
+
+def test_memory_watermark_and_donation_gates():
+    shrunk = _metrics(temp_bytes=78184 * 3)
+    fs = run_budget_passes(specs={"cnn/dp": _spec()}, budget=[_entry()],
+                           matrix={"cnn/dp": shrunk})
+    errs = _errors(fs, "hlo-budget-memory")
+    assert len(errs) == 1 and "watermark" in errs[0].message
+
+    lost_donation = _metrics(donated_bytes=0.0)
+    fs = run_budget_passes(specs={"cnn/dp": _spec()}, budget=[_entry()],
+                           matrix={"cnn/dp": lost_donation})
+    assert any("donation" in f.message
+               for f in _errors(fs, "hlo-budget-memory"))
+
+
+def test_reshard_plan_tie_in():
+    """Sync bytes wildly over the analytic grad_allreduce_bytes floor
+    trip the reshard rule even when the op/axis pair is expected."""
+    spec = _spec(plan_check=True)
+    m = _metrics(bytes_={"all-reduce|data": 36528.0 * 8},
+                 plan_bytes=36520.0)
+    e = _entry(collective_bytes={"all-reduce|data": 36528.0 * 8})
+    fs = run_budget_passes(specs={"cnn/dp": spec}, budget=[e],
+                           matrix={"cnn/dp": m})
+    errs = _errors(fs, "hlo-reshard")
+    assert len(errs) == 1 and "analytic plan" in errs[0].message
+
+
+def test_probe_build_failure_is_finding_not_crash():
+    fs = run_budget_passes(
+        specs={"cnn/dp": _spec()}, budget=[_entry()],
+        matrix={"cnn/dp": {"probe": "cnn/dp", "error": "Boom: nope"}})
+    errs = _errors(fs, "hlo-budget-bytes")
+    assert len(errs) == 1 and "failed to lower" in errs[0].message
+
+
+def test_budget_file_round_trip_and_malformed(tmp_path):
+    p = str(tmp_path / "b.json")
+    write_budget([_entry()], p)
+    assert load_budget(p)[0]["probe"] == "cnn/dp"
+    (tmp_path / "bad.json").write_text('{"version": 99, "entries": []}')
+    with pytest.raises(ValueError):
+        load_budget(str(tmp_path / "bad.json"))
+    (tmp_path / "bad2.json").write_text(
+        '{"version": 1, "entries": [{"probe": "x"}]}')
+    with pytest.raises(ValueError):
+        load_budget(str(tmp_path / "bad2.json"))
+
+
+def test_update_budget_appends_empty_and_clears_on_drift(tmp_path,
+                                                         monkeypatch):
+    import bigdl_tpu.analysis.hlo_budget as hb
+    p = str(tmp_path / "budget.json")
+    specs = {"cnn/dp": _spec(),
+             "cnn/new": _spec("cnn/new",
+                              expected={"data": ("all-reduce",)})}
+    matrix = {"cnn/dp": _metrics(),
+              "cnn/new": _metrics("cnn/new")}
+    monkeypatch.setattr(hb, "probe_matrix",
+                        lambda *a, **kw: matrix)
+    # seed: cnn/dp justified but with stale (doubled) bytes
+    write_budget([_entry(collective_bytes={"all-reduce|data": 73056.0},
+                         justification="was reviewed once")], p)
+    path, added, refreshed = update_budget(budget_path=p, specs=specs)
+    assert (added, refreshed) == (1, 1)
+    entries = {e["probe"]: e for e in load_budget(p)}
+    # the new probe landed with an EMPTY justification (gate stays red)
+    assert entries["cnn/new"]["justification"] == ""
+    # the drifted entry was refreshed AND its justification cleared
+    assert entries["cnn/dp"]["collective_bytes"]["all-reduce|data"] \
+        == 36528.0
+    assert entries["cnn/dp"]["justification"] == ""
+    # idempotent second run: nothing to add, nothing drifts... but the
+    # empty justifications still gate
+    path, added, refreshed = update_budget(budget_path=p, specs=specs)
+    assert (added, refreshed) == (0, 0)
+    fs = run_budget_passes(specs=specs, budget=load_budget(p),
+                           matrix=matrix)
+    assert len(_errors(fs, "budget-justification")) == 2
+
+
+def test_probe_cache_round_trip(tmp_path, monkeypatch):
+    """A cached metrics file short-circuits the compile; --no-cache
+    recomputes; a corrupt cache entry recomputes instead of crashing."""
+    monkeypatch.setenv("BIGDL_TPU_BUDGET_CACHE", str(tmp_path))
+    calls = []
+
+    def build():
+        calls.append(1)
+        raise RuntimeError("would compile here")
+
+    spec = ProbeSpec("unit/p", "unit", "p", build,
+                     expected={"data": ("all-reduce",)})
+    cdir = tmp_path / "fp-unit"
+    cdir.mkdir()
+    (cdir / "unit__p.json").write_text(json.dumps(_metrics("unit/p")))
+    m = probe_matrix({"unit/p": spec}, fingerprint="fp-unit")
+    assert m["unit/p"]["collective_bytes"] == {"all-reduce|data": 36528.0}
+    assert calls == []  # never built
+    m = probe_matrix({"unit/p": spec}, fingerprint="fp-unit",
+                     no_cache=True)
+    assert "error" in m["unit/p"] and calls == [1]
+    (cdir / "unit__p.json").write_text("{corrupt")
+    m = probe_matrix({"unit/p": spec}, fingerprint="fp-unit")
+    assert "error" in m["unit/p"] and calls == [1, 1]
+
+
+def test_tree_fingerprint_tracks_sources():
+    fp1 = tree_fingerprint()
+    assert fp1 == tree_fingerprint()  # stable on an unchanged tree
+    assert len(fp1) == 24
+
+
+# ---------------------------------------------------------------------------
+# real-compile legs
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def mlp_matrix():
+    """Freshly lowered mlp probes (the PR-8 envelope family) — small
+    enough to compile inside tier-1."""
+    specs = PROBES()
+    names = ("mlp/dp", "mlp/dcn_dp", "mlp/dcn_hier_fp32",
+             "mlp/dcn_hier_int8")
+    sub = {n: specs[n] for n in names}
+    return sub, probe_matrix(sub)
+
+
+def test_committed_budget_holds_for_mlp_probes(mlp_matrix):
+    """The committed parallel_budget.json matches freshly lowered
+    programs for the envelope family (full-matrix pin is the @slow
+    leg + the lint.sh gate)."""
+    specs, matrix = mlp_matrix
+    budget = load_budget()
+    fs = run_budget_passes(specs=specs, budget=[
+        e for e in budget if e["probe"] in specs], matrix=matrix)
+    assert _errors(fs) == [], render_human(fs)
+
+
+def test_dcn_envelope_lives_in_budget_not_constants(mlp_matrix):
+    """Acceptance: the PR-8 S=2 envelope (cross-slice 25% fp32 / 13%
+    int8 of the flat fp32 baseline) is BUDGET DATA — recompute the
+    ratios from the committed entries and check the measured programs
+    against them."""
+    specs, matrix = mlp_matrix
+    entries = {e["probe"]: e for e in load_budget()}
+
+    def dcn_bytes(name):
+        return sum(v for k, v in entries[name]["collective_bytes"]
+                   .items() if k.endswith("|dcn"))
+
+    flat_dcn = dcn_bytes("mlp/dcn_dp")
+    assert 0.22 <= dcn_bytes("mlp/dcn_hier_fp32") / flat_dcn <= 0.28, \
+        "25.1% measured at S=2"
+    assert 0.10 <= dcn_bytes("mlp/dcn_hier_int8") / flat_dcn <= 0.15, \
+        "13.1% measured at S=2"
+    # and the measured programs agree with the budget they are held to
+    for name in ("mlp/dcn_dp", "mlp/dcn_hier_fp32",
+                 "mlp/dcn_hier_int8"):
+        measured = matrix[name]["collective_bytes"]
+        for key, val in entries[name]["collective_bytes"].items():
+            assert measured.get(key, 0.0) == pytest.approx(val), (
+                name, key)
+
+
+def test_misspec_rule_trips_reshard(monkeypatch):
+    """Acceptance negative leg: a deliberately mis-specified sharding
+    rule (params sharded over the batch axis, composition declaring
+    pure dp) makes GSPMD insert a full-parameter all-gather — and
+    hlo-reshard names it."""
+    monkeypatch.setenv("BIGDL_TPU_BUDGET_MISSPEC", "1")
+    specs = PROBES()
+    assert "cnn/misspec_dp" in specs
+    spec = specs["cnn/misspec_dp"]
+    matrix = probe_matrix({"cnn/misspec_dp": spec})
+    fs = run_budget_passes(specs={"cnn/misspec_dp": spec}, budget=[],
+                           matrix=matrix)
+    errs = _errors(fs, "hlo-reshard")
+    assert errs, render_human(fs)
+    assert any("all-gather" in f.message and "'data'" in f.message
+               for f in errs)
+    # negative probes are exempt from the budget-entry requirement
+    assert _errors(fs, "hlo-budget-bytes") == []
+
+
+@pytest.mark.slow
+def test_full_matrix_zero_error_acceptance():
+    """THE acceptance pin: the complete probe catalog vs the committed
+    budget, zero errors, every entry justified (what `scripts/lint.sh
+    --budget` gates on)."""
+    fs = run_budget_passes()
+    assert _errors(fs) == [], render_human(fs)
+    assert all(str(e.get("justification", "")).strip()
+               for e in load_budget())
+
+
+def test_cli_lists_budget_rules():
+    out = subprocess.run(
+        [sys.executable, "-m", "bigdl_tpu.analysis", "--list"],
+        capture_output=True, text=True, cwd=REPO,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert out.returncode == 0, out.stdout + out.stderr
+    for rule in BUDGET_RULES:
+        assert rule in out.stdout
+
+
+def test_budget_covers_required_span():
+    """>= 8 strategy compositions over >= 2 models, every entry
+    justified — the coverage floor the ISSUE acceptance names."""
+    entries = load_budget()
+    comps = {e["probe"].split("/", 1)[1] for e in entries}
+    models = {e["probe"].split("/", 1)[0] for e in entries}
+    assert len(comps) >= 8, sorted(comps)
+    assert len(models) >= 2, sorted(models)
+    assert all(str(e.get("justification", "")).strip() for e in entries)
+    # and the catalog itself stays in sync with the committed file
+    assert {e["probe"] for e in entries} == set(PROBES())

@@ -22,7 +22,7 @@ from bigdl_tpu.analysis import (
 )
 from bigdl_tpu.analysis.passes import (
     clock_discipline, collective_discipline, lock_discipline,
-    metrics_catalog, trace_safety,
+    metrics_catalog, thread_lifecycle, trace_safety,
 )
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -54,9 +54,9 @@ def test_registry_has_every_pass():
     names = pass_names()
     # (collective-axis is a second rule id the collective-discipline
     # pass emits, not a separate registered pass)
-    for expected in ("trace-safety", "lock-discipline",
+    for expected in ("trace-safety", "lock-discipline", "lock-order",
                      "collective-discipline", "clock-discipline",
-                     "metrics-catalog"):
+                     "metrics-catalog", "thread-lifecycle"):
         assert expected in names, names
 
 
@@ -296,6 +296,260 @@ def test_lock_discipline_mutator_calls_count_as_writes(tmp_path):
         """})
     findings = lock_discipline.run(tree)
     assert len(findings) == 1 and findings[0].scope == "Q.producer"
+
+
+# ---------------------------------------------------------------------------
+# lock-order
+# ---------------------------------------------------------------------------
+
+def test_lock_order_cycle_detected_and_single_order_clean(tmp_path):
+    tree = _mini_repo(tmp_path, {"bigdl_tpu/serving/x.py": """\
+        import threading
+
+        class Deadlocky:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cond = threading.Lock()
+                self.n = 0
+
+            def a(self):
+                with self._lock:
+                    with self._cond:
+                        self.n += 1
+
+            def b(self):
+                with self._cond:
+                    with self._lock:
+                        self.n -= 1
+
+        class OneOrder:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cond = threading.Lock()
+                self.n = 0
+
+            def a(self):
+                with self._lock:
+                    with self._cond:
+                        self.n += 1
+
+            def c(self):
+                with self._lock:
+                    self.n += 2        # negative: consistent order
+        """})
+    findings = lock_discipline.run_order(tree)
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.rule == "lock-order" and "BOTH orders" in f.message
+    assert "Deadlocky._lock" in f.message \
+        and "Deadlocky._cond" in f.message
+
+
+def test_lock_order_same_class_name_across_files_not_conflated(
+        tmp_path):
+    """Identity is (file, class, attr): two same-named classes in
+    different modules nesting in opposite orders is NOT a cycle."""
+    half = """\
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cond = threading.Lock()
+                self.n = 0
+
+            def f(self):
+                with self.{outer}:
+                    with self.{inner}:
+                        self.n += 1
+        """
+    tree = _mini_repo(tmp_path, {
+        "bigdl_tpu/serving/a.py": half.format(outer="_lock",
+                                              inner="_cond"),
+        "bigdl_tpu/telemetry/b.py": half.format(outer="_cond",
+                                                inner="_lock"),
+    })
+    assert lock_discipline.run_order(tree) == []
+
+
+def test_lock_order_cross_class_not_conflated(tmp_path):
+    """Locks are identified per class: A._lock->A._cond in one class
+    and B._cond->B._lock in another is NOT a cycle."""
+    tree = _mini_repo(tmp_path, {"bigdl_tpu/telemetry/x.py": """\
+        import threading
+
+        class A:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cond = threading.Lock()
+                self.n = 0
+
+            def f(self):
+                with self._lock:
+                    with self._cond:
+                        self.n += 1
+
+        class B:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cond = threading.Lock()
+                self.n = 0
+
+            def g(self):
+                with self._cond:
+                    with self._lock:
+                        self.n += 1
+        """})
+    assert lock_discipline.run_order(tree) == []
+
+
+def test_lock_order_pragma(tmp_path):
+    tree = _mini_repo(tmp_path, {"bigdl_tpu/data/x.py": """\
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cond = threading.Lock()
+                self.n = 0
+
+            def a(self):
+                with self._lock:
+                    with self._cond:
+                        self.n += 1
+
+            def b(self):
+                with self._cond:
+                    # graftlint: disable=lock-order -- b only runs
+                    # before the worker thread starts
+                    with self._lock:
+                        self.n -= 1
+        """})
+    findings = lock_discipline.run_order(tree)
+    apply_suppressions(findings, tree, [])
+    # the reported witness is the lexicographically-first edge's inner
+    # `with` (C._cond->C._lock, i.e. b's nesting) — the pragma block
+    # directly above that line silences the cycle with its reason
+    assert len(findings) == 1
+    assert findings[0].suppressed == "pragma"
+
+
+# ---------------------------------------------------------------------------
+# thread-lifecycle
+# ---------------------------------------------------------------------------
+
+def test_thread_lifecycle_positive_negative_and_pragma(tmp_path):
+    tree = _mini_repo(tmp_path, {"bigdl_tpu/serving/x.py": """\
+        import threading
+        from threading import Thread
+
+        def leak():
+            t = threading.Thread(target=print)   # positive
+            t.start()
+
+        def ok_daemon():
+            threading.Thread(target=print, daemon=True).start()
+
+        def ok_daemon_attr():
+            t = Thread(target=print)
+            t.daemon = True
+            t.start()
+
+        def ok_joined():
+            t = Thread(target=print)
+            t.start()
+            t.join(timeout=1.0)
+
+        def fire_and_forget():
+            # graftlint: disable=thread-lifecycle -- process-lifetime
+            # worker, reaped by the OS at exit by design
+            threading.Thread(target=print).start()
+
+        class Owner:
+            def start(self):
+                self._t = threading.Thread(target=print)
+                self._t.start()
+
+            def stop(self):
+                self._t.join()
+
+        class Leaky:
+            def start(self):
+                self._t = threading.Thread(target=print)  # positive
+                self._t.start()
+        """})
+    findings = thread_lifecycle.run(tree)
+    apply_suppressions(findings, tree, [])
+    active = [f for f in findings if not f.suppressed]
+    assert sorted(f.scope for f in active) == ["Leaky.start", "leak"]
+    assert all("non-daemon" in f.message for f in active)
+    assert sum(1 for f in findings if f.suppressed == "pragma") == 1
+
+
+def test_thread_lifecycle_annotated_assignment(tmp_path):
+    """An annotated `self._t: threading.Thread = Thread(...)` binds
+    the target like a plain assignment — joined in stop() passes,
+    never-joined is flagged by NAME (not as an unnamed thread)."""
+    tree = _mini_repo(tmp_path, {"bigdl_tpu/telemetry/x.py": """\
+        import threading
+
+        class Owner:
+            def start(self):
+                self._t: threading.Thread = threading.Thread(
+                    target=print)
+                self._t.start()
+
+            def stop(self):
+                self._t.join()
+
+        class Leaky:
+            def start(self):
+                self._t: threading.Thread = threading.Thread(
+                    target=print)
+                self._t.start()
+        """})
+    findings = thread_lifecycle.run(tree)
+    assert [f.scope for f in findings] == ["Leaky.start"]
+    assert "self._t" in findings[0].message
+
+
+def test_thread_lifecycle_unassigned_thread_flagged(tmp_path):
+    tree = _mini_repo(tmp_path, {"bigdl_tpu/data/x.py": """\
+        import threading
+
+        def bad():
+            threading.Thread(target=print).start()   # positive
+        """})
+    findings = thread_lifecycle.run(tree)
+    assert len(findings) == 1
+    assert "unnamed thread" in findings[0].message
+
+
+def test_thread_lifecycle_module_alias_resolved(tmp_path):
+    """`import threading as t; t.Thread(...)` is the same ctor — an
+    aliased module import must not evade the lint."""
+    tree = _mini_repo(tmp_path, {"bigdl_tpu/serving/x.py": """\
+        import threading as t
+
+        def leak():
+            t.Thread(target=print).start()     # positive
+
+        def fine():
+            t.Thread(target=print, daemon=True).start()
+        """})
+    findings = thread_lifecycle.run(tree)
+    assert [f.scope for f in findings] == ["leak"]
+
+
+def test_thread_lifecycle_shipped_tree_is_clean():
+    """Every one of the framework's Thread sites is daemon or joined
+    on its stop path — the triage-to-zero pin (no pragmas needed:
+    the PR-2/PR-4 shutdown discipline already covered all ten)."""
+    tree = load_tree()
+    findings = thread_lifecycle.run(tree)
+    apply_suppressions(findings, tree, [])
+    active = [f for f in findings if not f.suppressed]
+    assert active == [], "\n".join(render_human(active))
 
 
 # ---------------------------------------------------------------------------
