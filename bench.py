@@ -738,12 +738,20 @@ def phase_int8(on_tpu: bool):
 def phase_generate_serving(on_tpu: bool):
     """Continuous-batching decode throughput (serving.generation): the
     ISSUE-10 acceptance workload — mixed-length prompts through the
-    fixed-shape KV slot pool vs the sequential ``generate()`` baseline.
-    Fully measurable on the CPU backend (unlike the MFU campaign), and
-    recorded as its own versioned RoundArtifact so the serving perf
-    trajectory is durable evidence like the training one."""
+    fixed-shape KV slot pool vs the sequential ``generate()`` baseline —
+    plus the ISSUE-13 prefill-wall probes: a shared-system-prompt
+    workload measuring the prefix KV cache's TTFT win, and a mixed
+    long/short arrival cadence probe measuring how chunked prefill
+    bounds the inter-token tail (both run against a larger
+    prefill-dominant model config).  Fully measurable on the CPU
+    backend (unlike the MFU campaign), and recorded as its own
+    versioned RoundArtifact so the serving perf trajectory is durable
+    evidence like the training one."""
     from bigdl_tpu.models import transformer_lm
-    from bigdl_tpu.serving.generation import run_mixed_workload
+    from bigdl_tpu.serving.generation import (
+        run_cadence_probe, run_mixed_workload,
+        run_shared_prefix_workload,
+    )
     from bigdl_tpu.utils import set_seed
 
     set_seed(7)
@@ -761,8 +769,36 @@ def phase_generate_serving(on_tpu: bool):
     prompts = [rng.integers(1, 129, rng.integers(8, 65)).astype(np.int32)
                for _ in range(n_req)]
     max_news = [int(rng.integers(16, 129)) for _ in range(n_req)]
+    # the UNSHARED workload runs with the defaults (prefix cache off):
+    # the no-regression bar vs GENSERVE_r01 is judged on this number
     out = run_mixed_workload(model.eval_mode(), prompts, max_news,
                              slots=slots, sequential_sample=seq_sample)
+
+    # prefill-wall probes: a model where prefill compute dominates a
+    # decode step (the regime the prefix cache and chunk budget exist
+    # for — at tiny-model scale prefill is all dispatch overhead and
+    # the probes measure nothing)
+    set_seed(7)
+    probe_model = transformer_lm(
+        vocab_size=32000 if on_tpu else 512, hidden_size=256,
+        num_layers=4, num_heads=8, filter_size=512,
+        max_len=512).eval_mode()
+    try:
+        shared = run_shared_prefix_workload(
+            probe_model, n_requests=32, prefix_len=448, tail=(8, 49),
+            max_new=8, slots=8, prefix_granularity=64, prefill_chunk=64)
+        out["shared_prefix"] = shared
+    except Exception:
+        _log("shared-prefix probe failed (non-fatal):\n"
+             + traceback.format_exc())
+    try:
+        out["cadence"] = {
+            "bounded": run_cadence_probe(probe_model, bounded=True),
+            "unbounded": run_cadence_probe(probe_model, bounded=False),
+        }
+    except Exception:
+        _log("cadence probe failed (non-fatal):\n"
+             + traceback.format_exc())
     _update(gen_serving_tokens_per_sec=out["continuous_tokens_per_sec"],
             gen_serving_speedup_vs_sequential=out.get(
                 "speedup_vs_sequential"),
@@ -771,6 +807,11 @@ def phase_generate_serving(on_tpu: bool):
             gen_serving_greedy_checked_requests=out.get(
                 "greedy_checked_requests"),
             gen_serving_slot_occupancy=out["slot_occupancy_mean"],
+            gen_serving_prefix_ttft_p50_speedup=out.get(
+                "shared_prefix", {}).get("ttft_p50_speedup"),
+            gen_serving_cadence_p99_over_steady=out.get(
+                "cadence", {}).get("bounded", {}).get(
+                    "p99_over_steady_p50"),
             gen_serving_config=f"slots{slots}-req{n_req}-prompts8to64-"
                                f"new16to128")
     # durable evidence: its own artifact series (GENSERVE_r<N>.json),

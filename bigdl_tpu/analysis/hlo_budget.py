@@ -397,6 +397,35 @@ def _ep_loss(n_devices, capacity):
     return loss, (mp, x), mesh, moe
 
 
+def _gen_probe(program: str) -> Dict:
+    """Lower a serving slot-pool program (single device): the chunked
+    KV-carry-in prefill or the prefix-cache KV copy.  No collectives
+    are legitimate in either — expected={} makes any collective above
+    the floor a reshard finding — and the budget entry pins their
+    donation coverage (the pool must update in place, never copy
+    S x layers x max_len of K/V per call)."""
+    import numpy as np
+
+    import jax
+    from jax.sharding import Mesh
+
+    from bigdl_tpu.models import transformer_lm
+    from bigdl_tpu.serving.generation import SlotPool
+    from bigdl_tpu.utils import set_seed
+
+    set_seed(21)
+    lm = transformer_lm(vocab_size=30, hidden_size=16, num_layers=2,
+                        num_heads=2, filter_size=32,
+                        max_len=32).eval_mode()
+    pool = SlotPool(lm, slots=2)
+    compiled = (pool.chunk_prefill_compiled(8)
+                if program == "chunk_prefill"
+                else pool.kv_copy_compiled(8))
+    mesh = Mesh(np.asarray(jax.devices()[:1]), ("data",))
+    return {"compiled": compiled, "mesh": mesh, "plan_bytes": None,
+            "param_bytes": None}
+
+
 def _build_probes() -> Dict[str, ProbeSpec]:
     from bigdl_tpu.parallel.sharding import ShardingRules
     # what each composition legitimately puts on each axis.  Tight for
@@ -524,6 +553,13 @@ def _build_probes() -> Dict[str, ProbeSpec]:
             "moe/ep_psum", "moe", "ep_psum",
             lambda: _functional_probe(lambda: _ep_loss(4, None)),
             expected={"expert": ("all-reduce", "collective-permute")}),
+        # -- generation serving (single-device slot-pool programs) ----------
+        ProbeSpec(
+            "generation/chunk_prefill", "generation", "chunk_prefill",
+            lambda: _gen_probe("chunk_prefill"), expected={}),
+        ProbeSpec(
+            "generation/kv_copy", "generation", "kv_copy",
+            lambda: _gen_probe("kv_copy"), expected={}),
     ]
     if os.environ.get("BIGDL_TPU_BUDGET_MISSPEC"):
         specs.append(ProbeSpec(

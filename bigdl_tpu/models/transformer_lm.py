@@ -28,6 +28,7 @@ import jax.numpy as jnp
 from bigdl_tpu.core.module import Module, ModuleList, Parameter
 from bigdl_tpu.nn.attention import (SequenceBeamSearch,
                                     TransformerDecoderLayer, causal_bias,
+                                    chunk_incremental_bias,
                                     incremental_bias, padding_bias,
                                     position_encoding)
 from bigdl_tpu.nn.linear import LookupTable
@@ -240,6 +241,88 @@ class TransformerLM(Module):
             y = blk.ffn(blk.ffn_norm(x))
             x = x + _residual_dropout(y, blk.ffn_dropout, blk.training)
         return layers, pad_cols
+
+    def prefill_chunk(self, toks, index, caches, slot=None):
+        """KV-carry-in prefill: write K/V + padding flags for ``toks
+        [B, W]`` at positions ``[index, index+W)`` of an incremental
+        cache whose positions ``< index`` are already filled.  The chunk
+        attends to the carried-in prefix AND itself (causally), so a
+        long prompt can be prefilled in fixed-width chunks interleaved
+        with decode steps instead of one monolithic forward — the
+        static-shape cousin of Sarathi-style chunked prefill.  Same
+        contract as :meth:`decode_step` (of which this is the W-token
+        generalization, equivalent to columns ``[index, index+W)`` of
+        the full forward); no logits are produced (prefill never needs
+        the vocab projection).
+
+        Two cache layouts:
+
+        * ``slot=None`` — per-request rows: caches carry ``B`` rows
+          aligned with ``toks``.
+        * ``slot`` given (a traced scalar) — POOLED: caches hold S slot
+          rows, ``toks`` is [1, W], and only ``slot``'s row is touched.
+          The cache write covers exactly the chunk window (so a DONATED
+          pool updates in place at O(chunk) write cost — writing a
+          whole gathered row back was measured to cost the full row's
+          traffic per chunk) and the attention keys are read by slicing
+          the slot's row after the write.
+
+        Attention is inlined like :meth:`prefill_kv` (the K/V written
+        to the cache are the K/V attended), expecting eval mode — the
+        serving slot pool always runs an eval clone."""
+        from bigdl_tpu.nn.attention import _residual_dropout
+        from bigdl_tpu.ops import dot_product_attention
+        _B, W = toks.shape
+        if slot is None:
+            pad = jax.lax.dynamic_update_slice(caches["pad"], toks == 0,
+                                               (0, index))
+            pad_read = pad
+        else:
+            pad = jax.lax.dynamic_update_slice(caches["pad"], toks == 0,
+                                               (slot, index))
+            pad_read = jax.lax.dynamic_slice(pad, (slot, 0),
+                                             (1, self.max_len))
+        x = self.embedding.forward(jnp.maximum(toks, 1))
+        x = x * (self.hidden_size ** 0.5)
+        pos = jax.lax.dynamic_slice_in_dim(
+            position_encoding(self.max_len, self.hidden_size,
+                              dtype=x.dtype), index, W, axis=0)
+        x = x + pos[None]
+        bias = chunk_incremental_bias(self.max_len, index, W, pad_read,
+                                      x.dtype)
+        new_layers = []
+        for blk, cache in zip(self.blocks, caches["layers"]):
+            attn = blk.self_attn
+            xn = blk.self_norm(x)
+            k_new = attn._split_heads(attn.k_layer(xn))
+            v_new = attn._split_heads(attn.v_layer(xn))
+            old = cache["self"]
+            if slot is None:
+                k = jax.lax.dynamic_update_slice(
+                    old["k"], k_new.astype(old["k"].dtype),
+                    (0, 0, index, 0))
+                v = jax.lax.dynamic_update_slice(
+                    old["v"], v_new.astype(old["v"].dtype),
+                    (0, 0, index, 0))
+                k_read, v_read = k, v
+            else:
+                k = jax.lax.dynamic_update_slice(
+                    old["k"], k_new.astype(old["k"].dtype),
+                    (slot, 0, index, 0))
+                v = jax.lax.dynamic_update_slice(
+                    old["v"], v_new.astype(old["v"].dtype),
+                    (slot, 0, index, 0))
+                row = (1,) + old["k"].shape[1:]
+                k_read = jax.lax.dynamic_slice(k, (slot, 0, 0, 0), row)
+                v_read = jax.lax.dynamic_slice(v, (slot, 0, 0, 0), row)
+            new_layers.append({"self": {"k": k, "v": v}})
+            q = attn._split_heads(attn.q_layer(xn))
+            ctxt = dot_product_attention(q, k_read, v_read, bias)
+            y = attn.output_layer(attn._combine_heads(ctxt))
+            x = x + _residual_dropout(y, blk.ffn_dropout, blk.training)
+            y = blk.ffn(blk.ffn_norm(x))
+            x = x + _residual_dropout(y, blk.ffn_dropout, blk.training)
+        return {"layers": new_layers, "pad": pad}
 
     def _prefill(self, prompt, caches):
         """Write prompt[:, :-1]'s per-layer K/V into the caches with ONE

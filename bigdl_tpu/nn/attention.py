@@ -34,7 +34,7 @@ __all__ = [
     "Attention", "FeedForwardNetwork", "TransformerEncoderLayer",
     "TransformerDecoderLayer", "Transformer", "SequenceBeamSearch",
     "position_encoding", "padding_bias", "causal_bias",
-    "incremental_bias", "shift_right_3d",
+    "incremental_bias", "chunk_incremental_bias", "shift_right_3d",
 ]
 
 
@@ -89,6 +89,21 @@ def incremental_bias(max_len: int, index, pad=None, dtype=jnp.float32):
             :, None, None, :]
     return jnp.where(invalid, _NEG_INF, 0.0).astype(dtype)[
         None, None, None, :]
+
+
+def chunk_incremental_bias(max_len: int, index, width: int, pad,
+                           dtype=jnp.float32):
+    """Additive attention bias for a ``width``-token chunk written at
+    positions ``[index, index+width)`` of a fixed-size KV cache: query
+    ``i`` (global position ``index+i``) may attend cache slots
+    ``j <= index+i`` that are not padding (``pad``: [B, max_len] bool,
+    including the chunk's own freshly written flags).  The ``width==1``
+    row is exactly :func:`incremental_bias` — decode is the degenerate
+    chunk.  Returns [B, 1, width, max_len]."""
+    qpos = index + jnp.arange(width)[:, None]
+    invalid = jnp.arange(max_len)[None, :] > qpos          # [W, max_len]
+    invalid = invalid[None, :, :] | pad[:, None, :]        # [B, W, max_len]
+    return jnp.where(invalid, _NEG_INF, 0.0).astype(dtype)[:, None, :, :]
 
 
 def shift_right_3d(x):

@@ -17,6 +17,7 @@ from bigdl_tpu.serving.generation import (     # noqa: F401
     GenerationRequest, GenerationScheduler, SlotPool,
 )
 from bigdl_tpu.serving.metrics import MetricsRegistry      # noqa: F401
+from bigdl_tpu.serving.prefix_cache import PrefixKVCache   # noqa: F401
 from bigdl_tpu.serving.scheduler import BatchScheduler     # noqa: F401
 from bigdl_tpu.serving.server import (         # noqa: F401
     ModelServer, install_shutdown_signals,
@@ -25,6 +26,7 @@ from bigdl_tpu.serving.server import (         # noqa: F401
 __all__ = [
     "ModelServer", "MetricsRegistry", "BatchScheduler",
     "GenerationScheduler", "GenerationRequest", "SlotPool",
+    "PrefixKVCache",
     "BoundedRequestQueue", "Request",
     "QueueFullError", "RequestSheddedError", "ServerClosedError",
     "bucket_sizes", "pick_bucket", "stack_requests", "split_outputs",

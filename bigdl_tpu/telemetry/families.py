@@ -354,6 +354,37 @@ def generation_queue_to_first_token_seconds() -> Histogram:
                  10.0, 30.0, float("inf")))
 
 
+def generation_inter_token_seconds() -> Histogram:
+    return get_registry().histogram(
+        "generation_inter_token_seconds",
+        "Gap between consecutive emitted tokens of one generation "
+        "request (the streaming cadence chunked prefill exists to "
+        "bound; the tail shows prefill stalls)",
+        buckets=(1e-4, 5e-4, 1e-3, 5e-3, 0.01, 0.025, 0.05, 0.1, 0.25,
+                 0.5, 1.0, 2.5, 10.0, float("inf")))
+
+
+def generation_prefix_cache_events_total() -> Counter:
+    return get_registry().counter(
+        "generation_prefix_cache_events_total",
+        "Prefix KV-cache lookups at admit, labelled hit (>= one cached "
+        "chunk copied) or miss", labelnames=("result",))
+
+
+def generation_prefix_cache_bytes_reused_total() -> Counter:
+    return get_registry().counter(
+        "generation_prefix_cache_bytes_reused_total",
+        "Prefill K/V bytes copied from the prefix cache instead of "
+        "recomputed (the prefill compute the cache saved)")
+
+
+def generation_prefix_cache_resident_bytes() -> Gauge:
+    return get_registry().gauge(
+        "generation_prefix_cache_resident_bytes",
+        "Bytes currently held by the prefix KV cache (LRU-bounded by "
+        "its byte budget)")
+
+
 _PREREGISTER = (
     optimizer_data_wait_seconds, optimizer_step_seconds,
     optimizer_validation_seconds, optimizer_retries_total,
@@ -377,6 +408,10 @@ _PREREGISTER = (
     serving_batch_occupancy,
     generation_tokens_per_second, generation_slot_occupancy,
     generation_phase_seconds, generation_queue_to_first_token_seconds,
+    generation_inter_token_seconds,
+    generation_prefix_cache_events_total,
+    generation_prefix_cache_bytes_reused_total,
+    generation_prefix_cache_resident_bytes,
 )
 
 

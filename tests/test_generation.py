@@ -253,7 +253,7 @@ def test_engine_survives_decode_failure(lm):
     eng = GenerationScheduler(lm, slots=2)
     try:
         calls = {"n": 0}
-        orig = eng.pool.decode
+        orig = eng.pool.decode_dispatch
 
         def boom():
             calls["n"] += 1
@@ -263,7 +263,7 @@ def test_engine_survives_decode_failure(lm):
 
         # engine is idle (blocked on the queue) here, so the patch
         # lands before any decode of p1 can start
-        eng.pool.decode = boom
+        eng.pool.decode_dispatch = boom
         f1 = eng.submit_async(p1, 4)
         with pytest.raises(RuntimeError, match="device on fire"):
             f1.result(timeout=60)
