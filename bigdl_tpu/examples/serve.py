@@ -189,6 +189,15 @@ def main(argv=None):
                         "API, e.g. TransformerLM)")
     p.add_argument("--slots", type=int, default=8,
                    help="KV slot-pool width for --generate")
+    p.add_argument("--fleet-dir", default=None, metavar="DIR",
+                   help="publish this replica's health snapshot into "
+                        "DIR via the fleet file transport so a serving-"
+                        "fabric Router (bigdl_tpu.serving.router) can "
+                        "route to / drain this process; the snapshot "
+                        "carries the /healthz drain state")
+    p.add_argument("--replica-id", type=int, default=0,
+                   help="fleet snapshot id under --fleet-dir (one per "
+                        "replica process)")
     p.add_argument("--no-telemetry", action="store_true",
                    help="disable the unified telemetry registry (the "
                         "/metrics endpoint then exposes an empty "
@@ -245,6 +254,26 @@ def main(argv=None):
         return info
 
     server.debugz.statusz_fn = _statusz
+    publisher = None
+    if args.fleet_dir:
+        # the replica side of the serving fabric: drop a periodic
+        # health snapshot (queue depth, slot occupancy, TTFT p99,
+        # draining flag) for the router's registry — the same file a
+        # Replica handle would write, so drain/deploy sees this
+        # process exactly like an in-process replica
+        from bigdl_tpu.serving.replica import (
+            SnapshotPublisher, replica_snapshot,
+        )
+        from bigdl_tpu.telemetry.fleet import write_host_snapshot
+
+        def _publish_snapshot():
+            write_host_snapshot(args.fleet_dir, replica_snapshot(
+                args.replica_id, gen_server or batcher,
+                name=f"serve-{args.replica_id}", role="mixed",
+                draining=bool(server.health_state.get("draining"))))
+
+        publisher = SnapshotPublisher(_publish_snapshot,
+                                      interval_s=0.25)
     logger.info("serving on %s:%d", args.host, server.server_port)
     # SIGTERM (the orchestrator's stop notice) takes the same graceful
     # path as Ctrl-C: unwind serve_forever, then drain the batcher so
@@ -270,6 +299,10 @@ def main(argv=None):
         # so the load balancer stops routing to this replica while the
         # already-admitted requests finish
         server.health_state["draining"] = True
+        if publisher is not None:
+            # the router registry must see draining:true BEFORE the
+            # drain starts, not one publish interval into it
+            publisher.publish_now()
         if batcher is not None or gen_server is not None:
             # keep answering HTTP (now-503 health checks, in-flight
             # predicts/generates) on a background accept loop while the
@@ -288,6 +321,14 @@ def main(argv=None):
             server.shutdown()
             t.join(timeout=10.0)
         server.server_close()
+        if publisher is not None:
+            # the draining state was already published when the flag
+            # flipped; on exit the snapshot is REMOVED so the registry
+            # forgets this replica instead of reporting a dead ghost
+            # as stale forever
+            publisher.stop(final_publish=False)
+            from bigdl_tpu.telemetry.fleet import remove_host_snapshot
+            remove_host_snapshot(args.fleet_dir, args.replica_id)
         if prev_term is not None:
             signal.signal(signal.SIGTERM, prev_term)
     return server

@@ -18,6 +18,12 @@ from bigdl_tpu.serving.generation import (     # noqa: F401
 )
 from bigdl_tpu.serving.metrics import MetricsRegistry      # noqa: F401
 from bigdl_tpu.serving.prefix_cache import PrefixKVCache   # noqa: F401
+from bigdl_tpu.serving.replica import (        # noqa: F401
+    DisaggregatedEngine, Replica, ReplicaRegistry,
+)
+from bigdl_tpu.serving.router import (         # noqa: F401
+    HashRing, NoReplicaAvailableError, Router,
+)
 from bigdl_tpu.serving.scheduler import BatchScheduler     # noqa: F401
 from bigdl_tpu.serving.server import (         # noqa: F401
     ModelServer, install_shutdown_signals,
@@ -27,6 +33,8 @@ __all__ = [
     "ModelServer", "MetricsRegistry", "BatchScheduler",
     "GenerationScheduler", "GenerationRequest", "SlotPool",
     "PrefixKVCache",
+    "Router", "HashRing", "Replica", "ReplicaRegistry",
+    "DisaggregatedEngine", "NoReplicaAvailableError",
     "BoundedRequestQueue", "Request",
     "QueueFullError", "RequestSheddedError", "ServerClosedError",
     "bucket_sizes", "pick_bucket", "stack_requests", "split_outputs",

@@ -55,6 +55,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "greedy tokens each through the KV slot pool")
     p.add_argument("--slots", type=int, default=4,
                    help="KV slot-pool width for --generate")
+    p.add_argument("--replicas", type=int, default=1, metavar="N",
+                   help="with --generate: spawn N in-process replicas "
+                        "behind the serving-fabric Router (session-"
+                        "affine consistent hashing, health registry, "
+                        "SLO shedding) instead of one engine")
     p.add_argument("--no-warmup", action="store_true",
                    help="skip pre-compiling the bucket shapes")
     p.add_argument("--log-dir", default=None,
@@ -73,6 +78,10 @@ def main(argv=None, stdin=None, stdout=None, stderr=None) -> int:
     from bigdl_tpu.serving.server import install_shutdown_signals
 
     model = zoo(args.model)
+    if args.replicas < 1:
+        print(f"error: --replicas must be >= 1, got {args.replicas}",
+              file=stderr)
+        return 2
     if args.generate is not None:
         if args.quantize:
             # dropping the flag silently would serve fp32 while the
@@ -81,7 +90,13 @@ def main(argv=None, stdin=None, stdout=None, stderr=None) -> int:
             print("error: --quantize is not supported with --generate "
                   "(the int8 path has no KV-cache decode)", file=stderr)
             return 2
+        if args.replicas > 1:
+            return _fabric_main(args, model, stdin, stdout, stderr)
         return _generate_main(args, model, stdin, stdout, stderr)
+    if args.replicas > 1:
+        print("error: --replicas needs --generate (the fabric routes "
+              "generation requests)", file=stderr)
+        return 2
     shape = zoo_sample_shape(args.model)
     if args.quantize:
         from bigdl_tpu.nn.quantized import quantize
@@ -150,16 +165,13 @@ def main(argv=None, stdin=None, stdout=None, stderr=None) -> int:
     return 0
 
 
-def _generate_main(args, model, stdin, stdout, stderr) -> int:
-    """--generate mode: prompt lines in, greedy continuations out, all
-    sharing the continuous-batching slot pool."""
-    from bigdl_tpu.serving import ModelServer
-    from bigdl_tpu.serving.server import install_shutdown_signals
-
-    server = ModelServer(
-        generator=model, slots=args.slots,
-        gen_queue_capacity=args.queue_capacity, admission=args.policy)
-
+def _drive_generation(args, model, stdin, stdout, stderr,
+                      submit) -> None:
+    """The shared --generate prompt harness: build the synthetic or
+    stdin prompt stream, submit each line fallibly through
+    ``submit(i, prompt) -> Future`` (a malformed line becomes ONE
+    ERROR row, never aborting the stream), drain on interrupt, and
+    print one ``<index>\\t<tokens>`` row per prompt."""
     if args.synthetic is not None:
         rng = np.random.default_rng(0)
         vocab = model.embedding.weight.shape[0] - 1
@@ -179,38 +191,95 @@ def _generate_main(args, model, stdin, stdout, stderr) -> int:
                 yield line   # parsed (fallibly) in the submit loop
 
     futures: List = []
+    try:
+        for i, p in enumerate(prompt_lines()):
+            try:
+                if isinstance(p, str):
+                    p = np.array(p.split(), dtype=np.int32)
+                futures.append(submit(i, p))
+            except Exception as e:
+                futures.append(e)
+    except KeyboardInterrupt:
+        print(f"interrupted: draining {len(futures)} in-flight "
+              "generations", file=stderr)
+    for i, f in enumerate(futures):
+        try:
+            row = np.asarray(f.result() if not isinstance(f, Exception)
+                             else _raise(f))
+        except Exception as e:
+            print(f"{i}\tERROR\t{type(e).__name__}", file=stdout)
+            continue
+        print(f"{i}\t" + " ".join(str(int(t)) for t in row),
+              file=stdout)
+
+
+def _generate_main(args, model, stdin, stdout, stderr) -> int:
+    """--generate mode: prompt lines in, greedy continuations out, all
+    sharing the continuous-batching slot pool."""
+    from bigdl_tpu.serving import ModelServer
+    from bigdl_tpu.serving.server import install_shutdown_signals
+
+    server = ModelServer(
+        generator=model, slots=args.slots,
+        gen_queue_capacity=args.queue_capacity, admission=args.policy)
     restore_signals = install_shutdown_signals(server)
     try:
-        try:
-            for p in prompt_lines():
-                # parse AND submit per line: a malformed line becomes
-                # one ERROR row, it must not abort the stream and
-                # discard every already-submitted generation
-                try:
-                    if isinstance(p, str):
-                        p = np.array(p.split(), dtype=np.int32)
-                    futures.append(
-                        server.submit_generate_async(p, args.generate))
-                except Exception as e:
-                    futures.append(e)
-        except KeyboardInterrupt:
-            print(f"interrupted: draining {len(futures)} in-flight "
-                  "generations", file=stderr)
-        for i, f in enumerate(futures):
-            try:
-                row = np.asarray(f.result() if not isinstance(f, Exception)
-                                 else _raise(f))
-            except Exception as e:
-                print(f"{i}\tERROR\t{type(e).__name__}", file=stdout)
-                continue
-            print(f"{i}\t" + " ".join(str(int(t)) for t in row),
-                  file=stdout)
+        _drive_generation(
+            args, model, stdin, stdout, stderr,
+            lambda i, p: server.submit_generate_async(p, args.generate))
     finally:
         server.shutdown(drain=True)
         restore_signals()
 
     print(json.dumps(server.generation_stats(), sort_keys=True),
           file=stderr)
+    return 0
+
+
+def _fabric_main(args, model, stdin, stdout, stderr) -> int:
+    """--generate --replicas N: the local serving fabric — N in-process
+    ModelServer replicas behind the session-affine Router, health
+    published through the file-transport registry in a temp dir."""
+    import shutil
+    import tempfile
+
+    from bigdl_tpu.serving import ModelServer, Replica, Router
+    from bigdl_tpu.serving.server import install_shutdown_signals
+
+    fleet_dir = tempfile.mkdtemp(prefix="bigdl-fabric-")
+    replicas = [
+        Replica(i, ModelServer(generator=model, slots=args.slots,
+                               gen_queue_capacity=args.queue_capacity,
+                               admission=args.policy),
+                snapshot_dir=fleet_dir, publish_interval_s=0.1)
+        for i in range(args.replicas)]
+    router = Router(replicas=replicas, snapshot_dir=fleet_dir,
+                    poll_interval_s=0.02)
+
+    fleet = None
+    # same SIGTERM/SIGINT contract as the single-engine mode: unwind
+    # into the drain instead of dying with futures in flight (the
+    # handler only raises KeyboardInterrupt; its argument is unused)
+    restore_signals = install_shutdown_signals(router)
+    try:
+        # a small session-key population so affinity is visible in
+        # the stats: same key -> same replica
+        _drive_generation(
+            args, model, stdin, stdout, stderr,
+            lambda i, p: router.submit_generate_async(
+                p, args.generate,
+                session=f"session-{i % (2 * args.replicas)}"))
+        # read the fleet table while the snapshots are still on disk
+        # (closing a replica removes its file so the registry forgets
+        # it instead of reporting a stale ghost)
+        fleet = router.registry.fleet()
+    finally:
+        router.shutdown(drain=True)
+        restore_signals()
+        shutil.rmtree(fleet_dir, ignore_errors=True)
+
+    out = {"router": router.stats(), "fleet": fleet}
+    print(json.dumps(out, sort_keys=True, default=str), file=stderr)
     return 0
 
 

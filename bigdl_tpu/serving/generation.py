@@ -555,7 +555,7 @@ class _ActiveSlot:
     """Host bookkeeping for one occupied slot (prefilling or decoding)."""
 
     __slots__ = ("req", "emitted", "t_first", "t_last", "eos_id", "slot",
-                 "phase", "next_pos", "end_pos")
+                 "phase", "next_pos", "end_pos", "was_follower")
 
     def __init__(self, req: GenerationRequest, eos_id, slot: int):
         self.req = req
@@ -567,6 +567,7 @@ class _ActiveSlot:
         self.phase = "prefill"
         self.next_pos = 0                       # next prefill position
         self.end_pos = max(len(req.prompt) - 1, 0)   # prefill covers [0, end)
+        self.was_follower = False               # dedup counted once
 
 
 class _Reservoir:
@@ -617,7 +618,26 @@ class GenerationScheduler:
     prefill drains at full speed.
 
     ``prefix_cache_bytes`` (None = off) enables the prefix KV cache at
-    ``prefix_granularity`` token chunks with an LRU byte budget.
+    ``prefix_granularity`` token chunks with an LRU byte budget;
+    ``prefix_cache=`` injects an existing :class:`PrefixKVCache`
+    instead — SHARING one cache between engines is how the
+    disaggregated prefill/decode split hands K/V across (see
+    ``serving.replica.DisaggregatedEngine``).
+
+    With a cache on, prefill is SINGLE-FLIGHT per prefix chunk: the
+    first request needing an uncached chunk claims it as the in-flight
+    leader; identical (or prefix-sharing) requests admitted while the
+    leader prefills park as followers and re-match once the leader's
+    insert lands — a burst of identical cold prompts prefills ONCE.
+    Dedup counts surface in ``stats()`` (``prefill_dedup_leaders`` /
+    ``prefill_dedup_followers``) and the
+    ``generation_prefill_dedup_total{result}`` family.
+
+    ``role="prefill"`` builds a PREFILL-ONLY engine: a request's
+    prompt is prefilled and its K/V published through the (mandatory)
+    prefix cache, then the future resolves without decoding a single
+    token — the producer half of disaggregated serving.  Prefill-role
+    requests may pass ``max_new_tokens=0``.
 
     >>> engine = GenerationScheduler(lm, slots=8)
     >>> fut = engine.submit_async([5, 9, 2], max_new_tokens=16)
@@ -633,10 +653,16 @@ class GenerationScheduler:
                  prefill_chunk: int = 64,
                  prefill_chunk_budget: int = 1,
                  prefix_cache_bytes: Optional[int] = None,
-                 prefix_granularity: int = 32):
+                 prefix_granularity: int = 32,
+                 prefix_cache: Optional[PrefixKVCache] = None,
+                 role: str = "mixed"):
         self.pool = SlotPool(model, slots, dtype=dtype,
                              prefill_batch=prefill_batch)
         self.default_eos_id = eos_id
+        if role not in ("mixed", "prefill"):
+            raise ValueError(
+                f"role must be 'mixed' or 'prefill', got {role!r}")
+        self.role = role
         if prefill_chunk < 2:
             raise ValueError(
                 f"prefill_chunk must be >= 2, got {prefill_chunk}")
@@ -647,18 +673,32 @@ class GenerationScheduler:
         self.prefill_chunk = min(int(prefill_chunk), self.pool.max_len)
         self.prefill_chunk_budget = int(prefill_chunk_budget)
         self._chunk_buckets = bucket_sizes(self.prefill_chunk)
-        self._prefix_cache = (
-            None if not prefix_cache_bytes
-            else PrefixKVCache(int(prefix_cache_bytes),
-                               int(prefix_granularity)))
+        if prefix_cache is not None:
+            self._prefix_cache = prefix_cache
+        else:
+            self._prefix_cache = (
+                None if not prefix_cache_bytes
+                else PrefixKVCache(int(prefix_cache_bytes),
+                                   int(prefix_granularity)))
+        if role == "prefill" and self._prefix_cache is None:
+            raise ValueError(
+                "a prefill-role engine publishes its K/V through the "
+                "prefix cache; pass prefix_cache= (shared with the "
+                "decode-role engine) or prefix_cache_bytes=")
         cap = queue_capacity if queue_capacity is not None else 8 * slots
         self._queue = BoundedRequestQueue(
             cap, policy=admission, on_shed=self._record_shed)
         self._prompt_buckets = bucket_sizes(self.pool.max_len)
         self._slot_state: List[Optional[_ActiveSlot]] = [None] * slots
         self._prefill_work: Deque[Tuple] = deque()
+        # dedup followers parked on another request's in-flight prefill
+        # (engine-thread-only, like _slot_state/_prefill_work)
+        self._follow_work: List[_ActiveSlot] = []
         self._pending: Optional[Tuple] = None   # (emit, n_active, t0)
         self._lock = threading.Lock()
+        self._outstanding = 0
+        self._dedup_leaders = 0
+        self._dedup_followers = 0
         self._requests_done = 0
         self._tokens_emitted = 0
         self._decode_steps = 0
@@ -728,8 +768,31 @@ class GenerationScheduler:
         err = self._validate(req)
         if err is not None:
             raise err
-        self._queue.put(req, timeout=timeout)
+        # count BEFORE the put: the engine may resolve the future
+        # before this thread returns, and the done-callback must never
+        # decrement a count that was not yet incremented
+        with self._lock:
+            self._outstanding += 1
+        try:
+            self._queue.put(req, timeout=timeout)
+        except BaseException:
+            with self._lock:
+                self._outstanding -= 1
+            raise
+        req.future.add_done_callback(self._dec_outstanding)
         return req.future
+
+    def _dec_outstanding(self, _fut) -> None:
+        with self._lock:
+            self._outstanding -= 1
+
+    def admitted_outstanding(self) -> int:
+        """Admitted requests not yet terminal (queued, prefilling, or
+        decoding) — the number a drain must take to ZERO before the
+        replica may be torn down; the router asserts exactly that
+        during deploy instead of inferring it from counters."""
+        with self._lock:
+            return self._outstanding
 
     def submit(self, prompt, max_new_tokens: int, eos_id=None,
                timeout: Optional[float] = None) -> np.ndarray:
@@ -745,9 +808,13 @@ class GenerationScheduler:
         tp = len(req.prompt)
         if tp < 1:
             return ValueError("empty prompt")
-        if req.max_new_tokens < 1:
+        # a prefill-role request decodes nothing: 0 new tokens is its
+        # natural budget (the future resolves after the K/V publish)
+        min_new = 0 if self.role == "prefill" else 1
+        if req.max_new_tokens < min_new:
             return ValueError(
-                f"max_new_tokens must be >= 1, got {req.max_new_tokens}")
+                f"max_new_tokens must be >= {min_new}, got "
+                f"{req.max_new_tokens}")
         if tp + req.max_new_tokens > self.pool.max_len:
             return ValueError(
                 f"prompt {tp} + {req.max_new_tokens} new tokens exceeds "
@@ -796,6 +863,10 @@ class GenerationScheduler:
                 "prefix_chunks_copied": self._prefix_copies,
                 "prefill_chunk": self.prefill_chunk,
                 "prefill_chunk_budget": self.prefill_chunk_budget,
+                "prefill_dedup_leaders": self._dedup_leaders,
+                "prefill_dedup_followers": self._dedup_followers,
+                "admitted_outstanding": self._outstanding,
+                "role": self.role,
                 "shed": self._shed,
                 "slots": self.pool.slots,
                 "tokens_per_second": (self._tokens_emitted / self._decode_s
@@ -822,7 +893,7 @@ class GenerationScheduler:
             if free > 0:
                 arrivals.extend(self._queue.get_nowait_up_to(free))
             try:
-                if arrivals or self._prefill_work:
+                if arrivals or self._prefill_work or self._follow_work:
                     # admits, prefix copies and prefill chunks only
                     # extend the donated cache chain — they are safe
                     # with a decode step in flight (the pipeline is
@@ -836,6 +907,12 @@ class GenerationScheduler:
                     self._dispatch_decode()
                 else:
                     self._drain_pending()
+                    if self._follow_work and not self._prefill_work:
+                        # every parked follower waits on ANOTHER
+                        # engine's in-flight prefill (a shared cache —
+                        # a local leader would still be in
+                        # _prefill_work): poll, don't spin
+                        time.sleep(0.0005)
             except Exception as e:  # noqa: BLE001 - engine must survive
                 # the BatchScheduler invariant, kept: a failing dispatch
                 # fails the affected futures and the loop continues —
@@ -852,6 +929,8 @@ class GenerationScheduler:
         poisoned cache cannot leak into a new occupant)."""
         self._pending = None
         self._prefill_work.clear()
+        self._follow_work.clear()   # followers are slot-resident: the
+        # loop below fails them with everyone else
         # the failed dispatch may have consumed the donated feed
         # buffers: rebuild from mirrors on the next dispatch
         self.pool.invalidate_feed()
@@ -859,6 +938,7 @@ class GenerationScheduler:
             st = self._slot_state[slot]
             if st is None:
                 continue
+            self._release_claims(st)
             if not st.req.future.done():
                 st.req.future.set_exception(exc)
             self._slot_state[slot] = None
@@ -902,22 +982,132 @@ class GenerationScheduler:
                     req.future.set_exception(e)
                 self._slot_state[slot] = None
                 continue
-            if st.end_pos - st.next_pos <= 0:
-                # the cached prefix (or a 1-token prompt) covers the
-                # whole prefill region — straight to decode
-                pool.activate(slot, int(req.prompt[-1]), st.end_pos)
-                st.phase = "decode"
-            elif st.next_pos == 0 \
-                    and len(req.prompt) <= self.prefill_chunk:
-                b = pick_bucket(len(req.prompt), self._prompt_buckets)
-                legacy.setdefault(b, []).append(st)
-            else:
-                self._prefill_work.append(("chunk", st))
+            self._route_after_prefix(st, tel, legacy=legacy)
         for bucket in sorted(legacy):
             sts = legacy[bucket]
             for lo in range(0, len(sts), pool.prefill_batch):
                 self._prefill_work.append(
                     ("legacy", bucket, sts[lo:lo + pool.prefill_batch]))
+
+    def _route_after_prefix(self, st: _ActiveSlot, tel: bool,
+                            legacy: Optional[Dict] = None) -> None:
+        """Route a slot-resident request whose prefix-cache match just
+        set ``st.next_pos``: complete (nothing left to prefill), park
+        as a dedup follower (another request is prefilling its next
+        missing chunk), or schedule the remaining prefill.  ``legacy``
+        batches bucket-prefill candidates across one _admit call;
+        woken followers pass None and schedule singleton batches."""
+        pool = self.pool
+        req = st.req
+        if st.end_pos - st.next_pos <= 0:
+            # the cached prefix (or a 1-token prompt) covers the whole
+            # prefill region — straight to decode (or, prefill role,
+            # straight to done: everything it would publish is cached)
+            if self.role == "prefill":
+                self._complete_prefill_role(st, tel)
+            else:
+                pool.activate(st.slot, int(req.prompt[-1]), st.end_pos)
+                st.phase = "decode"
+            return
+        if self._claim_or_park(st, tel):
+            return
+        st.phase = "prefill"
+        if st.next_pos == 0 and len(req.prompt) <= self.prefill_chunk:
+            b = pick_bucket(len(req.prompt), self._prompt_buckets)
+            if legacy is not None:
+                legacy.setdefault(b, []).append(st)
+            else:
+                self._prefill_work.append(("legacy", b, [st]))
+        else:
+            self._prefill_work.append(("chunk", st))
+
+    def _claim_or_park(self, st: _ActiveSlot, tel: bool) -> bool:
+        """Single-flight prefill dedup.  With a prefix cache on, the
+        request either CLAIMS its missing chunk keys (it will prefill
+        them — the leader) or PARKS as a follower because its next
+        missing chunk is already being prefilled by someone else (in
+        this engine or another one sharing the cache).  Returns True
+        when parked."""
+        cache = self._prefix_cache
+        if cache is None or st.end_pos < cache.granularity:
+            return False
+        region = st.req.prompt[:st.end_pos]
+        missing = cache.missing_boundaries(region)
+        if not missing:
+            return False     # only the sub-granule tail remains
+        first_key = cache.boundary_key(region, missing[0])
+        owner = cache.prefill_owner(first_key)
+        if owner is not None and owner is not st:
+            st.phase = "follow"
+            self._follow_work.append(st)
+            if not st.was_follower:
+                # once per REQUEST: a woken follower re-parking on a
+                # later chunk's leader is the same deduplicated
+                # request, not a second dedup win
+                st.was_follower = True
+                with self._lock:
+                    self._dedup_followers += 1
+                if tel:
+                    from bigdl_tpu.telemetry import families
+                    families.generation_prefill_dedup_total().labels(
+                        "follower").inc()
+            return True
+        keys = [cache.boundary_key(region, i) for i in missing]
+        if cache.claim_prefill(keys, st):
+            with self._lock:
+                self._dedup_leaders += 1
+            if tel:
+                from bigdl_tpu.telemetry import families
+                families.generation_prefill_dedup_total().labels(
+                    "leader").inc()
+        return False
+
+    def _release_claims(self, st: _ActiveSlot) -> None:
+        cache = self._prefix_cache
+        if cache is not None:
+            cache.release_prefill(st)
+
+    def _sweep_followers(self, tel: bool) -> None:
+        """Re-examine parked followers: any whose blocking chunk is now
+        cached (the leader's insert landed) or unowned (the leader
+        failed — the follower re-claims and leads) re-matches the cache
+        and re-routes; the rest stay parked."""
+        cache = self._prefix_cache
+        if cache is None or not self._follow_work:
+            return
+        parked, self._follow_work = self._follow_work, []
+        for st in parked:
+            if self._slot_state[st.slot] is not st:
+                continue    # failed/cleared while parked
+            region = st.req.prompt[:st.end_pos]
+            missing = cache.missing_boundaries(region)
+            if missing:
+                owner = cache.prefill_owner(
+                    cache.boundary_key(region, missing[0]))
+                if owner is not None and owner is not st:
+                    self._follow_work.append(st)    # still in flight
+                    continue
+            try:
+                st.next_pos = self._copy_cached_prefix(st, tel)
+            except Exception as e:  # noqa: BLE001 - fail the request,
+                # not the engine (same contract as the admit-time copy)
+                logger.exception("prefix KV copy failed for woken "
+                                 "follower in slot %d", st.slot)
+                if not st.req.future.done():
+                    st.req.future.set_exception(e)
+                self._slot_state[st.slot] = None
+                continue
+            self._route_after_prefix(st, tel)
+
+    def _complete_prefill_role(self, st: _ActiveSlot, tel: bool) -> None:
+        """Prefill-role terminal: the prompt's K/V is published through
+        the shared prefix cache; resolve the future (row = prompt, no
+        decoded tokens) and free the slot — releasing it if a batched
+        ``prefill_into`` already marked it decode-ready."""
+        self._release_claims(st)
+        self._finish(st, time.perf_counter(), tel)
+        self._slot_state[st.slot] = None
+        self.pool.release(st.slot)
 
     def _copy_cached_prefix(self, st: _ActiveSlot, tel: bool) -> int:
         """Match the prompt's prefill region against the prefix cache
@@ -951,6 +1141,8 @@ class GenerationScheduler:
         limit = (self.prefill_chunk_budget if pool.n_active() else None)
         done = 0
         tel = telemetry.enabled()
+        if self._follow_work:
+            self._sweep_followers(tel)
         while self._prefill_work and (limit is None or done < limit):
             item = self._prefill_work[0]
             if item[0] == "legacy":
@@ -981,15 +1173,22 @@ class GenerationScheduler:
             # engine: the slots were never activated
             logger.exception("prefill of bucket %d failed", bucket)
             for st in sts:
+                self._release_claims(st)
                 if not st.req.future.done():
                     st.req.future.set_exception(e)
                 self._slot_state[st.slot] = None
+            self._sweep_followers(tel)  # a parked follower re-claims
             return
         dt = time.perf_counter() - t0
         for st in sts:
-            st.phase = "decode"
             st.next_pos = st.end_pos
             self._store_prefix(st)
+            self._release_claims(st)
+            if self.role == "prefill":
+                self._complete_prefill_role(st, tel)
+            else:
+                st.phase = "decode"
+        self._sweep_followers(tel)
         with self._lock:
             self._prefill_calls += 1
             self._prefill_s += dt
@@ -1028,9 +1227,11 @@ class GenerationScheduler:
         except Exception as e:  # noqa: BLE001 - fail this request only
             logger.exception("chunked prefill failed for slot %d",
                              st.slot)
+            self._release_claims(st)
             if not st.req.future.done():
                 st.req.future.set_exception(e)
             self._slot_state[st.slot] = None
+            self._sweep_followers(tel)  # a parked follower re-claims
             return
         dt = time.perf_counter() - t0
         st.next_pos = end if s + w >= end else s + w
@@ -1043,8 +1244,13 @@ class GenerationScheduler:
                 "prefill").observe(dt)
         if st.next_pos >= end:
             self._store_prefix(st)
-            pool.activate(st.slot, int(p[-1]), end)
-            st.phase = "decode"
+            self._release_claims(st)
+            if self.role == "prefill":
+                self._complete_prefill_role(st, tel)
+            else:
+                pool.activate(st.slot, int(p[-1]), end)
+                st.phase = "decode"
+            self._sweep_followers(tel)
 
     def _store_prefix(self, st: _ActiveSlot) -> None:
         """After a prompt's prefill completed, extract and cache the

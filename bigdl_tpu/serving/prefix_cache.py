@@ -27,9 +27,21 @@ accelerator memory.  Eviction only drops the *cache's* reference —
 chains already matched by an in-flight admit keep their arrays alive, so
 eviction under byte pressure mid-stream is safe by construction.
 
-Thread-safety: every mutation and read takes ``self._lock``; the engine
-thread is the only writer, but ``stats()`` is served to arbitrary
-threads (``/statusz``, telemetry collectors).
+Thread-safety: every mutation and read takes ``self._lock``; within one
+engine the scheduler thread is the only writer, but the cache may be
+SHARED between engines (the disaggregated prefill/decode split hands
+K/V from a prefill-role engine to a decode-role engine through it) and
+``stats()`` is served to arbitrary threads (``/statusz``, telemetry
+collectors).
+
+Single-flight prefill: a burst of identical cold prompts would prefill
+the same chunks once per request.  The :meth:`claim_prefill` /
+:meth:`prefill_owner` / :meth:`release_prefill` registry lets the first
+requester claim the missing chunk keys as the in-flight LEADER; later
+requests seeing an owned key park as FOLLOWERS until the leader's
+insert lands (or its claim is released on failure), then re-match and
+hit.  The registry is keyed by the same full-prefix chunk keys as the
+entries, so it deduplicates across engines sharing one cache too.
 """
 
 from __future__ import annotations
@@ -84,6 +96,7 @@ class PrefixKVCache:
         self.granularity = int(granularity)
         self._lock = threading.Lock()
         self._entries: "OrderedDict[bytes, PrefixChunk]" = OrderedDict()
+        self._inflight: Dict[bytes, object] = {}
         self._bytes = 0
         self._lookups = 0
         self._hits = 0
@@ -133,6 +146,46 @@ class PrefixKVCache:
             return [i for i in range(1, len(toks) // g + 1)
                     if toks[:i * g].tobytes() not in self._entries]
 
+    def boundary_key(self, tokens: np.ndarray, chunk_index: int) -> bytes:
+        """The cache key of chunk ``chunk_index`` (1-based) of
+        ``tokens`` — the full token prefix up to and including it."""
+        toks = np.ascontiguousarray(np.asarray(tokens, np.int32))
+        return toks[:chunk_index * self.granularity].tobytes()
+
+    # ---- single-flight prefill (in-flight dedup) -------------------------
+
+    def claim_prefill(self, keys: Sequence[bytes], owner) -> List[bytes]:
+        """Register ``owner`` as the in-flight prefiller of every key in
+        ``keys`` that is neither cached nor already claimed; returns the
+        keys actually claimed.  ``owner`` is an opaque identity token —
+        claims are compared by ``is`` and released all at once via
+        :meth:`release_prefill`."""
+        claimed: List[bytes] = []
+        with self._lock:
+            for k in keys:
+                if k in self._entries or k in self._inflight:
+                    continue
+                self._inflight[k] = owner
+                claimed.append(k)
+        return claimed
+
+    def prefill_owner(self, key: bytes) -> Optional[object]:
+        """The in-flight owner of ``key`` (None when nobody is
+        prefilling it) — a request whose next missing chunk has an
+        owner other than itself parks as a dedup follower."""
+        with self._lock:
+            return self._inflight.get(key)
+
+    def release_prefill(self, owner) -> None:
+        """Drop every in-flight claim held by ``owner`` — called when
+        the leader's insert landed (followers now hit) or its prefill
+        failed (a follower re-claims and becomes the new leader).
+        Safe to call when ``owner`` holds nothing."""
+        with self._lock:
+            for k in [k for k, o in self._inflight.items()
+                      if o is owner]:
+                del self._inflight[k]
+
     # ---- insertion / eviction -------------------------------------------
 
     def insert(self, tokens: np.ndarray, chunk_index: int,
@@ -147,6 +200,9 @@ class PrefixKVCache:
         if entry.nbytes > self.byte_budget:
             return None
         with self._lock:
+            # the chunk is resident from here on: any in-flight claim
+            # on it is moot, and followers must see owner None
+            self._inflight.pop(key, None)
             old = self._entries.pop(key, None)
             if old is not None:
                 self._bytes -= old.nbytes
@@ -185,4 +241,5 @@ class PrefixKVCache:
                 "bytes_reused": self._bytes_reused,
                 "inserts": self._inserts,
                 "evictions": self._evictions,
+                "inflight_prefills": len(self._inflight),
             }

@@ -1,0 +1,592 @@
+"""Replica plane of the serving fabric: handles, health snapshots,
+the file-transport registry, and the disaggregated prefill/decode pair.
+
+A :class:`~bigdl_tpu.serving.router.Router` fronts N replicas.  Each
+replica is logically an independent process (the production shape), so
+the fabric's health plane deliberately uses NO collectives: replicas
+drop per-host JSON snapshots through the PR-7 file transport
+(:func:`~bigdl_tpu.telemetry.fleet.write_host_snapshot`) and the
+:class:`ReplicaRegistry` reads them back, treating a STALE snapshot
+(the replica stopped reporting) or a CORRUPT one (it wrote garbage) as
+an unhealthy replica — exactly the judgement a load balancer makes
+from a failed health check.  The same files feed
+:func:`~bigdl_tpu.telemetry.fleet.merge_host_snapshots`, so the PR-7
+straggler detection runs over a replica fleet unchanged
+(:meth:`ReplicaRegistry.fleet`).
+
+Three layers here:
+
+* :class:`Replica` — wraps an in-process serving target (a
+  :class:`~bigdl_tpu.serving.server.ModelServer`, a bare
+  :class:`~bigdl_tpu.serving.generation.GenerationScheduler`, or a
+  :class:`DisaggregatedEngine`) with an id, a role, a drain flag, and
+  a self-publishing snapshot thread — the in-process stand-in for a
+  replica process, publishing through the same transport a real one
+  would.
+* :class:`ReplicaRegistry` — the router's read side: per-replica
+  health records derived from the snapshot files plus any consumed
+  ``/healthz`` verdicts (a 503 ``{"status": "draining"}`` from
+  ``examples/serve.py`` marks the record draining).
+* :class:`DisaggregatedEngine` — the DistServe/Splitwise-style split:
+  a PREFILL-role engine computes prompt K/V and publishes it through a
+  shared :class:`~bigdl_tpu.serving.prefix_cache.PrefixKVCache`; the
+  DECODE-role engine admits a request only once its full prefix is
+  cache-resident, so decode slots never burn iterations hosting long
+  prefills.  Greedy rows stay bit-identical to the single-engine path
+  (and to solo ``generate()``): the decode engine still prefills any
+  sub-granule tail — or anything evicted in between — itself.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+from bigdl_tpu.serving.generation import GenerationScheduler
+from bigdl_tpu.serving.prefix_cache import PrefixKVCache
+from bigdl_tpu.telemetry.fleet import (
+    host_stats, merge_host_snapshots, read_host_snapshots,
+    remove_host_snapshot, write_host_snapshot,
+)
+
+__all__ = ["Replica", "ReplicaRegistry", "DisaggregatedEngine",
+           "replica_snapshot", "SnapshotPublisher", "scrape_healthz"]
+
+logger = logging.getLogger(__name__)
+
+ROLES = ("mixed", "prefill", "decode")
+
+
+def _target_stats(target) -> Dict[str, Any]:
+    """Generation-engine stats from any supported target shape."""
+    if hasattr(target, "generation_stats"):        # ModelServer
+        return target.generation_stats() or {}
+    if hasattr(target, "stats"):                   # engine / pair
+        return target.stats() or {}
+    return {}
+
+
+def _target_queue_depth(target) -> int:
+    if hasattr(target, "generation_queue_depth"):  # ModelServer
+        return int(target.generation_queue_depth())
+    if hasattr(target, "queue_depth"):
+        return int(target.queue_depth())
+    return 0
+
+
+def replica_snapshot(replica_id: int, target=None, name: str = "",
+                     role: str = "mixed", draining: bool = False,
+                     healthy: bool = True) -> Dict[str, Any]:
+    """One replica's health snapshot: the fleet ``host_stats`` vector
+    (so :func:`merge_host_snapshots` derives a straggler table from
+    the very same files) extended with the serving-plane fields the
+    router routes on.  ``target`` is optional — a replica with no
+    generation engine yet still reports health and drain state."""
+    stats = _target_stats(target) if target is not None else {}
+    steps = int(stats.get("decode_steps", 0) or 0)
+    snap = host_stats(
+        step_wall_s=float(stats.get("decode_seconds", 0.0) or 0.0),
+        data_wait_s=float(stats.get("prefill_seconds", 0.0) or 0.0),
+        iterations=max(steps, 1), process=int(replica_id))
+    snap.update({
+        "name": name or f"replica-{int(replica_id)}",
+        "role": role,
+        "healthy": bool(healthy),
+        "draining": bool(draining),
+        "queue_depth": _target_queue_depth(target)
+        if target is not None else 0,
+        "slots": int(stats.get("slots", 0) or 0),
+        "slot_occupancy_mean": float(
+            stats.get("slot_occupancy_mean", 0.0) or 0.0),
+        "admitted_outstanding": int(
+            target.admitted_outstanding())
+        if target is not None and hasattr(target, "admitted_outstanding")
+        else 0,
+        "ttft_p99_s": float(
+            stats.get("queue_to_first_token_s_p99", 0.0) or 0.0),
+        "inter_token_p99_s": float(
+            stats.get("inter_token_s_p99", 0.0) or 0.0),
+        "requests_done": int(stats.get("requests_done", 0) or 0),
+        "tokens_emitted": int(stats.get("tokens_emitted", 0) or 0),
+    })
+    return snap
+
+
+class SnapshotPublisher:
+    """Periodically invoke ``publish`` (a zero-arg callable writing one
+    snapshot) from a daemon thread.  ``publish_now()`` forces an
+    immediate write from the caller's thread — state flips (drain!)
+    must land in the file before the caller proceeds, not an interval
+    later.  Daemon AND joined on ``stop()`` (the exporter pattern)."""
+
+    def __init__(self, publish: Callable[[], Any],
+                 interval_s: float = 0.25, start: bool = True):
+        self._publish = publish
+        self.interval_s = float(interval_s)
+        self._stop_evt = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="bigdl-replica-snapshot", daemon=True)
+        if start:
+            self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop_evt.wait(self.interval_s):
+            self.publish_now()
+
+    def start(self) -> "SnapshotPublisher":
+        self._thread.start()
+        return self
+
+    def publish_now(self) -> None:
+        try:
+            self._publish()
+        except Exception:  # pragma: no cover - transport best effort
+            logger.exception("replica snapshot publish failed")
+
+    def stop(self, final_publish: bool = True,
+             timeout: float = 5.0) -> None:
+        self._stop_evt.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout)
+        if final_publish:
+            self.publish_now()
+
+
+class Replica:
+    """One serving replica as the router sees it: a target that can
+    generate, an integer id (the snapshot-file key), a role, and a
+    drain flag.  Publishes its own health snapshot on an interval like
+    the independent process it stands in for — the router learns about
+    it ONLY through the registry, so killing the publisher makes the
+    replica go stale-unhealthy exactly like a hung process would."""
+
+    def __init__(self, replica_id: int, target, name: Optional[str] = None,
+                 role: str = "mixed", snapshot_dir: Optional[str] = None,
+                 publish_interval_s: float = 0.25):
+        if role not in ROLES:
+            raise ValueError(f"role must be one of {ROLES}, got {role!r}")
+        for attr in ("submit_generate_async", "shutdown"):
+            if not hasattr(target, attr):
+                raise TypeError(
+                    f"replica target needs {attr!r}: got "
+                    f"{type(target).__name__} (pass a ModelServer, "
+                    f"GenerationScheduler, or DisaggregatedEngine)")
+        self.id = int(replica_id)
+        self.name = name or f"replica-{self.id}"
+        self.role = role
+        self.target = target
+        self.snapshot_dir = snapshot_dir
+        self._lock = threading.Lock()
+        self._draining = False
+        self._closed = False
+        self.publish_interval_s = float(publish_interval_s)
+        self._publisher: Optional[SnapshotPublisher] = None
+        if snapshot_dir is not None:
+            self._start_publisher()
+
+    def _start_publisher(self) -> None:
+        self._publisher = SnapshotPublisher(
+            self.publish, interval_s=self.publish_interval_s,
+            start=False)
+        self.publish()              # visible to the registry at birth
+        self._publisher.start()
+
+    def attach_snapshot_dir(self, directory: str) -> None:
+        """Point this replica's health publishing at ``directory`` and
+        START the interval publisher if it was constructed without one
+        — a replica the router adopts must keep reporting, or the
+        registry marks it stale-unhealthy ``max_age_s`` later and the
+        fleet silently goes unroutable."""
+        self.snapshot_dir = directory
+        if self._publisher is None:
+            self._start_publisher()
+        else:
+            self.publish()
+
+    # ---- serving plane ---------------------------------------------------
+
+    @property
+    def slots(self) -> int:
+        st = _target_stats(self.target)
+        return int(st.get("slots", 0) or 0) or 8
+
+    def submit_generate_async(self, prompt, max_new_tokens: int,
+                              eos_id=None, on_token=None,
+                              timeout: Optional[float] = None) -> Future:
+        return self.target.submit_generate_async(
+            prompt, max_new_tokens, eos_id=eos_id, on_token=on_token,
+            timeout=timeout)
+
+    def admitted_outstanding(self) -> int:
+        return int(self.target.admitted_outstanding()) \
+            if hasattr(self.target, "admitted_outstanding") else 0
+
+    def stats(self) -> Dict[str, Any]:
+        return _target_stats(self.target)
+
+    # ---- health plane ----------------------------------------------------
+
+    @property
+    def draining(self) -> bool:
+        with self._lock:
+            return self._draining
+
+    def start_drain(self) -> None:
+        """Flip to draining and publish IMMEDIATELY: the router's next
+        registry poll must see it before routing another session
+        here."""
+        with self._lock:
+            self._draining = True
+        self.publish()
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            draining = self._draining
+            closed = self._closed
+        return replica_snapshot(
+            self.id, self.target, name=self.name, role=self.role,
+            draining=draining, healthy=not closed)
+
+    def publish(self) -> None:
+        if self.snapshot_dir is not None:
+            write_host_snapshot(self.snapshot_dir, self.snapshot())
+
+    # ---- lifecycle -------------------------------------------------------
+
+    def close(self, drain: bool = True,
+              timeout: Optional[float] = 30.0) -> None:
+        """Stop publishing, drain the target (default), and remove this
+        replica's snapshot file so the registry forgets it instead of
+        reporting a stale ghost."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        if self._publisher is not None:
+            self._publisher.stop(final_publish=False)
+        self.target.shutdown(drain=drain, timeout=timeout)
+        if self.snapshot_dir is not None:
+            remove_host_snapshot(self.snapshot_dir, self.id)
+
+    def __enter__(self) -> "Replica":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def scrape_healthz(host: str, port: int,
+                   timeout: float = 2.0) -> tuple:
+    """GET ``/healthz`` from a replica's HTTP frontend
+    (``examples/serve.py``) and return ``(status_code, body_dict)`` —
+    feed the result to :meth:`ReplicaRegistry.observe_healthz`."""
+    import http.client
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        conn.request("GET", "/healthz")
+        resp = conn.getresponse()
+        raw = resp.read()
+        try:
+            body = json.loads(raw.decode("utf-8"))
+        except Exception:
+            body = {}
+        return resp.status, body
+    finally:
+        conn.close()
+
+
+class ReplicaRegistry:
+    """The router's view of the fleet, derived from the snapshot files
+    (plus consumed ``/healthz`` verdicts).  Per replica id the record
+    carries::
+
+        healthy     False for stale or corrupt snapshots (and for a
+                    snapshot that says so itself)
+        reason      None | "stale" | "corrupt"
+        draining    the snapshot flag OR a consumed 503 healthz
+        queue_depth / slots / slot_occupancy_mean / ttft_p99_s /
+        admitted_outstanding / role / name / age_s
+
+    The registry never guesses: a replica with no snapshot at all has
+    no record and is simply not routable."""
+
+    def __init__(self, directory: str, max_age_s: float = 2.0):
+        self.directory = directory
+        self.max_age_s = float(max_age_s)
+        self._lock = threading.Lock()
+        self._healthz: Dict[int, Dict[str, Any]] = {}
+
+    def observe_healthz(self, replica_id: int, status_code: int,
+                        body: Optional[Dict] = None) -> None:
+        """Consume one ``/healthz`` probe result.  A 503 (the
+        ``examples/serve.py`` drain contract answers ``{"status":
+        "draining"}``) marks the replica draining; any non-200,
+        non-503 answer marks it unhealthy; a 200 clears both."""
+        code = int(status_code)
+        verdict = {
+            "code": code,
+            "draining": code == 503,
+            "healthy": code in (200, 503),
+            "status": (body or {}).get("status"),
+        }
+        with self._lock:
+            self._healthz[int(replica_id)] = verdict
+
+    def poll(self) -> Dict[int, Dict[str, Any]]:
+        """Fresh per-replica records from whatever is on disk."""
+        rows = read_host_snapshots(self.directory)
+        now = time.time()
+        with self._lock:
+            healthz = dict(self._healthz)
+        records: Dict[int, Dict[str, Any]] = {}
+        for pid, row in rows.items():
+            if row is None:
+                records[pid] = {
+                    "id": pid, "healthy": False, "reason": "corrupt",
+                    "draining": False, "age_s": None,
+                }
+                continue
+            # graftlint: disable=clock-discipline -- staleness vs
+            # ANOTHER process's epoch stamp: perf_counter is not
+            # comparable across processes, the wall clock is the only
+            # shared one (same exemption as merge_host_snapshots)
+            age_s = max(now - float(row.get("time", 0.0)), 0.0)
+            stale = age_s > self.max_age_s
+            rec = {
+                "id": pid,
+                "name": row.get("name", f"replica-{pid}"),
+                "role": row.get("role", "mixed"),
+                "healthy": bool(row.get("healthy", True)) and not stale,
+                "reason": "stale" if stale else None,
+                "draining": bool(row.get("draining", False)),
+                "age_s": age_s,
+                "queue_depth": int(row.get("queue_depth", 0) or 0),
+                "slots": int(row.get("slots", 0) or 0),
+                "slot_occupancy_mean": float(
+                    row.get("slot_occupancy_mean", 0.0) or 0.0),
+                "admitted_outstanding": int(
+                    row.get("admitted_outstanding", 0) or 0),
+                "ttft_p99_s": float(row.get("ttft_p99_s", 0.0) or 0.0),
+                "requests_done": int(row.get("requests_done", 0) or 0),
+            }
+            hz = healthz.get(pid)
+            if hz is not None:
+                if hz["draining"]:
+                    rec["draining"] = True
+                if not hz["healthy"]:
+                    rec["healthy"] = False
+                    rec["reason"] = rec["reason"] or "healthz"
+            records[pid] = rec
+        return records
+
+    def fleet(self) -> Optional[Dict[str, Any]]:
+        """The PR-7 fleet table (straggler skews and all) over the
+        replica snapshots — same files, same derivation; a replica
+        whose per-step decode wall is 2x its peers' is named
+        ``slowest_process`` here exactly like a training host."""
+        return merge_host_snapshots(self.directory,
+                                    max_age_s=self.max_age_s)
+
+    def forget(self, replica_id: int) -> None:
+        """Drop everything the registry knows about a departed
+        replica: its consumed healthz verdict AND its snapshot file
+        (idempotent with the replica's own close-time cleanup)."""
+        with self._lock:
+            self._healthz.pop(int(replica_id), None)
+        remove_host_snapshot(self.directory, int(replica_id))
+
+
+class DisaggregatedEngine:
+    """Prefill/decode disaggregation over two engines and one shared
+    prefix cache.  ``submit_generate_async`` first sends the prompt to
+    the PREFILL-role engine (which publishes its K/V through the
+    cache), and only once the full granularity-aligned prefix is
+    cache-resident admits it to the DECODE-role engine — whose
+    admission-time prefix match then copies the whole chain and goes
+    straight to decode.  PR-12's single-engine chunking time-sliced
+    prefill against decode on ONE set of slots; this is the true
+    two-engine split (DistServe / Splitwise): decode slots only ever
+    host decode-ready work.
+
+    Correctness: the decode engine re-prefills anything not actually
+    resident at admit (sub-granule tails always; evicted chunks under
+    LRU pressure rarely), so greedy rows are bit-identical to the
+    single-engine path and to solo ``generate()`` regardless of cache
+    state.  An eviction between publish and admit is retried through
+    the prefill engine ``max_prefill_retries`` times before being
+    handed to decode as-is."""
+
+    def __init__(self, model, decode_slots: int = 8,
+                 prefill_slots: int = 4,
+                 prefix_cache_bytes: int = 1 << 26,
+                 prefix_granularity: int = 32,
+                 prefill_chunk: int = 64,
+                 queue_capacity: Optional[int] = None,
+                 eos_id=None, dtype=None,
+                 max_prefill_retries: int = 2):
+        self.cache = PrefixKVCache(int(prefix_cache_bytes),
+                                   int(prefix_granularity))
+        self.prefill = GenerationScheduler(
+            model, slots=prefill_slots, role="prefill",
+            prefix_cache=self.cache, prefill_chunk=prefill_chunk,
+            queue_capacity=queue_capacity, eos_id=eos_id, dtype=dtype)
+        self.decode = GenerationScheduler(
+            model, slots=decode_slots, prefix_cache=self.cache,
+            prefill_chunk=prefill_chunk,
+            queue_capacity=queue_capacity, eos_id=eos_id, dtype=dtype)
+        self.max_prefill_retries = int(max_prefill_retries)
+        self._lock = threading.Lock()
+        self._outstanding = 0
+        self._handoffs = 0
+        self._prefill_retries = 0
+        self._shutdown = False
+
+    # ---- submission ------------------------------------------------------
+
+    def submit_generate_async(self, prompt, max_new_tokens: int,
+                              eos_id=None, on_token=None,
+                              timeout: Optional[float] = None) -> Future:
+        with self._lock:
+            if self._shutdown:
+                from bigdl_tpu.serving.admission import ServerClosedError
+                raise ServerClosedError("engine is shut down")
+            self._outstanding += 1
+        outer: Future = Future()
+        outer.add_done_callback(self._dec_outstanding)
+        p = np.asarray(prompt, np.int32).reshape(-1)
+        try:
+            region_len = max(len(p) - 1, 0)
+            if region_len < self.cache.granularity:
+                # nothing the prefill tier could publish: the decode
+                # engine's own (bounded, sub-granule) prefill is the
+                # whole cost — skip the hop
+                self._to_decode(outer, p, max_new_tokens, eos_id,
+                                on_token, timeout)
+            else:
+                pf = self.prefill.submit_async(p, 0, timeout=timeout)
+                pf.add_done_callback(
+                    lambda f: self._after_prefill(
+                        f, outer, p, max_new_tokens, eos_id, on_token,
+                        self.max_prefill_retries))
+        except BaseException:
+            # the done-callback never fires for a future that was
+            # never resolved — rebalance the count before re-raising
+            if not outer.done():
+                with self._lock:
+                    self._outstanding -= 1
+            raise
+        return outer
+
+    submit_async = submit_generate_async
+
+    def submit_generate(self, prompt, max_new_tokens: int, eos_id=None,
+                        timeout: Optional[float] = None):
+        return self.submit_generate_async(
+            prompt, max_new_tokens, eos_id=eos_id,
+            timeout=timeout).result(timeout)
+
+    def _dec_outstanding(self, _fut) -> None:
+        with self._lock:
+            self._outstanding -= 1
+
+    def _after_prefill(self, pf: Future, outer: Future, prompt,
+                       max_new_tokens, eos_id, on_token,
+                       retries: int) -> None:
+        if outer.cancelled():
+            return
+        region = prompt[:len(prompt) - 1]
+        exc = None if pf.cancelled() else pf.exception()
+        if exc is None and self.cache.missing_boundaries(region) \
+                and retries > 0:
+            # evicted between the publish and this admit (LRU
+            # pressure): one more pass through the prefill tier
+            with self._lock:
+                self._prefill_retries += 1
+            try:
+                # timeout=0: this callback runs ON the prefill engine
+                # thread — a blocking put against the engine's own
+                # full queue would deadlock it (the only consumer is
+                # the thread that would be waiting)
+                nf = self.prefill.submit_async(prompt, 0, timeout=0)
+                nf.add_done_callback(
+                    lambda f: self._after_prefill(
+                        f, outer, prompt, max_new_tokens, eos_id,
+                        on_token, retries - 1))
+                return
+            except Exception:  # noqa: BLE001 - fall through to decode
+                pass
+        # prefill failed, retries exhausted, or the prefix is resident:
+        # decode serves it either way (it re-prefills anything missing
+        # itself — bit-identity never depends on the cache)
+        self._to_decode(outer, prompt, max_new_tokens, eos_id,
+                        on_token, 0)
+
+    def _to_decode(self, outer: Future, prompt, max_new_tokens,
+                   eos_id, on_token, timeout) -> None:
+        """Hand one request to the decode engine.  ``timeout`` is the
+        submitter's admission timeout on the direct (sub-granule)
+        path; the prefill-completion path passes 0 — that callback
+        runs on the prefill engine thread, and blocking it against a
+        full decode queue would stall (or cross-deadlock) the whole
+        prefill tier, so a saturated decode tier answers with the
+        typed QueueFullError instead."""
+        with self._lock:
+            self._handoffs += 1
+        try:
+            df = self.decode.submit_async(
+                prompt, max_new_tokens, eos_id=eos_id,
+                on_token=on_token, timeout=timeout)
+        except Exception as e:  # noqa: BLE001 - typed admission errors
+            # (queue full, closed) land on the caller's future
+            if outer.set_running_or_notify_cancel():
+                outer.set_exception(e)
+            return
+        df.add_done_callback(lambda f: self._chain(f, outer))
+
+    @staticmethod
+    def _chain(inner: Future, outer: Future) -> None:
+        if not outer.set_running_or_notify_cancel():
+            return      # the caller cancelled the outer future
+        try:
+            outer.set_result(inner.result())
+        except BaseException as e:  # noqa: BLE001 - inner exception or
+            # CancelledError, either way the outer future carries it
+            outer.set_exception(e)
+
+    # ---- observability / lifecycle ---------------------------------------
+
+    def queue_depth(self) -> int:
+        return self.prefill.queue_depth() + self.decode.queue_depth()
+
+    def admitted_outstanding(self) -> int:
+        with self._lock:
+            return self._outstanding
+
+    def stats(self) -> Dict[str, Any]:
+        out = dict(self.decode.stats())
+        with self._lock:
+            out.update({
+                "disaggregated": True,
+                "handoffs": self._handoffs,
+                "prefill_engine_retries": self._prefill_retries,
+                "admitted_outstanding": self._outstanding,
+            })
+        out["prefill_engine"] = self.prefill.stats()
+        return out
+
+    def shutdown(self, drain: bool = True,
+                 timeout: Optional[float] = 30.0) -> None:
+        with self._lock:
+            if self._shutdown:
+                return
+            self._shutdown = True
+        # prefill first: its completions hand work to decode, and the
+        # decode engine must still be admitting while they land
+        self.prefill.shutdown(drain=drain, timeout=timeout)
+        self.decode.shutdown(drain=drain, timeout=timeout)

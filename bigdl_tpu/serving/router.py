@@ -1,0 +1,688 @@
+"""The serving-fabric router: session-affine, SLO-aware dispatch over
+N replicas, with drain/deploy that drops nothing it admitted.
+
+PR 10/12 built a generation ENGINE; this is the tier above it — the
+layer "heavy traffic from millions of users" actually hits.  One
+:class:`Router` fronts N :class:`~bigdl_tpu.serving.replica.Replica`
+handles and decides, per request:
+
+* **Session affinity** (consistent hashing): a request carrying a
+  ``session`` key prefers the replica its key hashes to on a
+  :class:`HashRing` — the replica holding that session's warm
+  ``PrefixKVCache`` entries — with a BOUNDED-LOAD fallback: when the
+  affine replica's in-flight count exceeds its load bound, the request
+  walks the ring to the next replica instead of wedging the hot one
+  (consistent hashing with bounded loads; one viral session key must
+  not melt a single replica while its peers idle).
+* **Health**: eligibility comes from the
+  :class:`~bigdl_tpu.serving.replica.ReplicaRegistry` — the file-
+  transport health plane.  A replica whose snapshot went stale or
+  corrupt is unhealthy and receives nothing; no collectives anywhere.
+* **SLO-aware shedding**: a replica whose reported TTFT p99 breaches
+  ``slo_ttft_p99_s`` stops receiving NON-affine work (affine sessions
+  may still ride their warm cache).  When nothing eligible remains,
+  queued requests are shed — oldest first, with a TYPED rejection
+  (:class:`~bigdl_tpu.serving.admission.RequestSheddedError`) —
+  *before* the breach propagates into every queued request's latency:
+  a fast typed "no" beats a slow timeout.
+* **Admission budgets**: per-model in-flight caps
+  (``admission_budgets``), so one model's burst cannot starve the
+  rest of the fleet.
+* **Drain/deploy**: :meth:`drain` reroutes new work away from a
+  replica while its admitted requests finish (the PR-2/PR-10 drain
+  machinery); :meth:`deploy` swaps a replacement in and asserts the
+  ZERO-DROP invariant directly — the old replica's
+  ``admitted_outstanding()`` must reach 0 before it is removed.
+
+Observability: ``router_requests_total{outcome}``,
+``router_replica_inflight{replica}``, ``router_shed_total{reason}``
+(preregistered, linted), plus flight-recorder events ``replica_join``
+/ ``replica_drain`` / ``router_shed`` so a shed storm is visible in
+the PR-4 black box.
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+import logging
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from bigdl_tpu import telemetry
+from bigdl_tpu.serving.admission import (
+    BoundedRequestQueue, QueueFullError, RequestSheddedError,
+    ServerClosedError,
+)
+from bigdl_tpu.serving.replica import Replica, ReplicaRegistry
+from bigdl_tpu.telemetry import events as _events
+
+__all__ = ["Router", "HashRing", "RouterRequest",
+           "NoReplicaAvailableError"]
+
+logger = logging.getLogger(__name__)
+
+
+class NoReplicaAvailableError(RuntimeError):
+    """Typed rejection: no healthy, non-draining replica could take
+    the request before its shed deadline."""
+
+
+def _hash64(data: bytes) -> int:
+    # md5 for DISTRIBUTION, not security: stable across processes and
+    # python versions (hash() is salted per process — a restart would
+    # reshuffle every session)
+    return int.from_bytes(hashlib.md5(data).digest()[:8], "big")
+
+
+class HashRing:
+    """Consistent-hash ring over replica ids with virtual nodes.
+    ``preference(key)`` returns every registered replica ordered by
+    ring distance from the key — element 0 is the affine home; the
+    rest are the deterministic bounded-load walk order.  Adding or
+    removing a replica only remaps the keys that hashed to its arcs
+    (the point of consistent hashing: a deploy must not cold-start
+    every session's prefix cache, only the moved ones)."""
+
+    def __init__(self, vnodes: int = 64):
+        if vnodes < 1:
+            raise ValueError(f"vnodes must be >= 1, got {vnodes}")
+        self.vnodes = int(vnodes)
+        self._hashes: List[int] = []        # sorted vnode hashes
+        self._owners: List[int] = []        # replica id per vnode
+        self._ids: List[int] = []
+
+    def add(self, replica_id: int) -> None:
+        rid = int(replica_id)
+        if rid in self._ids:
+            raise ValueError(f"replica {rid} already on the ring")
+        self._ids.append(rid)
+        for v in range(self.vnodes):
+            h = _hash64(f"{rid}:{v}".encode())
+            i = bisect.bisect_left(self._hashes, h)
+            self._hashes.insert(i, h)
+            self._owners.insert(i, rid)
+
+    def remove(self, replica_id: int) -> None:
+        rid = int(replica_id)
+        if rid not in self._ids:
+            raise KeyError(f"replica {rid} not on the ring")
+        self._ids.remove(rid)
+        keep = [(h, o) for h, o in zip(self._hashes, self._owners)
+                if o != rid]
+        self._hashes = [h for h, _ in keep]
+        self._owners = [o for _, o in keep]
+
+    def ids(self) -> List[int]:
+        return list(self._ids)
+
+    def __len__(self) -> int:
+        return len(self._ids)
+
+    def preference(self, key: str) -> List[int]:
+        """Replica ids ordered by ring distance from ``key`` (each id
+        once, at its closest vnode).  Deterministic for a given
+        membership — the same session key always walks the same
+        order."""
+        if not self._ids:
+            return []
+        h = _hash64(str(key).encode())
+        start = bisect.bisect_left(self._hashes, h)
+        out: List[int] = []
+        seen = set()
+        n = len(self._hashes)
+        for step in range(n):
+            rid = self._owners[(start + step) % n]
+            if rid not in seen:
+                seen.add(rid)
+                out.append(rid)
+                if len(out) == len(self._ids):
+                    break
+        return out
+
+
+class RouterRequest:
+    """One routed generation request.  Duck-types
+    :class:`~bigdl_tpu.serving.admission.Request` (``future``,
+    ``t_enqueue``) so the bounded queue's shed machinery applies."""
+
+    __slots__ = ("prompt", "max_new_tokens", "eos_id", "on_token",
+                 "session", "model", "future", "t_enqueue",
+                 "affinity_counted")
+
+    def __init__(self, prompt, max_new_tokens: int, eos_id=None,
+                 on_token=None, session: Optional[str] = None,
+                 model: str = "default"):
+        self.prompt = np.asarray(prompt, np.int32).reshape(-1)
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos_id = eos_id
+        self.on_token = on_token
+        self.session = None if session is None else str(session)
+        self.model = str(model)
+        self.future: Future = Future()
+        self.t_enqueue = time.perf_counter()
+        self.affinity_counted = False
+
+
+class Router:
+    """Session-affine, SLO-aware router over in-process replicas.
+
+    >>> router = Router(replicas=[r0, r1, r2], snapshot_dir=d,
+    ...                 slo_ttft_p99_s=0.5)
+    >>> fut = router.submit_generate_async(prompt, 16, session="user-7")
+    >>> fut.result()
+    >>> router.drain(r1.id)                    # reroute new sessions
+    >>> router.deploy(r3, replaces=r1.id)      # zero-drop swap
+    >>> router.shutdown()
+    """
+
+    def __init__(self, replicas=(), snapshot_dir: Optional[str] = None,
+                 registry: Optional[ReplicaRegistry] = None,
+                 queue_capacity: int = 256,
+                 slo_ttft_p99_s: Optional[float] = None,
+                 bounded_load_factor: float = 2.0,
+                 admission_budgets: Optional[Dict[str, int]] = None,
+                 shed_after_s: Optional[float] = None,
+                 poll_interval_s: float = 0.05,
+                 registry_max_age_s: float = 2.0,
+                 vnodes: int = 64, start: bool = True):
+        if registry is not None:
+            self.registry = registry
+        else:
+            if snapshot_dir is None:
+                import tempfile
+                self._tmpdir = tempfile.TemporaryDirectory(
+                    prefix="bigdl-fabric-")
+                snapshot_dir = self._tmpdir.name
+            self.registry = ReplicaRegistry(
+                snapshot_dir, max_age_s=registry_max_age_s)
+        self.snapshot_dir = self.registry.directory
+        self.slo_ttft_p99_s = (None if slo_ttft_p99_s is None
+                               else float(slo_ttft_p99_s))
+        if bounded_load_factor < 1.0:
+            raise ValueError("bounded_load_factor must be >= 1.0, got "
+                             f"{bounded_load_factor}")
+        self.bounded_load_factor = float(bounded_load_factor)
+        self.admission_budgets = dict(admission_budgets or {})
+        # the shed deadline defaults to the SLO itself: a request that
+        # already waited one full TTFT budget unrouted would breach
+        # anyway — reject it typed instead of letting it time out
+        self.shed_after_s = float(
+            shed_after_s if shed_after_s is not None
+            else (slo_ttft_p99_s if slo_ttft_p99_s is not None else 5.0))
+        self._poll_s = float(poll_interval_s)
+        self._queue = BoundedRequestQueue(
+            queue_capacity, policy="shed_oldest",
+            on_shed=self._on_queue_shed)
+        self._lock = threading.Lock()
+        self._replicas: Dict[int, Replica] = {}
+        self._ring = HashRing(vnodes=vnodes)
+        self._inflight: Dict[int, int] = {}
+        self._model_inflight: Dict[str, int] = {}
+        self._records: Dict[int, Dict[str, Any]] = {}
+        self._submitted = 0
+        self._dispatched = 0
+        self._outcomes: Dict[str, int] = {}
+        self._shed_reasons: Dict[str, int] = {}
+        self._affine_total = 0
+        self._affine_hits = 0
+        self._shutdown = False
+        # router-thread-only state (never touched under the lock):
+        # undispatchable requests PARK here so the queue keeps
+        # draining — one budget-exhausted model's head must not
+        # head-of-line-block every other model's traffic
+        self._waiting: "deque[RouterRequest]" = deque()
+        self._last_poll = 0.0
+        for r in replicas:
+            self.add_replica(r)
+        self._thread: Optional[threading.Thread] = None
+        if start:
+            self.start()
+
+    # ---- membership ------------------------------------------------------
+
+    def add_replica(self, replica: Replica) -> None:
+        if replica.snapshot_dir is None:
+            # the health plane IS the registry: adopt the replica into
+            # this fabric's snapshot dir AND start its interval
+            # publisher — a single adoption-time publish would go
+            # stale max_age_s later and silently unroute the replica
+            replica.attach_snapshot_dir(self.snapshot_dir)
+        with self._lock:
+            if replica.id in self._replicas:
+                raise ValueError(
+                    f"replica id {replica.id} already registered")
+            self._replicas[replica.id] = replica
+            self._ring.add(replica.id)
+            self._inflight.setdefault(replica.id, 0)
+        replica.publish()
+        _events.record_event("replica_join", replica=replica.id,
+                             name=replica.name, role=replica.role)
+        self._refresh(force=True)
+
+    def drain(self, replica_id: int) -> None:
+        """Mark a replica draining: new work (sessions included)
+        reroutes immediately; its already-admitted requests finish
+        through the engine drain machinery."""
+        with self._lock:
+            replica = self._replicas[int(replica_id)]
+        replica.start_drain()
+        _events.record_event("replica_drain", replica=replica.id,
+                             name=replica.name,
+                             outstanding=replica.admitted_outstanding())
+        self._refresh(force=True)
+
+    def remove_replica(self, replica_id: int, drain: bool = True,
+                       timeout: Optional[float] = 30.0) -> None:
+        with self._lock:
+            replica = self._replicas.pop(int(replica_id))
+            self._ring.remove(replica.id)
+            self._inflight.pop(replica.id, None)
+        replica.close(drain=drain, timeout=timeout)
+        self.registry.forget(replica.id)
+        self._refresh(force=True)
+
+    def deploy(self, new_replica: Replica, replaces: int,
+               timeout: float = 60.0) -> Dict[str, Any]:
+        """Zero-drop replica swap: add ``new_replica``, drain the old
+        one, WAIT until its ``admitted_outstanding()`` is exactly 0 —
+        the invariant asserted, not inferred from counters — then
+        remove it.  Raises TimeoutError (old replica left draining,
+        nothing dropped) if the drain does not complete in time."""
+        with self._lock:
+            old = self._replicas[int(replaces)]
+        self.add_replica(new_replica)
+        self.drain(replaces)
+        deadline = time.perf_counter() + float(timeout)
+        while True:
+            outstanding = old.admitted_outstanding()
+            if outstanding == 0:
+                break
+            if time.perf_counter() > deadline:
+                raise TimeoutError(
+                    f"replica {replaces} still has {outstanding} "
+                    f"admitted request(s) after {timeout}s; it stays "
+                    f"draining — nothing was dropped")
+            time.sleep(0.01)
+        self.remove_replica(replaces, drain=True)
+        return {"replaced": int(replaces), "added": new_replica.id,
+                "outstanding_at_removal": 0}
+
+    # ---- submission ------------------------------------------------------
+
+    def submit_generate_async(self, prompt, max_new_tokens: int,
+                              eos_id=None, session: Optional[str] = None,
+                              model: str = "default", on_token=None,
+                              timeout: Optional[float] = None) -> Future:
+        """Admit one generation request into the fabric.  ``session``
+        keys affinity (same key → same warm replica while it stays
+        eligible); ``model`` keys the admission budgets.  The future
+        fails with a TYPED error on overload: RequestSheddedError
+        (shed while queued), NoReplicaAvailableError (nothing eligible
+        before the shed deadline), ServerClosedError (shutdown)."""
+        with self._lock:
+            if self._shutdown:
+                raise ServerClosedError("router is shut down")
+            self._submitted += 1
+        req = RouterRequest(prompt, max_new_tokens, eos_id=eos_id,
+                            on_token=on_token, session=session,
+                            model=model)
+        req.future.add_done_callback(self._on_terminal)
+        self._queue.put(req, timeout=timeout)
+        return req.future
+
+    def submit_generate(self, prompt, max_new_tokens: int, eos_id=None,
+                        session: Optional[str] = None,
+                        model: str = "default",
+                        timeout: Optional[float] = None):
+        deadline = (None if timeout is None
+                    else time.perf_counter() + timeout)
+        fut = self.submit_generate_async(
+            prompt, max_new_tokens, eos_id=eos_id, session=session,
+            model=model, timeout=timeout)
+        remaining = (None if deadline is None
+                     else max(deadline - time.perf_counter(), 0.0))
+        return fut.result(remaining)
+
+    # ---- lifecycle -------------------------------------------------------
+
+    def start(self) -> "Router":
+        if self._thread is not None:
+            raise RuntimeError("router already started")
+        self._thread = threading.Thread(
+            target=self._run, name="bigdl-serving-router", daemon=True)
+        self._thread.start()
+        return self
+
+    def shutdown(self, drain: bool = True,
+                 timeout: Optional[float] = 30.0,
+                 close_replicas: bool = True) -> None:
+        """Stop admitting.  With ``drain`` every queued request is
+        still routed and served; the replicas then drain their own
+        admitted work (closed here too unless ``close_replicas`` is
+        False)."""
+        with self._lock:
+            if self._shutdown:
+                return
+            self._shutdown = True
+            replicas = list(self._replicas.values())
+        self._queue.close(discard=not drain)
+        if self._thread is not None:
+            self._thread.join(timeout)
+            if self._thread.is_alive():
+                logger.warning("router did not drain within %ss", timeout)
+        if close_replicas:
+            for r in replicas:
+                r.close(drain=drain, timeout=timeout)
+
+    def __enter__(self) -> "Router":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()
+
+    # ---- the routing loop ------------------------------------------------
+
+    def _run(self) -> None:
+        while True:
+            self._retry_waiting()
+            req = self._queue.get(timeout=self._poll_s)
+            self._refresh()
+            if req is None:
+                if self._queue.closed and len(self._queue) == 0:
+                    if not self._waiting:
+                        return
+                    # a closed drained queue returns None instantly:
+                    # pace the waiting-list retries instead of
+                    # busy-spinning until their shed deadlines
+                    time.sleep(self._poll_s)
+                continue
+            if req.future.cancelled():
+                continue
+            if not self._route(req):
+                self._waiting.append(req)
+
+    def _retry_waiting(self) -> None:
+        """Re-attempt every parked request once (newly freed capacity,
+        fresher registry, expired shed deadlines), keeping FIFO order
+        among the still-undispatchable."""
+        if not self._waiting:
+            return
+        parked, self._waiting = self._waiting, deque()
+        for req in parked:
+            if req.future.cancelled():
+                continue
+            if not self._route(req):
+                self._waiting.append(req)
+
+    def _refresh(self, force: bool = False) -> None:
+        now = time.perf_counter()
+        if not force and now - self._last_poll < self._poll_s:
+            return
+        self._last_poll = now
+        try:
+            records = self.registry.poll()
+        except Exception:  # pragma: no cover - registry IO best effort
+            logger.exception("registry poll failed")
+            return
+        with self._lock:
+            self._records = records
+
+    @staticmethod
+    def _bound(rec: Dict[str, Any], n_eligible: int,
+               total_inflight: int, factor: float) -> int:
+        """Bounded-load cap for one replica: the classic
+        ceil(c * mean-load) bound, floored at the replica's slot count
+        so a cold fleet can still fill its pools.  Slots come from the
+        registry record the pick already holds — resolving them
+        through the live engine's stats() would pay an engine-lock
+        round per candidate per retry tick."""
+        slots = max(int(rec.get("slots", 0) or 0), 1)
+        mean = (total_inflight + 1) / max(n_eligible, 1)
+        return max(slots, int(np.ceil(factor * mean)))
+
+    def _pick(self, req: RouterRequest) \
+            -> Tuple[Optional[int], Optional[str]]:
+        """(replica id, None) or (None, block reason).  Affine work may
+        land on an SLO-breached replica (its warm cache is the point);
+        non-affine work never does."""
+        with self._lock:
+            records = dict(self._records)
+            inflight = dict(self._inflight)
+            known = set(self._replicas)
+            ring_order = (self._ring.preference(req.session)
+                          if req.session is not None else [])
+            budget = self.admission_budgets.get(req.model)
+            model_used = self._model_inflight.get(req.model, 0)
+        if budget is not None and model_used >= budget:
+            return None, "budget"
+        def rec_ok(rid):
+            rec = records.get(rid)
+            return (rid in known and rec is not None
+                    and rec["healthy"] and not rec["draining"])
+        eligible = [rid for rid in known if rec_ok(rid)]
+        if not eligible:
+            return None, "no_replica"
+        total = sum(inflight.get(rid, 0) for rid in eligible)
+        def has_room(rid):
+            return inflight.get(rid, 0) < self._bound(
+                records.get(rid) or {}, len(eligible), total,
+                self.bounded_load_factor)
+        def slo_ok(rid):
+            if self.slo_ttft_p99_s is None:
+                return True
+            rec = records.get(rid) or {}
+            return rec.get("ttft_p99_s", 0.0) <= self.slo_ttft_p99_s
+        if req.session is not None:
+            for i, rid in enumerate(ring_order):
+                # the HOME replica may be SLO-breached and still take
+                # its sessions (their warm cache lives there); a
+                # bounded-load SPILL stop holds none of this session's
+                # cache, so it gets no such exemption
+                if rec_ok(rid) and has_room(rid) \
+                        and (i == 0 or slo_ok(rid)):
+                    return rid, None
+            # every ring stop is draining/unhealthy/at-bound: fall
+            # through to the non-affine pick below
+        cands = [rid for rid in eligible
+                 if slo_ok(rid) and has_room(rid)]
+        if not cands:
+            breached = [rid for rid in eligible if not slo_ok(rid)]
+            return None, ("slo" if breached else "no_replica")
+        return min(cands, key=lambda rid: (inflight.get(rid, 0), rid)), \
+            None
+
+    def _route(self, req: RouterRequest) -> bool:
+        """Attempt one dispatch.  Returns True when the request reached
+        a terminal handling (dispatched, shed, or failed) and False
+        when it should PARK in the waiting list for a retry."""
+        rid, reason = self._pick(req)
+        if rid is None:
+            waited = time.perf_counter() - req.t_enqueue
+            if waited >= self.shed_after_s:
+                self._shed(req, reason or "no_replica", waited)
+                return True
+            return False
+        with self._lock:
+            replica = self._replicas.get(rid)
+        if replica is None:     # removed between pick and dispatch
+            return False
+        if not req.future.running() \
+                and not req.future.set_running_or_notify_cancel():
+            return True         # cancelled while queued (a parked
+            # request re-entering here is already RUNNING — skip)
+        try:
+            # timeout=0: a block-policy replica at capacity must answer
+            # the ONE router thread with the typed QueueFullError, not
+            # park it — a blocked dispatch would suspend routing,
+            # registry polls, and shedding for the whole fleet
+            inner = replica.submit_generate_async(
+                req.prompt, req.max_new_tokens, eos_id=req.eos_id,
+                on_token=req.on_token, timeout=0)
+        except (QueueFullError, ServerClosedError):
+            # the registry lagged reality (replica saturated or went
+            # away): park and re-pick next tick — RUNNING state is
+            # fine, the future resolves when it lands.  The shed
+            # deadline applies HERE too: a replica that keeps
+            # answering queue-full must not turn the typed-rejection
+            # contract into an indefinite hang
+            self._refresh(force=True)
+            waited = time.perf_counter() - req.t_enqueue
+            if waited >= self.shed_after_s:
+                self._shed(req, "no_replica", waited)
+                return True
+            return False
+        except Exception as e:  # noqa: BLE001 - dispatch bug: fail the
+            # one request, keep routing
+            logger.exception("dispatch to replica %d failed", rid)
+            req.future.set_exception(e)
+            return True
+        with self._lock:
+            self._dispatched += 1
+            self._inflight[rid] = self._inflight.get(rid, 0) + 1
+            self._model_inflight[req.model] = \
+                self._model_inflight.get(req.model, 0) + 1
+            n_now = self._inflight[rid]
+            if req.session is not None and not req.affinity_counted:
+                # once per DISPATCHED request — a parked request
+                # re-picked fifty times is one affinity datum, and the
+                # hit is judged on where it actually landed
+                req.affinity_counted = True
+                self._affine_total += 1
+                pref = self._ring.preference(req.session)
+                if pref and pref[0] == rid:
+                    self._affine_hits += 1
+        self._publish_inflight(rid, n_now)
+        inner.add_done_callback(
+            lambda f, rid=rid, req=req: self._on_replica_done(
+                f, rid, req))
+        return True
+
+    def _on_replica_done(self, inner: Future, rid: int,
+                         req: RouterRequest) -> None:
+        with self._lock:
+            if rid in self._inflight:   # a late completion for a
+                # removed replica must not resurrect its entry
+                self._inflight[rid] = max(self._inflight[rid] - 1, 0)
+            m = req.model
+            self._model_inflight[m] = max(
+                self._model_inflight.get(m, 1) - 1, 0)
+            n_now = self._inflight.get(rid, 0)
+        self._publish_inflight(rid, n_now)
+        outer = req.future
+        if outer.done():
+            return
+        try:
+            outer.set_result(inner.result())
+        except BaseException as e:  # noqa: BLE001 - replica exception
+            # (or cancellation) belongs to the caller
+            outer.set_exception(e)
+
+    # ---- shedding + terminal accounting ----------------------------------
+
+    def _shed(self, req: RouterRequest, reason: str,
+              waited_s: float) -> None:
+        with self._lock:
+            self._shed_reasons[reason] = \
+                self._shed_reasons.get(reason, 0) + 1
+        _events.record_event("router_shed", reason=reason,
+                             queued_s=round(waited_s, 6),
+                             model=req.model,
+                             session=req.session)
+        if telemetry.enabled():
+            from bigdl_tpu.telemetry import families
+            families.router_shed_total().labels(reason).inc()
+        exc = (RequestSheddedError(
+            f"shed after {waited_s:.3f}s: every eligible replica "
+            f"breached its SLO target") if reason == "slo"
+            else NoReplicaAvailableError(
+                f"shed after {waited_s:.3f}s ({reason}): no eligible "
+                f"replica"))
+        fut = req.future
+        if fut.running():
+            if not fut.done():
+                fut.set_exception(exc)
+        elif fut.set_running_or_notify_cancel():
+            fut.set_exception(exc)
+
+    def _on_queue_shed(self) -> None:
+        """The bounded queue shed its oldest entry (overflow): count it
+        under reason=queue_full; the victim's future already carries
+        RequestSheddedError from the queue itself."""
+        with self._lock:
+            self._shed_reasons["queue_full"] = \
+                self._shed_reasons.get("queue_full", 0) + 1
+        _events.record_event("router_shed", reason="queue_full")
+        if telemetry.enabled():
+            from bigdl_tpu.telemetry import families
+            families.router_shed_total().labels("queue_full").inc()
+
+    def _on_terminal(self, fut: Future) -> None:
+        if fut.cancelled():
+            outcome = "rejected"
+        else:
+            exc = fut.exception()
+            if exc is None:
+                outcome = "ok"
+            elif isinstance(exc, RequestSheddedError):
+                outcome = "shed"
+            elif isinstance(exc, (NoReplicaAvailableError,
+                                  ServerClosedError, QueueFullError)):
+                outcome = "rejected"
+            else:
+                outcome = "failed"
+        with self._lock:
+            self._outcomes[outcome] = self._outcomes.get(outcome, 0) + 1
+        if telemetry.enabled():
+            from bigdl_tpu.telemetry import families
+            families.router_requests_total().labels(outcome).inc()
+
+    def _publish_inflight(self, rid: int, n: int) -> None:
+        if telemetry.enabled():
+            from bigdl_tpu.telemetry import families
+            families.router_replica_inflight().labels(str(rid)).set(n)
+
+    # ---- observability ---------------------------------------------------
+
+    def queue_depth(self) -> int:
+        return len(self._queue)
+
+    def replica_ids(self) -> List[int]:
+        with self._lock:
+            return list(self._replicas)
+
+    def records(self) -> Dict[int, Dict[str, Any]]:
+        """The latest registry view the router routed on."""
+        with self._lock:
+            return dict(self._records)
+
+    def stats(self) -> Dict[str, Any]:
+        depth = len(self._queue)    # the queue has its own lock
+        # router-thread-owned deque: len() outside the lock is a
+        # benign monotonic read, and reading it inside would smuggle
+        # it into the lock's guarded set
+        waiting = len(self._waiting)
+        with self._lock:
+            return {
+                "replicas": len(self._replicas),
+                "submitted": self._submitted,
+                "dispatched": self._dispatched,
+                "outcomes": dict(self._outcomes),
+                "shed_reasons": dict(self._shed_reasons),
+                "inflight": dict(self._inflight),
+                "affinity_lookups": self._affine_total,
+                "affinity_hits": self._affine_hits,
+                "affinity_hit_rate": (
+                    self._affine_hits / self._affine_total
+                    if self._affine_total else 0.0),
+                "queue_depth": depth,
+                "waiting": waiting,
+                "slo_ttft_p99_s": self.slo_ttft_p99_s,
+                "bounded_load_factor": self.bounded_load_factor,
+                "shed_after_s": self.shed_after_s,
+            }

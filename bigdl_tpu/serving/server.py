@@ -143,6 +143,11 @@ class ModelServer:
                 "ModelServer needs a backend (one-shot inference), a "
                 "generator (continuous-batching generation), or both")
         self.metrics = metrics if metrics is not None else MetricsRegistry()
+        # admitted-but-not-terminal one-shot requests; the generation
+        # plane keeps its own count (GenerationScheduler) — together
+        # they are admitted_outstanding(), the router's drain invariant
+        self._outstanding_lock = threading.Lock()
+        self._outstanding = 0
         self._run_batch = None
         self._scheduler = None
         self._queue = None
@@ -200,12 +205,37 @@ class ModelServer:
                 "this server has no one-shot backend (generation-only); "
                 "use submit_generate / submit_generate_async")
         req = Request(sample)
+        with self._outstanding_lock:
+            self._outstanding += 1
         try:
             self._queue.put(req, timeout=timeout)
         except QueueFullError:
+            with self._outstanding_lock:
+                self._outstanding -= 1
             self.metrics.record_rejected()
             raise
+        except BaseException:
+            with self._outstanding_lock:
+                self._outstanding -= 1
+            raise
+        req.future.add_done_callback(self._dec_outstanding)
         return req.future
+
+    def _dec_outstanding(self, _fut) -> None:
+        with self._outstanding_lock:
+            self._outstanding -= 1
+
+    def admitted_outstanding(self) -> int:
+        """Admitted requests not yet terminal across BOTH planes
+        (one-shot queued/dispatched + generation queued/prefilling/
+        decoding).  A drained replica must reach exactly zero before
+        teardown — the router's deploy asserts this instead of
+        inferring zero-drop from request counters."""
+        with self._outstanding_lock:
+            n = self._outstanding
+        if self.generation is not None:
+            n += self.generation.admitted_outstanding()
+        return n
 
     def submit(self, sample, timeout: Optional[float] = None):
         """Blocking single-sample inference (≙ PredictionService.predict,

@@ -385,6 +385,45 @@ def generation_prefix_cache_resident_bytes() -> Gauge:
         "its byte budget)")
 
 
+def generation_prefill_dedup_total() -> Counter:
+    return get_registry().counter(
+        "generation_prefill_dedup_total",
+        "Single-flight prefill dedup decisions at admit: a leader "
+        "claimed uncached chunks and prefilled them; a follower "
+        "parked on another request's in-flight prefill and re-matched "
+        "the cache after its insert (a burst of identical cold "
+        "prompts prefills once)", labelnames=("result",))
+
+
+# ---- serving fabric (router + replica registry, serving.router) -----------
+
+def router_requests_total() -> Counter:
+    return get_registry().counter(
+        "router_requests_total",
+        "Requests reaching a terminal outcome at the router: ok "
+        "(served), shed (typed RequestSheddedError under overload), "
+        "rejected (no eligible replica / closed / cancelled), failed "
+        "(replica-side error)", labelnames=("outcome",))
+
+
+def router_replica_inflight() -> Gauge:
+    return get_registry().gauge(
+        "router_replica_inflight",
+        "Requests dispatched to a replica and not yet terminal, per "
+        "replica id (the quantity the bounded-load affinity fallback "
+        "caps)", labelnames=("replica",))
+
+
+def router_shed_total() -> Counter:
+    return get_registry().counter(
+        "router_shed_total",
+        "Requests shed by the router, by reason: queue_full (bounded "
+        "queue overflow, oldest first), slo (every eligible replica "
+        "breached its TTFT p99 target), no_replica (nothing healthy "
+        "and non-draining), budget (per-model admission budget "
+        "exhausted)", labelnames=("reason",))
+
+
 _PREREGISTER = (
     optimizer_data_wait_seconds, optimizer_step_seconds,
     optimizer_validation_seconds, optimizer_retries_total,
@@ -412,6 +451,8 @@ _PREREGISTER = (
     generation_prefix_cache_events_total,
     generation_prefix_cache_bytes_reused_total,
     generation_prefix_cache_resident_bytes,
+    generation_prefill_dedup_total,
+    router_requests_total, router_replica_inflight, router_shed_total,
 )
 
 

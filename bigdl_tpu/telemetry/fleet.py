@@ -54,6 +54,7 @@ from bigdl_tpu.telemetry import families as _fam
 
 __all__ = ["FleetMonitor", "host_stats", "fleet_table",
            "write_host_snapshot", "merge_host_snapshots",
+           "read_host_snapshots", "remove_host_snapshot",
            "FLEET_STAT_FIELDS"]
 
 # the fixed-shape per-host vector, in wire order — one float64 each
@@ -164,11 +165,16 @@ def write_host_snapshot(directory: str,
                         stats: Dict[str, Any]) -> str:
     """Atomically drop one host's stats as
     ``fleet_host_<process>.json`` under ``directory`` (tmp+rename: a
-    merger must never read a torn write)."""
+    merger must never read a torn write).  The tmp name is unique per
+    writer THREAD: a serving replica publishes from its interval
+    thread AND synchronously on state flips (drain), and two writers
+    sharing one tmp path race replace-vs-unlink (the loser's rename
+    finds its tmp already consumed); with unique tmps both renames are
+    atomic and last-writer-wins."""
     os.makedirs(directory, exist_ok=True)
     pid = int(stats["process"])
     path = os.path.join(directory, f"{_SNAPSHOT_PREFIX}{pid}.json")
-    tmp = path + ".tmp"
+    tmp = f"{path}.{os.getpid()}.{threading.get_ident()}.tmp"
     with open(tmp, "w", encoding="utf-8") as f:
         json.dump(stats, f)
     os.replace(tmp, path)
@@ -205,6 +211,47 @@ def merge_host_snapshots(directory: str,
     if not rows:
         return None
     return fleet_table(rows)
+
+
+def remove_host_snapshot(directory: str, process: int) -> bool:
+    """Remove one host's snapshot file (True if it existed) — a
+    cleanly departing process must be FORGOTTEN by mergers and
+    registries, not reported as stale forever.  The one place that
+    knows the filename scheme, shared by every cleanup site."""
+    try:
+        os.unlink(os.path.join(
+            directory, f"{_SNAPSHOT_PREFIX}{int(process)}.json"))
+        return True
+    except OSError:
+        return False
+
+
+def read_host_snapshots(directory: str) \
+        -> Dict[int, Optional[Dict[str, Any]]]:
+    """Raw per-host snapshot rows keyed by process id.  Unlike
+    :func:`merge_host_snapshots` (which silently SKIPS unusable files
+    to keep the fleet table clean), a corrupt or unparsable snapshot
+    surfaces as ``None`` — the serving replica registry treats it as
+    an UNHEALTHY replica rather than an absent one, because a replica
+    that writes garbage is in worse shape than one that never joined.
+    Staleness is left to the caller (the registry applies its own
+    ``max_age_s``)."""
+    out: Dict[int, Optional[Dict[str, Any]]] = {}
+    for path in sorted(_glob.glob(
+            os.path.join(directory, _SNAPSHOT_PREFIX + "*.json"))):
+        stem = os.path.basename(path)[len(_SNAPSHOT_PREFIX):-len(".json")]
+        try:
+            pid = int(stem)
+        except ValueError:
+            continue        # not one of ours
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                row = json.load(f)
+            float(row["process"])
+            out[pid] = row
+        except Exception:
+            out[pid] = None
+    return out
 
 
 # ---------------------------------------------------------------------------

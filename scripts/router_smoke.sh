@@ -1,0 +1,158 @@
+#!/usr/bin/env bash
+# Serving-fabric smoke: the ISSUE-14 acceptance gates over a 3-replica
+# local fabric on the CPU backend (docs/serving.md "Serving fabric").
+#
+#   1. session affinity: a sessioned workload over 3 replicas routes
+#      every repeat of a session key to its consistent-hash home
+#      (affinity hit rate 1.0 when nobody is overloaded) and every
+#      request completes;
+#   2. drain/deploy zero-drop: with requests in flight, deploy a
+#      replacement replica for a draining one — the router asserts
+#      admitted_outstanding() == 0 before removal and every pre-drain
+#      future still resolves with a full row;
+#   3. shed under overload: with a deliberately slowed (SLO-breached)
+#      replica fleet at 2x capacity, rejected requests fail FAST with
+#      typed errors (RequestSheddedError / NoReplicaAvailableError),
+#      never timeouts, while surviving requests' TTFT p99 stays within
+#      the configured SLO;
+#   4. dedup: an 8-way identical cold-prompt burst through one engine
+#      runs exactly ONE prefill pass (1 leader + 7 followers, chunk
+#      program calls == the leader's own chunk count);
+#   5. disaggregated prefill: the prefill-role engine publishes K/V
+#      through the shared prefix cache and the decode-role engine's
+#      greedy rows are bit-identical to the single-engine rows.
+#
+# Standalone: exits non-zero on any failed assertion.
+# scripts/tier1.sh runs it warn-only after the suite.
+set -o pipefail
+cd "$(dirname "$0")/.."
+exec env JAX_PLATFORMS=cpu python - <<'PY'
+import tempfile
+import time
+
+import numpy as np
+
+from bigdl_tpu.models import transformer_lm
+from bigdl_tpu.serving import (
+    DisaggregatedEngine, GenerationScheduler, ModelServer,
+    NoReplicaAvailableError, Replica, RequestSheddedError, Router,
+)
+from bigdl_tpu.utils import set_seed
+
+set_seed(7)
+model = transformer_lm(vocab_size=128, hidden_size=64, num_layers=2,
+                       num_heads=4, filter_size=128,
+                       max_len=128).eval_mode()
+rng = np.random.default_rng(21)
+
+
+def replica(rid, d, slots=4):
+    return Replica(rid, ModelServer(generator=model, slots=slots),
+                   snapshot_dir=d, publish_interval_s=0.05)
+
+
+# ---- 1: session affinity over 3 replicas ---------------------------------
+d = tempfile.mkdtemp(prefix="router-smoke-")
+router = Router(replicas=[replica(i, d) for i in range(3)],
+                snapshot_dir=d, poll_interval_s=0.02)
+sessions = [f"user-{i}" for i in range(9)]
+futs = []
+for _wave in range(3):
+    for s in sessions:
+        futs.append(router.submit_generate_async(
+            rng.integers(1, 129, int(rng.integers(4, 24))).astype(
+                np.int32), 6, session=s))
+rows = [f.result(300) for f in futs]
+st = router.stats()
+assert st["outcomes"].get("ok") == len(futs), st
+assert st["affinity_hit_rate"] == 1.0, \
+    f"sessioned workload missed its ring home: {st}"
+
+# ---- 2: drain/deploy with zero dropped admitted requests -----------------
+inflight = [router.submit_generate_async(
+    rng.integers(1, 129, 8).astype(np.int32), 24, session=f"user-{i}")
+    for i in range(8)]
+time.sleep(0.05)
+res = router.deploy(replica(9, d), replaces=0, timeout=120)
+assert res["outstanding_at_removal"] == 0, res
+for f in inflight:
+    assert len(f.result(300)) == 8 + 24
+st = router.stats()
+assert "shed" not in st["outcomes"] and "failed" not in st["outcomes"], \
+    f"deploy dropped admitted work: {st}"
+affinity_rate = st["affinity_hit_rate"]
+router.shutdown()
+
+# ---- 3: typed shedding under 2x overload, survivors within SLO -----------
+d2 = tempfile.mkdtemp(prefix="router-smoke-slo-")
+slo_s = 15.0
+only = replica(0, d2, slots=2)
+over = Router(replicas=[only], snapshot_dir=d2,
+              poll_interval_s=0.02, slo_ttft_p99_s=slo_s,
+              queue_capacity=12)
+# ~2x the queue+slot capacity, submitted as one burst
+burst = [over.submit_generate_async(
+    rng.integers(1, 129, 6).astype(np.int32), 16)
+    for _ in range(28)]
+ok, shed, ttfts = 0, 0, []
+t0 = time.perf_counter()
+for f in burst:
+    try:
+        f.result(300)
+        ok += 1
+    except (RequestSheddedError, NoReplicaAvailableError):
+        shed += 1           # typed, never a timeout
+wall = time.perf_counter() - t0
+stats0 = over.stats()
+# survivors' TTFT from the replica's LIVE reservoir, not the (possibly
+# lagging) registry snapshot: the gate must measure what was served
+survivor_p99 = only.stats()["queue_to_first_token_s_p99"]
+over.shutdown()
+assert ok + shed == len(burst)
+assert shed > 0, f"2x overload shed nothing: {stats0}"
+assert ok > 0, stats0
+assert stats0["shed_reasons"].get("queue_full", 0) > 0, stats0
+assert 0.0 < survivor_p99 <= slo_s, \
+    f"survivors' TTFT p99 {survivor_p99}s breached the {slo_s}s SLO"
+
+# ---- 4: 8-way identical cold burst prefills once -------------------------
+p = rng.integers(1, 129, 33).astype(np.int32)   # region 32 = 4 granules
+eng = GenerationScheduler(model, slots=8, prefix_cache_bytes=1 << 24,
+                          prefix_granularity=8, prefill_chunk=8)
+burst = [eng.submit_async(p, 4) for _ in range(8)]
+brows = [f.result(300) for f in burst]
+est = eng.stats()
+eng.shutdown()
+assert est["prefill_dedup_leaders"] == 1, est
+assert est["prefill_dedup_followers"] == 7, est
+assert est["prefill_calls"] == 4, \
+    f"burst should cost exactly the leader's 4 chunk calls: {est}"
+assert all(np.array_equal(r, brows[0]) for r in brows)
+
+# ---- 5: disaggregated prefill -> decode bit-identical --------------------
+prompts = [rng.integers(1, 129, int(n)).astype(np.int32)
+           for n in [5, 17, 33, 49, 33, 17]]
+budgets = [6] * len(prompts)
+de = DisaggregatedEngine(model, decode_slots=4, prefill_slots=2,
+                         prefix_granularity=8, prefill_chunk=8)
+dis = [de.submit_generate_async(q, m).result(300)
+       for q, m in zip(prompts, budgets)]
+dst = de.stats()
+de.shutdown()
+single = GenerationScheduler(model, slots=4, prefill_chunk=8,
+                             prefix_cache_bytes=1 << 24,
+                             prefix_granularity=8)
+sg = [single.submit_async(q, m).result(300)
+      for q, m in zip(prompts, budgets)]
+single.shutdown()
+for a, b in zip(dis, sg):
+    assert np.array_equal(a, b), "disaggregated rows != single-engine"
+assert dst["prefill_engine"]["requests_done"] >= 5, dst
+
+print(f"router_smoke: OK (affinity {affinity_rate:.2f} over 3 replicas, "
+      f"deploy zero-drop outstanding=0, overload ok={ok} shed={shed} "
+      f"typed in {wall:.1f}s survivors p99 {survivor_p99:.3f}s <= "
+      f"{slo_s}s SLO, dedup 1 leader + 7 followers = "
+      f"{est['prefill_calls']} chunk calls, disaggregated bit-identical "
+      f"over {len(prompts)} rows)")
+PY

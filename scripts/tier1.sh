@@ -97,4 +97,15 @@ if bash "$(dirname "$0")/serving_gen_smoke.sh" >"$gen_log" 2>&1; then
 else
   echo "serving_gen_smoke: FAILED (non-fatal ride-along; see $gen_log)"
 fi
+# serving-fabric smoke (3-replica router: session affinity, drain/
+# deploy zero-drop, typed shedding under 2x overload within SLO,
+# single-flight prefill dedup, disaggregated prefill bit-identity):
+# warn-only ride-along; run scripts/router_smoke.sh standalone for the
+# fatal form
+router_log=$(mktemp /tmp/router_smoke.XXXXXX.log)
+if bash "$(dirname "$0")/router_smoke.sh" >"$router_log" 2>&1; then
+  tail -n 1 "$router_log"
+else
+  echo "router_smoke: FAILED (non-fatal ride-along; see $router_log)"
+fi
 exit $rc

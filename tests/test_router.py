@@ -1,0 +1,850 @@
+"""Serving fabric tests (serving/router.py + serving/replica.py):
+hash-ring determinism and rebalance-on-leave, bounded-load overflow,
+SLO-aware shedding with a deliberately slowed replica, drain/deploy
+with the zero-drop invariant asserted, stale/corrupt snapshots read as
+unhealthy, /healthz drain consumption, single-flight prefill dedup,
+and the disaggregated prefill→decode handoff — bit-identical to the
+single-engine greedy rows and to solo ``generate()``.
+
+The load-bearing assertions: (a) a deploy drops NOTHING it admitted —
+``admitted_outstanding()`` reaches exactly 0 before the old replica is
+removed and every pre-drain future resolves with a result; (b) under
+overload the router answers with TYPED rejections, never timeouts;
+(c) an 8-way identical cold-prompt burst runs exactly one prefill.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from bigdl_tpu.models import transformer_lm
+from bigdl_tpu.serving import (
+    DisaggregatedEngine, GenerationScheduler, ModelServer,
+    NoReplicaAvailableError, Replica, RequestSheddedError, Router,
+)
+from bigdl_tpu.serving.replica import ReplicaRegistry, scrape_healthz
+from bigdl_tpu.serving.router import HashRing, RouterRequest
+from bigdl_tpu.telemetry import events
+from bigdl_tpu.telemetry.fleet import write_host_snapshot
+from bigdl_tpu.utils import set_seed
+
+
+@pytest.fixture(scope="module")
+def lm():
+    set_seed(0)
+    return transformer_lm(vocab_size=50, hidden_size=32, num_layers=2,
+                          num_heads=4, filter_size=64,
+                          max_len=64).eval_mode()
+
+
+def solo(model, prompt, max_new, eos_id=None):
+    import jax.numpy as jnp
+    return np.asarray(model.generate(
+        jnp.asarray(prompt, jnp.int32)[None], int(max_new),
+        eos_id=eos_id))[0]
+
+
+def _replica(lm, rid, d, slots=2, interval=0.05, **server_kw):
+    return Replica(rid, ModelServer(generator=lm, slots=slots,
+                                    **server_kw),
+                   snapshot_dir=d, publish_interval_s=interval)
+
+
+def _wait(cond, timeout=30.0, msg="condition"):
+    deadline = time.perf_counter() + timeout
+    while not cond():
+        if time.perf_counter() > deadline:
+            raise TimeoutError(f"{msg} not reached in {timeout}s")
+        time.sleep(0.01)
+
+
+# ---------------------------------------------------------------------------
+# hash ring
+# ---------------------------------------------------------------------------
+
+def test_hash_ring_deterministic_across_instances():
+    a, b = HashRing(), HashRing()
+    for ring in (a, b):
+        for rid in (3, 1, 7):
+            ring.add(rid)
+    for key in ("user-1", "user-2", "s", "a-long-session-key", "42"):
+        assert a.preference(key) == b.preference(key)
+        assert sorted(a.preference(key)) == [1, 3, 7]
+
+
+def test_hash_ring_balances_keys():
+    ring = HashRing()
+    for rid in range(4):
+        ring.add(rid)
+    homes = [ring.preference(f"k{i}")[0] for i in range(400)]
+    counts = {rid: homes.count(rid) for rid in range(4)}
+    # virtual nodes keep the split rough-uniform: nobody owns more
+    # than half or less than a twentieth of the keyspace
+    assert all(20 <= c <= 200 for c in counts.values()), counts
+
+
+def test_hash_ring_rebalance_on_leave_moves_only_orphans():
+    ring = HashRing()
+    for rid in range(4):
+        ring.add(rid)
+    keys = [f"session-{i}" for i in range(300)]
+    before = {k: ring.preference(k)[0] for k in keys}
+    ring.remove(2)
+    after = {k: ring.preference(k)[0] for k in keys}
+    for k in keys:
+        if before[k] != 2:
+            # the consistent-hashing contract: a leave moves ONLY the
+            # departed replica's keys — everyone else keeps their warm
+            # prefix caches
+            assert after[k] == before[k]
+        else:
+            assert after[k] != 2
+    with pytest.raises(KeyError):
+        ring.remove(2)
+    with pytest.raises(ValueError):
+        ring.add(3)
+
+
+# ---------------------------------------------------------------------------
+# registry: stale / corrupt / healthz
+# ---------------------------------------------------------------------------
+
+def test_registry_stale_snapshot_is_unhealthy(tmp_path):
+    d = str(tmp_path)
+    reg = ReplicaRegistry(d, max_age_s=0.2)
+    from bigdl_tpu.serving.replica import replica_snapshot
+    write_host_snapshot(d, replica_snapshot(0, None, name="fresh"))
+    stale = replica_snapshot(1, None, name="stale")
+    stale["time"] -= 10.0
+    write_host_snapshot(d, stale)
+    recs = reg.poll()
+    assert recs[0]["healthy"] and recs[0]["reason"] is None
+    assert not recs[1]["healthy"] and recs[1]["reason"] == "stale"
+
+
+def test_registry_corrupt_snapshot_is_unhealthy(tmp_path):
+    d = str(tmp_path)
+    with open(os.path.join(d, "fleet_host_5.json"), "w") as f:
+        f.write("{torn half-write")
+    recs = ReplicaRegistry(d).poll()
+    assert not recs[5]["healthy"] and recs[5]["reason"] == "corrupt"
+
+
+def test_registry_consumes_healthz_503_as_draining(tmp_path):
+    d = str(tmp_path)
+    from bigdl_tpu.serving.replica import replica_snapshot
+    write_host_snapshot(d, replica_snapshot(0, None))
+    reg = ReplicaRegistry(d)
+    assert not reg.poll()[0]["draining"]
+    reg.observe_healthz(0, 503, {"status": "draining"})
+    rec = reg.poll()[0]
+    assert rec["draining"] and rec["healthy"]
+    reg.observe_healthz(0, 200, {"status": "ok"})
+    assert not reg.poll()[0]["draining"]
+    reg.observe_healthz(0, 500, {})
+    assert not reg.poll()[0]["healthy"]
+
+
+def test_registry_scrapes_real_healthz_drain(tmp_path):
+    """End-to-end against the real HTTP frontend: a draining
+    examples/serve.py replica answers 503 and the registry consumes
+    it into the record."""
+    from bigdl_tpu.examples.serve import make_server
+    server = make_server(object(), "127.0.0.1", 0)
+    import threading
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    try:
+        d = str(tmp_path)
+        from bigdl_tpu.serving.replica import replica_snapshot
+        write_host_snapshot(d, replica_snapshot(0, None))
+        reg = ReplicaRegistry(d)
+        port = server.server_port
+        code, body = scrape_healthz("127.0.0.1", port)
+        assert code == 200 and body["status"] == "ok"
+        reg.observe_healthz(0, code, body)
+        assert not reg.poll()[0]["draining"]
+        server.health_state["draining"] = True
+        code, body = scrape_healthz("127.0.0.1", port)
+        assert code == 503 and body["status"] == "draining"
+        reg.observe_healthz(0, code, body)
+        assert reg.poll()[0]["draining"]
+    finally:
+        server.shutdown()
+        server.server_close()
+        t.join(5.0)
+
+
+# ---------------------------------------------------------------------------
+# routing: affinity, bounded load, SLO shed
+# ---------------------------------------------------------------------------
+
+def test_session_affinity_routes_to_ring_home(lm, tmp_path):
+    d = str(tmp_path)
+    reps = [_replica(lm, i, d) for i in range(3)]
+    router = Router(replicas=reps, snapshot_dir=d, poll_interval_s=0.02)
+    try:
+        rng = np.random.default_rng(3)
+        sessions = [f"user-{i}" for i in range(6)]
+        # two waves of the same sessions: each wave's request for a
+        # given key must land on the SAME replica
+        for _wave in range(2):
+            futs = [router.submit_generate_async(
+                rng.integers(1, 50, 6).astype(np.int32), 4, session=s)
+                for s in sessions]
+            for f in futs:
+                f.result(60)
+        st = router.stats()
+        assert st["outcomes"].get("ok") == 12
+        assert st["affinity_hit_rate"] == 1.0     # nobody overflowed
+    finally:
+        router.shutdown()
+
+
+def test_bounded_load_spills_hot_session_key(lm, tmp_path):
+    """One hot session key must not wedge its home replica: once the
+    home's in-flight count hits the bound, requests walk the ring."""
+    d = str(tmp_path)
+    reps = [_replica(lm, i, d, slots=1) for i in range(2)]
+    router = Router(replicas=reps, snapshot_dir=d, poll_interval_s=0.01,
+                    bounded_load_factor=1.0)
+    try:
+        rng = np.random.default_rng(4)
+        # long decodes keep the home busy while the burst arrives
+        futs = [router.submit_generate_async(
+            rng.integers(1, 50, 4).astype(np.int32), 40,
+            session="one-viral-session") for _ in range(6)]
+        for f in futs:
+            f.result(120)
+        done = [r.stats().get("requests_done", 0) for r in reps]
+        assert sum(done) == 6
+        assert all(n > 0 for n in done), \
+            f"hot key never spilled off its home replica: {done}"
+        st = router.stats()
+        assert st["affinity_hit_rate"] < 1.0
+    finally:
+        router.shutdown()
+
+
+def _saturate_ttft(replica, lm, n=8, max_new=30):
+    """Genuinely slow a 1-slot replica: queue enough long decodes that
+    late requests' queue-to-first-token climbs, then wait for them so
+    the p99 reservoir holds the breach."""
+    rng = np.random.default_rng(5)
+    futs = [replica.submit_generate_async(
+        rng.integers(1, 50, 4).astype(np.int32), max_new)
+        for _ in range(n)]
+    for f in futs:
+        f.result(120)
+
+
+def test_slo_breached_replica_stops_receiving_non_affine_work(
+        lm, tmp_path):
+    d = str(tmp_path)
+    slow = _replica(lm, 0, d, slots=1)
+    fast = _replica(lm, 1, d, slots=2)
+    _saturate_ttft(slow, lm)
+    p99 = slow.stats()["queue_to_first_token_s_p99"]
+    assert p99 > 0.0
+    router = Router(replicas=[slow, fast], snapshot_dir=d,
+                    poll_interval_s=0.01, slo_ttft_p99_s=p99 / 2)
+    try:
+        _wait(lambda: 0 in router.records()
+              and router.records()[0].get("ttft_p99_s", 0) > p99 / 2,
+              msg="registry sees the breach")
+        before = [slow.stats()["requests_done"],
+                  fast.stats()["requests_done"]]
+        rng = np.random.default_rng(6)
+        futs = [router.submit_generate_async(
+            rng.integers(1, 50, 4).astype(np.int32), 4)
+            for _ in range(5)]        # NON-affine: no session key
+        for f in futs:
+            f.result(60)
+        after = [slow.stats()["requests_done"],
+                 fast.stats()["requests_done"]]
+        assert after[0] == before[0], \
+            "SLO-breached replica still received non-affine work"
+        assert after[1] == before[1] + 5
+    finally:
+        router.shutdown()
+
+
+def test_all_replicas_breached_sheds_typed_not_timeout(lm, tmp_path):
+    d = str(tmp_path)
+    slow = _replica(lm, 0, d, slots=1)
+    _saturate_ttft(slow, lm)
+    p99 = slow.stats()["queue_to_first_token_s_p99"]
+    router = Router(replicas=[slow], snapshot_dir=d,
+                    poll_interval_s=0.01, slo_ttft_p99_s=p99 / 2,
+                    shed_after_s=0.15)
+    try:
+        _wait(lambda: router.records().get(0, {}).get("ttft_p99_s", 0)
+              > p99 / 2, msg="registry sees the breach")
+        t0 = time.perf_counter()
+        fut = router.submit_generate_async(
+            np.asarray([3, 4, 5], np.int32), 4)   # non-affine
+        with pytest.raises(RequestSheddedError):
+            fut.result(30)
+        waited = time.perf_counter() - t0
+        assert waited < 5.0, "shed must be a fast typed no, not a " \
+            "timeout"
+        assert router.stats()["shed_reasons"].get("slo", 0) >= 1
+        kinds = [e["kind"] for e in events.recent_events(50)]
+        assert "router_shed" in kinds
+        # affine work still reaches the breached replica (warm cache)
+        before = slow.stats()["requests_done"]
+        router.submit_generate(np.asarray([3, 4, 5], np.int32), 4,
+                               session="sticky", timeout=60)
+        assert slow.stats()["requests_done"] == before + 1
+    finally:
+        router.shutdown()
+
+
+def test_admission_budget_sheds_with_budget_reason(lm, tmp_path):
+    d = str(tmp_path)
+    rep = _replica(lm, 0, d, slots=2)
+    router = Router(replicas=[rep], snapshot_dir=d,
+                    poll_interval_s=0.01, shed_after_s=0.1,
+                    admission_budgets={"budgeted": 0})
+    try:
+        fut = router.submit_generate_async(
+            np.asarray([3, 4, 5], np.int32), 4, model="budgeted")
+        with pytest.raises(NoReplicaAvailableError):
+            fut.result(30)
+        assert router.stats()["shed_reasons"].get("budget", 0) >= 1
+        # other models are untouched by that budget
+        row = router.submit_generate(np.asarray([3, 4, 5], np.int32),
+                                     4, timeout=60)
+        assert len(row) == 7
+    finally:
+        router.shutdown()
+
+
+def test_replica_without_snapshot_dir_adopted_stays_routable(lm):
+    """The README construction path: Replicas built with NO
+    snapshot_dir are adopted by the router — which must START their
+    interval publishers, or the fleet silently goes stale-unroutable
+    max_age_s after the single adoption-time publish."""
+    reps = [Replica(i, ModelServer(generator=lm, slots=2))
+            for i in range(2)]
+    router = Router(replicas=reps, poll_interval_s=0.02,
+                    registry_max_age_s=0.4)
+    try:
+        time.sleep(1.0)     # > 2x max_age: only live publishing keeps
+        # the records fresh
+        recs = router.records()
+        assert recs and all(r["healthy"] for r in recs.values()), recs
+        row = router.submit_generate(np.asarray([3, 4, 5], np.int32),
+                                     4, timeout=60)
+        assert len(row) == 7
+    finally:
+        router.shutdown()
+
+
+def test_budget_blocked_model_does_not_starve_others(lm, tmp_path):
+    """A budget-exhausted model's parked request must not
+    head-of-line-block other models: model-B traffic keeps flowing
+    while the model-A request waits out its shed deadline."""
+    d = str(tmp_path)
+    rep = _replica(lm, 0, d, slots=2)
+    router = Router(replicas=[rep], snapshot_dir=d,
+                    poll_interval_s=0.01, shed_after_s=3.0,
+                    admission_budgets={"A": 0})
+    try:
+        futA = router.submit_generate_async(
+            np.asarray([3, 4, 5], np.int32), 4, model="A")
+        t0 = time.perf_counter()
+        rowB = router.submit_generate(np.asarray([3, 4, 5], np.int32),
+                                      4, model="B", timeout=60)
+        b_wall = time.perf_counter() - t0
+        assert len(rowB) == 7
+        assert b_wall < 2.0, \
+            f"model-B request waited {b_wall:.2f}s behind a " \
+            f"budget-blocked model-A head"
+        with pytest.raises(NoReplicaAvailableError):
+            futA.result(30)
+    finally:
+        router.shutdown()
+
+
+def test_no_replica_sheds_typed(tmp_path, lm):
+    router = Router(replicas=[], snapshot_dir=str(tmp_path),
+                    poll_interval_s=0.01, shed_after_s=0.1)
+    try:
+        fut = router.submit_generate_async(
+            np.asarray([3, 4, 5], np.int32), 4)
+        with pytest.raises(NoReplicaAvailableError):
+            fut.result(30)
+        assert router.stats()["shed_reasons"].get("no_replica", 0) >= 1
+    finally:
+        router.shutdown(close_replicas=False)
+
+
+class _FakeTarget:
+    """Minimal replica target for routing-logic tests: healthy
+    snapshots, optional always-full admission."""
+
+    def __init__(self, full: bool = False, slots: int = 2):
+        self._full = full
+        self._slots = slots
+
+    def submit_generate_async(self, prompt, max_new_tokens,
+                              eos_id=None, on_token=None, timeout=None):
+        from concurrent.futures import Future
+
+        from bigdl_tpu.serving import QueueFullError
+        if self._full:
+            raise QueueFullError("engine queue at capacity")
+        f = Future()
+        f.set_result(np.zeros(3, np.int32))
+        return f
+
+    def shutdown(self, drain=True, timeout=None):
+        pass
+
+    def admitted_outstanding(self):
+        return 0
+
+    def queue_depth(self):
+        return 0
+
+    def stats(self):
+        return {"slots": self._slots}
+
+
+def test_wedged_full_replica_still_sheds_at_deadline(tmp_path):
+    """A replica that keeps answering queue-full (healthy snapshot,
+    wedged engine) must not turn the typed-rejection contract into an
+    indefinite hang: the shed deadline applies to the dispatch-failure
+    park path too."""
+    d = str(tmp_path)
+    rep = Replica(0, _FakeTarget(full=True), snapshot_dir=d,
+                  publish_interval_s=0.05)
+    router = Router(replicas=[rep], snapshot_dir=d,
+                    poll_interval_s=0.01, shed_after_s=0.25)
+    try:
+        t0 = time.perf_counter()
+        fut = router.submit_generate_async(
+            np.asarray([3, 4, 5], np.int32), 4)
+        with pytest.raises(NoReplicaAvailableError):
+            fut.result(30)
+        assert time.perf_counter() - t0 < 5.0, \
+            "shed took far longer than the deadline"
+    finally:
+        router.shutdown()
+
+
+def test_affine_spill_respects_slo_gate(tmp_path):
+    """Only the session's HOME replica keeps its SLO exemption (its
+    warm cache is the justification); a bounded-load spill stop holds
+    none of the session's cache and must pass the same SLO gate as
+    non-affine work."""
+    d = str(tmp_path)
+    reps = [Replica(i, _FakeTarget(), snapshot_dir=d,
+                    publish_interval_s=0.05) for i in (0, 1)]
+    # factor 1.0 so the home can actually hit its bound with two
+    # replicas (at c=2, n=2 the ceil(c*mean) cap exceeds any single
+    # replica's possible share and never binds)
+    router = Router(replicas=reps, snapshot_dir=d, start=False,
+                    poll_interval_s=0.01, slo_ttft_p99_s=0.05,
+                    bounded_load_factor=1.0)
+    try:
+        key = next(k for k in (f"s{i}" for i in range(50))
+                   if router._ring.preference(k)[0] == 0)
+        # breach replica 1's SLO in the records the pick routes on
+        with router._lock:
+            router._records[1]["ttft_p99_s"] = 1.0
+        # home healthy within SLO: session routes home
+        r = RouterRequest(np.asarray([3], np.int32), 1, session=key)
+        assert router._pick(r) == (0, None)
+        # home at bound: the spill stop is breached -> shed, not spill
+        with router._lock:
+            router._inflight[0] = 10 ** 6
+        assert router._pick(r) == (None, "slo")
+        # home itself breached but with room: sessions still ride it
+        with router._lock:
+            router._inflight[0] = 0
+            router._records[0]["ttft_p99_s"] = 1.0
+        assert router._pick(r) == (0, None)
+    finally:
+        router.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# drain / deploy
+# ---------------------------------------------------------------------------
+
+def test_drain_reroutes_new_sessions_and_finishes_admitted(
+        lm, tmp_path):
+    d = str(tmp_path)
+    reps = [_replica(lm, i, d) for i in range(2)]
+    router = Router(replicas=reps, snapshot_dir=d, poll_interval_s=0.01)
+    try:
+        rng = np.random.default_rng(7)
+        # find a session whose ring home is replica 0, pin some work
+        key = next(k for k in (f"s{i}" for i in range(50))
+                   if router._ring.preference(k)[0] == 0)
+        futs = [router.submit_generate_async(
+            rng.integers(1, 50, 4).astype(np.int32), 24, session=key)
+            for _ in range(3)]
+        _wait(lambda: reps[0].admitted_outstanding() > 0,
+              msg="work admitted to replica 0")
+        router.drain(0)
+        assert router.records()[0]["draining"]
+        # new work for the SAME session now lands on replica 1
+        before = reps[1].stats()["requests_done"]
+        router.submit_generate(rng.integers(1, 50, 4).astype(np.int32),
+                               4, session=key, timeout=60)
+        assert reps[1].stats()["requests_done"] == before + 1
+        # the admitted work still finishes — nothing dropped
+        for f in futs:
+            assert len(f.result(120)) > 0
+        assert reps[0].admitted_outstanding() == 0
+        kinds = [e["kind"] for e in events.recent_events(50)]
+        assert "replica_drain" in kinds and "replica_join" in kinds
+    finally:
+        router.shutdown()
+
+
+def test_deploy_zero_drop_swap(lm, tmp_path):
+    """The acceptance e2e: requests in flight on the old replica, a
+    replacement deploys, and the router ASSERTS zero admitted drops via
+    admitted_outstanding() before removal — every pre-drain future
+    resolves with a real row."""
+    d = str(tmp_path)
+    reps = [_replica(lm, i, d, slots=2) for i in range(2)]
+    router = Router(replicas=reps, snapshot_dir=d, poll_interval_s=0.01)
+    try:
+        rng = np.random.default_rng(8)
+        futs = [router.submit_generate_async(
+            rng.integers(1, 50, 6).astype(np.int32), 24,
+            session=f"u{i}") for i in range(8)]
+        _wait(lambda: sum(r.admitted_outstanding() for r in reps) > 0,
+              msg="fleet has admitted work")
+        new = _replica(lm, 9, d, slots=2)
+        res = router.deploy(new, replaces=0, timeout=120)
+        assert res["outstanding_at_removal"] == 0
+        assert res["added"] == 9 and res["replaced"] == 0
+        assert set(router.replica_ids()) == {1, 9}
+        rows = [f.result(120) for f in futs]
+        assert len(rows) == 8 and all(len(r) == 6 + 24 for r in rows)
+        # no typed rejections, no drops: every outcome is ok
+        st = router.stats()
+        assert st["outcomes"].get("ok", 0) >= 8
+        assert "shed" not in st["outcomes"]
+        # the old replica's snapshot file is gone from the registry
+        assert 0 not in router.registry.poll()
+        # new sessions land on the survivor set only
+        router.submit_generate(rng.integers(1, 50, 4).astype(np.int32),
+                               4, session="post-deploy", timeout=60)
+    finally:
+        router.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# admitted_outstanding (the satellite API)
+# ---------------------------------------------------------------------------
+
+def test_model_server_admitted_outstanding_both_planes(lm):
+    server = ModelServer(generator=lm, slots=2)
+    try:
+        assert server.admitted_outstanding() == 0
+        futs = [server.submit_generate_async(
+            np.asarray([3, 4, 5], np.int32), 12) for _ in range(3)]
+        assert server.admitted_outstanding() >= 1
+        for f in futs:
+            f.result(60)
+        _wait(lambda: server.admitted_outstanding() == 0,
+              msg="outstanding back to zero")
+    finally:
+        server.shutdown()
+
+
+def test_generation_scheduler_outstanding_counts_failures(lm):
+    eng = GenerationScheduler(lm, slots=2)
+    try:
+        with pytest.raises(ValueError):
+            eng.submit_async(np.asarray([3], np.int32), 0)  # mixed: >=1
+        assert eng.admitted_outstanding() == 0
+        fut = eng.submit_async(np.asarray([3, 4], np.int32), 4)
+        fut.result(60)
+        _wait(lambda: eng.admitted_outstanding() == 0,
+              msg="outstanding drained")
+    finally:
+        eng.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# single-flight prefill dedup
+# ---------------------------------------------------------------------------
+
+def test_identical_cold_burst_prefills_once(lm):
+    """8-way identical cold burst: one leader prefill pass, 7
+    followers park on the in-flight claim, everyone's rows equal
+    solo generate()."""
+    rng = np.random.default_rng(11)
+    # region = 16 tokens = exactly 2 granules: followers need zero
+    # suffix prefill after the leader's insert lands
+    p = rng.integers(1, 50, 17).astype(np.int32)
+    eng = GenerationScheduler(lm, slots=8, prefix_cache_bytes=1 << 24,
+                              prefix_granularity=8, prefill_chunk=8)
+    try:
+        futs = [eng.submit_async(p, 4) for _ in range(8)]
+        rows = [f.result(60) for f in futs]
+        st = eng.stats()
+    finally:
+        eng.shutdown()
+    oracle = solo(lm, p, 4)
+    assert all(np.array_equal(r, oracle) for r in rows)
+    assert st["prefill_dedup_leaders"] == 1
+    assert st["prefill_dedup_followers"] == 7
+    # the leader's 16-token region at chunk width 8 = exactly 2
+    # prefill program calls for the WHOLE burst
+    assert st["prefill_calls"] == 2
+    assert st["prefix_cache"]["inserts"] == 2
+    assert st["prefix_cache"]["hits"] >= 7
+    assert st["prefix_cache"]["inflight_prefills"] == 0
+
+
+def test_dedup_shared_prefix_longer_follower(lm):
+    """A longer prompt sharing the leader's prefix parks, then wakes
+    and prefills ONLY its own suffix chunks."""
+    rng = np.random.default_rng(12)
+    prefix = rng.integers(1, 50, 17).astype(np.int32)   # 2 granules
+    longer = np.concatenate(
+        [prefix[:-1], rng.integers(1, 50, 17).astype(np.int32)])
+    eng = GenerationScheduler(lm, slots=4, prefix_cache_bytes=1 << 24,
+                              prefix_granularity=8, prefill_chunk=8)
+    try:
+        f1 = eng.submit_async(prefix, 4)
+        f2 = eng.submit_async(longer, 4)
+        r1, r2 = f1.result(60), f2.result(60)
+        st = eng.stats()
+    finally:
+        eng.shutdown()
+    assert np.array_equal(r1, solo(lm, prefix, 4))
+    assert np.array_equal(r2, solo(lm, longer, 4))
+    assert st["prefill_dedup_followers"] >= 1
+
+
+def test_dedup_leader_failure_promotes_follower(lm, monkeypatch):
+    """If the leader's prefill dispatch fails, its claims release and
+    a parked follower re-claims — the burst still completes (minus the
+    failed leader) instead of stalling forever."""
+    eng = GenerationScheduler(lm, slots=4, prefix_cache_bytes=1 << 24,
+                              prefix_granularity=8, prefill_chunk=8)
+    rng = np.random.default_rng(13)
+    p = rng.integers(1, 50, 17).astype(np.int32)
+    fired = {"n": 0}
+    orig = eng.pool.chunk_prefill_into
+
+    def flaky(toks, slot, index):
+        if fired["n"] == 0:
+            fired["n"] += 1
+            raise RuntimeError("injected prefill fault")
+        return orig(toks, slot, index)
+
+    monkeypatch.setattr(eng.pool, "chunk_prefill_into", flaky)
+    try:
+        futs = [eng.submit_async(p, 4) for _ in range(3)]
+        results = []
+        errors = 0
+        for f in futs:
+            try:
+                results.append(f.result(60))
+            except RuntimeError:
+                errors += 1
+        assert errors == 1, "exactly the leader fails"
+        oracle = solo(lm, p, 4)
+        assert len(results) == 2
+        assert all(np.array_equal(r, oracle) for r in results)
+    finally:
+        eng.shutdown()
+
+
+def test_dedup_family_recorded_when_enabled(lm):
+    from bigdl_tpu import telemetry
+    telemetry.enable()
+    telemetry.reset()
+    try:
+        rng = np.random.default_rng(14)
+        p = rng.integers(1, 50, 17).astype(np.int32)
+        eng = GenerationScheduler(lm, slots=4,
+                                  prefix_cache_bytes=1 << 24,
+                                  prefix_granularity=8, prefill_chunk=8)
+        try:
+            futs = [eng.submit_async(p, 4) for _ in range(4)]
+            for f in futs:
+                f.result(60)
+        finally:
+            eng.shutdown()
+        text = telemetry.prometheus_text()
+        assert 'generation_prefill_dedup_total{result="leader"}' in text
+        assert 'generation_prefill_dedup_total{result="follower"}' \
+            in text
+    finally:
+        telemetry.reset()
+        telemetry.disable()
+
+
+# ---------------------------------------------------------------------------
+# disaggregated prefill -> decode
+# ---------------------------------------------------------------------------
+
+def test_disaggregated_handoff_bit_identical(lm):
+    """The acceptance pin: disaggregated-mode greedy rows are
+    bit-identical to the single-engine engine's rows AND to solo
+    generate(), across mixed lengths (including sub-granule prompts
+    that skip the prefill tier)."""
+    rng = np.random.default_rng(15)
+    prompts = [rng.integers(1, 50, int(n)).astype(np.int32)
+               for n in [3, 9, 17, 25, 33, 40, 17, 33]]
+    budgets = [int(b) for b in rng.integers(2, 12, len(prompts))]
+    de = DisaggregatedEngine(lm, decode_slots=4, prefill_slots=2,
+                             prefix_granularity=8, prefill_chunk=8)
+    try:
+        futs = [de.submit_generate_async(p, m)
+                for p, m in zip(prompts, budgets)]
+        dis_rows = [f.result(120) for f in futs]
+        st = de.stats()
+    finally:
+        de.shutdown()
+    single = GenerationScheduler(lm, slots=4, prefill_chunk=8,
+                                 prefix_cache_bytes=1 << 24,
+                                 prefix_granularity=8)
+    try:
+        futs = [single.submit_async(p, m)
+                for p, m in zip(prompts, budgets)]
+        single_rows = [f.result(120) for f in futs]
+    finally:
+        single.shutdown()
+    for p, m, dr, sr in zip(prompts, budgets, dis_rows, single_rows):
+        assert np.array_equal(dr, sr), "disaggregated != single-engine"
+        assert np.array_equal(dr, solo(lm, p, m)), \
+            "disaggregated != solo generate()"
+    # the split actually happened: the prefill tier served the
+    # granule-sized prompts, and decode admits hit the shared cache
+    assert st["prefill_engine"]["requests_done"] >= 6
+    assert st["handoffs"] == len(prompts)
+    assert st["prefix_cache"]["hits"] >= 6
+
+
+def test_disaggregated_decode_admits_only_cache_resident(lm):
+    """The admission gate: once the prefill tier published a prompt's
+    chunks, the decode engine's admission match covers the whole
+    granularity-aligned region — its chunk-prefill work is only ever
+    the sub-granule tail."""
+    rng = np.random.default_rng(16)
+    p = rng.integers(1, 50, 33).astype(np.int32)   # region 32 = 4*8
+    de = DisaggregatedEngine(lm, decode_slots=2, prefill_slots=2,
+                             prefix_granularity=8, prefill_chunk=8)
+    try:
+        row = de.submit_generate_async(p, 4).result(120)
+        st = de.stats()
+    finally:
+        de.shutdown()
+    assert np.array_equal(row, solo(lm, p, 4))
+    # region is granularity-aligned: decode prefilled NOTHING
+    assert st["prefill_calls"] == 0, \
+        "decode engine ran prefill work the prefill tier owned"
+    assert st["prefix_chunks_copied"] == 4
+    assert st["prefill_engine"]["prefill_calls"] > 0
+
+
+def test_prefill_role_engine_requires_cache_and_accepts_zero_budget(lm):
+    with pytest.raises(ValueError):
+        GenerationScheduler(lm, slots=2, role="prefill")
+    eng = GenerationScheduler(lm, slots=2, role="prefill",
+                              prefix_cache_bytes=1 << 24,
+                              prefix_granularity=8, prefill_chunk=8)
+    try:
+        rng = np.random.default_rng(17)
+        p = rng.integers(1, 50, 17).astype(np.int32)
+        row = eng.submit_async(p, 0).result(60)
+        assert np.array_equal(row, p)      # prompt back, no decode
+        st = eng.stats()
+        assert st["role"] == "prefill"
+        assert st["decode_steps"] == 0
+        assert st["prefix_cache"]["inserts"] == 2
+    finally:
+        eng.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# router CLI fabric
+# ---------------------------------------------------------------------------
+
+def test_cli_fabric_replicas(capsys):
+    from bigdl_tpu.serving.__main__ import main
+    rc = main(["--model", "transformer_lm_tiny", "--generate", "4",
+               "--slots", "2", "--replicas", "2", "--synthetic", "5"])
+    assert rc == 0
+    out, err = capsys.readouterr()
+    rows = [ln for ln in out.strip().splitlines() if ln]
+    assert len(rows) == 5
+    stats = json.loads(err.strip().splitlines()[-1])
+    assert stats["router"]["replicas"] == 2
+    assert stats["router"]["outcomes"].get("ok") == 5
+    assert stats["fleet"]["processes"] == 2
+
+
+def test_cli_replicas_without_generate_rejected(capsys):
+    from bigdl_tpu.serving.__main__ import main
+    rc = main(["--model", "lenet5", "--replicas", "2",
+               "--synthetic", "1"])
+    assert rc == 2
+
+
+# ---------------------------------------------------------------------------
+# soak (slow): sustained RPS over the fabric with the PR-7 forensics
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_soak_sustained_rps_fleet_watched(lm, tmp_path):
+    """Sustained sessioned traffic over a 3-replica fabric: every
+    request completes or is shed TYPED (no timeouts), the PR-7 fleet
+    table derives from the replica snapshots (straggler detection over
+    the fleet), and the OOM forensics report is armed and readable."""
+    d = str(tmp_path)
+    reps = [_replica(lm, i, d, slots=4) for i in range(3)]
+    router = Router(replicas=reps, snapshot_dir=d, poll_interval_s=0.02,
+                    slo_ttft_p99_s=30.0, queue_capacity=64)
+    rng = np.random.default_rng(18)
+    futs = []
+    try:
+        t_end = time.perf_counter() + 8.0
+        i = 0
+        while time.perf_counter() < t_end:
+            futs.append(router.submit_generate_async(
+                rng.integers(1, 50, int(rng.integers(3, 30))).astype(
+                    np.int32),
+                int(rng.integers(2, 10)), session=f"user-{i % 16}"))
+            i += 1
+            time.sleep(0.01)      # ~100 rps offered
+        ok = shed = 0
+        for f in futs:
+            try:
+                f.result(120)
+                ok += 1
+            except (RequestSheddedError, NoReplicaAvailableError):
+                shed += 1
+        assert ok + shed == len(futs)
+        assert ok > 0
+        # straggler detection over the replica fleet: same files, same
+        # derivation as the training fleet monitor
+        fleet = router.registry.fleet()
+        assert fleet is not None and fleet["processes"] == 3
+        assert fleet["slowest_process"] in (0, 1, 2)
+        assert fleet["skew"] >= 1.0
+        # OOM forensics armed over the fleet host
+        from bigdl_tpu.telemetry.runtime import (
+            device_memory_snapshot, oom_forensics_report,
+        )
+        report = oom_forensics_report("RESOURCE_EXHAUSTED: probe", None)
+        assert "devices" in report and "rss_bytes" in report
+        assert device_memory_snapshot() is not None
+    finally:
+        router.shutdown()
