@@ -27,7 +27,8 @@ import logging
 from typing import Any, Dict, Iterator, Optional
 
 __all__ = ["PIPELINE_STATE_VERSION", "PipelineState", "epoch_iter",
-           "skip_batches", "supports_epoch", "dataset_seed"]
+           "skip_batches", "skip_samples", "supports_epoch",
+           "dataset_seed"]
 
 logger = logging.getLogger("bigdl_tpu.data")
 
@@ -41,28 +42,58 @@ class PipelineState:
     * ``seed``   — the permutation seed the epoch orders derive from;
     * ``epoch``  — the epoch whose order was being consumed;
     * ``offset`` — post-transform batches already consumed (stepped)
-      within that epoch;
+      within that epoch ON THE WRITING PROCESS — a per-host count,
+      meaningful only at the writing topology;
+    * ``global_offset`` / ``process_count`` / ``global_batch`` — the
+      topology-portable position: SAMPLES consumed globally within the
+      epoch, plus the writing process count and global batch size.
+      Because every process consumes the same number of lockstep
+      batches and ``DistributedDataSet`` shards ``order[pid::nproc]``
+      of ONE global permutation, the consumed set is always a prefix
+      of the global epoch order — so a resume on an M-process fleet
+      converts ``global_offset`` into per-host sample skips instead of
+      trusting the N-process batch count (which would silently skip
+      the WRONG samples under a changed topology);
     * ``sampler`` — the mixing sampler's configuration
       (``MixedDataSet.sampler_state()``), present so restore can verify
       the mixture it is resuming into draws the same choice sequence.
 
     ``snapshot()``/``restore()`` round-trip through a plain JSON-able
-    dict — the wire format the checkpoint manifest CRCs.
+    dict — the wire format the checkpoint manifest CRCs.  The global
+    fields are additive (still version 1): a sidecar without them
+    restores exactly as before at the SAME topology, and falls back to
+    epoch-start replay at a different one.
     """
 
-    __slots__ = ("seed", "epoch", "offset", "sampler")
+    __slots__ = ("seed", "epoch", "offset", "sampler", "global_offset",
+                 "process_count", "global_batch")
 
     def __init__(self, seed: int, epoch: int = 1, offset: int = 0,
-                 sampler: Optional[Dict] = None):
+                 sampler: Optional[Dict] = None,
+                 global_offset: Optional[int] = None,
+                 process_count: Optional[int] = None,
+                 global_batch: Optional[int] = None):
         self.seed = int(seed)
         self.epoch = int(epoch)
         self.offset = int(offset)
         self.sampler = sampler
+        self.global_offset = (None if global_offset is None
+                              else int(global_offset))
+        self.process_count = (None if process_count is None
+                              else int(process_count))
+        self.global_batch = (None if global_batch is None
+                             else int(global_batch))
 
     def snapshot(self) -> Dict[str, Any]:
         out: Dict[str, Any] = {"version": PIPELINE_STATE_VERSION,
                                "seed": self.seed, "epoch": self.epoch,
                                "offset": self.offset}
+        if self.global_offset is not None:
+            out["global_offset"] = self.global_offset
+        if self.process_count is not None:
+            out["process_count"] = self.process_count
+        if self.global_batch is not None:
+            out["global_batch"] = self.global_batch
         if self.sampler is not None:
             out["sampler"] = self.sampler
         return out
@@ -76,11 +107,15 @@ class PipelineState:
                 f"(supported: {PIPELINE_STATE_VERSION})")
         return cls(seed=snapshot["seed"], epoch=snapshot["epoch"],
                    offset=snapshot.get("offset", 0),
-                   sampler=snapshot.get("sampler"))
+                   sampler=snapshot.get("sampler"),
+                   global_offset=snapshot.get("global_offset"),
+                   process_count=snapshot.get("process_count"),
+                   global_batch=snapshot.get("global_batch"))
 
     def __repr__(self) -> str:  # pragma: no cover - debugging aid
         return (f"PipelineState(seed={self.seed}, epoch={self.epoch}, "
-                f"offset={self.offset})")
+                f"offset={self.offset}, "
+                f"global_offset={self.global_offset})")
 
 
 def dataset_seed(dataset) -> int:
@@ -133,3 +168,27 @@ def skip_batches(it: Iterator, n: int) -> int:
             break
         skipped += 1
     return skipped
+
+
+def skip_samples(it: Iterator, n_samples: int) -> tuple:
+    """Advance ``it`` until ``n_samples`` SAMPLES (summed ``b.size()``
+    over pulled batches) have been consumed — the topology-portable
+    form of :func:`skip_batches`, used when a checkpoint written on an
+    N-process fleet resumes on M processes and the per-host sample
+    count (not the per-host batch count) is what the global offset
+    converts to.  Returns ``(batches_skipped, samples_skipped)``; the
+    caller must verify ``samples_skipped == n_samples`` — an overshoot
+    means the skip point lands MID-batch on the new batch size (the
+    resume cannot split a batch and must fall back to epoch-start
+    replay), an undershoot means the epoch was shorter than the
+    recorded offset."""
+    want = int(n_samples)
+    batches = samples = 0
+    while samples < want:
+        try:
+            b = next(it)
+        except StopIteration:
+            break
+        batches += 1
+        samples += int(b.size())
+    return batches, samples

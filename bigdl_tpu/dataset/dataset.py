@@ -25,7 +25,17 @@ dataset object.  Consequences the checkpointable-pipeline service
   actually remix across epochs (the old scheme froze each host's
   round-robin shard at construction and only shuffled within it);
 * ``transform()`` copies share no RNG stream — sibling iteration order
-  cannot depend on how many draws the other copy made.
+  cannot depend on how many draws the other copy made;
+* **elastic-resume prefix invariant**: because host ``p`` takes
+  ``order[p::nproc]`` of the one global order and all hosts consume
+  lockstep batches, the set of samples the fleet has consumed after
+  any step is a PREFIX of the global permutation — which is what lets
+  a checkpoint's pipeline position be stored as one global sample
+  offset and re-sliced onto a DIFFERENT process count on resume
+  (docs/fault_tolerance.md "Elastic resume (N->M)").  Changing the
+  interleaved ``[p::nproc]`` sharding scheme (e.g. to contiguous
+  blocks) silently breaks N->M resume; tests/test_elastic_resume.py
+  and dist_worker leg 6 pin it.
 """
 
 from __future__ import annotations

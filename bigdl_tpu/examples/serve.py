@@ -42,6 +42,7 @@ from __future__ import annotations
 import argparse
 import json
 import logging
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 logger = logging.getLogger("bigdl_tpu.serve")
@@ -266,11 +267,20 @@ def main(argv=None):
         )
         from bigdl_tpu.telemetry.fleet import write_host_snapshot
 
+        # incarnation stamp, taken once at process start: a restart
+        # under the same --replica-id publishes a strictly larger
+        # generation, so the registry can tell the new life's
+        # snapshots from the dying publisher's final (draining) write
+        # racing them — without it, that stale write masks the
+        # restarted replica (ReplicaRegistry.poll rewarming)
+        start_generation = int(time.time() * 1000)
+
         def _publish_snapshot():
             write_host_snapshot(args.fleet_dir, replica_snapshot(
                 args.replica_id, gen_server or batcher,
                 name=f"serve-{args.replica_id}", role="mixed",
-                draining=bool(server.health_state.get("draining"))))
+                draining=bool(server.health_state.get("draining")),
+                start_generation=start_generation))
 
         publisher = SnapshotPublisher(_publish_snapshot,
                                       interval_s=0.25)

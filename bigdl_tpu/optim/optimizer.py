@@ -57,7 +57,7 @@ from bigdl_tpu.parallel.sharding import (
 from bigdl_tpu import telemetry
 from bigdl_tpu.data.pipeline import (
     PipelineState, dataset_seed, epoch_iter, skip_batches,
-    supports_epoch, PIPELINE_STATE_VERSION,
+    skip_samples, supports_epoch, PIPELINE_STATE_VERSION,
 )
 from bigdl_tpu.telemetry import events as _te
 from bigdl_tpu.telemetry import families as _tm, tracing as _tt
@@ -203,6 +203,13 @@ class Optimizer:
         self._pipeline_restore: Optional[Dict[str, Any]] = None
         self.device_prefetch_ahead: Optional[int] = None
         self._active_dp = None
+        # elastic (N->M) resume bookkeeping: the global batch size the
+        # last step consumed (recorded in the pipeline sidecar so a
+        # resume at a different width can sanity-check its own), and
+        # the topology manifest of the checkpoint being resumed (None
+        # = fresh run, or a pre-elastic checkpoint without one)
+        self._last_global_batch: Optional[int] = None
+        self._resume_topology: Optional[Dict[str, Any]] = None
 
     # ---- configuration (reference Optimizer.scala setters) -------------
 
@@ -1368,11 +1375,25 @@ class Optimizer:
             except Exception:  # pragma: no cover - exotic wrapper
                 logger.exception("dataset.sampler_state() failed; "
                                  "checkpointing without sampler state")
+        # the topology-portable position: state["records"] counts
+        # GLOBAL samples consumed this epoch (reset at each epoch
+        # start, restored across resumes), which is exactly the prefix
+        # of the global epoch permutation the fleet has consumed —
+        # independent of how many processes consumed it.  The local
+        # batch `offset` stays for same-topology restores of ragged
+        # setups; a changed process count resumes from global_offset.
         snap = PipelineState(
             seed=dataset_seed(self.dataset),
             epoch=int(self.state["epoch"]),
             offset=int(self._epoch_offset),
-            sampler=sampler).snapshot()
+            sampler=sampler,
+            # at an epoch boundary `records` still holds the finished
+            # epoch's total while the snapshot already names the NEXT
+            # epoch — the global offset there is 0, like the local one
+            global_offset=(int(self.state.get("records", 0))
+                           if self._epoch_offset > 0 else 0),
+            process_count=int(jax.process_count()),
+            global_batch=self._last_global_batch).snapshot()
         # cross-check token: the payload this snapshot belongs to (the
         # checkpoint generation IS neval).  In overwrite mode a crash
         # between the payload rename and the sidecar write can leave
@@ -1383,24 +1404,63 @@ class Optimizer:
         snap["generation"] = int(self.state["neval"])
         return snap
 
-    def _pipeline_restore_skip(self, ps: Dict[str, Any],
-                               epoch: int) -> int:
-        """Batches of THIS epoch the restored PipelineState says were
-        already consumed — the count the epoch iterator must skip for
-        sample-accurate resume.  Returns 0 (epoch-start replay, the
-        always-safe fallback) whenever the snapshot cannot be applied
-        faithfully: version/seed mismatch, a different epoch, or a
-        dataset whose order isn't replayable across restarts.  A
-        mismatched mixing-sampler configuration raises instead — that
-        resume would silently train on a different sample sequence
-        while claiming accuracy."""
+    def _topology_delta(self, mesh) -> Tuple[bool, str, str]:
+        """Did the topology change between the checkpoint being
+        resumed and the live fleet?  Returns ``(changed, saved_desc,
+        current_desc)``; never raises (a manifest-less checkpoint
+        compares as unchanged — the pre-elastic contract)."""
+        from bigdl_tpu.parallel.mesh import mesh_axes
+        from bigdl_tpu.utils.file import describe_topology
+        saved = self._resume_topology
+        cur = {"process_count": int(jax.process_count()),
+               "device_count": int(jax.device_count()),
+               "mesh": mesh_axes(mesh)}
+        if not saved:
+            return False, describe_topology(saved), \
+                describe_topology(cur)
+        try:
+            changed = (
+                int(saved.get("process_count",
+                              cur["process_count"]))
+                != cur["process_count"]
+                or int(saved.get("device_count", cur["device_count"]))
+                != cur["device_count"]
+                or (saved.get("mesh") is not None
+                    and {str(a): int(s)
+                         for a, s in saved["mesh"].items()}
+                    != cur["mesh"]))
+        except (TypeError, ValueError):  # malformed manifest record
+            changed = False
+        return changed, describe_topology(saved), describe_topology(cur)
+
+    def _note_reshard(self, outcome: str) -> None:
+        """One ``checkpoint_reshard_restores_total{outcome}`` tick
+        (no-op with telemetry off): resharded / fallback / failed."""
+        if telemetry.enabled():
+            _tm.checkpoint_reshard_restores_total().labels(outcome).inc()
+
+    def _pipeline_restore_plan(self, ps: Dict[str, Any],
+                               epoch: int) -> Tuple[str, int]:
+        """How to reposition the epoch iterator for sample-accurate
+        resume: ``("batches", n)`` (same-topology legacy skip of n
+        post-transform batches), ``("samples", n)`` (topology-portable
+        skip of n SAMPLES per process, converted from the sidecar's
+        global offset onto the CURRENT process count), or ``("none",
+        0)`` (epoch-start replay, the always-safe fallback) whenever
+        the snapshot cannot be applied faithfully: version/seed
+        mismatch, a different epoch, a changed process count without
+        the global-offset fields, a global offset the new topology
+        cannot divide, or a dataset whose order isn't replayable
+        across restarts.  A mismatched mixing-sampler configuration
+        raises instead — that resume would silently train on a
+        different sample sequence while claiming accuracy."""
         try:
             if int(ps.get("version", -1)) != PIPELINE_STATE_VERSION:
                 logger.warning(
                     "pipeline state version %s unsupported (want %d); "
                     "replaying the epoch from its start",
                     ps.get("version"), PIPELINE_STATE_VERSION)
-                return 0
+                return ("none", 0)
             gen = ps.get("generation")
             if gen is not None and int(gen) != int(self.state["neval"]):
                 logger.warning(
@@ -1408,34 +1468,70 @@ class Optimizer:
                     "iteration %s (stale sidecar from an interrupted "
                     "overwrite commit?); replaying the epoch from its "
                     "start", gen, self.state["neval"])
-                return 0
+                return ("none", 0)
             if int(ps.get("epoch", -1)) != int(epoch):
-                return 0  # epoch-boundary snapshot: nothing to skip
+                return ("none", 0)  # epoch-boundary: nothing to skip
             offset = int(ps.get("offset", 0))
+            go = ps.get("global_offset")
+            go = None if go is None else int(go)
+            saved_pc = ps.get("process_count")
+            saved_pc = None if saved_pc is None else int(saved_pc)
         except (TypeError, ValueError):
             logger.warning("malformed pipeline state %r; replaying the "
                            "epoch from its start", ps)
-            return 0
-        if offset <= 0:
-            return 0
+            return ("none", 0)
+        pc_now = int(jax.process_count())
+        if saved_pc is None:
+            # legacy sidecar: the checkpoint manifest's topology record
+            # is the only witness of the writing process count
+            topo_pc = (self._resume_topology or {}).get("process_count")
+            saved_pc = None if topo_pc is None else int(topo_pc)
+        if go is None:
+            # sidecar predates the global-offset fields: its batch
+            # offset is a PER-HOST count, only meaningful at the
+            # writing topology
+            if saved_pc is not None and saved_pc != pc_now:
+                logger.warning(
+                    "pipeline sidecar was written at process_count=%d "
+                    "and carries no global offset; resuming at "
+                    "process_count=%d would skip the WRONG samples — "
+                    "replaying the epoch from its start (re-checkpoint "
+                    "once to upgrade the sidecar)", saved_pc, pc_now)
+                self._note_reshard("fallback")
+                return ("none", 0)
+            if offset <= 0:
+                return ("none", 0)
+            plan: Tuple[str, int] = ("batches", offset)
+        else:
+            if go <= 0:
+                return ("none", 0)
+            if go % pc_now:
+                logger.warning(
+                    "pipeline global offset %d (written at "
+                    "process_count=%s) does not divide across the "
+                    "current %d process(es); replaying the epoch from "
+                    "its start", go, saved_pc, pc_now)
+                self._note_reshard("fallback")
+                return ("none", 0)
+            plan = ("samples", go // pc_now)
         seed_now = dataset_seed(self.dataset)
         if int(ps.get("seed", seed_now)) != seed_now:
             logger.warning(
                 "pipeline state seed %s != current dataset seed %d: the "
-                "epoch order differs, so skipping %d batches would drop "
+                "epoch order differs, so skipping %d %s would drop "
                 "the WRONG samples; replaying the epoch from its start",
-                ps.get("seed"), seed_now, offset)
-            return 0
+                ps.get("seed"), seed_now, plan[1], plan[0])
+            return ("none", 0)
         if not supports_epoch(self.dataset):
             logger.warning(
                 "dataset.data() does not accept the epoch keyword; its "
                 "order is not replayable across a restart — replaying "
                 "the epoch from its start (see docs/data_pipeline.md)")
-            return 0
+            return ("none", 0)
         restore_fn = getattr(self.dataset, "restore_sampler", None)
         if callable(restore_fn):
             restore_fn(ps.get("sampler"))  # raises on config mismatch
-        return offset
+        return plan
 
     # ---- main loop (≙ DistriOptimizer.optimize, :823) --------------------
 
@@ -1467,6 +1563,26 @@ class Optimizer:
                     self._stop_device_prefetch()
                     self._stop_flush_worker()
                     self._flush_summaries()  # keep the failed tail
+                    if isinstance(e, chaos.ReshardInjected):
+                        # the fleet regranted capacity at a different
+                        # width: the retry resumes from latest_good()
+                        # on the RESHAPED mesh — the in-process
+                        # simulation of a lost slice rejoining at
+                        # whatever the scheduler grants
+                        old_axes = dict(self.mesh_config.axes)
+                        to = e.reshard_to
+                        new_axes = (dict(to) if isinstance(to, dict)
+                                    else {"data": int(to)})
+                        self.mesh_config = MeshConfig(**new_axes)
+                        _te.record_event(
+                            "reshard", step=self.state.get("neval"),
+                            epoch=self.state.get("epoch"),
+                            old_axes=old_axes, new_axes=new_axes)
+                        logger.warning(
+                            "chaos reshard: fleet width changed — the "
+                            "retry will rebuild the mesh as %s (was "
+                            "%s) and resume from the latest good "
+                            "checkpoint", new_axes, old_axes)
                     if _is_oom(e):
                         # the most common hard-to-debug multi-chip
                         # failure: capture what held the memory BEFORE
@@ -1584,9 +1700,17 @@ class Optimizer:
             and getattr(self.val_dataset, "per_process_sharded",
                         lambda: False)())
 
-        from bigdl_tpu.utils.file import is_sharded_checkpoint_path
+        from bigdl_tpu.utils.file import (
+            is_sharded_checkpoint_path, load_checkpoint_topology,
+        )
         resume_sharded = bool(self._resume_from) \
             and is_sharded_checkpoint_path(self._resume_from)
+        # the writing topology, from the manifest beside the payload
+        # (None for manifest-less / pre-elastic checkpoints): drives
+        # the resharded-restore diagnostics and the legacy-sidecar
+        # fallback in _pipeline_restore_plan
+        self._resume_topology = (load_checkpoint_topology(
+            self._resume_from) if self._resume_from else None)
         saved_opt = None
         if self._resume_from and not resume_sharded:
             model_state, saved_opt, driver = load_checkpoint(
@@ -1599,16 +1723,33 @@ class Optimizer:
                         self._resume_from, self.state["epoch"],
                         self.state["neval"])
 
-        model = shard_model_params(model, mesh, self.sharding_rules)
-        (params_groups, rest, group_names, methods, opt_states,
-         spec_groups) = self._setup_step_state(model)
         if resume_sharded:
-            # restore INTO the sharded layout: the freshly-built (and
-            # already sharded) params/opt-state trees provide the
-            # abstract targets, so each host reads only its own shards
+            # Resharded/sharded resume goes through the ABSTRACT tree
+            # end to end: the model is lowered to shape/dtype/sharding
+            # structs (no device_put, no leaf read — on the in-process
+            # retry path the model's leaves are the crashed attempt's
+            # DONATED buffers, which must not be touched, and restore
+            # overwrites them anyway), the opt states come from
+            # _abstract_opt_state avals (never allocating the
+            # momentum/variance buffers restore is about to replace),
+            # and orbax reads each shard straight into the CURRENT
+            # mesh's shardings — which need not be the writing mesh.
+            from bigdl_tpu.parallel.sharding import model_shardings
             from bigdl_tpu.utils.file import load_checkpoint_sharded
-
             from jax.sharding import NamedSharding, PartitionSpec
+
+            shardings = model_shardings(model, mesh,
+                                        self.sharding_rules)
+            m_leaves, m_treedef = jax.tree_util.tree_flatten(model)
+            s_leaves = jax.tree_util.tree_leaves(
+                shardings,
+                is_leaf=lambda x: isinstance(x, NamedSharding))
+            abs_model = jax.tree_util.tree_unflatten(m_treedef, [
+                jax.ShapeDtypeStruct(l.shape, l.dtype, sharding=s)
+                for l, s in zip(m_leaves, s_leaves)])
+            (params_groups, rest, group_names, methods, opt_states,
+             spec_groups) = self._setup_step_state(
+                 abs_model, abstract_state=True)
 
             def _abstract(x):
                 sh = getattr(x, "sharding", None)
@@ -1621,8 +1762,8 @@ class Optimizer:
                 return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sh)
 
             abstract = jax.tree_util.tree_map(_abstract, {
-                "model": {"params": model.parameters(),
-                          "buffers": model.buffers()},
+                "model": {"params": abs_model.parameters(),
+                          "buffers": abs_model.buffers()},
                 "optim": opt_states,
                 # driver scalars live inside the same orbax tree (one
                 # atomic commit); current state supplies the dtypes,
@@ -1644,9 +1785,25 @@ class Optimizer:
             logger.info("resumed sharded checkpoint %s at epoch %s "
                         "iteration %s", self._resume_from,
                         self.state["epoch"], self.state["neval"])
-        elif self._resume_from:
+        else:
+            model = shard_model_params(model, mesh, self.sharding_rules)
+            (params_groups, rest, group_names, methods, opt_states,
+             spec_groups) = self._setup_step_state(model)
+        if self._resume_from and not resume_sharded:
             saved = jax.tree_util.tree_map(jnp.asarray, saved_opt)
             opt_states = saved
+
+        if self._resume_from:
+            changed, saved_d, cur_d = self._topology_delta(mesh)
+            if changed:
+                logger.warning(
+                    "resharded resume: checkpoint written by %s, "
+                    "restored onto %s — weights/optimizer state "
+                    "resharded onto the current mesh; pipeline "
+                    "position converts via the sidecar's global "
+                    "offset (or falls back to epoch-start replay)",
+                    saved_d, cur_d)
+                self._note_reshard("resharded")
 
         # PipelineState sidecar (written by CheckpointManager next to
         # the payload, CRC'd in the same manifest): the iterator
@@ -1662,6 +1819,10 @@ class Optimizer:
                                 health=wd is not None)
         eval_step = self._build_eval_step() if self.val_methods else None
         x_sharding = batch_sharding(mesh)
+        # checkpoints record the mesh they were written from (the
+        # manifest's topology record; .npz leaves are gathered to
+        # plain numpy, so the mesh cannot be recovered from them)
+        self._active_mesh = mesh
 
         seed_key = jax.random.key(get_seed())
         total_records = self.dataset.size()
@@ -2053,39 +2214,78 @@ class Optimizer:
             while not self.end_when(self.state):
                 epoch = self.state["epoch"]
                 epoch_start = time.perf_counter()
-                skip = 0
+                mode, skip = "none", 0
                 if pipeline_restore is not None:
-                    skip = self._pipeline_restore_skip(pipeline_restore,
-                                                       epoch)
+                    mode, skip = self._pipeline_restore_plan(
+                        pipeline_restore, epoch)
                     pipeline_restore = None  # applies to one epoch only
                 if skip <= 0:
                     self.state["records"] = 0
                 # else: mid-epoch resume — the restored driver records
                 # already count this epoch's consumed samples
-                self._epoch_offset = max(skip, 0)
+                self._epoch_offset = 0
                 batch_iter = iter(epoch_iter(self.dataset, epoch=epoch,
                                              train=True))
                 if skip > 0:
                     t_skip = time.perf_counter()
-                    skipped = skip_batches(batch_iter, skip)
+                    fell_back = False
+                    if mode == "samples":
+                        # topology-portable resume: the sidecar's
+                        # global offset converted to per-process
+                        # SAMPLES on the current fleet width
+                        skipped_b, skipped_s = skip_samples(batch_iter,
+                                                            skip)
+                        if skipped_s > skip:
+                            # the skip point lands MID-batch on the
+                            # new batch size: a batch cannot be split,
+                            # so the only faithful option is replay
+                            logger.warning(
+                                "pipeline restore: global offset "
+                                "lands mid-batch on the current batch "
+                                "size (%d samples to skip, batch "
+                                "boundary at %d); replaying epoch %d "
+                                "from its start", skip, skipped_s,
+                                epoch)
+                            self._note_reshard("fallback")
+                            _te.record_event(
+                                "pipeline_restore", epoch=epoch,
+                                offset=skip, mode=mode, skipped=0,
+                                fallback="mid_batch")
+                            self.state["records"] = 0
+                            batch_iter = iter(epoch_iter(
+                                self.dataset, epoch=epoch, train=True))
+                            skipped_b = 0
+                            fell_back = True
+                        skipped = skipped_b
+                        want = skip
+                        got = skipped_s
+                    else:
+                        skipped = skip_batches(batch_iter, skip)
+                        want, got = skip, skipped
+                    self._epoch_offset = skipped
                     saw_batches = True  # consumed pre-crash, not absent
-                    _te.record_event(
-                        "pipeline_restore", epoch=epoch, offset=skip,
-                        skipped=skipped,
-                        seconds=round(time.perf_counter() - t_skip, 6))
-                    if telemetry.enabled():
-                        _tm.pipeline_restore_skipped_batches_total().inc(
-                            skipped)
-                    logger.info(
-                        "pipeline restore: skipped %d consumed batch(es) "
-                        "of epoch %d, resuming at the next batch "
-                        "(sample-accurate)", skipped, epoch)
-                    if skipped < skip:
+                    if not fell_back:
+                        _te.record_event(
+                            "pipeline_restore", epoch=epoch,
+                            offset=skip, mode=mode, skipped=skipped,
+                            seconds=round(
+                                time.perf_counter() - t_skip, 6))
+                        if telemetry.enabled():
+                            _tm.pipeline_restore_skipped_batches_total(
+                            ).inc(skipped)
+                        logger.info(
+                            "pipeline restore: skipped %d consumed "
+                            "batch(es) of epoch %d (%s mode), resuming "
+                            "at the next batch (sample-accurate)",
+                            skipped, epoch, mode)
+                    if not fell_back and got < want:
                         logger.warning(
                             "pipeline restore: epoch %d has only %d "
-                            "batch(es) but the checkpoint consumed %d — "
+                            "%s but the checkpoint consumed %d — "
                             "did the dataset shrink since the "
-                            "checkpoint?", epoch, skipped, skip)
+                            "checkpoint?", epoch, got,
+                            "sample(s)" if mode == "samples"
+                            else "batch(es)", want)
                 dp = None
                 if use_dp:
                     from bigdl_tpu.data.device_prefetch import (
@@ -2248,6 +2448,7 @@ class Optimizer:
                     for b, loss_i in zip(group, loss_list):
                         # records are GLOBAL: b.size() is per-process
                         n = b.size() * nproc
+                        self._last_global_batch = n
                         self.state["records"] += n
                         pending.append((self.state["neval"], epoch, n,
                                         self.state["records"], loss_i))
@@ -2433,6 +2634,7 @@ class Optimizer:
         atomic payload commit, CRC manifest, retention GC."""
         mgr = self._ckpt_manager()
         pipeline_state = self._pipeline_snapshot()
+        mesh = getattr(self, "_active_mesh", None)
         if self.checkpoint_sharded:
             # device arrays pass through unchanged: each host writes
             # its own shards, no gather.  The driver rides inside the
@@ -2445,7 +2647,7 @@ class Optimizer:
                 {k: driver[k] for k in _DRIVER_KEYS if k in driver},
                 generation=self.state["neval"],
                 overwrite=self.overwrite_checkpoint, sharded=True,
-                pipeline_state=pipeline_state)
+                pipeline_state=pipeline_state, mesh=mesh)
         else:
             path = mgr.save(
                 {"params": _to_plain(temp.parameters()),
@@ -2453,7 +2655,7 @@ class Optimizer:
                 [s for s in opt_states], driver,
                 generation=self.state["neval"],
                 overwrite=self.overwrite_checkpoint, sharded=False,
-                pipeline_state=pipeline_state)
+                pipeline_state=pipeline_state, mesh=mesh)
         # /statusz reports the last generation this run committed
         self._last_ckpt_generation = self.state["neval"]
         self._last_ckpt_path = path

@@ -34,7 +34,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = ["make_mesh", "data_parallel_mesh", "MeshConfig", "P",
            "NamedSharding", "Mesh", "local_device_count",
-           "batch_sharding", "shard_map_compat", "axis_coord_maps"]
+           "batch_sharding", "shard_map_compat", "axis_coord_maps",
+           "mesh_axes"]
 
 
 def shard_map_compat(f, mesh, in_specs, out_specs):
@@ -188,6 +189,15 @@ def axis_coord_maps(mesh: Mesh) -> Dict[str, Dict[int, int]]:
         coords = np.indices(mesh.devices.shape)[ai].reshape(-1)
         out[axis] = {i: int(coords[i]) for i in range(n)}
     return out
+
+
+def mesh_axes(mesh: Mesh) -> Dict[str, int]:
+    """``{axis: size}`` of a mesh — the canonical topology rendering
+    the checkpoint manifest records (``utils/file.checkpoint_topology``)
+    and the elastic N->M resume compares against the live mesh to
+    decide whether a restore is resharding."""
+    return {str(a): int(s) for a, s in
+            zip(mesh.axis_names, mesh.devices.shape)}
 
 
 def data_parallel_mesh(devices=None) -> Mesh:

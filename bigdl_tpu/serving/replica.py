@@ -82,12 +82,22 @@ def _target_queue_depth(target) -> int:
 
 def replica_snapshot(replica_id: int, target=None, name: str = "",
                      role: str = "mixed", draining: bool = False,
-                     healthy: bool = True) -> Dict[str, Any]:
+                     healthy: bool = True,
+                     start_generation: Optional[int] = None) \
+        -> Dict[str, Any]:
     """One replica's health snapshot: the fleet ``host_stats`` vector
     (so :func:`merge_host_snapshots` derives a straggler table from
     the very same files) extended with the serving-plane fields the
     router routes on.  ``target`` is optional — a replica with no
-    generation engine yet still reports health and drain state."""
+    generation engine yet still reports health and drain state.
+
+    ``start_generation`` stamps which INCARNATION of the replica wrote
+    the snapshot (a wall-clock-ms stamp taken at construction, so a
+    restart under the same id always advances it).  The registry uses
+    it to tell a fresh post-restart replica apart from its own stale
+    pre-restart snapshot — without the stamp, a dying publisher's
+    final write (draining: true, the old life's TTFT tail) can land
+    AFTER the restarted replica's first publish and mask it."""
     stats = _target_stats(target) if target is not None else {}
     steps = int(stats.get("decode_steps", 0) or 0)
     snap = host_stats(
@@ -97,6 +107,8 @@ def replica_snapshot(replica_id: int, target=None, name: str = "",
     snap.update({
         "name": name or f"replica-{int(replica_id)}",
         "role": role,
+        "start_generation": (None if start_generation is None
+                             else int(start_generation)),
         "healthy": bool(healthy),
         "draining": bool(draining),
         "queue_depth": _target_queue_depth(target)
@@ -168,7 +180,8 @@ class Replica:
 
     def __init__(self, replica_id: int, target, name: Optional[str] = None,
                  role: str = "mixed", snapshot_dir: Optional[str] = None,
-                 publish_interval_s: float = 0.25):
+                 publish_interval_s: float = 0.25,
+                 start_generation: Optional[int] = None):
         if role not in ROLES:
             raise ValueError(f"role must be one of {ROLES}, got {role!r}")
         for attr in ("submit_generate_async", "shutdown"):
@@ -181,6 +194,13 @@ class Replica:
         self.name = name or f"replica-{self.id}"
         self.role = role
         self.target = target
+        # incarnation stamp: a restart under the same id constructs a
+        # new Replica and therefore a strictly larger stamp (wall ms —
+        # a cross-process ordering needs the one shared clock), so the
+        # registry can spot this life's snapshots from the last one's
+        self.start_generation = (int(start_generation)
+                                 if start_generation is not None
+                                 else int(time.time() * 1000))
         self.snapshot_dir = snapshot_dir
         self._lock = threading.Lock()
         self._draining = False
@@ -251,7 +271,8 @@ class Replica:
             closed = self._closed
         return replica_snapshot(
             self.id, self.target, name=self.name, role=self.role,
-            draining=draining, healthy=not closed)
+            draining=draining, healthy=not closed,
+            start_generation=self.start_generation)
 
     def publish(self) -> None:
         if self.snapshot_dir is not None:
@@ -321,6 +342,10 @@ class ReplicaRegistry:
         self.max_age_s = float(max_age_s)
         self._lock = threading.Lock()
         self._healthz: Dict[int, Dict[str, Any]] = {}
+        # highest start_generation seen per replica id: the witness
+        # that tells a restarted replica's fresh snapshots from its
+        # own stale pre-restart file racing them
+        self._seen_gen: Dict[int, int] = {}
 
     def observe_healthz(self, replica_id: int, status_code: int,
                         body: Optional[Dict] = None) -> None:
@@ -375,6 +400,44 @@ class ReplicaRegistry:
                 "ttft_p99_s": float(row.get("ttft_p99_s", 0.0) or 0.0),
                 "requests_done": int(row.get("requests_done", 0) or 0),
             }
+            gen = row.get("start_generation")
+            rewarming = False
+            if gen is not None:
+                gen = int(gen)
+                with self._lock:
+                    seen = self._seen_gen.get(pid)
+                    if seen is None or gen > seen:
+                        self._seen_gen[pid] = gen
+                        if seen is not None:
+                            # a NEW incarnation under the same id:
+                            # verdicts consumed from the old life's
+                            # /healthz (a 503 draining, say) must not
+                            # mask the restarted replica
+                            self._healthz.pop(pid, None)
+                            healthz.pop(pid, None)
+                    elif gen < seen:
+                        # the replica's own STALE pre-restart snapshot
+                        # (a dying publisher's final write landing
+                        # after the restart's first publish): its
+                        # drain flag and SLO tail describe the dead
+                        # life — treat the replica as a fresh,
+                        # re-warming one instead
+                        rewarming = True
+            if rewarming:
+                rec.update({
+                    "draining": False, "rewarming": True,
+                    # the old life's stats must not steer routing: no
+                    # SLO exclusion, no bounded-load penalty
+                    "ttft_p99_s": 0.0, "queue_depth": 0,
+                    "admitted_outstanding": 0,
+                })
+                if not stale:
+                    # the old life's self-reported health is as stale
+                    # as its drain flag; staleness (nobody publishing
+                    # at all) still marks the record unhealthy
+                    rec["healthy"] = True
+                    rec["reason"] = None
+                healthz.pop(pid, None)
             hz = healthz.get(pid)
             if hz is not None:
                 if hz["draining"]:

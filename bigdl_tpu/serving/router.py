@@ -477,6 +477,12 @@ class Router:
             if self.slo_ttft_p99_s is None:
                 return True
             rec = records.get(rid) or {}
+            if rec.get("rewarming"):
+                # a restarted replica masked by its own stale
+                # pre-restart snapshot: the TTFT tail in that file
+                # belongs to the dead life — route to it like a fresh
+                # join instead of excluding it on somebody else's p99
+                return True
             return rec.get("ttft_p99_s", 0.0) <= self.slo_ttft_p99_s
         if req.session is not None:
             for i, rid in enumerate(ring_order):

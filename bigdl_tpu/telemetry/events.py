@@ -36,10 +36,25 @@ import time
 from collections import deque
 from typing import Dict, List, Optional
 
-__all__ = ["record_event", "recent_events", "event_counts",
-           "dropped_events", "reset_events", "set_event_capacity",
-           "event_capacity", "events_summary", "events_dict",
-           "dumps_events", "dump_events", "json_safe"]
+__all__ = ["EVENT_KINDS", "record_event", "recent_events",
+           "event_counts", "dropped_events", "reset_events",
+           "set_event_capacity", "event_capacity", "events_summary",
+           "events_dict", "dumps_events", "dump_events", "json_safe"]
+
+# The stable event-kind vocabulary — the query keys a postmortem greps
+# for, documented in docs/observability.md "Flight recorder".  New
+# kinds are added here AND to the docs table; ``record_event`` does
+# NOT enforce membership (a broken recorder must never break the path
+# it documents), but tests pin that every shipped call site records a
+# kind from this list.
+EVENT_KINDS = (
+    "retry", "chaos_fault", "oom", "preemption", "reshard",
+    "checkpoint_commit", "checkpoint_walkback",
+    "pipeline_snapshot", "pipeline_restore",
+    "admission_shed", "watchdog", "watchdog_halt",
+    "flight_recorder_dump",
+    "replica_join", "replica_drain", "router_shed",
+)
 
 _DEFAULT_CAPACITY = 2048
 

@@ -163,6 +163,17 @@ def checkpoint_torn_generations_total() -> Counter:
         "or uncommitted")
 
 
+def checkpoint_reshard_restores_total() -> Counter:
+    return get_registry().counter(
+        "checkpoint_reshard_restores_total",
+        "Checkpoint restores onto a topology other than the one that "
+        "wrote them, by outcome: resharded (N->M resume succeeded), "
+        "fallback (pipeline position unportable — epoch-start replay), "
+        "failed (a leaf is genuinely unportable and the restore "
+        "raised)",
+        labelnames=("outcome",))
+
+
 # ---- chaos (fault injection) ----------------------------------------------
 
 def chaos_faults_injected_total() -> Counter:
@@ -433,6 +444,7 @@ _PREREGISTER = (
     hbm_bytes_peak,
     training_nonfinite_total, training_anomalies_total, grad_norm,
     checkpoint_commit_seconds, checkpoint_torn_generations_total,
+    checkpoint_reshard_restores_total,
     chaos_faults_injected_total,
     prefetch_queue_depth, prefetch_producer_wait_total,
     prefetch_consumer_wait_total,

@@ -39,6 +39,14 @@ or by environment variables (picked up lazily on the first hook call, so
   surfaces as) when training reaches this iteration, once — the seam
   the OOM-forensics pipeline is proven through without needing a real
   chip to run out of HBM.  ``1`` fires at the first step.
+* ``BIGDL_TPU_CHAOS_RESHARD`` — ``"<step>:<width>"``: raise
+  :class:`ReshardInjected` carrying the new width when training
+  reaches ``step`` (once) — a lost slice whose fleet regrants capacity
+  at a different width.  The optimizer's retry loop applies the width
+  to its mesh config and resumes from ``latest_good()`` on the
+  reshaped mesh, so the fault drives the whole N->M elastic-resume
+  path in one process.  API form: ``chaos.install(reshard_at_step=N,
+  reshard_to=width_or_axes_dict)``.
 
 Production code calls the module-level hook functions (``on_step``,
 ``on_io_write``, ``on_checkpoint_payload``, ``on_data_batch``); each is
@@ -55,9 +63,9 @@ import threading
 import time
 from typing import List, Optional
 
-__all__ = ["FaultInjected", "ChaosController", "install", "reset",
-           "active", "on_step", "on_io_write", "on_checkpoint_payload",
-           "on_data_batch"]
+__all__ = ["FaultInjected", "ReshardInjected", "ChaosController",
+           "install", "reset", "active", "on_step", "on_io_write",
+           "on_checkpoint_payload", "on_data_batch"]
 
 logger = logging.getLogger("bigdl_tpu.chaos")
 
@@ -66,6 +74,25 @@ class FaultInjected(RuntimeError):
     """A deliberately injected fault.  Subclasses RuntimeError so the
     optimizer's exception classifier treats it as transient/retryable —
     the faults it stands in for (preemption, IO blips) are."""
+
+
+class ReshardInjected(FaultInjected):
+    """A lost slice / changed fleet width: the run dies at a step
+    boundary and must resume at a DIFFERENT topology.  Carries the new
+    width the simulated scheduler grants — the optimizer's retry loop
+    applies it to the mesh config before resuming from
+    ``latest_good()``, so one ``optimize()`` call exercises the whole
+    N->M resharded-resume path in-process (see
+    docs/fault_tolerance.md "Elastic resume (N->M)")."""
+
+    def __init__(self, msg: str, reshard_to):
+        super().__init__(msg)
+        # int = the new data-parallel width; dict = full mesh axes
+        self.reshard_to = reshard_to
+
+    @property
+    def new_width(self):
+        return self.reshard_to
 
 
 class ChaosController:
@@ -78,9 +105,17 @@ class ChaosController:
                  io_fail_p: float = 0.0, seed: int = 0,
                  stall_pipeline_s: float = 0.0,
                  stall_pipeline_batches: Optional[int] = None,
-                 oom_at_step: Optional[int] = None):
+                 oom_at_step: Optional[int] = None,
+                 reshard_at_step: Optional[int] = None,
+                 reshard_to=None):
         self.fail_at_step = fail_at_step
         self.oom_at_step = oom_at_step
+        if (reshard_at_step is None) != (reshard_to is None):
+            raise ValueError(
+                "chaos.install: reshard_at_step and reshard_to come "
+                "together (the fault must carry the new width)")
+        self.reshard_at_step = reshard_at_step
+        self.reshard_to = reshard_to
         self.crash_checkpoint = crash_checkpoint
         self.truncate_checkpoint = truncate_checkpoint
         self.truncate_keep_bytes = int(truncate_keep_bytes)
@@ -112,6 +147,15 @@ class ChaosController:
             self._fire(f"injected failure at iteration {neval}")
             raise FaultInjected(f"chaos: injected failure at iteration "
                                 f"{neval}")
+        if self.reshard_at_step is not None \
+                and neval >= self.reshard_at_step:
+            to = self.reshard_to
+            self.reshard_at_step = None  # one-shot: the resume succeeds
+            self._fire(f"injected reshard at iteration {neval} "
+                       f"(new width {to})")
+            raise ReshardInjected(
+                f"chaos: slice lost at iteration {neval}; fleet "
+                f"regranted at width {to}", to)
         if self.oom_at_step is not None and neval >= self.oom_at_step:
             self.oom_at_step = None  # one-shot: the retry must succeed
             self._fire(f"injected OOM at iteration {neval}")
@@ -181,7 +225,22 @@ _env_checked = False
 
 _ENV_KEYS = ("BIGDL_TPU_CHAOS_FAIL_STEP", "BIGDL_TPU_CHAOS_CRASH_CKPT",
              "BIGDL_TPU_CHAOS_TRUNCATE_CKPT", "BIGDL_TPU_CHAOS_IO_FAIL_P",
-             "BIGDL_TPU_CHAOS_STALL_PIPELINE_S", "BIGDL_TPU_CHAOS_OOM")
+             "BIGDL_TPU_CHAOS_STALL_PIPELINE_S", "BIGDL_TPU_CHAOS_OOM",
+             "BIGDL_TPU_CHAOS_RESHARD")
+
+
+def _parse_reshard(v: Optional[str]):
+    """``"<step>:<width>"`` -> (step, width); malformed values raise
+    at arm time, not at fire time."""
+    if not v:
+        return None, None
+    try:
+        step, width = v.split(":", 1)
+        return int(step), int(width)
+    except ValueError as e:
+        raise ValueError(
+            f"BIGDL_TPU_CHAOS_RESHARD must be '<step>:<width>' "
+            f"(e.g. '5:2'), got {v!r}") from e
 
 
 def _from_env() -> Optional[ChaosController]:
@@ -193,6 +252,8 @@ def _from_env() -> Optional[ChaosController]:
         v = e.get(name)
         return int(v) if v else None
 
+    reshard_step, reshard_to = _parse_reshard(
+        e.get("BIGDL_TPU_CHAOS_RESHARD"))
     return ChaosController(
         fail_at_step=_i("BIGDL_TPU_CHAOS_FAIL_STEP"),
         crash_checkpoint=_i("BIGDL_TPU_CHAOS_CRASH_CKPT"),
@@ -203,7 +264,8 @@ def _from_env() -> Optional[ChaosController]:
             e.get("BIGDL_TPU_CHAOS_STALL_PIPELINE_S") or 0.0),
         stall_pipeline_batches=_i(
             "BIGDL_TPU_CHAOS_STALL_PIPELINE_BATCHES"),
-        oom_at_step=_i("BIGDL_TPU_CHAOS_OOM"))
+        oom_at_step=_i("BIGDL_TPU_CHAOS_OOM"),
+        reshard_at_step=reshard_step, reshard_to=reshard_to)
 
 
 def install(**kwargs) -> ChaosController:

@@ -32,7 +32,10 @@ __all__ = ["save_pytree", "load_pytree", "save_checkpoint",
            "load_checkpoint_sharded", "is_sharded_checkpoint_path",
            "open_file", "is_remote_path", "np_load_any",
            "strip_file_scheme", "CheckpointManager",
-           "pipeline_state_path", "load_pipeline_state"]
+           "pipeline_state_path", "load_pipeline_state",
+           "checkpoint_topology", "current_topology",
+           "checkpoint_manifest_path", "load_checkpoint_topology",
+           "describe_topology"]
 
 logger = logging.getLogger("bigdl_tpu.utils.file")
 
@@ -281,6 +284,125 @@ def load_checkpoint(path: str) -> Tuple[Dict, Any, Dict]:
     return tree["model"], tree["optim"], driver
 
 
+# --------------------------------------------------------------------------
+# Checkpoint topology: what wrote this checkpoint, and can WE read it?
+# --------------------------------------------------------------------------
+
+def current_topology() -> Dict:
+    """The reading/writing process's view of the fleet: process count
+    and global device count.  The base record a checkpoint's topology
+    manifest starts from, and the "current" side of every
+    topology-mismatch diagnostic."""
+    try:
+        import jax
+        return {"process_count": int(jax.process_count()),
+                "device_count": int(jax.device_count())}
+    except Exception:  # pragma: no cover - jax not initialized
+        return {"process_count": 1, "device_count": 1}
+
+
+def checkpoint_topology(model_state: Any, optim_state: Any,
+                        mesh=None) -> Dict:
+    """Describe the topology a checkpoint is being written FROM:
+    process/device counts, the mesh axis names and sizes (from
+    ``mesh`` when the writer passes its live mesh — the ``.npz``
+    format gathers leaves to plain numpy first, erasing their
+    shardings — else from the first ``NamedSharding`` leaf found),
+    and the per-leaf shape/dtype/PartitionSpec tree.  Metadata only —
+    no leaf is read or transferred.  Recorded in the per-generation
+    manifest so a resume onto a DIFFERENT topology can (a) know the
+    checkpoint is portable before touching orbax, and (b) name both
+    sides when a leaf genuinely is not (see
+    ``load_checkpoint_sharded``)."""
+    topo = current_topology()
+    mesh_axes: Optional[Dict[str, int]] = None
+    if mesh is not None:
+        try:
+            from bigdl_tpu.parallel.mesh import mesh_axes as _ma
+            mesh_axes = _ma(mesh)
+        except Exception:  # pragma: no cover - exotic mesh object
+            mesh_axes = None
+    leaves: Dict[str, Dict] = {}
+    try:
+        import jax
+        from jax.sharding import NamedSharding
+        flat, _ = jax.tree_util.tree_flatten_with_path(
+            {"model": model_state, "optim": optim_state})
+        for path, leaf in flat:
+            if not hasattr(leaf, "shape"):
+                continue  # python scalar: trivially portable
+            dtype = getattr(leaf, "dtype", None)
+            entry: Dict[str, Any] = {
+                "shape": [int(s) for s in leaf.shape],
+                "dtype": None if dtype is None else str(dtype)}
+            sh = getattr(leaf, "sharding", None)
+            if isinstance(sh, NamedSharding):
+                if mesh_axes is None:
+                    from bigdl_tpu.parallel.mesh import mesh_axes as _ma
+                    mesh_axes = _ma(sh.mesh)
+                entry["spec"] = [
+                    list(p) if isinstance(p, (tuple, list)) else p
+                    for p in sh.spec]
+            leaves[jax.tree_util.keystr(path)] = entry
+    except Exception:  # pragma: no cover - topology is best-effort
+        logger.warning("could not derive checkpoint topology leaves",
+                       exc_info=True)
+    topo["mesh"] = mesh_axes
+    topo["leaves"] = leaves
+    return topo
+
+
+def describe_topology(topo: Optional[Dict]) -> str:
+    """One-line human rendering of a topology record for error
+    messages: ``4 process(es) x 8 device(s), mesh {'dcn': 2, 'data':
+    4}`` — or ``unknown topology`` when no manifest recorded one."""
+    if not topo:
+        return "unknown topology (no manifest recorded it)"
+    out = (f"{topo.get('process_count', '?')} process(es) x "
+           f"{topo.get('device_count', '?')} device(s)")
+    if topo.get("mesh"):
+        out += f", mesh {topo['mesh']}"
+    return out
+
+
+def checkpoint_manifest_path(payload_path: str) -> str:
+    """The manifest path for a checkpoint payload:
+    ``checkpoint.<gen>.npz`` / ``checkpoint.<gen>.orbax`` ->
+    ``checkpoint.<gen>.manifest.json`` (same stem rule as the
+    pipeline sidecar)."""
+    stem = strip_file_scheme(payload_path).rstrip("/")
+    for suf in (".npz", ".orbax"):
+        if stem.endswith(suf):
+            stem = stem[:-len(suf)]
+            break
+    return stem + ".manifest.json"
+
+
+def load_checkpoint_topology(payload_path: str) -> Optional[Dict]:
+    """Best-effort read of the topology record from the manifest next
+    to a checkpoint payload; None when the manifest is absent,
+    unreadable, or predates topology recording (resume then assumes
+    the writing topology matches the current one — the pre-elastic
+    contract)."""
+    path = checkpoint_manifest_path(payload_path)
+    try:
+        if is_remote_path(path):
+            import fsspec
+            fs, p = fsspec.core.url_to_fs(path)
+            if not fs.exists(p):
+                return None
+        elif not os.path.exists(path):
+            return None
+        with open_file(path, "rb") as f:
+            man = json.loads(f.read().decode("utf-8"))
+        topo = man.get("topology") if isinstance(man, dict) else None
+        return topo if isinstance(topo, dict) else None
+    except Exception:
+        logger.warning("unreadable checkpoint manifest %s (topology "
+                       "unknown)", path, exc_info=True)
+        return None
+
+
 def _orbax_path(path: str) -> str:
     """Orbax (epath) handles remote schemes like gs:// natively — only
     LOCAL paths need absolutizing (os.path.abspath would mangle
@@ -327,13 +449,137 @@ def load_checkpoint_sharded(path: str, abstract_state=None) \
     target shardings — with it each host reads ONLY its own shards and
     arrays come back device-sharded (driver keys must match the saved
     set; the Optimizer produces both sides).  Without it (single-host /
-    inspection) every array is materialized fully on the host."""
+    inspection) every array is materialized fully on the host.
+
+    Topology portability: the target shardings are built against the
+    CURRENT mesh, which need not be the one that wrote the checkpoint
+    — orbax reshards matching-shape leaves natively.  When the strict
+    restore fails anyway (orbax version/metadata quirks across a
+    topology change), the fallback reads every leaf fully host-side
+    and ``jax.device_put``s it into the requested sharding; a leaf
+    whose shape/dtype genuinely differs from the target raises a
+    ``ValueError`` naming the leaf and BOTH topologies (from the
+    manifest next to the payload) instead of orbax's strict-restore
+    traceback."""
     path = _orbax_path(path)
     ck = _orbax_checkpointer()
-    tree = ck.restore(path + "/tree", target=abstract_state)
+    try:
+        tree = ck.restore(path + "/tree", target=abstract_state)
+        if abstract_state is not None:
+            # orbax versions differ on whether a shape mismatch is a
+            # strict error or a silent pass-through of the saved
+            # shape; the silent case is exactly the wrong-state
+            # resume this layer exists to prevent, so verify here
+            tree = _reshard_tree(path, abstract_state, tree,
+                                 device_put=False)
+    except _UnportableCheckpoint:
+        raise
+    except Exception as e:
+        if abstract_state is None:
+            raise
+        tree = _topology_portable_restore(path, abstract_state, e)
     driver = {k: np.asarray(v).item()
               for k, v in tree["driver"].items()}
     return tree["model"], tree["optim"], driver
+
+
+class _UnportableCheckpoint(ValueError):
+    """A checkpoint leaf that genuinely cannot restore onto the
+    current topology (see ``load_checkpoint_sharded``)."""
+
+
+def _unportable_error(orbax_path: str, why: str) -> ValueError:
+    saved = load_checkpoint_topology(orbax_path)
+    if telemetry.enabled():
+        _tm.checkpoint_reshard_restores_total().labels("failed").inc()
+    return _UnportableCheckpoint(
+        f"checkpoint at {orbax_path} is not portable to the current "
+        f"topology: {why}.  Saved by {describe_topology(saved)}; "
+        f"restoring on {describe_topology(current_topology())}.  "
+        f"Re-save on the current mesh, or restore at the original "
+        f"topology")
+
+
+def _reshard_tree(orbax_path: str, abstract_state, tree,
+                  device_put: bool):
+    """Verify a restored tree leaf-by-leaf against the abstract
+    targets (shape + dtype), optionally ``jax.device_put``-ing each
+    leaf into the target sharding (the mismatched-leaf fallback path
+    reads full host arrays and reshards them here).  Raises the
+    actionable unportable-checkpoint error naming the leaf and both
+    topologies on any mismatch."""
+    import jax
+
+    def place(keypath, a, leaf):
+        name = jax.tree_util.keystr(keypath)
+        got_shape = tuple(np.shape(leaf))
+        want_shape = tuple(getattr(a, "shape", got_shape))
+        if got_shape != want_shape:
+            raise _unportable_error(
+                orbax_path,
+                f"leaf {name} has shape {got_shape} but the current "
+                f"mesh expects {want_shape}")
+        want_dtype = getattr(a, "dtype", None)
+        got_dtype = getattr(leaf, "dtype", None)
+        dtype_drift = (want_dtype is not None and got_dtype is not None
+                       and np.dtype(want_dtype) != np.dtype(got_dtype))
+        if dtype_drift and got_shape == ():
+            # 0-d driver scalars narrow on EVERY x64-disabled restore
+            # (int64 -> int32) and an astype back to int64 would just
+            # warn and re-narrow; they round-trip through .item()
+            # anyway, so leave them as restored
+            logger.debug(
+                "sharded restore: scalar leaf %s has dtype %s, "
+                "current state expects %s — leaving as restored",
+                name, np.dtype(got_dtype), np.dtype(want_dtype))
+            dtype_drift = False
+        elif dtype_drift:
+            # shape, not dtype, is the unportable signal — but say
+            # so, a silent cast on a real corruption would be this
+            # layer's own failure mode
+            logger.warning(
+                "sharded restore: leaf %s has dtype %s, current state "
+                "expects %s — casting", name, np.dtype(got_dtype),
+                np.dtype(want_dtype))
+        if not device_put:
+            # strict-restore verification path: the leaf is already
+            # placed (orbax honored the sharding), but a drifted
+            # dtype would recompile the train step at first dispatch
+            return leaf.astype(want_dtype) if dtype_drift else leaf
+        sh = getattr(a, "sharding", None)
+        arr = np.asarray(leaf)
+        if dtype_drift:
+            arr = arr.astype(want_dtype)
+        return jax.device_put(arr, sh) if sh is not None else arr
+
+    try:
+        return jax.tree_util.tree_map_with_path(place, abstract_state,
+                                                tree)
+    except _UnportableCheckpoint:
+        raise
+    except Exception as e:
+        raise _unportable_error(
+            orbax_path,
+            f"saved tree structure does not match the current state "
+            f"({type(e).__name__}: {e})")
+
+
+def _topology_portable_restore(orbax_path: str, abstract_state, cause):
+    """The mismatched-restore path: strict orbax restore failed, so
+    read the full tree host-side and reshard each leaf onto the
+    abstract target's sharding with ``jax.device_put`` — or raise the
+    actionable unportable error."""
+    logger.warning(
+        "strict sharded restore failed (%s: %s); retrying as a "
+        "topology-portable restore (full host read + device_put "
+        "reshard)", type(cause).__name__, cause)
+    ck = _orbax_checkpointer()
+    try:
+        host = ck.restore(orbax_path + "/tree")
+    except Exception:
+        raise cause  # genuinely unreadable: surface the strict error
+    return _reshard_tree(orbax_path, abstract_state, host,
+                         device_put=True)
 
 
 def _orbax_checkpointer():
@@ -423,10 +669,38 @@ class CheckpointManager:
     """
 
     def __init__(self, directory: str, keep_n: Optional[int] = None,
-                 prefix: str = "checkpoint"):
+                 prefix: str = "checkpoint", fence: Optional[int] = None):
         self.directory = directory
         self.keep_n = keep_n
         self.prefix = prefix
+        # writer fence token (attempt id): claimed lazily on first
+        # save as (highest fence on disk) + 1, recorded in every
+        # manifest this writer commits.  latest_good() prefers the
+        # HIGHEST fence before the generation number, so a stale
+        # writer that lost a partition race cannot shadow the live
+        # writer's lineage with a bigger generation number (see
+        # claim_fence / docs/fault_tolerance.md "Elastic resume")
+        self._fence = None if fence is None else int(fence)
+
+    def claim_fence(self) -> int:
+        """This writer's fence token, claimed on first use by scanning
+        the directory's manifests for the highest committed fence and
+        taking the next one.  A rejoining process that believes it is
+        primary therefore starts a NEW lineage: its generations are
+        preferred by ``latest_good()`` over anything a partitioned
+        stale writer keeps committing under the old fence, even when
+        the stale writer's generation numbers are larger."""
+        if self._fence is None:
+            prior = [int(m.get("fence") or 0) for m in self._manifests()]
+            self._fence = (max(prior) if prior else 0) + 1
+        return self._fence
+
+    @staticmethod
+    def _lineage_key(man: Dict) -> Tuple:
+        """Manifest ordering: fence first (unfenced legacy manifests
+        rank as fence 0), then generation, then commit time."""
+        return (int(man.get("fence") or 0), man.get("generation", -1),
+                man.get("time", 0.0))
 
     # ---- fs plumbing (local + fsspec) -----------------------------------
 
@@ -490,12 +764,9 @@ class CheckpointManager:
 
     @staticmethod
     def _manifest_name(payload_name: str) -> str:
-        stem = payload_name.rstrip("/")
-        for suf in (".npz", ".orbax"):
-            if stem.endswith(suf):
-                stem = stem[:-len(suf)]
-                break
-        return stem + ".manifest.json"
+        # ONE stem rule: the module-level path helper (a bare payload
+        # name has no scheme to strip, so it passes through unchanged)
+        return checkpoint_manifest_path(payload_name)
 
     @staticmethod
     def _pipeline_name(payload_name: str) -> str:
@@ -506,7 +777,8 @@ class CheckpointManager:
     def save(self, model_state: Dict, optim_state: Any,
              driver_state: Dict, *, generation: int,
              overwrite: bool = False, sharded: bool = False,
-             pipeline_state: Optional[Dict] = None) -> str:
+             pipeline_state: Optional[Dict] = None,
+             mesh=None) -> str:
         """Write one checkpoint generation: payload, then (payload
         verified durable) the pipeline-state sidecar, then the manifest
         recording both payloads' CRCs, then retention GC.  With
@@ -542,8 +814,15 @@ class CheckpointManager:
                         "pipeline_snapshot", generation=int(generation),
                         epoch=pipeline_state.get("epoch"),
                         offset=pipeline_state.get("offset"))
+                try:
+                    topo = checkpoint_topology(model_state, optim_state,
+                                               mesh=mesh)
+                except Exception:  # pragma: no cover - best effort
+                    logger.warning("could not record checkpoint "
+                                   "topology", exc_info=True)
+                    topo = None
                 self._write_manifest(name, generation, crc, size, sharded,
-                                     pipeline=pinfo)
+                                     pipeline=pinfo, topology=topo)
                 if self.keep_n:
                     self.gc()
         _te.record_event("checkpoint_commit", generation=int(generation),
@@ -574,12 +853,16 @@ class CheckpointManager:
     def _write_manifest(self, payload_name: str, generation: int,
                         crc: Optional[int], size: Optional[int],
                         sharded: bool,
-                        pipeline: Optional[Dict] = None) -> None:
+                        pipeline: Optional[Dict] = None,
+                        topology: Optional[Dict] = None) -> None:
         manifest = {"format": MANIFEST_FORMAT, "generation": int(generation),
                     "payload": payload_name, "sharded": bool(sharded),
-                    "crc32": crc, "size": size, "time": time.time()}
+                    "crc32": crc, "size": size, "time": time.time(),
+                    "fence": self.claim_fence()}
         if pipeline is not None:
             manifest["pipeline"] = pipeline
+        if topology is not None:
+            manifest["topology"] = topology
         data = json.dumps(manifest, sort_keys=True).encode("utf-8")
         mpath = self._join(self._manifest_name(payload_name))
         if self._is_remote():
@@ -664,9 +947,7 @@ class CheckpointManager:
         is stale but whose bytes are complete).  None if nothing
         survives."""
         manifested = set()
-        for man in sorted(self._manifests(),
-                          key=lambda m: (m.get("generation", -1),
-                                         m.get("time", 0.0)),
+        for man in sorted(self._manifests(), key=self._lineage_key,
                           reverse=True):
             manifested.add(man["payload"])
             path = self._join(man["payload"])
@@ -787,18 +1068,17 @@ class CheckpointManager:
                         name == self.payload_name(None, sharded=True):
                     continue  # overwrite-mode file: not generational
                 entries.append(man)
-            entries.sort(key=lambda m: (m.get("generation", -1),
-                                        m.get("time", 0.0)), reverse=True)
+            entries.sort(key=self._lineage_key, reverse=True)
             good = [m for m in entries
                     if self._present_and_sized(m)][:self.keep_n]
             keep = {m["payload"] for m in good}
-            newest_good = (good[0].get("generation", -1) if good
+            newest_good = (self._lineage_key(good[0]) if good
                            else None)
             for man in entries:
                 if man["payload"] in keep:
                     continue
                 if newest_good is not None \
-                        and man.get("generation", -1) > newest_good:
+                        and self._lineage_key(man) > newest_good:
                     # bad generation newer than every good one: leave it
                     # for latest_good() to report, don't silently erase
                     continue

@@ -97,6 +97,16 @@ if bash "$(dirname "$0")/serving_gen_smoke.sh" >"$gen_log" 2>&1; then
 else
   echo "serving_gen_smoke: FAILED (non-fatal ride-along; see $gen_log)"
 fi
+# elastic-resume smoke (chaos reshard 8 -> 2x4 / 4x2 with loss
+# trajectories equal to the uninterrupted oracle, reshard
+# flight-recorder event, fenced writer race): warn-only ride-along;
+# run scripts/reshard_smoke.sh standalone for the fatal form
+reshard_log=$(mktemp /tmp/reshard_smoke.XXXXXX.log)
+if bash "$(dirname "$0")/reshard_smoke.sh" >"$reshard_log" 2>&1; then
+  tail -n 1 "$reshard_log"
+else
+  echo "reshard_smoke: FAILED (non-fatal ride-along; see $reshard_log)"
+fi
 # serving-fabric smoke (3-replica router: session affinity, drain/
 # deploy zero-drop, typed shedding under 2x overload within SLO,
 # single-flight prefill dedup, disaggregated prefill bit-identity):

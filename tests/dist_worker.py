@@ -11,7 +11,16 @@ single-process run — the analog of the reference running its full
 distributed loop on a local SparkContext
 (reference: optim/DistriOptimizerSpec.scala:139).
 
-argv: <port> <process_id> <num_processes> <outdir>
+argv: <port> <process_id> <num_processes> <outdir> [mode [phase]]
+
+mode "reshard" runs leg 6 — the elastic N->M resharded-resume leg —
+in three phases the PARENT orchestrates at DIFFERENT process counts
+over one shared outdir (a process group cannot change its own width;
+an elastic resume is by definition a new group): "oracle" (the
+uninterrupted fixed-seed run), "train" (train mid-epoch with
+per-iteration sharded checkpoints, then stop), "resume" (a fresh
+group at another width resumes from latest_good() and finishes).  The
+parent asserts the concatenated loss trajectory equals the oracle's.
 """
 
 import os
@@ -28,18 +37,98 @@ def build_samples():
     return xs, ys
 
 
-def main():
-    port, pid, nproc, outdir = (sys.argv[1], int(sys.argv[2]),
-                                int(sys.argv[3]), sys.argv[4])
+def _init(port, pid, nproc):
     os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
     import jax
     jax.config.update("jax_platforms", "cpu")
-
     from bigdl_tpu.utils.engine import Engine
     Engine.init_distributed(f"127.0.0.1:{port}", nproc, pid,
                             timeout_s=60)
     assert jax.process_count() == nproc, jax.process_count()
     assert len(jax.devices()) == 2 * nproc
+    return jax
+
+
+def reshard_main(port, pid, nproc, outdir, phase):
+    """Leg 6 (one phase): 2->4 and 4->2 resharded resume.  The global
+    batch is held at 8 (SampleToMiniBatch(8 // nproc) per process), so
+    the loss trajectory is a pure function of (seed, global order) and
+    must match the oracle across ANY width."""
+    import json
+
+    _init(port, pid, nproc)
+
+    import numpy as np
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.dataset import DataSet, Sample, SampleToMiniBatch
+    from bigdl_tpu.optim import Optimizer, Trigger
+    from bigdl_tpu.optim.methods import SGD
+    from bigdl_tpu.utils import set_seed
+    from bigdl_tpu.utils.file import CheckpointManager
+
+    xs, ys = build_samples()
+    samples = [Sample(xs[i], ys[i]) for i in range(len(xs))]
+
+    class LossLog:
+        def __init__(self):
+            self.losses = {}
+
+        def add_scalar(self, name, v, step):
+            if name == "Loss":
+                self.losses[step] = v
+
+        def flush(self):
+            pass
+
+    set_seed(99)
+    log = LossLog()
+    ds = (DataSet.sharded(samples, shuffle=True, seed=99,
+                          process_index=pid, process_count=nproc)
+          .transform(SampleToMiniBatch(8 // nproc)))
+
+    def make_model():
+        set_seed(123)
+        return nn.Sequential(nn.Linear(12, 16), nn.Tanh(),
+                             nn.Linear(16, 2))
+
+    ckdir = os.path.join(outdir, "ck_reshard")
+    opt = (Optimizer(make_model(), ds, nn.CrossEntropyCriterion())
+           .set_optim_method(SGD(0.1))
+           .set_train_summary(log))
+    if phase == "oracle":
+        opt.set_end_when(Trigger.max_epoch(2))
+    elif phase == "train":
+        # stop mid-epoch-2 (4 iterations/epoch at global batch 8),
+        # every iteration checkpointed by its owning hosts
+        opt.set_end_when(Trigger.max_iteration(6))
+        opt.set_checkpoint(ckdir, Trigger.several_iteration(1),
+                           sharded=True)
+    elif phase == "resume":
+        good = CheckpointManager(ckdir).latest_good()
+        assert good is not None, "no good checkpoint to reshard from"
+        opt.set_end_when(Trigger.max_epoch(2))
+        opt.resume(good)
+    else:
+        raise ValueError(f"unknown reshard phase {phase!r}")
+    opt.optimize()
+    if phase == "resume":
+        assert opt.state["epoch"] == 3, opt.state
+    if pid == 0:
+        with open(os.path.join(outdir, f"losses_{phase}.json"),
+                  "w") as f:
+            json.dump({str(k): float(v)
+                       for k, v in log.losses.items()}, f)
+    print(f"reshard worker {pid} ({phase}@{nproc}): done", flush=True)
+
+
+def main():
+    port, pid, nproc, outdir = (sys.argv[1], int(sys.argv[2]),
+                                int(sys.argv[3]), sys.argv[4])
+    if len(sys.argv) > 5 and sys.argv[5] == "reshard":
+        reshard_main(port, pid, nproc, outdir, sys.argv[6])
+        return
+    jax = _init(port, pid, nproc)
+    from bigdl_tpu.utils.engine import Engine
     assert Engine.node_number() == nproc
 
     import numpy as np

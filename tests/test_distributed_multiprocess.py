@@ -126,6 +126,66 @@ def test_two_process_train_checkpoint_resume(tmp_path):
             err_msg=f"{k} diverged between 2-process and 1-process runs")
 
 
+def _run_reshard_group(nproc, phase, outdir, timeout=300):
+    """One process group of dist_worker.py's reshard leg (leg 6)."""
+    import subprocess
+    port = _free_port()
+    procs = [
+        subprocess.Popen(
+            [sys.executable, os.path.join(HERE, "dist_worker.py"),
+             str(port), str(pid), str(nproc), outdir, "reshard", phase],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, env=_env())
+        for pid in range(nproc)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=timeout)
+            outs.append(out)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.fail(f"reshard {phase}@{nproc} workers timed out:\n"
+                    + "\n---\n".join(outs))
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, \
+            f"reshard {phase}@{nproc} worker failed:\n{out[-4000:]}"
+
+
+def _load_losses(outdir, phase):
+    import json
+    with open(os.path.join(outdir, f"losses_{phase}.json")) as f:
+        return {int(k): v for k, v in json.load(f).items()}
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("n_from,n_to", [(2, 4), (4, 2)])
+def test_elastic_reshard_resume_n_to_m(tmp_path, n_from, n_to):
+    """Leg 6: a checkpoint written by an N-process group resumes on an
+    M-process group (the elastic-fleet acceptance bar).  The global
+    batch is held constant, so the resumed trajectory must continue
+    the oracle's per-iteration losses — and the resumed group consumes
+    exactly the not-yet-consumed samples (any replay/skip shifts the
+    remixing global order and breaks the equality)."""
+    outdir = str(tmp_path)
+    _run_reshard_group(n_from, "oracle", outdir)
+    _run_reshard_group(n_from, "train", outdir)
+    _run_reshard_group(n_to, "resume", outdir)
+    oracle = _load_losses(outdir, "oracle")
+    train = _load_losses(outdir, "train")
+    resume = _load_losses(outdir, "resume")
+    merged = dict(train)
+    merged.update(resume)
+    assert set(merged) == set(oracle)
+    for step, v in oracle.items():
+        # the device count changes with the width, so the gradient
+        # all-reduce order changes: float-tolerance, not bitwise
+        assert abs(merged[step] - v) <= 1e-4 * max(abs(v), 1.0), (
+            f"iteration {step}: resharded loss {merged[step]} "
+            f"!= oracle {v}")
+
+
 @pytest.mark.slow
 def test_dead_coordinator_fails_loudly():
     """A worker pointed at a dead coordinator must die with a real,
