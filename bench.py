@@ -842,6 +842,66 @@ def phase_generate_serving(on_tpu: bool):
     return out
 
 
+def phase_fleet(on_tpu: bool):
+    """The self-driving-fleet closed loop, measured: chaos kill ->
+    controller replacement, spike -> scale-up, new checkpoint
+    generation -> rolling zero-drop hot-deploy.  Headline metric is
+    train-to-serve freshness (commit timestamp -> last replica
+    serving the new generation)."""
+    import tempfile
+
+    from bigdl_tpu.fleet.harness import run_fleet_scenario
+
+    work = tempfile.mkdtemp(prefix="bench-fleet-")
+    r = run_fleet_scenario(work, load_s=2.0, spike_requests=14,
+                           wait_scale_down=True)
+    out = {
+        "freshness_s": r["freshness_s"],
+        "deployed_generation": r["deployed_generation"],
+        "deploy_swapped_replicas": r["deploy_swapped"],
+        "killed_replica": r["killed_replica"],
+        "live_after_spike": r["live_after_spike"],
+        "live_final": r["live_final"],
+        "requests": {"submitted": r["submitted"], "ok": r["ok"],
+                     "shed": r["shed"], "dropped": r["dropped"]},
+        "greedy_rows_equal": r["greedy_rows_equal"],
+        "admitted_outstanding_at_end": r["admitted_outstanding"],
+        "events": r["events"],
+        "loop_duration_s": r["duration_s"],
+    }
+    _update(fleet_deploy_freshness_s=r["freshness_s"],
+            fleet_zero_drop=(r["dropped"] == 0
+                             and r["admitted_outstanding"] == 0),
+            fleet_scale_up_events=r["events"]["scale_up"],
+            fleet_config="1to3replicas-kill+spike+hotdeploy")
+    # durable evidence: its own artifact series (FLEET_r<N>.json),
+    # same envelope as the training rounds
+    try:
+        from bigdl_tpu.telemetry import perf
+        here = os.path.dirname(os.path.abspath(__file__))
+        tag = os.environ.get("BIGDL_TPU_ROUND", "latest")
+        payload = dict(out)
+        payload["metric"] = "fleet_deploy_freshness_seconds"
+        payload["value"] = r["freshness_s"]
+        payload["unit"] = "seconds"
+        payload["platform"] = "tpu" if on_tpu else "cpu"
+        art = perf.make_round_artifact(
+            payload, kind="fleet", timestamp=time.time(),
+            device_kind=RESULT.get("device_kind"),
+            confirmed_on_device=bool(on_tpu),
+            git_rev=perf.git_revision(here))
+        path = perf.write_round_artifact(
+            os.path.join(here, f"FLEET_r{tag}.json"), art)
+        _log(f"fleet artifact: {os.path.basename(path)} "
+             f"(freshness {r['freshness_s']}s, "
+             f"{r['deploy_swapped']} replicas hot-deployed, "
+             f"dropped={r['dropped']})")
+    except Exception:
+        _log("fleet artifact write failed (non-fatal):\n"
+             + traceback.format_exc())
+    return out
+
+
 def phase_roofline(on_tpu: bool):
     """Empirical bf16 matmul roofline: chained square matmuls (each
     output feeds the next so XLA cannot elide any), timed after warmup
@@ -1074,6 +1134,11 @@ def main():
                   deadline_s=120.0)
     else:
         RESULT["phases"]["generate_serving"] = "skipped (budget)"
+    if _remaining() > 60.0:
+        run_phase("fleet", lambda: phase_fleet(on_tpu),
+                  deadline_s=120.0)
+    else:
+        RESULT["phases"]["fleet"] = "skipped (budget)"
 
     # RoundArtifact provenance on the result line itself: schema
     # version, run timestamp, git rev, and the confirmed-on-device flag

@@ -42,7 +42,7 @@ RULE = "lock-discipline"
 
 # packages whose classes follow the thread-shared convention
 _SCOPES = ("bigdl_tpu/telemetry/", "bigdl_tpu/serving/",
-           "bigdl_tpu/data/")
+           "bigdl_tpu/data/", "bigdl_tpu/fleet/")
 
 _LOCK_CTORS = {"Lock", "RLock", "Condition"}
 _LOCKY_NAME = re.compile(r"(^|_)(lock|mutex|cond)$")

@@ -1,0 +1,32 @@
+"""The self-driving fleet: closed-loop autoscaling + continuous
+train-to-serve deployment (see docs/serving.md "Autoscaling &
+continuous deployment").
+
+* :class:`~bigdl_tpu.fleet.policy.PoolSpec` /
+  :class:`~bigdl_tpu.fleet.policy.ScalingPolicy` — the pure
+  observe/decide half (thresholds, hysteresis, cooldown).
+* :class:`~bigdl_tpu.fleet.controller.FleetController` — the reconcile
+  thread: replaces dead replicas, scales per-model pools on TTFT /
+  queue / shed breaches, never below ``min_replicas``.
+* :class:`~bigdl_tpu.fleet.controller.TrainingSupervisor` — auto-resume
+  of preempted training runs from ``latest_good()``.
+* :class:`~bigdl_tpu.fleet.watcher.CheckpointWatcher` — rolling
+  zero-drop hot-deploy of every new CRC-verified checkpoint
+  generation, freshness published as
+  ``fleet_deploy_freshness_seconds``.
+"""
+
+from bigdl_tpu.fleet.controller import (FleetController,
+                                        TrainingSupervisor,
+                                        controller_statusz,
+                                        next_replica_id,
+                                        register_statusz,
+                                        unregister_statusz)
+from bigdl_tpu.fleet.policy import (Decision, Observation, PoolSpec,
+                                    ScalingPolicy)
+from bigdl_tpu.fleet.watcher import CheckpointWatcher
+
+__all__ = ["FleetController", "TrainingSupervisor", "CheckpointWatcher",
+           "PoolSpec", "ScalingPolicy", "Observation", "Decision",
+           "controller_statusz", "register_statusz",
+           "unregister_statusz", "next_replica_id"]

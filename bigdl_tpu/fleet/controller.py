@@ -1,0 +1,581 @@
+"""The fleet controller: the closed loop between observation and
+actuation.
+
+PR-13 built the observations (per-replica health snapshots, router SLO
+stats, typed shed counters) and the actuators (``add_replica``,
+``drain``, zero-drop ``deploy``); PR-14 made any checkpoint restorable
+at any width.  Until now an OPERATOR was the loop between them.  This
+module closes it:
+
+* :class:`FleetController` — a daemon reconcile thread in the
+  Kubernetes mold: each tick it polls the
+  :class:`~bigdl_tpu.serving.replica.ReplicaRegistry` and the router's
+  stats, reduces them to one :class:`~bigdl_tpu.fleet.policy.Observation`
+  per model pool, asks the pool's
+  :class:`~bigdl_tpu.fleet.policy.ScalingPolicy` for a decision, and
+  reconciles live state toward desired state: dead replicas (stale or
+  corrupt snapshots) are replaced, breaches scale the pool up through
+  the pluggable ``factory``, sustained idleness scales it down through
+  the PR-13 zero-drop drain path — never below ``min_replicas``.
+  Every action (and every suppressed one) lands in the flight
+  recorder as ``scale_up`` / ``scale_down`` / ``controller_hold``
+  with the policy's reason verbatim, so a pager week reconstructs
+  from the event ring.
+* :class:`TrainingSupervisor` — the training-side half of "no operator
+  step": re-invokes a preempted ``optimize()`` from
+  ``CheckpointManager.latest_good()`` at whatever width the mesh now
+  grants (reshard faults already resume INSIDE ``optimize()`` via the
+  PR-14 retry handler; preemption's clean return was the one edge that
+  still needed a human).
+
+The factory contract: ``factory(replica_id, model, checkpoint_path)``
+returns a started :class:`~bigdl_tpu.serving.replica.Replica` serving
+``model`` — from ``checkpoint_path`` when one is given (the
+continuous-deploy path), from the factory's own latest weights when
+``None`` (scale-up and replacement).
+
+Lock discipline: the controller owns exactly one lock, guarding only
+the published ``_status`` snapshot.  All reconcile state (``_pools``
+and everything inside them) is touched by the reconcile thread alone,
+and the lock is never held across a router call — the router has its
+own lock and the controller must never entangle their order.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from bigdl_tpu import telemetry
+from bigdl_tpu.fleet.policy import Observation, PoolSpec, ScalingPolicy
+from bigdl_tpu.telemetry import events as _events
+
+__all__ = ["FleetController", "TrainingSupervisor", "next_replica_id",
+           "reserve_replica_ids",
+           "register_statusz", "unregister_statusz",
+           "controller_statusz"]
+
+logger = logging.getLogger(__name__)
+
+
+# ---- /statusz wiring ------------------------------------------------------
+# Trainer and serve frontends embed a `controller` section when any
+# controller-ish component is live in the process.  Providers register
+# here by name; the statusz builders pull the merged dict lazily, so
+# neither the optimizer nor examples/serve.py grows a hard dependency
+# on this package.
+
+_statusz_lock = threading.Lock()
+_statusz_providers: Dict[str, Callable[[], Dict]] = {}
+
+
+def register_statusz(name: str, fn: Callable[[], Dict]) -> None:
+    with _statusz_lock:
+        _statusz_providers[str(name)] = fn
+
+
+def unregister_statusz(name: str) -> None:
+    with _statusz_lock:
+        _statusz_providers.pop(str(name), None)
+
+
+def controller_statusz() -> Optional[Dict]:
+    """The merged ``controller`` section for ``/statusz`` pages, or
+    None when no controller component is live in this process."""
+    with _statusz_lock:
+        providers = dict(_statusz_providers)
+    if not providers:
+        return None
+    out: Dict[str, Any] = {}
+    for name, fn in providers.items():
+        try:
+            out[name] = fn()
+        except Exception as e:  # a broken provider must not take the
+            # whole debug page down with it
+            out[name] = {"error": f"{type(e).__name__}: {e}"}
+    return out
+
+
+# ---- replica id allocation ------------------------------------------------
+# The controller and the checkpoint watcher both mint replica ids from
+# different threads; a shared monotonic allocator (seeded past whatever
+# the router already holds) is what keeps them from colliding on
+# ``add_replica``.
+
+_id_lock = threading.Lock()
+_next_rid = 0
+
+
+def next_replica_id(router) -> int:
+    global _next_rid
+    existing = max(router.replica_ids(), default=-1)
+    with _id_lock:
+        _next_rid = max(_next_rid, existing + 1)
+        rid = _next_rid
+        _next_rid += 1
+        return rid
+
+
+def reserve_replica_ids(ids) -> None:
+    """Advance the allocator past externally-created replica ids.
+
+    The controller calls this with every id it OBSERVES (registry
+    records included), not just the router's live members: a dead
+    replica swept from the router still has a snapshot on disk for a
+    while, and re-minting its id would pin the stale unhealthy record
+    onto the fresh replacement."""
+    global _next_rid
+    top = max((int(i) for i in ids), default=-1)
+    with _id_lock:
+        _next_rid = max(_next_rid, top + 1)
+
+
+class _PoolState:
+    """Reconcile-thread-private state for one model pool."""
+
+    def __init__(self, spec: PoolSpec):
+        self.spec = spec
+        self.policy = ScalingPolicy(spec)
+        self.desired: Optional[int] = None      # set on the first tick
+        self.unhealthy_streak: Dict[int, int] = {}
+        self.dying: Dict[int, Any] = {}         # rid -> Replica, dead,
+        #                                         awaiting outstanding==0
+        self.draining_out: Dict[int, Any] = {}  # rid -> Replica,
+        #                                         scale-down in flight
+        self.last_shed = 0
+        self.last_decision: Dict[str, Any] = {}
+        self.hold_reason_emitted: Optional[str] = None
+
+
+class FleetController:
+    """Closed-loop autoscaler over one
+    :class:`~bigdl_tpu.serving.router.Router`.
+
+    >>> ctl = FleetController(
+    ...     router, factory,
+    ...     pools=[PoolSpec(model="default", min_replicas=2,
+    ...                     max_replicas=4, slo_ttft_p99_s=0.5)])
+    >>> ctl.start()
+    ... # chaos kills a replica / load spikes: the controller replaces
+    ... # and scales with no operator step
+    >>> ctl.stop()
+    """
+
+    def __init__(self, router, factory: Callable[..., Any],
+                 pools: Optional[List[PoolSpec]] = None,
+                 interval_s: float = 0.25, start: bool = False):
+        self.router = router
+        self.factory = factory
+        specs = list(pools) if pools else [PoolSpec()]
+        models = [s.model for s in specs]
+        if len(set(models)) != len(models):
+            raise ValueError(f"duplicate pool models: {models}")
+        self.interval_s = float(interval_s)
+        self._pools: Dict[str, _PoolState] = {
+            s.model: _PoolState(s) for s in specs}
+        self._lock = threading.Lock()
+        self._status: Dict[str, Any] = {"running": False, "pools": {}}
+        self._stop_evt = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="bigdl-fleet-controller", daemon=True)
+        self._started = False
+        if start:
+            self.start()
+
+    # ---- lifecycle -------------------------------------------------------
+
+    def start(self) -> "FleetController":
+        if self._started:
+            raise RuntimeError("controller already started")
+        self._started = True
+        # push each pool's SLO class and admission budget into the
+        # router before the first decision routes on them
+        for pool in self._pools.values():
+            s = pool.spec
+            if s.slo_ttft_p99_s is not None:
+                self.router.set_slo_class(s.model, s.slo_ttft_p99_s)
+            if s.admission_budget is not None:
+                self.router.set_admission_budget(s.model,
+                                                 s.admission_budget)
+        register_statusz("fleet", self.status)
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 10.0) -> None:
+        """Stop reconciling (daemon AND joined, the exporter pattern).
+        Replicas the controller spawned stay with the router — the
+        controller is the loop, not the fleet's owner."""
+        self._stop_evt.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout)
+        unregister_statusz("fleet")
+
+    def __enter__(self) -> "FleetController":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def status(self) -> Dict[str, Any]:
+        """The `/statusz` ``controller`` contribution: desired/live per
+        pool, the last decision + reason, cooldown remaining."""
+        with self._lock:
+            return dict(self._status)
+
+    # ---- the reconcile loop ----------------------------------------------
+
+    def _run(self) -> None:
+        while not self._stop_evt.is_set():
+            try:
+                self._tick()
+            except Exception:  # pragma: no cover - the loop must
+                # survive anything one tick throws (a wedged reconcile
+                # loop is an outage multiplier)
+                logger.exception("fleet controller tick failed")
+            self._stop_evt.wait(self.interval_s)
+
+    def reconcile_once(self) -> Dict[str, Any]:
+        """One synchronous tick (tests and the smoke harness drive the
+        loop deterministically through this)."""
+        self._tick()
+        return self.status()
+
+    def _tick(self) -> None:
+        try:
+            records = self.router.registry.poll()
+        except Exception:
+            # a doctored/unreadable registry is an observation outage,
+            # not a controller crash: hold everything this tick
+            logger.exception("registry poll failed; holding")
+            records = None
+        try:
+            stats = self.router.stats()
+        except Exception:
+            logger.exception("router stats failed; holding")
+            records = None
+            stats = {}
+        if records is not None:
+            reserve_replica_ids(list(records.keys())
+                                + list(self.router.replica_ids()))
+        now = time.perf_counter()
+        status_pools: Dict[str, Any] = {}
+        for model, pool in self._pools.items():
+            if records is None:
+                status_pools[model] = dict(
+                    pool.last_decision,
+                    error="registry unreadable; holding")
+                continue
+            try:
+                status_pools[model] = self._reconcile_pool(
+                    pool, records, stats, now)
+            except Exception:
+                logger.exception("reconcile failed for pool %r", model)
+                status_pools[model] = dict(pool.last_decision,
+                                           error="reconcile failed")
+        with self._lock:
+            self._status = {
+                "running": not self._stop_evt.is_set(),
+                "interval_s": self.interval_s,
+                "pools": status_pools,
+            }
+
+    # ---- per-pool reconcile ----------------------------------------------
+
+    def _members(self, pool: _PoolState) -> Dict[int, Any]:
+        out = {}
+        for rid in self.router.replica_ids():
+            if rid in pool.dying or rid in pool.draining_out:
+                continue
+            r = self.router.replica(rid)
+            if r is not None \
+                    and getattr(r, "model", "default") == pool.spec.model:
+                out[rid] = r
+        return out
+
+    def _reconcile_pool(self, pool: _PoolState, records: Dict,
+                        stats: Dict, now: float) -> Dict[str, Any]:
+        spec = pool.spec
+        members = self._members(pool)
+
+        # classify members on their registry records.  A member with
+        # no record yet (just added, first snapshot racing the poll)
+        # is presumed live — spawning another copy because the health
+        # plane is half a tick behind would thrash the pool.
+        live: Dict[int, Any] = {}
+        dead: Dict[int, Any] = {}
+        for rid, r in members.items():
+            rec = records.get(rid)
+            if rec is None:
+                live[rid] = r
+                pool.unhealthy_streak.pop(rid, None)
+            elif rec.get("healthy"):
+                live[rid] = r
+                pool.unhealthy_streak.pop(rid, None)
+            else:
+                # stale/corrupt/healthz-failed: demand the verdict
+                # hold for dead_after_polls consecutive ticks before
+                # acting — one torn snapshot read must not kill a
+                # healthy replica.  A suspect still counts as live
+                # until confirmed: spawning its replacement early
+                # would double the pool on a noisy read
+                n = pool.unhealthy_streak.get(rid, 0) + 1
+                pool.unhealthy_streak[rid] = n
+                if n >= spec.dead_after_polls:
+                    dead[rid] = r
+                else:
+                    live[rid] = r
+        for rid, r in dead.items():
+            reason = (records.get(rid) or {}).get("reason")
+            logger.warning("pool %r: replica %d is dead (%s); "
+                           "replacing", spec.model, rid, reason)
+            pool.dying[rid] = r
+            pool.unhealthy_streak.pop(rid, None)
+
+        # finish in-flight removals the zero-drop way: a dying or
+        # draining-out replica leaves only once its admitted work hits 0
+        self._sweep_removals(pool)
+
+        if pool.desired is None:
+            pool.desired = spec.clamp(len(live) if live
+                                      else spec.min_replicas)
+
+        obs = self._observe(pool, live, records, stats)
+        decision = pool.policy.decide(obs, now)
+        if decision.action == "up":
+            pool.desired = spec.clamp(pool.desired + 1)
+            pool.policy.actuated(now)
+        elif decision.action == "down":
+            pool.desired = spec.clamp(pool.desired - 1)
+            pool.policy.actuated(now)
+        self._note_hold(pool, decision)
+
+        # actuate toward desired
+        spawned = self._spawn_missing(pool, live, dead, decision)
+        self._drain_excess(pool, live)
+
+        if decision.action or spawned or dead:
+            pool.last_decision = {
+                "action": decision.action,
+                "reason": decision.reason or
+                ("replaced dead replica(s) "
+                 f"{sorted(dead)}" if dead else ""),
+            }
+        self._publish_gauges(spec.model, pool.desired, len(live))
+        return {
+            "desired": pool.desired,
+            "live": len(live),
+            "dying": sorted(pool.dying),
+            "draining_out": sorted(pool.draining_out),
+            "last_decision": dict(pool.last_decision),
+            "cooldown_remaining_s": round(
+                pool.policy.cooldown_remaining(now), 3),
+            "observation": {
+                "ttft_p99_s": obs.ttft_p99_s,
+                "queue_depth": obs.queue_depth,
+                "shed_delta": obs.shed_delta,
+                "inflight": obs.inflight,
+            },
+        }
+
+    def _observe(self, pool: _PoolState, live: Dict, records: Dict,
+                 stats: Dict) -> Observation:
+        model = pool.spec.model
+        ttft = 0.0
+        queue = 0
+        for rid in live:
+            rec = records.get(rid) or {}
+            ttft = max(ttft, float(rec.get("ttft_p99_s", 0.0) or 0.0))
+            queue += int(rec.get("queue_depth", 0) or 0)
+        if len(self._pools) == 1:
+            # single-pool fleet: the router's own queue + parked
+            # requests all belong to this pool — they are the earliest
+            # overload signal (work that could not even dispatch)
+            queue += int(stats.get("queue_depth", 0) or 0)
+            queue += int(stats.get("waiting", 0) or 0)
+        shed_now = int(
+            (stats.get("model_shed") or {}).get(model, 0) or 0)
+        shed_delta = max(shed_now - pool.last_shed, 0)
+        pool.last_shed = shed_now
+        inflight = int(
+            (stats.get("model_inflight") or {}).get(model, 0) or 0)
+        return Observation(live=len(live), desired=pool.desired,
+                           ttft_p99_s=ttft, queue_depth=queue,
+                           shed_delta=shed_delta, inflight=inflight)
+
+    # ---- actuation -------------------------------------------------------
+
+    def _spawn_missing(self, pool: _PoolState, live: Dict, dead: Dict,
+                       decision) -> int:
+        spec = pool.spec
+        missing = pool.desired - len(live)
+        spawned = 0
+        while missing > 0:
+            if dead:
+                reason = (f"replacing dead replica(s) "
+                          f"{sorted(dead)}")
+            else:
+                reason = decision.reason or "below desired count"
+            try:
+                rid = next_replica_id(self.router)
+                replica = self.factory(rid, spec.model, None)
+                self.router.add_replica(replica)
+            except Exception:
+                logger.exception("pool %r: replica spawn failed",
+                                 spec.model)
+                break
+            # THE one scale_up emission site: load-driven growth and
+            # dead-replica replacement share it, told apart by reason
+            _events.record_event("scale_up", model=spec.model,
+                                 replica=rid, desired=pool.desired,
+                                 reason=reason)
+            if telemetry.enabled():
+                from bigdl_tpu.telemetry import families
+                families.fleet_scale_events_total().labels("up").inc()
+            live[rid] = replica
+            spawned += 1
+            missing -= 1
+        return spawned
+
+    def _drain_excess(self, pool: _PoolState, live: Dict) -> None:
+        excess = len(live) - pool.desired
+        while excess > 0 and len(live) > pool.spec.min_replicas:
+            # evict the member with the least admitted work (cheapest
+            # zero-drop drain), ties to the youngest id
+            victim_id = min(
+                live, key=lambda rid: (live[rid].admitted_outstanding(),
+                                       -rid))
+            victim = live.pop(victim_id)
+            try:
+                self.router.drain(victim_id)
+            except Exception:
+                logger.exception("pool %r: drain of %d failed",
+                                 pool.spec.model, victim_id)
+                break
+            pool.draining_out[victim_id] = victim
+            # THE one scale_down emission site
+            _events.record_event(
+                "scale_down", model=pool.spec.model, replica=victim_id,
+                desired=pool.desired,
+                outstanding=victim.admitted_outstanding())
+            if telemetry.enabled():
+                from bigdl_tpu.telemetry import families
+                families.fleet_scale_events_total().labels("down").inc()
+            excess -= 1
+
+    def _sweep_removals(self, pool: _PoolState) -> None:
+        for group in (pool.dying, pool.draining_out):
+            for rid in list(group):
+                replica = group[rid]
+                try:
+                    outstanding = replica.admitted_outstanding()
+                except Exception:
+                    outstanding = 0
+                if outstanding > 0:
+                    continue  # zero-drop: wait for admitted work
+                try:
+                    if rid in self.router.replica_ids():
+                        self.router.remove_replica(rid, drain=True,
+                                                   timeout=10.0)
+                except Exception:
+                    logger.exception("removal of replica %d failed",
+                                     rid)
+                group.pop(rid, None)
+
+    def _note_hold(self, pool: _PoolState, decision) -> None:
+        if decision.action != "hold":
+            pool.hold_reason_emitted = None
+            return
+        # latch on the STABLE key (the reason prose carries tick-varying
+        # streak counts and countdowns): one event per suppression
+        # episode, not one per tick — the ring must outlive a long
+        # cooldown
+        latch = decision.key or decision.reason
+        if pool.hold_reason_emitted == latch:
+            return
+        pool.hold_reason_emitted = latch
+        # THE one controller_hold emission site
+        _events.record_event("controller_hold", model=pool.spec.model,
+                             desired=pool.desired,
+                             reason=decision.reason)
+
+    def _publish_gauges(self, model: str, desired: int,
+                        live: int) -> None:
+        if telemetry.enabled():
+            from bigdl_tpu.telemetry import families
+            families.fleet_replicas_desired().labels(model).set(desired)
+            families.fleet_replicas_live().labels(model).set(live)
+
+
+class TrainingSupervisor:
+    """The training half of the self-driving fleet: run ``optimize()``
+    and, when it returns preempted (the SIGTERM grace-checkpoint
+    path), resume from ``latest_good()`` and keep going — the
+    walkback-verified checkpoint plus its topology manifest mean the
+    resume lands at whatever width the current mesh config grants,
+    with no operator step.  Reshard faults never reach here: the
+    PR-14 retry handler already rebuilds the mesh and resumes INSIDE
+    ``optimize()``.
+
+    >>> model = TrainingSupervisor(opt).run()
+    """
+
+    def __init__(self, optimizer, checkpoint_dir: Optional[str] = None,
+                 max_resumes: int = 8):
+        self.optimizer = optimizer
+        self.checkpoint_dir = checkpoint_dir \
+            or getattr(optimizer, "checkpoint_path", None)
+        if self.checkpoint_dir is None:
+            raise ValueError(
+                "TrainingSupervisor needs a checkpoint directory "
+                "(set_checkpoint on the optimizer, or pass "
+                "checkpoint_dir) — resuming a preempted run without "
+                "checkpoints is not a thing")
+        self.max_resumes = int(max_resumes)
+        self.resumes = 0
+        self.last_resume_from: Optional[str] = None
+
+    def _latest_good(self) -> Optional[str]:
+        from bigdl_tpu.utils.file import CheckpointManager
+        return CheckpointManager(self.checkpoint_dir).latest_good()
+
+    def run(self):
+        """``optimize()`` to completion, resuming past preemptions.
+        Returns the trained model; raises RuntimeError when the run
+        keeps getting preempted past ``max_resumes`` (at that point a
+        human SHOULD look)."""
+        register_statusz("training", self.statusz)
+        try:
+            while True:
+                model = self.optimizer.optimize()
+                if not getattr(self.optimizer, "preempted", False):
+                    return model
+                if self.resumes >= self.max_resumes:
+                    raise RuntimeError(
+                        f"run preempted {self.resumes + 1}x "
+                        f"(max_resumes={self.max_resumes}); giving "
+                        f"the pager a chance")
+                good = self._latest_good()
+                if good is None:
+                    raise RuntimeError(
+                        "preempted before any checkpoint committed; "
+                        "nothing to resume from")
+                self.resumes += 1
+                self.last_resume_from = good
+                logger.warning(
+                    "preempted; auto-resuming from %s (resume %d/%d)",
+                    good, self.resumes, self.max_resumes)
+                self.optimizer.resume(good)
+        finally:
+            unregister_statusz("training")
+
+    def statusz(self) -> Dict[str, Any]:
+        return {
+            "kind": "training_supervisor",
+            "resumes": self.resumes,
+            "max_resumes": self.max_resumes,
+            "last_resume_from": self.last_resume_from,
+            "preempted": bool(getattr(self.optimizer, "preempted",
+                                      False)),
+        }

@@ -1,0 +1,272 @@
+"""The fleet acceptance harness: one scenario, three consumers.
+
+``run_fleet_scenario`` drives the full self-driving loop against a
+tiny deterministic transformer-LM fleet — sustained sessioned load, a
+chaos replica kill, a load spike, and a new checkpoint generation —
+with NO operator action between fault and recovery: the
+:class:`~bigdl_tpu.fleet.controller.FleetController` replaces the dead
+and scales the pool, the
+:class:`~bigdl_tpu.fleet.watcher.CheckpointWatcher` rolling-hot-deploys
+the new generation, and the report counts what the acceptance criteria
+pin: zero dropped admitted requests (every future resolves ok or
+TYPED), greedy rows bit-identical to solo ``generate()`` after the
+swap, and the measured train-to-serve freshness.
+
+The slow soak test, ``scripts/controller_smoke.sh``, and the bench
+``FLEET_r<N>.json`` round all run THIS function — one encoding of the
+scenario, three levels of budget.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from bigdl_tpu.telemetry import events as _events
+from bigdl_tpu.utils import chaos
+
+__all__ = ["build_tiny_lm", "checkpoint_factory", "run_fleet_scenario"]
+
+
+def build_tiny_lm():
+    """The deterministic tiny LM every consumer shares: same seed +
+    config as the serving-fabric tests, so greedy rows are comparable
+    across fresh builds, checkpoint round-trips, and solo oracles."""
+    from bigdl_tpu.models import transformer_lm
+    from bigdl_tpu.utils import set_seed
+    set_seed(0)
+    return transformer_lm(vocab_size=50, hidden_size=32, num_layers=2,
+                          num_heads=4, filter_size=64,
+                          max_len=64).eval_mode()
+
+
+def solo_row(model, prompt, max_new: int):
+    """The single-engine greedy oracle (no fabric in the path)."""
+    import jax.numpy as jnp
+    return np.asarray(model.generate(
+        jnp.asarray(prompt, jnp.int32)[None], int(max_new)))[0]
+
+
+def checkpoint_factory(snapshot_dir: str, checkpoint_dir: str,
+                       slots: int = 2, publish_interval_s: float = 0.05):
+    """A :class:`FleetController`/:class:`CheckpointWatcher` factory
+    over the tiny LM: ``factory(rid, model, checkpoint_path)`` builds a
+    started replica serving the weights at ``checkpoint_path`` — or,
+    when None (scale-up / replacement), the newest committed generation
+    (falling back to the deterministic seed weights before any commit).
+    """
+    from bigdl_tpu.serving import ModelServer, Replica
+    from bigdl_tpu.utils.file import CheckpointManager, load_checkpoint
+
+    def factory(replica_id: int, model: str,
+                checkpoint_path: Optional[str]):
+        lm = build_tiny_lm()
+        path = checkpoint_path
+        if path is None:
+            path = CheckpointManager(checkpoint_dir).latest_good()
+        if path is not None:
+            model_state, _opt, _driver = load_checkpoint(path)
+            lm.load_parameters(model_state["params"])
+            if "buffers" in model_state:
+                lm.load_buffers(model_state["buffers"])
+        return Replica(replica_id, ModelServer(generator=lm,
+                                               slots=slots),
+                       snapshot_dir=snapshot_dir,
+                       publish_interval_s=publish_interval_s,
+                       model=model)
+
+    return factory
+
+
+def _wait(cond, timeout: float, msg: str) -> None:
+    deadline = time.perf_counter() + timeout
+    while not cond():
+        if time.perf_counter() > deadline:
+            raise TimeoutError(f"{msg} not reached in {timeout}s")
+        time.sleep(0.02)
+
+
+def _commit_generation(checkpoint_dir: str, lm, generation: int) -> str:
+    """One committed checkpoint generation holding the LM's weights
+    (the CRC manifest makes it ``latest_good()``-visible)."""
+    from bigdl_tpu.utils.file import CheckpointManager
+
+    def plain(tree):
+        import jax
+        return jax.tree_util.tree_map(np.asarray, tree)
+
+    return CheckpointManager(checkpoint_dir).save(
+        {"params": plain(lm.parameters()),
+         "buffers": plain(lm.buffers())},
+        [], {"epoch": 0, "neval": int(generation)},
+        generation=int(generation))
+
+
+def run_fleet_scenario(workdir: str, *, load_s: float = 3.0,
+                       spike_requests: int = 18,
+                       kill: bool = True, deploy: bool = True,
+                       wait_scale_down: bool = True,
+                       max_replicas: int = 3,
+                       timeout_s: float = 120.0) -> Dict[str, Any]:
+    """The closed-loop acceptance scenario.  Returns a report dict;
+    raises TimeoutError if the loop never converges (that IS the
+    failure the scenario exists to catch).
+
+    Sequence: 1-replica fleet under sessioned load -> chaos kills the
+    replica (stops publishing; registry reads it stale-unhealthy) ->
+    controller replaces it -> a burst spike breaches the queue
+    watermark -> controller scales up -> training commits a new
+    checkpoint generation -> watcher rolling-hot-deploys it with the
+    zero-drop ``deploy()`` path -> greedy rows after the swap are
+    bit-identical to solo ``generate()`` -> idle fleet scales back
+    down.  Every submitted future must resolve ok or typed-shed;
+    anything else counts as ``dropped`` and the caller should fail.
+    """
+    from bigdl_tpu.serving import (NoReplicaAvailableError,
+                                   RequestSheddedError, Router)
+    from bigdl_tpu.fleet.controller import FleetController
+    from bigdl_tpu.fleet.policy import PoolSpec
+    from bigdl_tpu.fleet.watcher import CheckpointWatcher
+    from bigdl_tpu.utils.file import CheckpointManager
+
+    t_start = time.perf_counter()
+    snap_dir = os.path.join(workdir, "snapshots")
+    ckpt_dir = os.path.join(workdir, "checkpoints")
+    os.makedirs(snap_dir, exist_ok=True)
+    os.makedirs(ckpt_dir, exist_ok=True)
+
+    lm = build_tiny_lm()
+    _commit_generation(ckpt_dir, lm, 1)    # the baseline generation
+    factory = checkpoint_factory(snap_dir, ckpt_dir)
+
+    rng = np.random.default_rng(21)
+    probe_prompts = [rng.integers(1, 50, 6).astype(np.int32)
+                     for _ in range(3)]
+    probe_max_new = 8
+    oracles = [solo_row(lm, p, probe_max_new) for p in probe_prompts]
+
+    victim = factory(0, "default", None)
+    router = Router(replicas=[victim], snapshot_dir=snap_dir,
+                    poll_interval_s=0.02, registry_max_age_s=0.6,
+                    queue_capacity=256, shed_after_s=30.0)
+    spec = PoolSpec(model="default", min_replicas=1,
+                    max_replicas=int(max_replicas), queue_high=6,
+                    queue_low=1, breach_consecutive=2,
+                    clear_consecutive=4, cooldown_s=1.0,
+                    dead_after_polls=2)
+    controller = FleetController(router, factory, pools=[spec],
+                                 interval_s=0.05, start=True)
+    watcher = CheckpointWatcher(CheckpointManager(ckpt_dir), router,
+                                factory, poll_interval_s=0.1,
+                                deploy_timeout_s=timeout_s,
+                                start=True) if deploy else None
+
+    futures: List[Any] = []
+    report: Dict[str, Any] = {"killed_replica": None,
+                              "replaced_with": None}
+    try:
+        # warm the fleet before offering load: the first generate pays
+        # the jit compile, and a multi-second compile under offered
+        # load reads as a queue breach the scenario didn't script
+        router.submit_generate(probe_prompts[0], probe_max_new,
+                               timeout=timeout_s)
+
+        # ---- phase A: sustained sessioned load ---------------------------
+        t_end = time.perf_counter() + load_s
+        i = 0
+        while time.perf_counter() < t_end:
+            futures.append(router.submit_generate_async(
+                rng.integers(1, 50, int(rng.integers(3, 10))).astype(
+                    np.int32),
+                int(rng.integers(2, 8)), session=f"user-{i % 8}"))
+            i += 1
+            time.sleep(0.02)
+
+            if kill and report["killed_replica"] is None \
+                    and time.perf_counter() > t_end - load_s / 2:
+                # ---- phase B: chaos kill, mid-load -----------------------
+                chaos.install(kill_replica_after_s=0.0,
+                              kill_replica_id=0)
+                report["killed_replica"] = 0
+
+        if kill:
+            # the controller notices the stale snapshot and replaces
+            # the dead replica with no operator step
+            _wait(lambda: 0 not in router.replica_ids()
+                  and len(router.replica_ids()) >= 1,
+                  timeout_s, "dead replica replaced")
+            report["replaced_with"] = sorted(router.replica_ids())
+
+        # ---- phase C: load spike -> scale-up -----------------------------
+        base_live = len(router.replica_ids())
+        for _ in range(int(spike_requests)):
+            futures.append(router.submit_generate_async(
+                rng.integers(1, 50, 6).astype(np.int32), 32))
+        _wait(lambda: len(router.replica_ids()) > base_live
+              or len(router.replica_ids()) >= max_replicas,
+              timeout_s, "scale-up past the spike")
+        report["live_after_spike"] = len(router.replica_ids())
+
+        # ---- drain the offered load (ok or TYPED, nothing dropped) -------
+        ok = shed = dropped = 0
+        for f in futures:
+            try:
+                f.result(timeout_s)
+                ok += 1
+            except (RequestSheddedError, NoReplicaAvailableError):
+                shed += 1
+            except Exception:
+                dropped += 1
+        report.update(submitted=len(futures), ok=ok, shed=shed,
+                      dropped=dropped)
+
+        # ---- phase D: new generation -> rolling hot-deploy ---------------
+        if deploy:
+            _commit_generation(ckpt_dir, lm, 2)
+            _wait(lambda: watcher.status().get("deployed_generation")
+                  == 2, timeout_s, "generation 2 hot-deployed")
+            st = watcher.status()
+            report["deployed_generation"] = st["deployed_generation"]
+            report["freshness_s"] = st["last_freshness_s"]
+            report["deploy_swapped"] = st["last_swapped"]
+
+        # greedy rows across the (possibly swapped) fleet must equal
+        # the solo oracle bit for bit
+        rows = [router.submit_generate(p, probe_max_new,
+                                       timeout=timeout_s)
+                for p in probe_prompts]
+        report["greedy_rows_equal"] = all(
+            np.array_equal(r, o) for r, o in zip(rows, oracles))
+        report["greedy_checked"] = len(rows)
+
+        # ---- phase E: idle fleet scales back down ------------------------
+        if wait_scale_down:
+            _wait(lambda: len(router.replica_ids())
+                  < report["live_after_spike"],
+                  timeout_s, "scale-down after the spike drains")
+        report["live_final"] = len(router.replica_ids())
+
+        # the zero-drop invariant, measured the acceptance way
+        report["admitted_outstanding"] = sum(
+            router.replica(rid).admitted_outstanding()
+            for rid in router.replica_ids()
+            if router.replica(rid) is not None)
+        report["controller_status"] = controller.status()
+        kinds: Dict[str, int] = {}
+        for e in _events.recent_events(500):
+            kinds[e["kind"]] = kinds.get(e["kind"], 0) + 1
+        report["events"] = {k: kinds.get(k, 0)
+                            for k in ("scale_up", "scale_down",
+                                      "hot_deploy", "controller_hold",
+                                      "chaos_fault")}
+        report["duration_s"] = round(time.perf_counter() - t_start, 2)
+        return report
+    finally:
+        if watcher is not None:
+            watcher.stop()
+        controller.stop()
+        chaos.reset()
+        router.shutdown()

@@ -1,0 +1,218 @@
+"""Scaling policy: the pure decide() half of the fleet controller.
+
+The controller splits Kubernetes-style into an OBSERVE/DECIDE half
+(this module — no threads, no locks, no IO, fully unit-testable with
+hand-built observations) and an ACTUATE half
+(:mod:`bigdl_tpu.fleet.controller` — the reconcile thread that spawns,
+drains, and removes replicas).  The split is what makes "the
+controller did something — why?" answerable: every decision is a
+:class:`Decision` with a human-readable reason string, and the same
+reason lands verbatim in the flight-recorder event and the
+``/statusz`` ``controller`` section.
+
+Hysteresis semantics (the knobs an operator actually tunes):
+
+* **Separate up/down thresholds** — scale-up triggers on
+  ``queue_high`` / ``ttft_high_s`` / any shed; scale-down requires the
+  queue at or below the LOWER ``queue_low`` watermark with no sheds
+  and under one in-flight request per replica.  The gap between the
+  watermarks is the dead band that stops the pool oscillating around
+  a single threshold.
+* **Consecutive-observation streaks** — a breach must hold for
+  ``breach_consecutive`` reconcile ticks (and idleness for
+  ``clear_consecutive``) before the policy acts; one noisy snapshot
+  never moves the fleet.
+* **Cooldown** — after any scaling action the policy answers ``hold``
+  for ``cooldown_s``, long enough for the previous action's effect
+  (a replica warming its compile cache, a drain finishing) to show up
+  in the signals it decides on.  Without it the controller would read
+  the still-breached queue and scale again every tick.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+__all__ = ["PoolSpec", "Observation", "Decision", "ScalingPolicy"]
+
+
+class PoolSpec:
+    """Per-model pool configuration: the size envelope, the SLO class
+    and admission budget pushed into the router, and the scaling
+    thresholds the policy judges against.  ``ttft_high_s`` defaults to
+    the pool's SLO target — breach the promise, grow the pool."""
+
+    def __init__(self, model: str = "default", min_replicas: int = 1,
+                 max_replicas: int = 4,
+                 slo_ttft_p99_s: Optional[float] = None,
+                 admission_budget: Optional[int] = None,
+                 ttft_high_s: Optional[float] = None,
+                 queue_high: int = 8, queue_low: int = 1,
+                 breach_consecutive: int = 2,
+                 clear_consecutive: int = 4,
+                 cooldown_s: float = 5.0,
+                 dead_after_polls: int = 2):
+        if min_replicas < 1:
+            raise ValueError(
+                f"min_replicas must be >= 1, got {min_replicas}")
+        if max_replicas < min_replicas:
+            raise ValueError(
+                f"max_replicas ({max_replicas}) < min_replicas "
+                f"({min_replicas})")
+        if queue_low >= queue_high:
+            raise ValueError(
+                f"queue_low ({queue_low}) must sit strictly below "
+                f"queue_high ({queue_high}) — the gap is the "
+                f"hysteresis dead band")
+        self.model = str(model)
+        self.min_replicas = int(min_replicas)
+        self.max_replicas = int(max_replicas)
+        self.slo_ttft_p99_s = (None if slo_ttft_p99_s is None
+                               else float(slo_ttft_p99_s))
+        self.admission_budget = (None if admission_budget is None
+                                 else int(admission_budget))
+        self.ttft_high_s = (float(ttft_high_s)
+                            if ttft_high_s is not None
+                            else self.slo_ttft_p99_s)
+        self.queue_high = int(queue_high)
+        self.queue_low = int(queue_low)
+        self.breach_consecutive = int(breach_consecutive)
+        self.clear_consecutive = int(clear_consecutive)
+        self.cooldown_s = float(cooldown_s)
+        self.dead_after_polls = int(dead_after_polls)
+
+    def clamp(self, n: int) -> int:
+        return max(self.min_replicas, min(self.max_replicas, int(n)))
+
+
+class Observation:
+    """One reconcile tick's view of a pool, already reduced to the
+    signals the policy decides on."""
+
+    __slots__ = ("live", "desired", "ttft_p99_s", "queue_depth",
+                 "shed_delta", "inflight")
+
+    def __init__(self, live: int, desired: int, ttft_p99_s: float = 0.0,
+                 queue_depth: int = 0, shed_delta: int = 0,
+                 inflight: int = 0):
+        self.live = int(live)
+        self.desired = int(desired)
+        self.ttft_p99_s = float(ttft_p99_s)
+        self.queue_depth = int(queue_depth)
+        self.shed_delta = int(shed_delta)
+        self.inflight = int(inflight)
+
+
+class Decision:
+    """What the policy wants this tick.  ``action`` is one of
+    ``"up"`` / ``"down"`` / ``"hold"`` / ``None`` — ``hold`` means a
+    breach-driven action WAS warranted but is suppressed (cooldown, or
+    clamped at the pool envelope), the case an operator most wants
+    explained; ``None`` means nothing to do at all.  ``key`` is a
+    STABLE slug for the hold cause ("cooldown" / "at-max"): the reason
+    string carries tick-varying numbers (streaks, seconds remaining),
+    so the controller latches its one-event-per-episode flight-recorder
+    emission on the key, not the prose."""
+
+    __slots__ = ("action", "reason", "key")
+
+    def __init__(self, action: Optional[str], reason: str = "",
+                 key: Optional[str] = None):
+        self.action = action
+        self.reason = reason
+        self.key = key
+
+    def __repr__(self) -> str:
+        return f"Decision({self.action!r}, {self.reason!r})"
+
+
+class ScalingPolicy:
+    """Streak + cooldown state for one pool.  Pure against injected
+    time: every method takes ``now`` from the caller's
+    ``time.perf_counter()`` so tests drive hysteresis without
+    sleeping."""
+
+    def __init__(self, spec: PoolSpec):
+        self.spec = spec
+        self._high_streak = 0
+        self._low_streak = 0
+        self._last_action_at: Optional[float] = None
+
+    # -- observation -> decision -------------------------------------------
+
+    def _breaches(self, obs: Observation) -> list:
+        s = self.spec
+        out = []
+        if s.ttft_high_s is not None and obs.ttft_p99_s > s.ttft_high_s:
+            out.append(f"ttft_p99 {obs.ttft_p99_s:.3f}s > "
+                       f"{s.ttft_high_s:.3f}s")
+        if obs.queue_depth >= s.queue_high:
+            out.append(f"queue depth {obs.queue_depth} >= "
+                       f"{s.queue_high}")
+        if obs.shed_delta > 0:
+            out.append(f"{obs.shed_delta} request(s) shed since last "
+                       f"tick")
+        return out
+
+    def _idle(self, obs: Observation) -> bool:
+        s = self.spec
+        return (obs.queue_depth <= s.queue_low
+                and obs.shed_delta == 0
+                and obs.inflight < max(obs.live, 1)
+                and (s.ttft_high_s is None
+                     or obs.ttft_p99_s <= s.ttft_high_s))
+
+    def cooldown_remaining(self, now: float) -> float:
+        if self._last_action_at is None:
+            return 0.0
+        return max(self.spec.cooldown_s - (now - self._last_action_at),
+                   0.0)
+
+    def decide(self, obs: Observation, now: float) -> Decision:
+        s = self.spec
+        breaches = self._breaches(obs)
+        if breaches:
+            self._high_streak += 1
+            self._low_streak = 0
+        elif self._idle(obs):
+            self._low_streak += 1
+            self._high_streak = 0
+        else:
+            self._high_streak = 0
+            self._low_streak = 0
+        if self._high_streak >= s.breach_consecutive:
+            reason = "; ".join(breaches) \
+                + f" (for {self._high_streak} ticks)"
+            if obs.desired >= s.max_replicas:
+                return Decision("hold", f"{reason} — already at "
+                                        f"max_replicas={s.max_replicas}",
+                                key="at-max")
+            cd = self.cooldown_remaining(now)
+            if cd > 0:
+                return Decision("hold", f"{reason} — cooling down "
+                                        f"{cd:.1f}s more",
+                                key="cooldown")
+            return Decision("up", reason)
+        if self._low_streak >= s.clear_consecutive:
+            reason = (f"idle for {self._low_streak} ticks (queue <= "
+                      f"{s.queue_low}, no sheds, inflight "
+                      f"{obs.inflight} < live {obs.live})")
+            if obs.desired <= s.min_replicas:
+                # sitting at the floor while idle is the steady state,
+                # not a suppressed action worth paging about
+                return Decision(None, "")
+            if self.cooldown_remaining(now) > 0:
+                return Decision(
+                    "hold", f"{reason} — cooling down "
+                            f"{self.cooldown_remaining(now):.1f}s more",
+                    key="cooldown")
+            return Decision("down", reason)
+        return Decision(None, "")
+
+    def actuated(self, now: float) -> None:
+        """The controller carried out a scaling action: restart the
+        streaks (the next action needs fresh evidence) and stamp the
+        cooldown clock."""
+        self._high_streak = 0
+        self._low_streak = 0
+        self._last_action_at = now

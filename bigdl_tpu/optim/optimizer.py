@@ -1226,6 +1226,16 @@ class Optimizer:
                 out["fleet"] = self._fleet_monitor.status()
             except Exception:  # pragma: no cover - best effort
                 out["fleet"] = None
+        # fleet-controller section (autoscaler / deploy watcher /
+        # training supervisor) when any is live in this process — the
+        # "the controller did something — why?" page
+        try:
+            from bigdl_tpu.fleet.controller import controller_statusz
+            ctl = controller_statusz()
+            if ctl is not None:
+                out["controller"] = ctl
+        except Exception:  # pragma: no cover - best effort
+            pass
         return out
 
     def _start_debug_server(self) -> None:

@@ -54,6 +54,7 @@ from bigdl_tpu.telemetry.fleet import (
     host_stats, merge_host_snapshots, read_host_snapshots,
     remove_host_snapshot, write_host_snapshot,
 )
+from bigdl_tpu.utils import chaos
 
 __all__ = ["Replica", "ReplicaRegistry", "DisaggregatedEngine",
            "replica_snapshot", "SnapshotPublisher", "scrape_healthz"]
@@ -83,8 +84,8 @@ def _target_queue_depth(target) -> int:
 def replica_snapshot(replica_id: int, target=None, name: str = "",
                      role: str = "mixed", draining: bool = False,
                      healthy: bool = True,
-                     start_generation: Optional[int] = None) \
-        -> Dict[str, Any]:
+                     start_generation: Optional[int] = None,
+                     model: str = "default") -> Dict[str, Any]:
     """One replica's health snapshot: the fleet ``host_stats`` vector
     (so :func:`merge_host_snapshots` derives a straggler table from
     the very same files) extended with the serving-plane fields the
@@ -107,6 +108,7 @@ def replica_snapshot(replica_id: int, target=None, name: str = "",
     snap.update({
         "name": name or f"replica-{int(replica_id)}",
         "role": role,
+        "model": str(model),
         "start_generation": (None if start_generation is None
                              else int(start_generation)),
         "healthy": bool(healthy),
@@ -181,7 +183,8 @@ class Replica:
     def __init__(self, replica_id: int, target, name: Optional[str] = None,
                  role: str = "mixed", snapshot_dir: Optional[str] = None,
                  publish_interval_s: float = 0.25,
-                 start_generation: Optional[int] = None):
+                 start_generation: Optional[int] = None,
+                 model: str = "default"):
         if role not in ROLES:
             raise ValueError(f"role must be one of {ROLES}, got {role!r}")
         for attr in ("submit_generate_async", "shutdown"):
@@ -193,6 +196,10 @@ class Replica:
         self.id = int(replica_id)
         self.name = name or f"replica-{self.id}"
         self.role = role
+        # which model pool this replica serves: the router restricts a
+        # request's candidates to its model's pool, and the fleet
+        # controller scales each pool independently
+        self.model = str(model)
         self.target = target
         # incarnation stamp: a restart under the same id constructs a
         # new Replica and therefore a strictly larger stamp (wall ms —
@@ -205,6 +212,7 @@ class Replica:
         self._lock = threading.Lock()
         self._draining = False
         self._closed = False
+        self._chaos_killed = False
         self.publish_interval_s = float(publish_interval_s)
         self._publisher: Optional[SnapshotPublisher] = None
         if snapshot_dir is not None:
@@ -239,6 +247,11 @@ class Replica:
     def submit_generate_async(self, prompt, max_new_tokens: int,
                               eos_id=None, on_token=None,
                               timeout: Optional[float] = None) -> Future:
+        with self._lock:
+            if self._chaos_killed:
+                from bigdl_tpu.serving.admission import ServerClosedError
+                raise ServerClosedError(
+                    f"replica {self.id} was chaos-killed")
         return self.target.submit_generate_async(
             prompt, max_new_tokens, eos_id=eos_id, on_token=on_token,
             timeout=timeout)
@@ -272,11 +285,39 @@ class Replica:
         return replica_snapshot(
             self.id, self.target, name=self.name, role=self.role,
             draining=draining, healthy=not closed,
-            start_generation=self.start_generation)
+            start_generation=self.start_generation, model=self.model)
 
     def publish(self) -> None:
+        if chaos.on_replica_publish(self.id):
+            self._chaos_kill()
+        with self._lock:
+            killed = self._chaos_killed
+        if killed:
+            # a killed replica writes NOTHING — the registry sees its
+            # snapshot go stale and marks it unhealthy, exactly like a
+            # hung process; the stale file stays on disk until the
+            # controller removes the replica (forget())
+            return
         if self.snapshot_dir is not None:
             write_host_snapshot(self.snapshot_dir, self.snapshot())
+
+    def _chaos_kill(self) -> None:
+        """Die the SIGTERM way: stop publishing (stale-unhealthy to
+        the registry), refuse new submissions (typed
+        ServerClosedError — the router parks and re-picks), and drain
+        already-admitted requests on a background thread so
+        ``admitted_outstanding()`` still falls to 0 — the zero-drop
+        invariant the controller's replacement path is proven
+        against."""
+        with self._lock:
+            if self._chaos_killed:
+                return
+            self._chaos_killed = True
+        threading.Thread(
+            target=lambda: self.target.shutdown(drain=True,
+                                                timeout=30.0),
+            name=f"bigdl-replica-{self.id}-chaos-drain",
+            daemon=True).start()
 
     # ---- lifecycle -------------------------------------------------------
 
@@ -387,6 +428,7 @@ class ReplicaRegistry:
                 "id": pid,
                 "name": row.get("name", f"replica-{pid}"),
                 "role": row.get("role", "mixed"),
+                "model": str(row.get("model", "default") or "default"),
                 "healthy": bool(row.get("healthy", True)) and not stale,
                 "reason": "stale" if stale else None,
                 "draining": bool(row.get("draining", False)),

@@ -187,6 +187,7 @@ class Router:
                  slo_ttft_p99_s: Optional[float] = None,
                  bounded_load_factor: float = 2.0,
                  admission_budgets: Optional[Dict[str, int]] = None,
+                 slo_classes: Optional[Dict[str, float]] = None,
                  shed_after_s: Optional[float] = None,
                  poll_interval_s: float = 0.05,
                  registry_max_age_s: float = 2.0,
@@ -209,6 +210,11 @@ class Router:
                              f"{bounded_load_factor}")
         self.bounded_load_factor = float(bounded_load_factor)
         self.admission_budgets = dict(admission_budgets or {})
+        # per-model TTFT p99 targets (SLO classes): a model listed here
+        # is judged against its own number, everything else against
+        # the router-wide slo_ttft_p99_s
+        self.slo_classes = {str(m): float(s)
+                            for m, s in (slo_classes or {}).items()}
         # the shed deadline defaults to the SLO itself: a request that
         # already waited one full TTFT budget unrouted would breach
         # anyway — reject it typed instead of letting it time out
@@ -229,6 +235,7 @@ class Router:
         self._dispatched = 0
         self._outcomes: Dict[str, int] = {}
         self._shed_reasons: Dict[str, int] = {}
+        self._model_shed: Dict[str, int] = {}
         self._affine_total = 0
         self._affine_hits = 0
         self._shutdown = False
@@ -312,6 +319,29 @@ class Router:
         self.remove_replica(replaces, drain=True)
         return {"replaced": int(replaces), "added": new_replica.id,
                 "outstanding_at_removal": 0}
+
+    # ---- per-model knobs (the fleet controller's actuation surface) ------
+
+    def set_admission_budget(self, model: str,
+                             budget: Optional[int]) -> None:
+        """Install (or with ``None`` clear) a per-model in-flight cap
+        — thread-safe, so the fleet controller can apply a pool's
+        budget while traffic flows."""
+        with self._lock:
+            if budget is None:
+                self.admission_budgets.pop(str(model), None)
+            else:
+                self.admission_budgets[str(model)] = int(budget)
+
+    def set_slo_class(self, model: str,
+                      slo_ttft_p99_s: Optional[float]) -> None:
+        """Install (or with ``None`` clear) a per-model TTFT p99
+        target overriding the router-wide one."""
+        with self._lock:
+            if slo_ttft_p99_s is None:
+                self.slo_classes.pop(str(model), None)
+            else:
+                self.slo_classes[str(model)] = float(slo_ttft_p99_s)
 
     # ---- submission ------------------------------------------------------
 
@@ -459,12 +489,23 @@ class Router:
                           if req.session is not None else [])
             budget = self.admission_budgets.get(req.model)
             model_used = self._model_inflight.get(req.model, 0)
+            slo_target = self.slo_classes.get(
+                req.model, self.slo_ttft_p99_s)
         if budget is not None and model_used >= budget:
             return None, "budget"
+        # model pools: when ANY known replica declares this request's
+        # model, the pool is exactly those replicas (a pool with no
+        # healthy member sheds rather than landing on another model's
+        # weights); a model nobody declares falls through to the
+        # "default" pool, so a single-model fleet needs no labels
+        declared = {(records.get(rid) or {}).get("model", "default")
+                    for rid in known}
+        pool_model = (req.model if req.model in declared else "default")
         def rec_ok(rid):
             rec = records.get(rid)
             return (rid in known and rec is not None
-                    and rec["healthy"] and not rec["draining"])
+                    and rec["healthy"] and not rec["draining"]
+                    and rec.get("model", "default") == pool_model)
         eligible = [rid for rid in known if rec_ok(rid)]
         if not eligible:
             return None, "no_replica"
@@ -474,7 +515,7 @@ class Router:
                 records.get(rid) or {}, len(eligible), total,
                 self.bounded_load_factor)
         def slo_ok(rid):
-            if self.slo_ttft_p99_s is None:
+            if slo_target is None:
                 return True
             rec = records.get(rid) or {}
             if rec.get("rewarming"):
@@ -483,7 +524,7 @@ class Router:
                 # belongs to the dead life — route to it like a fresh
                 # join instead of excluding it on somebody else's p99
                 return True
-            return rec.get("ttft_p99_s", 0.0) <= self.slo_ttft_p99_s
+            return rec.get("ttft_p99_s", 0.0) <= slo_target
         if req.session is not None:
             for i, rid in enumerate(ring_order):
                 # the HOME replica may be SLO-breached and still take
@@ -596,6 +637,8 @@ class Router:
         with self._lock:
             self._shed_reasons[reason] = \
                 self._shed_reasons.get(reason, 0) + 1
+            self._model_shed[req.model] = \
+                self._model_shed.get(req.model, 0) + 1
         _events.record_event("router_shed", reason=reason,
                              queued_s=round(waited_s, 6),
                              model=req.model,
@@ -662,6 +705,12 @@ class Router:
         with self._lock:
             return list(self._replicas)
 
+    def replica(self, replica_id: int) -> Optional[Replica]:
+        """The live handle for a registered replica id (None if it has
+        been removed) — the fleet controller's actuation handle."""
+        with self._lock:
+            return self._replicas.get(int(replica_id))
+
     def records(self) -> Dict[int, Dict[str, Any]]:
         """The latest registry view the router routed on."""
         with self._lock:
@@ -688,7 +737,10 @@ class Router:
                     if self._affine_total else 0.0),
                 "queue_depth": depth,
                 "waiting": waiting,
+                "model_inflight": dict(self._model_inflight),
+                "model_shed": dict(self._model_shed),
                 "slo_ttft_p99_s": self.slo_ttft_p99_s,
+                "slo_classes": dict(self.slo_classes),
                 "bounded_load_factor": self.bounded_load_factor,
                 "shed_after_s": self.shed_after_s,
             }

@@ -86,6 +86,17 @@ class Debugz:
                        "counts": ev["counts"],
                        "recent": ev["recent"]},
         }
+        # fleet-controller section (autoscaler / deploy watcher /
+        # training supervisor) whenever one is live in this process:
+        # desired/live counts, the last decision + reason, cooldown
+        # remaining — the "the controller did something, why?" page
+        try:
+            from bigdl_tpu.fleet.controller import controller_statusz
+            ctl = controller_statusz()
+            if ctl is not None:
+                base["controller"] = ctl
+        except Exception:  # pragma: no cover - best effort
+            pass
         if self.statusz_fn is not None:
             try:
                 extra = self.statusz_fn()

@@ -54,6 +54,7 @@ EVENT_KINDS = (
     "admission_shed", "watchdog", "watchdog_halt",
     "flight_recorder_dump",
     "replica_join", "replica_drain", "router_shed",
+    "scale_up", "scale_down", "hot_deploy", "controller_hold",
 )
 
 _DEFAULT_CAPACITY = 2048

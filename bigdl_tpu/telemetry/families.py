@@ -435,6 +435,43 @@ def router_shed_total() -> Counter:
         "exhausted)", labelnames=("reason",))
 
 
+# ---- fleet controller (autoscaler + continuous deployment, fleet/) --------
+
+def fleet_replicas_desired() -> Gauge:
+    return get_registry().gauge(
+        "fleet_replicas_desired",
+        "Replica count the controller currently wants per model pool "
+        "(the reconcile target; moves on scale decisions, clamped to "
+        "[min_replicas, max_replicas])", labelnames=("model",))
+
+
+def fleet_replicas_live() -> Gauge:
+    return get_registry().gauge(
+        "fleet_replicas_live",
+        "Healthy, non-draining replicas the registry currently "
+        "reports per model pool (the reconcile observation; lags "
+        "desired while spawns warm up or drains finish)",
+        labelnames=("model",))
+
+
+def fleet_scale_events_total() -> Counter:
+    return get_registry().counter(
+        "fleet_scale_events_total",
+        "Scaling actions the controller actually took, by direction: "
+        "up (spawned a replica — load breach or replacement of a dead "
+        "one), down (started a zero-drop drain-out)",
+        labelnames=("direction",))
+
+
+def fleet_deploy_freshness_seconds() -> Gauge:
+    return get_registry().gauge(
+        "fleet_deploy_freshness_seconds",
+        "Train-to-serve freshness: seconds from a checkpoint "
+        "generation's commit timestamp (manifest time) to the moment "
+        "the LAST serving replica in the pool finished hot-deploying "
+        "it — the one number answering how stale serving weights are")
+
+
 _PREREGISTER = (
     optimizer_data_wait_seconds, optimizer_step_seconds,
     optimizer_validation_seconds, optimizer_retries_total,
@@ -465,6 +502,8 @@ _PREREGISTER = (
     generation_prefix_cache_resident_bytes,
     generation_prefill_dedup_total,
     router_requests_total, router_replica_inflight, router_shed_total,
+    fleet_replicas_desired, fleet_replicas_live,
+    fleet_scale_events_total, fleet_deploy_freshness_seconds,
 )
 
 

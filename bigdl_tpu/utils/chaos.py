@@ -39,6 +39,14 @@ or by environment variables (picked up lazily on the first hook call, so
   surfaces as) when training reaches this iteration, once — the seam
   the OOM-forensics pipeline is proven through without needing a real
   chip to run out of HBM.  ``1`` fires at the first step.
+* ``BIGDL_TPU_CHAOS_KILL_REPLICA`` — ``"<seconds>"`` or
+  ``"<seconds>:<replica_id>"``: this long after arming, kill one
+  serving replica (the id given, else whichever publishes first) —
+  SIGTERM-style: it stops publishing health snapshots (the registry
+  marks it stale-unhealthy, exactly like a hung process), refuses new
+  submissions, and drains its already-admitted requests in the
+  background, so the fleet controller's replace-the-dead path is
+  provable without killing a real process.  Fires once.
 * ``BIGDL_TPU_CHAOS_RESHARD`` — ``"<step>:<width>"``: raise
   :class:`ReshardInjected` carrying the new width when training
   reaches ``step`` (once) — a lost slice whose fleet regrants capacity
@@ -65,7 +73,8 @@ from typing import List, Optional
 
 __all__ = ["FaultInjected", "ReshardInjected", "ChaosController",
            "install", "reset", "active", "on_step", "on_io_write",
-           "on_checkpoint_payload", "on_data_batch"]
+           "on_checkpoint_payload", "on_data_batch",
+           "on_replica_publish"]
 
 logger = logging.getLogger("bigdl_tpu.chaos")
 
@@ -107,7 +116,9 @@ class ChaosController:
                  stall_pipeline_batches: Optional[int] = None,
                  oom_at_step: Optional[int] = None,
                  reshard_at_step: Optional[int] = None,
-                 reshard_to=None):
+                 reshard_to=None,
+                 kill_replica_after_s: Optional[float] = None,
+                 kill_replica_id: Optional[int] = None):
         self.fail_at_step = fail_at_step
         self.oom_at_step = oom_at_step
         if (reshard_at_step is None) != (reshard_to is None):
@@ -119,6 +130,14 @@ class ChaosController:
         self.crash_checkpoint = crash_checkpoint
         self.truncate_checkpoint = truncate_checkpoint
         self.truncate_keep_bytes = int(truncate_keep_bytes)
+        self.kill_replica_after_s = (
+            None if kill_replica_after_s is None
+            else float(kill_replica_after_s))
+        self.kill_replica_id = (None if kill_replica_id is None
+                                else int(kill_replica_id))
+        # the kill clock starts at arm time (perf_counter: a duration
+        # within one process, never compared across processes)
+        self._armed_pc = time.perf_counter()
         self.io_fail_p = float(io_fail_p)
         self.stall_pipeline_s = float(stall_pipeline_s)
         self.stall_pipeline_batches = stall_pipeline_batches
@@ -191,6 +210,27 @@ class ChaosController:
                        f"{self.stall_pipeline_s}s per batch")
         time.sleep(self.stall_pipeline_s)
 
+    def on_replica_publish(self, replica_id: int) -> bool:
+        """Called from each replica's snapshot publish.  Returns True
+        exactly once — the moment the armed kill fires for this
+        replica (the id given at arm time, else whoever publishes
+        first past the deadline); the replica reacts by dying the
+        SIGTERM way (stop publishing, refuse new work, drain admitted
+        work in the background)."""
+        with self._lock:
+            if self.kill_replica_after_s is None:
+                return False
+            if self.kill_replica_id is not None \
+                    and int(replica_id) != self.kill_replica_id:
+                return False
+            if time.perf_counter() - self._armed_pc \
+                    < self.kill_replica_after_s:
+                return False
+            self.kill_replica_after_s = None  # one-shot: the fleet
+            # controller's replacement must come up and stay up
+        self._fire(f"killed replica {int(replica_id)}")
+        return True
+
     def on_checkpoint_payload(self, path: str) -> None:
         """Called after a checkpoint payload is durably on disk, before
         its manifest/commit marker is written."""
@@ -226,7 +266,7 @@ _env_checked = False
 _ENV_KEYS = ("BIGDL_TPU_CHAOS_FAIL_STEP", "BIGDL_TPU_CHAOS_CRASH_CKPT",
              "BIGDL_TPU_CHAOS_TRUNCATE_CKPT", "BIGDL_TPU_CHAOS_IO_FAIL_P",
              "BIGDL_TPU_CHAOS_STALL_PIPELINE_S", "BIGDL_TPU_CHAOS_OOM",
-             "BIGDL_TPU_CHAOS_RESHARD")
+             "BIGDL_TPU_CHAOS_RESHARD", "BIGDL_TPU_CHAOS_KILL_REPLICA")
 
 
 def _parse_reshard(v: Optional[str]):
@@ -243,6 +283,23 @@ def _parse_reshard(v: Optional[str]):
             f"(e.g. '5:2'), got {v!r}") from e
 
 
+def _parse_kill_replica(v: Optional[str]):
+    """``"<seconds>"`` or ``"<seconds>:<replica_id>"`` ->
+    (after_s, replica_id-or-None); malformed values raise at arm
+    time, not at fire time."""
+    if not v:
+        return None, None
+    try:
+        if ":" in v:
+            after, rid = v.split(":", 1)
+            return float(after), int(rid)
+        return float(v), None
+    except ValueError as e:
+        raise ValueError(
+            f"BIGDL_TPU_CHAOS_KILL_REPLICA must be '<seconds>' or "
+            f"'<seconds>:<replica_id>' (e.g. '0.5:3'), got {v!r}") from e
+
+
 def _from_env() -> Optional[ChaosController]:
     e = os.environ
     if not any(e.get(k) for k in _ENV_KEYS):
@@ -254,6 +311,8 @@ def _from_env() -> Optional[ChaosController]:
 
     reshard_step, reshard_to = _parse_reshard(
         e.get("BIGDL_TPU_CHAOS_RESHARD"))
+    kill_after, kill_id = _parse_kill_replica(
+        e.get("BIGDL_TPU_CHAOS_KILL_REPLICA"))
     return ChaosController(
         fail_at_step=_i("BIGDL_TPU_CHAOS_FAIL_STEP"),
         crash_checkpoint=_i("BIGDL_TPU_CHAOS_CRASH_CKPT"),
@@ -265,7 +324,8 @@ def _from_env() -> Optional[ChaosController]:
         stall_pipeline_batches=_i(
             "BIGDL_TPU_CHAOS_STALL_PIPELINE_BATCHES"),
         oom_at_step=_i("BIGDL_TPU_CHAOS_OOM"),
-        reshard_at_step=reshard_step, reshard_to=reshard_to)
+        reshard_at_step=reshard_step, reshard_to=reshard_to,
+        kill_replica_after_s=kill_after, kill_replica_id=kill_id)
 
 
 def install(**kwargs) -> ChaosController:
@@ -313,3 +373,8 @@ def on_data_batch() -> None:
     c = active()
     if c is not None:
         c.on_data_batch()
+
+
+def on_replica_publish(replica_id: int) -> bool:
+    c = active()
+    return c.on_replica_publish(replica_id) if c is not None else False

@@ -989,6 +989,33 @@ class CheckpointManager:
                 _tm.checkpoint_torn_generations_total().inc()
         return None
 
+    def latest_good_info(self) -> Optional[Dict]:
+        """:meth:`latest_good` plus the manifest metadata the
+        continuous-deploy watcher keys on: ``{"path", "generation",
+        "time"}``.  ``time`` is the manifest's commit timestamp — the
+        start of the train-to-serve freshness clock
+        (``fleet_deploy_freshness_seconds``); a legacy manifest-less
+        payload falls back to file mtime with ``generation`` None."""
+        path = self.latest_good()
+        if path is None:
+            return None
+        info: Dict = {"path": path, "generation": None, "time": None}
+        try:
+            mp = checkpoint_manifest_path(path)
+            with open_file(mp, "rb") as f:
+                man = json.loads(f.read().decode("utf-8"))
+            info["generation"] = man.get("generation")
+            info["time"] = man.get("time")
+        except Exception:
+            pass
+        if info["time"] is None:
+            try:
+                info["time"] = os.path.getmtime(
+                    strip_file_scheme(path).rstrip("/"))
+            except OSError:
+                pass
+        return info
+
     def _legacy_candidates(self) -> List[str]:
         """All checkpoint*.npz/.orbax payloads, newest first — by mtime
         locally, by numeric suffix when mtimes are unreliable (object

@@ -1,0 +1,52 @@
+#!/usr/bin/env bash
+# Self-driving-fleet smoke: the ISSUE-16 acceptance loop on the CPU
+# backend with NO operator step anywhere in the fault-to-recovery path
+# (docs/serving.md "Autoscaling & continuous deployment").
+#
+#   1. chaos kills the only replica mid-load -> the registry reads it
+#      stale-unhealthy -> the FleetController replaces it;
+#   2. a burst spike breaches the queue watermark -> the controller
+#      scales the pool up (scale_up in the flight recorder);
+#   3. training commits a new checkpoint generation -> the
+#      CheckpointWatcher rolling-hot-deploys it replica by replica
+#      through the zero-drop deploy() path, and greedy rows after the
+#      swap are bit-identical to solo generate() on the same weights;
+#   4. every submitted request resolves ok or typed-shed — zero
+#      dropped admitted work (admitted_outstanding() == 0 at the end);
+#   5. the idle fleet scales back down toward min_replicas.
+#
+# Standalone: exits non-zero on any failed assertion.
+# scripts/tier1.sh runs it warn-only after the suite.
+set -o pipefail
+cd "$(dirname "$0")/.."
+exec env JAX_PLATFORMS=cpu python - <<'PY'
+import json
+import tempfile
+
+from bigdl_tpu.fleet.harness import run_fleet_scenario
+
+work = tempfile.mkdtemp(prefix="controller-smoke-")
+r = run_fleet_scenario(work, load_s=2.5, spike_requests=16,
+                       wait_scale_down=True)
+
+assert r["killed_replica"] is not None, r
+assert r["killed_replica"] not in (r["replaced_with"] or []), r
+assert r["events"]["chaos_fault"] >= 1, r
+assert r["events"]["scale_up"] >= 2, \
+    f"expected replacement + spike scale-up: {json.dumps(r, default=str)}"
+assert r["dropped"] == 0 and r["ok"] + r["shed"] == r["submitted"], r
+assert r["deployed_generation"] == 2, r
+assert r["deploy_swapped"] >= 1, r
+assert r["freshness_s"] is not None and r["freshness_s"] < 60.0, r
+assert r["greedy_rows_equal"], \
+    "post-deploy greedy rows != solo oracle (weights drifted in swap)"
+assert r["admitted_outstanding"] == 0, r
+assert r["live_final"] < r["live_after_spike"], r
+
+print(f"controller_smoke: OK (kill->replace + spike->scale-up to "
+      f"{r['live_after_spike']} replicas, {r['submitted']} requests "
+      f"ok={r['ok']} shed={r['shed']} dropped=0, gen 2 hot-deployed "
+      f"across {r['deploy_swapped']} replicas freshness "
+      f"{r['freshness_s']:.2f}s, greedy rows bit-identical, idle "
+      f"scale-down to {r['live_final']}, {r['duration_s']:.1f}s)")
+PY

@@ -118,4 +118,15 @@ if bash "$(dirname "$0")/router_smoke.sh" >"$router_log" 2>&1; then
 else
   echo "router_smoke: FAILED (non-fatal ride-along; see $router_log)"
 fi
+# self-driving-fleet smoke (chaos kill -> controller replaces, spike
+# -> scale-up, new checkpoint generation -> rolling zero-drop
+# hot-deploy with bit-identical greedy rows, idle -> scale-down; no
+# operator step anywhere): warn-only ride-along; run
+# scripts/controller_smoke.sh standalone for the fatal form
+controller_log=$(mktemp /tmp/controller_smoke.XXXXXX.log)
+if bash "$(dirname "$0")/controller_smoke.sh" >"$controller_log" 2>&1; then
+  tail -n 1 "$controller_log"
+else
+  echo "controller_smoke: FAILED (non-fatal ride-along; see $controller_log)"
+fi
 exit $rc

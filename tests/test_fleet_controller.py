@@ -1,0 +1,713 @@
+"""Self-driving fleet tests (fleet/policy.py + fleet/controller.py +
+fleet/watcher.py): hysteresis/cooldown/clamp policy logic with
+injected time, dead-replica replacement and breach-driven scaling
+against a fake router, zero-drop drain-out on scale-down, doctored
+registry reads holding (never wedging) the loop, rolling hot-deploy of
+new CRC-verified checkpoint generations with torn payloads never
+deploying, the ``/statusz`` ``controller`` section, auto-resume of
+preempted training, and the closed-loop acceptance scenario: chaos
+kill under load -> replacement + scale-up -> live hot-deploy with
+greedy rows bit-identical across the swap and zero dropped admitted
+requests.
+
+The load-bearing assertions: (a) the controller acts with NO operator
+step — the fault-to-recovery path is registry poll -> policy ->
+actuation only; (b) every removal (dead, drain-out, deploy) waits for
+``admitted_outstanding() == 0``; (c) the four controller event kinds
+each have exactly ONE emission site in the tree.
+"""
+
+import ast
+import os
+import re
+import time
+
+import numpy as np
+import pytest
+
+from bigdl_tpu.fleet.controller import (FleetController,
+                                        TrainingSupervisor,
+                                        controller_statusz,
+                                        register_statusz,
+                                        unregister_statusz)
+from bigdl_tpu.fleet.policy import (Decision, Observation, PoolSpec,
+                                    ScalingPolicy)
+from bigdl_tpu.fleet.watcher import CheckpointWatcher
+from bigdl_tpu.telemetry import events
+from bigdl_tpu.utils.file import CheckpointManager
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_replica_ids():
+    # the allocator is process-global and monotonic on purpose (id
+    # reuse would pin stale registry records onto fresh replicas);
+    # tests reset it so spawned-id assertions are deterministic
+    import bigdl_tpu.fleet.controller as _ctl
+    with _ctl._id_lock:
+        _ctl._next_rid = 0
+    yield
+
+
+# ---------------------------------------------------------------------------
+# policy: hysteresis, cooldown, clamps (pure, injected time)
+# ---------------------------------------------------------------------------
+
+def _spec(**kw):
+    kw.setdefault("min_replicas", 1)
+    kw.setdefault("max_replicas", 4)
+    kw.setdefault("queue_high", 5)
+    kw.setdefault("queue_low", 1)
+    kw.setdefault("breach_consecutive", 2)
+    kw.setdefault("clear_consecutive", 3)
+    kw.setdefault("cooldown_s", 10.0)
+    return PoolSpec(**kw)
+
+
+def test_policy_breach_needs_consecutive_ticks():
+    pol = ScalingPolicy(_spec())
+    hot = Observation(live=1, desired=1, queue_depth=9)
+    calm = Observation(live=1, desired=1, queue_depth=0)
+    assert pol.decide(hot, now=0.0).action is None   # streak 1 of 2
+    # one calm tick resets the streak: a noisy snapshot never scales
+    assert pol.decide(calm, now=1.0).action is None
+    assert pol.decide(hot, now=2.0).action is None
+    d = pol.decide(hot, now=3.0)
+    assert d.action == "up" and "queue depth 9" in d.reason
+
+
+def test_policy_cooldown_holds_with_stable_key():
+    pol = ScalingPolicy(_spec())
+    hot = Observation(live=1, desired=1, queue_depth=9)
+    pol.decide(hot, now=0.0)
+    assert pol.decide(hot, now=1.0).action == "up"
+    pol.actuated(now=1.0)
+    pol.decide(hot, now=2.0)
+    held = pol.decide(hot, now=3.0)
+    assert held.action == "hold" and held.key == "cooldown"
+    assert "cooling down" in held.reason
+    assert pol.cooldown_remaining(3.0) == pytest.approx(8.0)
+    # past the cooldown the same breach goes through
+    pol.decide(hot, now=12.0)
+    assert pol.decide(hot, now=12.5).action == "up"
+
+
+def test_policy_holds_at_max_and_steady_at_min():
+    pol = ScalingPolicy(_spec(max_replicas=2))
+    hot = Observation(live=2, desired=2, queue_depth=9)
+    pol.decide(hot, now=0.0)
+    d = pol.decide(hot, now=1.0)
+    assert d.action == "hold" and d.key == "at-max"
+    assert "max_replicas=2" in d.reason
+    # idle at the floor is steady state, not a suppressed action
+    pol2 = ScalingPolicy(_spec())
+    idle = Observation(live=1, desired=1, queue_depth=0, inflight=0)
+    for t in range(5):
+        d = pol2.decide(idle, now=float(t))
+    assert d.action is None and d.reason == ""
+
+
+def test_policy_scales_down_after_clear_streak():
+    pol = ScalingPolicy(_spec(cooldown_s=0.0))
+    idle = Observation(live=3, desired=3, queue_depth=0, inflight=1)
+    assert pol.decide(idle, now=0.0).action is None
+    assert pol.decide(idle, now=1.0).action is None
+    d = pol.decide(idle, now=2.0)
+    assert d.action == "down" and "idle for 3 ticks" in d.reason
+
+
+def test_policy_ttft_and_shed_breaches():
+    pol = ScalingPolicy(_spec(ttft_high_s=0.5, breach_consecutive=1))
+    d = pol.decide(Observation(live=1, desired=1, ttft_p99_s=0.9),
+                   now=0.0)
+    assert d.action == "up" and "ttft_p99" in d.reason
+    pol2 = ScalingPolicy(_spec(breach_consecutive=1))
+    d = pol2.decide(Observation(live=1, desired=1, shed_delta=3),
+                    now=0.0)
+    assert d.action == "up" and "3 request(s) shed" in d.reason
+
+
+def test_pool_spec_validates_envelope_and_dead_band():
+    with pytest.raises(ValueError, match="min_replicas"):
+        PoolSpec(min_replicas=0)
+    with pytest.raises(ValueError, match="max_replicas"):
+        PoolSpec(min_replicas=3, max_replicas=2)
+    with pytest.raises(ValueError, match="dead band"):
+        PoolSpec(queue_high=4, queue_low=4)
+    assert _spec().clamp(99) == 4 and _spec().clamp(0) == 1
+
+
+# ---------------------------------------------------------------------------
+# event vocabulary: pinned, and one emission site per kind
+# ---------------------------------------------------------------------------
+
+def test_controller_kinds_in_pinned_vocabulary():
+    for kind in ("scale_up", "scale_down", "hot_deploy",
+                 "controller_hold"):
+        assert kind in events.EVENT_KINDS
+
+
+def _record_event_literals():
+    """Every ``record_event("<literal>", ...)`` call site in the
+    shipped tree, kind -> [file, ...]."""
+    sites = {}
+    for root, _dirs, files in os.walk(os.path.join(REPO, "bigdl_tpu")):
+        for name in files:
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(root, name)
+            with open(path, encoding="utf-8") as f:
+                src = f.read()
+            if "record_event" not in src:
+                continue
+            for node in ast.walk(ast.parse(src)):
+                if isinstance(node, ast.Call) \
+                        and getattr(node.func, "attr",
+                                    getattr(node.func, "id", None)) \
+                        == "record_event" \
+                        and node.args \
+                        and isinstance(node.args[0], ast.Constant) \
+                        and isinstance(node.args[0].value, str):
+                    sites.setdefault(node.args[0].value, []).append(
+                        os.path.relpath(path, REPO))
+    return sites
+
+
+def test_every_shipped_call_site_uses_vocabulary_kind():
+    sites = _record_event_literals()
+    unknown = {k: v for k, v in sites.items()
+               if k not in events.EVENT_KINDS}
+    assert not unknown, f"record_event kinds outside EVENT_KINDS: " \
+                        f"{unknown}"
+
+
+def test_controller_kinds_have_exactly_one_emission_site():
+    sites = _record_event_literals()
+    for kind in ("scale_up", "scale_down", "hot_deploy",
+                 "controller_hold"):
+        assert len(sites.get(kind, [])) == 1, \
+            f"{kind} must have exactly one emission site, " \
+            f"got {sites.get(kind)}"
+
+
+# ---------------------------------------------------------------------------
+# controller against a fake router (deterministic reconcile_once)
+# ---------------------------------------------------------------------------
+
+class FakeReplica:
+    def __init__(self, rid, model="default", outstanding=0):
+        self.id = rid
+        self.model = model
+        self.outstanding = outstanding
+
+    def admitted_outstanding(self):
+        return self.outstanding
+
+
+class FakeRouter:
+    """Registry + membership + actuation surface the controller
+    drives, with scriptable records (``recs``) and stats."""
+
+    def __init__(self, replicas=(), records=None, stats=None):
+        self.replicas = {r.id: r for r in replicas}
+        self.recs = dict(records or {})
+        self.stats_d = dict(stats or {})
+        self.added, self.drained, self.removed = [], [], []
+        self.registry = self
+        self.poll_error = None
+
+    # registry half
+    def poll(self):
+        if self.poll_error is not None:
+            raise self.poll_error
+        return dict(self.recs)
+
+    def records(self):                        # Router.records() shape
+        return dict(self.recs)
+
+    # router half
+    def stats(self):
+        return dict(self.stats_d)
+
+    def replica_ids(self):
+        return sorted(self.replicas)
+
+    def replica(self, rid):
+        return self.replicas.get(int(rid))
+
+    def add_replica(self, replica):
+        self.replicas[replica.id] = replica
+        self.added.append(replica.id)
+
+    def drain(self, rid):
+        self.drained.append(rid)
+
+    def remove_replica(self, rid, drain=True, timeout=None):
+        self.replicas.pop(rid, None)
+        self.removed.append(rid)
+
+    def set_slo_class(self, model, slo):
+        self.stats_d.setdefault("slo_classes", {})[model] = slo
+
+    def set_admission_budget(self, model, budget):
+        self.stats_d.setdefault("budgets", {})[model] = budget
+
+
+def _healthy(rid, model="default", **kw):
+    rec = {"id": rid, "healthy": True, "reason": None,
+           "draining": False, "model": model, "queue_depth": 0,
+           "ttft_p99_s": 0.0}
+    rec.update(kw)
+    return rec
+
+
+def _mk_controller(router, **spec_kw):
+    spec_kw.setdefault("cooldown_s", 0.0)
+    spec_kw.setdefault("max_replicas", 4)
+    factory_calls = []
+
+    def factory(rid, model, ckpt):
+        factory_calls.append((rid, model, ckpt))
+        return FakeReplica(rid, model)
+
+    ctl = FleetController(router, factory,
+                          pools=[PoolSpec(**spec_kw)],
+                          interval_s=0.01)
+    ctl._factory_calls = factory_calls
+    return ctl
+
+
+def test_controller_replaces_dead_after_streak():
+    # the victim carries admitted work so its removal must wait for
+    # the drain, not ride along with the replacement tick
+    router = FakeRouter([FakeReplica(0), FakeReplica(1, outstanding=3)],
+                        records={0: _healthy(0), 1: _healthy(1)})
+    ctl = _mk_controller(router, dead_after_polls=2)
+    ctl.reconcile_once()                     # desired pins to 2
+    router.recs[1] = _healthy(1, healthy=False, reason="stale")
+    st = ctl.reconcile_once()                # streak 1: no action yet
+    assert router.added == [] and st["pools"]["default"]["live"] == 2
+    before = len(events.recent_events(500))
+    st = ctl.reconcile_once()                # streak 2: dead -> replace
+    pool = st["pools"]["default"]
+    assert router.added == [2]
+    assert 1 in pool["dying"]
+    assert ctl._factory_calls[-1] == (2, "default", None)
+    new = [e for e in events.recent_events(500)[before:]
+           if e["kind"] == "scale_up"]
+    assert len(new) == 1 and "dead" in new[0]["reason"]
+    # the dead replica leaves only once admitted work drains to zero
+    ctl.reconcile_once()
+    assert 1 not in router.removed
+    router.replicas[1].outstanding = 0
+    ctl.reconcile_once()
+    assert 1 in router.removed
+
+
+def test_controller_scales_up_on_queue_breach():
+    router = FakeRouter([FakeReplica(0)], records={0: _healthy(0)})
+    ctl = _mk_controller(router, queue_high=5, breach_consecutive=2)
+    ctl.reconcile_once()
+    router.recs[0] = _healthy(0, queue_depth=9)
+    ctl.reconcile_once()                     # streak 1
+    assert router.added == []
+    st = ctl.reconcile_once()                # streak 2 -> up + spawn
+    pool = st["pools"]["default"]
+    assert pool["desired"] == 2 and router.added == [1]
+    assert "queue depth" in pool["last_decision"]["reason"]
+
+
+def test_controller_scale_down_drains_zero_drop():
+    router = FakeRouter(
+        [FakeReplica(0, outstanding=2), FakeReplica(1, outstanding=5)],
+        records={0: _healthy(0), 1: _healthy(1)})
+    ctl = _mk_controller(router, clear_consecutive=2)
+    ctl.reconcile_once()                     # desired 2
+    st = ctl.reconcile_once()                # idle streak 2 -> down
+    pool = st["pools"]["default"]
+    # victim = least admitted work (0), drained but NOT removed while
+    # its admitted requests are still in flight
+    assert pool["desired"] == 1 and router.drained == [0]
+    assert 0 in pool["draining_out"] and router.removed == []
+    router.replicas[0].outstanding = 0
+    st = ctl.reconcile_once()
+    assert router.removed == [0]
+    assert st["pools"]["default"]["draining_out"] == []
+    kinds = [e["kind"] for e in events.recent_events(100)]
+    assert "scale_down" in kinds
+
+
+def test_controller_never_scales_below_min():
+    router = FakeRouter([FakeReplica(0)], records={0: _healthy(0)})
+    ctl = _mk_controller(router, clear_consecutive=1)
+    for _ in range(5):
+        st = ctl.reconcile_once()
+    assert st["pools"]["default"]["desired"] == 1
+    assert router.drained == [] and router.removed == []
+
+
+def test_controller_unreadable_registry_holds_without_wedging():
+    router = FakeRouter([FakeReplica(0)], records={0: _healthy(0)})
+    ctl = _mk_controller(router, dead_after_polls=2)
+    ctl.reconcile_once()
+    router.poll_error = OSError("doctored snapshot dir")
+    for _ in range(5):                       # no spawn/kill storm
+        st = ctl.reconcile_once()
+    assert st["pools"]["default"]["error"] \
+        == "registry unreadable; holding"
+    assert router.added == [] and router.removed == []
+    router.poll_error = None                 # and the loop recovers
+    st = ctl.reconcile_once()
+    assert st["pools"]["default"]["live"] == 1
+    assert "error" not in st["pools"]["default"]
+
+
+def test_controller_corrupt_snapshot_reads_unhealthy_then_replaces():
+    # the registry's corrupt-record shape: no model key, healthy False
+    router = FakeRouter(
+        [FakeReplica(0), FakeReplica(1)],
+        records={0: _healthy(0),
+                 1: {"id": 1, "healthy": False, "reason": "corrupt",
+                     "draining": False, "age_s": None}})
+    ctl = _mk_controller(router, dead_after_polls=2)
+    ctl.reconcile_once()
+    ctl.reconcile_once()
+    assert router.added == [2]               # replaced, not wedged
+
+
+def test_controller_hold_event_latched_per_episode():
+    router = FakeRouter([FakeReplica(0)], records={0: _healthy(0)})
+    ctl = _mk_controller(router, queue_high=5, breach_consecutive=1,
+                         cooldown_s=60.0, max_replicas=4)
+    ctl.reconcile_once()
+    router.recs[0] = _healthy(0, queue_depth=9)
+    ctl.reconcile_once()                     # up (no cooldown yet? no:
+    # cooldown_s=60 but _last_action_at None -> acts, then stamps)
+    before = len([e for e in events.recent_events(500)
+                  if e["kind"] == "controller_hold"])
+    for _ in range(6):                       # all suppressed by cooldown
+        st = ctl.reconcile_once()
+    after = [e for e in events.recent_events(500)
+             if e["kind"] == "controller_hold"]
+    assert len(after) - before == 1          # one event per episode
+    pool = st["pools"]["default"]
+    assert pool["cooldown_remaining_s"] > 0
+    assert pool["last_decision"]["action"] == "hold"
+    assert "cooling down" in pool["last_decision"]["reason"]
+
+
+def test_controller_rejects_duplicate_pools():
+    router = FakeRouter()
+    with pytest.raises(ValueError, match="duplicate"):
+        FleetController(router, lambda *a: None,
+                        pools=[PoolSpec(model="m"), PoolSpec(model="m")])
+
+
+def test_controller_multi_pool_scales_independently():
+    router = FakeRouter(
+        [FakeReplica(0, model="a"), FakeReplica(1, model="b")],
+        records={0: _healthy(0, model="a", queue_depth=9),
+                 1: _healthy(1, model="b")})
+    calls = []
+
+    def factory(rid, model, ckpt):
+        calls.append((rid, model))
+        return FakeReplica(rid, model)
+
+    ctl = FleetController(
+        router, factory,
+        pools=[PoolSpec(model="a", queue_high=5, breach_consecutive=1,
+                        cooldown_s=60.0),
+               PoolSpec(model="b", queue_high=5, cooldown_s=60.0)])
+    st = ctl.reconcile_once()                # a: breach streak 1 -> up
+    st = ctl.reconcile_once()                # a: cooling down -> hold
+    assert calls == [(2, "a")]
+    assert st["pools"]["a"]["desired"] == 2
+    assert st["pools"]["b"]["desired"] == 1
+
+
+def test_controller_start_pushes_slo_class_and_budget():
+    router = FakeRouter([FakeReplica(0)], records={0: _healthy(0)})
+    ctl = FleetController(
+        router, lambda rid, m, c: FakeReplica(rid, m),
+        pools=[PoolSpec(model="default", slo_ttft_p99_s=0.75,
+                        admission_budget=16)], interval_s=0.01)
+    ctl.start()
+    try:
+        assert router.stats_d["slo_classes"]["default"] == 0.75
+        assert router.stats_d["budgets"]["default"] == 16
+        deadline = time.perf_counter() + 10.0
+        while not ctl.status().get("pools"):
+            assert time.perf_counter() < deadline
+            time.sleep(0.01)
+        assert ctl.status()["running"]
+    finally:
+        ctl.stop()
+    assert not ctl._thread.is_alive()
+
+
+# ---------------------------------------------------------------------------
+# checkpoint watcher: baseline, deploy, torn generations
+# ---------------------------------------------------------------------------
+
+class FakeDeployRouter(FakeRouter):
+    def __init__(self, replicas=(), records=None):
+        super().__init__(replicas, records)
+        self.deploys = []
+
+    def deploy(self, new_replica, replaces, timeout=None):
+        assert self.replicas[replaces].admitted_outstanding() == 0
+        self.replicas.pop(replaces)
+        self.replicas[new_replica.id] = new_replica
+        self.deploys.append((replaces, new_replica.id))
+        return {"added": new_replica.id, "replaced": replaces,
+                "outstanding_at_removal": 0}
+
+
+def _commit(tmp_path, gen):
+    return CheckpointManager(str(tmp_path)).save(
+        {"params": {"w": np.arange(4.0) + gen}}, [],
+        {"epoch": 0, "neval": gen}, generation=gen)
+
+
+def _mk_watcher(tmp_path, router, **kw):
+    built = []
+
+    def factory(rid, model, ckpt):
+        assert ckpt is not None    # deploys always pin the payload
+        built.append((rid, ckpt))
+        return FakeReplica(rid, model)
+
+    w = CheckpointWatcher(CheckpointManager(str(tmp_path)), router,
+                          factory, **kw)
+    w._built = built
+    return w
+
+
+def test_watcher_baselines_existing_generation(tmp_path):
+    _commit(tmp_path, 1)
+    router = FakeDeployRouter([FakeReplica(0)],
+                              records={0: _healthy(0)})
+    w = _mk_watcher(tmp_path, router)
+    assert w.check_once() is None            # baseline, no deploy
+    assert w.status()["deployed_generation"] == 1
+    assert router.deploys == []
+    p2 = _commit(tmp_path, 2)
+    report = w.check_once()
+    assert report["generation"] == 2
+    assert router.deploys == [(0, w._built[0][0])]
+    assert w._built[0][1] == p2
+    assert report["freshness_s"] is not None \
+        and report["freshness_s"] >= 0.0
+    assert w.check_once() is None            # idempotent per generation
+
+
+def test_watcher_deploy_existing_rolls_out_first_generation(tmp_path):
+    _commit(tmp_path, 1)
+    router = FakeDeployRouter([FakeReplica(0)],
+                              records={0: _healthy(0)})
+    w = _mk_watcher(tmp_path, router, deploy_existing=True)
+    assert w.check_once()["generation"] == 1
+    assert len(router.deploys) == 1
+
+
+def test_watcher_torn_generation_never_deploys(tmp_path):
+    _commit(tmp_path, 1)
+    router = FakeDeployRouter([FakeReplica(0)],
+                              records={0: _healthy(0)})
+    w = _mk_watcher(tmp_path, router)
+    w.check_once()
+    p2 = _commit(tmp_path, 2)
+    with open(p2, "r+b") as f:               # torn payload, manifest
+        f.truncate(16)                       # intact: CRC must fail
+    assert w.check_once() is None
+    assert router.deploys == []
+    p3 = _commit(tmp_path, 3)                # next good gen deploys
+    report = w.check_once()
+    assert report["generation"] == 3 and w._built[-1][1] == p3
+
+
+def test_watcher_skips_unhealthy_and_foreign_models(tmp_path):
+    _commit(tmp_path, 1)
+    router = FakeDeployRouter(
+        [FakeReplica(0), FakeReplica(1), FakeReplica(2, model="other")],
+        records={0: _healthy(0),
+                 1: _healthy(1, healthy=False, reason="stale"),
+                 2: _healthy(2, model="other")})
+    w = _mk_watcher(tmp_path, router)
+    w.check_once()
+    _commit(tmp_path, 2)
+    report = w.check_once()
+    assert [old for old, _new in router.deploys] == [0]
+    assert report["swapped"][0][0] == 0
+    assert 1 in router.replicas and 2 in router.replicas
+
+
+def test_watcher_empty_directory_is_quiet(tmp_path):
+    router = FakeDeployRouter()
+    w = _mk_watcher(tmp_path, router)
+    assert w.check_once() is None and router.deploys == []
+
+
+# ---------------------------------------------------------------------------
+# /statusz controller section
+# ---------------------------------------------------------------------------
+
+def test_statusz_registry_merges_and_survives_broken_provider():
+    assert controller_statusz() is None
+    register_statusz("good", lambda: {"x": 1})
+    register_statusz("broken", lambda: 1 / 0)
+    try:
+        out = controller_statusz()
+        assert out["good"] == {"x": 1}
+        assert "ZeroDivisionError" in out["broken"]["error"]
+    finally:
+        unregister_statusz("good")
+        unregister_statusz("broken")
+    assert controller_statusz() is None
+
+
+def test_serve_statusz_gains_controller_section():
+    from bigdl_tpu.examples.serve import make_server
+    import json
+    import urllib.request
+    import threading
+    server = make_server(object(), "127.0.0.1", 0)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    register_statusz("fleet", lambda: {"pools": {"default": {}}})
+    try:
+        url = f"http://127.0.0.1:{server.server_port}/statusz"
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            page = json.loads(resp.read())
+        assert "pools" in page["controller"]["fleet"]
+    finally:
+        unregister_statusz("fleet")
+        server.shutdown()
+        server.server_close()
+        t.join(5.0)
+
+
+# ---------------------------------------------------------------------------
+# training supervisor: auto-resume past preemption
+# ---------------------------------------------------------------------------
+
+class FakeOptimizer:
+    def __init__(self, ckpt_dir, preempt_times=1):
+        self.checkpoint_path = ckpt_dir
+        self.preempt_times = preempt_times
+        self.calls = 0
+        self.preempted = False
+        self.resumed_from = []
+
+    def optimize(self):
+        self.calls += 1
+        self.preempted = self.calls <= self.preempt_times
+        return "trained-model"
+
+    def resume(self, path):
+        self.resumed_from.append(path)
+
+
+def test_supervisor_resumes_preempted_run_from_latest_good(tmp_path):
+    good = _commit(tmp_path, 7)
+    opt = FakeOptimizer(str(tmp_path), preempt_times=2)
+    sup = TrainingSupervisor(opt)
+    assert sup.run() == "trained-model"
+    assert opt.calls == 3
+    assert opt.resumed_from == [good, good]
+    assert sup.resumes == 2 and sup.last_resume_from == good
+    st = sup.statusz()
+    assert st["resumes"] == 2 and not st["preempted"]
+    assert controller_statusz() is None      # unregistered on exit
+
+
+def test_supervisor_requires_checkpoint_dir_and_committed_gen(tmp_path):
+    class NoCkpt:
+        checkpoint_path = None
+    with pytest.raises(ValueError, match="checkpoint directory"):
+        TrainingSupervisor(NoCkpt())
+    opt = FakeOptimizer(str(tmp_path), preempt_times=1)
+    with pytest.raises(RuntimeError, match="before any checkpoint"):
+        TrainingSupervisor(opt).run()
+
+
+def test_supervisor_gives_up_past_max_resumes(tmp_path):
+    _commit(tmp_path, 1)
+    opt = FakeOptimizer(str(tmp_path), preempt_times=99)
+    with pytest.raises(RuntimeError, match="max_resumes"):
+        TrainingSupervisor(opt, max_resumes=2).run()
+
+
+# ---------------------------------------------------------------------------
+# telemetry families
+# ---------------------------------------------------------------------------
+
+def test_fleet_families_recorded_when_enabled():
+    from bigdl_tpu import telemetry
+    telemetry.enable()
+    telemetry.reset()
+    try:
+        router = FakeRouter([FakeReplica(0)],
+                            records={0: _healthy(0, queue_depth=9)})
+        ctl = _mk_controller(router, queue_high=5,
+                             breach_consecutive=1)
+        ctl.reconcile_once()
+        ctl.reconcile_once()
+        text = telemetry.prometheus_text()
+        assert 'fleet_replicas_desired{model="default"}' in text
+        assert 'fleet_replicas_live{model="default"}' in text
+        assert 'fleet_scale_events_total{direction="up"}' in text
+    finally:
+        telemetry.reset()
+        telemetry.disable()
+
+
+# ---------------------------------------------------------------------------
+# the closed loop, end to end (fast) and under soak (slow)
+# ---------------------------------------------------------------------------
+
+def test_closed_loop_kill_replace_deploy_bit_identical(tmp_path):
+    """The acceptance e2e at test budget: chaos kill under load ->
+    controller replaces with no operator step; a new checkpoint
+    generation rolling-hot-deploys through drain/deploy; greedy rows
+    after the swap are bit-identical to solo generate(); nothing
+    admitted is dropped."""
+    from bigdl_tpu.fleet.harness import run_fleet_scenario
+    report = run_fleet_scenario(str(tmp_path), load_s=1.2,
+                                spike_requests=12,
+                                wait_scale_down=False)
+    assert report["killed_replica"] == 0
+    assert 0 not in report["replaced_with"]
+    assert report["dropped"] == 0
+    assert report["ok"] + report["shed"] == report["submitted"]
+    assert report["deployed_generation"] == 2
+    assert report["freshness_s"] is not None \
+        and report["freshness_s"] < 60.0
+    assert report["greedy_rows_equal"]
+    assert report["admitted_outstanding"] == 0
+    assert report["events"]["scale_up"] >= 1
+    assert report["events"]["hot_deploy"] == 1
+    assert report["events"]["chaos_fault"] >= 1
+
+
+@pytest.mark.slow
+def test_soak_closed_loop_scales_and_recovers(tmp_path):
+    """The chaos-driven closure soak: sustained load + kill + spike ->
+    replacement AND breach-driven scale-up, live deploy mid-fleet,
+    idle scale-down back toward the floor, zero drops throughout."""
+    from bigdl_tpu.fleet.harness import run_fleet_scenario
+    report = run_fleet_scenario(str(tmp_path), load_s=5.0,
+                                spike_requests=24,
+                                wait_scale_down=True)
+    assert report["dropped"] == 0
+    assert report["ok"] > 0
+    assert report["live_after_spike"] >= 2   # spike grew the pool
+    assert report["live_final"] < report["live_after_spike"]
+    assert report["events"]["scale_up"] >= 2  # replacement + growth
+    assert report["events"]["scale_down"] >= 1
+    assert report["greedy_rows_equal"]
+    assert report["admitted_outstanding"] == 0
+    pools = report["controller_status"]["pools"]
+    assert pools["default"]["dying"] == []
