@@ -376,6 +376,7 @@ class FleetController:
                 "queue_depth": obs.queue_depth,
                 "shed_delta": obs.shed_delta,
                 "inflight": obs.inflight,
+                "breakers_open": obs.breakers_open,
             },
         }
 
@@ -400,9 +401,11 @@ class FleetController:
         pool.last_shed = shed_now
         inflight = int(
             (stats.get("model_inflight") or {}).get(model, 0) or 0)
+        breakers_open = int(stats.get("breakers_open", 0) or 0)
         return Observation(live=len(live), desired=pool.desired,
                            ttft_p99_s=ttft, queue_depth=queue,
-                           shed_delta=shed_delta, inflight=inflight)
+                           shed_delta=shed_delta, inflight=inflight,
+                           breakers_open=breakers_open)
 
     # ---- actuation -------------------------------------------------------
 

@@ -90,17 +90,18 @@ class Observation:
     signals the policy decides on."""
 
     __slots__ = ("live", "desired", "ttft_p99_s", "queue_depth",
-                 "shed_delta", "inflight")
+                 "shed_delta", "inflight", "breakers_open")
 
     def __init__(self, live: int, desired: int, ttft_p99_s: float = 0.0,
                  queue_depth: int = 0, shed_delta: int = 0,
-                 inflight: int = 0):
+                 inflight: int = 0, breakers_open: int = 0):
         self.live = int(live)
         self.desired = int(desired)
         self.ttft_p99_s = float(ttft_p99_s)
         self.queue_depth = int(queue_depth)
         self.shed_delta = int(shed_delta)
         self.inflight = int(inflight)
+        self.breakers_open = int(breakers_open)
 
 
 class Decision:
@@ -156,8 +157,12 @@ class ScalingPolicy:
 
     def _idle(self, obs: Observation) -> bool:
         s = self.spec
+        # an open breaker means part of the nominal capacity is
+        # untrusted: never call that pool idle (a scale-down would
+        # compound the degradation the breaker is riding out)
         return (obs.queue_depth <= s.queue_low
                 and obs.shed_delta == 0
+                and obs.breakers_open == 0
                 and obs.inflight < max(obs.live, 1)
                 and (s.ttft_high_s is None
                      or obs.ttft_p99_s <= s.ttft_high_s))

@@ -18,6 +18,11 @@ from bigdl_tpu.serving.generation import (     # noqa: F401
 )
 from bigdl_tpu.serving.metrics import MetricsRegistry      # noqa: F401
 from bigdl_tpu.serving.prefix_cache import PrefixKVCache   # noqa: F401
+from bigdl_tpu.serving.reliability import (    # noqa: F401
+    CircuitBreaker, Deadline, DeadlineExceededError, HedgePolicy,
+    ReliabilityPolicy, ReplicaDeadError, ReplicaTransportError,
+    RequestCancelledError, RetryPolicy,
+)
 from bigdl_tpu.serving.replica import (        # noqa: F401
     DisaggregatedEngine, Replica, ReplicaRegistry,
 )
@@ -37,6 +42,10 @@ __all__ = [
     "DisaggregatedEngine", "NoReplicaAvailableError",
     "BoundedRequestQueue", "Request",
     "QueueFullError", "RequestSheddedError", "ServerClosedError",
+    "Deadline", "DeadlineExceededError", "RequestCancelledError",
+    "ReplicaTransportError", "ReplicaDeadError",
+    "RetryPolicy", "HedgePolicy", "CircuitBreaker",
+    "ReliabilityPolicy",
     "bucket_sizes", "pick_bucket", "stack_requests", "split_outputs",
     "install_shutdown_signals",
 ]

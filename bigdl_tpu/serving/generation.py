@@ -87,6 +87,7 @@ import threading
 import time
 from collections import deque
 from concurrent.futures import Future
+from concurrent.futures import TimeoutError as FuturesTimeout
 from typing import Callable, Deque, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -97,6 +98,9 @@ from bigdl_tpu.serving.admission import (
 )
 from bigdl_tpu.serving.batching import bucket_sizes, pick_bucket
 from bigdl_tpu.serving.prefix_cache import PrefixChunk, PrefixKVCache
+from bigdl_tpu.serving.reliability import (
+    Deadline, ReplicaDeadError, RequestCancelledError,
+)
 from bigdl_tpu.telemetry import tracing
 
 __all__ = ["GenerationRequest", "SlotPool", "GenerationScheduler",
@@ -113,14 +117,16 @@ class GenerationRequest:
     block/reject/shed_oldest — apply to generation unchanged."""
 
     __slots__ = ("prompt", "max_new_tokens", "eos_id", "on_token",
-                 "future", "t_enqueue")
+                 "future", "t_enqueue", "deadline")
 
     def __init__(self, prompt, max_new_tokens: int, eos_id=None,
-                 on_token: Optional[Callable[[int], None]] = None):
+                 on_token: Optional[Callable[[int], None]] = None,
+                 deadline: Optional[Deadline] = None):
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         self.max_new_tokens = int(max_new_tokens)
         self.eos_id = eos_id
         self.on_token = on_token
+        self.deadline = deadline
         self.future: "Future" = Future()
         self.t_enqueue = time.perf_counter()
 
@@ -713,6 +719,11 @@ class GenerationScheduler:
         self._prefix_copies = 0
         self._shed = 0
         self._shutdown = False
+        # reliability plane: caller-side cancels land here (lock-
+        # guarded; the engine sweep consumes them), a hard kill() lands
+        # in _die_exc (the loop checks it every iteration)
+        self._cancel_requests: set = set()
+        self._die_exc: Optional[Exception] = None
         # tokens/s gauge window (scheduler-thread-only state)
         self._tps_tokens = 0
         self._tps_t0 = time.perf_counter()
@@ -753,18 +764,42 @@ class GenerationScheduler:
                     "generation scheduler did not drain within %ss",
                     timeout)
 
+    def kill(self, exc: Optional[Exception] = None) -> None:
+        """Hard death (the chaos ``kill_replica_mode=hard`` fault):
+        unlike :meth:`shutdown`, nothing drains — every queued request
+        fails with ServerClosedError, every SLOT-RESIDENT request
+        (mid-prefill or mid-decode) fails with ``exc`` (default
+        :class:`ReplicaDeadError`), and the engine thread exits.  The
+        router's failover path depends on exactly this shape: the
+        inner future of an interrupted stream fails typed, carrying
+        whatever tokens ``on_token`` already delivered."""
+        if exc is None:
+            exc = ReplicaDeadError("replica killed hard mid-flight")
+        with self._lock:
+            self._shutdown = True
+            self._die_exc = exc
+        # wakes a _run loop blocked in _queue.get(); queued requests
+        # fail ServerClosedError (they never reached a slot, so a
+        # plain re-submit elsewhere is safe)
+        self._queue.close(discard=True)
+
     # -- submission ---------------------------------------------------------
 
     def submit_async(self, prompt, max_new_tokens: int, eos_id=None,
                      on_token: Optional[Callable[[int], None]] = None,
-                     timeout: Optional[float] = None) -> Future:
+                     timeout: Optional[float] = None,
+                     deadline: Optional[Deadline] = None) -> Future:
         """Admit one prompt (1-D int tokens) and return a Future of the
         full ``[Tp + max_new_tokens]`` row — bit-identical to
         ``model.generate(prompt[None], max_new_tokens, eos_id)[0]``.
         ``on_token`` (optional) streams each emitted token from the
-        scheduler thread the iteration it is decoded."""
+        scheduler thread the iteration it is decoded.  ``deadline``
+        (optional) rides the request through admit and decode: once
+        expired, the engine fails the future with the typed
+        :class:`DeadlineExceededError` and frees the slot instead of
+        decoding an answer nobody is waiting for."""
         req = GenerationRequest(prompt, max_new_tokens, eos_id=eos_id,
-                                on_token=on_token)
+                                on_token=on_token, deadline=deadline)
         err = self._validate(req)
         if err is not None:
             raise err
@@ -802,7 +837,29 @@ class GenerationScheduler:
                                 timeout=timeout)
         remaining = (None if deadline is None
                      else max(deadline - time.perf_counter(), 0.0))
-        return fut.result(remaining)
+        try:
+            return fut.result(remaining)
+        except FuturesTimeout:
+            # the caller is walking away: without this cancel the
+            # abandoned request stays slot-resident and decodes to
+            # completion — a slot leak under client-side timeouts
+            self.cancel(fut)
+            raise
+
+    def cancel(self, fut: Future) -> bool:
+        """Best-effort cancel of a submitted request.  Still queued →
+        plain ``Future.cancel`` (``_admit``'s RUNNING gate drops it
+        without consuming a slot).  Slot-resident → the engine sweep
+        frees the slot within one loop iteration and fails the future
+        with :class:`RequestCancelledError`.  Returns False only for a
+        future that already completed."""
+        if fut.cancel():
+            return True
+        if fut.done():
+            return False
+        with self._lock:
+            self._cancel_requests.add(fut)
+        return True
 
     def _validate(self, req: GenerationRequest) -> Optional[Exception]:
         tp = len(req.prompt)
@@ -881,12 +938,22 @@ class GenerationScheduler:
     def _run(self) -> None:
         pool = self.pool
         while True:
+            with self._lock:
+                exc = self._die_exc
+            if exc is not None:
+                self._fail_in_flight(exc)
+                return              # hard-killed: nothing drains
+            self._sweep_reliability()
             occupied = sum(1 for st in self._slot_state if st is not None)
             arrivals: List[GenerationRequest] = []
             if occupied == 0 and self._pending is None \
                     and not self._prefill_work:
                 first = self._queue.get(timeout=None)
                 if first is None:
+                    with self._lock:
+                        exc = self._die_exc
+                    if exc is not None:
+                        self._fail_in_flight(exc)
                     return          # closed + drained, nothing in flight
                 arrivals.append(first)
             free = pool.slots - occupied - len(arrivals)
@@ -944,6 +1011,65 @@ class GenerationScheduler:
             self._slot_state[slot] = None
             self.pool.release(slot)
 
+    # -- reliability sweep (engine thread) ----------------------------------
+
+    def _sweep_reliability(self) -> None:
+        """Free slots whose occupant was cancelled by the caller or ran
+        out of deadline budget.  Runs at the top of every engine
+        iteration, so an abandoned request costs at most one more
+        decode step before its slot is reusable.  ``pool.release`` is a
+        plain mirror write (safe in any phase), the credit-epoch masks
+        already discard a late in-flight emit for a re-seeded slot, and
+        the claim release wakes any dedup followers parked on us."""
+        cancels = None
+        with self._lock:
+            if self._cancel_requests:
+                cancels = self._cancel_requests
+                self._cancel_requests = set()
+        now = time.perf_counter()
+        for slot in range(self.pool.slots):
+            st = self._slot_state[slot]
+            if st is None:
+                continue
+            exc: Optional[Exception] = None
+            if cancels and st.req.future in cancels:
+                exc = RequestCancelledError(
+                    "caller abandoned the request (client-side "
+                    "timeout or explicit cancel)")
+            elif st.req.deadline is not None \
+                    and st.req.deadline.expired(now):
+                stage = "decode" if st.phase == "decode" else "prefill"
+                exc = st.req.deadline.error(stage, now)
+            if exc is None:
+                continue
+            self._purge_prefill_work(st)
+            self._release_claims(st)
+            if not st.req.future.done():
+                st.req.future.set_exception(exc)
+            self._slot_state[slot] = None
+            self.pool.release(slot)
+
+    def _purge_prefill_work(self, st: "_ActiveSlot") -> None:
+        """Drop every pending prefill work item that references ``st``
+        (its chunk entry, its seat in a legacy bucket batch, its
+        follower parking) so an evicted request cannot be prefilled
+        into a slot that no longer belongs to it."""
+        if st in self._follow_work:
+            self._follow_work.remove(st)
+        if not self._prefill_work:
+            return
+        kept: Deque[Tuple] = deque()
+        for item in self._prefill_work:
+            if item[0] == "chunk" and item[1] is st:
+                continue
+            if item[0] == "legacy":
+                sts = [s for s in item[2] if s is not st]
+                if not sts:
+                    continue
+                item = ("legacy", item[1], sts)
+            kept.append(item)
+        self._prefill_work = kept
+
     # -- admit + prefill ----------------------------------------------------
 
     def _admit(self, arrivals: List[GenerationRequest]) -> None:
@@ -951,6 +1077,11 @@ class GenerationScheduler:
         ready: List[GenerationRequest] = []
         for req in arrivals:
             err = self._validate(req)   # re-check: queue bypass callers
+            if err is None and req.deadline is not None \
+                    and req.deadline.expired():
+                # budget burned in the queue: typed rejection before a
+                # slot (and a prefill) is spent on it
+                err = req.deadline.error("queue")
             if err is not None:
                 if req.future.set_running_or_notify_cancel():
                     req.future.set_exception(err)

@@ -1,0 +1,474 @@
+"""Request reliability: deadlines, circuit breakers, retry/hedge policy.
+
+PR 16 made the FLEET self-healing (a dead replica is replaced), but an
+individual request still rode one replica's future to the end: a
+replica that died, stalled, or flaked mid-request simply lost it.  The
+reference handles failure as a normal case at the TASK level (Spark
+task retry, docs/docs/whitepaper.md); this module gives the serving
+fabric the same property at the REQUEST level.  It is the pure-policy
+half — small state machines against injected time, no threads, no IO —
+and :mod:`bigdl_tpu.serving.router` is the actuation half that wires
+them into dispatch:
+
+* :class:`Deadline` — a per-request end-to-end budget minted at
+  admission and threaded through queue wait → prefill → decode.  A
+  request that can no longer meet its SLO class is rejected with the
+  typed :class:`DeadlineExceededError` (stage-stamped, counted in
+  ``request_deadline_exceeded_total{stage}``) instead of burning
+  slot-iterations on an answer nobody is waiting for.
+* :class:`CircuitBreaker` — per-replica closed/open/half-open state
+  driven by consecutive submit failures AND snapshot staleness.  The
+  router stops routing to a sick replica *before* the fleet
+  controller's ``dead_after_polls`` window expires (submit failures
+  surface in milliseconds; the registry needs whole poll intervals),
+  and half-open probe requests re-admit it once it recovers.  Every
+  transition lands in the flight recorder (``breaker_transition``) and
+  ``router_breaker_transitions_total{to}``.
+* :class:`RetryPolicy` — bounded retries with exponential backoff and
+  jitter, the PR-2 ``set_failure_retry`` shape (``times`` /
+  ``interval_s`` / ``backoff_s`` / ``backoff_cap_s`` / ``jitter``)
+  applied to dispatch: an idempotent (greedy, non-streaming) request
+  that fails replica-side is re-dispatched on a DIFFERENT replica.
+* :class:`HedgePolicy` — tail-latency hedging: after a p99-derived
+  delay an unfinished idempotent request is dispatched to a second
+  replica, first completion wins, the loser is cancelled.  The
+  single-flight prefix-cache dedup (``prefix_cache.py``) makes the
+  duplicate prefill cheap when the twins share a cache.
+
+See docs/serving.md "Request reliability" for the state machine, the
+idempotency rules, and the deadline-budget table.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from bigdl_tpu import telemetry
+from bigdl_tpu.telemetry import events as _events
+
+__all__ = [
+    "Deadline", "DeadlineExceededError", "RequestCancelledError",
+    "ReplicaTransportError", "ReplicaDeadError",
+    "RetryPolicy", "HedgePolicy", "CircuitBreaker",
+    "ReliabilityPolicy", "deadline_error",
+]
+
+
+# ---------------------------------------------------------------------------
+# typed errors
+# ---------------------------------------------------------------------------
+
+class DeadlineExceededError(RuntimeError):
+    """The request's end-to-end budget ran out at ``stage`` (one of
+    ``queue`` / ``prefill`` / ``decode``) — a typed rejection, so the
+    caller can tell "the system said no in time" from "the system
+    failed"."""
+
+    def __init__(self, msg: str, stage: str = "queue"):
+        super().__init__(msg)
+        self.stage = stage
+
+
+class RequestCancelledError(RuntimeError):
+    """The caller abandoned the request (client-side timeout or an
+    explicit cancel) and the engine freed its slot mid-flight."""
+
+
+class ReplicaTransportError(RuntimeError):
+    """Submitting to a replica failed at the transport layer (the fault
+    ``chaos.flaky_submit_p`` injects): the request never reached the
+    replica's queue, so retrying it elsewhere is always safe."""
+
+
+class ReplicaDeadError(RuntimeError):
+    """The replica died hard mid-flight: every resident request failed
+    without draining.  The router's failover path reacts by replaying
+    ``prompt + tokens_already_emitted`` onto a survivor."""
+
+
+def deadline_error(stage: str, budget_s: float,
+                   elapsed_s: float) -> DeadlineExceededError:
+    """Build the typed error AND count it — the one place
+    ``request_deadline_exceeded_total{stage}`` ticks, so the metric
+    can never disagree with the rejections callers observed."""
+    if telemetry.enabled():
+        from bigdl_tpu.telemetry import families
+        families.request_deadline_exceeded_total().labels(stage).inc()
+    return DeadlineExceededError(
+        f"deadline exceeded at {stage}: {elapsed_s:.3f}s elapsed of a "
+        f"{budget_s:.3f}s budget", stage=stage)
+
+
+# ---------------------------------------------------------------------------
+# deadline propagation
+# ---------------------------------------------------------------------------
+
+class Deadline:
+    """One request's end-to-end budget, minted at admission.  Pure
+    against ``time.perf_counter()`` — every check takes an optional
+    ``now`` so tests drive expiry without sleeping.  The object rides
+    the request through router queue → replica submit → engine admit →
+    decode sweep; whoever notices expiry stamps the stage."""
+
+    __slots__ = ("budget_s", "t_start")
+
+    def __init__(self, budget_s: float, now: Optional[float] = None):
+        self.budget_s = float(budget_s)
+        if self.budget_s <= 0:
+            raise ValueError(
+                f"deadline budget must be > 0, got {budget_s}")
+        self.t_start = time.perf_counter() if now is None else float(now)
+
+    def elapsed(self, now: Optional[float] = None) -> float:
+        return (time.perf_counter() if now is None else now) \
+            - self.t_start
+
+    def remaining(self, now: Optional[float] = None) -> float:
+        return self.budget_s - self.elapsed(now)
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        return self.remaining(now) <= 0.0
+
+    def error(self, stage: str,
+              now: Optional[float] = None) -> DeadlineExceededError:
+        return deadline_error(stage, self.budget_s, self.elapsed(now))
+
+    def __repr__(self) -> str:
+        return (f"Deadline(budget_s={self.budget_s}, "
+                f"remaining={self.remaining():.3f})")
+
+
+# ---------------------------------------------------------------------------
+# retry + hedge policy (pure)
+# ---------------------------------------------------------------------------
+
+class RetryPolicy:
+    """Bounded retry with exponential backoff + jitter — the PR-2
+    ``set_failure_retry`` knob shape, applied per request instead of
+    per training run.  ``delay_s(attempt)`` (attempt counts from 1) is
+    ``interval_s + backoff_s * 2**(attempt-1)`` capped at
+    ``backoff_cap_s``, with ±``jitter`` relative noise so a burst of
+    failed requests does not re-dispatch in lockstep against whatever
+    just failed them."""
+
+    __slots__ = ("times", "interval_s", "backoff_s", "backoff_cap_s",
+                 "jitter", "_rng")
+
+    def __init__(self, times: int = 2, interval_s: float = 0.0,
+                 backoff_s: float = 0.05,
+                 backoff_cap_s: float = 2.0, jitter: float = 0.1,
+                 seed: int = 0):
+        if times < 0:
+            raise ValueError(f"times must be >= 0, got {times}")
+        self.times = int(times)
+        self.interval_s = float(interval_s)
+        self.backoff_s = float(backoff_s)
+        self.backoff_cap_s = float(backoff_cap_s)
+        self.jitter = float(jitter)
+        self._rng = random.Random(seed)
+
+    def delay_s(self, attempt: int) -> float:
+        base = self.interval_s + min(
+            self.backoff_s * (2.0 ** max(int(attempt) - 1, 0)),
+            self.backoff_cap_s)
+        j = self.jitter
+        return max(base * self._rng.uniform(1.0 - j, 1.0 + j), 0.0)
+
+
+class HedgePolicy:
+    """Tail-latency hedging policy: when to send the duplicate.
+    ``delay_for(ttft_p99_s)`` derives the hedge delay from the primary
+    replica's reported TTFT p99 — a request still unanswered after
+    ``p99_factor`` times the typical tail is probably stuck behind a
+    straggler, and the duplicate's expected cost is one prefill (the
+    prefix-cache single-flight dedup absorbs even that when the twins
+    share a cache).  ``floor_s`` keeps a cold replica (p99 == 0) from
+    hedging instantly."""
+
+    __slots__ = ("enabled", "after_s", "p99_factor", "floor_s")
+
+    def __init__(self, enabled: bool = False,
+                 after_s: Optional[float] = None,
+                 p99_factor: float = 2.0, floor_s: float = 0.05):
+        self.enabled = bool(enabled)
+        self.after_s = None if after_s is None else float(after_s)
+        self.p99_factor = float(p99_factor)
+        self.floor_s = float(floor_s)
+
+    def delay_for(self, ttft_p99_s: float) -> float:
+        if self.after_s is not None:
+            return self.after_s
+        return max(self.p99_factor * float(ttft_p99_s or 0.0),
+                   self.floor_s)
+
+
+# ---------------------------------------------------------------------------
+# per-replica circuit breakers
+# ---------------------------------------------------------------------------
+
+class _Breaker:
+    __slots__ = ("state", "failures", "stale", "opened_at", "probes")
+
+    def __init__(self):
+        self.state = "closed"
+        self.failures = 0       # consecutive submit failures
+        self.stale = 0          # consecutive unhealthy registry polls
+        self.opened_at = 0.0
+        self.probes = 0         # half-open probes still allowed
+
+
+class CircuitBreaker:
+    """Closed / open / half-open breaker per replica id.
+
+    closed --(``failure_threshold`` consecutive submit failures, or
+    ``stale_threshold`` consecutive unhealthy registry polls)--> open
+    --(``open_s`` elapsed)--> half-open (``probe_budget`` requests may
+    pass) --(probe success)--> closed / --(probe failure)--> open.
+
+    Thread-safe: the router thread routes on it while engine-callback
+    threads record completions.  Transitions are emitted OUTSIDE the
+    lock (the flight recorder and metric registry take their own
+    locks; nesting them under ours would hand graftlint's lock-order
+    pass a real cycle to complain about)."""
+
+    def __init__(self, failure_threshold: int = 3,
+                 stale_threshold: int = 1, open_s: float = 1.0,
+                 probe_budget: int = 1):
+        if failure_threshold < 1:
+            raise ValueError(f"failure_threshold must be >= 1, got "
+                             f"{failure_threshold}")
+        if stale_threshold < 1:
+            raise ValueError(f"stale_threshold must be >= 1, got "
+                             f"{stale_threshold}")
+        if probe_budget < 1:
+            raise ValueError(f"probe_budget must be >= 1, got "
+                             f"{probe_budget}")
+        self.failure_threshold = int(failure_threshold)
+        self.stale_threshold = int(stale_threshold)
+        self.open_s = float(open_s)
+        self.probe_budget = int(probe_budget)
+        # RLock: _get/_to take it themselves (re-entrantly — every
+        # caller already holds it), so each helper is safe standalone
+        self._lock = threading.RLock()
+        self._by_rid: Dict[int, _Breaker] = {}
+        self._transitions: Dict[str, int] = {}
+
+    # -- internals (emit the returned record AFTER releasing the lock) --
+
+    def _get(self, rid: int) -> _Breaker:
+        with self._lock:
+            b = self._by_rid.get(rid)
+            if b is None:
+                b = self._by_rid[rid] = _Breaker()
+            return b
+
+    def _to(self, rid: int, b: _Breaker, state: str, reason: str,
+            now: float) -> Dict[str, Any]:
+        with self._lock:
+            prev, b.state = b.state, state
+            if state == "open":
+                b.opened_at = now
+                b.probes = 0
+            elif state == "half_open":
+                b.probes = self.probe_budget
+            elif state == "closed":
+                b.failures = 0
+                b.stale = 0
+            self._transitions[state] = \
+                self._transitions.get(state, 0) + 1
+        return {"replica": rid, "from": prev, "to": state,
+                "reason": reason}
+
+    @staticmethod
+    def _emit(rec: Optional[Dict[str, Any]]) -> None:
+        if rec is None:
+            return
+        # the ONE emission site of the breaker_transition kind
+        _events.record_event("breaker_transition", **rec)
+        if telemetry.enabled():
+            from bigdl_tpu.telemetry import families
+            families.router_breaker_transitions_total().labels(
+                rec["to"]).inc()
+
+    # -- routing side (router thread) ---------------------------------------
+
+    def routable(self, rid: int, now: Optional[float] = None) -> bool:
+        """May the router send ``rid`` a request right now?  An open
+        breaker past its ``open_s`` window flips to half-open here —
+        lazily, on the first routing decision that could use it."""
+        now = time.perf_counter() if now is None else now
+        rec = None
+        with self._lock:
+            b = self._by_rid.get(int(rid))
+            if b is None or b.state == "closed":
+                return True
+            if b.state == "open":
+                if now - b.opened_at < self.open_s:
+                    return False
+                rec = self._to(int(rid), b, "half_open",
+                               f"open {self.open_s}s elapsed; probing",
+                               now)
+                ok = True
+            else:       # half_open
+                ok = b.probes > 0
+        self._emit(rec)
+        return ok
+
+    def on_dispatch(self, rid: int) -> None:
+        """The router picked ``rid``: a half-open breaker spends one
+        probe (further requests hold off until the probe reports)."""
+        with self._lock:
+            b = self._by_rid.get(int(rid))
+            if b is not None and b.state == "half_open" and b.probes > 0:
+                b.probes -= 1
+
+    def prefer_closed(self, rid: int) -> int:
+        """Sort key: 0 for a closed breaker, 1 otherwise — a half-open
+        probe target only takes traffic when no closed replica can."""
+        with self._lock:
+            b = self._by_rid.get(int(rid))
+            return 0 if b is None or b.state == "closed" else 1
+
+    # -- outcome side (engine callback threads + router refresh) ------------
+
+    def record_success(self, rid: int,
+                       now: Optional[float] = None) -> None:
+        now = time.perf_counter() if now is None else now
+        rec = None
+        with self._lock:
+            b = self._by_rid.get(int(rid))
+            if b is None:
+                return
+            b.failures = 0
+            b.stale = 0
+            if b.state == "half_open":
+                rec = self._to(int(rid), b, "closed",
+                               "probe request succeeded", now)
+        self._emit(rec)
+
+    def record_failure(self, rid: int, reason: str = "submit",
+                       now: Optional[float] = None) -> None:
+        now = time.perf_counter() if now is None else now
+        rec = None
+        with self._lock:
+            b = self._get(int(rid))
+            b.failures += 1
+            if b.state == "half_open":
+                rec = self._to(int(rid), b, "open",
+                               f"probe request failed ({reason})", now)
+            elif b.state == "closed" \
+                    and b.failures >= self.failure_threshold:
+                rec = self._to(
+                    int(rid), b, "open",
+                    f"{b.failures} consecutive failures ({reason})",
+                    now)
+        self._emit(rec)
+
+    def note_unhealthy(self, rid: int,
+                       now: Optional[float] = None) -> None:
+        """One registry poll read this replica's snapshot as stale /
+        corrupt / unhealthy — the health-plane signal, counted on its
+        own streak so a single torn read does not trip the breaker
+        when ``stale_threshold`` > 1."""
+        now = time.perf_counter() if now is None else now
+        rec = None
+        with self._lock:
+            b = self._get(int(rid))
+            b.stale += 1
+            if b.state == "closed" and b.stale >= self.stale_threshold:
+                rec = self._to(int(rid), b, "open",
+                               f"snapshot unhealthy x{b.stale}", now)
+        self._emit(rec)
+
+    def note_healthy(self, rid: int,
+                     now: Optional[float] = None) -> None:
+        """A healthy registry poll: clears the staleness streak, and
+        closes a breaker that was opened PURELY on staleness (zero
+        submit failures) — the health plane retracting its own verdict
+        needs no probe.  A failure-opened breaker stays driven by the
+        probe machinery: a replica can publish healthy snapshots while
+        flaking every submit."""
+        now = time.perf_counter() if now is None else now
+        rec = None
+        with self._lock:
+            b = self._by_rid.get(int(rid))
+            if b is None:
+                return
+            b.stale = 0
+            if b.state != "closed" and b.failures == 0:
+                rec = self._to(int(rid), b, "closed",
+                               "healthy snapshot retracts staleness",
+                               now)
+        self._emit(rec)
+
+    def forget(self, rid: int) -> None:
+        with self._lock:
+            self._by_rid.pop(int(rid), None)
+
+    # -- observability -------------------------------------------------------
+
+    def state(self, rid: int) -> str:
+        with self._lock:
+            b = self._by_rid.get(int(rid))
+            return "closed" if b is None else b.state
+
+    def open_count(self) -> int:
+        with self._lock:
+            return sum(1 for b in self._by_rid.values()
+                       if b.state != "closed")
+
+    def snapshot(self) -> Dict[int, Dict[str, Any]]:
+        with self._lock:
+            return {rid: {"state": b.state, "failures": b.failures,
+                          "stale": b.stale}
+                    for rid, b in self._by_rid.items()}
+
+    def transition_counts(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._transitions)
+
+
+# ---------------------------------------------------------------------------
+# the bundle the router consumes
+# ---------------------------------------------------------------------------
+
+class ReliabilityPolicy:
+    """Everything the router's reliability layer is configured by, in
+    one object: retry, hedge, breaker thresholds, and the per-SLO-class
+    deadline budgets.  The defaults keep every behavior that changes
+    an answer OFF (no deadlines unless a budget is given, no hedging
+    unless enabled) and every behavior that only saves a lost request
+    ON (retries, failover, breakers)."""
+
+    def __init__(self, retry: Optional[RetryPolicy] = None,
+                 hedge: Optional[HedgePolicy] = None,
+                 failure_threshold: int = 3, stale_threshold: int = 1,
+                 open_s: float = 1.0, probe_budget: int = 1,
+                 deadline_budget_s: Optional[float] = None,
+                 deadline_budgets: Optional[Dict[str, float]] = None,
+                 failover: bool = True):
+        self.retry = retry if retry is not None else RetryPolicy()
+        self.hedge = hedge if hedge is not None else HedgePolicy()
+        self.failure_threshold = int(failure_threshold)
+        self.stale_threshold = int(stale_threshold)
+        self.open_s = float(open_s)
+        self.probe_budget = int(probe_budget)
+        self.deadline_budget_s = (None if deadline_budget_s is None
+                                  else float(deadline_budget_s))
+        self.deadline_budgets = {
+            str(m): float(s)
+            for m, s in (deadline_budgets or {}).items()}
+        self.failover = bool(failover)
+
+    def budget_for(self, model: str) -> Optional[float]:
+        return self.deadline_budgets.get(str(model),
+                                         self.deadline_budget_s)
+
+    def make_breaker(self) -> CircuitBreaker:
+        return CircuitBreaker(
+            failure_threshold=self.failure_threshold,
+            stale_threshold=self.stale_threshold,
+            open_s=self.open_s, probe_budget=self.probe_budget)

@@ -50,6 +50,9 @@ import numpy as np
 
 from bigdl_tpu.serving.generation import GenerationScheduler
 from bigdl_tpu.serving.prefix_cache import PrefixKVCache
+from bigdl_tpu.serving.reliability import (
+    Deadline, ReplicaTransportError,
+)
 from bigdl_tpu.telemetry.fleet import (
     host_stats, merge_host_snapshots, read_host_snapshots,
     remove_host_snapshot, write_host_snapshot,
@@ -213,6 +216,14 @@ class Replica:
         self._draining = False
         self._closed = False
         self._chaos_killed = False
+        # feature-detected once: may deadline= be forwarded verbatim?
+        # (third-party targets only need the PR-12 submit shape)
+        try:
+            import inspect
+            sig = inspect.signature(target.submit_generate_async)
+            self._accepts_deadline = "deadline" in sig.parameters
+        except (TypeError, ValueError):
+            self._accepts_deadline = False
         self.publish_interval_s = float(publish_interval_s)
         self._publisher: Optional[SnapshotPublisher] = None
         if snapshot_dir is not None:
@@ -246,15 +257,40 @@ class Replica:
 
     def submit_generate_async(self, prompt, max_new_tokens: int,
                               eos_id=None, on_token=None,
-                              timeout: Optional[float] = None) -> Future:
+                              timeout: Optional[float] = None,
+                              deadline: Optional[Deadline] = None
+                              ) -> Future:
+        # chaos transport faults, injected at the replica boundary —
+        # the shape a flaky network or an overloaded frontend shows the
+        # router: added submit latency and/or a typed transport error
+        # BEFORE the request reaches the engine queue (so a flaked
+        # submit is always safe to retry elsewhere)
+        delay_s, flake = chaos.on_replica_submit(self.id)
+        if delay_s > 0.0:
+            time.sleep(delay_s)
+        if flake:
+            raise ReplicaTransportError(
+                f"chaos: submit to replica {self.id} flaked")
         with self._lock:
             if self._chaos_killed:
                 from bigdl_tpu.serving.admission import ServerClosedError
                 raise ServerClosedError(
                     f"replica {self.id} was chaos-killed")
+        if deadline is not None and self._accepts_deadline:
+            return self.target.submit_generate_async(
+                prompt, max_new_tokens, eos_id=eos_id,
+                on_token=on_token, timeout=timeout, deadline=deadline)
         return self.target.submit_generate_async(
             prompt, max_new_tokens, eos_id=eos_id, on_token=on_token,
             timeout=timeout)
+
+    def cancel(self, fut: Future) -> bool:
+        """Cancel a request previously submitted to this replica —
+        the hedged-dispatch loser path.  Falls back to a plain
+        ``Future.cancel`` for targets without engine-side cancel."""
+        if hasattr(self.target, "cancel"):
+            return bool(self.target.cancel(fut))
+        return fut.cancel()
 
     def admitted_outstanding(self) -> int:
         return int(self.target.admitted_outstanding()) \
@@ -288,8 +324,9 @@ class Replica:
             start_generation=self.start_generation, model=self.model)
 
     def publish(self) -> None:
-        if chaos.on_replica_publish(self.id):
-            self._chaos_kill()
+        mode = chaos.on_replica_publish(self.id)
+        if mode:
+            self._chaos_kill(hard=(mode == "hard"))
         with self._lock:
             killed = self._chaos_killed
         if killed:
@@ -301,23 +338,42 @@ class Replica:
         if self.snapshot_dir is not None:
             write_host_snapshot(self.snapshot_dir, self.snapshot())
 
-    def _chaos_kill(self) -> None:
-        """Die the SIGTERM way: stop publishing (stale-unhealthy to
-        the registry), refuse new submissions (typed
-        ServerClosedError — the router parks and re-picks), and drain
-        already-admitted requests on a background thread so
+    def _chaos_kill(self, hard: bool = False) -> None:
+        """Default: die the SIGTERM way — stop publishing
+        (stale-unhealthy to the registry), refuse new submissions
+        (typed ServerClosedError — the router parks and re-picks), and
+        drain already-admitted requests on a background thread so
         ``admitted_outstanding()`` still falls to 0 — the zero-drop
-        invariant the controller's replacement path is proven
-        against."""
+        invariant the controller's replacement path is proven against.
+
+        ``hard`` is the SIGKILL way: nothing drains — slot-resident
+        requests fail typed (:class:`ReplicaDeadError` from the
+        engine's ``kill()``), which is the fault the router's
+        mid-stream failover path exists for."""
         with self._lock:
             if self._chaos_killed:
                 return
             self._chaos_killed = True
+        if hard:
+            self.kill()
+            return
         threading.Thread(
             target=lambda: self.target.shutdown(drain=True,
                                                 timeout=30.0),
             name=f"bigdl-replica-{self.id}-chaos-drain",
             daemon=True).start()
+
+    def kill(self) -> None:
+        """Hard-kill the serving target NOW (no drain): in-flight
+        requests fail typed so the router can replay them elsewhere.
+        Targets without an engine ``kill()`` fall back to a
+        non-draining shutdown (queued requests still fail fast)."""
+        with self._lock:
+            self._chaos_killed = True
+        if hasattr(self.target, "kill"):
+            self.target.kill()
+        else:
+            self.target.shutdown(drain=False, timeout=5.0)
 
     # ---- lifecycle -------------------------------------------------------
 
@@ -551,12 +607,18 @@ class DisaggregatedEngine:
         self._handoffs = 0
         self._prefill_retries = 0
         self._shutdown = False
+        # outer future -> decode-engine inner future, tracked so
+        # cancel() can reach the slot-owning engine after the handoff
+        self._dfut_lock = threading.Lock()
+        self._decode_futs: Dict[Future, Future] = {}
 
     # ---- submission ------------------------------------------------------
 
     def submit_generate_async(self, prompt, max_new_tokens: int,
                               eos_id=None, on_token=None,
-                              timeout: Optional[float] = None) -> Future:
+                              timeout: Optional[float] = None,
+                              deadline: Optional[Deadline] = None
+                              ) -> Future:
         with self._lock:
             if self._shutdown:
                 from bigdl_tpu.serving.admission import ServerClosedError
@@ -572,13 +634,14 @@ class DisaggregatedEngine:
                 # engine's own (bounded, sub-granule) prefill is the
                 # whole cost — skip the hop
                 self._to_decode(outer, p, max_new_tokens, eos_id,
-                                on_token, timeout)
+                                on_token, timeout, deadline)
             else:
-                pf = self.prefill.submit_async(p, 0, timeout=timeout)
+                pf = self.prefill.submit_async(p, 0, timeout=timeout,
+                                               deadline=deadline)
                 pf.add_done_callback(
                     lambda f: self._after_prefill(
                         f, outer, p, max_new_tokens, eos_id, on_token,
-                        self.max_prefill_retries))
+                        self.max_prefill_retries, deadline))
         except BaseException:
             # the done-callback never fires for a future that was
             # never resolved — rebalance the count before re-raising
@@ -602,7 +665,8 @@ class DisaggregatedEngine:
 
     def _after_prefill(self, pf: Future, outer: Future, prompt,
                        max_new_tokens, eos_id, on_token,
-                       retries: int) -> None:
+                       retries: int,
+                       deadline: Optional[Deadline] = None) -> None:
         if outer.cancelled():
             return
         region = prompt[:len(prompt) - 1]
@@ -618,11 +682,12 @@ class DisaggregatedEngine:
                 # thread — a blocking put against the engine's own
                 # full queue would deadlock it (the only consumer is
                 # the thread that would be waiting)
-                nf = self.prefill.submit_async(prompt, 0, timeout=0)
+                nf = self.prefill.submit_async(prompt, 0, timeout=0,
+                                               deadline=deadline)
                 nf.add_done_callback(
                     lambda f: self._after_prefill(
                         f, outer, prompt, max_new_tokens, eos_id,
-                        on_token, retries - 1))
+                        on_token, retries - 1, deadline))
                 return
             except Exception:  # noqa: BLE001 - fall through to decode
                 pass
@@ -630,10 +695,11 @@ class DisaggregatedEngine:
         # decode serves it either way (it re-prefills anything missing
         # itself — bit-identity never depends on the cache)
         self._to_decode(outer, prompt, max_new_tokens, eos_id,
-                        on_token, 0)
+                        on_token, 0, deadline)
 
     def _to_decode(self, outer: Future, prompt, max_new_tokens,
-                   eos_id, on_token, timeout) -> None:
+                   eos_id, on_token, timeout,
+                   deadline: Optional[Deadline] = None) -> None:
         """Hand one request to the decode engine.  ``timeout`` is the
         submitter's admission timeout on the direct (sub-granule)
         path; the prefill-completion path passes 0 — that callback
@@ -646,13 +712,15 @@ class DisaggregatedEngine:
         try:
             df = self.decode.submit_async(
                 prompt, max_new_tokens, eos_id=eos_id,
-                on_token=on_token, timeout=timeout)
+                on_token=on_token, timeout=timeout, deadline=deadline)
         except Exception as e:  # noqa: BLE001 - typed admission errors
             # (queue full, closed) land on the caller's future
             if outer.set_running_or_notify_cancel():
                 outer.set_exception(e)
             return
-        df.add_done_callback(lambda f: self._chain(f, outer))
+        with self._dfut_lock:
+            self._decode_futs[outer] = df
+        df.add_done_callback(lambda f: self._chain_tracked(f, outer))
 
     @staticmethod
     def _chain(inner: Future, outer: Future) -> None:
@@ -663,6 +731,32 @@ class DisaggregatedEngine:
         except BaseException as e:  # noqa: BLE001 - inner exception or
             # CancelledError, either way the outer future carries it
             outer.set_exception(e)
+
+    def _chain_tracked(self, inner: Future, outer: Future) -> None:
+        with self._dfut_lock:
+            self._decode_futs.pop(outer, None)
+        self._chain(inner, outer)
+
+    def cancel(self, fut: Future) -> bool:
+        """Cancel an outer future: reaches through to the decode
+        engine's slot-freeing cancel once the handoff happened; a
+        request still in the prefill hop cancels at the outer future
+        (``_after_prefill``/``_chain`` observe it and stand down)."""
+        with self._dfut_lock:
+            inner = self._decode_futs.get(fut)
+        if inner is not None:
+            return self.decode.cancel(inner)
+        if fut.cancel():
+            return True
+        return not fut.done()
+
+    def kill(self, exc: Optional[Exception] = None) -> None:
+        """Hard-kill both tiers (no drain) — see
+        :meth:`GenerationScheduler.kill`."""
+        with self._lock:
+            self._shutdown = True
+        self.prefill.kill(exc)
+        self.decode.kill(exc)
 
     # ---- observability / lifecycle ---------------------------------------
 

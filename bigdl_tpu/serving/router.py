@@ -34,11 +34,41 @@ handles and decides, per request:
   ZERO-DROP invariant directly — the old replica's
   ``admitted_outstanding()`` must reach 0 before it is removed.
 
+On top of dispatch sits the REQUEST-RELIABILITY layer (policy objects
+in :mod:`bigdl_tpu.serving.reliability`, actuation here):
+
+* **Deadline propagation**: each request may carry a
+  :class:`~bigdl_tpu.serving.reliability.Deadline` (minted at
+  admission from ``deadline_s=`` or the policy's per-model budgets)
+  that rides queue wait → replica submit → engine prefill/decode; the
+  stage that notices expiry rejects typed
+  (``DeadlineExceededError.stage``) instead of burning slot-iterations.
+* **Per-replica circuit breakers**: consecutive submit failures or
+  stale health snapshots open a replica's breaker, pulling it out of
+  ``_pick`` *before* the fleet controller's ``dead_after_polls``
+  window expires; after ``open_s`` a half-open probe request re-admits
+  it.
+* **Bounded retries + hedged dispatch**: a request its replica failed
+  AFTER admission re-dispatches to a different replica with the PR-2
+  backoff shape (bounded by ``RetryPolicy.times``); an idempotent
+  (non-streaming) request may hedge to a second replica after a
+  p99-derived delay, first completion wins, the loser is cancelled.
+* **Mid-stream generation failover**: when a replica dies mid-decode,
+  the router replays ``prompt + tokens_already_emitted`` onto a
+  survivor with the remaining token budget — the row-length invariant
+  (``len(prompt) + max_new`` is conserved across the fold) makes the
+  stitched greedy stream bit-identical to an uninterrupted solo
+  ``generate()``, the same bar PR 10/12 property-tested.
+
 Observability: ``router_requests_total{outcome}``,
-``router_replica_inflight{replica}``, ``router_shed_total{reason}``
-(preregistered, linted), plus flight-recorder events ``replica_join``
-/ ``replica_drain`` / ``router_shed`` so a shed storm is visible in
-the PR-4 black box.
+``router_replica_inflight{replica}``, ``router_shed_total{reason}``,
+``router_retries_total{reason}``, ``router_hedges_total{outcome}``,
+``router_breaker_transitions_total{to}``,
+``request_deadline_exceeded_total{stage}`` (preregistered, linted),
+plus flight-recorder events ``replica_join`` / ``replica_drain`` /
+``router_shed`` / ``request_retry`` / ``request_hedge`` /
+``breaker_transition`` / ``generation_failover`` so a shed storm or a
+failover burst is visible in the PR-4 black box.
 """
 
 from __future__ import annotations
@@ -49,7 +79,8 @@ import logging
 import threading
 import time
 from collections import deque
-from concurrent.futures import Future
+from concurrent.futures import CancelledError, Future, InvalidStateError
+from concurrent.futures import TimeoutError as FuturesTimeout
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -60,6 +91,10 @@ from bigdl_tpu.serving.admission import (
     ServerClosedError,
 )
 from bigdl_tpu.serving.replica import Replica, ReplicaRegistry
+from bigdl_tpu.serving.reliability import (
+    Deadline, DeadlineExceededError, ReliabilityPolicy,
+    ReplicaDeadError, ReplicaTransportError, RequestCancelledError,
+)
 from bigdl_tpu.telemetry import events as _events
 
 __all__ = ["Router", "HashRing", "RouterRequest",
@@ -153,11 +188,16 @@ class RouterRequest:
 
     __slots__ = ("prompt", "max_new_tokens", "eos_id", "on_token",
                  "session", "model", "future", "t_enqueue",
-                 "affinity_counted")
+                 "affinity_counted", "deadline", "tried", "attempts",
+                 "not_before", "inners", "emitted", "hedge",
+                 "hedge_dispatched", "primary_rid", "t_dispatch",
+                 "failovers", "cancel_requested")
 
     def __init__(self, prompt, max_new_tokens: int, eos_id=None,
                  on_token=None, session: Optional[str] = None,
-                 model: str = "default"):
+                 model: str = "default",
+                 deadline: Optional[Deadline] = None,
+                 hedge: bool = False):
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         self.max_new_tokens = int(max_new_tokens)
         self.eos_id = eos_id
@@ -167,6 +207,21 @@ class RouterRequest:
         self.future: Future = Future()
         self.t_enqueue = time.perf_counter()
         self.affinity_counted = False
+        # --- reliability state ---
+        self.deadline = deadline
+        self.hedge = bool(hedge)
+        self.tried: set = set()         # rids that failed this request
+        self.attempts = 0               # failed dispatches (retry cap)
+        self.failovers = 0              # mid-stream replays so far
+        self.not_before = 0.0           # backoff: no re-dispatch before
+        self.inners: Dict[int, Future] = {}   # rid -> live inner future
+        # streamed tokens so far (recorder-wrapped on_token), the
+        # failover replay's salvage; None for non-streaming requests
+        self.emitted: Optional[list] = None
+        self.hedge_dispatched = False
+        self.primary_rid: Optional[int] = None
+        self.t_dispatch = 0.0
+        self.cancel_requested = False
 
 
 class Router:
@@ -191,7 +246,11 @@ class Router:
                  shed_after_s: Optional[float] = None,
                  poll_interval_s: float = 0.05,
                  registry_max_age_s: float = 2.0,
-                 vnodes: int = 64, start: bool = True):
+                 vnodes: int = 64,
+                 reliability: Optional[ReliabilityPolicy] = None,
+                 deadline_budget_s: Optional[float] = None,
+                 deadline_budgets: Optional[Dict[str, float]] = None,
+                 start: bool = True):
         if registry is not None:
             self.registry = registry
         else:
@@ -239,11 +298,36 @@ class Router:
         self._affine_total = 0
         self._affine_hits = 0
         self._shutdown = False
+        # --- request-reliability layer ---
+        if reliability is not None:
+            self.reliability = reliability
+        else:
+            self.reliability = ReliabilityPolicy(
+                deadline_budget_s=deadline_budget_s,
+                deadline_budgets=deadline_budgets)
+        self._breaker = self.reliability.make_breaker()
+        self._retries = 0
+        self._hedges = 0
+        self._failover_count = 0
+        # future -> RouterRequest, so cancel() can reach the inner
+        # dispatches; popped at terminal accounting
+        self._req_of: Dict[Future, RouterRequest] = {}
+        # inner-future failures land here (engine callback threads
+        # append, the router thread drains and decides retry /
+        # failover / propagate); _retire closes the box at router-
+        # thread exit so a late failure propagates inline instead of
+        # stranding its outer future
+        self._fb_lock = threading.Lock()
+        self._failbox: "deque" = deque()
+        self._retire = False
         # router-thread-only state (never touched under the lock):
         # undispatchable requests PARK here so the queue keeps
         # draining — one budget-exhausted model's head must not
         # head-of-line-block every other model's traffic
         self._waiting: "deque[RouterRequest]" = deque()
+        # hedge-armed dispatched requests the router thread watches
+        # for the p99-derived twin-dispatch delay
+        self._hedge_watch: List[RouterRequest] = []
         self._last_poll = 0.0
         for r in replicas:
             self.add_replica(r)
@@ -292,6 +376,7 @@ class Router:
             self._inflight.pop(replica.id, None)
         replica.close(drain=drain, timeout=timeout)
         self.registry.forget(replica.id)
+        self._breaker.forget(replica.id)
         self._refresh(force=True)
 
     def deploy(self, new_replica: Replica, replaces: int,
@@ -348,36 +433,109 @@ class Router:
     def submit_generate_async(self, prompt, max_new_tokens: int,
                               eos_id=None, session: Optional[str] = None,
                               model: str = "default", on_token=None,
-                              timeout: Optional[float] = None) -> Future:
+                              timeout: Optional[float] = None,
+                              deadline_s: Optional[float] = None,
+                              hedge: Optional[bool] = None) -> Future:
         """Admit one generation request into the fabric.  ``session``
         keys affinity (same key → same warm replica while it stays
         eligible); ``model`` keys the admission budgets.  The future
         fails with a TYPED error on overload: RequestSheddedError
         (shed while queued), NoReplicaAvailableError (nothing eligible
-        before the shed deadline), ServerClosedError (shutdown)."""
+        before the shed deadline), ServerClosedError (shutdown),
+        DeadlineExceededError (end-to-end budget expired — ``deadline_s``
+        here, else the reliability policy's per-model budget).
+        ``hedge`` opts one non-streaming request in/out of hedged
+        dispatch (default: the policy's ``hedge.enabled``)."""
         with self._lock:
             if self._shutdown:
                 raise ServerClosedError("router is shut down")
             self._submitted += 1
+        budget = (deadline_s if deadline_s is not None
+                  else self.reliability.budget_for(model))
+        dl = None if budget is None else Deadline(budget)
+        want_hedge = (self.reliability.hedge.enabled if hedge is None
+                      else bool(hedge))
         req = RouterRequest(prompt, max_new_tokens, eos_id=eos_id,
                             on_token=on_token, session=session,
-                            model=model)
+                            model=model, deadline=dl,
+                            # a streamed duplicate would double-deliver
+                            # tokens: hedging is for idempotent
+                            # (non-streaming) requests only
+                            hedge=want_hedge and on_token is None)
+        if on_token is not None:
+            # recorder wrap: every delivered token is remembered on the
+            # request, so a mid-stream replica death can replay
+            # prompt+emitted onto a survivor (attribute lookup at call
+            # time — a failover rebinds req.emitted and the recorder
+            # follows)
+            req.emitted = []
+
+            def _recorded(tok, _req=req, _user=on_token):
+                _req.emitted.append(int(tok))
+                _user(tok)
+
+            req.on_token = _recorded
         req.future.add_done_callback(self._on_terminal)
-        self._queue.put(req, timeout=timeout)
+        with self._lock:
+            self._req_of[req.future] = req
+        try:
+            self._queue.put(req, timeout=timeout)
+        except BaseException:
+            with self._lock:
+                self._req_of.pop(req.future, None)
+            raise
         return req.future
 
     def submit_generate(self, prompt, max_new_tokens: int, eos_id=None,
                         session: Optional[str] = None,
                         model: str = "default",
-                        timeout: Optional[float] = None):
+                        timeout: Optional[float] = None,
+                        deadline_s: Optional[float] = None):
         deadline = (None if timeout is None
                     else time.perf_counter() + timeout)
         fut = self.submit_generate_async(
             prompt, max_new_tokens, eos_id=eos_id, session=session,
-            model=model, timeout=timeout)
+            model=model, timeout=timeout, deadline_s=deadline_s)
         remaining = (None if deadline is None
                      else max(deadline - time.perf_counter(), 0.0))
-        return fut.result(remaining)
+        try:
+            return fut.result(remaining)
+        except FuturesTimeout:
+            # the caller walks away: propagate the abandonment into
+            # the fabric so the request frees its replica slot instead
+            # of decoding to completion for nobody
+            self.cancel(fut)
+            raise
+
+    def cancel(self, fut: Future) -> bool:
+        """Best-effort cancel of a routed request, wherever it is:
+        queued/parked → dropped (or failed typed at the next routing
+        touch); dispatched → the replica-side cancel frees the engine
+        slot and the failure propagates back typed
+        (:class:`RequestCancelledError`).  Returns False only for an
+        already-terminal future."""
+        with self._lock:
+            req = self._req_of.get(fut)
+        if req is None:
+            return fut.cancel()
+        req.cancel_requested = True
+        if fut.cancel():
+            return True          # still PENDING (queued, never routed)
+        if fut.done():
+            return False
+        # RUNNING: parked (the router thread fails it typed at its
+        # next touch) or dispatched (cancel the live inners)
+        with self._lock:
+            inners = dict(req.inners)
+            replicas = {rid: self._replicas.get(rid) for rid in inners}
+        for rid, inner in inners.items():
+            rep = replicas.get(rid)
+            if rep is not None:
+                try:
+                    rep.cancel(inner)
+                except Exception:  # noqa: BLE001 - best effort; the
+                    pass           # engine sweep is the backstop
+        return True
 
     # ---- lifecycle -------------------------------------------------------
 
@@ -420,13 +578,17 @@ class Router:
 
     def _run(self) -> None:
         while True:
+            self._drain_failbox()
+            self._check_hedges()
             self._retry_waiting()
             req = self._queue.get(timeout=self._poll_s)
             self._refresh()
             if req is None:
                 if self._queue.closed and len(self._queue) == 0:
-                    if not self._waiting:
-                        return
+                    with self._fb_lock:
+                        failbox_empty = not self._failbox
+                    if not self._waiting and failbox_empty:
+                        break
                     # a closed drained queue returns None instantly:
                     # pace the waiting-list retries instead of
                     # busy-spinning until their shed deadlines
@@ -436,6 +598,12 @@ class Router:
                 continue
             if not self._route(req):
                 self._waiting.append(req)
+        # retire: late inner-future failures now propagate inline on
+        # their callback thread (no retries after drain), and one
+        # final drain catches anything boxed before the flag flipped
+        with self._fb_lock:
+            self._retire = True
+        self._drain_failbox(propagate_only=True)
 
     def _retry_waiting(self) -> None:
         """Re-attempt every parked request once (newly freed capacity,
@@ -462,6 +630,17 @@ class Router:
             return
         with self._lock:
             self._records = records
+            known = set(self._replicas)
+        # feed the breaker's staleness channel (outside the router
+        # lock: breaker transitions emit telemetry)
+        for rid in known:
+            rec = records.get(rid)
+            if rec is None:
+                continue
+            if rec.get("healthy"):
+                self._breaker.note_healthy(rid)
+            else:
+                self._breaker.note_unhealthy(rid)
 
     @staticmethod
     def _bound(rec: Dict[str, Any], n_eligible: int,
@@ -476,11 +655,17 @@ class Router:
         mean = (total_inflight + 1) / max(n_eligible, 1)
         return max(slots, int(np.ceil(factor * mean)))
 
-    def _pick(self, req: RouterRequest) \
+    def _pick(self, req: RouterRequest,
+              exclude: Optional[set] = None) \
             -> Tuple[Optional[int], Optional[str]]:
         """(replica id, None) or (None, block reason).  Affine work may
         land on an SLO-breached replica (its warm cache is the point);
-        non-affine work never does."""
+        non-affine work never does.  ``exclude`` hard-bars replicas (a
+        hedge twin must not land on its primary); replicas that
+        already FAILED this request (``req.tried``) are avoided only
+        while an untried candidate exists, and open-breaker replicas
+        take nothing (half-open ones only when no closed-breaker
+        candidate can)."""
         with self._lock:
             records = dict(self._records)
             inflight = dict(self._inflight)
@@ -493,6 +678,8 @@ class Router:
                 req.model, self.slo_ttft_p99_s)
         if budget is not None and model_used >= budget:
             return None, "budget"
+        if exclude:
+            known = known - set(exclude)
         # model pools: when ANY known replica declares this request's
         # model, the pool is exactly those replicas (a pool with no
         # healthy member sheds rather than landing on another model's
@@ -505,7 +692,8 @@ class Router:
             rec = records.get(rid)
             return (rid in known and rec is not None
                     and rec["healthy"] and not rec["draining"]
-                    and rec.get("model", "default") == pool_model)
+                    and rec.get("model", "default") == pool_model
+                    and self._breaker.routable(rid))
         eligible = [rid for rid in known if rec_ok(rid)]
         if not eligible:
             return None, "no_replica"
@@ -531,7 +719,7 @@ class Router:
                 # its sessions (their warm cache lives there); a
                 # bounded-load SPILL stop holds none of this session's
                 # cache, so it gets no such exemption
-                if rec_ok(rid) and has_room(rid) \
+                if rec_ok(rid) and has_room(rid) and rid not in req.tried \
                         and (i == 0 or slo_ok(rid)):
                     return rid, None
             # every ring stop is draining/unhealthy/at-bound: fall
@@ -541,25 +729,53 @@ class Router:
         if not cands:
             breached = [rid for rid in eligible if not slo_ok(rid)]
             return None, ("slo" if breached else "no_replica")
-        return min(cands, key=lambda rid: (inflight.get(rid, 0), rid)), \
-            None
+        fresh = [rid for rid in cands if rid not in req.tried]
+        if fresh:
+            # a retry goes to a DIFFERENT replica while one exists;
+            # re-offering the one that just failed is the last resort
+            cands = fresh
+        # closed-breaker replicas first: a half-open probe target only
+        # takes traffic when nothing fully-trusted can
+        return min(cands, key=lambda rid: (
+            self._breaker.prefer_closed(rid),
+            inflight.get(rid, 0), rid)), None
 
     def _route(self, req: RouterRequest) -> bool:
         """Attempt one dispatch.  Returns True when the request reached
         a terminal handling (dispatched, shed, or failed) and False
         when it should PARK in the waiting list for a retry."""
+        now = time.perf_counter()
+        if req.cancel_requested:
+            fut = req.future
+            if not fut.cancel() and not fut.done():
+                fut.set_exception(RequestCancelledError(
+                    "request cancelled before dispatch"))
+            return True
+        if req.deadline is not None and req.deadline.expired(now):
+            self._shed(req, "deadline", now - req.t_enqueue)
+            return True
+        if now < req.not_before:
+            return False        # retry backoff still running: park
         rid, reason = self._pick(req)
         if rid is None:
-            waited = time.perf_counter() - req.t_enqueue
+            waited = now - req.t_enqueue
             if waited >= self.shed_after_s:
                 self._shed(req, reason or "no_replica", waited)
                 return True
             return False
+        return self._dispatch(req, rid)
+
+    def _dispatch(self, req: RouterRequest, rid: int,
+                  twin: bool = False) -> bool:
+        """Submit ``req`` to replica ``rid``.  Same True/False contract
+        as ``_route``; ``twin`` marks the hedged duplicate (future
+        already RUNNING, no park-on-failure — a failed hedge simply
+        doesn't happen, the primary is still in flight)."""
         with self._lock:
             replica = self._replicas.get(rid)
         if replica is None:     # removed between pick and dispatch
             return False
-        if not req.future.running() \
+        if not twin and not req.future.running() \
                 and not req.future.set_running_or_notify_cancel():
             return True         # cancelled while queued (a parked
             # request re-entering here is already RUNNING — skip)
@@ -570,14 +786,48 @@ class Router:
             # registry polls, and shedding for the whole fleet
             inner = replica.submit_generate_async(
                 req.prompt, req.max_new_tokens, eos_id=req.eos_id,
-                on_token=req.on_token, timeout=0)
-        except (QueueFullError, ServerClosedError):
-            # the registry lagged reality (replica saturated or went
-            # away): park and re-pick next tick — RUNNING state is
-            # fine, the future resolves when it lands.  The shed
-            # deadline applies HERE too: a replica that keeps
-            # answering queue-full must not turn the typed-rejection
-            # contract into an indefinite hang
+                on_token=req.on_token, timeout=0,
+                deadline=req.deadline)
+        except ReplicaTransportError:
+            # the submit never reached the replica (chaos flake / a
+            # real transport blip): always safe to retry — on a
+            # different replica, after the PR-2 backoff — and it
+            # counts toward the breaker (consecutive flakes open it)
+            self._breaker.record_failure(rid, "transport")
+            if twin:
+                return True
+            req.tried.add(rid)
+            req.attempts += 1
+            if req.attempts > self.reliability.retry.times:
+                waited = time.perf_counter() - req.t_enqueue
+                self._shed(req, "no_replica", waited)
+                return True
+            self._note_retry(req, rid, "transport")
+            req.not_before = time.perf_counter() + \
+                self.reliability.retry.delay_s(req.attempts)
+            return False
+        except QueueFullError:
+            # load, not sickness: no breaker count.  The registry
+            # lagged reality (replica saturated): park and re-pick
+            # next tick — RUNNING state is fine, the future resolves
+            # when it lands.  The shed deadline applies HERE too: a
+            # replica that keeps answering queue-full must not turn
+            # the typed-rejection contract into an indefinite hang
+            if twin:
+                return True
+            self._refresh(force=True)
+            waited = time.perf_counter() - req.t_enqueue
+            if waited >= self.shed_after_s:
+                self._shed(req, "no_replica", waited)
+                return True
+            return False
+        except ServerClosedError:
+            # the replica went away under us: breaker failure (this is
+            # sickness — chaos kill, crash, unannounced close), then
+            # the same park-or-shed contract as before
+            self._breaker.record_failure(rid, "closed")
+            if twin:
+                return True
             self._refresh(force=True)
             waited = time.perf_counter() - req.t_enqueue
             if waited >= self.shed_after_s:
@@ -587,14 +837,24 @@ class Router:
         except Exception as e:  # noqa: BLE001 - dispatch bug: fail the
             # one request, keep routing
             logger.exception("dispatch to replica %d failed", rid)
-            req.future.set_exception(e)
+            if not twin and not req.future.done():
+                req.future.set_exception(e)
             return True
+        self._breaker.on_dispatch(rid)
+        hedge_arm = False
         with self._lock:
             self._dispatched += 1
             self._inflight[rid] = self._inflight.get(rid, 0) + 1
             self._model_inflight[req.model] = \
                 self._model_inflight.get(req.model, 0) + 1
             n_now = self._inflight[rid]
+            req.inners[rid] = inner
+            if twin:
+                req.hedge_dispatched = True
+            else:
+                req.primary_rid = rid
+                req.t_dispatch = time.perf_counter()
+                hedge_arm = req.hedge and not req.hedge_dispatched
             if req.session is not None and not req.affinity_counted:
                 # once per DISPATCHED request — a parked request
                 # re-picked fifty times is one affinity datum, and the
@@ -604,11 +864,40 @@ class Router:
                 pref = self._ring.preference(req.session)
                 if pref and pref[0] == rid:
                     self._affine_hits += 1
+        if hedge_arm:
+            self._hedge_watch.append(req)
         self._publish_inflight(rid, n_now)
         inner.add_done_callback(
             lambda f, rid=rid, req=req: self._on_replica_done(
                 f, rid, req))
         return True
+
+    def _check_hedges(self) -> None:
+        """Dispatch the hedged twin of any watched request whose
+        primary has been silent past the p99-derived delay; first
+        completion wins, the loser is cancelled at resolution."""
+        if not self._hedge_watch:
+            return
+        now = time.perf_counter()
+        keep: List[RouterRequest] = []
+        for req in self._hedge_watch:
+            if req.future.done() or req.hedge_dispatched \
+                    or req.cancel_requested:
+                continue
+            with self._lock:
+                rec = self._records.get(req.primary_rid) or {}
+            delay = self.reliability.hedge.delay_for(
+                rec.get("ttft_p99_s", 0.0))
+            if now - req.t_dispatch < delay:
+                keep.append(req)
+                continue
+            exclude = {req.primary_rid} | set(req.inners)
+            rid, _reason = self._pick(req, exclude=exclude)
+            if rid is None:
+                keep.append(req)    # nobody to hedge to yet: re-check
+                continue
+            self._dispatch(req, rid, twin=True)
+        self._hedge_watch = keep
 
     def _on_replica_done(self, inner: Future, rid: int,
                          req: RouterRequest) -> None:
@@ -620,15 +909,182 @@ class Router:
             self._model_inflight[m] = max(
                 self._model_inflight.get(m, 1) - 1, 0)
             n_now = self._inflight.get(rid, 0)
+            req.inners.pop(rid, None)
         self._publish_inflight(rid, n_now)
         outer = req.future
-        if outer.done():
+        exc = inner.exception() if not inner.cancelled() else None
+        if inner.cancelled() or exc is not None:
+            e = exc if exc is not None else inner.exception()
+            self._on_inner_failed(inner, rid, req, e)
             return
+        # success: the breaker learns, and (hedge race) the FIRST
+        # completion wins the outer future
+        self._breaker.record_success(rid)
+        won = False
         try:
             outer.set_result(inner.result())
-        except BaseException as e:  # noqa: BLE001 - replica exception
-            # (or cancellation) belongs to the caller
-            outer.set_exception(e)
+            won = True
+        except InvalidStateError:
+            pass        # the other leg (or a shed) got there first
+        if won and req.hedge_dispatched:
+            self._note_hedge(req, rid)
+        if won:
+            self._cancel_other_legs(req, rid)
+
+    def _on_inner_failed(self, inner: Future, rid: int,
+                         req: RouterRequest, exc) -> None:
+        """An inner future failed on its engine-callback thread: box
+        it for the router thread (which owns retry/failover policy)
+        unless the router is retiring — then propagate inline."""
+        if exc is None:     # inner future was cancelled outright
+            exc = CancelledError()
+        if not isinstance(exc, (RequestCancelledError,
+                                DeadlineExceededError)) \
+                and isinstance(exc, Exception):
+            # cancels are ours (hedge loser / caller abandon) and
+            # deadline evictions are the request's own budget — only
+            # genuine replica-side failures count against the breaker
+            self._breaker.record_failure(rid, type(exc).__name__)
+        boxed = False
+        with self._fb_lock:
+            if not self._retire:
+                self._failbox.append((req, rid, exc))
+                boxed = True
+        if not boxed:
+            outer = req.future
+            if not outer.done():
+                try:
+                    outer.set_exception(exc)
+                except InvalidStateError:
+                    pass
+
+    def _drain_failbox(self, propagate_only: bool = False) -> None:
+        """Router-thread handling of replica-side failures: retry a
+        (bounded) re-dispatch on a different replica, replay a
+        mid-stream failure's salvaged tokens onto a survivor, or
+        propagate the typed error.  ``propagate_only`` (router-thread
+        exit) skips the recovery paths."""
+        while True:
+            with self._fb_lock:
+                if not self._failbox:
+                    return
+                req, rid, exc = self._failbox.popleft()
+            outer = req.future
+            if outer.done():
+                continue        # the other hedge leg already won
+            if propagate_only or not self._recoverable(exc) \
+                    or req.cancel_requested:
+                try:
+                    outer.set_exception(exc)
+                except InvalidStateError:
+                    pass
+                continue
+            req.tried.add(rid)
+            if req.emitted:
+                # mid-stream failover: fold the salvaged tokens into
+                # the prompt and replay the REMAINDER on a survivor.
+                # len(prompt)+max_new is conserved, so the replayed
+                # engine's final row [prompt+emitted | rest | pad] is
+                # byte-for-byte the uninterrupted solo row
+                req.failovers += 1
+                if req.failovers > self.reliability.retry.times + 1 \
+                        or not self.reliability.failover:
+                    try:
+                        outer.set_exception(exc)
+                    except InvalidStateError:
+                        pass
+                    continue
+                k = len(req.emitted)
+                req.prompt = np.concatenate(
+                    [req.prompt,
+                     np.asarray(req.emitted, np.int32)])
+                req.max_new_tokens -= k
+                # rebind (don't clear): the recorder closure reads
+                # req.emitted at call time, and the dead replica's
+                # engine thread has already stopped emitting
+                req.emitted = []
+                self._note_failover(req, rid, k)
+            else:
+                req.attempts += 1
+                if req.attempts > self.reliability.retry.times:
+                    try:
+                        outer.set_exception(exc)
+                    except InvalidStateError:
+                        pass
+                    continue
+                self._note_retry(req, rid, "replica_failed")
+                req.not_before = time.perf_counter() + \
+                    self.reliability.retry.delay_s(req.attempts)
+            self._waiting.append(req)
+
+    @staticmethod
+    def _recoverable(exc) -> bool:
+        """May this replica-side failure be retried / failed over?
+        Cancels and deadline evictions are the request's own verdicts;
+        validation errors are deterministic (the retry would fail
+        identically); everything replica-shaped — died, closed,
+        transport, engine fault — is recoverable."""
+        if isinstance(exc, (RequestCancelledError,
+                            DeadlineExceededError, ValueError,
+                            TypeError)):
+            return False
+        return isinstance(exc, (ReplicaDeadError, ServerClosedError,
+                                ReplicaTransportError, RuntimeError,
+                                OSError))
+
+    def _cancel_other_legs(self, req: RouterRequest,
+                           winner_rid: int) -> None:
+        """First completion won: cancel the losing hedge leg so it
+        stops burning slot-iterations on an answer already delivered."""
+        with self._lock:
+            losers = {r: f for r, f in req.inners.items()
+                      if r != winner_rid}
+            replicas = {r: self._replicas.get(r) for r in losers}
+        for r, f in losers.items():
+            rep = replicas.get(r)
+            if rep is None:
+                continue
+            try:
+                rep.cancel(f)
+            except Exception:  # noqa: BLE001 - loser cleanup is best
+                pass           # effort; the engine sweep backstops it
+
+    # ---- reliability accounting (one emission site per event kind) -------
+
+    def _note_retry(self, req: RouterRequest, rid: int,
+                    reason: str) -> None:
+        with self._lock:
+            self._retries += 1
+        _events.record_event("request_retry", replica=int(rid),
+                             reason=reason, attempt=req.attempts,
+                             model=req.model)
+        if telemetry.enabled():
+            from bigdl_tpu.telemetry import families
+            families.router_retries_total().labels(reason).inc()
+
+    def _note_failover(self, req: RouterRequest, rid: int,
+                       salvaged: int) -> None:
+        with self._lock:
+            self._failover_count += 1
+        _events.record_event("generation_failover", replica=int(rid),
+                             tokens_salvaged=int(salvaged),
+                             remaining=int(req.max_new_tokens),
+                             model=req.model)
+        if telemetry.enabled():
+            from bigdl_tpu.telemetry import families
+            families.router_retries_total().labels("failover").inc()
+
+    def _note_hedge(self, req: RouterRequest,
+                    winner_rid: int) -> None:
+        outcome = ("primary_won" if winner_rid == req.primary_rid
+                   else "hedge_won")
+        with self._lock:
+            self._hedges += 1
+        _events.record_event("request_hedge", outcome=outcome,
+                             replica=int(winner_rid), model=req.model)
+        if telemetry.enabled():
+            from bigdl_tpu.telemetry import families
+            families.router_hedges_total().labels(outcome).inc()
 
     # ---- shedding + terminal accounting ----------------------------------
 
@@ -646,12 +1102,19 @@ class Router:
         if telemetry.enabled():
             from bigdl_tpu.telemetry import families
             families.router_shed_total().labels(reason).inc()
-        exc = (RequestSheddedError(
-            f"shed after {waited_s:.3f}s: every eligible replica "
-            f"breached its SLO target") if reason == "slo"
-            else NoReplicaAvailableError(
+        if reason == "deadline":
+            # the request's own budget ran out in the queue: the typed
+            # deadline error (which ticks the per-stage metric) is the
+            # verdict, not a generic shed
+            exc = req.deadline.error("queue")
+        elif reason == "slo":
+            exc = RequestSheddedError(
+                f"shed after {waited_s:.3f}s: every eligible replica "
+                f"breached its SLO target")
+        else:
+            exc = NoReplicaAvailableError(
                 f"shed after {waited_s:.3f}s ({reason}): no eligible "
-                f"replica"))
+                f"replica")
         fut = req.future
         if fut.running():
             if not fut.done():
@@ -678,15 +1141,18 @@ class Router:
             exc = fut.exception()
             if exc is None:
                 outcome = "ok"
-            elif isinstance(exc, RequestSheddedError):
+            elif isinstance(exc, (RequestSheddedError,
+                                  DeadlineExceededError)):
                 outcome = "shed"
             elif isinstance(exc, (NoReplicaAvailableError,
-                                  ServerClosedError, QueueFullError)):
+                                  ServerClosedError, QueueFullError,
+                                  RequestCancelledError)):
                 outcome = "rejected"
             else:
                 outcome = "failed"
         with self._lock:
             self._outcomes[outcome] = self._outcomes.get(outcome, 0) + 1
+            self._req_of.pop(fut, None)
         if telemetry.enabled():
             from bigdl_tpu.telemetry import families
             families.router_requests_total().labels(outcome).inc()
@@ -722,6 +1188,12 @@ class Router:
         # benign monotonic read, and reading it inside would smuggle
         # it into the lock's guarded set
         waiting = len(self._waiting)
+        # breaker state is read BEFORE taking self._lock: the breaker
+        # has its own lock and keeping the two disjoint keeps the lock
+        # graph acyclic by construction
+        breakers = self._breaker.snapshot()
+        breakers_open = self._breaker.open_count()
+        breaker_transitions = self._breaker.transition_counts()
         with self._lock:
             return {
                 "replicas": len(self._replicas),
@@ -743,4 +1215,10 @@ class Router:
                 "slo_classes": dict(self.slo_classes),
                 "bounded_load_factor": self.bounded_load_factor,
                 "shed_after_s": self.shed_after_s,
+                "retries": self._retries,
+                "hedges": self._hedges,
+                "failovers": self._failover_count,
+                "breakers": breakers,
+                "breakers_open": breakers_open,
+                "breaker_transitions": breaker_transitions,
             }

@@ -276,16 +276,34 @@ class ModelServer:
 
     def submit_generate_async(self, prompt, max_new_tokens: int,
                               eos_id=None, on_token=None,
-                              timeout: Optional[float] = None) -> Future:
+                              timeout: Optional[float] = None,
+                              deadline=None) -> Future:
         """Admit one prompt into the continuous-batching decode engine;
         returns a Future of the full ``[Tp + max_new_tokens]`` token row
         (greedy, bit-identical to a solo ``model.generate()``).  Unlike
         one-shot inference the request is MULTI-STEP: it occupies a KV
         slot for many decode iterations, and drain waits for every
-        admitted request's last token."""
+        admitted request's last token.  ``deadline`` (a
+        :class:`~bigdl_tpu.serving.reliability.Deadline`) propagates
+        the caller's end-to-end budget into the engine."""
         return self._gen().submit_async(
             prompt, max_new_tokens, eos_id=eos_id, on_token=on_token,
-            timeout=timeout)
+            timeout=timeout, deadline=deadline)
+
+    def cancel_generate(self, fut: Future) -> bool:
+        """Best-effort cancel of a generation future — queued requests
+        drop without a slot, slot-resident ones are evicted by the
+        engine sweep (see :meth:`GenerationScheduler.cancel`)."""
+        return self._gen().cancel(fut)
+
+    # the replica plane duck-types targets on .cancel/.kill
+    cancel = cancel_generate
+
+    def kill(self, exc: Optional[Exception] = None) -> None:
+        """Hard-kill the generation engine (no drain): in-flight
+        requests fail typed so a router can fail them over."""
+        if self.generation is not None:
+            self.generation.kill(exc)
 
     def submit_generate(self, prompt, max_new_tokens: int, eos_id=None,
                         timeout: Optional[float] = None):

@@ -55,6 +55,8 @@ EVENT_KINDS = (
     "flight_recorder_dump",
     "replica_join", "replica_drain", "router_shed",
     "scale_up", "scale_down", "hot_deploy", "controller_hold",
+    "request_retry", "request_hedge", "breaker_transition",
+    "generation_failover",
 )
 
 _DEFAULT_CAPACITY = 2048

@@ -432,7 +432,49 @@ def router_shed_total() -> Counter:
         "queue overflow, oldest first), slo (every eligible replica "
         "breached its TTFT p99 target), no_replica (nothing healthy "
         "and non-draining), budget (per-model admission budget "
-        "exhausted)", labelnames=("reason",))
+        "exhausted), deadline (the request's end-to-end deadline "
+        "budget expired while it waited)", labelnames=("reason",))
+
+
+# -- request reliability (deadlines, breakers, retry/hedge) ------------------
+
+def router_retries_total() -> Counter:
+    return get_registry().counter(
+        "router_retries_total",
+        "Re-dispatches of a request to a different replica, by "
+        "reason: transport (typed submit flake — the request never "
+        "reached the replica), replica_failed (the replica failed the "
+        "request after admitting it), failover (mid-stream generation "
+        "failover — the replay of prompt+emitted onto a survivor)",
+        labelnames=("reason",))
+
+
+def router_hedges_total() -> Counter:
+    return get_registry().counter(
+        "router_hedges_total",
+        "Hedged dispatches (a duplicate sent to a second replica "
+        "after the p99-derived delay), by outcome: primary_won, "
+        "hedge_won (the duplicate finished first; the loser was "
+        "cancelled)", labelnames=("outcome",))
+
+
+def router_breaker_transitions_total() -> Counter:
+    return get_registry().counter(
+        "router_breaker_transitions_total",
+        "Per-replica circuit-breaker state transitions, by "
+        "destination state: open (consecutive submit failures or "
+        "stale health snapshots), half_open (open window elapsed; "
+        "probe traffic admitted), closed (a probe succeeded)",
+        labelnames=("to",))
+
+
+def request_deadline_exceeded_total() -> Counter:
+    return get_registry().counter(
+        "request_deadline_exceeded_total",
+        "Requests rejected because their end-to-end deadline budget "
+        "ran out, by pipeline stage: queue (before a slot was "
+        "spent), prefill, decode (evicted mid-stream by the engine "
+        "sweep)", labelnames=("stage",))
 
 
 # ---- fleet controller (autoscaler + continuous deployment, fleet/) --------
@@ -502,6 +544,8 @@ _PREREGISTER = (
     generation_prefix_cache_resident_bytes,
     generation_prefill_dedup_total,
     router_requests_total, router_replica_inflight, router_shed_total,
+    router_retries_total, router_hedges_total,
+    router_breaker_transitions_total, request_deadline_exceeded_total,
     fleet_replicas_desired, fleet_replicas_live,
     fleet_scale_events_total, fleet_deploy_freshness_seconds,
 )

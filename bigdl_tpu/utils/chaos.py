@@ -47,6 +47,22 @@ or by environment variables (picked up lazily on the first hook call, so
   submissions, and drains its already-admitted requests in the
   background, so the fleet controller's replace-the-dead path is
   provable without killing a real process.  Fires once.
+* ``BIGDL_TPU_CHAOS_KILL_MODE`` — ``drain`` (default, the SIGTERM
+  shape above) or ``hard`` (the SIGKILL shape: nothing drains,
+  slot-resident requests fail typed mid-decode — the fault the
+  router's mid-stream generation failover is proven against).
+* ``BIGDL_TPU_CHAOS_SLOW_REPLICA`` — ``"<seconds>"`` or
+  ``"<seconds>:<replica_id>"``: add this much latency to every
+  request submitted to one serving replica (the id given, else all) —
+  a straggling frontend, the fault hedged dispatch exists for.
+* ``BIGDL_TPU_CHAOS_FLAKY_SUBMIT`` — ``"<p>"`` or
+  ``"<p>:<replica_id>"``: each submit to the replica raises a typed
+  transport error with probability ``p`` (seeded by
+  ``BIGDL_TPU_CHAOS_SEED``) — a flaky network path, the fault the
+  router's circuit breaker opens on.
+  ``BIGDL_TPU_CHAOS_FLAKY_SUBMIT_COUNT`` bounds how many submits
+  flake in total (default: unbounded), so a breaker-recovery test
+  can let the replica heal.
 * ``BIGDL_TPU_CHAOS_RESHARD`` — ``"<step>:<width>"``: raise
   :class:`ReshardInjected` carrying the new width when training
   reaches ``step`` (once) — a lost slice whose fleet regrants capacity
@@ -74,7 +90,7 @@ from typing import List, Optional
 __all__ = ["FaultInjected", "ReshardInjected", "ChaosController",
            "install", "reset", "active", "on_step", "on_io_write",
            "on_checkpoint_payload", "on_data_batch",
-           "on_replica_publish"]
+           "on_replica_publish", "on_replica_submit"]
 
 logger = logging.getLogger("bigdl_tpu.chaos")
 
@@ -118,7 +134,13 @@ class ChaosController:
                  reshard_at_step: Optional[int] = None,
                  reshard_to=None,
                  kill_replica_after_s: Optional[float] = None,
-                 kill_replica_id: Optional[int] = None):
+                 kill_replica_id: Optional[int] = None,
+                 kill_replica_mode: str = "drain",
+                 slow_replica_s: float = 0.0,
+                 slow_replica_id: Optional[int] = None,
+                 flaky_submit_p: float = 0.0,
+                 flaky_replica_id: Optional[int] = None,
+                 flaky_submit_count: Optional[int] = None):
         self.fail_at_step = fail_at_step
         self.oom_at_step = oom_at_step
         if (reshard_at_step is None) != (reshard_to is None):
@@ -135,6 +157,23 @@ class ChaosController:
             else float(kill_replica_after_s))
         self.kill_replica_id = (None if kill_replica_id is None
                                 else int(kill_replica_id))
+        if kill_replica_mode not in ("drain", "hard"):
+            raise ValueError(
+                f"kill_replica_mode must be 'drain' or 'hard', got "
+                f"{kill_replica_mode!r}")
+        self.kill_replica_mode = kill_replica_mode
+        self.slow_replica_s = float(slow_replica_s)
+        self.slow_replica_id = (None if slow_replica_id is None
+                                else int(slow_replica_id))
+        if not 0.0 <= float(flaky_submit_p) <= 1.0:
+            raise ValueError(
+                f"flaky_submit_p must be in [0, 1], got "
+                f"{flaky_submit_p}")
+        self.flaky_submit_p = float(flaky_submit_p)
+        self.flaky_replica_id = (None if flaky_replica_id is None
+                                 else int(flaky_replica_id))
+        self.flaky_submit_count = (None if flaky_submit_count is None
+                                   else int(flaky_submit_count))
         # the kill clock starts at arm time (perf_counter: a duration
         # within one process, never compared across processes)
         self._armed_pc = time.perf_counter()
@@ -145,6 +184,8 @@ class ChaosController:
         self._lock = threading.Lock()
         self.checkpoint_writes = 0
         self.stalled_batches = 0
+        self.slowed_submits = 0
+        self.flaked_submits = 0
         self.events: List[str] = []
 
     def _fire(self, what: str) -> None:
@@ -210,13 +251,15 @@ class ChaosController:
                        f"{self.stall_pipeline_s}s per batch")
         time.sleep(self.stall_pipeline_s)
 
-    def on_replica_publish(self, replica_id: int) -> bool:
-        """Called from each replica's snapshot publish.  Returns True
-        exactly once — the moment the armed kill fires for this
-        replica (the id given at arm time, else whoever publishes
-        first past the deadline); the replica reacts by dying the
-        SIGTERM way (stop publishing, refuse new work, drain admitted
-        work in the background)."""
+    def on_replica_publish(self, replica_id: int):
+        """Called from each replica's snapshot publish.  Returns the
+        kill mode (``"drain"`` — SIGTERM-style: stop publishing,
+        refuse new work, drain admitted work in the background — or
+        ``"hard"`` — SIGKILL-style: nothing drains, slot-resident
+        requests fail typed) exactly once, the moment the armed kill
+        fires for this replica (the id given at arm time, else
+        whoever publishes first past the deadline); False
+        otherwise."""
         with self._lock:
             if self.kill_replica_after_s is None:
                 return False
@@ -228,8 +271,50 @@ class ChaosController:
                 return False
             self.kill_replica_after_s = None  # one-shot: the fleet
             # controller's replacement must come up and stay up
-        self._fire(f"killed replica {int(replica_id)}")
-        return True
+            mode = self.kill_replica_mode
+        self._fire(f"killed replica {int(replica_id)} ({mode})")
+        return mode
+
+    def on_replica_submit(self, replica_id: int):
+        """Called at the replica boundary for every submitted request;
+        returns ``(delay_s, flake)`` — how long the submit should
+        stall, and whether it should fail with a typed transport
+        error.  Each fault records ONE flight-recorder event per
+        campaign (on its first injection), not one per request."""
+        delay = 0.0
+        flake = False
+        fire_slow = fire_flake = False
+        rid = int(replica_id)
+        if self.slow_replica_s > 0.0 \
+                and (self.slow_replica_id is None
+                     or rid == self.slow_replica_id):
+            delay = self.slow_replica_s
+            with self._lock:
+                self.slowed_submits += 1
+                fire_slow = self.slowed_submits == 1
+        if self.flaky_submit_p > 0.0 \
+                and (self.flaky_replica_id is None
+                     or rid == self.flaky_replica_id):
+            with self._lock:
+                budget_left = (
+                    self.flaky_submit_count is None
+                    or self.flaked_submits < self.flaky_submit_count)
+                if budget_left \
+                        and self._rng.random() < self.flaky_submit_p:
+                    self.flaked_submits += 1
+                    fire_flake = self.flaked_submits == 1
+                    flake = True
+        if fire_slow:
+            who = ("all replicas" if self.slow_replica_id is None
+                   else f"replica {self.slow_replica_id}")
+            self._fire(f"slowing submits to {who} by "
+                       f"{self.slow_replica_s}s each")
+        if fire_flake:
+            who = ("all replicas" if self.flaky_replica_id is None
+                   else f"replica {self.flaky_replica_id}")
+            self._fire(f"flaking submits to {who} with "
+                       f"p={self.flaky_submit_p}")
+        return delay, flake
 
     def on_checkpoint_payload(self, path: str) -> None:
         """Called after a checkpoint payload is durably on disk, before
@@ -266,7 +351,9 @@ _env_checked = False
 _ENV_KEYS = ("BIGDL_TPU_CHAOS_FAIL_STEP", "BIGDL_TPU_CHAOS_CRASH_CKPT",
              "BIGDL_TPU_CHAOS_TRUNCATE_CKPT", "BIGDL_TPU_CHAOS_IO_FAIL_P",
              "BIGDL_TPU_CHAOS_STALL_PIPELINE_S", "BIGDL_TPU_CHAOS_OOM",
-             "BIGDL_TPU_CHAOS_RESHARD", "BIGDL_TPU_CHAOS_KILL_REPLICA")
+             "BIGDL_TPU_CHAOS_RESHARD", "BIGDL_TPU_CHAOS_KILL_REPLICA",
+             "BIGDL_TPU_CHAOS_SLOW_REPLICA",
+             "BIGDL_TPU_CHAOS_FLAKY_SUBMIT")
 
 
 def _parse_reshard(v: Optional[str]):
@@ -300,6 +387,26 @@ def _parse_kill_replica(v: Optional[str]):
             f"'<seconds>:<replica_id>' (e.g. '0.5:3'), got {v!r}") from e
 
 
+def _parse_value_replica(v: Optional[str], env_name: str,
+                         what: str):
+    """``"<value>"`` or ``"<value>:<replica_id>"`` ->
+    (value, replica_id-or-None) — the shared shape of the
+    slow-replica and flaky-submit seams; malformed values raise at
+    arm time, not at fire time."""
+    if not v:
+        return 0.0, None
+    try:
+        if ":" in v:
+            val, rid = v.split(":", 1)
+            return float(val), int(rid)
+        return float(v), None
+    except ValueError as e:
+        raise ValueError(
+            f"{env_name} must be '<{what}>' or "
+            f"'<{what}>:<replica_id>' (e.g. '0.25:3'), got "
+            f"{v!r}") from e
+
+
 def _from_env() -> Optional[ChaosController]:
     e = os.environ
     if not any(e.get(k) for k in _ENV_KEYS):
@@ -313,6 +420,12 @@ def _from_env() -> Optional[ChaosController]:
         e.get("BIGDL_TPU_CHAOS_RESHARD"))
     kill_after, kill_id = _parse_kill_replica(
         e.get("BIGDL_TPU_CHAOS_KILL_REPLICA"))
+    slow_s, slow_id = _parse_value_replica(
+        e.get("BIGDL_TPU_CHAOS_SLOW_REPLICA"),
+        "BIGDL_TPU_CHAOS_SLOW_REPLICA", "seconds")
+    flaky_p, flaky_id = _parse_value_replica(
+        e.get("BIGDL_TPU_CHAOS_FLAKY_SUBMIT"),
+        "BIGDL_TPU_CHAOS_FLAKY_SUBMIT", "probability")
     return ChaosController(
         fail_at_step=_i("BIGDL_TPU_CHAOS_FAIL_STEP"),
         crash_checkpoint=_i("BIGDL_TPU_CHAOS_CRASH_CKPT"),
@@ -325,7 +438,12 @@ def _from_env() -> Optional[ChaosController]:
             "BIGDL_TPU_CHAOS_STALL_PIPELINE_BATCHES"),
         oom_at_step=_i("BIGDL_TPU_CHAOS_OOM"),
         reshard_at_step=reshard_step, reshard_to=reshard_to,
-        kill_replica_after_s=kill_after, kill_replica_id=kill_id)
+        kill_replica_after_s=kill_after, kill_replica_id=kill_id,
+        kill_replica_mode=(
+            e.get("BIGDL_TPU_CHAOS_KILL_MODE") or "drain"),
+        slow_replica_s=slow_s, slow_replica_id=slow_id,
+        flaky_submit_p=flaky_p, flaky_replica_id=flaky_id,
+        flaky_submit_count=_i("BIGDL_TPU_CHAOS_FLAKY_SUBMIT_COUNT"))
 
 
 def install(**kwargs) -> ChaosController:
@@ -375,6 +493,14 @@ def on_data_batch() -> None:
         c.on_data_batch()
 
 
-def on_replica_publish(replica_id: int) -> bool:
+def on_replica_publish(replica_id: int):
     c = active()
     return c.on_replica_publish(replica_id) if c is not None else False
+
+
+def on_replica_submit(replica_id: int):
+    """(delay_s, flake) for one submit to ``replica_id`` — (0.0,
+    False) when no chaos is armed."""
+    c = active()
+    return (c.on_replica_submit(replica_id) if c is not None
+            else (0.0, False))

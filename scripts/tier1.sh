@@ -129,4 +129,14 @@ if bash "$(dirname "$0")/controller_smoke.sh" >"$controller_log" 2>&1; then
 else
   echo "controller_smoke: FAILED (non-fatal ride-along; see $controller_log)"
 fi
+# request-reliability smoke (chaos hard-kill mid-decode -> failover
+# with bit-identical stitched stream; flaky submits -> breaker opens
+# -> half-open recovery): warn-only ride-along; run
+# scripts/reliability_smoke.sh standalone for the fatal form
+reliability_log=$(mktemp /tmp/reliability_smoke.XXXXXX.log)
+if bash "$(dirname "$0")/reliability_smoke.sh" >"$reliability_log" 2>&1; then
+  tail -n 1 "$reliability_log"
+else
+  echo "reliability_smoke: FAILED (non-fatal ride-along; see $reliability_log)"
+fi
 exit $rc

@@ -1,0 +1,613 @@
+"""Request-reliability layer tests (serving/reliability.py wired
+through serving/router.py): deadline propagation with stage-stamped
+typed rejection, per-replica circuit breakers (failure AND staleness
+channels, half-open probe recovery), bounded retry with the PR-2
+backoff shape, hedged dispatch with first-completion-wins, and
+mid-stream generation failover.
+
+The load-bearing assertions: (a) a replica hard-killed mid-decode
+loses NOTHING — the failed-over stream's final row is bit-identical to
+an uninterrupted solo ``generate()`` and every streamed token is
+delivered exactly once; (b) a flaked submit retries on a DIFFERENT
+replica and the answer is still bit-identical; (c) the breaker opens
+on submit failures in milliseconds — strictly inside the fleet
+controller's ``dead_after_polls`` registry window; (d) a caller that
+abandons a request frees its engine slot (no slot leak)."""
+
+import ast
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from bigdl_tpu.models import transformer_lm
+from bigdl_tpu.serving import (
+    CircuitBreaker, Deadline, DeadlineExceededError, HedgePolicy,
+    ModelServer, ReliabilityPolicy, Replica, RetryPolicy, Router,
+)
+from bigdl_tpu.telemetry import events
+from bigdl_tpu.utils import chaos, set_seed
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _no_chaos():
+    yield
+    chaos.reset()
+
+
+@pytest.fixture(scope="module")
+def lm():
+    set_seed(0)
+    return transformer_lm(vocab_size=50, hidden_size=32, num_layers=2,
+                          num_heads=4, filter_size=64,
+                          max_len=64).eval_mode()
+
+
+def solo(model, prompt, max_new, eos_id=None):
+    import jax.numpy as jnp
+    return np.asarray(model.generate(
+        jnp.asarray(prompt, jnp.int32)[None], int(max_new),
+        eos_id=eos_id))[0]
+
+
+def _replica(lm, rid, d, slots=2, interval=0.05, **server_kw):
+    return Replica(rid, ModelServer(generator=lm, slots=slots,
+                                    **server_kw),
+                   snapshot_dir=d, publish_interval_s=interval)
+
+
+def _wait(cond, timeout=30.0, msg="condition"):
+    deadline = time.perf_counter() + timeout
+    while not cond():
+        if time.perf_counter() > deadline:
+            raise TimeoutError(f"{msg} not reached in {timeout}s")
+        time.sleep(0.01)
+
+
+# ---------------------------------------------------------------------------
+# deadlines (pure, injected time)
+# ---------------------------------------------------------------------------
+
+def test_deadline_expiry_against_injected_time():
+    d = Deadline(0.5, now=100.0)
+    assert not d.expired(now=100.4)
+    assert d.remaining(now=100.4) == pytest.approx(0.1)
+    assert d.expired(now=100.5)
+    assert d.expired(now=101.0)
+    err = d.error("decode", now=100.7)
+    assert isinstance(err, DeadlineExceededError)
+    assert err.stage == "decode"
+    assert "decode" in str(err)
+
+
+def test_deadline_rejects_nonpositive_budget():
+    with pytest.raises(ValueError):
+        Deadline(0.0)
+    with pytest.raises(ValueError):
+        Deadline(-1.0)
+
+
+# ---------------------------------------------------------------------------
+# retry / hedge policy (pure)
+# ---------------------------------------------------------------------------
+
+def test_retry_policy_pr2_backoff_shape():
+    # jitter=0 makes the schedule exact: interval + backoff doubling,
+    # capped — the set_failure_retry knob shape
+    p = RetryPolicy(times=3, interval_s=0.1, backoff_s=0.05,
+                    backoff_cap_s=0.15, jitter=0.0)
+    assert p.delay_s(1) == pytest.approx(0.15)   # 0.1 + 0.05
+    assert p.delay_s(2) == pytest.approx(0.20)   # 0.1 + 0.10
+    assert p.delay_s(3) == pytest.approx(0.25)   # 0.1 + cap(0.20)=0.15
+    assert p.delay_s(9) == pytest.approx(0.25)   # stays capped
+
+
+def test_retry_policy_jitter_bounds_and_validation():
+    p = RetryPolicy(times=2, interval_s=0.0, backoff_s=0.1,
+                    backoff_cap_s=1.0, jitter=0.5, seed=7)
+    for attempt in (1, 2, 3):
+        base = min(0.1 * 2 ** (attempt - 1), 1.0)
+        for _ in range(20):
+            d = p.delay_s(attempt)
+            assert base * 0.5 - 1e-9 <= d <= base * 1.5 + 1e-9
+    with pytest.raises(ValueError):
+        RetryPolicy(times=-1)
+
+
+def test_hedge_policy_delay_derivation():
+    assert HedgePolicy(after_s=0.25).delay_for(10.0) == 0.25
+    h = HedgePolicy(p99_factor=2.0, floor_s=0.05)
+    assert h.delay_for(0.3) == pytest.approx(0.6)
+    # a cold replica (p99==0) must not hedge instantly
+    assert h.delay_for(0.0) == pytest.approx(0.05)
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker (pure, injected time)
+# ---------------------------------------------------------------------------
+
+def test_breaker_opens_on_consecutive_failures_and_probes_back():
+    cb = CircuitBreaker(failure_threshold=3, open_s=1.0,
+                        probe_budget=1)
+    cb.record_failure(0, "submit", now=0.0)
+    cb.record_failure(0, "submit", now=0.1)
+    assert cb.state(0) == "closed" and cb.routable(0, now=0.2)
+    cb.record_failure(0, "submit", now=0.2)
+    assert cb.state(0) == "open"
+    assert not cb.routable(0, now=0.5)
+    # open_s elapsed: the first routing decision flips to half-open
+    assert cb.routable(0, now=1.3)
+    assert cb.state(0) == "half_open"
+    cb.on_dispatch(0)               # the probe is in flight
+    assert not cb.routable(0, now=1.4)  # budget spent: hold the rest
+    cb.record_success(0, now=1.5)
+    assert cb.state(0) == "closed"
+    assert cb.routable(0, now=1.6)
+    tc = cb.transition_counts()
+    assert tc.get("open") == 1 and tc.get("half_open") == 1 \
+        and tc.get("closed") == 1
+
+
+def test_breaker_success_resets_failure_streak():
+    cb = CircuitBreaker(failure_threshold=3)
+    cb.record_failure(1, now=0.0)
+    cb.record_failure(1, now=0.1)
+    cb.record_success(1, now=0.2)
+    cb.record_failure(1, now=0.3)
+    cb.record_failure(1, now=0.4)
+    assert cb.state(1) == "closed"  # CONSECUTIVE failures, not total
+
+
+def test_breaker_half_open_probe_failure_reopens():
+    cb = CircuitBreaker(failure_threshold=1, open_s=0.5)
+    cb.record_failure(0, now=0.0)
+    assert cb.state(0) == "open"
+    assert cb.routable(0, now=1.0)          # half-open
+    cb.on_dispatch(0)
+    cb.record_failure(0, "probe", now=1.1)
+    assert cb.state(0) == "open"
+    assert not cb.routable(0, now=1.2)      # new open_s window
+
+def test_breaker_staleness_channel_and_healthy_retraction():
+    cb = CircuitBreaker(failure_threshold=3, stale_threshold=2,
+                        open_s=60.0)
+    cb.note_unhealthy(0, now=0.0)
+    assert cb.state(0) == "closed"
+    cb.note_unhealthy(0, now=0.1)
+    assert cb.state(0) == "open"
+    # the health plane retracting its own verdict needs no probe
+    cb.note_healthy(0, now=0.2)
+    assert cb.state(0) == "closed" and cb.routable(0, now=0.3)
+    # but a FAILURE-opened breaker is not closed by healthy snapshots:
+    # a replica can publish healthy while flaking every submit
+    for i in range(3):
+        cb.record_failure(0, now=0.4 + i * 0.01)
+    assert cb.state(0) == "open"
+    cb.note_healthy(0, now=0.5)
+    assert cb.state(0) == "open"
+
+
+def test_breaker_forget_and_snapshot():
+    cb = CircuitBreaker(failure_threshold=1)
+    cb.record_failure(3, now=0.0)
+    assert cb.open_count() == 1
+    snap = cb.snapshot()
+    assert snap[3]["state"] == "open" and snap[3]["failures"] == 1
+    cb.forget(3)
+    assert cb.open_count() == 0 and cb.state(3) == "closed"
+
+
+def test_breaker_transitions_land_in_flight_recorder():
+    events.reset_events()
+    cb = CircuitBreaker(failure_threshold=1, open_s=0.1)
+    cb.record_failure(7, "submit", now=0.0)
+    assert cb.routable(7, now=1.0)
+    cb.on_dispatch(7)
+    cb.record_success(7, now=1.1)
+    recs = [e for e in events.recent_events()
+            if e["kind"] == "breaker_transition"]
+    assert [r["to"] for r in recs] == ["open", "half_open", "closed"]
+    assert all(r["replica"] == 7 for r in recs)
+
+
+def test_breaker_opens_inside_controller_dead_window():
+    """The breaker's whole point: it must fire BEFORE the fleet
+    controller's dead-replica sweep.  Submit failures open it at
+    failure_threshold dispatches (milliseconds); staleness opens it at
+    stale_threshold registry polls — structurally <= the controller's
+    dead_after_polls default, so the router stops routing to a corpse
+    while the controller is still confirming the death."""
+    from bigdl_tpu.fleet.policy import PoolSpec
+    pol = ReliabilityPolicy()
+    assert pol.stale_threshold <= PoolSpec().dead_after_polls
+
+
+def test_reliability_policy_budget_per_model():
+    pol = ReliabilityPolicy(deadline_budget_s=2.0,
+                            deadline_budgets={"fast": 0.5})
+    assert pol.budget_for("fast") == 0.5
+    assert pol.budget_for("default") == 2.0
+    assert ReliabilityPolicy().budget_for("default") is None
+
+
+# ---------------------------------------------------------------------------
+# emission-site discipline (AST)
+# ---------------------------------------------------------------------------
+
+def _record_event_literals():
+    sites = {}
+    for root, _dirs, files in os.walk(os.path.join(REPO, "bigdl_tpu")):
+        for name in files:
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(root, name)
+            with open(path, encoding="utf-8") as f:
+                src = f.read()
+            if "record_event" not in src:
+                continue
+            for node in ast.walk(ast.parse(src)):
+                if isinstance(node, ast.Call) \
+                        and getattr(node.func, "attr",
+                                    getattr(node.func, "id", None)) \
+                        == "record_event" \
+                        and node.args \
+                        and isinstance(node.args[0], ast.Constant) \
+                        and isinstance(node.args[0].value, str):
+                    sites.setdefault(node.args[0].value, []).append(
+                        os.path.relpath(path, REPO))
+    return sites
+
+
+def test_reliability_kinds_have_exactly_one_emission_site():
+    sites = _record_event_literals()
+    for kind in ("request_retry", "request_hedge",
+                 "breaker_transition", "generation_failover"):
+        assert kind in events.EVENT_KINDS
+        assert len(sites.get(kind, [])) == 1, \
+            f"{kind} must have exactly one emission site, " \
+            f"got {sites.get(kind)}"
+
+
+# ---------------------------------------------------------------------------
+# integration: retries, breakers, deadlines through the fabric
+# ---------------------------------------------------------------------------
+
+def test_flaky_submit_retries_on_other_replica(lm, tmp_path):
+    """chaos.flaky_submit_p on replica 0: the transport error never
+    reaches the engine, the retry lands on replica 1, the answer is
+    bit-identical, and the campaign records ONE chaos event."""
+    d = str(tmp_path)
+    events.reset_events()
+    chaos.install(flaky_submit_p=1.0, flaky_replica_id=0)
+    prompt = np.array([5, 9, 2, 7], np.int32)
+    rel = ReliabilityPolicy(
+        retry=RetryPolicy(times=3, backoff_s=0.01, backoff_cap_s=0.05,
+                          jitter=0.0))
+    with Router([_replica(lm, 0, d), _replica(lm, 1, d)],
+                snapshot_dir=d, registry_max_age_s=5.0, shed_after_s=20.0,
+                reliability=rel) as router:
+        _wait(lambda: sum(
+            1 for r in router.records().values() if r["healthy"]) == 2,
+            msg="both replicas healthy")
+        out = router.submit_generate(prompt, 8, timeout=60.0)
+        np.testing.assert_array_equal(out, solo(lm, prompt, 8))
+        st = router.stats()
+        assert st["retries"] >= 1
+        assert st["outcomes"].get("ok", 0) == 1
+    kinds = events.event_counts()
+    assert kinds.get("request_retry", 0) >= 1
+    ctl = chaos.active()
+    assert ctl.flaked_submits >= 1
+    assert sum("flaking submits" in e for e in ctl.events) == 1
+
+
+def test_flaky_submit_opens_breaker_then_half_open_recovery(lm, tmp_path):
+    """A single-replica fabric whose submits flake exactly twice:
+    failure_threshold=2 opens the breaker (traffic holds), open_s
+    later the half-open probe goes through (the flake budget is
+    spent), succeeds, and closes the breaker — the full state-machine
+    loop against real dispatch."""
+    d = str(tmp_path)
+    chaos.install(flaky_submit_p=1.0, flaky_replica_id=0,
+                  flaky_submit_count=2)
+    prompt = np.array([3, 1, 4], np.int32)
+    rel = ReliabilityPolicy(
+        retry=RetryPolicy(times=6, backoff_s=0.01, backoff_cap_s=0.05,
+                          jitter=0.0),
+        failure_threshold=2, open_s=0.3)
+    with Router([_replica(lm, 0, d)], snapshot_dir=d, registry_max_age_s=5.0,
+                shed_after_s=30.0, reliability=rel) as router:
+        _wait(lambda: any(
+            r["healthy"] for r in router.records().values()),
+            msg="replica healthy")
+        out = router.submit_generate(prompt, 6, timeout=60.0)
+        np.testing.assert_array_equal(out, solo(lm, prompt, 6))
+        st = router.stats()
+        assert st["retries"] >= 2
+        tc = st["breaker_transitions"]
+        assert tc.get("open", 0) >= 1, tc
+        assert tc.get("half_open", 0) >= 1, tc
+        assert tc.get("closed", 0) >= 1, tc
+        assert st["breakers"][0]["state"] == "closed"
+        assert st["breakers_open"] == 0
+
+
+def test_deadline_expires_in_queue_typed_and_staged(lm, tmp_path):
+    """No routable replica + a 50ms budget: the request is rejected
+    with the stage-stamped typed error, not a generic shed and not a
+    hang."""
+    d = str(tmp_path)
+    with Router([], snapshot_dir=d, shed_after_s=30.0) as router:
+        fut = router.submit_generate_async(
+            np.array([1, 2, 3], np.int32), 4, deadline_s=0.05)
+        with pytest.raises(DeadlineExceededError) as ei:
+            fut.result(timeout=10.0)
+        assert ei.value.stage == "queue"
+        st = router.stats()
+        assert st["shed_reasons"].get("deadline", 0) == 1
+        assert st["outcomes"].get("shed", 0) == 1
+
+
+def test_deadline_expires_mid_generation_and_frees_slot(lm):
+    """A budget that expires after decode begins: the engine sweep
+    evicts the request with stage prefill/decode (not queue) and the
+    slot is reusable immediately after."""
+    server = ModelServer(generator=lm, slots=1)
+    try:
+        prompt = np.array([2, 4, 6, 8], np.int32)
+        started = threading.Event()
+
+        def slow_stream(_tok):
+            # pace the decode loop so the 0.25s budget reliably dies
+            # mid-decode instead of racing a fast machine to the end
+            started.set()
+            time.sleep(0.05)
+
+        fut = server.submit_generate_async(
+            prompt, 50, on_token=slow_stream, deadline=Deadline(0.25))
+        started.wait(20.0)
+        with pytest.raises(DeadlineExceededError) as ei:
+            fut.result(timeout=30.0)
+        assert ei.value.stage in ("prefill", "decode")
+        # the evicted request's slot must be free for the next one
+        out = server.submit_generate(prompt, 6, timeout=30.0)
+        np.testing.assert_array_equal(out, solo(lm, prompt, 6))
+    finally:
+        server.shutdown(drain=False, timeout=10.0)
+
+
+def test_abandoned_request_frees_slot(lm):
+    """The slot-leak regression: a caller whose submit_generate times
+    out walks away — the timeout must propagate into an engine cancel
+    so the slot frees within a few iterations, instead of decoding to
+    completion for nobody.
+
+    A filler stream paces the engine loop at >=50ms per iteration (its
+    on_token sleeps on the engine thread), so the abandoned 50-token
+    victim would hold its slot >=2.5s if leaked.  With slots=2 (filler
+    + victim own both), a third request admits quickly ONLY if the
+    victim's slot actually freed — the timing assertion detects the
+    leak with seconds of margin."""
+    from concurrent.futures import TimeoutError as FuturesTimeout
+    server = ModelServer(generator=lm, slots=2)
+    try:
+        filler_started = threading.Event()
+
+        def pace(_tok):
+            filler_started.set()
+            time.sleep(0.05)
+
+        filler = server.submit_generate_async(
+            np.array([9, 9, 9], np.int32), 60, on_token=pace)
+        assert filler_started.wait(30.0)
+        prompt = np.array([7, 3, 1, 9], np.int32)
+        with pytest.raises(FuturesTimeout):
+            server.submit_generate(prompt, 50, timeout=0.3)
+        t0 = time.perf_counter()
+        out = server.submit_generate(prompt, 2, timeout=30.0)
+        elapsed = time.perf_counter() - t0
+        np.testing.assert_array_equal(out, solo(lm, prompt, 2))
+        # leaked: the victim keeps its slot for the remaining ~45 paced
+        # iterations (>2s) and the third request queues behind it
+        assert elapsed < 1.5, \
+            f"slot not reused promptly ({elapsed:.2f}s): leak"
+        server.cancel_generate(filler)
+    finally:
+        server.shutdown(drain=False, timeout=10.0)
+
+
+def test_router_client_timeout_cancels_through_fabric(lm, tmp_path):
+    """Router.submit_generate(timeout=...) abandonment reaches the
+    engine: the inner request is cancelled (slot freed), and the
+    fabric still serves the next request promptly."""
+    from concurrent.futures import TimeoutError as FuturesTimeout
+    d = str(tmp_path)
+    prompt = np.array([1, 5, 9], np.int32)
+    with Router([_replica(lm, 0, d, slots=1)], snapshot_dir=d, registry_max_age_s=5.0,
+                shed_after_s=20.0) as router:
+        _wait(lambda: any(
+            r["healthy"] for r in router.records().values()),
+            msg="replica healthy")
+        with pytest.raises(FuturesTimeout):
+            router.submit_generate(prompt, 50, timeout=0.05)
+        out = router.submit_generate(prompt, 5, timeout=60.0)
+        np.testing.assert_array_equal(out, solo(lm, prompt, 5))
+
+
+# ---------------------------------------------------------------------------
+# integration: mid-stream failover + hedging
+# ---------------------------------------------------------------------------
+
+def test_midstream_failover_bit_identical(lm, tmp_path):
+    """THE failover contract: a replica hard-killed mid-decode loses
+    nothing — the router replays prompt+emitted onto the survivor, the
+    final row is bit-identical to an uninterrupted solo generate, and
+    the streamed tokens arrive exactly once each."""
+    d = str(tmp_path)
+    events.reset_events()
+    prompt = np.array([4, 8, 15, 16, 23], np.int32)
+    max_new = 20
+    expect = solo(lm, prompt, max_new)
+    got = []
+    seen3 = threading.Event()
+
+    def on_token(t):
+        got.append(int(t))
+        if len(got) >= 3:
+            seen3.set()
+
+    rel = ReliabilityPolicy(
+        retry=RetryPolicy(times=2, backoff_s=0.01, backoff_cap_s=0.05,
+                          jitter=0.0))
+    with Router([_replica(lm, 0, d), _replica(lm, 1, d)],
+                snapshot_dir=d, registry_max_age_s=5.0, shed_after_s=30.0,
+                reliability=rel) as router:
+        _wait(lambda: sum(
+            1 for r in router.records().values() if r["healthy"]) == 2,
+            msg="both replicas healthy")
+        fut = router.submit_generate_async(prompt, max_new,
+                                           on_token=on_token)
+        assert seen3.wait(60.0), "stream never started"
+        # find where it landed and kill that replica HARD (no drain:
+        # slot-resident requests fail typed)
+        inflight = router.stats()["inflight"]
+        primary = next(rid for rid, n in inflight.items() if n > 0)
+        router.replica(primary).kill()
+        row = fut.result(timeout=120.0)
+        np.testing.assert_array_equal(row, expect)
+        st = router.stats()
+        assert st["failovers"] >= 1
+        assert st["outcomes"].get("ok", 0) == 1
+    # the stitched stream: every generated token exactly once, in order
+    assert got == list(expect[len(prompt):])
+    assert events.event_counts().get("generation_failover", 0) >= 1
+
+
+def test_hedged_dispatch_first_completion_wins(lm, tmp_path):
+    """Primary lands on a replica whose slots are wedged behind long
+    decodes; after the hedge delay the twin goes to the idle replica
+    and the first completion resolves the caller — bit-identical
+    either way, exactly one hedge counted."""
+    d = str(tmp_path)
+    events.reset_events()
+    srv0 = ModelServer(generator=lm, slots=2)
+    r0 = Replica(0, srv0, snapshot_dir=d, publish_interval_s=0.05)
+    r1 = _replica(lm, 1, d)
+    prompt = np.array([6, 2, 9], np.int32)
+    rel = ReliabilityPolicy(
+        retry=RetryPolicy(times=2, backoff_s=0.01, jitter=0.0),
+        hedge=HedgePolicy(enabled=True, after_s=0.1))
+    with Router([r0, r1], snapshot_dir=d, registry_max_age_s=5.0, shed_after_s=30.0,
+                reliability=rel) as router:
+        _wait(lambda: sum(
+            1 for r in router.records().values() if r["healthy"]) == 2,
+            msg="both replicas healthy")
+        # pick a session whose ring home is replica 0, then wedge 0
+        session = next(s for s in (f"s{i}" for i in range(64))
+                       if router._ring.preference(s)[0] == 0)
+        fillers = [srv0.submit_generate_async(
+            np.array([1, 1, 1, i], np.int32), 45) for i in range(2)]
+        fut = router.submit_generate_async(prompt, 8, session=session)
+        row = fut.result(timeout=120.0)
+        np.testing.assert_array_equal(row, solo(lm, prompt, 8))
+        _wait(lambda: router.stats()["hedges"] >= 1, timeout=60.0,
+              msg="hedge resolution")
+        st = router.stats()
+        assert st["hedges"] == 1
+        for f in fillers:
+            f.result(timeout=120.0)
+    recs = [e for e in events.recent_events()
+            if e["kind"] == "request_hedge"]
+    assert len(recs) == 1
+    assert recs[0]["outcome"] in ("primary_won", "hedge_won")
+
+
+def test_slow_replica_chaos_fires_one_event(lm, tmp_path):
+    """chaos.slow_replica_s stalls every submit by the given delay and
+    records ONE flight-recorder event for the whole campaign."""
+    d = str(tmp_path)
+    chaos.install(slow_replica_s=0.05)
+    prompt = np.array([2, 7], np.int32)
+    with Router([_replica(lm, 0, d)], snapshot_dir=d, registry_max_age_s=5.0,
+                shed_after_s=20.0) as router:
+        _wait(lambda: any(
+            r["healthy"] for r in router.records().values()),
+            msg="replica healthy")
+        for _ in range(3):
+            out = router.submit_generate(prompt, 4, timeout=60.0)
+            np.testing.assert_array_equal(out, solo(lm, prompt, 4))
+    ctl = chaos.active()
+    assert ctl.slowed_submits >= 3
+    assert sum("slowing submits" in e for e in ctl.events) == 1
+
+
+def test_chaos_env_seams_for_reliability_faults(monkeypatch):
+    """The BIGDL_TPU_CHAOS_* env seams parse value[:replica] for the
+    new faults."""
+    monkeypatch.setenv("BIGDL_TPU_CHAOS_SLOW_REPLICA", "0.25:3")
+    monkeypatch.setenv("BIGDL_TPU_CHAOS_FLAKY_SUBMIT", "0.5")
+    monkeypatch.setenv("BIGDL_TPU_CHAOS_FLAKY_SUBMIT_COUNT", "4")
+    chaos.reset()
+    ctl = chaos._from_env()
+    assert ctl is not None
+    assert ctl.slow_replica_s == 0.25 and ctl.slow_replica_id == 3
+    assert ctl.flaky_submit_p == 0.5 and ctl.flaky_replica_id is None
+    assert ctl.flaky_submit_count == 4
+
+
+# ---------------------------------------------------------------------------
+# slow: chaos soak
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_chaos_soak_no_admitted_request_lost(lm, tmp_path):
+    """Sustained load over a 3-replica fabric while chaos flakes
+    submits and a replica is hard-killed mid-stream: every admitted
+    request resolves (bit-identical for the streaming cohort), zero
+    admitted-request failures, and the breaker's verdicts land in the
+    flight recorder."""
+    d = str(tmp_path)
+    events.reset_events()
+    chaos.install(flaky_submit_p=0.2, flaky_submit_count=8, seed=3)
+    rel = ReliabilityPolicy(
+        retry=RetryPolicy(times=5, backoff_s=0.01, backoff_cap_s=0.1,
+                          jitter=0.0),
+        failure_threshold=3, open_s=0.3)
+    prompts = [np.array([1 + i, 2 + i, 3 + i], np.int32)
+               for i in range(12)]
+    budgets = [6 + (i % 5) for i in range(12)]
+    expected = [solo(lm, p, m) for p, m in zip(prompts, budgets)]
+    streams = {i: [] for i in range(12)}
+    with Router([_replica(lm, r, d, slots=2) for r in range(3)],
+                snapshot_dir=d, registry_max_age_s=5.0, shed_after_s=60.0,
+                reliability=rel) as router:
+        _wait(lambda: sum(
+            1 for r in router.records().values() if r["healthy"]) == 3,
+            msg="all replicas healthy")
+        futs = []
+        for i, (p, m) in enumerate(zip(prompts, budgets)):
+            cb = ((lambda t, i=i: streams[i].append(int(t)))
+                  if i % 2 == 0 else None)
+            futs.append(router.submit_generate_async(p, m, on_token=cb))
+        # once some streams are moving, hard-kill a busy replica
+        _wait(lambda: any(len(s) >= 2 for s in streams.values()),
+              timeout=120.0, msg="streams started")
+        inflight = router.stats()["inflight"]
+        victim = max(inflight, key=lambda r: inflight[r])
+        router.replica(victim).kill()
+        rows = [f.result(timeout=300.0) for f in futs]
+        for row, exp in zip(rows, expected):
+            np.testing.assert_array_equal(row, exp)
+        st = router.stats()
+        assert st["outcomes"].get("ok", 0) == 12
+        assert st["outcomes"].get("failed", 0) == 0
+    for i, (p, m, exp) in enumerate(zip(prompts, budgets, expected)):
+        if i % 2 == 0:
+            assert streams[i] == list(exp[len(p):]), f"stream {i}"
+    counts = events.event_counts()
+    assert counts.get("request_retry", 0) + \
+        counts.get("generation_failover", 0) >= 1

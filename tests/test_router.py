@@ -15,6 +15,7 @@ overload the router answers with TYPED rejections, never timeouts;
 
 import json
 import os
+import threading
 import time
 
 import numpy as np
@@ -540,6 +541,96 @@ def test_deploy_zero_drop_swap(lm, tmp_path):
         # new sessions land on the survivor set only
         router.submit_generate(rng.integers(1, 50, 4).astype(np.int32),
                                4, session="post-deploy", timeout=60)
+    finally:
+        router.shutdown()
+
+
+def test_deploy_drain_deadline_exceeded_mid_swap(lm, tmp_path):
+    """deploy() whose old replica cannot drain in time: TimeoutError,
+    the old replica stays REGISTERED and DRAINING (nothing dropped),
+    the new replica is already serving, and the wedged request still
+    finishes afterwards."""
+    d = str(tmp_path)
+    reps = [_replica(lm, i, d) for i in range(2)]
+    router = Router(replicas=reps, snapshot_dir=d, poll_interval_s=0.01)
+    try:
+        key = next(k for k in (f"s{i}" for i in range(50))
+                   if router._ring.preference(k)[0] == 0)
+        paced = threading.Event()
+
+        def pace(_tok):
+            paced.set()
+            time.sleep(0.05)    # ~40 paced tokens: >=2s of drain debt
+
+        prompt = np.array([5, 6, 7], np.int32)
+        fut = router.submit_generate_async(prompt, 40, session=key,
+                                           on_token=pace)
+        assert paced.wait(60.0), "paced stream never started"
+        new = _replica(lm, 9, d)
+        with pytest.raises(TimeoutError):
+            router.deploy(new, replaces=0, timeout=0.3)
+        # mid-swap state: old replica still held (draining), new one in
+        assert set(router.replica_ids()) == {0, 1, 9}
+        assert router.records()[0]["draining"]
+        # the admitted request was NOT dropped by the failed swap
+        row = fut.result(120)
+        np.testing.assert_array_equal(row, solo(lm, prompt, 40))
+        _wait(lambda: reps[0].admitted_outstanding() == 0,
+              msg="old replica drained after all")
+    finally:
+        router.shutdown()
+
+
+def test_remove_replica_no_drain_with_admitted_requests(lm, tmp_path):
+    """remove_replica(drain=False) while requests are admitted: the
+    rude removal must not strand a single future — every admitted
+    request resolves bit-identical (served by the dying replica's
+    last breaths or replayed onto the survivor)."""
+    d = str(tmp_path)
+    reps = [_replica(lm, i, d) for i in range(2)]
+    router = Router(replicas=reps, snapshot_dir=d, poll_interval_s=0.01)
+    try:
+        key = next(k for k in (f"s{i}" for i in range(50))
+                   if router._ring.preference(k)[0] == 0)
+        prompts = [np.array([2 + i, 3 + i, 4 + i], np.int32)
+                   for i in range(4)]
+        futs = [router.submit_generate_async(p, 16, session=key)
+                for p in prompts]
+        _wait(lambda: reps[0].admitted_outstanding() > 0,
+              msg="work admitted to replica 0")
+        router.remove_replica(0, drain=False, timeout=5.0)
+        assert set(router.replica_ids()) == {1}
+        assert 0 not in router.registry.poll()
+        rows = [f.result(120) for f in futs]
+        for row, p in zip(rows, prompts):
+            np.testing.assert_array_equal(row, solo(lm, p, 16))
+    finally:
+        router.shutdown()
+
+
+def test_preference_exhaustion_all_replicas_draining(lm, tmp_path):
+    """Every ring stop draining: the affine preference list exhausts,
+    the non-affine fallback finds nothing either, and the request is
+    rejected TYPED (NoReplicaAvailableError) at the shed deadline —
+    never a hang."""
+    d = str(tmp_path)
+    reps = [_replica(lm, i, d) for i in range(2)]
+    router = Router(replicas=reps, snapshot_dir=d,
+                    poll_interval_s=0.01, shed_after_s=0.3)
+    try:
+        router.drain(0)
+        router.drain(1)
+        _wait(lambda: all(r["draining"]
+                          for r in router.records().values()),
+              msg="both replicas draining")
+        t0 = time.perf_counter()
+        with pytest.raises(NoReplicaAvailableError):
+            router.submit_generate(np.array([1, 2, 3], np.int32), 4,
+                                   session="sticky", timeout=30.0)
+        assert time.perf_counter() - t0 < 10.0
+        st = router.stats()
+        assert st["shed_reasons"].get("no_replica", 0) >= 1
+        assert st["outcomes"].get("rejected", 0) >= 1
     finally:
         router.shutdown()
 
