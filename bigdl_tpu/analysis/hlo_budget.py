@@ -397,6 +397,56 @@ def _ep_loss(n_devices, capacity):
     return loss, (mp, x), mesh, moe
 
 
+def _wd():
+    from bigdl_tpu.models import WideAndDeep
+    from bigdl_tpu.utils import set_seed
+    set_seed(17)
+    return WideAndDeep(64, 32, embed_dim=8, mlp_dims=(16,))
+
+
+def _wd_batch():
+    import numpy as np
+
+    from bigdl_tpu.dataset.dataset import MiniBatch
+    rng = np.random.default_rng(3)
+    pairs = np.stack([rng.integers(1, 65, size=16),
+                      rng.integers(1, 33, size=16)],
+                     axis=1).astype(np.int32)
+    return MiniBatch(pairs,
+                     rng.integers(0, 2, size=(16, 1)).astype(np.float32))
+
+
+def _wd_probe(sharded: bool) -> Dict:
+    """Lower the wide-and-deep training step: pure dp (tables
+    replicated, dense-gradient all-reduce — the FLOPs baseline), or
+    the hybrid composition ``configure_hybrid`` wires (tables
+    row-sharded over data, lookups as a2a, table gradients staying
+    per-shard — the budget entry pins that the a2a ids+vectors bytes
+    are ALL the tables put on the wire)."""
+    import numpy as np
+
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.dataset.dataset import Sample
+    from bigdl_tpu.optim import Optimizer, SGD
+    from bigdl_tpu.parallel.mesh import MeshConfig
+    from bigdl_tpu.parallel.sharding import ShardingRules
+
+    model = _wd()
+    opt = (Optimizer(model,
+                     [Sample(np.ones((2,), np.int32),
+                             np.zeros((1,), np.float32))],
+                     nn.BCECriterion(), batch_size=16)
+           .set_optim_method(SGD(0.1)))
+    if sharded:
+        from bigdl_tpu.embedding import configure_hybrid
+        configure_hybrid(opt, axes={"data": _N_DEVICES})
+    else:
+        opt.set_mesh(MeshConfig(data=_N_DEVICES), ShardingRules())
+    compiled = opt.compile_step(_wd_batch())
+    return {"compiled": compiled, "mesh": opt.mesh_config.build(),
+            "plan_bytes": None, "param_bytes": _sum_param_nbytes(model)}
+
+
 def _gen_probe(program: str) -> Dict:
     """Lower a serving slot-pool program (single device): the chunked
     KV-carry-in prefill or the prefix-cache KV copy.  No collectives
@@ -553,6 +603,20 @@ def _build_probes() -> Dict[str, ProbeSpec]:
             "moe/ep_psum", "moe", "ep_psum",
             lambda: _functional_probe(lambda: _ep_loss(4, None)),
             expected={"expert": ("all-reduce", "collective-permute")}),
+        # -- wide_deep (sharded-embedding hybrid, embedding/) ---------------
+        ProbeSpec(
+            "wide_deep/dp", "wide_deep", "dp",
+            lambda: _wd_probe(False),
+            expected={"data": DP}),
+        ProbeSpec(
+            # hybrid: a2a carries ids out and vectors back per lookup;
+            # all-reduce carries ONLY the dense tower + loss — a dense
+            # (rows x dim) table all-reduce appearing here would blow
+            # the pinned byte envelope (the sparsity regression gate)
+            "wide_deep/dp_emb8", "wide_deep", "dp_emb8",
+            lambda: _wd_probe(True),
+            expected={"data": ("all-reduce", "all-to-all")},
+            flops_baseline="wide_deep/dp"),
         # -- generation serving (single-device slot-pool programs) ----------
         ProbeSpec(
             "generation/chunk_prefill", "generation", "chunk_prefill",

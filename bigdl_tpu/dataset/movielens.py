@@ -16,7 +16,7 @@ import os
 import numpy as np
 
 __all__ = ["read_data_sets", "get_id_pairs", "get_id_ratings",
-           "synthetic_ratings"]
+           "synthetic_ratings", "synthetic_id_stream"]
 
 
 def read_data_sets(data_dir: str) -> np.ndarray:
@@ -69,3 +69,48 @@ def synthetic_ratings(n_users: int = 100, n_items: int = 50,
             rows.append((user + 1, int(item) + 1,
                          max(5 - int(t) // 2, 1), 978300000 + int(t)))
     return np.asarray(rows, dtype=np.int64)
+
+
+def synthetic_id_stream(n_users: int = 100_000_000,
+                        n_items: int = 1_000_000,
+                        batch_size: int = 4096, batches: int = 16,
+                        seed: int = 0):
+    """Constant-memory interaction stream over a 100M-row-scale id
+    space — the sharded-embedding workload generator.
+
+    ``synthetic_ratings`` materializes an (n_users x n_items) score
+    matrix, which caps it at toy sizes; this generator never holds more
+    than one batch: ids are drawn uniformly from the full 1-based
+    space and the label is a DETERMINISTIC integer-hash preference —
+    ``label(u, i)`` is a pure function of the pair, so repeated draws
+    of the same (user, item) always agree, any stream position can be
+    replayed from ``seed``, and a model with (user, item) embeddings
+    has real structure to fit (the hash mixes both ids).
+
+    Yields ``batches`` tuples of ``(pairs [B, 2] int32,
+    labels [B, 1] float32)``.  Defaults name the target id-space scale;
+    tests and the smoke pass small values — the generator's cost is
+    per-batch, not per-id-space.
+    """
+    if n_users > np.iinfo(np.int32).max or \
+            n_items > np.iinfo(np.int32).max:
+        raise ValueError(
+            f"id space ({n_users} users, {n_items} items) exceeds "
+            f"int32; the embedding lookup path ships int32 ids")
+    rng = np.random.default_rng(seed)
+    # Knuth/Fibonacci multiplicative mixing constants (mod 2^32)
+    KU, KI, KX = np.uint64(2654435761), np.uint64(2246822519), \
+        np.uint64(3266489917)
+    for _ in range(int(batches)):
+        users = rng.integers(1, n_users + 1, size=batch_size,
+                             dtype=np.int64)
+        items = rng.integers(1, n_items + 1, size=batch_size,
+                             dtype=np.int64)
+        h = (users.astype(np.uint64) * KU
+             + items.astype(np.uint64) * KI) & np.uint64(0xFFFFFFFF)
+        h = (h ^ (h >> np.uint64(15))) * KX & np.uint64(0xFFFFFFFF)
+        h ^= h >> np.uint64(13)
+        # ~38% positives: threshold on the mixed hash's low 16 bits
+        labels = ((h & np.uint64(0xFFFF)) < np.uint64(25000))
+        pairs = np.stack([users, items], axis=1).astype(np.int32)
+        yield pairs, labels.astype(np.float32).reshape(-1, 1)

@@ -1,0 +1,23 @@
+"""Mesh-sharded embedding tables and the sparse-dense hybrid workload.
+
+The recommender tier (ROADMAP item 3): row-sharded tables with
+all_to_all lookup (:mod:`sharded_table`), one-step sparse+dense hybrid
+training (:mod:`hybrid`), streaming resumable HitRatio/NDCG evaluation
+(:mod:`eval`), and embedding-shard serving affinity (:mod:`serving`).
+See docs/recommender.md.
+"""
+
+from bigdl_tpu.embedding.eval import StreamingRecEval
+from bigdl_tpu.embedding.hybrid import (
+    HybridPlanError, configure_hybrid, embedding_rules,
+    hybrid_optim_methods, resolve_hybrid, sharded_tables,
+)
+from bigdl_tpu.embedding.serving import RecommenderScorer, shard_affinity_key
+from bigdl_tpu.embedding.sharded_table import ShardedEmbeddingTable
+
+__all__ = [
+    "ShardedEmbeddingTable", "StreamingRecEval", "HybridPlanError",
+    "configure_hybrid", "embedding_rules", "hybrid_optim_methods",
+    "resolve_hybrid", "sharded_tables", "RecommenderScorer",
+    "shard_affinity_key",
+]

@@ -1,0 +1,149 @@
+"""Streaming, resumable recommender evaluation.
+
+HitRatio@K / NDCG@K over the 1-positive + N-negatives protocol
+(``optim.validation``: scores [batch, 1+neg], positive at column 0),
+consumed as a STREAM: the evaluator scores one minibatch at a time and
+folds each method's ``(numerator, denominator)`` halves into running
+partial sums, so a 100M-user eval sweep never materializes the score
+matrix and can stop/resume at any batch boundary.
+
+Resume rides the data-pipeline sidecar (:mod:`bigdl_tpu.data.pipeline`):
+the snapshot carries a ``PipelineState`` (seed / epoch / batch offset,
+plus the mixing sampler's configuration when the source is a PR-5
+``MixedDataSet``) next to the partial sums.  Restoring replays the
+exact iterator the interrupted sweep was consuming — same permutation
+seed, same mixture draws — and verifies the sampler configuration
+before trusting the offset, exactly like ``Optimizer``'s training
+resume.  The pinned invariant: interrupted-and-resumed results equal
+the one-shot sweep.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.data.pipeline import (
+    PipelineState, dataset_seed, epoch_iter, skip_batches,
+)
+from bigdl_tpu.optim.validation import HitRatio, NDCG, ValidationMethod
+
+__all__ = ["StreamingRecEval", "EVAL_STATE_VERSION"]
+
+EVAL_STATE_VERSION = 1
+
+
+class StreamingRecEval:
+    """Streaming HitRatio/NDCG evaluator over minibatches of
+    [1+neg, 2] id rows (user, item; positive first).
+
+    >>> ev = StreamingRecEval(model)
+    >>> _, state = ev.evaluate(ds, max_batches=2)   # interrupted
+    >>> results, _ = StreamingRecEval(model).evaluate(ds, state=state)
+    """
+
+    def __init__(self, model,
+                 methods: Optional[Sequence[ValidationMethod]] = None,
+                 batch_size: int = 32):
+        from bigdl_tpu.embedding.hybrid import sharded_tables
+        from bigdl_tpu.optim.predictor import jit_forward
+        self.methods = list(methods) if methods is not None \
+            else [HitRatio(10), NDCG(10)]
+        self.batch_size = int(batch_size)
+        self._model, self._fn = jit_forward(model)
+        # score on the dense lookup: eval batches (including the final
+        # partial one) need not divide over the training mesh
+        for t in sharded_tables(self._model).values():
+            t.mesh = None
+
+    # -- scoring -----------------------------------------------------------
+
+    def _score(self, feats) -> jnp.ndarray:
+        out = self._fn(self._model, jnp.asarray(feats))
+        if out.ndim and out.shape[-1] == 1:
+            out = out[..., 0]
+        return out
+
+    def _wrap(self, dataset):
+        """Accept a DataSet/MixedDataSet of minibatches as-is; wrap a
+        raw [U, 1+neg, 2] array into a deterministic batched one."""
+        # require a CALLABLE .data: np.ndarray.data is a memoryview
+        if callable(getattr(dataset, "data", None)):
+            return dataset
+        from bigdl_tpu.dataset import SampleToMiniBatch
+        from bigdl_tpu.dataset.dataset import DataSet, Sample
+        rows = np.asarray(dataset)
+        samples = [Sample(rows[i].astype(np.int32), 1)
+                   for i in range(rows.shape[0])]
+        return (DataSet.array(samples, shuffle=False)
+                .transform(SampleToMiniBatch(self.batch_size)))
+
+    # -- the stream --------------------------------------------------------
+
+    def evaluate(self, dataset, state: Optional[Dict] = None,
+                 max_batches: Optional[int] = None):
+        """Consume (the rest of) one eval epoch.  Returns
+        ``(results, snapshot)`` — ``results`` is None when
+        ``max_batches`` interrupted the sweep mid-stream, in which case
+        ``snapshot`` resumes it."""
+        dataset = self._wrap(dataset)
+        sampler = (dataset.sampler_state()
+                   if hasattr(dataset, "sampler_state") else None)
+        if state is not None:
+            if state.get("version") != EVAL_STATE_VERSION:
+                raise ValueError(
+                    f"unsupported eval-state version "
+                    f"{state.get('version')!r} "
+                    f"(supported: {EVAL_STATE_VERSION})")
+            fmts = [m.fmt for m in self.methods]
+            if state.get("methods") != fmts:
+                raise ValueError(
+                    f"eval state was written for {state.get('methods')} "
+                    f"but this evaluator computes {fmts}; resume with "
+                    f"the same method list")
+            ps = PipelineState.restore(state["pipeline"])
+            if ps.sampler is not None and sampler is not None \
+                    and ps.sampler != sampler:
+                raise ValueError(
+                    "eval state was written against a different mixing "
+                    "configuration; resume over the same MixedDataSet "
+                    "(weights/seed/children) it snapshotted")
+            partials: List[Tuple[float, float]] = [
+                (float(n), float(d)) for n, d in state["partials"]]
+        else:
+            ps = PipelineState(seed=dataset_seed(dataset), epoch=1,
+                               offset=0, sampler=sampler)
+            partials = [(0.0, 0.0) for _ in self.methods]
+
+        it = epoch_iter(dataset, ps.epoch, train=False)
+        if ps.offset:
+            skipped = skip_batches(it, ps.offset)
+            if skipped < ps.offset:
+                raise ValueError(
+                    f"eval state recorded {ps.offset} consumed batches "
+                    f"but the epoch only has {skipped}; the dataset "
+                    f"shrank since the snapshot — restart the sweep")
+        consumed = 0
+        for batch in it:
+            scores = self._score(batch.get_input())
+            partials = [
+                (n + float(num), d + float(den))
+                for (n, d), (num, den) in zip(
+                    partials,
+                    (m.batch_stats(scores) for m in self.methods))]
+            ps.offset += 1
+            consumed += 1
+            if max_batches is not None and consumed >= max_batches:
+                return None, self._snapshot(ps, partials)
+        results = [m.to_result(n, d)
+                   for m, (n, d) in zip(self.methods, partials)]
+        return results, self._snapshot(ps, partials)
+
+    def _snapshot(self, ps: PipelineState,
+                  partials: List[Tuple[float, float]]) -> Dict:
+        return {"version": EVAL_STATE_VERSION,
+                "pipeline": ps.snapshot(),
+                "partials": [[n, d] for n, d in partials],
+                "methods": [m.fmt for m in self.methods]}

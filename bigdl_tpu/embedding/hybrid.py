@@ -1,0 +1,193 @@
+"""Sparse-dense hybrid training plan.
+
+One ``optimize()`` step trains row-sharded embedding tables (sparse,
+per-shard scatter-add updates) and dp-replicated dense towers (flat
+all-reduce) together.  The mechanics are nothing but sharding
+annotations: :func:`embedding_rules` pins every
+:class:`~bigdl_tpu.embedding.sharded_table.ShardedEmbeddingTable`
+weight to ``P(axis)`` over rows and leaves every dense leaf
+replicated, so GSPMD all-reduces the dense gradients over the batch
+axis while the table gradients — already per-shard after the lookup's
+transposed all_to_all — sync nothing at all.
+
+Like ``Optimizer._grad_sync_plan``, :func:`resolve_hybrid` REJECTS
+compositions the plan cannot honor with actionable errors instead of
+silently compiling something else: no sharded table in the model,
+tensor/pipeline/sequence/expert axes on the mesh, hierarchical grad
+sync (which requires fully replicated params), rows not divisible by
+the shard count.
+
+Per-table optimizer state rides the existing per-submodule
+OptimMethods split (``Optimizer.set_optim_methods``): every table gets
+its OWN method instance — sparse tables routinely want a different
+learning rate than the dense towers, and per-table slots (momentum,
+Adam moments) must never alias between tables.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Dict, Optional
+
+from bigdl_tpu.embedding.sharded_table import ShardedEmbeddingTable
+
+__all__ = ["HybridPlanError", "sharded_tables", "embedding_rules",
+           "resolve_hybrid", "hybrid_optim_methods", "configure_hybrid"]
+
+
+class HybridPlanError(ValueError):
+    """A mesh/model composition the hybrid embedding plan cannot
+    honor; the message says what to change."""
+
+
+def sharded_tables(model) -> Dict[str, ShardedEmbeddingTable]:
+    """``{param-path prefix: table}`` for every ShardedEmbeddingTable
+    in the tree.  Prefixes align with ``core.module.param_paths``
+    (root module = empty prefix)."""
+    out: Dict[str, ShardedEmbeddingTable] = {}
+    for prefix, mod in model.named_modules():
+        if isinstance(mod, ShardedEmbeddingTable):
+            out["" if mod is model else prefix] = mod
+    return out
+
+
+def embedding_rules(model, axis: str = "data"):
+    """ShardingRules placing every sharded table's weight ``P(axis)``
+    over rows; everything unmatched stays replicated (pure dp)."""
+    import re
+
+    from jax.sharding import PartitionSpec as P
+    from bigdl_tpu.parallel.sharding import ShardingRules
+
+    def row_spec(shape, mesh):
+        if axis not in mesh.axis_names:
+            return P()
+        if shape and shape[0] % mesh.shape[axis] == 0:
+            return P(axis, *([None] * (len(shape) - 1)))
+        return P()
+
+    rules = []
+    for prefix in sharded_tables(model):
+        path = f"{prefix}.weight" if prefix else "weight"
+        rules.append((f"^{re.escape(path)}$", row_spec))
+    return ShardingRules(rules)
+
+
+def resolve_hybrid(model, mesh, axis: str = "data",
+                   hierarchical: bool = False) -> Dict:
+    """Validate the (model, mesh) composition and return the plan:
+    ``{"tables", "axis", "n_shards", "bytes_per_lookup"}``.  Raises
+    :class:`HybridPlanError` with an actionable message otherwise."""
+    tables = sharded_tables(model)
+    if not tables:
+        raise HybridPlanError(
+            "hybrid embedding plan: the model has no "
+            "ShardedEmbeddingTable — use Optimizer.set_mesh directly, "
+            "or build the towers on bigdl_tpu.embedding tables "
+            "(models/dlrm.py is the template)")
+    if axis not in mesh.axis_names:
+        raise HybridPlanError(
+            f"hybrid embedding plan: shard axis {axis!r} is not on the "
+            f"mesh (axes: {tuple(mesh.axis_names)}); build it with "
+            f"MeshConfig({axis}=N)")
+    bad = [a for a in ("model", "pipe", "seq", "expert")
+           if a in mesh.axis_names and mesh.shape[a] > 1]
+    if bad:
+        raise HybridPlanError(
+            f"hybrid embedding plan supports batch-parallel meshes "
+            f"(data/fsdp/dcn) only; mesh has {bad} axes > 1 — drop "
+            f"them, or train the tables unsharded under those "
+            f"compositions")
+    if hierarchical:
+        raise HybridPlanError(
+            "hybrid embedding plan: hierarchical gradient sync "
+            "requires fully replicated parameters, but sharded "
+            "embedding tables are row-sharded — call "
+            "set_gradient_sync(hierarchical=False) or train the "
+            "tables unsharded")
+    n = int(mesh.shape[axis])
+    for prefix, t in tables.items():
+        if t.n_index % n != 0:
+            raise HybridPlanError(
+                f"hybrid embedding plan: table "
+                f"{prefix or t.name!r} has {t.n_index} rows, not "
+                f"divisible over {n} shards on axis {axis!r}; pad "
+                f"n_index to a multiple of {n} (unused high rows are "
+                f"harmless)")
+    # per-device bytes one lookup step moves for S local flattened ids
+    # (the formula docs/recommender.md documents; itemsize 4 = fp32)
+    bytes_per_lookup = {
+        prefix: f"n*S*4 ids + n*S*{t.n_output}*4 vectors (n={n})"
+        for prefix, t in tables.items()}
+    return {"tables": tables, "axis": axis, "n_shards": n,
+            "bytes_per_lookup": bytes_per_lookup}
+
+
+def hybrid_optim_methods(model, table_method, dense_method) -> Dict:
+    """Per-submodule OptimMethods: every sharded table gets its own
+    deep copy of ``table_method`` (per-table state never aliases) and
+    every other top-level child its own copy of ``dense_method``."""
+    from bigdl_tpu.core.module import Module, ModuleList
+    if isinstance(model, ShardedEmbeddingTable):
+        raise HybridPlanError(
+            "hybrid_optim_methods: the model IS a single table; use "
+            "set_optim_method directly")
+    if model._params:
+        raise HybridPlanError(
+            "hybrid_optim_methods: the model owns direct parameters "
+            f"({sorted(model._params)}); per-submodule methods cannot "
+            "cover them — move them into a child module or call "
+            "set_optim_methods yourself")
+
+    def subtree_has_table(obj) -> bool:
+        if isinstance(obj, ShardedEmbeddingTable):
+            return True
+        if isinstance(obj, Module):
+            return any(subtree_has_table(m) for m in obj._modules.values())
+        if isinstance(obj, ModuleList):
+            return any(subtree_has_table(m) for m in obj._items)
+        return False
+
+    methods: Dict = {}
+    for name, child in model._modules.items():
+        if isinstance(child, ShardedEmbeddingTable):
+            methods[name] = copy.deepcopy(table_method)
+        elif subtree_has_table(child):
+            raise HybridPlanError(
+                f"hybrid_optim_methods: child {name!r} mixes a nested "
+                f"sharded table with dense parameters; hoist tables to "
+                f"top-level attributes (models/dlrm.py layout) or call "
+                f"set_optim_methods with explicit keys")
+        else:
+            methods[name] = copy.deepcopy(dense_method)
+    return methods
+
+
+def configure_hybrid(optimizer, axes: Optional[Dict[str, int]] = None,
+                     axis: str = "data", table_method=None,
+                     dense_method=None) -> Dict:
+    """One-call hybrid setup on an :class:`~bigdl_tpu.optim.Optimizer`:
+    build the mesh, validate the composition, point every table's
+    lookup at the mesh, install the row-sharding rules (and, when both
+    methods are given, the per-table OptimMethods split).  Returns the
+    resolved plan."""
+    from bigdl_tpu.parallel.mesh import MeshConfig
+
+    cfg = MeshConfig(**(axes or {axis: -1}))
+    mesh = cfg.build()
+    model = optimizer.model
+    plan = resolve_hybrid(
+        model, mesh, axis,
+        hierarchical=getattr(optimizer, "grad_sync_hierarchical", False))
+    for t in plan["tables"].values():
+        t.set_mesh(mesh, axis)
+    optimizer.set_mesh(cfg, embedding_rules(model, axis))
+    if (table_method is None) != (dense_method is None):
+        raise HybridPlanError(
+            "configure_hybrid: pass BOTH table_method and dense_method "
+            "(or neither, keeping the optimizer's current method)")
+    if table_method is not None:
+        optimizer.set_optim_methods(
+            hybrid_optim_methods(model, table_method, dense_method))
+    plan["mesh"] = mesh
+    return plan

@@ -1,0 +1,96 @@
+"""Embedding-aware serving: shard affinity + a scoring replica target.
+
+Two pieces wire the recommender into the PR-13 serving fabric:
+
+* :func:`shard_affinity_key` — a router ``session`` key derived from
+  the EMBEDDING SHARD that owns a request's user id (contiguous-block
+  layout, same math as ``ShardedEmbeddingTable.owner_of``).  The
+  router's consistent-hash ring then pins every request touching one
+  shard's rows to the same replica — the replica whose lookup cache /
+  pinned host rows stay warm for exactly those users — without the
+  router learning anything about embeddings: the shard id is just a
+  session key.
+
+* :class:`RecommenderScorer` — adapts a one-shot scoring
+  ``ModelServer`` (dynamic batcher, admission, SLO metrics — the
+  existing machinery, untouched) to the ``submit_generate_async``
+  protocol :class:`~bigdl_tpu.serving.replica.Replica` speaks, so a
+  wide-and-deep/NeuralCF model serves scored requests through the
+  Router end-to-end.  ``prompt`` carries the [2] (user, item) id row
+  (or a [1+neg, 2] ranking slate); ``max_new_tokens`` is ignored — a
+  score is one forward, not a decode loop.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["shard_affinity_key", "RecommenderScorer"]
+
+
+def shard_affinity_key(user_id: int, n_rows: int, n_shards: int,
+                       model: str = "default",
+                       table: str = "user") -> str:
+    """Router session key for the shard owning ``user_id`` (1-based)
+    under the contiguous row-block layout ``ShardedEmbeddingTable``
+    uses.  All sessions touching one shard hash to one home replica."""
+    n_shards = max(1, int(n_shards))
+    rows_per_shard = max(1, int(n_rows) // n_shards)
+    idx0 = min(max(int(user_id) - 1, 0), int(n_rows) - 1)
+    shard = min(idx0 // rows_per_shard, n_shards - 1)
+    return f"emb-{model}-{table}-s{shard}"
+
+
+class RecommenderScorer:
+    """Replica-target adapter over a one-shot scoring ModelServer.
+
+    >>> rep = Replica(0, RecommenderScorer(model), snapshot_dir=d)
+    >>> fut = router.submit_generate_async(
+    ...     np.asarray([user, item], np.int32), 1,
+    ...     session=shard_affinity_key(user, rows, shards))
+    >>> score = fut.result()
+    """
+
+    def __init__(self, model, max_batch: int = 32, **server_kwargs):
+        from bigdl_tpu.embedding.hybrid import sharded_tables
+        from bigdl_tpu.serving.server import ModelServer
+        # score on the DENSE lookup: a replica holds the full tables
+        # and a 1-row request cannot ride the 8-way training a2a; the
+        # shard-affinity key routes for cache warmth, not for sharding
+        model = model.clone()
+        for t in sharded_tables(model).values():
+            t.mesh = None
+        self._server = ModelServer(backend=model, max_batch=max_batch,
+                                   **server_kwargs)
+
+    def warmup(self, example_sample) -> "RecommenderScorer":
+        self._server.warmup(example_sample)
+        return self
+
+    # ---- the Replica target protocol -----------------------------------
+
+    def submit_generate_async(self, prompt, max_new_tokens: int = 0,
+                              eos_id=None, on_token=None,
+                              timeout: Optional[float] = None):
+        # a scored request is one forward: the "prompt" is the id row,
+        # the "generation" is its score
+        return self._server.submit_async(
+            np.asarray(prompt, np.int32), timeout=timeout)
+
+    def shutdown(self, drain: bool = True,
+                 timeout: Optional[float] = None) -> None:
+        self._server.shutdown(drain=drain, timeout=timeout)
+
+    # ---- health/stats delegation (router drain + load accounting) ------
+
+    def admitted_outstanding(self) -> int:
+        return self._server.admitted_outstanding()
+
+    def queue_depth(self) -> int:
+        return self._server.queue_depth()
+
+    def stats(self):
+        return {"slots": self._server.max_batch,
+                "queue_depth": self._server.queue_depth()}

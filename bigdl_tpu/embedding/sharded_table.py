@@ -1,0 +1,228 @@
+"""Mesh-sharded embedding tables.
+
+The reference's recommendation stack (nn/LookupTable.scala,
+nn/LookupTableSparse.scala) keeps every table on one node; the
+production shape — a (rows x dim) table too big for a single device's
+HBM — is new TPU-first capability.  :class:`ShardedEmbeddingTable`
+row-shards the table across a mesh axis (the batch axes ``data`` /
+``fsdp`` from :mod:`bigdl_tpu.parallel.mesh`; shard s owns the
+contiguous row block ``[s*rows/n, (s+1)*rows/n)``) and lowers lookup
+with the :mod:`bigdl_tpu.nn.moe` dispatch pattern:
+
+1. bucket each device's local ids by owning shard (position-in-bucket
+   via cumsum, exact — per-destination capacity is the local id count,
+   so nothing is ever dropped);
+2. ``all_to_all`` the id buckets to their owning shards;
+3. local gather on the owner (``dedup_gather`` — duplicate ids combine
+   into one scatter row on the backward);
+4. ``all_to_all`` the vectors back and un-bucket.
+
+Every collective goes through :mod:`bigdl_tpu.telemetry.collectives`,
+so lookup traffic lands in ``collective_bytes_total{op="all_to_all",
+axis}`` like every other exchange.  Per-device bytes per lookup step:
+``n*S*4`` for the id exchange plus ``n*S*dim*itemsize`` for the vector
+exchange (S = local flattened ids) — the formula docs/recommender.md
+pins and scripts/parallel_budget.json red-gates.
+
+The BACKWARD stays sparse: the table enters the ``shard_map`` with
+``P(axis)`` over rows, so its cotangent is the per-shard scatter-add
+of the combined unique-id updates that flowed back through the
+transposed all_to_all — never a dense (rows x dim) all-reduce (pinned
+by the compiled-HLO test and the budget entry).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from bigdl_tpu.core.module import Module, Parameter
+from bigdl_tpu.nn.sparse import dedup_gather
+from bigdl_tpu.parallel.mesh import shard_map_compat
+from bigdl_tpu.telemetry import collectives as _coll
+from bigdl_tpu.utils.rng import next_key
+
+__all__ = ["ShardedEmbeddingTable", "LAST_LOOKUP_SHAPES"]
+
+# Per-device (inside-shard_map) buffer shapes of the most recent a2a
+# lookup trace — a debug/test hook (module attrs would pollute the
+# pytree), mirroring nn.moe.LAST_A2A_SHAPES.
+LAST_LOOKUP_SHAPES = {}
+
+
+def _account_lookup(table_name: str, n_ids: int, ids=None) -> None:
+    """Best-effort telemetry: never raises into the lookup it
+    describes.  ``embedding_lookup_ids_total`` is accounted at trace
+    time per compiled program (the collective-counter convention);
+    ``embedding_unique_id_fraction`` needs concrete values so it is
+    set only on eager (non-traced) lookups."""
+    try:
+        from bigdl_tpu import telemetry
+        from bigdl_tpu.telemetry import families as _fam
+        if not telemetry.enabled():
+            return
+        _fam.embedding_lookup_ids_total().labels(table_name).inc(
+            float(n_ids))
+        if ids is not None and not isinstance(ids, jax.core.Tracer):
+            vals = np.asarray(ids).reshape(-1)
+            if vals.size:
+                frac = float(np.unique(vals).size) / float(vals.size)
+                _fam.embedding_unique_id_fraction().labels(
+                    table_name).set(frac)
+    except Exception:  # pragma: no cover - accounting is best-effort
+        pass
+
+
+class ShardedEmbeddingTable(Module):
+    """Row-sharded embedding lookup, 1-based ids like
+    :class:`bigdl_tpu.nn.linear.LookupTable`.
+
+    Without :meth:`set_mesh` the forward is the plain dense gather
+    (bit-identical to ``LookupTable`` with default options) — the
+    single-device baseline the loss-equivalence test trains against.
+    With a mesh set, ``forward`` routes through the all_to_all lookup
+    so the layer composes with the Optimizer, whose jitted step just
+    calls ``model.forward`` (the ``nn.moe`` integration shape).
+    """
+
+    def __init__(self, n_index: int, n_output: int,
+                 name: Optional[str] = None):
+        super().__init__()
+        self.n_index = int(n_index)
+        self.n_output = int(n_output)
+        if name is not None:
+            self.set_name(name)
+        self.weight = Parameter(jax.random.normal(
+            next_key(), (self.n_index, self.n_output)))
+        self.mesh = None
+        self.axis = "data"
+
+    def __deepcopy__(self, memo):
+        # Module.clone() deepcopies; the Mesh holds Device handles that
+        # cannot be pickled, and after hybrid training the weights are
+        # device-committed arrays whose NamedSharding references the
+        # same handles.  Both are immutable — share them by reference
+        # so a sharded-trained model clones for eval/serving.
+        import copy as _copy
+        new = self.__class__.__new__(self.__class__)
+        memo[id(self)] = new
+        for k, v in self.__dict__.items():
+            if k in ("_params", "_static"):
+                # _static holds the Mesh, _params the (possibly
+                # device-committed) weight — shallow-copy the dicts,
+                # share the immutable values
+                new.__dict__[k] = dict(v)
+            else:
+                new.__dict__[k] = _copy.deepcopy(v, memo)
+        return new
+
+    # -- placement ---------------------------------------------------------
+
+    @property
+    def n_shards(self) -> int:
+        return 1 if self.mesh is None else int(self.mesh.shape[self.axis])
+
+    @property
+    def rows_per_shard(self) -> int:
+        return self.n_index // self.n_shards
+
+    def set_mesh(self, mesh: Mesh, axis: str = "data") \
+            -> "ShardedEmbeddingTable":
+        """Route lookups through the a2a path, row-sharding the table
+        over ``axis``.  Rejects layouts the lookup cannot honor with
+        actionable errors (the ``_grad_sync_plan`` discipline)."""
+        if axis not in mesh.axis_names:
+            raise ValueError(
+                f"ShardedEmbeddingTable {self.name!r}: axis {axis!r} is "
+                f"not on the mesh (axes: {tuple(mesh.axis_names)}); "
+                f"build the mesh with MeshConfig({axis}=N) or pick one "
+                f"of its batch axes")
+        n = int(mesh.shape[axis])
+        if self.n_index % n != 0:
+            raise ValueError(
+                f"ShardedEmbeddingTable {self.name!r}: {self.n_index} "
+                f"rows do not divide over {n} shards on axis {axis!r}; "
+                f"pad n_index to a multiple of {n} (unused high rows "
+                f"are harmless) or shard over a smaller axis")
+        self.mesh = mesh
+        self.axis = axis
+        try:
+            from bigdl_tpu import telemetry
+            from bigdl_tpu.telemetry import families as _fam
+            if telemetry.enabled():
+                g = _fam.embedding_shard_rows()
+                for s in range(n):
+                    g.labels(self.name, str(s)).set(self.n_index // n)
+        except Exception:  # pragma: no cover - accounting is best-effort
+            pass
+        return self
+
+    def owner_of(self, ids) -> jnp.ndarray:
+        """Shard that owns each (1-based) id under the contiguous-block
+        layout — also the serving affinity key's input (shard id as the
+        consistent-hash key, docs/recommender.md)."""
+        idx0 = jnp.clip(jnp.asarray(ids).astype(jnp.int32) - 1, 0,
+                        self.n_index - 1)
+        return idx0 // self.rows_per_shard
+
+    # -- lookup ------------------------------------------------------------
+
+    def forward(self, ids):
+        ids = jnp.asarray(ids).astype(jnp.int32)
+        _account_lookup(self.name, ids.size, ids)
+        if self.mesh is None:
+            return self._dense_lookup(ids)
+        return self._forward_a2a(ids, self.mesh, self.axis)
+
+    def _dense_lookup(self, ids):
+        idx = jnp.clip(ids - 1, 0, self.n_index - 1)
+        return dedup_gather(self.weight, idx)
+
+    def _forward_a2a(self, ids, mesh: Mesh, axis: str):
+        n = int(mesh.shape[axis])
+        lead = ids.shape
+        flat = ids.reshape(-1)
+        if flat.shape[0] % n != 0:
+            raise ValueError(
+                f"ShardedEmbeddingTable {self.name!r}: {flat.shape[0]} "
+                f"ids do not shard over the {n}-way {axis!r} axis; pad "
+                f"the batch so batch*ids_per_sample is a multiple of "
+                f"{n}")
+        rows_shard = self.n_index // n
+        n_index = self.n_index
+
+        def shard_fn(w_local, ids_loc):
+            # ids_loc [S] 1-based local ids; w_local [rows/n, dim]
+            idx0 = jnp.clip(ids_loc - 1, 0, n_index - 1)
+            owner = idx0 // rows_shard                        # [S]
+            onehot = (owner[:, None]
+                      == jnp.arange(n)[None, :]).astype(jnp.int32)
+            pos = jnp.sum((jnp.cumsum(onehot, axis=0) - 1) * onehot,
+                          axis=1)                             # [S]
+            # per-destination capacity = S: exact (no drops), the
+            # worst case being every local id owned by one shard
+            send = jnp.zeros((n, ids_loc.shape[0]), jnp.int32)
+            send = send.at[owner, pos].set(idx0 + 1)          # 0 = empty
+            recv = _coll.all_to_all(send, axis, split_axis=0,
+                                    concat_axis=0, tiled=True)
+            me = jax.lax.axis_index(axis)
+            local = recv - 1 - me * rows_shard
+            valid = (recv > 0) & (local >= 0) & (local < rows_shard)
+            vecs = dedup_gather(w_local,
+                                jnp.clip(local, 0, rows_shard - 1))
+            vecs = vecs * valid[..., None].astype(vecs.dtype)
+            back = _coll.all_to_all(vecs, axis, split_axis=0,
+                                    concat_axis=0, tiled=True)
+            LAST_LOOKUP_SHAPES.update(send=send.shape, recv=recv.shape,
+                                      vecs=vecs.shape, back=back.shape)
+            return back[owner, pos]                           # [S, dim]
+
+        fn = shard_map_compat(
+            shard_fn, mesh=mesh,
+            in_specs=(P(axis), P(axis)), out_specs=P(axis))
+        out = fn(self.weight, flat)
+        return out.reshape(lead + (self.n_output,))

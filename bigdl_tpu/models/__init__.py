@@ -12,6 +12,7 @@ from bigdl_tpu.models.maskrcnn import (
 from bigdl_tpu.models.ssd import SSDVGG16, ssd_vgg16_300
 from bigdl_tpu.models.transformer_lm import TransformerLM, transformer_lm
 from bigdl_tpu.models.ncf import NeuralCF
+from bigdl_tpu.models.dlrm import WideAndDeep, wide_and_deep
 
 # ---------------------------------------------------------------------------
 # Zoo registry: name → builder, for CLI entry points (serving demo, tools)
@@ -36,6 +37,7 @@ _ZOO = {
     "resnet_cifar": resnet_cifar,
     "vgg_cifar10": VggForCifar10,
     "transformer_lm_tiny": _transformer_lm_tiny,
+    "wide_and_deep": wide_and_deep,
 }
 
 # per-sample (unbatched) input shape each zoo model expects, used by the
@@ -46,6 +48,9 @@ _ZOO_SAMPLE_SHAPES = {
     "autoencoder": (784,),
     "resnet_cifar": (32, 32, 3),
     "vgg_cifar10": (32, 32, 3),
+    # (user, item) 1-based id pair — the scoring row RecommenderScorer
+    # ships as the router "prompt"
+    "wide_and_deep": (2,),
 }
 
 

@@ -29,8 +29,61 @@ from bigdl_tpu.utils.rng import next_key
 
 __all__ = [
     "SparseTensor", "DenseToSparse", "SparseJoinTable", "SparseLinear",
-    "LookupTableSparse",
+    "LookupTableSparse", "dedup_gather", "dedup_scatter_updates",
 ]
+
+
+def dedup_scatter_updates(idx, grads):
+    """Combine duplicate-row updates before a scatter-add.
+
+    ``idx`` (N,) int row ids with repeats, ``grads`` (N, ...) their
+    per-occurrence updates.  Returns ``(rows, contrib)`` of the same
+    static shapes where every row id's total update is carried by its
+    FIRST occurrence in sorted order and every other occurrence
+    carries exact zeros — ``zeros.at[rows].add(contrib)`` lands one
+    non-zero update per unique row instead of one per occurrence.
+    The combine is a sort + ``segment_sum``, not a per-duplicate
+    scatter chain, which is what keeps a duplicate-heavy batch from
+    serializing the table update on TPU.
+    """
+    idx = idx.reshape(-1)
+    order = jnp.argsort(idx)
+    sidx = idx[order]
+    sg = grads[order]
+    first = jnp.concatenate(
+        [jnp.ones((1,), bool), sidx[1:] != sidx[:-1]])
+    seg = jnp.cumsum(first.astype(jnp.int32)) - 1
+    summed = jax.ops.segment_sum(sg, seg, num_segments=idx.shape[0])
+    keep = first.reshape((-1,) + (1,) * (grads.ndim - 1))
+    contrib = summed[seg] * keep.astype(grads.dtype)
+    return sidx, contrib
+
+
+@jax.custom_vjp
+def dedup_gather(w, idx):
+    """``w[idx]`` whose backward scatter-adds ONE combined update per
+    unique id (via :func:`dedup_scatter_updates`) instead of one row
+    per occurrence — the duplicate-heavy recommender batch fix."""
+    return w[idx]
+
+
+def _dedup_gather_fwd(w, idx):
+    # residual leaves must be jax types: a zero-size token carries the
+    # table's row count and dtype instead of raw shape/dtype objects
+    return w[idx], (idx, jnp.zeros((w.shape[0], 0), w.dtype))
+
+
+def _dedup_gather_bwd(res, g):
+    idx, token = res
+    tail = g.shape[idx.ndim:]
+    flat = g.reshape((-1,) + tail)
+    rows, contrib = dedup_scatter_updates(idx.reshape(-1), flat)
+    dw = jnp.zeros((token.shape[0],) + tail, token.dtype)
+    dw = dw.at[rows].add(contrib.astype(token.dtype))
+    return dw, None
+
+
+dedup_gather.defvjp(_dedup_gather_fwd, _dedup_gather_bwd)
 
 
 class SparseTensor:
@@ -178,7 +231,11 @@ class LookupTableSparse(Module):
         rows = ids.indices[:, 0]
         id_vals = ids.values.astype(jnp.int32)
         present = (id_vals > 0).astype(self.weight.dtype)
-        emb = self.weight[jnp.clip(id_vals - 1, 0, self.n_index - 1)]
+        # dedup_gather: duplicate ids in one batch (the common
+        # recommender shape) backward into ONE combined scatter row per
+        # unique id, not one per occurrence
+        emb = dedup_gather(self.weight,
+                           jnp.clip(id_vals - 1, 0, self.n_index - 1))
         if self.max_norm > 0:
             # clip only the gathered (nnz, dim) rows, not the whole table
             norms = jnp.linalg.norm(emb, axis=1, keepdims=True)

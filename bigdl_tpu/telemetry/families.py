@@ -477,6 +477,37 @@ def request_deadline_exceeded_total() -> Counter:
         "sweep)", labelnames=("stage",))
 
 
+# ---- sharded embedding tables (embedding/) --------------------------------
+
+def embedding_lookup_ids_total() -> Counter:
+    return get_registry().counter(
+        "embedding_lookup_ids_total",
+        "Ids looked up per sharded embedding table (counted at trace "
+        "time per compiled batch shape; multiply by executions for "
+        "wall totals — the a2a bytes these ids imply are what "
+        "collective_bytes_total{op=all_to_all} accounts)",
+        labelnames=("table",))
+
+
+def embedding_unique_id_fraction() -> Gauge:
+    return get_registry().gauge(
+        "embedding_unique_id_fraction",
+        "Unique/total id ratio of the last concrete (non-traced) "
+        "lookup batch per table — the dedup leverage: backward "
+        "scatters one combined row per UNIQUE id, so 0.3 here means "
+        "the sparse gradient is 3.3x smaller than the id count "
+        "suggests", labelnames=("table",))
+
+
+def embedding_shard_rows() -> Gauge:
+    return get_registry().gauge(
+        "embedding_shard_rows",
+        "Rows owned by each shard of a mesh-sharded embedding table "
+        "(contiguous-block layout; set at set_mesh time — uniform "
+        "today, the gauge exists so a future non-uniform placement "
+        "shows its skew)", labelnames=("table", "shard"))
+
+
 # ---- fleet controller (autoscaler + continuous deployment, fleet/) --------
 
 def fleet_replicas_desired() -> Gauge:
@@ -548,6 +579,8 @@ _PREREGISTER = (
     router_breaker_transitions_total, request_deadline_exceeded_total,
     fleet_replicas_desired, fleet_replicas_live,
     fleet_scale_events_total, fleet_deploy_freshness_seconds,
+    embedding_lookup_ids_total, embedding_unique_id_fraction,
+    embedding_shard_rows,
 )
 
 

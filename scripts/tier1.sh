@@ -6,7 +6,7 @@
 set -o pipefail
 cd "$(dirname "$0")/.."
 rm -f /tmp/_t1.log
-timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+timeout -k 10 1500 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
   -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
   -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
 rc=${PIPESTATUS[0]}
@@ -138,5 +138,17 @@ if bash "$(dirname "$0")/reliability_smoke.sh" >"$reliability_log" 2>&1; then
   tail -n 1 "$reliability_log"
 else
   echo "reliability_smoke: FAILED (non-fatal ride-along; see $reliability_log)"
+fi
+# sharded-embedding smoke (hybrid train loss == single-device baseline,
+# compiled step provably sparse — a2a present, no dense table
+# all-reduce — streaming HitRatio/NDCG resumes to the one-shot
+# numbers, one scored request through the router with a shard-affinity
+# key): warn-only ride-along; run scripts/embedding_smoke.sh
+# standalone for the fatal form
+embedding_log=$(mktemp /tmp/embedding_smoke.XXXXXX.log)
+if bash "$(dirname "$0")/embedding_smoke.sh" >"$embedding_log" 2>&1; then
+  tail -n 1 "$embedding_log"
+else
+  echo "embedding_smoke: FAILED (non-fatal ride-along; see $embedding_log)"
 fi
 exit $rc
