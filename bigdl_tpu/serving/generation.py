@@ -101,7 +101,7 @@ from bigdl_tpu.serving.prefix_cache import PrefixChunk, PrefixKVCache
 from bigdl_tpu.serving.reliability import (
     Deadline, ReplicaDeadError, RequestCancelledError,
 )
-from bigdl_tpu.telemetry import tracing
+from bigdl_tpu.telemetry import request_trace, tracing
 
 __all__ = ["GenerationRequest", "SlotPool", "GenerationScheduler",
            "run_mixed_workload", "run_shared_prefix_workload",
@@ -117,16 +117,20 @@ class GenerationRequest:
     block/reject/shed_oldest — apply to generation unchanged."""
 
     __slots__ = ("prompt", "max_new_tokens", "eos_id", "on_token",
-                 "future", "t_enqueue", "deadline")
+                 "future", "t_enqueue", "deadline", "trace")
 
     def __init__(self, prompt, max_new_tokens: int, eos_id=None,
                  on_token: Optional[Callable[[int], None]] = None,
-                 deadline: Optional[Deadline] = None):
+                 deadline: Optional[Deadline] = None, trace=None):
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         self.max_new_tokens = int(max_new_tokens)
         self.eos_id = eos_id
         self.on_token = on_token
         self.deadline = deadline
+        # TraceContext (telemetry.request_trace) minted at router
+        # admission, or None — the telemetry-disabled default — in
+        # which case every trace site below is one bool check
+        self.trace = trace
         self.future: "Future" = Future()
         self.t_enqueue = time.perf_counter()
 
@@ -561,7 +565,8 @@ class _ActiveSlot:
     """Host bookkeeping for one occupied slot (prefilling or decoding)."""
 
     __slots__ = ("req", "emitted", "t_first", "t_last", "eos_id", "slot",
-                 "phase", "next_pos", "end_pos", "was_follower")
+                 "phase", "next_pos", "end_pos", "was_follower",
+                 "t_decode")
 
     def __init__(self, req: GenerationRequest, eos_id, slot: int):
         self.req = req
@@ -574,6 +579,7 @@ class _ActiveSlot:
         self.next_pos = 0                       # next prefill position
         self.end_pos = max(len(req.prompt) - 1, 0)   # prefill covers [0, end)
         self.was_follower = False               # dedup counted once
+        self.t_decode: Optional[float] = None   # decode-join stamp
 
 
 class _Reservoir:
@@ -788,7 +794,8 @@ class GenerationScheduler:
     def submit_async(self, prompt, max_new_tokens: int, eos_id=None,
                      on_token: Optional[Callable[[int], None]] = None,
                      timeout: Optional[float] = None,
-                     deadline: Optional[Deadline] = None) -> Future:
+                     deadline: Optional[Deadline] = None,
+                     trace=None) -> Future:
         """Admit one prompt (1-D int tokens) and return a Future of the
         full ``[Tp + max_new_tokens]`` row — bit-identical to
         ``model.generate(prompt[None], max_new_tokens, eos_id)[0]``.
@@ -797,9 +804,15 @@ class GenerationScheduler:
         (optional) rides the request through admit and decode: once
         expired, the engine fails the future with the typed
         :class:`DeadlineExceededError` and frees the slot instead of
-        decoding an answer nobody is waiting for."""
+        decoding an answer nobody is waiting for.  ``trace`` (optional)
+        is the request's :class:`~bigdl_tpu.telemetry.request_trace.
+        TraceContext`: the engine then records its queue / prefill /
+        decode / emit phases as spans of that trace (the replica layer
+        forwards it only when this signature accepts it — feature
+        detection, like ``deadline``)."""
         req = GenerationRequest(prompt, max_new_tokens, eos_id=eos_id,
-                                on_token=on_token, deadline=deadline)
+                                on_token=on_token, deadline=deadline,
+                                trace=trace)
         err = self._validate(req)
         if err is not None:
             raise err
@@ -1001,11 +1014,23 @@ class GenerationScheduler:
         # the failed dispatch may have consumed the donated feed
         # buffers: rebuild from mirrors on the next dispatch
         self.pool.invalidate_feed()
+        now = time.perf_counter()
         for slot in range(self.pool.slots):
             st = self._slot_state[slot]
             if st is None:
                 continue
             self._release_claims(st)
+            if st.req.trace is not None:
+                # the aborted phase span: the assembled trace shows how
+                # far this replica got before the failure cut it off
+                # (the failover replay's salvage is len(st.emitted))
+                name = ("request/decode" if st.phase == "decode"
+                        else "request/prefill")
+                request_trace.record_span(
+                    name, st.t_decode if st.t_decode is not None
+                    else st.req.t_enqueue, now, ctx=st.req.trace,
+                    aborted=type(exc).__name__,
+                    new_tokens=len(st.emitted))
             if not st.req.future.done():
                 st.req.future.set_exception(exc)
             self._slot_state[slot] = None
@@ -1039,11 +1064,22 @@ class GenerationScheduler:
             elif st.req.deadline is not None \
                     and st.req.deadline.expired(now):
                 stage = "decode" if st.phase == "decode" else "prefill"
-                exc = st.req.deadline.error(stage, now)
+                exc = st.req.deadline.error(
+                    stage, now,
+                    trace_id=(st.req.trace.trace_id
+                              if st.req.trace is not None else None))
             if exc is None:
                 continue
             self._purge_prefill_work(st)
             self._release_claims(st)
+            if st.req.trace is not None:
+                request_trace.record_span(
+                    "request/decode" if st.phase == "decode"
+                    else "request/prefill",
+                    st.t_decode if st.t_decode is not None
+                    else st.req.t_enqueue, now, ctx=st.req.trace,
+                    aborted=type(exc).__name__,
+                    new_tokens=len(st.emitted))
             if not st.req.future.done():
                 st.req.future.set_exception(exc)
             self._slot_state[slot] = None
@@ -1081,7 +1117,10 @@ class GenerationScheduler:
                     and req.deadline.expired():
                 # budget burned in the queue: typed rejection before a
                 # slot (and a prefill) is spent on it
-                err = req.deadline.error("queue")
+                err = req.deadline.error(
+                    "queue",
+                    trace_id=(req.trace.trace_id
+                              if req.trace is not None else None))
             if err is not None:
                 if req.future.set_running_or_notify_cancel():
                     req.future.set_exception(err)
@@ -1103,6 +1142,13 @@ class GenerationScheduler:
                    else self.default_eos_id)
             st = _ActiveSlot(req, eos, slot)
             self._slot_state[slot] = st
+            if req.trace is not None:
+                # queue phase ends at slot assignment, not at dequeue:
+                # the trace's queue span is "how long before a slot
+                # worked on it", which is what an SLO debugger wants
+                request_trace.record_span(
+                    "request/queue", req.t_enqueue,
+                    time.perf_counter(), ctx=req.trace, slot=slot)
             try:
                 st.next_pos = self._copy_cached_prefix(st, tel)
             except Exception as e:  # noqa: BLE001 - fail the request,
@@ -1139,6 +1185,7 @@ class GenerationScheduler:
             else:
                 pool.activate(st.slot, int(req.prompt[-1]), st.end_pos)
                 st.phase = "decode"
+                st.t_decode = time.perf_counter()
             return
         if self._claim_or_park(st, tel):
             return
@@ -1310,15 +1357,21 @@ class GenerationScheduler:
                 self._slot_state[st.slot] = None
             self._sweep_followers(tel)  # a parked follower re-claims
             return
-        dt = time.perf_counter() - t0
+        t1 = time.perf_counter()
+        dt = t1 - t0
         for st in sts:
             st.next_pos = st.end_pos
             self._store_prefix(st)
             self._release_claims(st)
+            if st.req.trace is not None:
+                request_trace.record_span(
+                    "request/prefill", t0, t1, ctx=st.req.trace,
+                    bucket=bucket, batched=len(sts))
             if self.role == "prefill":
                 self._complete_prefill_role(st, tel)
             else:
                 st.phase = "decode"
+                st.t_decode = t1
         self._sweep_followers(tel)
         with self._lock:
             self._prefill_calls += 1
@@ -1364,7 +1417,14 @@ class GenerationScheduler:
             self._slot_state[st.slot] = None
             self._sweep_followers(tel)  # a parked follower re-claims
             return
-        dt = time.perf_counter() - t0
+        t1 = time.perf_counter()
+        dt = t1 - t0
+        if st.req.trace is not None:
+            # one child span PER CHUNK: a slow prefill shows up in the
+            # assembled trace as which chunk stalled, not one blur
+            request_trace.record_span(
+                "request/prefill", t0, t1, ctx=st.req.trace,
+                chunk=w, index=s)
         st.next_pos = end if s + w >= end else s + w
         with self._lock:
             self._prefill_calls += 1
@@ -1381,6 +1441,7 @@ class GenerationScheduler:
             else:
                 pool.activate(st.slot, int(p[-1]), end)
                 st.phase = "decode"
+                st.t_decode = t1
             self._sweep_followers(tel)
 
     def _store_prefix(self, st: _ActiveSlot) -> None:
@@ -1456,7 +1517,10 @@ class GenerationScheduler:
         now = time.perf_counter()
         dt = now - t0
         emitted = 0
-        gaps: List[float] = []
+        # (gap_s, trace-or-None) pairs: the trace rides along so the
+        # inter-token histogram can attach an exemplar and the tail
+        # sampler can watermark the causing request, not just the value
+        gaps: List[tuple] = []
         finished: List[int] = []
         for slot in range(pool.slots):
             st = self._slot_state[slot]
@@ -1470,7 +1534,7 @@ class GenerationScheduler:
             if st.t_first is None:
                 st.t_first = now
             else:
-                gaps.append(now - st.t_last)
+                gaps.append((now - st.t_last, st.req.trace))
             st.t_last = now
             if st.req.on_token is not None:
                 try:
@@ -1490,7 +1554,7 @@ class GenerationScheduler:
             self._tokens_emitted += emitted
             self._decode_s += dt
             self._occupancy_sum += n_active
-            for g in gaps:
+            for g, _ in gaps:
                 self._itl_res.add(g)
         for slot in finished:
             st = self._slot_state[slot]
@@ -1513,24 +1577,45 @@ class GenerationScheduler:
             self._ttft_sum += ttft
             self._ttft_n += 1
             self._ttft_res.add(ttft)
+        if req.trace is not None:
+            # BEFORE set_result: the router's terminal callback files
+            # the trace the moment the future resolves, and these
+            # phase spans belong in it, not as late arrivals
+            request_trace.record_span(
+                "request/decode",
+                st.t_decode if st.t_decode is not None
+                else req.t_enqueue,
+                now, ctx=req.trace, new_tokens=len(st.emitted))
+            if st.t_first is not None and st.t_last is not None:
+                # retroactive: the emit span covers first->last token
+                request_trace.record_span(
+                    "request/emit", st.t_first, st.t_last,
+                    ctx=req.trace, tokens=len(st.emitted))
+            request_trace.observe_ttft(req.trace, ttft)
         # positions after EOS stay 0 — exactly generate()'s padding
         req.future.set_result(row)
         if tel:
             from bigdl_tpu.telemetry import families
             families.generation_queue_to_first_token_seconds().observe(
-                ttft)
+                ttft, exemplar=(req.trace.trace_id
+                                if req.trace is not None else None))
             tracing.record_span("serving/generate", req.t_enqueue, now,
                                 prompt_len=len(req.prompt),
                                 new_tokens=len(st.emitted))
 
     def _publish_telemetry(self, dt: float, n_active: int, emitted: int,
-                           gaps: List[float], now: float) -> None:
+                           gaps: List[tuple], now: float) -> None:
         from bigdl_tpu.telemetry import families
         families.generation_phase_seconds().labels("decode").observe(dt)
         families.generation_slot_occupancy().set(n_active / self.pool.slots)
         itl = families.generation_inter_token_seconds()
-        for g in gaps:
-            itl.observe(g)
+        for g, ctx in gaps:
+            # exemplar + watermark: a breached inter-token histogram
+            # bucket names the trace that put it there, and the tail
+            # sampler retains that trace even if the bulk ring drops it
+            itl.observe(g, exemplar=(ctx.trace_id if ctx is not None
+                                     else None))
+            request_trace.observe_inter_token(ctx, g)
         # tokens/s over a rolling ~0.5 s window (scheduler-thread-only
         # counters; the gauge is the published aggregate)
         self._tps_tokens += emitted

@@ -67,9 +67,14 @@ class DeadlineExceededError(RuntimeError):
     caller can tell "the system said no in time" from "the system
     failed"."""
 
-    def __init__(self, msg: str, stage: str = "queue"):
+    def __init__(self, msg: str, stage: str = "queue",
+                 trace_id: Optional[str] = None):
         super().__init__(msg)
         self.stage = stage
+        # the request's distributed-trace id when telemetry minted one
+        # — the caller's one-step path from a typed rejection to the
+        # retained timeline (/tracez?trace=<id>)
+        self.trace_id = trace_id
 
 
 class RequestCancelledError(RuntimeError):
@@ -89,17 +94,20 @@ class ReplicaDeadError(RuntimeError):
     ``prompt + tokens_already_emitted`` onto a survivor."""
 
 
-def deadline_error(stage: str, budget_s: float,
-                   elapsed_s: float) -> DeadlineExceededError:
+def deadline_error(stage: str, budget_s: float, elapsed_s: float,
+                   trace_id: Optional[str] = None) \
+        -> DeadlineExceededError:
     """Build the typed error AND count it — the one place
     ``request_deadline_exceeded_total{stage}`` ticks, so the metric
-    can never disagree with the rejections callers observed."""
+    can never disagree with the rejections callers observed.
+    ``trace_id`` stamps the rejection with the request's distributed
+    trace so the caller can resolve the breach to its timeline."""
     if telemetry.enabled():
         from bigdl_tpu.telemetry import families
         families.request_deadline_exceeded_total().labels(stage).inc()
     return DeadlineExceededError(
         f"deadline exceeded at {stage}: {elapsed_s:.3f}s elapsed of a "
-        f"{budget_s:.3f}s budget", stage=stage)
+        f"{budget_s:.3f}s budget", stage=stage, trace_id=trace_id)
 
 
 # ---------------------------------------------------------------------------
@@ -132,9 +140,10 @@ class Deadline:
     def expired(self, now: Optional[float] = None) -> bool:
         return self.remaining(now) <= 0.0
 
-    def error(self, stage: str,
-              now: Optional[float] = None) -> DeadlineExceededError:
-        return deadline_error(stage, self.budget_s, self.elapsed(now))
+    def error(self, stage: str, now: Optional[float] = None,
+              trace_id: Optional[str] = None) -> DeadlineExceededError:
+        return deadline_error(stage, self.budget_s, self.elapsed(now),
+                              trace_id=trace_id)
 
     def __repr__(self) -> str:
         return (f"Deadline(budget_s={self.budget_s}, "

@@ -48,11 +48,14 @@ from typing import Any, Callable, Dict, Optional
 
 import numpy as np
 
+from bigdl_tpu import telemetry
 from bigdl_tpu.serving.generation import GenerationScheduler
 from bigdl_tpu.serving.prefix_cache import PrefixKVCache
 from bigdl_tpu.serving.reliability import (
     Deadline, ReplicaTransportError,
 )
+from bigdl_tpu.telemetry import request_trace
+from bigdl_tpu.telemetry.request_trace import TraceContext
 from bigdl_tpu.telemetry.fleet import (
     host_stats, merge_host_snapshots, read_host_snapshots,
     remove_host_snapshot, write_host_snapshot,
@@ -216,14 +219,17 @@ class Replica:
         self._draining = False
         self._closed = False
         self._chaos_killed = False
-        # feature-detected once: may deadline= be forwarded verbatim?
-        # (third-party targets only need the PR-12 submit shape)
+        # feature-detected once: may deadline= / trace= be forwarded
+        # verbatim?  (third-party targets only need the PR-12 submit
+        # shape; the two capabilities are independent)
         try:
             import inspect
             sig = inspect.signature(target.submit_generate_async)
             self._accepts_deadline = "deadline" in sig.parameters
+            self._accepts_trace = "trace" in sig.parameters
         except (TypeError, ValueError):
             self._accepts_deadline = False
+            self._accepts_trace = False
         self.publish_interval_s = float(publish_interval_s)
         self._publisher: Optional[SnapshotPublisher] = None
         if snapshot_dir is not None:
@@ -258,7 +264,8 @@ class Replica:
     def submit_generate_async(self, prompt, max_new_tokens: int,
                               eos_id=None, on_token=None,
                               timeout: Optional[float] = None,
-                              deadline: Optional[Deadline] = None
+                              deadline: Optional[Deadline] = None,
+                              trace: Optional[TraceContext] = None
                               ) -> Future:
         # chaos transport faults, injected at the replica boundary —
         # the shape a flaky network or an overloaded frontend shows the
@@ -276,13 +283,17 @@ class Replica:
                 from bigdl_tpu.serving.admission import ServerClosedError
                 raise ServerClosedError(
                     f"replica {self.id} was chaos-killed")
+        # kwargs built per capability: deadline/trace acceptance are
+        # detected independently (a target may take either, both, or
+        # just the PR-12 shape)
+        kw: Dict[str, Any] = {}
         if deadline is not None and self._accepts_deadline:
-            return self.target.submit_generate_async(
-                prompt, max_new_tokens, eos_id=eos_id,
-                on_token=on_token, timeout=timeout, deadline=deadline)
+            kw["deadline"] = deadline
+        if trace is not None and self._accepts_trace:
+            kw["trace"] = trace
         return self.target.submit_generate_async(
             prompt, max_new_tokens, eos_id=eos_id, on_token=on_token,
-            timeout=timeout)
+            timeout=timeout, **kw)
 
     def cancel(self, fut: Future) -> bool:
         """Cancel a request previously submitted to this replica —
@@ -337,6 +348,11 @@ class Replica:
             return
         if self.snapshot_dir is not None:
             write_host_snapshot(self.snapshot_dir, self.snapshot())
+            if telemetry.enabled():
+                # trace spans ride the same transport as health: one
+                # atomic per-process shard next to the snapshot, so
+                # assemble_trace() stitches this replica's hops in
+                request_trace.write_trace_shard(self.snapshot_dir)
 
     def _chaos_kill(self, hard: bool = False) -> None:
         """Default: die the SIGTERM way — stop publishing
@@ -617,7 +633,8 @@ class DisaggregatedEngine:
     def submit_generate_async(self, prompt, max_new_tokens: int,
                               eos_id=None, on_token=None,
                               timeout: Optional[float] = None,
-                              deadline: Optional[Deadline] = None
+                              deadline: Optional[Deadline] = None,
+                              trace: Optional[TraceContext] = None
                               ) -> Future:
         with self._lock:
             if self._shutdown:
@@ -634,14 +651,15 @@ class DisaggregatedEngine:
                 # engine's own (bounded, sub-granule) prefill is the
                 # whole cost — skip the hop
                 self._to_decode(outer, p, max_new_tokens, eos_id,
-                                on_token, timeout, deadline)
+                                on_token, timeout, deadline, trace)
             else:
                 pf = self.prefill.submit_async(p, 0, timeout=timeout,
-                                               deadline=deadline)
+                                               deadline=deadline,
+                                               trace=trace)
                 pf.add_done_callback(
                     lambda f: self._after_prefill(
                         f, outer, p, max_new_tokens, eos_id, on_token,
-                        self.max_prefill_retries, deadline))
+                        self.max_prefill_retries, deadline, trace))
         except BaseException:
             # the done-callback never fires for a future that was
             # never resolved — rebalance the count before re-raising
@@ -666,7 +684,8 @@ class DisaggregatedEngine:
     def _after_prefill(self, pf: Future, outer: Future, prompt,
                        max_new_tokens, eos_id, on_token,
                        retries: int,
-                       deadline: Optional[Deadline] = None) -> None:
+                       deadline: Optional[Deadline] = None,
+                       trace: Optional[TraceContext] = None) -> None:
         if outer.cancelled():
             return
         region = prompt[:len(prompt) - 1]
@@ -683,11 +702,12 @@ class DisaggregatedEngine:
                 # full queue would deadlock it (the only consumer is
                 # the thread that would be waiting)
                 nf = self.prefill.submit_async(prompt, 0, timeout=0,
-                                               deadline=deadline)
+                                               deadline=deadline,
+                                               trace=trace)
                 nf.add_done_callback(
                     lambda f: self._after_prefill(
                         f, outer, prompt, max_new_tokens, eos_id,
-                        on_token, retries - 1, deadline))
+                        on_token, retries - 1, deadline, trace))
                 return
             except Exception:  # noqa: BLE001 - fall through to decode
                 pass
@@ -695,11 +715,12 @@ class DisaggregatedEngine:
         # decode serves it either way (it re-prefills anything missing
         # itself — bit-identity never depends on the cache)
         self._to_decode(outer, prompt, max_new_tokens, eos_id,
-                        on_token, 0, deadline)
+                        on_token, 0, deadline, trace)
 
     def _to_decode(self, outer: Future, prompt, max_new_tokens,
                    eos_id, on_token, timeout,
-                   deadline: Optional[Deadline] = None) -> None:
+                   deadline: Optional[Deadline] = None,
+                   trace: Optional[TraceContext] = None) -> None:
         """Hand one request to the decode engine.  ``timeout`` is the
         submitter's admission timeout on the direct (sub-granule)
         path; the prefill-completion path passes 0 — that callback
@@ -709,10 +730,18 @@ class DisaggregatedEngine:
         typed QueueFullError instead."""
         with self._lock:
             self._handoffs += 1
+        if trace is not None:
+            # marker span: the prefill->decode tier boundary is a hop
+            # the assembled trace must name, like a failover hop
+            t = time.perf_counter()
+            request_trace.record_span("request/handoff", t, t,
+                                      ctx=trace,
+                                      region_len=max(len(prompt) - 1, 0))
         try:
             df = self.decode.submit_async(
                 prompt, max_new_tokens, eos_id=eos_id,
-                on_token=on_token, timeout=timeout, deadline=deadline)
+                on_token=on_token, timeout=timeout, deadline=deadline,
+                trace=trace)
         except Exception as e:  # noqa: BLE001 - typed admission errors
             # (queue full, closed) land on the caller's future
             if outer.set_running_or_notify_cancel():

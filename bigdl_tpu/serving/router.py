@@ -96,6 +96,7 @@ from bigdl_tpu.serving.reliability import (
     ReplicaDeadError, ReplicaTransportError, RequestCancelledError,
 )
 from bigdl_tpu.telemetry import events as _events
+from bigdl_tpu.telemetry import request_trace
 
 __all__ = ["Router", "HashRing", "RouterRequest",
            "NoReplicaAvailableError"]
@@ -191,7 +192,7 @@ class RouterRequest:
                  "affinity_counted", "deadline", "tried", "attempts",
                  "not_before", "inners", "emitted", "hedge",
                  "hedge_dispatched", "primary_rid", "t_dispatch",
-                 "failovers", "cancel_requested")
+                 "failovers", "cancel_requested", "trace")
 
     def __init__(self, prompt, max_new_tokens: int, eos_id=None,
                  on_token=None, session: Optional[str] = None,
@@ -222,6 +223,10 @@ class RouterRequest:
         self.primary_rid: Optional[int] = None
         self.t_dispatch = 0.0
         self.cancel_requested = False
+        # TraceContext minted at router admission (None with telemetry
+        # off): rides the request through dispatch, the replica
+        # boundary, and every reliability hop
+        self.trace = None
 
 
 class Router:
@@ -475,6 +480,16 @@ class Router:
                 _user(tok)
 
             req.on_token = _recorded
+        # trace minted HERE, at admission: every later hop (dispatch,
+        # retry, hedge, failover, engine phases) files spans under this
+        # one id; with telemetry off mint() returns None and the
+        # request rides trace-free at zero cost
+        req.trace = request_trace.mint()
+        if req.trace is not None:
+            request_trace.record_span(
+                "request/admission", req.t_enqueue,
+                time.perf_counter(), ctx=req.trace, model=req.model,
+                session=req.session, hedge=req.hedge)
         req.future.add_done_callback(self._on_terminal)
         with self._lock:
             self._req_of[req.future] = req
@@ -787,7 +802,7 @@ class Router:
             inner = replica.submit_generate_async(
                 req.prompt, req.max_new_tokens, eos_id=req.eos_id,
                 on_token=req.on_token, timeout=0,
-                deadline=req.deadline)
+                deadline=req.deadline, trace=req.trace)
         except ReplicaTransportError:
             # the submit never reached the replica (chaos flake / a
             # real transport blip): always safe to retry — on a
@@ -840,6 +855,15 @@ class Router:
             if not twin and not req.future.done():
                 req.future.set_exception(e)
             return True
+        if req.trace is not None:
+            # marker span naming WHICH replica this hop landed on and
+            # WHY it is special (hedged twin / half-open breaker
+            # probe); state read before on_dispatch consumes the probe
+            t = time.perf_counter()
+            request_trace.record_span(
+                "request/dispatch", t, t, ctx=req.trace, replica=rid,
+                twin=twin,
+                probe=(self._breaker.state(rid) == "half_open"))
         self._breaker.on_dispatch(rid)
         hedge_arm = False
         with self._lock:
@@ -1044,6 +1068,13 @@ class Router:
             rep = replicas.get(r)
             if rep is None:
                 continue
+            if req.trace is not None:
+                # the losing twin appears in the trace as a cancelled
+                # hop, not a silent disappearance
+                t = time.perf_counter()
+                request_trace.record_span(
+                    "request/hedge_cancelled", t, t, ctx=req.trace,
+                    replica=int(r), winner=int(winner_rid))
             try:
                 rep.cancel(f)
             except Exception:  # noqa: BLE001 - loser cleanup is best
@@ -1057,7 +1088,16 @@ class Router:
             self._retries += 1
         _events.record_event("request_retry", replica=int(rid),
                              reason=reason, attempt=req.attempts,
-                             model=req.model)
+                             model=req.model,
+                             trace_id=(req.trace.trace_id
+                                       if req.trace is not None
+                                       else None))
+        if req.trace is not None:
+            t = time.perf_counter()
+            request_trace.record_span(
+                "request/retry", t, t, ctx=req.trace,
+                replica=int(rid), reason=reason,
+                attempt=req.attempts)
         if telemetry.enabled():
             from bigdl_tpu.telemetry import families
             families.router_retries_total().labels(reason).inc()
@@ -1069,7 +1109,20 @@ class Router:
         _events.record_event("generation_failover", replica=int(rid),
                              tokens_salvaged=int(salvaged),
                              remaining=int(req.max_new_tokens),
-                             model=req.model)
+                             model=req.model,
+                             trace_id=(req.trace.trace_id
+                                       if req.trace is not None
+                                       else None))
+        if req.trace is not None:
+            # a failed-over request is always tail-retained: the trace
+            # that explains "why did this request move replicas" must
+            # survive the bulk ring
+            request_trace.mark(req.trace, "failover")
+            t = time.perf_counter()
+            request_trace.record_span(
+                "request/failover", t, t, ctx=req.trace,
+                dead_replica=int(rid), salvaged=int(salvaged),
+                remaining=int(req.max_new_tokens))
         if telemetry.enabled():
             from bigdl_tpu.telemetry import families
             families.router_retries_total().labels("failover").inc()
@@ -1081,7 +1134,14 @@ class Router:
         with self._lock:
             self._hedges += 1
         _events.record_event("request_hedge", outcome=outcome,
-                             replica=int(winner_rid), model=req.model)
+                             replica=int(winner_rid), model=req.model,
+                             trace_id=(req.trace.trace_id
+                                       if req.trace is not None
+                                       else None))
+        if req.trace is not None and outcome == "hedge_won":
+            # only the interesting case retains: the hedge that SAVED
+            # the request is tail-worthy, a primary win is bulk
+            request_trace.mark(req.trace, "hedge_won")
         if telemetry.enabled():
             from bigdl_tpu.telemetry import families
             families.router_hedges_total().labels(outcome).inc()
@@ -1098,7 +1158,10 @@ class Router:
         _events.record_event("router_shed", reason=reason,
                              queued_s=round(waited_s, 6),
                              model=req.model,
-                             session=req.session)
+                             session=req.session,
+                             trace_id=(req.trace.trace_id
+                                       if req.trace is not None
+                                       else None))
         if telemetry.enabled():
             from bigdl_tpu.telemetry import families
             families.router_shed_total().labels(reason).inc()
@@ -1106,7 +1169,9 @@ class Router:
             # the request's own budget ran out in the queue: the typed
             # deadline error (which ticks the per-stage metric) is the
             # verdict, not a generic shed
-            exc = req.deadline.error("queue")
+            exc = req.deadline.error(
+                "queue", trace_id=(req.trace.trace_id
+                                   if req.trace is not None else None))
         elif reason == "slo":
             exc = RequestSheddedError(
                 f"shed after {waited_s:.3f}s: every eligible replica "
@@ -1135,6 +1200,7 @@ class Router:
             families.router_shed_total().labels("queue_full").inc()
 
     def _on_terminal(self, fut: Future) -> None:
+        exc = None
         if fut.cancelled():
             outcome = "rejected"
         else:
@@ -1152,7 +1218,17 @@ class Router:
                 outcome = "failed"
         with self._lock:
             self._outcomes[outcome] = self._outcomes.get(outcome, 0) + 1
-            self._req_of.pop(fut, None)
+            req = self._req_of.pop(fut, None)
+        if req is not None and req.trace is not None:
+            # tail-retention verdicts the router itself can render,
+            # then terminal filing: the trace moves from the active
+            # table to retained (marked) or the droppable bulk ring
+            if isinstance(exc, DeadlineExceededError):
+                request_trace.mark(req.trace, "deadline")
+            elif isinstance(exc, (RequestSheddedError,
+                                  NoReplicaAvailableError)):
+                request_trace.mark(req.trace, "shed")
+            request_trace.finish(req.trace, outcome=outcome)
         if telemetry.enabled():
             from bigdl_tpu.telemetry import families
             families.router_requests_total().labels(outcome).inc()

@@ -277,7 +277,7 @@ class ModelServer:
     def submit_generate_async(self, prompt, max_new_tokens: int,
                               eos_id=None, on_token=None,
                               timeout: Optional[float] = None,
-                              deadline=None) -> Future:
+                              deadline=None, trace=None) -> Future:
         """Admit one prompt into the continuous-batching decode engine;
         returns a Future of the full ``[Tp + max_new_tokens]`` token row
         (greedy, bit-identical to a solo ``model.generate()``).  Unlike
@@ -285,10 +285,13 @@ class ModelServer:
         slot for many decode iterations, and drain waits for every
         admitted request's last token.  ``deadline`` (a
         :class:`~bigdl_tpu.serving.reliability.Deadline`) propagates
-        the caller's end-to-end budget into the engine."""
+        the caller's end-to-end budget into the engine; ``trace`` (a
+        :class:`~bigdl_tpu.telemetry.request_trace.TraceContext`)
+        carries the request's distributed-trace identity so the engine
+        files its queue/prefill/decode spans under it."""
         return self._gen().submit_async(
             prompt, max_new_tokens, eos_id=eos_id, on_token=on_token,
-            timeout=timeout, deadline=deadline)
+            timeout=timeout, deadline=deadline, trace=trace)
 
     def cancel_generate(self, fut: Future) -> bool:
         """Best-effort cancel of a generation future — queued requests

@@ -30,7 +30,11 @@ from bigdl_tpu.telemetry.metrics import (      # noqa: F401
 )
 from bigdl_tpu.telemetry.tracing import (      # noqa: F401
     span, record_span, current_span, propagate, finished_spans,
-    reset_spans, set_ring_capacity, chrome_trace, write_chrome_trace,
+    dropped_spans, reset_spans, set_ring_capacity, chrome_trace,
+    write_chrome_trace, merge_chrome_traces,
+)
+from bigdl_tpu.telemetry.request_trace import (  # noqa: F401
+    TraceContext, assemble_trace, write_trace_shard, reset_traces,
 )
 from bigdl_tpu.telemetry.export import (       # noqa: F401
     prometheus_text, json_snapshot, publish_summary, PeriodicExporter,
@@ -44,8 +48,10 @@ __all__ = [
     "enable", "disable", "enabled", "reset",
     "Counter", "Gauge", "Histogram", "TelemetryRegistry", "get_registry",
     "span", "record_span", "current_span", "propagate", "finished_spans",
-    "reset_spans", "set_ring_capacity", "chrome_trace",
-    "write_chrome_trace",
+    "dropped_spans", "reset_spans", "set_ring_capacity", "chrome_trace",
+    "write_chrome_trace", "merge_chrome_traces",
+    "TraceContext", "assemble_trace", "write_trace_shard",
+    "reset_traces",
     "prometheus_text", "json_snapshot", "publish_summary",
     "PeriodicExporter",
     "record_event", "recent_events", "event_counts", "dropped_events",
@@ -79,11 +85,12 @@ def enabled() -> bool:
 
 def reset() -> None:
     """Test-friendly full reset: zero every metric in place (handles
-    stay valid), drop all buffered spans, and clear the flight
-    recorder."""
+    stay valid), drop all buffered spans, request traces, and the
+    flight recorder."""
     get_registry().reset()
     reset_spans()
     reset_events()
+    reset_traces()
 
 
 if _os.environ.get("BIGDL_TPU_TELEMETRY", "").lower() in (

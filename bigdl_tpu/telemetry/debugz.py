@@ -12,7 +12,11 @@ restarting anything:
   watchdog state; the serving CLI: model, queue depth, drain state).
 * ``GET  /tracez``   — the newest spans from the PR-3 ring buffer
   (``?limit=N``, default 200), so "where did the last second go" is a
-  curl away.
+  curl away.  ``?name=<prefix>`` filters spans by name prefix
+  (``name=request/`` shows only request-journey spans);
+  ``?trace=<id>`` instead returns ONE assembled request trace — every
+  hop on every replica, stitched across processes — which is how a
+  TTFT exemplar's trace id resolves to its timeline in one step.
 * ``POST /profilez`` — a time-boxed ``jax.profiler`` capture (body:
   ``{"duration_s": 1.0, "logdir": "..."}``, both optional) via
   ``optim.profiling.profile_trace``; returns the logdir to point
@@ -60,8 +64,12 @@ class Debugz:
     the status page — a zero-arg callable returning a JSON-able dict,
     merged over the base fields."""
 
-    def __init__(self, statusz_fn: Optional[Callable[[], Dict]] = None):
+    def __init__(self, statusz_fn: Optional[Callable[[], Dict]] = None,
+                 trace_shard_dir: Optional[str] = None):
         self.statusz_fn = statusz_fn
+        # where request-trace shards live (the serving snapshot dir):
+        # lets /tracez?trace=<id> stitch spans from OTHER processes
+        self.trace_shard_dir = trace_shard_dir
         self._t0 = time.perf_counter()
         self._profile_busy = threading.Lock()
 
@@ -106,9 +114,23 @@ class Debugz:
                 base.update(extra)
         return base
 
-    def tracez(self, limit: int = 200) -> Dict:
+    def tracez(self, limit: int = 200,
+               name: Optional[str] = None,
+               trace: Optional[str] = None) -> Dict:
         from bigdl_tpu.telemetry import tracing
+        from bigdl_tpu.telemetry import request_trace
+        if trace is not None:
+            # assembled-request mode: one stitched timeline, shards
+            # read from the serving snapshot dir when one is known
+            assembled = request_trace.assemble_trace(
+                str(trace), directory=self.trace_shard_dir)
+            if assembled is None:
+                raise KeyError(f"unknown trace id {trace!r}")
+            return {"trace": assembled,
+                    "retained": list(request_trace.retained_ids())}
         spans = tracing.finished_spans()
+        if name is not None:
+            spans = [r for r in spans if r.name.startswith(str(name))]
         limit = max(int(limit), 0)
         out = []
         # NOT spans[-limit:]: a -0 slice is the whole ring, and
@@ -124,9 +146,12 @@ class Debugz:
             if rec.args:
                 d["args"] = rec.args
             out.append(d)
-        return {"buffered": len(spans),
+        resp = {"buffered": len(spans),
                 "dropped": tracing.dropped_spans(),
                 "limit": limit, "spans": out}
+        if name is not None:
+            resp["name"] = str(name)
+        return resp
 
     def profilez(self, duration_s: float = 1.0,
                  logdir: Optional[str] = None) -> Dict:
@@ -184,12 +209,27 @@ class DebugzHandlerMixin:
             return True
         if method == "GET" and path == "/tracez":
             params = urllib.parse.parse_qs(query)
+            unknown = set(params) - {"limit", "name", "trace"}
+            if unknown:
+                self._debugz_json(
+                    400, {"error": "unknown tracez params: "
+                          + ", ".join(sorted(unknown))})
+                return True
             try:
                 limit = int(params.get("limit", ["200"])[0])
             except ValueError:
                 self._debugz_json(400, {"error": "limit must be an int"})
                 return True
-            self._debugz_json(200, dz.tracez(limit=limit))
+            name = params.get("name", [None])[0]
+            trace = params.get("trace", [None])[0]
+            try:
+                resp = dz.tracez(limit=limit, name=name, trace=trace)
+            except KeyError as e:
+                # an unknown trace id is the CLIENT's bad parameter,
+                # same contract as /profilez's 400 on a bad body
+                self._debugz_json(400, {"error": str(e.args[0])})
+                return True
+            self._debugz_json(200, resp)
             return True
         if method == "POST" and path == "/profilez":
             n = int(self.headers.get("Content-Length", 0) or 0)

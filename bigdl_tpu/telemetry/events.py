@@ -24,6 +24,15 @@ which is the part a postmortem reads first.
                         resume_from="ckpt/checkpoint.12.npz")
     ...
     events.dump_events("flight_recorder.json")
+
+Request-scoped cross-reference: events describing one routed request's
+journey (``request_retry`` / ``request_hedge`` / ``router_shed`` /
+``generation_failover``) carry an optional ``trace_id`` field naming
+the request's distributed trace when telemetry is on (None otherwise)
+— a failover event in the black box and its assembled timeline at
+``/tracez?trace=<id>`` point at each other.  It is an ordinary field:
+the recorder itself stays trace-agnostic, and each event kind keeps
+its single emission site.
 """
 
 from __future__ import annotations

@@ -477,6 +477,37 @@ def request_deadline_exceeded_total() -> Counter:
         "sweep)", labelnames=("stage",))
 
 
+# -- request-scoped distributed tracing (telemetry.request_trace) ------------
+
+def request_traces_retained_total() -> Counter:
+    return get_registry().counter(
+        "request_traces_retained_total",
+        "Completed request traces kept by tail-based retention, by "
+        "reason: deadline (the request's budget expired), shed (typed "
+        "rejection under overload), failover (a mid-stream replay "
+        "moved it between replicas), hedge_won (the hedged twin beat "
+        "the primary), slow_ttft / slow_inter_token (latency above "
+        "the rolling percentile watermark) — the p99 requests a "
+        "uniform sampler would drop", labelnames=("reason",))
+
+
+def request_trace_spans_total() -> Counter:
+    return get_registry().counter(
+        "request_trace_spans_total",
+        "Spans recorded into request-scoped traces (admission, "
+        "dispatch, queue, prefill, decode, handoff, and every "
+        "reliability hop) — volume of the per-trace store, retained "
+        "and bulk alike")
+
+
+def request_traces_dropped_total() -> Counter:
+    return get_registry().counter(
+        "request_traces_dropped_total",
+        "Completed request traces evicted unretained from the bounded "
+        "bulk ring (healthy traffic sampled out by design; a retained "
+        "trace is never counted here)")
+
+
 # ---- sharded embedding tables (embedding/) --------------------------------
 
 def embedding_lookup_ids_total() -> Counter:
@@ -577,6 +608,8 @@ _PREREGISTER = (
     router_requests_total, router_replica_inflight, router_shed_total,
     router_retries_total, router_hedges_total,
     router_breaker_transitions_total, request_deadline_exceeded_total,
+    request_traces_retained_total, request_trace_spans_total,
+    request_traces_dropped_total,
     fleet_replicas_desired, fleet_replicas_live,
     fleet_scale_events_total, fleet_deploy_freshness_seconds,
     embedding_lookup_ids_total, embedding_unique_id_fraction,

@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import bisect
 import threading
+import time
 from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
 
 __all__ = ["Counter", "Gauge", "Histogram", "TelemetryRegistry",
@@ -194,12 +195,17 @@ class Gauge(_Metric):
 
 
 class _HistChild:
-    __slots__ = ("counts", "sum", "count")
+    __slots__ = ("counts", "sum", "count", "exemplars")
 
     def __init__(self, n_buckets: int):
         self.counts = [0] * n_buckets
         self.sum = 0.0
         self.count = 0
+        # bucket index -> {"value", "trace_id", "time"}: the most
+        # recent exemplar-tagged observation landing in that bucket,
+        # so a histogram breach resolves to the trace that caused it.
+        # Lazily populated; {} until an observe passes an exemplar.
+        self.exemplars: Dict[int, Dict] = {}
 
 
 class Histogram(_Metric):
@@ -228,31 +234,46 @@ class Histogram(_Metric):
         child.counts = [0] * len(child.counts)
         child.sum = 0.0
         child.count = 0
+        child.exemplars = {}
 
-    def _op_observe(self, child, v: float) -> None:
+    def _op_observe(self, child, v: float,
+                    exemplar: Optional[str] = None) -> None:
         i = bisect.bisect_left(self.buckets, v)
         with self._lock:
             child.counts[i] += 1
             child.sum += v
             child.count += 1
+            if exemplar is not None:
+                # graftlint: disable=clock-discipline -- an exemplar's
+                # timestamp is a cross-process record (it names a trace
+                # another process may assemble), so it lives on the
+                # shared wall clock, not this process's perf_counter
+                child.exemplars[i] = {"value": float(v),
+                                      "trace_id": str(exemplar),
+                                      "time": time.time()}
+
+    @staticmethod
+    def _child_dump(buckets, c) -> Dict:
+        out = {"buckets": list(buckets), "counts": list(c.counts),
+               "sum": c.sum, "count": c.count}
+        if c.exemplars:
+            out["exemplars"] = {i: dict(e)
+                                for i, e in c.exemplars.items()}
+        return out
 
     def _op_snapshot(self, child) -> Dict:
         with self._lock:
-            return {"buckets": list(self.buckets),
-                    "counts": list(child.counts),
-                    "sum": child.sum, "count": child.count}
+            return self._child_dump(self.buckets, child)
 
-    def observe(self, v: float) -> None:
-        self._op_observe(self._default_child(), v)
+    def observe(self, v: float, exemplar: Optional[str] = None) -> None:
+        self._op_observe(self._default_child(), v, exemplar=exemplar)
 
     def snapshot(self) -> Dict:
         return self._op_snapshot(self._default_child())
 
     def samples(self) -> List[Tuple[Tuple[str, ...], Dict]]:
         with self._lock:
-            return [(k, {"buckets": list(self.buckets),
-                         "counts": list(c.counts),
-                         "sum": c.sum, "count": c.count})
+            return [(k, self._child_dump(self.buckets, c))
                     for k, c in sorted(self._children.items())]
 
 

@@ -1,0 +1,449 @@
+"""Request-scoped distributed tracing: one assembled timeline per
+request, across every replica it touched.
+
+The process-local span ring (:mod:`bigdl_tpu.telemetry.tracing`)
+answers "where did THIS process's wall time go"; it cannot answer "why
+did request X breach its TTFT SLO" once the serving fabric moves a
+request between replicas — retries, hedged twins, mid-stream failover,
+the disaggregated prefill→decode handoff.  This module adds the
+request-scoped layer (Dapper, Sigelman et al. 2010):
+
+* A :class:`TraceContext` (``trace_id`` + parent span id + origin pid)
+  is minted at router admission and rides the request object through
+  dispatch, the replica boundary, and the generation engine.  With
+  telemetry disabled nothing is minted: the request carries ``None``
+  and every instrumentation site pays the existing one-bool check.
+* :func:`record_span` records a span BOTH into the process ring (with
+  a ``trace_id`` arg, so ``/tracez`` and Chrome export cross-reference)
+  and into a per-trace buffer here.
+* **Tail-based retention** ("The Tail at Scale", Dean & Barroso 2013):
+  completed traces land in a bounded bulk ring that drops healthy
+  traffic by design, EXCEPT traces marked interesting — deadline
+  expiry, shed, failover, hedge-won, TTFT / inter-token latency above
+  a rolling percentile watermark — which move to the retained store.
+  The p99 request is exactly the one a uniform sampler loses.
+* **Cross-process stitching** rides the fleet file transport: a
+  process drops its per-trace spans as an atomic JSON shard (the way
+  replicas write health snapshots), wall-converted through its own
+  ``wall_time_of`` anchor pair at write time, so
+  :func:`assemble_trace` merges shards from any number of processes
+  onto one wall-clock axis with no further rebasing.
+
+Exemplars: the engine tags its TTFT / inter-token histogram
+observations with the trace id (``Histogram.observe(v, exemplar=...)``)
+so a metric breach on ``/statusz`` resolves in one step to the causing
+trace via ``/tracez?trace=<id>``.
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import itertools
+import json
+import os
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Any, Dict, List, Optional
+
+from bigdl_tpu.telemetry import tracing
+
+__all__ = ["TraceContext", "mint", "record_span", "mark", "finish",
+           "observe_ttft", "observe_inter_token", "assemble_trace",
+           "write_trace_shard", "trace_ids", "retained_ids",
+           "retained_reasons", "set_bulk_capacity",
+           "set_retained_capacity", "reset_traces",
+           "RETENTION_REASONS", "SHARD_PREFIX"]
+
+# The retention vocabulary (docs/observability.md "Request tracing"):
+# every mark() reason must come from here so the
+# request_traces_retained_total{reason} label set stays bounded.
+RETENTION_REASONS = ("deadline", "shed", "failover", "hedge_won",
+                     "slow_ttft", "slow_inter_token")
+
+SHARD_PREFIX = "trace_spans_"
+
+_DEFAULT_BULK = 256          # completed healthy traces kept (ring)
+_DEFAULT_RETAINED = 256      # completed marked traces kept (FIFO)
+_WATERMARK_WINDOW = 512      # latency samples backing the watermark
+_WATERMARK_MIN_SAMPLES = 30  # no watermark verdicts before this many
+_WATERMARK_QUANTILE = 0.95   # "above the percentile watermark"
+_WATERMARK_REFRESH = 32      # recompute cadence (samples)
+
+# process tag: pid alone recycles; two random bytes make a trace id
+# minted after a pid reuse distinguishable in a shared shard directory
+_PROC_TAG = f"{os.getpid():x}-{os.urandom(2).hex()}"
+_ids = itertools.count(1)
+
+_lock = threading.Lock()
+_active: Dict[str, "_Trace"] = {}
+_bulk: "OrderedDict[str, _Trace]" = OrderedDict()
+_retained: "OrderedDict[str, _Trace]" = OrderedDict()
+_bulk_capacity = _DEFAULT_BULK
+_retained_capacity = _DEFAULT_RETAINED
+
+
+class TraceContext:
+    """What rides the request object.  Allocation-light on purpose —
+    minted once per admitted request, only when telemetry is on."""
+
+    __slots__ = ("trace_id", "parent_span_id", "origin_pid")
+
+    def __init__(self, trace_id: str,
+                 parent_span_id: Optional[int] = None,
+                 origin_pid: Optional[int] = None):
+        self.trace_id = trace_id
+        self.parent_span_id = parent_span_id
+        self.origin_pid = (os.getpid() if origin_pid is None
+                           else int(origin_pid))
+
+    def __repr__(self) -> str:
+        return (f"TraceContext({self.trace_id!r}, "
+                f"parent={self.parent_span_id}, "
+                f"pid={self.origin_pid})")
+
+
+class _Trace:
+    __slots__ = ("trace_id", "origin_pid", "t_start_wall", "spans",
+                 "marks", "outcome")
+
+    def __init__(self, trace_id: str, origin_pid: int):
+        self.trace_id = trace_id
+        self.origin_pid = origin_pid
+        self.t_start_wall = time.time()
+        self.spans: List[Dict[str, Any]] = []
+        self.marks: List[str] = []
+        self.outcome: Optional[str] = None
+
+
+class _Reservoir:
+    """Rolling latency window with a cached percentile watermark.
+    ``over(v)`` is O(1) between refreshes — it runs per emitted token
+    on the inter-token side, so no per-call sort."""
+
+    __slots__ = ("values", "watermark", "_since_refresh")
+
+    def __init__(self):
+        self.values: deque = deque(maxlen=_WATERMARK_WINDOW)
+        self.watermark: Optional[float] = None
+        self._since_refresh = 0
+
+    def over(self, v: float) -> bool:
+        self.values.append(float(v))
+        self._since_refresh += 1
+        if (self.watermark is None
+                or self._since_refresh >= _WATERMARK_REFRESH):
+            self._since_refresh = 0
+            if len(self.values) >= _WATERMARK_MIN_SAMPLES:
+                s = sorted(self.values)
+                self.watermark = s[min(
+                    int(_WATERMARK_QUANTILE * len(s)), len(s) - 1)]
+        return self.watermark is not None and v > self.watermark
+
+    def reset(self) -> None:
+        self.values.clear()
+        self.watermark = None
+        self._since_refresh = 0
+
+
+_ttft_res = _Reservoir()
+_itl_res = _Reservoir()
+
+
+def _enabled() -> bool:
+    from bigdl_tpu import telemetry
+    return telemetry.enabled()
+
+
+def _counters():
+    from bigdl_tpu.telemetry import families
+    return families
+
+
+# ---- the write side --------------------------------------------------------
+
+def mint(parent_span_id: Optional[int] = None) -> Optional[TraceContext]:
+    """A fresh context for one admitted request, or None with
+    telemetry disabled (the request object then carries None and the
+    fabric's instrumentation sites all no-op on the existing bool)."""
+    if not _enabled():
+        return None
+    tid = f"{_PROC_TAG}-{next(_ids):x}"
+    ctx = TraceContext(tid, parent_span_id=parent_span_id)
+    with _lock:
+        _active[tid] = _Trace(tid, ctx.origin_pid)
+    return ctx
+
+
+def record_span(name: str, t_start: float, t_end: float,
+                ctx: Optional[TraceContext] = None,
+                parent_id: Optional[int] = None,
+                **args) -> Optional[int]:
+    """Record one span of ``ctx``'s trace (no-op when ``ctx`` is None
+    or telemetry is off).  Endpoints on ``time.perf_counter`` like
+    every span; the trace id lands in the ring span's args so the
+    process-local ``/tracez`` view and the Chrome export carry the
+    cross-reference.  Returns the ring span id."""
+    if ctx is None or not _enabled():
+        return None
+    sid = tracing.record_span(name, t_start, t_end,
+                              parent_id=(parent_id if parent_id
+                                         is not None
+                                         else ctx.parent_span_id),
+                              trace_id=ctx.trace_id, **args)
+    rec = {"name": name,
+           # graftlint: disable=clock-discipline -- wall conversion at
+           # record time IS the sanctioned bridge (wall_time_of): trace
+           # spans are merged across processes, where perf_counter
+           # values are not comparable
+           "t_start_wall": tracing.wall_time_of(t_start),
+           "t_end_wall": tracing.wall_time_of(t_end),
+           "duration_s": max(float(t_end) - float(t_start), 0.0),
+           "span_id": sid, "pid": os.getpid(),
+           "args": args or None}
+    with _lock:
+        tr = _active.get(ctx.trace_id)
+        if tr is None:
+            # late span for an already-finished trace (an engine
+            # callback racing terminal accounting): attach if the
+            # trace is still held anywhere, else drop silently
+            tr = _retained.get(ctx.trace_id) or _bulk.get(ctx.trace_id)
+        if tr is not None:
+            tr.spans.append(rec)
+    if tr is not None:
+        _counters().request_trace_spans_total().inc()
+    return sid
+
+
+def mark(ctx: Optional[TraceContext], reason: str) -> None:
+    """Flag ``ctx``'s trace for tail retention.  ``reason`` must come
+    from :data:`RETENTION_REASONS` (the metric label vocabulary).  A
+    mark landing AFTER terminal filing (a hedge verdict resolving just
+    behind the future) promotes the trace out of the droppable bulk
+    ring — interesting-late is still interesting."""
+    if ctx is None or not _enabled():
+        return
+    if reason not in RETENTION_REASONS:
+        raise ValueError(f"unknown retention reason {reason!r}; "
+                         f"expected one of {RETENTION_REASONS}")
+    promoted = False
+    with _lock:
+        tr = (_active.get(ctx.trace_id)
+              or _retained.get(ctx.trace_id))
+        if tr is None:
+            tr = _bulk.pop(ctx.trace_id, None)
+            if tr is not None:
+                _retained[ctx.trace_id] = tr
+                while len(_retained) > _retained_capacity:
+                    _retained.popitem(last=False)
+                promoted = True
+        if tr is not None and reason not in tr.marks:
+            tr.marks.append(reason)
+        else:
+            promoted = False    # duplicate reason: nothing new to count
+    if promoted:
+        # finish() already ran and counted nothing for this trace (it
+        # was unmarked then) — the retained tick happens here instead
+        _counters().request_traces_retained_total().labels(reason).inc()
+
+
+def finish(ctx: Optional[TraceContext],
+           outcome: Optional[str] = None) -> None:
+    """Terminal accounting for one request's trace: marked traces move
+    to the retained store (FIFO-bounded), unmarked ones to the bulk
+    ring whose evictions are the sampled-out healthy traffic."""
+    if ctx is None:
+        return
+    reasons: List[str] = []
+    dropped = 0
+    with _lock:
+        tr = _active.pop(ctx.trace_id, None)
+        if tr is None:
+            return
+        tr.outcome = outcome
+        if tr.marks:
+            reasons = list(tr.marks)
+            _retained[ctx.trace_id] = tr
+            while len(_retained) > _retained_capacity:
+                _retained.popitem(last=False)
+        else:
+            _bulk[ctx.trace_id] = tr
+            while len(_bulk) > _bulk_capacity:
+                _bulk.popitem(last=False)
+                dropped += 1
+    if not _enabled():
+        return
+    fam = _counters()
+    for r in reasons:
+        fam.request_traces_retained_total().labels(r).inc()
+    if dropped:
+        fam.request_traces_dropped_total().inc(dropped)
+
+
+def observe_ttft(ctx: Optional[TraceContext], ttft_s: float) -> None:
+    """Feed the TTFT watermark; marks ``slow_ttft`` when this request
+    sits above the rolling p95 of recent traffic."""
+    if ctx is None or not _enabled():
+        return
+    with _lock:
+        slow = _ttft_res.over(ttft_s)
+    if slow:
+        mark(ctx, "slow_ttft")
+
+
+def observe_inter_token(ctx: Optional[TraceContext],
+                        gap_s: float) -> None:
+    """Feed the inter-token watermark; marks ``slow_inter_token`` when
+    one streaming gap sits above the rolling p95."""
+    if ctx is None or not _enabled():
+        return
+    with _lock:
+        slow = _itl_res.over(gap_s)
+    if slow:
+        mark(ctx, "slow_inter_token")
+
+
+# ---- cross-process stitching (fleet file transport) ------------------------
+
+def write_trace_shard(directory: str) -> Optional[str]:
+    """Atomically drop this process's per-trace spans as
+    ``trace_spans_<pid>.json`` under ``directory`` — the fleet
+    snapshot idiom (unique tmp per pid+thread, then ``os.replace``; a
+    merger must never read a torn write).  Spans are already
+    wall-converted, so the reader needs no anchor math.  Returns the
+    path, or None when there is nothing to write."""
+    with _lock:
+        traces: Dict[str, Dict[str, Any]] = {}
+        for store in (_active, _retained, _bulk):
+            for tid, tr in store.items():
+                if tr.spans:
+                    traces[tid] = {"origin_pid": tr.origin_pid,
+                                   "marks": list(tr.marks),
+                                   "outcome": tr.outcome,
+                                   "spans": list(tr.spans)}
+    if not traces:
+        return None
+    os.makedirs(directory, exist_ok=True)
+    payload = {"pid": os.getpid(), "time": time.time(),
+               "traces": traces}
+    path = os.path.join(directory, f"{SHARD_PREFIX}{os.getpid()}.json")
+    tmp = f"{path}.{os.getpid()}.{threading.get_ident()}.tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(payload, f)
+    os.replace(tmp, path)
+    return path
+
+
+def _read_shards(directory: str,
+                 trace_id: str) -> List[Dict[str, Any]]:
+    out: List[Dict[str, Any]] = []
+    for path in sorted(_glob.glob(
+            os.path.join(directory, SHARD_PREFIX + "*.json"))):
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                payload = json.load(f)
+            entry = payload["traces"].get(trace_id)
+        except Exception:
+            continue        # torn/corrupt shard: skip, like the fleet
+        if entry:
+            out.append(entry)
+    return out
+
+
+def assemble_trace(trace_id: str,
+                   directory: Optional[str] = None) \
+        -> Optional[Dict[str, Any]]:
+    """ONE timeline for ``trace_id``: local spans (live, retained, or
+    bulk) merged with any per-process shards under ``directory``, all
+    on the wall clock, sorted by start time.  Every replica the
+    request touched appears by pid and span args; None when the trace
+    is unknown everywhere."""
+    spans: List[Dict[str, Any]] = []
+    marks: List[str] = []
+    outcome = None
+    origin_pid = None
+    found = False
+    with _lock:
+        tr = (_active.get(trace_id) or _retained.get(trace_id)
+              or _bulk.get(trace_id))
+        if tr is not None:
+            found = True
+            spans.extend(dict(s) for s in tr.spans)
+            marks.extend(tr.marks)
+            outcome = tr.outcome
+            origin_pid = tr.origin_pid
+    if directory is not None:
+        local = {(s["pid"], s["span_id"]) for s in spans}
+        for entry in _read_shards(directory, trace_id):
+            found = True
+            if origin_pid is None:
+                origin_pid = entry.get("origin_pid")
+            for r in entry.get("marks", []):
+                if r not in marks:
+                    marks.append(r)
+            if outcome is None:
+                outcome = entry.get("outcome")
+            for s in entry.get("spans", []):
+                key = (s.get("pid"), s.get("span_id"))
+                if key in local:    # our own shard re-read: dedup
+                    continue
+                spans.append(dict(s))
+    if not found:
+        return None
+    spans.sort(key=lambda s: (s.get("t_start_wall", 0.0),
+                              s.get("t_end_wall", 0.0)))
+    pids = sorted({s.get("pid") for s in spans if s.get("pid")})
+    return {"trace_id": trace_id, "origin_pid": origin_pid,
+            "retained_reasons": marks, "outcome": outcome,
+            "pids": pids, "spans": spans,
+            "names": [s["name"] for s in spans]}
+
+
+# ---- reading / lifecycle ---------------------------------------------------
+
+def trace_ids() -> List[str]:
+    """Every trace id currently held (open, retained, or bulk)."""
+    with _lock:
+        return list(_active) + list(_retained) + list(_bulk)
+
+
+def retained_ids() -> List[str]:
+    with _lock:
+        return list(_retained)
+
+
+def retained_reasons() -> Dict[str, List[str]]:
+    """trace_id -> retention reasons, for the retained store only."""
+    with _lock:
+        return {tid: list(tr.marks) for tid, tr in _retained.items()}
+
+
+def set_bulk_capacity(n: int) -> None:
+    global _bulk_capacity
+    if n < 1:
+        raise ValueError("bulk capacity must be >= 1")
+    with _lock:
+        _bulk_capacity = int(n)
+        while len(_bulk) > _bulk_capacity:
+            _bulk.popitem(last=False)
+
+
+def set_retained_capacity(n: int) -> None:
+    global _retained_capacity
+    if n < 1:
+        raise ValueError("retained capacity must be >= 1")
+    with _lock:
+        _retained_capacity = int(n)
+        while len(_retained) > _retained_capacity:
+            _retained.popitem(last=False)
+
+
+def reset_traces() -> None:
+    """Drop every held trace and both watermark reservoirs (wired into
+    ``telemetry.reset()`` so tests start clean); capacities persist."""
+    with _lock:
+        _active.clear()
+        _bulk.clear()
+        _retained.clear()
+        _ttft_res.reset()
+        _itl_res.reset()

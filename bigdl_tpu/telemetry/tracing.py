@@ -38,8 +38,9 @@ from contextlib import contextmanager
 from typing import Dict, Iterator, List, Optional
 
 __all__ = ["span", "record_span", "current_span", "propagate",
-           "finished_spans", "reset_spans", "set_ring_capacity",
-           "chrome_trace", "write_chrome_trace", "wall_time_of"]
+           "finished_spans", "dropped_spans", "reset_spans",
+           "set_ring_capacity", "chrome_trace", "write_chrome_trace",
+           "merge_chrome_traces", "wall_time_of"]
 
 # The clock contract (enforced tree-wide by graftlint's
 # clock-discipline pass, docs/static_analysis.md):
@@ -226,8 +227,12 @@ def chrome_trace() -> Dict:
             "tid": rec.thread,
             "args": args,
         })
+    # epoch_wall anchors this file's ts=0 on the shared wall clock, so
+    # merge_chrome_traces can re-base per-process timelines onto one
+    # axis (each process's perf_counter starts at an arbitrary zero)
     return {"traceEvents": events, "displayTimeUnit": "ms",
-            "otherData": {"dropped_spans": dropped_spans()}}
+            "otherData": {"dropped_spans": dropped_spans(),
+                          "epoch_wall": _EPOCH_WALL}}
 
 
 def write_chrome_trace(path: str) -> str:
@@ -235,3 +240,43 @@ def write_chrome_trace(path: str) -> str:
     with open(path, "w", encoding="utf-8") as f:
         json.dump(chrome_trace(), f)
     return path
+
+
+def merge_chrome_traces(paths) -> Dict:
+    """Merge per-process Chrome trace files into ONE Perfetto-loadable
+    timeline.  Each file's ``ts`` values are relative to its own
+    process's perf_counter zero; the ``otherData.epoch_wall`` anchor
+    (written by :func:`chrome_trace`) says where that zero sits on the
+    shared wall clock, so every file is shifted onto the earliest
+    anchor's axis.  A file with no anchor (pre-anchor export) merges
+    unshifted.  Distinct pids keep their own tracks; drop counters
+    sum."""
+    loaded = []
+    dropped = 0
+    for p in paths:
+        with open(p, "r", encoding="utf-8") as f:
+            data = json.load(f)
+        other = data.get("otherData") or {}
+        loaded.append((data, other.get("epoch_wall")))
+        try:
+            dropped += int(other.get("dropped_spans", 0) or 0)
+        except (TypeError, ValueError):
+            pass
+    anchors = [a for _, a in loaded if a is not None]
+    base = min(anchors) if anchors else None
+    events: List[Dict] = []
+    for data, anchor in loaded:
+        shift_us = (0.0 if anchor is None or base is None
+                    else (float(anchor) - base) * 1e6)
+        for ev in data.get("traceEvents", []):
+            ev = dict(ev)
+            if "ts" in ev:
+                ev["ts"] = float(ev["ts"]) + shift_us
+            events.append(ev)
+    events.sort(key=lambda e: e.get("ts", 0.0))
+    out = {"traceEvents": events, "displayTimeUnit": "ms",
+           "otherData": {"dropped_spans": dropped,
+                         "merged_files": len(loaded)}}
+    if base is not None:
+        out["otherData"]["epoch_wall"] = base
+    return out

@@ -151,4 +151,15 @@ if bash "$(dirname "$0")/embedding_smoke.sh" >"$embedding_log" 2>&1; then
 else
   echo "embedding_smoke: FAILED (non-fatal ride-along; see $embedding_log)"
 fi
+# request-tracing smoke (chaos hard-kill mid-decode -> ONE assembled
+# trace across both replicas with exactly-once decode-span accounting,
+# tail-retained with reason failover, TTFT exemplar resolving through
+# /tracez?trace=<id>): warn-only ride-along; run
+# scripts/trace_smoke.sh standalone for the fatal form
+trace_log=$(mktemp /tmp/trace_smoke.XXXXXX.log)
+if bash "$(dirname "$0")/trace_smoke.sh" >"$trace_log" 2>&1; then
+  tail -n 1 "$trace_log"
+else
+  echo "trace_smoke: FAILED (non-fatal ride-along; see $trace_log)"
+fi
 exit $rc

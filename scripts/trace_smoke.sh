@@ -1,0 +1,147 @@
+#!/usr/bin/env bash
+# Request-tracing smoke: the ISSUE-19 acceptance loop on the CPU
+# backend (docs/observability.md "Request tracing").
+#
+#   1. a mixed workload runs with telemetry on; chaos hard-kills the
+#      primary mid-decode -> assemble_trace() returns ONE timeline
+#      with admission, both dispatches, the aborted decode, the
+#      failover hop, and the survivor's decode/emit — exactly-once
+#      token accounting across the decode spans;
+#   2. the trace is tail-retained (reason failover) while the healthy
+#      bulk traffic stays droppable, and the retained counter ticked;
+#   3. the TTFT histogram carries a trace-id exemplar that resolves to
+#      its assembled timeline through the /tracez?trace=<id> logic.
+#
+# Standalone: exits non-zero on any failed assertion.
+# scripts/tier1.sh runs it warn-only after the suite.
+set -o pipefail
+cd "$(dirname "$0")/.."
+exec env JAX_PLATFORMS=cpu python - <<'PY'
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+from bigdl_tpu import telemetry
+from bigdl_tpu.models import transformer_lm
+from bigdl_tpu.serving import (
+    ModelServer, ReliabilityPolicy, Replica, RetryPolicy, Router,
+)
+from bigdl_tpu.telemetry import events, families, request_trace
+from bigdl_tpu.telemetry.debugz import Debugz
+from bigdl_tpu.utils import chaos, set_seed
+
+set_seed(0)
+telemetry.enable()
+telemetry.reset()
+lm = transformer_lm(vocab_size=50, hidden_size=32, num_layers=2,
+                    num_heads=4, filter_size=64, max_len=64).eval_mode()
+
+
+def solo(prompt, max_new):
+    import jax.numpy as jnp
+    return np.asarray(lm.generate(
+        jnp.asarray(prompt, jnp.int32)[None], int(max_new)))[0]
+
+
+def replica(rid, d):
+    return Replica(rid, ModelServer(generator=lm, slots=2),
+                   snapshot_dir=d, publish_interval_s=0.05)
+
+
+def wait(cond, timeout=60.0, msg="condition"):
+    deadline = time.perf_counter() + timeout
+    while not cond():
+        assert time.perf_counter() < deadline, f"{msg}: timed out"
+        time.sleep(0.01)
+
+
+t0 = time.perf_counter()
+d = tempfile.mkdtemp(prefix="trace-smoke-")
+rel = ReliabilityPolicy(
+    retry=RetryPolicy(times=5, backoff_s=0.01, backoff_cap_s=0.05,
+                      jitter=0.0))
+prompt = np.array([4, 8, 15, 16, 23], np.int32)
+max_new = 20
+expect = solo(prompt, max_new)
+got, seen3 = [], threading.Event()
+
+
+def on_token(t):
+    got.append(int(t))
+    if len(got) >= 3:
+        seen3.set()
+    # pace the decode loop so the chaos kill (fires on the victim's
+    # next ~50ms snapshot publish) lands mid-decode on fast machines
+    time.sleep(0.02)
+
+
+with Router([replica(0, d), replica(1, d)], snapshot_dir=d,
+            registry_max_age_s=5.0, shed_after_s=30.0,
+            reliability=rel) as router:
+    wait(lambda: sum(1 for r in router.records().values()
+                     if r["healthy"]) == 2, msg="both replicas healthy")
+    # healthy bulk traffic first: these traces land in the droppable
+    # bulk ring, NOT the retained store
+    for i in range(3):
+        p = np.array([3, 1, 4, i], np.int32)
+        out = router.submit_generate(p, 4, timeout=60.0)
+        assert np.array_equal(out, solo(p, 4)), "healthy row drifted"
+    fut = router.submit_generate_async(prompt, max_new,
+                                       on_token=on_token)
+    assert seen3.wait(60.0), "stream never started"
+    primary = next(rid for rid, n in
+                   router.stats()["inflight"].items() if n > 0)
+    chaos.install(kill_replica_after_s=0.0, kill_replica_id=primary,
+                  kill_replica_mode="hard")
+    row = fut.result(timeout=120.0)
+    assert np.array_equal(row, expect), "failover row != solo oracle"
+assert got == list(expect[len(prompt):]), \
+    "stitched stream not exactly-once in order"
+chaos.reset()
+
+# -- 1: ONE assembled timeline across both replicas, every hop present
+fo_ev = [e for e in events.recent_events()
+         if e["kind"] == "generation_failover"]
+assert fo_ev and fo_ev[0].get("trace_id"), "failover event lost trace"
+tid = fo_ev[0]["trace_id"]
+asm = request_trace.assemble_trace(tid, directory=d)
+assert asm is not None, "trace not assembled"
+names = asm["names"]
+assert names[0] == "request/admission", names
+for hop in ("request/dispatch", "request/prefill", "request/decode",
+            "request/failover", "request/emit"):
+    assert hop in names, (hop, names)
+dispatched_to = {s["args"]["replica"] for s in asm["spans"]
+                 if s["name"] == "request/dispatch"}
+assert dispatched_to == {0, 1}, dispatched_to
+decode = [s for s in asm["spans"] if s["name"] == "request/decode"]
+aborted = [s for s in decode if (s["args"] or {}).get("aborted")]
+assert len(aborted) == 1, decode
+total = sum(s["args"]["new_tokens"] for s in decode)
+assert total == max_new, f"decode spans account {total} != {max_new}"
+
+# -- 2: tail retention — the failover trace survives, marked
+assert "failover" in asm["retained_reasons"], asm["retained_reasons"]
+assert asm["outcome"] == "ok", asm["outcome"]
+assert tid in request_trace.retained_ids()
+retained = families.request_traces_retained_total().labels(
+    "failover").value()
+assert retained >= 1, retained
+
+# -- 3: the exemplar loop — TTFT bucket -> trace id -> full timeline
+snap = families.generation_queue_to_first_token_seconds().snapshot()
+exemplars = snap.get("exemplars")
+assert exemplars, "TTFT histogram carried no exemplar"
+ex_tid = next(iter(exemplars.values()))["trace_id"]
+resp = Debugz(trace_shard_dir=d).tracez(trace=ex_tid)
+assert resp["trace"]["trace_id"] == ex_tid
+assert "request/admission" in resp["trace"]["names"]
+
+telemetry.disable()
+print(f"trace_smoke: OK (hard-kill mid-decode -> one trace across "
+      f"replicas {sorted(dispatched_to)}, {len(names)} spans, "
+      f"{total} tokens exactly-once, retained reason=failover, "
+      f"TTFT exemplar resolved, {time.perf_counter() - t0:.1f}s)")
+PY

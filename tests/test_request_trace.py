@@ -1,0 +1,416 @@
+"""Request-scoped distributed tracing tests (telemetry/request_trace.py
+wired through the serving fabric): context minting at router admission,
+span recording across dispatch / engine phases / reliability hops,
+tail-based retention with watermark promotion, cross-process shard
+stitching, and the exemplar -> /tracez?trace=<id> resolution step.
+
+The load-bearing assertions: (a) a replica hard-killed mid-decode
+yields ONE assembled trace — admission, both dispatches, the aborted
+decode on the dead replica, the failover hop, and the survivor's
+prefill/decode/emit — with exactly-once token accounting across the
+decode spans; (b) a hedged request's losing twin is marked cancelled
+inside the SAME trace as the winner; (c) with telemetry disabled the
+request carries no context and zero ``request/*`` spans exist."""
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from bigdl_tpu import telemetry
+from bigdl_tpu.models import transformer_lm
+from bigdl_tpu.serving import (
+    HedgePolicy, ModelServer, ReliabilityPolicy, Replica, RetryPolicy,
+    Router,
+)
+from bigdl_tpu.telemetry import events, families, request_trace, tracing
+from bigdl_tpu.telemetry.debugz import Debugz, DebugzServer
+from bigdl_tpu.utils import chaos, set_seed
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_on():
+    telemetry.enable()
+    telemetry.reset()
+    events.reset_events()
+    yield
+    chaos.reset()
+    telemetry.reset()
+    telemetry.disable()
+    request_trace.set_bulk_capacity(256)
+    request_trace.set_retained_capacity(256)
+
+
+@pytest.fixture(scope="module")
+def lm():
+    set_seed(0)
+    return transformer_lm(vocab_size=50, hidden_size=32, num_layers=2,
+                          num_heads=4, filter_size=64,
+                          max_len=64).eval_mode()
+
+
+def solo(model, prompt, max_new, eos_id=None):
+    import jax.numpy as jnp
+    return np.asarray(model.generate(
+        jnp.asarray(prompt, jnp.int32)[None], int(max_new),
+        eos_id=eos_id))[0]
+
+
+def _replica(lm, rid, d, slots=2, interval=0.05):
+    return Replica(rid, ModelServer(generator=lm, slots=slots),
+                   snapshot_dir=d, publish_interval_s=interval)
+
+
+def _wait(cond, timeout=30.0, msg="condition"):
+    deadline = time.perf_counter() + timeout
+    while not cond():
+        if time.perf_counter() > deadline:
+            raise TimeoutError(f"{msg} not reached in {timeout}s")
+        time.sleep(0.01)
+
+
+# ---------------------------------------------------------------------------
+# the store: mint / mark / finish / tail retention (pure, no model)
+# ---------------------------------------------------------------------------
+
+def test_off_mints_nothing_and_every_site_noops():
+    telemetry.disable()
+    assert request_trace.mint() is None
+    # every instrumentation site takes the None context without caring
+    assert request_trace.record_span("request/queue", 0.0, 1.0,
+                                     ctx=None) is None
+    request_trace.mark(None, "deadline")
+    request_trace.finish(None, outcome="ok")
+    request_trace.observe_ttft(None, 0.5)
+    request_trace.observe_inter_token(None, 0.5)
+    assert request_trace.trace_ids() == []
+
+
+def test_mark_rejects_reasons_outside_the_vocabulary():
+    ctx = request_trace.mint()
+    assert ctx is not None
+    with pytest.raises(ValueError):
+        request_trace.mark(ctx, "felt_slow")
+
+
+def test_tail_retention_bulk_drops_marked_survives():
+    """The Tail-at-Scale shape: healthy traffic is sampled OUT by the
+    bounded bulk ring (drop counter ticks), the marked trace survives
+    in the retained store no matter how much traffic follows."""
+    request_trace.set_bulk_capacity(4)
+    dropped0 = families.request_traces_dropped_total().value()
+    t = time.perf_counter()
+    slow = request_trace.mint()
+    request_trace.record_span("request/queue", t, t + 0.001, ctx=slow)
+    request_trace.mark(slow, "deadline")
+    request_trace.finish(slow, outcome="deadline")
+    healthy = []
+    for _ in range(8):
+        c = request_trace.mint()
+        request_trace.record_span("request/queue", t, t + 0.001, ctx=c)
+        request_trace.finish(c, outcome="ok")
+        healthy.append(c.trace_id)
+    assert slow.trace_id in request_trace.retained_ids()
+    assert request_trace.retained_reasons()[slow.trace_id] == ["deadline"]
+    held = request_trace.trace_ids()
+    # bulk kept only the newest 4 healthy traces; the oldest 4 dropped
+    assert [h for h in healthy if h in held] == healthy[-4:]
+    assert (families.request_traces_dropped_total().value()
+            - dropped0) == 4
+    assert families.request_traces_retained_total().labels(
+        "deadline").value() >= 1
+    asm = request_trace.assemble_trace(slow.trace_id)
+    assert asm["retained_reasons"] == ["deadline"]
+    assert asm["outcome"] == "deadline"
+
+
+def test_late_mark_promotes_a_filed_trace_out_of_bulk():
+    """A hedge verdict resolving just behind the future: the trace was
+    already filed unmarked into the droppable bulk ring; the late mark
+    must move it to retained and count it exactly once."""
+    ctx = request_trace.mint()
+    request_trace.finish(ctx, outcome="ok")
+    assert ctx.trace_id not in request_trace.retained_ids()
+    before = families.request_traces_retained_total().labels(
+        "hedge_won").value()
+    request_trace.mark(ctx, "hedge_won")
+    assert ctx.trace_id in request_trace.retained_ids()
+    assert families.request_traces_retained_total().labels(
+        "hedge_won").value() == before + 1
+    request_trace.mark(ctx, "hedge_won")  # duplicate: nothing new
+    assert families.request_traces_retained_total().labels(
+        "hedge_won").value() == before + 1
+
+
+# ---------------------------------------------------------------------------
+# cross-process stitching (fleet file transport)
+# ---------------------------------------------------------------------------
+
+def test_shard_write_and_assemble_across_processes(tmp_path):
+    d = str(tmp_path)
+    ctx = request_trace.mint()
+    t = time.perf_counter()
+    request_trace.record_span("request/queue", t, t + 0.01, ctx=ctx)
+    path = request_trace.write_trace_shard(d)
+    assert path is not None and os.path.exists(path)
+    # a second "process": a hand-written shard under a foreign pid,
+    # spans already wall-converted (the write-side contract)
+    wall = tracing.wall_time_of(t)
+    foreign = {"pid": 99991, "time": time.time(), "traces": {
+        ctx.trace_id: {
+            "origin_pid": os.getpid(), "marks": ["failover"],
+            "outcome": None,
+            "spans": [{"name": "request/decode",
+                       "t_start_wall": wall + 0.02,
+                       "t_end_wall": wall + 0.03,
+                       "duration_s": 0.01, "span_id": 1,
+                       "pid": 99991, "args": {"new_tokens": 3}}]}}}
+    with open(os.path.join(
+            d, f"{request_trace.SHARD_PREFIX}99991.json"), "w") as f:
+        json.dump(foreign, f)
+    # a torn shard must be skipped, never fatal (fleet reader idiom)
+    with open(os.path.join(
+            d, f"{request_trace.SHARD_PREFIX}7.json"), "w") as f:
+        f.write("{torn")
+    asm = request_trace.assemble_trace(ctx.trace_id, directory=d)
+    assert asm is not None
+    assert sorted(asm["pids"]) == sorted([os.getpid(), 99991])
+    # wall-clock merge order, local span first; our own shard re-read
+    # did NOT duplicate the local span
+    assert asm["names"] == ["request/queue", "request/decode"]
+    assert "failover" in asm["retained_reasons"]
+    assert request_trace.assemble_trace("nope", directory=d) is None
+
+
+def test_merge_chrome_traces_rebases_onto_earliest_anchor(tmp_path):
+    pa, pb = str(tmp_path / "a.json"), str(tmp_path / "b.json")
+    mk = lambda name, pid, wall, dropped: {
+        "traceEvents": [{"ph": "X", "name": name, "cat": "bigdl_tpu",
+                         "ts": 1000.0, "dur": 10.0, "pid": pid,
+                         "tid": "main"}],
+        "displayTimeUnit": "ms",
+        "otherData": {"dropped_spans": dropped, "epoch_wall": wall}}
+    with open(pa, "w") as f:
+        json.dump(mk("early", 1, 100.0, 2), f)
+    with open(pb, "w") as f:
+        json.dump(mk("late", 2, 100.5, 3), f)
+    merged = tracing.merge_chrome_traces([pa, pb])
+    assert merged["otherData"]["epoch_wall"] == 100.0
+    assert merged["otherData"]["dropped_spans"] == 5
+    assert merged["otherData"]["merged_files"] == 2
+    assert [e["name"] for e in merged["traceEvents"]] == ["early", "late"]
+    # the later file's events shifted by the anchor delta (0.5 s in us)
+    assert merged["traceEvents"][1]["ts"] == pytest.approx(
+        1000.0 + 0.5e6)
+    assert merged["traceEvents"][0]["ts"] == pytest.approx(1000.0)
+
+
+# ---------------------------------------------------------------------------
+# integration: the fabric writes one stitched story per request
+# ---------------------------------------------------------------------------
+
+def test_hard_kill_mid_decode_yields_one_assembled_trace(lm, tmp_path):
+    """THE acceptance scenario: chaos hard-kill mid-decode produces ONE
+    trace whose timeline shows admission -> dispatch -> prefill ->
+    decode (aborted on the dead replica) -> failover -> survivor
+    dispatch/prefill/decode -> emit, with exactly-once token accounting
+    across the decode spans and the trace retained (reason failover)."""
+    d = str(tmp_path)
+    prompt = np.array([4, 8, 15, 16, 23], np.int32)
+    max_new = 20
+    expect = solo(lm, prompt, max_new)
+    got = []
+    seen3 = threading.Event()
+
+    def on_token(t):
+        got.append(int(t))
+        if len(got) >= 3:
+            seen3.set()
+
+    rel = ReliabilityPolicy(
+        retry=RetryPolicy(times=2, backoff_s=0.01, backoff_cap_s=0.05,
+                          jitter=0.0))
+    with Router([_replica(lm, 0, d), _replica(lm, 1, d)],
+                snapshot_dir=d, registry_max_age_s=5.0,
+                shed_after_s=30.0, reliability=rel) as router:
+        _wait(lambda: sum(
+            1 for r in router.records().values() if r["healthy"]) == 2,
+            msg="both replicas healthy")
+        fut = router.submit_generate_async(prompt, max_new,
+                                           on_token=on_token)
+        assert seen3.wait(60.0), "stream never started"
+        primary = next(rid for rid, n in
+                       router.stats()["inflight"].items() if n > 0)
+        router.replica(primary).kill()
+        row = fut.result(timeout=120.0)
+        np.testing.assert_array_equal(row, expect)
+    assert got == list(expect[len(prompt):])
+
+    # the failover event names the trace — a metric/event breach
+    # resolves to the timeline without grepping anything
+    fo_ev = [e for e in events.recent_events()
+             if e["kind"] == "generation_failover"]
+    assert fo_ev and fo_ev[0].get("trace_id")
+    tid = fo_ev[0]["trace_id"]
+
+    asm = request_trace.assemble_trace(tid, directory=d)
+    assert asm is not None
+    names = asm["names"]
+    assert names[0] == "request/admission"
+    assert names.count("request/admission") == 1
+    assert names.count("request/dispatch") >= 2
+    assert "request/failover" in names
+    assert "request/prefill" in names
+    assert names.count("request/emit") == 1
+    # BOTH replicas appear in one trace, by dispatch target
+    dispatched_to = {s["args"]["replica"] for s in asm["spans"]
+                     if s["name"] == "request/dispatch"}
+    assert dispatched_to == {0, 1}
+    # exactly-once accounting: the aborted decode's salvaged tokens
+    # plus the survivor's remainder cover the budget with no overlap
+    decode = [s for s in asm["spans"] if s["name"] == "request/decode"]
+    aborted = [s for s in decode if (s["args"] or {}).get("aborted")]
+    clean = [s for s in decode if not (s["args"] or {}).get("aborted")]
+    assert len(aborted) == 1
+    assert aborted[0]["args"]["aborted"] == "ReplicaDeadError"
+    assert len(clean) >= 1
+    assert sum(s["args"]["new_tokens"] for s in decode) == max_new
+    fo = next(s for s in asm["spans"]
+              if s["name"] == "request/failover")
+    assert fo["args"]["dead_replica"] == primary
+    # tail sampler verdict: retained, reason failover, outcome ok
+    assert "failover" in asm["retained_reasons"]
+    assert asm["outcome"] == "ok"
+    assert tid in request_trace.retained_ids()
+    assert families.request_traces_retained_total().labels(
+        "failover").value() >= 1
+
+
+def test_hedge_loser_cancelled_inside_the_winners_trace(lm, tmp_path):
+    """Both hedge legs belong to ONE trace: two dispatch markers (one
+    twin), and the losing leg's cancellation is a span in the same
+    timeline naming the winner."""
+    d = str(tmp_path)
+    srv0 = ModelServer(generator=lm, slots=2)
+    r0 = Replica(0, srv0, snapshot_dir=d, publish_interval_s=0.05)
+    r1 = _replica(lm, 1, d)
+    prompt = np.array([6, 2, 9], np.int32)
+    rel = ReliabilityPolicy(
+        retry=RetryPolicy(times=2, backoff_s=0.01, jitter=0.0),
+        hedge=HedgePolicy(enabled=True, after_s=0.1))
+    with Router([r0, r1], snapshot_dir=d, registry_max_age_s=5.0,
+                shed_after_s=30.0, reliability=rel) as router:
+        _wait(lambda: sum(
+            1 for r in router.records().values() if r["healthy"]) == 2,
+            msg="both replicas healthy")
+        session = next(s for s in (f"s{i}" for i in range(64))
+                       if router._ring.preference(s)[0] == 0)
+        fillers = [srv0.submit_generate_async(
+            np.array([1, 1, 1, i], np.int32), 45) for i in range(2)]
+        fut = router.submit_generate_async(prompt, 8, session=session)
+        row = fut.result(timeout=120.0)
+        np.testing.assert_array_equal(row, solo(lm, prompt, 8))
+        _wait(lambda: router.stats()["hedges"] >= 1, timeout=60.0,
+              msg="hedge resolution")
+        for f in fillers:
+            f.result(timeout=120.0)
+
+    # the fillers bypassed the router: exactly one context was minted
+    tids = request_trace.trace_ids()
+    assert len(tids) == 1
+    asm = request_trace.assemble_trace(tids[0])
+    dispatches = [s for s in asm["spans"]
+                  if s["name"] == "request/dispatch"]
+    assert len(dispatches) == 2
+    assert sorted(s["args"]["twin"] for s in dispatches) == [False, True]
+    cancelled = [s for s in asm["spans"]
+                 if s["name"] == "request/hedge_cancelled"]
+    assert len(cancelled) == 1
+    assert cancelled[0]["args"]["replica"] != cancelled[0]["args"]["winner"]
+    rec = [e for e in events.recent_events()
+           if e["kind"] == "request_hedge"]
+    assert len(rec) == 1 and rec[0]["trace_id"] == tids[0]
+    if rec[0]["outcome"] == "hedge_won":
+        assert "hedge_won" in request_trace.retained_reasons().get(
+            tids[0], [])
+
+
+def test_off_by_default_request_rides_with_no_context(lm, tmp_path):
+    """Telemetry disabled: no context is allocated at admission, zero
+    ``request/*`` spans land anywhere, and the trace stores stay
+    empty — the fabric pays only the existing one-bool checks."""
+    telemetry.disable()
+    d = str(tmp_path)
+    prompt = np.array([3, 1, 4], np.int32)
+    with Router([_replica(lm, 0, d)], snapshot_dir=d,
+                registry_max_age_s=5.0, shed_after_s=30.0) as router:
+        _wait(lambda: any(
+            r["healthy"] for r in router.records().values()),
+            msg="replica healthy")
+        out = router.submit_generate(prompt, 4, timeout=60.0)
+        np.testing.assert_array_equal(out, solo(lm, prompt, 4))
+    assert request_trace.trace_ids() == []
+    assert request_trace.retained_ids() == []
+    assert not any(r.name.startswith("request/")
+                   for r in tracing.finished_spans())
+    assert request_trace.write_trace_shard(d) is None
+
+
+def test_ttft_exemplar_resolves_via_tracez(lm, tmp_path):
+    """The SLO-debugging loop: a TTFT histogram bucket carries an
+    exemplar trace id, and /tracez?trace=<that id> returns the full
+    assembled timeline in one step."""
+    d = str(tmp_path)
+    prompt = np.array([7, 7, 7], np.int32)
+    with Router([_replica(lm, 0, d)], snapshot_dir=d,
+                registry_max_age_s=5.0, shed_after_s=30.0) as router:
+        _wait(lambda: any(
+            r["healthy"] for r in router.records().values()),
+            msg="replica healthy")
+        out = router.submit_generate(prompt, 4, timeout=60.0)
+        np.testing.assert_array_equal(out, solo(lm, prompt, 4))
+    snap = families.generation_queue_to_first_token_seconds().snapshot()
+    exemplars = snap.get("exemplars")
+    assert exemplars, "TTFT observation carried no exemplar"
+    tid = next(iter(exemplars.values()))["trace_id"]
+    dz = Debugz(trace_shard_dir=d)
+    resp = dz.tracez(trace=tid)
+    assert resp["trace"]["trace_id"] == tid
+    assert "request/admission" in resp["trace"]["names"]
+    assert "request/decode" in resp["trace"]["names"]
+    with pytest.raises(KeyError):
+        dz.tracez(trace="no-such-trace")
+
+
+def test_tracez_http_name_filter_and_400_contract():
+    ctx = request_trace.mint()
+    t = time.perf_counter()
+    request_trace.record_span("request/queue", t, t + 0.01, ctx=ctx)
+    tracing.record_span("optimizer/step", t, t + 0.01)
+    srv = DebugzServer(Debugz()).start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        with urllib.request.urlopen(base + "/tracez?name=request/",
+                                    timeout=30) as r:
+            body = json.load(r)
+        assert body["name"] == "request/"
+        assert body["spans"]
+        assert all(s["name"].startswith("request/")
+                   for s in body["spans"])
+        with urllib.request.urlopen(
+                base + f"/tracez?trace={ctx.trace_id}", timeout=30) as r:
+            body = json.load(r)
+        assert body["trace"]["trace_id"] == ctx.trace_id
+        assert "retained" in body
+        for bad in ("/tracez?limit=abc", "/tracez?bogus=1",
+                    "/tracez?trace=missing"):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(base + bad, timeout=30)
+            assert ei.value.code == 400
+    finally:
+        srv.stop()
