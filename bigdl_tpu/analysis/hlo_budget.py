@@ -167,12 +167,16 @@ def _sum_param_nbytes(model) -> int:
     return total
 
 
-def _optimizer_probe(make_model, sample_shape, make_batch, axes, rules,
-                     criterion=None, sample_dtype="float32",
-                     hierarchical=False, wire=None) -> Dict:
+def _optimizer_probe(make_model, sample_shape, make_batch, axes=None,
+                     rules=None, criterion=None, sample_dtype="float32",
+                     hierarchical=False, wire=None, plan=None,
+                     target_dtype="int64") -> Dict:
     """Lower the training step the Optimizer would dispatch for this
     (model, mesh, rules) triple — the same ``compile_step`` hook the
-    comm tooling reads."""
+    comm tooling reads.  ``plan`` routes through
+    ``Optimizer.set_partition_plan`` instead of raw ``set_mesh``: the
+    ONE lowering path every composition shares, sp/ep/pp included —
+    there is no direct-jit side door left in this catalog."""
     import numpy as np
 
     import bigdl_tpu.nn as nn
@@ -182,28 +186,38 @@ def _optimizer_probe(make_model, sample_shape, make_batch, axes, rules,
     from bigdl_tpu.parallel.sharding import grad_allreduce_bytes
 
     model = make_model()
-    target = (np.zeros(sample_shape[1], np.int64)
-              if isinstance(sample_shape, tuple)
-              and isinstance(sample_shape[0], tuple) else 1)
-    feat_shape = (sample_shape[0] if isinstance(sample_shape, tuple)
-                  and isinstance(sample_shape[0], tuple) else sample_shape)
+    nested = (isinstance(sample_shape, tuple)
+              and isinstance(sample_shape[0], tuple))
+    target = np.zeros(sample_shape[1], target_dtype) if nested else 1
+    feat_shape = sample_shape[0] if nested else sample_shape
     opt = (Optimizer(model,
                      [Sample(np.zeros(feat_shape, sample_dtype), target)],
                      criterion or nn.ClassNLLCriterion(), batch_size=16)
-           .set_optim_method(SGD(0.1))
-           .set_mesh(MeshConfig(**axes), rules))
+           .set_optim_method(SGD(0.1)))
+    if plan is not None:
+        opt.set_partition_plan(plan)
+    else:
+        opt.set_mesh(MeshConfig(**axes), rules)
     if hierarchical:
         opt.set_gradient_sync(hierarchical=True, wire_dtype=wire)
     compiled = opt.compile_step(make_batch())
     mesh = opt.mesh_config.build()
-    plan = None
+    plan_bytes = None
     if not hierarchical:
         try:
-            plan = grad_allreduce_bytes(model, mesh, rules)["bytes_per_step"]
+            plan_bytes = grad_allreduce_bytes(
+                model, mesh,
+                rules if rules is not None else opt.sharding_rules,
+            )["bytes_per_step"]
         except Exception:
-            plan = None
-    return {"compiled": compiled, "mesh": mesh, "plan_bytes": plan,
+            plan_bytes = None
+    return {"compiled": compiled, "mesh": mesh, "plan_bytes": plan_bytes,
             "param_bytes": _sum_param_nbytes(model)}
+
+
+def _partition_plan(**kw):
+    from bigdl_tpu.parallel.plan import PartitionPlan
+    return PartitionPlan(**kw)
 
 
 # -- model builders ---------------------------------------------------------
@@ -275,10 +289,10 @@ def _lm_tp_rules(fsdp=False):
         row=[r"output_layer", r"out_layer"], fsdp=fsdp)
 
 
-def _lm_probe(axes, rules) -> Dict:
+def _lm_probe(axes=None, rules=None, plan=None) -> Dict:
     return _optimizer_probe(
         _lm, ((32,), (32,)), _lm_batch, axes, rules,
-        criterion=_lm_criterion(), sample_dtype="int32")
+        criterion=_lm_criterion(), sample_dtype="int32", plan=plan)
 
 
 def _misspec_probe() -> Dict:
@@ -293,108 +307,54 @@ def _misspec_probe() -> Dict:
     return _optimizer_probe(_cnn, (8, 8, 3), _cnn_batch, {"data": 8}, bad)
 
 
-def _functional_probe(build_loss, grad: bool = True) -> Dict:
-    """Lower a fwd+bwd jax program directly (the sp/ep/pp strategies
-    live outside the Optimizer façade until the ROADMAP item-2
-    refactor lands; their conformance is pinned at the jax level the
-    MULTICHIP dryrun proves).  ``grad=False`` for steps that already
-    compute their own gradients in-schedule (1F1B)."""
-    import jax
-    fn, args, mesh, model = build_loss()
-    if grad:
-        fn = jax.value_and_grad(fn)
-    compiled = jax.jit(fn).lower(*args).compile()
-    return {"compiled": compiled, "mesh": mesh, "plan_bytes": None,
-            "param_bytes": (_sum_param_nbytes(model)
-                            if model is not None else None)}
-
-
-def _sp_loss():
-    import numpy as np
-
-    import jax
-    import jax.numpy as jnp
-    from jax.sharding import Mesh
-
-    import bigdl_tpu.nn as nn
-    from bigdl_tpu.core.module import combine, partition
-    from bigdl_tpu.models import transformer_lm
-    from bigdl_tpu.utils import set_seed
-
-    set_seed(11)
-    rng = np.random.default_rng(0)
-    lm = transformer_lm(vocab_size=30, hidden_size=16, num_layers=2,
-                        num_heads=2, filter_size=32,
-                        max_len=64).eval_mode()
-    mesh = Mesh(np.array(jax.devices()[:_N_DEVICES]), ("seq",))
-    lm.set_sequence_parallel(mesh, "seq")
-    toks = jnp.asarray(rng.integers(1, 31, (2, 64)), jnp.int32)
-    targets = jnp.asarray(rng.integers(1, 31, (2, 64)), jnp.int32)
-    crit = nn.CrossEntropyCriterion()
-    params, rest = partition(lm)
-
-    def loss(p, toks, targets):
-        out = combine(p, rest).forward(toks).reshape(-1, 31)
-        return crit(out, targets.reshape(-1))
-
-    return loss, (params, toks, targets), mesh, lm
-
-
-def _pp_loss():
-    import numpy as np
-
-    import jax
-    import jax.numpy as jnp
-    from jax.sharding import Mesh
-
+def _pipe():
     import bigdl_tpu.nn as nn
     from bigdl_tpu.parallel import Pipeline
     from bigdl_tpu.utils import set_seed
-
     set_seed(13)
-    rng = np.random.default_rng(0)
-    pipe = Pipeline([nn.TransformerEncoderLayer(16, 2, 32)
-                     for _ in range(4)], num_microbatches=4).eval_mode()
-    xb = jnp.asarray(rng.normal(size=(8, 6, 16)), jnp.float32)
-    tgt = jnp.asarray(rng.normal(size=(8, 6, 16)), jnp.float32)
-    mesh = Mesh(np.array(jax.devices()[:4]), ("pipe",))
-
-    def mse(out, t):
-        return jnp.mean((out - t) ** 2)
-
-    # 1F1B computes its own gradients in-schedule — the step IS the
-    # fwd+bwd program, no outer value_and_grad
-    def step(x, t):
-        return pipe.train_step_on_mesh(x, t, mse, mesh)
-
-    return step, (xb, tgt), mesh, pipe
+    return Pipeline([nn.TransformerEncoderLayer(16, 2, 32)
+                     for _ in range(4)])
 
 
-def _ep_loss(n_devices, capacity):
+def _pipe_batch():
     import numpy as np
 
-    import jax
-    import jax.numpy as jnp
-    from jax.sharding import Mesh
+    from bigdl_tpu.dataset.dataset import MiniBatch
+    rng = np.random.default_rng(0)
+    return MiniBatch(rng.normal(size=(8, 6, 16)).astype(np.float32),
+                     rng.normal(size=(8, 6, 16)).astype(np.float32))
 
+
+def _pipe_probe(plan) -> Dict:
     import bigdl_tpu.nn as nn
-    from bigdl_tpu.core.module import combine, partition
+    return _optimizer_probe(
+        _pipe, ((6, 16), (6, 16)), _pipe_batch, plan=plan,
+        criterion=nn.MSECriterion(), target_dtype="float32")
+
+
+def _moe():
+    import bigdl_tpu.nn as nn
     from bigdl_tpu.nn.moe import MoE
     from bigdl_tpu.utils import set_seed
-
     set_seed(12)
+    return MoE(16, [nn.FeedForwardNetwork(16, 32) for _ in range(8)],
+               top_k=2)
+
+
+def _moe_batch():
+    import numpy as np
+
+    from bigdl_tpu.dataset.dataset import MiniBatch
     rng = np.random.default_rng(0)
-    moe = MoE(16, [nn.FeedForwardNetwork(16, 32) for _ in range(8)],
-              top_k=2).eval_mode()
-    mesh = Mesh(np.array(jax.devices()[:n_devices]), ("expert",))
-    moe.set_mesh(mesh, capacity_factor=capacity)
-    x = jnp.asarray(rng.normal(size=(2, 8, 16)), jnp.float32)
-    mp, rest = partition(moe)
+    return MiniBatch(rng.normal(size=(16, 8, 16)).astype(np.float32),
+                     rng.normal(size=(16, 8, 16)).astype(np.float32))
 
-    def loss(p, x):
-        return jnp.sum(combine(p, rest).forward(x) ** 2)
 
-    return loss, (mp, x), mesh, moe
+def _moe_probe(plan) -> Dict:
+    import bigdl_tpu.nn as nn
+    return _optimizer_probe(
+        _moe, ((8, 16), (8, 16)), _moe_batch, plan=plan,
+        criterion=nn.MSECriterion(), target_dtype="float32")
 
 
 def _wd():
@@ -585,24 +545,53 @@ def _build_probes() -> Dict[str, ProbeSpec]:
             flops_baseline="transformer_lm/dp"),
         ProbeSpec(
             "transformer_lm/sp", "transformer_lm", "sp",
-            lambda: _functional_probe(_sp_loss),
+            lambda: _lm_probe(plan=_partition_plan(sp=_N_DEVICES)),
             expected={"seq": ("collective-permute", "all-gather",
                               "all-reduce")}),
         ProbeSpec(
+            # the 1F1B schedule: fwd+loss+bwd run inside the pipeline
+            # shard_map, gradients come back stacked per stage
             "transformer_lm/pp", "transformer_lm", "pp",
-            lambda: _functional_probe(_pp_loss, grad=False),
-            expected={"pipe": ("collective-permute", "all-reduce")}),
+            lambda: _pipe_probe(_partition_plan(pp=4,
+                                                pp_schedule="1f1b")),
+            expected={"pipe": ("collective-permute", "all-reduce",
+                               "all-gather")}),
+        ProbeSpec(
+            # 3-way through ONE plan: dp shards the batch, tp shards
+            # parameter storage (stage compute inside the gpipe
+            # shard_map is replicated over 'model' — the all-gathers
+            # that re-assemble the stacked stage params are the pinned
+            # contract), pp rings the microbatches
+            "transformer_lm/dp_tp_pp", "transformer_lm", "dp_tp_pp",
+            lambda: _lm_probe(plan=_partition_plan(dp=2, tp=2, pp=2)),
+            expected={"data": ("all-reduce", "all-gather",
+                               "collective-permute"),
+                      "model": ("all-reduce", "all-gather",
+                                "collective-permute"),
+                      "pipe": ("collective-permute", "all-reduce",
+                               "all-gather")},
+            flops_baseline="transformer_lm/dp"),
+        ProbeSpec(
+            # fsdp×sp: ZeRO-3 param gathers on 'fsdp', ring attention
+            # on 'seq' — the long-context + sharded-state composition
+            "transformer_lm/fsdp_sp", "transformer_lm", "fsdp_sp",
+            lambda: _lm_probe(plan=_partition_plan(fsdp=2, sp=4)),
+            expected={"fsdp": FSDP,
+                      "seq": ("collective-permute", "all-gather",
+                              "all-reduce")},
+            flops_baseline="transformer_lm/dp"),
         # -- moe ------------------------------------------------------------
         ProbeSpec(
             "moe/ep", "moe", "ep",
-            lambda: _functional_probe(
-                lambda: _ep_loss(_N_DEVICES, 2.0)),
+            lambda: _moe_probe(_partition_plan(
+                ep=_N_DEVICES, ep_capacity_factor=2.0)),
             expected={"expert": ("all-to-all", "all-reduce",
-                                 "collective-permute")}),
+                                 "collective-permute", "all-gather")}),
         ProbeSpec(
             "moe/ep_psum", "moe", "ep_psum",
-            lambda: _functional_probe(lambda: _ep_loss(4, None)),
-            expected={"expert": ("all-reduce", "collective-permute")}),
+            lambda: _moe_probe(_partition_plan(ep=4)),
+            expected={"expert": ("all-reduce", "collective-permute",
+                                 "all-gather")}),
         # -- wide_deep (sharded-embedding hybrid, embedding/) ---------------
         ProbeSpec(
             "wide_deep/dp", "wide_deep", "dp",

@@ -482,6 +482,21 @@ class Module:
 
     # -- misc --------------------------------------------------------------
 
+    def __deepcopy__(self, memo):
+        # _static values are contractually hashable-immutable, and some
+        # hold a Mesh (set_pipeline_parallel / ring attention) whose
+        # Device handles cannot be pickled — share them by reference and
+        # copy everything else, so a mesh-armed model still clone()s.
+        cls = self.__class__
+        new = cls.__new__(cls)
+        memo[id(self)] = new
+        for k, v in self.__dict__.items():
+            if k == "_static":
+                new.__dict__[k] = dict(v)
+            else:
+                new.__dict__[k] = _copy.deepcopy(v, memo)
+        return new
+
     def clone(self) -> "Module":
         return _copy.deepcopy(self)
 

@@ -30,14 +30,17 @@ import copy
 from typing import Dict, Optional
 
 from bigdl_tpu.embedding.sharded_table import ShardedEmbeddingTable
+from bigdl_tpu.parallel.plan import PlanError
 
 __all__ = ["HybridPlanError", "sharded_tables", "embedding_rules",
            "resolve_hybrid", "hybrid_optim_methods", "configure_hybrid"]
 
 
-class HybridPlanError(ValueError):
+class HybridPlanError(PlanError):
     """A mesh/model composition the hybrid embedding plan cannot
-    honor; the message says what to change."""
+    honor; the message says what to change.  A ``PlanError``: the
+    partition planner (``parallel.plan.resolve``) surfaces these
+    unchanged when a plan touches a model with sharded tables."""
 
 
 def sharded_tables(model) -> Dict[str, ShardedEmbeddingTable]:
@@ -166,26 +169,36 @@ def hybrid_optim_methods(model, table_method, dense_method) -> Dict:
 def configure_hybrid(optimizer, axes: Optional[Dict[str, int]] = None,
                      axis: str = "data", table_method=None,
                      dense_method=None) -> Dict:
-    """One-call hybrid setup on an :class:`~bigdl_tpu.optim.Optimizer`:
-    build the mesh, validate the composition, point every table's
-    lookup at the mesh, install the row-sharding rules (and, when both
-    methods are given, the per-table OptimMethods split).  Returns the
-    resolved plan."""
-    from bigdl_tpu.parallel.mesh import MeshConfig
+    """One-call hybrid setup on an :class:`~bigdl_tpu.optim.Optimizer`,
+    lowered through the partition planner: the requested axes become a
+    :class:`~bigdl_tpu.parallel.plan.PartitionPlan` (table row-sharding
+    is one of its rules) and ``optimizer.set_partition_plan`` validates
+    the composition, points every table's lookup at the mesh, and
+    installs the sharding rules.  When both methods are given the
+    per-table OptimMethods split is installed too.  Returns the
+    resolved hybrid plan dict."""
+    from bigdl_tpu.parallel.plan import STRATEGIES, PartitionPlan
 
-    cfg = MeshConfig(**(axes or {axis: -1}))
-    mesh = cfg.build()
-    model = optimizer.model
-    plan = resolve_hybrid(
-        model, mesh, axis,
-        hierarchical=getattr(optimizer, "grad_sync_hierarchical", False))
-    for t in plan["tables"].values():
-        t.set_mesh(mesh, axis)
-    optimizer.set_mesh(cfg, embedding_rules(model, axis))
     if (table_method is None) != (dense_method is None):
         raise HybridPlanError(
             "configure_hybrid: pass BOTH table_method and dense_method "
             "(or neither, keeping the optimizer's current method)")
+    axis_to_strategy = {v: k for k, v in STRATEGIES.items()}
+    degrees = {}
+    for ax, size in (axes or {axis: -1}).items():
+        strat = axis_to_strategy.get(ax)
+        if strat is None:
+            raise HybridPlanError(
+                f"configure_hybrid: unknown mesh axis {ax!r} (known: "
+                f"{sorted(axis_to_strategy)})")
+        degrees[strat] = size
+    pplan = PartitionPlan(embedding_axis=axis, **degrees)
+    optimizer.set_partition_plan(pplan)
+    model = optimizer.model
+    mesh = optimizer.partition_plan.mesh
+    plan = resolve_hybrid(
+        model, mesh, axis,
+        hierarchical=getattr(optimizer, "grad_sync_hierarchical", False))
     if table_method is not None:
         optimizer.set_optim_methods(
             hybrid_optim_methods(model, table_method, dense_method))

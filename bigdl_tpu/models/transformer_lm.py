@@ -57,6 +57,11 @@ class TransformerLM(Module):
         self.max_len = max_len
         self.remat = remat
         self.seq_parallel = False
+        # pipeline-parallel routing (set_pipeline_parallel): when armed,
+        # the block stack runs through the GPipe schedule over pipe_mesh
+        self.pipe_mesh = None
+        self.pipe_axis = "pipe"
+        self.pipe_microbatches = 1
         # padded_inputs=False: contiguous LM batching (no token-0
         # padding) — the causal mask moves INSIDE the attention kernel
         # (flash skips above-diagonal blocks; no [B,H,T,T] bias is
@@ -105,6 +110,59 @@ class TransformerLM(Module):
         self.seq_parallel = True
         return self
 
+    def set_pipeline_parallel(self, mesh, axis: str = "pipe",
+                              num_microbatches: int = None) \
+            -> "TransformerLM":
+        """Run the block stack through the GPipe schedule over
+        ``mesh[axis]`` (embedding/posenc and final_norm/head stay
+        replicated around it; the blocks are homogeneous
+        TransformerDecoderLayers, so stage parameters stack and shard
+        over the pipe axis).  Like the sequence-parallel path, the
+        causal mask moves INSIDE the attention kernel (the per-batch
+        padding bias cannot ride the microbatch ring), so padded
+        batches are rejected the same way.  ``mesh=None`` disarms."""
+        if mesh is not None:
+            n = len(self.blocks)
+            s = mesh.shape[axis]
+            if n % s:
+                raise ValueError(
+                    f"set_pipeline_parallel: {n} blocks do not divide "
+                    f"into {s} stages on axis {axis!r}")
+        self.pipe_mesh = mesh
+        self.pipe_axis = axis
+        self.pipe_microbatches = (num_microbatches
+                                  or (mesh.shape[axis] if mesh is not None
+                                      else 1))
+        return self
+
+    def _blocks_gpipe(self, x):
+        """Run the (homogeneous) blocks as pipeline stages: stack
+        per-block leaves onto [S, per_stage, ...] and stream the batch
+        through parallel.pipeline.gpipe.  Gradients flow through the
+        schedule via autodiff (the Optimizer's outer value_and_grad)."""
+        from bigdl_tpu.parallel.pipeline import gpipe
+        mesh, axis = self.pipe_mesh, self.pipe_axis
+        s = mesh.shape[axis]
+        blocks = list(self.blocks)
+        per_stage = len(blocks) // s
+        flats = [jax.tree_util.tree_flatten(b)[0] for b in blocks]
+        treedef0 = jax.tree_util.tree_structure(blocks[0])
+        stacked_leaves = [
+            jnp.stack(ls).reshape((s, per_stage) + ls[0].shape)
+            for ls in zip(*flats)]
+        stacked = jax.tree_util.tree_unflatten(treedef0, stacked_leaves)
+
+        def stage_apply(stage_tree, x_mb):
+            def one(i, acc):
+                blk = jax.tree_util.tree_map(
+                    lambda l: jax.lax.dynamic_index_in_dim(
+                        l, i, 0, keepdims=False), stage_tree)
+                return blk.forward(acc, self_bias=None, self_causal=True)
+            return jax.lax.fori_loop(0, per_stage, one, x_mb)
+
+        return gpipe(stage_apply, stacked, x, mesh, axis,
+                     self.pipe_microbatches)
+
     def forward(self, tokens):
         B, T = tokens.shape
         if T > self.max_len:
@@ -114,8 +172,9 @@ class TransformerLM(Module):
         x = self.embedding.forward(jnp.maximum(tokens, 1))
         x = x * (self.hidden_size ** 0.5)
         x = x + position_encoding(T, self.hidden_size, dtype=x.dtype)
+        pipe = self.pipe_mesh is not None
         causal_in_kernel = False
-        if self.seq_parallel or not self.padded_inputs:
+        if self.seq_parallel or pipe or not self.padded_inputs:
             # Both modes handle causality INSIDE the attention kernel
             # (the ring applies it per block pair; the dense causal
             # flash path skips above-diagonal blocks) — an additive
@@ -126,6 +185,7 @@ class TransformerLM(Module):
             # the activations are NaN-poisoned so the loss/logits are
             # unmistakably wrong, not subtly so
             mode = ("sequence-parallel" if self.seq_parallel
+                    else "pipeline-parallel" if pipe
                     else "padded_inputs=False")
             if not isinstance(tokens, jax.core.Tracer):
                 if bool(jnp.any(tokens == 0)):
@@ -143,18 +203,22 @@ class TransformerLM(Module):
             bias = causal_bias(T, dtype=x.dtype) \
                 + padding_bias(tokens).astype(x.dtype)
 
-        for blk in self.blocks:
-            if self.remat:
-                # recompute the block in backward instead of storing its
-                # activations (jax.checkpoint); module buffers are not
-                # mutated in these blocks so the functional wrap is safe
-                def run(blk_, x_, bias_):
-                    return blk_.forward(x_, self_bias=bias_,
-                                        self_causal=causal_in_kernel)
-                x = jax.checkpoint(run)(blk, x, bias)
-            else:
-                x = blk.forward(x, self_bias=bias,
-                                self_causal=causal_in_kernel)
+        if pipe:
+            x = self._blocks_gpipe(x)
+        else:
+            for blk in self.blocks:
+                if self.remat:
+                    # recompute the block in backward instead of storing
+                    # its activations (jax.checkpoint); module buffers
+                    # are not mutated in these blocks so the functional
+                    # wrap is safe
+                    def run(blk_, x_, bias_):
+                        return blk_.forward(x_, self_bias=bias_,
+                                            self_causal=causal_in_kernel)
+                    x = jax.checkpoint(run)(blk, x, bias)
+                else:
+                    x = blk.forward(x, self_bias=bias,
+                                    self_causal=causal_in_kernel)
         x = self.final_norm(x)
         # weight-tied output head: logits against the embedding matrix
         emb = self.embedding.weight            # [vocab+1, H]

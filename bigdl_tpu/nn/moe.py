@@ -39,7 +39,7 @@ from bigdl_tpu.core.module import Module, ModuleList, Parameter
 from bigdl_tpu.telemetry import collectives as _coll
 from bigdl_tpu.nn.linear import Linear
 from bigdl_tpu.utils.rng import next_key
-from bigdl_tpu.parallel.mesh import shard_map_compat
+from bigdl_tpu.parallel.mesh import pin_replicated, shard_map_compat
 
 __all__ = ["MoE"]
 
@@ -248,6 +248,10 @@ class MoE(Module):
             in_specs=(jax.tree_util.tree_map(lambda _: P(axis), stacked),
                       P(axis), P(axis)),
             out_specs=(P(axis), P()))
+        # pin operands replicated — see parallel.mesh.pin_replicated
+        stacked = pin_replicated(stacked, mesh)
+        xf = pin_replicated(xf, mesh)
+        pf = pin_replicated(pf, mesh)
         y, drop = fn(stacked, xf, pf)
         self.drop_rate = jax.lax.stop_gradient(drop)
         return y.reshape(B, T, H)
@@ -273,4 +277,7 @@ class MoE(Module):
             in_specs=(jax.tree_util.tree_map(lambda _: P(axis), stacked),
                       P(), P()),
             out_specs=P())
+        stacked = pin_replicated(stacked, mesh)
+        x = pin_replicated(x, mesh)
+        weights = pin_replicated(weights, mesh)
         return fn(stacked, x, weights)

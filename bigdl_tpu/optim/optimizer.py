@@ -136,6 +136,9 @@ class Optimizer:
         self.grad_clip_norm: Optional[float] = None
         self.mesh_config = MeshConfig(data=-1)
         self.sharding_rules = ShardingRules()
+        # declarative parallelism (set_partition_plan): the resolved
+        # PartitionPlan, when one drives this optimizer's layout
+        self.partition_plan = None
         self.compute_dtype = None  # e.g. jnp.bfloat16 for mixed precision
         # gradient-sync routing (set_gradient_sync): OFF by default —
         # the flat XLA-inserted sync compiles exactly as it always has
@@ -294,6 +297,47 @@ class Optimizer:
         if rules is not None:
             self.sharding_rules = rules
         return self
+
+    def set_partition_plan(self, plan) -> "Optimizer":
+        """Drive the whole parallelism layout from one declarative
+        :class:`~bigdl_tpu.parallel.plan.PartitionPlan`: resolve it
+        against the model (raising
+        :class:`~bigdl_tpu.parallel.plan.PlanError` for compositions
+        the planner cannot honor, with the offending axis/leaf named),
+        apply the module wirings (ring attention, expert dispatch,
+        pipeline staging, embedding-table row sharding), and install
+        the composed sharding rules + mesh so ``_build_step``/
+        :meth:`compile_step` emit the same program shape for every
+        composition — dp/fsdp/tp/sp/ep/pp all lower through the one
+        step builder.  Accepts a ``PartitionPlan`` or an
+        already-resolved ``ResolvedPlan``.  See docs/parallelism.md
+        "Declarative composition"."""
+        from bigdl_tpu.parallel.plan import (
+            PlanError, ResolvedPlan, resolve,
+        )
+        rp = plan if isinstance(plan, ResolvedPlan) else resolve(
+            plan, self.model,
+            hierarchical=self.grad_sync_hierarchical,
+            compute_dtype=self.compute_dtype)
+        if rp.pp_schedule == "1f1b":
+            # the 1F1B schedule means per-microbatch losses — only a
+            # mean-reduction criterion keeps the math equal to the
+            # full-batch step (the _grad_sync_plan guard's logic)
+            crit = self.criterion
+            crit_mods = ([m for _, m in crit.named_modules()]
+                         if hasattr(crit, "named_modules") else [crit])
+            if any(getattr(m, "size_average", True) is False
+                   for m in crit_mods):
+                raise PlanError(
+                    "pp_schedule='1f1b' requires a mean-reduction "
+                    "criterion (size_average=True): the schedule "
+                    "means per-microbatch losses, which changes the "
+                    "math for a sum-reduction criterion")
+        rp.apply()
+        for desc, _fn in rp.wirings:
+            logger.info("partition plan: %s", desc)
+        self.partition_plan = rp
+        return self.set_mesh(rp.mesh_config, rp.rules)
 
     def set_compute_dtype(self, dtype) -> "Optimizer":
         """bf16 compute (≙ FP16 gradient compression — but end-to-end)."""
@@ -698,6 +742,28 @@ class Optimizer:
 
         merge_groups = self._merge_groups_host  # jit-traceable as-is
         sync_plan = self._grad_sync_plan(mesh)
+        # declarative pp (set_partition_plan with pp_schedule="1f1b"):
+        # the fwd+loss+bwd all run inside the pipeline schedule, so the
+        # step swaps the flat value_and_grad for train_step_on_mesh and
+        # re-selects the param-leaf grads in partition() order — clip /
+        # regularizers / optim methods / watchdog guard compose after,
+        # unchanged.  Statics (block count, param flags) are trace-time
+        # constants.
+        rp = self.partition_plan
+        pipe_1f1b = False
+        if rp is not None and getattr(rp, "pp_schedule", None) == "1f1b":
+            from bigdl_tpu.parallel.pipeline import Pipeline as _Pipeline
+            if isinstance(self.model, _Pipeline) \
+                    and rp.pp_axis in mesh.axis_names \
+                    and mesh.shape[rp.pp_axis] > 1:
+                pipe_1f1b = True
+                from bigdl_tpu.core.module import _param_flags
+                assert sync_plan is None, \
+                    "1F1B does not compose with hierarchical grad sync"
+                pipe_axis = rp.pp_axis
+                pipe_n_blocks = len(self.model.blocks)
+                pipe_flags = _param_flags(self.model.blocks[0])
+                group_idx = self._group_idx
         if sync_plan is not None:
             from jax.sharding import PartitionSpec as _PS
             from bigdl_tpu.parallel.hierarchy import (
@@ -791,7 +857,29 @@ class Optimizer:
                 loss = criterion(out, y_)
                 return loss, m
 
-            if sync_plan is None:
+            if pipe_1f1b:
+                # grads come back stacked [S, per_stage, ...] under
+                # block 0's treedef (params + buffers); unstack to
+                # per-block leaves and keep the param slots, which by
+                # construction (_param_flags walks the same order as
+                # tree flattening) is exactly partition()'s leaf order
+                m = combine(merge_groups(params_groups), rest)
+                with forward_context(rng=rng):
+                    loss, g_stacked, _dx = m.train_step_on_mesh(
+                        x, y, lambda out, tgt: criterion(out, tgt),
+                        mesh, pipe_axis)
+                flat_g = [g.reshape((pipe_n_blocks,) + g.shape[2:])
+                          for g in jax.tree_util.tree_leaves(g_stacked)]
+                per_leaf = []
+                for i in range(pipe_n_blocks):
+                    per_leaf.extend(
+                        g[i] for g, is_param in zip(flat_g, pipe_flags)
+                        if is_param)
+                grads_groups = [[per_leaf[j] for j in idxs]
+                                for idxs in group_idx]
+                m2 = m   # 1F1B mutates no buffers in-schedule
+                sync_rest = None
+            elif sync_plan is None:
                 (loss, m2), grads_groups = jax.value_and_grad(
                     lambda groups: loss_of(groups, rest, x, y, rng),
                     has_aux=True)(params_groups)
@@ -2639,12 +2727,28 @@ class Optimizer:
                 path = self._write_checkpoint(temp, opt_states, driver)
             logger.info("checkpoint written to %s", path)
 
+    def _plan_record(self) -> Optional[Dict[str, Any]]:
+        """The partition-plan stamp for checkpoint topology manifests:
+        strategy degrees (>1 only) + pipeline schedule, or None when
+        the run never set a plan.  Lets a resume see WHICH strategies
+        (tp/pp/...) shaped the saved shardings, not just the mesh."""
+        rp = self.partition_plan
+        if rp is None:
+            return None
+        rec: Dict[str, Any] = {
+            "degrees": {k: int(v) for k, v in rp.degrees.items()
+                        if int(v) > 1}}
+        if rp.pp_schedule is not None:
+            rec["pp_schedule"] = rp.pp_schedule
+        return rec
+
     def _write_checkpoint(self, temp, opt_states, driver) -> str:
         """One checkpoint generation through the CheckpointManager:
         atomic payload commit, CRC manifest, retention GC."""
         mgr = self._ckpt_manager()
         pipeline_state = self._pipeline_snapshot()
         mesh = getattr(self, "_active_mesh", None)
+        plan_rec = self._plan_record()
         if self.checkpoint_sharded:
             # device arrays pass through unchanged: each host writes
             # its own shards, no gather.  The driver rides inside the
@@ -2657,7 +2761,8 @@ class Optimizer:
                 {k: driver[k] for k in _DRIVER_KEYS if k in driver},
                 generation=self.state["neval"],
                 overwrite=self.overwrite_checkpoint, sharded=True,
-                pipeline_state=pipeline_state, mesh=mesh)
+                pipeline_state=pipeline_state, mesh=mesh,
+                plan=plan_rec)
         else:
             path = mgr.save(
                 {"params": _to_plain(temp.parameters()),
@@ -2665,7 +2770,8 @@ class Optimizer:
                 [s for s in opt_states], driver,
                 generation=self.state["neval"],
                 overwrite=self.overwrite_checkpoint, sharded=False,
-                pipeline_state=pipeline_state, mesh=mesh)
+                pipeline_state=pipeline_state, mesh=mesh,
+                plan=plan_rec)
         # /statusz reports the last generation this run committed
         self._last_ckpt_generation = self.state["neval"]
         self._last_ckpt_path = path

@@ -16,3 +16,6 @@ from bigdl_tpu.parallel.ring_attention import (
     RingSelfAttention, ring_attention, ring_self_attention,
 )
 from bigdl_tpu.parallel.pipeline import gpipe, Pipeline
+from bigdl_tpu.parallel.plan import (
+    PartitionPlan, PlanError, ResolvedPlan, resolve,
+)

@@ -35,7 +35,23 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 __all__ = ["make_mesh", "data_parallel_mesh", "MeshConfig", "P",
            "NamedSharding", "Mesh", "local_device_count",
            "batch_sharding", "shard_map_compat", "axis_coord_maps",
-           "mesh_axes"]
+           "mesh_axes", "pin_replicated"]
+
+
+def pin_replicated(tree, mesh):
+    """Pin every leaf to the fully-replicated layout before it enters a
+    shard_map.  On multi-axis meshes GSPMD mispartitions IN-GRAPH
+    producers of shard_map operands — a ``jnp.stack`` of per-stage /
+    per-expert parameters or a pad of the microbatch ring compiled
+    under jit silently yields values that DIVERGE from the eager result
+    (observed on jax 0.4.37 CPU; exercised by the dp×pp / dp×ep
+    training-equivalence tests and the partition-plan conformance
+    matrix).  Forcing the operand replicated at the boundary removes
+    the partitioner's freedom to misplace it; the shard_map's in_specs
+    then carve the per-device shards themselves."""
+    rep = NamedSharding(mesh, P())
+    return jax.tree_util.tree_map(
+        lambda l: jax.lax.with_sharding_constraint(l, rep), tree)
 
 
 def shard_map_compat(f, mesh, in_specs, out_specs):

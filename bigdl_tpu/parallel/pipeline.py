@@ -49,7 +49,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from bigdl_tpu.core.module import Module, ModuleList
 from bigdl_tpu.telemetry import collectives as _coll
-from bigdl_tpu.parallel.mesh import shard_map_compat
+from bigdl_tpu.parallel.mesh import pin_replicated, shard_map_compat
 
 __all__ = ["gpipe", "one_f_one_b", "Pipeline"]
 
@@ -121,9 +121,12 @@ def _run_pipe(stage_apply, stacked_params, param_specs, x, mesh,
     m_pad = -m % s
     if m_pad:
         # pad the schedule with dummy microbatches so the ring shards
-        # evenly; costs bubble compute, not memory
-        x_mb = jnp.concatenate(
-            [x_mb, jnp.zeros((m_pad,) + x_mb.shape[1:], x_mb.dtype)], 0)
+        # evenly; costs bubble compute, not memory.  jnp.pad, NOT
+        # concatenate-with-zeros: on multi-axis meshes GSPMD
+        # mispartitions the concat feeding the shard_map (observed on
+        # jax 0.4.37: jit result diverges from eager; tested in
+        # test_parallel.py and the plan conformance matrix)
+        x_mb = jnp.pad(x_mb, ((0, m_pad),) + ((0, 0),) * (x_mb.ndim - 1))
 
     fn = shard_map_compat(
         functools.partial(_pipe_loop, stage_apply=stage_apply,
@@ -132,6 +135,8 @@ def _run_pipe(stage_apply, stacked_params, param_specs, x, mesh,
         in_specs=(param_specs, P(axis)),
         out_specs=P(axis),
     )
+    stacked_params = pin_replicated(stacked_params, mesh)
+    x_mb = pin_replicated(x_mb, mesh)
     y_mb = fn(stacked_params, x_mb)[:m]
     return y_mb.reshape((b,) + y_mb.shape[2:])
 
@@ -309,10 +314,9 @@ def one_f_one_b(stage_apply: Callable, loss_fn: Callable, stacked_params,
     t_mb = targets.reshape((m, b // m) + targets.shape[1:])
     m_pad = -m % s
     if m_pad:
-        x_mb = jnp.concatenate(
-            [x_mb, jnp.zeros((m_pad,) + x_mb.shape[1:], x_mb.dtype)], 0)
-        t_mb = jnp.concatenate(
-            [t_mb, jnp.zeros((m_pad,) + t_mb.shape[1:], t_mb.dtype)], 0)
+        # jnp.pad, not concatenate-with-zeros — see _run_pipe
+        x_mb = jnp.pad(x_mb, ((0, m_pad),) + ((0, 0),) * (x_mb.ndim - 1))
+        t_mb = jnp.pad(t_mb, ((0, m_pad),) + ((0, 0),) * (t_mb.ndim - 1))
 
     specs = jax.tree_util.tree_map(lambda _: P(axis), stacked_params)
     fn = shard_map_compat(
@@ -323,6 +327,9 @@ def one_f_one_b(stage_apply: Callable, loss_fn: Callable, stacked_params,
         in_specs=(specs, P(axis), P(axis)),
         out_specs=(P(), specs, P(axis)),
     )
+    stacked_params = pin_replicated(stacked_params, mesh)
+    x_mb = pin_replicated(x_mb, mesh)
+    t_mb = pin_replicated(t_mb, mesh)
     loss_sum, grads, dx_mb = fn(stacked_params, x_mb, t_mb)
     # mean over the real microbatches; grads follow the same scale.
     # shard_map concatenates the per-device (stripped) grad trees along

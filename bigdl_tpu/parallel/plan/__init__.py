@@ -1,0 +1,17 @@
+"""Declarative 3D-parallelism planner.
+
+One :class:`PartitionPlan` names every parallel strategy and its degree
+(dp/fsdp/tp/sp/ep/pp, plus the dcn slice tier); :func:`resolve`
+validates the composition against the model and the mesh — rejecting
+unhonorable layouts with actionable :class:`PlanError`\\ s that name the
+offending axis or parameter leaf — and hands the Optimizer façade ONE
+lowering path: ``Optimizer.set_partition_plan(plan)``.  See
+docs/parallelism.md "Declarative composition".
+"""
+
+from bigdl_tpu.parallel.plan.partition import (
+    STRATEGIES, PartitionPlan, PlanError, ResolvedPlan, resolve,
+)
+
+__all__ = ["STRATEGIES", "PartitionPlan", "PlanError", "ResolvedPlan",
+           "resolve"]

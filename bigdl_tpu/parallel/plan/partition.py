@@ -1,0 +1,486 @@
+"""PartitionPlan: one declarative spec drives every parallelism
+composition through the Optimizer façade.
+
+SURVEY §7.5 names the target the reference never reached: parallelism
+"expressed as sharding rules so DistriOptimizer-equivalent code stays
+strategy-agnostic".  The reference's only strategy is flat data
+parallelism over BlockManagers (parameters/AllReduceParameter.scala);
+every other axis here is new capability, and before this module each
+one had its own wiring ritual (``tensor_parallel_rules`` by hand,
+``set_sequence_parallel``, ``MoE.set_mesh``, ``Pipeline.set_mesh``,
+``configure_hybrid``).  A :class:`PartitionPlan` replaces the rituals:
+
+* per-axis strategy assignment — ``PartitionPlan(dp=2, tp=2, pp=2)``
+  maps strategies onto the canonical mesh axes
+  (:data:`bigdl_tpu.parallel.mesh.AXES`),
+* per-leaf PartitionSpec derivation via composable rule sets extending
+  :class:`~bigdl_tpu.parallel.sharding.ShardingRules` (precedence:
+  embedding-table rules > user rules > tensor-parallel rules > fsdp
+  fallback > replicate),
+* a :func:`resolve` planner that validates the composition against the
+  model and the mesh, raising :class:`PlanError` with the offending
+  axis/leaf named (the ``HybridPlanError`` pattern — which now IS a
+  ``PlanError`` subclass), and
+* the module-wiring closures (ring attention, expert dispatch, pipeline
+  staging, table row-sharding) the Optimizer applies in
+  ``set_partition_plan`` so ``_build_step``/``compile_step`` emit the
+  same program shape for every composition.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from jax.sharding import Mesh, PartitionSpec as P
+
+from bigdl_tpu.parallel.mesh import MeshConfig, mesh_axes
+from bigdl_tpu.parallel.sharding import ShardingRules, tensor_parallel_rules
+
+__all__ = ["STRATEGIES", "PlanError", "PartitionPlan", "ResolvedPlan",
+           "resolve"]
+
+# strategy name -> canonical mesh axis (parallel.mesh.AXES order)
+STRATEGIES = {
+    "dcn": "dcn",      # slice tier (slow network); batch-like
+    "dp": "data",      # batch sharding
+    "fsdp": "fsdp",    # batch sharding + parameter/optim-state sharding
+    "tp": "model",     # megatron-style tensor parallelism
+    "pp": "pipe",      # pipeline stages
+    "sp": "seq",       # ring-attention sequence/context parallelism
+    "ep": "expert",    # MoE expert parallelism
+}
+
+# default Megatron split for the in-repo transformer family: q/k/v and
+# the FFN filter are column-parallel (output dim), the attention output
+# and FFN output projections are row-parallel (input dim) — the same
+# patterns analysis/hlo_budget.py budgets
+_TRANSFORMER_TP_COLUMN = (r"q_layer", r"k_layer", r"v_layer",
+                          r"filter_layer")
+_TRANSFORMER_TP_ROW = (r"output_layer", r"out_layer")
+
+
+class PlanError(ValueError):
+    """A (plan, model, mesh) composition the planner cannot honor;
+    the message names the offending axis or parameter leaf and says
+    what to change."""
+
+
+@dataclasses.dataclass
+class PartitionPlan:
+    """Per-axis strategy degrees plus strategy options.  Degrees are
+    positive ints (1 = strategy off); exactly one may be ``-1`` to
+    absorb the remaining devices.  ``resolve(plan, model)`` validates
+    and returns the :class:`ResolvedPlan` the Optimizer consumes."""
+
+    dp: int = 1
+    fsdp: int = 1
+    tp: int = 1
+    pp: int = 1
+    sp: int = 1
+    ep: int = 1
+    dcn: int = 1
+
+    # tp options: regex patterns over parameter paths (see
+    # sharding.tensor_parallel_rules).  None -> transformer defaults
+    # when the model has attention blocks, else a generic
+    # column-parallel rule over every divisible weight.
+    tp_column: Optional[Sequence[str]] = None
+    tp_row: Optional[Sequence[str]] = None
+
+    # pp options: microbatch count (default = pp degree) and schedule.
+    # "gpipe" stages the forward (autodiff through the schedule);
+    # "1f1b" runs fwd+loss+bwd inside the schedule (Pipeline models
+    # only — the loss must live at the last stage).
+    pp_microbatches: Optional[int] = None
+    pp_schedule: str = "gpipe"
+
+    # sp options: optional attention kernel and the head axis the ring
+    # keeps sharded (defaults to "model" when composing with tp)
+    sp_kernel: Optional[Callable] = None
+    sp_head_axis: Optional[str] = None
+
+    # ep options: capacity-based all_to_all dispatch when set, exact
+    # psum fallback when None (see nn.moe.MoE.set_mesh)
+    ep_capacity_factor: Optional[float] = None
+
+    # sharded embedding tables row-shard over this (batch-like) axis
+    embedding_axis: str = "data"
+
+    # extra user rules, applied after table rules but before tp rules
+    rules: Optional[ShardingRules] = None
+
+    def degrees(self) -> Dict[str, int]:
+        out = {k: getattr(self, k) for k in STRATEGIES}
+        neg = [k for k, v in out.items() if v == -1]
+        for k, v in out.items():
+            if not isinstance(v, int) or v == 0 or v < -1:
+                raise PlanError(
+                    f"{k}={v!r}: strategy degrees are positive ints "
+                    f"(1 = off), or -1 on at most one strategy to "
+                    f"absorb the remaining devices")
+        if len(neg) > 1:
+            raise PlanError(
+                f"only one strategy may be -1; got {sorted(neg)}")
+        return out
+
+    def mesh_axes(self) -> Dict[str, int]:
+        """The MeshConfig axes this plan asks for (degree-1 strategies
+        stay off the mesh)."""
+        axes = {STRATEGIES[k]: v for k, v in self.degrees().items()
+                if v != 1}
+        return axes or {"data": 1}
+
+    def describe(self) -> str:
+        on = [f"{k}={v}" for k, v in self.degrees().items() if v != 1]
+        return "PartitionPlan(" + (", ".join(on) or "single-device") + ")"
+
+
+@dataclasses.dataclass
+class ResolvedPlan:
+    """A validated plan bound to a concrete mesh: the composed sharding
+    rules, the module wirings to apply, and the resolved degrees.  The
+    Optimizer stores this and routes ``_build_step``/``compile_step``
+    decisions (e.g. the 1F1B schedule) through it."""
+
+    plan: PartitionPlan
+    mesh_config: MeshConfig
+    mesh: Mesh
+    rules: ShardingRules
+    degrees: Dict[str, int]
+    wirings: List[Tuple[str, Callable[[], Any]]]
+    notes: List[str] = dataclasses.field(default_factory=list)
+    applied: bool = False
+
+    @property
+    def pp_schedule(self) -> Optional[str]:
+        return (self.plan.pp_schedule if self.degrees.get("pp", 1) > 1
+                else None)
+
+    @property
+    def pp_axis(self) -> str:
+        return STRATEGIES["pp"]
+
+    def apply(self) -> "ResolvedPlan":
+        """Run the module wirings (idempotent)."""
+        if not self.applied:
+            for _desc, fn in self.wirings:
+                fn()
+            self.applied = True
+        return self
+
+    def describe(self) -> str:
+        comp = "×".join(f"{k}{v}" for k, v in self.degrees.items()
+                        if v > 1) or "single-device"
+        lines = [f"{comp} on mesh {dict(mesh_axes(self.mesh))}"]
+        lines += [f"  wire: {d}" for d, _ in self.wirings]
+        lines += [f"  note: {n}" for n in self.notes]
+        return "\n".join(lines)
+
+
+def _struct_homogeneous(blocks) -> bool:
+    from bigdl_tpu.parallel.pipeline import Pipeline
+    sigs = [Pipeline._struct_sig(b) for b in blocks]
+    return all(s == sigs[0] for s in sigs[1:])
+
+
+def _tp_rules_for(plan: PartitionPlan, model) -> ShardingRules:
+    if plan.tp_column or plan.tp_row:
+        return tensor_parallel_rules(column=plan.tp_column or (),
+                                     row=plan.tp_row or ())
+    has_attention = any(
+        "q_layer" in getattr(m, "_modules", {})
+        for _, m in model.named_modules())
+    if has_attention:
+        return tensor_parallel_rules(column=_TRANSFORMER_TP_COLUMN,
+                                     row=_TRANSFORMER_TP_ROW)
+
+    # generic fallback: column-shard every >=2-D weight whose output
+    # dim divides.  Sharding annotations never change the math — GSPMD
+    # inserts the collectives — so this gives non-transformer models a
+    # meaningful tp without per-model rule sets.
+    def col_spec(shape, mesh):
+        axis = STRATEGIES["tp"]
+        if axis not in mesh.axis_names:
+            return P()
+        if len(shape) >= 2 and shape[0] % mesh.shape[axis] == 0:
+            return P(axis, *([None] * (len(shape) - 1)))
+        return P()
+
+    return ShardingRules([(r"weight", col_spec)])
+
+
+def _check_tp(plan: PartitionPlan, model, mesh, tp: int,
+              notes: List[str]) -> ShardingRules:
+    """Validate that tensor parallelism actually shards something, and
+    name the leaf that blocks it when nothing divides."""
+    import jax
+    from bigdl_tpu.core.module import param_paths, partition
+
+    axis = STRATEGIES["tp"]
+    tpr = _tp_rules_for(plan, model)
+    params_tree, _ = partition(model)
+    leaves = jax.tree_util.tree_leaves(params_tree)
+    paths = param_paths(model)
+    sharded, blocked = [], []
+    for p, leaf in zip(paths, leaves):
+        matched = any(pat.search(p) for pat, _fn in tpr.rules)
+        if not matched:
+            continue
+        if tpr.spec_for(p, leaf.shape, mesh) != P():
+            sharded.append(p)
+        else:
+            blocked.append((p, tuple(leaf.shape)))
+    if not sharded:
+        if blocked:
+            p0, s0 = blocked[0]
+            raise PlanError(
+                f"tp={tp}: no parameter shards over axis {axis!r} — "
+                f"leaf {p0!r} (shape {s0}) matches the tensor-parallel "
+                f"rules but its split dim does not divide by {tp}; "
+                f"lower the tp degree or pad the layer width")
+        raise PlanError(
+            f"tp={tp}: no parameter path matches the tensor-parallel "
+            f"rules (column={list(plan.tp_column or ())!r}, "
+            f"row={list(plan.tp_row or ())!r}) — pass tp_column/tp_row "
+            f"patterns that name this model's layers")
+    if blocked:
+        notes.append(
+            f"tp: {len(blocked)} matched leaf/leaves do not divide by "
+            f"{tp} and stay replicated (e.g. {blocked[0][0]!r} "
+            f"{blocked[0][1]})")
+    return tpr
+
+
+def resolve(plan: PartitionPlan, model, mesh: Optional[Mesh] = None, *,
+            hierarchical: bool = False,
+            compute_dtype=None) -> ResolvedPlan:
+    """Validate ``plan`` against ``model`` (and ``mesh``, when given an
+    explicit one) and return the :class:`ResolvedPlan`.
+
+    Raises :class:`PlanError` naming the offending axis/leaf for every
+    unhonorable composition: degrees that don't divide the device
+    count, a planned axis missing from an explicit mesh, pp on a
+    non-sequential model, tp that shards nothing, sp/ep on models
+    without the corresponding structure, sharded embedding tables
+    combined with non-batch axes, and hierarchical-sync or
+    compute-dtype combinations the step builder would reject later.
+    """
+    degrees = plan.degrees()
+    axes = plan.mesh_axes()
+    if mesh is None:
+        try:
+            mesh_config = MeshConfig(**axes)
+            mesh = mesh_config.build()
+        except ValueError as e:
+            raise PlanError(f"{plan.describe()}: {e}") from None
+    else:
+        shape = mesh_axes(mesh)
+        for k, v in degrees.items():
+            ax = STRATEGIES[k]
+            if v == 1:
+                continue
+            if ax not in shape or shape[ax] <= 1:
+                raise PlanError(
+                    f"{k}={v}: axis {ax!r} is not on the mesh (axes: "
+                    f"{dict(shape)}); build the mesh with "
+                    f"MeshConfig({ax}={v}) or drop {k} from the plan")
+            if v != -1 and shape[ax] != v:
+                raise PlanError(
+                    f"{k}={v}: mesh axis {ax!r} has size {shape[ax]}, "
+                    f"not {v}; the plan and the mesh disagree")
+        mesh_config = MeshConfig(**{a: int(s) for a, s in shape.items()})
+    shape = mesh_axes(mesh)
+    deg = {k: int(shape.get(STRATEGIES[k], 1)) for k in STRATEGIES}
+
+    non_batch = [k for k in ("tp", "pp", "sp", "ep") if deg[k] > 1]
+    if hierarchical and non_batch:
+        raise PlanError(
+            f"hierarchical gradient sync supports batch-parallel "
+            f"meshes (dcn/data/fsdp axes); this plan also has "
+            f"{non_batch} — use the flat sync when composing with "
+            f"tensor/pipeline/sequence/expert parallelism")
+
+    rule_list: List[Tuple[Any, Callable]] = []
+    wirings: List[Tuple[str, Callable[[], Any]]] = []
+    notes: List[str] = []
+
+    # ---- sharded embedding tables (batch-parallel only) ----------------
+    from bigdl_tpu.embedding.hybrid import sharded_tables
+    tables = sharded_tables(model)
+    if tables:
+        from bigdl_tpu.embedding.hybrid import (
+            embedding_rules, resolve_hybrid,
+        )
+        # resolve_hybrid raises HybridPlanError (a PlanError) naming
+        # the failing axis/table
+        resolve_hybrid(model, mesh, plan.embedding_axis,
+                       hierarchical=hierarchical)
+        rule_list.extend(embedding_rules(model, plan.embedding_axis).rules)
+        _tables, _ax = tables, plan.embedding_axis
+
+        def wire_tables(tables=_tables, axis=_ax, mesh=mesh):
+            for t in tables.values():
+                t.set_mesh(mesh, axis)
+
+        wirings.append((
+            f"embedding: row-shard {len(tables)} table(s) over "
+            f"{plan.embedding_axis!r}", wire_tables))
+
+    # ---- user rules ----------------------------------------------------
+    if plan.rules is not None:
+        rule_list.extend(plan.rules.rules)
+
+    # ---- tensor parallelism --------------------------------------------
+    if deg["tp"] > 1:
+        rule_list.extend(_check_tp(plan, model, mesh, deg["tp"],
+                                   notes).rules)
+
+    # ---- pipeline parallelism ------------------------------------------
+    if deg["pp"] > 1:
+        _resolve_pp(plan, model, mesh, deg, compute_dtype, wirings,
+                    notes)
+
+    # ---- sequence parallelism ------------------------------------------
+    if deg["sp"] > 1:
+        if not hasattr(model, "set_sequence_parallel"):
+            raise PlanError(
+                f"sp={deg['sp']}: {type(model).__name__} has no "
+                f"sequence-parallel path (set_sequence_parallel) — "
+                f"ring attention over axis {STRATEGIES['sp']!r} "
+                f"applies to attention models (models/transformer_lm)")
+        head_axis = plan.sp_head_axis or (
+            STRATEGIES["tp"] if deg["tp"] > 1 else None)
+
+        def wire_sp(model=model, mesh=mesh, kernel=plan.sp_kernel,
+                    head_axis=head_axis):
+            model.set_sequence_parallel(mesh, STRATEGIES["sp"],
+                                        kernel=kernel,
+                                        head_axis=head_axis)
+
+        wirings.append((
+            f"sp: ring attention over {STRATEGIES['sp']!r}"
+            + (f" (heads stay on {head_axis!r})" if head_axis else ""),
+            wire_sp))
+
+    # ---- expert parallelism --------------------------------------------
+    if deg["ep"] > 1:
+        from bigdl_tpu.nn.moe import MoE
+        moes = [(p, m) for p, m in model.named_modules()
+                if isinstance(m, MoE)]
+        if not moes:
+            raise PlanError(
+                f"ep={deg['ep']}: the model has no MoE layer to "
+                f"expert-shard over axis {STRATEGIES['ep']!r} — drop "
+                f"ep from the plan or build the model on nn.moe.MoE")
+        for p, m in moes:
+            if m.num_experts % deg["ep"]:
+                raise PlanError(
+                    f"ep={deg['ep']}: MoE {p or m.name!r} has "
+                    f"{m.num_experts} experts, not divisible over "
+                    f"{deg['ep']} shards on axis {STRATEGIES['ep']!r}")
+
+        def wire_ep(moes=moes, mesh=mesh, cf=plan.ep_capacity_factor):
+            for _p, m in moes:
+                m.set_mesh(mesh, STRATEGIES["ep"], capacity_factor=cf)
+
+        wirings.append((
+            f"ep: {len(moes)} MoE layer(s) over {STRATEGIES['ep']!r} "
+            f"({'a2a cap ' + str(plan.ep_capacity_factor) if plan.ep_capacity_factor is not None else 'exact psum'})",
+            wire_ep))
+
+    if deg["fsdp"] > 1:
+        notes.append(
+            f"fsdp: unmatched parameter leaves shard their largest "
+            f"divisible dim over {STRATEGIES['fsdp']!r} (ZeRO-3 style)")
+
+    rules = ShardingRules(rule_list, fsdp=deg["fsdp"] > 1)
+    return ResolvedPlan(plan=plan, mesh_config=mesh_config, mesh=mesh,
+                        rules=rules, degrees=deg, wirings=wirings,
+                        notes=notes)
+
+
+def _resolve_pp(plan: PartitionPlan, model, mesh, deg: Dict[str, int],
+                compute_dtype, wirings, notes) -> None:
+    from bigdl_tpu.parallel.pipeline import Pipeline
+
+    s = deg["pp"]
+    axis = STRATEGIES["pp"]
+    if plan.pp_schedule not in ("gpipe", "1f1b"):
+        raise PlanError(
+            f"pp_schedule={plan.pp_schedule!r}: known schedules are "
+            f"'gpipe' and '1f1b'")
+    if deg["sp"] > 1 or deg["ep"] > 1:
+        both = [k for k in ("sp", "ep") if deg[k] > 1]
+        raise PlanError(
+            f"pp cannot compose with {both} in one program: the "
+            f"ring-attention / expert all_to_all shard_map would nest "
+            f"inside the pipeline shard_map — drop pp or {both[0]}")
+    if plan.pp_schedule == "1f1b" and compute_dtype is not None:
+        raise PlanError(
+            "pp_schedule='1f1b' does not compose with "
+            "set_compute_dtype: the in-schedule loss/backward runs at "
+            "the stage dtype — use pp_schedule='gpipe' or drop the "
+            "compute dtype")
+    n_mb = plan.pp_microbatches or s
+    if n_mb < 1:
+        raise PlanError(f"pp_microbatches={n_mb}: must be >= 1")
+
+    blocks = getattr(model, "blocks", None)
+    if isinstance(model, Pipeline):
+        n = len(model.blocks)
+        if n % s:
+            raise PlanError(
+                f"pp={s}: model has {n} blocks, not divisible into "
+                f"{s} stages on axis {axis!r}; regroup the blocks or "
+                f"lower the pp degree")
+        if plan.pp_schedule == "1f1b" \
+                and not model._blocks_homogeneous():
+            raise PlanError(
+                "pp_schedule='1f1b' needs structurally homogeneous "
+                "blocks (the stacked stage layout); this Pipeline's "
+                "blocks differ — group them into structurally-equal "
+                "stages or use pp_schedule='gpipe'")
+
+        def wire_pipe(model=model, mesh=mesh, n_mb=n_mb, axis=axis):
+            model.num_microbatches = n_mb
+            model.set_mesh(mesh, axis)
+
+        wirings.append((
+            f"pp: {n} blocks → {s} stages over {axis!r} "
+            f"({n_mb} microbatches, {plan.pp_schedule})", wire_pipe))
+        return
+
+    if hasattr(model, "set_pipeline_parallel"):
+        if plan.pp_schedule == "1f1b":
+            raise PlanError(
+                f"pp_schedule='1f1b' runs the loss inside the pipeline "
+                f"schedule, which requires the model to BE a "
+                f"parallel.Pipeline (blocks only); "
+                f"{type(model).__name__} has pre/post-block stages "
+                f"(embedding/head) — use pp_schedule='gpipe'")
+        if blocks is None or len(blocks) % s:
+            n = 0 if blocks is None else len(blocks)
+            raise PlanError(
+                f"pp={s}: {type(model).__name__} has {n} blocks, not "
+                f"divisible into {s} stages on axis {axis!r}")
+        if not _struct_homogeneous(list(blocks)):
+            raise PlanError(
+                f"pp={s}: {type(model).__name__}'s blocks are not "
+                f"structurally homogeneous; the stacked stage layout "
+                f"needs structurally-equal blocks")
+
+        def wire_model(model=model, mesh=mesh, n_mb=n_mb, axis=axis):
+            model.set_pipeline_parallel(mesh, axis,
+                                        num_microbatches=n_mb)
+
+        wirings.append((
+            f"pp: {len(blocks)} blocks → {s} stages over {axis!r} "
+            f"({n_mb} microbatches, gpipe)", wire_model))
+        return
+
+    raise PlanError(
+        f"pp={s}: {type(model).__name__} is not pipeline-stageable on "
+        f"axis {axis!r}: it is neither a parallel.Pipeline nor exposes "
+        f"set_pipeline_parallel(mesh, axis, num_microbatches) — wrap "
+        f"its layers in parallel.Pipeline([...])")

@@ -302,13 +302,17 @@ def current_topology() -> Dict:
 
 
 def checkpoint_topology(model_state: Any, optim_state: Any,
-                        mesh=None) -> Dict:
+                        mesh=None, plan: Optional[Dict] = None) -> Dict:
     """Describe the topology a checkpoint is being written FROM:
     process/device counts, the mesh axis names and sizes (from
     ``mesh`` when the writer passes its live mesh — the ``.npz``
     format gathers leaves to plain numpy first, erasing their
     shardings — else from the first ``NamedSharding`` leaf found),
-    and the per-leaf shape/dtype/PartitionSpec tree.  Metadata only —
+    and the per-leaf shape/dtype/PartitionSpec tree.  ``plan`` is the
+    writing run's partition-plan record (strategy degrees +
+    schedule, from ``Optimizer.set_partition_plan``) — with it a
+    resume can see not just the mesh shape but WHICH strategies
+    (tp/pp/...) shaped the saved shardings.  Metadata only —
     no leaf is read or transferred.  Recorded in the per-generation
     manifest so a resume onto a DIFFERENT topology can (a) know the
     checkpoint is portable before touching orbax, and (b) name both
@@ -348,6 +352,8 @@ def checkpoint_topology(model_state: Any, optim_state: Any,
         logger.warning("could not derive checkpoint topology leaves",
                        exc_info=True)
     topo["mesh"] = mesh_axes
+    if plan is not None:
+        topo["plan"] = plan
     topo["leaves"] = leaves
     return topo
 
@@ -362,6 +368,13 @@ def describe_topology(topo: Optional[Dict]) -> str:
            f"{topo.get('device_count', '?')} device(s)")
     if topo.get("mesh"):
         out += f", mesh {topo['mesh']}"
+    plan = topo.get("plan")
+    if isinstance(plan, dict) and plan.get("degrees"):
+        comp = "x".join(f"{k}{v}" for k, v in
+                        sorted(plan["degrees"].items()))
+        out += f", plan {comp}"
+        if plan.get("pp_schedule"):
+            out += f" ({plan['pp_schedule']})"
     return out
 
 
@@ -778,7 +791,7 @@ class CheckpointManager:
              driver_state: Dict, *, generation: int,
              overwrite: bool = False, sharded: bool = False,
              pipeline_state: Optional[Dict] = None,
-             mesh=None) -> str:
+             mesh=None, plan: Optional[Dict] = None) -> str:
         """Write one checkpoint generation: payload, then (payload
         verified durable) the pipeline-state sidecar, then the manifest
         recording both payloads' CRCs, then retention GC.  With
@@ -816,7 +829,7 @@ class CheckpointManager:
                         offset=pipeline_state.get("offset"))
                 try:
                     topo = checkpoint_topology(model_state, optim_state,
-                                               mesh=mesh)
+                                               mesh=mesh, plan=plan)
                 except Exception:  # pragma: no cover - best effort
                     logger.warning("could not record checkpoint "
                                    "topology", exc_info=True)

@@ -151,6 +151,17 @@ if bash "$(dirname "$0")/embedding_smoke.sh" >"$embedding_log" 2>&1; then
 else
   echo "embedding_smoke: FAILED (non-fatal ride-along; see $embedding_log)"
 fi
+# declarative-planner smoke (PartitionPlan dp2xtp2xpp2 losses == dp
+# baseline, compiled 3D step moves bytes on all three axes with the
+# dp sync within 2x the analytic floor, plan-stamped checkpoint
+# resumed under a different plan): warn-only ride-along; run
+# scripts/plan_smoke.sh standalone for the fatal form
+plan_log=$(mktemp /tmp/plan_smoke.XXXXXX.log)
+if bash "$(dirname "$0")/plan_smoke.sh" >"$plan_log" 2>&1; then
+  tail -n 1 "$plan_log"
+else
+  echo "plan_smoke: FAILED (non-fatal ride-along; see $plan_log)"
+fi
 # request-tracing smoke (chaos hard-kill mid-decode -> ONE assembled
 # trace across both replicas with exactly-once decode-span accounting,
 # tail-retained with reason failover, TTFT exemplar resolving through
